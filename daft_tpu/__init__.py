@@ -1,0 +1,91 @@
+"""daft_tpu: a TPU-native multimodal data engine with Daft's capabilities.
+
+Public API surface mirrors the reference's ``daft`` package (daft/__init__.py):
+DataFrame constructors, expression helpers, DataType, config, and AI functions
+— re-designed for JAX/XLA on TPU.
+"""
+
+from daft_tpu.context import (
+    execution_config_ctx,
+    get_context,
+    planning_config_ctx,
+    set_execution_config,
+    set_planning_config,
+    set_runner_native,
+)
+from daft_tpu.datatype import DataType, ImageFormat, ImageMode, TimeUnit
+from daft_tpu.errors import DaftError
+from daft_tpu.expressions import Expression, col, element, interval, lit
+from daft_tpu.schema import Field, Schema
+from daft_tpu.series import Series
+from daft_tpu.recordbatch import RecordBatch
+from daft_tpu.micropartition import MicroPartition
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "DataFrame",
+    "DataType",
+    "DaftError",
+    "Expression",
+    "Field",
+    "ImageFormat",
+    "ImageMode",
+    "MicroPartition",
+    "RecordBatch",
+    "Schema",
+    "Series",
+    "TimeUnit",
+    "col",
+    "element",
+    "execution_config_ctx",
+    "from_arrow",
+    "from_pandas",
+    "from_pydict",
+    "from_pylist",
+    "get_context",
+    "interval",
+    "lit",
+    "read_csv",
+    "read_json",
+    "read_parquet",
+    "set_execution_config",
+    "set_planning_config",
+    "sql",
+    "udf",
+]
+
+
+def __getattr__(name: str):
+    # Lazy imports to keep `import daft_tpu` light and cycle-free.
+    if name in ("DataFrame",):
+        from daft_tpu.dataframe.dataframe import DataFrame
+
+        return DataFrame
+    if name in ("from_pydict", "from_pylist", "from_arrow", "from_pandas", "range"):
+        from daft_tpu.dataframe import creation
+
+        return getattr(creation, name)
+    if name in ("read_parquet", "read_csv", "read_json", "read_text", "from_glob_path"):
+        from daft_tpu.io import reads
+
+        return getattr(reads, name)
+    if name == "sql":
+        from daft_tpu.sql.sql import sql
+
+        return sql
+    if name in ("func", "cls", "method", "udf"):
+        import daft_tpu.udf as udf_mod
+
+        if name == "udf":
+            return udf_mod
+        return getattr(udf_mod, name)
+    if name == "functions":
+        import daft_tpu.functions as fns
+
+        return fns
+    if name == "Window":
+        from daft_tpu.window import Window
+
+        return Window
+    raise AttributeError(f"module 'daft_tpu' has no attribute {name!r}")

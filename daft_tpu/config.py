@@ -1,0 +1,80 @@
+"""Planning and execution configuration.
+
+Reference: ``DaftPlanningConfig`` / ``DaftExecutionConfig``
+(src/common/daft-config/src/lib.rs:120-200, ~35 flags). Frozen dataclasses
+threaded through the context; TPU-specific knobs (device_eval, batch-shape
+bucketing to avoid XLA recompiles) extend the reference's set.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from dataclasses import dataclass
+from typing import Optional, Tuple
+
+
+@dataclass(frozen=True)
+class PlanningConfig:
+    default_io_config: Optional[object] = None
+
+    def with_changes(self, **kwargs) -> "PlanningConfig":
+        return dataclasses.replace(self, **kwargs)
+
+
+@dataclass(frozen=True)
+class ExecutionConfig:
+    # Scan task sizing (reference defaults: lib.rs:165-200)
+    scan_tasks_min_size_bytes: int = 96 * 1024 * 1024
+    scan_tasks_max_size_bytes: int = 384 * 1024 * 1024
+    max_sources_per_scan_task: int = 10
+    # Join strategy
+    broadcast_join_size_bytes_threshold: int = 10 * 1024 * 1024
+    sort_merge_join_sort_with_aligned_boundaries: bool = False
+    # Partitioning
+    hash_join_partition_size_leniency: float = 0.5
+    num_preview_rows: int = 8
+    default_morsel_size: int = 128 * 1024
+    target_batch_size_bytes: int = 64 * 1024 * 1024
+    shuffle_algorithm: str = "auto"  # "auto" | "flight" | "in_memory"
+    flight_shuffle_dirs: Tuple[str, ...] = ("/tmp",)
+    partial_aggregation_threshold: int = 10_000
+    high_cardinality_aggregation_threshold: float = 0.8
+    # Reader/writer
+    parquet_target_filesize: int = 512 * 1024 * 1024
+    parquet_target_row_group_size: int = 128 * 1024 * 1024
+    parquet_inflation_factor: float = 3.0
+    csv_target_filesize: int = 512 * 1024 * 1024
+    csv_inflation_factor: float = 0.5
+    json_target_filesize: int = 512 * 1024 * 1024
+    read_sql_partition_size_bytes: int = 512 * 1024 * 1024
+    # Execution
+    enable_aqe: bool = False
+    default_maintain_order: bool = True
+    enable_strict_filter_pushdown: bool = True
+    min_cpu_per_task: float = 0.5
+    memory_limit_bytes: Optional[int] = None
+    # TPU-specific
+    device_eval: bool = True
+    device_eval_min_rows: int = 1024
+    device_batch_buckets: Tuple[int, ...] = (1024, 4096, 16384, 65536, 131072)
+    tpu_chips_per_host: int = 0  # 0 = autodetect
+    # Distributed
+    num_workers: int = 0  # 0 = autodetect / local
+    autoscaling_threshold: float = 1.25
+
+    def with_changes(self, **kwargs) -> "ExecutionConfig":
+        return dataclasses.replace(self, **kwargs)
+
+    @staticmethod
+    def from_env() -> "ExecutionConfig":
+        cfg = ExecutionConfig()
+        env_memory = os.environ.get("DAFT_MEMORY_LIMIT")
+        changes = {}
+        if env_memory:
+            changes["memory_limit_bytes"] = int(env_memory)
+        if os.environ.get("DAFT_TPU_DEVICE_EVAL") in ("0", "false"):
+            changes["device_eval"] = False
+        if os.environ.get("DAFT_SHUFFLE_ALGORITHM"):
+            changes["shuffle_algorithm"] = os.environ["DAFT_SHUFFLE_ALGORITHM"]
+        return cfg.with_changes(**changes) if changes else cfg

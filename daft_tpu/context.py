@@ -1,0 +1,117 @@
+"""Global engine context: config + runner handle + subscribers.
+
+Reference: ``DaftContext`` (src/daft-context/src/lib.rs) and daft/context.py
+(set_runner_*, set_execution_config, execution_config_ctx).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Iterator, List, Optional
+
+from daft_tpu.config import ExecutionConfig, PlanningConfig
+
+
+class DaftContext:
+    def __init__(self) -> None:
+        self._lock = threading.RLock()
+        self.planning_config = PlanningConfig()
+        self.execution_config = ExecutionConfig.from_env()
+        self._runner = None
+        self._subscribers: List[object] = []
+
+    # -- runner -----------------------------------------------------------
+    def get_or_create_runner(self):
+        with self._lock:
+            if self._runner is None:
+                import os
+
+                which = os.environ.get("DAFT_RUNNER", "native").lower()
+                if which in ("native", "py"):
+                    from daft_tpu.runners.native import NativeRunner
+
+                    self._runner = NativeRunner()
+                elif which in ("flotilla", "distributed"):
+                    from daft_tpu.runners.distributed import DistributedRunner
+
+                    self._runner = DistributedRunner()
+                else:
+                    raise ValueError(f"Unknown DAFT_RUNNER: {which}")
+            return self._runner
+
+    def set_runner(self, runner) -> None:
+        with self._lock:
+            self._runner = runner
+
+    # -- subscribers ------------------------------------------------------
+    def attach_subscriber(self, subscriber) -> None:
+        with self._lock:
+            self._subscribers.append(subscriber)
+
+    def detach_subscriber(self, subscriber) -> None:
+        with self._lock:
+            self._subscribers.remove(subscriber)
+
+    def subscribers(self) -> List[object]:
+        return list(self._subscribers)
+
+    def notify(self, event) -> None:
+        for s in self.subscribers():
+            try:
+                s.on_event(event)
+            except Exception:
+                pass
+
+
+_CONTEXT = DaftContext()
+
+
+def get_context() -> DaftContext:
+    return _CONTEXT
+
+
+def set_execution_config(config: Optional[ExecutionConfig] = None, **kwargs) -> None:
+    ctx = get_context()
+    base = config or ctx.execution_config
+    ctx.execution_config = base.with_changes(**kwargs) if kwargs else base
+
+
+def set_planning_config(config: Optional[PlanningConfig] = None, **kwargs) -> None:
+    ctx = get_context()
+    base = config or ctx.planning_config
+    ctx.planning_config = base.with_changes(**kwargs) if kwargs else base
+
+
+@contextlib.contextmanager
+def execution_config_ctx(**kwargs) -> Iterator[None]:
+    ctx = get_context()
+    old = ctx.execution_config
+    try:
+        ctx.execution_config = old.with_changes(**kwargs)
+        yield
+    finally:
+        ctx.execution_config = old
+
+
+@contextlib.contextmanager
+def planning_config_ctx(**kwargs) -> Iterator[None]:
+    ctx = get_context()
+    old = ctx.planning_config
+    try:
+        ctx.planning_config = old.with_changes(**kwargs)
+        yield
+    finally:
+        ctx.planning_config = old
+
+
+def set_runner_native() -> None:
+    from daft_tpu.runners.native import NativeRunner
+
+    get_context().set_runner(NativeRunner())
+
+
+def set_runner_distributed(**kwargs) -> None:
+    from daft_tpu.runners.distributed import DistributedRunner
+
+    get_context().set_runner(DistributedRunner(**kwargs))

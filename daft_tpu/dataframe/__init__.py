@@ -1,0 +1,3 @@
+from daft_tpu.dataframe.dataframe import DataFrame
+
+__all__ = ["DataFrame"]

@@ -1,0 +1,403 @@
+"""DataFrame: the lazy user-facing API.
+
+Reference: daft/dataframe/dataframe.py (162 methods over a LogicalPlanBuilder).
+A DataFrame wraps an immutable LogicalPlanBuilder; transformations return new
+DataFrames; materialisation goes through the context's runner.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Iterator, List, Optional, Sequence, Union
+
+from daft_tpu.context import get_context
+from daft_tpu.datatype import DataType
+from daft_tpu.errors import DaftValueError
+from daft_tpu.expressions.expr import ColumnRef
+from daft_tpu.expressions.expression import Expression, col, lit
+from daft_tpu.logical.builder import LogicalPlanBuilder
+from daft_tpu.micropartition import MicroPartition
+from daft_tpu.schema import Schema
+
+ColumnInput = Union[str, Expression]
+
+
+def _to_expr(c: ColumnInput) -> Expression:
+    if isinstance(c, Expression):
+        return c
+    if isinstance(c, str):
+        return col(c)
+    raise DaftValueError(f"Expected column name or Expression, got {type(c)}")
+
+
+def _inner(exprs: Sequence[ColumnInput]) -> list:
+    return [_to_expr(e)._expr for e in exprs]
+
+
+class DataFrame:
+    def __init__(self, builder: LogicalPlanBuilder):
+        self._builder = builder
+        self._result: Optional[List[MicroPartition]] = None
+
+    # ------------------------------------------------------------------ #
+    # Introspection                                                       #
+    # ------------------------------------------------------------------ #
+    @property
+    def schema(self) -> Schema:
+        return self._builder.schema
+
+    @property
+    def column_names(self) -> List[str]:
+        return self._builder.schema.column_names()
+
+    @property
+    def columns(self) -> List[Expression]:
+        return [col(n) for n in self.column_names]
+
+    def __contains__(self, name: str) -> bool:
+        return name in self._builder.schema
+
+    def __getitem__(self, key) -> Expression:
+        if isinstance(key, str):
+            if key not in self._builder.schema:
+                raise DaftValueError(f"Column {key!r} not in schema")
+            return col(key)
+        if isinstance(key, int):
+            return col(self.column_names[key])
+        raise DaftValueError(f"Cannot index DataFrame with {key!r}")
+
+    def explain(self, show_all: bool = False) -> None:
+        print(self._builder.explain_string(show_all))
+
+    def __repr__(self) -> str:
+        if self._result is not None:
+            return self._preview_str()
+        names = ", ".join(f"{f.name}: {f.dtype!r}" for f in self.schema)
+        return f"DataFrame({names})\n(unmaterialized — call .collect() or .show())"
+
+    # ------------------------------------------------------------------ #
+    # Transformations                                                     #
+    # ------------------------------------------------------------------ #
+    def _with(self, builder: LogicalPlanBuilder) -> "DataFrame":
+        return DataFrame(builder)
+
+    def select(self, *columns: ColumnInput) -> "DataFrame":
+        return self._with(self._builder.select(_inner(columns)))
+
+    def with_column(self, name: str, expr: Expression) -> "DataFrame":
+        return self.with_columns({name: expr})
+
+    def with_columns(self, columns: Dict[str, Expression]) -> "DataFrame":
+        exprs = [_to_expr(e).alias(n)._expr for n, e in columns.items()]
+        return self._with(self._builder.with_columns(exprs))
+
+    def with_column_renamed(self, existing: str, new: str) -> "DataFrame":
+        return self.with_columns_renamed({existing: new})
+
+    def with_columns_renamed(self, mapping: Dict[str, str]) -> "DataFrame":
+        exprs = []
+        for f in self.schema:
+            if f.name in mapping:
+                exprs.append(col(f.name).alias(mapping[f.name])._expr)
+            else:
+                exprs.append(ColumnRef(f.name))
+        return self._with(self._builder.project(exprs))
+
+    def exclude(self, *names: str) -> "DataFrame":
+        return self._with(self._builder.exclude(list(names)))
+
+    def where(self, predicate: Union[Expression, str]) -> "DataFrame":
+        if isinstance(predicate, str):
+            from daft_tpu.sql.sql import sql_expr
+
+            predicate = sql_expr(predicate)
+        return self._with(self._builder.filter(predicate._expr))
+
+    filter = where
+
+    def limit(self, n: int, offset: int = 0) -> "DataFrame":
+        return self._with(self._builder.limit(n, offset))
+
+    def offset(self, n: int) -> "DataFrame":
+        return self._with(self._builder.limit(1 << 62, n))
+
+    def sample(self, fraction: Optional[float] = None, *, size: Optional[int] = None,
+               with_replacement: bool = False, seed: Optional[int] = None) -> "DataFrame":
+        return self._with(self._builder.sample(fraction, size, with_replacement, seed))
+
+    def sort(self, by: Union[ColumnInput, List[ColumnInput]], desc: Union[bool, List[bool]] = False,
+             nulls_first: Optional[Union[bool, List[bool]]] = None) -> "DataFrame":
+        by = by if isinstance(by, list) else [by]
+        desc = desc if isinstance(desc, list) else [desc] * len(by)
+        if nulls_first is not None and not isinstance(nulls_first, list):
+            nulls_first = [nulls_first] * len(by)
+        return self._with(self._builder.sort(_inner(by), desc, nulls_first))
+
+    def distinct(self, *on: ColumnInput) -> "DataFrame":
+        return self._with(self._builder.distinct(_inner(on) if on else None))
+
+    unique = distinct
+    drop_duplicates = distinct
+
+    def explode(self, *columns: ColumnInput) -> "DataFrame":
+        return self._with(self._builder.explode(_inner(columns)))
+
+    def unpivot(self, ids: Sequence[ColumnInput], values: Sequence[ColumnInput] = (),
+                variable_name: str = "variable", value_name: str = "value") -> "DataFrame":
+        ids_e = _inner(ids)
+        if not values:
+            id_names = {e.name() for e in ids_e}
+            values = [f.name for f in self.schema if f.name not in id_names]
+        return self._with(self._builder.unpivot(ids_e, _inner(values), variable_name, value_name))
+
+    melt = unpivot
+
+    def pivot(self, group_by: Union[ColumnInput, List[ColumnInput]], pivot_col: ColumnInput,
+              value_col: ColumnInput, agg_fn: str, names: Optional[List[str]] = None) -> "DataFrame":
+        group_by = group_by if isinstance(group_by, list) else [group_by]
+        if names is None:
+            distinct_vals = (
+                self.select(pivot_col).distinct().to_pydict()
+            )
+            names = [str(v) for v in next(iter(distinct_vals.values()))]
+        return self._with(self._builder.pivot(
+            _inner(group_by), _to_expr(pivot_col)._expr, _to_expr(value_col)._expr, agg_fn, names
+        ))
+
+    def transform(self, func, *args, **kwargs) -> "DataFrame":
+        out = func(self, *args, **kwargs)
+        if not isinstance(out, DataFrame):
+            raise DaftValueError("transform function must return a DataFrame")
+        return out
+
+    def add_monotonically_increasing_id(self, column_name: str = "id") -> "DataFrame":
+        return self._with(self._builder.add_monotonically_increasing_id(column_name))
+
+    # -- joins ------------------------------------------------------------
+    def join(self, other: "DataFrame", on: Optional[Union[ColumnInput, List[ColumnInput]]] = None,
+             left_on=None, right_on=None, how: str = "inner", strategy: Optional[str] = None,
+             suffix: str = "right.", prefix: str = "") -> "DataFrame":
+        if on is not None:
+            on = on if isinstance(on, list) else [on]
+            left_on = right_on = on
+        if left_on is None or right_on is None:
+            raise DaftValueError("join requires `on` or both `left_on` and `right_on`")
+        left_on = left_on if isinstance(left_on, list) else [left_on]
+        right_on = right_on if isinstance(right_on, list) else [right_on]
+        return self._with(self._builder.join(
+            other._builder, _inner(left_on), _inner(right_on), how, strategy, suffix, prefix
+        ))
+
+    def cross_join(self, other: "DataFrame", suffix: str = "right.") -> "DataFrame":
+        return self._with(self._builder.cross_join(other._builder, suffix))
+
+    def concat(self, other: "DataFrame") -> "DataFrame":
+        return self._with(self._builder.concat(other._builder))
+
+    union = concat
+
+    def intersect(self, other: "DataFrame") -> "DataFrame":
+        return self._with(self._builder.intersect(other._builder))
+
+    def intersect_all(self, other: "DataFrame") -> "DataFrame":
+        return self._with(self._builder.intersect(other._builder, is_all=True))
+
+    def except_distinct(self, other: "DataFrame") -> "DataFrame":
+        return self._with(self._builder.except_(other._builder))
+
+    # -- aggregation ------------------------------------------------------
+    def agg(self, *exprs: Expression) -> "DataFrame":
+        exprs = _flatten(exprs)
+        return self._with(self._builder.aggregate(_inner(exprs), []))
+
+    def groupby(self, *group_by: ColumnInput) -> "GroupedDataFrame":
+        from daft_tpu.dataframe.groupby import GroupedDataFrame
+
+        return GroupedDataFrame(self, _flatten(group_by))
+
+    group_by = groupby
+
+    def _agg_all(self, op: str) -> "DataFrame":
+        exprs = []
+        for f in self.schema:
+            if op in ("min", "max", "count", "any_value") or f.dtype.is_numeric():
+                exprs.append(getattr(col(f.name), op)())
+        return self.agg(*exprs)
+
+    def sum(self, *cols: ColumnInput) -> "DataFrame":
+        return self.agg(*[_to_expr(c).sum() for c in cols]) if cols else self._agg_all("sum")
+
+    def mean(self, *cols: ColumnInput) -> "DataFrame":
+        return self.agg(*[_to_expr(c).mean() for c in cols]) if cols else self._agg_all("mean")
+
+    def min(self, *cols: ColumnInput) -> "DataFrame":
+        return self.agg(*[_to_expr(c).min() for c in cols]) if cols else self._agg_all("min")
+
+    def max(self, *cols: ColumnInput) -> "DataFrame":
+        return self.agg(*[_to_expr(c).max() for c in cols]) if cols else self._agg_all("max")
+
+    def stddev(self, *cols: ColumnInput) -> "DataFrame":
+        return self.agg(*[_to_expr(c).stddev() for c in cols]) if cols else self._agg_all("stddev")
+
+    def any_value(self, *cols: ColumnInput) -> "DataFrame":
+        return self.agg(*[_to_expr(c).any_value() for c in cols]) if cols else self._agg_all("any_value")
+
+    def agg_list(self, *cols: ColumnInput) -> "DataFrame":
+        return self.agg(*[_to_expr(c).agg_list() for c in cols])
+
+    def agg_concat(self, *cols: ColumnInput) -> "DataFrame":
+        return self.agg(*[_to_expr(c).agg_concat() for c in cols])
+
+    def count(self, *cols: ColumnInput) -> "DataFrame":
+        if cols:
+            return self.agg(*[_to_expr(c).count() for c in cols])
+        return self.agg(lit(1).count().alias("count"))
+
+    def count_rows(self) -> int:
+        result = self.count().to_pydict()
+        return int(next(iter(result.values()))[0])
+
+    def __len__(self) -> int:
+        return self.count_rows()
+
+    # -- partitioning -----------------------------------------------------
+    def repartition(self, num: int, *partition_by: ColumnInput) -> "DataFrame":
+        if partition_by:
+            return self._with(self._builder.repartition_hash(_inner(partition_by), num))
+        return self._with(self._builder.repartition_random(num))
+
+    def into_partitions(self, num: int) -> "DataFrame":
+        return self._with(self._builder.into_partitions(num))
+
+    def shard(self, strategy: str = "file", world_size: int = 1, rank: int = 0) -> "DataFrame":
+        return self._with(self._builder.shard(strategy, world_size, rank))
+
+    def num_partitions(self) -> int:
+        return max(1, len(self._materialize().partitions))
+
+    # ------------------------------------------------------------------ #
+    # Materialisation                                                     #
+    # ------------------------------------------------------------------ #
+    def _materialize(self):
+        from daft_tpu.runners.runner import PartitionCacheEntry
+
+        if self._result is None:
+            runner = get_context().get_or_create_runner()
+            entry = runner.run(self._builder)
+            self._result = entry.partitions
+        from daft_tpu.runners.runner import PartitionCacheEntry
+
+        return PartitionCacheEntry(self._result)
+
+    def collect(self) -> "DataFrame":
+        self._materialize()
+        return self
+
+    def show(self, n: int = 8) -> None:
+        print(self.limit(n)._materialize_preview(n))
+
+    def _materialize_preview(self, n: int) -> str:
+        parts = self._materialize().partitions
+        mp = MicroPartition.concat(parts) if parts else MicroPartition.empty(self.schema)
+        return mp.combined().preview_string(n)
+
+    def _preview_str(self) -> str:
+        parts = self._result or []
+        mp = MicroPartition.concat(parts) if parts else MicroPartition.empty(self.schema)
+        return mp.combined().preview_string(get_context().execution_config.num_preview_rows)
+
+    def iter_partitions(self) -> Iterator[MicroPartition]:
+        if self._result is not None:
+            yield from self._result
+            return
+        runner = get_context().get_or_create_runner()
+        yield from runner.run_iter(self._builder)
+
+    def iter_rows(self) -> Iterator[Dict[str, Any]]:
+        for part in self.iter_partitions():
+            rb = part.combined()
+            cols = {c.name: c.to_pylist() for c in rb.columns()}
+            for i in range(len(rb)):
+                yield {k: v[i] for k, v in cols.items()}
+
+    def to_pydict(self) -> Dict[str, list]:
+        parts = self._materialize().partitions
+        if not parts:
+            return {f.name: [] for f in self.schema}
+        return MicroPartition.concat(parts).to_pydict()
+
+    def to_pylist(self) -> List[Dict[str, Any]]:
+        return list(self.iter_rows())
+
+    def to_arrow(self):
+        parts = self._materialize().partitions
+        if not parts:
+            return self.schema.to_arrow().empty_table()
+        return MicroPartition.concat(parts).to_arrow_table()
+
+    def to_pandas(self):
+        parts = self._materialize().partitions
+        if not parts:
+            import pandas as pd
+
+            return pd.DataFrame({f.name: [] for f in self.schema})
+        return MicroPartition.concat(parts).combined().to_pandas()
+
+    def to_torch_iter_dataset(self):
+        import torch.utils.data
+
+        df = self
+
+        class _IterDataset(torch.utils.data.IterableDataset):
+            def __iter__(self):
+                return df.iter_rows()
+
+        return _IterDataset()
+
+    # ------------------------------------------------------------------ #
+    # Writes                                                              #
+    # ------------------------------------------------------------------ #
+    def _write(self, file_format: str, root_dir: str, partition_cols=None,
+               compression=None, write_mode="append") -> "DataFrame":
+        from daft_tpu.io.writers import WriteInfo
+
+        info = WriteInfo(
+            file_format=file_format, root_dir=str(root_dir),
+            partition_cols=_inner(partition_cols) if partition_cols else None,
+            compression=compression, write_mode=write_mode,
+        )
+        out = self._with(self._builder.table_write(info))
+        return out.collect()
+
+    def write_parquet(self, root_dir: str, compression: str = "snappy",
+                      partition_cols=None, write_mode: str = "append") -> "DataFrame":
+        return self._write("parquet", root_dir, partition_cols, compression, write_mode)
+
+    def write_csv(self, root_dir: str, partition_cols=None, write_mode: str = "append") -> "DataFrame":
+        return self._write("csv", root_dir, partition_cols, None, write_mode)
+
+    def write_json(self, root_dir: str, partition_cols=None, write_mode: str = "append") -> "DataFrame":
+        return self._write("json", root_dir, partition_cols, None, write_mode)
+
+    def write_ipc(self, root_dir: str, partition_cols=None, write_mode: str = "append") -> "DataFrame":
+        return self._write("ipc", root_dir, partition_cols, None, write_mode)
+
+    def write_sink(self, sink) -> "DataFrame":
+        """Write through a pluggable DataSink (reference: daft/io/sink.py)."""
+        sink.start()
+        results = []
+        for part in self.iter_partitions():
+            results.append(sink.write(part))
+        final = sink.finalize(results)
+        from daft_tpu.dataframe import creation
+
+        return creation.from_pydict(final if isinstance(final, dict) else {"result": [repr(final)]})
+
+
+def _flatten(items) -> list:
+    out = []
+    for it in items:
+        if isinstance(it, (list, tuple)):
+            out.extend(it)
+        else:
+            out.append(it)
+    return out

@@ -1,0 +1,72 @@
+"""GroupedDataFrame (reference: daft/dataframe — GroupedDataFrame API)."""
+
+from __future__ import annotations
+
+from typing import Dict, List, Union
+
+from daft_tpu.errors import DaftValueError
+from daft_tpu.expressions.expression import Expression, col
+
+
+class GroupedDataFrame:
+    def __init__(self, df, group_by: List):
+        from daft_tpu.dataframe.dataframe import _to_expr
+
+        self._df = df
+        self._group_by = [_to_expr(g) for g in group_by]
+
+    def agg(self, *exprs: Expression):
+        from daft_tpu.dataframe.dataframe import DataFrame, _flatten
+
+        exprs = _flatten(exprs)
+        return DataFrame(self._df._builder.aggregate(
+            [e._expr for e in exprs], [g._expr for g in self._group_by]
+        ))
+
+    def _agg_all(self, op: str):
+        group_names = {g.name() for g in self._group_by}
+        exprs = []
+        for f in self._df.schema:
+            if f.name in group_names:
+                continue
+            if op in ("min", "max", "count", "any_value", "agg_list", "agg_concat") or f.dtype.is_numeric():
+                exprs.append(getattr(col(f.name), op)())
+        return self.agg(*exprs)
+
+    def sum(self, *cols):
+        return self.agg(*[_e(c).sum() for c in cols]) if cols else self._agg_all("sum")
+
+    def mean(self, *cols):
+        return self.agg(*[_e(c).mean() for c in cols]) if cols else self._agg_all("mean")
+
+    def min(self, *cols):
+        return self.agg(*[_e(c).min() for c in cols]) if cols else self._agg_all("min")
+
+    def max(self, *cols):
+        return self.agg(*[_e(c).max() for c in cols]) if cols else self._agg_all("max")
+
+    def count(self, *cols):
+        from daft_tpu.expressions.expression import lit
+
+        if cols:
+            return self.agg(*[_e(c).count() for c in cols])
+        return self.agg(lit(1).count().alias("count"))
+
+    def stddev(self, *cols):
+        return self.agg(*[_e(c).stddev() for c in cols]) if cols else self._agg_all("stddev")
+
+    def any_value(self, *cols):
+        return self.agg(*[_e(c).any_value() for c in cols]) if cols else self._agg_all("any_value")
+
+    def agg_list(self, *cols):
+        return self.agg(*[_e(c).agg_list() for c in cols]) if cols else self._agg_all("agg_list")
+
+    def agg_concat(self, *cols):
+        return self.agg(*[_e(c).agg_concat() for c in cols]) if cols else self._agg_all("agg_concat")
+
+    def map_groups(self, udf_expr):
+        raise NotImplementedError("map_groups lands with the UDAF layer")
+
+
+def _e(c) -> Expression:
+    return c if isinstance(c, Expression) else col(c)

@@ -1,0 +1,44 @@
+"""Error hierarchy for the engine.
+
+Mirrors the reference's ``DaftError`` / ``DaftResult`` error taxonomy
+(reference: src/common/error/src/lib.rs) as Python exceptions.
+"""
+
+from __future__ import annotations
+
+
+class DaftError(Exception):
+    """Base class for all engine errors."""
+
+
+class DaftTypeError(DaftError, TypeError):
+    """Type mismatch in expressions, casts, or kernels."""
+
+
+class DaftSchemaError(DaftError):
+    """Schema mismatch / unresolvable field."""
+
+
+class DaftValueError(DaftError, ValueError):
+    """Invalid argument value."""
+
+
+class DaftNotImplementedError(DaftError, NotImplementedError):
+    """Feature not implemented yet."""
+
+
+class DaftIOError(DaftError, IOError):
+    """IO-layer failure (object store, file format decode)."""
+
+
+class DaftPlanError(DaftError):
+    """Logical/physical planning failure."""
+
+
+class DaftExecutionError(DaftError):
+    """Runtime execution failure."""
+
+
+class DaftTransientError(DaftError):
+    """Retryable failure (mirrors reference retry taxonomy in
+    src/daft-io/src/retry.rs and python_udf/retry.rs)."""

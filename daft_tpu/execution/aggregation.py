@@ -1,0 +1,197 @@
+"""Two-phase (partial → merge → finalize) aggregation.
+
+Reference: the reference's grouped-aggregate blocking sink performs partial
+aggregation per input morsel and merges partials at finalize
+(src/daft-local-execution/src/sinks/{aggregate,grouped_aggregate}.rs). The
+same decomposition drives distributed aggregation (partial on workers, merge
+on the reducer). Each AggOp decomposes into:
+
+* partial aggs  — run per morsel/partition,
+* merge aggs    — re-aggregate partial columns (associative),
+* a final expr  — computes the user-visible value from merged columns.
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence, Tuple
+
+from daft_tpu.datatype import DataType
+from daft_tpu.errors import DaftValueError
+from daft_tpu.expressions.expr import (
+    AggOp,
+    Alias,
+    BinaryOp,
+    Cast,
+    ColumnRef,
+    Expr,
+    FunctionCall,
+)
+from daft_tpu.micropartition import MicroPartition
+from daft_tpu.recordbatch import RecordBatch
+
+
+class TwoPhasePlan:
+    """Decomposition of a full aggregation into partial/merge/final exprs."""
+
+    def __init__(self, agg_exprs: Sequence[Expr], group_by: Sequence[Expr]):
+        self.group_by = list(group_by)
+        self.key_names = [g.name() for g in self.group_by]
+        self.partial_exprs: List[Expr] = []
+        self.merge_exprs: List[Expr] = []
+        final_map = {}
+        counter = [0]
+
+        def decompose(agg: AggOp) -> Expr:
+            """Register partial+merge aggs; return the final expr for this agg."""
+            i = counter[0]
+            counter[0] += 1
+            op = agg.op
+            child = agg.child
+
+            def add(suffix: str, partial: AggOp, merge_op: str, merge_kwargs=None) -> ColumnRef:
+                name = f"__p{i}_{suffix}"
+                self.partial_exprs.append(Alias(partial, name))
+                self.merge_exprs.append(Alias(AggOp(merge_op, ColumnRef(name), merge_kwargs), name))
+                return ColumnRef(name)
+
+            if op in ("sum", "min", "max", "bool_and", "bool_or"):
+                return add("v", AggOp(op, child), op)
+            if op == "any_value":
+                return add("v", agg, "any_value", agg.kwargs)
+            if op == "count":
+                c = add("c", AggOp("count", child, agg.kwargs), "sum")
+                return Cast(c, DataType.uint64())
+            if op == "mean":
+                s = add("s", AggOp("sum", Cast(child, DataType.float64())), "sum")
+                c = add("c", AggOp("count", child), "sum")
+                return BinaryOp("truediv", s, Cast(c, DataType.float64()))
+            if op == "list":
+                l = add("l", AggOp("list", child), "concat")
+                return l
+            if op == "concat":
+                return add("l", AggOp("concat", child), "concat")
+            if op in ("count_distinct", "approx_count_distinct"):
+                l = add("l", AggOp("list", child), "concat")
+                return FunctionCall("list_count_distinct", [l])
+            if op in ("stddev", "variance"):
+                cf = Cast(child, DataType.float64())
+                s = add("s", AggOp("sum", cf), "sum")
+                s2 = add("s2", AggOp("sum", BinaryOp("mul", cf, cf)), "sum")
+                c = add("c", AggOp("count", child), "sum")
+                cF = Cast(c, DataType.float64())
+                mean = BinaryOp("truediv", s, cF)
+                var = BinaryOp("sub", BinaryOp("truediv", s2, cF), BinaryOp("mul", mean, mean))
+                var = FunctionCall("clip", [var], {"min": 0.0, "max": None})
+                if op == "variance":
+                    return var
+                return FunctionCall("sqrt", [var])
+            if op == "skew":
+                cf = Cast(child, DataType.float64())
+                s = add("s", AggOp("sum", cf), "sum")
+                s2 = add("s2", AggOp("sum", BinaryOp("mul", cf, cf)), "sum")
+                s3 = add("s3", AggOp("sum", BinaryOp("mul", BinaryOp("mul", cf, cf), cf)), "sum")
+                c = add("c", AggOp("count", child), "sum")
+                cF = Cast(c, DataType.float64())
+                m = BinaryOp("truediv", s, cF)
+                m2 = BinaryOp("sub", BinaryOp("truediv", s2, cF), BinaryOp("mul", m, m))
+                m3 = BinaryOp(
+                    "add",
+                    BinaryOp("sub", BinaryOp("truediv", s3, cF),
+                             BinaryOp("mul", BinaryOp("mul", m, BinaryOp("truediv", s2, cF)),
+                                      Cast(_lit(3.0), DataType.float64()))),
+                    BinaryOp("mul", Cast(_lit(2.0), DataType.float64()),
+                             BinaryOp("mul", BinaryOp("mul", m, m), m)),
+                )
+                denom = FunctionCall("pow_3_2", [m2])
+                return BinaryOp("truediv", m3, denom)
+            if op == "approx_percentile":
+                l = add("l", AggOp("list", Cast(child, DataType.float64())), "concat")
+                return FunctionCall("list_quantile", [l], {"percentiles": agg.kwargs.get("percentiles")})
+            raise DaftValueError(f"Cannot decompose agg op {op}")
+
+        self.final_exprs: List[Expr] = []
+        for e in agg_exprs:
+            def rewrite(n: Expr):
+                if isinstance(n, AggOp):
+                    return decompose(n)
+                return None
+
+            self.final_exprs.append(Alias(e.transform(rewrite), e.name()))
+
+        self.merge_group_by = [ColumnRef(n) for n in self.key_names]
+
+
+def _lit(v):
+    from daft_tpu.expressions.expr import Literal
+
+    return Literal(v)
+
+
+class AggState:
+    """Streaming aggregation state: partial-agg each morsel, periodically merge
+    (bounded memory), finalize at end-of-stream."""
+
+    MERGE_THRESHOLD_ROWS = 1 << 20
+
+    def __init__(self, agg_exprs: Sequence[Expr], group_by: Sequence[Expr], out_schema,
+                 input_schema=None):
+        self.plan = TwoPhasePlan(agg_exprs, group_by)
+        self.out_schema = out_schema
+        self.input_schema = input_schema
+        self._buffers: List[RecordBatch] = []
+        self._buffer_rows = 0
+
+    def accumulate(self, mp: MicroPartition) -> None:
+        rb = mp.combined()
+        if len(rb) == 0:
+            return
+        partial = rb.agg(self.plan.partial_exprs, self.plan.group_by)
+        self._buffers.append(partial)
+        self._buffer_rows += len(partial)
+        if self._buffer_rows > self.MERGE_THRESHOLD_ROWS:
+            self._merge()
+
+    def _merge(self) -> None:
+        if not self._buffers:
+            return
+        merged = RecordBatch.concat(self._buffers).agg(
+            self.plan.merge_exprs, self.plan.merge_group_by
+        )
+        self._buffers = [merged]
+        self._buffer_rows = len(merged)
+
+    def partial_batches(self) -> List[RecordBatch]:
+        """Expose merged partial state (for distributed shuffle of partials)."""
+        self._merge()
+        return list(self._buffers)
+
+    def finalize(self) -> RecordBatch:
+        from daft_tpu.expressions.evaluator import evaluate
+
+        if not self._buffers:
+            if self.plan.group_by:
+                return RecordBatch.empty(self.out_schema)
+            # Global agg over empty input still yields one row: run the
+            # partial phase over an empty batch of the input schema.
+            empty = RecordBatch.empty(self.input_schema)
+            merged = empty.agg(self.plan.partial_exprs, [])
+        else:
+            self._merge()
+            merged = self._buffers[0]
+        key_cols = [merged.get_column(n) for n in self.plan.key_names] if self.plan.group_by else []
+        out_cols = key_cols + [
+            evaluate(e, merged).rename(e.name()) for e in self.plan.final_exprs
+        ]
+        from daft_tpu.schema import Field, Schema
+
+        out = RecordBatch(
+            Schema([Field(c.name, c.dtype) for c in out_cols]), out_cols, len(merged)
+        )
+        # Cast to the statically-resolved output schema.
+        casted = []
+        for f in self.out_schema:
+            c = out.get_column(f.name)
+            casted.append(c.cast(f.dtype) if c.dtype != f.dtype else c)
+        return RecordBatch(self.out_schema, casted, len(out))
+
+

@@ -1,0 +1,125 @@
+"""Window function evaluation.
+
+Reference: the four window blocking sinks in src/daft-local-execution/src/sinks
+(window_partition_only, window_partition_and_order_by,
+window_partition_and_dynamic_frame, window_order_by_only) + daft/window.py.
+Round-1 support: partition_by (+ optional order_by) with row_number / rank /
+dense_rank / percent_rank and whole-partition aggregates.
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence
+
+import numpy as np
+
+from daft_tpu.errors import DaftValueError
+from daft_tpu.expressions.evaluator import evaluate
+from daft_tpu.expressions.expr import AggOp, Alias, Expr, WindowExpr
+from daft_tpu.recordbatch import RecordBatch, _group_codes
+from daft_tpu.schema import Field, Schema
+from daft_tpu.series import Series
+
+
+def eval_windows(rb: RecordBatch, window_exprs: Sequence[Expr], out_schema: Schema) -> RecordBatch:
+    out_cols = list(rb.columns())
+    for e in window_exprs:
+        name = e.name()
+        w = e
+        while isinstance(w, Alias):
+            w = w.child
+        if not isinstance(w, WindowExpr):
+            raise DaftValueError(f"Expected window expression, got {e!r}")
+        out_cols.append(_eval_one(rb, w).rename(name))
+    cols = []
+    for f in out_schema:
+        c = next(c for c in out_cols if c.name == f.name)
+        cols.append(c.cast(f.dtype) if c.dtype != f.dtype else c)
+    return RecordBatch(out_schema, cols, len(rb))
+
+
+def _eval_one(rb: RecordBatch, w: WindowExpr) -> Series:
+    n = len(rb)
+    if w.partition_by:
+        keys = [evaluate(k, rb) for k in w.partition_by]
+        group_ids, _ = _group_codes(keys)
+    else:
+        group_ids = np.zeros(n, dtype=np.int64)
+
+    order_idx = None
+    if w.order_by:
+        order_keys = [evaluate(k, rb) for k in w.order_by]
+        sort_batch = RecordBatch(
+            Schema([Field(f"__k{i}", k.dtype) for i, k in enumerate(order_keys)]),
+            [k.rename(f"__k{i}") for i, k in enumerate(order_keys)], n,
+        )
+        order_idx = sort_batch.argsort(
+            [sort_batch.get_column(f"__k{i}") for i in range(len(order_keys))],
+            list(w.descending) if w.descending else [False] * len(order_keys),
+        ).to_numpy().astype(np.int64)
+
+    if w.func in ("row_number", "rank", "dense_rank", "percent_rank"):
+        if order_idx is None:
+            order_idx = np.arange(n, dtype=np.int64)
+        out = np.zeros(n, dtype=np.float64 if w.func == "percent_rank" else np.uint64)
+        sorted_groups = group_ids[order_idx]
+        if w.order_by:
+            order_key_vals = [evaluate(k, rb).take(order_idx.astype(np.uint64)) for k in w.order_by]
+            key_rows = list(zip(*[k.to_pylist() for k in order_key_vals]))
+        else:
+            key_rows = [()] * n
+        # Walk rows in global sort order, tracking per-group counters.
+        counters: dict = {}
+        for pos, row in enumerate(order_idx):
+            g = sorted_groups[pos]
+            cnt, rank, dense, prev_key = counters.get(g, (0, 0, 0, None))
+            cnt += 1
+            cur_key = key_rows[pos]
+            if cur_key != prev_key:
+                rank = cnt
+                dense += 1
+            counters[g] = (cnt, rank, dense, cur_key)
+            if w.func == "row_number":
+                out[row] = cnt
+            elif w.func == "rank":
+                out[row] = rank
+            elif w.func == "dense_rank":
+                out[row] = dense
+            else:
+                out[row] = rank  # percent_rank finalised below
+        if w.func == "percent_rank":
+            sizes = np.bincount(group_ids, minlength=int(group_ids.max()) + 1 if n else 1)
+            denom = np.maximum(sizes[group_ids] - 1, 1).astype(np.float64)
+            out = (out - 1.0) / denom
+        return Series.from_numpy(out, w.func)
+
+    # Whole-partition aggregate broadcast back to rows.
+    assert w.child is not None
+    child = evaluate(w.child, rb)
+    agg = AggOp(w.func, _SeriesRef(child))
+    num_groups = int(group_ids.max()) + 1 if n else 0
+    per_group_vals = []
+    for g in range(num_groups):
+        sub = child.take(np.nonzero(group_ids == g)[0].astype(np.uint64))
+        from daft_tpu.expressions.agg_eval import _global_agg
+
+        per_group_vals.append(_global_agg(sub, AggOp(w.func, _SeriesRef(sub))))
+    if not per_group_vals:
+        return Series.null(w.func, child.dtype, 0)
+    per_group = Series.concat(per_group_vals)
+    return per_group.take(group_ids.astype(np.uint64)).rename(child.name)
+
+
+class _SeriesRef(Expr):
+    """Pre-evaluated child placeholder used only inside window agg dispatch."""
+
+    __slots__ = ("series",)
+
+    def __init__(self, series: Series):
+        self.series = series
+
+    def to_field(self, schema):
+        return Field(self.series.name, self.series.dtype)
+
+    def _attrs_key(self):
+        return (id(self.series),)

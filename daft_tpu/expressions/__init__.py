@@ -1,0 +1,4 @@
+from daft_tpu.expressions.expression import Expression, ExpressionsProjection, col, lit, element, interval
+from daft_tpu.expressions import expr as _expr_ir
+
+__all__ = ["Expression", "ExpressionsProjection", "col", "lit", "element", "interval"]

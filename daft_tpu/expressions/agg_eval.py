@@ -1,0 +1,197 @@
+"""Aggregation evaluation (global and grouped).
+
+Reference: agg kernels in src/daft-core/src/array/ops (sum/mean/stddev/...)
+and grouped-aggregate sinks in src/daft-local-execution. Grouped standard
+aggs dispatch to Arrow Acero's hash aggregation (native C++); composite agg
+expressions (e.g. ``(col('a')*2).sum() + 1``) are decomposed: inner Agg nodes
+are computed per group, then the outer expression tree is evaluated over the
+agg results — the same decomposition the reference's planner does when
+extracting AggExprs from projections.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Sequence, Tuple
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.compute as pc
+
+from daft_tpu.datatype import DataType
+from daft_tpu.errors import DaftValueError
+from daft_tpu.expressions.evaluator import evaluate
+from daft_tpu.expressions.expr import AggOp, Alias, ColumnRef, Expr
+from daft_tpu.schema import Field, Schema
+from daft_tpu.series import Series
+
+_ARROW_AGGS = {
+    "sum": "sum", "mean": "mean", "min": "min", "max": "max",
+    "count": "count", "count_distinct": "count_distinct", "list": "list",
+    "stddev": "stddev", "variance": "variance",
+    "any_value": "first", "bool_and": "all", "bool_or": "any",
+}
+
+
+def _decompose(exprs: Sequence[Expr]) -> Tuple[List[AggOp], List[Expr]]:
+    """Extract unique AggOp nodes; rewrite outer exprs to reference them."""
+    aggs: List[AggOp] = []
+    keys: Dict[tuple, str] = {}
+
+    def rewrite(e: Expr):
+        if isinstance(e, AggOp):
+            k = e.key()
+            if k not in keys:
+                name = f"__agg_{len(aggs)}"
+                keys[k] = name
+                aggs.append((name, e))
+            return ColumnRef(keys[k])
+        return None
+
+    # Keep each original output name: the rewritten tree's natural name would
+    # be the synthetic __agg_N column.
+    outer = [Alias(e.transform(rewrite), e.name()) for e in exprs]
+    return aggs, outer
+
+
+def eval_aggregation(rb, agg_exprs: Sequence[Expr], group_by: Sequence[Expr] = ()):
+    from daft_tpu.recordbatch import RecordBatch, _group_codes
+
+    agg_exprs = list(agg_exprs)
+    group_by = list(group_by)
+    named_aggs, outer = _decompose(agg_exprs)
+
+    if not group_by:
+        agg_cols = []
+        for name, agg in named_aggs:
+            child = evaluate(agg.child, rb)
+            agg_cols.append(_global_agg(child, agg).rename(name))
+        inter = RecordBatch(
+            Schema([Field(c.name, c.dtype) for c in agg_cols]), agg_cols, 1
+        ) if agg_cols else RecordBatch.empty(Schema.empty())
+        if not agg_cols:
+            inter = RecordBatch(Schema.empty(), [], 1)
+        out_cols = [evaluate(e, inter).rename(e.name()) for e in outer]
+        return RecordBatch(Schema([Field(c.name, c.dtype) for c in out_cols]), out_cols, 1)
+
+    key_series = [evaluate(g, rb).rename(g.name()) for g in group_by]
+    group_ids, first_idx = _group_codes(key_series)
+    num_groups = len(first_idx)
+    keys_rb = RecordBatch(
+        Schema([Field(k.name, k.dtype) for k in key_series]), key_series, len(rb)
+    ).take(first_idx.astype(np.uint64))
+
+    # Evaluate agg children once over the whole batch.
+    agg_results: List[Series] = []
+    arrow_cols: Dict[str, pa.Array] = {}
+    arrow_specs: List[Tuple[str, str, object]] = []
+    post_arrow: List[Tuple[int, str, object]] = []  # (slot, name, agg)
+    slots: List[Tuple[str, object, object]] = []  # (name, agg, child_series)
+    for name, agg in named_aggs:
+        child = evaluate(agg.child, rb)
+        slots.append((name, agg, child))
+
+    results: Dict[str, Series] = {}
+    code_arr = pa.array(group_ids)
+    # Build one Acero group_by for all standard aggs.
+    acero_targets = []
+    table_cols = {"__code": code_arr}
+    for name, agg, child in slots:
+        if agg.op in _ARROW_AGGS and not child.dtype.is_python() and not child.dtype.is_logical():
+            colname = f"__v_{name}"
+            table_cols[colname] = child.to_arrow()
+            opts = None
+            if agg.op == "count":
+                mode = agg.kwargs.get("mode", "valid")
+                arrow_mode = {"valid": "only_valid", "null": "only_null", "all": "all"}.get(mode, "only_valid")
+                opts = pc.CountOptions(mode=arrow_mode)
+            elif agg.op in ("stddev", "variance"):
+                opts = pc.VarianceOptions(ddof=0)
+            elif agg.op == "any_value":
+                opts = pc.ScalarAggregateOptions(skip_nulls=bool(agg.kwargs.get("ignore_nulls", False)))
+            acero_targets.append((colname, _ARROW_AGGS[agg.op], opts, name, agg))
+    if acero_targets:
+        table = pa.table(table_cols)
+        tgb = table.group_by("__code", use_threads=False)
+        agged = tgb.aggregate([(c, a, o) if o is not None else (c, a) for c, a, o, _, _ in acero_targets])
+        # Align to first-occurrence group order.
+        code_order = np.asarray(agged.column("__code"))
+        perm = np.argsort(code_order, kind="stable")
+        for (colname, arrow_agg, _opts, name, agg) in acero_targets:
+            out_col = agged.column(f"{colname}_{arrow_agg}").combine_chunks()
+            out_col = out_col.take(pa.array(perm))
+            res = Series.from_arrow(out_col, name)
+            res = _fix_agg_dtype(res, agg, name)
+            results[name] = res
+    # Python/sketch/percentile fallbacks: loop per group.
+    for name, agg, child in slots:
+        if name in results:
+            continue
+        parts = []
+        for g in range(num_groups):
+            mask = group_ids == g
+            sub = child.take(np.nonzero(mask)[0].astype(np.uint64))
+            parts.append(_global_agg(sub, agg))
+        results[name] = Series.concat(parts).rename(name) if parts else Series.null(name, child.dtype, 0)
+
+    inter_cols = list(keys_rb.columns()) + [results[name] for name, _, _ in slots]
+    inter = RecordBatch(
+        Schema([Field(c.name, c.dtype) for c in inter_cols]), inter_cols, num_groups
+    )
+    out_cols = list(keys_rb.columns()) + [
+        evaluate(e, inter).rename(e.name()) for e in outer
+    ]
+    names = [c.name for c in out_cols]
+    if len(set(names)) != len(names):
+        raise DaftValueError(f"Duplicate output names in aggregation: {names}")
+    return RecordBatch(Schema([Field(c.name, c.dtype) for c in out_cols]), out_cols, num_groups)
+
+
+def _fix_agg_dtype(res: Series, agg: AggOp, name: str) -> Series:
+    if agg.op in ("count", "count_distinct"):
+        return res.cast(DataType.uint64())
+    if agg.op in ("stddev", "variance", "mean"):
+        return res.cast(DataType.float64())
+    if agg.op == "list":
+        from daft_tpu.datatype import TypeId
+
+        return res
+    return res
+
+
+def _global_agg(child: Series, agg: AggOp) -> Series:
+    op = agg.op
+    if op == "sum":
+        return child.sum()
+    if op == "mean":
+        return child.mean()
+    if op == "min":
+        return child.min()
+    if op == "max":
+        return child.max()
+    if op == "count":
+        return child.count(agg.kwargs.get("mode", "valid"))
+    if op == "count_distinct":
+        return child.count_distinct()
+    if op == "any_value":
+        return child.any_value(agg.kwargs.get("ignore_nulls", False))
+    if op == "list":
+        return child.agg_list()
+    if op == "concat":
+        return child.agg_concat()
+    if op == "stddev":
+        return child.stddev()
+    if op == "variance":
+        return child.variance()
+    if op == "skew":
+        return child.skew()
+    if op == "approx_count_distinct":
+        return child.approx_count_distinct()
+    if op == "approx_percentile":
+        return child.approx_percentile(agg.kwargs["percentiles"])
+    if op == "bool_and":
+        v = child.drop_null().to_numpy()
+        return Series.from_pylist([bool(v.all()) if len(v) else None], child.name, DataType.bool())
+    if op == "bool_or":
+        v = child.drop_null().to_numpy()
+        return Series.from_pylist([bool(v.any()) if len(v) else None], child.name, DataType.bool())
+    raise DaftValueError(f"Unknown agg op {op}")

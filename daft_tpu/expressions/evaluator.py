@@ -1,0 +1,161 @@
+"""Expression evaluation over RecordBatches.
+
+Reference: ``RecordBatch::eval_expression_list`` / ``eval_expression``
+(src/daft-recordbatch/src/lib.rs:1623,1281). The CPU path walks the Expr tree
+dispatching to Series ops and registry kernels; when device-eval is enabled
+(the default on TPU), maximal numeric subtrees of a projection are fused into
+a single jitted XLA computation per morsel instead (daft_tpu/ops/device_eval).
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence
+
+import numpy as np
+
+from daft_tpu.datatype import DataType
+from daft_tpu.errors import DaftTypeError, DaftValueError
+from daft_tpu.expressions.expr import (
+    AggOp,
+    Alias,
+    BinaryOp,
+    Cast,
+    ColumnRef,
+    Expr,
+    FunctionCall,
+    IfElse,
+    IsIn,
+    Literal,
+    UdfCall,
+    UnaryOp,
+    WindowExpr,
+)
+from daft_tpu.schema import Schema
+from daft_tpu.series import Series
+
+_BINARY_DISPATCH = {
+    "add": lambda a, b: a + b,
+    "sub": lambda a, b: a - b,
+    "mul": lambda a, b: a * b,
+    "truediv": lambda a, b: a / b,
+    "floordiv": lambda a, b: a // b,
+    "mod": lambda a, b: a % b,
+    "pow": lambda a, b: a ** b,
+    "eq": lambda a, b: a.eq(b),
+    "ne": lambda a, b: a.ne(b),
+    "lt": lambda a, b: a.lt(b),
+    "le": lambda a, b: a.le(b),
+    "gt": lambda a, b: a.gt(b),
+    "ge": lambda a, b: a.ge(b),
+    "eq_null_safe": lambda a, b: a.eq_null_safe(b),
+    "and": lambda a, b: a.and_(b),
+    "or": lambda a, b: a.or_(b),
+    "xor": lambda a, b: a.xor_(b),
+}
+
+
+def evaluate(expr: Expr, rb) -> Series:
+    n = len(rb)
+    if isinstance(expr, ColumnRef):
+        return rb.get_column(expr.name_)
+    if isinstance(expr, Literal):
+        return Series.full("literal", expr.value, n, expr.dtype)
+    if isinstance(expr, Alias):
+        return evaluate(expr.child, rb).rename(expr.alias)
+    if isinstance(expr, Cast):
+        return evaluate(expr.child, rb).cast(expr.dtype)
+    if isinstance(expr, BinaryOp):
+        a = evaluate(expr.left, rb)
+        b = evaluate(expr.right, rb)
+        if expr.op in ("lshift", "rshift"):
+            av, am = a.to_numpy_masked()
+            bv, bm = b.to_numpy_masked()
+            out = (av << bv) if expr.op == "lshift" else (av >> bv)
+            mask = am if bm is None else (bm if am is None else am | bm)
+            return Series.from_numpy(out, a.name, a.dtype)._with_mask(mask)
+        return _BINARY_DISPATCH[expr.op](a, b)
+    if isinstance(expr, UnaryOp):
+        c = evaluate(expr.child, rb)
+        if expr.op == "not":
+            return c.not_()
+        if expr.op == "negate":
+            return c.negate()
+        if expr.op == "abs":
+            return c.abs()
+        if expr.op == "is_null":
+            return c.is_null()
+        if expr.op == "not_null":
+            return c.not_null()
+        raise DaftValueError(f"Unknown unary op {expr.op}")
+    if isinstance(expr, IsIn):
+        c = evaluate(expr.child, rb)
+        items = expr.items
+        if isinstance(items, Literal) and isinstance(items.value, (list, tuple)):
+            vals = Series.from_pylist(list(items.value), "items")
+        else:
+            vals = evaluate(items, rb)
+        return c.is_in(vals)
+    if isinstance(expr, IfElse):
+        pred = evaluate(expr.pred, rb)
+        t = evaluate(expr.if_true, rb)
+        f = evaluate(expr.if_false, rb)
+        return pred.if_else(t, f)
+    if isinstance(expr, FunctionCall):
+        from daft_tpu.kernels.registry import get_kernel
+
+        kernel = get_kernel(expr.fn_name)
+        args = [evaluate(a, rb) for a in expr.args]
+        return kernel(args, **expr.kwargs)
+    if isinstance(expr, UdfCall):
+        args = [evaluate(a, rb) for a in expr.args]
+        return expr.udf.evaluate(args, expr.kwargs).rename(expr.name())
+    if isinstance(expr, AggOp):
+        raise DaftValueError(
+            "Aggregation expression evaluated outside an aggregation context"
+        )
+    if isinstance(expr, WindowExpr):
+        raise DaftValueError("Window expression evaluated outside a Window plan node")
+    raise DaftValueError(f"Cannot evaluate expression node {type(expr).__name__}")
+
+
+def evaluate_to_batch(rb, exprs: Sequence[Expr]):
+    from daft_tpu.recordbatch import RecordBatch
+    from daft_tpu.schema import Field
+
+    exprs = list(exprs)
+    from daft_tpu.context import get_context
+
+    cfg = get_context().execution_config
+    series_out: List[Series] = [None] * len(exprs)  # type: ignore[list-item]
+    if cfg.device_eval:
+        from daft_tpu.ops.device_eval import try_evaluate_fused
+
+        fused = try_evaluate_fused(rb, exprs)
+        if fused is not None:
+            for i, s in fused.items():
+                series_out[i] = s
+    for i, e in enumerate(exprs):
+        if series_out[i] is None:
+            s = evaluate(e, rb)
+            # Resolved schema is the source of truth: both the CPU and device
+            # paths cast their result to the statically-resolved field dtype.
+            try:
+                target = e.to_field(rb.schema).dtype
+            except Exception:
+                target = s.dtype
+            if s.dtype != target and not target.is_null():
+                s = s.cast(target)
+            series_out[i] = s
+    names = [e.name() for e in exprs]
+    if len(set(names)) != len(names):
+        raise DaftValueError(f"Duplicate output names in projection: {names}")
+    cols = [s.rename(nm) for s, nm in zip(series_out, names)]
+    schema = Schema([Field(c.name, c.dtype) for c in cols])
+    return RecordBatch(schema, cols, len(rb))
+
+
+def resolve_schema(exprs: Sequence[Expr], input_schema: Schema) -> Schema:
+    from daft_tpu.schema import Field
+
+    fields = [e.to_field(input_schema) for e in exprs]
+    return Schema(fields)

@@ -1,0 +1,748 @@
+"""User-facing Expression API.
+
+Mirrors the reference's ``Expression`` class surface (reference:
+daft/expressions/expressions.py:138 — operators, casts, and the
+``.str/.dt/.list/.struct/.float/.image/.embedding`` accessor namespaces),
+lowered onto the engine's Expr IR (daft_tpu/expressions/expr.py).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Iterable, Iterator, List, Optional, Sequence, Union
+
+from daft_tpu.datatype import DataType
+from daft_tpu.errors import DaftValueError
+from daft_tpu.expressions.expr import (
+    AggOp,
+    Alias,
+    BinaryOp,
+    Cast,
+    ColumnRef,
+    Expr,
+    FunctionCall,
+    IfElse,
+    IsIn,
+    Literal,
+    UnaryOp,
+    ensure_expr,
+)
+from daft_tpu.schema import Field, Schema
+
+
+def col(name: str) -> "Expression":
+    """Reference a column by name (reference: daft.col)."""
+    return Expression(ColumnRef(name))
+
+
+def lit(value: Any, dtype: Optional[DataType] = None) -> "Expression":
+    """A literal value expression (reference: daft.lit)."""
+    return Expression(Literal(value, dtype))
+
+
+def element() -> "Expression":
+    """Placeholder for the current list element inside ``.list.eval`` /
+    ``.list.map`` style expressions (reference: daft.element)."""
+    return Expression(ColumnRef(""))
+
+
+def interval(**kwargs: int) -> "Expression":
+    import datetime
+
+    return lit(datetime.timedelta(**{k: v for k, v in kwargs.items() if k in (
+        "days", "seconds", "microseconds", "milliseconds", "minutes", "hours", "weeks")}))
+
+
+class Expression:
+    __slots__ = ("_expr",)
+
+    def __init__(self, expr: Expr):
+        self._expr = expr
+
+    @staticmethod
+    def _from_any(value: Any) -> "Expression":
+        if isinstance(value, Expression):
+            return value
+        return lit(value)
+
+    # -- infra ------------------------------------------------------------
+    def to_field(self, schema: Schema) -> Field:
+        return self._expr.to_field(schema)
+
+    def name(self) -> str:
+        return self._expr.name()
+
+    def __repr__(self) -> str:
+        return repr(self._expr)
+
+    def __bool__(self) -> bool:
+        raise DaftValueError(
+            "Expressions are lazy; use & | ~ for logic, not `and`/`or`/`not`"
+        )
+
+    def __hash__(self) -> int:
+        return hash(self._expr)
+
+    # -- naming / casting -------------------------------------------------
+    def alias(self, name: str) -> "Expression":
+        return Expression(Alias(self._expr, name))
+
+    def cast(self, dtype: DataType) -> "Expression":
+        return Expression(Cast(self._expr, dtype))
+
+    # -- arithmetic -------------------------------------------------------
+    def _bin(self, other: Any, op: str, reverse: bool = False) -> "Expression":
+        rhs = Expression._from_any(other)._expr
+        lhs = self._expr
+        if reverse:
+            lhs, rhs = rhs, lhs
+        return Expression(BinaryOp(op, lhs, rhs))
+
+    def __add__(self, other):
+        return self._bin(other, "add")
+
+    def __radd__(self, other):
+        return self._bin(other, "add", True)
+
+    def __sub__(self, other):
+        return self._bin(other, "sub")
+
+    def __rsub__(self, other):
+        return self._bin(other, "sub", True)
+
+    def __mul__(self, other):
+        return self._bin(other, "mul")
+
+    def __rmul__(self, other):
+        return self._bin(other, "mul", True)
+
+    def __truediv__(self, other):
+        return self._bin(other, "truediv")
+
+    def __rtruediv__(self, other):
+        return self._bin(other, "truediv", True)
+
+    def __floordiv__(self, other):
+        return self._bin(other, "floordiv")
+
+    def __rfloordiv__(self, other):
+        return self._bin(other, "floordiv", True)
+
+    def __mod__(self, other):
+        return self._bin(other, "mod")
+
+    def __rmod__(self, other):
+        return self._bin(other, "mod", True)
+
+    def __pow__(self, other):
+        return self._bin(other, "pow")
+
+    def __rpow__(self, other):
+        return self._bin(other, "pow", True)
+
+    def __neg__(self):
+        return Expression(UnaryOp("negate", self._expr))
+
+    def __abs__(self):
+        return self.abs()
+
+    def abs(self) -> "Expression":
+        return Expression(UnaryOp("abs", self._expr))
+
+    # -- comparison -------------------------------------------------------
+    def __eq__(self, other):  # type: ignore[override]
+        return self._bin(other, "eq")
+
+    def __ne__(self, other):  # type: ignore[override]
+        return self._bin(other, "ne")
+
+    def __lt__(self, other):
+        return self._bin(other, "lt")
+
+    def __le__(self, other):
+        return self._bin(other, "le")
+
+    def __gt__(self, other):
+        return self._bin(other, "gt")
+
+    def __ge__(self, other):
+        return self._bin(other, "ge")
+
+    def eq_null_safe(self, other) -> "Expression":
+        return self._bin(other, "eq_null_safe")
+
+    # -- logic ------------------------------------------------------------
+    def __and__(self, other):
+        return self._bin(other, "and")
+
+    def __rand__(self, other):
+        return self._bin(other, "and", True)
+
+    def __or__(self, other):
+        return self._bin(other, "or")
+
+    def __ror__(self, other):
+        return self._bin(other, "or", True)
+
+    def __xor__(self, other):
+        return self._bin(other, "xor")
+
+    def __invert__(self):
+        return Expression(UnaryOp("not", self._expr))
+
+    # -- null handling ----------------------------------------------------
+    def is_null(self) -> "Expression":
+        return Expression(UnaryOp("is_null", self._expr))
+
+    def not_null(self) -> "Expression":
+        return Expression(UnaryOp("not_null", self._expr))
+
+    def fill_null(self, fill_value) -> "Expression":
+        return Expression(FunctionCall("fill_null", [self._expr, ensure_expr(fill_value)]))
+
+    def is_in(self, items: Union["Expression", Sequence[Any]]) -> "Expression":
+        if isinstance(items, Expression):
+            rhs = items._expr
+        else:
+            rhs = Literal(list(items), DataType.python()) if not _is_plain_seq(items) else Literal(list(items))
+        return Expression(IsIn(self._expr, rhs))
+
+    def between(self, lower, upper) -> "Expression":
+        return (self >= lower) & (self <= upper)
+
+    def if_else(self, if_true, if_false) -> "Expression":
+        return Expression(IfElse(self._expr, ensure_expr(if_true), ensure_expr(if_false)))
+
+    # -- function helpers -------------------------------------------------
+    def _fn(self, _fn_name: str, *args: Any, **kwargs: Any) -> "Expression":
+        return Expression(FunctionCall(_fn_name, [self._expr, *(ensure_expr(a) for a in args)], kwargs))
+
+    def apply(self, func, return_dtype: DataType) -> "Expression":
+        from daft_tpu.udf import func as make_udf
+
+        udf = make_udf(func, return_dtype=return_dtype)
+        return udf(self)
+
+    # -- numeric functions ------------------------------------------------
+    def ceil(self):
+        return self._fn("ceil")
+
+    def floor(self):
+        return self._fn("floor")
+
+    def round(self, decimals: int = 0):
+        return self._fn("round", decimals=decimals)
+
+    def clip(self, min=None, max=None):
+        return self._fn("clip", min=min, max=max)
+
+    def sqrt(self):
+        return self._fn("sqrt")
+
+    def cbrt(self):
+        return self._fn("cbrt")
+
+    def exp(self):
+        return self._fn("exp")
+
+    def expm1(self):
+        return self._fn("expm1")
+
+    def log(self, base: float | None = None):
+        return self._fn("log", base=base) if base else self._fn("ln")
+
+    def ln(self):
+        return self._fn("ln")
+
+    def log1p(self):
+        return self._fn("log1p")
+
+    def log2(self):
+        return self._fn("log2")
+
+    def log10(self):
+        return self._fn("log10")
+
+    def sin(self):
+        return self._fn("sin")
+
+    def cos(self):
+        return self._fn("cos")
+
+    def tan(self):
+        return self._fn("tan")
+
+    def asin(self):
+        return self._fn("asin")
+
+    def acos(self):
+        return self._fn("acos")
+
+    def atan(self):
+        return self._fn("atan")
+
+    def atan2(self, other):
+        return self._fn("atan2", other)
+
+    def sinh(self):
+        return self._fn("sinh")
+
+    def cosh(self):
+        return self._fn("cosh")
+
+    def tanh(self):
+        return self._fn("tanh")
+
+    def sign(self):
+        return self._fn("sign")
+
+    def shift_left(self, other):
+        return self._bin(other, "lshift")
+
+    def shift_right(self, other):
+        return self._bin(other, "rshift")
+
+    def hash(self, seed=None) -> "Expression":
+        return self._fn("hash", **({"seed": seed} if seed is not None else {}))
+
+    def minhash(self, num_hashes: int, ngram_size: int, seed: int = 1) -> "Expression":
+        return self._fn("minhash", num_hashes=num_hashes, ngram_size=ngram_size, seed=seed)
+
+    # -- aggregation constructors ----------------------------------------
+    def _agg(self, op: str, **kwargs) -> "Expression":
+        return Expression(AggOp(op, self._expr, kwargs))
+
+    def sum(self):
+        return self._agg("sum")
+
+    def mean(self):
+        return self._agg("mean")
+
+    def avg(self):
+        return self._agg("mean")
+
+    def min(self):
+        return self._agg("min")
+
+    def max(self):
+        return self._agg("max")
+
+    def count(self, mode: str = "valid"):
+        return self._agg("count", mode=mode)
+
+    def count_distinct(self):
+        return self._agg("count_distinct")
+
+    def any_value(self, ignore_nulls: bool = False):
+        return self._agg("any_value", ignore_nulls=ignore_nulls)
+
+    def agg_list(self):
+        return self._agg("list")
+
+    def agg_concat(self):
+        return self._agg("concat")
+
+    def stddev(self):
+        return self._agg("stddev")
+
+    def skew(self):
+        return self._agg("skew")
+
+    def bool_and(self):
+        return self._agg("bool_and")
+
+    def bool_or(self):
+        return self._agg("bool_or")
+
+    def approx_count_distinct(self):
+        return self._agg("approx_count_distinct")
+
+    def approx_percentiles(self, percentiles):
+        return self._agg("approx_percentile", percentiles=percentiles)
+
+    # -- window -----------------------------------------------------------
+    def over(self, window) -> "Expression":
+        from daft_tpu.expressions.expr import AggOp, WindowExpr
+
+        inner = self._expr
+        if isinstance(inner, WindowExpr):
+            # e.g. row_number().over(w): bind the window spec.
+            return Expression(WindowExpr(
+                inner.func, inner.child, tuple(e._expr for e in window._partition_by),
+                tuple(e._expr for e in window._order_by), tuple(window._descending),
+                window._frame,
+            ))
+        if isinstance(inner, AggOp):
+            func, child = inner.op, inner.child
+        else:
+            raise DaftValueError("over() requires an aggregation or window function expression")
+        return Expression(WindowExpr(
+            func, child, tuple(e._expr for e in window._partition_by),
+            tuple(e._expr for e in window._order_by), tuple(window._descending),
+            window._frame,
+        ))
+
+    # -- namespaces -------------------------------------------------------
+    @property
+    def str(self) -> "StringNamespace":
+        return StringNamespace(self)
+
+    @property
+    def dt(self) -> "TemporalNamespace":
+        return TemporalNamespace(self)
+
+    @property
+    def list(self) -> "ListNamespace":
+        return ListNamespace(self)
+
+    @property
+    def struct(self) -> "StructNamespace":
+        return StructNamespace(self)
+
+    @property
+    def map(self) -> "MapNamespace":
+        return MapNamespace(self)
+
+    @property
+    def float(self) -> "FloatNamespace":
+        return FloatNamespace(self)
+
+    @property
+    def image(self) -> "ImageNamespace":
+        return ImageNamespace(self)
+
+    @property
+    def embedding(self) -> "EmbeddingNamespace":
+        return EmbeddingNamespace(self)
+
+    @property
+    def binary(self) -> "BinaryNamespace":
+        return BinaryNamespace(self)
+
+    def __getitem__(self, key) -> "Expression":
+        if isinstance(key, int):
+            return self.list.get(key)
+        if isinstance(key, str):
+            return self.struct.get(key)
+        raise DaftValueError(f"Cannot index expression with {key!r}")
+
+
+class _Namespace:
+    __slots__ = ("_e",)
+
+    def __init__(self, e: Expression):
+        self._e = e
+
+    def _fn(self, _fn_name: str, *args, **kwargs) -> Expression:
+        return self._e._fn(_fn_name, *args, **kwargs)
+
+
+class StringNamespace(_Namespace):
+    def contains(self, pattern):
+        return self._fn("str_contains", pattern)
+
+    def startswith(self, prefix):
+        return self._fn("str_startswith", prefix)
+
+    def endswith(self, suffix):
+        return self._fn("str_endswith", suffix)
+
+    def concat(self, other):
+        return self._e + other
+
+    def length(self):
+        return self._fn("str_length")
+
+    def length_bytes(self):
+        return self._fn("str_length_bytes")
+
+    def lower(self):
+        return self._fn("str_lower")
+
+    def upper(self):
+        return self._fn("str_upper")
+
+    def capitalize(self):
+        return self._fn("str_capitalize")
+
+    def reverse(self):
+        return self._fn("str_reverse")
+
+    def lstrip(self):
+        return self._fn("str_lstrip")
+
+    def rstrip(self):
+        return self._fn("str_rstrip")
+
+    def strip(self):
+        return self._fn("str_strip")
+
+    def split(self, pattern, regex: bool = False):
+        return self._fn("str_split", pattern, regex=regex)
+
+    def extract(self, pattern, index: int = 0):
+        return self._fn("str_extract", pattern, index=index)
+
+    def extract_all(self, pattern, index: int = 0):
+        return self._fn("str_extract_all", pattern, index=index)
+
+    def replace(self, pattern, replacement, regex: bool = False):
+        return self._fn("str_replace", pattern, replacement, regex=regex)
+
+    def match(self, pattern):
+        return self._fn("str_match", pattern)
+
+    def left(self, n):
+        return self._fn("str_left", n)
+
+    def right(self, n):
+        return self._fn("str_right", n)
+
+    def find(self, substr):
+        return self._fn("str_find", substr)
+
+    def rpad(self, length, pad):
+        return self._fn("str_rpad", length, pad)
+
+    def lpad(self, length, pad):
+        return self._fn("str_lpad", length, pad)
+
+    def repeat(self, n):
+        return self._fn("str_repeat", n)
+
+    def like(self, pattern):
+        return self._fn("str_like", pattern)
+
+    def ilike(self, pattern):
+        return self._fn("str_ilike", pattern)
+
+    def substr(self, start, length=None):
+        return self._fn("str_substr", start, length=length)
+
+    def to_date(self, format: str):
+        return self._fn("str_to_date", format=format)
+
+    def to_datetime(self, format: str, timezone: Optional[str] = None):
+        return self._fn("str_to_datetime", format=format, timezone=timezone)
+
+    def normalize(self, remove_punct=False, lowercase=False, nfd_unicode=False, white_space=False):
+        return self._fn("str_normalize", remove_punct=remove_punct, lowercase=lowercase,
+                        nfd_unicode=nfd_unicode, white_space=white_space)
+
+    def count_matches(self, patterns, whole_words=False, case_sensitive=True):
+        return self._fn("str_count_matches", patterns=patterns, whole_words=whole_words,
+                        case_sensitive=case_sensitive)
+
+    def tokenize_encode(self, tokens_path: str):
+        return self._fn("tokenize_encode", tokens_path=tokens_path)
+
+    def tokenize_decode(self, tokens_path: str):
+        return self._fn("tokenize_decode", tokens_path=tokens_path)
+
+
+class TemporalNamespace(_Namespace):
+    def date(self):
+        return self._fn("dt_date")
+
+    def day(self):
+        return self._fn("dt_day")
+
+    def hour(self):
+        return self._fn("dt_hour")
+
+    def minute(self):
+        return self._fn("dt_minute")
+
+    def second(self):
+        return self._fn("dt_second")
+
+    def millisecond(self):
+        return self._fn("dt_millisecond")
+
+    def microsecond(self):
+        return self._fn("dt_microsecond")
+
+    def time(self):
+        return self._fn("dt_time")
+
+    def month(self):
+        return self._fn("dt_month")
+
+    def quarter(self):
+        return self._fn("dt_quarter")
+
+    def year(self):
+        return self._fn("dt_year")
+
+    def day_of_week(self):
+        return self._fn("dt_day_of_week")
+
+    def day_of_month(self):
+        return self._fn("dt_day")
+
+    def day_of_year(self):
+        return self._fn("dt_day_of_year")
+
+    def week_of_year(self):
+        return self._fn("dt_week_of_year")
+
+    def truncate(self, interval: str):
+        return self._fn("dt_truncate", interval=interval)
+
+    def to_unix_epoch(self, time_unit: str = "s"):
+        return self._fn("dt_to_unix_epoch", time_unit=time_unit)
+
+    def strftime(self, format: Optional[str] = None):
+        return self._fn("dt_strftime", format=format)
+
+    def total_seconds(self):
+        return self._fn("dt_total_seconds")
+
+
+class ListNamespace(_Namespace):
+    def join(self, delimiter):
+        return self._fn("list_join", delimiter)
+
+    def value_counts(self):
+        return self._fn("list_value_counts")
+
+    def count(self, mode: str = "valid"):
+        return self._fn("list_count", mode=mode)
+
+    def lengths(self):
+        return self._fn("list_length")
+
+    def length(self):
+        return self._fn("list_length")
+
+    def get(self, idx, default=None):
+        return self._fn("list_get", idx, default=default)
+
+    def slice(self, start, end=None):
+        return self._fn("list_slice", start, end=end)
+
+    def chunk(self, size: int):
+        return self._fn("list_chunk", size=size)
+
+    def sum(self):
+        return self._fn("list_sum")
+
+    def mean(self):
+        return self._fn("list_mean")
+
+    def min(self):
+        return self._fn("list_min")
+
+    def max(self):
+        return self._fn("list_max")
+
+    def sort(self, desc: bool = False):
+        return self._fn("list_sort", desc=desc)
+
+    def distinct(self):
+        return self._fn("list_distinct")
+
+    def contains(self, value):
+        return self._fn("list_contains", value)
+
+    def explode(self):
+        return self._fn("explode")
+
+
+class StructNamespace(_Namespace):
+    def get(self, name: str):
+        return self._fn("struct_get", name=name)
+
+
+class MapNamespace(_Namespace):
+    def get(self, key):
+        return self._fn("map_get", key)
+
+
+class FloatNamespace(_Namespace):
+    def is_nan(self):
+        return self._fn("is_nan")
+
+    def is_inf(self):
+        return self._fn("is_inf")
+
+    def not_nan(self):
+        return self._fn("not_nan")
+
+    def fill_nan(self, fill_value):
+        return self._fn("fill_nan", fill_value)
+
+
+class ImageNamespace(_Namespace):
+    def decode(self, on_error: str = "raise", mode=None):
+        return self._fn("image_decode", on_error=on_error, mode=mode)
+
+    def encode(self, image_format):
+        return self._fn("image_encode", image_format=image_format)
+
+    def resize(self, w: int, h: int):
+        return self._fn("image_resize", w=w, h=h)
+
+    def crop(self, bbox):
+        return self._fn("image_crop", bbox=bbox)
+
+    def to_mode(self, mode):
+        return self._fn("image_to_mode", mode=mode)
+
+
+class EmbeddingNamespace(_Namespace):
+    def cosine_distance(self, other):
+        return self._fn("cosine_distance", other)
+
+    def dot(self, other):
+        return self._fn("embedding_dot", other)
+
+    def l2_distance(self, other):
+        return self._fn("l2_distance", other)
+
+    def l2_normalize(self):
+        return self._fn("l2_normalize")
+
+
+class BinaryNamespace(_Namespace):
+    def length(self):
+        return self._fn("binary_length")
+
+    def concat(self, other):
+        return self._fn("binary_concat", other)
+
+    def slice(self, start, length=None):
+        return self._fn("binary_slice", start, length=length)
+
+
+class ExpressionsProjection:
+    """An ordered list of expressions with unique output names
+    (reference: daft/expressions/expressions.py ExpressionsProjection)."""
+
+    def __init__(self, exprs: Sequence[Expression]):
+        self._exprs = list(exprs)
+        seen = set()
+        for e in self._exprs:
+            n = e.name()
+            if n in seen:
+                raise DaftValueError(f"Duplicate output name in projection: {n!r}")
+            seen.add(n)
+
+    @staticmethod
+    def from_schema(schema: Schema) -> "ExpressionsProjection":
+        return ExpressionsProjection([col(f.name) for f in schema])
+
+    def __iter__(self) -> Iterator[Expression]:
+        return iter(self._exprs)
+
+    def __len__(self) -> int:
+        return len(self._exprs)
+
+    def to_inner_exprs(self) -> List[Expr]:
+        return [e._expr for e in self._exprs]
+
+    def resolve_schema(self, schema: Schema) -> Schema:
+        return Schema([e.to_field(schema) for e in self._exprs])
+
+
+def _is_plain_seq(items: Iterable[Any]) -> bool:
+    return all(isinstance(v, (int, float, str, bytes, bool, type(None))) for v in items)

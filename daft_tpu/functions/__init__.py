@@ -1,0 +1,193 @@
+"""Free-function expression library.
+
+Reference: daft/functions — 303 exported functions. Most are thin wrappers
+over registry kernels; AI functions live in daft_tpu.functions.ai.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence, Union
+
+from daft_tpu.datatype import DataType
+from daft_tpu.expressions.expr import FunctionCall, ensure_expr
+from daft_tpu.expressions.expression import Expression, col, lit
+
+
+def _fn(name: str, *args, **kwargs) -> Expression:
+    return Expression(FunctionCall(name, [ensure_expr(a) for a in args], kwargs))
+
+
+# -- general ---------------------------------------------------------------
+def coalesce(*exprs) -> Expression:
+    return _fn("coalesce", *exprs)
+
+
+def fill_null(expr, value) -> Expression:
+    return _fn("fill_null", expr, value)
+
+
+def hash(expr, seed: Optional[int] = None) -> Expression:
+    return _fn("hash", expr, **({"seed": seed} if seed is not None else {}))
+
+
+def minhash(expr, num_hashes: int, ngram_size: int, seed: int = 1) -> Expression:
+    return _fn("minhash", expr, num_hashes=num_hashes, ngram_size=ngram_size, seed=seed)
+
+
+def concat_ws(sep, *exprs) -> Expression:
+    return _fn("concat_ws", sep, *exprs)
+
+
+def if_else(pred, if_true, if_false) -> Expression:
+    p = pred if isinstance(pred, Expression) else lit(pred)
+    return p.if_else(if_true, if_false)
+
+
+def when(pred, value) -> "CaseWhen":
+    return CaseWhen().when(pred, value)
+
+
+class CaseWhen:
+    """SQL-style CASE WHEN chain."""
+
+    def __init__(self):
+        self._branches = []
+
+    def when(self, pred, value) -> "CaseWhen":
+        self._branches.append((pred, value))
+        return self
+
+    def otherwise(self, value) -> Expression:
+        out = value if isinstance(value, Expression) else lit(value)
+        for pred, val in reversed(self._branches):
+            p = pred if isinstance(pred, Expression) else lit(pred)
+            out = p.if_else(val, out)
+        return out
+
+
+# -- numeric ---------------------------------------------------------------
+def sqrt(e):
+    return _fn("sqrt", e)
+
+
+def exp(e):
+    return _fn("exp", e)
+
+
+def log(e, base: Optional[float] = None):
+    return _fn("log", e, base=base) if base else _fn("ln", e)
+
+
+def sin(e):
+    return _fn("sin", e)
+
+
+def cos(e):
+    return _fn("cos", e)
+
+
+def tan(e):
+    return _fn("tan", e)
+
+
+def abs(e):
+    return ensure_expr_wrap(e).abs()
+
+
+def ceil(e):
+    return _fn("ceil", e)
+
+
+def floor(e):
+    return _fn("floor", e)
+
+
+def round(e, decimals: int = 0):
+    return _fn("round", e, decimals=decimals)
+
+
+def clip(e, min=None, max=None):
+    return _fn("clip", e, min=min, max=max)
+
+
+def ensure_expr_wrap(e) -> Expression:
+    return e if isinstance(e, Expression) else lit(e)
+
+
+# -- distance / embedding --------------------------------------------------
+def cosine_distance(a, b) -> Expression:
+    return _fn("cosine_distance", a, b)
+
+
+def l2_distance(a, b) -> Expression:
+    return _fn("l2_distance", a, b)
+
+
+def dot(a, b) -> Expression:
+    return _fn("embedding_dot", a, b)
+
+
+def l2_normalize(a) -> Expression:
+    return _fn("l2_normalize", a)
+
+
+# -- columnar --------------------------------------------------------------
+def columns_sum(*exprs) -> Expression:
+    out = ensure_expr_wrap(exprs[0])
+    for e in exprs[1:]:
+        out = out + e
+    return out
+
+
+def columns_mean(*exprs) -> Expression:
+    return columns_sum(*exprs) / float(len(exprs))
+
+
+def columns_min(*exprs) -> Expression:
+    out = ensure_expr_wrap(exprs[0])
+    for e in exprs[1:]:
+        nxt = ensure_expr_wrap(e)
+        out = (out <= nxt).if_else(out, nxt)
+    return out
+
+
+def columns_max(*exprs) -> Expression:
+    out = ensure_expr_wrap(exprs[0])
+    for e in exprs[1:]:
+        nxt = ensure_expr_wrap(e)
+        out = (out >= nxt).if_else(out, nxt)
+    return out
+
+
+# -- window ----------------------------------------------------------------
+def row_number() -> Expression:
+    from daft_tpu.expressions.expr import WindowExpr
+
+    return Expression(WindowExpr("row_number", None, (), (), ()))
+
+
+def rank() -> Expression:
+    from daft_tpu.expressions.expr import WindowExpr
+
+    return Expression(WindowExpr("rank", None, (), (), ()))
+
+
+def dense_rank() -> Expression:
+    from daft_tpu.expressions.expr import WindowExpr
+
+    return Expression(WindowExpr("dense_rank", None, (), (), ()))
+
+
+def monotonically_increasing_id() -> Expression:
+    raise NotImplementedError(
+        "Use DataFrame.add_monotonically_increasing_id() (plan-level op)"
+    )
+
+
+def __getattr__(name: str):
+    if name in ("embed_text", "embed_image", "classify_text", "classify_image", "prompt",
+                "llm_generate"):
+        from daft_tpu.functions import ai as ai_mod
+
+        return getattr(ai_mod, name)
+    raise AttributeError(f"module 'daft_tpu.functions' has no attribute {name!r}")

@@ -1,0 +1,53 @@
+"""Read entrypoints (reference: daft/io/__init__.py:72-86 read_* functions)."""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Union
+
+from daft_tpu.dataframe.dataframe import DataFrame
+from daft_tpu.io.formats import infer_schema
+from daft_tpu.io.scan import ScanInfo
+from daft_tpu.logical.builder import LogicalPlanBuilder
+from daft_tpu.schema import Schema
+
+
+def _read(paths: Union[str, List[str]], file_format: str, schema: Optional[Schema],
+          read_options: Optional[Dict[str, Any]] = None) -> DataFrame:
+    if isinstance(paths, str):
+        paths = [paths]
+    if schema is None:
+        schema = infer_schema(paths, file_format, read_options)
+    info = ScanInfo(paths, file_format, schema, read_options)
+    return DataFrame(LogicalPlanBuilder.scan(info))
+
+
+def read_parquet(path: Union[str, List[str]], schema: Optional[Schema] = None,
+                 io_config=None, **kwargs) -> DataFrame:
+    return _read(path, "parquet", schema)
+
+
+def read_csv(path: Union[str, List[str]], schema: Optional[Schema] = None,
+             has_headers: bool = True, delimiter: str = ",", io_config=None, **kwargs) -> DataFrame:
+    return _read(path, "csv", schema, {"has_headers": has_headers, "delimiter": delimiter})
+
+
+def read_json(path: Union[str, List[str]], schema: Optional[Schema] = None,
+              io_config=None, **kwargs) -> DataFrame:
+    return _read(path, "json", schema)
+
+
+def read_text(path: Union[str, List[str]], io_config=None, **kwargs) -> DataFrame:
+    return _read(path, "text", None)
+
+
+def from_glob_path(path: Union[str, List[str]], io_config=None) -> DataFrame:
+    """List files matching a glob as a DataFrame of (path, size)
+    (reference: daft.from_glob_path)."""
+    from daft_tpu.dataframe.creation import from_pydict
+    from daft_tpu.io.scan import glob_paths
+
+    files = glob_paths([path] if isinstance(path, str) else list(path))
+    return from_pydict({
+        "path": [f.path for f in files],
+        "size": [f.size_bytes for f in files],
+    })

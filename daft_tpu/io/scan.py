@@ -1,0 +1,174 @@
+"""Scan planning: pushdowns, scan tasks, file-format scan operators.
+
+Reference: src/daft-scan — ``ScanTask`` (lib.rs:350-378) bundles source files +
+schema + pushdowns + stats; scan-task split/merge iterators size tasks between
+min/max byte targets (scan_task_iters/); ``Pushdowns`` carries
+projection/filter/limit/shard pruning into readers.
+
+Filesystem access goes through pyarrow.fs (Arrow C++ filesystems: local, S3,
+GCS), replacing the reference's src/daft-io object-store layer.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import glob as _glob
+import os
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import pyarrow as pa
+import pyarrow.fs as pafs
+
+from daft_tpu.errors import DaftIOError, DaftValueError
+from daft_tpu.schema import Schema
+
+
+@dataclass(frozen=True)
+class Pushdowns:
+    """Pushdowns applied to a scan (reference: src/daft-scan/src/pushdowns.rs)."""
+
+    columns: Optional[Tuple[str, ...]] = None
+    filters: Optional[object] = None  # Expr
+    limit: Optional[int] = None
+    shard: Optional[Tuple[int, int]] = None  # (world_size, rank)
+
+    def with_changes(self, **kwargs) -> "Pushdowns":
+        return dataclasses.replace(self, **kwargs)
+
+
+@dataclass
+class FileInfo:
+    path: str
+    size_bytes: Optional[int] = None
+    num_rows: Optional[int] = None
+
+
+@dataclass
+class ScanTask:
+    """A unit of scan work: one or more files read into MicroPartitions
+    (reference: src/daft-scan/src/lib.rs:350-378)."""
+
+    files: List[FileInfo]
+    file_format: str  # parquet | csv | json | text | warc
+    schema: Schema
+    pushdowns: Pushdowns = field(default_factory=Pushdowns)
+    read_options: Dict[str, Any] = field(default_factory=dict)
+
+    def size_bytes(self) -> int:
+        return sum(f.size_bytes or 0 for f in self.files)
+
+    def display(self) -> str:
+        return f"ScanTask({self.file_format}, {len(self.files)} files)"
+
+
+def resolve_filesystem(path: str) -> Tuple[pafs.FileSystem, str]:
+    """Resolve a URI to (filesystem, fs-local path) via Arrow C++ filesystems."""
+    if "://" in path:
+        fs, p = pafs.FileSystem.from_uri(path)
+        return fs, p
+    return pafs.LocalFileSystem(), os.path.abspath(os.path.expanduser(path))
+
+
+def glob_paths(paths: Sequence[str]) -> List[FileInfo]:
+    """Expand glob patterns / directories into concrete files with sizes
+    (reference: src/daft-io/src/object_store_glob.rs)."""
+    out: List[FileInfo] = []
+    for path in paths:
+        fs, p = resolve_filesystem(path)
+        if isinstance(fs, pafs.LocalFileSystem):
+            if any(ch in p for ch in "*?["):
+                matches = sorted(_glob.glob(p, recursive=True))
+                for m in matches:
+                    if os.path.isfile(m):
+                        out.append(FileInfo(m, os.path.getsize(m)))
+            elif os.path.isdir(p):
+                sel = pafs.FileSelector(p, recursive=True)
+                for info in fs.get_file_info(sel):
+                    if info.type == pafs.FileType.File and not os.path.basename(info.path).startswith((".", "_")):
+                        out.append(FileInfo(info.path, info.size))
+                out.sort(key=lambda f: f.path)
+            elif os.path.isfile(p):
+                out.append(FileInfo(p, os.path.getsize(p)))
+            else:
+                raise DaftIOError(f"Path not found: {path}")
+        else:
+            # Remote: support trailing glob on the basename and directories.
+            if any(ch in p for ch in "*?["):
+                base = p.split("*")[0].rsplit("/", 1)[0]
+                sel = pafs.FileSelector(base, recursive=True)
+                import fnmatch
+
+                for info in fs.get_file_info(sel):
+                    if info.type == pafs.FileType.File and fnmatch.fnmatch(info.path, p):
+                        out.append(FileInfo(info.path, info.size))
+                out.sort(key=lambda f: f.path)
+            else:
+                info = fs.get_file_info(p)
+                if info.type == pafs.FileType.Directory:
+                    sel = pafs.FileSelector(p, recursive=True)
+                    for i in fs.get_file_info(sel):
+                        if i.type == pafs.FileType.File:
+                            out.append(FileInfo(i.path, i.size))
+                    out.sort(key=lambda f: f.path)
+                elif info.type == pafs.FileType.File:
+                    out.append(FileInfo(p, info.size))
+                else:
+                    raise DaftIOError(f"Path not found: {path}")
+    if not out:
+        raise DaftIOError(f"No files found at {list(paths)!r}")
+    return out
+
+
+class ScanInfo:
+    """A scan operator over a set of globbed files of one format
+    (reference: src/daft-scan/src/glob.rs GlobScanOperator)."""
+
+    def __init__(self, paths: Sequence[str], file_format: str, schema: Schema,
+                 read_options: Optional[Dict[str, Any]] = None,
+                 files: Optional[List[FileInfo]] = None):
+        self.paths = list(paths)
+        self.file_format = file_format
+        self.schema = schema
+        self.read_options = read_options or {}
+        self._files = files
+
+    def files(self) -> List[FileInfo]:
+        if self._files is None:
+            self._files = glob_paths(self.paths)
+        return self._files
+
+    def display_name(self) -> str:
+        return f"{self.file_format}({self.paths[0]}{'...' if len(self.paths) > 1 else ''})"
+
+    def estimate_rows_bytes(self) -> Tuple[float, float]:
+        files = self.files()
+        size = float(sum(f.size_bytes or 0 for f in files))
+        row_size = self.schema.estimate_row_size_bytes()
+        inflation = 3.0 if self.file_format == "parquet" else 1.0
+        return (size * inflation / max(row_size, 1.0), size * inflation)
+
+    def to_scan_tasks(self, pushdowns: Pushdowns, cfg) -> List[ScanTask]:
+        """Split/merge files into scan tasks within [min,max] byte targets
+        (reference: src/daft-scan/src/scan_task_iters/split_parquet_*)."""
+        files = self.files()
+        if pushdowns.shard is not None:
+            world, rank = pushdowns.shard
+            files = [f for i, f in enumerate(files) if i % world == rank]
+        tasks: List[ScanTask] = []
+        bucket: List[FileInfo] = []
+        bucket_bytes = 0
+        for f in files:
+            fsize = f.size_bytes or cfg.scan_tasks_min_size_bytes
+            if bucket and (bucket_bytes + fsize > cfg.scan_tasks_max_size_bytes
+                           or len(bucket) >= cfg.max_sources_per_scan_task):
+                tasks.append(ScanTask(bucket, self.file_format, self.schema, pushdowns, self.read_options))
+                bucket, bucket_bytes = [], 0
+            bucket.append(f)
+            bucket_bytes += fsize
+            if bucket_bytes >= cfg.scan_tasks_min_size_bytes:
+                tasks.append(ScanTask(bucket, self.file_format, self.schema, pushdowns, self.read_options))
+                bucket, bucket_bytes = [], 0
+        if bucket:
+            tasks.append(ScanTask(bucket, self.file_format, self.schema, pushdowns, self.read_options))
+        return tasks

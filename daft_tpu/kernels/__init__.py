@@ -1,0 +1,8 @@
+"""CPU kernel library.
+
+Host-side compute kernels over Series, organised like the reference's kernel
+crates (src/daft-core/src/array/ops, src/daft-functions-*). Fixed-width numeric
+work should instead flow through the device-eval path (daft_tpu/ops) onto TPU;
+these kernels cover the string/list/temporal/hash surface that is XLA-hostile
+and belongs on the host.
+"""

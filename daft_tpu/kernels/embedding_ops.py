@@ -1,0 +1,95 @@
+"""Embedding kernels — TPU-dispatched.
+
+Reference: distance functions in src/daft-functions/src/distance. Unlike the
+reference's CPU SIMD kernels, embeddings here are dense fixed-width columns,
+so these lower straight onto the device-eval path: batched matmuls/reductions
+on the MXU via jitted jnp ops.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from daft_tpu.datatype import DataType
+from daft_tpu.errors import DaftTypeError
+from daft_tpu.kernels.registry import register_kernel
+from daft_tpu.schema import Field
+from daft_tpu.series import Series
+
+import jax
+import jax.numpy as jnp
+
+
+def _f64(fields, kwargs):
+    return Field(fields[0].name, DataType.float64())
+
+
+def _emb_pair(args):
+    a, b = args[0], args[1]
+    if not (a.dtype.is_device_representable() and a.dtype.shape):
+        raise DaftTypeError(f"Expected embedding-like column, got {a.dtype!r}")
+    av, am = a.to_numpy_masked()
+    if len(b) == 1 and len(a) != 1:
+        bv = np.broadcast_to(b.to_numpy()[0], av.shape)
+        bm = None
+    else:
+        bv, bm = b.to_numpy_masked()
+    mask = am if bm is None else (am | bm if am is not None else bm)
+    return av, bv, mask
+
+
+@jax.jit
+def _cosine_distance_jax(a, b):
+    a = a.astype(jnp.float32)
+    b = b.astype(jnp.float32)
+    num = jnp.sum(a * b, axis=-1)
+    den = jnp.linalg.norm(a, axis=-1) * jnp.linalg.norm(b, axis=-1)
+    return 1.0 - num / jnp.where(den == 0, 1.0, den)
+
+
+@jax.jit
+def _dot_jax(a, b):
+    return jnp.sum(a.astype(jnp.float32) * b.astype(jnp.float32), axis=-1)
+
+
+@jax.jit
+def _l2_jax(a, b):
+    d = a.astype(jnp.float32) - b.astype(jnp.float32)
+    return jnp.sqrt(jnp.sum(d * d, axis=-1))
+
+
+@jax.jit
+def _l2_normalize_jax(a):
+    a = a.astype(jnp.float32)
+    n = jnp.linalg.norm(a, axis=-1, keepdims=True)
+    return a / jnp.where(n == 0, 1.0, n)
+
+
+@register_kernel("cosine_distance", _f64)
+def _cosine_distance(args, **kwargs):
+    av, bv, mask = _emb_pair(args)
+    out = np.asarray(_cosine_distance_jax(av, bv), dtype=np.float64)
+    return Series.from_numpy(out, args[0].name)._with_mask(mask)
+
+
+@register_kernel("embedding_dot", _f64)
+def _dot(args, **kwargs):
+    av, bv, mask = _emb_pair(args)
+    out = np.asarray(_dot_jax(av, bv), dtype=np.float64)
+    return Series.from_numpy(out, args[0].name)._with_mask(mask)
+
+
+@register_kernel("l2_distance", _f64)
+def _l2_distance(args, **kwargs):
+    av, bv, mask = _emb_pair(args)
+    out = np.asarray(_l2_jax(av, bv), dtype=np.float64)
+    return Series.from_numpy(out, args[0].name)._with_mask(mask)
+
+
+@register_kernel("l2_normalize", lambda f, k: Field(f[0].name, DataType.embedding(DataType.float32(), f[0].dtype.shape[0])))
+def _l2_normalize(args, **kwargs):
+    s = args[0]
+    vals, mask = s.to_numpy_masked()
+    out = np.asarray(_l2_normalize_jax(vals))
+    dt = DataType.embedding(DataType.float32(), out.shape[1])
+    return Series.from_numpy(out, s.name, dt)._with_mask(mask)

@@ -1,0 +1,42 @@
+"""Float special-value kernels (reference: daft Expression.float namespace)."""
+
+from __future__ import annotations
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.compute as pc
+
+from daft_tpu.datatype import DataType
+from daft_tpu.kernels.registry import register_kernel, returns, same_dtype
+from daft_tpu.series import Series
+
+import jax.numpy as jnp
+
+_BOOL = DataType.bool()
+
+
+@register_kernel("is_nan", returns(_BOOL), jax_fn=lambda a: jnp.isnan(a[0]))
+def _is_nan(args, **kwargs):
+    return Series.from_arrow(pc.is_nan(args[0].to_arrow()), args[0].name, _BOOL)
+
+
+@register_kernel("is_inf", returns(_BOOL), jax_fn=lambda a: jnp.isinf(a[0]))
+def _is_inf(args, **kwargs):
+    return Series.from_arrow(pc.is_inf(args[0].to_arrow()), args[0].name, _BOOL)
+
+
+@register_kernel("not_nan", returns(_BOOL), jax_fn=lambda a: ~jnp.isnan(a[0]))
+def _not_nan(args, **kwargs):
+    return Series.from_arrow(pc.invert(pc.is_nan(args[0].to_arrow())), args[0].name, _BOOL)
+
+
+@register_kernel("fill_nan", same_dtype)
+def _fill_nan(args, **kwargs):
+    s, fill = args[0], args[1].cast(args[0].dtype)
+    arr = s.to_arrow()
+    nan_mask = pc.is_nan(arr)
+    f = fill.to_arrow()
+    if len(fill) == 1:
+        f = f[0]
+    out = pc.if_else(nan_mask, f, arr)
+    return Series.from_arrow(out, s.name, s.dtype)

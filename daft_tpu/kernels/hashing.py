@@ -1,0 +1,169 @@
+"""Deterministic vectorised hashing kernels.
+
+Replaces the reference's hash ops (src/daft-core/src/array/ops/hash.rs,
+src/daft-hash/src/lib.rs — MurmurHash3 / xxhash BuildHashers) with a
+numpy-vectorised 64-bit polynomial (FNV-flavoured) hash that is stable across
+processes and hosts — the property distributed hash-partitioning requires.
+
+A C++ drop-in with true MurmurHash3 lives in daft_tpu/_native (used when the
+compiled extension is available).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.compute as pc
+
+from daft_tpu.datatype import DataType, TypeId
+
+_FNV_PRIME = np.uint64(1099511628211)
+_FNV_OFFSET = np.uint64(14695981039346656037)
+_NULL_HASH = np.uint64(0x9E3779B97F4A7C15)
+_MAX_POW_TABLE = 1 << 22
+
+_pow_table: Optional[np.ndarray] = None
+
+
+def _powers(n: int) -> np.ndarray:
+    global _pow_table
+    if _pow_table is None or len(_pow_table) < n:
+        size = max(n, 4096)
+        with np.errstate(over="ignore"):
+            t = np.empty(size, dtype=np.uint64)
+            t[0] = np.uint64(1)
+            np.multiply.accumulate(np.full(size - 1, _FNV_PRIME, dtype=np.uint64), out=t[1:])
+        _pow_table = t
+    return _pow_table[:n]
+
+
+def _finalize(h: np.ndarray) -> np.ndarray:
+    # xorshift-multiply avalanche (splitmix64 finaliser)
+    with np.errstate(over="ignore"):
+        h = h.copy()
+        h ^= h >> np.uint64(30)
+        h *= np.uint64(0xBF58476D1CE4E5B9)
+        h ^= h >> np.uint64(27)
+        h *= np.uint64(0x94D049BB133111EB)
+        h ^= h >> np.uint64(31)
+    return h
+
+
+def hash_bytes_batch(data: np.ndarray, starts: np.ndarray, lengths: np.ndarray) -> np.ndarray:
+    """Hash a batch of variable-length byte strings.
+
+    ``data`` is the concatenated uint8 byte buffer; value i spans
+    ``data[starts[i] : starts[i] + lengths[i]]``.
+    """
+    n = len(starts)
+    if n == 0:
+        return np.empty(0, dtype=np.uint64)
+    total = int(lengths.sum())
+    if total == 0:
+        return np.full(n, _finalize(np.array([_FNV_OFFSET]))[0], dtype=np.uint64)
+    # Position of each byte within its own value.
+    flat_idx = np.arange(total, dtype=np.int64)
+    value_ids = np.repeat(np.arange(n, dtype=np.int64), lengths)
+    value_starts_rep = np.repeat(np.cumsum(lengths, dtype=np.int64) - lengths, lengths)
+    pos = flat_idx - value_starts_rep
+    # Gather the actual bytes (starts may be non-contiguous due to offsets)
+    gather = np.repeat(starts.astype(np.int64), lengths) + pos
+    b = data[gather].astype(np.uint64)
+    with np.errstate(over="ignore"):
+        weighted = b * _powers(int(lengths.max()))[pos]
+    sums = np.zeros(n, dtype=np.uint64)
+    np.add.at(sums, value_ids, weighted)  # wraps mod 2^64
+    with np.errstate(over="ignore"):
+        out = _FNV_OFFSET + sums + lengths.astype(np.uint64) * np.uint64(0x100000001B3)
+    return _finalize(out)
+
+
+def _hash_fixed_width(vals: np.ndarray) -> np.ndarray:
+    """Hash fixed-width values bitwise; vals is (n,) or (n, k) numeric."""
+    if vals.ndim == 1:
+        vals = vals.reshape(len(vals), 1)
+    raw = np.ascontiguousarray(vals).view(np.uint8).reshape(len(vals), -1)
+    width = raw.shape[1]
+    with np.errstate(over="ignore"):
+        acc = np.full(len(vals), _FNV_OFFSET, dtype=np.uint64)
+        p = _powers(width)
+        acc = acc + (raw.astype(np.uint64) * p[None, :]).sum(axis=1, dtype=np.uint64)
+    return _finalize(acc)
+
+
+def hash_series(s, seed=None):
+    """64-bit deterministic hash of each row of a Series -> UInt64 Series."""
+    from daft_tpu.series import Series
+
+    dt = s.dtype
+    n = len(s)
+    if dt.id == TypeId.NULL:
+        out = np.full(n, _NULL_HASH, dtype=np.uint64)
+    elif dt.is_python():
+        import hashlib
+
+        out = np.empty(n, dtype=np.uint64)
+        for i, v in enumerate(s._data):
+            if v is None:
+                out[i] = _NULL_HASH
+            else:
+                d = hashlib.sha1(repr(v).encode()).digest()
+                out[i] = np.frombuffer(d[:8], dtype=np.uint64)[0]
+    elif dt.is_string() or dt.id == TypeId.BINARY:
+        arr = s._data
+        # large_string/large_binary: int64 offsets buffer + data buffer
+        offsets = np.frombuffer(arr.buffers()[1], dtype=np.int64, count=len(arr) + 1 + arr.offset)[arr.offset:]
+        databuf = arr.buffers()[2]
+        data = np.frombuffer(databuf, dtype=np.uint8) if databuf is not None else np.empty(0, np.uint8)
+        starts = offsets[:-1]
+        lengths = (offsets[1:] - starts).astype(np.int64)
+        out = hash_bytes_batch(data, starts.astype(np.int64), lengths)
+    elif dt.is_device_representable():
+        vals, _ = s.to_numpy_masked()
+        if dt.is_floating():
+            # Normalise -0.0 == 0.0 and NaNs to a canonical bit pattern.
+            vals = vals.astype(np.float64, copy=True)
+            vals[vals == 0.0] = 0.0
+            vals[np.isnan(vals)] = np.nan
+        if dt.is_boolean():
+            vals = vals.astype(np.uint8)
+        out = _hash_fixed_width(vals.reshape(n, -1) if vals.ndim > 1 else vals)
+    elif dt.is_temporal() or dt.id == TypeId.DECIMAL128 or dt.id == TypeId.FIXED_SIZE_BINARY:
+        casted = s._data.cast(pa.large_binary()) if dt.id == TypeId.FIXED_SIZE_BINARY else None
+        if casted is not None:
+            return hash_series(Series("h", DataType.binary(), casted), seed).rename(s.name)
+        vals = np.asarray(pc.cast(s._data, pa.int64(), safe=False))
+        out = _hash_fixed_width(vals)
+    else:
+        # Nested types: hash the canonical string repr row-wise (slow path).
+        import hashlib
+
+        out = np.empty(n, dtype=np.uint64)
+        for i, v in enumerate(s.to_pylist()):
+            if v is None:
+                out[i] = _NULL_HASH
+            else:
+                d = hashlib.sha1(repr(v).encode()).digest()
+                out[i] = np.frombuffer(d[:8], dtype=np.uint64)[0]
+    # Null rows hash to a fixed sentinel, matching reference semantics
+    # (nulls are groupable / joinable as equal keys in hash partitioning).
+    if not dt.is_python() and not dt.is_null() and s._data.null_count:
+        mask = np.asarray(pc.is_null(s._data))
+        out = out.copy()
+        out[mask] = _NULL_HASH
+    if seed is not None:
+        seed_vals = seed.to_numpy().astype(np.uint64)
+        with np.errstate(over="ignore"):
+            out = _finalize(out * _FNV_PRIME ^ seed_vals)
+    return Series.from_numpy(out, s.name, DataType.uint64())
+
+
+def combine_hashes(hashes: list) -> "np.ndarray":
+    """Combine per-column row hashes into one row hash."""
+    acc = hashes[0].astype(np.uint64, copy=True)
+    with np.errstate(over="ignore"):
+        for h in hashes[1:]:
+            acc = _finalize(acc * _FNV_PRIME + h.astype(np.uint64))
+    return acc

@@ -1,0 +1,248 @@
+"""Image kernels (reference: src/daft-image, ~2.8k LoC).
+
+Design split for TPU:
+* **decode/encode** — host-side (PIL), producing the variable-shape ``Image``
+  struct column or, when ``mode`` + fixed shape are known, the
+  ``FixedShapeImage`` flat column that can go straight into HBM.
+* **resize / to_mode on fixed shapes** — device-side batched ``jax.image``
+  ops (XLA), replacing the reference's per-image CPU resize
+  (src/daft-image/src/ops.rs). This is the "decode → device, no host
+  round-trip" path called out in the build plan (SURVEY.md §7.6).
+"""
+
+from __future__ import annotations
+
+import io
+from typing import Optional
+
+import numpy as np
+import pyarrow as pa
+
+from daft_tpu.datatype import DataType, ImageFormat, ImageMode, TypeId
+from daft_tpu.errors import DaftTypeError, DaftValueError
+from daft_tpu.kernels.registry import register_kernel
+from daft_tpu.schema import Field
+from daft_tpu.series import Series
+
+import jax
+import jax.numpy as jnp
+from functools import partial
+
+_MODE_TO_PIL = {
+    ImageMode.L: "L", ImageMode.LA: "LA", ImageMode.RGB: "RGB", ImageMode.RGBA: "RGBA",
+}
+
+
+def _decode_resolver(fields, kwargs):
+    mode = kwargs.get("mode")
+    if isinstance(mode, str):
+        mode = ImageMode.from_str(mode)
+    return Field(fields[0].name, DataType.image(mode))
+
+
+@register_kernel("image_decode", _decode_resolver)
+def _image_decode(args, on_error: str = "raise", mode=None, **kwargs):
+    from PIL import Image as PILImage
+
+    s = args[0]
+    if isinstance(mode, str):
+        mode = ImageMode.from_str(mode)
+    out_rows = []
+    for raw in s.to_pylist():
+        if raw is None:
+            out_rows.append(None)
+            continue
+        try:
+            img = PILImage.open(io.BytesIO(raw))
+            pil_mode = _MODE_TO_PIL.get(mode) if mode else ("RGB" if img.mode not in ("L", "LA", "RGB", "RGBA") else img.mode)
+            if pil_mode and img.mode != pil_mode:
+                img = img.convert(pil_mode)
+            arr = np.asarray(img)
+            if arr.ndim == 2:
+                arr = arr[:, :, None]
+            m = mode or ImageMode.from_str(img.mode if img.mode in ("L", "LA", "RGB", "RGBA") else "RGB")
+            out_rows.append({
+                "data": arr.tobytes(), "channel": arr.shape[2],
+                "height": arr.shape[0], "width": arr.shape[1], "mode": m.value,
+            })
+        except Exception:
+            if on_error == "raise":
+                raise
+            out_rows.append(None)
+    dtype = DataType.image(mode)
+    arr = pa.array(out_rows, dtype.to_arrow())
+    return Series.from_arrow(arr, s.name, dtype)
+
+
+def _image_rows(s: Series):
+    """Yield (ndarray HWC or None, mode) rows from an image-typed series."""
+    dt = s.dtype
+    if dt.id == TypeId.FIXED_SHAPE_IMAGE:
+        vals, mask = s.to_numpy_masked()
+        for i in range(len(s)):
+            if mask is not None and mask[i]:
+                yield None, dt.image_mode
+            else:
+                yield vals[i], dt.image_mode
+    elif dt.id == TypeId.IMAGE:
+        for row in s.to_arrow().to_pylist():
+            if row is None:
+                yield None, None
+            else:
+                m = ImageMode(row["mode"])
+                arr = np.frombuffer(row["data"], dtype=m.pixel_dtype.to_numpy()).reshape(
+                    row["height"], row["width"], row["channel"]
+                )
+                yield arr, m
+    else:
+        raise DaftTypeError(f"Expected image column, got {dt!r}")
+
+
+@register_kernel("image_encode", lambda f, k: Field(f[0].name, DataType.binary()))
+def _image_encode(args, image_format="png", **kwargs):
+    from PIL import Image as PILImage
+
+    if isinstance(image_format, str):
+        image_format = ImageFormat.from_str(image_format)
+    s = args[0]
+    out = []
+    for arr, m in _image_rows(s):
+        if arr is None:
+            out.append(None)
+            continue
+        img = PILImage.fromarray(arr.squeeze(-1) if arr.shape[2] == 1 else arr)
+        buf = io.BytesIO()
+        img.save(buf, format=image_format.value.upper())
+        out.append(buf.getvalue())
+    return Series.from_pylist(out, s.name, DataType.binary())
+
+
+def _resize_resolver(fields, kwargs):
+    f = fields[0]
+    dt = f.dtype
+    w, h = kwargs["w"], kwargs["h"]
+    if dt.id == TypeId.FIXED_SHAPE_IMAGE:
+        return Field(f.name, DataType.image(dt.image_mode, h, w))
+    if dt.id == TypeId.IMAGE and dt.image_mode is not None:
+        return Field(f.name, DataType.image(dt.image_mode, h, w))
+    return Field(f.name, dt)
+
+
+@partial(jax.jit, static_argnums=(1, 2))
+def _batch_resize_jax(batch, h, w):
+    """Bilinear resize of an NHWC uint8/float batch on device."""
+    x = batch.astype(jnp.float32)
+    out = jax.image.resize(x, (x.shape[0], h, w, x.shape[3]), method="bilinear")
+    return jnp.clip(jnp.round(out), 0, 255).astype(batch.dtype) if batch.dtype == jnp.uint8 else out
+
+
+@register_kernel("image_resize", _resize_resolver)
+def _image_resize(args, w: int = 0, h: int = 0, **kwargs):
+    s = args[0]
+    dt = s.dtype
+    if dt.id == TypeId.FIXED_SHAPE_IMAGE:
+        # Whole column is one dense NHWC batch: resize on TPU in one XLA call.
+        vals, mask = s.to_numpy_masked()
+        out = np.asarray(_batch_resize_jax(jnp.asarray(vals), h, w))
+        out_dt = DataType.image(dt.image_mode, h, w)
+        return Series.from_numpy(out.reshape(len(s), -1), s.name, out_dt)._with_mask(mask)
+    # Variable-shape: per-row host resize via PIL (mixed shapes can't batch).
+    from PIL import Image as PILImage
+
+    mode = dt.image_mode
+    out_rows = []
+    for arr, m in _image_rows(s):
+        if arr is None:
+            out_rows.append(None)
+            continue
+        img = PILImage.fromarray(arr.squeeze(-1) if arr.shape[2] == 1 else arr)
+        img = img.resize((w, h), PILImage.BILINEAR)
+        res = np.asarray(img)
+        if res.ndim == 2:
+            res = res[:, :, None]
+        out_rows.append({
+            "data": res.tobytes(), "channel": res.shape[2],
+            "height": h, "width": w, "mode": (m or ImageMode.RGB).value,
+        })
+    if mode is not None:
+        # Known mode + fixed target shape -> dense FixedShapeImage output.
+        out_dt = DataType.image(mode, h, w)
+        dense = np.zeros((len(out_rows), h * w * mode.num_channels), dtype=mode.pixel_dtype.to_numpy())
+        validity = np.ones(len(out_rows), dtype=bool)
+        for i, row in enumerate(out_rows):
+            if row is None:
+                validity[i] = False
+            else:
+                dense[i] = np.frombuffer(row["data"], dtype=mode.pixel_dtype.to_numpy())
+        res = Series.from_numpy(dense, s.name, out_dt)
+        return res._with_mask(~validity) if not validity.all() else res
+    out_dt = DataType.image(None)
+    return Series.from_arrow(pa.array(out_rows, out_dt.to_arrow()), s.name, out_dt)
+
+
+@register_kernel("image_to_mode", lambda f, k: Field(f[0].name, _to_mode_dtype(f[0].dtype, k["mode"])))
+def _image_to_mode(args, mode=None, **kwargs):
+    from PIL import Image as PILImage
+
+    if isinstance(mode, str):
+        mode = ImageMode.from_str(mode)
+    s = args[0]
+    dt = s.dtype
+    out_rows = []
+    for arr, m in _image_rows(s):
+        if arr is None:
+            out_rows.append(None)
+            continue
+        img = PILImage.fromarray(arr.squeeze(-1) if arr.shape[2] == 1 else arr)
+        img = img.convert(_MODE_TO_PIL[mode])
+        res = np.asarray(img)
+        if res.ndim == 2:
+            res = res[:, :, None]
+        out_rows.append(res)
+    out_dt = _to_mode_dtype(dt, mode)
+    if out_dt.id == TypeId.FIXED_SHAPE_IMAGE:
+        h, w = dt._params[1], dt._params[2]
+        dense = np.zeros((len(out_rows), h * w * mode.num_channels), dtype=mode.pixel_dtype.to_numpy())
+        validity = np.ones(len(out_rows), dtype=bool)
+        for i, r in enumerate(out_rows):
+            if r is None:
+                validity[i] = False
+            else:
+                dense[i] = r.reshape(-1)
+        res = Series.from_numpy(dense, s.name, out_dt)
+        return res._with_mask(~validity) if not validity.all() else res
+    rows = [
+        None if r is None else {
+            "data": r.tobytes(), "channel": r.shape[2], "height": r.shape[0],
+            "width": r.shape[1], "mode": mode.value,
+        }
+        for r in out_rows
+    ]
+    return Series.from_arrow(pa.array(rows, out_dt.to_arrow()), s.name, out_dt)
+
+
+def _to_mode_dtype(dt: DataType, mode) -> DataType:
+    if isinstance(mode, str):
+        mode = ImageMode.from_str(mode)
+    if dt.id == TypeId.FIXED_SHAPE_IMAGE:
+        return DataType.image(mode, dt._params[1], dt._params[2])
+    return DataType.image(mode)
+
+
+@register_kernel("image_crop", lambda f, k: Field(f[0].name, DataType.image(f[0].dtype.image_mode) if f[0].dtype.id in (TypeId.IMAGE, TypeId.FIXED_SHAPE_IMAGE) else f[0].dtype))
+def _image_crop(args, bbox=None, **kwargs):
+    s = args[0]
+    x, y, w, h = bbox
+    out_rows = []
+    for arr, m in _image_rows(s):
+        if arr is None:
+            out_rows.append(None)
+            continue
+        cropped = arr[y:y + h, x:x + w]
+        out_rows.append({
+            "data": cropped.tobytes(), "channel": cropped.shape[2],
+            "height": cropped.shape[0], "width": cropped.shape[1],
+            "mode": (m or ImageMode.RGB).value,
+        })
+    out_dt = DataType.image(s.dtype.image_mode)
+    return Series.from_arrow(pa.array(out_rows, out_dt.to_arrow()), s.name, out_dt)

@@ -1,0 +1,195 @@
+"""List kernels (reference: src/daft-functions-list, ~3.9k LoC)."""
+
+from __future__ import annotations
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.compute as pc
+
+from daft_tpu.datatype import DataType
+from daft_tpu.errors import DaftTypeError
+from daft_tpu.kernels.registry import register_kernel
+from daft_tpu.schema import Field
+from daft_tpu.series import Series
+
+
+def _inner_field(fields, kwargs):
+    f = fields[0]
+    if not f.dtype.is_list():
+        raise DaftTypeError(f"Expected list column, got {f.dtype!r}")
+    return Field(f.name, f.dtype.inner)
+
+
+def _same(fields, kwargs):
+    return fields[0]
+
+
+@register_kernel("list_length", lambda f, k: Field(f[0].name, DataType.uint64()))
+def _list_length(args, **kwargs):
+    out = pc.list_value_length(args[0].to_arrow())
+    return Series.from_arrow(out.cast(pa.uint64()), args[0].name, DataType.uint64())
+
+
+@register_kernel("list_count", lambda f, k: Field(f[0].name, DataType.uint64()))
+def _list_count(args, mode: str = "valid", **kwargs):
+    arr = args[0].to_arrow()
+    if mode == "all":
+        out = pc.list_value_length(arr)
+        return Series.from_arrow(pc.fill_null(out, 0).cast(pa.uint64()), args[0].name, DataType.uint64())
+    out = []
+    for v in arr.to_pylist():
+        if v is None:
+            out.append(0)
+        else:
+            out.append(sum(1 for x in v if x is not None))
+    return Series.from_pylist(out, args[0].name, DataType.uint64())
+
+
+@register_kernel("list_get", _inner_field)
+def _list_get(args, default=None, **kwargs):
+    s = args[0]
+    idx = args[1].to_pylist()
+    idx = idx * len(s) if len(idx) == 1 else idx
+    inner = s.dtype.inner
+    out = []
+    for v, i in zip(s.to_pylist(), idx):
+        if v is None or i is None or not (-len(v) <= i < len(v)):
+            out.append(default)
+        else:
+            out.append(v[i])
+    return Series.from_pylist(out, s.name, inner)
+
+
+@register_kernel("list_slice", _same)
+def _list_slice(args, end=None, **kwargs):
+    s = args[0]
+    start = int(args[1].to_pylist()[0])
+    out = [None if v is None else v[start:end] for v in s.to_pylist()]
+    return Series.from_pylist(out, s.name, s.dtype)
+
+
+@register_kernel("list_chunk", lambda f, k: Field(f[0].name, DataType.list(DataType.fixed_size_list(f[0].dtype.inner, k["size"]))))
+def _list_chunk(args, size: int = 1, **kwargs):
+    s = args[0]
+    out = []
+    for v in s.to_pylist():
+        if v is None:
+            out.append(None)
+        else:
+            chunks = [v[i:i + size] for i in range(0, len(v) - size + 1, size)]
+            out.append(chunks)
+    return Series.from_pylist(out, s.name, DataType.list(DataType.fixed_size_list(s.dtype.inner, size)))
+
+
+@register_kernel("list_join", lambda f, k: Field(f[0].name, DataType.string()))
+def _list_join(args, **kwargs):
+    sep = args[1].to_pylist()[0]
+    arr = args[0].to_arrow()
+    out = pc.binary_join(arr.cast(pa.large_list(pa.large_string())), sep)
+    return Series.from_arrow(out, args[0].name, DataType.string())
+
+
+def _agg_resolver(out_dtype_fn):
+    def resolver(fields, kwargs):
+        f = fields[0]
+        if not f.dtype.is_list():
+            raise DaftTypeError(f"Expected list column, got {f.dtype!r}")
+        return Field(f.name, out_dtype_fn(f.dtype.inner))
+
+    return resolver
+
+
+def _list_agg(pyarrow_agg, np_fallback):
+    def fn(args, **kwargs):
+        s = args[0]
+        out = []
+        for v in s.to_pylist():
+            if v is None:
+                out.append(None)
+            else:
+                vals = [x for x in v if x is not None]
+                out.append(np_fallback(vals) if vals else None)
+        return out
+
+    return fn
+
+
+@register_kernel("list_sum", _agg_resolver(lambda dt: dt))
+def _list_sum(args, **kwargs):
+    out = _list_agg(None, lambda v: sum(v))(args)
+    return Series.from_pylist(out, args[0].name, args[0].dtype.inner)
+
+
+@register_kernel("list_mean", _agg_resolver(lambda dt: DataType.float64()))
+def _list_mean(args, **kwargs):
+    out = _list_agg(None, lambda v: float(np.mean(v)))(args)
+    return Series.from_pylist(out, args[0].name, DataType.float64())
+
+
+@register_kernel("list_min", _agg_resolver(lambda dt: dt))
+def _list_min(args, **kwargs):
+    out = _list_agg(None, lambda v: min(v))(args)
+    return Series.from_pylist(out, args[0].name, args[0].dtype.inner)
+
+
+@register_kernel("list_max", _agg_resolver(lambda dt: dt))
+def _list_max(args, **kwargs):
+    out = _list_agg(None, lambda v: max(v))(args)
+    return Series.from_pylist(out, args[0].name, args[0].dtype.inner)
+
+
+@register_kernel("list_sort", _same)
+def _list_sort(args, desc: bool = False, **kwargs):
+    s = args[0]
+    out = []
+    for v in s.to_pylist():
+        if v is None:
+            out.append(None)
+        else:
+            vals = sorted((x for x in v if x is not None), reverse=desc)
+            nulls = [None] * (len(v) - len(vals))
+            out.append(vals + nulls)
+    return Series.from_pylist(out, s.name, s.dtype)
+
+
+@register_kernel("list_distinct", _same)
+def _list_distinct(args, **kwargs):
+    s = args[0]
+    out = []
+    for v in s.to_pylist():
+        if v is None:
+            out.append(None)
+        else:
+            seen, res = set(), []
+            for x in v:
+                if x is not None and x not in seen:
+                    seen.add(x)
+                    res.append(x)
+            out.append(res)
+    return Series.from_pylist(out, s.name, s.dtype)
+
+
+@register_kernel("list_contains", lambda f, k: Field(f[0].name, DataType.bool()))
+def _list_contains(args, **kwargs):
+    s = args[0]
+    needle = args[1].to_pylist()
+    needle = needle * len(s) if len(needle) == 1 else needle
+    out = [None if v is None else (n in v) for v, n in zip(s.to_pylist(), needle)]
+    return Series.from_pylist(out, s.name, DataType.bool())
+
+
+@register_kernel("list_value_counts", lambda f, k: Field(f[0].name, DataType.map(f[0].dtype.inner, DataType.uint64())))
+def _list_value_counts(args, **kwargs):
+    s = args[0]
+    out = []
+    for v in s.to_pylist():
+        if v is None:
+            out.append(None)
+        else:
+            counts: dict = {}
+            for x in v:
+                if x is not None:
+                    counts[x] = counts.get(x, 0) + 1
+            out.append(list(counts.items()))
+    dtype = DataType.map(s.dtype.inner, DataType.uint64())
+    return Series.from_arrow(pa.array(out, dtype.to_arrow()), s.name, dtype)

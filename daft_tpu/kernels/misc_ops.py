@@ -1,0 +1,133 @@
+"""Misc scalar kernels: hashing, null-fills, coalesce, minhash
+(reference: src/daft-functions, src/daft-minhash)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from daft_tpu.datatype import DataType, unify_dtypes
+from daft_tpu.errors import DaftTypeError
+from daft_tpu.kernels.registry import register_kernel, same_dtype
+from daft_tpu.schema import Field
+from daft_tpu.series import Series
+
+
+@register_kernel("hash", lambda f, k: Field(f[0].name, DataType.uint64()))
+def _hash(args, seed=None, **kwargs):
+    s = args[0]
+    seed_series = None
+    if seed is not None:
+        seed_series = Series.from_numpy(np.full(len(s), seed, dtype=np.uint64))
+    return s.hash(seed_series)
+
+
+@register_kernel("fill_null", same_dtype)
+def _fill_null(args, **kwargs):
+    return args[0].fill_null(args[1].cast(args[0].dtype))
+
+
+def _coalesce_resolver(fields, kwargs):
+    dt = fields[0].dtype
+    for f in fields[1:]:
+        dt = unify_dtypes(dt, f.dtype)
+    return Field(fields[0].name, dt)
+
+
+@register_kernel("coalesce", _coalesce_resolver)
+def _coalesce(args, **kwargs):
+    dt = args[0].dtype
+    for a in args[1:]:
+        dt = unify_dtypes(dt, a.dtype)
+    out = args[0].cast(dt)
+    for a in args[1:]:
+        out = out.fill_null(a.cast(dt))
+    return out
+
+
+@register_kernel("list_count_distinct", lambda f, k: Field(f[0].name, DataType.uint64()))
+def _list_count_distinct(args, **kwargs):
+    """Distinct-element count per list row (used by two-phase count_distinct)."""
+    s = args[0]
+    out = []
+    for v in s.to_pylist():
+        if v is None:
+            out.append(0)
+        else:
+            out.append(len({x for x in v if x is not None}))
+    return Series.from_pylist(out, s.name, DataType.uint64())
+
+
+def _quantile_resolver(fields, kwargs):
+    q = kwargs.get("percentiles")
+    if isinstance(q, (list, tuple)):
+        return Field(fields[0].name, DataType.list(DataType.float64()))
+    return Field(fields[0].name, DataType.float64())
+
+
+@register_kernel("list_quantile", _quantile_resolver)
+def _list_quantile(args, percentiles=0.5, **kwargs):
+    """Quantile(s) of each list row (two-phase approx_percentile finalizer)."""
+    s = args[0]
+    multi = isinstance(percentiles, (list, tuple))
+    qs = list(percentiles) if multi else [percentiles]
+    out = []
+    for v in s.to_pylist():
+        vals = [x for x in (v or []) if x is not None]
+        if not vals:
+            out.append(None)
+        else:
+            res = [float(np.quantile(np.asarray(vals, dtype=np.float64), q)) for q in qs]
+            out.append(res if multi else res[0])
+    dt = DataType.list(DataType.float64()) if multi else DataType.float64()
+    return Series.from_pylist(out, s.name, dt)
+
+
+@register_kernel("pow_3_2", lambda f, k: Field(f[0].name, DataType.float64()))
+def _pow_3_2(args, **kwargs):
+    s = args[0]
+    vals, mask = s.to_numpy_masked()
+    with np.errstate(all="ignore"):
+        out = np.power(vals.astype(np.float64), 1.5)
+    return Series.from_numpy(out, s.name)._with_mask(mask)
+
+
+@register_kernel("minhash", lambda f, k: Field(f[0].name, DataType.fixed_size_list(DataType.uint32(), k["num_hashes"])))
+def _minhash(args, num_hashes: int = 64, ngram_size: int = 1, seed: int = 1, **kwargs):
+    """MinHash signature over word ngrams (reference: src/daft-minhash/src/lib.rs).
+
+    Universal-hash family h_i(x) = (a_i * x + b_i) mod p over 64-bit FNV token
+    hashes, vectorised with numpy. TPU note: this stays host-side — variable
+    token counts per row are XLA-hostile.
+    """
+    from daft_tpu.kernels.hashing import hash_bytes_batch
+
+    s = args[0]
+    if not s.dtype.is_string():
+        raise DaftTypeError("minhash requires a string column")
+    rng = np.random.default_rng(seed)
+    MERSENNE = np.uint64((1 << 61) - 1)
+    a = rng.integers(1, MERSENNE, size=num_hashes, dtype=np.uint64)
+    b = rng.integers(0, MERSENNE, size=num_hashes, dtype=np.uint64)
+    out = np.zeros((len(s), num_hashes), dtype=np.uint32)
+    validity = np.ones(len(s), dtype=bool)
+    for i, text in enumerate(s.to_pylist()):
+        if text is None:
+            validity[i] = False
+            continue
+        words = text.split()
+        if len(words) >= ngram_size and words:
+            grams = [" ".join(words[j:j + ngram_size]) for j in range(len(words) - ngram_size + 1)]
+        else:
+            grams = [" ".join(words)] if words else [""]
+        data = "\x00".join(grams).encode()
+        lens = np.array([len(g.encode()) for g in grams], dtype=np.int64)
+        starts = np.concatenate([[0], np.cumsum(lens[:-1] + 1)]).astype(np.int64)
+        token_hashes = hash_bytes_batch(np.frombuffer(data, dtype=np.uint8), starts, lens)
+        with np.errstate(over="ignore"):
+            hv = (token_hashes[None, :] * a[:, None] + b[:, None]) % MERSENNE
+        out[i] = hv.min(axis=1).astype(np.uint32)
+    dt = DataType.fixed_size_list(DataType.uint32(), num_hashes)
+    res = Series.from_numpy(out, s.name, dt)
+    if not validity.all():
+        res = res._with_mask(~validity)
+    return res

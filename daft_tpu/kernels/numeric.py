@@ -1,0 +1,129 @@
+"""Numeric scalar kernels.
+
+CPU side uses Arrow C++ compute; each kernel also carries a JAX lowering so the
+device-eval path can fuse it into an XLA computation on TPU (the reference's
+equivalents are per-array Rust kernels, src/daft-core/src/array/ops/*).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.compute as pc
+
+from daft_tpu.datatype import DataType
+from daft_tpu.kernels.registry import float_preserving, register_kernel, returns, same_dtype
+from daft_tpu.series import Series
+
+
+def _unary_arrow(pc_fn):
+    def fn(args, **kwargs):
+        s = args[0]
+        out = pc_fn(s.to_arrow())
+        return Series.from_arrow(out, s.name)
+
+    return fn
+
+
+def _unary_numpy(np_fn, out_float=True):
+    def fn(args, **kwargs):
+        s = args[0]
+        vals, mask = s.to_numpy_masked()
+        dtype = np.float32 if s.dtype == DataType.float32() else np.float64
+        with np.errstate(all="ignore"):
+            out = np_fn(vals.astype(dtype))
+        return Series.from_numpy(out, s.name)._with_mask(mask)
+
+    return fn
+
+
+import jax.numpy as jnp  # noqa: E402  (device lowerings)
+
+
+def _reg_float(name, np_fn, jax_fn):
+    register_kernel(name, float_preserving, jax_fn=jax_fn)(_unary_numpy(np_fn))
+
+
+_reg_float("sqrt", np.sqrt, lambda a: jnp.sqrt(a[0]))
+_reg_float("cbrt", np.cbrt, lambda a: jnp.cbrt(a[0]))
+_reg_float("exp", np.exp, lambda a: jnp.exp(a[0]))
+_reg_float("expm1", np.expm1, lambda a: jnp.expm1(a[0]))
+_reg_float("ln", np.log, lambda a: jnp.log(a[0]))
+_reg_float("log1p", np.log1p, lambda a: jnp.log1p(a[0]))
+_reg_float("log2", np.log2, lambda a: jnp.log2(a[0]))
+_reg_float("log10", np.log10, lambda a: jnp.log10(a[0]))
+_reg_float("sin", np.sin, lambda a: jnp.sin(a[0]))
+_reg_float("cos", np.cos, lambda a: jnp.cos(a[0]))
+_reg_float("tan", np.tan, lambda a: jnp.tan(a[0]))
+_reg_float("asin", np.arcsin, lambda a: jnp.arcsin(a[0]))
+_reg_float("acos", np.arccos, lambda a: jnp.arccos(a[0]))
+_reg_float("atan", np.arctan, lambda a: jnp.arctan(a[0]))
+_reg_float("sinh", np.sinh, lambda a: jnp.sinh(a[0]))
+_reg_float("cosh", np.cosh, lambda a: jnp.cosh(a[0]))
+_reg_float("tanh", np.tanh, lambda a: jnp.tanh(a[0]))
+
+
+@register_kernel("log", float_preserving, jax_fn=lambda a, base=None: jnp.log(a[0]) / jnp.log(base))
+def _log(args, base=None, **kwargs):
+    s = args[0]
+    vals, mask = s.to_numpy_masked()
+    with np.errstate(all="ignore"):
+        out = np.log(vals.astype(np.float64)) / np.log(base)
+    return Series.from_numpy(out, s.name)._with_mask(mask)
+
+
+@register_kernel("atan2", float_preserving, jax_fn=lambda a: jnp.arctan2(a[0], a[1]))
+def _atan2(args, **kwargs):
+    y, x = args[0], args[1]
+    vals_y, mask = y.to_numpy_masked()
+    vals_x, mask_x = x.to_numpy_masked()
+    out = np.arctan2(vals_y.astype(np.float64), vals_x.astype(np.float64))
+    if mask is None:
+        mask = mask_x
+    elif mask_x is not None:
+        mask = mask | mask_x
+    return Series.from_numpy(out, y.name)._with_mask(mask)
+
+
+@register_kernel("ceil", same_dtype, jax_fn=lambda a: jnp.ceil(a[0]))
+def _ceil(args, **kwargs):
+    s = args[0]
+    if s.dtype.is_integer():
+        return s
+    return Series.from_arrow(pc.ceil(s.to_arrow()), s.name, s.dtype)
+
+
+@register_kernel("floor", same_dtype, jax_fn=lambda a: jnp.floor(a[0]))
+def _floor(args, **kwargs):
+    s = args[0]
+    if s.dtype.is_integer():
+        return s
+    return Series.from_arrow(pc.floor(s.to_arrow()), s.name, s.dtype)
+
+
+@register_kernel("round", same_dtype, jax_fn=lambda a, decimals=0: jnp.round(a[0], decimals))
+def _round(args, decimals: int = 0, **kwargs):
+    s = args[0]
+    if s.dtype.is_integer():
+        return s
+    return Series.from_arrow(
+        pc.round(s.to_arrow(), ndigits=decimals, round_mode="half_to_even"), s.name, s.dtype
+    )
+
+
+@register_kernel("sign", same_dtype, jax_fn=lambda a: jnp.sign(a[0]))
+def _sign(args, **kwargs):
+    s = args[0]
+    return Series.from_arrow(pc.sign(s.to_arrow()).cast(s.dtype.to_arrow()), s.name, s.dtype)
+
+
+def _clip_jax(a, min=None, max=None):
+    return jnp.clip(a[0], min, max)
+
+
+@register_kernel("clip", same_dtype, jax_fn=_clip_jax)
+def _clip(args, min=None, max=None, **kwargs):
+    s = args[0]
+    vals, mask = s.to_numpy_masked()
+    out = np.clip(vals, min, max)
+    return Series.from_numpy(out, s.name, s.dtype)._with_mask(mask)

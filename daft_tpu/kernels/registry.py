@@ -1,0 +1,112 @@
+"""Scalar-function kernel registry.
+
+Reference: the reference registers scalar functions into a ``FunctionRegistry``
+keyed by name (src/daft-dsl/src/functions/scalar.rs, module registration e.g.
+src/daft-geo/src/lib.rs:4-8). Here each kernel bundles a CPU implementation
+over Series with a field resolver; device-lowerable kernels also carry a JAX
+lowering used by the device-eval fusion path (daft_tpu/ops).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional
+
+from daft_tpu.errors import DaftValueError
+from daft_tpu.schema import Field
+
+
+class Kernel:
+    __slots__ = ("name", "fn", "resolver", "jax_fn")
+
+    def __init__(
+        self,
+        name: str,
+        fn: Callable,
+        resolver: Callable[[List[Field], Dict[str, Any]], Field],
+        jax_fn: Optional[Callable] = None,
+    ):
+        self.name = name
+        self.fn = fn            # (args: list[Series], **kwargs) -> Series
+        self.resolver = resolver
+        self.jax_fn = jax_fn    # (args: list[jax.Array], **kwargs) -> jax.Array
+
+    def resolve(self, fields: List[Field], kwargs: Dict[str, Any]) -> Field:
+        return self.resolver(fields, kwargs)
+
+    def __call__(self, args, **kwargs):
+        return self.fn(args, **kwargs)
+
+
+_REGISTRY: Dict[str, Kernel] = {}
+
+
+def register_kernel(name: str, resolver, jax_fn=None):
+    """Decorator: register ``fn(args: list[Series], **kwargs) -> Series``."""
+
+    def deco(fn):
+        _REGISTRY[name] = Kernel(name, fn, resolver, jax_fn)
+        return fn
+
+    return deco
+
+
+def get_kernel(name: str) -> Kernel:
+    _ensure_loaded()
+    k = _REGISTRY.get(name)
+    if k is None:
+        raise DaftValueError(f"Unknown function: {name!r}")
+    return k
+
+
+def has_kernel(name: str) -> bool:
+    _ensure_loaded()
+    return name in _REGISTRY
+
+
+def all_kernels() -> Dict[str, Kernel]:
+    _ensure_loaded()
+    return dict(_REGISTRY)
+
+
+_loaded = False
+
+
+def _ensure_loaded() -> None:
+    global _loaded
+    if _loaded:
+        return
+    _loaded = True
+    # Import for side effect of registration.
+    from daft_tpu.kernels import (  # noqa: F401
+        binary_ops,
+        embedding_ops,
+        float_ops,
+        image_ops,
+        list_ops,
+        misc_ops,
+        numeric,
+        string_ops,
+        struct_map_ops,
+        temporal_ops,
+    )
+
+
+# -- shared resolvers ------------------------------------------------------
+def same_dtype(fields, kwargs):
+    return fields[0]
+
+
+def returns(dtype):
+    def resolver(fields, kwargs):
+        return fields[0].with_dtype(dtype)
+
+    return resolver
+
+
+def float_preserving(fields, kwargs):
+    """float32 stays float32, everything else promotes to float64."""
+    from daft_tpu.datatype import DataType, TypeId
+
+    dt = fields[0].dtype
+    out = DataType.float32() if dt.id in (TypeId.FLOAT32, TypeId.BFLOAT16) else DataType.float64()
+    return fields[0].with_dtype(out)

@@ -1,0 +1,55 @@
+"""Struct/map kernels (reference: struct/map ops in src/daft-core)."""
+
+from __future__ import annotations
+
+import pyarrow.compute as pc
+
+from daft_tpu.datatype import DataType
+from daft_tpu.errors import DaftTypeError
+from daft_tpu.kernels.registry import register_kernel
+from daft_tpu.schema import Field
+from daft_tpu.series import Series
+
+
+def _struct_get_resolver(fields, kwargs):
+    f = fields[0]
+    if not f.dtype.is_struct():
+        raise DaftTypeError(f"struct.get on non-struct {f.dtype!r}")
+    name = kwargs["name"]
+    inner = f.dtype.fields.get(name)
+    if inner is None:
+        raise DaftTypeError(f"Struct has no field {name!r}")
+    return Field(name, inner)
+
+
+@register_kernel("struct_get", _struct_get_resolver)
+def _struct_get(args, name: str = "", **kwargs):
+    s = args[0]
+    out = pc.struct_field(s.to_arrow(), name)
+    return Series.from_arrow(out, name, s.dtype.fields[name])
+
+
+def _map_get_resolver(fields, kwargs):
+    f = fields[0]
+    if not f.dtype.is_map():
+        raise DaftTypeError(f"map.get on non-map {f.dtype!r}")
+    return Field("value", f.dtype._params[1])
+
+
+@register_kernel("map_get", _map_get_resolver)
+def _map_get(args, **kwargs):
+    s = args[0]
+    key = args[1].to_pylist()[0]
+    value_dtype = s.dtype._params[1]
+    out = []
+    for row in s.to_arrow().to_pylist():
+        if row is None:
+            out.append(None)
+            continue
+        val = None
+        for k, v in row:
+            if k == key:
+                val = v
+                break
+        out.append(val)
+    return Series.from_pylist(out, "value", value_dtype)

@@ -1,0 +1,93 @@
+"""Temporal kernels (reference: src/daft-functions-temporal)."""
+
+from __future__ import annotations
+
+import pyarrow as pa
+import pyarrow.compute as pc
+
+from daft_tpu.datatype import DataType, TimeUnit, TypeId
+from daft_tpu.kernels.registry import register_kernel, returns
+from daft_tpu.schema import Field
+from daft_tpu.series import Series
+
+_I32 = DataType.int32()
+_U32 = DataType.uint32()
+
+
+def _wrap(out, name, dtype):
+    return Series.from_arrow(out, name, dtype)
+
+
+def _simple(name, pc_fn, dtype, cast=None):
+    @register_kernel(name, returns(dtype))
+    def fn(args, **kwargs):
+        out = pc_fn(args[0].to_arrow())
+        if cast is not None:
+            out = out.cast(cast)
+        return _wrap(out, args[0].name, dtype)
+
+    return fn
+
+
+_simple("dt_day", pc.day, _U32, pa.uint32())
+_simple("dt_hour", pc.hour, _U32, pa.uint32())
+_simple("dt_minute", pc.minute, _U32, pa.uint32())
+_simple("dt_second", pc.second, _U32, pa.uint32())
+_simple("dt_millisecond", pc.millisecond, _U32, pa.uint32())
+_simple("dt_microsecond", pc.microsecond, _U32, pa.uint32())
+_simple("dt_month", pc.month, _U32, pa.uint32())
+_simple("dt_quarter", pc.quarter, _U32, pa.uint32())
+_simple("dt_year", pc.year, _I32, pa.int32())
+_simple("dt_day_of_year", pc.day_of_year, _U32, pa.uint32())
+_simple("dt_week_of_year", pc.iso_week, _U32, pa.uint32())
+
+
+@register_kernel("dt_date", returns(DataType.date()))
+def _date(args, **kwargs):
+    return _wrap(args[0].to_arrow().cast(pa.date32()), args[0].name, DataType.date())
+
+
+@register_kernel("dt_day_of_week", returns(_U32))
+def _day_of_week(args, **kwargs):
+    out = pc.day_of_week(args[0].to_arrow(), count_from_zero=True)
+    return _wrap(out.cast(pa.uint32()), args[0].name, _U32)
+
+
+@register_kernel("dt_time", lambda f, k: Field(f[0].name, DataType.time("us")))
+def _time(args, **kwargs):
+    out = args[0].to_arrow().cast(pa.time64("us"))
+    return _wrap(out, args[0].name, DataType.time("us"))
+
+
+@register_kernel("dt_truncate", lambda f, k: f[0])
+def _truncate(args, interval: str = "1 day", **kwargs):
+    num, unit = interval.split(" ", 1) if " " in interval else ("1", interval)
+    unit = unit.rstrip("s")
+    out = pc.floor_temporal(args[0].to_arrow(), multiple=int(num), unit=unit)
+    return Series.from_arrow(out, args[0].name, args[0].dtype)
+
+
+@register_kernel("dt_to_unix_epoch", returns(DataType.int64()))
+def _to_unix_epoch(args, time_unit: str = "s", **kwargs):
+    tu = TimeUnit.from_str(time_unit)
+    arr = args[0].to_arrow()
+    if not pa.types.is_timestamp(arr.type):
+        arr = arr.cast(pa.timestamp("us"))
+    out = arr.cast(pa.timestamp(tu.value)).cast(pa.int64())
+    return _wrap(out, args[0].name, DataType.int64())
+
+
+@register_kernel("dt_strftime", returns(DataType.string()))
+def _strftime(args, format=None, **kwargs):
+    fmt = format or ("%Y-%m-%d" if args[0].dtype.id == TypeId.DATE else "%Y-%m-%dT%H:%M:%S%.f")
+    fmt = fmt.replace("%.f", "%f")
+    out = pc.strftime(args[0].to_arrow(), format=fmt)
+    return _wrap(out.cast(pa.large_string()), args[0].name, DataType.string())
+
+
+@register_kernel("dt_total_seconds", returns(DataType.float64()))
+def _total_seconds(args, **kwargs):
+    arr = args[0].to_arrow()
+    us = arr.cast(pa.duration("us")).cast(pa.int64())
+    out = pc.divide(us.cast(pa.float64()), 1_000_000.0)
+    return _wrap(out, args[0].name, DataType.float64())

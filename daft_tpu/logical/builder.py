@@ -1,0 +1,163 @@
+"""LogicalPlanBuilder (reference: src/daft-logical-plan/src/builder/mod.rs:61-1240).
+
+Thin, immutable builder over LogicalPlan nodes; the DataFrame API wraps this.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+from daft_tpu.errors import DaftValueError
+from daft_tpu.expressions.expr import ColumnRef, Expr
+from daft_tpu.logical import plan as lp
+from daft_tpu.schema import Schema
+
+
+class LogicalPlanBuilder:
+    def __init__(self, plan: lp.LogicalPlan):
+        self._plan = plan
+
+    @property
+    def plan(self) -> lp.LogicalPlan:
+        return self._plan
+
+    @property
+    def schema(self) -> Schema:
+        return self._plan.schema
+
+    # -- sources ----------------------------------------------------------
+    @staticmethod
+    def in_memory(partitions: Sequence, schema: Schema) -> "LogicalPlanBuilder":
+        return LogicalPlanBuilder(lp.InMemorySource(partitions, schema))
+
+    @staticmethod
+    def scan(scan_info) -> "LogicalPlanBuilder":
+        return LogicalPlanBuilder(lp.ScanSource(scan_info, scan_info.schema))
+
+    # -- row ops ----------------------------------------------------------
+    def project(self, exprs: Sequence[Expr]) -> "LogicalPlanBuilder":
+        from daft_tpu.expressions.expr import Alias, WindowExpr
+
+        # Projections containing window expressions plan a Window node that
+        # appends the window columns, then a final Project re-shapes
+        # (reference: window extraction in the logical builder, daft/window.py).
+        window_aliases = []
+        counter = [0]
+
+        def hoist(n: Expr):
+            if isinstance(n, WindowExpr):
+                name = f"__window_{counter[0]}"
+                counter[0] += 1
+                window_aliases.append(Alias(n, name))
+                from daft_tpu.expressions.expr import ColumnRef
+
+                return ColumnRef(name)
+            return None
+
+        rewritten = []
+        for e in exprs:
+            r = e.transform(hoist)
+            rewritten.append(Alias(r, e.name()) if r is not e and r.name() != e.name() else r)
+        if window_aliases:
+            windowed = lp.Window(self._plan, window_aliases)
+            return LogicalPlanBuilder(lp.Project(windowed, rewritten))
+        return LogicalPlanBuilder(lp.Project(self._plan, exprs))
+
+    def select(self, exprs: Sequence[Expr]) -> "LogicalPlanBuilder":
+        return self.project(exprs)
+
+    def with_columns(self, exprs: Sequence[Expr]) -> "LogicalPlanBuilder":
+        new_names = {e.name() for e in exprs}
+        keep = [ColumnRef(f.name) for f in self.schema if f.name not in new_names]
+        return self.project(keep + list(exprs))
+
+    def exclude(self, names: Sequence[str]) -> "LogicalPlanBuilder":
+        drop = set(names)
+        keep = [ColumnRef(f.name) for f in self.schema if f.name not in drop]
+        return self.project(keep)
+
+    def filter(self, predicate: Expr) -> "LogicalPlanBuilder":
+        return LogicalPlanBuilder(lp.Filter(self._plan, predicate))
+
+    def limit(self, n: int, offset: int = 0) -> "LogicalPlanBuilder":
+        return LogicalPlanBuilder(lp.Limit(self._plan, n, offset))
+
+    def sample(self, fraction=None, size=None, with_replacement=False, seed=None) -> "LogicalPlanBuilder":
+        return LogicalPlanBuilder(lp.Sample(self._plan, fraction, size, with_replacement, seed))
+
+    def explode(self, exprs: Sequence[Expr]) -> "LogicalPlanBuilder":
+        return LogicalPlanBuilder(lp.Explode(self._plan, exprs))
+
+    def unpivot(self, ids, values, variable_name="variable", value_name="value") -> "LogicalPlanBuilder":
+        return LogicalPlanBuilder(lp.Unpivot(self._plan, ids, values, variable_name, value_name))
+
+    def add_monotonically_increasing_id(self, column_name: str = "id") -> "LogicalPlanBuilder":
+        return LogicalPlanBuilder(lp.MonotonicallyIncreasingId(self._plan, column_name))
+
+    # -- blocking ---------------------------------------------------------
+    def sort(self, sort_by: Sequence[Expr], descending: Sequence[bool],
+             nulls_first: Optional[Sequence[bool]] = None) -> "LogicalPlanBuilder":
+        return LogicalPlanBuilder(lp.Sort(self._plan, sort_by, descending, nulls_first))
+
+    def aggregate(self, agg_exprs: Sequence[Expr], group_by: Sequence[Expr]) -> "LogicalPlanBuilder":
+        return LogicalPlanBuilder(lp.Aggregate(self._plan, agg_exprs, group_by))
+
+    def pivot(self, group_by, pivot_col, value_col, agg_fn, names) -> "LogicalPlanBuilder":
+        return LogicalPlanBuilder(lp.Pivot(self._plan, group_by, pivot_col, value_col, agg_fn, names))
+
+    def distinct(self, on: Optional[Sequence[Expr]] = None) -> "LogicalPlanBuilder":
+        return LogicalPlanBuilder(lp.Distinct(self._plan, on))
+
+    def window(self, window_exprs: Sequence[Expr]) -> "LogicalPlanBuilder":
+        return LogicalPlanBuilder(lp.Window(self._plan, window_exprs))
+
+    # -- multi-input ------------------------------------------------------
+    def concat(self, other: "LogicalPlanBuilder") -> "LogicalPlanBuilder":
+        return LogicalPlanBuilder(lp.Concat([self._plan, other._plan]))
+
+    def join(self, right: "LogicalPlanBuilder", left_on, right_on, how="inner",
+             strategy=None, suffix="right.", prefix="") -> "LogicalPlanBuilder":
+        return LogicalPlanBuilder(lp.Join(self._plan, right._plan, left_on, right_on,
+                                          how, strategy, suffix, prefix))
+
+    def cross_join(self, right: "LogicalPlanBuilder", suffix="right.") -> "LogicalPlanBuilder":
+        return LogicalPlanBuilder(lp.Join(self._plan, right._plan, [], [], "cross", None, suffix))
+
+    def intersect(self, right: "LogicalPlanBuilder", is_all=False) -> "LogicalPlanBuilder":
+        return LogicalPlanBuilder(lp.Intersect(self._plan, right._plan, is_all))
+
+    def except_(self, right: "LogicalPlanBuilder", is_all=False) -> "LogicalPlanBuilder":
+        return LogicalPlanBuilder(lp.Except(self._plan, right._plan, is_all))
+
+    # -- partitioning / sink ---------------------------------------------
+    def repartition_hash(self, exprs: Sequence[Expr], num_partitions: int) -> "LogicalPlanBuilder":
+        return LogicalPlanBuilder(lp.Repartition(self._plan, ("hash", list(exprs), num_partitions)))
+
+    def repartition_random(self, num_partitions: int) -> "LogicalPlanBuilder":
+        return LogicalPlanBuilder(lp.Repartition(self._plan, ("random", num_partitions)))
+
+    def into_partitions(self, num_partitions: int) -> "LogicalPlanBuilder":
+        return LogicalPlanBuilder(lp.Repartition(self._plan, ("into", num_partitions)))
+
+    def shard(self, strategy: str, world_size: int, rank: int) -> "LogicalPlanBuilder":
+        return LogicalPlanBuilder(lp.Shard(self._plan, strategy, world_size, rank))
+
+    def table_write(self, write_info) -> "LogicalPlanBuilder":
+        return LogicalPlanBuilder(lp.Sink(self._plan, write_info))
+
+    # -- optimization -----------------------------------------------------
+    def optimize(self, cfg=None) -> "LogicalPlanBuilder":
+        from daft_tpu.logical.optimizer import Optimizer
+
+        return LogicalPlanBuilder(Optimizer(cfg).optimize(self._plan))
+
+    def explain_string(self, show_all: bool = False) -> str:
+        out = ["== Unoptimized Logical Plan ==", repr(self._plan)]
+        if show_all:
+            out += ["", "== Optimized Logical Plan ==", repr(self.optimize()._plan)]
+            from daft_tpu.physical.translate import translate
+            from daft_tpu.context import get_context
+
+            out += ["", "== Physical Plan ==",
+                    repr(translate(self.optimize()._plan, get_context().execution_config))]
+        return "\n".join(out)

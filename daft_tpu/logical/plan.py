@@ -1,0 +1,531 @@
+"""Logical plan nodes.
+
+Reference: the 30-variant ``LogicalPlan`` enum
+(src/daft-logical-plan/src/logical_plan.rs:35-66) and its per-op modules
+(src/daft-logical-plan/src/ops/*). Nodes are immutable; output schema is
+resolved eagerly at construction so schema errors surface at build time,
+matching the reference's behavior.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from daft_tpu.datatype import DataType, unify_dtypes
+from daft_tpu.errors import DaftPlanError, DaftSchemaError, DaftTypeError, DaftValueError
+from daft_tpu.expressions.expr import AggOp, Alias, ColumnRef, Expr, WindowExpr
+from daft_tpu.schema import Field, Schema
+from daft_tpu.stats import ApproxStats
+
+
+class LogicalPlan:
+    """Base logical plan node."""
+
+    def __init__(self, children: Sequence["LogicalPlan"], schema: Schema):
+        self._children = list(children)
+        self._schema = schema
+
+    @property
+    def schema(self) -> Schema:
+        return self._schema
+
+    def children(self) -> List["LogicalPlan"]:
+        return list(self._children)
+
+    def with_children(self, children: Sequence["LogicalPlan"]) -> "LogicalPlan":
+        raise NotImplementedError
+
+    def name(self) -> str:
+        return type(self).__name__
+
+    def multiline_display(self) -> List[str]:
+        return [self.name()]
+
+    def approx_stats(self) -> ApproxStats:
+        """Cardinality estimate used by join ordering / broadcast decisions
+        (reference: src/daft-logical-plan/src/stats.rs)."""
+        if self._children:
+            return self._children[0].approx_stats()
+        return ApproxStats()
+
+    def repr_indent(self, level: int = 0) -> str:
+        pad = "  " * level
+        lines = [pad + ("* " if level == 0 else "|- ") + "; ".join(self.multiline_display())]
+        for c in self._children:
+            lines.append(c.repr_indent(level + 1))
+        return "\n".join(lines)
+
+    def __repr__(self) -> str:
+        return self.repr_indent()
+
+    def walk(self):
+        yield self
+        for c in self._children:
+            yield from c.walk()
+
+
+# ---------------------------------------------------------------------- #
+# Sources                                                                 #
+# ---------------------------------------------------------------------- #
+class InMemorySource(LogicalPlan):
+    """Materialised partitions already in memory (reference:
+    LogicalPlan::Source with InMemory scan info, ops/source.rs)."""
+
+    def __init__(self, partitions: Sequence, schema: Schema):
+        super().__init__([], schema)
+        self.partitions = list(partitions)
+
+    def with_children(self, children):
+        assert not children
+        return self
+
+    def multiline_display(self):
+        return [f"InMemorySource: {len(self.partitions)} partitions"]
+
+    def approx_stats(self) -> ApproxStats:
+        rows = sum(len(p) for p in self.partitions)
+        size = sum(p.size_bytes() for p in self.partitions)
+        return ApproxStats(rows, size)
+
+
+class ScanSource(LogicalPlan):
+    """A file-based scan (reference: LogicalPlan::Source + daft-scan ScanTask,
+    src/daft-scan/src/lib.rs:350-378). Carries pushdowns mutated by the
+    optimizer: projection, filter, limit, sharding."""
+
+    def __init__(self, scan_info, schema: Schema, pushdowns=None):
+        super().__init__([], schema)
+        self.scan_info = scan_info
+        from daft_tpu.io.scan import Pushdowns
+
+        self.pushdowns = pushdowns or Pushdowns()
+
+    def with_children(self, children):
+        assert not children
+        return self
+
+    def with_pushdowns(self, pushdowns) -> "ScanSource":
+        schema = self._schema
+        if pushdowns.columns is not None:
+            schema = self._schema.select(pushdowns.columns)
+        return ScanSource(self.scan_info, schema, pushdowns)
+
+    def multiline_display(self):
+        out = [f"ScanSource: {self.scan_info.display_name()}"]
+        if self.pushdowns.columns is not None:
+            out.append(f"Projection pushdown = {self.pushdowns.columns}")
+        if self.pushdowns.filters is not None:
+            out.append(f"Filter pushdown = {self.pushdowns.filters!r}")
+        if self.pushdowns.limit is not None:
+            out.append(f"Limit pushdown = {self.pushdowns.limit}")
+        return out
+
+    def approx_stats(self) -> ApproxStats:
+        est = self.scan_info.estimate_rows_bytes()
+        stats = ApproxStats(*est)
+        if self.pushdowns.limit is not None and stats.num_rows > self.pushdowns.limit:
+            frac = self.pushdowns.limit / max(stats.num_rows, 1)
+            stats = stats.scaled(frac)
+        if self.pushdowns.filters is not None:
+            stats = stats.scaled(0.2)
+        return stats
+
+
+# ---------------------------------------------------------------------- #
+# Row-wise ops                                                            #
+# ---------------------------------------------------------------------- #
+class Project(LogicalPlan):
+    def __init__(self, input: LogicalPlan, exprs: Sequence[Expr]):
+        from daft_tpu.expressions.evaluator import resolve_schema
+
+        self.exprs = list(exprs)
+        schema = resolve_schema(self.exprs, input.schema)
+        super().__init__([input], schema)
+
+    def with_children(self, children):
+        return Project(children[0], self.exprs)
+
+    def multiline_display(self):
+        return [f"Project: {', '.join(repr(e) for e in self.exprs[:6])}{'...' if len(self.exprs) > 6 else ''}"]
+
+    def approx_stats(self) -> ApproxStats:
+        return self._children[0].approx_stats()
+
+
+class UDFProject(LogicalPlan):
+    """An isolated UDF projection (reference: optimizer rule SplitUDFs +
+    ops/udf_project — gives the executor a dedicated operator with
+    concurrency/accelerator-slot control)."""
+
+    def __init__(self, input: LogicalPlan, udf_expr: Expr, passthrough: Sequence[Expr]):
+        from daft_tpu.expressions.evaluator import resolve_schema
+
+        self.udf_expr = udf_expr
+        self.passthrough = list(passthrough)
+        schema = resolve_schema(self.passthrough + [udf_expr], input.schema)
+        super().__init__([input], schema)
+
+    def with_children(self, children):
+        return UDFProject(children[0], self.udf_expr, self.passthrough)
+
+    def udf(self):
+        from daft_tpu.expressions.expr import UdfCall
+
+        for node in self.udf_expr.walk():
+            if isinstance(node, UdfCall):
+                return node.udf
+        raise DaftPlanError("UDFProject without UdfCall")
+
+    def multiline_display(self):
+        return [f"UDFProject: {self.udf_expr!r}"]
+
+
+class Filter(LogicalPlan):
+    def __init__(self, input: LogicalPlan, predicate: Expr):
+        pf = predicate.to_field(input.schema)
+        if not pf.dtype.is_boolean() and not pf.dtype.is_null():
+            raise DaftTypeError(f"Filter predicate must be Boolean, got {pf.dtype!r}")
+        self.predicate = predicate
+        super().__init__([input], input.schema)
+
+    def with_children(self, children):
+        return Filter(children[0], self.predicate)
+
+    def multiline_display(self):
+        return [f"Filter: {self.predicate!r}"]
+
+    def approx_stats(self) -> ApproxStats:
+        return self._children[0].approx_stats().scaled(0.2)
+
+
+class Limit(LogicalPlan):
+    def __init__(self, input: LogicalPlan, limit: int, offset: int = 0):
+        self.limit = limit
+        self.offset = offset
+        super().__init__([input], input.schema)
+
+    def with_children(self, children):
+        return Limit(children[0], self.limit, self.offset)
+
+    def multiline_display(self):
+        return [f"Limit: {self.limit}" + (f" offset {self.offset}" if self.offset else "")]
+
+    def approx_stats(self) -> ApproxStats:
+        s = self._children[0].approx_stats()
+        if s.num_rows > self.limit:
+            return s.scaled(self.limit / max(s.num_rows, 1))
+        return s
+
+
+class Sample(LogicalPlan):
+    def __init__(self, input: LogicalPlan, fraction: Optional[float] = None,
+                 size: Optional[int] = None, with_replacement: bool = False,
+                 seed: Optional[int] = None):
+        self.fraction = fraction
+        self.size = size
+        self.with_replacement = with_replacement
+        self.seed = seed
+        super().__init__([input], input.schema)
+
+    def with_children(self, children):
+        return Sample(children[0], self.fraction, self.size, self.with_replacement, self.seed)
+
+
+class Explode(LogicalPlan):
+    def __init__(self, input: LogicalPlan, to_explode: Sequence[Expr]):
+        self.to_explode = list(to_explode)
+        fields = []
+        explode_names = {e.name() for e in self.to_explode}
+        for f in input.schema:
+            if f.name in explode_names:
+                if not f.dtype.is_list():
+                    raise DaftTypeError(f"Cannot explode non-list column {f.name!r} ({f.dtype!r})")
+                fields.append(Field(f.name, f.dtype.inner))
+            else:
+                fields.append(f)
+        super().__init__([input], Schema(fields))
+
+    def with_children(self, children):
+        return Explode(children[0], self.to_explode)
+
+    def multiline_display(self):
+        return [f"Explode: {[e.name() for e in self.to_explode]}"]
+
+
+class Unpivot(LogicalPlan):
+    def __init__(self, input: LogicalPlan, ids: Sequence[Expr], values: Sequence[Expr],
+                 variable_name: str = "variable", value_name: str = "value"):
+        self.ids = list(ids)
+        self.values = list(values)
+        self.variable_name = variable_name
+        self.value_name = value_name
+        if not self.values:
+            raise DaftValueError("unpivot requires at least one value column")
+        val_dtype = DataType.null()
+        for v in self.values:
+            val_dtype = unify_dtypes(val_dtype, v.to_field(input.schema).dtype)
+        fields = [e.to_field(input.schema) for e in self.ids]
+        fields.append(Field(variable_name, DataType.string()))
+        fields.append(Field(value_name, val_dtype))
+        super().__init__([input], Schema(fields))
+
+    def with_children(self, children):
+        return Unpivot(children[0], self.ids, self.values, self.variable_name, self.value_name)
+
+
+class MonotonicallyIncreasingId(LogicalPlan):
+    """Adds a 64-bit id column: high bits = partition index, low bits = row
+    index within partition (reference: ops/monotonically_increasing_id.rs)."""
+
+    def __init__(self, input: LogicalPlan, column_name: str = "id"):
+        self.column_name = column_name
+        fields = [Field(column_name, DataType.uint64())] + input.schema.fields()
+        super().__init__([input], Schema(fields))
+
+    def with_children(self, children):
+        return MonotonicallyIncreasingId(children[0], self.column_name)
+
+
+# ---------------------------------------------------------------------- #
+# Blocking ops                                                            #
+# ---------------------------------------------------------------------- #
+class Sort(LogicalPlan):
+    def __init__(self, input: LogicalPlan, sort_by: Sequence[Expr],
+                 descending: Sequence[bool], nulls_first: Optional[Sequence[bool]] = None):
+        self.sort_by = list(sort_by)
+        self.descending = list(descending)
+        self.nulls_first = list(nulls_first) if nulls_first is not None else list(descending)
+        for e in self.sort_by:
+            f = e.to_field(input.schema)
+            if not f.dtype.is_comparable():
+                raise DaftTypeError(f"Cannot sort by {f.dtype!r}")
+        super().__init__([input], input.schema)
+
+    def with_children(self, children):
+        return Sort(children[0], self.sort_by, self.descending, self.nulls_first)
+
+    def multiline_display(self):
+        return [f"Sort: {[e.name() for e in self.sort_by]} desc={self.descending}"]
+
+
+class TopN(LogicalPlan):
+    """Sort + limit fused (reference: ops/top_n.rs)."""
+
+    def __init__(self, input: LogicalPlan, sort_by: Sequence[Expr], descending: Sequence[bool],
+                 nulls_first: Sequence[bool], limit: int, offset: int = 0):
+        self.sort_by = list(sort_by)
+        self.descending = list(descending)
+        self.nulls_first = list(nulls_first)
+        self.limit = limit
+        self.offset = offset
+        super().__init__([input], input.schema)
+
+    def with_children(self, children):
+        return TopN(children[0], self.sort_by, self.descending, self.nulls_first, self.limit, self.offset)
+
+
+class Aggregate(LogicalPlan):
+    def __init__(self, input: LogicalPlan, agg_exprs: Sequence[Expr], group_by: Sequence[Expr]):
+        self.agg_exprs = list(agg_exprs)
+        self.group_by = list(group_by)
+        for e in self.agg_exprs:
+            if not e.has_agg():
+                raise DaftValueError(f"Aggregate expression {e!r} contains no aggregation")
+        fields = [g.to_field(input.schema) for g in self.group_by]
+        fields += [e.to_field(input.schema) for e in self.agg_exprs]
+        super().__init__([input], Schema(fields))
+
+    def with_children(self, children):
+        return Aggregate(children[0], self.agg_exprs, self.group_by)
+
+    def multiline_display(self):
+        return [f"Aggregate: {[e.name() for e in self.agg_exprs]} groupby={[g.name() for g in self.group_by]}"]
+
+    def approx_stats(self) -> ApproxStats:
+        s = self._children[0].approx_stats()
+        if not self.group_by:
+            return ApproxStats(1, 1024)
+        return s.scaled(0.1)
+
+
+class Pivot(LogicalPlan):
+    def __init__(self, input: LogicalPlan, group_by: Sequence[Expr], pivot_col: Expr,
+                 value_col: Expr, agg_fn: str, names: Sequence[str]):
+        self.group_by = list(group_by)
+        self.pivot_col = pivot_col
+        self.value_col = value_col
+        self.agg_fn = agg_fn
+        self.names = list(names)
+        fields = [g.to_field(input.schema) for g in self.group_by]
+        vf = AggOp(agg_fn, value_col).to_field(input.schema)
+        for n in self.names:
+            fields.append(Field(n, vf.dtype))
+        super().__init__([input], Schema(fields))
+
+    def with_children(self, children):
+        return Pivot(children[0], self.group_by, self.pivot_col, self.value_col, self.agg_fn, self.names)
+
+
+class Distinct(LogicalPlan):
+    def __init__(self, input: LogicalPlan, on: Optional[Sequence[Expr]] = None):
+        self.on = list(on) if on else None
+        super().__init__([input], input.schema)
+
+    def with_children(self, children):
+        return Distinct(children[0], self.on)
+
+
+class Window(LogicalPlan):
+    def __init__(self, input: LogicalPlan, window_exprs: Sequence[Expr]):
+        from daft_tpu.expressions.evaluator import resolve_schema
+
+        self.window_exprs = list(window_exprs)
+        out_fields = input.schema.fields() + [
+            e.to_field(input.schema) for e in self.window_exprs
+        ]
+        super().__init__([input], Schema(out_fields))
+
+    def with_children(self, children):
+        return Window(children[0], self.window_exprs)
+
+
+# ---------------------------------------------------------------------- #
+# Multi-input ops                                                         #
+# ---------------------------------------------------------------------- #
+class Concat(LogicalPlan):
+    def __init__(self, inputs: Sequence[LogicalPlan]):
+        first = inputs[0].schema
+        for other in inputs[1:]:
+            if other.schema.column_names() != first.column_names():
+                raise DaftSchemaError(
+                    f"Cannot concat differing schemas: {first!r} vs {other.schema!r}"
+                )
+        super().__init__(list(inputs), first)
+
+    def with_children(self, children):
+        return Concat(children)
+
+    def approx_stats(self) -> ApproxStats:
+        stats = [c.approx_stats() for c in self._children]
+        return ApproxStats(sum(s.num_rows for s in stats), sum(s.size_bytes for s in stats))
+
+
+class Intersect(LogicalPlan):
+    def __init__(self, left: LogicalPlan, right: LogicalPlan, is_all: bool = False):
+        self.is_all = is_all
+        super().__init__([left, right], left.schema)
+
+    def with_children(self, children):
+        return Intersect(children[0], children[1], self.is_all)
+
+
+class Except(LogicalPlan):
+    def __init__(self, left: LogicalPlan, right: LogicalPlan, is_all: bool = False):
+        self.is_all = is_all
+        super().__init__([left, right], left.schema)
+
+    def with_children(self, children):
+        return Except(children[0], children[1], self.is_all)
+
+
+class Join(LogicalPlan):
+    def __init__(self, left: LogicalPlan, right: LogicalPlan,
+                 left_on: Sequence[Expr], right_on: Sequence[Expr], how: str = "inner",
+                 strategy: Optional[str] = None, suffix: str = "right.", prefix: str = ""):
+        if how not in ("inner", "left", "right", "outer", "semi", "anti", "cross"):
+            raise DaftValueError(f"Unknown join type {how}")
+        self.left_on = list(left_on)
+        self.right_on = list(right_on)
+        self.how = how
+        self.strategy = strategy  # None=auto | hash | broadcast | sort_merge | cross
+        self.suffix = suffix
+        self.prefix = prefix
+        if len(self.left_on) != len(self.right_on):
+            raise DaftValueError("join requires equal numbers of left/right keys")
+        if how != "cross" and not self.left_on:
+            raise DaftValueError(f"{how} join requires at least one key")
+        # Resolve keys eagerly so bad column names fail at plan time.
+        for e in self.left_on:
+            e.to_field(left.schema)
+        for e in self.right_on:
+            e.to_field(right.schema)
+        fields = list(left.schema.fields())
+        if how not in ("semi", "anti"):
+            # Right-side join keys with identical names merge into the left key.
+            merged = {
+                r.name() for l, r in zip(self.left_on, self.right_on)
+                if isinstance(l, ColumnRef) and isinstance(r, ColumnRef) and l.name_ == r.name_
+            } if how != "cross" else set()
+            left_names = set(left.schema.column_names())
+            for f in right.schema:
+                if f.name in merged:
+                    continue
+                if f.name in left_names:
+                    fields.append(f.rename(f"{prefix}{suffix}{f.name}"))
+                else:
+                    fields.append(f)
+        super().__init__([left, right], Schema(fields))
+
+    def with_children(self, children):
+        return Join(children[0], children[1], self.left_on, self.right_on, self.how,
+                    self.strategy, self.suffix, self.prefix)
+
+    def multiline_display(self):
+        return [f"Join[{self.how}]: on {[e.name() for e in self.left_on]}"]
+
+    def approx_stats(self) -> ApproxStats:
+        l = self._children[0].approx_stats()
+        r = self._children[1].approx_stats()
+        rows = max(l.num_rows, r.num_rows)
+        return ApproxStats(rows, l.size_bytes + r.size_bytes)
+
+
+# ---------------------------------------------------------------------- #
+# Partitioning / output                                                   #
+# ---------------------------------------------------------------------- #
+class Repartition(LogicalPlan):
+    """scheme: ("hash", exprs, n) | ("random", n) | ("range", exprs, desc, n)
+    | ("into", n) (reference: ops/repartition.rs + RepartitionSpec)."""
+
+    def __init__(self, input: LogicalPlan, scheme: Tuple):
+        self.scheme = scheme
+        super().__init__([input], input.schema)
+
+    def with_children(self, children):
+        return Repartition(children[0], self.scheme)
+
+    def multiline_display(self):
+        return [f"Repartition: {self.scheme[0]}"]
+
+
+class Shard(LogicalPlan):
+    """Deterministic shard selection for multi-job ingestion
+    (reference: builder/mod.rs:475 shard + ShardScans rule)."""
+
+    def __init__(self, input: LogicalPlan, strategy: str, world_size: int, rank: int):
+        if strategy != "file":
+            raise DaftValueError("Only 'file' shard strategy is supported")
+        if not (0 <= rank < world_size):
+            raise DaftValueError("rank must be in [0, world_size)")
+        self.strategy = strategy
+        self.world_size = world_size
+        self.rank = rank
+        super().__init__([input], input.schema)
+
+    def with_children(self, children):
+        return Shard(children[0], self.strategy, self.world_size, self.rank)
+
+
+class Sink(LogicalPlan):
+    """Write sink (reference: ops/sink.rs + SinkInfo). Produces a small
+    result table describing written files."""
+
+    def __init__(self, input: LogicalPlan, write_info):
+        self.write_info = write_info
+        super().__init__([input], write_info.result_schema())
+
+    def with_children(self, children):
+        return Sink(children[0], self.write_info)
+
+    def multiline_display(self):
+        return [f"Sink: {self.write_info.display_name()}"]

@@ -1,0 +1,1 @@
+"""Device (TPU/XLA) compute paths: fused projection eval, staging, padding."""

@@ -1,0 +1,216 @@
+"""Local physical plan nodes.
+
+Reference: ``LocalPhysicalPlan`` (src/daft-local-plan/src/plan.rs:74-133, 40
+variants). Each node maps 1:1 onto a streaming-engine operator
+(daft_tpu/execution): sources, intermediate (streaming) ops, streaming sinks,
+and blocking sinks.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+from daft_tpu.schema import Schema
+
+
+class PhysicalPlan:
+    def __init__(self, children: Sequence["PhysicalPlan"], schema: Schema):
+        self.children = list(children)
+        self.schema = schema
+
+    def name(self) -> str:
+        return type(self).__name__
+
+    def repr_indent(self, level: int = 0) -> str:
+        pad = "  " * level
+        lines = [pad + ("* " if level == 0 else "|- ") + self.describe()]
+        for c in self.children:
+            lines.append(c.repr_indent(level + 1))
+        return "\n".join(lines)
+
+    def describe(self) -> str:
+        return self.name()
+
+    def __repr__(self) -> str:
+        return self.repr_indent()
+
+
+class PhysicalScan(PhysicalPlan):
+    def __init__(self, scan_tasks: List, schema: Schema):
+        super().__init__([], schema)
+        self.scan_tasks = scan_tasks
+
+    def describe(self):
+        return f"PhysicalScan[{len(self.scan_tasks)} tasks]"
+
+
+class InMemorySource(PhysicalPlan):
+    def __init__(self, partitions: List, schema: Schema):
+        super().__init__([], schema)
+        self.partitions = partitions
+
+    def describe(self):
+        return f"InMemorySource[{len(self.partitions)}]"
+
+
+class ShuffleReadSource(PhysicalPlan):
+    """Reads this worker's shuffle partitions (distributed only; reference:
+    src/daft-local-execution/src/sources/shuffle_read.rs)."""
+
+    def __init__(self, partition_refs: List, schema: Schema):
+        super().__init__([], schema)
+        self.partition_refs = partition_refs
+
+
+class Project(PhysicalPlan):
+    def __init__(self, child: PhysicalPlan, exprs, schema: Schema):
+        super().__init__([child], schema)
+        self.exprs = exprs
+
+
+class UDFProject(PhysicalPlan):
+    def __init__(self, child: PhysicalPlan, udf_expr, passthrough, schema: Schema):
+        super().__init__([child], schema)
+        self.udf_expr = udf_expr
+        self.passthrough = passthrough
+
+    def describe(self):
+        return f"UDFProject[{self.udf_expr!r}]"
+
+
+class Filter(PhysicalPlan):
+    def __init__(self, child: PhysicalPlan, predicate):
+        super().__init__([child], child.schema)
+        self.predicate = predicate
+
+
+class Explode(PhysicalPlan):
+    def __init__(self, child: PhysicalPlan, to_explode, schema: Schema):
+        super().__init__([child], schema)
+        self.to_explode = to_explode
+
+
+class Unpivot(PhysicalPlan):
+    def __init__(self, child: PhysicalPlan, ids, values, variable_name, value_name, schema: Schema):
+        super().__init__([child], schema)
+        self.ids = ids
+        self.values = values
+        self.variable_name = variable_name
+        self.value_name = value_name
+
+
+class Sample(PhysicalPlan):
+    def __init__(self, child: PhysicalPlan, fraction, size, with_replacement, seed):
+        super().__init__([child], child.schema)
+        self.fraction = fraction
+        self.size = size
+        self.with_replacement = with_replacement
+        self.seed = seed
+
+
+class MonotonicallyIncreasingId(PhysicalPlan):
+    def __init__(self, child: PhysicalPlan, column_name: str, schema: Schema,
+                 partition_offset: int = 0):
+        super().__init__([child], schema)
+        self.column_name = column_name
+        self.partition_offset = partition_offset
+
+
+class Limit(PhysicalPlan):
+    def __init__(self, child: PhysicalPlan, limit: int, offset: int = 0):
+        super().__init__([child], child.schema)
+        self.limit = limit
+        self.offset = offset
+
+
+class TopN(PhysicalPlan):
+    def __init__(self, child: PhysicalPlan, sort_by, descending, nulls_first, limit, offset):
+        super().__init__([child], child.schema)
+        self.sort_by = sort_by
+        self.descending = descending
+        self.nulls_first = nulls_first
+        self.limit = limit
+        self.offset = offset
+
+
+class Sort(PhysicalPlan):
+    def __init__(self, child: PhysicalPlan, sort_by, descending, nulls_first):
+        super().__init__([child], child.schema)
+        self.sort_by = sort_by
+        self.descending = descending
+        self.nulls_first = nulls_first
+
+
+class Aggregate(PhysicalPlan):
+    def __init__(self, child: PhysicalPlan, agg_exprs, group_by, schema: Schema):
+        super().__init__([child], schema)
+        self.agg_exprs = agg_exprs
+        self.group_by = group_by
+
+    def describe(self):
+        return f"Aggregate[{len(self.agg_exprs)} aggs, {len(self.group_by)} keys]"
+
+
+class Pivot(PhysicalPlan):
+    def __init__(self, child: PhysicalPlan, group_by, pivot_col, value_col, agg_fn, names, schema: Schema):
+        super().__init__([child], schema)
+        self.group_by = group_by
+        self.pivot_col = pivot_col
+        self.value_col = value_col
+        self.agg_fn = agg_fn
+        self.names = names
+
+
+class Distinct(PhysicalPlan):
+    def __init__(self, child: PhysicalPlan, on):
+        super().__init__([child], child.schema)
+        self.on = on
+
+
+class Window(PhysicalPlan):
+    def __init__(self, child: PhysicalPlan, window_exprs, schema: Schema):
+        super().__init__([child], schema)
+        self.window_exprs = window_exprs
+
+
+class HashJoin(PhysicalPlan):
+    def __init__(self, left: PhysicalPlan, right: PhysicalPlan, left_on, right_on,
+                 how, schema: Schema, suffix: str, merged_keys):
+        super().__init__([left, right], schema)
+        self.left_on = left_on
+        self.right_on = right_on
+        self.how = how
+        self.suffix = suffix
+        self.merged_keys = merged_keys
+
+    def describe(self):
+        return f"HashJoin[{self.how}]"
+
+
+class CrossJoin(PhysicalPlan):
+    def __init__(self, left: PhysicalPlan, right: PhysicalPlan, schema: Schema, suffix: str):
+        super().__init__([left, right], schema)
+        self.suffix = suffix
+
+
+class Concat(PhysicalPlan):
+    def __init__(self, children: Sequence[PhysicalPlan], schema: Schema):
+        super().__init__(list(children), schema)
+
+
+class Repartition(PhysicalPlan):
+    def __init__(self, child: PhysicalPlan, scheme: Tuple):
+        super().__init__([child], child.schema)
+        self.scheme = scheme
+
+    def describe(self):
+        return f"Repartition[{self.scheme[0]}]"
+
+
+class Write(PhysicalPlan):
+    def __init__(self, child: PhysicalPlan, write_info, schema: Schema):
+        super().__init__([child], schema)
+        self.write_info = write_info
+
+    def describe(self):
+        return f"Write[{self.write_info.display_name()}]"

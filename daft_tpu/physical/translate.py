@@ -1,0 +1,84 @@
+"""Logical → local physical plan translation.
+
+Reference: src/daft-local-plan/src/translate.rs. Intersect/Except lower to
+distinct + semi/anti joins, matching the reference's logical rewrites.
+"""
+
+from __future__ import annotations
+
+from daft_tpu.errors import DaftPlanError
+from daft_tpu.expressions.expr import ColumnRef
+from daft_tpu.logical import plan as lp
+from daft_tpu.physical import plan as pp
+
+
+def translate(node: lp.LogicalPlan, cfg) -> pp.PhysicalPlan:
+    t = lambda n: translate(n, cfg)
+    if isinstance(node, lp.InMemorySource):
+        return pp.InMemorySource(node.partitions, node.schema)
+    if isinstance(node, lp.ScanSource):
+        tasks = node.scan_info.to_scan_tasks(node.pushdowns, cfg)
+        return pp.PhysicalScan(tasks, node.schema)
+    if isinstance(node, lp.Project):
+        return pp.Project(t(node.children()[0]), node.exprs, node.schema)
+    if isinstance(node, lp.UDFProject):
+        return pp.UDFProject(t(node.children()[0]), node.udf_expr, node.passthrough, node.schema)
+    if isinstance(node, lp.Filter):
+        return pp.Filter(t(node.children()[0]), node.predicate)
+    if isinstance(node, lp.Explode):
+        return pp.Explode(t(node.children()[0]), node.to_explode, node.schema)
+    if isinstance(node, lp.Unpivot):
+        return pp.Unpivot(t(node.children()[0]), node.ids, node.values,
+                          node.variable_name, node.value_name, node.schema)
+    if isinstance(node, lp.Sample):
+        return pp.Sample(t(node.children()[0]), node.fraction, node.size,
+                         node.with_replacement, node.seed)
+    if isinstance(node, lp.MonotonicallyIncreasingId):
+        return pp.MonotonicallyIncreasingId(t(node.children()[0]), node.column_name, node.schema)
+    if isinstance(node, lp.Limit):
+        return pp.Limit(t(node.children()[0]), node.limit, node.offset)
+    if isinstance(node, lp.TopN):
+        return pp.TopN(t(node.children()[0]), node.sort_by, node.descending,
+                       node.nulls_first, node.limit, node.offset)
+    if isinstance(node, lp.Sort):
+        return pp.Sort(t(node.children()[0]), node.sort_by, node.descending, node.nulls_first)
+    if isinstance(node, lp.Aggregate):
+        return pp.Aggregate(t(node.children()[0]), node.agg_exprs, node.group_by, node.schema)
+    if isinstance(node, lp.Pivot):
+        return pp.Pivot(t(node.children()[0]), node.group_by, node.pivot_col,
+                        node.value_col, node.agg_fn, node.names, node.schema)
+    if isinstance(node, lp.Distinct):
+        return pp.Distinct(t(node.children()[0]), node.on)
+    if isinstance(node, lp.Window):
+        return pp.Window(t(node.children()[0]), node.window_exprs, node.schema)
+    if isinstance(node, lp.Concat):
+        return pp.Concat([t(c) for c in node.children()], node.schema)
+    if isinstance(node, lp.Join):
+        left, right = node.children()
+        if node.how == "cross":
+            return pp.CrossJoin(t(left), t(right), node.schema, node.suffix)
+        merged = {
+            r.name() for l, r in zip(node.left_on, node.right_on)
+            if isinstance(l, ColumnRef) and isinstance(r, ColumnRef) and l.name_ == r.name_
+        }
+        return pp.HashJoin(t(left), t(right), node.left_on, node.right_on,
+                           node.how, node.schema, f"{node.prefix}{node.suffix}", merged)
+    if isinstance(node, lp.Intersect):
+        left, right = node.children()
+        keys = [ColumnRef(n) for n in left.schema.column_names()]
+        join = lp.Join(lp.Distinct(left), right, keys, keys, "semi")
+        return t(join)
+    if isinstance(node, lp.Except):
+        left, right = node.children()
+        keys = [ColumnRef(n) for n in left.schema.column_names()]
+        join = lp.Join(lp.Distinct(left), right, keys, keys, "anti")
+        return t(join)
+    if isinstance(node, lp.Repartition):
+        return pp.Repartition(t(node.children()[0]), node.scheme)
+    if isinstance(node, lp.Shard):
+        # Shard that couldn't push into a scan: filter rows deterministically.
+        return pp.Repartition(t(node.children()[0]),
+                              ("shard", node.world_size, node.rank))
+    if isinstance(node, lp.Sink):
+        return pp.Write(t(node.children()[0]), node.write_info, node.schema)
+    raise DaftPlanError(f"Cannot translate logical node {node.name()}")

@@ -1,0 +1,41 @@
+"""NativeRunner: single-host execution (reference: daft/runners/native_runner.py:69-200).
+
+optimize → translate to local physical plan → stream through the executor,
+emitting subscriber events (QueryStart/QueryEnd) along the way.
+"""
+
+from __future__ import annotations
+
+import time
+import uuid
+from typing import Iterator
+
+from daft_tpu.context import get_context
+from daft_tpu.execution.executor import Executor
+from daft_tpu.micropartition import MicroPartition
+from daft_tpu.physical.translate import translate
+from daft_tpu.runners.runner import Runner
+from daft_tpu.subscribers.events import QueryEnd, QueryStart
+
+
+class NativeRunner(Runner):
+    name = "native"
+
+    def run_iter(self, builder) -> Iterator[MicroPartition]:
+        ctx = get_context()
+        cfg = ctx.execution_config
+        query_id = uuid.uuid4().hex[:16]
+        optimized = builder.optimize(cfg)
+        physical = translate(optimized.plan, cfg)
+        ctx.notify(QueryStart(query_id=query_id, plan=repr(optimized.plan)))
+        start = time.perf_counter()
+        error = None
+        try:
+            executor = Executor(cfg)
+            yield from executor.run(physical)
+        except BaseException as e:  # noqa: BLE001
+            error = str(e)
+            raise
+        finally:
+            ctx.notify(QueryEnd(query_id=query_id,
+                                duration_s=time.perf_counter() - start, error=error))

@@ -1,0 +1,31 @@
+"""Runner interface (reference: daft/runners/runner.py:26-61)."""
+
+from __future__ import annotations
+
+from typing import Iterator, List
+
+from daft_tpu.micropartition import MicroPartition
+
+
+class PartitionCacheEntry:
+    """Materialised result partitions, cacheable on a DataFrame
+    (reference: partition caching in src/daft-context/src/partition_cache.rs)."""
+
+    def __init__(self, partitions: List[MicroPartition]):
+        self.partitions = partitions
+
+    def num_rows(self) -> int:
+        return sum(len(p) for p in self.partitions)
+
+    def size_bytes(self) -> int:
+        return sum(p.size_bytes() for p in self.partitions)
+
+
+class Runner:
+    name = "base"
+
+    def run_iter(self, builder) -> Iterator[MicroPartition]:
+        raise NotImplementedError
+
+    def run(self, builder) -> PartitionCacheEntry:
+        return PartitionCacheEntry(list(self.run_iter(builder)))

@@ -1,0 +1,73 @@
+"""Engine events + subscriber ABC.
+
+Reference: the ``Subscriber`` trait and 15-variant ``Event`` enum
+(src/daft-context/src/subscribers/mod.rs:52, events.rs:11-32) and the Python
+subscriber ABC (daft/subscribers/).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional
+
+
+@dataclass
+class Event:
+    pass
+
+
+@dataclass
+class QueryStart(Event):
+    query_id: str = ""
+    plan: str = ""
+
+
+@dataclass
+class QueryEnd(Event):
+    query_id: str = ""
+    duration_s: float = 0.0
+    error: Optional[str] = None
+
+
+@dataclass
+class OptimizationStart(Event):
+    query_id: str = ""
+
+
+@dataclass
+class OptimizationEnd(Event):
+    query_id: str = ""
+    optimized_plan: str = ""
+
+
+@dataclass
+class TaskScheduled(Event):
+    query_id: str = ""
+    task_id: str = ""
+    worker_id: str = ""
+
+
+@dataclass
+class TaskCompleted(Event):
+    query_id: str = ""
+    task_id: str = ""
+    worker_id: str = ""
+    duration_s: float = 0.0
+    error: Optional[str] = None
+
+
+@dataclass
+class OperatorStats(Event):
+    query_id: str = ""
+    operator: str = ""
+    rows_in: int = 0
+    rows_out: int = 0
+    cpu_us: int = 0
+    extra: Dict[str, Any] = field(default_factory=dict)
+
+
+class Subscriber:
+    """Attach with ``get_context().attach_subscriber(sub)``."""
+
+    def on_event(self, event: Event) -> None:
+        raise NotImplementedError

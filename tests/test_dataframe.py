@@ -1,0 +1,190 @@
+import numpy as np
+import pytest
+
+import daft_tpu
+from daft_tpu import col, lit
+
+
+@pytest.fixture
+def df(make_df):
+    return make_df({
+        "a": list(range(10)),
+        "b": ["x", "y"] * 5,
+        "c": [float(i) for i in range(10)],
+    })
+
+
+def test_select_where_sort(df):
+    out = (df.where(col("a") > 3)
+             .select("a", "b", (col("c") * 2).alias("c2"))
+             .sort("a", desc=True)
+             .to_pydict())
+    assert out["a"] == [9, 8, 7, 6, 5, 4]
+    assert out["c2"][0] == 18.0
+
+
+def test_with_column(df):
+    out = df.with_column("d", col("a") + 100).limit(2).to_pydict()
+    assert out["d"] == [100, 101]
+
+
+def test_exclude_rename(df):
+    assert df.exclude("b").column_names == ["a", "c"]
+    assert df.with_column_renamed("a", "aa").column_names == ["aa", "b", "c"]
+
+
+def test_groupby_agg(df):
+    out = (df.groupby("b")
+             .agg(col("a").sum().alias("sa"), col("c").mean().alias("mc"))
+             .sort("b").to_pydict())
+    assert out == {"b": ["x", "y"], "sa": [20, 25], "mc": [4.0, 5.0]}
+
+
+def test_global_agg(df):
+    out = df.agg(
+        col("a").sum().alias("s"),
+        col("a").count().alias("n"),
+        (col("a").mean() * 2).alias("m2"),
+    ).to_pydict()
+    assert out == {"s": [45], "n": [10], "m2": [9.0]}
+
+
+def test_count_rows(df):
+    assert df.count_rows() == 10
+    assert df.where(col("b") == "x").count_rows() == 5
+
+
+def test_join(df):
+    other = daft_tpu.from_pydict({"b": ["x"], "v": [100]})
+    out = df.join(other, on="b")
+    assert out.count_rows() == 5
+    assert "v" in out.column_names
+    # merged key: no duplicate b column
+    assert out.column_names.count("b") == 1
+
+
+def test_join_left(df):
+    other = daft_tpu.from_pydict({"b": ["x"], "v": [100]})
+    out = df.join(other, on="b", how="left").sort("a").to_pydict()
+    assert out["v"] == [100, None] * 5
+
+
+def test_concat(df):
+    assert df.concat(df).count_rows() == 20
+
+
+def test_distinct(df):
+    assert df.select("b").distinct().count_rows() == 2
+
+
+def test_explode():
+    df = daft_tpu.from_pydict({"i": [1, 2], "l": [[1, 2], [3]]})
+    assert df.explode("l").to_pydict() == {"i": [1, 1, 2], "l": [1, 2, 3]}
+
+
+def test_limit_offset(df):
+    assert df.limit(3).to_pydict()["a"] == [0, 1, 2]
+    assert df.limit(3, offset=2).to_pydict()["a"] == [2, 3, 4]
+
+
+def test_sample(df):
+    assert df.sample(0.5, seed=1).count_rows() <= 10
+    assert df.sample(size=3, seed=1).count_rows() == 3
+
+
+def test_monotonic_id(df):
+    out = df.add_monotonically_increasing_id("rid").to_pydict()
+    assert out["rid"] == list(range(10))
+
+
+def test_pivot():
+    df = daft_tpu.from_pydict({
+        "g": ["a", "a", "b"], "k": ["x", "y", "x"], "v": [1, 2, 3],
+    })
+    out = df.pivot("g", "k", "v", "sum", names=["x", "y"]).sort("g").to_pydict()
+    assert out == {"g": ["a", "b"], "x": [1, 3], "y": [2, None]}
+
+
+def test_unpivot(df):
+    out = df.unpivot(["b"], ["a", "c"])
+    assert out.count_rows() == 20
+
+
+def test_intersect_except():
+    d1 = daft_tpu.from_pydict({"a": [1, 2, 3]})
+    d2 = daft_tpu.from_pydict({"a": [2, 3, 4]})
+    assert sorted(d1.intersect(d2).to_pydict()["a"]) == [2, 3]
+    assert d1.except_distinct(d2).to_pydict()["a"] == [1]
+
+
+def test_iter_rows(df):
+    rows = list(df.limit(2).iter_rows())
+    assert rows[0] == {"a": 0, "b": "x", "c": 0.0}
+
+
+def test_to_pandas_arrow(df):
+    pdf = df.to_pandas()
+    assert len(pdf) == 10
+    at = df.to_arrow()
+    assert at.num_rows == 10
+
+
+def test_repartition(df):
+    out = df.repartition(3, "b")
+    assert out.count_rows() == 10
+
+
+def test_into_partitions(df):
+    assert df.into_partitions(4).count_rows() == 10
+
+
+def test_udf_rowwise(df):
+    @daft_tpu.udf.func(return_dtype=daft_tpu.DataType.int64())
+    def add_one(x):
+        return x + 1
+
+    out = df.select(add_one(col("a")).alias("a1")).limit(3).to_pydict()
+    assert out["a1"] == [1, 2, 3]
+
+
+def test_udf_batch(df):
+    @daft_tpu.udf.func.batch(return_dtype=daft_tpu.DataType.float64())
+    def double(s):
+        return s.to_numpy() * 2.0
+
+    out = df.select(double(col("c")).alias("c2")).limit(2).to_pydict()
+    assert out["c2"] == [0.0, 2.0]
+
+
+def test_stateful_cls_udf(df):
+    @daft_tpu.udf.cls(max_concurrency=2)
+    class Scaler:
+        def __init__(self, k):
+            self.k = k
+
+        @daft_tpu.udf.method(return_dtype=daft_tpu.DataType.int64())
+        def scale(self, x):
+            return x * self.k
+
+    scaler = Scaler(3)
+    out = df.select(scaler.scale(col("a")).alias("s")).limit(3).to_pydict()
+    assert out["s"] == [0, 3, 6]
+
+
+def test_shard():
+    df = daft_tpu.from_pydict({"a": list(range(8))})
+    total = 0
+    for rank in range(2):
+        total += df.shard("file", 2, rank).count_rows()
+    assert total == 8
+
+
+def test_limit_offset_composition():
+    df = daft_tpu.from_pydict({"a": list(range(20))})
+    assert df.limit(10).offset(5).to_pydict()["a"] == [5, 6, 7, 8, 9]
+
+
+def test_monotonic_id_not_renumbered_by_filter():
+    df = daft_tpu.from_pydict({"x": [1, 2, 3, 4]})
+    out = df.add_monotonically_increasing_id("rid").where(col("x") > 2).to_pydict()
+    assert out["rid"] == [2, 3]

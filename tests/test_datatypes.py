@@ -1,0 +1,82 @@
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from daft_tpu.datatype import DataType, ImageMode, TimeUnit, TypeId, unify_dtypes
+from daft_tpu.errors import DaftTypeError
+from daft_tpu.schema import Field, Schema
+
+
+def test_simple_roundtrip():
+    for dt in [DataType.int64(), DataType.float32(), DataType.bool(),
+               DataType.string(), DataType.binary(), DataType.date()]:
+        assert DataType.from_arrow(dt.to_arrow()) == dt
+
+
+def test_nested_types():
+    lst = DataType.list(DataType.int32())
+    assert lst.inner == DataType.int32()
+    st = DataType.struct({"a": DataType.int64(), "b": DataType.string()})
+    assert st.fields["a"] == DataType.int64()
+    assert DataType.from_arrow(st.to_arrow()) == st
+
+
+def test_embedding():
+    emb = DataType.embedding(DataType.float32(), 768)
+    assert emb.size == 768
+    assert emb.shape == (768,)
+    assert emb.is_device_representable()
+    import jax.numpy as jnp
+
+    jdt, shape = emb.to_jax()
+    assert shape == (768,)
+
+
+def test_image_types():
+    img = DataType.image("RGB")
+    assert img.image_mode == ImageMode.RGB
+    fixed = DataType.image("RGB", 224, 224)
+    assert fixed.shape == (224, 224, 3)
+    assert fixed.is_device_representable()
+    with pytest.raises(Exception):
+        DataType.image(height=3)
+
+
+def test_tensor():
+    t = DataType.tensor(DataType.float32(), (3, 4))
+    assert t.shape == (3, 4)
+    ragged = DataType.tensor(DataType.float32())
+    assert not ragged.is_device_representable()
+
+
+def test_bfloat16():
+    bf = DataType.bfloat16()
+    assert bf.is_floating()
+    assert bf.to_arrow() == pa.binary(2)
+    assert bf.is_device_representable()
+
+
+def test_unify():
+    assert unify_dtypes(DataType.int32(), DataType.int64()) == DataType.int64()
+    assert unify_dtypes(DataType.int64(), DataType.float32()) == DataType.float64()
+    assert unify_dtypes(DataType.null(), DataType.string()) == DataType.string()
+    assert unify_dtypes(DataType.float32(), DataType.float32()) == DataType.float32()
+
+
+def test_schema():
+    s = Schema.from_pydict({"a": DataType.int64(), "b": DataType.string()})
+    assert s.column_names() == ["a", "b"]
+    assert s["a"].dtype == DataType.int64()
+    s2 = s.exclude(["a"])
+    assert s2.column_names() == ["b"]
+    with pytest.raises(Exception):
+        Schema([Field("x", DataType.int64()), Field("x", DataType.int64())])
+
+
+def test_infer_from_py():
+    assert DataType.infer_from_py(1) == DataType.int64()
+    assert DataType.infer_from_py(1.0) == DataType.float64()
+    assert DataType.infer_from_py("x") == DataType.string()
+    assert DataType.infer_from_py([1, 2]) == DataType.list(DataType.int64())
+    arr = np.zeros((3, 4), dtype=np.float32)
+    assert DataType.infer_from_py(arr) == DataType.tensor(DataType.float32(), (3, 4))

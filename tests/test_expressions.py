@@ -1,0 +1,149 @@
+import datetime
+
+import numpy as np
+import pytest
+
+import daft_tpu
+from daft_tpu import DataType, col, lit
+
+
+@pytest.fixture
+def df(make_df):
+    return make_df({
+        "s": ["Hello World", "foo bar", None, "xyz"],
+        "i": [1, -2, 3, 4],
+        "f": [1.5, -2.5, float("nan"), 4.0],
+        "l": [[1, 2], [3], None, []],
+    })
+
+
+def test_str_namespace(df):
+    out = df.select(
+        col("s").str.upper().alias("u"),
+        col("s").str.length().alias("n"),
+        col("s").str.contains("o").alias("c"),
+        col("s").str.split(" ").alias("sp"),
+    ).to_pydict()
+    assert out["u"] == ["HELLO WORLD", "FOO BAR", None, "XYZ"]
+    assert out["n"] == [11, 7, None, 3]
+    assert out["c"] == [True, True, None, False]
+    assert out["sp"][0] == ["Hello", "World"]
+
+
+def test_str_more(df):
+    out = df.select(
+        col("s").str.left(3).alias("l3"),
+        col("s").str.lower().alias("lo"),
+        col("s").str.replace("o", "0").alias("rep"),
+        col("s").str.like("He%").alias("lk"),
+    ).to_pydict()
+    assert out["l3"] == ["Hel", "foo", None, "xyz"]
+    assert out["rep"][0] == "Hell0 W0rld"
+    assert out["lk"] == [True, False, None, False]
+
+
+def test_numeric_fns(df):
+    out = df.select(
+        col("i").abs().alias("a"),
+        col("f").ceil().alias("c"),
+        col("i").cast(DataType.float64()).sqrt().alias("sq"),
+        col("f").clip(0, 2).alias("cl"),
+    ).to_pydict()
+    assert out["a"] == [1, 2, 3, 4]
+    assert out["c"][0] == 2.0
+
+
+def test_float_namespace(df):
+    out = df.select(
+        col("f").float.is_nan().alias("nan"),
+        col("f").float.fill_nan(0.0).alias("fill"),
+    ).to_pydict()
+    assert out["nan"] == [False, False, True, False]
+    assert out["fill"][2] == 0.0
+
+
+def test_list_namespace(df):
+    out = df.select(
+        col("l").list.length().alias("n"),
+        col("l").list.get(0).alias("g"),
+        col("l").list.sum().alias("s"),
+        col("l").list.contains(3).alias("c"),
+    ).to_pydict()
+    assert out["n"] == [2, 1, None, 0]
+    assert out["g"] == [1, 3, None, None]
+    assert out["s"] == [3, 3, None, None]
+
+
+def test_temporal():
+    df = daft_tpu.from_pydict({
+        "d": [datetime.datetime(2024, 3, 15, 10, 30), datetime.datetime(2020, 1, 1)],
+    })
+    out = df.select(
+        col("d").dt.year().alias("y"),
+        col("d").dt.month().alias("m"),
+        col("d").dt.day().alias("dd"),
+        col("d").dt.hour().alias("h"),
+    ).to_pydict()
+    assert out["y"] == [2024, 2020]
+    assert out["m"] == [3, 1]
+    assert out["h"] == [10, 0]
+
+
+def test_if_else_between_isin(df):
+    out = df.select(
+        (col("i") > 0).if_else(lit("pos"), lit("neg")).alias("sign"),
+        col("i").between(1, 3).alias("btw"),
+        col("i").is_in([1, 4]).alias("in_"),
+    ).to_pydict()
+    assert out["sign"] == ["pos", "neg", "pos", "pos"]
+    assert out["btw"] == [True, False, True, False]
+    assert out["in_"] == [True, False, False, True]
+
+
+def test_null_handling(df):
+    out = df.select(
+        col("s").is_null().alias("n"),
+        col("s").fill_null("??").alias("f"),
+    ).to_pydict()
+    assert out["n"] == [False, False, True, False]
+    assert out["f"][2] == "??"
+
+
+def test_struct_access():
+    df = daft_tpu.from_pydict({"st": [{"x": 1, "y": "a"}, {"x": 2, "y": "b"}]})
+    out = df.select(col("st").struct.get("x")).to_pydict()
+    assert out["x"] == [1, 2]
+    out2 = df.select(col("st")["y"]).to_pydict()
+    assert out2["y"] == ["a", "b"]
+
+
+def test_embedding_ops():
+    emb = DataType.embedding(DataType.float32(), 3)
+    df = daft_tpu.from_pydict({
+        "e1": daft_tpu.Series.from_numpy(np.eye(3, dtype=np.float32), "e1", emb),
+        "e2": daft_tpu.Series.from_numpy(np.eye(3, dtype=np.float32)[::-1].copy(), "e2", emb),
+    })
+    out = df.select(
+        col("e1").embedding.cosine_distance(col("e2")).alias("cd"),
+        col("e1").embedding.dot(col("e2")).alias("dot"),
+    ).to_pydict()
+    assert out["cd"][0] == pytest.approx(1.0)
+    assert out["cd"][1] == pytest.approx(0.0)
+    assert out["dot"][1] == pytest.approx(1.0)
+
+
+def test_hash_minhash(df):
+    out = df.select(col("s").hash().alias("h")).to_pydict()
+    assert out["h"][0] is not None
+    out2 = daft_tpu.from_pydict({"t": ["a b c d", "a b c d", "x y z w"]}).select(
+        col("t").minhash(num_hashes=16, ngram_size=2).alias("mh")
+    ).to_pydict()
+    assert out2["mh"][0] == out2["mh"][1]
+    assert out2["mh"][0] != out2["mh"][2]
+
+
+def test_coalesce():
+    from daft_tpu.functions import coalesce
+
+    df = daft_tpu.from_pydict({"a": [None, 2], "b": [10, 20]})
+    assert df.select(coalesce(col("a"), col("b")).alias("c")).to_pydict()["c"] == [10, 2]

@@ -1,0 +1,81 @@
+import os
+
+import numpy as np
+import pytest
+
+import daft_tpu
+from daft_tpu import col
+
+
+@pytest.fixture
+def df():
+    return daft_tpu.from_pydict({
+        "a": list(range(100)),
+        "b": [f"s{i % 7}" for i in range(100)],
+        "c": np.linspace(0, 1, 100),
+    })
+
+
+def test_parquet_roundtrip(df, tmp_path):
+    res = df.write_parquet(str(tmp_path))
+    assert res.to_pydict()["num_rows"] == [100]
+    back = daft_tpu.read_parquet(str(tmp_path))
+    assert back.count_rows() == 100
+    assert back.schema.column_names() == ["a", "b", "c"]
+    out = back.where(col("a") < 5).select("a").sort("a").to_pydict()
+    assert out["a"] == [0, 1, 2, 3, 4]
+
+
+def test_csv_roundtrip(df, tmp_path):
+    df.write_csv(str(tmp_path))
+    back = daft_tpu.read_csv(str(tmp_path))
+    assert back.count_rows() == 100
+
+
+def test_json_roundtrip(df, tmp_path):
+    df.write_json(str(tmp_path))
+    back = daft_tpu.read_json(str(tmp_path))
+    assert back.count_rows() == 100
+
+
+def test_partitioned_write(df, tmp_path):
+    df.write_parquet(str(tmp_path), partition_cols=[col("b")])
+    subdirs = sorted(os.listdir(tmp_path))
+    assert len(subdirs) == 7
+    assert subdirs[0].startswith("b=")
+    back = daft_tpu.read_parquet(str(tmp_path) + "/b=s0")
+    assert back.count_rows() > 0
+
+
+def test_glob_read(df, tmp_path):
+    df.write_parquet(str(tmp_path))
+    back = daft_tpu.read_parquet(str(tmp_path) + "/*.parquet")
+    assert back.count_rows() == 100
+
+
+def test_from_glob_path(df, tmp_path):
+    df.write_parquet(str(tmp_path))
+    listing = daft_tpu.from_glob_path(str(tmp_path) + "/*.parquet")
+    assert listing.count_rows() >= 1
+    assert "path" in listing.column_names
+
+
+def test_limit_pushdown_reads_less(df, tmp_path):
+    df.write_parquet(str(tmp_path))
+    out = daft_tpu.read_parquet(str(tmp_path)).limit(3).to_pydict()
+    assert len(out["a"]) == 3
+
+
+def test_multi_file_scan(df, tmp_path):
+    for i in range(3):
+        df.write_parquet(str(tmp_path / f"d{i}"))
+    paths = [str(tmp_path / f"d{i}") for i in range(3)]
+    back = daft_tpu.read_parquet(paths)
+    assert back.count_rows() == 300
+
+
+def test_read_text(tmp_path):
+    p = tmp_path / "f.txt"
+    p.write_text("hello\nworld\n")
+    out = daft_tpu.read_text(str(p)).to_pydict()
+    assert out["text"] == ["hello", "world"]

@@ -1,0 +1,80 @@
+import daft_tpu
+from daft_tpu import col, lit
+from daft_tpu.logical import plan as lp
+from daft_tpu.logical.optimizer import Optimizer, simplify_expr
+
+
+def _optimized(df):
+    return Optimizer().optimize(df._builder.plan)
+
+
+def test_filter_pushdown_into_scan(tmp_path):
+    df = daft_tpu.from_pydict({"a": [1, 2, 3], "b": ["x", "y", "z"]})
+    df.write_parquet(str(tmp_path))
+    scan_df = daft_tpu.read_parquet(str(tmp_path))
+    plan = _optimized(scan_df.select("a", "b").where(col("a") > 1))
+    scans = [n for n in plan.walk() if isinstance(n, lp.ScanSource)]
+    assert scans and scans[0].pushdowns.filters is not None
+
+
+def test_projection_pushdown_into_scan(tmp_path):
+    df = daft_tpu.from_pydict({"a": [1], "b": ["x"], "c": [1.0]})
+    df.write_parquet(str(tmp_path))
+    plan = _optimized(daft_tpu.read_parquet(str(tmp_path)).select("a"))
+    scans = [n for n in plan.walk() if isinstance(n, lp.ScanSource)]
+    assert scans[0].pushdowns.columns == ("a",)
+
+
+def test_limit_pushdown(tmp_path):
+    df = daft_tpu.from_pydict({"a": list(range(100))})
+    df.write_parquet(str(tmp_path))
+    plan = _optimized(daft_tpu.read_parquet(str(tmp_path)).limit(5))
+    scans = [n for n in plan.walk() if isinstance(n, lp.ScanSource)]
+    assert scans[0].pushdowns.limit == 5
+
+
+def test_sort_limit_fuses_topn():
+    df = daft_tpu.from_pydict({"a": [3, 1, 2]})
+    plan = _optimized(df.sort("a").limit(2))
+    assert any(isinstance(n, lp.TopN) for n in plan.walk())
+    assert df.sort("a").limit(2).to_pydict()["a"] == [1, 2]
+
+
+def test_filter_merge():
+    df = daft_tpu.from_pydict({"a": [1, 2, 3]})
+    plan = _optimized(df.where(col("a") > 1).where(col("a") < 3))
+    filters = [n for n in plan.walk() if isinstance(n, lp.Filter)]
+    assert len(filters) == 1
+
+
+def test_split_udfs():
+    @daft_tpu.udf.func(return_dtype=daft_tpu.DataType.int64())
+    def f(x):
+        return x
+
+    df = daft_tpu.from_pydict({"a": [1, 2]})
+    plan = _optimized(df.select(f(col("a")).alias("fa"), col("a")))
+    assert any(isinstance(n, lp.UDFProject) for n in plan.walk())
+    out = df.select(f(col("a")).alias("fa"), col("a")).to_pydict()
+    assert out == {"fa": [1, 2], "a": [1, 2]}
+
+
+def test_constant_folding():
+    e = (lit(2) + lit(3))._expr
+    folded = simplify_expr(e)
+    from daft_tpu.expressions.expr import Literal
+
+    assert isinstance(folded, Literal) and folded.value == 5
+
+
+def test_filter_pushdown_through_join():
+    left = daft_tpu.from_pydict({"k": [1, 2], "a": [10, 20]})
+    right = daft_tpu.from_pydict({"k": [1, 2], "b": [100, 200]})
+    joined = left.join(right, on="k").where(col("a") > 10)
+    plan = _optimized(joined)
+    # Filter should sit below the join on the left side
+    join_nodes = [n for n in plan.walk() if isinstance(n, lp.Join)]
+    assert join_nodes
+    left_side = join_nodes[0].children()[0]
+    assert any(isinstance(n, lp.Filter) for n in left_side.walk())
+    assert joined.to_pydict()["a"] == [20]

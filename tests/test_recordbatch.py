@@ -1,0 +1,101 @@
+import numpy as np
+import pytest
+
+from daft_tpu import col, lit
+from daft_tpu.datatype import DataType
+from daft_tpu.recordbatch import RecordBatch
+from daft_tpu.series import Series
+
+
+@pytest.fixture
+def rb():
+    return RecordBatch.from_pydict({
+        "a": [1, 2, 3, 4],
+        "b": ["x", "y", "x", "z"],
+        "c": [1.0, 2.0, 3.0, 4.0],
+    })
+
+
+def test_eval_projection(rb):
+    out = rb.eval_expression_list([col("a")._expr, (col("a") * 2 + col("c")).alias("d")._expr])
+    assert out.to_pydict() == {"a": [1, 2, 3, 4], "d": [3.0, 6.0, 9.0, 12.0]}
+
+
+def test_filter(rb):
+    mask = rb.eval_expression((col("b") == "x")._expr)
+    assert rb.filter(mask).to_pydict()["a"] == [1, 3]
+
+
+def test_sort_multi(rb):
+    keys = [rb.get_column("b"), rb.get_column("a")]
+    out = rb.sort(keys, [False, True])
+    assert out.to_pydict()["b"] == ["x", "x", "y", "z"]
+    assert out.to_pydict()["a"] == [3, 1, 2, 4]
+
+
+def test_agg_grouped(rb):
+    out = rb.agg([col("a").sum()._expr, col("c").mean()._expr], [col("b")._expr])
+    d = out.to_pydict()
+    assert d["b"] == ["x", "y", "z"]
+    assert d["a"] == [4, 2, 4]
+
+
+def test_agg_global(rb):
+    out = rb.agg([col("a").sum().alias("s")._expr, col("a").count().alias("n")._expr])
+    assert out.to_pydict() == {"s": [10], "n": [4]}
+
+
+def test_joins(rb):
+    right = RecordBatch.from_pydict({"b": ["x", "z"], "v": [10, 20]})
+    j = rb.hash_join(right, [rb.get_column("b")], [right.get_column("b")], "inner")
+    assert sorted(j.to_pydict()["v"]) == [10, 10, 20]
+    semi = rb.hash_join(right, [rb.get_column("b")], [right.get_column("b")], "semi")
+    assert sorted(semi.to_pydict()["a"]) == [1, 3, 4]
+    anti = rb.hash_join(right, [rb.get_column("b")], [right.get_column("b")], "anti")
+    assert anti.to_pydict()["a"] == [2]
+
+
+def test_partition_by_hash(rb):
+    parts = rb.partition_by_hash([rb.get_column("b")], 3)
+    assert sum(len(p) for p in parts) == 4
+    # Same key lands in same partition
+    all_bs = [set(p.to_pydict()["b"]) for p in parts if len(p)]
+    seen = set()
+    for s in all_bs:
+        assert not (s & seen)
+        seen |= s
+
+
+def test_explode():
+    rb = RecordBatch.from_pydict({"i": [1, 2, 3], "l": [[1, 2], [], None]})
+    out = rb.explode(["l"])
+    assert out.to_pydict() == {"i": [1, 1, 2, 3], "l": [1, 2, None, None]}
+
+
+def test_unpivot(rb):
+    out = rb.unpivot(["b"], ["a", "c"])
+    assert len(out) == 8
+    assert set(out.to_pydict()["variable"]) == {"a", "c"}
+
+
+def test_distinct():
+    rb = RecordBatch.from_pydict({"a": [1, 1, 2], "b": ["x", "x", "y"]})
+    assert len(rb.distinct()) == 2
+
+
+def test_quantiles(rb):
+    q = rb.quantiles(2, [rb.get_column("a")], [False])
+    assert len(q) == 1
+
+
+def test_partition_by_range():
+    rb = RecordBatch.from_pydict({"k": [5, 1, 9, 3, 7, None]})
+    bounds = RecordBatch.from_pydict({"k": [4, 8]})
+    parts = rb.partition_by_range([rb.get_column("k")], bounds, [False])
+    assert [p.to_pydict()["k"] for p in parts] == [[1, 3], [5, 7], [9, None]]
+
+
+def test_explode_misaligned_raises():
+    rb = RecordBatch.from_pydict({"a": [[1, 2], [3]], "b": [[10], [20, 30]]})
+    with pytest.raises(Exception):
+        rb.explode(["a", "b"])

@@ -1,0 +1,125 @@
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from daft_tpu.datatype import DataType
+from daft_tpu.series import Series
+
+
+def test_from_pylist_infer():
+    s = Series.from_pylist([1, 2, None])
+    assert s.dtype == DataType.int64()
+    assert s.to_pylist() == [1, 2, None]
+    assert s.null_count() == 1
+
+
+def test_arithmetic():
+    a = Series.from_pylist([1, 2, 3], "a")
+    b = Series.from_pylist([10, 20, 30], "b")
+    assert (a + b).to_pylist() == [11, 22, 33]
+    assert (b / a).to_pylist() == [10.0, 10.0, 10.0]
+    assert (b % a).to_pylist() == [0, 0, 0]
+    assert (a ** b.cast(DataType.int64())).to_pylist()[0] == 1
+
+
+def test_string_concat_add():
+    a = Series.from_pylist(["x", "y"], "a")
+    b = Series.from_pylist(["1", "2"], "b")
+    assert (a + b).to_pylist() == ["x1", "y2"]
+
+
+def test_comparison_and_logic():
+    a = Series.from_pylist([1, 2, 3], "a")
+    m = a.gt(Series.from_pylist([2, 2, 2]))
+    assert m.to_pylist() == [False, False, True]
+    assert m.not_().to_pylist() == [True, True, False]
+
+
+def test_filter_take_slice():
+    a = Series.from_pylist([1, 2, 3, 4], "a")
+    mask = Series.from_pylist([True, False, True, False])
+    assert a.filter(mask).to_pylist() == [1, 3]
+    assert a.take([3, 0]).to_pylist() == [4, 1]
+    assert a.slice(1, 2).to_pylist() == [2, 3]
+
+
+def test_cast():
+    a = Series.from_pylist([1, 2], "a")
+    assert a.cast(DataType.float32()).dtype == DataType.float32()
+    assert a.cast(DataType.string()).to_pylist() == ["1", "2"]
+
+
+def test_sort_argsort():
+    a = Series.from_pylist([3, 1, None, 2], "a")
+    assert a.sort().to_pylist() == [1, 2, 3, None]
+    assert a.sort(descending=True).to_pylist() == [None, 3, 2, 1]
+
+
+def test_aggs():
+    a = Series.from_pylist([1.0, 2.0, 3.0, None], "a")
+    assert a.sum().to_pylist() == [6.0]
+    assert a.mean().to_pylist() == [2.0]
+    assert a.min().to_pylist() == [1.0]
+    assert a.max().to_pylist() == [3.0]
+    assert a.count().to_pylist() == [3]
+    assert a.count("all").to_pylist() == [4]
+
+
+def test_hash_deterministic():
+    a = Series.from_pylist(["foo", "bar", None], "a")
+    h1 = a.hash().to_pylist()
+    h2 = Series.from_pylist(["foo", "bar", None], "a").hash().to_pylist()
+    assert h1 == h2
+    assert h1[0] != h1[1]
+    ints = Series.from_pylist([1, 2, 1]).hash().to_pylist()
+    assert ints[0] == ints[2] and ints[0] != ints[1]
+
+
+def test_embedding_roundtrip():
+    emb = DataType.embedding(DataType.float32(), 4)
+    data = np.arange(8, dtype=np.float32).reshape(2, 4)
+    s = Series.from_numpy(data, "e", emb)
+    assert s.dtype == emb
+    np.testing.assert_array_equal(s.to_numpy(), data)
+    j = s.to_jax()
+    assert j.shape == (2, 4)
+    back = Series.from_jax(j, "e2")
+    np.testing.assert_array_equal(back.to_numpy(), data)
+
+
+def test_bfloat16_series():
+    s = Series.from_pylist([1.5, 2.5, None], "b", DataType.bfloat16())
+    assert s.dtype == DataType.bfloat16()
+    vals = s.to_pylist()
+    assert vals[0] == 1.5 and vals[2] is None
+    import jax.numpy as jnp
+
+    assert s.to_jax().dtype == jnp.bfloat16
+
+
+def test_tensor_series():
+    rows = [np.ones((2, 2), dtype=np.float32), None, np.zeros((2, 2), dtype=np.float32)]
+    s = Series.from_pylist(rows, "t", DataType.tensor(DataType.float32(), (2, 2)))
+    out = s.to_pylist()
+    assert out[1] is None
+    np.testing.assert_array_equal(out[0], rows[0])
+
+
+def test_if_else():
+    pred = Series.from_pylist([True, False, True])
+    t = Series.from_pylist([1, 1, 1])
+    f = Series.from_pylist([0, 0, 0])
+    assert pred.if_else(t, f).to_pylist() == [1, 0, 1]
+
+
+def test_is_in():
+    a = Series.from_pylist([1, 2, 3])
+    assert a.is_in(Series.from_pylist([2, 3])).to_pylist() == [False, True, True]
+
+
+def test_concat():
+    a = Series.from_pylist([1, 2])
+    b = Series.from_pylist([3.0])
+    out = Series.concat([a, b])
+    assert out.dtype == DataType.float64()
+    assert out.to_pylist() == [1.0, 2.0, 3.0]

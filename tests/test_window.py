@@ -1,0 +1,42 @@
+import pytest
+
+import daft_tpu
+from daft_tpu import col
+from daft_tpu.functions import dense_rank, rank, row_number
+from daft_tpu.window import Window
+
+
+@pytest.fixture
+def df(make_df):
+    return make_df({
+        "g": ["a", "a", "a", "b", "b"],
+        "v": [3, 1, 2, 10, 10],
+    })
+
+
+def test_partition_agg(df):
+    w = Window().partition_by("g")
+    out = df.select("g", "v", col("v").sum().over(w).alias("gs")).sort(["g", "v"]).to_pydict()
+    assert out["gs"] == [6, 6, 6, 20, 20]
+
+
+def test_row_number(df):
+    w = Window().partition_by("g").order_by("v")
+    out = df.select("g", "v", row_number().over(w).alias("rn")).sort(["g", "v"]).to_pydict()
+    assert out["rn"] == [1, 2, 3, 1, 2]
+
+
+def test_rank_dense_rank(df):
+    w = Window().partition_by("g").order_by("v")
+    out = df.select(
+        "g", "v", rank().over(w).alias("r"), dense_rank().over(w).alias("dr")
+    ).sort(["g", "v"]).to_pydict()
+    assert out["r"] == [1, 2, 3, 1, 1]
+    assert out["dr"] == [1, 2, 3, 1, 1]
+
+
+def test_mean_over(df):
+    w = Window().partition_by("g")
+    out = df.select("g", col("v").mean().over(w).alias("m")).sort("g").to_pydict()
+    assert out["m"][0] == pytest.approx(2.0)
+    assert out["m"][3] == pytest.approx(10.0)
