@@ -1,0 +1,72 @@
+"""Headline benchmark: CLIP ViT-L/14 embed_image throughput on TPU.
+
+North star (BASELINE.json): `df.with_column(embed_image(...))` over a
+LAION-like image corpus, measured as embeddings/sec/chip, matching
+RayRunner-on-A100 rows/sec. The comparison point is CLIP ViT-L/14 batch
+inference on one A100 (fp16, batched) ≈ 340 images/sec — the published
+ballpark for the reference's GPU path.
+
+Runs the REAL engine path: FixedShapeImage column -> UDFProject actor ->
+uint8 HBM staging -> jitted bf16 Flax CLIP forward. Prints exactly one JSON
+line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+A100_BASELINE_IMGS_PER_SEC = 340.0
+
+NUM_IMAGES = 3072
+BATCH_SIZE = 256
+IMAGE_SIZE = 224
+
+
+def main() -> None:
+    import jax
+
+    import daft_tpu
+    from daft_tpu import col
+    from daft_tpu.datatype import DataType
+    from daft_tpu.functions.ai import embed_image
+
+    n_chips = max(len(jax.devices()), 1)
+
+    rng = np.random.default_rng(0)
+    imgs = rng.integers(0, 255, (NUM_IMAGES, IMAGE_SIZE, IMAGE_SIZE, 3), dtype=np.uint8)
+    img_dtype = DataType.image("RGB", IMAGE_SIZE, IMAGE_SIZE)
+    series = daft_tpu.Series.from_numpy(imgs.reshape(NUM_IMAGES, -1), "img", img_dtype)
+
+    df = daft_tpu.from_pydict({"img": series})
+    expr = embed_image(col("img"), provider="flax_random", model="ViT-L/14",
+                       batch_size=BATCH_SIZE)
+
+    with daft_tpu.execution_config_ctx(default_morsel_size=NUM_IMAGES):
+        # Warmup: compile the forward for the batch bucket.
+        warm = df.limit(BATCH_SIZE).with_column("emb", expr)
+        warm.collect()
+
+        start = time.perf_counter()
+        out = df.with_column("emb", expr).select("emb")
+        total = 0
+        for part in out.iter_partitions():
+            total += len(part)
+        elapsed = time.perf_counter() - start
+
+    assert total == NUM_IMAGES, f"expected {NUM_IMAGES} rows, got {total}"
+    throughput = NUM_IMAGES / elapsed
+    per_chip = throughput / n_chips
+    print(json.dumps({
+        "metric": "embed_image_clip_vit_l14_throughput_per_chip",
+        "value": round(per_chip, 2),
+        "unit": "images/sec/chip",
+        "vs_baseline": round(per_chip / A100_BASELINE_IMGS_PER_SEC, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

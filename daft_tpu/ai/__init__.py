@@ -1,0 +1,3 @@
+from daft_tpu.ai.provider import Provider, load_provider, register_provider
+
+__all__ = ["Provider", "load_provider", "register_provider"]

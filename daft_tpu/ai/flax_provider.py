@@ -1,0 +1,346 @@
+"""Flax/TPU provider: protocol implementations over daft_tpu.models.
+
+This is the engine's north-star path (reference analogue:
+daft/ai/transformers/* — torch CUDA): CLIP image/text towers, MiniLM sentence
+encoder and a decoder LM, all served as jitted XLA computations with
+
+* **bf16 params resident in HBM** — initialised once per worker process,
+* **batch-shape bucketing** — inputs pad to power-of-two buckets so jax.jit
+  recompiles O(log batch) times, never per morsel (SURVEY.md §7 hard part (f)),
+* **uint8 device staging** — images ship to HBM as uint8 NHWC and are
+  normalised on device (4× less PCIe/DMA traffic than host-side f32),
+* **zero-egress weights** — random init by default; ``weights_path`` loads a
+  local checkpoint when present.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from daft_tpu.ai.protocols import (
+    Descriptor,
+    ImageClassifierDescriptor,
+    ImageEmbedderDescriptor,
+    PrompterDescriptor,
+    TextClassifierDescriptor,
+    TextEmbedderDescriptor,
+    UDFOptions,
+)
+from daft_tpu.ai.provider import Provider
+from daft_tpu.errors import DaftValueError
+from daft_tpu.utils.tokenizer import HashingTokenizer
+
+_BUCKETS = (8, 32, 128, 256, 512, 1024)
+
+
+def _bucket(n: int) -> int:
+    for b in _BUCKETS:
+        if n <= b:
+            return b
+    return ((n + _BUCKETS[-1] - 1) // _BUCKETS[-1]) * _BUCKETS[-1]
+
+
+def _pad_batch(arr: np.ndarray, to: int) -> np.ndarray:
+    if arr.shape[0] == to:
+        return arr
+    pad = [(0, to - arr.shape[0])] + [(0, 0)] * (arr.ndim - 1)
+    return np.pad(arr, pad)
+
+
+
+def _load_flax_checkpoint(path: str, params):
+    """Load a local .msgpack (flax.serialization) or .npz checkpoint into an
+    already-initialised param tree."""
+    import flax.serialization
+
+    if path.endswith(".npz"):
+        import flax.traverse_util as tu
+
+        flat_file = dict(np.load(path))
+        flat = tu.flatten_dict(flax.serialization.to_state_dict(params), sep="/")
+        for k in flat:
+            if k in flat_file:
+                flat[k] = jnp.asarray(flat_file[k])
+        return flax.serialization.from_state_dict(
+            params, tu.unflatten_dict({tuple(k.split("/")): v for k, v in flat.items()})
+        )
+    with open(path, "rb") as f:
+        return flax.serialization.from_bytes(params, f.read())
+
+
+def _chunked_forward(fwd, params, arr: np.ndarray, max_batch: int, out_dim: int) -> np.ndarray:
+    """Chunk to max_batch, pad each chunk to a bucket, dispatch ALL forwards
+    before gathering any result (jax async dispatch overlaps host->HBM
+    transfers with compute), then gather. Empty input short-circuits."""
+    n = arr.shape[0]
+    if n == 0:
+        return np.zeros((0, out_dim), dtype=np.float32)
+    futures = []
+    for start in range(0, n, max_batch):
+        chunk = arr[start:start + max_batch]
+        b = _bucket(min(len(chunk), max_batch))
+        futures.append((len(chunk), fwd(params, jnp.asarray(_pad_batch(chunk, b)))))
+    outs = [np.asarray(f)[:cn] for cn, f in futures]
+    return np.concatenate(outs, axis=0) if len(outs) > 1 else outs[0]
+
+
+class _FlaxModelBase:
+    """Holds params on device; one instance per worker process (libtpu
+    single-owner: the UDF actor pool gives each chip one process)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+
+
+class FlaxCLIPImageEmbedder(_FlaxModelBase):
+    def __init__(self, model_name: str, weights_path: Optional[str] = None,
+                 dtype=jnp.bfloat16, seed: int = 0, batch_size: int = 128):
+        super().__init__()
+        from daft_tpu.models.clip import CLIPConfig, init_clip_params, load_params
+
+        self.cfg = CLIPConfig.from_name(model_name)
+        self.max_batch = batch_size
+        if weights_path:
+            self.model, params = load_params(weights_path, self.cfg)
+        else:
+            self.model, params = init_clip_params(self.cfg, seed)
+        self.params = jax.device_put(params)
+        model = self.model
+
+        @jax.jit
+        def fwd(p, pixels):
+            emb = model.apply(p, pixels, method=model.encode_image)
+            return emb / jnp.linalg.norm(emb, axis=-1, keepdims=True).clip(1e-6)
+
+        self._fwd = fwd
+
+    @property
+    def dimensions(self) -> int:
+        return self.cfg.embed_dim
+
+    def embed_image(self, images: np.ndarray) -> np.ndarray:
+        """images: (B, H, W, 3) uint8 (or flat (B, H*W*3)). Returns (B, D) f32.
+
+        Chunks to ``max_batch`` and dispatches ALL chunk forwards before
+        gathering any result: jax's async dispatch queues them on device, so
+        host->HBM transfers of chunk i+1 overlap compute of chunk i — critical
+        when the chip sits behind a transfer tunnel.
+        """
+        n = images.shape[0]
+        if images.ndim == 2:
+            images = images.reshape(n, self.cfg.image_size, self.cfg.image_size, 3)
+        return _chunked_forward(self._fwd, self.params, images, self.max_batch, self.cfg.embed_dim)
+
+
+class FlaxCLIPTextEmbedder(_FlaxModelBase):
+    max_batch = 512
+
+    def __init__(self, model_name: str, weights_path: Optional[str] = None, seed: int = 0):
+        super().__init__()
+        from daft_tpu.models.clip import CLIPConfig, init_clip_params, load_params
+
+        self.cfg = CLIPConfig.from_name(model_name)
+        if weights_path:
+            self.model, params = load_params(weights_path, self.cfg)
+        else:
+            self.model, params = init_clip_params(self.cfg, seed)
+        self.params = jax.device_put(params)
+        self.tokenizer = HashingTokenizer(self.cfg.vocab_size, self.cfg.context_length)
+        model = self.model
+
+        @jax.jit
+        def fwd(p, tokens):
+            emb = model.apply(p, tokens, method=model.encode_text)
+            return emb / jnp.linalg.norm(emb, axis=-1, keepdims=True).clip(1e-6)
+
+        self._fwd = fwd
+
+    @property
+    def dimensions(self) -> int:
+        return self.cfg.embed_dim
+
+    def embed_text(self, texts: Sequence[Optional[str]]) -> np.ndarray:
+        tokens, _ = self.tokenizer.encode_batch(texts)
+        return _chunked_forward(self._fwd, self.params, tokens, self.max_batch, self.cfg.embed_dim)
+
+
+class FlaxMiniLMTextEmbedder(_FlaxModelBase):
+    max_batch = 512
+
+    def __init__(self, model_name: str, weights_path: Optional[str] = None, seed: int = 0):
+        super().__init__()
+        from daft_tpu.models.minilm import MiniLMConfig, init_minilm_params
+
+        self.cfg = MiniLMConfig.from_name(model_name)
+        self.model, params = init_minilm_params(self.cfg, seed)
+        if weights_path:
+            params = _load_flax_checkpoint(weights_path, params)
+        self.params = jax.device_put(params)
+        self.tokenizer = HashingTokenizer(self.cfg.vocab_size, self.cfg.max_length)
+        model = self.model
+        self._fwd = jax.jit(model.apply)
+
+    @property
+    def dimensions(self) -> int:
+        return self.cfg.embed_dim
+
+    def embed_text(self, texts: Sequence[Optional[str]]) -> np.ndarray:
+        tokens, _ = self.tokenizer.encode_batch(texts)
+        return _chunked_forward(self._fwd, self.params, tokens, self.max_batch, self.cfg.embed_dim)
+
+
+class FlaxCLIPClassifier(_FlaxModelBase):
+    """Zero-shot classification: cosine similarity between image/text
+    embeddings and label-text embeddings."""
+
+    def __init__(self, model_name: str, weights_path: Optional[str] = None, seed: int = 0):
+        super().__init__()
+        self.image_embedder = FlaxCLIPImageEmbedder(model_name, weights_path, seed=seed)
+        self.text_embedder = FlaxCLIPTextEmbedder(model_name, weights_path, seed=seed)
+        self._label_cache: Dict[tuple, np.ndarray] = {}
+
+    def _label_embs(self, labels: Sequence[str]) -> np.ndarray:
+        key = tuple(labels)
+        if key not in self._label_cache:
+            self._label_cache[key] = self.text_embedder.embed_text(
+                [f"a photo of a {l}" for l in labels]
+            )
+        return self._label_cache[key]
+
+    def classify_image(self, images: np.ndarray, labels: Sequence[str]) -> List[str]:
+        img = self.image_embedder.embed_image(images)
+        lab = self._label_embs(labels)
+        sims = img @ lab.T
+        idx = sims.argmax(axis=1)
+        return [labels[i] for i in idx]
+
+    def classify_text(self, texts: Sequence[Optional[str]], labels: Sequence[str]) -> List[str]:
+        emb = self.text_embedder.embed_text(texts)
+        key = ("__text__",) + tuple(labels)
+        if key not in self._label_cache:
+            self._label_cache[key] = self.text_embedder.embed_text(list(labels))
+        lab = self._label_cache[key]
+        sims = emb @ lab.T
+        idx = sims.argmax(axis=1)
+        return [labels[i] for i in idx]
+
+
+class FlaxPrompter(_FlaxModelBase):
+    def __init__(self, model_name: str, weights_path: Optional[str] = None,
+                 max_new_tokens: int = 32, temperature: float = 0.0, seed: int = 0):
+        super().__init__()
+        from daft_tpu.models.lm import DecoderLMConfig, init_lm_params
+
+        self.cfg = DecoderLMConfig.from_name(model_name)
+        self.model, self.params = init_lm_params(self.cfg, seed)
+        if weights_path:
+            self.params = _load_flax_checkpoint(weights_path, self.params)
+        self.params = jax.device_put(self.params)
+        self.max_new_tokens = max_new_tokens
+        self.temperature = temperature
+        self.prompt_len = min(self.cfg.max_seq_len // 2, 128)
+        self.tokenizer = HashingTokenizer(self.cfg.vocab_size, self.prompt_len)
+
+    def prompt(self, prompts: Sequence[Optional[str]]) -> List[str]:
+        from daft_tpu.models.lm import generate
+
+        tokens, lengths = self.tokenizer.encode_batch(prompts)
+        lengths = np.maximum(lengths, 1)
+        out = generate(self.model, self.params, jnp.asarray(tokens),
+                       jnp.asarray(lengths), self.max_new_tokens, self.temperature)
+        out = np.asarray(out)
+        return [" ".join(str(t) for t in row if t != 0) for row in out]
+
+
+# ---------------------------------------------------------------------- #
+# Descriptors                                                             #
+# ---------------------------------------------------------------------- #
+class _FlaxDescriptor(Descriptor):
+    def __init__(self, kind: str, model: str, options: Dict[str, Any]):
+        self.kind = kind
+        self.model = model
+        self.options = dict(options)
+
+    def get_provider(self) -> str:
+        return "flax"
+
+    def get_model(self) -> str:
+        return self.model
+
+    def get_options(self) -> Dict[str, Any]:
+        return dict(self.options)
+
+    def get_udf_options(self) -> UDFOptions:
+        return UDFOptions(
+            batch_size=self.options.get("batch_size", 256),
+            max_concurrency=self.options.get("max_concurrency", 1),
+            tpus=self.options.get("tpus", 1.0),
+        )
+
+    def get_dimensions(self) -> Optional[int]:
+        from daft_tpu.models.clip import CLIPConfig
+        from daft_tpu.models.minilm import MiniLMConfig
+
+        if self.kind == "image_embedder":
+            return CLIPConfig.from_name(self.model).embed_dim
+        if self.kind == "text_embedder":
+            if "clip" in self.model.lower() or "vit" in self.model.lower():
+                return CLIPConfig.from_name(self.model).embed_dim
+            return MiniLMConfig.from_name(self.model).embed_dim
+        return None
+
+    def instantiate(self):
+        opts = {k: v for k, v in self.options.items()
+                if k in ("weights_path", "seed", "max_new_tokens", "temperature")}
+        if self.kind == "image_embedder":
+            kw = {k: v for k, v in opts.items() if k in ("weights_path", "seed")}
+            kw["batch_size"] = self.options.get("batch_size", 128)
+            return FlaxCLIPImageEmbedder(self.model, **kw)
+        if self.kind == "text_embedder":
+            if "clip" in self.model.lower() or "vit" in self.model.lower():
+                return FlaxCLIPTextEmbedder(self.model, **{k: v for k, v in opts.items() if k in ("weights_path", "seed")})
+            return FlaxMiniLMTextEmbedder(self.model, **{k: v for k, v in opts.items() if k in ("weights_path", "seed")})
+        if self.kind in ("image_classifier", "text_classifier"):
+            return FlaxCLIPClassifier(self.model, **{k: v for k, v in opts.items() if k in ("weights_path", "seed")})
+        if self.kind == "prompter":
+            return FlaxPrompter(self.model, **opts)
+        raise DaftValueError(self.kind)
+
+
+class FlaxProvider(Provider):
+    name = "flax"
+
+    DEFAULT_IMAGE_MODEL = "ViT-L/14"
+    DEFAULT_TEXT_MODEL = "all-MiniLM-L6-v2"
+    DEFAULT_LM = "default-lm"
+
+    def __init__(self, random_init: bool = False, **options):
+        self.random_init = random_init
+        self.options = options
+
+    def _opts(self, options: Dict[str, Any]) -> Dict[str, Any]:
+        merged = {**self.options, **options}
+        if self.random_init:
+            merged.pop("weights_path", None)
+        return merged
+
+    def get_image_embedder(self, model: Optional[str] = None, **options) -> _FlaxDescriptor:
+        return _FlaxDescriptor("image_embedder", model or self.DEFAULT_IMAGE_MODEL, self._opts(options))
+
+    def get_text_embedder(self, model: Optional[str] = None, **options) -> _FlaxDescriptor:
+        return _FlaxDescriptor("text_embedder", model or self.DEFAULT_TEXT_MODEL, self._opts(options))
+
+    def get_image_classifier(self, model: Optional[str] = None, **options) -> _FlaxDescriptor:
+        return _FlaxDescriptor("image_classifier", model or "ViT-B/32", self._opts(options))
+
+    def get_text_classifier(self, model: Optional[str] = None, **options) -> _FlaxDescriptor:
+        return _FlaxDescriptor("text_classifier", model or "ViT-B/32", self._opts(options))
+
+    def get_prompter(self, model: Optional[str] = None, **options) -> _FlaxDescriptor:
+        return _FlaxDescriptor("prompter", model or self.DEFAULT_LM, self._opts(options))

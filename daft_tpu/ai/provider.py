@@ -1,0 +1,59 @@
+"""Provider registry (reference: daft/ai/provider.py).
+
+A Provider vends protocol descriptors (text/image embedders, classifiers,
+prompters). Built-in: ``flax`` (TPU-native models from daft_tpu.models) and
+``flax_random`` (same architectures, random init — for benchmarking and
+zero-egress environments). Third-party providers register via
+``register_provider``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional
+
+from daft_tpu.errors import DaftValueError
+
+_PROVIDERS: Dict[str, Callable[..., "Provider"]] = {}
+
+
+class Provider:
+    name = "base"
+
+    def get_text_embedder(self, model: Optional[str] = None, **options):
+        raise DaftValueError(f"Provider {self.name!r} has no text embedder")
+
+    def get_image_embedder(self, model: Optional[str] = None, **options):
+        raise DaftValueError(f"Provider {self.name!r} has no image embedder")
+
+    def get_text_classifier(self, model: Optional[str] = None, **options):
+        raise DaftValueError(f"Provider {self.name!r} has no text classifier")
+
+    def get_image_classifier(self, model: Optional[str] = None, **options):
+        raise DaftValueError(f"Provider {self.name!r} has no image classifier")
+
+    def get_prompter(self, model: Optional[str] = None, **options):
+        raise DaftValueError(f"Provider {self.name!r} has no prompter")
+
+
+def register_provider(name: str, factory: Callable[..., Provider]) -> None:
+    _PROVIDERS[name] = factory
+
+
+def load_provider(provider: "str | Provider | None", **options) -> Provider:
+    if isinstance(provider, Provider):
+        return provider
+    name = provider or "flax"
+    if name not in _PROVIDERS:
+        _ensure_builtins()
+    if name not in _PROVIDERS:
+        raise DaftValueError(
+            f"Unknown AI provider {name!r}; registered: {sorted(_PROVIDERS)}"
+        )
+    return _PROVIDERS[name](**options)
+
+
+def _ensure_builtins() -> None:
+    from daft_tpu.ai.flax_provider import FlaxProvider
+
+    _PROVIDERS.setdefault("flax", lambda **kw: FlaxProvider(**kw))
+    _PROVIDERS.setdefault("flax_random", lambda **kw: FlaxProvider(random_init=True, **kw))

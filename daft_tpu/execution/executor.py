@@ -39,6 +39,17 @@ from daft_tpu.series import Series
 _SENTINEL = object()
 
 
+def _remorsel(it: Iterator[MicroPartition], max_rows: int) -> Iterator[MicroPartition]:
+    """Split oversized morsels; small morsels pass through untouched."""
+    for mp in it:
+        n = len(mp)
+        if n <= max_rows:
+            yield mp
+            continue
+        for start in range(0, n, max_rows):
+            yield mp.slice(start, min(max_rows, n - start))
+
+
 class Executor:
     """Runs a local physical plan, yielding result MicroPartitions."""
 
@@ -176,7 +187,14 @@ class Executor:
                 break
         concurrency = max(1, getattr(udf, "max_concurrency", None) or 1)
         exprs = node.passthrough + [node.udf_expr]
-        child_iter = self._run(node.children[0])
+        # Re-morselize so oversized in-memory partitions don't reach the UDF
+        # as one giant batch (bounds host memory + enables replica
+        # concurrency). A UDF with a declared device batch_size gets morsels
+        # of 16 device-batches — enough chunks for async transfer/compute
+        # overlap inside the impl without unbounded host buffers.
+        udf_bs = getattr(udf, "batch_size", None)
+        morsel_rows = udf_bs * 16 if udf_bs else self.cfg.default_morsel_size
+        child_iter = _remorsel(self._run(node.children[0]), min(morsel_rows, self.cfg.default_morsel_size))
         if concurrency == 1:
             for mp in child_iter:
                 yield mp.eval_expression_list(exprs)

@@ -1,0 +1,211 @@
+"""AI expression functions: embed_text / embed_image / classify_* / prompt.
+
+Reference: daft/functions/ai/__init__.py (embed_text:72, embed_image:157,
+classify_text:250, classify_image:329, prompt:430) — each resolves a provider,
+gets a protocol descriptor, and wraps it into a stateful batch UDF whose
+replicas the executor schedules onto accelerator slots. Here the slots are
+TPU chips and the models are jitted Flax forwards (daft_tpu/ai/flax_provider).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, List, Optional, Sequence, Union
+
+import numpy as np
+
+from daft_tpu.ai.provider import load_provider
+from daft_tpu.datatype import DataType, TypeId
+from daft_tpu.errors import DaftTypeError
+from daft_tpu.expressions.expression import Expression
+from daft_tpu.series import Series
+from daft_tpu.udf import Udf
+
+
+class _ProtocolUdf(Udf):
+    """Batch UDF over a lazily-instantiated protocol implementation.
+
+    The instance (model params in HBM) is created once per worker process on
+    first batch — the actor-pool replica pattern (reference:
+    daft/ai/_expressions.py + @daft.cls wrapping in functions/ai).
+    """
+
+    def __init__(self, descriptor, call, return_dtype: DataType, name: str):
+        self._descriptor = descriptor
+        self._call = call
+        self._instance = None
+        self._instance_lock = threading.Lock()
+        udf_opts = descriptor.get_udf_options()
+
+        def fn(*series):
+            # Device-batch chunking lives inside the protocol impls (they
+            # chunk to their device batch and async-dispatch all chunks so
+            # transfers overlap compute); here we just hand over the morsel.
+            inst = self._get_instance()
+            return self._call(inst, *series)
+
+        fn.__name__ = name
+        super().__init__(
+            fn, return_dtype, batch=True, name=name,
+            max_concurrency=udf_opts.max_concurrency,
+            cpus=udf_opts.cpus, tpus=udf_opts.tpus,
+            memory_bytes=udf_opts.memory_bytes,
+            batch_size=udf_opts.batch_size, use_process=udf_opts.use_process,
+        )
+
+    def _get_instance(self):
+        if self._instance is None:
+            with self._instance_lock:
+                if self._instance is None:
+                    self._instance = self._descriptor.instantiate()
+        return self._instance
+
+
+def _images_to_numpy(series: Series, size: int) -> np.ndarray:
+    """Convert an image-bearing Series to a dense (B, size, size, 3) uint8
+    batch. Fixed-shape columns are zero-copy reshapes; variable-shape images
+    host-resize (PIL) first — matching the reference's preprocessing
+    transform step."""
+    dt = series.dtype
+    if dt.id == TypeId.FIXED_SHAPE_IMAGE:
+        vals, _ = series.to_numpy_masked()
+        h, w, c = dt.shape
+        if (h, w) != (size, size) or c != 3:
+            vals = _host_resize_batch(vals, size)
+        return np.ascontiguousarray(vals)
+    if dt.id in (TypeId.FIXED_SHAPE_TENSOR, TypeId.EMBEDDING, TypeId.FIXED_SIZE_LIST):
+        vals, _ = series.to_numpy_masked()
+        if vals.ndim == 2 and vals.shape[1] == size * size * 3:
+            return vals.reshape(-1, size, size, 3).astype(np.uint8)
+        if vals.ndim == 4:
+            return vals.astype(np.uint8)
+        raise DaftTypeError(f"Cannot interpret {dt!r} as {size}x{size}x3 images")
+    if dt.id == TypeId.IMAGE:
+        from PIL import Image as PILImage
+
+        out = np.zeros((len(series), size, size, 3), dtype=np.uint8)
+        for i, row in enumerate(series.to_arrow().to_pylist()):
+            if row is None:
+                continue
+            from daft_tpu.datatype import ImageMode
+
+            m = ImageMode(row["mode"])
+            arr = np.frombuffer(row["data"], dtype=m.pixel_dtype.to_numpy()).reshape(
+                row["height"], row["width"], row["channel"]
+            )
+            img = PILImage.fromarray(arr.squeeze(-1) if arr.shape[2] == 1 else arr)
+            img = img.convert("RGB").resize((size, size), PILImage.BILINEAR)
+            out[i] = np.asarray(img)
+        return out
+    if dt.is_binary():
+        # Encoded images: decode+resize on host.
+        from PIL import Image as PILImage
+        import io
+
+        out = np.zeros((len(series), size, size, 3), dtype=np.uint8)
+        for i, raw in enumerate(series.to_pylist()):
+            if raw is None:
+                continue
+            img = PILImage.open(io.BytesIO(raw)).convert("RGB").resize(
+                (size, size), PILImage.BILINEAR
+            )
+            out[i] = np.asarray(img)
+        return out
+    raise DaftTypeError(f"embed_image expects an image column, got {dt!r}")
+
+
+def _host_resize_batch(vals: np.ndarray, size: int) -> np.ndarray:
+    from PIL import Image as PILImage
+
+    out = np.zeros((vals.shape[0], size, size, 3), dtype=np.uint8)
+    for i in range(vals.shape[0]):
+        arr = vals[i]
+        img = PILImage.fromarray(arr.squeeze(-1) if arr.shape[-1] == 1 else arr[..., :3])
+        out[i] = np.asarray(img.convert("RGB").resize((size, size), PILImage.BILINEAR))
+    return out
+
+
+def embed_text(text: Expression, *, provider: Union[str, object, None] = None,
+               model: Optional[str] = None, **options) -> Expression:
+    """Embed a string column (reference: daft/functions/ai/__init__.py:72)."""
+    p = load_provider(provider)
+    desc = p.get_text_embedder(model, **options)
+    dims = desc.get_dimensions() or 384
+    dtype = DataType.embedding(DataType.float32(), dims)
+
+    def call(inst, series: Series) -> Series:
+        embs = inst.embed_text(series.to_pylist())
+        return Series.from_numpy(embs, "embedding", dtype)
+
+    return _ProtocolUdf(desc, call, dtype, "embed_text")(text)
+
+
+def embed_image(image: Expression, *, provider: Union[str, object, None] = None,
+                model: Optional[str] = None, **options) -> Expression:
+    """Embed an image column (reference: daft/functions/ai/__init__.py:157).
+
+    Accepts FixedShapeImage (zero-copy to HBM), variable Image, raw encoded
+    bytes, or a uint8 tensor column.
+    """
+    p = load_provider(provider)
+    desc = p.get_image_embedder(model, **options)
+    dims = desc.get_dimensions() or 768
+    dtype = DataType.embedding(DataType.float32(), dims)
+
+    def call(inst, series: Series) -> Series:
+        size = getattr(inst, "cfg", None).image_size if hasattr(inst, "cfg") else 224
+        batch = _images_to_numpy(series, size)
+        embs = inst.embed_image(batch)
+        return Series.from_numpy(embs, "embedding", dtype)
+
+    return _ProtocolUdf(desc, call, dtype, "embed_image")(image)
+
+
+def classify_text(text: Expression, labels: Sequence[str], *,
+                  provider: Union[str, object, None] = None,
+                  model: Optional[str] = None, **options) -> Expression:
+    p = load_provider(provider)
+    desc = p.get_text_classifier(model, **options)
+    labels = list(labels)
+
+    def call(inst, series: Series) -> Series:
+        out = inst.classify_text(series.to_pylist(), labels)
+        return Series.from_pylist(out, "label", DataType.string())
+
+    return _ProtocolUdf(desc, call, DataType.string(), "classify_text")(text)
+
+
+def classify_image(image: Expression, labels: Sequence[str], *,
+                   provider: Union[str, object, None] = None,
+                   model: Optional[str] = None, **options) -> Expression:
+    p = load_provider(provider)
+    desc = p.get_image_classifier(model, **options)
+    labels = list(labels)
+
+    def call(inst, series: Series) -> Series:
+        size = inst.image_embedder.cfg.image_size if hasattr(inst, "image_embedder") else 224
+        batch = _images_to_numpy(series, size)
+        out = inst.classify_image(batch, labels)
+        return Series.from_pylist(out, "label", DataType.string())
+
+    return _ProtocolUdf(desc, call, DataType.string(), "classify_image")(image)
+
+
+def prompt(text: Expression, *, provider: Union[str, object, None] = None,
+           model: Optional[str] = None, **options) -> Expression:
+    """Generate text per row (reference: daft/functions/ai/__init__.py:430)."""
+    p = load_provider(provider)
+    desc = p.get_prompter(model, **options)
+
+    def call(inst, series: Series) -> Series:
+        out = inst.prompt(series.to_pylist())
+        return Series.from_pylist(out, "response", DataType.string())
+
+    return _ProtocolUdf(desc, call, DataType.string(), "prompt")(text)
+
+
+def llm_generate(text: Expression, *, model: Optional[str] = None,
+                 provider: Union[str, object, None] = None, **options) -> Expression:
+    """Batched LLM generation (reference: daft/functions/llm.py llm_generate
+    → vLLM; here the continuous-batching DecoderLM sink)."""
+    return prompt(text, provider=provider, model=model, **options)

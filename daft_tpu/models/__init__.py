@@ -1,0 +1,18 @@
+"""Flax model zoo serving the AI expression layer.
+
+These replace the reference's torch/transformers model loading
+(daft/ai/transformers/) with TPU-native Flax implementations: bf16 params,
+static shapes, jit/pjit-compatible forwards, and mesh-shardable parameters
+for models larger than one chip.
+"""
+
+from daft_tpu.models.clip import CLIPConfig, CLIPImageEncoder, CLIPModel, CLIPTextEncoder
+from daft_tpu.models.minilm import MiniLMConfig, MiniLMEncoder
+from daft_tpu.models.resnet import ResNet18, ResNetConfig
+from daft_tpu.models.lm import DecoderLM, DecoderLMConfig
+
+__all__ = [
+    "CLIPConfig", "CLIPImageEncoder", "CLIPModel", "CLIPTextEncoder",
+    "MiniLMConfig", "MiniLMEncoder", "ResNet18", "ResNetConfig",
+    "DecoderLM", "DecoderLMConfig",
+]

@@ -1,0 +1,81 @@
+"""ResNet-18 in Flax (image classification parity with the reference's
+benchmark model — BASELINE.md row 'Image classification, ResNet18')."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Any, Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+@dataclass(frozen=True)
+class ResNetConfig:
+    stage_sizes: Sequence[int] = (2, 2, 2, 2)
+    num_classes: int = 1000
+    width: int = 64
+    dtype: Any = jnp.bfloat16
+
+    @staticmethod
+    def tiny() -> "ResNetConfig":
+        return ResNetConfig(stage_sizes=(1, 1), num_classes=10, width=16)
+
+
+class BasicBlock(nn.Module):
+    filters: int
+    strides: int = 1
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        residual = x
+        y = nn.Conv(self.filters, (3, 3), strides=(self.strides, self.strides),
+                    padding=1, use_bias=False, dtype=self.dtype)(x)
+        y = nn.BatchNorm(use_running_average=not train, dtype=jnp.float32)(y).astype(self.dtype)
+        y = nn.relu(y)
+        y = nn.Conv(self.filters, (3, 3), padding=1, use_bias=False, dtype=self.dtype)(y)
+        y = nn.BatchNorm(use_running_average=not train, dtype=jnp.float32)(y).astype(self.dtype)
+        if residual.shape != y.shape:
+            residual = nn.Conv(self.filters, (1, 1), strides=(self.strides, self.strides),
+                               use_bias=False, dtype=self.dtype)(residual)
+            residual = nn.BatchNorm(use_running_average=not train, dtype=jnp.float32)(residual).astype(self.dtype)
+        return nn.relu(y + residual)
+
+
+class ResNet18(nn.Module):
+    cfg: ResNetConfig
+
+    @nn.compact
+    def __call__(self, pixels: jax.Array, train: bool = False) -> jax.Array:
+        """pixels: (B, H, W, 3) uint8 or float. Returns (B, num_classes) logits."""
+        cfg = self.cfg
+        x = pixels.astype(jnp.float32)
+        if jnp.issubdtype(pixels.dtype, jnp.integer):
+            x = x / 255.0
+        mean = jnp.array([0.485, 0.456, 0.406])
+        std = jnp.array([0.229, 0.224, 0.225])
+        x = ((x - mean) / std).astype(cfg.dtype)
+        x = nn.Conv(cfg.width, (7, 7), strides=(2, 2), padding=3, use_bias=False,
+                    dtype=cfg.dtype, name="stem")(x)
+        x = nn.BatchNorm(use_running_average=not train, dtype=jnp.float32)(x).astype(cfg.dtype)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
+        for i, n_blocks in enumerate(cfg.stage_sizes):
+            filters = cfg.width * (2 ** i)
+            for j in range(n_blocks):
+                strides = 2 if (i > 0 and j == 0) else 1
+                x = BasicBlock(filters, strides, cfg.dtype, name=f"stage{i}_block{j}")(x, train)
+        x = x.mean(axis=(1, 2)).astype(jnp.float32)
+        return nn.Dense(cfg.num_classes, dtype=jnp.float32, name="head")(x)
+
+
+def init_resnet_params(cfg: ResNetConfig, seed: int = 0):
+    model = ResNet18(cfg)
+    rng = jax.random.PRNGKey(seed)
+    size = 224 if cfg.num_classes == 1000 else 32
+    pixels = jnp.zeros((2, size, size, 3), jnp.uint8)
+    variables = model.init(rng, pixels)
+    return model, variables

@@ -1,0 +1,3 @@
+from daft_tpu.parallel.mesh import make_mesh, match_partition_rules, shard_params
+
+__all__ = ["make_mesh", "match_partition_rules", "shard_params"]

@@ -1,0 +1,104 @@
+"""Device mesh + parameter sharding utilities.
+
+The reference has no model parallelism (SURVEY.md §2.3 — models run inside
+opaque CUDA UDFs); this module is the TPU extension that generalises the
+reference's ``gpus_per_actor`` into ``chips_per_replica`` over an ICI mesh
+(SURVEY.md §7.8): pick a mesh, annotate param/batch shardings with
+PartitionSpec rules, and let XLA insert the collectives.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def make_mesh(axes: Dict[str, int], devices: Optional[Sequence] = None) -> Mesh:
+    """Create a Mesh with named axes, e.g. {"dp": 2, "tp": 4}.
+
+    An axis size of -1 absorbs the remaining devices.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    names = list(axes.keys())
+    sizes = list(axes.values())
+    known = int(np.prod([s for s in sizes if s > 0]))
+    if -1 in sizes:
+        rem = len(devices) // known
+        sizes = [rem if s == -1 else s for s in sizes]
+    total = int(np.prod(sizes))
+    if total > len(devices):
+        raise ValueError(f"Mesh {dict(zip(names, sizes))} needs {total} devices, have {len(devices)}")
+    grid = np.array(devices[:total]).reshape(sizes)
+    return Mesh(grid, tuple(names))
+
+
+# Default tensor-parallel rules for the transformer stacks in daft_tpu.models:
+# shard the wide dense kernels over the "tp" axis, replicate the rest.
+DEFAULT_TP_RULES: List[Tuple[str, P]] = [
+    (r".*attn/qkv/kernel", P(None, "tp")),
+    (r".*attn/out/kernel", P("tp", None)),
+    (r".*mlp/fc1/kernel", P(None, "tp")),
+    (r".*mlp/fc2/kernel", P("tp", None)),
+    (r".*tok_embed/embedding", P(None, "tp")),
+    (r".*lm_head/kernel", P(None, "tp")),
+    (r".*proj/kernel", P(None, "tp")),
+    (r".*patch_embed/kernel", P()),
+    (r".*", P()),
+]
+
+
+def _axis_size(mesh: Optional[Mesh], ax) -> int:
+    if mesh is None or ax is None:
+        return 1
+    if isinstance(ax, (tuple, list)):
+        return int(np.prod([mesh.shape[a] for a in ax]))
+    return mesh.shape[ax]
+
+
+def match_partition_rules(rules: Sequence[Tuple[str, P]], params,
+                          mesh: Optional[Mesh] = None):
+    """Map each param leaf to a PartitionSpec by regex on its tree path
+    (the public fmengine/EasyLM pattern — see SNIPPETS.md [3]).
+
+    Pass ``mesh`` to drop spec axes that don't divide the dim evenly; without
+    it, rules apply verbatim.
+    """
+    flat = jax.tree_util.tree_flatten_with_path(params)[0]
+
+    def spec_for(path, leaf) -> P:
+        name = "/".join(_key_str(k) for k in path)
+        if leaf.ndim == 0 or int(np.prod(leaf.shape)) == 1:
+            return P()
+        for pattern, spec in rules:
+            if re.search(pattern, name):
+                fixed = []
+                for dim, ax in zip(leaf.shape, tuple(spec) + (None,) * (leaf.ndim - len(tuple(spec)))):
+                    fixed.append(ax if ax is None or dim % _axis_size(mesh, ax) == 0 else None)
+                return P(*fixed)
+        return P()
+
+    specs = [spec_for(path, leaf) for path, leaf in flat]
+    treedef = jax.tree_util.tree_structure(params)
+    return jax.tree_util.tree_unflatten(treedef, specs)
+
+
+def shard_params(params, mesh: Mesh, rules: Sequence[Tuple[str, P]] = DEFAULT_TP_RULES):
+    """Place params onto the mesh per the rules; returns (sharded_params, specs)."""
+    specs = match_partition_rules(rules, params, mesh)
+    shardings = jax.tree_util.tree_map(lambda s: NamedSharding(mesh, s), specs)
+    sharded = jax.tree_util.tree_map(
+        lambda x, sh: jax.device_put(x, sh), params, shardings
+    )
+    return sharded, specs
+
+
+def _key_str(k) -> str:
+    if hasattr(k, "key"):
+        return str(k.key)
+    if hasattr(k, "idx"):
+        return str(k.idx)
+    return str(k)

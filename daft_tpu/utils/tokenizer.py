@@ -1,0 +1,106 @@
+"""Tokenizers for the AI expression layer.
+
+Zero-egress default: a deterministic hashing word tokenizer (stable across
+hosts, no vocab files). When a local vocab/merges file is available, a
+greedy-BPE tokenizer loads it (reference: src/daft-functions-tokenize,
+tiktoken-style BPE).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+_WORD_RE = re.compile(r"\w+|[^\w\s]")
+
+
+class HashingTokenizer:
+    """Deterministic word-hash tokenizer: token id = FNV(word) % (vocab-2) + 2.
+
+    Reserves 0 = pad, 1 = BOS, 2 = EOS semantics are caller-defined. Suitable
+    for throughput benchmarking and tests; swap in a BPE vocab for quality.
+    """
+
+    def __init__(self, vocab_size: int, max_length: int, lowercase: bool = True):
+        self.vocab_size = vocab_size
+        self.max_length = max_length
+        self.lowercase = lowercase
+
+    def encode_batch(self, texts: Sequence[Optional[str]]) -> "tuple[np.ndarray, np.ndarray]":
+        """Returns (tokens (B, max_length) int32 zero-padded, lengths (B,))."""
+        from daft_tpu.kernels.hashing import hash_bytes_batch
+
+        B = len(texts)
+        out = np.zeros((B, self.max_length), dtype=np.int32)
+        lengths = np.zeros(B, dtype=np.int32)
+        mod = max(self.vocab_size - 2, 1)
+        for i, text in enumerate(texts):
+            if not text:
+                continue
+            if self.lowercase:
+                text = text.lower()
+            words = _WORD_RE.findall(text)[: self.max_length]
+            if not words:
+                continue
+            data = "\x00".join(words).encode()
+            lens = np.array([len(w.encode()) for w in words], dtype=np.int64)
+            starts = np.concatenate([[0], np.cumsum(lens[:-1] + 1)]).astype(np.int64)
+            hashes = hash_bytes_batch(np.frombuffer(data, dtype=np.uint8), starts, lens)
+            ids = (hashes % np.uint64(mod)).astype(np.int32) + 2
+            out[i, : len(ids)] = ids
+            lengths[i] = len(ids)
+        return out, lengths
+
+
+class BPETokenizer:
+    """Greedy byte-pair tokenizer over a local vocab file (one token per line
+    or tiktoken-style base64 ranks)."""
+
+    def __init__(self, vocab_path: str, max_length: int):
+        self.max_length = max_length
+        self.vocab: dict = {}
+        with open(vocab_path, "rb") as f:
+            for i, line in enumerate(f):
+                line = line.rstrip(b"\n")
+                if b" " in line:  # tiktoken: base64 rank
+                    import base64
+
+                    tok, rank = line.split(b" ", 1)
+                    self.vocab[base64.b64decode(tok)] = int(rank)
+                else:
+                    self.vocab[line] = i
+        self.vocab_size = max(self.vocab.values()) + 1
+
+    def _encode_word(self, word: bytes) -> List[int]:
+        # Greedy longest-match segmentation.
+        out = []
+        i = 0
+        while i < len(word):
+            for j in range(len(word), i, -1):
+                piece = word[i:j]
+                if piece in self.vocab:
+                    out.append(self.vocab[piece])
+                    i = j
+                    break
+            else:
+                i += 1  # unknown byte: skip
+        return out
+
+    def encode_batch(self, texts: Sequence[Optional[str]]):
+        B = len(texts)
+        out = np.zeros((B, self.max_length), dtype=np.int32)
+        lengths = np.zeros(B, dtype=np.int32)
+        for i, text in enumerate(texts):
+            if not text:
+                continue
+            ids: List[int] = []
+            for w in _WORD_RE.findall(text):
+                ids.extend(self._encode_word(w.encode()))
+                if len(ids) >= self.max_length:
+                    break
+            ids = ids[: self.max_length]
+            out[i, : len(ids)] = ids
+            lengths[i] = len(ids)
+        return out, lengths

@@ -5,17 +5,24 @@ Mirrors the reference's env-switched runner parametrisation
 runs the whole behavioral suite on either engine. Tests run on a virtual
 8-device CPU mesh so multi-chip sharding logic is exercised without TPU
 hardware (SURVEY.md §4 fake-device-mesh pattern).
+
+NOTE: the axon TPU plugin in this image force-appends itself to
+jax_platforms, ignoring the JAX_PLATFORMS env var — so we must call
+jax.config.update after import, before first backend use.
 """
 
 import os
 
-# Must be set before jax import: 8 virtual CPU devices for mesh tests.
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 xla_flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in xla_flags:
     os.environ["XLA_FLAGS"] = (
         xla_flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+if os.environ.get("DAFT_TEST_PLATFORM", "cpu") == "cpu":
+    jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 

@@ -1,0 +1,93 @@
+import numpy as np
+import pytest
+
+import daft_tpu
+from daft_tpu import col
+from daft_tpu.datatype import DataType
+from daft_tpu.functions.ai import classify_image, classify_text, embed_image, embed_text, prompt
+
+
+@pytest.fixture
+def image_df():
+    imgs = np.random.default_rng(0).integers(0, 255, (12, 32, 32, 3), dtype=np.uint8)
+    return daft_tpu.from_pydict({
+        "img": daft_tpu.Series.from_numpy(imgs, "img", DataType.image("RGB", 32, 32)),
+        "txt": [f"sample text {i}" for i in range(12)],
+    })
+
+
+def test_embed_image(image_df):
+    out = image_df.with_column(
+        "emb", embed_image(col("img"), provider="flax_random", model="tiny")
+    )
+    assert out.schema["emb"].dtype == DataType.embedding(DataType.float32(), 32)
+    embs = out.to_pydict()["emb"]
+    assert len(embs) == 12
+    v = np.asarray(embs[0])
+    assert v.shape == (32,)
+    assert abs(float(np.linalg.norm(v)) - 1.0) < 1e-3  # normalised
+
+
+def test_embed_image_deterministic(image_df):
+    e = embed_image(col("img"), provider="flax_random", model="tiny")
+    a = image_df.with_column("emb", e).to_pydict()["emb"]
+    b = image_df.with_column("emb", e).to_pydict()["emb"]
+    np.testing.assert_allclose(np.asarray(a[0]), np.asarray(b[0]), rtol=1e-5)
+
+
+def test_embed_text(image_df):
+    out = image_df.with_column(
+        "emb", embed_text(col("txt"), provider="flax_random", model="tiny")
+    ).to_pydict()
+    assert np.asarray(out["emb"][0]).shape == (64,)
+    # Same text -> same embedding (hashing tokenizer + fixed seed)
+    df2 = daft_tpu.from_pydict({"txt": ["sample text 0", "sample text 0"]})
+    embs = df2.with_column(
+        "emb", embed_text(col("txt"), provider="flax_random", model="tiny")
+    ).to_pydict()["emb"]
+    np.testing.assert_allclose(np.asarray(embs[0]), np.asarray(embs[1]), rtol=1e-5)
+
+
+def test_classify(image_df):
+    out = image_df.with_column(
+        "lbl", classify_image(col("img"), ["cat", "dog"], provider="flax_random", model="tiny")
+    ).to_pydict()
+    assert set(out["lbl"]) <= {"cat", "dog"}
+    out2 = image_df.with_column(
+        "lbl", classify_text(col("txt"), ["a", "b"], provider="flax_random", model="tiny")
+    ).to_pydict()
+    assert set(out2["lbl"]) <= {"a", "b"}
+
+
+def test_prompt(image_df):
+    out = image_df.limit(2).with_column(
+        "resp", prompt(col("txt"), provider="flax_random", model="tiny", max_new_tokens=4)
+    ).to_pydict()
+    assert len(out["resp"]) == 2
+    assert all(isinstance(r, str) for r in out["resp"])
+
+
+def test_provider_registry():
+    from daft_tpu.ai.provider import load_provider
+
+    p = load_provider("flax_random")
+    desc = p.get_image_embedder("tiny")
+    assert desc.get_provider() == "flax"
+    assert desc.get_dimensions() == 32
+    with pytest.raises(Exception):
+        load_provider("nope")
+
+
+def test_encoded_bytes_images():
+    import io
+
+    from PIL import Image as PILImage
+
+    raws = []
+    for i in range(4):
+        buf = io.BytesIO()
+        PILImage.new("RGB", (20, 20), (i * 20, 0, 0)).save(buf, format="PNG")
+        raws.append(buf.getvalue())
+    df = daft_tpu.from_pydict({"raw": daft_tpu.Series.from_pylist(raws, "raw", DataType.binary())})
+    out = df.with_column("emb", embed_image(col("raw"), provider="flax_random", model="tiny")).to_pydict()
+    assert np.asarray(out["emb"][0]).shape == (32,)
