@@ -1,0 +1,69 @@
+"""Arrow Flight shuffle server + client.
+
+Reference: the per-worker tonic ``ShuffleFlightServer`` serving spilled
+partitions (src/daft-shuffles/src/server/flight_server.rs) and the flight
+client decoding streams to RecordBatches (client/flight_client.rs). Here the
+server is pyarrow.flight (Arrow C++ gRPC) over a ShuffleCache — reduce tasks
+on other hosts pull partitions by ticket over DCN.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional
+
+import pyarrow as pa
+import pyarrow.flight as flight
+
+from daft_tpu.distributed.shuffle import ShuffleCache
+from daft_tpu.micropartition import MicroPartition
+
+
+class ShuffleFlightServer(flight.FlightServerBase):
+    def __init__(self, cache: ShuffleCache, location: str = "grpc://0.0.0.0:0"):
+        super().__init__(location)
+        self.cache = cache
+
+    def do_get(self, context, ticket: flight.Ticket):
+        key = ticket.ticket.decode()
+        mp = self.cache.read_partition(key)
+        table = mp.to_arrow_table()
+        return flight.RecordBatchStream(table)
+
+    def list_flights(self, context, criteria):
+        for t in self.cache.tickets():
+            meta = self.cache.partition_meta(t)
+            descriptor = flight.FlightDescriptor.for_path(t)
+            yield flight.FlightInfo(
+                pa.schema([]), descriptor,
+                [flight.FlightEndpoint(t, [f"grpc://localhost:{self.port}"])],
+                meta.rows, meta.bytes_,
+            )
+
+    @property
+    def address(self) -> str:
+        return f"grpc://localhost:{self.port}"
+
+
+def start_shuffle_server(cache: ShuffleCache, port: int = 0) -> ShuffleFlightServer:
+    server = ShuffleFlightServer(cache, f"grpc://0.0.0.0:{port}")
+    thread = threading.Thread(target=server.serve, daemon=True,
+                              name="daft-shuffle-flight")
+    thread.start()
+    return server
+
+
+_client_cache: Dict[str, flight.FlightClient] = {}
+_client_lock = threading.Lock()
+
+
+def fetch_partition(address: str, ticket: str) -> MicroPartition:
+    """Pull one shuffle partition from a worker's flight server."""
+    with _client_lock:
+        client = _client_cache.get(address)
+        if client is None:
+            client = flight.FlightClient(address)
+            _client_cache[address] = client
+    reader = client.do_get(flight.Ticket(ticket.encode()))
+    table = reader.read_all()
+    return MicroPartition.from_arrow_table(table)

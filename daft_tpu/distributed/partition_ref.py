@@ -1,0 +1,99 @@
+"""Partition references: handles to materialised partitions.
+
+Reference: src/common/partitioning (PartitionRef/PartitionSet) and
+src/daft-partition-refs (FlightPartitionRef — address+size handle to a shuffle
+partition living on a worker). Local refs hold the MicroPartition in-process;
+flight refs point at a worker's shuffle server and fetch over Arrow IPC.
+"""
+
+from __future__ import annotations
+
+import io
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+import pyarrow as pa
+
+from daft_tpu.micropartition import MicroPartition
+from daft_tpu.recordbatch import RecordBatch
+from daft_tpu.schema import Schema
+
+
+class PartitionRef:
+    """A handle to a materialised partition, fetchable from anywhere."""
+
+    def fetch(self) -> MicroPartition:
+        raise NotImplementedError
+
+    def num_rows(self) -> int:
+        raise NotImplementedError
+
+    def size_bytes(self) -> int:
+        raise NotImplementedError
+
+    @property
+    def location(self) -> Optional[str]:
+        """Worker id holding the data (for locality-aware scheduling)."""
+        return None
+
+
+@dataclass
+class LocalPartitionRef(PartitionRef):
+    partition: MicroPartition
+    worker_id: Optional[str] = None
+
+    def fetch(self) -> MicroPartition:
+        return self.partition
+
+    def num_rows(self) -> int:
+        return len(self.partition)
+
+    def size_bytes(self) -> int:
+        return self.partition.size_bytes()
+
+    @property
+    def location(self) -> Optional[str]:
+        return self.worker_id
+
+
+@dataclass
+class FlightPartitionRef(PartitionRef):
+    """Address + ticket of a partition served by a worker's shuffle Flight
+    server (reference: src/daft-partition-refs/src/lib.rs)."""
+
+    address: str
+    ticket: str
+    rows: int
+    bytes_: int
+    worker_id: Optional[str] = None
+
+    def fetch(self) -> MicroPartition:
+        from daft_tpu.distributed.flight import fetch_partition
+
+        return fetch_partition(self.address, self.ticket)
+
+    def num_rows(self) -> int:
+        return self.rows
+
+    def size_bytes(self) -> int:
+        return self.bytes_
+
+    @property
+    def location(self) -> Optional[str]:
+        return self.worker_id
+
+
+def serialize_partition(mp: MicroPartition) -> bytes:
+    """Arrow IPC stream serialisation (the shuffle wire format — reference
+    keeps Arrow IPC on the wire too, src/daft-shuffles)."""
+    table = mp.to_arrow_table()
+    sink = io.BytesIO()
+    with pa.ipc.new_stream(sink, table.schema) as writer:
+        writer.write_table(table)
+    return sink.getvalue()
+
+
+def deserialize_partition(data: bytes, schema: Optional[Schema] = None) -> MicroPartition:
+    with pa.ipc.open_stream(io.BytesIO(data)) as reader:
+        table = reader.read_all()
+    return MicroPartition.from_arrow_table(table, schema)

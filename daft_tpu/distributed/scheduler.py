@@ -1,0 +1,92 @@
+"""Scheduler + dispatcher: task → worker assignment with failure recovery.
+
+Reference: src/daft-distributed/src/scheduling — ``DefaultScheduler``
+(spread / soft worker-affinity, scheduler/default.rs:9-70), the dispatcher
+mapping failures to ``WorkerDied``/``WorkerUnavailable`` and **rescheduling the
+task elsewhere** (dispatcher.rs:100-140), and the autoscale request at
+pending-demand > 1.25× capacity (default.rs:22-44).
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+from concurrent.futures import FIRST_COMPLETED, Future, wait
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from daft_tpu.distributed.partition_ref import PartitionRef
+from daft_tpu.distributed.task import Task
+from daft_tpu.distributed.worker import Worker, WorkerDiedError, WorkerManager
+from daft_tpu.errors import DaftExecutionError
+
+
+class Scheduler:
+    """Picks a worker for each task: honour affinity hints, else spread to the
+    least-loaded worker."""
+
+    def __init__(self, manager: WorkerManager, autoscaling_threshold: float = 1.25):
+        self.manager = manager
+        self.autoscaling_threshold = autoscaling_threshold
+        self._rr = itertools.count()
+
+    def assign(self, task: Task) -> Worker:
+        workers = self.manager.workers()
+        if not workers:
+            raise DaftExecutionError("No live workers")
+        if task.strategy.kind == "affinity" and task.strategy.worker_id:
+            w = self.manager.get(task.strategy.worker_id)
+            if w is not None:
+                return w
+            if not task.strategy.soft:
+                raise DaftExecutionError(
+                    f"Hard-affinity worker {task.strategy.worker_id} unavailable"
+                )
+        # Spread: least active tasks, round-robin tiebreak.
+        idx = next(self._rr)
+        return min(enumerate(workers), key=lambda iw: (iw[1].active_tasks(), (iw[0] + idx) % len(workers)))[1]
+
+    def request_autoscale(self, pending: int) -> None:
+        capacity = max(self.manager.total_slots(), 1)
+        if pending > self.autoscaling_threshold * capacity:
+            self.manager.try_autoscale(pending)
+
+
+class Dispatcher:
+    """Runs a batch of tasks to completion with bounded in-flight tasks,
+    per-task retry on worker death, and ordered results."""
+
+    MAX_TASK_RETRIES = 3
+
+    def __init__(self, scheduler: Scheduler, max_inflight: Optional[int] = None):
+        self.scheduler = scheduler
+        self.max_inflight = max_inflight
+
+    def run_tasks(self, tasks: Sequence[Task]) -> List[List[PartitionRef]]:
+        results: Dict[int, List[PartitionRef]] = {}
+        pending: List[Tuple[int, Task, int]] = [(i, t, 0) for i, t in enumerate(tasks)]
+        inflight: Dict[Future, Tuple[int, Task, int, Worker]] = {}
+        limit = self.max_inflight or max(self.scheduler.manager.total_slots(), 1)
+        self.scheduler.request_autoscale(len(pending))
+        while pending or inflight:
+            while pending and len(inflight) < limit:
+                idx, task, attempt = pending.pop(0)
+                worker = self.scheduler.assign(task)
+                fut = worker.submit(task)
+                inflight[fut] = (idx, task, attempt, worker)
+            done, _ = wait(list(inflight.keys()), return_when=FIRST_COMPLETED)
+            for fut in done:
+                idx, task, attempt, worker = inflight.pop(fut)
+                try:
+                    results[idx] = fut.result()
+                except WorkerDiedError:
+                    # Mark dead and reschedule elsewhere (reference
+                    # dispatcher.rs:100-140 WorkerDied handling).
+                    self.scheduler.manager.mark_dead(worker.worker_id)
+                    if attempt + 1 >= self.MAX_TASK_RETRIES:
+                        raise DaftExecutionError(
+                            f"Task {task.task_id} failed after {attempt + 1} attempts"
+                        )
+                    pending.append((idx, task, attempt + 1))
+                except Exception as e:  # noqa: BLE001
+                    raise DaftExecutionError(f"Task {task.task_id} failed: {e}") from e
+        return [results[i] for i in range(len(tasks))]

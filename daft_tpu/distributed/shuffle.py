@@ -1,0 +1,91 @@
+"""Shuffle substrate: partitioned spill to local-disk Arrow IPC files.
+
+Reference: src/daft-shuffles/src/shuffle_cache.rs:10-60 — map tasks write
+hash-partitioned Arrow IPC chunk files (4 MiB chunk target) under the
+configured shuffle dirs; a per-worker Flight server serves them to reduce
+tasks (server/flight_server.rs). The wire format stays Arrow IPC end-to-end.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import uuid
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence
+
+import pyarrow as pa
+
+from daft_tpu.micropartition import MicroPartition
+from daft_tpu.recordbatch import RecordBatch
+from daft_tpu.schema import Schema
+
+TARGET_CHUNK_BYTES = 4 * 1024 * 1024  # reference: shuffle_cache.rs:30
+
+
+@dataclass
+class ShufflePartitionMeta:
+    ticket: str
+    files: List[str] = field(default_factory=list)
+    rows: int = 0
+    bytes_: int = 0
+
+
+class ShuffleCache:
+    """Per-worker shuffle spill: one directory per shuffle, one IPC file per
+    (map task, bucket) chunk; partitions are retrievable by ticket."""
+
+    def __init__(self, dirs: Sequence[str] = ("/tmp",)):
+        self.root = os.path.join(dirs[0], f"daft-shuffle-{uuid.uuid4().hex[:8]}")
+        os.makedirs(self.root, exist_ok=True)
+        self._meta: Dict[str, ShufflePartitionMeta] = {}
+        self._schemas: Dict[str, pa.Schema] = {}
+        self._lock = threading.Lock()
+
+    def write_partition(self, shuffle_id: str, bucket: int, mp: MicroPartition) -> str:
+        """Spill one bucket's data from a map task; returns its ticket."""
+        ticket = f"{shuffle_id}/{bucket}"
+        table = mp.to_arrow_table()
+        path = os.path.join(self.root, f"{shuffle_id}-{bucket}-{uuid.uuid4().hex[:8]}.arrow")
+        with pa.OSFile(path, "wb") as f:
+            with pa.ipc.new_stream(f, table.schema) as writer:
+                # Chunk to the target IPC chunk size.
+                if table.nbytes > TARGET_CHUNK_BYTES and table.num_rows > 1:
+                    rows_per_chunk = max(1, table.num_rows * TARGET_CHUNK_BYTES // max(table.nbytes, 1))
+                    for start in range(0, table.num_rows, rows_per_chunk):
+                        writer.write_table(table.slice(start, rows_per_chunk))
+                else:
+                    writer.write_table(table)
+        with self._lock:
+            meta = self._meta.setdefault(ticket, ShufflePartitionMeta(ticket))
+            meta.files.append(path)
+            meta.rows += table.num_rows
+            meta.bytes_ += table.nbytes
+            self._schemas[ticket] = table.schema
+        return ticket
+
+    def read_partition(self, ticket: str) -> MicroPartition:
+        with self._lock:
+            meta = self._meta.get(ticket)
+        if meta is None:
+            raise KeyError(f"Unknown shuffle ticket {ticket!r}")
+        tables = []
+        for path in meta.files:
+            with pa.OSFile(path, "rb") as f:
+                with pa.ipc.open_stream(f) as reader:
+                    tables.append(reader.read_all())
+        combined = pa.concat_tables(tables) if tables else None
+        return MicroPartition.from_arrow_table(combined)
+
+    def partition_meta(self, ticket: str) -> ShufflePartitionMeta:
+        with self._lock:
+            return self._meta[ticket]
+
+    def tickets(self) -> List[str]:
+        with self._lock:
+            return list(self._meta)
+
+    def cleanup(self) -> None:
+        import shutil
+
+        shutil.rmtree(self.root, ignore_errors=True)

@@ -1,0 +1,62 @@
+"""Distributed tasks: a self-contained local-plan fragment over inputs.
+
+Reference: ``SwordfishTask`` (src/daft-distributed/src/scheduling/task.rs) —
+each task bundles a LocalPhysicalPlan + input partitions and a
+``SchedulingStrategy::{Spread, WorkerAffinity}`` hint (task.rs:195-198).
+"""
+
+from __future__ import annotations
+
+import itertools
+from dataclasses import dataclass, field
+from typing import List, Optional, Sequence
+
+from daft_tpu.distributed.partition_ref import PartitionRef
+from daft_tpu.physical import plan as pp
+
+_task_counter = itertools.count()
+
+
+@dataclass
+class SchedulingStrategy:
+    kind: str = "spread"  # spread | affinity
+    worker_id: Optional[str] = None
+    soft: bool = True
+
+    @staticmethod
+    def spread() -> "SchedulingStrategy":
+        return SchedulingStrategy("spread")
+
+    @staticmethod
+    def affinity(worker_id: str, soft: bool = True) -> "SchedulingStrategy":
+        return SchedulingStrategy("affinity", worker_id, soft)
+
+
+@dataclass
+class Task:
+    """One unit of distributed work: run ``fragment`` (a local physical plan
+    whose leaves are PhysicalScan/InMemorySource placeholders) after binding
+    ``inputs`` into its BoundInput leaves."""
+
+    fragment: pp.PhysicalPlan
+    inputs: List[List[PartitionRef]] = field(default_factory=list)
+    strategy: SchedulingStrategy = field(default_factory=SchedulingStrategy.spread)
+    task_id: str = field(default_factory=lambda: f"task-{next(_task_counter)}")
+    partition_idx: int = 0
+    # Shuffle-map tasks yield one output partition per shuffle bucket; the
+    # worker must preserve them instead of concatenating (expect_outputs > 1).
+    expect_outputs: int = 1
+
+    def input_size_bytes(self) -> int:
+        return sum(r.size_bytes() for refs in self.inputs for r in refs)
+
+
+class BoundInput(pp.PhysicalPlan):
+    """Leaf placeholder bound to a task input slot at execution time."""
+
+    def __init__(self, slot: int, schema):
+        super().__init__([], schema)
+        self.slot = slot
+
+    def describe(self):
+        return f"BoundInput[{self.slot}]"

@@ -1,0 +1,158 @@
+"""Worker & WorkerManager abstractions + in-process LocalWorker.
+
+Reference: the ``Worker``/``WorkerManager`` traits
+(src/daft-distributed/src/scheduling/worker.rs:13-77, incl. try_autoscale +
+retire_idle_workers) and the in-process ``LocalSwordfishWorker`` used to test
+the whole scheduler/dispatcher/plan lifecycle without a cluster
+(src/daft-distributed/src/scheduling/local_worker.rs) — the same pattern here:
+LocalWorker runs the real streaming Executor on a thread pool, so distributed
+tests exercise real execution in CI.
+"""
+
+from __future__ import annotations
+
+import threading
+import uuid
+from concurrent.futures import Future, ThreadPoolExecutor
+from typing import Callable, Dict, List, Optional, Sequence
+
+from daft_tpu.distributed.partition_ref import LocalPartitionRef, PartitionRef
+from daft_tpu.distributed.task import BoundInput, Task
+from daft_tpu.errors import DaftExecutionError
+from daft_tpu.micropartition import MicroPartition
+from daft_tpu.physical import plan as pp
+
+
+class WorkerDiedError(DaftExecutionError):
+    """Task failed because its worker died (reference: TaskStatus::WorkerDied)."""
+
+
+class Worker:
+    worker_id: str
+    num_slots: int
+
+    def submit(self, task: Task) -> "Future[List[PartitionRef]]":
+        raise NotImplementedError
+
+    def active_tasks(self) -> int:
+        raise NotImplementedError
+
+    def shutdown(self) -> None:
+        pass
+
+
+def bind_task_fragment(fragment: pp.PhysicalPlan, inputs: Sequence[Sequence[PartitionRef]]) -> pp.PhysicalPlan:
+    """Replace BoundInput leaves with InMemorySource over fetched partitions."""
+
+    def rebuild(node: pp.PhysicalPlan) -> pp.PhysicalPlan:
+        if isinstance(node, BoundInput):
+            parts = [r.fetch() for r in inputs[node.slot]]
+            parts = [p for p in parts if len(p)] or [MicroPartition.empty(node.schema)]
+            return pp.InMemorySource(parts, node.schema)
+        new_children = [rebuild(c) for c in node.children]
+        if any(a is not b for a, b in zip(new_children, node.children)):
+            import copy
+
+            clone = copy.copy(node)
+            clone.children = new_children
+            return clone
+        return node
+
+    return rebuild(fragment)
+
+
+class LocalWorker(Worker):
+    """In-process worker executing tasks on the real local Executor."""
+
+    def __init__(self, worker_id: Optional[str] = None, num_slots: int = 4, cfg=None):
+        from daft_tpu.context import get_context
+
+        self.worker_id = worker_id or f"local-{uuid.uuid4().hex[:8]}"
+        self.num_slots = num_slots
+        self.cfg = cfg or get_context().execution_config
+        self._pool = ThreadPoolExecutor(max_workers=num_slots,
+                                        thread_name_prefix=f"worker-{self.worker_id}")
+        self._active = 0
+        self._lock = threading.Lock()
+        self._dead = False
+
+    def kill(self) -> None:
+        """Simulate worker death (fault-injection hook for tests)."""
+        self._dead = True
+
+    def submit(self, task: Task) -> "Future[List[PartitionRef]]":
+        with self._lock:
+            self._active += 1
+
+        def run() -> List[PartitionRef]:
+            try:
+                if self._dead:
+                    raise WorkerDiedError(f"worker {self.worker_id} is dead")
+                from daft_tpu.execution.executor import Executor
+
+                bound = bind_task_fragment(task.fragment, task.inputs)
+                executor = Executor(self.cfg, partition_offset=task.partition_idx)
+                out = list(executor.run(bound))
+                if task.expect_outputs > 1:
+                    # Shuffle map task: one ref per output bucket, order kept.
+                    if len(out) != task.expect_outputs:
+                        raise DaftExecutionError(
+                            f"expected {task.expect_outputs} outputs, got {len(out)}"
+                        )
+                    return [LocalPartitionRef(p, self.worker_id) for p in out]
+                mp = MicroPartition.concat(out) if out else MicroPartition.empty(task.fragment.schema)
+                return [LocalPartitionRef(mp, self.worker_id)]
+            finally:
+                with self._lock:
+                    self._active -= 1
+
+        return self._pool.submit(run)
+
+    def active_tasks(self) -> int:
+        return self._active
+
+    def shutdown(self) -> None:
+        self._pool.shutdown(wait=False, cancel_futures=True)
+
+
+class WorkerManager:
+    """Tracks live workers; supports scale-up/down and death marking
+    (reference: worker.rs WorkerManager trait + try_autoscale/retire_idle)."""
+
+    def __init__(self, workers: Optional[List[Worker]] = None,
+                 factory: Optional[Callable[[], Worker]] = None):
+        self._workers: Dict[str, Worker] = {w.worker_id: w for w in (workers or [])}
+        self._factory = factory
+        self._dead: set = set()
+        self._lock = threading.Lock()
+
+    def workers(self) -> List[Worker]:
+        with self._lock:
+            return [w for wid, w in self._workers.items() if wid not in self._dead]
+
+    def get(self, worker_id: str) -> Optional[Worker]:
+        with self._lock:
+            if worker_id in self._dead:
+                return None
+            return self._workers.get(worker_id)
+
+    def mark_dead(self, worker_id: str) -> None:
+        with self._lock:
+            self._dead.add(worker_id)
+
+    def total_slots(self) -> int:
+        return sum(w.num_slots for w in self.workers())
+
+    def try_autoscale(self, demand: int) -> None:
+        """Scale up when pending demand exceeds capacity (reference:
+        default scheduler requests scale-up at demand > 1.25x capacity)."""
+        if self._factory is None:
+            return
+        while self.total_slots() < demand:
+            w = self._factory()
+            with self._lock:
+                self._workers[w.worker_id] = w
+
+    def shutdown(self) -> None:
+        for w in self.workers():
+            w.shutdown()

@@ -165,6 +165,23 @@ class AggState:
         self._merge()
         return list(self._buffers)
 
+    def accumulate_partial(self, rb: RecordBatch) -> None:
+        """Ingest an already-partial batch (distributed merge stage)."""
+        if len(rb) == 0:
+            return
+        self._buffers.append(rb)
+        self._buffer_rows += len(rb)
+        if self._buffer_rows > self.MERGE_THRESHOLD_ROWS:
+            self._merge()
+
+    def partial_schema(self, input_schema):
+        """Schema of the partial-state batches."""
+        from daft_tpu.schema import Schema
+
+        key_fields = [g.to_field(input_schema) for g in self.plan.group_by]
+        partial_fields = [e.to_field(input_schema) for e in self.plan.partial_exprs]
+        return Schema(key_fields + partial_fields)
+
     def finalize(self) -> RecordBatch:
         from daft_tpu.expressions.evaluator import evaluate
 

@@ -304,6 +304,33 @@ class Executor:
             state.accumulate(mp)
         yield MicroPartition(node.schema, [state.finalize()])
 
+    def _run_AggregatePartial(self, node: pp.AggregatePartial) -> Iterator[MicroPartition]:
+        state: AggState = node.two_phase() if callable(node.two_phase) else node.two_phase
+        for mp in self._run(node.children[0]):
+            state.accumulate(mp)
+        batches = state.partial_batches()
+        yield MicroPartition(node.schema, batches or [RecordBatch.empty(node.schema)])
+
+    def _run_AggregateFinal(self, node: pp.AggregateFinal) -> Iterator[MicroPartition]:
+        state: AggState = node.two_phase() if callable(node.two_phase) else node.two_phase
+        for mp in self._run(node.children[0]):
+            for rb in mp.record_batches():
+                state.accumulate_partial(rb)
+        yield MicroPartition(node.schema, [state.finalize()])
+
+    def _run_SortSample(self, node: pp.SortSample) -> Iterator[MicroPartition]:
+        combined = self._collect(node.children[0]).combined()
+        keys = [evaluate(e, combined).rename(f"__sk_{i}") for i, e in enumerate(node.sort_by)]
+        keys_rb = RecordBatch(node.schema, keys, len(combined)) if keys else RecordBatch.empty(node.schema)
+        sorted_rb = keys_rb.sort(list(keys_rb.columns()), node.descending, node.nulls_first)
+        n = len(sorted_rb)
+        if n == 0:
+            yield MicroPartition(node.schema, [])
+            return
+        take = min(node.num, n)
+        idx = (np.arange(take) * n // take).clip(0, n - 1)
+        yield MicroPartition(node.schema, [sorted_rb.take(idx.astype(np.uint64))])
+
     def _run_Pivot(self, node: pp.Pivot) -> Iterator[MicroPartition]:
         from daft_tpu.expressions.expr import AggOp, Alias
 
@@ -397,6 +424,15 @@ class Executor:
             _, exprs, n = scheme
             for part in combined.partition_by_hash(exprs, n):
                 yield part
+        elif kind == "range_bound":
+            # Range partition against precomputed boundary rows (distributed
+            # sort stage 2).
+            _, exprs, descending, nulls_first, boundaries = scheme
+            rb = combined.combined()
+            keys = [evaluate(e, rb) for e in exprs]
+            for part in rb.partition_by_range(keys, boundaries, list(descending),
+                                              list(nulls_first)):
+                yield MicroPartition(node.schema, [part])
         elif kind == "random":
             _, n = scheme
             for part in combined.partition_by_random(n, seed=42):

@@ -82,6 +82,8 @@ def hash_bytes_batch(data: np.ndarray, starts: np.ndarray, lengths: np.ndarray) 
 
 def _hash_fixed_width(vals: np.ndarray) -> np.ndarray:
     """Hash fixed-width values bitwise; vals is (n,) or (n, k) numeric."""
+    if len(vals) == 0:
+        return np.empty(0, dtype=np.uint64)
     if vals.ndim == 1:
         vals = vals.reshape(len(vals), 1)
     raw = np.ascontiguousarray(vals).view(np.uint8).reshape(len(vals), -1)

@@ -68,27 +68,36 @@ def all_kernels() -> Dict[str, Kernel]:
     return dict(_REGISTRY)
 
 
+import threading
+
 _loaded = False
+_load_lock = threading.Lock()
 
 
 def _ensure_loaded() -> None:
     global _loaded
     if _loaded:
         return
-    _loaded = True
-    # Import for side effect of registration.
-    from daft_tpu.kernels import (  # noqa: F401
-        binary_ops,
-        embedding_ops,
-        float_ops,
-        image_ops,
-        list_ops,
-        misc_ops,
-        numeric,
-        string_ops,
-        struct_map_ops,
-        temporal_ops,
-    )
+    with _load_lock:
+        if _loaded:
+            return
+        # Import for side effect of registration. _loaded flips only AFTER
+        # the imports complete — worker threads must never observe a
+        # half-populated registry.
+        from daft_tpu.kernels import (  # noqa: F401
+            binary_ops,
+            embedding_ops,
+            float_ops,
+            image_ops,
+            list_ops,
+            misc_ops,
+            numeric,
+            string_ops,
+            struct_map_ops,
+            temporal_ops,
+        )
+
+        _loaded = True
 
 
 # -- shared resolvers ------------------------------------------------------

@@ -59,7 +59,16 @@ class LogicalPlanBuilder:
             r = e.transform(hoist)
             rewritten.append(Alias(r, e.name()) if r is not e and r.name() != e.name() else r)
         if window_aliases:
-            windowed = lp.Window(self._plan, window_aliases)
+            # One Window node per distinct partition_by spec: keeps each node
+            # shuffle-able by a single key set in the distributed planner.
+            groups: dict = {}
+            for alias in window_aliases:
+                w = alias.child
+                key = tuple(pb.key() for pb in w.partition_by)
+                groups.setdefault(key, []).append(alias)
+            windowed = self._plan
+            for group in groups.values():
+                windowed = lp.Window(windowed, group)
             return LogicalPlanBuilder(lp.Project(windowed, rewritten))
         return LogicalPlanBuilder(lp.Project(self._plan, exprs))
 

@@ -151,6 +151,38 @@ class Aggregate(PhysicalPlan):
         return f"Aggregate[{len(self.agg_exprs)} aggs, {len(self.group_by)} keys]"
 
 
+class AggregatePartial(PhysicalPlan):
+    """Per-partition partial aggregation emitting partial-state columns
+    (distributed stage 1; reference: partial agg in grouped_aggregate sink +
+    flotilla's partial/final agg pipeline nodes)."""
+
+    def __init__(self, child: PhysicalPlan, two_phase, schema: Schema):
+        super().__init__([child], schema)
+        self.two_phase = two_phase
+
+
+class AggregateFinal(PhysicalPlan):
+    """Merge + finalize partial aggregation states (distributed stage 2)."""
+
+    def __init__(self, child: PhysicalPlan, two_phase, schema: Schema, input_schema: Schema):
+        super().__init__([child], schema)
+        self.two_phase = two_phase
+        self.input_schema = input_schema
+
+
+class SortSample(PhysicalPlan):
+    """Evenly-spaced sample of sort-key rows, used to derive range-partition
+    boundaries for distributed sort (reference: sort sampling in flotilla)."""
+
+    def __init__(self, child: PhysicalPlan, sort_by, descending, num: int, schema: Schema,
+                 nulls_first=None):
+        super().__init__([child], schema)
+        self.sort_by = sort_by
+        self.descending = descending
+        self.nulls_first = nulls_first
+        self.num = num
+
+
 class Pivot(PhysicalPlan):
     def __init__(self, child: PhysicalPlan, group_by, pivot_col, value_col, agg_fn, names, schema: Schema):
         super().__init__([child], schema)

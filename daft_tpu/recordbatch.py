@@ -228,12 +228,13 @@ class RecordBatch:
              nulls_first: Optional[Sequence[bool]] = None) -> "RecordBatch":
         return self.take(self.argsort(sort_keys, descending, nulls_first))
 
-    def quantiles(self, num: int, sort_keys: Sequence[Series], descending: Sequence[bool]) -> "RecordBatch":
+    def quantiles(self, num: int, sort_keys: Sequence[Series], descending: Sequence[bool],
+                  nulls_first: Optional[Sequence[bool]] = None) -> "RecordBatch":
         """num-1 boundary rows used for range partitioning (reference:
         src/daft-recordbatch quantiles for sort)."""
         sorted_batch = RecordBatch(
             Schema([Field(k.name, k.dtype) for k in sort_keys]), list(sort_keys)
-        ).sort(sort_keys, list(descending))
+        ).sort(sort_keys, list(descending), nulls_first)
         if len(sorted_batch) == 0 or num <= 1:
             return sorted_batch.head(0)
         idx = (np.arange(1, num) * len(sorted_batch) // num).clip(0, len(sorted_batch) - 1)
@@ -267,14 +268,17 @@ class RecordBatch:
         return self._split_by_ids(part_ids, num_partitions)
 
     def partition_by_range(self, key_series: Sequence[Series], boundaries: "RecordBatch",
-                           descending: Sequence[bool]) -> List["RecordBatch"]:
+                           descending: Sequence[bool],
+                           nulls_first: Optional[Sequence[bool]] = None) -> List["RecordBatch"]:
         num_partitions = len(boundaries) + 1
         if self._num_rows == 0:
             return [self.head(0) for _ in range(num_partitions)]
+        if nulls_first is None:
+            nulls_first = list(descending)
         # Compare each row against boundary rows lexicographically.
         part_ids = np.zeros(self._num_rows, dtype=np.int64)
         for b in range(len(boundaries)):
-            ge = _row_ge(key_series, boundaries, b, descending)
+            ge = _row_ge(key_series, boundaries, b, descending, nulls_first)
             part_ids += ge.astype(np.int64)
         return self._split_by_ids(part_ids, num_partitions)
 
@@ -469,16 +473,20 @@ class RecordBatch:
 
 
 def _row_ge(key_series: Sequence[Series], boundaries: "RecordBatch", b: int,
-            descending: Sequence[bool]) -> np.ndarray:
+            descending: Sequence[bool],
+            nulls_first: Optional[Sequence[bool]] = None) -> np.ndarray:
     """Lexicographic per-row test: does each row sort at-or-after boundary b?
 
-    Used by range partitioning; honours per-key descending flags. Nulls sort
-    last (ascending) / first (descending), matching sort defaults.
+    Used by range partitioning; honours per-key descending and nulls_first
+    flags (defaults match sort defaults: nulls last ascending / first
+    descending).
     """
     n = len(key_series[0]) if key_series else 0
+    if nulls_first is None:
+        nulls_first = list(descending)
     result = np.zeros(n, dtype=bool)      # rows strictly decided >= boundary
     undecided = np.ones(n, dtype=bool)    # rows equal on all keys so far
-    for i, (key, desc) in enumerate(zip(key_series, descending)):
+    for i, (key, desc, nf) in enumerate(zip(key_series, descending, nulls_first)):
         bound_col = boundaries.columns()[i]
         bound_val = bound_col.slice(b, 1)
         rep = Series.concat([bound_val] * n) if n else bound_val.head(0)
@@ -493,11 +501,11 @@ def _row_ge(key_series: Sequence[Series], boundaries: "RecordBatch", b: int,
             if both_valid.any():
                 gt[both_valid] = (kv[both_valid] < bv[both_valid]) if desc else (kv[both_valid] > bv[both_valid])
                 eq[both_valid] = kv[both_valid] == bv[both_valid]
-            if desc:
-                # Descending: nulls sort first -> a valid key is after a null bound.
+            if nf:
+                # Nulls sort first -> any valid key is after a null bound.
                 gt |= (~k_null) & b_null
             else:
-                # Ascending: nulls sort last -> a null key is after a valid bound.
+                # Nulls sort last -> a null key is after any valid bound.
                 gt |= k_null & (~b_null)
             eq |= k_null & b_null
         result |= undecided & gt

@@ -1,0 +1,61 @@
+"""DistributedRunner: partition-parallel execution over a worker pool.
+
+Reference: daft/runners/flotilla.py (FlotillaRunner / RaySwordfishActor).
+The control plane here is the in-process scheduler + LocalWorkers (the
+reference's LocalSwordfishWorker CI pattern); remote gRPC/Flight workers plug
+in behind the same Worker interface.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+import uuid
+from typing import Iterator, List, Optional
+
+from daft_tpu.context import get_context
+from daft_tpu.distributed.planner import DistributedExecutor
+from daft_tpu.distributed.worker import LocalWorker, WorkerManager
+from daft_tpu.micropartition import MicroPartition
+from daft_tpu.physical.translate import translate
+from daft_tpu.runners.runner import Runner
+from daft_tpu.subscribers.events import QueryEnd, QueryStart
+
+
+class DistributedRunner(Runner):
+    name = "distributed"
+
+    def __init__(self, num_workers: Optional[int] = None, slots_per_worker: int = 2,
+                 manager: Optional[WorkerManager] = None):
+        cfg = get_context().execution_config
+        if manager is not None:
+            self.manager = manager
+        else:
+            n = num_workers or cfg.num_workers or int(os.environ.get("DAFT_NUM_WORKERS", "2"))
+            workers = [LocalWorker(f"worker-{i}", num_slots=slots_per_worker) for i in range(n)]
+            self.manager = WorkerManager(
+                workers, factory=lambda: LocalWorker(num_slots=slots_per_worker)
+            )
+
+    def run_iter(self, builder) -> Iterator[MicroPartition]:
+        ctx = get_context()
+        cfg = ctx.execution_config
+        query_id = uuid.uuid4().hex[:16]
+        optimized = builder.optimize(cfg)
+        physical = translate(optimized.plan, cfg)
+        ctx.notify(QueryStart(query_id=query_id, plan=repr(optimized.plan)))
+        start = time.perf_counter()
+        error = None
+        try:
+            executor = DistributedExecutor(self.manager, cfg)
+            refs = executor.execute(physical)
+            for ref in refs:
+                mp = ref.fetch()
+                if len(mp):
+                    yield mp
+        except BaseException as e:  # noqa: BLE001
+            error = str(e)
+            raise
+        finally:
+            ctx.notify(QueryEnd(query_id=query_id,
+                                duration_s=time.perf_counter() - start, error=error))

@@ -1,0 +1,56 @@
+"""Shuffle cache + Flight server/client tests (cross-host data plane)."""
+
+import numpy as np
+import pytest
+
+import daft_tpu
+from daft_tpu.distributed.flight import fetch_partition, start_shuffle_server
+from daft_tpu.distributed.partition_ref import (
+    FlightPartitionRef,
+    deserialize_partition,
+    serialize_partition,
+)
+from daft_tpu.distributed.shuffle import ShuffleCache
+from daft_tpu.micropartition import MicroPartition
+
+
+@pytest.fixture
+def mp():
+    return MicroPartition.from_pydict({
+        "a": list(range(1000)),
+        "b": [f"val-{i}" for i in range(1000)],
+    })
+
+
+def test_ipc_roundtrip(mp):
+    data = serialize_partition(mp)
+    back = deserialize_partition(data)
+    assert back.to_pydict() == mp.to_pydict()
+
+
+def test_shuffle_cache_spill_and_read(mp, tmp_path):
+    cache = ShuffleCache([str(tmp_path)])
+    t1 = cache.write_partition("shuf1", 0, mp)
+    t2 = cache.write_partition("shuf1", 1, mp)
+    # Appending a second chunk to the same bucket merges on read.
+    cache.write_partition("shuf1", 0, mp)
+    out = cache.read_partition(t1)
+    assert len(out) == 2000
+    assert cache.partition_meta(t2).rows == 1000
+    cache.cleanup()
+
+
+def test_flight_server_fetch(mp, tmp_path):
+    cache = ShuffleCache([str(tmp_path)])
+    ticket = cache.write_partition("s", 3, mp)
+    server = start_shuffle_server(cache)
+    try:
+        out = fetch_partition(server.address, ticket)
+        assert out.to_pydict() == mp.to_pydict()
+        ref = FlightPartitionRef(server.address, ticket, 1000, mp.size_bytes())
+        assert ref.fetch().to_pydict() == mp.to_pydict()
+        with pytest.raises(Exception):
+            fetch_partition(server.address, "missing/ticket")
+    finally:
+        server.shutdown()
+        cache.cleanup()
