@@ -70,10 +70,18 @@ def __getattr__(name: str):
         from daft_tpu.io import reads
 
         return getattr(reads, name)
-    if name == "sql":
-        from daft_tpu.sql.sql import sql
+    if name == "Session":
+        from daft_tpu.session import Session
 
-        return sql
+        return Session
+    if name == "current_session":
+        from daft_tpu.session import current_session
+
+        return current_session
+    if name == "Catalog":
+        from daft_tpu.catalog import Catalog
+
+        return Catalog
     if name in ("func", "cls", "method", "udf"):
         import daft_tpu.udf as udf_mod
 
@@ -89,3 +97,9 @@ def __getattr__(name: str):
 
         return Window
     raise AttributeError(f"module 'daft_tpu' has no attribute {name!r}")
+
+
+# Rebind `daft_tpu.sql` from the subpackage module to the sql() function
+# (the subpackage import above sets the module attribute first; this eager
+# from-import shadows it — same pattern as the reference's daft/__init__.py).
+from daft_tpu.sql.sql import sql, sql_expr  # noqa: E402

@@ -1,0 +1,61 @@
+"""CLI entrypoint: ``python -m daft_tpu <command>``.
+
+Reference: src/daft-cli (clap `daft` binary — dashboard launch, version).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="daft_tpu", description="daft_tpu CLI")
+    sub = parser.add_subparsers(dest="command")
+
+    dash = sub.add_parser("dashboard", help="launch the engine dashboard")
+    dash.add_argument("--port", type=int, default=8238)
+
+    sub.add_parser("version", help="print version")
+
+    q = sub.add_parser("sql", help="run a SQL query against parquet/csv tables")
+    q.add_argument("query")
+    q.add_argument("--table", action="append", default=[],
+                   help="name=path table binding (parquet dir/file)")
+
+    args = parser.parse_args(argv)
+    if args.command == "version":
+        import daft_tpu
+
+        print(daft_tpu.__version__)
+        return 0
+    if args.command == "dashboard":
+        from daft_tpu.subscribers.dashboard import launch
+
+        server = launch(port=args.port)
+        print(f"dashboard running at {server.url} (ctrl-c to stop)")
+        try:
+            import time
+
+            while True:
+                time.sleep(3600)
+        except KeyboardInterrupt:
+            server.shutdown()
+        return 0
+    if args.command == "sql":
+        import daft_tpu
+
+        bindings = {}
+        for spec in args.table:
+            name, path = spec.split("=", 1)
+            bindings[name] = daft_tpu.read_parquet(path) if not path.endswith(".csv") \
+                else daft_tpu.read_csv(path)
+        df = daft_tpu.sql(args.query, **bindings)
+        print(df._materialize_preview(20))
+        return 0
+    parser.print_help()
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

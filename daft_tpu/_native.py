@@ -1,0 +1,150 @@
+"""Loader for the native C++ kernel library (native/daft_native.cpp).
+
+Builds the shared library on first use when a compiler is available (the
+image bakes g++); falls back silently to the numpy kernels otherwise.
+Disable with DAFT_NATIVE=0. Hash outputs are bit-identical across the native
+and numpy paths (cross-host hash-partitioning requirement).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+import numpy as np
+
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+_lock = threading.Lock()
+
+_SRC = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                    "native", "daft_native.cpp")
+_SO = os.path.join(os.path.dirname(os.path.abspath(__file__)), "_daft_native.so")
+
+
+def _build() -> bool:
+    try:
+        subprocess.run(
+            ["g++", "-O3", "-march=native", "-shared", "-fPIC", "-std=c++17",
+             _SRC, "-o", _SO],
+            check=True, capture_output=True, timeout=120,
+        )
+        return True
+    except Exception:
+        try:
+            # Portable fallback without -march=native.
+            subprocess.run(
+                ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", _SRC, "-o", _SO],
+                check=True, capture_output=True, timeout=120,
+            )
+            return True
+        except Exception:
+            return False
+
+
+def get_lib() -> Optional[ctypes.CDLL]:
+    global _lib, _tried
+    if _lib is not None or _tried:
+        return _lib
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if os.environ.get("DAFT_NATIVE", "1") in ("0", "false"):
+            return None
+        if not os.path.exists(_SO) or (
+            os.path.exists(_SRC) and os.path.getmtime(_SRC) > os.path.getmtime(_SO)
+        ):
+            if not os.path.exists(_SRC) or not _build():
+                return None
+        try:
+            lib = ctypes.CDLL(_SO)
+            if lib.daft_native_abi_version() != 1:
+                return None
+            u8p = ctypes.POINTER(ctypes.c_uint8)
+            i64p = ctypes.POINTER(ctypes.c_int64)
+            u64p = ctypes.POINTER(ctypes.c_uint64)
+            u32p = ctypes.POINTER(ctypes.c_uint32)
+            lib.hash_bytes_batch.argtypes = [u8p, i64p, i64p, ctypes.c_int64, u64p]
+            lib.hash_fixed_width.argtypes = [u8p, ctypes.c_int64, ctypes.c_int64, u64p]
+            lib.combine_hashes.argtypes = [u64p, u64p, ctypes.c_int64, u64p]
+            lib.minhash_rows.argtypes = [u64p, i64p, ctypes.c_int64, u64p, u64p,
+                                         ctypes.c_int64, u32p]
+            lib.hll_build.argtypes = [u64p, ctypes.c_int64, ctypes.c_int32, u8p]
+            _lib = lib
+        except Exception:
+            _lib = None
+        return _lib
+
+
+def _ptr(arr: np.ndarray, ctype):
+    return arr.ctypes.data_as(ctypes.POINTER(ctype))
+
+
+def native_hash_bytes(data: np.ndarray, starts: np.ndarray, lengths: np.ndarray) -> Optional[np.ndarray]:
+    lib = get_lib()
+    if lib is None:
+        return None
+    n = len(starts)
+    out = np.empty(n, dtype=np.uint64)
+    data = np.ascontiguousarray(data, dtype=np.uint8)
+    starts = np.ascontiguousarray(starts, dtype=np.int64)
+    lengths = np.ascontiguousarray(lengths, dtype=np.int64)
+    lib.hash_bytes_batch(_ptr(data, ctypes.c_uint8), _ptr(starts, ctypes.c_int64),
+                         _ptr(lengths, ctypes.c_int64), n, _ptr(out, ctypes.c_uint64))
+    return out
+
+
+def native_hash_fixed(raw: np.ndarray) -> Optional[np.ndarray]:
+    """raw: (n, width) uint8 contiguous."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    raw = np.ascontiguousarray(raw, dtype=np.uint8)
+    n, width = raw.shape
+    out = np.empty(n, dtype=np.uint64)
+    lib.hash_fixed_width(_ptr(raw, ctypes.c_uint8), n, width, _ptr(out, ctypes.c_uint64))
+    return out
+
+
+def native_combine(a: np.ndarray, b: np.ndarray) -> Optional[np.ndarray]:
+    lib = get_lib()
+    if lib is None:
+        return None
+    a = np.ascontiguousarray(a, dtype=np.uint64)
+    b = np.ascontiguousarray(b, dtype=np.uint64)
+    out = np.empty(len(a), dtype=np.uint64)
+    lib.combine_hashes(_ptr(a, ctypes.c_uint64), _ptr(b, ctypes.c_uint64),
+                       len(a), _ptr(out, ctypes.c_uint64))
+    return out
+
+
+def native_minhash(token_hashes: np.ndarray, row_offsets: np.ndarray,
+                   a: np.ndarray, b: np.ndarray, num_hashes: int) -> Optional[np.ndarray]:
+    lib = get_lib()
+    if lib is None:
+        return None
+    token_hashes = np.ascontiguousarray(token_hashes, dtype=np.uint64)
+    row_offsets = np.ascontiguousarray(row_offsets, dtype=np.int64)
+    a = np.ascontiguousarray(a, dtype=np.uint64)
+    b = np.ascontiguousarray(b, dtype=np.uint64)
+    n_rows = len(row_offsets) - 1
+    out = np.zeros((n_rows, num_hashes), dtype=np.uint32)
+    lib.minhash_rows(_ptr(token_hashes, ctypes.c_uint64), _ptr(row_offsets, ctypes.c_int64),
+                     n_rows, _ptr(a, ctypes.c_uint64), _ptr(b, ctypes.c_uint64),
+                     num_hashes, _ptr(out, ctypes.c_uint32))
+    return out
+
+
+def native_hll(hashes: np.ndarray, precision: int) -> Optional[np.ndarray]:
+    lib = get_lib()
+    if lib is None:
+        return None
+    hashes = np.ascontiguousarray(hashes, dtype=np.uint64)
+    registers = np.zeros(1 << precision, dtype=np.uint8)
+    lib.hll_build(_ptr(hashes, ctypes.c_uint64), len(hashes), precision,
+                  _ptr(registers, ctypes.c_uint8))
+    return registers

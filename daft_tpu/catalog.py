@@ -1,0 +1,158 @@
+"""Catalog / Table abstractions + in-memory implementation.
+
+Reference: src/daft-catalog (Catalog/Table/Identifier traits + in-memory
+impl, catalog.rs) and daft/catalog/__init__.py ABCs.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from daft_tpu.errors import DaftValueError
+from daft_tpu.schema import Schema
+
+
+class Table:
+    """A named table: readable as a DataFrame, optionally writable."""
+
+    name: str
+
+    def read(self):
+        raise NotImplementedError
+
+    def schema(self) -> Schema:
+        return self.read().schema
+
+    def append(self, df) -> None:
+        raise DaftValueError(f"Table {self.name!r} is read-only")
+
+    def overwrite(self, df) -> None:
+        raise DaftValueError(f"Table {self.name!r} is read-only")
+
+
+class ViewTable(Table):
+    """A table backed by a DataFrame (temp view)."""
+
+    def __init__(self, name: str, df):
+        self.name = name
+        self._df = df
+
+    def read(self):
+        return self._df
+
+
+class MemoryTable(Table):
+    """A mutable in-memory table."""
+
+    def __init__(self, name: str, df=None, schema: Optional[Schema] = None):
+        self.name = name
+        self._parts = []
+        self._schema = schema
+        if df is not None:
+            self.append(df)
+
+    def read(self):
+        import daft_tpu
+        from daft_tpu.dataframe.dataframe import DataFrame
+        from daft_tpu.logical.builder import LogicalPlanBuilder
+        from daft_tpu.micropartition import MicroPartition
+
+        if not self._parts:
+            if self._schema is None:
+                raise DaftValueError(f"Table {self.name!r} is empty with no schema")
+            return DataFrame(LogicalPlanBuilder.in_memory(
+                [MicroPartition.empty(self._schema)], self._schema))
+        return DataFrame(LogicalPlanBuilder.in_memory(self._parts, self._parts[0].schema))
+
+    def append(self, df) -> None:
+        parts = list(df.iter_partitions())
+        if parts:
+            if self._schema is None:
+                self._schema = parts[0].schema
+            self._parts.extend(parts)
+
+    def overwrite(self, df) -> None:
+        self._parts = []
+        self.append(df)
+
+
+class ParquetTable(Table):
+    """A table backed by parquet files at a path."""
+
+    def __init__(self, name: str, path: str):
+        self.name = name
+        self.path = path
+
+    def read(self):
+        import daft_tpu
+
+        return daft_tpu.read_parquet(self.path)
+
+    def append(self, df) -> None:
+        df.write_parquet(self.path)
+
+    def overwrite(self, df) -> None:
+        df.write_parquet(self.path, write_mode="overwrite")
+
+
+class Catalog:
+    """Catalog ABC (reference: daft/catalog Catalog)."""
+
+    name: str = "catalog"
+
+    def list_tables(self, pattern: Optional[str] = None) -> List[str]:
+        raise NotImplementedError
+
+    def get_table(self, name: str) -> Table:
+        raise NotImplementedError
+
+    def create_table(self, name: str, source=None) -> Table:
+        raise NotImplementedError
+
+    def drop_table(self, name: str) -> None:
+        raise NotImplementedError
+
+    def has_table(self, name: str) -> bool:
+        try:
+            self.get_table(name)
+            return True
+        except Exception:
+            return False
+
+
+class InMemoryCatalog(Catalog):
+    def __init__(self, name: str = "default"):
+        self.name = name
+        self._tables: Dict[str, Table] = {}
+
+    def list_tables(self, pattern: Optional[str] = None) -> List[str]:
+        names = sorted(self._tables)
+        if pattern:
+            import fnmatch
+
+            names = [n for n in names if fnmatch.fnmatch(n, pattern)]
+        return names
+
+    def get_table(self, name: str) -> Table:
+        if name not in self._tables:
+            raise DaftValueError(f"Table {name!r} not found in catalog {self.name!r}")
+        return self._tables[name]
+
+    def create_table(self, name: str, source=None) -> Table:
+        from daft_tpu.dataframe.dataframe import DataFrame
+
+        if isinstance(source, Table):
+            t: Table = source
+        elif isinstance(source, DataFrame):
+            t = MemoryTable(name, source)
+        elif isinstance(source, Schema):
+            t = MemoryTable(name, schema=source)
+        elif source is None:
+            t = MemoryTable(name)
+        else:
+            raise DaftValueError(f"Cannot create table from {type(source)}")
+        self._tables[name] = t
+        return t
+
+    def drop_table(self, name: str) -> None:
+        self._tables.pop(name, None)

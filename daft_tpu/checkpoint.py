@@ -1,0 +1,103 @@
+"""Pipeline-progress checkpointing.
+
+Reference: src/daft-checkpoint + daft/checkpoint.py — records processed source
+keys so re-running a pipeline skips work already done (NOT model
+checkpointing). The reference splits the source into done/undone via a
+key-filtering join (optimization/rules/rewrite_checkpoint_source.rs); here the
+same semantics: ``df.with_checkpoint(cfg)`` anti-filters done keys, and a
+write with ``checkpoint=cfg`` seals the processed keys at pipeline end
+(the reference's CheckpointTerminus).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import uuid
+from dataclasses import dataclass
+from typing import List, Optional, Set
+
+import pyarrow as pa
+import pyarrow.parquet as pq
+
+from daft_tpu.errors import DaftValueError
+
+
+class CheckpointStore:
+    """Stores processed keys under a directory (local or pyarrow-fs URI) as
+    parquet key files (reference: src/daft-checkpoint/src/{store.rs,impls})."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._lock = threading.Lock()
+
+    def _fs(self):
+        from daft_tpu.io.scan import resolve_filesystem
+
+        return resolve_filesystem(self.path)
+
+    def load_keys(self) -> Set:
+        import pyarrow.fs as pafs
+
+        fs, p = self._fs()
+        info = fs.get_file_info(p)
+        if info.type == pafs.FileType.NotFound:
+            return set()
+        out: Set = set()
+        sel = pafs.FileSelector(p, recursive=True)
+        for f in fs.get_file_info(sel):
+            if f.type == pafs.FileType.File and f.path.endswith(".parquet"):
+                table = pq.read_table(fs.open_input_file(f.path))
+                out.update(table.column("key").to_pylist())
+        return out
+
+    def append_keys(self, keys: List) -> None:
+        if not keys:
+            return
+        fs, p = self._fs()
+        fs.create_dir(p, recursive=True)
+        table = pa.table({"key": keys})
+        with self._lock:
+            path = f"{p}/keys-{uuid.uuid4().hex[:12]}.parquet"
+            pq.write_table(table, fs.open_output_stream(path))
+
+    def clear(self) -> None:
+        import pyarrow.fs as pafs
+
+        fs, p = self._fs()
+        info = fs.get_file_info(p)
+        if info.type != pafs.FileType.NotFound:
+            fs.delete_dir_contents(p)
+
+
+@dataclass
+class CheckpointConfig:
+    store: CheckpointStore
+    on: str  # key column name
+
+    def filter_done(self, df):
+        """Anti-filter rows whose key was already processed."""
+        from daft_tpu.expressions.expression import col
+
+        done = self.store.load_keys()
+        if not done:
+            return df
+        return df.where(~col(self.on).is_in(sorted(done)))
+
+    def seal(self, df) -> None:
+        """Record the keys of a fully-processed DataFrame.
+
+        NOTE: re-executes `df` if it isn't materialised; prefer
+        ``seal_partitions`` with already-materialised partitions.
+        """
+        keys = df.select(self.on).distinct().to_pydict()[self.on]
+        self.store.append_keys([k for k in keys if k is not None])
+
+    def seal_partitions(self, partitions, schema) -> None:
+        """Record keys from already-materialised partitions (no re-execution)."""
+        keys: Set = set()
+        for part in partitions:
+            col = part.combined().get_column(self.on)
+            keys.update(k for k in col.unique().to_pylist() if k is not None)
+        self.store.append_keys(sorted(keys, key=repr))

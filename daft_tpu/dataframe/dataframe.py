@@ -356,8 +356,13 @@ class DataFrame:
     # ------------------------------------------------------------------ #
     # Writes                                                              #
     # ------------------------------------------------------------------ #
+    def with_checkpoint(self, config) -> "DataFrame":
+        """Skip rows whose checkpoint key was already processed
+        (reference: CheckpointConfig attached to reads, daft/checkpoint.py)."""
+        return config.filter_done(self)
+
     def _write(self, file_format: str, root_dir: str, partition_cols=None,
-               compression=None, write_mode="append") -> "DataFrame":
+               compression=None, write_mode="append", checkpoint=None) -> "DataFrame":
         from daft_tpu.io.writers import WriteInfo
 
         info = WriteInfo(
@@ -365,12 +370,26 @@ class DataFrame:
             partition_cols=_inner(partition_cols) if partition_cols else None,
             compression=compression, write_mode=write_mode,
         )
+        if checkpoint is not None:
+            # Materialise ONCE, write the materialised data, then seal keys
+            # from the same partitions — never re-execute the pipeline (a
+            # nondeterministic stage re-run could seal keys that were never
+            # written). Reference: CheckpointTerminus seals at pipeline end.
+            src = self.collect()
+            parts = src._result or []
+            mat = DataFrame(LogicalPlanBuilder.in_memory(
+                parts or [MicroPartition.empty(self.schema)], self.schema))
+            out = mat._with(mat._builder.table_write(info)).collect()
+            checkpoint.seal_partitions(parts, self.schema)
+            return out
         out = self._with(self._builder.table_write(info))
         return out.collect()
 
     def write_parquet(self, root_dir: str, compression: str = "snappy",
-                      partition_cols=None, write_mode: str = "append") -> "DataFrame":
-        return self._write("parquet", root_dir, partition_cols, compression, write_mode)
+                      partition_cols=None, write_mode: str = "append",
+                      checkpoint=None) -> "DataFrame":
+        return self._write("parquet", root_dir, partition_cols, compression, write_mode,
+                           checkpoint)
 
     def write_csv(self, root_dir: str, partition_cols=None, write_mode: str = "append") -> "DataFrame":
         return self._write("csv", root_dir, partition_cols, None, write_mode)

@@ -67,6 +67,7 @@ class Dispatcher:
         inflight: Dict[Future, Tuple[int, Task, int, Worker]] = {}
         limit = self.max_inflight or max(self.scheduler.manager.total_slots(), 1)
         self.scheduler.request_autoscale(len(pending))
+        failure: Optional[BaseException] = None
         while pending or inflight:
             while pending and len(inflight) < limit:
                 idx, task, attempt = pending.pop(0)
@@ -83,10 +84,20 @@ class Dispatcher:
                     # dispatcher.rs:100-140 WorkerDied handling).
                     self.scheduler.manager.mark_dead(worker.worker_id)
                     if attempt + 1 >= self.MAX_TASK_RETRIES:
-                        raise DaftExecutionError(
+                        failure = DaftExecutionError(
                             f"Task {task.task_id} failed after {attempt + 1} attempts"
                         )
-                    pending.append((idx, task, attempt + 1))
+                    else:
+                        pending.append((idx, task, attempt + 1))
                 except Exception as e:  # noqa: BLE001
-                    raise DaftExecutionError(f"Task {task.task_id} failed: {e}") from e
+                    failure = DaftExecutionError(f"Task {task.task_id} failed: {e}")
+                    failure.__cause__ = e
+            if failure is not None:
+                # Abort cleanly: stop submitting, drain in-flight work so no
+                # task keeps mutating state (writes!) after the raise.
+                pending.clear()
+                if inflight:
+                    wait(list(inflight.keys()))
+                    inflight.clear()
+                raise failure
         return [results[i] for i in range(len(tasks))]

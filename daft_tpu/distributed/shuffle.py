@@ -39,7 +39,6 @@ class ShuffleCache:
         self.root = os.path.join(dirs[0], f"daft-shuffle-{uuid.uuid4().hex[:8]}")
         os.makedirs(self.root, exist_ok=True)
         self._meta: Dict[str, ShufflePartitionMeta] = {}
-        self._schemas: Dict[str, pa.Schema] = {}
         self._lock = threading.Lock()
 
     def write_partition(self, shuffle_id: str, bucket: int, mp: MicroPartition) -> str:
@@ -61,7 +60,6 @@ class ShuffleCache:
             meta.files.append(path)
             meta.rows += table.num_rows
             meta.bytes_ += table.nbytes
-            self._schemas[ticket] = table.schema
         return ticket
 
     def read_partition(self, ticket: str) -> MicroPartition:

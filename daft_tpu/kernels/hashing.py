@@ -5,8 +5,9 @@ src/daft-hash/src/lib.rs — MurmurHash3 / xxhash BuildHashers) with a
 numpy-vectorised 64-bit polynomial (FNV-flavoured) hash that is stable across
 processes and hosts — the property distributed hash-partitioning requires.
 
-A C++ drop-in with true MurmurHash3 lives in daft_tpu/_native (used when the
-compiled extension is available).
+The same algorithm is implemented in C++ (native/daft_native.cpp, loaded via
+daft_tpu/_native) and dispatched to when the library is built — outputs are
+bit-identical so mixed native/numpy clusters still agree on partitioning.
 """
 
 from __future__ import annotations
@@ -55,11 +56,17 @@ def hash_bytes_batch(data: np.ndarray, starts: np.ndarray, lengths: np.ndarray) 
     """Hash a batch of variable-length byte strings.
 
     ``data`` is the concatenated uint8 byte buffer; value i spans
-    ``data[starts[i] : starts[i] + lengths[i]]``.
+    ``data[starts[i] : starts[i] + lengths[i]]``. Dispatches to the C++
+    kernel library when built (bit-identical results).
     """
     n = len(starts)
     if n == 0:
         return np.empty(0, dtype=np.uint64)
+    from daft_tpu._native import native_hash_bytes
+
+    native = native_hash_bytes(data, starts, lengths)
+    if native is not None:
+        return native
     total = int(lengths.sum())
     if total == 0:
         return np.full(n, _finalize(np.array([_FNV_OFFSET]))[0], dtype=np.uint64)
@@ -87,6 +94,11 @@ def _hash_fixed_width(vals: np.ndarray) -> np.ndarray:
     if vals.ndim == 1:
         vals = vals.reshape(len(vals), 1)
     raw = np.ascontiguousarray(vals).view(np.uint8).reshape(len(vals), -1)
+    from daft_tpu._native import native_hash_fixed
+
+    native = native_hash_fixed(raw)
+    if native is not None:
+        return native
     width = raw.shape[1]
     with np.errstate(over="ignore"):
         acc = np.full(len(vals), _FNV_OFFSET, dtype=np.uint64)
@@ -164,8 +176,14 @@ def hash_series(s, seed=None):
 
 def combine_hashes(hashes: list) -> "np.ndarray":
     """Combine per-column row hashes into one row hash."""
+    from daft_tpu._native import get_lib, native_combine
+
     acc = hashes[0].astype(np.uint64, copy=True)
-    with np.errstate(over="ignore"):
-        for h in hashes[1:]:
-            acc = _finalize(acc * _FNV_PRIME + h.astype(np.uint64))
+    use_native = get_lib() is not None
+    for h in hashes[1:]:
+        if use_native:
+            acc = native_combine(acc, h)
+        else:
+            with np.errstate(over="ignore"):
+                acc = _finalize(acc * _FNV_PRIME + h.astype(np.uint64))
     return acc

@@ -99,6 +99,7 @@ def _minhash(args, num_hashes: int = 64, ngram_size: int = 1, seed: int = 1, **k
     hashes, vectorised with numpy. TPU note: this stays host-side — variable
     token counts per row are XLA-hostile.
     """
+    from daft_tpu._native import native_minhash
     from daft_tpu.kernels.hashing import hash_bytes_batch
 
     s = args[0]
@@ -108,8 +109,11 @@ def _minhash(args, num_hashes: int = 64, ngram_size: int = 1, seed: int = 1, **k
     MERSENNE = np.uint64((1 << 61) - 1)
     a = rng.integers(1, MERSENNE, size=num_hashes, dtype=np.uint64)
     b = rng.integers(0, MERSENNE, size=num_hashes, dtype=np.uint64)
-    out = np.zeros((len(s), num_hashes), dtype=np.uint32)
-    validity = np.ones(len(s), dtype=bool)
+    n = len(s)
+    validity = np.ones(n, dtype=bool)
+    # Build all rows' ngram tokens into one flat byte buffer, hash once.
+    all_grams: list = []
+    row_token_counts = np.zeros(n, dtype=np.int64)
     for i, text in enumerate(s.to_pylist()):
         if text is None:
             validity[i] = False
@@ -119,13 +123,26 @@ def _minhash(args, num_hashes: int = 64, ngram_size: int = 1, seed: int = 1, **k
             grams = [" ".join(words[j:j + ngram_size]) for j in range(len(words) - ngram_size + 1)]
         else:
             grams = [" ".join(words)] if words else [""]
-        data = "\x00".join(grams).encode()
-        lens = np.array([len(g.encode()) for g in grams], dtype=np.int64)
-        starts = np.concatenate([[0], np.cumsum(lens[:-1] + 1)]).astype(np.int64)
-        token_hashes = hash_bytes_batch(np.frombuffer(data, dtype=np.uint8), starts, lens)
+        row_token_counts[i] = len(grams)
+        all_grams.extend(g.encode() for g in grams)
+    if all_grams:
+        lens = np.array([len(g) for g in all_grams], dtype=np.int64)
+        starts = np.concatenate([[0], np.cumsum(lens[:-1])]).astype(np.int64)
+        data = np.frombuffer(b"".join(all_grams), dtype=np.uint8)
+        token_hashes = hash_bytes_batch(data, starts, lens)
+    else:
+        token_hashes = np.empty(0, dtype=np.uint64)
+    row_offsets = np.concatenate([[0], np.cumsum(row_token_counts)]).astype(np.int64)
+    out = native_minhash(token_hashes, row_offsets, a, b, num_hashes)
+    if out is None:
+        out = np.zeros((n, num_hashes), dtype=np.uint32)
         with np.errstate(over="ignore"):
-            hv = (token_hashes[None, :] * a[:, None] + b[:, None]) % MERSENNE
-        out[i] = hv.min(axis=1).astype(np.uint32)
+            for i in range(n):
+                th = token_hashes[row_offsets[i]:row_offsets[i + 1]]
+                if len(th) == 0:
+                    continue
+                hv = (th[None, :] * a[:, None] + b[:, None]) % MERSENNE
+                out[i] = hv.min(axis=1).astype(np.uint32)
     dt = DataType.fixed_size_list(DataType.uint32(), num_hashes)
     res = Series.from_numpy(out, s.name, dt)
     if not validity.all():

@@ -20,26 +20,33 @@ def hll_sketch(series) -> np.ndarray:
     return hll_from_hashes(hashes)
 
 
+def _bit_length_u64(x: np.ndarray) -> np.ndarray:
+    """Exact vectorised bit length of uint64 values (0 -> 0)."""
+    v = x.copy()
+    bl = np.zeros(len(x), dtype=np.uint64)
+    for s in (32, 16, 8, 4, 2, 1):
+        ge = v >= (np.uint64(1) << np.uint64(s))
+        bl[ge] += np.uint64(s)
+        v[ge] >>= np.uint64(s)
+    bl += (v > 0).astype(np.uint64)
+    return bl
+
+
 def hll_from_hashes(hashes: np.ndarray) -> np.ndarray:
+    from daft_tpu._native import native_hll
+
+    if len(hashes):
+        native = native_hll(hashes, HLL_PRECISION)
+        if native is not None:
+            return native
     registers = np.zeros(_M, dtype=np.uint8)
     if len(hashes) == 0:
         return registers
     idx = (hashes >> np.uint64(64 - HLL_PRECISION)).astype(np.int64)
     rest = hashes << np.uint64(HLL_PRECISION)
-    # rank = leading zeros of the remaining 64-p bits, +1
-    lz = np.zeros(len(hashes), dtype=np.uint8)
-    nonzero = rest != 0
-    # count leading zeros via bit_length: lz = 64 - bit_length(rest)
-    bl = np.zeros(len(hashes), dtype=np.uint64)
-    r = rest[nonzero]
-    bits = np.frexp(r.astype(np.float64))[1].astype(np.uint64)  # approx bit length
-    # frexp is imprecise at 64-bit boundaries; correct by checking
-    bits = np.minimum(bits, 64)
-    adj = (np.uint64(1) << np.minimum(bits, np.uint64(63))) <= r
-    bits = bits + adj.astype(np.uint64)
-    bl[nonzero] = bits
-    rank = np.where(nonzero, 64 - HLL_PRECISION - (bl - 1) + 1, 64 - HLL_PRECISION + 1)
-    rank = np.clip(rank, 1, 64 - HLL_PRECISION + 1).astype(np.uint8)
+    # rank = leading zeros of the top (64-p) bits of rest, + 1.
+    lz = np.uint64(64) - _bit_length_u64(rest)
+    rank = np.minimum(lz + np.uint64(1), np.uint64(64 - HLL_PRECISION + 1)).astype(np.uint8)
     np.maximum.at(registers, idx, rank)
     return registers
 

@@ -1,0 +1,39 @@
+"""Per-query heartbeat thread (reference: daft/runners/heartbeat.py:13-30 —
+notifies subscribers so a dead query is detectable)."""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+
+from daft_tpu.subscribers.events import Event
+
+
+@dataclass
+class QueryHeartbeat(Event):
+    query_id: str = ""
+    seq: int = 0
+
+
+class Heartbeat:
+    def __init__(self, query_id: str, interval_s: float = 5.0):
+        self.query_id = query_id
+        self.interval_s = interval_s
+        self._stop = threading.Event()
+        self._seq = 0
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name=f"daft-heartbeat-{query_id[:8]}")
+
+    def _loop(self) -> None:
+        from daft_tpu.context import get_context
+
+        while not self._stop.wait(self.interval_s):
+            self._seq += 1
+            get_context().notify(QueryHeartbeat(query_id=self.query_id, seq=self._seq))
+
+    def __enter__(self) -> "Heartbeat":
+        self._thread.start()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self._stop.set()

@@ -1,0 +1,105 @@
+"""Session: attached catalogs, temp tables, SQL execution.
+
+Reference: src/daft-session + daft/session.py:86-602 (Session.sql / attach /
+create_table / use, temp tables).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+from daft_tpu.catalog import Catalog, InMemoryCatalog, Table, ViewTable
+from daft_tpu.errors import DaftValueError
+
+_current: Optional["Session"] = None
+_lock = threading.Lock()
+
+
+def current_session() -> "Session":
+    global _current
+    with _lock:
+        if _current is None:
+            _current = Session()
+        return _current
+
+
+class Session:
+    def __init__(self):
+        self._catalogs: Dict[str, Catalog] = {"default": InMemoryCatalog("default")}
+        self._current_catalog = "default"
+        self._temp_tables: Dict[str, Table] = {}
+
+    # -- catalogs ---------------------------------------------------------
+    def attach(self, catalog: Catalog, alias: Optional[str] = None) -> None:
+        self._catalogs[alias or catalog.name] = catalog
+
+    def attach_table(self, table_or_df, alias: str) -> None:
+        from daft_tpu.dataframe.dataframe import DataFrame
+
+        if isinstance(table_or_df, DataFrame):
+            self._temp_tables[alias] = ViewTable(alias, table_or_df)
+        elif isinstance(table_or_df, Table):
+            self._temp_tables[alias] = table_or_df
+        else:
+            raise DaftValueError(f"Cannot attach {type(table_or_df)}")
+
+    def detach_catalog(self, alias: str) -> None:
+        self._catalogs.pop(alias, None)
+
+    def detach_table(self, alias: str) -> None:
+        self._temp_tables.pop(alias, None)
+
+    def use(self, catalog: str) -> None:
+        if catalog not in self._catalogs:
+            raise DaftValueError(f"Unknown catalog {catalog!r}")
+        self._current_catalog = catalog
+
+    @property
+    def current_catalog(self) -> Catalog:
+        return self._catalogs[self._current_catalog]
+
+    def list_catalogs(self) -> List[str]:
+        return sorted(self._catalogs)
+
+    # -- tables -----------------------------------------------------------
+    def create_temp_table(self, name: str, df) -> Table:
+        t = ViewTable(name, df)
+        self._temp_tables[name] = t
+        return t
+
+    def create_table(self, name: str, source=None) -> Table:
+        if "." in name:
+            cat_name, tbl = name.split(".", 1)
+            return self._catalogs[cat_name].create_table(tbl, source)
+        return self.current_catalog.create_table(name, source)
+
+    def get_table(self, name: str) -> Optional[Table]:
+        if name in self._temp_tables:
+            return self._temp_tables[name]
+        if "." in name:
+            cat_name, tbl = name.split(".", 1)
+            cat = self._catalogs.get(cat_name)
+            if cat is not None and cat.has_table(tbl):
+                return cat.get_table(tbl)
+            return None
+        cat = self.current_catalog
+        if cat.has_table(name):
+            return cat.get_table(name)
+        return None
+
+    def list_tables(self, pattern: Optional[str] = None) -> List[str]:
+        names = sorted(self._temp_tables) + self.current_catalog.list_tables(pattern)
+        return names
+
+    def drop_table(self, name: str) -> None:
+        if name in self._temp_tables:
+            del self._temp_tables[name]
+        else:
+            self.current_catalog.drop_table(name)
+
+    # -- sql --------------------------------------------------------------
+    def sql(self, query: str, **bindings):
+        from daft_tpu.sql.planner import plan_sql
+
+        return plan_sql(query, bindings)

@@ -1,0 +1,3 @@
+from daft_tpu.sql.sql import sql, sql_expr
+
+__all__ = ["sql", "sql_expr"]

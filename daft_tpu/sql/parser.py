@@ -1,0 +1,605 @@
+"""SQL tokenizer + parser.
+
+Reference: src/daft-sql (~9.1k LoC, sqlparser-rs based). Implemented here as a
+hand-written tokenizer + Pratt expression parser + recursive-descent statement
+parser producing an AST that sql/planner.py lowers to LogicalPlanBuilder ops.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple, Union
+
+from daft_tpu.datatype import DataType, TimeUnit
+from daft_tpu.errors import DaftValueError
+from daft_tpu.expressions.expr import (
+    AggOp,
+    Alias,
+    BinaryOp,
+    Cast,
+    ColumnRef,
+    Expr,
+    FunctionCall,
+    IfElse,
+    IsIn,
+    Literal,
+    UnaryOp,
+)
+
+
+class SQLParseError(DaftValueError):
+    pass
+
+
+_TOKEN_RE = re.compile(r"""
+    (?P<ws>\s+)
+  | (?P<comment>--[^\n]*)
+  | (?P<float>\d+\.\d*(?:[eE][+-]?\d+)?|\.\d+(?:[eE][+-]?\d+)?|\d+[eE][+-]?\d+)
+  | (?P<int>\d+)
+  | (?P<str>'(?:[^']|'')*')
+  | (?P<qident>"(?:[^"]|"")*")
+  | (?P<ident>[A-Za-z_][A-Za-z0-9_]*)
+  | (?P<op><=|>=|<>|!=|\|\||::|[-+*/%(),.<>=\[\]])
+""", re.VERBOSE)
+
+KEYWORDS = {
+    "select", "from", "where", "group", "by", "having", "order", "limit",
+    "offset", "as", "and", "or", "not", "in", "between", "like", "ilike",
+    "is", "null", "true", "false", "case", "when", "then", "else", "end",
+    "cast", "distinct", "join", "inner", "left", "right", "full", "outer",
+    "cross", "on", "union", "all", "with", "asc", "desc", "nulls", "first",
+    "last", "semi", "anti", "using", "interval", "exists",
+}
+
+
+@dataclass
+class Token:
+    kind: str  # ident | qident | int | float | str | op | kw | eof
+    value: str
+    pos: int
+
+
+def tokenize(text: str) -> List[Token]:
+    out: List[Token] = []
+    pos = 0
+    while pos < len(text):
+        m = _TOKEN_RE.match(text, pos)
+        if m is None:
+            raise SQLParseError(f"Unexpected character {text[pos]!r} at {pos}")
+        pos = m.end()
+        kind = m.lastgroup
+        if kind in ("ws", "comment"):
+            continue
+        value = m.group()
+        if kind == "ident" and value.lower() in KEYWORDS:
+            out.append(Token("kw", value.lower(), m.start()))
+        elif kind == "qident":
+            out.append(Token("ident", value[1:-1].replace('""', '"'), m.start()))
+        else:
+            out.append(Token(kind, value, m.start()))
+    out.append(Token("eof", "", len(text)))
+    return out
+
+
+# ---------------------------------------------------------------------- #
+# AST for statements                                                      #
+# ---------------------------------------------------------------------- #
+@dataclass
+class TableRef:
+    name: str
+    alias: Optional[str] = None
+
+
+@dataclass
+class SubqueryRef:
+    query: "SelectStmt"
+    alias: Optional[str] = None
+
+
+@dataclass
+class JoinClause:
+    right: Union[TableRef, SubqueryRef]
+    how: str
+    on: Optional[Expr]
+    using: Optional[List[str]]
+
+
+@dataclass
+class OrderItem:
+    expr: Expr
+    desc: bool = False
+    nulls_first: Optional[bool] = None
+
+
+@dataclass
+class SelectStmt:
+    projections: List[Tuple[Optional[Expr], Optional[str]]]  # (expr|None for *, alias)
+    source: Optional[Union[TableRef, SubqueryRef]] = None
+    joins: List[JoinClause] = field(default_factory=list)
+    where: Optional[Expr] = None
+    group_by: List[Expr] = field(default_factory=list)
+    having: Optional[Expr] = None
+    order_by: List[OrderItem] = field(default_factory=list)
+    limit: Optional[int] = None
+    offset: Optional[int] = None
+    distinct: bool = False
+    union: Optional[Tuple[str, "SelectStmt"]] = None  # ("all"|"distinct", stmt)
+    ctes: Dict[str, "SelectStmt"] = field(default_factory=dict)
+
+
+# ---------------------------------------------------------------------- #
+# Parser                                                                  #
+# ---------------------------------------------------------------------- #
+_AGG_FUNCS = {"sum", "min", "max", "count", "avg", "mean", "stddev", "stddev_pop",
+              "variance", "var_pop", "skew", "any_value",
+              "count_distinct", "approx_count_distinct", "list", "array_agg",
+              "bool_and", "bool_or"}
+
+_FUNC_MAP = {
+    # name -> kernel name (1:1 unless noted)
+    "upper": "str_upper", "lower": "str_lower", "length": "str_length",
+    "char_length": "str_length", "trim": "str_strip", "ltrim": "str_lstrip",
+    "rtrim": "str_rstrip", "reverse": "str_reverse", "capitalize": "str_capitalize",
+    "contains": "str_contains", "starts_with": "str_startswith",
+    "ends_with": "str_endswith", "regexp_match": "str_match",
+    "split": "str_split", "replace": "str_replace", "lpad": "str_lpad",
+    "rpad": "str_rpad", "repeat": "str_repeat", "left": "str_left",
+    "right": "str_right", "find": "str_find",
+    "abs": None, "ceil": "ceil", "ceiling": "ceil", "floor": "floor",
+    "round": "round", "sqrt": "sqrt", "cbrt": "cbrt", "exp": "exp", "ln": "ln",
+    "log": "log", "log2": "log2", "log10": "log10", "sin": "sin", "cos": "cos",
+    "tan": "tan", "asin": "asin", "acos": "acos", "atan": "atan", "atan2": "atan2",
+    "sign": "sign", "clip": "clip", "pow": None, "power": None,
+    "coalesce": "coalesce", "hash": "hash", "minhash": "minhash",
+    "concat_ws": "concat_ws", "cosine_distance": "cosine_distance",
+    "year": "dt_year", "month": "dt_month", "day": "dt_day", "hour": "dt_hour",
+    "minute": "dt_minute", "second": "dt_second", "day_of_week": "dt_day_of_week",
+    "date_trunc": None, "to_date": "str_to_date", "to_datetime": "str_to_datetime",
+    "list_get": "list_get", "list_sum": "list_sum", "list_mean": "list_mean",
+    "list_min": "list_min", "list_max": "list_max", "list_sort": "list_sort",
+    "list_join": "list_join", "list_contains": "list_contains",
+    "fill_null": "fill_null", "ifnull": "fill_null", "nvl": "fill_null",
+    "is_nan": "is_nan", "fill_nan": "fill_nan",
+}
+
+_TYPE_MAP = {
+    "int": DataType.int64, "integer": DataType.int64, "bigint": DataType.int64,
+    "smallint": DataType.int16, "tinyint": DataType.int8,
+    "float": DataType.float64, "real": DataType.float32, "double": DataType.float64,
+    "float32": DataType.float32, "float64": DataType.float64,
+    "bool": DataType.bool, "boolean": DataType.bool,
+    "text": DataType.string, "string": DataType.string, "varchar": DataType.string,
+    "binary": DataType.binary, "bytes": DataType.binary,
+    "date": DataType.date, "timestamp": DataType.timestamp,
+    "bfloat16": DataType.bfloat16,
+}
+
+
+class Parser:
+    def __init__(self, text: str):
+        self.tokens = tokenize(text)
+        self.i = 0
+
+    # -- token helpers -----------------------------------------------------
+    def peek(self, ahead: int = 0) -> Token:
+        return self.tokens[min(self.i + ahead, len(self.tokens) - 1)]
+
+    def next(self) -> Token:
+        t = self.tokens[self.i]
+        self.i += 1
+        return t
+
+    def accept(self, kind: str, value: Optional[str] = None) -> Optional[Token]:
+        t = self.peek()
+        if t.kind == kind and (value is None or t.value == value):
+            return self.next()
+        return None
+
+    def expect(self, kind: str, value: Optional[str] = None) -> Token:
+        t = self.accept(kind, value)
+        if t is None:
+            got = self.peek()
+            raise SQLParseError(f"Expected {value or kind}, got {got.value!r} at {got.pos}")
+        return t
+
+    def accept_kw(self, *kws: str) -> Optional[str]:
+        t = self.peek()
+        if t.kind == "kw" and t.value in kws:
+            self.next()
+            return t.value
+        return None
+
+    # -- statements --------------------------------------------------------
+    def parse_statement(self) -> SelectStmt:
+        ctes: Dict[str, SelectStmt] = {}
+        if self.accept_kw("with"):
+            while True:
+                name = self.expect("ident").value
+                self.expect("kw", "as")
+                self.expect("op", "(")
+                ctes[name] = self.parse_select()
+                self.expect("op", ")")
+                if not self.accept("op", ","):
+                    break
+        stmt = self.parse_select()
+        stmt.ctes = ctes
+        self.expect("eof")
+        return stmt
+
+    def parse_select(self) -> SelectStmt:
+        self.expect("kw", "select")
+        stmt = SelectStmt(projections=[])
+        stmt.distinct = bool(self.accept_kw("distinct"))
+        while True:
+            if self.accept("op", "*"):
+                stmt.projections.append((None, None))
+            else:
+                e = self.parse_expr()
+                alias = None
+                if self.accept_kw("as"):
+                    alias = self._ident_like()
+                elif self.peek().kind == "ident":
+                    alias = self.next().value
+                stmt.projections.append((e, alias))
+            if not self.accept("op", ","):
+                break
+        if self.accept_kw("from"):
+            stmt.source = self.parse_table_factor()
+            while True:
+                how = self._parse_join_kind()
+                if how is None:
+                    break
+                right = self.parse_table_factor()
+                on = None
+                using = None
+                if how != "cross":
+                    if self.accept_kw("on"):
+                        on = self.parse_expr()
+                    elif self.accept_kw("using"):
+                        self.expect("op", "(")
+                        using = [self._ident_like()]
+                        while self.accept("op", ","):
+                            using.append(self._ident_like())
+                        self.expect("op", ")")
+                    else:
+                        raise SQLParseError("JOIN requires ON or USING")
+                stmt.joins.append(JoinClause(right, how, on, using))
+        if self.accept_kw("where"):
+            stmt.where = self.parse_expr()
+        if self.accept_kw("group"):
+            self.expect("kw", "by")
+            stmt.group_by.append(self.parse_expr())
+            while self.accept("op", ","):
+                stmt.group_by.append(self.parse_expr())
+        if self.accept_kw("having"):
+            stmt.having = self.parse_expr()
+        if self.accept_kw("union"):
+            mode = "all" if self.accept_kw("all") else "distinct"
+            stmt.union = (mode, self.parse_select())
+        if self.accept_kw("order"):
+            self.expect("kw", "by")
+            while True:
+                e = self.parse_expr()
+                desc = False
+                if self.accept_kw("desc"):
+                    desc = True
+                elif self.accept_kw("asc"):
+                    desc = False
+                nulls_first = None
+                if self.accept_kw("nulls"):
+                    which = self.accept_kw("first", "last")
+                    nulls_first = which == "first"
+                stmt.order_by.append(OrderItem(e, desc, nulls_first))
+                if not self.accept("op", ","):
+                    break
+        if self.accept_kw("limit"):
+            stmt.limit = int(self.expect("int").value)
+        if self.accept_kw("offset"):
+            stmt.offset = int(self.expect("int").value)
+        return stmt
+
+    def _parse_join_kind(self) -> Optional[str]:
+        if self.accept_kw("cross"):
+            self.expect("kw", "join")
+            return "cross"
+        if self.accept_kw("join") or self.accept_kw("inner"):
+            self.accept_kw("join")
+            return "inner"
+        for kw, how in (("left", "left"), ("right", "right"), ("full", "outer"),
+                        ("semi", "semi"), ("anti", "anti")):
+            if self.accept_kw(kw):
+                self.accept_kw("outer")
+                self.accept_kw("semi")
+                self.accept_kw("anti")
+                self.expect("kw", "join")
+                return how
+        return None
+
+    def parse_table_factor(self) -> Union[TableRef, SubqueryRef]:
+        if self.accept("op", "("):
+            sub = self.parse_select()
+            self.expect("op", ")")
+            alias = None
+            self.accept_kw("as")
+            if self.peek().kind == "ident":
+                alias = self.next().value
+            return SubqueryRef(sub, alias)
+        name = self._ident_like()
+        while self.accept("op", "."):
+            name += "." + self._ident_like()
+        alias = None
+        if self.accept_kw("as"):
+            alias = self._ident_like()
+        elif self.peek().kind == "ident":
+            alias = self.next().value
+        return TableRef(name, alias)
+
+    def _ident_like(self) -> str:
+        t = self.peek()
+        if t.kind in ("ident",):
+            return self.next().value
+        raise SQLParseError(f"Expected identifier, got {t.value!r} at {t.pos}")
+
+    # -- expressions (Pratt) ----------------------------------------------
+    def parse_expr(self) -> Expr:
+        return self._parse_or()
+
+    def _parse_or(self) -> Expr:
+        left = self._parse_and()
+        while self.accept_kw("or"):
+            left = BinaryOp("or", left, self._parse_and())
+        return left
+
+    def _parse_and(self) -> Expr:
+        left = self._parse_not()
+        while self.accept_kw("and"):
+            left = BinaryOp("and", left, self._parse_not())
+        return left
+
+    def _parse_not(self) -> Expr:
+        if self.accept_kw("not"):
+            return UnaryOp("not", self._parse_not())
+        return self._parse_comparison()
+
+    def _parse_comparison(self) -> Expr:
+        left = self._parse_additive()
+        t = self.peek()
+        if t.kind == "op" and t.value in ("=", "<>", "!=", "<", "<=", ">", ">="):
+            self.next()
+            op = {"=": "eq", "<>": "ne", "!=": "ne", "<": "lt", "<=": "le",
+                  ">": "gt", ">=": "ge"}[t.value]
+            return BinaryOp(op, left, self._parse_additive())
+        negate = False
+        if self.peek().kind == "kw" and self.peek().value == "not":
+            if self.peek(1).kind == "kw" and self.peek(1).value in ("in", "between", "like", "ilike"):
+                self.next()
+                negate = True
+        if self.accept_kw("in"):
+            self.expect("op", "(")
+            items = [self._literal_value()]
+            while self.accept("op", ","):
+                items.append(self._literal_value())
+            self.expect("op", ")")
+            e: Expr = IsIn(left, Literal(items))
+            return UnaryOp("not", e) if negate else e
+        if self.accept_kw("between"):
+            lo = self._parse_additive()
+            self.expect("kw", "and")
+            hi = self._parse_additive()
+            e = BinaryOp("and", BinaryOp("ge", left, lo), BinaryOp("le", left, hi))
+            return UnaryOp("not", e) if negate else e
+        if self.accept_kw("like"):
+            pat = self._parse_additive()
+            e = FunctionCall("str_like", [left, pat])
+            return UnaryOp("not", e) if negate else e
+        if self.accept_kw("ilike"):
+            pat = self._parse_additive()
+            e = FunctionCall("str_ilike", [left, pat])
+            return UnaryOp("not", e) if negate else e
+        if self.accept_kw("is"):
+            neg = bool(self.accept_kw("not"))
+            self.expect("kw", "null")
+            return UnaryOp("not_null" if neg else "is_null", left)
+        return left
+
+    def _parse_additive(self) -> Expr:
+        left = self._parse_multiplicative()
+        while True:
+            t = self.peek()
+            if t.kind == "op" and t.value in ("+", "-", "||"):
+                self.next()
+                right = self._parse_multiplicative()
+                if t.value == "||":
+                    left = BinaryOp("add", Cast(left, DataType.string()),
+                                    Cast(right, DataType.string()))
+                else:
+                    left = BinaryOp("add" if t.value == "+" else "sub", left, right)
+            else:
+                return left
+
+    def _parse_multiplicative(self) -> Expr:
+        left = self._parse_unary()
+        while True:
+            t = self.peek()
+            if t.kind == "op" and t.value in ("*", "/", "%"):
+                self.next()
+                op = {"*": "mul", "/": "truediv", "%": "mod"}[t.value]
+                left = BinaryOp(op, left, self._parse_unary())
+            else:
+                return left
+
+    def _parse_unary(self) -> Expr:
+        if self.accept("op", "-"):
+            return UnaryOp("negate", self._parse_unary())
+        if self.accept("op", "+"):
+            return self._parse_unary()
+        return self._parse_postfix()
+
+    def _parse_postfix(self) -> Expr:
+        e = self._parse_primary()
+        while True:
+            if self.accept("op", "::"):
+                e = Cast(e, self._parse_type())
+            elif self.accept("op", "["):
+                idx = self.parse_expr()
+                self.expect("op", "]")
+                e = FunctionCall("list_get", [e, idx])
+            elif self.accept("op", "."):
+                name = self._ident_like()
+                e = FunctionCall("struct_get", [e], {"name": name})
+            else:
+                return e
+
+    def _parse_primary(self) -> Expr:
+        t = self.peek()
+        if t.kind == "int":
+            self.next()
+            return Literal(int(t.value))
+        if t.kind == "float":
+            self.next()
+            return Literal(float(t.value))
+        if t.kind == "str":
+            self.next()
+            return Literal(t.value[1:-1].replace("''", "'"))
+        if t.kind == "kw":
+            if self.accept_kw("true"):
+                return Literal(True)
+            if self.accept_kw("false"):
+                return Literal(False)
+            if self.accept_kw("null"):
+                return Literal(None)
+            if self.accept_kw("case"):
+                return self._parse_case()
+            if self.accept_kw("cast"):
+                self.expect("op", "(")
+                inner = self.parse_expr()
+                self.expect("kw", "as")
+                dtype = self._parse_type()
+                self.expect("op", ")")
+                return Cast(inner, dtype)
+            if self.accept_kw("interval"):
+                raw = self.expect("str").value[1:-1]
+                return Literal(_parse_interval(raw))
+            if self.accept_kw("not"):
+                return UnaryOp("not", self._parse_not())
+        if self.accept("op", "("):
+            inner = self.parse_expr()
+            self.expect("op", ")")
+            return inner
+        if t.kind == "ident":
+            self.next()
+            if self.peek().kind == "op" and self.peek().value == "(":
+                return self._parse_function(t.value)
+            # qualified column a.b -> struct access is handled postfix; here a
+            # bare identifier is a column ref.
+            return ColumnRef(t.value)
+        raise SQLParseError(f"Unexpected token {t.value!r} at {t.pos}")
+
+    def _parse_case(self) -> Expr:
+        branches = []
+        while self.accept_kw("when"):
+            cond = self.parse_expr()
+            self.expect("kw", "then")
+            val = self.parse_expr()
+            branches.append((cond, val))
+        default: Expr = Literal(None)
+        if self.accept_kw("else"):
+            default = self.parse_expr()
+        self.expect("kw", "end")
+        out = default
+        for cond, val in reversed(branches):
+            out = IfElse(cond, val, out)
+        return out
+
+    def _parse_function(self, name: str) -> Expr:
+        name_l = name.lower()
+        self.expect("op", "(")
+        if name_l == "count" and self.accept("op", "*"):
+            self.expect("op", ")")
+            return AggOp("count", Literal(1), {"mode": "all"})
+        distinct = bool(self.accept_kw("distinct"))
+        args: List[Expr] = []
+        if not self.accept("op", ")"):
+            args.append(self.parse_expr())
+            while self.accept("op", ","):
+                args.append(self.parse_expr())
+            self.expect("op", ")")
+        if name_l in _AGG_FUNCS:
+            op = {"avg": "mean", "array_agg": "list", "stddev_pop": "stddev",
+                  "var_pop": "variance", "mean": "mean"}.get(name_l, name_l)
+            if name_l == "count" and distinct:
+                op = "count_distinct"
+            return AggOp(op, args[0] if args else Literal(1))
+        if name_l == "abs":
+            return UnaryOp("abs", args[0])
+        if name_l in ("pow", "power"):
+            return BinaryOp("pow", args[0], args[1])
+        if name_l == "if":
+            return IfElse(args[0], args[1], args[2])
+        if name_l == "date_trunc":
+            unit = args[0]
+            assert isinstance(unit, Literal)
+            return FunctionCall("dt_truncate", [args[1]], {"interval": f"1 {unit.value}"})
+        if name_l == "substr" or name_l == "substring":
+            kwargs = {}
+            if len(args) >= 3:
+                lit_len = args[2]
+                kwargs["length"] = lit_len.value if isinstance(lit_len, Literal) else None
+            start = args[1]
+            if isinstance(start, Literal):
+                start = Literal(max(0, start.value - 1))  # SQL is 1-based
+            return FunctionCall("str_substr", [args[0], start], kwargs)
+        kernel = _FUNC_MAP.get(name_l, name_l)
+        if kernel is None:
+            kernel = name_l
+        return FunctionCall(kernel, args)
+
+    def _parse_type(self) -> DataType:
+        name = self._ident_like().lower()
+        if name in _TYPE_MAP:
+            return _TYPE_MAP[name]()
+        raise SQLParseError(f"Unknown type {name!r}")
+
+    def _literal_value(self):
+        t = self.next()
+        if t.kind == "int":
+            return int(t.value)
+        if t.kind == "float":
+            return float(t.value)
+        if t.kind == "str":
+            return t.value[1:-1].replace("''", "'")
+        if t.kind == "kw" and t.value in ("true", "false"):
+            return t.value == "true"
+        if t.kind == "kw" and t.value == "null":
+            return None
+        raise SQLParseError(f"Expected literal, got {t.value!r} at {t.pos}")
+
+
+def _parse_interval(raw: str):
+    import datetime
+
+    m = re.match(r"(\d+)\s+(\w+)", raw)
+    if not m:
+        raise SQLParseError(f"Bad interval: {raw!r}")
+    n, unit = int(m.group(1)), m.group(2).lower().rstrip("s")
+    mapping = {"day": "days", "hour": "hours", "minute": "minutes",
+               "second": "seconds", "week": "weeks", "millisecond": "milliseconds",
+               "microsecond": "microseconds"}
+    if unit not in mapping:
+        raise SQLParseError(f"Unsupported interval unit {unit!r}")
+    return datetime.timedelta(**{mapping[unit]: n})
+
+
+def parse_sql(text: str) -> SelectStmt:
+    return Parser(text).parse_statement()
+
+
+def parse_expression(text: str):
+    """Parse a scalar SQL expression -> Expression (daft.sql_expr)."""
+    from daft_tpu.expressions.expression import Expression
+
+    p = Parser(text)
+    e = p.parse_expr()
+    p.expect("eof")
+    return Expression(e)

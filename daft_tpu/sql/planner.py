@@ -1,0 +1,257 @@
+"""SQL AST → LogicalPlanBuilder lowering.
+
+Reference: src/daft-sql/src/planner.rs — resolves table names against bound
+DataFrames / catalog tables, plans joins/filters/aggregations/windows onto the
+same LogicalPlanBuilder the DataFrame API uses.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+from daft_tpu.errors import DaftValueError
+from daft_tpu.expressions.expr import (
+    AggOp,
+    Alias,
+    BinaryOp,
+    ColumnRef,
+    Expr,
+)
+from daft_tpu.sql.parser import JoinClause, SelectStmt, SubqueryRef, TableRef, parse_sql
+
+
+def plan_sql(query: str, bindings: Dict[str, object]):
+    from daft_tpu.dataframe.dataframe import DataFrame
+
+    stmt = parse_sql(query)
+    df = _plan_select(stmt, bindings, dict(stmt.ctes))
+    return df
+
+
+def _resolve_source(src, bindings, ctes):
+    from daft_tpu.dataframe.dataframe import DataFrame
+
+    if isinstance(src, SubqueryRef):
+        return _plan_select(src.query, bindings, ctes)
+    assert isinstance(src, TableRef)
+    name = src.name
+    if name in ctes:
+        return _plan_select(ctes[name], bindings, ctes)
+    if name in bindings:
+        obj = bindings[name]
+        if isinstance(obj, DataFrame):
+            return obj
+    # Session catalog lookup.
+    from daft_tpu.session import current_session
+
+    sess = current_session()
+    table = sess.get_table(name) if sess else None
+    if table is not None:
+        return table.read()
+    raise DaftValueError(f"Unknown table {name!r} in SQL query")
+
+
+def _plan_select(stmt: SelectStmt, bindings, ctes):
+    from daft_tpu.dataframe.dataframe import DataFrame
+    from daft_tpu.expressions.expression import Expression
+
+    if stmt.source is None:
+        # SELECT without FROM: single-row evaluation.
+        import daft_tpu
+
+        df = daft_tpu.from_pydict({"__dummy": [1]})
+    else:
+        df = _resolve_source(stmt.source, bindings, ctes)
+    for join in stmt.joins:
+        right = _resolve_source(join.right, bindings, ctes)
+        if join.how == "cross":
+            df = df.cross_join(right)
+            continue
+        if join.using:
+            df = df.join(right, on=join.using, how=join.how)
+            continue
+        left_on, right_on = _split_join_condition(join.on, df, right)
+        df = df.join(
+            right,
+            left_on=[Expression(e) for e in left_on],
+            right_on=[Expression(e) for e in right_on],
+            how=join.how,
+        )
+    # Table-qualifier resolution: `t.c` parses as struct_get(col(t), name=c);
+    # when t is a table name/alias rather than a struct column, rewrite to
+    # col(c) (reference: qualified-identifier binding in daft-sql's planner).
+    colnames = set(df.column_names)
+    dequal = lambda e: _dequalify(e, colnames)
+    if stmt.where is not None:
+        df = df.where(Expression(dequal(stmt.where)))
+
+    # Projections: expand *, attach aliases.
+    proj_exprs: List[Expr] = []
+    for e, alias in stmt.projections:
+        if e is None:
+            for name in df.column_names:
+                if name != "__dummy":
+                    proj_exprs.append(ColumnRef(name))
+        else:
+            e = dequal(e)
+            proj_exprs.append(Alias(e, alias) if alias else e)
+    stmt.group_by = [dequal(g) for g in stmt.group_by]
+    if stmt.having is not None:
+        stmt.having = dequal(stmt.having)
+    for o in stmt.order_by:
+        o.expr = dequal(o.expr)
+
+    has_agg = bool(stmt.group_by) or any(e.has_agg() for e in proj_exprs)
+    if has_agg:
+        group_exprs = list(stmt.group_by)
+        # A projection that is exactly a group key passes through.
+        group_keys = {g.key() for g in group_exprs}
+        agg_exprs = [e for e in proj_exprs if _strip_alias(e).key() not in group_keys]
+        keys_in_proj = [e for e in proj_exprs if _strip_alias(e).key() in group_keys]
+        # HAVING: rewrite aggregate subtrees to reference agg output columns;
+        # unmatched aggregates become hidden agg columns dropped after filter.
+        hidden_aggs: List[Expr] = []
+        having_rewritten: Optional[Expr] = None
+        if stmt.having is not None:
+            existing = {_strip_alias(e).key(): e.name() for e in agg_exprs}
+
+            def rw(n: Expr):
+                if isinstance(n, AggOp):
+                    k = n.key()
+                    if k in existing:
+                        return ColumnRef(existing[k])
+                    name = f"__having_{len(hidden_aggs)}"
+                    hidden_aggs.append(Alias(n, name))
+                    existing[k] = name
+                    return ColumnRef(name)
+                return None
+
+            having_rewritten = stmt.having.transform(rw)
+        gdf = df.groupby(*[Expression(g) for g in group_exprs]) if group_exprs else None
+        all_aggs = agg_exprs + hidden_aggs
+        if gdf is not None:
+            out = gdf.agg(*[Expression(e) for e in all_aggs])
+        else:
+            out = df.agg(*[Expression(e) for e in all_aggs])
+        if having_rewritten is not None:
+            out = out.where(Expression(having_rewritten))
+            if hidden_aggs:
+                out = out.exclude(*[e.name() for e in hidden_aggs])
+        # Re-order columns to match projection order when possible.
+        want = [e.name() for e in proj_exprs]
+        if set(want) <= set(out.column_names):
+            out = out.select(*want)
+        df = out
+    else:
+        # ORDER BY may reference pre-projection columns (SQL scoping): carry
+        # them through as hidden columns and drop after the sort.
+        hidden: List[str] = []
+        if stmt.order_by:
+            proj_names = {e.name() for e in proj_exprs}
+            order_refs = set()
+            for o in stmt.order_by:
+                order_refs |= o.expr.column_refs()
+            hidden = sorted((order_refs - proj_names) & set(df.column_names))
+        df = df.select(*[Expression(e) for e in proj_exprs + [ColumnRef(h) for h in hidden]])
+        if hidden:
+            if stmt.distinct:
+                raise DaftValueError("ORDER BY on non-projected columns with DISTINCT")
+            df = df.sort(
+                [Expression(o.expr) for o in stmt.order_by],
+                [o.desc for o in stmt.order_by],
+                nulls_first=[o.nulls_first if o.nulls_first is not None else o.desc
+                             for o in stmt.order_by],
+            )
+            df = df.exclude(*hidden)
+            stmt.order_by = []
+        if stmt.having is not None:
+            raise DaftValueError("HAVING requires GROUP BY / aggregation")
+
+    if stmt.distinct:
+        df = df.distinct()
+    if stmt.union is not None:
+        mode, other_stmt = stmt.union
+        other = _plan_select(other_stmt, bindings, ctes)
+        df = df.concat(other)
+        if mode == "distinct":
+            df = df.distinct()
+    if stmt.order_by:
+        df = df.sort(
+            [Expression(o.expr) for o in stmt.order_by],
+            [o.desc for o in stmt.order_by],
+            nulls_first=[o.nulls_first if o.nulls_first is not None else o.desc
+                         for o in stmt.order_by],
+        )
+    if stmt.limit is not None:
+        df = df.limit(stmt.limit, offset=stmt.offset or 0)
+    elif stmt.offset:
+        df = df.offset(stmt.offset)
+    return df
+
+
+def _strip_alias(e: Expr) -> Expr:
+    while isinstance(e, Alias):
+        e = e.child
+    return e
+
+
+def _split_join_condition(on: Optional[Expr], left_df, right_df) -> Tuple[List[Expr], List[Expr]]:
+    """Decompose `a.x = b.y AND ...` into (left_on, right_on) key lists."""
+    if on is None:
+        raise DaftValueError("JOIN requires ON or USING")
+    conjuncts: List[Expr] = []
+
+    def flatten(e: Expr):
+        if isinstance(e, BinaryOp) and e.op == "and":
+            flatten(e.left)
+            flatten(e.right)
+        else:
+            conjuncts.append(e)
+
+    flatten(on)
+    left_names = set(left_df.column_names)
+    right_names = set(right_df.column_names)
+    left_on, right_on = [], []
+    for c in conjuncts:
+        if not (isinstance(c, BinaryOp) and c.op == "eq"):
+            raise DaftValueError(f"Only equi-join conditions supported, got {c!r}")
+        l, r = _strip_qualifier(c.left), _strip_qualifier(c.right)
+        l_refs, r_refs = l.column_refs(), r.column_refs()
+        if l_refs <= left_names and r_refs <= right_names:
+            left_on.append(l)
+            right_on.append(r)
+        elif l_refs <= right_names and r_refs <= left_names:
+            left_on.append(r)
+            right_on.append(l)
+        else:
+            raise DaftValueError(f"Cannot attribute join condition sides: {c!r}")
+    return left_on, right_on
+
+
+def _dequalify(e: Expr, column_names: set) -> Expr:
+    """struct_get(col(q), name=c) -> col(c) when q is not a real column."""
+    from daft_tpu.expressions.expr import FunctionCall
+
+    def rw(n: Expr):
+        if isinstance(n, FunctionCall) and n.fn_name == "struct_get" and len(n.args) == 1:
+            inner = n.args[0]
+            if isinstance(inner, ColumnRef) and inner.name_ not in column_names:
+                return ColumnRef(n.kwargs["name"])
+        return None
+
+    return e.transform(rw)
+
+
+def _strip_qualifier(e: Expr) -> Expr:
+    """Rewrite struct_get(col(t), name=c) used as a table qualifier t.c into
+    col(c) when t is not an actual column."""
+    from daft_tpu.expressions.expr import FunctionCall
+
+    def rw(n: Expr):
+        if isinstance(n, FunctionCall) and n.fn_name == "struct_get" and len(n.args) == 1:
+            inner = n.args[0]
+            if isinstance(inner, ColumnRef):
+                return ColumnRef(n.kwargs["name"])
+        return None
+
+    return e.transform(rw)

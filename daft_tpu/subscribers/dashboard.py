@@ -1,0 +1,157 @@
+"""Embedded dashboard: live query/operator state over HTTP.
+
+Reference: src/daft-dashboard (axum server + UI, lib.rs:326-397) and the
+dashboard subscriber posting events to it. Here a stdlib http.server serves
+JSON state + a minimal HTML view; the DashboardSubscriber feeds it events.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional
+
+from daft_tpu.subscribers.events import (
+    Event,
+    OperatorStats,
+    QueryEnd,
+    QueryStart,
+    Subscriber,
+    TaskCompleted,
+    TaskScheduled,
+)
+
+_HTML = """<!doctype html><html><head><title>daft_tpu dashboard</title>
+<style>body{font-family:monospace;margin:2em}table{border-collapse:collapse}
+td,th{border:1px solid #999;padding:4px 8px}</style></head>
+<body><h2>daft_tpu dashboard</h2><div id="out">loading...</div>
+<script>
+async function tick(){
+  const r = await fetch('/api/queries'); const qs = await r.json();
+  let h = '<table><tr><th>query</th><th>status</th><th>duration</th><th>tasks</th></tr>';
+  for (const q of qs) h += `<tr><td>${q.query_id}</td><td>${q.status}</td>`+
+    `<td>${q.duration_s?.toFixed(2) ?? ''}</td><td>${q.tasks}</td></tr>`;
+  document.getElementById('out').innerHTML = h + '</table>';
+}
+setInterval(tick, 1000); tick();
+</script></body></html>"""
+
+
+class DashboardState:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.queries: Dict[str, dict] = {}
+
+    def on_event(self, e: Event) -> None:
+        with self._lock:
+            if isinstance(e, QueryStart):
+                self.queries[e.query_id] = {
+                    "query_id": e.query_id, "status": "running", "plan": e.plan,
+                    "start": time.time(), "duration_s": None, "tasks": 0,
+                    "operators": [],
+                }
+            elif isinstance(e, QueryEnd):
+                q = self.queries.get(e.query_id)
+                if q:
+                    q["status"] = "error" if e.error else "done"
+                    q["duration_s"] = e.duration_s
+                    q["error"] = e.error
+            elif isinstance(e, (TaskScheduled, TaskCompleted)):
+                q = self.queries.get(e.query_id)
+                if q and isinstance(e, TaskCompleted):
+                    q["tasks"] += 1
+            elif isinstance(e, OperatorStats):
+                q = self.queries.get(e.query_id)
+                if q:
+                    q["operators"].append({
+                        "operator": e.operator, "rows_in": e.rows_in,
+                        "rows_out": e.rows_out, "cpu_us": e.cpu_us,
+                    })
+
+    def snapshot(self) -> List[dict]:
+        with self._lock:
+            return [dict(q, plan=None, operators=len(q["operators"]))
+                    for q in self.queries.values()]
+
+    def query_detail(self, query_id: str) -> Optional[dict]:
+        with self._lock:
+            return dict(self.queries.get(query_id) or {}) or None
+
+
+class DashboardSubscriber(Subscriber):
+    def __init__(self, state: DashboardState):
+        self.state = state
+
+    def on_event(self, event: Event) -> None:
+        self.state.on_event(event)
+
+
+class _Handler(BaseHTTPRequestHandler):
+    state: DashboardState = None  # type: ignore[assignment]
+
+    def log_message(self, *args):  # quiet
+        pass
+
+    def do_GET(self):
+        if self.path in ("/", "/index.html"):
+            body = _HTML.encode()
+            ctype = "text/html"
+        elif self.path == "/api/queries":
+            body = json.dumps(self.state.snapshot()).encode()
+            ctype = "application/json"
+        elif self.path.startswith("/api/queries/"):
+            qid = self.path.rsplit("/", 1)[1]
+            detail = self.state.query_detail(qid)
+            if detail is None:
+                self.send_error(404)
+                return
+            body = json.dumps(detail, default=str).encode()
+            ctype = "application/json"
+        elif self.path == "/api/health":
+            body = b'{"status":"ok"}'
+            ctype = "application/json"
+        else:
+            self.send_error(404)
+            return
+        self.send_response(200)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+
+class DashboardServer:
+    def __init__(self, port: int = 0):
+        self.state = DashboardState()
+        handler = type("Handler", (_Handler,), {"state": self.state})
+        self._server = ThreadingHTTPServer(("127.0.0.1", port), handler)
+        self.port = self._server.server_address[1]
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        daemon=True, name="daft-dashboard")
+
+    def start(self) -> "DashboardServer":
+        self._thread.start()
+        return self
+
+    @property
+    def url(self) -> str:
+        return f"http://127.0.0.1:{self.port}"
+
+    def subscriber(self) -> DashboardSubscriber:
+        return DashboardSubscriber(self.state)
+
+    def shutdown(self) -> None:
+        self._server.shutdown()
+
+
+def launch(port: int = 8238, attach: bool = True) -> DashboardServer:
+    """Start the dashboard and attach its subscriber to the context
+    (reference: `daft dashboard` CLI)."""
+    server = DashboardServer(port).start()
+    if attach:
+        from daft_tpu.context import get_context
+
+        get_context().attach_subscriber(server.subscriber())
+    return server

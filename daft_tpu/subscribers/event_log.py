@@ -1,0 +1,38 @@
+"""JSONL event-log subscriber (reference: daft/subscribers/event_log.py).
+
+Appends one JSON line per engine event; workers on other hosts can stream
+events back to the driver by pointing at a shared path (the reference's
+remote event-log sink, daft/runners/flotilla.py:171-176).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import threading
+import time
+from typing import Optional, TextIO
+
+from daft_tpu.subscribers.events import Event, Subscriber
+
+
+class EventLogSubscriber(Subscriber):
+    def __init__(self, path: str):
+        self.path = path
+        self._lock = threading.Lock()
+        self._f: Optional[TextIO] = open(path, "a")
+
+    def on_event(self, event: Event) -> None:
+        record = {"ts": time.time(), "event": type(event).__name__}
+        record.update(dataclasses.asdict(event))
+        line = json.dumps(record, default=str)
+        with self._lock:
+            if self._f is not None:
+                self._f.write(line + "\n")
+                self._f.flush()
+
+    def close(self) -> None:
+        with self._lock:
+            if self._f is not None:
+                self._f.close()
+                self._f = None

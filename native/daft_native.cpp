@@ -1,0 +1,117 @@
+// Native host-side kernels for daft_tpu.
+//
+// Replaces the reference's Rust kernel crates for the host hot paths the
+// Python/numpy fallback is slowest at: row hashing (src/daft-hash,
+// src/daft-core/src/array/ops/hash.rs), MinHash (src/daft-minhash/src/lib.rs)
+// and HyperLogLog register building (src/hyperloglog). Exposed as a plain C
+// ABI consumed via ctypes (no pybind11 in this image).
+//
+// CONTRACT: hash outputs are bit-identical to the numpy implementation in
+// daft_tpu/kernels/hashing.py — distributed hash partitioning requires every
+// host (with or without this library) to agree on hashes.
+
+#include <cstdint>
+#include <cstring>
+#include <algorithm>
+
+extern "C" {
+
+static const uint64_t FNV_PRIME = 1099511628211ULL;
+static const uint64_t FNV_OFFSET = 14695981039346656037ULL;
+
+static inline uint64_t splitmix_finalize(uint64_t h) {
+    h ^= h >> 30;
+    h *= 0xBF58476D1CE4E5B9ULL;
+    h ^= h >> 27;
+    h *= 0x94D049BB133111EBULL;
+    h ^= h >> 31;
+    return h;
+}
+
+// Hash n var-width byte strings: value i spans data[starts[i]..starts[i]+lengths[i]).
+// Matches hash_bytes_batch() in kernels/hashing.py.
+void hash_bytes_batch(const uint8_t* data, const int64_t* starts,
+                      const int64_t* lengths, int64_t n, uint64_t* out) {
+    for (int64_t i = 0; i < n; i++) {
+        uint64_t acc = 0;
+        uint64_t p = 1;
+        const uint8_t* ptr = data + starts[i];
+        int64_t len = lengths[i];
+        for (int64_t j = 0; j < len; j++) {
+            acc += (uint64_t)ptr[j] * p;
+            p *= FNV_PRIME;
+        }
+        uint64_t h = FNV_OFFSET + acc + (uint64_t)len * 0x100000001B3ULL;
+        out[i] = splitmix_finalize(h);
+    }
+}
+
+// Hash n fixed-width rows of `width` bytes each (contiguous).
+// Matches _hash_fixed_width() in kernels/hashing.py.
+void hash_fixed_width(const uint8_t* data, int64_t n, int64_t width, uint64_t* out) {
+    for (int64_t i = 0; i < n; i++) {
+        const uint8_t* row = data + i * width;
+        uint64_t acc = FNV_OFFSET;
+        uint64_t p = 1;
+        for (int64_t j = 0; j < width; j++) {
+            acc += (uint64_t)row[j] * p;
+            p *= FNV_PRIME;
+        }
+        out[i] = splitmix_finalize(acc);
+    }
+}
+
+// Combine per-column row hashes into one row hash (matches combine_hashes()).
+void combine_hashes(const uint64_t* a, const uint64_t* b, int64_t n, uint64_t* out) {
+    for (int64_t i = 0; i < n; i++) {
+        out[i] = splitmix_finalize(a[i] * FNV_PRIME + b[i]);
+    }
+}
+
+// MinHash: for each of n_rows rows, token hashes are
+// token_hashes[row_offsets[i]..row_offsets[i+1]); signature k =
+// min over tokens of ((a[k]*h + b[k]) mod M61), truncated to u32.
+// Matches the kernel in kernels/misc_ops.py.
+void minhash_rows(const uint64_t* token_hashes, const int64_t* row_offsets,
+                  int64_t n_rows, const uint64_t* a, const uint64_t* b,
+                  int64_t num_hashes, uint32_t* out) {
+    const uint64_t M61 = (1ULL << 61) - 1;
+    for (int64_t i = 0; i < n_rows; i++) {
+        int64_t start = row_offsets[i];
+        int64_t end = row_offsets[i + 1];
+        uint32_t* sig = out + i * num_hashes;
+        for (int64_t k = 0; k < num_hashes; k++) {
+            uint64_t best = UINT64_MAX;
+            for (int64_t t = start; t < end; t++) {
+                uint64_t hv = (token_hashes[t] * a[k] + b[k]) % M61;
+                if (hv < best) best = hv;
+            }
+            sig[k] = (uint32_t)best;
+        }
+    }
+}
+
+// HyperLogLog register build from 64-bit hashes (precision p).
+// Matches hll_from_hashes() in kernels/sketches.py.
+void hll_build(const uint64_t* hashes, int64_t n, int32_t precision,
+               uint8_t* registers) {
+    int32_t rest_bits = 64 - precision;
+    for (int64_t i = 0; i < n; i++) {
+        uint64_t h = hashes[i];
+        uint64_t idx = h >> rest_bits;
+        uint64_t rest = h << precision;
+        uint8_t rank;
+        if (rest == 0) {
+            rank = (uint8_t)(rest_bits + 1);
+        } else {
+            int lz = __builtin_clzll(rest);
+            rank = (uint8_t)std::min(lz + 1, rest_bits + 1);
+        }
+        if (rank > registers[idx]) registers[idx] = rank;
+    }
+}
+
+// ABI version for loader sanity checks.
+int64_t daft_native_abi_version() { return 1; }
+
+}  // extern "C"

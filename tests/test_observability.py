@@ -1,0 +1,119 @@
+"""Subscribers, event log, dashboard, heartbeat, checkpoint tests
+(reference: tests/test_subscribers.py, tests/observability, integration/checkpoint)."""
+
+import json
+import time
+import urllib.request
+
+import pytest
+
+import daft_tpu
+from daft_tpu import col
+from daft_tpu.subscribers.events import QueryEnd, QueryStart, Subscriber
+
+
+class _Collect(Subscriber):
+    def __init__(self):
+        self.events = []
+
+    def on_event(self, e):
+        self.events.append(e)
+
+
+def test_query_events(make_df):
+    sub = _Collect()
+    ctx = daft_tpu.get_context()
+    ctx.attach_subscriber(sub)
+    try:
+        make_df({"a": [1, 2]}).collect()
+    finally:
+        ctx.detach_subscriber(sub)
+    kinds = [type(e).__name__ for e in sub.events]
+    assert "QueryStart" in kinds and "QueryEnd" in kinds
+    end = [e for e in sub.events if isinstance(e, QueryEnd)][0]
+    assert end.error is None and end.duration_s >= 0
+
+
+def test_event_log_jsonl(make_df, tmp_path):
+    from daft_tpu.subscribers.event_log import EventLogSubscriber
+
+    path = str(tmp_path / "events.jsonl")
+    sub = EventLogSubscriber(path)
+    ctx = daft_tpu.get_context()
+    ctx.attach_subscriber(sub)
+    try:
+        make_df({"a": [1]}).collect()
+    finally:
+        ctx.detach_subscriber(sub)
+        sub.close()
+    lines = [json.loads(l) for l in open(path)]
+    assert any(l["event"] == "QueryStart" for l in lines)
+    assert any(l["event"] == "QueryEnd" for l in lines)
+
+
+def test_dashboard_server(make_df):
+    from daft_tpu.subscribers.dashboard import DashboardServer
+
+    server = DashboardServer().start()
+    ctx = daft_tpu.get_context()
+    sub = server.subscriber()
+    ctx.attach_subscriber(sub)
+    try:
+        make_df({"a": [1, 2, 3]}).where(col("a") > 1).collect()
+        health = json.load(urllib.request.urlopen(f"{server.url}/api/health"))
+        assert health == {"status": "ok"}
+        queries = json.load(urllib.request.urlopen(f"{server.url}/api/queries"))
+        assert len(queries) >= 1
+        assert queries[-1]["status"] == "done"
+        html = urllib.request.urlopen(server.url).read().decode()
+        assert "dashboard" in html
+    finally:
+        ctx.detach_subscriber(sub)
+        server.shutdown()
+
+
+def test_heartbeat():
+    from daft_tpu.runners.heartbeat import Heartbeat, QueryHeartbeat
+
+    sub = _Collect()
+    ctx = daft_tpu.get_context()
+    ctx.attach_subscriber(sub)
+    try:
+        with Heartbeat("q1", interval_s=0.05):
+            time.sleep(0.2)
+    finally:
+        ctx.detach_subscriber(sub)
+    beats = [e for e in sub.events if isinstance(e, QueryHeartbeat)]
+    assert len(beats) >= 2
+
+
+def test_checkpoint_resume(make_df, tmp_path):
+    from daft_tpu.checkpoint import CheckpointConfig, CheckpointStore
+
+    store = CheckpointStore(str(tmp_path / "ckpt"))
+    cfg = CheckpointConfig(store, on="key")
+    df = make_df({"key": ["a", "b", "c", "d"], "v": [1, 2, 3, 4]})
+
+    # First run: everything processes, keys sealed at write.
+    out1 = df.with_checkpoint(cfg)
+    assert out1.count_rows() == 4
+    out1.write_parquet(str(tmp_path / "out1"), checkpoint=cfg)
+    assert store.load_keys() == {"a", "b", "c", "d"}
+
+    # Second run over a superset: only the new key processes.
+    df2 = make_df({"key": ["a", "b", "c", "d", "e"], "v": [1, 2, 3, 4, 5]})
+    remaining = df2.with_checkpoint(cfg)
+    assert remaining.to_pydict()["key"] == ["e"]
+    remaining.write_parquet(str(tmp_path / "out2"), checkpoint=cfg)
+    assert "e" in store.load_keys()
+
+    store.clear()
+    assert store.load_keys() == set()
+
+
+def test_cli_version(capsys):
+    from daft_tpu.__main__ import main
+
+    assert main(["version"]) == 0
+    out = capsys.readouterr().out.strip()
+    assert out == daft_tpu.__version__

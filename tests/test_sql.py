@@ -1,0 +1,131 @@
+import pytest
+
+import daft_tpu
+from daft_tpu import col
+
+
+@pytest.fixture
+def people(make_df):
+    return make_df({
+        "name": ["ann", "bob", "cat", "dan"],
+        "age": [25, 32, 19, 45],
+        "dept": ["eng", "eng", "ops", "ops"],
+    })
+
+
+@pytest.fixture
+def salaries(make_df):
+    return make_df({"name": ["ann", "bob", "cat", "dan"],
+                    "salary": [100.0, 120.0, 80.0, 95.0]})
+
+
+def test_select_where_order(people):
+    out = daft_tpu.sql(
+        "SELECT name, age + 1 AS age1 FROM people WHERE age > 20 ORDER BY age DESC",
+        people=people,
+    ).to_pydict()
+    assert out == {"name": ["dan", "bob", "ann"], "age1": [46, 33, 26]}
+
+
+def test_join_groupby(people, salaries):
+    out = daft_tpu.sql(
+        "SELECT dept, sum(salary) AS total, count(*) AS n FROM people "
+        "JOIN salaries ON people.name = salaries.name GROUP BY dept ORDER BY dept",
+        people=people, salaries=salaries,
+    ).to_pydict()
+    assert out == {"dept": ["eng", "ops"], "total": [220.0, 175.0], "n": [2, 2]}
+
+
+def test_case_when(people):
+    out = daft_tpu.sql(
+        "SELECT CASE WHEN age >= 30 THEN 'senior' ELSE 'junior' END AS level "
+        "FROM people ORDER BY name", people=people,
+    ).to_pydict()
+    assert out["level"] == ["junior", "senior", "junior", "senior"]
+
+
+def test_cte(people):
+    out = daft_tpu.sql(
+        "WITH adults AS (SELECT * FROM people WHERE age >= 21) "
+        "SELECT count(*) AS n FROM adults", people=people,
+    ).to_pydict()
+    assert out == {"n": [3]}
+
+
+def test_having(people):
+    out = daft_tpu.sql(
+        "SELECT dept, avg(age) AS m FROM people GROUP BY dept "
+        "HAVING count(*) > 1 ORDER BY dept", people=people,
+    ).to_pydict()
+    assert out["m"] == [28.5, 32.0]
+
+
+def test_string_ops_like_in_between(people):
+    out = daft_tpu.sql(
+        "SELECT upper(name) AS u FROM people WHERE name LIKE 'a%'", people=people
+    ).to_pydict()
+    assert out == {"u": ["ANN"]}
+    out2 = daft_tpu.sql(
+        "SELECT name FROM people WHERE age BETWEEN 20 AND 40 AND dept IN ('eng') ORDER BY name",
+        people=people,
+    ).to_pydict()
+    assert out2["name"] == ["ann", "bob"]
+
+
+def test_cast_concat(people):
+    out = daft_tpu.sql(
+        "SELECT cast(age AS string) || '!' AS s FROM people ORDER BY age LIMIT 1",
+        people=people,
+    ).to_pydict()
+    assert out == {"s": ["19!"]}
+
+
+def test_distinct_union(people):
+    assert daft_tpu.sql("SELECT DISTINCT dept FROM people", people=people).count_rows() == 2
+    assert daft_tpu.sql(
+        "SELECT name FROM people UNION ALL SELECT name FROM people", people=people
+    ).count_rows() == 8
+    assert daft_tpu.sql(
+        "SELECT dept FROM people UNION SELECT dept FROM people", people=people
+    ).count_rows() == 2
+
+
+def test_sql_expr(people):
+    out = people.where(daft_tpu.sql_expr("age > 30 AND dept = 'ops'")).to_pydict()
+    assert out["name"] == ["dan"]
+
+
+def test_subquery(people):
+    out = daft_tpu.sql(
+        "SELECT count(*) AS n FROM (SELECT * FROM people WHERE dept = 'eng') t",
+        people=people,
+    ).to_pydict()
+    assert out == {"n": [2]}
+
+
+def test_is_null_not(make_df):
+    df = make_df({"x": [1, None, 3]})
+    assert daft_tpu.sql("SELECT count(*) AS n FROM df WHERE x IS NULL", df=df).to_pydict()["n"] == [1]
+    assert daft_tpu.sql("SELECT count(*) AS n FROM df WHERE x IS NOT NULL", df=df).to_pydict()["n"] == [2]
+
+
+def test_session_tables(people):
+    s = daft_tpu.current_session()
+    s.create_temp_table("people_tmp", people)
+    try:
+        assert s.sql("SELECT count(*) AS n FROM people_tmp").to_pydict() == {"n": [4]}
+        t = s.create_table("people_mem", people)
+        assert s.get_table("people_mem").read().count_rows() == 4
+        assert "people_mem" in s.list_tables()
+    finally:
+        s.detach_table("people_tmp")
+        s.drop_table("people_mem")
+
+
+def test_parse_errors():
+    from daft_tpu.sql.parser import SQLParseError
+
+    with pytest.raises(SQLParseError):
+        daft_tpu.sql_expr("1 +")
+    with pytest.raises(Exception):
+        daft_tpu.sql("SELECT * FROM nonexistent_table_xyz")
