@@ -66,10 +66,15 @@ def __getattr__(name: str):
         from daft_tpu.dataframe import creation
 
         return getattr(creation, name)
-    if name in ("read_parquet", "read_csv", "read_json", "read_text", "from_glob_path"):
+    if name in ("read_parquet", "read_csv", "read_json", "read_text", "read_warc",
+                "from_glob_path"):
         from daft_tpu.io import reads
 
         return getattr(reads, name)
+    if name == "read_source":
+        from daft_tpu.io.source import read_source
+
+        return read_source
     if name == "Session":
         from daft_tpu.session import Session
 

@@ -26,23 +26,38 @@ _SO = os.path.join(os.path.dirname(os.path.abspath(__file__)), "_daft_native.so"
 
 
 def _build() -> bool:
+    """Compile to a temp path and os.rename into place (atomic on POSIX), with
+    an flock so concurrent worker processes never dlopen a half-written .so."""
+    import fcntl
+
+    lock_path = _SO + ".lock"
+    tmp_path = f"{_SO}.{os.getpid()}.tmp"
     try:
-        subprocess.run(
-            ["g++", "-O3", "-march=native", "-shared", "-fPIC", "-std=c++17",
-             _SRC, "-o", _SO],
-            check=True, capture_output=True, timeout=120,
-        )
-        return True
-    except Exception:
-        try:
-            # Portable fallback without -march=native.
-            subprocess.run(
-                ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", _SRC, "-o", _SO],
-                check=True, capture_output=True, timeout=120,
-            )
-            return True
-        except Exception:
+        with open(lock_path, "w") as lock_f:
+            fcntl.flock(lock_f, fcntl.LOCK_EX)
+            # Another process may have finished the build while we waited.
+            if os.path.exists(_SO) and os.path.getmtime(_SO) >= os.path.getmtime(_SRC):
+                return True
+            for flags in (["-O3", "-march=native"], ["-O3"]):
+                try:
+                    subprocess.run(
+                        ["g++", *flags, "-shared", "-fPIC", "-std=c++17",
+                         _SRC, "-o", tmp_path],
+                        check=True, capture_output=True, timeout=120,
+                    )
+                    os.rename(tmp_path, _SO)
+                    return True
+                except Exception:
+                    continue
             return False
+    except Exception:
+        return False
+    finally:
+        try:
+            if os.path.exists(tmp_path):
+                os.remove(tmp_path)
+        except OSError:
+            pass
 
 
 def get_lib() -> Optional[ctypes.CDLL]:

@@ -418,6 +418,10 @@ class Expression:
     def binary(self) -> "BinaryNamespace":
         return BinaryNamespace(self)
 
+    @property
+    def url(self) -> "UrlNamespace":
+        return UrlNamespace(self)
+
     def __getitem__(self, key) -> "Expression":
         if isinstance(key, int):
             return self.list.get(key)
@@ -712,6 +716,17 @@ class BinaryNamespace(_Namespace):
 
     def slice(self, start, length=None):
         return self._fn("binary_slice", start, length=length)
+
+
+class UrlNamespace(_Namespace):
+    def download(self, on_error: str = "raise", max_connections: int = 32):
+        return self._fn("url_download", on_error=on_error, max_connections=max_connections)
+
+    def upload(self, location: str, on_error: str = "raise"):
+        return self._fn("url_upload", location=location, on_error=on_error)
+
+    def parse(self):
+        return self._fn("url_parse")
 
 
 class ExpressionsProjection:

@@ -27,11 +27,32 @@ def read_scan_task(task: ScanTask, morsel_rows: int = 128 * 1024) -> Iterator[Mi
     """Stream a scan task as MicroPartitions of ~morsel_rows rows."""
     pushdowns = task.pushdowns
     remaining = pushdowns.limit
+    if task.file_format == "python_source":
+        # Custom DataSource task (daft_tpu/io/source.py plugin surface).
+        source_task = task.read_options["source_task"]
+        for mp in source_task.execute():
+            mp = _apply_post_pushdowns(mp, task)
+            if task.pushdowns.columns is not None:
+                from daft_tpu.expressions.expr import ColumnRef
+
+                mp = mp.eval_expression_list(
+                    [ColumnRef(c) for c in task.pushdowns.columns])
+            if remaining is not None:
+                if len(mp) > remaining:
+                    mp = mp.head(remaining)
+                remaining -= len(mp)
+            if len(mp):
+                yield mp
+            if remaining is not None and remaining <= 0:
+                return
+        return
     for f in task.files:
         if remaining is not None and remaining <= 0:
             return
         if task.file_format == "parquet":
             it = _read_parquet_file(f.path, task, morsel_rows)
+        elif task.file_format == "warc":
+            it = _read_warc_file(f.path, task, morsel_rows)
         elif task.file_format == "csv":
             it = _read_csv_file(f.path, task, morsel_rows)
         elif task.file_format == "json":
@@ -170,3 +191,76 @@ def infer_schema(paths: List[str], file_format: str, read_options=None) -> Schem
 
         return Schema([Field("text", DataType.string())])
     raise DaftValueError(f"Unknown file format: {file_format}")
+
+
+def _read_warc_file(path: str, task: ScanTask, morsel_rows: int) -> Iterator[MicroPartition]:
+    """WARC (Common Crawl) reader (reference: src/daft-warc). Streams records
+    incrementally — a multi-GB archive never materialises in memory. Handles
+    plain and gzip payloads (pyarrow decompresses *.gz transparently; a
+    still-gzipped payload is wrapped in GzipFile)."""
+    import gzip
+    import io as _io
+
+    fs, p = resolve_filesystem(path)
+    stream = fs.open_input_stream(p)
+    try:
+        reader = _io.BufferedReader(_WarcRawAdapter(stream), buffer_size=1 << 20)
+        head = reader.peek(2)[:2]
+        if head == b"\x1f\x8b":
+            reader = _io.BufferedReader(gzip.GzipFile(fileobj=reader), buffer_size=1 << 20)
+        rows = {"WARC-Record-ID": [], "WARC-Type": [], "WARC-Target-URI": [],
+                "WARC-Date": [], "Content-Length": [], "warc_content": []}
+        while True:
+            line = reader.readline()
+            if not line:
+                break
+            if not line.startswith(b"WARC/"):
+                continue
+            headers = {}
+            while True:
+                h = reader.readline()
+                if not h or h in (b"\r\n", b"\n"):
+                    break
+                if b":" in h:
+                    k, v = h.split(b":", 1)
+                    headers[k.strip().decode()] = v.strip().decode()
+            length = int(headers.get("Content-Length", "0"))
+            content = reader.read(length)
+            rows["WARC-Record-ID"].append(headers.get("WARC-Record-ID"))
+            rows["WARC-Type"].append(headers.get("WARC-Type"))
+            rows["WARC-Target-URI"].append(headers.get("WARC-Target-URI"))
+            rows["WARC-Date"].append(headers.get("WARC-Date"))
+            rows["Content-Length"].append(length)
+            rows["warc_content"].append(content)
+            if len(rows["WARC-Type"]) >= morsel_rows:
+                yield MicroPartition.from_pydict(dict(rows))
+                rows = {k: [] for k in rows}
+        if rows["WARC-Type"]:
+            yield MicroPartition.from_pydict(dict(rows))
+    finally:
+        stream.close()
+
+
+class _WarcRawAdapter:
+    """Minimal raw-IO adapter so io.BufferedReader can wrap a pyarrow stream."""
+
+    def __init__(self, stream):
+        self._stream = stream
+
+    def readable(self):
+        return True
+
+    def readinto(self, b):
+        data = self._stream.read(len(b))
+        n = len(data)
+        b[:n] = data
+        return n
+
+    def read(self, n=-1):
+        return self._stream.read(n if n is not None and n >= 0 else None)
+
+    def close(self):
+        pass
+
+    closed = False
+    seekable = lambda self: False

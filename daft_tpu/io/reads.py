@@ -51,3 +51,19 @@ def from_glob_path(path: Union[str, List[str]], io_config=None) -> DataFrame:
         "path": [f.path for f in files],
         "size": [f.size_bytes for f in files],
     })
+
+
+def read_warc(path, io_config=None, **kwargs):
+    """Read WARC (Common Crawl) archives (reference: daft.read_warc)."""
+    from daft_tpu.datatype import DataType
+    from daft_tpu.schema import Field, Schema
+
+    schema = Schema([
+        Field("WARC-Record-ID", DataType.string()),
+        Field("WARC-Type", DataType.string()),
+        Field("WARC-Target-URI", DataType.string()),
+        Field("WARC-Date", DataType.string()),
+        Field("Content-Length", DataType.int64()),
+        Field("warc_content", DataType.binary()),
+    ])
+    return _read(path, "warc", schema)

@@ -1,0 +1,36 @@
+"""Pluggable DataSink ABC (reference: daft/io/sink.py:31).
+
+``DataFrame.write_sink`` drives: start() once, write(partition) per
+partition (possibly on different workers), finalize(results) once.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Generic, Iterable, List, TypeVar
+
+from daft_tpu.micropartition import MicroPartition
+
+T = TypeVar("T")
+
+
+class WriteResult(Generic[T]):
+    def __init__(self, result: T, rows: int = 0, bytes_: int = 0):
+        self.result = result
+        self.rows = rows
+        self.bytes_ = bytes_
+
+
+class DataSink(Generic[T]):
+    @property
+    def name(self) -> str:
+        return type(self).__name__
+
+    def start(self) -> None:
+        """Called once before any writes."""
+
+    def write(self, partition: MicroPartition) -> WriteResult[T]:
+        raise NotImplementedError
+
+    def finalize(self, results: List[WriteResult[T]]):
+        """Called once after all writes; returns the result table dict."""
+        return {"wrote": [r.rows for r in results]}

@@ -1,0 +1,79 @@
+"""Pluggable DataSource ABC (reference: daft/io/source.py:27).
+
+Third-party readers implement ``DataSource``/``DataSourceTask``; the engine
+plans one scan task per DataSourceTask and streams MicroPartitions from
+``execute()`` — same pushdown surface as file scans.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, List, Optional
+
+from daft_tpu.micropartition import MicroPartition
+from daft_tpu.schema import Schema
+
+
+class DataSourceTask:
+    """One unit of scan work for a custom source."""
+
+    def schema(self) -> Schema:
+        raise NotImplementedError
+
+    def execute(self) -> Iterator[MicroPartition]:
+        raise NotImplementedError
+
+    def estimate_size_bytes(self) -> Optional[int]:
+        return None
+
+
+class DataSource:
+    @property
+    def name(self) -> str:
+        return type(self).__name__
+
+    def schema(self) -> Schema:
+        raise NotImplementedError
+
+    def get_tasks(self, pushdowns=None) -> List[DataSourceTask]:
+        raise NotImplementedError
+
+    def display_name(self) -> str:
+        return self.name
+
+
+class _PythonScanInfo:
+    """Adapter presenting a DataSource as a ScanInfo (io/scan.py surface)."""
+
+    def __init__(self, source: DataSource):
+        self.source = source
+        self.schema = source.schema()
+        self.file_format = "python_source"
+        self.read_options: dict = {}
+
+    def display_name(self) -> str:
+        return f"source({self.source.display_name()})"
+
+    def estimate_rows_bytes(self):
+        tasks = self.source.get_tasks()
+        size = sum(t.estimate_size_bytes() or 0 for t in tasks)
+        row = self.schema.estimate_row_size_bytes()
+        if size:
+            return (size / max(row, 1.0), float(size))
+        return (1000.0 * len(tasks), 1000.0 * len(tasks) * row)
+
+    def to_scan_tasks(self, pushdowns, cfg):
+        from daft_tpu.io.scan import ScanTask
+
+        out = []
+        for t in self.source.get_tasks(pushdowns):
+            out.append(ScanTask([], "python_source", self.schema, pushdowns,
+                                {"source_task": t}))
+        return out
+
+
+def read_source(source: DataSource):
+    """Build a DataFrame over a custom DataSource (reference: daft.read_source)."""
+    from daft_tpu.dataframe.dataframe import DataFrame
+    from daft_tpu.logical.builder import LogicalPlanBuilder
+
+    return DataFrame(LogicalPlanBuilder.scan(_PythonScanInfo(source)))

@@ -95,6 +95,7 @@ def _ensure_loaded() -> None:
             string_ops,
             struct_map_ops,
             temporal_ops,
+            uri_ops,
         )
 
         _loaded = True

@@ -230,9 +230,33 @@ def _ilike(args, **kwargs):
 
 @register_kernel("str_substr", returns(_STR))
 def _substr(args, length=None, **kwargs):
-    start = int(args[1].to_pylist()[0])
-    stop = None if length is None else start + int(length)
-    return _wrap(pc.utf8_slice_codeunits(_s(args).to_arrow(), start, stop), args[0].name, _STR)
+    starts = args[1].to_pylist()
+    lengths = args[2].to_pylist() if len(args) >= 3 else None
+    uniq_start = set(starts)
+    uniq_len = set(lengths) if lengths is not None else {length}
+    if len(uniq_start) == 1 and len(uniq_len) == 1:
+        # Scalar fast path via the Arrow C++ kernel.
+        start = int(starts[0] or 0)
+        ln = uniq_len.pop()
+        stop = None if ln is None else start + int(ln)
+        return _wrap(pc.utf8_slice_codeunits(_s(args).to_arrow(), start, stop),
+                     args[0].name, _STR)
+    # Per-row starts/lengths.
+    out = []
+    vals = _s(args).to_pylist()
+    n = len(vals)
+    starts = starts * n if len(starts) == 1 else starts
+    if lengths is None:
+        lengths = [length] * n
+    elif len(lengths) == 1:
+        lengths = lengths * n
+    for v, st, ln in zip(vals, starts, lengths):
+        if v is None or st is None:
+            out.append(None)
+        else:
+            st = max(0, int(st))
+            out.append(v[st:] if ln is None else v[st:st + int(ln)])
+    return Series.from_pylist(out, args[0].name, _STR)
 
 
 @register_kernel("str_to_date", returns(DataType.date()))

@@ -102,4 +102,4 @@ class Session:
     def sql(self, query: str, **bindings):
         from daft_tpu.sql.planner import plan_sql
 
-        return plan_sql(query, bindings)
+        return plan_sql(query, bindings, session=self)

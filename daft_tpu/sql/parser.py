@@ -227,7 +227,7 @@ class Parser:
         self.expect("eof")
         return stmt
 
-    def parse_select(self) -> SelectStmt:
+    def parse_select(self, in_union: bool = False) -> SelectStmt:
         self.expect("kw", "select")
         stmt = SelectStmt(projections=[])
         stmt.distinct = bool(self.accept_kw("distinct"))
@@ -276,7 +276,11 @@ class Parser:
             stmt.having = self.parse_expr()
         if self.accept_kw("union"):
             mode = "all" if self.accept_kw("all") else "distinct"
-            stmt.union = (mode, self.parse_select())
+            # The right arm must NOT consume a trailing ORDER BY/LIMIT — in a
+            # union chain those apply to the whole union result.
+            stmt.union = (mode, self.parse_select(in_union=True))
+        if in_union:
+            return stmt
         if self.accept_kw("order"):
             self.expect("kw", "by")
             while True:
@@ -306,12 +310,20 @@ class Parser:
         if self.accept_kw("join") or self.accept_kw("inner"):
             self.accept_kw("join")
             return "inner"
-        for kw, how in (("left", "left"), ("right", "right"), ("full", "outer"),
-                        ("semi", "semi"), ("anti", "anti")):
+        if self.accept_kw("semi"):
+            self.expect("kw", "join")
+            return "semi"
+        if self.accept_kw("anti"):
+            self.expect("kw", "join")
+            return "anti"
+        for kw, how in (("left", "left"), ("right", "right"), ("full", "outer")):
             if self.accept_kw(kw):
                 self.accept_kw("outer")
-                self.accept_kw("semi")
-                self.accept_kw("anti")
+                # LEFT SEMI / LEFT ANTI override the outer kind.
+                if self.accept_kw("semi"):
+                    how = "semi"
+                elif self.accept_kw("anti"):
+                    how = "anti"
                 self.expect("kw", "join")
                 return how
         return None
@@ -528,7 +540,11 @@ class Parser:
         if name_l in _AGG_FUNCS:
             op = {"avg": "mean", "array_agg": "list", "stddev_pop": "stddev",
                   "var_pop": "variance", "mean": "mean"}.get(name_l, name_l)
-            if name_l == "count" and distinct:
+            if distinct:
+                if name_l != "count":
+                    raise SQLParseError(
+                        f"DISTINCT inside {name_l}() is not supported (only COUNT(DISTINCT ...))"
+                    )
                 op = "count_distinct"
             return AggOp(op, args[0] if args else Literal(1))
         if name_l == "abs":
@@ -542,14 +558,15 @@ class Parser:
             assert isinstance(unit, Literal)
             return FunctionCall("dt_truncate", [args[1]], {"interval": f"1 {unit.value}"})
         if name_l == "substr" or name_l == "substring":
-            kwargs = {}
+            # SQL is 1-based: shift start by -1 as an expression so per-row
+            # (column) starts work too.
+            start = BinaryOp("sub", args[1], Literal(1))
+            if isinstance(args[1], Literal):
+                start = Literal(max(0, args[1].value - 1))
+            call_args = [args[0], start]
             if len(args) >= 3:
-                lit_len = args[2]
-                kwargs["length"] = lit_len.value if isinstance(lit_len, Literal) else None
-            start = args[1]
-            if isinstance(start, Literal):
-                start = Literal(max(0, start.value - 1))  # SQL is 1-based
-            return FunctionCall("str_substr", [args[0], start], kwargs)
+                call_args.append(args[2])
+            return FunctionCall("str_substr", call_args)
         kernel = _FUNC_MAP.get(name_l, name_l)
         if kernel is None:
             kernel = name_l
@@ -563,6 +580,11 @@ class Parser:
 
     def _literal_value(self):
         t = self.next()
+        if t.kind == "op" and t.value == "-":
+            inner = self._literal_value()
+            if not isinstance(inner, (int, float)):
+                raise SQLParseError("Expected numeric literal after '-'")
+            return -inner
         if t.kind == "int":
             return int(t.value)
         if t.kind == "float":

@@ -20,38 +20,37 @@ from daft_tpu.expressions.expr import (
 from daft_tpu.sql.parser import JoinClause, SelectStmt, SubqueryRef, TableRef, parse_sql
 
 
-def plan_sql(query: str, bindings: Dict[str, object]):
-    from daft_tpu.dataframe.dataframe import DataFrame
-
+def plan_sql(query: str, bindings: Dict[str, object], session=None):
     stmt = parse_sql(query)
-    df = _plan_select(stmt, bindings, dict(stmt.ctes))
-    return df
+    return _plan_select(stmt, bindings, dict(stmt.ctes), session)
 
 
-def _resolve_source(src, bindings, ctes):
+def _resolve_source(src, bindings, ctes, session=None):
     from daft_tpu.dataframe.dataframe import DataFrame
 
     if isinstance(src, SubqueryRef):
-        return _plan_select(src.query, bindings, ctes)
+        return _plan_select(src.query, bindings, ctes, session)
     assert isinstance(src, TableRef)
     name = src.name
     if name in ctes:
-        return _plan_select(ctes[name], bindings, ctes)
+        return _plan_select(ctes[name], bindings, ctes, session)
     if name in bindings:
         obj = bindings[name]
         if isinstance(obj, DataFrame):
             return obj
-    # Session catalog lookup.
+    # Session catalog lookup: the calling Session first, then the global one.
     from daft_tpu.session import current_session
 
-    sess = current_session()
-    table = sess.get_table(name) if sess else None
-    if table is not None:
-        return table.read()
+    for sess in (session, current_session()):
+        if sess is None:
+            continue
+        table = sess.get_table(name)
+        if table is not None:
+            return table.read()
     raise DaftValueError(f"Unknown table {name!r} in SQL query")
 
 
-def _plan_select(stmt: SelectStmt, bindings, ctes):
+def _plan_select(stmt: SelectStmt, bindings, ctes, session=None):
     from daft_tpu.dataframe.dataframe import DataFrame
     from daft_tpu.expressions.expression import Expression
 
@@ -61,9 +60,9 @@ def _plan_select(stmt: SelectStmt, bindings, ctes):
 
         df = daft_tpu.from_pydict({"__dummy": [1]})
     else:
-        df = _resolve_source(stmt.source, bindings, ctes)
+        df = _resolve_source(stmt.source, bindings, ctes, session)
     for join in stmt.joins:
-        right = _resolve_source(join.right, bindings, ctes)
+        right = _resolve_source(join.right, bindings, ctes, session)
         if join.how == "cross":
             df = df.cross_join(right)
             continue
@@ -171,7 +170,7 @@ def _plan_select(stmt: SelectStmt, bindings, ctes):
         df = df.distinct()
     if stmt.union is not None:
         mode, other_stmt = stmt.union
-        other = _plan_select(other_stmt, bindings, ctes)
+        other = _plan_select(other_stmt, bindings, ctes, session)
         df = df.concat(other)
         if mode == "distinct":
             df = df.distinct()

@@ -1,0 +1,182 @@
+"""TPC-H-style analytic queries through the DataFrame and SQL APIs.
+
+Reference: tests/benchmarks/test_local_tpch.py + benchmarking/tpch. Queries
+Q1/Q3/Q5(simplified)/Q6 run at a small scale with results cross-checked
+against pandas; set DAFT_BENCH_SCALE to raise scale for timing runs.
+"""
+
+import datetime
+import os
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import daft_tpu
+from daft_tpu import col, lit
+
+from .tpch_data import generate_tpch
+
+SCALE = int(os.environ.get("DAFT_BENCH_SCALE", "20000"))
+
+
+@pytest.fixture(scope="module")
+def tables():
+    return generate_tpch(SCALE)
+
+
+@pytest.fixture(scope="module")
+def pandas_tables(tables):
+    return {k: v.to_pandas() for k, v in tables.items()}
+
+
+def test_q1_pricing_summary(tables, pandas_tables):
+    cutoff = datetime.date(1998, 9, 2)
+    li = tables["lineitem"]
+    out = (
+        li.where(col("l_shipdate") <= lit(cutoff))
+        .groupby("l_returnflag", "l_linestatus")
+        .agg(
+            col("l_quantity").sum().alias("sum_qty"),
+            col("l_extendedprice").sum().alias("sum_base_price"),
+            (col("l_extendedprice") * (1 - col("l_discount"))).sum().alias("sum_disc_price"),
+            (col("l_extendedprice") * (1 - col("l_discount")) * (1 + col("l_tax"))).sum().alias("sum_charge"),
+            col("l_quantity").mean().alias("avg_qty"),
+            col("l_extendedprice").mean().alias("avg_price"),
+            col("l_discount").mean().alias("avg_disc"),
+            col("l_quantity").count().alias("count_order"),
+        )
+        .sort(["l_returnflag", "l_linestatus"])
+        .to_pandas()
+    )
+    pli = pandas_tables["lineitem"]
+    pli = pli[pli["l_shipdate"] <= cutoff]
+    ref = (
+        pli.assign(
+            disc_price=pli.l_extendedprice * (1 - pli.l_discount),
+            charge=pli.l_extendedprice * (1 - pli.l_discount) * (1 + pli.l_tax),
+        )
+        .groupby(["l_returnflag", "l_linestatus"], as_index=False)
+        .agg(
+            sum_qty=("l_quantity", "sum"), sum_base_price=("l_extendedprice", "sum"),
+            sum_disc_price=("disc_price", "sum"), sum_charge=("charge", "sum"),
+            avg_qty=("l_quantity", "mean"), avg_price=("l_extendedprice", "mean"),
+            avg_disc=("l_discount", "mean"), count_order=("l_quantity", "count"),
+        )
+        .sort_values(["l_returnflag", "l_linestatus"])
+        .reset_index(drop=True)
+    )
+    np.testing.assert_allclose(out["sum_disc_price"], ref["sum_disc_price"], rtol=1e-9)
+    np.testing.assert_allclose(out["avg_qty"], ref["avg_qty"], rtol=1e-9)
+    assert list(out["count_order"]) == list(ref["count_order"])
+
+
+def test_q3_shipping_priority(tables, pandas_tables):
+    cutoff = datetime.date(1995, 3, 15)
+    cust = tables["customer"].where(col("c_mktsegment") == "BUILDING")
+    orders = tables["orders"].where(col("o_orderdate") < lit(cutoff))
+    li = tables["lineitem"].where(col("l_shipdate") > lit(cutoff))
+    out = (
+        cust.join(orders, left_on="c_custkey", right_on="o_custkey")
+        .join(li, left_on="o_orderkey", right_on="l_orderkey")
+        .with_column("revenue", col("l_extendedprice") * (1 - col("l_discount")))
+        .groupby("o_orderkey", "o_orderdate", "o_shippriority")
+        .agg(col("revenue").sum().alias("revenue"))
+        .sort(["revenue", "o_orderdate"], desc=[True, False])
+        .limit(10)
+        .to_pandas()
+    )
+    pc_, po, pl = (pandas_tables["customer"], pandas_tables["orders"], pandas_tables["lineitem"])
+    pc_ = pc_[pc_.c_mktsegment == "BUILDING"]
+    po = po[po.o_orderdate < cutoff]
+    pl = pl[pl.l_shipdate > cutoff]
+    merged = pc_.merge(po, left_on="c_custkey", right_on="o_custkey").merge(
+        pl, left_on="o_orderkey", right_on="l_orderkey"
+    )
+    merged["revenue"] = merged.l_extendedprice * (1 - merged.l_discount)
+    ref = (
+        merged.groupby(["o_orderkey", "o_orderdate", "o_shippriority"], as_index=False)
+        .agg(revenue=("revenue", "sum"))
+        .sort_values(["revenue", "o_orderdate"], ascending=[False, True])
+        .head(10)
+        .reset_index(drop=True)
+    )
+    np.testing.assert_allclose(out["revenue"], ref["revenue"], rtol=1e-9)
+    assert list(out["o_orderkey"]) == list(ref["o_orderkey"])
+
+
+def test_q6_forecast_revenue(tables, pandas_tables):
+    lo, hi = datetime.date(1994, 1, 1), datetime.date(1995, 1, 1)
+    li = tables["lineitem"]
+    out = (
+        li.where(
+            (col("l_shipdate") >= lit(lo)) & (col("l_shipdate") < lit(hi))
+            & (col("l_discount") >= 0.05) & (col("l_discount") <= 0.07)
+            & (col("l_quantity") < 24)
+        )
+        .agg((col("l_extendedprice") * col("l_discount")).sum().alias("revenue"))
+        .to_pydict()
+    )
+    pl = pandas_tables["lineitem"]
+    mask = ((pl.l_shipdate >= lo) & (pl.l_shipdate < hi)
+            & (pl.l_discount >= 0.05) & (pl.l_discount <= 0.07) & (pl.l_quantity < 24))
+    ref = (pl[mask].l_extendedprice * pl[mask].l_discount).sum()
+    assert out["revenue"][0] == pytest.approx(ref, rel=1e-9)
+
+
+def test_q5_local_supplier_volume_sql(tables, pandas_tables):
+    """Simplified Q5 via SQL: revenue per nation."""
+    lineitem, orders, customer, nation = (
+        tables["lineitem"], tables["orders"], tables["customer"], tables["nation"]
+    )
+    out = daft_tpu.sql(
+        "SELECT n_name, sum(l_extendedprice * (1 - l_discount)) AS revenue "
+        "FROM customer "
+        "JOIN orders ON c_custkey = o_custkey "
+        "JOIN lineitem ON o_orderkey = l_orderkey "
+        "JOIN nation ON c_nationkey = n_nationkey "
+        "GROUP BY n_name ORDER BY revenue DESC",
+        customer=customer, orders=orders, lineitem=lineitem, nation=nation,
+    ).to_pandas()
+    pc_, po, pl, pn = (pandas_tables["customer"], pandas_tables["orders"],
+                       pandas_tables["lineitem"], pandas_tables["nation"])
+    merged = (pc_.merge(po, left_on="c_custkey", right_on="o_custkey")
+                 .merge(pl, left_on="o_orderkey", right_on="l_orderkey")
+                 .merge(pn, left_on="c_nationkey", right_on="n_nationkey"))
+    merged["revenue"] = merged.l_extendedprice * (1 - merged.l_discount)
+    ref = (merged.groupby("n_name", as_index=False).agg(revenue=("revenue", "sum"))
+                 .sort_values("revenue", ascending=False).reset_index(drop=True))
+    np.testing.assert_allclose(out["revenue"], ref["revenue"], rtol=1e-9)
+    assert list(out["n_name"]) == list(ref["n_name"])
+
+
+def test_q1_distributed_matches_native(tables):
+    """Q1 on the distributed runner must match the native runner exactly."""
+    from daft_tpu.runners.distributed import DistributedRunner
+
+    cutoff = datetime.date(1998, 9, 2)
+
+    def q1(li):
+        return (
+            li.where(col("l_shipdate") <= lit(cutoff))
+            .groupby("l_returnflag", "l_linestatus")
+            .agg(
+                (col("l_extendedprice") * (1 - col("l_discount"))).sum().alias("rev"),
+                col("l_quantity").count().alias("n"),
+            )
+            .sort(["l_returnflag", "l_linestatus"])
+            .to_pydict()
+        )
+
+    native = q1(tables["lineitem"])
+    ctx = daft_tpu.get_context()
+    old = ctx._runner
+    runner = DistributedRunner(num_workers=3)
+    ctx.set_runner(runner)
+    try:
+        dist = q1(tables["lineitem"].into_partitions(5))
+    finally:
+        runner.manager.shutdown()
+        ctx.set_runner(old)
+    assert native["n"] == dist["n"]
+    np.testing.assert_allclose(native["rev"], dist["rev"], rtol=1e-12)

@@ -1,0 +1,70 @@
+"""Tiny TPC-H data generator (reference: benchmarking/tpch + tests/benchmarks/
+test_local_tpch.py use dbgen; here a seeded numpy generator with the same
+schema/relationships at configurable scale)."""
+
+from __future__ import annotations
+
+import datetime
+
+import numpy as np
+
+import daft_tpu
+
+_SEGMENTS = ["AUTOMOBILE", "BUILDING", "FURNITURE", "HOUSEHOLD", "MACHINERY"]
+_PRIORITIES = ["1-URGENT", "2-HIGH", "3-MEDIUM", "4-NOT SPECIFIED", "5-LOW"]
+_SHIPMODES = ["AIR", "FOB", "MAIL", "RAIL", "REG AIR", "SHIP", "TRUCK"]
+_NATIONS = ["ALGERIA", "ARGENTINA", "BRAZIL", "CANADA", "EGYPT", "ETHIOPIA",
+            "FRANCE", "GERMANY", "INDIA", "INDONESIA"]
+_EPOCH = datetime.date(1992, 1, 1)
+
+
+def generate_tpch(scale_rows: int = 10_000, seed: int = 0):
+    """Returns dict of DataFrames: lineitem, orders, customer, nation."""
+    rng = np.random.default_rng(seed)
+    n_orders = max(scale_rows // 4, 1)
+    n_customers = max(n_orders // 10, 1)
+    n_li = scale_rows
+
+    customer = daft_tpu.from_pydict({
+        "c_custkey": np.arange(n_customers, dtype=np.int64),
+        "c_name": [f"Customer#{i:09d}" for i in range(n_customers)],
+        "c_nationkey": rng.integers(0, len(_NATIONS), n_customers).astype(np.int64),
+        "c_mktsegment": [_SEGMENTS[i] for i in rng.integers(0, len(_SEGMENTS), n_customers)],
+        "c_acctbal": np.round(rng.uniform(-999, 9999, n_customers), 2),
+    })
+    order_dates = rng.integers(0, 2400, n_orders)
+    orders = daft_tpu.from_pydict({
+        "o_orderkey": np.arange(n_orders, dtype=np.int64),
+        "o_custkey": rng.integers(0, n_customers, n_orders).astype(np.int64),
+        "o_orderstatus": [["F", "O", "P"][i] for i in rng.integers(0, 3, n_orders)],
+        "o_totalprice": np.round(rng.uniform(800, 500000, n_orders), 2),
+        "o_orderdate": [_EPOCH + datetime.timedelta(days=int(d)) for d in order_dates],
+        "o_orderpriority": [_PRIORITIES[i] for i in rng.integers(0, 5, n_orders)],
+        "o_shippriority": np.zeros(n_orders, dtype=np.int32),
+    })
+    li_order = rng.integers(0, n_orders, n_li).astype(np.int64)
+    ship_delay = rng.integers(1, 121, n_li)
+    qty = rng.integers(1, 51, n_li).astype(np.float64)
+    price = np.round(rng.uniform(900, 105000, n_li), 2)
+    disc = np.round(rng.uniform(0.0, 0.1, n_li), 2)
+    tax = np.round(rng.uniform(0.0, 0.08, n_li), 2)
+    ship_dates = [
+        _EPOCH + datetime.timedelta(days=int(order_dates[o]) + int(d))
+        for o, d in zip(li_order, ship_delay)
+    ]
+    lineitem = daft_tpu.from_pydict({
+        "l_orderkey": li_order,
+        "l_quantity": qty,
+        "l_extendedprice": price,
+        "l_discount": disc,
+        "l_tax": tax,
+        "l_returnflag": [["A", "N", "R"][i] for i in rng.integers(0, 3, n_li)],
+        "l_linestatus": [["F", "O"][i] for i in rng.integers(0, 2, n_li)],
+        "l_shipdate": ship_dates,
+        "l_shipmode": [_SHIPMODES[i] for i in rng.integers(0, len(_SHIPMODES), n_li)],
+    })
+    nation = daft_tpu.from_pydict({
+        "n_nationkey": np.arange(len(_NATIONS), dtype=np.int64),
+        "n_name": _NATIONS,
+    })
+    return {"lineitem": lineitem, "orders": orders, "customer": customer, "nation": nation}

@@ -1,0 +1,86 @@
+"""URL kernels, WARC reader, DataSource/DataSink plugin tests."""
+
+import gzip
+import os
+
+import pytest
+
+import daft_tpu
+from daft_tpu import col
+from daft_tpu.datatype import DataType
+from daft_tpu.io.sink import DataSink, WriteResult
+from daft_tpu.io.source import DataSource, DataSourceTask, read_source
+from daft_tpu.micropartition import MicroPartition
+from daft_tpu.schema import Field, Schema
+
+
+def test_url_download_upload(tmp_path):
+    for i in range(2):
+        (tmp_path / f"f{i}.bin").write_bytes(f"payload-{i}".encode())
+    df = daft_tpu.from_pydict({"p": [str(tmp_path / "f0.bin"), str(tmp_path / "f1.bin"), None]})
+    out = df.with_column("d", col("p").url.download(on_error="null")).to_pydict()
+    assert out["d"] == [b"payload-0", b"payload-1", None]
+    up = daft_tpu.from_pydict({"d": [b"abc"]})
+    res = up.with_column("loc", col("d").url.upload(location=str(tmp_path / "up"))).to_pydict()
+    assert os.path.exists(res["loc"][0])
+    with pytest.raises(Exception):
+        daft_tpu.from_pydict({"p": ["/nope/missing"]}).select(col("p").url.download()).to_pydict()
+
+
+def test_url_parse():
+    out = daft_tpu.from_pydict({"u": ["https://example.com:8080/p?q=1#f"]}).select(
+        col("u").url.parse()
+    ).to_pydict()["u"][0]
+    assert out["scheme"] == "https" and out["host"] == "example.com" and out["port"] == 8080
+
+
+def test_warc_reader(tmp_path):
+    rec = (b"WARC/1.0\r\nWARC-Type: response\r\nWARC-Record-ID: <urn:uuid:1>\r\n"
+           b"WARC-Target-URI: http://x.test/\r\nWARC-Date: 2024-01-01T00:00:00Z\r\n"
+           b"Content-Length: 11\r\n\r\nhello world\r\n\r\n")
+    path = tmp_path / "t.warc.gz"
+    path.write_bytes(gzip.compress(rec * 3))
+    w = daft_tpu.read_warc(str(path))
+    assert w.count_rows() == 3
+    d = w.to_pydict()
+    assert d["warc_content"][0] == b"hello world"
+    assert d["WARC-Type"] == ["response"] * 3
+
+
+class _RangeTask(DataSourceTask):
+    def __init__(self, lo, hi):
+        self.lo, self.hi = lo, hi
+
+    def schema(self):
+        return Schema([Field("x", DataType.int64())])
+
+    def execute(self):
+        yield MicroPartition.from_pydict({"x": list(range(self.lo, self.hi))})
+
+
+class _RangeSource(DataSource):
+    def schema(self):
+        return Schema([Field("x", DataType.int64())])
+
+    def get_tasks(self, pushdowns=None):
+        return [_RangeTask(0, 5), _RangeTask(5, 10)]
+
+
+def test_data_source_plugin():
+    df = read_source(_RangeSource())
+    assert df.count_rows() == 10
+    assert df.where(col("x") > 6).to_pydict()["x"] == [7, 8, 9]
+    assert df.limit(3).count_rows() == 3
+    assert df.select((col("x") * 2).alias("y")).sum("y").to_pydict()["y"] == [90]
+
+
+def test_data_sink_plugin():
+    class CollectSink(DataSink):
+        def write(self, p):
+            return WriteResult(None, len(p))
+
+        def finalize(self, results):
+            return {"total": [sum(r.rows for r in results)]}
+
+    out = daft_tpu.from_pydict({"a": [1, 2, 3]}).write_sink(CollectSink())
+    assert out.to_pydict() == {"total": [3]}

@@ -129,3 +129,50 @@ def test_parse_errors():
         daft_tpu.sql_expr("1 +")
     with pytest.raises(Exception):
         daft_tpu.sql("SELECT * FROM nonexistent_table_xyz")
+
+
+def test_semi_anti_join(people, salaries):
+    out = daft_tpu.sql(
+        "SELECT name FROM people LEFT SEMI JOIN salaries ON people.name = salaries.name "
+        "ORDER BY name", people=people, salaries=salaries,
+    ).to_pydict()
+    assert out == {"name": ["ann", "bob", "cat", "dan"]}
+    only = daft_tpu.from_pydict({"name": ["ann"], "x": [1]})
+    anti = daft_tpu.sql(
+        "SELECT name FROM people ANTI JOIN only ON people.name = only.name ORDER BY name",
+        people=people, only=only,
+    ).to_pydict()
+    assert anti == {"name": ["bob", "cat", "dan"]}
+
+
+def test_union_order_limit_applies_to_whole(people):
+    out = daft_tpu.sql(
+        "SELECT age FROM people UNION ALL SELECT age FROM people ORDER BY age LIMIT 3",
+        people=people,
+    ).to_pydict()
+    assert out == {"age": [19, 19, 25]}
+
+
+def test_in_negative_numbers(make_df):
+    df = make_df({"x": [-1, 2, 3]})
+    out = daft_tpu.sql("SELECT x FROM df WHERE x IN (-1, 3) ORDER BY x", df=df).to_pydict()
+    assert out == {"x": [-1, 3]}
+
+
+def test_substr_per_row(make_df):
+    df = make_df({"s": ["abcdef", "xyzw"], "start": [2, 1], "n": [3, 2]})
+    out = daft_tpu.sql("SELECT substr(s, start, n) AS sub FROM df", df=df).to_pydict()
+    assert out == {"sub": ["bcd", "xy"]}
+
+
+def test_distinct_in_sum_rejected(people):
+    from daft_tpu.sql.parser import SQLParseError
+
+    with pytest.raises(SQLParseError):
+        daft_tpu.sql("SELECT sum(DISTINCT age) FROM people", people=people)
+
+
+def test_private_session_tables(people):
+    s = daft_tpu.Session()
+    s.create_temp_table("mine", people)
+    assert s.sql("SELECT count(*) AS n FROM mine").to_pydict() == {"n": [4]}
