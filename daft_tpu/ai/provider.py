@@ -54,6 +54,8 @@ def load_provider(provider: "str | Provider | None", **options) -> Provider:
 
 def _ensure_builtins() -> None:
     from daft_tpu.ai.flax_provider import FlaxProvider
+    from daft_tpu.ai.stub_providers import register_stub_providers
 
     _PROVIDERS.setdefault("flax", lambda **kw: FlaxProvider(**kw))
     _PROVIDERS.setdefault("flax_random", lambda **kw: FlaxProvider(random_init=True, **kw))
+    register_stub_providers()

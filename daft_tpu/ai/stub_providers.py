@@ -1,0 +1,170 @@
+"""Additional AI providers: torch-transformers (CPU/local-weights) + API
+provider stubs.
+
+Reference: the reference ships openai / transformers / google / lm_studio /
+vllm providers (daft/ai/*). Here:
+
+* ``transformers`` — a working provider over torch transformers (CPU in this
+  image) for locally-available model weights; same protocol surface as the
+  flax provider.
+* ``openai`` / ``google`` / ``lm_studio`` / ``vllm`` — registered names with
+  the same descriptor surface that raise actionable errors at instantiation
+  when credentials/endpoints/deps are unavailable (zero-egress environment).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from daft_tpu.ai.protocols import Descriptor, UDFOptions
+from daft_tpu.ai.provider import Provider
+from daft_tpu.errors import DaftValueError
+
+
+class TorchTextEmbedder:
+    """sentence-transformers-style mean-pooled embedder over torch
+    transformers (reference: daft/ai/transformers provider)."""
+
+    def __init__(self, model_name: str, **options):
+        import torch
+        from transformers import AutoModel, AutoTokenizer
+
+        self.tokenizer = AutoTokenizer.from_pretrained(model_name)
+        self.model = AutoModel.from_pretrained(model_name)
+        self.model.eval()
+        self.torch = torch
+
+    @property
+    def dimensions(self) -> int:
+        return int(self.model.config.hidden_size)
+
+    def embed_text(self, texts: Sequence[Optional[str]]) -> np.ndarray:
+        torch = self.torch
+        clean = [t or "" for t in texts]
+        with torch.inference_mode():
+            enc = self.tokenizer(clean, padding=True, truncation=True,
+                                 max_length=256, return_tensors="pt")
+            out = self.model(**enc).last_hidden_state
+            mask = enc["attention_mask"].unsqueeze(-1).float()
+            pooled = (out * mask).sum(1) / mask.sum(1).clamp(min=1.0)
+            pooled = torch.nn.functional.normalize(pooled, dim=-1)
+        return pooled.numpy().astype(np.float32)
+
+
+class _TorchDescriptor(Descriptor):
+    def __init__(self, kind: str, model: str, options: Dict[str, Any]):
+        self.kind = kind
+        self.model = model
+        self.options = options
+
+    def get_provider(self) -> str:
+        return "transformers"
+
+    def get_model(self) -> str:
+        return self.model
+
+    def get_udf_options(self) -> UDFOptions:
+        return UDFOptions(batch_size=self.options.get("batch_size", 64),
+                          max_concurrency=self.options.get("max_concurrency", 1),
+                          tpus=0.0)
+
+    def get_dimensions(self) -> Optional[int]:
+        return self.options.get("dimensions")
+
+    def instantiate(self):
+        if self.kind == "text_embedder":
+            return TorchTextEmbedder(self.model, **self.options)
+        raise DaftValueError(f"transformers provider: {self.kind} not supported yet")
+
+
+class TorchTransformersProvider(Provider):
+    name = "transformers"
+
+    def __init__(self, **options):
+        self.options = options
+
+    def get_text_embedder(self, model: Optional[str] = None, **options) -> _TorchDescriptor:
+        return _TorchDescriptor("text_embedder",
+                                model or "sentence-transformers/all-MiniLM-L6-v2",
+                                {**self.options, **options})
+
+
+class _UnavailableDescriptor(Descriptor):
+    def __init__(self, provider: str, kind: str, model: str, reason: str):
+        self.provider_name = provider
+        self.kind = kind
+        self.model = model
+        self.reason = reason
+
+    def get_provider(self) -> str:
+        return self.provider_name
+
+    def get_model(self) -> str:
+        return self.model
+
+    def instantiate(self):
+        raise DaftValueError(
+            f"Provider {self.provider_name!r} is registered but unavailable here: "
+            f"{self.reason}"
+        )
+
+
+class _ApiProvider(Provider):
+    """Shared shape for API-backed providers (openai/google/lm_studio/vllm)."""
+
+    reason = "requires network access / credentials"
+
+    def __init__(self, **options):
+        self.options = options
+
+    def _desc(self, kind: str, model: Optional[str]) -> _UnavailableDescriptor:
+        return _UnavailableDescriptor(self.name, kind, model or "default", self.reason)
+
+    def get_text_embedder(self, model=None, **options):
+        return self._desc("text_embedder", model)
+
+    def get_image_embedder(self, model=None, **options):
+        return self._desc("image_embedder", model)
+
+    def get_text_classifier(self, model=None, **options):
+        return self._desc("text_classifier", model)
+
+    def get_image_classifier(self, model=None, **options):
+        return self._desc("image_classifier", model)
+
+    def get_prompter(self, model=None, **options):
+        return self._desc("prompter", model)
+
+
+class OpenAIProvider(_ApiProvider):
+    name = "openai"
+    reason = "requires OPENAI_API_KEY and network egress"
+
+
+class GoogleProvider(_ApiProvider):
+    name = "google"
+    reason = "requires Google GenAI credentials and network egress"
+
+
+class LMStudioProvider(_ApiProvider):
+    name = "lm_studio"
+    reason = "requires a running LM Studio endpoint"
+
+
+class VLLMProvider(_ApiProvider):
+    name = "vllm"
+    reason = "vLLM is CUDA-based; use provider='flax' on TPU"
+
+
+def register_stub_providers() -> None:
+    # setdefault: never clobber a provider the user registered under these
+    # names before the builtins loaded.
+    from daft_tpu.ai import provider as _p
+
+    _p._PROVIDERS.setdefault("transformers", lambda **kw: TorchTransformersProvider(**kw))
+    _p._PROVIDERS.setdefault("openai", lambda **kw: OpenAIProvider(**kw))
+    _p._PROVIDERS.setdefault("google", lambda **kw: GoogleProvider(**kw))
+    _p._PROVIDERS.setdefault("lm_studio", lambda **kw: LMStudioProvider(**kw))
+    _p._PROVIDERS.setdefault("vllm", lambda **kw: VLLMProvider(**kw))

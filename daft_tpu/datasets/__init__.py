@@ -1,0 +1,36 @@
+"""Curated dataset loaders (reference: daft/datasets — common_crawl.py,
+lerobot.py, droid.py)."""
+
+from __future__ import annotations
+
+
+def common_crawl(segment_paths, content: str = "raw", **kwargs):
+    """Load Common Crawl WARC segments (reference: daft/datasets/common_crawl.py).
+
+    ``segment_paths``: WARC file path(s)/glob (local or object store). In
+    connected environments pass the public CC segment URLs.
+    """
+    import daft_tpu
+
+    df = daft_tpu.read_warc(segment_paths)
+    if content == "text":
+        from daft_tpu.datatype import DataType
+        from daft_tpu.expressions.expression import col
+
+        df = df.with_column("text", col("warc_content").cast(DataType.string()))
+    return df
+
+
+def lerobot(repo_path: str, **kwargs):
+    """LeRobot episode datasets: parquet episode tables under the repo path
+    (reference: daft/datasets/lerobot.py)."""
+    import daft_tpu
+
+    return daft_tpu.read_parquet(f"{repo_path}/data/**/*.parquet")
+
+
+def droid(path: str, **kwargs):
+    """DROID robot-manipulation dataset (reference: daft/datasets/droid.py)."""
+    import daft_tpu
+
+    return daft_tpu.read_parquet(path)

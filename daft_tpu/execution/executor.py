@@ -53,20 +53,62 @@ def _remorsel(it: Iterator[MicroPartition], max_rows: int) -> Iterator[MicroPart
 class Executor:
     """Runs a local physical plan, yielding result MicroPartitions."""
 
-    def __init__(self, cfg, num_io_threads: int = 8, partition_offset: int = 0):
+    def __init__(self, cfg, num_io_threads: int = 8, partition_offset: int = 0,
+                 stats=None):
+        from daft_tpu.execution.resource_manager import get_memory_manager
+
         self.cfg = cfg
         self.num_io_threads = num_io_threads
         self.partition_offset = partition_offset
+        self.stats = stats  # RuntimeStats | None
+        self.memory = get_memory_manager()
+        self._held_bytes = 0
+        self._op_stack: List[str] = []
 
     def run(self, plan: pp.PhysicalPlan) -> Iterator[MicroPartition]:
-        yield from self._run(plan)
+        try:
+            yield from self._run(plan)
+        finally:
+            if self._held_bytes:
+                self.memory.release(self._held_bytes)
+                self._held_bytes = 0
+            if self.stats is not None:
+                self.stats.flush()
 
     # ------------------------------------------------------------------ #
     def _run(self, node: pp.PhysicalPlan) -> Iterator[MicroPartition]:
         handler = getattr(self, f"_run_{type(node).__name__}", None)
         if handler is None:
             raise DaftPlanError(f"No executor for physical node {node.name()}")
-        return handler(node)
+        it = handler(node)
+        if self.stats is None:
+            return it
+        return self._instrumented(node.name(), it)
+
+    def _instrumented(self, op: str, it: Iterator[MicroPartition]) -> Iterator[MicroPartition]:
+        """Per-operator counters with EXCLUSIVE cpu attribution: each level
+        subtracts its inclusive time from its parent (the op stack tracks the
+        current pull chain), so summing operator cpu ~= query cpu."""
+        import time as _time
+
+        while True:
+            t0 = _time.perf_counter_ns()
+            self._op_stack.append(op)
+            try:
+                mp = next(it)
+            except StopIteration:
+                self._op_stack.pop()
+                return
+            finally:
+                if self._op_stack and self._op_stack[-1] == op:
+                    self._op_stack.pop()
+            dt = _time.perf_counter_ns() - t0
+            self.stats.record(op, rows_out=len(mp), cpu_ns=dt)
+            if self._op_stack:
+                # Parent's timed region includes ours: remove the double count
+                # and credit it with the rows flowing in.
+                self.stats.record(self._op_stack[-1], rows_in=len(mp), cpu_ns=-dt)
+            yield mp
 
     # -- sources ---------------------------------------------------------
     def _run_InMemorySource(self, node: pp.InMemorySource) -> Iterator[MicroPartition]:
@@ -270,7 +312,21 @@ class Executor:
 
     # -- blocking sinks ---------------------------------------------------
     def _collect(self, node: pp.PhysicalPlan) -> MicroPartition:
-        parts = list(self._run(node))
+        """Materialise a blocking-sink input under memory permits
+        (reference: resource_manager.rs memory manager + DAFT_MEMORY_LIMIT)."""
+        parts = []
+        limit = self.memory.limit
+        for mp in self._run(node):
+            nbytes = mp.size_bytes()
+            # Skip the gate once WE hold >= the whole budget: the only
+            # releaser is this executor at query end, so waiting would be a
+            # self-deadlock (60s/morsel stall). Permits thus bound memory
+            # across CONCURRENT executors (distributed workers), degrading to
+            # best-effort within one oversized blocking sink.
+            if limit is not None and self._held_bytes < limit:
+                if self.memory.acquire(nbytes, timeout=5.0):
+                    self._held_bytes += min(nbytes, limit)
+            parts.append(mp)
         if not parts:
             return MicroPartition.empty(node.schema)
         return MicroPartition.concat(parts)

@@ -1,0 +1,106 @@
+"""Memory manager with permits + per-operator runtime stats.
+
+Reference: src/daft-local-execution/src/resource_manager.rs:9-44 (global
+memory manager handing out byte permits, DAFT_MEMORY_LIMIT env) and
+runtime_stats/ (per-operator rows/bytes/cpu counters surfaced as events).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, Optional
+
+
+class MemoryManager:
+    """Byte-permit gate for blocking sinks: acquire before buffering a morsel,
+    release when the buffer drains. Oversized single requests are clamped so a
+    morsel larger than the budget still makes progress."""
+
+    def __init__(self, limit_bytes: Optional[int] = None):
+        if limit_bytes is None:
+            env = os.environ.get("DAFT_MEMORY_LIMIT")
+            limit_bytes = int(env) if env else None
+        self.limit = limit_bytes
+        self._used = 0
+        self._cond = threading.Condition()
+
+    def acquire(self, nbytes: int, timeout: Optional[float] = None) -> bool:
+        if self.limit is None:
+            return True
+        request = min(nbytes, self.limit)
+        with self._cond:
+            deadline = None if timeout is None else time.monotonic() + timeout
+            while self._used + request > self.limit:
+                remaining = None if deadline is None else deadline - time.monotonic()
+                if remaining is not None and remaining <= 0:
+                    return False
+                if not self._cond.wait(remaining):
+                    return False
+            self._used += request
+            return True
+
+    def release(self, nbytes: int) -> None:
+        if self.limit is None:
+            return
+        with self._cond:
+            self._used = max(0, self._used - min(nbytes, self.limit))
+            self._cond.notify_all()
+
+    def used(self) -> int:
+        return self._used
+
+
+_GLOBAL: Optional[MemoryManager] = None
+_lock = threading.Lock()
+
+
+def get_memory_manager() -> MemoryManager:
+    global _GLOBAL
+    with _lock:
+        if _GLOBAL is None:
+            _GLOBAL = MemoryManager()
+        return _GLOBAL
+
+
+@dataclass
+class OperatorCounters:
+    rows_in: int = 0
+    rows_out: int = 0
+    cpu_ns: int = 0
+
+
+class RuntimeStats:
+    """Per-query operator counters, flushed as OperatorStats events at query
+    end (reference: RuntimeStatsManager)."""
+
+    def __init__(self, query_id: str = ""):
+        self.query_id = query_id
+        self._ops: Dict[str, OperatorCounters] = {}
+        self._lock = threading.Lock()
+
+    def record(self, op: str, rows_in: int = 0, rows_out: int = 0, cpu_ns: int = 0) -> None:
+        with self._lock:
+            c = self._ops.setdefault(op, OperatorCounters())
+            c.rows_in += rows_in
+            c.rows_out += rows_out
+            c.cpu_ns += cpu_ns
+
+    def flush(self) -> None:
+        from daft_tpu.context import get_context
+        from daft_tpu.subscribers.events import OperatorStats
+
+        ctx = get_context()
+        with self._lock:
+            for op, c in self._ops.items():
+                ctx.notify(OperatorStats(
+                    query_id=self.query_id, operator=op,
+                    rows_in=c.rows_in, rows_out=c.rows_out,
+                    cpu_us=c.cpu_ns // 1000,
+                ))
+
+    def snapshot(self) -> Dict[str, OperatorCounters]:
+        with self._lock:
+            return dict(self._ops)

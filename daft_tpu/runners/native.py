@@ -31,7 +31,9 @@ class NativeRunner(Runner):
         start = time.perf_counter()
         error = None
         try:
-            executor = Executor(cfg)
+            from daft_tpu.execution.resource_manager import RuntimeStats
+
+            executor = Executor(cfg, stats=RuntimeStats(query_id))
             yield from executor.run(physical)
         except BaseException as e:  # noqa: BLE001
             error = str(e)

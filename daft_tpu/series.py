@@ -191,6 +191,11 @@ class Series:
         tid = self._dtype.id
         if tid in (TypeId.TENSOR, TypeId.FIXED_SHAPE_TENSOR):
             return _tensor_to_pylist(self)
+        if tid == TypeId.FILE:
+            # Row-wise UDFs receive lazy File handles (reference: daft-file).
+            from daft_tpu.io.file import File
+
+            return [File.from_row(r) for r in self._data.to_pylist()]
         if tid == TypeId.BFLOAT16:
             vals, mask = self.to_numpy_masked()
             return [

@@ -91,3 +91,37 @@ def test_encoded_bytes_images():
     df = daft_tpu.from_pydict({"raw": daft_tpu.Series.from_pylist(raws, "raw", DataType.binary())})
     out = df.with_column("emb", embed_image(col("raw"), provider="flax_random", model="tiny")).to_pydict()
     assert np.asarray(out["emb"][0]).shape == (32,)
+
+
+def test_stub_providers_registered():
+    from daft_tpu.ai.provider import load_provider
+
+    for name in ("transformers", "openai", "google", "lm_studio", "vllm"):
+        p = load_provider(name)
+        assert p.name == name
+    # API providers give actionable errors at instantiation, not at lookup.
+    desc = load_provider("openai").get_text_embedder()
+    with pytest.raises(Exception, match="unavailable"):
+        desc.instantiate()
+
+
+def test_file_runtime(tmp_path):
+    from daft_tpu.io.file import File, file_series
+
+    p = tmp_path / "x.txt"
+    p.write_bytes(b"hello")
+    s = file_series([b"inline", str(p), None], "f")
+    assert s.dtype == daft_tpu.DataType.file()
+    files = s.to_pylist()
+    assert files[0].read() == b"inline"
+    assert files[1].read() == b"hello"
+    assert files[1].size() == 5
+    assert files[2] is None
+
+    @daft_tpu.udf.func(return_dtype=daft_tpu.DataType.int64())
+    def size_of(f):
+        return None if f is None else len(f.read())
+
+    df = daft_tpu.from_pydict({"f": s})
+    out = df.select(size_of(col("f")).alias("n")).to_pydict()
+    assert out["n"] == [6, 5, None]

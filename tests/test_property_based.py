@@ -1,0 +1,82 @@
+"""Property-based tests (reference: tests/property_based_testing/
+{strategies.py,test_sort.py} — Hypothesis over dtypes/dataframes)."""
+
+import hypothesis.strategies as st
+import pytest
+from hypothesis import HealthCheck, given, settings
+
+import daft_tpu
+from daft_tpu import col
+
+_SETTINGS = dict(max_examples=30, deadline=None,
+                 suppress_health_check=[HealthCheck.too_slow])
+
+# One scalar strategy per column (mixed-type columns become Python-object
+# dtype by design and are not parquet-writable).
+homogeneous_column = st.one_of(
+    st.lists(st.one_of(st.integers(min_value=-(2**31), max_value=2**31), st.none()),
+             min_size=1, max_size=100),
+    st.lists(st.one_of(st.text(max_size=12), st.none()), min_size=1, max_size=100),
+    st.lists(st.one_of(st.floats(allow_nan=False, allow_infinity=False,
+                                 width=32), st.none()), min_size=1, max_size=100),
+)
+
+
+@given(values=st.lists(st.one_of(st.integers(-1000, 1000), st.none()),
+                       min_size=0, max_size=200))
+@settings(**_SETTINGS)
+def test_sort_is_sorted(values):
+    df = daft_tpu.from_pydict({"x": values}) if values else None
+    if df is None:
+        return
+    out = df.sort("x").to_pydict()["x"]
+    non_null = [v for v in out if v is not None]
+    assert non_null == sorted(v for v in values if v is not None)
+    assert out[len(non_null):] == [None] * (len(out) - len(non_null))
+
+
+@given(values=st.lists(st.integers(-50, 50), min_size=1, max_size=100),
+       pivot=st.integers(-50, 50))
+@settings(**_SETTINGS)
+def test_filter_partition(values, pivot):
+    df = daft_tpu.from_pydict({"x": values})
+    hi = df.where(col("x") > pivot).count_rows()
+    lo = df.where(~(col("x") > pivot)).count_rows()
+    assert hi + lo == len(values)
+
+
+@given(values=st.lists(st.text(max_size=8), min_size=1, max_size=80))
+@settings(**_SETTINGS)
+def test_groupby_count_totals(values):
+    df = daft_tpu.from_pydict({"k": values})
+    out = df.groupby("k").count().to_pydict()
+    assert sum(out["count"]) == len(values)
+    assert len(out["k"]) == len(set(values))
+
+
+@given(values=st.lists(st.integers(-10**6, 10**6), min_size=1, max_size=120),
+       parts=st.integers(1, 5))
+@settings(**_SETTINGS)
+def test_distributed_sum_matches(values, parts):
+    """Partitioned two-phase aggregation must equal the direct sum."""
+    df = daft_tpu.from_pydict({"x": values}).into_partitions(parts)
+    out = df.agg(col("x").sum().alias("s")).to_pydict()["s"][0]
+    assert out == sum(values)
+
+
+@given(values=homogeneous_column)
+@settings(**_SETTINGS)
+def test_parquet_roundtrip_any(values):
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as d:
+        df = daft_tpu.from_pydict({"v": values})
+        if df.schema["v"].dtype.is_null():
+            return  # all-null columns have no parquet type
+        df.write_parquet(d)
+        back = daft_tpu.read_parquet(d).to_pydict()["v"]
+        first = next((v for v in values if v is not None), None)
+        if isinstance(first, float):
+            assert back == pytest.approx(values)
+        else:
+            assert back == values
