@@ -41,6 +41,18 @@ class Worker:
         pass
 
 
+def collect_task_outputs(out, expect_outputs: int, schema):
+    """Shared LocalWorker/ProcessWorker output handling: validate multi-output
+    shuffle maps, else concat (or empty)."""
+    if expect_outputs > 1:
+        if len(out) != expect_outputs:
+            raise DaftExecutionError(
+                f"expected {expect_outputs} outputs, got {len(out)}"
+            )
+        return out
+    return [MicroPartition.concat(out) if out else MicroPartition.empty(schema)]
+
+
 def bind_task_fragment(fragment: pp.PhysicalPlan, inputs: Sequence[Sequence[PartitionRef]]) -> pp.PhysicalPlan:
     """Replace BoundInput leaves with InMemorySource over fetched partitions."""
 
@@ -93,15 +105,8 @@ class LocalWorker(Worker):
                 bound = bind_task_fragment(task.fragment, task.inputs)
                 executor = Executor(self.cfg, partition_offset=task.partition_idx)
                 out = list(executor.run(bound))
-                if task.expect_outputs > 1:
-                    # Shuffle map task: one ref per output bucket, order kept.
-                    if len(out) != task.expect_outputs:
-                        raise DaftExecutionError(
-                            f"expected {task.expect_outputs} outputs, got {len(out)}"
-                        )
-                    return [LocalPartitionRef(p, self.worker_id) for p in out]
-                mp = MicroPartition.concat(out) if out else MicroPartition.empty(task.fragment.schema)
-                return [LocalPartitionRef(mp, self.worker_id)]
+                parts = collect_task_outputs(out, task.expect_outputs, task.fragment.schema)
+                return [LocalPartitionRef(p, self.worker_id) for p in parts]
             finally:
                 with self._lock:
                     self._active -= 1

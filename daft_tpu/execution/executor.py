@@ -93,21 +93,23 @@ class Executor:
 
         while True:
             t0 = _time.perf_counter_ns()
-            self._op_stack.append(op)
+            # Unique frame entry: identity-checked pop so adjacent same-named
+            # operators (Project over Project) can never double-pop.
+            entry = (object(), op)
+            self._op_stack.append(entry)
             try:
                 mp = next(it)
             except StopIteration:
-                self._op_stack.pop()
                 return
             finally:
-                if self._op_stack and self._op_stack[-1] == op:
+                if self._op_stack and self._op_stack[-1] is entry:
                     self._op_stack.pop()
             dt = _time.perf_counter_ns() - t0
             self.stats.record(op, rows_out=len(mp), cpu_ns=dt)
             if self._op_stack:
                 # Parent's timed region includes ours: remove the double count
                 # and credit it with the rows flowing in.
-                self.stats.record(self._op_stack[-1], rows_in=len(mp), cpu_ns=-dt)
+                self.stats.record(self._op_stack[-1][1], rows_in=len(mp), cpu_ns=-dt)
             yield mp
 
     # -- sources ---------------------------------------------------------
@@ -316,16 +318,19 @@ class Executor:
         (reference: resource_manager.rs memory manager + DAFT_MEMORY_LIMIT)."""
         parts = []
         limit = self.memory.limit
+        gate_on = limit is not None
         for mp in self._run(node):
             nbytes = mp.size_bytes()
-            # Skip the gate once WE hold >= the whole budget: the only
-            # releaser is this executor at query end, so waiting would be a
-            # self-deadlock (60s/morsel stall). Permits thus bound memory
-            # across CONCURRENT executors (distributed workers), degrading to
-            # best-effort within one oversized blocking sink.
-            if limit is not None and self._held_bytes < limit:
+            # Permits bound memory across CONCURRENT executors (distributed
+            # workers); within one oversized blocking sink they degrade to
+            # best-effort. After the first failed acquire the gate disengages
+            # for this sink — the only releaser is this executor at query end,
+            # so further waits are pure self-deadlock stalls.
+            if gate_on and self._held_bytes < limit:
                 if self.memory.acquire(nbytes, timeout=5.0):
                     self._held_bytes += min(nbytes, limit)
+                else:
+                    gate_on = False
             parts.append(mp)
         if not parts:
             return MicroPartition.empty(node.schema)

@@ -60,6 +60,21 @@ class _ProtocolUdf(Udf):
                     self._instance = self._descriptor.instantiate()
         return self._instance
 
+    def __getstate__(self):
+        # Cross-process shipping: drop the lock and the live model instance —
+        # each worker process re-instantiates (params must live in ITS HBM).
+        state = self.__dict__.copy()
+        state["_instance"] = None
+        state.pop("_instance_lock", None)
+        return state
+
+    def __setstate__(self, state):
+        import threading
+
+        self.__dict__.update(state)
+        self._instance = None
+        self._instance_lock = threading.Lock()
+
 
 def _images_to_numpy(series: Series, size: int) -> np.ndarray:
     """Convert an image-bearing Series to a dense (B, size, size, 3) uint8

@@ -26,12 +26,21 @@ class DistributedRunner(Runner):
     name = "distributed"
 
     def __init__(self, num_workers: Optional[int] = None, slots_per_worker: int = 2,
-                 manager: Optional[WorkerManager] = None):
+                 manager: Optional[WorkerManager] = None, backend: Optional[str] = None):
         cfg = get_context().execution_config
         if manager is not None:
             self.manager = manager
+            return
+        backend = backend or os.environ.get("DAFT_WORKER_BACKEND", "thread")
+        n = num_workers or cfg.num_workers or int(os.environ.get("DAFT_NUM_WORKERS", "2"))
+        if backend == "process":
+            # True process isolation (reference: per-node Ray actors; on TPU
+            # hosts, one process per chip — libtpu single-owner).
+            from daft_tpu.distributed.process_worker import ProcessWorker
+
+            workers = [ProcessWorker(f"proc-{i}") for i in range(n)]
+            self.manager = WorkerManager(workers, factory=lambda: ProcessWorker())
         else:
-            n = num_workers or cfg.num_workers or int(os.environ.get("DAFT_NUM_WORKERS", "2"))
             workers = [LocalWorker(f"worker-{i}", num_slots=slots_per_worker) for i in range(n)]
             self.manager = WorkerManager(
                 workers, factory=lambda: LocalWorker(num_slots=slots_per_worker)

@@ -186,6 +186,17 @@ class _StatefulMethodUdf(Udf):
                     self._instance = self._cls_wrapper.cls(*self._init_args, **self._init_kwargs)
         return self._instance
 
+    def __getstate__(self):
+        state = self.__dict__.copy()
+        state["_instance"] = None
+        state.pop("_lock", None)
+        return state
+
+    def __setstate__(self, state):
+        self.__dict__.update(state)
+        self._instance = None
+        self._lock = threading.Lock()
+
 
 def method(fn: Optional[Callable] = None, *, return_dtype: Optional[DataType] = None,
            batch: bool = False):
