@@ -26,7 +26,33 @@ BATCH_SIZE = 256
 IMAGE_SIZE = 224
 
 
+def _wait_for_tpu(max_wait_s: int = 360) -> None:
+    """The axon tunnel occasionally needs time to come up; probe backend init
+    in SUBPROCESSES (jax caches a failed init in-process) before committing
+    the main process to it."""
+    import subprocess
+
+    deadline = time.time() + max_wait_s
+    while True:
+        err = ""
+        try:
+            probe = subprocess.run(
+                [sys.executable, "-c", "import jax; print(len(jax.devices()))"],
+                capture_output=True, text=True, timeout=120,
+            )
+            if probe.returncode == 0:
+                return
+            err = probe.stderr[-500:]
+        except subprocess.TimeoutExpired:
+            err = "backend init timed out"
+        if time.time() > deadline:
+            sys.stderr.write(f"TPU backend unavailable after {max_wait_s}s: {err}\n")
+            sys.exit(1)
+        time.sleep(20)
+
+
 def main() -> None:
+    _wait_for_tpu()
     import jax
 
     import daft_tpu

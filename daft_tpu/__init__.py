@@ -87,6 +87,10 @@ def __getattr__(name: str):
         from daft_tpu.catalog import Catalog
 
         return Catalog
+    if name in ("IOConfig", "S3Config", "GCSConfig", "AzureConfig", "HTTPConfig"):
+        from daft_tpu.io import config as io_config_mod
+
+        return getattr(io_config_mod, name)
     if name in ("func", "cls", "method", "udf"):
         import daft_tpu.udf as udf_mod
 

@@ -141,6 +141,7 @@ class ProcessWorker(Worker):
         child_sock.close()
         self._sock = parent_sock
         self._active = 0
+        self._active_lock = threading.Lock()
         self._lock = threading.Lock()  # serializes socket use
 
     def kill(self) -> None:
@@ -149,11 +150,12 @@ class ProcessWorker(Worker):
 
     def submit(self, task: Task) -> "Future[List[PartitionRef]]":
         fut: "Future[List[PartitionRef]]" = Future()
+        # Count queued work synchronously (before the thread even starts) so
+        # the dispatcher's next least-loaded pick sees this worker's backlog.
+        with self._active_lock:
+            self._active += 1
 
         def run() -> List[PartitionRef]:
-            # Count queued work BEFORE the serializing lock so the scheduler's
-            # least-loaded pick sees backlog, not just the running task.
-            self._active += 1
             try:
                 with self._lock:
                     if self._proc.poll() is not None:
@@ -183,7 +185,8 @@ class ProcessWorker(Worker):
                         for blob in result["parts"]
                     ]
             finally:
-                self._active -= 1
+                with self._active_lock:
+                    self._active -= 1
 
         def runner():
             try:

@@ -159,5 +159,9 @@ class WorkerManager:
                 self._workers[w.worker_id] = w
 
     def shutdown(self) -> None:
-        for w in self.workers():
+        # Include dead-marked workers: a crashed ProcessWorker still needs its
+        # subprocess reaped and socket closed.
+        with self._lock:
+            all_workers = list(self._workers.values())
+        for w in all_workers:
             w.shutdown()

@@ -1,0 +1,106 @@
+"""IO configuration: object-store credentials/options.
+
+Reference: src/common/io-config (S3Config / AzureConfig / GCSConfig /
+HTTPConfig bundled into IOConfig, threaded through scans and writes).
+Materialised here as frozen dataclasses lowered onto pyarrow's Arrow C++
+filesystems.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+
+@dataclass(frozen=True)
+class S3Config:
+    region_name: Optional[str] = None
+    endpoint_url: Optional[str] = None
+    key_id: Optional[str] = None
+    access_key: Optional[str] = None
+    session_token: Optional[str] = None
+    anonymous: bool = False
+    # NOTE: verify_ssl / num_tries are accepted for API parity but the Arrow
+    # C++ S3 filesystem manages TLS verification and retries itself.
+    verify_ssl: bool = True
+    connect_timeout_ms: int = 30_000
+    num_tries: int = 3
+
+
+@dataclass(frozen=True)
+class GCSConfig:
+    project_id: Optional[str] = None
+    credentials_path: Optional[str] = None
+    anonymous: bool = False
+
+
+@dataclass(frozen=True)
+class AzureConfig:
+    storage_account: Optional[str] = None
+    access_key: Optional[str] = None
+    anonymous: bool = False
+
+
+@dataclass(frozen=True)
+class HTTPConfig:
+    user_agent: str = "daft_tpu/0.1"
+    bearer_token: Optional[str] = None
+
+
+@dataclass(frozen=True)
+class IOConfig:
+    s3: S3Config = field(default_factory=S3Config)
+    gcs: GCSConfig = field(default_factory=GCSConfig)
+    azure: AzureConfig = field(default_factory=AzureConfig)
+    http: HTTPConfig = field(default_factory=HTTPConfig)
+
+
+def filesystem_for(scheme: str, io_config: Optional[IOConfig]):
+    """Build a pyarrow filesystem honouring the IOConfig, or None to use
+    pyarrow's default URI resolution."""
+    import pyarrow.fs as pafs
+
+    if io_config is None:
+        return None
+    if scheme == "s3":
+        cfg = io_config.s3
+        kwargs = {}
+        if cfg.region_name:
+            kwargs["region"] = cfg.region_name
+        if cfg.endpoint_url:
+            kwargs["endpoint_override"] = cfg.endpoint_url
+        if cfg.anonymous:
+            kwargs["anonymous"] = True
+        elif cfg.key_id:
+            kwargs["access_key"] = cfg.key_id
+            kwargs["secret_key"] = cfg.access_key
+            if cfg.session_token:
+                kwargs["session_token"] = cfg.session_token
+        kwargs["connect_timeout"] = cfg.connect_timeout_ms / 1000.0
+        return pafs.S3FileSystem(**kwargs)
+    if scheme in ("gs", "gcs"):
+        cfg = io_config.gcs
+        kwargs = {}
+        if cfg.anonymous:
+            kwargs["anonymous"] = True
+        if cfg.project_id:
+            kwargs["project_id"] = cfg.project_id
+        if cfg.credentials_path:
+            # Arrow's GCS filesystem reads ADC from the environment.
+            import os
+
+            os.environ.setdefault("GOOGLE_APPLICATION_CREDENTIALS", cfg.credentials_path)
+        return pafs.GcsFileSystem(**kwargs)
+    if scheme in ("az", "abfs", "abfss"):
+        cfg = io_config.azure
+        if not hasattr(pafs, "AzureFileSystem"):
+            from daft_tpu.errors import DaftIOError
+
+            raise DaftIOError("This pyarrow build has no AzureFileSystem")
+        kwargs = {}
+        if cfg.storage_account:
+            kwargs["account_name"] = cfg.storage_account
+        if cfg.access_key:
+            kwargs["account_key"] = cfg.access_key
+        return pafs.AzureFileSystem(**kwargs)
+    return None

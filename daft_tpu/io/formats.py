@@ -92,7 +92,7 @@ def _filter_ref_columns(task: ScanTask) -> List[str]:
 
 
 def _read_parquet_file(path: str, task: ScanTask, morsel_rows: int) -> Iterator[MicroPartition]:
-    fs, p = resolve_filesystem(path)
+    fs, p = resolve_filesystem(path, task.read_options.get("io_config"))
     schema = _project_schema(task)
     want = None
     if task.pushdowns.columns is not None:
@@ -110,7 +110,7 @@ def _read_parquet_file(path: str, task: ScanTask, morsel_rows: int) -> Iterator[
 
 
 def _read_csv_file(path: str, task: ScanTask, morsel_rows: int) -> Iterator[MicroPartition]:
-    fs, p = resolve_filesystem(path)
+    fs, p = resolve_filesystem(path, task.read_options.get("io_config"))
     opts = task.read_options
     read_opts = pacsv.ReadOptions(block_size=16 * 1024 * 1024)
     parse_opts = pacsv.ParseOptions(delimiter=opts.get("delimiter", ","))
@@ -130,7 +130,7 @@ def _read_csv_file(path: str, task: ScanTask, morsel_rows: int) -> Iterator[Micr
 
 
 def _read_json_file(path: str, task: ScanTask, morsel_rows: int) -> Iterator[MicroPartition]:
-    fs, p = resolve_filesystem(path)
+    fs, p = resolve_filesystem(path, task.read_options.get("io_config"))
     with fs.open_input_stream(p) as stream:
         table = pajson.read_json(stream)
     if task.pushdowns.columns is not None:
@@ -146,7 +146,7 @@ def _read_json_file(path: str, task: ScanTask, morsel_rows: int) -> Iterator[Mic
 
 
 def _read_text_file(path: str, task: ScanTask, morsel_rows: int) -> Iterator[MicroPartition]:
-    fs, p = resolve_filesystem(path)
+    fs, p = resolve_filesystem(path, task.read_options.get("io_config"))
     with fs.open_input_stream(p) as stream:
         data = stream.read().decode("utf-8", errors="replace")
     lines = data.splitlines()
@@ -163,10 +163,10 @@ def infer_schema(paths: List[str], file_format: str, read_options=None) -> Schem
     inference in daft-parquet/daft-csv/daft-json)."""
     from daft_tpu.io.scan import glob_paths
 
-    files = glob_paths(paths)
-    path = files[0].path
-    fs, p = resolve_filesystem(path)
     read_options = read_options or {}
+    files = glob_paths(paths, read_options.get("io_config"))
+    path = files[0].path
+    fs, p = resolve_filesystem(path, read_options.get("io_config"))
     if file_format == "parquet":
         pf = pq.ParquetFile(fs.open_input_file(p))
         arrow_schema = pf.schema_arrow
@@ -201,7 +201,7 @@ def _read_warc_file(path: str, task: ScanTask, morsel_rows: int) -> Iterator[Mic
     import gzip
     import io as _io
 
-    fs, p = resolve_filesystem(path)
+    fs, p = resolve_filesystem(path, task.read_options.get("io_config"))
     stream = fs.open_input_stream(p)
     try:
         reader = _io.BufferedReader(_WarcRawAdapter(stream), buffer_size=1 << 20)

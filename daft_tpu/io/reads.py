@@ -12,9 +12,12 @@ from daft_tpu.schema import Schema
 
 
 def _read(paths: Union[str, List[str]], file_format: str, schema: Optional[Schema],
-          read_options: Optional[Dict[str, Any]] = None) -> DataFrame:
+          read_options: Optional[Dict[str, Any]] = None, io_config=None) -> DataFrame:
     if isinstance(paths, str):
         paths = [paths]
+    read_options = dict(read_options or {})
+    if io_config is not None:
+        read_options["io_config"] = io_config
     if schema is None:
         schema = infer_schema(paths, file_format, read_options)
     info = ScanInfo(paths, file_format, schema, read_options)
@@ -23,21 +26,22 @@ def _read(paths: Union[str, List[str]], file_format: str, schema: Optional[Schem
 
 def read_parquet(path: Union[str, List[str]], schema: Optional[Schema] = None,
                  io_config=None, **kwargs) -> DataFrame:
-    return _read(path, "parquet", schema)
+    return _read(path, "parquet", schema, io_config=io_config)
 
 
 def read_csv(path: Union[str, List[str]], schema: Optional[Schema] = None,
              has_headers: bool = True, delimiter: str = ",", io_config=None, **kwargs) -> DataFrame:
-    return _read(path, "csv", schema, {"has_headers": has_headers, "delimiter": delimiter})
+    return _read(path, "csv", schema, {"has_headers": has_headers, "delimiter": delimiter},
+                 io_config=io_config)
 
 
 def read_json(path: Union[str, List[str]], schema: Optional[Schema] = None,
               io_config=None, **kwargs) -> DataFrame:
-    return _read(path, "json", schema)
+    return _read(path, "json", schema, io_config=io_config)
 
 
 def read_text(path: Union[str, List[str]], io_config=None, **kwargs) -> DataFrame:
-    return _read(path, "text", None)
+    return _read(path, "text", None, io_config=io_config)
 
 
 def from_glob_path(path: Union[str, List[str]], io_config=None) -> DataFrame:
@@ -66,4 +70,4 @@ def read_warc(path, io_config=None, **kwargs):
         Field("Content-Length", DataType.int64()),
         Field("warc_content", DataType.binary()),
     ])
-    return _read(path, "warc", schema)
+    return _read(path, "warc", schema, io_config=kwargs.get("io_config") or io_config)

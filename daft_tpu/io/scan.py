@@ -62,20 +62,32 @@ class ScanTask:
         return f"ScanTask({self.file_format}, {len(self.files)} files)"
 
 
-def resolve_filesystem(path: str) -> Tuple[pafs.FileSystem, str]:
-    """Resolve a URI to (filesystem, fs-local path) via Arrow C++ filesystems."""
+def resolve_filesystem(path: str, io_config=None) -> Tuple[pafs.FileSystem, str]:
+    """Resolve a URI to (filesystem, fs-local path) via Arrow C++ filesystems,
+    honouring IOConfig credentials (reference: common/io-config)."""
     if "://" in path:
+        scheme = path.split("://", 1)[0]
+        if io_config is None:
+            from daft_tpu.context import get_context
+
+            io_config = get_context().planning_config.default_io_config
+        if io_config is not None:
+            from daft_tpu.io.config import filesystem_for
+
+            fs = filesystem_for(scheme, io_config)
+            if fs is not None:
+                return fs, path.split("://", 1)[1]
         fs, p = pafs.FileSystem.from_uri(path)
         return fs, p
     return pafs.LocalFileSystem(), os.path.abspath(os.path.expanduser(path))
 
 
-def glob_paths(paths: Sequence[str]) -> List[FileInfo]:
+def glob_paths(paths: Sequence[str], io_config=None) -> List[FileInfo]:
     """Expand glob patterns / directories into concrete files with sizes
     (reference: src/daft-io/src/object_store_glob.rs)."""
     out: List[FileInfo] = []
     for path in paths:
-        fs, p = resolve_filesystem(path)
+        fs, p = resolve_filesystem(path, io_config)
         if isinstance(fs, pafs.LocalFileSystem):
             if any(ch in p for ch in "*?["):
                 matches = sorted(_glob.glob(p, recursive=True))
@@ -135,7 +147,7 @@ class ScanInfo:
 
     def files(self) -> List[FileInfo]:
         if self._files is None:
-            self._files = glob_paths(self.paths)
+            self._files = glob_paths(self.paths, self.read_options.get("io_config"))
         return self._files
 
     def display_name(self) -> str:

@@ -58,7 +58,7 @@ class Optimizer:
         self.batches: List[List[Rule]] = [
             [SimplifyExpressions()],
             [SplitUDFs()],
-            [PushDownFilter(), PushDownShard(), DropRepartition()],
+            [EliminateCrossJoin(), PushDownFilter(), PushDownShard(), DropRepartition()],
             [PushDownLimit()],
             [PushDownProjection()],
         ]
@@ -230,6 +230,61 @@ class PushDownFilter(Rule):
             combined = pred if pd.filters is None else BinaryOp("and", pd.filters, pred)
             return child.with_pushdowns(pd.with_changes(filters=combined))
         return None
+
+
+class EliminateCrossJoin(Rule):
+    """Filter(CrossJoin) with cross-side equality conjuncts → inner Join
+    (reference: rules/eliminate_cross_join.rs)."""
+
+    name = "EliminateCrossJoin"
+
+    def rewrite(self, node):
+        if not isinstance(node, lp.Filter):
+            return None
+        child = node.children()[0]
+        if not isinstance(child, lp.Join) or child.how != "cross":
+            return None
+        left, right = child.children()
+        left_names = set(left.schema.column_names())
+        # Cross-join output renames right-side collisions; only act when the
+        # sides are disjoint so predicate refs map unambiguously.
+        right_names = set(right.schema.column_names())
+        if left_names & right_names:
+            return None
+        conjuncts: List[Expr] = []
+
+        def flatten(e: Expr):
+            if isinstance(e, BinaryOp) and e.op == "and":
+                flatten(e.left)
+                flatten(e.right)
+            else:
+                conjuncts.append(e)
+
+        flatten(node.predicate)
+        left_on, right_on, rest = [], [], []
+        for c in conjuncts:
+            if isinstance(c, BinaryOp) and c.op == "eq":
+                l_refs, r_refs = c.left.column_refs(), c.right.column_refs()
+                if l_refs and r_refs:
+                    if l_refs <= left_names and r_refs <= right_names:
+                        left_on.append(c.left)
+                        right_on.append(c.right)
+                        continue
+                    if l_refs <= right_names and r_refs <= left_names:
+                        left_on.append(c.right)
+                        right_on.append(c.left)
+                        continue
+            rest.append(c)
+        if not left_on:
+            return None
+        joined = lp.Join(left, right, left_on, right_on, "inner",
+                         suffix=child.suffix, prefix=child.prefix)
+        if not rest:
+            return joined
+        pred = rest[0]
+        for c in rest[1:]:
+            pred = BinaryOp("and", pred, c)
+        return lp.Filter(joined, pred)
 
 
 class PushDownLimit(Rule):

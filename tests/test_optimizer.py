@@ -78,3 +78,14 @@ def test_filter_pushdown_through_join():
     left_side = join_nodes[0].children()[0]
     assert any(isinstance(n, lp.Filter) for n in left_side.walk())
     assert joined.to_pydict()["a"] == [20]
+
+
+def test_eliminate_cross_join():
+    left = daft_tpu.from_pydict({"k": [1, 2, 3], "a": [10, 20, 30]})
+    right = daft_tpu.from_pydict({"j": [2, 3, 4], "b": [200, 300, 400]})
+    q = left.cross_join(right).where((col("k") == col("j")) & (col("b") > 200))
+    plan = _optimized(q)
+    joins = [n for n in plan.walk() if isinstance(n, lp.Join)]
+    assert joins and joins[0].how == "inner"
+    out = q.sort("k").to_pydict()
+    assert out["k"] == [3] and out["b"] == [300]
