@@ -163,6 +163,63 @@ class DataFrame:
             _inner(group_by), _to_expr(pivot_col)._expr, _to_expr(value_col)._expr, agg_fn, names
         ))
 
+    def describe(self) -> "DataFrame":
+        """Schema description: one row per column (reference: DataFrame.describe)."""
+        from daft_tpu.dataframe import creation
+
+        return creation.from_pydict({
+            "column": [f.name for f in self.schema],
+            "type": [repr(f.dtype) for f in self.schema],
+        })
+
+    def summarize(self) -> "DataFrame":
+        """Per-column statistics (reference: DataFrame.summarize)."""
+        from daft_tpu.dataframe import creation
+
+        rows = {"column": [], "type": [], "min": [], "max": [], "count": [],
+                "count_nulls": [], "approx_count_distinct": []}
+        aggs = []
+        for f in self.schema:
+            name = f.name
+            c = col(name)
+            aggs.append(c.count().alias(f"{name}__count"))
+            aggs.append(c.count("null").alias(f"{name}__nulls"))
+            if f.dtype.is_comparable() and not f.dtype.is_null():
+                aggs.append(c.min().alias(f"{name}__min"))
+                aggs.append(c.max().alias(f"{name}__max"))
+                aggs.append(c.approx_count_distinct().alias(f"{name}__acd"))
+        stats = self.agg(*aggs).to_pydict()
+
+        def render(key):
+            v = stats[key][0]
+            return None if v is None else str(v)
+
+        for f in self.schema:
+            name = f.name
+            rows["column"].append(name)
+            rows["type"].append(repr(f.dtype))
+            rows["count"].append(stats[f"{name}__count"][0])
+            rows["count_nulls"].append(stats[f"{name}__nulls"][0])
+            has = f"{name}__min" in stats
+            rows["min"].append(render(f"{name}__min") if has else None)
+            rows["max"].append(render(f"{name}__max") if has else None)
+            rows["approx_count_distinct"].append(stats[f"{name}__acd"][0] if has else None)
+        return creation.from_pydict(rows)
+
+    def into_batches(self, batch_size: int) -> "DataFrame":
+        """Re-chunk into partitions of ~batch_size rows (reference:
+        LocalPhysicalPlan::IntoBatches). Materialises ONCE and repartitions
+        the materialised result (no double execution)."""
+        if batch_size <= 0:
+            raise DaftValueError(f"batch_size must be positive, got {batch_size}")
+        materialized = self.collect()
+        parts = materialized._result or []
+        total = sum(len(p) for p in parts)
+        n = max(1, (total + batch_size - 1) // batch_size)
+        mat = DataFrame(LogicalPlanBuilder.in_memory(
+            parts or [MicroPartition.empty(self.schema)], self.schema))
+        return mat.into_partitions(n)
+
     def transform(self, func, *args, **kwargs) -> "DataFrame":
         out = func(self, *args, **kwargs)
         if not isinstance(out, DataFrame):

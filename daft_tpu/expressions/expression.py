@@ -649,7 +649,12 @@ class ListNamespace(_Namespace):
         return self._fn("list_contains", value)
 
     def explode(self):
-        return self._fn("explode")
+        from daft_tpu.errors import DaftValueError
+
+        raise DaftValueError(
+            "explode is a plan-level operation: use DataFrame.explode(col) "
+            "(one row per list element changes the row count)"
+        )
 
 
 class StructNamespace(_Namespace):

@@ -28,49 +28,73 @@ def read_scan_task(task: ScanTask, morsel_rows: int = 128 * 1024) -> Iterator[Mi
     pushdowns = task.pushdowns
     remaining = pushdowns.limit
     if task.file_format == "python_source":
-        # Custom DataSource task (daft_tpu/io/source.py plugin surface).
+        # Custom DataSource task (daft_tpu/io/source.py plugin surface); same
+        # transient-retry policy as file scans.
         source_task = task.read_options["source_task"]
-        for mp in source_task.execute():
-            mp = _apply_post_pushdowns(mp, task)
-            if task.pushdowns.columns is not None:
-                from daft_tpu.expressions.expr import ColumnRef
-
-                mp = mp.eval_expression_list(
-                    [ColumnRef(c) for c in task.pushdowns.columns])
-            if remaining is not None:
-                if len(mp) > remaining:
-                    mp = mp.head(remaining)
-                remaining -= len(mp)
-            if len(mp):
-                yield mp
-            if remaining is not None and remaining <= 0:
-                return
+        yield from _stream_with_retry(task, lambda: source_task.execute(),
+                                      remaining, project_columns=True)
         return
     for f in task.files:
         if remaining is not None and remaining <= 0:
             return
-        if task.file_format == "parquet":
-            it = _read_parquet_file(f.path, task, morsel_rows)
-        elif task.file_format == "warc":
-            it = _read_warc_file(f.path, task, morsel_rows)
-        elif task.file_format == "csv":
-            it = _read_csv_file(f.path, task, morsel_rows)
-        elif task.file_format == "json":
-            it = _read_json_file(f.path, task, morsel_rows)
-        elif task.file_format == "text":
-            it = _read_text_file(f.path, task, morsel_rows)
-        else:
-            raise DaftValueError(f"Unknown file format: {task.file_format}")
-        for mp in it:
-            mp = _apply_post_pushdowns(mp, task)
-            if remaining is not None:
-                if len(mp) > remaining:
-                    mp = mp.head(remaining)
-                remaining -= len(mp)
-            if len(mp):
-                yield mp
-            if remaining is not None and remaining <= 0:
-                return
+        remaining = yield from _stream_with_retry(
+            task, lambda f=f: _read_one_file(task, f, morsel_rows), remaining
+        )
+
+
+_SCAN_RETRIES = 3
+
+
+def _stream_with_retry(task: ScanTask, make_iter, remaining, project_columns: bool = False):
+    """Stream morsels from ``make_iter()`` applying pushdown filters/limit,
+    retrying transient failures (reference: src/daft-io/src/retry.rs).
+
+    Retry is only safe BEFORE the first morsel reached the consumer (a
+    mid-stream retry would duplicate yielded rows); the final attempt always
+    re-raises, so the loop has no normal fall-through.
+    """
+    import time as _time
+
+    from daft_tpu.errors import DaftTransientError
+
+    for attempt in range(_SCAN_RETRIES):
+        yielded = False
+        try:
+            for mp in make_iter():
+                mp = _apply_post_pushdowns(mp, task)
+                if project_columns and task.pushdowns.columns is not None:
+                    from daft_tpu.expressions.expr import ColumnRef
+
+                    mp = mp.eval_expression_list(
+                        [ColumnRef(c) for c in task.pushdowns.columns])
+                if remaining is not None:
+                    if len(mp) > remaining:
+                        mp = mp.head(remaining)
+                    remaining -= len(mp)
+                if len(mp):
+                    yielded = True
+                    yield mp
+                if remaining is not None and remaining <= 0:
+                    return remaining
+            return remaining
+        except DaftTransientError:
+            if yielded or attempt + 1 >= _SCAN_RETRIES:
+                raise
+            _time.sleep(0.05 * (2 ** attempt))
+
+
+def _read_one_file(task: ScanTask, f, morsel_rows: int):
+    if task.file_format == "parquet":
+        return _read_parquet_file(f.path, task, morsel_rows)
+    if task.file_format == "warc":
+        return _read_warc_file(f.path, task, morsel_rows)
+    if task.file_format == "csv":
+        return _read_csv_file(f.path, task, morsel_rows)
+    if task.file_format == "json":
+        return _read_json_file(f.path, task, morsel_rows)
+    if task.file_format == "text":
+        return _read_text_file(f.path, task, morsel_rows)
+    raise DaftValueError(f"Unknown file format: {task.file_format}")
 
 
 def _apply_post_pushdowns(mp: MicroPartition, task: ScanTask) -> MicroPartition:

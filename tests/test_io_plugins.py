@@ -84,3 +84,38 @@ def test_data_sink_plugin():
 
     out = daft_tpu.from_pydict({"a": [1, 2, 3]}).write_sink(CollectSink())
     assert out.to_pydict() == {"total": [3]}
+
+
+def test_mock_source_transient_retry():
+    """Transient failures retry and succeed; fatal failures surface
+    (reference: src/daft-io/src/mock.rs failure-injection pattern)."""
+    from daft_tpu.io.mock import MockSource
+
+    src = MockSource(
+        [{"x": [1, 2]}, {"x": [3, 4]}],
+        transient_failures={0: 2},  # task 0 fails its first two attempts
+    )
+    df = read_source(src)
+    assert sorted(df.to_pydict()["x"]) == [1, 2, 3, 4]
+    assert src.attempts(0) == 3  # 2 failures + 1 success
+
+    fatal = MockSource([{"x": [1]}], fatal_tasks={0})
+    with pytest.raises(Exception, match="fatal"):
+        read_source(fatal).to_pydict()
+
+    exhausted = MockSource([{"x": [1]}], transient_failures={0: 99})
+    with pytest.raises(Exception, match="transient"):
+        read_source(exhausted).to_pydict()
+
+
+def test_describe_summarize_into_batches():
+    import daft_tpu as dt
+
+    df = dt.from_pydict({"a": [1, 2, 2, None], "s": ["x", "y", "y", "z"]})
+    desc = df.describe().to_pydict()
+    assert desc["column"] == ["a", "s"]
+    summ = df.summarize().to_pydict()
+    assert summ["count"] == [3, 4]
+    assert summ["count_nulls"] == [1, 0]
+    assert summ["min"][0] == "1" and summ["max"][0] == "2"
+    assert df.into_batches(2).count_rows() == 4
