@@ -67,7 +67,8 @@ def __getattr__(name: str):
 
         return getattr(creation, name)
     if name in ("read_parquet", "read_csv", "read_json", "read_text", "read_warc",
-                "from_glob_path"):
+                "read_iceberg", "read_deltalake", "read_lance", "read_hudi",
+                "read_sql", "read_huggingface", "from_glob_path"):
         from daft_tpu.io import reads
 
         return getattr(reads, name)

@@ -54,24 +54,12 @@ def _pad_batch(arr: np.ndarray, to: int) -> np.ndarray:
 
 
 
-def _load_flax_checkpoint(path: str, params):
-    """Load a local .msgpack (flax.serialization) or .npz checkpoint into an
-    already-initialised param tree."""
-    import flax.serialization
+def load_checkpoint(path: str, params):
+    """Delegate to the single loader in models/checkpoint.py (orbax dir,
+    .msgpack, or .npz)."""
+    from daft_tpu.models.checkpoint import load_params
 
-    if path.endswith(".npz"):
-        import flax.traverse_util as tu
-
-        flat_file = dict(np.load(path))
-        flat = tu.flatten_dict(flax.serialization.to_state_dict(params), sep="/")
-        for k in flat:
-            if k in flat_file:
-                flat[k] = jnp.asarray(flat_file[k])
-        return flax.serialization.from_state_dict(
-            params, tu.unflatten_dict({tuple(k.split("/")): v for k, v in flat.items()})
-        )
-    with open(path, "rb") as f:
-        return flax.serialization.from_bytes(params, f.read())
+    return load_params(path, params)
 
 
 def _chunked_forward(fwd, params, arr: np.ndarray, max_batch: int, out_dim: int) -> np.ndarray:
@@ -180,7 +168,7 @@ class FlaxMiniLMTextEmbedder(_FlaxModelBase):
         self.cfg = MiniLMConfig.from_name(model_name)
         self.model, params = init_minilm_params(self.cfg, seed)
         if weights_path:
-            params = _load_flax_checkpoint(weights_path, params)
+            params = load_checkpoint(weights_path, params)
         self.params = jax.device_put(params)
         self.tokenizer = HashingTokenizer(self.cfg.vocab_size, self.cfg.max_length)
         model = self.model
@@ -240,7 +228,7 @@ class FlaxPrompter(_FlaxModelBase):
         self.cfg = DecoderLMConfig.from_name(model_name)
         self.model, self.params = init_lm_params(self.cfg, seed)
         if weights_path:
-            self.params = _load_flax_checkpoint(weights_path, self.params)
+            self.params = load_checkpoint(weights_path, self.params)
         self.params = jax.device_put(self.params)
         self.max_new_tokens = max_new_tokens
         self.temperature = temperature

@@ -359,17 +359,23 @@ class DistributedExecutor:
         left_refs = self._run(left)
         right_refs = self._run(right)
         right_bytes = sum(r.size_bytes() for r in right_refs)
-        if (node.how in ("inner", "left", "semi", "anti")
-                and right_bytes <= self.cfg.broadcast_join_size_bytes_threshold):
+        strategy = getattr(node, "strategy", None)
+        use_broadcast = (
+            node.how in ("inner", "left", "semi", "anti")
+            and (strategy == "broadcast"
+                 or (strategy in (None, "auto")
+                     and right_bytes <= self.cfg.broadcast_join_size_bytes_threshold))
+        )
+        if use_broadcast:
             # Broadcast join: ship the small build side to every left partition.
             tasks = []
             for i, lref in enumerate(left_refs):
                 frag = pp.HashJoin(BoundInput(0, left.schema), BoundInput(1, right.schema),
                                    node.left_on, node.right_on, node.how, node.schema,
                                    node.suffix, node.merged_keys)
-                strategy = (SchedulingStrategy.affinity(lref.location)
+                sched = (SchedulingStrategy.affinity(lref.location)
                             if lref.location else SchedulingStrategy.spread())
-                tasks.append(Task(frag, [[lref], list(right_refs)], strategy=strategy,
+                tasks.append(Task(frag, [[lref], list(right_refs)], strategy=sched,
                                   partition_idx=i))
             return [r[0] for r in self._dispatch(tasks)]
         # Hash-shuffle both sides on the join keys.

@@ -71,3 +71,82 @@ def read_warc(path, io_config=None, **kwargs):
         Field("warc_content", DataType.binary()),
     ])
     return _read(path, "warc", schema, io_config=kwargs.get("io_config") or io_config)
+
+
+def _integration_read(name: str, required: str):
+    from daft_tpu.errors import DaftIOError
+
+    raise DaftIOError(
+        f"read_{name} requires the {required} integration, which is not "
+        "available in this environment (no network egress / package). The "
+        "reader surface is reserved for parity with the reference "
+        "(daft/io) and activates when the dependency is present."
+    )
+
+
+def read_iceberg(table, **kwargs):
+    """Apache Iceberg tables (reference: daft.read_iceberg)."""
+    return _integration_read("iceberg", "pyiceberg")
+
+
+def read_deltalake(table, **kwargs):
+    """Delta Lake tables (reference: daft.read_deltalake)."""
+    return _integration_read("deltalake", "deltalake")
+
+
+def read_lance(url, **kwargs):
+    """Lance datasets (reference: daft.read_lance)."""
+    return _integration_read("lance", "pylance")
+
+
+def read_hudi(table_uri, **kwargs):
+    """Apache Hudi tables (reference: daft.read_hudi)."""
+    return _integration_read("hudi", "hudi")
+
+
+def read_sql(sql_query: str, conn, **kwargs):
+    """SQL databases via a connection factory (reference: daft.read_sql).
+
+    Works when `conn` yields a DB-API connection: the query runs once and the
+    result materialises through Arrow.
+    """
+    import pyarrow as pa
+
+    from daft_tpu.dataframe.creation import from_arrow
+    from daft_tpu.errors import DaftIOError, DaftValueError
+
+    # A factory is anything callable that isn't already a DB-API connection
+    # (sqlite3.Connection is itself callable, so check for .cursor first).
+    if isinstance(conn, str):
+        raise DaftIOError(
+            "read_sql takes a DB-API connection or a zero-arg factory "
+            "returning one; connection-string URLs need the connectorx "
+            "integration, unavailable in this environment"
+        )
+    connection = conn if hasattr(conn, "cursor") else conn()
+    cursor = connection.cursor()
+    cursor.execute(sql_query)
+    if cursor.description is None:
+        raise DaftValueError(
+            "read_sql requires a statement returning rows (SELECT); "
+            f"got no result set from {sql_query[:60]!r}"
+        )
+    columns = []
+    seen: dict = {}
+    for d in cursor.description:
+        name = d[0]
+        if name in seen:
+            seen[name] += 1
+            name = f"{name}_{seen[d[0]]}"
+        else:
+            seen[name] = 0
+        columns.append(name)
+    rows = cursor.fetchall()
+    data = {c: [r[i] for r in rows] for i, c in enumerate(columns)}
+    return from_arrow(pa.table(data))
+
+
+def read_huggingface(repo: str, **kwargs):
+    """HuggingFace datasets (reference: daft.read_huggingface); requires
+    network egress."""
+    return _integration_read("huggingface", "network egress + hf hub")

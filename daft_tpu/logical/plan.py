@@ -434,6 +434,13 @@ class Join(LogicalPlan):
                  strategy: Optional[str] = None, suffix: str = "right.", prefix: str = ""):
         if how not in ("inner", "left", "right", "outer", "semi", "anti", "cross"):
             raise DaftValueError(f"Unknown join type {how}")
+        if strategy not in (None, "auto", "hash", "broadcast", "sort_merge", "cross"):
+            raise DaftValueError(f"Unknown join strategy {strategy!r}")
+        if strategy == "broadcast" and how in ("right", "outer"):
+            raise DaftValueError(
+                f"broadcast strategy cannot preserve unmatched build-side rows "
+                f"for {how!r} joins; use hash"
+            )
         self.left_on = list(left_on)
         self.right_on = list(right_on)
         self.how = how

@@ -1,0 +1,59 @@
+"""Model parameter checkpointing via orbax.
+
+Gives the AI providers a production weight path: ``weights_path`` may be a
+flax .msgpack file, an .npz, or an orbax checkpoint directory (sharded,
+mesh-restorable — the format multi-chip deployments use).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+
+def save_params(params: Any, path: str) -> None:
+    """Save a param pytree to an orbax checkpoint directory."""
+    import orbax.checkpoint as ocp
+
+    path = os.path.abspath(path)
+    checkpointer = ocp.StandardCheckpointer()
+    checkpointer.save(path, params)
+    checkpointer.wait_until_finished()
+
+
+def load_params(path: str, like: Any) -> Any:
+    """Restore a param pytree (shaped like ``like``) from an orbax dir,
+    a flax .msgpack, or an .npz file. The single loading implementation —
+    providers and model modules all delegate here."""
+    path = os.path.abspath(path)
+    if os.path.isdir(path):
+        import jax
+        import orbax.checkpoint as ocp
+
+        checkpointer = ocp.StandardCheckpointer()
+        target = jax.tree_util.tree_map(
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), like
+        )
+        return checkpointer.restore(path, target)
+    return _load_flax_file(path, like)
+
+
+def _load_flax_file(path: str, params: Any) -> Any:
+    """.msgpack (flax.serialization) or .npz into an initialised tree."""
+    import flax.serialization
+    import numpy as np
+
+    if path.endswith(".npz"):
+        import flax.traverse_util as tu
+        import jax.numpy as jnp
+
+        flat_file = dict(np.load(path))
+        flat = tu.flatten_dict(flax.serialization.to_state_dict(params), sep="/")
+        for k in flat:
+            if k in flat_file:
+                flat[k] = jnp.asarray(flat_file[k])
+        return flax.serialization.from_state_dict(
+            params, tu.unflatten_dict({tuple(k.split("/")): v for k, v in flat.items()})
+        )
+    with open(path, "rb") as f:
+        return flax.serialization.from_bytes(params, f.read())

@@ -181,23 +181,8 @@ def init_clip_params(cfg: CLIPConfig, seed: int = 0):
 
 
 def load_params(path: str, cfg: CLIPConfig):
-    """Load a locally-available Flax checkpoint (.msgpack via flax serialization
-    or .npz). Falls back is caller's responsibility."""
-    import flax.serialization
+    """Load a locally-available checkpoint (orbax dir, .msgpack, or .npz)."""
+    from daft_tpu.models.checkpoint import load_params as _load
 
     model, params = init_clip_params(cfg)
-    if path.endswith(".npz"):
-        flat = dict(np.load(path))
-        import flax.traverse_util as tu
-
-        target = tu.flatten_dict(flax.serialization.to_state_dict(params), sep="/")
-        for k in target:
-            if k in flat:
-                target[k] = jnp.asarray(flat[k])
-        params = flax.serialization.from_state_dict(
-            params, tu.unflatten_dict({tuple(k.split("/")): v for k, v in target.items()})
-        )
-        return model, params
-    with open(path, "rb") as f:
-        params = flax.serialization.from_bytes(params, f.read())
-    return model, params
+    return model, _load(path, params)

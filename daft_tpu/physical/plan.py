@@ -207,13 +207,14 @@ class Window(PhysicalPlan):
 
 class HashJoin(PhysicalPlan):
     def __init__(self, left: PhysicalPlan, right: PhysicalPlan, left_on, right_on,
-                 how, schema: Schema, suffix: str, merged_keys):
+                 how, schema: Schema, suffix: str, merged_keys, strategy=None):
         super().__init__([left, right], schema)
         self.left_on = left_on
         self.right_on = right_on
         self.how = how
         self.suffix = suffix
         self.merged_keys = merged_keys
+        self.strategy = strategy  # None=auto | broadcast | hash | sort_merge
 
     def describe(self):
         return f"HashJoin[{self.how}]"

@@ -62,7 +62,8 @@ def translate(node: lp.LogicalPlan, cfg) -> pp.PhysicalPlan:
             if isinstance(l, ColumnRef) and isinstance(r, ColumnRef) and l.name_ == r.name_
         }
         return pp.HashJoin(t(left), t(right), node.left_on, node.right_on,
-                           node.how, node.schema, f"{node.prefix}{node.suffix}", merged)
+                           node.how, node.schema, f"{node.prefix}{node.suffix}", merged,
+                           node.strategy)
     if isinstance(node, lp.Intersect):
         left, right = node.children()
         keys = [ColumnRef(n) for n in left.schema.column_names()]

@@ -125,3 +125,36 @@ def test_file_runtime(tmp_path):
     df = daft_tpu.from_pydict({"f": s})
     out = df.select(size_of(col("f")).alias("n")).to_pydict()
     assert out["n"] == [6, 5, None]
+
+
+def test_orbax_checkpoint_roundtrip(tmp_path):
+    import jax
+
+    from daft_tpu.models.checkpoint import load_params, save_params
+    from daft_tpu.models.minilm import MiniLMConfig, init_minilm_params
+
+    _, params = init_minilm_params(MiniLMConfig.tiny(), seed=7)
+    d = str(tmp_path / "ckpt")
+    save_params(params, d)
+    _, fresh = init_minilm_params(MiniLMConfig.tiny(), seed=99)
+    restored = load_params(d, fresh)
+    a = jax.tree_util.tree_leaves(params)
+    b = jax.tree_util.tree_leaves(restored)
+    for x, y in zip(a, b):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+def test_weights_path_orbax_dir(tmp_path):
+    from daft_tpu.functions.ai import embed_text
+    from daft_tpu.models.checkpoint import save_params
+    from daft_tpu.models.minilm import MiniLMConfig, init_minilm_params
+
+    _, params = init_minilm_params(MiniLMConfig.tiny(), seed=7)
+    d = str(tmp_path / "w")
+    save_params(params, d)
+    df = daft_tpu.from_pydict({"t": ["hello"]})
+    e1 = df.with_column("e", embed_text(col("t"), provider="flax", model="tiny",
+                                        weights_path=d, seed=7)).to_pydict()["e"][0]
+    e2 = df.with_column("e", embed_text(col("t"), provider="flax_random", model="tiny",
+                                        seed=7)).to_pydict()["e"][0]
+    np.testing.assert_allclose(np.asarray(e1), np.asarray(e2), atol=1e-5)

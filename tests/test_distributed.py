@@ -187,3 +187,12 @@ def test_into_partitions_grow_preserves_order(dist_ctx):
     df = daft_tpu.from_pydict({"a": list(range(20))}).into_partitions(2)
     out = df.into_partitions(5).to_pydict()["a"]
     assert out == list(range(20))
+
+
+def test_forced_broadcast_join_strategy(dist_ctx):
+    left = daft_tpu.from_pydict({"k": list(range(20)), "v": list(range(20))}).into_partitions(4)
+    right = daft_tpu.from_pydict({"k": list(range(20)), "w": list(range(20))})
+    with daft_tpu.execution_config_ctx(broadcast_join_size_bytes_threshold=0):
+        # auto would hash-shuffle; strategy="broadcast" must force broadcast
+        out = left.join(right, on="k", strategy="broadcast").count_rows()
+    assert out == 20

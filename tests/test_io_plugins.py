@@ -119,3 +119,21 @@ def test_describe_summarize_into_batches():
     assert summ["count_nulls"] == [1, 0]
     assert summ["min"][0] == "1" and summ["max"][0] == "2"
     assert df.into_batches(2).count_rows() == 4
+
+
+def test_integration_reader_stubs():
+    for name in ("read_iceberg", "read_deltalake", "read_lance", "read_hudi",
+                 "read_huggingface"):
+        fn = getattr(daft_tpu, name)
+        with pytest.raises(Exception, match="integration"):
+            fn("anything")
+
+
+def test_read_sql_dbapi():
+    import sqlite3
+
+    conn = sqlite3.connect(":memory:")
+    conn.execute("CREATE TABLE t (a INTEGER, b TEXT)")
+    conn.executemany("INSERT INTO t VALUES (?, ?)", [(1, "x"), (2, "y")])
+    df = daft_tpu.read_sql("SELECT * FROM t ORDER BY a", lambda: conn)
+    assert df.to_pydict() == {"a": [1, 2], "b": ["x", "y"]}
