@@ -244,6 +244,21 @@ class DataFrame:
             other._builder, _inner(left_on), _inner(right_on), how, strategy, suffix, prefix
         ))
 
+    def join_asof(self, other: "DataFrame", on: Optional[ColumnInput] = None,
+                  left_on: Optional[ColumnInput] = None, right_on: Optional[ColumnInput] = None,
+                  by: Optional[Union[ColumnInput, List[ColumnInput]]] = None,
+                  direction: str = "backward", suffix: str = "right.") -> "DataFrame":
+        """Nearest-key join (reference: asof join; benchmarking/asof_join)."""
+        if on is not None:
+            left_on = right_on = on
+        if left_on is None or right_on is None:
+            raise DaftValueError("join_asof requires `on` or both `left_on`/`right_on`")
+        by = by if isinstance(by, list) else ([by] if by is not None else [])
+        return self._with(self._builder.asof_join(
+            other._builder, _to_expr(left_on)._expr, _to_expr(right_on)._expr,
+            _inner(by), _inner(by), direction, suffix,
+        ))
+
     def cross_join(self, other: "DataFrame", suffix: str = "right.") -> "DataFrame":
         return self._with(self._builder.cross_join(other._builder, suffix))
 

@@ -391,6 +391,20 @@ class DistributedExecutor:
             tasks.append(Task(frag, [left_buckets[j], right_buckets[j]], partition_idx=j))
         return [r[0] for r in self._dispatch(tasks)]
 
+    def _run_AsofJoin(self, node: pp.AsofJoin) -> List[PartitionRef]:
+        # The build side must be complete for nearest-key matching: broadcast
+        # it to every left partition.
+        left, right = node.children
+        left_refs = self._run(left)
+        right_refs = self._run(right)
+        tasks = []
+        for i, lref in enumerate(left_refs):
+            frag = pp.AsofJoin(BoundInput(0, left.schema), BoundInput(1, right.schema),
+                               node.left_on, node.right_on, node.left_by, node.right_by,
+                               node.direction, node.schema, node.suffix)
+            tasks.append(Task(frag, [[lref], list(right_refs)], partition_idx=i))
+        return [r[0] for r in self._dispatch(tasks)]
+
     def _run_CrossJoin(self, node: pp.CrossJoin) -> List[PartitionRef]:
         left, right = node.children
         left_refs = self._run(left)

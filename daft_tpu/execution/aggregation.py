@@ -107,6 +107,11 @@ class TwoPhasePlan:
             if op == "approx_percentile":
                 l = add("l", AggOp("list", Cast(child, DataType.float64())), "concat")
                 return FunctionCall("list_quantile", [l], {"percentiles": agg.kwargs.get("percentiles")})
+            if op == "udaf":
+                # Exact for any UDAF: collect -> concat -> apply. Incremental
+                # partial states are a later optimisation.
+                l = add("l", AggOp("list", child), "concat")
+                return FunctionCall("udaf_apply", [l], {"udaf": agg.kwargs["udaf"]})
             raise DaftValueError(f"Cannot decompose agg op {op}")
 
         self.final_exprs: List[Expr] = []

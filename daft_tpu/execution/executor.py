@@ -442,6 +442,15 @@ class Executor:
             out = self._join_and_fix(left, right, left_keys, right_keys, node)
             yield MicroPartition(node.schema, [out])
 
+    @staticmethod
+    def _conform_to_schema(rb: RecordBatch, schema: Schema) -> RecordBatch:
+        """Reorder/cast columns to the planned output schema."""
+        cols = []
+        for f in schema:
+            c = rb.get_column(f.name)
+            cols.append(c.cast(f.dtype) if c.dtype != f.dtype else c)
+        return RecordBatch(schema, cols, len(rb))
+
     def _join_and_fix(self, left, right, left_keys, right_keys, node) -> RecordBatch:
         if node.merged_keys and node.how not in ("semi", "anti"):
             # Same-name equi-keys merge: drop the right copy before joining.
@@ -450,19 +459,25 @@ class Executor:
         else:
             right_data = right
         joined = left.hash_join(right_data, left_keys, right_keys, node.how, node.suffix)
-        # Conform to planned schema (column order, dtypes).
-        cols = []
-        for f in node.schema:
-            c = joined.get_column(f.name)
-            cols.append(c.cast(f.dtype) if c.dtype != f.dtype else c)
-        return RecordBatch(node.schema, cols, len(joined))
+        return self._conform_to_schema(joined, node.schema)
+
+    def _run_AsofJoin(self, node: pp.AsofJoin) -> Iterator[MicroPartition]:
+        right = self._collect(node.children[1]).combined()
+        right_on = evaluate(node.right_on, right)
+        right_by = [evaluate(e, right) for e in node.right_by]
+        for mp in self._run(node.children[0]):
+            left = mp.combined()
+            left_on = evaluate(node.left_on, left)
+            left_by = [evaluate(e, left) for e in node.left_by]
+            joined = left.asof_join(right, left_on, right_on, left_by, right_by,
+                                    node.direction, node.suffix)
+            yield MicroPartition(node.schema, [self._conform_to_schema(joined, node.schema)])
 
     def _run_CrossJoin(self, node: pp.CrossJoin) -> Iterator[MicroPartition]:
         right = self._collect(node.children[1]).combined()
         for mp in self._run(node.children[0]):
             joined = mp.combined().cross_join(right, node.suffix)
-            cols = [joined.get_column(f.name) for f in node.schema]
-            yield MicroPartition(node.schema, [RecordBatch(node.schema, cols, len(joined))])
+            yield MicroPartition(node.schema, [self._conform_to_schema(joined, node.schema)])
 
     # -- multi-input / partitioning --------------------------------------
     def _run_Concat(self, node: pp.Concat) -> Iterator[MicroPartition]:

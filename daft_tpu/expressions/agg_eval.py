@@ -188,6 +188,11 @@ def _global_agg(child: Series, agg: AggOp) -> Series:
         return child.approx_count_distinct()
     if op == "approx_percentile":
         return child.approx_percentile(agg.kwargs["percentiles"])
+    if op == "udaf":
+        udaf_obj = agg.kwargs["udaf"]
+        vals = [v for v in child.to_pylist() if v is not None]
+        return Series.from_pylist([udaf_obj.apply(vals)], child.name,
+                                  udaf_obj.return_dtype)
     if op == "bool_and":
         v = child.drop_null().to_numpy()
         return Series.from_pylist([bool(v.all()) if len(v) else None], child.name, DataType.bool())

@@ -354,7 +354,7 @@ class AggOp(Expr):
     OPS = {
         "sum", "mean", "min", "max", "count", "count_distinct", "any_value",
         "list", "concat", "stddev", "variance", "skew", "approx_count_distinct",
-        "approx_percentile", "bool_and", "bool_or",
+        "approx_percentile", "bool_and", "bool_or", "udaf",
     }
 
     __slots__ = ("op", "child", "kwargs")
@@ -398,6 +398,8 @@ class AggOp(Expr):
             if isinstance(q, (list, tuple)):
                 return f.with_dtype(DataType.list(DataType.float64()))
             return f.with_dtype(DataType.float64())
+        if op == "udaf":
+            return f.with_dtype(self.kwargs["udaf"].return_dtype)
         raise DaftValueError(op)
 
     def _attrs_key(self) -> tuple:

@@ -148,3 +148,14 @@ def _minhash(args, num_hashes: int = 64, ngram_size: int = 1, seed: int = 1, **k
     if not validity.all():
         res = res._with_mask(~validity)
     return res
+
+
+@register_kernel("udaf_apply", lambda f, k: Field(f[0].name, k["udaf"].return_dtype))
+def _udaf_apply(args, udaf=None, **kwargs):
+    """Apply a UDAF to each list row (two-phase UDAF finalizer)."""
+    s = args[0]
+    out = []
+    for v in s.to_pylist():
+        vals = [x for x in (v or []) if x is not None]
+        out.append(udaf.apply(vals))
+    return Series.from_pylist(out, s.name, udaf.return_dtype)

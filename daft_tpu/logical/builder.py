@@ -129,6 +129,12 @@ class LogicalPlanBuilder:
         return LogicalPlanBuilder(lp.Join(self._plan, right._plan, left_on, right_on,
                                           how, strategy, suffix, prefix))
 
+    def asof_join(self, right: "LogicalPlanBuilder", left_on, right_on,
+                  left_by=(), right_by=(), direction="backward",
+                  suffix="right.") -> "LogicalPlanBuilder":
+        return LogicalPlanBuilder(lp.AsofJoin(self._plan, right._plan, left_on, right_on,
+                                              left_by, right_by, direction, suffix))
+
     def cross_join(self, right: "LogicalPlanBuilder", suffix="right.") -> "LogicalPlanBuilder":
         return LogicalPlanBuilder(lp.Join(self._plan, right._plan, [], [], "cross", None, suffix))
 

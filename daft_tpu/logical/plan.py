@@ -487,6 +487,41 @@ class Join(LogicalPlan):
         return ApproxStats(rows, l.size_bytes + r.size_bytes)
 
 
+class AsofJoin(LogicalPlan):
+    """Nearest-key join (reference: asof join in the local execution joins)."""
+
+    def __init__(self, left: LogicalPlan, right: LogicalPlan, left_on: Expr, right_on: Expr,
+                 left_by: Sequence[Expr] = (), right_by: Sequence[Expr] = (),
+                 direction: str = "backward", suffix: str = "right."):
+        self.left_on = left_on
+        self.right_on = right_on
+        self.left_by = list(left_by)
+        self.right_by = list(right_by)
+        self.direction = direction
+        self.suffix = suffix
+        if direction not in ("backward", "forward"):
+            raise DaftValueError(
+                f"asof direction must be 'backward' or 'forward', got {direction!r}"
+            )
+        lf = left_on.to_field(left.schema)
+        rf = right_on.to_field(right.schema)
+        if not lf.dtype.is_comparable() or not rf.dtype.is_comparable():
+            raise DaftTypeError("asof join keys must be orderable")
+        for e in self.left_by:
+            e.to_field(left.schema)
+        for e in self.right_by:
+            e.to_field(right.schema)
+        fields = list(left.schema.fields())
+        left_names = set(left.schema.column_names())
+        for f in right.schema:
+            fields.append(f.rename(f"{suffix}{f.name}") if f.name in left_names else f)
+        super().__init__([left, right], Schema(fields))
+
+    def with_children(self, children):
+        return AsofJoin(children[0], children[1], self.left_on, self.right_on,
+                        self.left_by, self.right_by, self.direction, self.suffix)
+
+
 # ---------------------------------------------------------------------- #
 # Partitioning / output                                                   #
 # ---------------------------------------------------------------------- #

@@ -220,6 +220,18 @@ class HashJoin(PhysicalPlan):
         return f"HashJoin[{self.how}]"
 
 
+class AsofJoin(PhysicalPlan):
+    def __init__(self, left: PhysicalPlan, right: PhysicalPlan, left_on, right_on,
+                 left_by, right_by, direction, schema: Schema, suffix: str):
+        super().__init__([left, right], schema)
+        self.left_on = left_on
+        self.right_on = right_on
+        self.left_by = left_by
+        self.right_by = right_by
+        self.direction = direction
+        self.suffix = suffix
+
+
 class CrossJoin(PhysicalPlan):
     def __init__(self, left: PhysicalPlan, right: PhysicalPlan, schema: Schema, suffix: str):
         super().__init__([left, right], schema)

@@ -64,6 +64,11 @@ def translate(node: lp.LogicalPlan, cfg) -> pp.PhysicalPlan:
         return pp.HashJoin(t(left), t(right), node.left_on, node.right_on,
                            node.how, node.schema, f"{node.prefix}{node.suffix}", merged,
                            node.strategy)
+    if isinstance(node, lp.AsofJoin):
+        left, right = node.children()
+        return pp.AsofJoin(t(left), t(right), node.left_on, node.right_on,
+                           node.left_by, node.right_by, node.direction,
+                           node.schema, node.suffix)
     if isinstance(node, lp.Intersect):
         left, right = node.children()
         keys = [ColumnRef(n) for n in left.schema.column_names()]

@@ -337,6 +337,59 @@ class RecordBatch:
         joined = joined.select(keep)
         return RecordBatch.from_arrow_table(joined)
 
+    def asof_join(self, right: "RecordBatch", left_on: Series, right_on: Series,
+                  left_by: Sequence[Series] = (), right_by: Sequence[Series] = (),
+                  direction: str = "backward", suffix: str = "right.") -> "RecordBatch":
+        """As-of (nearest-key) join: for each left row, the right row with the
+        greatest on-key <= left key (backward) / least >= (forward), within
+        equal `by` groups (reference: asof join in swordfish join operators,
+        src/daft-local-execution/src/join + benchmarking/asof_join)."""
+        if direction not in ("backward", "forward"):
+            raise DaftValueError(f"asof direction must be backward/forward, got {direction}")
+        n_left = len(self)
+        match_idx = np.full(n_left, -1, dtype=np.int64)
+        if len(right) and n_left:
+            if left_by:
+                # Group by the by-keys; combine left+right so codes align.
+                all_by = [Series.concat([lb, rb]) for lb, rb in zip(left_by, right_by)]
+                codes, _ = _group_codes(all_by)
+                l_g, r_g = codes[:n_left], codes[n_left:]
+            else:
+                l_g = np.zeros(n_left, dtype=np.int64)
+                r_g = np.zeros(len(right), dtype=np.int64)
+            l_vals = left_on.to_numpy()
+            r_vals = right_on.to_numpy()
+            for g in np.unique(np.concatenate([l_g, r_g])):
+                li = np.nonzero(l_g == g)[0]
+                ri = np.nonzero(r_g == g)[0]
+                if len(li) == 0 or len(ri) == 0:
+                    continue
+                order = np.argsort(r_vals[ri], kind="stable")
+                sorted_r = r_vals[ri][order]
+                if direction == "backward":
+                    pos = np.searchsorted(sorted_r, l_vals[li], side="right") - 1
+                    valid = pos >= 0
+                else:
+                    pos = np.searchsorted(sorted_r, l_vals[li], side="left")
+                    valid = pos < len(sorted_r)
+                match_idx[li[valid]] = ri[order[pos[valid].clip(0, len(sorted_r) - 1)]]
+        matched = match_idx >= 0
+        safe_idx = np.where(matched, match_idx, 0).astype(np.uint64)
+        overlap = set(self.column_names()) & set(right.column_names())
+        out_cols = list(self._columns)
+        for c in right.columns():
+            name = f"{suffix}{c.name}" if c.name in overlap else c.name
+            if len(right) == 0 or not matched.any():
+                # Nothing to take from (or nothing matched): all-null column.
+                out_cols.append(Series.null(name, c.dtype, n_left))
+                continue
+            taken = c.take(safe_idx)
+            if not matched.all():
+                taken = taken._with_mask(~matched)
+            out_cols.append(taken.rename(name))
+        return RecordBatch(Schema([Field(c.name, c.dtype) for c in out_cols]),
+                           out_cols, n_left)
+
     def cross_join(self, right: "RecordBatch", suffix: str = "right.") -> "RecordBatch":
         n_l, n_r = len(self), len(right)
         left_idx = np.repeat(np.arange(n_l, dtype=np.uint64), n_r)

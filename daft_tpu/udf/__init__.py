@@ -22,6 +22,9 @@ from daft_tpu.expressions.expression import Expression
 from daft_tpu.series import Series
 
 
+from daft_tpu.udf.udaf import Udaf, udaf  # noqa: F401  (public surface)
+
+
 class Udf:
     """A callable UDF descriptor; calling it builds a UdfCall expression."""
 

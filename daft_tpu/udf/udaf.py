@@ -1,0 +1,55 @@
+"""User-defined aggregation functions.
+
+Reference: daft/udf/udaf.py — UDAFs aggregate a column per group. Two forms:
+
+* a plain function ``fn(values: list) -> scalar``;
+* a class with ``accumulate(values) / finalize()`` (``merge(other)`` is
+  reserved for a future incremental-partial path; today the engine collects
+  then applies, which is exact for any UDAF).
+
+Distributed execution routes UDAFs through the two-phase planner as
+list-collect → concat → apply, which is semantically exact for any UDAF
+(incremental partial states are a later optimisation).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+from daft_tpu.datatype import DataType
+from daft_tpu.errors import DaftValueError
+
+
+class Udaf:
+    def __init__(self, fn_or_cls, return_dtype: DataType, name: Optional[str] = None):
+        self.fn_or_cls = fn_or_cls
+        self.return_dtype = return_dtype
+        self.name = name or getattr(fn_or_cls, "__name__", "udaf")
+
+    def apply(self, values: list) -> Any:
+        target = self.fn_or_cls
+        if isinstance(target, type):
+            inst = target()
+            inst.accumulate(values)
+            return inst.finalize()
+        return target(values)
+
+    def __call__(self, expr) -> "Expression":
+        from daft_tpu.expressions.expr import AggOp, ensure_expr
+        from daft_tpu.expressions.expression import Expression
+
+        return Expression(AggOp("udaf", ensure_expr(expr), {"udaf": self}))
+
+
+def udaf(return_dtype: DataType, name: Optional[str] = None):
+    """Decorator: ``@udaf(DataType.float64())`` over a function or class
+    (reference: daft.udf.udaf)."""
+
+    def deco(fn_or_cls):
+        if isinstance(fn_or_cls, type):
+            for required in ("accumulate", "finalize"):
+                if not hasattr(fn_or_cls, required):
+                    raise DaftValueError(f"UDAF class needs a {required}() method")
+        return Udaf(fn_or_cls, return_dtype, name)
+
+    return deco

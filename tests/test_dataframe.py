@@ -188,3 +188,50 @@ def test_monotonic_id_not_renumbered_by_filter():
     df = daft_tpu.from_pydict({"x": [1, 2, 3, 4]})
     out = df.add_monotonically_increasing_id("rid").where(col("x") > 2).to_pydict()
     assert out["rid"] == [2, 3]
+
+
+def test_join_asof():
+    trades = daft_tpu.from_pydict({
+        "t": [3, 7, 12, 20], "sym": ["A", "A", "B", "B"], "px": [1.0, 2.0, 3.0, 4.0],
+    })
+    quotes = daft_tpu.from_pydict({
+        "t": [1, 5, 10, 15], "sym": ["A", "A", "B", "B"], "bid": [0.9, 1.9, 2.9, 3.9],
+    })
+    out = trades.join_asof(quotes, on="t", by="sym").sort("t").to_pydict()
+    assert out["bid"] == [0.9, 1.9, 2.9, 3.9]
+    fwd = trades.join_asof(quotes, on="t", by="sym", direction="forward").sort("t").to_pydict()
+    assert fwd["bid"] == [1.9, None, 3.9, None]
+    # without by: global nearest
+    nob = trades.join_asof(quotes, on="t").sort("t").to_pydict()
+    assert nob["bid"] == [0.9, 1.9, 2.9, 3.9]
+
+
+def test_udaf_function_and_class():
+    from daft_tpu.udf import udaf
+
+    @udaf(daft_tpu.DataType.float64())
+    def geo_mean(values):
+        import math
+
+        return math.exp(sum(math.log(v) for v in values) / len(values)) if values else None
+
+    df = daft_tpu.from_pydict({"g": ["a", "a", "b"], "x": [1.0, 4.0, 9.0]})
+    assert df.agg(geo_mean(col("x")).alias("gm")).to_pydict()["gm"][0] == pytest.approx(
+        (1.0 * 4.0 * 9.0) ** (1 / 3)
+    )
+    out = df.groupby("g").agg(geo_mean(col("x")).alias("gm")).sort("g").to_pydict()
+    assert out["gm"] == [pytest.approx(2.0), pytest.approx(9.0)]
+
+    @udaf(daft_tpu.DataType.int64())
+    class RangeWidth:
+        def __init__(self):
+            self.vals = []
+
+        def accumulate(self, values):
+            self.vals.extend(values)
+
+        def finalize(self):
+            return int(max(self.vals) - min(self.vals)) if self.vals else None
+
+    df2 = daft_tpu.from_pydict({"x": [3, 9, 1]})
+    assert df2.agg(RangeWidth(col("x")).alias("w")).to_pydict()["w"] == [8]

@@ -196,3 +196,24 @@ def test_forced_broadcast_join_strategy(dist_ctx):
         # auto would hash-shuffle; strategy="broadcast" must force broadcast
         out = left.join(right, on="k", strategy="broadcast").count_rows()
     assert out == 20
+
+
+def test_udaf_distributed_two_phase(dist_ctx):
+    from daft_tpu.udf import udaf
+
+    @udaf(daft_tpu.DataType.int64())
+    def spread(values):
+        return int(max(values) - min(values)) if values else None
+
+    df = daft_tpu.from_pydict({
+        "g": ["a"] * 6 + ["b"] * 6, "x": list(range(12)),
+    }).into_partitions(4)
+    out = df.groupby("g").agg(spread(col("x")).alias("w")).sort("g").to_pydict()
+    assert out["w"] == [5, 5]
+
+
+def test_asof_join_distributed(dist_ctx):
+    trades = daft_tpu.from_pydict({"t": [3, 7, 12], "px": [1.0, 2.0, 3.0]}).into_partitions(2)
+    quotes = daft_tpu.from_pydict({"t": [1, 5, 10], "bid": [0.9, 1.9, 2.9]})
+    out = trades.join_asof(quotes, on="t").sort("t").to_pydict()
+    assert out["bid"] == [0.9, 1.9, 2.9]
