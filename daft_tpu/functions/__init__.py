@@ -159,6 +159,12 @@ def columns_max(*exprs) -> Expression:
     return out
 
 
+# -- geo -------------------------------------------------------------------
+def great_circle_distance(lat1, lon1, lat2, lon2, radius: float = 6371000.0) -> Expression:
+    """Haversine distance in meters (reference: daft-geo)."""
+    return _fn("great_circle_distance", lat1, lon1, lat2, lon2, radius=radius)
+
+
 # -- window ----------------------------------------------------------------
 def row_number() -> Expression:
     from daft_tpu.expressions.expr import WindowExpr

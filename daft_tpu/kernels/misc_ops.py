@@ -159,3 +159,46 @@ def _udaf_apply(args, udaf=None, **kwargs):
         vals = [x for x in (v or []) if x is not None]
         out.append(udaf.apply(vals))
     return Series.from_pylist(out, s.name, udaf.return_dtype)
+
+
+def _geo_resolver(fields, kwargs):
+    if len(fields) != 4:
+        raise DaftTypeError(
+            f"great_circle_distance takes (lat1, lon1, lat2, lon2); got {len(fields)} args"
+        )
+    for f in fields:
+        if not f.dtype.is_numeric() and not f.dtype.is_null():
+            raise DaftTypeError(
+                f"great_circle_distance needs numeric coordinates; {f.name!r} is {f.dtype!r}"
+            )
+    return Field(fields[0].name, DataType.float64())
+
+
+@register_kernel("great_circle_distance", _geo_resolver)
+def _great_circle_distance(args, radius: float = 6371000.0, **kwargs):
+    """Haversine great-circle distance in meters between (lat1,lon1) and
+    (lat2,lon2) degree columns (reference: src/daft-geo great-circle fn).
+    Rows with null, non-finite, or out-of-range coordinates (|lat|>90,
+    |lon|>180) yield null, matching the reference's validity semantics."""
+    vals, mask = [], None
+    for a in args[:4]:
+        v, m = a.to_numpy_masked()
+        vals.append(v.astype(np.float64))
+        if m is not None:
+            mask = m if mask is None else (mask | m)
+    lat1d, lon1d, lat2d, lon2d = vals
+    with np.errstate(invalid="ignore"):
+        invalid = (
+            ~np.isfinite(lat1d) | ~np.isfinite(lon1d)
+            | ~np.isfinite(lat2d) | ~np.isfinite(lon2d)
+            | (np.abs(lat1d) > 90.0) | (np.abs(lat2d) > 90.0)
+            | (np.abs(lon1d) > 180.0) | (np.abs(lon2d) > 180.0)
+        )
+    mask = invalid if mask is None else (mask | invalid)
+    lat1, lon1, lat2, lon2 = (np.radians(v) for v in vals)
+    with np.errstate(invalid="ignore"):
+        h = (np.sin((lat2 - lat1) / 2.0) ** 2
+             + np.cos(lat1) * np.cos(lat2) * np.sin((lon2 - lon1) / 2.0) ** 2)
+        out = 2.0 * radius * np.arcsin(np.sqrt(np.clip(h, 0.0, 1.0)))
+    out = np.where(mask, 0.0, out) if mask.any() else out
+    return Series.from_numpy(out, args[0].name)._with_mask(mask if mask.any() else None)

@@ -147,3 +147,29 @@ def test_coalesce():
 
     df = daft_tpu.from_pydict({"a": [None, 2], "b": [10, 20]})
     assert df.select(coalesce(col("a"), col("b")).alias("c")).to_pydict()["c"] == [10, 2]
+
+
+def test_great_circle_distance():
+    from daft_tpu.functions import great_circle_distance
+
+    df = daft_tpu.from_pydict({
+        "lat1": [52.52, 0.0, None, 91.0],
+        "lon1": [13.40, 0.0, 0.0, 0.0],
+        "lat2": [48.85, 0.0, 0.0, 0.0],
+        "lon2": [2.35, 90.0, 0.0, 0.0],
+    })
+    out = df.select(great_circle_distance(
+        col("lat1"), col("lon1"), col("lat2"), col("lon2")).alias("d")).to_pydict()["d"]
+    assert out[0] == pytest.approx(877_700, rel=0.01)     # Berlin -> Paris
+    assert out[1] == pytest.approx(10_007_543, rel=0.001)  # quarter circumference
+    assert out[2] is None  # null coordinate
+    assert out[3] is None  # out-of-range latitude
+    # plan-time arity validation (3 args instead of 4)
+    from daft_tpu.expressions.expr import FunctionCall
+
+    three_args = daft_tpu.Expression(FunctionCall(
+        "great_circle_distance",
+        [col("lat1")._expr, col("lon1")._expr, col("lat2")._expr],
+    ))
+    with pytest.raises(Exception, match="great_circle_distance"):
+        df.select(three_args).to_pydict()
