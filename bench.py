@@ -26,7 +26,7 @@ BATCH_SIZE = 256
 IMAGE_SIZE = 224
 
 
-def _wait_for_tpu(max_wait_s: int = 360) -> None:
+def _wait_for_tpu(max_wait_s: int = 600) -> None:
     """The axon tunnel occasionally needs time to come up; probe backend init
     in SUBPROCESSES (jax caches a failed init in-process) before committing
     the main process to it."""

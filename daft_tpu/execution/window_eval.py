@@ -93,9 +93,12 @@ def _eval_one(rb: RecordBatch, w: WindowExpr) -> Series:
             out = (out - 1.0) / denom
         return Series.from_numpy(out, w.func)
 
-    # Whole-partition aggregate broadcast back to rows.
+    # Aggregate windows: whole-partition, or a rows_between frame
+    # (reference: window_partition_and_order_by / dynamic-frame sinks).
     assert w.child is not None
     child = evaluate(w.child, rb)
+    if w.frame is not None:
+        return _eval_rows_frame(rb, w, child, group_ids, order_idx, n)
     agg = AggOp(w.func, _SeriesRef(child))
     num_groups = int(group_ids.max()) + 1 if n else 0
     per_group_vals = []
@@ -123,3 +126,100 @@ class _SeriesRef(Expr):
 
     def _attrs_key(self):
         return (id(self.series),)
+
+
+def _frame_bound(bound, n: int):
+    """Normalise a rows_between bound to an int offset or +/-inf sentinel."""
+    from daft_tpu.window import Window
+
+    if bound is Window.unbounded_preceding:
+        return -n
+    if bound is Window.unbounded_following:
+        return n
+    if bound is Window.current_row:
+        return 0
+    return int(bound)
+
+
+def _eval_rows_frame(rb, w: WindowExpr, child: Series, group_ids, order_idx, n: int) -> Series:
+    """Rolling aggregate over a rows frame [i+start, i+end] within each
+    partition, in sort order. sum/mean/count are vectorised over prefix
+    arrays (exact int64 arithmetic for integer children); min/max fall back
+    to per-row windows and support any orderable dtype."""
+    kind, start_b, end_b = w.frame
+    if kind != "rows":
+        raise DaftValueError("Only rows_between frames are supported (range pending)")
+    if w.func not in ("sum", "mean", "min", "max", "count"):
+        raise DaftValueError(f"Window frames not supported for {w.func}")
+    if w.func in ("sum", "mean") and not child.dtype.is_numeric():
+        raise DaftValueError(f"Cannot {w.func} over {child.dtype!r}")
+    if order_idx is None:
+        order_idx = np.arange(n, dtype=np.int64)
+    start_off = _frame_bound(start_b, n)
+    end_off = _frame_bound(end_b, n)
+    is_int_sum = w.func == "sum" and child.dtype.is_integer()
+    numeric = child.dtype.is_numeric()
+    if numeric:
+        vals, null_mask = child.to_numpy_masked()
+        acc_vals = vals.astype(np.int64) if is_int_sum else vals.astype(np.float64)
+    else:
+        pyvals = child.to_pylist()
+        null_mask = np.array([v is None for v in pyvals])
+        acc_vals = None
+    valid = ~null_mask if null_mask is not None else np.ones(n, dtype=bool)
+
+    out_num = np.zeros(n, dtype=np.int64 if is_int_sum else np.float64)
+    out_py: list = [None] * n
+    out_valid = np.ones(n, dtype=bool)
+    sorted_groups = group_ids[order_idx]
+    for g in np.unique(sorted_groups) if n else []:
+        rows = gidx = order_idx[sorted_groups == g]
+        m = len(rows)
+        idx = np.arange(m)
+        lo = np.clip(idx + start_off, 0, m)
+        hi_excl = np.clip(idx + end_off + 1, 0, m)
+        empty = hi_excl <= lo
+        gc = valid[rows].astype(np.int64)
+        ccnt = np.concatenate([[0], np.cumsum(gc)])
+        cnt = ccnt[hi_excl] - ccnt[lo]
+        if w.func == "count":
+            # SQL: count over an empty frame is 0, never null.
+            out_num[rows] = np.where(empty, 0, cnt)
+            continue
+        no_data = empty | (cnt == 0)
+        out_valid[rows[no_data]] = False
+        if w.func in ("sum", "mean"):
+            gv = np.where(valid[rows], acc_vals[rows], 0)
+            csum = np.concatenate([[0], np.cumsum(gv)])
+            s = csum[hi_excl] - csum[lo]
+            if w.func == "mean":
+                with np.errstate(invalid="ignore", divide="ignore"):
+                    out_num[rows] = np.where(cnt > 0, s / np.maximum(cnt, 1), 0.0)
+            else:
+                out_num[rows] = s
+            continue
+        # min/max: per-row windows; supports any orderable dtype.
+        for i in idx[~no_data]:
+            window_rows = rows[lo[i]:hi_excl[i]]
+            if numeric:
+                wv = acc_vals[window_rows][valid[window_rows]]
+                out_num[rows[i]] = wv.min() if w.func == "min" else wv.max()
+            else:
+                wv = [pyvals[r] for r in window_rows if pyvals[r] is not None]
+                out_py[rows[i]] = min(wv) if w.func == "min" else max(wv)
+    name = child.name
+    if w.func == "count":
+        return Series.from_numpy(out_num.astype(np.uint64), name)
+    if not numeric:
+        result = Series.from_pylist(
+            [out_py[i] if out_valid[i] else None for i in range(n)], name, child.dtype
+        )
+        return result
+    result = Series.from_numpy(out_num, name)
+    if not out_valid.all():
+        result = result._with_mask(~out_valid)
+    if w.func in ("sum", "min", "max") and child.dtype.is_integer() and not is_int_sum:
+        from daft_tpu.datatype import DataType
+
+        result = result.cast(DataType.int64())
+    return result

@@ -40,3 +40,27 @@ def test_mean_over(df):
     out = df.select("g", col("v").mean().over(w).alias("m")).sort("g").to_pydict()
     assert out["m"][0] == pytest.approx(2.0)
     assert out["m"][3] == pytest.approx(10.0)
+
+
+def test_rows_between_running_sum(df):
+    w = (Window().partition_by("g").order_by("v")
+         .rows_between(Window.unbounded_preceding, Window.current_row))
+    out = df.select("g", "v", col("v").sum().over(w).alias("run")).sort(["g", "v"]).to_pydict()
+    assert out["run"] == [1, 3, 6, 10, 20]
+
+
+def test_rows_between_centered_and_trailing(df):
+    w = Window().partition_by("g").order_by("v").rows_between(-1, 1)
+    out = df.select("g", "v", col("v").mean().over(w).alias("m")).sort(["g", "v"]).to_pydict()
+    assert out["m"] == [1.5, 2.0, 2.5, 10.0, 10.0]
+    w2 = Window().partition_by("g").order_by("v").rows_between(-1, 0)
+    out2 = df.select("g", "v", col("v").max().over(w2).alias("mx")).sort(["g", "v"]).to_pydict()
+    assert out2["mx"] == [1, 2, 3, 10, 10]
+
+
+def test_rows_between_count_with_nulls():
+    df = daft_tpu.from_pydict({"g": ["a"] * 4, "t": [1, 2, 3, 4], "v": [1, None, 3, None]})
+    w = (Window().partition_by("g").order_by("t")
+         .rows_between(Window.unbounded_preceding, Window.current_row))
+    out = df.select("t", col("v").count().over(w).alias("c")).sort("t").to_pydict()
+    assert out["c"] == [1, 1, 2, 2]
