@@ -9,15 +9,22 @@ ballpark for the reference's GPU path.
 Runs the REAL engine path: FixedShapeImage column -> UDFProject actor ->
 uint8 HBM staging -> jitted bf16 Flax CLIP forward. Prints exactly one JSON
 line: {"metric", "value", "unit", "vs_baseline"}.
+
+Robustness contract (VERDICT r1 #1): the axon TPU tunnel can be slow to come
+up or outright wedged (a killed remote compile leaves jax.devices() hanging).
+The parent process therefore NEVER initializes the TPU backend itself — it
+probes in subprocesses, runs the real bench in a subprocess with a hard
+timeout, and if the TPU is unusable falls back to a small CPU run so the
+driver always records a parseable JSON line instead of rc=1.
 """
 
 from __future__ import annotations
 
 import json
+import os
+import subprocess
 import sys
 import time
-
-import numpy as np
 
 A100_BASELINE_IMGS_PER_SEC = 340.0
 
@@ -25,55 +32,116 @@ NUM_IMAGES = 3072
 BATCH_SIZE = 256
 IMAGE_SIZE = 224
 
+# CPU fallback runs the same engine path at a size that finishes in minutes.
+CPU_NUM_IMAGES = 64
+CPU_BATCH_SIZE = 32
 
-def _wait_for_tpu(max_wait_s: int = 600) -> None:
-    """The axon tunnel occasionally needs time to come up; probe backend init
-    in SUBPROCESSES (jax caches a failed init in-process) before committing
-    the main process to it."""
-    import subprocess
+# Global wall-clock budget: the driver enforces its own (unknown) timeout,
+# so the parent must print a JSON line well before any plausible budget. The
+# pieces below are carved out of this one deadline.
+TOTAL_BUDGET_S = int(os.environ.get("DAFT_BENCH_BUDGET_S", "1500"))
+TPU_PROBE_WAIT_S = int(os.environ.get("DAFT_BENCH_TPU_WAIT_S", "400"))
+CPU_RESERVE_S = int(os.environ.get("DAFT_BENCH_CPU_TIMEOUT_S", "400"))
+_START = time.time()
 
+
+def _remaining(reserve: float = 0.0) -> float:
+    return max(TOTAL_BUDGET_S - (time.time() - _START) - reserve, 30.0)
+
+
+def _probe_tpu(max_wait_s: int) -> bool:
+    """Probe TPU backend init in SUBPROCESSES (jax caches a failed init
+    in-process, and a wedged tunnel hangs jax.devices() indefinitely)."""
     deadline = time.time() + max_wait_s
+    cpu_only_hits = 0
     while True:
+        # Patient timeout: first backend init through the tunnel can
+        # legitimately take >60s, and killing an in-flight init is exactly
+        # what wedges the tunnel — never time a probe out early.
+        probe_timeout = max(min(180.0, deadline - time.time() + 30.0), 60.0)
         err = ""
         try:
             probe = subprocess.run(
-                [sys.executable, "-c", "import jax; print(len(jax.devices()))"],
-                capture_output=True, text=True, timeout=120,
+                [sys.executable, "-c",
+                 "import jax; d=jax.devices(); "
+                 "print(len(d), d[0].platform)"],
+                capture_output=True, text=True, timeout=probe_timeout,
             )
             if probe.returncode == 0:
-                return
-            err = probe.stderr[-500:]
+                if "cpu" not in probe.stdout.lower():
+                    return True
+                # Healthy jax but no TPU plugin/devices: deterministic —
+                # don't burn the whole window re-asking.
+                cpu_only_hits += 1
+                if cpu_only_hits >= 2:
+                    sys.stderr.write("no TPU platform present (cpu only)\n")
+                    return False
+            err = (probe.stdout + probe.stderr)[-300:]
         except subprocess.TimeoutExpired:
-            err = "backend init timed out"
+            err = f"backend init timed out ({probe_timeout:.0f}s)"
         if time.time() > deadline:
-            sys.stderr.write(f"TPU backend unavailable after {max_wait_s}s: {err}\n")
-            sys.exit(1)
-        time.sleep(20)
+            sys.stderr.write(
+                f"TPU backend unavailable after {max_wait_s}s: {err}\n")
+            return False
+        time.sleep(15)
 
 
-def main() -> None:
-    _wait_for_tpu()
+def _run_child(mode: str, timeout_s: int) -> dict | None:
+    """Run the actual bench in a subprocess; return the parsed JSON line."""
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), f"--child={mode}"],
+            capture_output=True, text=True, timeout=timeout_s,
+        )
+    except subprocess.TimeoutExpired:
+        sys.stderr.write(f"bench child ({mode}) timed out after {timeout_s}s\n")
+        return None
+    sys.stderr.write(proc.stderr[-2000:])
+    for line in reversed(proc.stdout.strip().splitlines()):
+        try:
+            rec = json.loads(line)
+            if isinstance(rec, dict) and "metric" in rec:
+                return rec
+        except json.JSONDecodeError:
+            continue
+    sys.stderr.write(f"bench child ({mode}) rc={proc.returncode}, "
+                     f"no JSON line in output\n")
+    return None
+
+
+def _bench_engine(num_images: int, batch_size: int, cpu: bool) -> dict:
+    """The real measurement: engine-path embed_image over an image column."""
     import jax
+
+    if cpu:
+        jax.config.update("jax_platforms", "cpu")
+    else:
+        # If the tunnel degraded between the parent's probe and now, fail
+        # fast so the parent falls back instead of crawling full-size on CPU.
+        assert jax.devices()[0].platform != "cpu", "TPU gone; refusing CPU run"
+    import numpy as np
 
     import daft_tpu
     from daft_tpu import col
     from daft_tpu.datatype import DataType
     from daft_tpu.functions.ai import embed_image
 
-    n_chips = max(len(jax.devices()), 1)
+    n_chips = max(len(jax.devices()), 1) if not cpu else 1
 
     rng = np.random.default_rng(0)
-    imgs = rng.integers(0, 255, (NUM_IMAGES, IMAGE_SIZE, IMAGE_SIZE, 3), dtype=np.uint8)
+    imgs = rng.integers(0, 255, (num_images, IMAGE_SIZE, IMAGE_SIZE, 3),
+                        dtype=np.uint8)
     img_dtype = DataType.image("RGB", IMAGE_SIZE, IMAGE_SIZE)
-    series = daft_tpu.Series.from_numpy(imgs.reshape(NUM_IMAGES, -1), "img", img_dtype)
+    series = daft_tpu.Series.from_numpy(
+        imgs.reshape(num_images, -1), "img", img_dtype)
 
     df = daft_tpu.from_pydict({"img": series})
     expr = embed_image(col("img"), provider="flax_random", model="ViT-L/14",
-                       batch_size=BATCH_SIZE)
+                       batch_size=batch_size)
 
-    with daft_tpu.execution_config_ctx(default_morsel_size=NUM_IMAGES):
+    with daft_tpu.execution_config_ctx(default_morsel_size=num_images):
         # Warmup: compile the forward for the batch bucket.
-        warm = df.limit(BATCH_SIZE).with_column("emb", expr)
+        warm = df.limit(batch_size).with_column("emb", expr)
         warm.collect()
 
         start = time.perf_counter()
@@ -83,15 +151,42 @@ def main() -> None:
             total += len(part)
         elapsed = time.perf_counter() - start
 
-    assert total == NUM_IMAGES, f"expected {NUM_IMAGES} rows, got {total}"
-    throughput = NUM_IMAGES / elapsed
-    per_chip = throughput / n_chips
-    print(json.dumps({
-        "metric": "embed_image_clip_vit_l14_throughput_per_chip",
+    assert total == num_images, f"expected {num_images} rows, got {total}"
+    per_chip = num_images / elapsed / n_chips
+    metric = "embed_image_clip_vit_l14_throughput_per_chip"
+    if cpu:
+        metric += "_cpu_fallback"
+    return {
+        "metric": metric,
         "value": round(per_chip, 2),
         "unit": "images/sec/chip",
         "vs_baseline": round(per_chip / A100_BASELINE_IMGS_PER_SEC, 3),
-    }))
+    }
+
+
+def main() -> None:
+    if len(sys.argv) > 1 and sys.argv[1].startswith("--child="):
+        mode = sys.argv[1].split("=", 1)[1]
+        if mode == "tpu":
+            rec = _bench_engine(NUM_IMAGES, BATCH_SIZE, cpu=False)
+        else:
+            rec = _bench_engine(CPU_NUM_IMAGES, CPU_BATCH_SIZE, cpu=True)
+        print(json.dumps(rec))
+        return
+
+    rec = None
+    probe_wait = min(TPU_PROBE_WAIT_S, _remaining(reserve=CPU_RESERVE_S + 120))
+    if _probe_tpu(probe_wait):
+        rec = _run_child("tpu", _remaining(reserve=CPU_RESERVE_S))
+    if rec is None:
+        sys.stderr.write("falling back to CPU mini-bench\n")
+        rec = _run_child("cpu", _remaining(reserve=10))
+    if rec is None:
+        # Last resort: still emit a parseable line — distinct metric name so
+        # a total failure is never mistaken for a measured 0.0.
+        rec = {"metric": "embed_image_clip_vit_l14_throughput_per_chip_failed",
+               "value": 0.0, "unit": "images/sec/chip", "vs_baseline": 0.0}
+    print(json.dumps(rec))
 
 
 if __name__ == "__main__":
