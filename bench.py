@@ -56,9 +56,11 @@ def _probe_tpu(max_wait_s: int) -> bool:
     cpu_only_hits = 0
     while True:
         # Patient timeout: first backend init through the tunnel can
-        # legitimately take >60s, and killing an in-flight init is exactly
-        # what wedges the tunnel — never time a probe out early.
-        probe_timeout = max(min(180.0, deadline - time.time() + 30.0), 60.0)
+        # legitimately take minutes, and killing an in-flight init is exactly
+        # what wedges the tunnel — a probe may run for the entire remaining
+        # window. (The final kill at window edge is unavoidable with a
+        # bounded budget, but by then we are falling back regardless.)
+        probe_timeout = max(deadline - time.time() + 60.0, 60.0)
         err = ""
         try:
             probe = subprocess.run(

@@ -83,7 +83,7 @@ class CheckpointConfig:
         done = self.store.load_keys()
         if not done:
             return df
-        return df.where(~col(self.on).is_in(sorted(done)))
+        return df.where(~col(self.on).is_in(sorted(done, key=repr)))
 
     def seal(self, df) -> None:
         """Record the keys of a fully-processed DataFrame.

@@ -452,13 +452,26 @@ class Executor:
         return RecordBatch(schema, cols, len(rb))
 
     def _join_and_fix(self, left, right, left_keys, right_keys, node) -> RecordBatch:
-        if node.merged_keys and node.how not in ("semi", "anti"):
-            # Same-name equi-keys merge: drop the right copy before joining.
-            keep = right.schema.exclude(sorted(node.merged_keys))
-            right_data = RecordBatch(keep, [right.get_column(n) for n in keep.column_names()], len(right))
+        merged = sorted(node.merged_keys) if node.merged_keys and node.how not in ("semi", "anti") else []
+        # For right/outer joins, right-only output rows have null values in
+        # the left copy of a merged key — carry the right copy through the
+        # join under a reserved name and coalesce after (the reference
+        # coalesces common join columns in hash_outer_join).
+        coalesce = merged if node.how in ("right", "outer") else []
+        if merged:
+            keep = right.schema.exclude(merged)
+            cols = [right.get_column(n) for n in keep.column_names()]
+            cols += [right.get_column(n).rename(f"__rk_{n}") for n in coalesce]
+            schema = Schema([Field(c.name, c.dtype) for c in cols])
+            right_data = RecordBatch(schema, cols, len(right))
         else:
             right_data = right
         joined = left.hash_join(right_data, left_keys, right_keys, node.how, node.suffix)
+        if coalesce:
+            cols = [c.coalesce(joined.get_column(f"__rk_{c.name}")) if c.name in coalesce
+                    else c for c in joined.columns() if not c.name.startswith("__rk_")]
+            joined = RecordBatch(Schema([Field(c.name, c.dtype) for c in cols]),
+                                 cols, len(joined))
         return self._conform_to_schema(joined, node.schema)
 
     def _run_AsofJoin(self, node: pp.AsofJoin) -> Iterator[MicroPartition]:

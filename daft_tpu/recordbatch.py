@@ -357,11 +357,15 @@ class RecordBatch:
             else:
                 l_g = np.zeros(n_left, dtype=np.int64)
                 r_g = np.zeros(len(right), dtype=np.int64)
-            l_vals = left_on.to_numpy()
-            r_vals = right_on.to_numpy()
+            # Rows with a null on-key never match (to_numpy alone would fill
+            # nulls with 0 and let them match key 0 spuriously).
+            l_vals, l_null = left_on.to_numpy_masked()
+            r_vals, r_null = right_on.to_numpy_masked()
+            l_ok = np.ones(n_left, dtype=bool) if l_null is None else ~l_null
+            r_ok = np.ones(len(right), dtype=bool) if r_null is None else ~r_null
             for g in np.unique(np.concatenate([l_g, r_g])):
-                li = np.nonzero(l_g == g)[0]
-                ri = np.nonzero(r_g == g)[0]
+                li = np.nonzero((l_g == g) & l_ok)[0]
+                ri = np.nonzero((r_g == g) & r_ok)[0]
                 if len(li) == 0 or len(ri) == 0:
                     continue
                 order = np.argsort(r_vals[ri], kind="stable")
@@ -620,20 +624,37 @@ def _group_codes(keys: Sequence[Series]) -> Tuple[np.ndarray, np.ndarray]:
     if not keys:
         return np.zeros(n, dtype=np.int64), np.zeros(1 if n else 0, dtype=np.int64)
     codes = []
+    radices = []
     for k in keys:
         arr = k.to_arrow() if not k.dtype.is_python() else None
         if arr is not None and not k.dtype.is_nested() and not k.dtype.is_logical():
             enc = pc.dictionary_encode(arr)
-            idx = np.asarray(enc.indices.fill_null(-1)).astype(np.int64)
-            codes.append(idx + 1)  # nulls -> 0
+            idx = np.asarray(enc.indices.fill_null(-1)).astype(np.int64) + 1  # nulls -> 0
         else:
             h = k.hash().to_numpy().astype(np.int64)
-            codes.append(h)
-    combo = codes[0].astype(np.uint64)
-    with np.errstate(over="ignore"):
-        for c in codes[1:]:
-            combo = combo * np.uint64(1000003) + c.astype(np.uint64)
-    uniq, first_idx, inverse = np.unique(combo, return_index=True, return_inverse=True)
+            _, idx = np.unique(h, return_inverse=True)
+            idx = idx.astype(np.int64)
+        codes.append(idx)
+        radices.append(int(idx.max()) + 1 if len(idx) else 1)
+    # Combine per-column dense codes exactly: mixed-radix when the key-space
+    # product fits in int64, else unique over row tuples (a fixed-stride
+    # linear combination silently collides distinct key tuples at scale).
+    if len(codes) == 1:
+        combo = codes[0]
+        uniq, first_idx, inverse = np.unique(combo, return_index=True, return_inverse=True)
+    else:
+        space = 1
+        for r in radices:
+            space *= r
+        if space < 2 ** 62:
+            combo = np.zeros(n, dtype=np.int64)
+            for c, r in zip(codes, radices):
+                combo = combo * np.int64(r) + c
+            uniq, first_idx, inverse = np.unique(combo, return_index=True, return_inverse=True)
+        else:
+            mat = np.ascontiguousarray(np.stack(codes, axis=1))
+            view = mat.view([("", mat.dtype)] * mat.shape[1]).reshape(-1)
+            uniq, first_idx, inverse = np.unique(view, return_index=True, return_inverse=True)
     # Renumber groups by first occurrence to keep deterministic order.
     order = np.argsort(first_idx, kind="stable")
     remap = np.empty_like(order)

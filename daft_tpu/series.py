@@ -361,6 +361,13 @@ class Series:
     def drop_null(self) -> "Series":
         return Series(self._name, self._dtype, _combine(self._data.drop_null()))
 
+    def coalesce(self, other: "Series") -> "Series":
+        """self where non-null, else the aligned value from `other`."""
+        common = unify_dtypes(self._dtype, other.dtype)
+        a = self if self._dtype == common else self.cast(common)
+        b = other if other.dtype == common else other.cast(common)
+        return a.fill_null(b)
+
     # ------------------------------------------------------------------ #
     # Arithmetic / comparison / logic                                     #
     # ------------------------------------------------------------------ #
@@ -469,6 +476,13 @@ class Series:
 
     def is_in(self, values: "Series") -> "Series":
         common = unify_dtypes(self.dtype, values.dtype)
+        if common.is_python():
+            # Mixed-type value sets (e.g. checkpoint keys accumulated across
+            # runs) can't form an Arrow value set — python membership.
+            vals = set(values.to_pylist())
+            data = self.to_pylist() if not self._dtype.is_python() else self._data
+            return Series.from_pylist([v in vals for v in data], self._name,
+                                      DataType.bool())
         out = pc.is_in(self.cast(common)._data, value_set=values.cast(common)._data)
         return Series(self._name, DataType.bool(), _combine(out))
 

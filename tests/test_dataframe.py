@@ -235,3 +235,38 @@ def test_udaf_function_and_class():
 
     df2 = daft_tpu.from_pydict({"x": [3, 9, 1]})
     assert df2.agg(RangeWidth(col("x")).alias("w")).to_pydict()["w"] == [8]
+
+
+def test_join_outer_right_merged_key_coalesced():
+    """Regression (ADVICE r1): outer/right joins on a merged key must keep
+    the key value of right-only rows instead of emitting null."""
+    left = daft_tpu.from_pydict({"id": [1, 2], "l": ["a", "b"]})
+    right = daft_tpu.from_pydict({"id": [2, 3], "r": ["B", "C"]})
+    out = left.join(right, on="id", how="outer").sort("id").to_pydict()
+    assert out["id"] == [1, 2, 3]
+    assert out["l"] == ["a", "b", None]
+    assert out["r"] == [None, "B", "C"]
+    rout = left.join(right, on="id", how="right").sort("id").to_pydict()
+    assert rout["id"] == [2, 3]
+    assert rout["l"] == ["b", None]
+    assert rout["r"] == ["B", "C"]
+    # multi-key outer
+    l2 = daft_tpu.from_pydict({"k1": [1, 1], "k2": ["x", "y"], "l": [10, 11]})
+    r2 = daft_tpu.from_pydict({"k1": [1, 2], "k2": ["y", "z"], "r": [20, 21]})
+    o2 = l2.join(r2, on=["k1", "k2"], how="outer").sort(["k1", "k2"]).to_pydict()
+    assert o2["k1"] == [1, 1, 2]
+    assert o2["k2"] == ["x", "y", "z"]
+    assert o2["l"] == [10, 11, None]
+    assert o2["r"] == [None, 20, 21]
+
+
+def test_join_asof_null_keys_never_match():
+    """Regression (ADVICE r1): null on-keys must not be treated as key 0."""
+    left = daft_tpu.from_pydict({"t": [None, 1.0, 5.0], "l": ["n", "a", "b"]})
+    right = daft_tpu.from_pydict({"t": [None, 2.0], "r": ["rn", "r2"]})
+    out = left.join_asof(right, on="t", direction="forward").to_pydict()
+    # null left key -> no match; 1.0 -> 2.0; 5.0 -> nothing (null right key
+    # must not act as a forward match target)
+    assert out["r"] == [None, "r2", None]
+    back = left.join_asof(right, on="t", direction="backward").to_pydict()
+    assert back["r"] == [None, None, "r2"]

@@ -117,3 +117,19 @@ def test_cli_version(capsys):
     assert main(["version"]) == 0
     out = capsys.readouterr().out.strip()
     assert out == daft_tpu.__version__
+
+
+def test_checkpoint_mixed_type_keys(make_df, tmp_path):
+    """Regression (ADVICE r1): filter_done must tolerate int+str keys
+    accumulated across runs (sorted() would raise TypeError)."""
+    from daft_tpu.checkpoint import CheckpointConfig, CheckpointStore
+
+    store = CheckpointStore(str(tmp_path / "ckpt"))
+    store.append_keys([1, 2])
+    store.append_keys(["a", "b"])
+    assert store.load_keys() == {1, 2, "a", "b"}
+    cfg = CheckpointConfig(store, on="key")
+    df = make_df({"key": [1, "a", 3, "c"], "v": [10, 20, 30, 40]})
+    out = cfg.filter_done(df).to_pydict()
+    assert out["key"] == [3, "c"]
+    assert out["v"] == [30, 40]

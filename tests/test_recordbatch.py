@@ -99,3 +99,37 @@ def test_explode_misaligned_raises():
     rb = RecordBatch.from_pydict({"a": [[1, 2], [3]], "b": [[10], [20, 30]]})
     with pytest.raises(Exception):
         rb.explode(["a", "b"])
+
+
+def test_group_codes_no_stride_collision():
+    """Regression (ADVICE r1): distinct key tuples must never share a group
+    even when a non-first key column exceeds the old 1,000,003 stride."""
+    import numpy as np
+
+    from daft_tpu.recordbatch import _group_codes
+    from daft_tpu.series import Series
+
+    n = 1_100_000
+    k1 = Series.from_numpy((np.arange(n) % 2).astype(np.int64), "k1")
+    k2 = Series.from_numpy(np.arange(n, dtype=np.int64), "k2")
+    codes, first_idx = _group_codes([k1, k2])
+    assert len(first_idx) == n  # every (k1, k2) pair is distinct
+    assert len(np.unique(codes)) == n
+
+
+def test_group_codes_huge_keyspace_fallback():
+    """Row-tuple fallback when the mixed-radix key space exceeds int64."""
+    import numpy as np
+
+    from daft_tpu.recordbatch import _group_codes
+    from daft_tpu.series import Series
+
+    n = 10_000
+    base = np.arange(n, dtype=np.int64)
+    cols = [Series.from_numpy(base, f"k{i}") for i in range(5)]
+    codes, first_idx = _group_codes(cols)
+    assert len(first_idx) == n
+    # duplicate tuples collapse to one group
+    dup = [Series.from_numpy(np.zeros(4, dtype=np.int64), f"k{i}") for i in range(5)]
+    codes2, first2 = _group_codes(dup)
+    assert len(first2) == 1 and list(codes2) == [0] * 4
