@@ -200,6 +200,20 @@ class Expression:
         return Expression(FunctionCall("fill_null", [self._expr, ensure_expr(fill_value)]))
 
     def is_in(self, items: Union["Expression", Sequence[Any]]) -> "Expression":
+        from daft_tpu.dataframe.dataframe import DataFrame
+
+        if isinstance(items, DataFrame):
+            # Uncorrelated IN-subquery over a one-column DataFrame; the
+            # optimizer unnests it into a semi join (reference:
+            # Expr::InSubquery + rules/unnest_subquery.rs).
+            from daft_tpu.expressions.expr import InSubquery
+
+            plan = items._builder.plan
+            names = plan.schema.column_names()
+            if len(names) != 1:
+                raise DaftValueError(
+                    f"is_in subquery must have exactly one column, got {names}")
+            return Expression(InSubquery(self._expr, plan, ColumnRef(names[0])))
         if isinstance(items, Expression):
             rhs = items._expr
         else:

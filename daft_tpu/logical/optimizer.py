@@ -25,8 +25,11 @@ from daft_tpu.expressions.expr import (
     Alias,
     BinaryOp,
     ColumnRef,
+    Exists,
     Expr,
+    InSubquery,
     Literal,
+    Subquery,
     UnaryOp,
 )
 from daft_tpu.logical import plan as lp
@@ -34,6 +37,7 @@ from daft_tpu.logical import plan as lp
 
 class Rule:
     name = "rule"
+    top_down = False  # apply at a node before recursing into its children
 
     def rewrite(self, node: lp.LogicalPlan) -> Optional[lp.LogicalPlan]:
         """Return a replacement for this node, or None to keep it."""
@@ -48,6 +52,16 @@ def _rewrite_bottom_up(node: lp.LogicalPlan, rule: Rule) -> lp.LogicalPlan:
     return replaced if replaced is not None else node
 
 
+def _rewrite_top_down(node: lp.LogicalPlan, rule: Rule) -> lp.LogicalPlan:
+    replaced = rule.rewrite(node)
+    if replaced is not None:
+        node = replaced
+    new_children = [_rewrite_top_down(c, rule) for c in node.children()]
+    if any(a is not b for a, b in zip(new_children, node.children())):
+        node = node.with_children(new_children)
+    return node
+
+
 class Optimizer:
     MAX_PASSES = 5
 
@@ -56,10 +70,12 @@ class Optimizer:
 
         self.cfg = cfg or get_context().execution_config
         self.batches: List[List[Rule]] = [
+            [UnnestSubqueries()],
             [SimplifyExpressions()],
             [SplitUDFs()],
             [EliminateCrossJoin(), PushDownFilter(), PushDownShard(), DropRepartition()],
             [PushDownLimit()],
+            [ReorderJoins(self.cfg)],
             [PushDownProjection()],
         ]
 
@@ -68,7 +84,8 @@ class Optimizer:
             for _ in range(self.MAX_PASSES):
                 changed = False
                 for rule in batch:
-                    new_plan = _rewrite_bottom_up(plan, rule)
+                    rewriter = _rewrite_top_down if rule.top_down else _rewrite_bottom_up
+                    new_plan = rewriter(plan, rule)
                     if new_plan is not plan:
                         plan = new_plan
                         changed = True
@@ -398,3 +415,386 @@ class PushDownProjection(Rule):
         elif isinstance(node, lp.Explode):
             return None
         return req
+
+
+# ---------------------------------------------------------------------- #
+def _flatten_and(e: Expr, out: List[Expr]) -> None:
+    if isinstance(e, BinaryOp) and e.op == "and":
+        _flatten_and(e.left, out)
+        _flatten_and(e.right, out)
+    else:
+        out.append(e)
+
+
+def _and_all(conjuncts: Sequence[Expr]) -> Expr:
+    pred = conjuncts[0]
+    for c in conjuncts[1:]:
+        pred = BinaryOp("and", pred, c)
+    return pred
+
+
+class UnnestSubqueries(Rule):
+    """Rewrite IN/EXISTS/scalar subqueries in filters into joins.
+
+    Reference: src/daft-logical-plan/src/optimization/rules/unnest_subquery.rs —
+    EXISTS/IN become semi/anti joins keyed on the correlated equalities (or a
+    constant key when uncorrelated); scalar subqueries become a cross join of
+    the single-row result (uncorrelated) or a grouped aggregate left-joined on
+    the correlation keys (correlated).
+
+    NOTE on NOT IN: SQL three-valued logic makes ``x NOT IN (subquery)``
+    reject every row when the subquery yields any NULL. Like most pragmatic
+    engines we lower to an anti join over the non-null subquery values.
+    """
+
+    name = "UnnestSubqueries"
+
+    def rewrite(self, node):
+        if not isinstance(node, lp.Filter) or not node.predicate.has_subquery():
+            return None
+        base = node.children()[0]
+        original_cols = [f.name for f in base.schema]
+        conjuncts: List[Expr] = []
+        _flatten_and(node.predicate, conjuncts)
+        remaining: List[Expr] = []
+        self._counter = 0
+        for c in conjuncts:
+            inner_c, neg = c, False
+            while isinstance(inner_c, UnaryOp) and inner_c.op == "not":
+                neg = not neg
+                inner_c = inner_c.child
+            if isinstance(inner_c, Exists):
+                base = self._semi_anti(base, inner_c.plan, inner_c.corr,
+                                       None, None, inner_c.negated ^ neg,
+                                       inner_c.extra)
+                continue
+            if isinstance(inner_c, InSubquery):
+                base = self._semi_anti(base, inner_c.plan, inner_c.corr,
+                                       inner_c.child, inner_c.value,
+                                       inner_c.negated ^ neg, inner_c.extra)
+                continue
+            if c.has_subquery():
+                c, base = self._rewrite_scalars(c, base)
+            remaining.append(c)
+        out = base
+        if remaining:
+            out = lp.Filter(out, _and_all(remaining))
+        if [f.name for f in out.schema] != original_cols:
+            out = lp.Project(out, [ColumnRef(n) for n in original_cols])
+        return out
+
+    def _uniq(self, stem: str) -> str:
+        self._counter += 1
+        return f"__sq{self._counter}_{stem}"
+
+    def _semi_anti(self, base, plan, corr, in_child, in_value, negated, extra=()):
+        """EXISTS / IN → semi (anti when negated) join on the correlation
+        equalities plus, for IN, value-column equality; uncorrelated EXISTS
+        joins on a constant key. Non-equi correlated predicates (``extra``)
+        use the row-id technique: tag outer rows, inner-join on the equi
+        keys, filter the non-equi predicates, then semi/anti join the outer
+        side against the surviving row ids."""
+        left_on: List[Expr] = []
+        proj: List[Expr] = []
+        right_on: List[Expr] = []
+        if in_value is not None:
+            v = self._uniq("v")
+            proj.append(Alias(in_value, v))
+            left_on.append(in_child)
+            right_on.append(ColumnRef(v))
+        for j, (outer_e, inner_e) in enumerate(corr):
+            k = self._uniq(f"k{j}")
+            proj.append(Alias(inner_e, k))
+            left_on.append(outer_e)
+            right_on.append(ColumnRef(k))
+        if extra:
+            # Inner columns referenced by the non-equi predicates travel
+            # through the join under their reserved __in_<name> aliases.
+            inner_refs = sorted({ref[5:] for e in extra for ref in e.column_refs()
+                                 if ref.startswith("__in_")})
+            proj.extend(Alias(ColumnRef(r), f"__in_{r}") for r in inner_refs)
+            rowid = self._uniq("rowid")
+            base_id = lp.MonotonicallyIncreasingId(base, rowid)
+            right = lp.Project(plan, proj)
+            if left_on:
+                joined = lp.Join(base_id, right, left_on, right_on, "inner")
+            else:
+                joined = lp.Join(base_id, right, [], [], "cross")
+            matched = lp.Filter(joined, _and_all(list(extra)))
+            return lp.Join(base_id, matched, [ColumnRef(rowid)], [ColumnRef(rowid)],
+                           "anti" if negated else "semi")
+        if not proj:  # uncorrelated EXISTS
+            one = self._uniq("one")
+            proj.append(Alias(Literal(1), one))
+            left_on.append(Literal(1))
+            right_on.append(ColumnRef(one))
+        right = lp.Project(plan, proj)
+        if negated and in_value is not None:
+            # Pragmatic NOT IN: drop NULL subquery values (see class note).
+            right = lp.Filter(right, UnaryOp("not_null", right_on[0]))
+        how = "anti" if negated else "semi"
+        return lp.Join(base, right, left_on, right_on, how)
+
+    def _rewrite_scalars(self, c: Expr, base):
+        """Replace subquery nodes that appear INSIDE a larger predicate (e.g.
+        under OR): scalar Subquery becomes a joined-in column; InSubquery /
+        Exists become boolean membership columns via a deduplicated left
+        join whose match flag is null for non-members."""
+
+        def rw(n: Expr):
+            nonlocal base
+            if isinstance(n, (InSubquery, Exists)):
+                if n.extra:
+                    from daft_tpu.errors import DaftPlanError
+
+                    raise DaftPlanError(
+                        "IN/EXISTS with non-equi correlation is only supported "
+                        "as a top-level AND conjunct of a filter")
+                flag = self._uniq("flag")
+                left_on: List[Expr] = []
+                keys: List[Expr] = []
+                if isinstance(n, InSubquery):
+                    left_on.append(n.child)
+                    keys.append(Alias(n.value, self._uniq("v")))
+                for j, (outer_e, inner_e) in enumerate(n.corr):
+                    left_on.append(outer_e)
+                    keys.append(Alias(inner_e, self._uniq(f"k{j}")))
+                if not keys:  # uncorrelated EXISTS
+                    left_on.append(Literal(1))
+                    keys.append(Alias(Literal(1), self._uniq("one")))
+                dedup = lp.Distinct(lp.Project(n.plan, keys))
+                right = lp.Project(
+                    dedup,
+                    [ColumnRef(k.name()) for k in keys] + [Alias(Literal(True), flag)])
+                base = lp.Join(base, right, left_on,
+                               [ColumnRef(k.name()) for k in keys], "left")
+                matched: Expr = UnaryOp("not_null", ColumnRef(flag))
+                return UnaryOp("not", matched) if n.negated else matched
+            if not isinstance(n, Subquery):
+                return None
+            name = self._uniq("val")
+            if n.corr:
+                group_by = [inner for _, inner in n.corr]
+                agg = lp.Aggregate(n.plan, [Alias(n.value, name)], group_by)
+                keys = []
+                proj = []
+                for j, g in enumerate(group_by):
+                    k = self._uniq(f"gk{j}")
+                    proj.append(Alias(ColumnRef(g.name()), k))
+                    keys.append(ColumnRef(k))
+                proj.append(ColumnRef(name))
+                right = lp.Project(agg, proj)
+                base = lp.Join(base, right, [o for o, _ in n.corr], keys, "left")
+            else:
+                if n.value.has_agg():
+                    right = lp.Aggregate(n.plan, [Alias(n.value, name)], [])
+                else:
+                    right = lp.Limit(lp.Project(n.plan, [Alias(n.value, name)]), 1, 0)
+                base = lp.Join(base, right, [], [], "cross")
+            return ColumnRef(name)
+
+        return c.transform(rw), base
+
+
+# ---------------------------------------------------------------------- #
+class ReorderJoins(Rule):
+    """Cost-based join reordering over chains of inner equi-joins.
+
+    Reference: src/daft-logical-plan/src/optimization/rules/reorder_joins/ —
+    the reference enumerates join orders with DP-CCP over a join hypergraph
+    enriched with stats. Here: collect the maximal region of inner
+    ColumnRef-equi-joins, estimate relation cardinalities via approx_stats,
+    run DP over connected subsets (exact for <= 10 relations), and rebuild
+    the cheapest tree. The output column set is restored with a Project.
+
+    Only fires when every non-key output column name is unique across
+    relations, so reordering cannot change suffix-renaming semantics.
+    """
+
+    name = "ReorderJoins"
+    top_down = True  # fire at the TOPMOST join so the region is maximal
+    MAX_RELATIONS = 10
+
+    def __init__(self, cfg=None):
+        self.cfg = cfg
+
+    def rewrite(self, node):
+        if not isinstance(node, lp.Join) or not self._reorderable(node):
+            return None
+        if getattr(node, "_reordered", False):
+            return None
+        # Collect the join region: relations (non-join leaves) + edges.
+        relations: List[lp.LogicalPlan] = []
+        edges: List[tuple] = []  # (left_rel_idx, right_rel_idx, left_expr, right_expr)
+
+        def collect(j) -> None:
+            for side in j.children():
+                if self._reorderable(side) and isinstance(side, lp.Join):
+                    collect(side)
+                else:
+                    relations.append(side)
+
+        def owner(e: Expr, rels_cols) -> Optional[int]:
+            refs = e.column_refs()
+            if not refs:
+                return None
+            for i, cols in enumerate(rels_cols):
+                if refs <= cols:
+                    return i
+            return None
+
+        collect(node)
+        if not (2 < len(relations) <= self.MAX_RELATIONS):
+            return None
+        rels_cols = [set(r.schema.column_names()) for r in relations]
+        # Names must be unambiguous: every column name owned by one relation.
+        all_names: dict = {}
+        for i, cols in enumerate(rels_cols):
+            for n in cols:
+                all_names.setdefault(n, []).append(i)
+        shared = {n for n, owners in all_names.items() if len(owners) > 1}
+
+        def collect_edges(j) -> bool:
+            ok = True
+            for side in j.children():
+                if self._reorderable(side) and isinstance(side, lp.Join):
+                    ok = ok and collect_edges(side)
+            for l, r in zip(j.left_on, j.right_on):
+                li, ri = owner(l, rels_cols), owner(r, rels_cols)
+                if li is None or ri is None or li == ri:
+                    return False
+                # Shared names are only tolerable as merged equi-keys.
+                if (l.column_refs() | r.column_refs()) & shared:
+                    if not (isinstance(l, ColumnRef) and isinstance(r, ColumnRef)
+                            and l.name_ == r.name_):
+                        return False
+                edges.append((li, ri, l, r))
+            return True
+
+        if not collect_edges(node):
+            return None
+        non_key_shared = shared - {
+            l.name_ for _, _, l, r in edges
+            if isinstance(l, ColumnRef) and isinstance(r, ColumnRef) and l.name_ == r.name_
+        }
+        if non_key_shared:
+            return None
+
+        order = self._dp_order(relations, edges)
+        if order is None:
+            return None
+        new_plan = self._build(order, relations, edges)
+        if new_plan is None:
+            return None
+        try:
+            out_names = [f.name for f in node.schema]
+            if set(out_names) - set(new_plan.schema.column_names()):
+                return None
+            rebuilt = lp.Project(new_plan, [ColumnRef(n) for n in out_names])
+        except Exception:
+            return None
+        if self._tree_shape(rebuilt) == self._tree_shape(node):
+            return None
+        return rebuilt
+
+    @staticmethod
+    def _reorderable(n) -> bool:
+        return (isinstance(n, lp.Join) and n.how == "inner"
+                and n.strategy in (None, "auto")
+                and all(e.column_refs() and not e.has_udf() and not e.has_subquery()
+                        for e in list(n.left_on) + list(n.right_on)))
+
+    @staticmethod
+    def _tree_shape(n) -> tuple:
+        if isinstance(n, lp.Join):
+            return ("J", ReorderJoins._tree_shape(n.children()[0]),
+                    ReorderJoins._tree_shape(n.children()[1]))
+        if isinstance(n, lp.Project):
+            return ReorderJoins._tree_shape(n.children()[0])
+        return ("R", id(n))
+
+    def _dp_order(self, relations, edges):
+        """DP over connected subsets (DP-CCP style): best[mask] = (cost, rows,
+        plan_desc). Returns a nested tuple describing the join tree."""
+        n = len(relations)
+        rows = [max(r.approx_stats().num_rows, 1.0) for r in relations]
+        # Connectivity + per-pair selectivity from edges. Each equi-key pair
+        # contributes 1/max(distinct) ~ 1/max(rows) of the smaller side —
+        # without NDV stats, use the standard |L||R|/max(|L|,|R|) estimate
+        # per edge between the two sides.
+        best: dict = {}
+        for i in range(n):
+            best[1 << i] = (0.0, rows[i], i)
+
+        def join_sel(mask_a, mask_b):
+            found = False
+            sel = 1.0
+            for li, ri, _, _ in edges:
+                if ((mask_a >> li) & 1 and (mask_b >> ri) & 1) or \
+                   ((mask_b >> li) & 1 and (mask_a >> ri) & 1):
+                    found = True
+                    # |L||R| / NDV(key); without column NDV stats the best
+                    # proxy is the smaller relation's cardinality (exact for
+                    # FK->PK joins, conservative otherwise).
+                    sel *= 1.0 / max(min(rows[li], rows[ri]), 1.0)
+            return sel if found else None
+
+        full = (1 << n) - 1
+        # Enumerate subsets by popcount so splits are ready.
+        masks = sorted(range(1, full + 1), key=lambda m: bin(m).count("1"))
+        for mask in masks:
+            if mask in best and bin(mask).count("1") == 1:
+                continue
+            entry = None
+            sub = (mask - 1) & mask
+            while sub:
+                other = mask ^ sub
+                if sub > other:  # visit each unordered split once
+                    sub = (sub - 1) & mask
+                    continue
+                a, b = sub, other
+                if a in best and b in best:
+                    sel = join_sel(a, b)
+                    if sel is not None:
+                        ca, ra, pa = best[a]
+                        cb, rb, pb = best[b]
+                        out_rows = max(ra * rb * sel, 1.0)
+                        # cost: intermediate rows produced + build-side size
+                        cost = ca + cb + out_rows + min(ra, rb)
+                        if entry is None or cost < entry[0]:
+                            # build on the smaller side: right = build
+                            plan = (pa, pb) if ra >= rb else (pb, pa)
+                            entry = (cost, out_rows, plan)
+                sub = (sub - 1) & mask
+            if entry is not None:
+                best[mask] = entry
+        if full not in best:
+            return None
+        return best[full][2]
+
+    def _build(self, desc, relations, edges):
+        """Materialise the DP tree description into Join nodes."""
+        if isinstance(desc, int):
+            return relations[desc]
+        left = self._build(desc[0], relations, edges)
+        right = self._build(desc[1], relations, edges)
+        if left is None or right is None:
+            return None
+        left_cols = set(left.schema.column_names())
+        right_cols = set(right.schema.column_names())
+        left_on, right_on = [], []
+        for li, ri, le, re_ in edges:
+            if le.column_refs() <= left_cols and re_.column_refs() <= right_cols:
+                left_on.append(le)
+                right_on.append(re_)
+            elif re_.column_refs() <= left_cols and le.column_refs() <= right_cols:
+                left_on.append(re_)
+                right_on.append(le)
+        if not left_on:
+            return None
+        try:
+            j = lp.Join(left, right, left_on, right_on, "inner")
+            j._reordered = True  # don't re-enumerate subtrees of a DP result
+            return j
+        except Exception:
+            return None

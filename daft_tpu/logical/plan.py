@@ -127,7 +127,9 @@ class ScanSource(LogicalPlan):
             frac = self.pushdowns.limit / max(stats.num_rows, 1)
             stats = stats.scaled(frac)
         if self.pushdowns.filters is not None:
-            stats = stats.scaled(0.2)
+            from daft_tpu.stats import estimate_selectivity
+
+            stats = stats.scaled(estimate_selectivity(self.pushdowns.filters))
         return stats
 
 
@@ -195,7 +197,10 @@ class Filter(LogicalPlan):
         return [f"Filter: {self.predicate!r}"]
 
     def approx_stats(self) -> ApproxStats:
-        return self._children[0].approx_stats().scaled(0.2)
+        from daft_tpu.stats import estimate_selectivity
+
+        return self._children[0].approx_stats().scaled(
+            estimate_selectivity(self.predicate))
 
 
 class Limit(LogicalPlan):

@@ -85,6 +85,40 @@ def tokenize(text: str) -> List[Token]:
 # ---------------------------------------------------------------------- #
 # AST for statements                                                      #
 # ---------------------------------------------------------------------- #
+class SubqueryExpr(Expr):
+    """Parsed-but-unplanned subquery expression (``(SELECT ...)`` scalar,
+    ``IN (SELECT ...)``, ``EXISTS (SELECT ...)``); the SQL planner resolves
+    it into Subquery/InSubquery/Exists with a built plan and correlations
+    (reference: sqlparser Expr::Subquery/InSubquery/Exists lowering in
+    src/daft-sql/src/planner.rs)."""
+
+    __slots__ = ("stmt", "kind", "operand", "negated")
+
+    def __init__(self, stmt, kind: str, operand: Optional[Expr] = None,
+                 negated: bool = False):
+        assert kind in ("scalar", "in", "exists")
+        self.stmt = stmt
+        self.kind = kind
+        self.operand = operand
+        self.negated = negated
+
+    def children(self):
+        return (self.operand,) if self.operand is not None else ()
+
+    def with_children(self, children):
+        return SubqueryExpr(self.stmt, self.kind,
+                            children[0] if children else None, self.negated)
+
+    def to_field(self, schema):
+        raise SQLParseError("unresolved SQL subquery expression")
+
+    def _attrs_key(self):
+        return (id(self.stmt), self.kind, self.negated)
+
+    def __repr__(self):
+        return f"sql_subquery[{self.kind}]"
+
+
 @dataclass
 class TableRef:
     name: str
@@ -389,6 +423,10 @@ class Parser:
                 negate = True
         if self.accept_kw("in"):
             self.expect("op", "(")
+            if self.peek().kind == "kw" and self.peek().value == "select":
+                stmt = self.parse_select()
+                self.expect("op", ")")
+                return SubqueryExpr(stmt, "in", left, negated=negate)
             items = [self._literal_value()]
             while self.accept("op", ","):
                 items.append(self._literal_value())
@@ -495,7 +533,16 @@ class Parser:
                 return Literal(_parse_interval(raw))
             if self.accept_kw("not"):
                 return UnaryOp("not", self._parse_not())
+            if self.accept_kw("exists"):
+                self.expect("op", "(")
+                stmt = self.parse_select()
+                self.expect("op", ")")
+                return SubqueryExpr(stmt, "exists")
         if self.accept("op", "("):
+            if self.peek().kind == "kw" and self.peek().value == "select":
+                stmt = self.parse_select()
+                self.expect("op", ")")
+                return SubqueryExpr(stmt, "scalar")
             inner = self.parse_expr()
             self.expect("op", ")")
             return inner

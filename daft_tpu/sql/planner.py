@@ -15,9 +15,43 @@ from daft_tpu.expressions.expr import (
     Alias,
     BinaryOp,
     ColumnRef,
+    Exists,
     Expr,
+    FunctionCall,
+    InSubquery,
+    Subquery,
 )
-from daft_tpu.sql.parser import JoinClause, SelectStmt, SubqueryRef, TableRef, parse_sql
+from daft_tpu.sql.parser import (
+    JoinClause,
+    SelectStmt,
+    SubqueryExpr,
+    SubqueryRef,
+    TableRef,
+    parse_sql,
+)
+
+
+class _OuterRef(Expr):
+    """Marker for a column resolved to the OUTER query scope while planning a
+    correlated subquery (reference: outer-reference binding in
+    src/daft-sql/src/planner.rs + rules/unnest_subquery.rs)."""
+
+    __slots__ = ("name_",)
+
+    def __init__(self, name: str):
+        self.name_ = name
+
+    def name(self) -> str:
+        return self.name_
+
+    def to_field(self, schema):
+        raise DaftValueError(f"unresolved outer reference {self.name_!r}")
+
+    def _attrs_key(self):
+        return (self.name_,)
+
+    def __repr__(self):
+        return f"outer({self.name_})"
 
 
 def plan_sql(query: str, bindings: Dict[str, object], session=None):
@@ -50,19 +84,26 @@ def _resolve_source(src, bindings, ctes, session=None):
     raise DaftValueError(f"Unknown table {name!r} in SQL query")
 
 
-def _plan_select(stmt: SelectStmt, bindings, ctes, session=None):
-    from daft_tpu.dataframe.dataframe import DataFrame
+def _src_alias(src) -> str:
+    if isinstance(src, SubqueryRef):
+        return src.alias or "__subquery"
+    return src.alias or src.name
+
+
+def _plan_from(stmt: SelectStmt, bindings, ctes, session=None):
+    """Plan the FROM clause + JOINs; returns (df, alias_names)."""
     from daft_tpu.expressions.expression import Expression
 
     if stmt.source is None:
         # SELECT without FROM: single-row evaluation.
         import daft_tpu
 
-        df = daft_tpu.from_pydict({"__dummy": [1]})
-    else:
-        df = _resolve_source(stmt.source, bindings, ctes, session)
+        return daft_tpu.from_pydict({"__dummy": [1]}), set()
+    df = _resolve_source(stmt.source, bindings, ctes, session)
+    aliases = {_src_alias(stmt.source)}
     for join in stmt.joins:
         right = _resolve_source(join.right, bindings, ctes, session)
+        aliases.add(_src_alias(join.right))
         if join.how == "cross":
             df = df.cross_join(right)
             continue
@@ -76,13 +117,21 @@ def _plan_select(stmt: SelectStmt, bindings, ctes, session=None):
             right_on=[Expression(e) for e in right_on],
             how=join.how,
         )
+    return df, aliases
+
+
+def _plan_select(stmt: SelectStmt, bindings, ctes, session=None):
+    from daft_tpu.expressions.expression import Expression
+
+    df, aliases = _plan_from(stmt, bindings, ctes, session)
     # Table-qualifier resolution: `t.c` parses as struct_get(col(t), name=c);
     # when t is a table name/alias rather than a struct column, rewrite to
     # col(c) (reference: qualified-identifier binding in daft-sql's planner).
     colnames = set(df.column_names)
     dequal = lambda e: _dequalify(e, colnames)
     if stmt.where is not None:
-        df = df.where(Expression(dequal(stmt.where)))
+        w = _resolve_subqueries(dequal(stmt.where), df, aliases, bindings, ctes, session)
+        df = df.where(Expression(w))
 
     # Projections: expand *, attach aliases.
     proj_exprs: List[Expr] = []
@@ -96,7 +145,8 @@ def _plan_select(stmt: SelectStmt, bindings, ctes, session=None):
             proj_exprs.append(Alias(e, alias) if alias else e)
     stmt.group_by = [dequal(g) for g in stmt.group_by]
     if stmt.having is not None:
-        stmt.having = dequal(stmt.having)
+        stmt.having = _resolve_subqueries(dequal(stmt.having), df, aliases,
+                                          bindings, ctes, session)
     for o in stmt.order_by:
         o.expr = dequal(o.expr)
 
@@ -250,6 +300,199 @@ def _strip_qualifier(e: Expr) -> Expr:
         if isinstance(n, FunctionCall) and n.fn_name == "struct_get" and len(n.args) == 1:
             inner = n.args[0]
             if isinstance(inner, ColumnRef):
+                return ColumnRef(n.kwargs["name"])
+        return None
+
+    return e.transform(rw)
+
+
+# ---------------------------------------------------------------------- #
+# Subquery resolution (reference: src/daft-sql/src/planner.rs subquery     #
+# lowering + src/daft-logical-plan rules/unnest_subquery.rs)               #
+# ---------------------------------------------------------------------- #
+def _resolve_subqueries(e: Expr, outer_df, outer_aliases, bindings, ctes, session):
+    """Replace parser-level SubqueryExpr holders inside `e` with planned
+    Subquery/InSubquery/Exists nodes, extracting correlated predicates
+    against `outer_df`'s scope."""
+
+    def rw(n: Expr):
+        if isinstance(n, SubqueryExpr):
+            return _plan_subquery(n, outer_df, outer_aliases, bindings, ctes, session)
+        return None
+
+    return e.transform(rw)
+
+
+def _plan_subquery(holder: SubqueryExpr, outer_df, outer_aliases, bindings, ctes, session):
+    from daft_tpu.expressions.expression import Expression
+
+    stmt = holder.stmt
+    complex_shape = bool(stmt.group_by or stmt.having or stmt.union or
+                         stmt.order_by or stmt.limit is not None)
+    if complex_shape:
+        # Uncorrelated-only path: delegate to the full SELECT planner. Any
+        # reference into the outer scope would be silently rebound to a
+        # same-named inner column by _dequalify — reject it up front.
+        _reject_correlation(stmt, outer_df, outer_aliases, bindings, ctes, session)
+        inner = _plan_select(stmt, bindings, ctes, session)
+        plan = inner._builder.plan
+        names = plan.schema.column_names()
+        if holder.kind == "exists":
+            return Exists(plan, (), holder.negated)
+        if len(names) != 1:
+            raise DaftValueError(
+                f"{holder.kind} subquery must produce one column, got {names}")
+        if holder.kind == "in":
+            return InSubquery(holder.operand, plan, ColumnRef(names[0]),
+                              (), holder.negated)
+        return Subquery(plan, ColumnRef(names[0]))
+
+    inner_df, inner_aliases = _plan_from(stmt, bindings, ctes, session)
+    filters, corr, extra = _classify_where(
+        stmt.where, inner_df, inner_aliases, outer_df, outer_aliases,
+        bindings, ctes, session)
+    for f in filters:
+        inner_df = inner_df.where(Expression(f))
+    plan = inner_df._builder.plan
+
+    if holder.kind == "exists":
+        return Exists(plan, corr, holder.negated, extra)
+
+    # IN / scalar need the single projection expression.
+    projs = [p for p in stmt.projections if p[0] is not None]
+    if len(stmt.projections) != 1 or not projs:
+        if holder.kind == "in":
+            raise DaftValueError("IN subquery must select exactly one column")
+        raise DaftValueError("scalar subquery must select exactly one expression")
+    value = _dequalify_aliases(projs[0][0], set(inner_df.column_names), inner_aliases)
+    if holder.kind == "in":
+        return InSubquery(holder.operand, plan, value, corr, holder.negated, extra)
+    if extra:
+        raise DaftValueError(
+            "scalar subqueries support only equality correlation")
+    return Subquery(plan, value, corr)
+
+
+def _reject_correlation(stmt, outer_df, outer_aliases, bindings, ctes, session):
+    """Raise when a GROUP BY/HAVING/ORDER BY/LIMIT subquery references the
+    outer scope — decorrelation of those shapes is not supported, and letting
+    them through would silently rebind outer refs to inner columns."""
+    inner_df, inner_aliases = _plan_from(stmt, bindings, ctes, session)
+    inner_cols = set(inner_df.column_names)
+    outer_cols = set(outer_df.column_names)
+    exprs = [e for e, _ in stmt.projections if e is not None]
+    exprs += [e for e in (stmt.where, stmt.having) if e is not None]
+    exprs += list(stmt.group_by)
+    for e in exprs:
+        for n in e.walk():
+            if isinstance(n, FunctionCall) and n.fn_name == "struct_get" \
+                    and len(n.args) == 1:
+                q = n.args[0]
+                if isinstance(q, ColumnRef) and q.name_ not in inner_cols \
+                        and q.name_ not in inner_aliases and q.name_ in outer_aliases:
+                    raise DaftValueError(
+                        f"correlated reference {q.name_}.{n.kwargs['name']} is not "
+                        "supported in subqueries with GROUP BY/HAVING/ORDER BY/LIMIT")
+            elif isinstance(n, ColumnRef):
+                if n.name_ not in inner_cols and n.name_ not in inner_aliases \
+                        and n.name_ in outer_cols:
+                    raise DaftValueError(
+                        f"correlated reference {n.name_!r} is not supported in "
+                        "subqueries with GROUP BY/HAVING/ORDER BY/LIMIT")
+
+
+def _classify_where(where, inner_df, inner_aliases, outer_df, outer_aliases,
+                    bindings, ctes, session):
+    """Split a subquery's WHERE into (inner filters, correlated equality
+    pairs, non-equi correlated predicates). Inner refs win over outer refs
+    for both qualifiers and bare names (SQL scoping)."""
+    if where is None:
+        return [], [], []
+    inner_cols = set(inner_df.column_names)
+    outer_cols = set(outer_df.column_names)
+
+    def scope(e: Expr) -> Expr:
+        def rw(n: Expr):
+            if isinstance(n, FunctionCall) and n.fn_name == "struct_get" and len(n.args) == 1:
+                q = n.args[0]
+                if isinstance(q, ColumnRef) and q.name_ not in inner_cols:
+                    if q.name_ in inner_aliases:
+                        return ColumnRef(n.kwargs["name"])
+                    if q.name_ in outer_aliases or q.name_ in outer_cols:
+                        return _OuterRef(n.kwargs["name"])
+                    return ColumnRef(n.kwargs["name"])
+            elif isinstance(n, ColumnRef):
+                if n.name_ not in inner_cols and n.name_ in outer_cols:
+                    return _OuterRef(n.name_)
+            return None
+
+        return e.transform(rw)
+
+    conjuncts: List[Expr] = []
+
+    def flatten(e: Expr):
+        if isinstance(e, BinaryOp) and e.op == "and":
+            flatten(e.left)
+            flatten(e.right)
+        else:
+            conjuncts.append(e)
+
+    flatten(where)
+    filters: List[Expr] = []
+    corr: List[Tuple[Expr, Expr]] = []
+    extra: List[Expr] = []
+    for c in conjuncts:
+        c = scope(c)
+        outers = [x for x in c.walk() if isinstance(x, _OuterRef)]
+        if not outers:
+            filters.append(_resolve_subqueries(c, inner_df, inner_aliases,
+                                               bindings, ctes, session))
+            continue
+        if c.has_subquery() or any(isinstance(x, SubqueryExpr) for x in c.walk()):
+            raise DaftValueError(
+                f"correlated predicate may not itself contain a subquery: {c!r}")
+        if isinstance(c, BinaryOp) and c.op == "eq":
+            sides = [c.left, c.right]
+            outer_side = [s for s in sides
+                          if any(isinstance(x, _OuterRef) for x in s.walk())
+                          and not s.column_refs()]
+            inner_side = [s for s in sides
+                          if not any(isinstance(x, _OuterRef) for x in s.walk())]
+            if len(outer_side) == 1 and len(inner_side) == 1:
+                corr.append((_outer_to_col(outer_side[0]), inner_side[0]))
+                continue
+        # Non-equi (or mixed-side) correlated predicate: outer refs become
+        # natural column refs, inner refs go through the __in_ channel.
+        # (Single pass — transform() does not revisit replacements, so an
+        # outer ref that shares its name with an inner column stays outer.)
+        def mark(n: Expr):
+            if isinstance(n, _OuterRef):
+                return ColumnRef(n.name_)
+            if isinstance(n, ColumnRef) and n.name_ in inner_cols \
+                    and not n.name_.startswith("__in_"):
+                return ColumnRef(f"__in_{n.name_}")
+            return None
+
+        extra.append(c.transform(mark))
+    return filters, corr, extra
+
+
+def _outer_to_col(e: Expr) -> Expr:
+    def rw(n: Expr):
+        if isinstance(n, _OuterRef):
+            return ColumnRef(n.name_)
+        return None
+
+    return e.transform(rw)
+
+
+def _dequalify_aliases(e: Expr, inner_cols: set, inner_aliases: set) -> Expr:
+    """Qualifier resolution for a subquery's projection expression."""
+
+    def rw(n: Expr):
+        if isinstance(n, FunctionCall) and n.fn_name == "struct_get" and len(n.args) == 1:
+            q = n.args[0]
+            if isinstance(q, ColumnRef) and q.name_ not in inner_cols:
                 return ColumnRef(n.kwargs["name"])
         return None
 

@@ -80,3 +80,33 @@ class ApproxStats:
 
     def scaled(self, selectivity: float) -> "ApproxStats":
         return ApproxStats(self.num_rows * selectivity, self.size_bytes * selectivity)
+
+
+def estimate_selectivity(expr) -> float:
+    """Shape-based predicate selectivity estimate (reference:
+    src/daft-logical-plan/src/stats.rs selectivity heuristics).
+
+    eq -> 0.1, ranges -> 0.3, AND multiplies, OR saturating-adds,
+    NOT complements, is_null -> 0.05, anything else -> 0.25.
+    """
+    from daft_tpu.expressions.expr import BinaryOp, UnaryOp
+
+    if isinstance(expr, BinaryOp):
+        if expr.op == "and":
+            return estimate_selectivity(expr.left) * estimate_selectivity(expr.right)
+        if expr.op == "or":
+            return min(estimate_selectivity(expr.left) + estimate_selectivity(expr.right), 1.0)
+        if expr.op == "eq":
+            return 0.1
+        if expr.op in ("lt", "le", "gt", "ge"):
+            return 0.3
+        if expr.op == "ne":
+            return 0.9
+    if isinstance(expr, UnaryOp):
+        if expr.op == "not":
+            return max(1.0 - estimate_selectivity(expr.child), 0.05)
+        if expr.op == "is_null":
+            return 0.05
+        if expr.op == "not_null":
+            return 0.95
+    return 0.25

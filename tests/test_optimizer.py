@@ -89,3 +89,124 @@ def test_eliminate_cross_join():
     assert joins and joins[0].how == "inner"
     out = q.sort("k").to_pydict()
     assert out["k"] == [3] and out["b"] == [300]
+
+
+# ------------------------- subquery unnesting ------------------------- #
+def test_unnest_in_subquery_to_semi_join():
+    left = daft_tpu.from_pydict({"id": [1, 2, 3], "v": [10, 20, 30]})
+    keys = daft_tpu.from_pydict({"id": [2, 3]})
+    q = left.where(col("id").is_in(keys.select("id")))
+    plan = _optimized(q)
+    joins = [n for n in plan.walk() if isinstance(n, lp.Join)]
+    assert joins and joins[0].how == "semi"
+    assert q.sort("id").to_pydict()["id"] == [2, 3]
+
+
+def test_unnest_not_in_subquery_to_anti_join():
+    from daft_tpu.expressions.expr import InSubquery
+    from daft_tpu.expressions.expression import Expression
+
+    left = daft_tpu.from_pydict({"id": [1, 2, 3]})
+    keys = daft_tpu.from_pydict({"id": [2]})
+    e = col("id").is_in(keys)._expr
+    q = left.where(~Expression(e))
+    plan = _optimized(q)
+    joins = [n for n in plan.walk() if isinstance(n, lp.Join)]
+    assert joins and joins[0].how == "anti"
+    assert q.sort("id").to_pydict()["id"] == [1, 3]
+
+
+def test_unnest_scalar_subquery_cross_join():
+    import daft_tpu as d
+
+    t = d.from_pydict({"x": [1.0, 5.0, 9.0]})
+    out = d.sql("SELECT x FROM t WHERE x > (SELECT avg(x) FROM t)").to_pydict()
+    assert out["x"] == [9.0]
+
+
+# ------------------------- join reordering ---------------------------- #
+def _make_star():
+    import numpy as np
+
+    rng = np.random.default_rng(0)
+    n = 50_000
+    fact = daft_tpu.from_pydict({
+        "f_ok": rng.integers(0, 5_000, n),
+        "f_sk": rng.integers(0, 50, n),
+        "f_val": rng.random(n),
+    })
+    orders = daft_tpu.from_pydict({
+        "o_ok": list(range(5_000)),
+        "o_ck": [i % 500 for i in range(5_000)],
+    })
+    cust = daft_tpu.from_pydict({"c_ck": list(range(500))})
+    supp = daft_tpu.from_pydict({"s_sk": list(range(50))})
+    return fact, orders, cust, supp
+
+
+def test_reorder_joins_keeps_fact_on_probe_side():
+    """TPC-H Q5/Q9-style chain: after reordering, no join may use the fact
+    table (largest relation) as its build (right) side."""
+    fact, orders, cust, supp = _make_star()
+    df = (fact.join(orders, left_on="f_ok", right_on="o_ok")
+              .join(cust, left_on="o_ck", right_on="c_ck")
+              .join(supp, left_on="f_sk", right_on="s_sk"))
+    plan = _optimized(df)
+    joins = [n for n in plan.walk() if isinstance(n, lp.Join)]
+    assert len(joins) == 3
+    for j in joins:
+        right_rows = j.children()[1].approx_stats().num_rows
+        assert right_rows < 25_000, f"fact table on build side: {j}"
+    # correctness unchanged
+    import pandas as pd
+
+    got = df.agg(col("f_val").sum().alias("s")).to_pydict()["s"][0]
+    ref = (fact.to_pandas().merge(orders.to_pandas(), left_on="f_ok", right_on="o_ok")
+           .merge(cust.to_pandas(), left_on="o_ck", right_on="c_ck")
+           .merge(supp.to_pandas(), left_on="f_sk", right_on="s_sk"))["f_val"].sum()
+    assert abs(got - ref) < 1e-6
+
+
+def test_reorder_joins_restores_output_schema():
+    fact, orders, cust, supp = _make_star()
+    df = (fact.join(orders, left_on="f_ok", right_on="o_ok")
+              .join(cust, left_on="o_ck", right_on="c_ck")
+              .join(supp, left_on="f_sk", right_on="s_sk"))
+    plan = _optimized(df)
+    assert [f.name for f in plan.schema] == df.column_names
+
+
+def test_in_subquery_under_or():
+    """Subqueries inside OR lower to boolean membership columns."""
+    left = daft_tpu.from_pydict({"id": [1, 2, 3, 4]})
+    keys = daft_tpu.from_pydict({"id": [3, 3, 4]})
+    q = left.where(col("id").is_in(keys) | (col("id") == 1))
+    assert q.sort("id").to_pydict()["id"] == [1, 3, 4]
+    qn = left.where(~col("id").is_in(keys) | (col("id") == 4))
+    assert qn.sort("id").to_pydict()["id"] == [1, 2, 4]
+
+
+def test_sql_exists_under_or():
+    import daft_tpu as d
+
+    cust = d.from_pydict({"c_id": [1, 2, 3]})
+    orders = d.from_pydict({"c_id": [3]})
+    out = d.sql("""
+        SELECT c_id FROM cust WHERE c_id = 1 OR EXISTS (
+            SELECT 1 FROM orders WHERE orders.c_id = cust.c_id)
+        ORDER BY c_id""", cust=cust, orders=orders).to_pydict()
+    assert out["c_id"] == [1, 3]
+
+
+def test_correlated_complex_subquery_rejected():
+    import pytest as pt
+
+    import daft_tpu as d
+
+    cust = d.from_pydict({"c_id": [1, 2], "total": [1.0, 1000.0]})
+    orders = d.from_pydict({"c_id": [1, 3], "total": [5.0, 50.0]})
+    with pt.raises(Exception, match="correlated reference"):
+        d.sql("""
+            SELECT c_id FROM cust WHERE c_id IN (
+                SELECT c_id FROM orders WHERE total > cust.total GROUP BY c_id)""",
+              cust=cust, orders=orders).collect()

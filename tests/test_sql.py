@@ -176,3 +176,88 @@ def test_private_session_tables(people):
     s = daft_tpu.Session()
     s.create_temp_table("mine", people)
     assert s.sql("SELECT count(*) AS n FROM mine").to_pydict() == {"n": [4]}
+
+
+# ---------------------- subqueries (IN/EXISTS/scalar) ------------------ #
+@pytest.fixture
+def subq_tables():
+    cust = daft_tpu.from_pydict({"c_id": [1, 2, 3], "name": ["a", "b", "c"]})
+    orders = daft_tpu.from_pydict(
+        {"o_id": [10, 11], "c_id": [1, 3], "total": [5.0, 50.0]})
+    return cust, orders
+
+
+def test_sql_in_subquery(subq_tables):
+    cust, orders = subq_tables
+    out = daft_tpu.sql(
+        "SELECT name FROM cust WHERE c_id IN (SELECT c_id FROM orders) ORDER BY name",
+        cust=cust, orders=orders).to_pydict()
+    assert out["name"] == ["a", "c"]
+
+
+def test_sql_not_in_subquery(subq_tables):
+    cust, orders = subq_tables
+    out = daft_tpu.sql(
+        "SELECT name FROM cust WHERE c_id NOT IN (SELECT c_id FROM orders)",
+        cust=cust, orders=orders).to_pydict()
+    assert out["name"] == ["b"]
+
+
+def test_sql_exists_correlated(subq_tables):
+    cust, orders = subq_tables
+    out = daft_tpu.sql("""
+        SELECT name FROM cust WHERE NOT EXISTS (
+            SELECT 1 FROM orders WHERE orders.c_id = cust.c_id AND total > 10.0)
+        ORDER BY name""", cust=cust, orders=orders).to_pydict()
+    assert out["name"] == ["a", "b"]
+
+
+def test_sql_scalar_subquery_uncorrelated(subq_tables):
+    cust, orders = subq_tables
+    out = daft_tpu.sql(
+        "SELECT name FROM cust WHERE c_id < (SELECT avg(c_id) FROM orders)",
+        cust=cust, orders=orders).to_pydict()
+    assert out["name"] == ["a"]
+
+
+def test_sql_scalar_subquery_correlated():
+    items = daft_tpu.from_pydict({"part": [1, 1, 2, 2], "qty": [1.0, 9.0, 4.0, 6.0]})
+    out = daft_tpu.sql("""
+        SELECT part, qty FROM items WHERE qty < (
+            SELECT 0.5 * avg(qty) FROM items i2 WHERE i2.part = items.part)
+        ORDER BY part""", items=items).to_pydict()
+    assert out["part"] == [1] and out["qty"] == [1.0]
+
+
+def test_sql_exists_non_equi_self_correlation():
+    """Q21 shape: EXISTS over the same table with an inequality on the
+    correlated alias."""
+    li = daft_tpu.from_pydict({"ok": [1, 1, 2, 3], "sk": [10, 20, 30, 40]})
+    out = daft_tpu.sql("""
+        SELECT sk FROM li l1 WHERE EXISTS (
+            SELECT 1 FROM li l2 WHERE l2.ok = l1.ok AND l2.sk <> l1.sk)
+        ORDER BY sk""", li=li).to_pydict()
+    assert out["sk"] == [10, 20]
+    out = daft_tpu.sql("""
+        SELECT sk FROM li l1 WHERE NOT EXISTS (
+            SELECT 1 FROM li l2 WHERE l2.ok = l1.ok AND l2.sk <> l1.sk)
+        ORDER BY sk""", li=li).to_pydict()
+    assert out["sk"] == [30, 40]
+
+
+def test_sql_in_subquery_with_grouped_having(subq_tables):
+    cust, orders = subq_tables
+    out = daft_tpu.sql("""
+        SELECT name FROM cust WHERE c_id IN (
+            SELECT c_id FROM orders GROUP BY c_id HAVING sum(total) > 10.0)""",
+        cust=cust, orders=orders).to_pydict()
+    assert out["name"] == ["c"]
+
+
+def test_sql_scalar_subquery_in_having(subq_tables):
+    cust, orders = subq_tables
+    out = daft_tpu.sql("""
+        SELECT c_id, sum(total) AS t FROM orders GROUP BY c_id
+        HAVING sum(total) > (SELECT sum(total) * 0.5 FROM orders)""",
+        orders=orders).to_pydict()
+    assert out["c_id"] == [3]
