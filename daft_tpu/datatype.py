@@ -634,6 +634,20 @@ class DataType:
         name = dtype.name
         if name == "bfloat16":
             return DataType.bfloat16()
+        if dtype.kind == "M":  # datetime64
+            unit = np.datetime_data(dtype)[0]
+            if unit == "D":
+                return DataType.date()
+            if unit in ("s", "ms", "us", "ns"):
+                return DataType.timestamp(unit)
+            raise DaftTypeError(f"Unsupported datetime64 unit: {unit}")
+        if dtype.kind == "m":  # timedelta64
+            unit = np.datetime_data(dtype)[0]
+            if unit in ("s", "ms", "us", "ns"):
+                return DataType.duration(unit)
+            raise DaftTypeError(f"Unsupported timedelta64 unit: {unit}")
+        if dtype.kind == "U":
+            return DataType.string()
         try:
             return DataType(TypeId(name))
         except ValueError:

@@ -54,8 +54,16 @@ class TwoPhasePlan:
                 self.merge_exprs.append(Alias(AggOp(merge_op, ColumnRef(name), merge_kwargs), name))
                 return ColumnRef(name)
 
-            if op in ("sum", "min", "max", "bool_and", "bool_or"):
+            if op in ("sum", "min", "max", "bool_and", "bool_or", "product"):
                 return add("v", AggOp(op, child), op)
+            if op == "median":
+                l = add("l", AggOp("list", Cast(child, DataType.float64())), "concat")
+                return FunctionCall("list_quantile", [l], {"percentiles": 0.5})
+            if op == "string_agg":
+                l = add("l", AggOp("list", child), "concat")
+                sep = agg.kwargs.get("sep", ",")
+                return FunctionCall("list_join", [FunctionCall("list_compact", [l]),
+                                                  _lit(sep)])
             if op == "any_value":
                 return add("v", agg, "any_value", agg.kwargs)
             if op == "count":

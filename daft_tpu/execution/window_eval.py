@@ -58,6 +58,10 @@ def _eval_one(rb: RecordBatch, w: WindowExpr) -> Series:
             list(w.descending) if w.descending else [False] * len(order_keys),
         ).to_numpy().astype(np.int64)
 
+    if w.func in ("lag", "lead") or \
+            (w.func in ("first_value", "last_value") and w.frame is None):
+        return _eval_offset_fn(rb, w, group_ids, order_idx, n)
+
     if w.func in ("row_number", "rank", "dense_rank", "percent_rank"):
         if order_idx is None:
             order_idx = np.arange(n, dtype=np.int64)
@@ -223,3 +227,53 @@ def _eval_rows_frame(rb, w: WindowExpr, child: Series, group_ids, order_idx, n: 
 
         result = result.cast(DataType.int64())
     return result
+
+
+def _eval_offset_fn(rb, w, group_ids, order_idx, n):
+    """lag/lead/first_value/last_value within each partition in sort order
+    (reference: window_partition_and_order_by sink's navigation functions)."""
+    child = evaluate(w.child, rb)
+    if order_idx is None:
+        order_idx = np.arange(n, dtype=np.int64)
+    sorted_groups = group_ids[order_idx]
+    # position of each row inside its partition in sorted order
+    out_idx = np.full(n, -1, dtype=np.int64)
+    valid = np.zeros(n, dtype=bool)
+    if w.func in ("lag", "lead"):
+        # The sort order is global (order_by only); partition membership is
+        # interleaved, so walk per-group histories rather than fixed steps.
+        offset = int(w.kwargs.get("offset", 1))
+        positions = range(n) if w.func == "lag" else range(n - 1, -1, -1)
+        hist: dict = {}
+        for pos in positions:
+            row = order_idx[pos]
+            g = sorted_groups[pos]
+            seen = hist.setdefault(g, [])
+            if len(seen) >= offset:
+                out_idx[row] = seen[-offset]
+                valid[row] = True
+            seen.append(row)
+    else:
+        # first/last row of each partition in sorted order
+        first: dict = {}
+        last: dict = {}
+        for pos in range(n):
+            g = sorted_groups[pos]
+            if g not in first:
+                first[g] = order_idx[pos]
+            last[g] = order_idx[pos]
+        src = first if w.func == "first_value" else last
+        for pos in range(n):
+            out_idx[order_idx[pos]] = src[sorted_groups[pos]]
+            valid[order_idx[pos]] = True
+    safe = np.where(valid, out_idx, 0).astype(np.uint64)
+    taken = child.take(safe)
+    if not valid.all():
+        default = w.kwargs.get("default")
+        if default is not None:
+            dseries = Series.full(child.name, default, n, child.dtype)
+            mask = Series.from_numpy(valid, "m")
+            taken = mask.if_else(taken, dseries)
+        else:
+            taken = taken._with_mask(~valid)
+    return taken

@@ -25,7 +25,7 @@ from daft_tpu.schema import Field, Schema
 from daft_tpu.series import Series
 
 _ARROW_AGGS = {
-    "sum": "sum", "mean": "mean", "min": "min", "max": "max",
+    "sum": "sum", "mean": "mean", "min": "min", "max": "max", "product": "product",
     "count": "count", "count_distinct": "count_distinct", "list": "list",
     "stddev": "stddev", "variance": "variance",
     "any_value": "first", "bool_and": "all", "bool_or": "any",
@@ -162,6 +162,24 @@ def _global_agg(child: Series, agg: AggOp) -> Series:
     op = agg.op
     if op == "sum":
         return child.sum()
+    if op == "product":
+        import numpy as np
+
+        v = child.drop_null().to_numpy()
+        out = np.prod(v) if len(v) else None
+        return Series.from_pylist([None if out is None else out.item()],
+                                  child.name, child.dtype)
+    if op == "median":
+        import numpy as np
+
+        v = child.drop_null().cast(DataType.float64()).to_numpy()
+        return Series.from_pylist([float(np.median(v)) if len(v) else None],
+                                  child.name, DataType.float64())
+    if op == "string_agg":
+        sep = agg.kwargs.get("sep", ",")
+        vals = [v for v in child.to_pylist() if v is not None]
+        return Series.from_pylist([sep.join(str(v) for v in vals) if vals else None],
+                                  child.name, DataType.string())
     if op == "mean":
         return child.mean()
     if op == "min":

@@ -458,6 +458,7 @@ class AggOp(Expr):
         "sum", "mean", "min", "max", "count", "count_distinct", "any_value",
         "list", "concat", "stddev", "variance", "skew", "approx_count_distinct",
         "approx_percentile", "bool_and", "bool_or", "udaf",
+        "product", "median", "string_agg",
     }
 
     __slots__ = ("op", "child", "kwargs")
@@ -480,8 +481,12 @@ class AggOp(Expr):
 
         f = self.child.to_field(schema)
         op = self.op
-        if op == "sum":
+        if op in ("sum", "product"):
             return f.with_dtype(_sum_dtype(f.dtype))
+        if op == "median":
+            return f.with_dtype(DataType.float64())
+        if op == "string_agg":
+            return f.with_dtype(DataType.string())
         if op in ("mean", "stddev", "variance", "skew"):
             return f.with_dtype(DataType.float64())
         if op in ("count", "count_distinct", "approx_count_distinct"):
@@ -557,16 +562,18 @@ class WindowExpr(Expr):
     window variants) + daft/window.py.
     """
 
-    __slots__ = ("func", "child", "partition_by", "order_by", "descending", "frame")
+    __slots__ = ("func", "child", "partition_by", "order_by", "descending", "frame", "kwargs")
 
     def __init__(self, func: str, child: Optional[Expr], partition_by: Tuple[Expr, ...],
-                 order_by: Tuple[Expr, ...], descending: Tuple[bool, ...], frame: Optional[tuple] = None):
+                 order_by: Tuple[Expr, ...], descending: Tuple[bool, ...], frame: Optional[tuple] = None,
+                 kwargs: Optional[Dict[str, Any]] = None):
         self.func = func
         self.child = child
         self.partition_by = tuple(partition_by)
         self.order_by = tuple(order_by)
         self.descending = tuple(descending)
         self.frame = frame
+        self.kwargs = dict(kwargs or {})
 
     def children(self) -> Tuple[Expr, ...]:
         base = (self.child,) if self.child is not None else ()
@@ -577,7 +584,7 @@ class WindowExpr(Expr):
         child = children.pop(0) if self.child is not None else None
         np_ = len(self.partition_by)
         return WindowExpr(self.func, child, tuple(children[:np_]), tuple(children[np_:]),
-                          self.descending, self.frame)
+                          self.descending, self.frame, self.kwargs)
 
     def name(self) -> str:
         if self.child is not None:
@@ -589,6 +596,9 @@ class WindowExpr(Expr):
             return Field(self.name(), DataType.uint64())
         if self.func == "percent_rank":
             return Field(self.name(), DataType.float64())
+        if self.func in ("lag", "lead", "first_value", "last_value"):
+            assert self.child is not None
+            return self.child.to_field(schema).rename(self.name())
         assert self.child is not None
         inner = self.child.to_field(schema)
         if self.func in ("sum",):
@@ -600,7 +610,8 @@ class WindowExpr(Expr):
         return inner
 
     def _attrs_key(self) -> tuple:
-        return (self.func, self.descending, self.frame)
+        return (self.func, self.descending, self.frame,
+                tuple(sorted((k, repr(v)) for k, v in self.kwargs.items())))
 
     def __repr__(self) -> str:
         return f"window[{self.func}]({self.child!r})"

@@ -383,7 +383,7 @@ class Expression:
             return Expression(WindowExpr(
                 inner.func, inner.child, tuple(e._expr for e in window._partition_by),
                 tuple(e._expr for e in window._order_by), tuple(window._descending),
-                window._frame,
+                window._frame, inner.kwargs,
             ))
         if isinstance(inner, AggOp):
             func, child = inner.op, inner.child
@@ -394,6 +394,156 @@ class Expression:
             tuple(e._expr for e in window._order_by), tuple(window._descending),
             window._frame,
         ))
+
+
+    # -- long-tail flat methods (reference: daft/expressions/expressions.py
+    # exposes the function library as flat Expression methods too) ---------
+    def try_cast(self, dtype) -> "Expression":
+        """Cast; rows that fail become null (reference: Expression.try_cast)."""
+        return self._fn("try_cast", dtype=dtype)
+
+    def negate(self) -> "Expression":
+        return self._fn("negate")
+
+    def csc(self):
+        return self._fn("csc")
+
+    def sec(self):
+        return self._fn("sec")
+
+    def cot(self):
+        return self._fn("cot")
+
+    def arcsin(self):
+        return self._fn("asin")
+
+    def arccos(self):
+        return self._fn("acos")
+
+    def arctan(self):
+        return self._fn("atan")
+
+    def arctanh(self):
+        return self._fn("atanh")
+
+    def arccosh(self):
+        return self._fn("acosh")
+
+    def arcsinh(self):
+        return self._fn("asinh")
+
+    def radians(self):
+        return self._fn("radians")
+
+    def degrees(self):
+        return self._fn("degrees")
+
+    def factorial(self):
+        return self._fn("factorial")
+
+    def hypot(self, other):
+        return self._fn("hypot", other)
+
+    def pmod(self, other):
+        return self._fn("pmod", other)
+
+    def is_nan(self):
+        return self._fn("is_nan")
+
+    def is_inf(self):
+        return self._fn("is_inf")
+
+    def not_nan(self):
+        return self._fn("not_nan")
+
+    def fill_nan(self, value):
+        return self._fn("fill_nan", value)
+
+    def bitwise_and(self, other):
+        return self._fn("bitwise_and", other)
+
+    def bitwise_or(self, other):
+        return self._fn("bitwise_or", other)
+
+    def bitwise_xor(self, other):
+        return self._fn("bitwise_xor", other)
+
+    def bitwise_not(self):
+        return self._fn("bitwise_not")
+
+    def product(self):
+        return self._agg("product")
+
+    def median(self):
+        return self._agg("median")
+
+    def variance(self):
+        return self._agg("variance")
+
+    def string_agg(self, sep: str = ","):
+        return self._agg("string_agg", sep=sep)
+
+    def agg_list_distinct(self):
+        return self.agg_list().list.distinct()
+
+    def lag(self, offset: int = 1, default=None) -> "Expression":
+        from daft_tpu.expressions.expr import WindowExpr
+
+        return Expression(WindowExpr("lag", self._expr, (), (), (),
+                                     kwargs={"offset": offset, "default": default}))
+
+    def lead(self, offset: int = 1, default=None) -> "Expression":
+        from daft_tpu.expressions.expr import WindowExpr
+
+        return Expression(WindowExpr("lead", self._expr, (), (), (),
+                                     kwargs={"offset": offset, "default": default}))
+
+    def first_value(self) -> "Expression":
+        from daft_tpu.expressions.expr import WindowExpr
+
+        return Expression(WindowExpr("first_value", self._expr, (), (), ()))
+
+    def last_value(self) -> "Expression":
+        from daft_tpu.expressions.expr import WindowExpr
+
+        return Expression(WindowExpr("last_value", self._expr, (), (), ()))
+
+    def length(self) -> "Expression":
+        return self._fn("str_length")
+
+    def serialize(self, format: str = "json"):
+        return self._fn("serialize", format=format)
+
+    def deserialize(self, format: str = "json"):
+        return self._fn("deserialize", format=format)
+
+    def try_deserialize(self, format: str = "json"):
+        return self._fn("try_deserialize", format=format)
+
+    def simhash(self, ngram_size: int = 2):
+        return self._fn("simhash", ngram_size=ngram_size)
+
+    def encode(self, codec: str = "base64"):
+        return self._fn("encode", codec=codec)
+
+    def decode(self, codec: str = "base64"):
+        return self._fn("decode", codec=codec)
+
+    def try_encode(self, codec: str = "base64"):
+        return self._fn("try_encode", codec=codec)
+
+    def try_decode(self, codec: str = "base64"):
+        return self._fn("try_decode", codec=codec)
+
+    def compress(self, codec: str = "zstd"):
+        return self._fn("compress", codec=codec)
+
+    def decompress(self, codec: str = "zstd"):
+        return self._fn("decompress", codec=codec)
+
+    @property
+    def partitioning(self) -> "PartitioningNamespace":
+        return PartitioningNamespace(self)
 
     # -- namespaces -------------------------------------------------------
     @property
@@ -556,6 +706,81 @@ class StringNamespace(_Namespace):
     def tokenize_decode(self, tokens_path: str):
         return self._fn("tokenize_decode", tokens_path=tokens_path)
 
+    def to_camel_case(self):
+        return self._fn("str_to_camel_case")
+
+    def to_upper_camel_case(self):
+        return self._fn("str_to_upper_camel_case")
+
+    def to_snake_case(self):
+        return self._fn("str_to_snake_case")
+
+    def to_upper_snake_case(self):
+        return self._fn("str_to_upper_snake_case")
+
+    def to_kebab_case(self):
+        return self._fn("str_to_kebab_case")
+
+    def to_upper_kebab_case(self):
+        return self._fn("str_to_upper_kebab_case")
+
+    def to_title_case(self):
+        return self._fn("str_to_title_case")
+
+    def swapcase(self):
+        return self._fn("str_swapcase")
+
+    def translate(self, src, dst):
+        return self._fn("str_translate", src, dst)
+
+    def substring_index(self, delim, count):
+        return self._fn("str_substring_index", delim, count)
+
+    def soundex(self):
+        return self._fn("str_soundex")
+
+    def ascii(self):
+        return self._fn("ascii")
+
+    def levenshtein_distance(self, other):
+        return self._fn("levenshtein_distance", other)
+
+    def damerau_levenshtein_distance(self, other):
+        return self._fn("damerau_levenshtein_distance", other)
+
+    def jaro_similarity(self, other):
+        return self._fn("jaro_similarity", other)
+
+    def jaro_winkler_similarity(self, other):
+        return self._fn("jaro_winkler_similarity", other)
+
+    def hamming_distance(self, other):
+        return self._fn("hamming_distance_str", other)
+
+    def jq(self, query: str):
+        return self._fn("json_query", query=query)
+
+    def json_query(self, query: str):
+        return self._fn("json_query", query=query)
+
+    def json_array_length(self):
+        return self._fn("json_array_length")
+
+    def json_object_keys(self):
+        return self._fn("json_object_keys")
+
+    def regexp_replace(self, pattern, replacement):
+        return self._fn("str_replace", pattern, replacement, regex=True)
+
+    def regexp_count(self, pattern):
+        return self._fn("str_count_matches", pattern, regex=True)
+
+    def regexp_split(self, pattern):
+        return self._fn("str_split", pattern, regex=True)
+
+    def zfill(self, width: int):
+        return self._fn("str_lpad", width, "0")
+
 
 class TemporalNamespace(_Namespace):
     def date(self):
@@ -615,6 +840,61 @@ class TemporalNamespace(_Namespace):
     def total_seconds(self):
         return self._fn("dt_total_seconds")
 
+    def nanosecond(self):
+        return self._fn("dt_nanosecond")
+
+    def unix_date(self):
+        return self._fn("dt_unix_date")
+
+    def total_milliseconds(self):
+        return self._fn("dt_total_milliseconds")
+
+    def total_microseconds(self):
+        return self._fn("dt_total_microseconds")
+
+    def total_nanoseconds(self):
+        return self._fn("dt_total_nanoseconds")
+
+    def total_minutes(self):
+        return self._fn("dt_total_minutes")
+
+    def total_hours(self):
+        return self._fn("dt_total_hours")
+
+    def total_days(self):
+        return self._fn("dt_total_days")
+
+    def date_add(self, days):
+        if isinstance(days, int):
+            return self._fn("date_add", days=days)
+        return self._fn("date_add", days)
+
+    def date_sub(self, days):
+        if isinstance(days, int):
+            return self._fn("date_sub", days=days)
+        return self._fn("date_sub", days)
+
+    def date_diff(self, other):
+        return self._fn("date_diff", other)
+
+    def add_months(self, months: int):
+        return self._fn("add_months", months=months)
+
+    def months_between(self, other):
+        return self._fn("months_between", other)
+
+    def last_day(self):
+        return self._fn("last_day")
+
+    def next_day(self, day: str):
+        return self._fn("next_day", day=day)
+
+    def convert_time_zone(self, timezone: str):
+        return self._fn("convert_time_zone", timezone=timezone)
+
+    def replace_time_zone(self, timezone=None):
+        return self._fn("replace_time_zone", timezone=timezone)
+
 
 class ListNamespace(_Namespace):
     def join(self, delimiter):
@@ -669,6 +949,32 @@ class ListNamespace(_Namespace):
             "explode is a plan-level operation: use DataFrame.explode(col) "
             "(one row per list element changes the row count)"
         )
+
+    def flatten(self):
+        return self._fn("list_flatten")
+
+    def bool_and(self):
+        return self._fn("list_bool_and")
+
+    def bool_or(self):
+        return self._fn("list_bool_or")
+
+    def append(self, other):
+        return self._fn("list_append", other)
+
+    def map(self, expr):
+        mapper = expr._expr if isinstance(expr, Expression) else expr
+        return self._fn("list_map", expr=mapper)
+
+    def filter(self, expr):
+        pred = expr._expr if isinstance(expr, Expression) else expr
+        return self._fn("list_filter", expr=pred)
+
+    def quantile(self, percentiles):
+        return self._fn("list_quantile", percentiles=percentiles)
+
+    def count_distinct(self):
+        return self._fn("list_count_distinct")
 
 
 class StructNamespace(_Namespace):
@@ -725,6 +1031,18 @@ class EmbeddingNamespace(_Namespace):
     def l2_normalize(self):
         return self._fn("l2_normalize")
 
+    def cosine_similarity(self, other):
+        other = other._e if isinstance(other, _Namespace) else other
+        return self._fn("cosine_similarity", other)
+
+    def hamming_distance(self, other):
+        other = other._e if isinstance(other, _Namespace) else other
+        return self._fn("hamming_distance", other)
+
+    def pearson_correlation(self, other):
+        other = other._e if isinstance(other, _Namespace) else other
+        return self._fn("pearson_correlation", other)
+
 
 class BinaryNamespace(_Namespace):
     def length(self):
@@ -735,6 +1053,54 @@ class BinaryNamespace(_Namespace):
 
     def slice(self, start, length=None):
         return self._fn("binary_slice", start, length=length)
+
+    def encode(self, codec: str = "base64"):
+        return self._fn("encode", codec=codec)
+
+    def decode(self, codec: str = "base64"):
+        return self._fn("decode", codec=codec)
+
+    def try_encode(self, codec: str = "base64"):
+        return self._fn("try_encode", codec=codec)
+
+    def try_decode(self, codec: str = "base64"):
+        return self._fn("try_decode", codec=codec)
+
+    def compress(self, codec: str = "zstd"):
+        return self._fn("compress", codec=codec)
+
+    def decompress(self, codec: str = "zstd"):
+        return self._fn("decompress", codec=codec)
+
+    def try_compress(self, codec: str = "zstd"):
+        return self._fn("try_compress", codec=codec)
+
+    def try_decompress(self, codec: str = "zstd"):
+        return self._fn("try_decompress", codec=codec)
+
+
+class PartitioningNamespace(_Namespace):
+    """Partition transforms (reference: daft/functions/partition.py +
+    Expression.partitioning in the reference API)."""
+
+    def days(self):
+        return self._fn("partition_days")
+
+    def hours(self):
+        return self._fn("partition_hours")
+
+    def months(self):
+        return self._fn("partition_months")
+
+    def years(self):
+        return self._fn("partition_years")
+
+    def iceberg_bucket(self, n: int):
+        return self._fn("partition_iceberg_bucket", n=n)
+
+    def iceberg_truncate(self, w: int):
+        return self._fn("partition_iceberg_truncate", w=w)
+
 
 
 class UrlNamespace(_Namespace):

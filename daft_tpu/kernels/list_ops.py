@@ -85,7 +85,8 @@ def _list_chunk(args, size: int = 1, **kwargs):
 def _list_join(args, **kwargs):
     sep = args[1].to_pylist()[0]
     arr = args[0].to_arrow()
-    out = pc.binary_join(arr.cast(pa.large_list(pa.large_string())), sep)
+    out = pc.binary_join(arr.cast(pa.large_list(pa.large_string())),
+                         pa.scalar(sep, pa.large_string()))
     return Series.from_arrow(out, args[0].name, DataType.string())
 
 
@@ -193,3 +194,110 @@ def _list_value_counts(args, **kwargs):
             out.append(list(counts.items()))
     dtype = DataType.map(s.dtype.inner, DataType.uint64())
     return Series.from_arrow(pa.array(out, dtype.to_arrow()), s.name, dtype)
+
+
+# ------------------------------------------------------------------ #
+# List long tail (reference: daft/functions/list.py)                  #
+# ------------------------------------------------------------------ #
+def _flatten_resolver(fields, kwargs):
+    f = fields[0]
+    if not f.dtype.is_list() or not f.dtype.inner.is_list():
+        raise DaftTypeError(f"list_flatten expects list<list<T>>, got {f.dtype!r}")
+    return Field(f.name, DataType.list(f.dtype.inner.inner))
+
+
+@register_kernel("list_flatten", _flatten_resolver)
+def _list_flatten(args, **kwargs):
+    """list<list<T>> -> list<T> per row."""
+    f = args[0]
+    if not f.dtype.is_list() or not f.dtype.inner.is_list():
+        raise DaftTypeError(f"list_flatten expects list<list<T>>, got {f.dtype!r}")
+    out = [None if v is None else [x for sub in v if sub is not None for x in sub]
+           for v in f.to_pylist()]
+    return Series.from_pylist(out, f.name, DataType.list(f.dtype.inner.inner))
+
+
+@register_kernel("list_bool_and", lambda f, k: Field(f[0].name, DataType.bool()))
+def _list_bool_and(args, **kwargs):
+    out = [None if v is None else all(bool(x) for x in v if x is not None)
+           for v in args[0].to_pylist()]
+    return Series.from_pylist(out, args[0].name, DataType.bool())
+
+
+@register_kernel("list_bool_or", lambda f, k: Field(f[0].name, DataType.bool()))
+def _list_bool_or(args, **kwargs):
+    out = [None if v is None else any(bool(x) for x in v if x is not None)
+           for v in args[0].to_pylist()]
+    return Series.from_pylist(out, args[0].name, DataType.bool())
+
+
+@register_kernel("list_append", _same)
+def _list_append(args, **kwargs):
+    lists = args[0].to_pylist()
+    vals = args[1].to_pylist()
+    if len(vals) == 1 and len(lists) != 1:
+        vals = vals * len(lists)
+    out = [None if v is None else list(v) + [x] for v, x in zip(lists, vals)]
+    return Series.from_pylist(out, args[0].name, args[0].dtype)
+
+
+def _eval_over_elements(list_series, expr):
+    """Evaluate `expr` (referencing element()) over the flattened elements,
+    then re-wrap with the original offsets. This is how list_map/list_filter
+    lower: one vectorized evaluation, no per-row Python loop on the expr."""
+    from daft_tpu.expressions.evaluator import evaluate
+    from daft_tpu.recordbatch import RecordBatch
+    from daft_tpu.schema import Schema
+
+    arr = list_series.to_arrow()
+    if isinstance(arr, pa.ChunkedArray):
+        arr = arr.combine_chunks()
+    flat = arr.flatten()
+    inner = Series.from_arrow(flat, "__list_element__", list_series.dtype.inner)
+    rb = RecordBatch(Schema([Field("__list_element__", inner.dtype)]), [inner], len(inner))
+    return arr, evaluate(expr, rb)
+
+
+def _list_map_resolver(fields, kwargs):
+    from daft_tpu.schema import Schema
+
+    inner = Schema([Field("__list_element__", fields[0].dtype.inner)])
+    return Field(fields[0].name, DataType.list(kwargs["expr"].to_field(inner).dtype))
+
+
+@register_kernel("list_map", _list_map_resolver)
+def _list_map(args, expr=None, **kwargs):
+    arr, mapped = _eval_over_elements(args[0], expr)
+    offsets = arr.offsets
+    mapped_arr = mapped.to_arrow()
+    if isinstance(mapped_arr, pa.ChunkedArray):
+        mapped_arr = mapped_arr.combine_chunks()
+    out = pa.LargeListArray.from_arrays(offsets.cast(pa.int64()), mapped_arr)
+    if not arr.is_valid().to_numpy(zero_copy_only=False).all():
+        out = pc.if_else(arr.is_valid(), out, pa.nulls(len(out), out.type))
+    return Series.from_arrow(out, args[0].name, DataType.list(mapped.dtype))
+
+
+@register_kernel("list_filter", _same)
+def _list_filter(args, expr=None, **kwargs):
+    arr, keep = _eval_over_elements(args[0], expr)
+    keep_np = np.asarray(pc.fill_null(keep.to_arrow(), False))
+    offsets = np.asarray(arr.offsets.cast(pa.int64()))
+    lists = arr.flatten().to_pylist()
+    valid = arr.is_valid().to_numpy(zero_copy_only=False)
+    out = []
+    for i in range(len(arr)):
+        if not valid[i]:
+            out.append(None)
+            continue
+        lo, hi = offsets[i], offsets[i + 1]
+        out.append([lists[j] for j in range(lo, hi) if keep_np[j]])
+    return Series.from_pylist(out, args[0].name, args[0].dtype)
+
+
+@register_kernel("list_compact", _same)
+def _list_compact(args, **kwargs):
+    """Drop null elements from each list."""
+    out = [None if v is None else [x for x in v if x is not None]
+           for v in args[0].to_pylist()]
+    return Series.from_pylist(out, args[0].name, args[0].dtype)

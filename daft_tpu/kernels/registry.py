@@ -87,9 +87,11 @@ def _ensure_loaded() -> None:
         from daft_tpu.kernels import (  # noqa: F401
             binary_ops,
             embedding_ops,
+            extended_ops,
             float_ops,
             image_ops,
             list_ops,
+            media_ops,
             misc_ops,
             numeric,
             string_ops,

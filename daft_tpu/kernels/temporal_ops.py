@@ -91,3 +91,221 @@ def _total_seconds(args, **kwargs):
     us = arr.cast(pa.duration("us")).cast(pa.int64())
     out = pc.divide(us.cast(pa.float64()), 1_000_000.0)
     return _wrap(out, args[0].name, DataType.float64())
+
+
+# ------------------------------------------------------------------ #
+# Date arithmetic long tail (reference: daft/functions/datetime.py)   #
+# ------------------------------------------------------------------ #
+@register_kernel("dt_nanosecond", returns(_U32))
+def _nanosecond(args, **kwargs):
+    arr = args[0].to_arrow()
+    us = pc.microsecond(arr)
+    return _wrap(pc.multiply(us.cast(pa.int64()), 1000).cast(pa.uint32()),
+                 args[0].name, _U32)
+
+
+def _total_factory(name, divisor_us):
+    @register_kernel(name, returns(DataType.float64()))
+    def _k(args, **kwargs):
+        us = args[0].to_arrow().cast(pa.duration("us")).cast(pa.int64())
+        out = pc.divide(us.cast(pa.float64()), float(divisor_us))
+        return _wrap(out, args[0].name, DataType.float64())
+    return _k
+
+
+_total_factory("dt_total_milliseconds", 1_000)
+_total_factory("dt_total_microseconds", 1)
+_total_factory("dt_total_minutes", 60_000_000)
+_total_factory("dt_total_hours", 3_600_000_000)
+_total_factory("dt_total_days", 86_400_000_000)
+
+
+@register_kernel("dt_total_nanoseconds", returns(DataType.int64()))
+def _total_ns(args, **kwargs):
+    us = args[0].to_arrow().cast(pa.duration("us")).cast(pa.int64())
+    return _wrap(pc.multiply(us, 1000), args[0].name, DataType.int64())
+
+
+@register_kernel("dt_unix_date", returns(DataType.int64()))
+def _unix_date(args, **kwargs):
+    out = args[0].to_arrow().cast(pa.date32()).cast(pa.int32()).cast(pa.int64())
+    return _wrap(out, args[0].name, DataType.int64())
+
+
+@register_kernel("date_from_unix_date", returns(DataType.date()))
+def _date_from_unix_date(args, **kwargs):
+    out = args[0].to_arrow().cast(pa.int32()).cast(pa.date32())
+    return _wrap(out, args[0].name, DataType.date())
+
+
+def _ts_factory(name, unit):
+    @register_kernel(name, lambda f, k: Field(f[0].name, DataType.timestamp(unit)))
+    def _k(args, **kwargs):
+        out = args[0].to_arrow().cast(pa.int64()).cast(pa.timestamp(unit))
+        return _wrap(out, args[0].name, DataType.timestamp(unit))
+    return _k
+
+
+_ts_factory("timestamp_seconds", "s")
+_ts_factory("timestamp_millis", "ms")
+_ts_factory("timestamp_micros", "us")
+
+
+@register_kernel("date_add", lambda f, k: f[0])
+def _date_add(args, days: int = 0, **kwargs):
+    arr = args[0].to_arrow()
+    if len(args) > 1:
+        d = args[1].to_arrow().cast(pa.int64())
+        if pa.types.is_date(arr.type):
+            out = pc.add(arr.cast(pa.int32()).cast(pa.int64()), d).cast(pa.int32()).cast(pa.date32())
+        else:
+            out = pc.add(arr, pc.multiply(d, 86_400_000_000).cast(pa.duration("us")))
+    else:
+        if pa.types.is_date(arr.type):
+            out = pc.add(arr.cast(pa.int32()), days).cast(pa.int32()).cast(pa.date32())
+        else:
+            out = pc.add(arr, pa.scalar(days * 86_400_000_000, pa.duration("us")))
+    return _wrap(out, args[0].name, args[0].dtype)
+
+
+@register_kernel("date_sub", lambda f, k: f[0])
+def _date_sub(args, days: int = 0, **kwargs):
+    from daft_tpu.kernels.registry import get_kernel
+
+    if len(args) > 1:
+        import daft_tpu.series as S
+
+        neg = Series.from_arrow(pc.negate(args[1].to_arrow().cast(pa.int64())),
+                                args[1].name, DataType.int64())
+        return get_kernel("date_add")([args[0], neg])
+    return get_kernel("date_add")([args[0]], days=-days)
+
+
+@register_kernel("date_diff", returns(DataType.int64()))
+def _date_diff(args, **kwargs):
+    a = args[0].to_arrow().cast(pa.date32()).cast(pa.int32()).cast(pa.int64())
+    b = args[1].to_arrow().cast(pa.date32()).cast(pa.int32()).cast(pa.int64())
+    return _wrap(pc.subtract(a, b), args[0].name, DataType.int64())
+
+
+@register_kernel("add_months", lambda f, k: f[0])
+def _add_months(args, months: int = 1, **kwargs):
+    import datetime as _dt
+
+    def do(v):
+        if v is None:
+            return None
+        d = v.date() if isinstance(v, _dt.datetime) else v
+        total = d.year * 12 + (d.month - 1) + months
+        y, m = divmod(total, 12)
+        m += 1
+        # clamp to month end
+        for day in (d.day, 30, 29, 28):
+            try:
+                nd = _dt.date(y, m, day)
+                break
+            except ValueError:
+                continue
+        if isinstance(v, _dt.datetime):
+            return _dt.datetime.combine(nd, v.time())
+        return nd
+
+    return Series.from_pylist([do(v) for v in args[0].to_pylist()],
+                              args[0].name, args[0].dtype)
+
+
+@register_kernel("months_between", returns(DataType.float64()))
+def _months_between(args, **kwargs):
+    import datetime as _dt
+
+    a = args[0].to_pylist()
+    b = args[1].to_pylist()
+    if len(b) == 1 and len(a) != 1:
+        b = b * len(a)
+
+    def norm(v):
+        return v.date() if isinstance(v, _dt.datetime) else v
+
+    def do(x, y):
+        if x is None or y is None:
+            return None
+        x, y = norm(x), norm(y)
+        return (x.year - y.year) * 12 + (x.month - y.month) + (x.day - y.day) / 31.0
+
+    return Series.from_pylist([do(x, y) for x, y in zip(a, b)],
+                              args[0].name, DataType.float64())
+
+
+@register_kernel("last_day", returns(DataType.date()))
+def _last_day(args, **kwargs):
+    import calendar
+    import datetime as _dt
+
+    def do(v):
+        if v is None:
+            return None
+        d = v.date() if isinstance(v, _dt.datetime) else v
+        return _dt.date(d.year, d.month, calendar.monthrange(d.year, d.month)[1])
+
+    return Series.from_pylist([do(v) for v in args[0].to_pylist()],
+                              args[0].name, DataType.date())
+
+
+_DAYNAMES = {"mon": 0, "tue": 1, "wed": 2, "thu": 3, "fri": 4, "sat": 5, "sun": 6}
+
+
+@register_kernel("next_day", returns(DataType.date()))
+def _next_day(args, day: str = "mon", **kwargs):
+    import datetime as _dt
+
+    target = _DAYNAMES[day.lower()[:3]]
+
+    def do(v):
+        if v is None:
+            return None
+        d = v.date() if isinstance(v, _dt.datetime) else v
+        delta = (target - d.weekday() - 1) % 7 + 1
+        return d + _dt.timedelta(days=delta)
+
+    return Series.from_pylist([do(v) for v in args[0].to_pylist()],
+                              args[0].name, DataType.date())
+
+
+@register_kernel("make_date", returns(DataType.date()))
+def _make_date(args, **kwargs):
+    import datetime as _dt
+
+    ys, ms, ds = (a.to_pylist() for a in args[:3])
+    out = [None if None in (y, m, d) else _dt.date(int(y), int(m), int(d))
+           for y, m, d in zip(ys, ms, ds)]
+    return Series.from_pylist(out, args[0].name, DataType.date())
+
+
+@register_kernel("replace_time_zone", lambda f, k: Field(
+    f[0].name, DataType.timestamp("us", k.get("timezone"))))
+def _replace_time_zone(args, timezone=None, **kwargs):
+    arr = args[0].to_arrow()
+    if not pa.types.is_timestamp(arr.type):
+        arr = arr.cast(pa.timestamp("us"))
+    if arr.type.tz is not None:
+        # Keep the WALL CLOCK, not the instant (a bare cast would keep the
+        # UTC instant and silently shift local time).
+        naive = pc.local_timestamp(arr)
+    else:
+        naive = arr
+    if timezone is None:
+        return _wrap(naive, args[0].name, DataType.timestamp(arr.type.unit))
+    out = pc.assume_timezone(naive, timezone)
+    return _wrap(out, args[0].name, DataType.timestamp(arr.type.unit, timezone))
+
+
+@register_kernel("convert_time_zone", lambda f, k: Field(
+    f[0].name, DataType.timestamp("us", k.get("timezone"))))
+def _convert_time_zone(args, timezone="UTC", **kwargs):
+    arr = args[0].to_arrow()
+    if not pa.types.is_timestamp(arr.type):
+        arr = arr.cast(pa.timestamp("us"))
+    if arr.type.tz is None:
+        arr = pc.assume_timezone(arr, "UTC")
+    out = arr.cast(pa.timestamp(arr.type.unit, timezone))
+    return _wrap(out, args[0].name, DataType.timestamp(arr.type.unit, timezone))

@@ -713,11 +713,41 @@ class ReorderJoins(Rule):
             return ReorderJoins._tree_shape(n.children()[0])
         return ("R", id(n))
 
+    @staticmethod
+    def _ndv(rel, e) -> Optional[float]:
+        """Actual number-of-distinct-values of a join key when the relation's
+        data is already in memory (reference: EnrichWithStats feeding the
+        join-order cost model). Low-cardinality keys (e.g. nationkey) are
+        exactly where the rows-as-NDV proxy causes catastrophic orders."""
+        if not isinstance(e, ColumnRef):
+            return None
+        if not isinstance(rel, lp.InMemorySource):
+            return None
+        total_rows = sum(len(p) for p in rel.partitions)
+        if total_rows == 0 or total_rows > 5_000_000:
+            return None
+        try:
+            import pyarrow as pa
+            import pyarrow.compute as pc
+
+            chunks = [p.combined().get_column(e.name_).to_arrow()
+                      for p in rel.partitions]
+            return float(pc.count_distinct(pa.chunked_array(chunks)).as_py())
+        except Exception:
+            return None
+
     def _dp_order(self, relations, edges):
         """DP over connected subsets (DP-CCP style): best[mask] = (cost, rows,
         plan_desc). Returns a nested tuple describing the join tree."""
         n = len(relations)
         rows = [max(r.approx_stats().num_rows, 1.0) for r in relations]
+        ndv_cache: dict = {}
+
+        def ndv(idx, e):
+            key = (idx, e.key())
+            if key not in ndv_cache:
+                ndv_cache[key] = self._ndv(relations[idx], e)
+            return ndv_cache[key]
         # Connectivity + per-pair selectivity from edges. Each equi-key pair
         # contributes 1/max(distinct) ~ 1/max(rows) of the smaller side —
         # without NDV stats, use the standard |L||R|/max(|L|,|R|) estimate
@@ -729,14 +759,17 @@ class ReorderJoins(Rule):
         def join_sel(mask_a, mask_b):
             found = False
             sel = 1.0
-            for li, ri, _, _ in edges:
+            for li, ri, le, re_ in edges:
                 if ((mask_a >> li) & 1 and (mask_b >> ri) & 1) or \
                    ((mask_b >> li) & 1 and (mask_a >> ri) & 1):
                     found = True
-                    # |L||R| / NDV(key); without column NDV stats the best
-                    # proxy is the smaller relation's cardinality (exact for
-                    # FK->PK joins, conservative otherwise).
-                    sel *= 1.0 / max(min(rows[li], rows[ri]), 1.0)
+                    # System-R: |L||R| / max(V(L,a), V(R,b)). Use measured
+                    # NDV where available; otherwise the smaller relation's
+                    # cardinality (exact for FK->PK joins).
+                    vl, vr = ndv(li, le), ndv(ri, re_)
+                    known = [v for v in (vl, vr) if v]
+                    v = max(known) if known else min(rows[li], rows[ri])
+                    sel *= 1.0 / max(v, 1.0)
             return sel if found else None
 
         full = (1 << n) - 1

@@ -548,6 +548,11 @@ class Parser:
             return inner
         if t.kind == "ident":
             self.next()
+            if t.value.lower() == "date" and self.peek().kind == "str":
+                raw = self.next().value[1:-1]
+                import datetime as _dt
+
+                return Literal(_dt.date.fromisoformat(raw))
             if self.peek().kind == "op" and self.peek().value == "(":
                 return self._parse_function(t.value)
             # qualified column a.b -> struct access is handled postfix; here a

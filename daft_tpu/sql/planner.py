@@ -110,7 +110,11 @@ def _plan_from(stmt: SelectStmt, bindings, ctes, session=None):
         if join.using:
             df = df.join(right, on=join.using, how=join.how)
             continue
-        left_on, right_on = _split_join_condition(join.on, df, right)
+        left_on, right_on, lf, rf = _split_join_condition(join.on, df, right, join.how)
+        for f in lf:
+            df = df.where(Expression(f))
+        for f in rf:
+            right = right.where(Expression(f))
         df = df.join(
             right,
             left_on=[Expression(e) for e in left_on],
@@ -186,10 +190,20 @@ def _plan_select(stmt: SelectStmt, bindings, ctes, session=None):
             out = out.where(Expression(having_rewritten))
             if hidden_aggs:
                 out = out.exclude(*[e.name() for e in hidden_aggs])
-        # Re-order columns to match projection order when possible.
-        want = [e.name() for e in proj_exprs]
-        if set(want) <= set(out.column_names):
-            out = out.select(*want)
+        # Re-order columns to match projection order (and re-apply aliases on
+        # group keys, whose agg output columns carry the key's own name).
+        want_exprs = []
+        for e in proj_exprs:
+            nm = e.name()
+            strip = _strip_alias(e)
+            src = strip.name() if strip.key() in group_keys else nm
+            if src not in out.column_names:
+                want_exprs = None
+                break
+            want_exprs.append(Expression(Alias(ColumnRef(src), nm)) if src != nm
+                              else Expression(ColumnRef(nm)))
+        if want_exprs is not None:
+            out = out.select(*want_exprs)
         df = out
     else:
         # ORDER BY may reference pre-projection columns (SQL scoping): carry
@@ -244,8 +258,11 @@ def _strip_alias(e: Expr) -> Expr:
     return e
 
 
-def _split_join_condition(on: Optional[Expr], left_df, right_df) -> Tuple[List[Expr], List[Expr]]:
-    """Decompose `a.x = b.y AND ...` into (left_on, right_on) key lists."""
+def _split_join_condition(on: Optional[Expr], left_df, right_df, how: str = "inner"):
+    """Decompose an ON condition into (left_on, right_on, left_filters,
+    right_filters). Single-side non-equi conjuncts become prefilters on that
+    side when that is semantics-preserving (always for inner; for outer joins
+    only the side whose unmatched rows are dropped anyway)."""
     if on is None:
         raise DaftValueError("JOIN requires ON or USING")
     conjuncts: List[Expr] = []
@@ -261,20 +278,31 @@ def _split_join_condition(on: Optional[Expr], left_df, right_df) -> Tuple[List[E
     left_names = set(left_df.column_names)
     right_names = set(right_df.column_names)
     left_on, right_on = [], []
+    left_filters, right_filters = [], []
     for c in conjuncts:
-        if not (isinstance(c, BinaryOp) and c.op == "eq"):
-            raise DaftValueError(f"Only equi-join conditions supported, got {c!r}")
-        l, r = _strip_qualifier(c.left), _strip_qualifier(c.right)
-        l_refs, r_refs = l.column_refs(), r.column_refs()
-        if l_refs <= left_names and r_refs <= right_names:
-            left_on.append(l)
-            right_on.append(r)
-        elif l_refs <= right_names and r_refs <= left_names:
-            left_on.append(r)
-            right_on.append(l)
-        else:
-            raise DaftValueError(f"Cannot attribute join condition sides: {c!r}")
-    return left_on, right_on
+        cq = _strip_qualifier(c)
+        refs = cq.column_refs()
+        if isinstance(c, BinaryOp) and c.op == "eq":
+            l, r = _strip_qualifier(c.left), _strip_qualifier(c.right)
+            l_refs, r_refs = l.column_refs(), r.column_refs()
+            if l_refs <= left_names and r_refs <= right_names:
+                left_on.append(l)
+                right_on.append(r)
+                continue
+            if l_refs <= right_names and r_refs <= left_names:
+                left_on.append(r)
+                right_on.append(l)
+                continue
+        if refs <= right_names and how in ("inner", "left", "semi", "anti"):
+            right_filters.append(cq)
+            continue
+        if refs <= left_names and how in ("inner", "right"):
+            left_filters.append(cq)
+            continue
+        raise DaftValueError(
+            f"Unsupported {how}-join condition (not an equi key or a "
+            f"prefilterable single-side predicate): {c!r}")
+    return left_on, right_on, left_filters, right_filters
 
 
 def _dequalify(e: Expr, column_names: set) -> Expr:
@@ -383,6 +411,7 @@ def _reject_correlation(stmt, outer_df, outer_aliases, bindings, ctes, session):
     exprs = [e for e, _ in stmt.projections if e is not None]
     exprs += [e for e in (stmt.where, stmt.having) if e is not None]
     exprs += list(stmt.group_by)
+    exprs += [o.expr for o in stmt.order_by]
     for e in exprs:
         for n in e.walk():
             if isinstance(n, FunctionCall) and n.fn_name == "struct_get" \

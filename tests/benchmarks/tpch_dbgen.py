@@ -1,0 +1,213 @@
+"""Full dbgen-shaped TPC-H generator: all 8 tables, all columns used by
+Q1-Q22, dbgen row-count ratios scaled by SF (reference: benchmarking/tpch
+which shells out to dbgen; here a seeded vectorized numpy generator with the
+same schema, key relationships, and LIKE-selectable text domains)."""
+
+from __future__ import annotations
+
+import datetime
+
+import numpy as np
+
+import daft_tpu
+
+EPOCH = datetime.date(1992, 1, 1)
+END = datetime.date(1998, 12, 1)
+N_DAYS = (END - EPOCH).days
+
+NATIONS = [
+    ("ALGERIA", 0), ("ARGENTINA", 1), ("BRAZIL", 1), ("CANADA", 1), ("EGYPT", 4),
+    ("ETHIOPIA", 0), ("FRANCE", 3), ("GERMANY", 3), ("INDIA", 2), ("INDONESIA", 2),
+    ("IRAN", 4), ("IRAQ", 4), ("JAPAN", 2), ("JORDAN", 4), ("KENYA", 0),
+    ("MOROCCO", 0), ("MOZAMBIQUE", 0), ("PERU", 1), ("CHINA", 2), ("ROMANIA", 3),
+    ("SAUDI ARABIA", 4), ("VIETNAM", 2), ("RUSSIA", 3), ("UNITED KINGDOM", 3),
+    ("UNITED STATES", 1),
+]
+REGIONS = ["AFRICA", "AMERICA", "ASIA", "EUROPE", "MIDDLE EAST"]
+SEGMENTS = ["AUTOMOBILE", "BUILDING", "FURNITURE", "HOUSEHOLD", "MACHINERY"]
+PRIORITIES = ["1-URGENT", "2-HIGH", "3-MEDIUM", "4-NOT SPECIFIED", "5-LOW"]
+SHIPMODES = ["AIR", "FOB", "MAIL", "RAIL", "REG AIR", "SHIP", "TRUCK"]
+SHIPINSTRUCT = ["COLLECT COD", "DELIVER IN PERSON", "NONE", "TAKE BACK RETURN"]
+COLORS = ["almond", "antique", "aquamarine", "azure", "beige", "bisque", "black",
+          "blanched", "blue", "blush", "brown", "burlywood", "burnished", "chartreuse",
+          "chiffon", "chocolate", "coral", "cornflower", "cornsilk", "cream", "cyan",
+          "dark", "deep", "dim", "dodger", "drab", "firebrick", "floral", "forest",
+          "frosted", "gainsboro", "ghost", "goldenrod", "green", "grey", "honeydew",
+          "hot", "hotpink", "indian", "ivory", "khaki", "lace", "lavender", "lawn",
+          "lemon", "light", "lime", "linen", "magenta", "maroon", "medium"]
+TYPES_1 = ["STANDARD", "SMALL", "MEDIUM", "LARGE", "ECONOMY", "PROMO"]
+TYPES_2 = ["ANODIZED", "BURNISHED", "PLATED", "POLISHED", "BRUSHED"]
+TYPES_3 = ["TIN", "NICKEL", "BRASS", "STEEL", "COPPER"]
+CONTAINERS_1 = ["SM", "LG", "MED", "JUMBO", "WRAP"]
+CONTAINERS_2 = ["CASE", "BOX", "BAG", "JAR", "PKG", "PACK", "CAN", "DRUM"]
+FILLER_WORDS = ["carefully", "quickly", "slyly", "furiously", "ironic", "final",
+                "pending", "regular", "express", "bold", "even", "silent", "deposits",
+                "accounts", "theodolites", "pinto", "beans", "foxes", "packages"]
+
+
+D64_EPOCH = np.datetime64("1992-01-01")
+
+
+def _dates(rng, n, lo=0, hi=N_DAYS):
+    days = rng.integers(lo, hi, n)
+    return D64_EPOCH + days.astype("timedelta64[D]"), days
+
+
+def _phones(rng, nk):
+    a = rng.integers(100, 1000, len(nk)).astype("U3")
+    b = rng.integers(100, 1000, len(nk)).astype("U3")
+    c = rng.integers(1000, 10000, len(nk)).astype("U4")
+    k = (10 + nk).astype("U2")
+    dash = np.full(len(nk), "-", dtype="U1")
+    return reduce_add([k, dash, a, dash, b, dash, c])
+
+
+def reduce_add(parts):
+    out = parts[0]
+    for p in parts[1:]:
+        out = np.char.add(out, p)
+    return out
+
+
+_TEXT_POOL = None
+
+
+def _text(rng, n, extra=None, extra_frac=0.05):
+    """Random filler text drawn from a 4096-entry pool; `extra` phrase is
+    appended on ~extra_frac of rows."""
+    global _TEXT_POOL
+    if _TEXT_POOL is None:
+        pr = np.random.default_rng(1234)
+        w = pr.integers(0, len(FILLER_WORDS), (4096, 4))
+        _TEXT_POOL = np.array([" ".join(FILLER_WORDS[j] for j in row) for row in w])
+    out = _TEXT_POOL[rng.integers(0, len(_TEXT_POOL), n)]
+    if extra is not None:
+        hits = rng.random(n) < extra_frac
+        if hits.any():
+            out = out.astype(object)
+            out[hits] = out[hits] + (" " + extra)
+            out = out.astype("U")
+    return out
+
+
+def generate_tpch_dbgen(sf: float = 0.01, seed: int = 0):
+    """dict of the 8 TPC-H DataFrames at scale factor `sf`."""
+    rng = np.random.default_rng(seed)
+    n_supp = max(int(10_000 * sf), 10)
+    n_part = max(int(200_000 * sf), 40)
+    n_cust = max(int(150_000 * sf), 30)
+    n_ord = max(int(1_500_000 * sf), 150)
+    n_li = max(int(6_000_000 * sf), 600)
+    n_ps_per_part = 4
+
+    region = daft_tpu.from_pydict({
+        "r_regionkey": np.arange(5, dtype=np.int64),
+        "r_name": REGIONS,
+        "r_comment": _text(rng, 5),
+    })
+    nation = daft_tpu.from_pydict({
+        "n_nationkey": np.arange(len(NATIONS), dtype=np.int64),
+        "n_name": [n for n, _ in NATIONS],
+        "n_regionkey": np.array([r for _, r in NATIONS], dtype=np.int64),
+        "n_comment": _text(rng, len(NATIONS)),
+    })
+
+    s_nk = rng.integers(0, len(NATIONS), n_supp).astype(np.int64)
+    supplier = daft_tpu.from_pydict({
+        "s_suppkey": np.arange(n_supp, dtype=np.int64),
+        "s_name": np.char.add("Supplier#", np.char.zfill(np.arange(n_supp).astype("U9"), 9)),
+        "s_address": _text(rng, n_supp),
+        "s_nationkey": s_nk,
+        "s_phone": _phones(rng, s_nk),
+        "s_acctbal": np.round(rng.uniform(-999, 9999, n_supp), 2),
+        "s_comment": _text(rng, n_supp, extra="Customer Complaints", extra_frac=0.03),
+    })
+
+    p_c1 = rng.integers(0, len(COLORS), n_part)
+    p_c2 = rng.integers(0, len(COLORS), n_part)
+    p_t1 = rng.integers(0, len(TYPES_1), n_part)
+    p_t2 = rng.integers(0, len(TYPES_2), n_part)
+    p_t3 = rng.integers(0, len(TYPES_3), n_part)
+    part = daft_tpu.from_pydict({
+        "p_partkey": np.arange(n_part, dtype=np.int64),
+        "p_name": reduce_add([np.array(COLORS)[p_c1], np.full(n_part, " ", "U1"), np.array(COLORS)[p_c2]]),
+        "p_mfgr": np.char.add("Manufacturer#", rng.integers(1, 6, n_part).astype("U1")),
+        "p_brand": reduce_add([np.full(n_part, "Brand#", "U6"), rng.integers(1, 6, n_part).astype("U1"), rng.integers(1, 6, n_part).astype("U1")]),
+        "p_type": reduce_add([np.array(TYPES_1)[p_t1], np.full(n_part, " ", "U1"), np.array(TYPES_2)[p_t2], np.full(n_part, " ", "U1"), np.array(TYPES_3)[p_t3]]),
+        "p_size": rng.integers(1, 51, n_part).astype(np.int64),
+        "p_container": reduce_add([np.array(CONTAINERS_1)[rng.integers(0, 5, n_part)], np.full(n_part, " ", "U1"), np.array(CONTAINERS_2)[rng.integers(0, 8, n_part)]]),
+        "p_retailprice": np.round(900 + rng.uniform(0, 200, n_part), 2),
+        "p_comment": _text(rng, n_part),
+    })
+
+    n_ps = n_part * n_ps_per_part
+    ps_pk = np.repeat(np.arange(n_part, dtype=np.int64), n_ps_per_part)
+    ps_sk = ((ps_pk * 13 + np.tile(np.arange(n_ps_per_part), n_part)
+              * (n_supp // n_ps_per_part + 1)) % n_supp).astype(np.int64)
+    partsupp = daft_tpu.from_pydict({
+        "ps_partkey": ps_pk,
+        "ps_suppkey": ps_sk,
+        "ps_availqty": rng.integers(1, 10_000, n_ps).astype(np.int64),
+        "ps_supplycost": np.round(rng.uniform(1, 1000, n_ps), 2),
+        "ps_comment": _text(rng, n_ps),
+    })
+
+    c_nk = rng.integers(0, len(NATIONS), n_cust).astype(np.int64)
+    customer = daft_tpu.from_pydict({
+        "c_custkey": np.arange(n_cust, dtype=np.int64),
+        "c_name": np.char.add("Customer#", np.char.zfill(np.arange(n_cust).astype("U9"), 9)),
+        "c_address": _text(rng, n_cust),
+        "c_nationkey": c_nk,
+        "c_phone": _phones(rng, c_nk),
+        "c_acctbal": np.round(rng.uniform(-999, 9999, n_cust), 2),
+        "c_mktsegment": np.array(SEGMENTS)[rng.integers(0, 5, n_cust)],
+        "c_comment": _text(rng, n_cust),
+    })
+
+    # ~1/3 of customers place no orders (dbgen leaves key gaps) — Q13/Q22.
+    o_ck = rng.integers(0, n_cust, n_ord).astype(np.int64)
+    o_ck = np.where(o_ck % 3 == 0, (o_ck + 1) % n_cust, o_ck)
+    o_dates, o_days = _dates(rng, n_ord, 0, N_DAYS - 151)
+    orders = daft_tpu.from_pydict({
+        "o_orderkey": np.arange(n_ord, dtype=np.int64),
+        "o_custkey": o_ck,
+        "o_orderstatus": np.array(["F", "O", "P"])[rng.integers(0, 3, n_ord)],
+        "o_totalprice": np.round(rng.uniform(800, 500_000, n_ord), 2),
+        "o_orderdate": o_dates,
+        "o_orderpriority": np.array(PRIORITIES)[rng.integers(0, 5, n_ord)],
+        "o_clerk": np.char.add("Clerk#", np.char.zfill(rng.integers(0, max(n_ord // 1000, 1), n_ord).astype("U9"), 9)),
+        "o_shippriority": np.zeros(n_ord, dtype=np.int64),
+        "o_comment": _text(rng, n_ord, extra="special requests", extra_frac=0.02),
+    })
+
+    l_ok = rng.integers(0, n_ord, n_li).astype(np.int64)
+    l_pk = rng.integers(0, n_part, n_li).astype(np.int64)
+    # supplier must be one of the part's 4 partsupp suppliers (Q9/Q20 rely on
+    # the (l_partkey, l_suppkey) pair existing in partsupp)
+    slot = rng.integers(0, n_ps_per_part, n_li)
+    l_sk = ((l_pk * 13 + slot * (n_supp // n_ps_per_part + 1)) % n_supp).astype(np.int64)
+    ship_delay = rng.integers(1, 122, n_li)
+    commit_delay = rng.integers(30, 92, n_li)
+    receipt_delay = rng.integers(1, 31, n_li)
+    ship_days = o_days[l_ok] + ship_delay
+    lineitem = daft_tpu.from_pydict({
+        "l_orderkey": l_ok,
+        "l_partkey": l_pk,
+        "l_suppkey": l_sk,
+        "l_linenumber": (np.arange(n_li) % 7 + 1).astype(np.int64),
+        "l_quantity": rng.integers(1, 51, n_li).astype(np.float64),
+        "l_extendedprice": np.round(rng.uniform(900, 105_000, n_li), 2),
+        "l_discount": np.round(rng.uniform(0.0, 0.1, n_li), 2),
+        "l_tax": np.round(rng.uniform(0.0, 0.08, n_li), 2),
+        "l_returnflag": np.array(["A", "N", "R"])[rng.integers(0, 3, n_li)],
+        "l_linestatus": np.array(["F", "O"])[rng.integers(0, 2, n_li)],
+        "l_shipdate": D64_EPOCH + ship_days.astype("timedelta64[D]"),
+        "l_commitdate": D64_EPOCH + (o_days[l_ok] + commit_delay).astype("timedelta64[D]"),
+        "l_receiptdate": D64_EPOCH + (ship_days + receipt_delay).astype("timedelta64[D]"),
+        "l_shipinstruct": np.array(SHIPINSTRUCT)[rng.integers(0, 4, n_li)],
+        "l_shipmode": np.array(SHIPMODES)[rng.integers(0, 7, n_li)],
+        "l_comment": _text(rng, n_li),
+    })
+    return {"region": region, "nation": nation, "supplier": supplier, "part": part,
+            "partsupp": partsupp, "customer": customer, "orders": orders,
+            "lineitem": lineitem}
