@@ -1,0 +1,394 @@
+"""Multi-host worker daemon: TCP control plane + Arrow Flight data plane.
+
+Reference: the reference runs one worker per node, reachable only over the
+network — Ray actor RPC control plane (daft/runners/flotilla.py:139-290,
+RaySwordfishActor per node) with an Arrow Flight shuffle data plane
+(src/daft-shuffles/src/server/flight_server.rs); the scheduler talks to them
+through the Worker/WorkerManager abstraction
+(src/daft-distributed/src/scheduling/worker.rs:13-77).
+
+Here the control plane is a framed-cloudpickle TCP protocol (the shape a
+gRPC service would have, without codegen): a daemon process per host accepts
+``run_task`` requests, executes plan fragments on the real streaming
+Executor, keeps the outputs LOCAL in its shuffle cache, and answers with
+FlightPartitionRefs. Downstream tasks running on other hosts fetch those
+inputs directly from the owning daemon's Flight server — worker↔worker data
+movement rides the data plane (DCN), never the driver.
+
+SECURITY: the control protocol deserializes cloudpickle from any peer that
+can reach the port — equivalent to remote code execution by design (tasks ARE
+code). Run daemons only on a private cluster network (the reference's Ray
+actors have the same trust model); bind --host to an internal interface.
+
+Launch standalone:  ``python -m daft_tpu.distributed.daemon --port 9201``
+Connect a driver:   ``DAFT_WORKER_ADDRESSES=hostA:9201,hostB:9201``
+                    ``DAFT_RUNNER=distributed``
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import struct
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+import uuid
+from concurrent.futures import Future, ThreadPoolExecutor
+from typing import Dict, List, Optional, Sequence
+
+import cloudpickle
+
+from daft_tpu.distributed.partition_ref import (
+    FlightPartitionRef,
+    LocalPartitionRef,
+    PartitionRef,
+    deserialize_partition,
+    serialize_partition,
+)
+from daft_tpu.distributed.task import Task
+from daft_tpu.distributed.worker import (
+    Worker,
+    WorkerDiedError,
+    bind_task_fragment,
+    collect_task_outputs,
+)
+
+_LEN = struct.Struct("<Q")
+
+
+def _send_frame(sock: socket.socket, data: bytes) -> None:
+    sock.sendall(_LEN.pack(len(data)) + data)
+
+
+def _recv_frame(sock: socket.socket) -> bytes:
+    buf = bytearray()
+    while len(buf) < _LEN.size:
+        chunk = sock.recv(_LEN.size - len(buf))
+        if not chunk:
+            raise EOFError("socket closed")
+        buf += chunk
+    (n,) = _LEN.unpack(bytes(buf))
+    out = bytearray()
+    while len(out) < n:
+        chunk = sock.recv(min(n - len(out), 1 << 20))
+        if not chunk:
+            raise EOFError("socket closed")
+        out += chunk
+    return bytes(out)
+
+
+# ------------------------------------------------------------------ #
+# Ref wire format                                                      #
+# ------------------------------------------------------------------ #
+def encode_ref(ref: PartitionRef) -> dict:
+    """Flight refs travel as addresses (zero-copy); anything else ships its
+    bytes inline (driver-resident partitions, e.g. from_pydict inputs)."""
+    if isinstance(ref, FlightPartitionRef):
+        return {"kind": "flight", "address": ref.address, "ticket": ref.ticket,
+                "rows": ref.rows, "bytes": ref.bytes_, "worker_id": ref.worker_id}
+    return {"kind": "bytes", "data": serialize_partition(ref.fetch())}
+
+
+def decode_ref(d: dict) -> PartitionRef:
+    if d["kind"] == "flight":
+        return FlightPartitionRef(d["address"], d["ticket"], d["rows"],
+                                  d["bytes"], d.get("worker_id"))
+    return LocalPartitionRef(deserialize_partition(d["data"]))
+
+
+# ------------------------------------------------------------------ #
+# Daemon (server side)                                                 #
+# ------------------------------------------------------------------ #
+class WorkerDaemon:
+    """One per host. Executes task fragments; serves results over Flight."""
+
+    def __init__(self, port: int = 0, slots: int = 2, data_dir: Optional[str] = None,
+                 host: str = "0.0.0.0", advertise_host: Optional[str] = None):
+        from daft_tpu.distributed.flight import ShuffleFlightServer
+        from daft_tpu.distributed.shuffle import ShuffleCache
+
+        self.worker_id = f"daemon-{uuid.uuid4().hex[:8]}"
+        self.slots = slots
+        self.cache = ShuffleCache(data_dir or tempfile.mkdtemp(prefix="daft_daemon_"))
+        self.flight = ShuffleFlightServer(self.cache)
+        self.advertise_host = advertise_host or os.environ.get(
+            "DAFT_ADVERTISE_HOST", "localhost")
+        self._pool = ThreadPoolExecutor(max_workers=slots,
+                                        thread_name_prefix=f"{self.worker_id}-task")
+        self._server = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._server.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._server.bind((host, port))
+        self._server.listen(64)
+        self.port = self._server.getsockname()[1]
+        self._active = 0
+        self._lock = threading.Lock()
+        self._shutdown = threading.Event()
+
+    @property
+    def flight_address(self) -> str:
+        return f"grpc://{self.advertise_host}:{self.flight.port}"
+
+    def serve_forever(self) -> None:
+        while not self._shutdown.is_set():
+            try:
+                conn, _ = self._server.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._serve_conn, args=(conn,),
+                             daemon=True).start()
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        try:
+            while True:
+                frame = _recv_frame(conn)
+                try:
+                    msg = cloudpickle.loads(frame)
+                except BaseException as e:  # noqa: BLE001
+                    # A payload referencing modules this host can't import
+                    # must fail THIS request, not the whole connection.
+                    _send_frame(conn, cloudpickle.dumps(
+                        {"ok": False, "error": f"cannot decode request: {e}"}))
+                    continue
+                op = msg.get("op")
+                if op == "ping":
+                    _send_frame(conn, cloudpickle.dumps(
+                        {"ok": True, "worker_id": self.worker_id,
+                         "slots": self.slots, "flight": self.flight_address}))
+                elif op == "run_task":
+                    # The pool caps concurrent executions at `slots` even
+                    # with many connections (per-chip ownership on TPU hosts).
+                    fut = self._pool.submit(self._run_task, msg)
+                    _send_frame(conn, cloudpickle.dumps(fut.result()))
+                elif op == "die":
+                    # Fault injection (tests only): refuse unless explicitly
+                    # enabled — an unauthenticated kill switch otherwise.
+                    if os.environ.get("DAFT_DAEMON_ALLOW_FAULT_INJECTION"):
+                        os._exit(17)
+                    _send_frame(conn, cloudpickle.dumps(
+                        {"ok": False, "error": "fault injection disabled"}))
+                elif op == "shutdown":
+                    _send_frame(conn, cloudpickle.dumps({"ok": True}))
+                    self.stop()
+                    return
+                else:
+                    _send_frame(conn, cloudpickle.dumps(
+                        {"ok": False, "error": f"unknown op {op!r}"}))
+        except (EOFError, OSError, ConnectionError):
+            pass
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _run_task(self, msg: dict) -> dict:
+        with self._lock:
+            self._active += 1
+        try:
+            from daft_tpu.execution.executor import Executor
+
+            fragment = msg["fragment"]
+            inputs = [[decode_ref(d) for d in slot] for slot in msg["inputs"]]
+            bound = bind_task_fragment(fragment, inputs)
+            executor = Executor(msg["cfg"], partition_offset=msg["partition_idx"])
+            out = list(executor.run(bound))
+            parts = collect_task_outputs(out, msg["expect_outputs"], fragment.schema)
+            refs = []
+            shuffle_id = f"task-{uuid.uuid4().hex[:12]}"
+            for i, p in enumerate(parts):
+                ticket = self.cache.write_partition(shuffle_id, i, p)
+                refs.append({"kind": "flight", "address": self.flight_address,
+                             "ticket": ticket, "rows": len(p),
+                             "bytes": p.size_bytes(), "worker_id": self.worker_id})
+            return {"ok": True, "refs": refs}
+        except BaseException as e:  # noqa: BLE001
+            import traceback
+
+            return {"ok": False, "error": f"{e}\n{traceback.format_exc()}"}
+        finally:
+            with self._lock:
+                self._active -= 1
+
+    def stop(self) -> None:
+        self._shutdown.set()
+        self._pool.shutdown(wait=False, cancel_futures=True)
+        try:
+            self._server.close()
+        except OSError:
+            pass
+        self.flight.shutdown()
+        self.cache.cleanup()
+
+
+# ------------------------------------------------------------------ #
+# RemoteWorker (driver side)                                           #
+# ------------------------------------------------------------------ #
+class RemoteWorker(Worker):
+    """Driver-side handle to a WorkerDaemon, speaking the TCP protocol.
+    Implements the same Worker interface the scheduler/dispatcher already
+    use, so WorkerDied rescheduling and autoscale work unchanged."""
+
+    def __init__(self, address: str, cfg=None, connect_timeout: float = 10.0):
+        from daft_tpu.context import get_context
+
+        self.address = address
+        host, port = address.rsplit(":", 1)
+        self._host, self._port = host, int(port)
+        self.cfg = cfg or get_context().execution_config
+        self._active = 0
+        self._lock = threading.Lock()
+        info = self._request({"op": "ping"}, timeout=connect_timeout)
+        self.worker_id = info["worker_id"]
+        self.num_slots = info["slots"]
+        self.flight_address = info["flight"]
+
+    def _request(self, msg: dict, timeout: Optional[float] = None) -> dict:
+        try:
+            with socket.create_connection((self._host, self._port),
+                                          timeout=timeout) as sock:
+                sock.settimeout(None)
+                _send_frame(sock, cloudpickle.dumps(msg))
+                reply = cloudpickle.loads(_recv_frame(sock))
+        except (OSError, EOFError, ConnectionError) as e:
+            raise WorkerDiedError(
+                f"worker at {self.address} unreachable: {e}") from e
+        if not reply.get("ok"):
+            raise RuntimeError(reply.get("error", "unknown daemon error"))
+        return reply
+
+    def submit(self, task: Task) -> "Future[List[PartitionRef]]":
+        fut: "Future[List[PartitionRef]]" = Future()
+        with self._lock:
+            self._active += 1
+
+        def run() -> List[PartitionRef]:
+            try:
+                payload = {
+                    "op": "run_task",
+                    "cfg": self.cfg,
+                    "fragment": task.fragment,
+                    "inputs": [[encode_ref(r) for r in slot] for slot in task.inputs],
+                    "partition_idx": task.partition_idx,
+                    "expect_outputs": task.expect_outputs,
+                }
+                reply = self._request(payload)
+                return [decode_ref(d) for d in reply["refs"]]
+            finally:
+                with self._lock:
+                    self._active -= 1
+
+        def runner():
+            try:
+                fut.set_result(run())
+            except BaseException as e:  # noqa: BLE001
+                fut.set_exception(e)
+
+        threading.Thread(target=runner, daemon=True,
+                         name=f"submit-{self.worker_id}").start()
+        return fut
+
+    def active_tasks(self) -> int:
+        return self._active
+
+    def kill(self) -> None:
+        """Fault injection: crash the remote daemon process."""
+        try:
+            with socket.create_connection((self._host, self._port), timeout=5) as sock:
+                _send_frame(sock, cloudpickle.dumps({"op": "die"}))
+        except OSError:
+            pass
+
+    def shutdown(self) -> None:
+        try:
+            self._request({"op": "shutdown"}, timeout=2)
+        except Exception:
+            pass
+
+
+# ------------------------------------------------------------------ #
+# Spawning helpers (single-machine clusters for tests / dev)           #
+# ------------------------------------------------------------------ #
+def spawn_local_daemon(port: int = 0, slots: int = 2,
+                       jax_platforms: Optional[str] = None) -> "subprocess.Popen":
+    """Launch a daemon subprocess on localhost; returns the Popen. The port
+    is written to stdout line 1 (`PORT <n>`) when 0 is requested."""
+    repo_root = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    env = dict(os.environ)
+    # Same-host spawn: propagate the driver's full sys.path so task payloads
+    # referencing driver-importable modules (plugins, test fixtures) resolve.
+    extra = [p for p in sys.path if p and os.path.isdir(p)]
+    env["PYTHONPATH"] = os.pathsep.join([repo_root, *extra,
+                                         env.get("PYTHONPATH", "")])
+    if jax_platforms is None:
+        try:
+            import jax
+
+            if jax.config.jax_platforms == "cpu":
+                jax_platforms = "cpu"
+        except Exception:
+            pass
+    if jax_platforms:
+        env["DAFT_CHILD_JAX_PLATFORMS"] = jax_platforms
+    env["DAFT_DAEMON_ALLOW_FAULT_INJECTION"] = "1"
+    return subprocess.Popen(
+        [sys.executable, "-m", "daft_tpu.distributed.daemon",
+         "--port", str(port), "--slots", str(slots)],
+        env=env, stdout=subprocess.PIPE, text=True,
+    )
+
+
+def wait_for_daemon(proc: "subprocess.Popen", timeout: float = 60.0) -> str:
+    """Block until the daemon prints its PORT line; returns 'localhost:port'.
+    Fails fast if the process dies, and respects the deadline even if the
+    daemon stays alive but silent."""
+    import select
+
+    deadline = time.time() + timeout
+    buf = ""
+    while time.time() < deadline:
+        if proc.poll() is not None:
+            raise DaftDaemonError(
+                f"daemon exited rc={proc.returncode} before reporting a port")
+        ready, _, _ = select.select([proc.stdout], [], [], 0.2)
+        if not ready:
+            continue
+        line = proc.stdout.readline()
+        if not line:
+            time.sleep(0.1)
+            continue
+        if line.startswith("PORT "):
+            return f"localhost:{line.split()[1]}"
+    raise DaftDaemonError("daemon did not report a port in time")
+
+
+class DaftDaemonError(RuntimeError):
+    pass
+
+
+def main(argv: Optional[Sequence[str]] = None) -> None:
+    import argparse
+
+    parser = argparse.ArgumentParser(description="daft_tpu worker daemon")
+    parser.add_argument("--port", type=int, default=0)
+    parser.add_argument("--slots", type=int, default=2)
+    parser.add_argument("--data-dir", default=None)
+    parser.add_argument("--host", default="0.0.0.0")
+    args = parser.parse_args(argv)
+
+    platforms = os.environ.get("DAFT_CHILD_JAX_PLATFORMS")
+    if platforms:
+        import jax
+
+        jax.config.update("jax_platforms", platforms)
+
+    daemon = WorkerDaemon(port=args.port, slots=args.slots, data_dir=args.data_dir,
+                          host=args.host)
+    print(f"PORT {daemon.port}", flush=True)
+    daemon.serve_forever()
+
+
+if __name__ == "__main__":
+    main()

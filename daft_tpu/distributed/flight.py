@@ -25,10 +25,11 @@ class ShuffleFlightServer(flight.FlightServerBase):
         self.cache = cache
 
     def do_get(self, context, ticket: flight.Ticket):
+        from daft_tpu.distributed.partition_ref import partition_to_wire_table
+
         key = ticket.ticket.decode()
         mp = self.cache.read_partition(key)
-        table = mp.to_arrow_table()
-        return flight.RecordBatchStream(table)
+        return flight.RecordBatchStream(partition_to_wire_table(mp))
 
     def list_flights(self, context, criteria):
         for t in self.cache.tickets():
@@ -66,4 +67,6 @@ def fetch_partition(address: str, ticket: str) -> MicroPartition:
             _client_cache[address] = client
     reader = client.do_get(flight.Ticket(ticket.encode()))
     table = reader.read_all()
-    return MicroPartition.from_arrow_table(table)
+    from daft_tpu.distributed.partition_ref import partition_from_wire_table
+
+    return partition_from_wire_table(table)

@@ -83,10 +83,59 @@ class FlightPartitionRef(PartitionRef):
         return self.worker_id
 
 
+def partition_to_wire_table(mp: MicroPartition) -> pa.Table:
+    """Arrow table in the shuffle wire format: daft Schema in the IPC schema
+    metadata (logical types — File/Image/Embedding — survive the host
+    boundary); Python-object columns (no Arrow representation) travel as
+    per-row pickled binary."""
+    import cloudpickle
+
+    py_cols = [f.name for f in mp.schema if f.dtype.is_python()]
+    if py_cols:
+        rb = mp.combined()
+        arrays, names = [], []
+        for c in rb.columns():
+            names.append(c.name)
+            if c.dtype.is_python():
+                arrays.append(pa.array(
+                    [cloudpickle.dumps(v) for v in c.to_pylist()],
+                    pa.large_binary()))
+            else:
+                arrays.append(c.to_arrow())
+        table = pa.table(dict(zip(names, arrays)))
+    else:
+        table = mp.to_arrow_table()
+    return table.replace_schema_metadata(
+        {**(table.schema.metadata or {}),
+         b"daft_schema": cloudpickle.dumps(mp.schema)})
+
+
+def partition_from_wire_table(table: pa.Table,
+                              schema: Optional[Schema] = None) -> MicroPartition:
+    import cloudpickle
+
+    if schema is None and table.schema.metadata \
+            and b"daft_schema" in table.schema.metadata:
+        schema = cloudpickle.loads(table.schema.metadata[b"daft_schema"])
+    if schema is not None and any(f.dtype.is_python() for f in schema):
+        from daft_tpu.series import Series
+
+        cols = []
+        for f in schema:
+            arr = table.column(f.name)
+            if f.dtype.is_python():
+                vals = [None if b is None else cloudpickle.loads(b)
+                        for b in arr.to_pylist()]
+                cols.append(Series.from_pylist(vals, f.name, f.dtype))
+            else:
+                cols.append(Series.from_arrow(arr.combine_chunks(), f.name, f.dtype))
+        rb = RecordBatch(schema, cols, table.num_rows)
+        return MicroPartition(schema, [rb])
+    return MicroPartition.from_arrow_table(table, schema)
+
+
 def serialize_partition(mp: MicroPartition) -> bytes:
-    """Arrow IPC stream serialisation (the shuffle wire format — reference
-    keeps Arrow IPC on the wire too, src/daft-shuffles)."""
-    table = mp.to_arrow_table()
+    table = partition_to_wire_table(mp)
     sink = io.BytesIO()
     with pa.ipc.new_stream(sink, table.schema) as writer:
         writer.write_table(table)
@@ -96,4 +145,4 @@ def serialize_partition(mp: MicroPartition) -> bytes:
 def deserialize_partition(data: bytes, schema: Optional[Schema] = None) -> MicroPartition:
     with pa.ipc.open_stream(io.BytesIO(data)) as reader:
         table = reader.read_all()
-    return MicroPartition.from_arrow_table(table, schema)
+    return partition_from_wire_table(table, schema)

@@ -43,8 +43,10 @@ class ShuffleCache:
 
     def write_partition(self, shuffle_id: str, bucket: int, mp: MicroPartition) -> str:
         """Spill one bucket's data from a map task; returns its ticket."""
+        from daft_tpu.distributed.partition_ref import partition_to_wire_table
+
         ticket = f"{shuffle_id}/{bucket}"
-        table = mp.to_arrow_table()
+        table = partition_to_wire_table(mp)
         path = os.path.join(self.root, f"{shuffle_id}-{bucket}-{uuid.uuid4().hex[:8]}.arrow")
         with pa.OSFile(path, "wb") as f:
             with pa.ipc.new_stream(f, table.schema) as writer:
@@ -72,8 +74,11 @@ class ShuffleCache:
             with pa.OSFile(path, "rb") as f:
                 with pa.ipc.open_stream(f) as reader:
                     tables.append(reader.read_all())
-        combined = pa.concat_tables(tables) if tables else None
-        return MicroPartition.from_arrow_table(combined)
+        if not tables:
+            return MicroPartition.from_arrow_table(None)
+        from daft_tpu.distributed.partition_ref import partition_from_wire_table
+
+        return partition_from_wire_table(pa.concat_tables(tables))
 
     def partition_meta(self, ticket: str) -> ShufflePartitionMeta:
         with self._lock:

@@ -136,11 +136,11 @@ def _frame_bound(bound, n: int):
     """Normalise a rows_between bound to an int offset or +/-inf sentinel."""
     from daft_tpu.window import Window
 
-    if bound is Window.unbounded_preceding:
+    if bound == Window.unbounded_preceding:
         return -n
-    if bound is Window.unbounded_following:
+    if bound == Window.unbounded_following:
         return n
-    if bound is Window.current_row:
+    if bound == Window.current_row:
         return 0
     return int(bound)
 

@@ -165,10 +165,14 @@ def _global_agg(child: Series, agg: AggOp) -> Series:
     if op == "product":
         import numpy as np
 
+        from daft_tpu.series import _sum_dtype
+
         v = child.drop_null().to_numpy()
-        out = np.prod(v) if len(v) else None
-        return Series.from_pylist([None if out is None else out.item()],
-                                  child.name, child.dtype)
+        out_dt = _sum_dtype(child.dtype)
+        if len(v) == 0:
+            return Series.from_pylist([None], child.name, out_dt)
+        out = np.prod(v.astype(out_dt.to_numpy(), copy=False))
+        return Series.from_pylist([out.item()], child.name, out_dt)
     if op == "median":
         import numpy as np
 

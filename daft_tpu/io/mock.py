@@ -48,7 +48,12 @@ class MockSource(DataSource):
         self.partitions = partitions
         self.transient_failures = transient_failures or {}
         self.fatal_tasks = fatal_tasks or set()
-        self._attempts: Dict[int, int] = {}
+        import tempfile
+
+        # Attempt counters are file-backed: fault-injected scans may execute
+        # on daemon/process workers, and the asserting test runs in the
+        # driver process.
+        self._attempt_dir = tempfile.mkdtemp(prefix="daft_mock_attempts_")
         self._lock = threading.Lock()
 
     def schema(self) -> Schema:
@@ -58,9 +63,31 @@ class MockSource(DataSource):
         return [MockScanTask(self, i, p) for i, p in enumerate(self.partitions)]
 
     def record_attempt(self, index: int) -> None:
+        import os
+        import uuid as _uuid
+
         with self._lock:
-            self._attempts[index] = self._attempts.get(index, 0) + 1
+            path = os.path.join(self._attempt_dir,
+                                f"{index}-{_uuid.uuid4().hex[:8]}")
+            open(path, "w").close()
 
     def attempts(self, index: int) -> int:
+        import os
+
         with self._lock:
-            return self._attempts.get(index, 0)
+            try:
+                return sum(1 for f in os.listdir(self._attempt_dir)
+                           if f.startswith(f"{index}-"))
+            except OSError:
+                return 0
+
+    # Task fragments cross process boundaries on daemon workers; the lock
+    # is per-process state (attempt counters then live on the worker).
+    def __getstate__(self):
+        state = self.__dict__.copy()
+        del state["_lock"]
+        return state
+
+    def __setstate__(self, state):
+        self.__dict__.update(state)
+        self._lock = threading.Lock()

@@ -40,12 +40,15 @@ def _read_bytes(v):
         return f.read()
 
 
-def _parse_wav(data: bytes):
+def _parse_wav(data: bytes, with_offset: bool = False):
+    """RIFF chunk walk; with_offset also returns the data payload offset
+    (never substring-search for b"data" — comment chunks may contain it)."""
     if len(data) < 44 or data[:4] != b"RIFF" or data[8:12] != b"WAVE":
         return None
     pos = 12
     fmt = None
     frames = 0
+    data_off = None
     while pos + 8 <= len(data):
         cid = data[pos:pos + 4]
         (size,) = struct.unpack("<I", data[pos + 4:pos + 8])
@@ -55,12 +58,14 @@ def _parse_wav(data: bytes):
             fmt = (channels, rate, block_align)
         elif cid == b"data" and fmt is not None:
             frames = size // max(fmt[2], 1)
+            data_off = pos + 8
         pos += 8 + size + (size & 1)
     if fmt is None:
         return None
     channels, rate, _ = fmt
-    return {"sample_rate": rate, "channels": channels, "frames": frames,
+    meta = {"sample_rate": rate, "channels": channels, "frames": frames,
             "duration_sec": frames / rate if rate else 0.0, "format": "wav"}
+    return (meta, data_off) if with_offset else meta
 
 
 @register_kernel("audio_metadata", lambda f, k: Field(f[0].name, _AUDIO_META))
@@ -91,11 +96,11 @@ def _audio_resample(args, target_rate: int = 16000, **kwargs):
             return None
         if isinstance(v, (bytes, bytearray, str)):
             data = _read_bytes(v)
-            meta = _parse_wav(data)
-            if meta is None:
+            parsed = _parse_wav(data, with_offset=True)
+            if parsed is None or parsed[1] is None:
                 raise DaftValueError("audio_resample: not a WAV payload")
-            idx = data.find(b"data")
-            pcm = np.frombuffer(data, np.int16, offset=idx + 8,
+            meta, data_off = parsed
+            pcm = np.frombuffer(data, np.int16, offset=data_off,
                                 count=meta["frames"] * meta["channels"])
             samples = pcm.astype(np.float32).reshape(-1, meta["channels"]).mean(1) / 32768.0
             rate = meta["sample_rate"]

@@ -281,8 +281,13 @@ def _make_date(args, **kwargs):
     return Series.from_pylist(out, args[0].name, DataType.date())
 
 
-@register_kernel("replace_time_zone", lambda f, k: Field(
-    f[0].name, DataType.timestamp("us", k.get("timezone"))))
+def _tz_resolver(fields, kwargs):
+    dt = fields[0].dtype
+    unit = dt.timeunit if dt.id == TypeId.TIMESTAMP else "us"
+    return Field(fields[0].name, DataType.timestamp(unit, kwargs.get("timezone")))
+
+
+@register_kernel("replace_time_zone", _tz_resolver)
 def _replace_time_zone(args, timezone=None, **kwargs):
     arr = args[0].to_arrow()
     if not pa.types.is_timestamp(arr.type):
@@ -299,8 +304,7 @@ def _replace_time_zone(args, timezone=None, **kwargs):
     return _wrap(out, args[0].name, DataType.timestamp(arr.type.unit, timezone))
 
 
-@register_kernel("convert_time_zone", lambda f, k: Field(
-    f[0].name, DataType.timestamp("us", k.get("timezone"))))
+@register_kernel("convert_time_zone", _tz_resolver)
 def _convert_time_zone(args, timezone="UTC", **kwargs):
     arr = args[0].to_arrow()
     if not pa.types.is_timestamp(arr.type):

@@ -32,7 +32,46 @@ class DistributedRunner(Runner):
             self.manager = manager
             return
         backend = backend or os.environ.get("DAFT_WORKER_BACKEND", "thread")
+        addresses = os.environ.get("DAFT_WORKER_ADDRESSES")
         n = num_workers or cfg.num_workers or int(os.environ.get("DAFT_NUM_WORKERS", "2"))
+        if addresses or backend == "daemon":
+            # Multi-host daemons reachable over TCP + Flight (reference: the
+            # Ray-actor control plane in daft/runners/flotilla.py:139-290).
+            from daft_tpu.distributed.daemon import (
+                RemoteWorker,
+                spawn_local_daemon,
+                wait_for_daemon,
+            )
+
+            addrs = [a.strip() for a in (addresses or "").split(",") if a.strip()]
+            self._daemon_procs = []
+            try:
+                if not addrs:
+                    # No cluster given: spawn a local one (dev/CI convenience).
+                    self._daemon_procs = [spawn_local_daemon(slots=slots_per_worker)
+                                          for _ in range(n)]
+                    addrs = [wait_for_daemon(p) for p in self._daemon_procs]
+                workers = [RemoteWorker(a) for a in addrs]
+            except BaseException:
+                for p in self._daemon_procs:  # don't leak half-started daemons
+                    try:
+                        p.kill()
+                    except Exception:
+                        pass
+                raise
+            procs = self._daemon_procs
+
+            class _DaemonManager(WorkerManager):
+                def shutdown(self) -> None:
+                    super().shutdown()
+                    for p in procs:
+                        try:
+                            p.kill()
+                        except Exception:
+                            pass
+
+            self.manager = _DaemonManager(workers)
+            return
         if backend == "process":
             # True process isolation (reference: per-node Ray actors; on TPU
             # hosts, one process per chip — libtpu single-owner).

@@ -10,9 +10,11 @@ from daft_tpu.expressions.expression import Expression, col
 
 
 class Window:
-    unbounded_preceding = object()
-    unbounded_following = object()
-    current_row = object()
+    # String sentinels: must keep identity across process boundaries (a
+    # pickled object() sentinel is a different instance on the worker).
+    unbounded_preceding = "__unbounded_preceding__"
+    unbounded_following = "__unbounded_following__"
+    current_row = "__current_row__"
 
     def __init__(self):
         self._partition_by: List[Expression] = []

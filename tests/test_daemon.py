@@ -1,0 +1,147 @@
+"""Multi-host worker daemon tests: TCP control plane + Flight data plane.
+
+Reference: the reference's distributed tests run the full scheduler /
+dispatcher / plan lifecycle against in-process workers
+(src/daft-distributed/src/scheduling/local_worker.rs) and against real Ray
+actors (tests/ray). Here daemons are REAL separate processes reachable only
+via TCP + Flight — cross-host addressing, ref serialization between
+machines, and partial-cluster failure all exercised on localhost.
+"""
+
+import numpy as np
+import pytest
+
+import daft_tpu
+from daft_tpu import col
+from daft_tpu.distributed.daemon import (
+    RemoteWorker,
+    spawn_local_daemon,
+    wait_for_daemon,
+)
+from daft_tpu.distributed.worker import WorkerManager
+from daft_tpu.runners.distributed import DistributedRunner
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    procs = [spawn_local_daemon(slots=2) for _ in range(3)]
+    addrs = [wait_for_daemon(p) for p in procs]
+    yield procs, addrs
+    for p in procs:
+        p.kill()
+
+
+@pytest.fixture
+def daemon_runner(cluster):
+    procs, addrs = cluster
+    workers = [RemoteWorker(a) for a in addrs]
+    mgr = WorkerManager(workers)
+    runner = DistributedRunner(manager=mgr)
+    ctx = daft_tpu.get_context()
+    old = ctx._runner
+    ctx.set_runner(runner)
+    yield runner
+    ctx.set_runner(old)
+
+
+def test_daemon_two_host_shuffle_query(daemon_runner):
+    """A grouped aggregation whose map outputs live on one daemon and whose
+    reduce tasks run on another — inputs cross hosts via Flight refs."""
+    n = 5000
+    df = daft_tpu.from_pydict({"k": list(range(n)), "g": [i % 11 for i in range(n)]})
+    out = (df.into_partitions(6).groupby("g")
+             .agg(col("k").sum().alias("s"), col("k").count().alias("c"))
+             .sort("g").to_pydict())
+    expect = [sum(i for i in range(n) if i % 11 == g) for g in range(11)]
+    assert out["s"] == expect
+    assert sum(out["c"]) == n
+
+
+def test_daemon_join_and_write(daemon_runner, tmp_path):
+    df = daft_tpu.from_pydict({"k": list(range(500)), "g": [i % 5 for i in range(500)]})
+    names = daft_tpu.from_pydict({"g": list(range(5)), "nm": list("abcde")})
+    j = df.into_partitions(4).join(names, on="g")
+    j.write_parquet(str(tmp_path / "out"))
+    back = daft_tpu.read_parquet(str(tmp_path / "out"))
+    assert back.count_rows() == 500
+    assert set(back.select("nm").distinct().to_pydict()["nm"]) == set("abcde")
+
+
+def test_daemon_worker_died_rescheduling(cluster):
+    """Kill one daemon mid-stream: the dispatcher must mark it dead and
+    reschedule its tasks on the survivors (reference: dispatcher.rs
+    WorkerDied handling)."""
+    procs, addrs = cluster
+    spare = [spawn_local_daemon(slots=2) for _ in range(2)]
+    try:
+        spare_addrs = [wait_for_daemon(p) for p in spare]
+        workers = [RemoteWorker(a) for a in spare_addrs]
+        mgr = WorkerManager(workers)
+        runner = DistributedRunner(manager=mgr)
+        ctx = daft_tpu.get_context()
+        old = ctx._runner
+        ctx.set_runner(runner)
+        try:
+            # Kill one of the two daemons; the query must still complete.
+            workers[0].kill()
+            import time
+
+            time.sleep(0.3)
+            df = daft_tpu.from_pydict({"x": list(range(2000))})
+            out = df.into_partitions(8).agg(col("x").sum().alias("s")).to_pydict()
+            assert out["s"] == [sum(range(2000))]
+            assert len(mgr.workers()) >= 1
+        finally:
+            ctx.set_runner(old)
+            mgr.shutdown()
+    finally:
+        for p in spare:
+            p.kill()
+
+
+def test_daemon_refs_are_remote(cluster):
+    """Task outputs stay on the worker as Flight refs; the driver only pulls
+    when fetching results."""
+    procs, addrs = cluster
+    from daft_tpu.distributed.daemon import encode_ref
+    from daft_tpu.distributed.partition_ref import FlightPartitionRef
+    from daft_tpu.distributed.task import Task
+    from daft_tpu.physical import plan as pp
+    from daft_tpu.micropartition import MicroPartition
+
+    w = RemoteWorker(addrs[0])
+    mp = MicroPartition.from_pydict({"a": [1, 2, 3]})
+    frag = pp.InMemorySource([mp], mp.schema)
+    refs = w.submit(Task(frag, [], partition_idx=0)).result()
+    assert all(isinstance(r, FlightPartitionRef) for r in refs)
+    assert refs[0].worker_id == w.worker_id
+    fetched = refs[0].fetch()
+    assert fetched.to_pydict()["a"] == [1, 2, 3]
+    # a second daemon can consume the first daemon's ref directly
+    w2 = RemoteWorker(addrs[1])
+    from daft_tpu.distributed.task import BoundInput
+
+    frag2 = pp.InMemorySource([mp], mp.schema)  # placeholder; use BoundInput path
+    t = Task(_identity_fragment(mp.schema), [list(refs)], partition_idx=0)
+    out = w2.submit(t).result()
+    assert out[0].fetch().to_pydict()["a"] == [1, 2, 3]
+
+
+def _identity_fragment(schema):
+    from daft_tpu.distributed.task import BoundInput
+
+    return BoundInput(0, schema)
+
+
+def test_daemon_autospawn_backend(monkeypatch):
+    """DAFT_WORKER_BACKEND=daemon with no addresses spawns a local cluster."""
+    runner = DistributedRunner(num_workers=2, backend="daemon")
+    ctx = daft_tpu.get_context()
+    old = ctx._runner
+    ctx.set_runner(runner)
+    try:
+        df = daft_tpu.from_pydict({"x": [1, 2, 3, 4]})
+        assert df.into_partitions(2).agg(col("x").sum().alias("s")).to_pydict()["s"] == [10]
+    finally:
+        ctx.set_runner(old)
+        runner.manager.shutdown()
