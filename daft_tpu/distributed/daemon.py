@@ -115,7 +115,7 @@ class WorkerDaemon:
         self.cache = ShuffleCache(data_dir or tempfile.mkdtemp(prefix="daft_daemon_"))
         self.flight = ShuffleFlightServer(self.cache)
         self.advertise_host = advertise_host or os.environ.get(
-            "DAFT_ADVERTISE_HOST", "localhost")
+            "DAFT_ADVERTISE_HOST") or socket.gethostname()
         self._pool = ThreadPoolExecutor(max_workers=slots,
                                         thread_name_prefix=f"{self.worker_id}-task")
         self._server = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
@@ -249,7 +249,10 @@ class RemoteWorker(Worker):
         try:
             with socket.create_connection((self._host, self._port),
                                           timeout=timeout) as sock:
-                sock.settimeout(None)
+                # run_task legitimately waits unbounded; control ops
+                # (ping/shutdown/die) keep the caller's timeout on recv too.
+                if msg.get("op") == "run_task":
+                    sock.settimeout(None)
                 _send_frame(sock, cloudpickle.dumps(msg))
                 reply = cloudpickle.loads(_recv_frame(sock))
         except (OSError, EOFError, ConnectionError) as e:
@@ -312,7 +315,8 @@ class RemoteWorker(Worker):
 # Spawning helpers (single-machine clusters for tests / dev)           #
 # ------------------------------------------------------------------ #
 def spawn_local_daemon(port: int = 0, slots: int = 2,
-                       jax_platforms: Optional[str] = None) -> "subprocess.Popen":
+                       jax_platforms: Optional[str] = None,
+                       fault_injection: bool = False) -> "subprocess.Popen":
     """Launch a daemon subprocess on localhost; returns the Popen. The port
     is written to stdout line 1 (`PORT <n>`) when 0 is requested."""
     repo_root = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
@@ -332,10 +336,12 @@ def spawn_local_daemon(port: int = 0, slots: int = 2,
             pass
     if jax_platforms:
         env["DAFT_CHILD_JAX_PLATFORMS"] = jax_platforms
-    env["DAFT_DAEMON_ALLOW_FAULT_INJECTION"] = "1"
+    if fault_injection:
+        env["DAFT_DAEMON_ALLOW_FAULT_INJECTION"] = "1"
     return subprocess.Popen(
         [sys.executable, "-m", "daft_tpu.distributed.daemon",
-         "--port", str(port), "--slots", str(slots)],
+         "--port", str(port), "--slots", str(slots),
+         "--advertise-host", "localhost"],
         env=env, stdout=subprocess.PIPE, text=True,
     )
 
@@ -376,6 +382,10 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
     parser.add_argument("--slots", type=int, default=2)
     parser.add_argument("--data-dir", default=None)
     parser.add_argument("--host", default="0.0.0.0")
+    parser.add_argument("--advertise-host", default=None,
+                        help="hostname other workers use to fetch this "
+                             "daemon's partitions over Flight (default: "
+                             "$DAFT_ADVERTISE_HOST or gethostname())")
     args = parser.parse_args(argv)
 
     platforms = os.environ.get("DAFT_CHILD_JAX_PLATFORMS")
@@ -385,8 +395,14 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
         jax.config.update("jax_platforms", platforms)
 
     daemon = WorkerDaemon(port=args.port, slots=args.slots, data_dir=args.data_dir,
-                          host=args.host)
+                          host=args.host, advertise_host=args.advertise_host)
     print(f"PORT {daemon.port}", flush=True)
+    # Re-point stdout at stderr: the spawner reads only the PORT line from
+    # the stdout pipe, and unread task print()s would fill it and deadlock.
+    try:
+        os.dup2(sys.stderr.fileno(), sys.stdout.fileno())
+    except OSError:
+        pass
     daemon.serve_forever()
 
 

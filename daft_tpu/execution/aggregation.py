@@ -113,13 +113,24 @@ class TwoPhasePlan:
                 denom = FunctionCall("pow_3_2", [m2])
                 return BinaryOp("truediv", m3, denom)
             if op == "approx_percentile":
-                l = add("l", AggOp("list", Cast(child, DataType.float64())), "concat")
-                return FunctionCall("list_quantile", [l], {"percentiles": agg.kwargs.get("percentiles")})
+                # Bounded-memory two-phase: DDSketch partials merged in
+                # sketch space (reference: src/daft-sketch).
+                sk = add("sk", AggOp("dd_sketch", Cast(child, DataType.float64())),
+                         "dd_merge")
+                return FunctionCall("dd_quantile", [sk],
+                                    {"percentiles": agg.kwargs.get("percentiles")})
             if op == "udaf":
-                # Exact for any UDAF: collect -> concat -> apply. Incremental
-                # partial states are a later optimisation.
+                u = agg.kwargs["udaf"]
+                if u.supports_partial():
+                    # Incremental two-phase: accumulate per partition, merge
+                    # states, finalize once — bounded memory per group
+                    # (reference: daft/udf/udaf.py partial aggregation).
+                    st = add("st", AggOp("udaf_partial", child, {"udaf": u}),
+                             "udaf_merge", {"udaf": u})
+                    return FunctionCall("udaf_finalize", [st], {"udaf": u})
+                # Exact fallback for function UDAFs: collect -> concat -> apply.
                 l = add("l", AggOp("list", child), "concat")
-                return FunctionCall("udaf_apply", [l], {"udaf": agg.kwargs["udaf"]})
+                return FunctionCall("udaf_apply", [l], {"udaf": u})
             raise DaftValueError(f"Cannot decompose agg op {op}")
 
         self.final_exprs: List[Expr] = []

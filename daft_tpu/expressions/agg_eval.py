@@ -209,7 +209,41 @@ def _global_agg(child: Series, agg: AggOp) -> Series:
     if op == "approx_count_distinct":
         return child.approx_count_distinct()
     if op == "approx_percentile":
-        return child.approx_percentile(agg.kwargs["percentiles"])
+        # DDSketch-backed so native and distributed answers agree
+        # (reference: src/daft-sketch DDSketch for approx percentiles).
+        from daft_tpu.kernels.sketches import DDSketch
+
+        sk = DDSketch.from_series(child.cast(DataType.float64()))
+        q = agg.kwargs["percentiles"]
+        if isinstance(q, (list, tuple)):
+            out = [[sk.quantile(float(x)) for x in q]] if sk.count else [None]
+            return Series.from_pylist(out, child.name,
+                                      DataType.list(DataType.float64()))
+        return Series.from_pylist([sk.quantile(float(q))], child.name,
+                                  DataType.float64())
+    if op == "dd_sketch":
+        from daft_tpu.kernels.sketches import DDSketch
+
+        sk = DDSketch.from_series(child.cast(DataType.float64()))
+        return Series.from_pylist([sk.to_bytes()], child.name, DataType.binary())
+    if op == "dd_merge":
+        from daft_tpu.kernels.sketches import DDSketch
+
+        blobs = [b for b in child.to_pylist() if b is not None]
+        sk = DDSketch.from_bytes(blobs[0]) if blobs else DDSketch()
+        for b in blobs[1:]:
+            sk = sk.merge(DDSketch.from_bytes(b))
+        return Series.from_pylist([sk.to_bytes()], child.name, DataType.binary())
+    if op == "udaf_partial":
+        u = agg.kwargs["udaf"]
+        vals = [v for v in child.to_pylist() if v is not None]
+        return Series.from_pylist([u.partial_state(vals)], child.name,
+                                  DataType.binary())
+    if op == "udaf_merge":
+        u = agg.kwargs["udaf"]
+        blobs = [b for b in child.to_pylist() if b is not None]
+        return Series.from_pylist([u.merge_states(blobs)], child.name,
+                                  DataType.binary())
     if op == "udaf":
         udaf_obj = agg.kwargs["udaf"]
         vals = [v for v in child.to_pylist() if v is not None]

@@ -459,6 +459,7 @@ class AggOp(Expr):
         "list", "concat", "stddev", "variance", "skew", "approx_count_distinct",
         "approx_percentile", "bool_and", "bool_or", "udaf",
         "product", "median", "string_agg",
+        "dd_sketch", "dd_merge", "udaf_partial", "udaf_merge",
     }
 
     __slots__ = ("op", "child", "kwargs")
@@ -508,6 +509,8 @@ class AggOp(Expr):
             return f.with_dtype(DataType.float64())
         if op == "udaf":
             return f.with_dtype(self.kwargs["udaf"].return_dtype)
+        if op in ("dd_sketch", "dd_merge", "udaf_partial", "udaf_merge"):
+            return f.with_dtype(DataType.binary())
         raise DaftValueError(op)
 
     def _attrs_key(self) -> tuple:

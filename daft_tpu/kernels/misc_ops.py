@@ -202,3 +202,35 @@ def _great_circle_distance(args, radius: float = 6371000.0, **kwargs):
         out = 2.0 * radius * np.arcsin(np.sqrt(np.clip(h, 0.0, 1.0)))
     out = np.where(mask, 0.0, out) if mask.any() else out
     return Series.from_numpy(out, args[0].name)._with_mask(mask if mask.any() else None)
+
+
+@register_kernel("dd_quantile", lambda f, k: Field(
+    f[0].name,
+    DataType.list(DataType.float64())
+    if isinstance(k.get("percentiles"), (list, tuple)) else DataType.float64()))
+def _dd_quantile(args, percentiles=0.5, **kwargs):
+    """Finalize DDSketch two-phase approx_percentile (reference: daft-sketch)."""
+    from daft_tpu.kernels.sketches import DDSketch
+
+    multi = isinstance(percentiles, (list, tuple))
+    out = []
+    for blob in args[0].to_pylist():
+        if blob is None:
+            out.append(None)
+            continue
+        sk = DDSketch.from_bytes(bytes(blob))
+        if multi:
+            out.append([sk.quantile(float(q)) for q in percentiles]
+                       if sk.count else None)
+        else:
+            out.append(sk.quantile(float(percentiles)))
+    dt = DataType.list(DataType.float64()) if multi else DataType.float64()
+    return Series.from_pylist(out, args[0].name, dt)
+
+
+@register_kernel("udaf_finalize", lambda f, k: Field(
+    f[0].name, k["udaf"].return_dtype))
+def _udaf_finalize(args, udaf=None, **kwargs):
+    out = [None if blob is None else udaf.finalize_state(bytes(blob))
+           for blob in args[0].to_pylist()]
+    return Series.from_pylist(out, args[0].name, udaf.return_dtype)

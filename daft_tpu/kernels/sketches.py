@@ -106,3 +106,104 @@ class MergeableQuantileSketch:
         if len(self.values) == 0:
             return None
         return float(np.quantile(self.values, q))
+
+
+class DDSketch:
+    """DDSketch: quantile sketch with relative-error guarantee alpha.
+
+    Reference: src/daft-sketch (DDSketch serde for approx percentiles) and
+    the DDSketch paper (Masson et al., VLDB'19). Values map to logarithmic
+    buckets i = ceil(log_gamma(|x|)) with gamma = (1+a)/(1-a); any quantile
+    read back from bucket midpoints has relative error <= a. Merging is
+    bucket-wise addition, so distributed two-phase aggregation is exact in
+    sketch space (vectorised numpy; buckets stored sparsely).
+    """
+
+    def __init__(self, alpha: float = 0.01):
+        self.alpha = alpha
+        self.gamma = (1.0 + alpha) / (1.0 - alpha)
+        self._log_gamma = np.log(self.gamma)
+        self.pos: dict = {}   # bucket index -> count (x > 0)
+        self.neg: dict = {}   # bucket index -> count (x < 0), indexed on |x|
+        self.zeros = 0
+        self.count = 0
+
+    # -- build ----------------------------------------------------------- #
+    def add_array(self, values: np.ndarray) -> "DDSketch":
+        v = np.asarray(values, dtype=np.float64)
+        v = v[~np.isnan(v)]
+        self.count += len(v)
+        self.zeros += int((v == 0).sum())
+        for store, sel in ((self.pos, v[v > 0]), (self.neg, -v[v < 0])):
+            if len(sel) == 0:
+                continue
+            idx = np.ceil(np.log(sel) / self._log_gamma).astype(np.int64)
+            uniq, counts = np.unique(idx, return_counts=True)
+            for i, c in zip(uniq, counts):
+                store[int(i)] = store.get(int(i), 0) + int(c)
+        return self
+
+    @staticmethod
+    def from_series(series, alpha: float = 0.01) -> "DDSketch":
+        vals = series.drop_null().to_numpy().astype(np.float64)
+        return DDSketch(alpha).add_array(vals)
+
+    # -- merge ----------------------------------------------------------- #
+    def merge(self, other: "DDSketch") -> "DDSketch":
+        assert abs(self.alpha - other.alpha) < 1e-12, "alpha mismatch"
+        out = DDSketch(self.alpha)
+        for store_name in ("pos", "neg"):
+            a = getattr(self, store_name)
+            b = getattr(other, store_name)
+            merged = dict(a)
+            for k, c in b.items():
+                merged[k] = merged.get(k, 0) + c
+            setattr(out, store_name, merged)
+        out.zeros = self.zeros + other.zeros
+        out.count = self.count + other.count
+        return out
+
+    # -- read ------------------------------------------------------------ #
+    def quantile(self, q: float):
+        if self.count == 0:
+            return None
+        rank = q * (self.count - 1)
+        # Walk: negatives (descending |x|), zeros, positives (ascending).
+        acc = 0
+        for i in sorted(self.neg, reverse=True):
+            acc += self.neg[i]
+            if acc > rank:
+                return -self._bucket_mid(i)
+        if self.zeros and acc + self.zeros > rank:
+            return 0.0
+        acc += self.zeros
+        for i in sorted(self.pos):
+            acc += self.pos[i]
+            if acc > rank:
+                return self._bucket_mid(i)
+        # numeric edge: return max bucket
+        store = self.pos or self.neg
+        i = max(store) if store is self.pos else min(store)
+        return self._bucket_mid(i) if store is self.pos else -self._bucket_mid(i)
+
+    def _bucket_mid(self, i: int) -> float:
+        return 2.0 * self.gamma ** i / (self.gamma + 1.0)
+
+    # -- serde (the two-phase agg wire format) --------------------------- #
+    def to_bytes(self) -> bytes:
+        import pickle
+
+        return pickle.dumps({
+            "alpha": self.alpha, "pos": self.pos, "neg": self.neg,
+            "zeros": self.zeros, "count": self.count,
+        })
+
+    @staticmethod
+    def from_bytes(data: bytes) -> "DDSketch":
+        import pickle
+
+        d = pickle.loads(data)
+        sk = DDSketch(d["alpha"])
+        sk.pos, sk.neg = d["pos"], d["neg"]
+        sk.zeros, sk.count = d["zeros"], d["count"]
+        return sk

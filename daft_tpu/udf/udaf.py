@@ -3,13 +3,13 @@
 Reference: daft/udf/udaf.py — UDAFs aggregate a column per group. Two forms:
 
 * a plain function ``fn(values: list) -> scalar``;
-* a class with ``accumulate(values) / finalize()`` (``merge(other)`` is
-  reserved for a future incremental-partial path; today the engine collects
-  then applies, which is exact for any UDAF).
+* a class with ``accumulate(values) / finalize()``; adding ``merge(other)``
+  opts into INCREMENTAL two-phase aggregation: each partition accumulates
+  into its own instance, states merge pairwise, finalize runs once —
+  bounded memory per group, no collect-all.
 
-Distributed execution routes UDAFs through the two-phase planner as
-list-collect → concat → apply, which is semantically exact for any UDAF
-(incremental partial states are a later optimisation).
+Function UDAFs (no merge) fall back to list-collect → concat → apply,
+which stays exact for arbitrary functions.
 """
 
 from __future__ import annotations
@@ -33,6 +33,31 @@ class Udaf:
             inst.accumulate(values)
             return inst.finalize()
         return target(values)
+
+    def supports_partial(self) -> bool:
+        return isinstance(self.fn_or_cls, type) and hasattr(self.fn_or_cls, "merge")
+
+    def partial_state(self, values: list) -> bytes:
+        import cloudpickle
+
+        inst = self.fn_or_cls()
+        inst.accumulate(values)
+        return cloudpickle.dumps(inst)
+
+    def merge_states(self, blobs: list) -> bytes:
+        import cloudpickle
+
+        if not blobs:
+            return self.partial_state([])
+        inst = cloudpickle.loads(blobs[0])
+        for b in blobs[1:]:
+            inst.merge(cloudpickle.loads(b))
+        return cloudpickle.dumps(inst)
+
+    def finalize_state(self, blob: bytes) -> Any:
+        import cloudpickle
+
+        return cloudpickle.loads(blob).finalize()
 
     def __call__(self, expr) -> "Expression":
         from daft_tpu.expressions.expr import AggOp, ensure_expr

@@ -24,7 +24,7 @@ from daft_tpu.runners.distributed import DistributedRunner
 
 @pytest.fixture(scope="module")
 def cluster():
-    procs = [spawn_local_daemon(slots=2) for _ in range(3)]
+    procs = [spawn_local_daemon(slots=2, fault_injection=True) for _ in range(3)]
     addrs = [wait_for_daemon(p) for p in procs]
     yield procs, addrs
     for p in procs:
@@ -72,7 +72,7 @@ def test_daemon_worker_died_rescheduling(cluster):
     reschedule its tasks on the survivors (reference: dispatcher.rs
     WorkerDied handling)."""
     procs, addrs = cluster
-    spare = [spawn_local_daemon(slots=2) for _ in range(2)]
+    spare = [spawn_local_daemon(slots=2, fault_injection=True) for _ in range(2)]
     try:
         spare_addrs = [wait_for_daemon(p) for p in spare]
         workers = [RemoteWorker(a) for a in spare_addrs]
@@ -119,9 +119,6 @@ def test_daemon_refs_are_remote(cluster):
     assert fetched.to_pydict()["a"] == [1, 2, 3]
     # a second daemon can consume the first daemon's ref directly
     w2 = RemoteWorker(addrs[1])
-    from daft_tpu.distributed.task import BoundInput
-
-    frag2 = pp.InMemorySource([mp], mp.schema)  # placeholder; use BoundInput path
     t = Task(_identity_fragment(mp.schema), [list(refs)], partition_idx=0)
     out = w2.submit(t).result()
     assert out[0].fetch().to_pydict()["a"] == [1, 2, 3]
