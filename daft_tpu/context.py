@@ -57,6 +57,9 @@ class DaftContext:
         return list(self._subscribers)
 
     def notify(self, event) -> None:
+        from daft_tpu.tracing import maybe_enable_tracing
+
+        maybe_enable_tracing(self)
         for s in self.subscribers():
             try:
                 s.on_event(event)

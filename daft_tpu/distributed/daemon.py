@@ -190,10 +190,15 @@ class WorkerDaemon:
         try:
             from daft_tpu.execution.executor import Executor
 
+            from daft_tpu.execution.resource_manager import RuntimeStats
+
             fragment = msg["fragment"]
             inputs = [[decode_ref(d) for d in slot] for slot in msg["inputs"]]
             bound = bind_task_fragment(fragment, inputs)
-            executor = Executor(msg["cfg"], partition_offset=msg["partition_idx"])
+            stats = RuntimeStats(msg.get("query_id", ""))
+            stats.local_flush = False  # shipped back in the reply instead
+            executor = Executor(msg["cfg"], partition_offset=msg["partition_idx"],
+                                stats=stats)
             out = list(executor.run(bound))
             parts = collect_task_outputs(out, msg["expect_outputs"], fragment.schema)
             refs = []
@@ -203,7 +208,7 @@ class WorkerDaemon:
                 refs.append({"kind": "flight", "address": self.flight_address,
                              "ticket": ticket, "rows": len(p),
                              "bytes": p.size_bytes(), "worker_id": self.worker_id})
-            return {"ok": True, "refs": refs}
+            return {"ok": True, "refs": refs, "stats": stats.to_wire()}
         except BaseException as e:  # noqa: BLE001
             import traceback
 
@@ -276,8 +281,15 @@ class RemoteWorker(Worker):
                     "inputs": [[encode_ref(r) for r in slot] for slot in task.inputs],
                     "partition_idx": task.partition_idx,
                     "expect_outputs": task.expect_outputs,
+                    "query_id": task.query_id,
                 }
                 reply = self._request(payload)
+                # Worker-side operator stats stream back with the reply and
+                # re-emit on the driver (reference: the remote event-log sink
+                # forwarding worker events, daft/runners/flotilla.py:171-176).
+                from daft_tpu.execution.resource_manager import emit_operator_stats
+
+                emit_operator_stats(task.query_id, reply.get("stats"))
                 return [decode_ref(d) for d in reply["refs"]]
             finally:
                 with self._lock:

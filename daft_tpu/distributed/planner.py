@@ -39,9 +39,10 @@ _NARROW = (pp.Project, pp.UDFProject, pp.Filter, pp.Explode, pp.Unpivot,
 
 
 class DistributedExecutor:
-    def __init__(self, manager: WorkerManager, cfg):
+    def __init__(self, manager: WorkerManager, cfg, query_id: str = ""):
         self.manager = manager
         self.cfg = cfg
+        self.query_id = query_id
         self.scheduler = Scheduler(manager, cfg.autoscaling_threshold)
         self.dispatcher = Dispatcher(self.scheduler)
 
@@ -50,6 +51,8 @@ class DistributedExecutor:
         return self._run(plan)
 
     def _dispatch(self, tasks: Sequence[Task]) -> List[List[PartitionRef]]:
+        for t in tasks:
+            t.query_id = self.query_id
         return self.dispatcher.run_tasks(tasks)
 
     def _chain_over(self, chain: List[pp.PhysicalPlan], leaf: pp.PhysicalPlan) -> pp.PhysicalPlan:

@@ -89,11 +89,17 @@ def _worker_entry(fd: int) -> None:
             ]
             expect = payload["expect_outputs"]
             bound = bind_task_fragment(fragment, inputs)
-            executor = Executor(cfg, partition_offset=payload["partition_idx"])
+            from daft_tpu.execution.resource_manager import RuntimeStats
+
+            stats = RuntimeStats(payload.get("query_id", ""))
+            stats.local_flush = False  # shipped back in the reply instead
+            executor = Executor(cfg, partition_offset=payload["partition_idx"],
+                                stats=stats)
             out = list(executor.run(bound))
             parts = collect_task_outputs(out, expect, fragment.schema)
             blobs = [serialize_partition(p) for p in parts]
-            _send_frame(sock, cloudpickle.dumps({"ok": True, "parts": blobs}))
+            _send_frame(sock, cloudpickle.dumps(
+                {"ok": True, "parts": blobs, "stats": stats.to_wire()}))
         except BaseException as e:  # noqa: BLE001
             import traceback
 
@@ -169,6 +175,7 @@ class ProcessWorker(Worker):
                         ],
                         "partition_idx": task.partition_idx,
                         "expect_outputs": task.expect_outputs,
+                        "query_id": task.query_id,
                     }
                     try:
                         _send_frame(self._sock, cloudpickle.dumps(payload))
@@ -180,6 +187,11 @@ class ProcessWorker(Worker):
                     result = cloudpickle.loads(msg)
                     if not result["ok"]:
                         raise RuntimeError(result["error"])
+                    from daft_tpu.execution.resource_manager import (
+                        emit_operator_stats,
+                    )
+
+                    emit_operator_stats(task.query_id, result.get("stats"))
                     return [
                         LocalPartitionRef(deserialize_partition(blob), self.worker_id)
                         for blob in result["parts"]

@@ -62,9 +62,15 @@ class Dispatcher:
         self.max_inflight = max_inflight
 
     def run_tasks(self, tasks: Sequence[Task]) -> List[List[PartitionRef]]:
+        import time
+
+        from daft_tpu.context import get_context
+        from daft_tpu.subscribers.events import TaskCompleted, TaskScheduled
+
+        notify = get_context().notify
         results: Dict[int, List[PartitionRef]] = {}
         pending: List[Tuple[int, Task, int]] = [(i, t, 0) for i, t in enumerate(tasks)]
-        inflight: Dict[Future, Tuple[int, Task, int, Worker]] = {}
+        inflight: Dict[Future, Tuple[int, Task, int, Worker, float]] = {}
         limit = self.max_inflight or max(self.scheduler.manager.total_slots(), 1)
         self.scheduler.request_autoscale(len(pending))
         failure: Optional[BaseException] = None
@@ -72,16 +78,20 @@ class Dispatcher:
             while pending and len(inflight) < limit:
                 idx, task, attempt = pending.pop(0)
                 worker = self.scheduler.assign(task)
+                notify(TaskScheduled(query_id=task.query_id, task_id=task.task_id,
+                                     worker_id=worker.worker_id))
                 fut = worker.submit(task)
-                inflight[fut] = (idx, task, attempt, worker)
+                inflight[fut] = (idx, task, attempt, worker, time.perf_counter())
             done, _ = wait(list(inflight.keys()), return_when=FIRST_COMPLETED)
             for fut in done:
-                idx, task, attempt, worker = inflight.pop(fut)
+                idx, task, attempt, worker, t0 = inflight.pop(fut)
+                err: Optional[str] = None
                 try:
                     results[idx] = fut.result()
-                except WorkerDiedError:
+                except WorkerDiedError as e:
                     # Mark dead and reschedule elsewhere (reference
                     # dispatcher.rs:100-140 WorkerDied handling).
+                    err = str(e)
                     self.scheduler.manager.mark_dead(worker.worker_id)
                     if attempt + 1 >= self.MAX_TASK_RETRIES:
                         failure = DaftExecutionError(
@@ -90,8 +100,13 @@ class Dispatcher:
                     else:
                         pending.append((idx, task, attempt + 1))
                 except Exception as e:  # noqa: BLE001
+                    err = str(e)
                     failure = DaftExecutionError(f"Task {task.task_id} failed: {e}")
                     failure.__cause__ = e
+                notify(TaskCompleted(
+                    query_id=task.query_id, task_id=task.task_id,
+                    worker_id=worker.worker_id,
+                    duration_s=time.perf_counter() - t0, error=err))
             if failure is not None:
                 # Abort cleanly: stop submitting, drain in-flight work so no
                 # task keeps mutating state (writes!) after the raise.

@@ -42,6 +42,7 @@ class Task:
     inputs: List[List[PartitionRef]] = field(default_factory=list)
     strategy: SchedulingStrategy = field(default_factory=SchedulingStrategy.spread)
     task_id: str = field(default_factory=lambda: f"task-{next(_task_counter)}")
+    query_id: str = ""
     partition_idx: int = 0
     # Shuffle-map tasks yield one output partition per shuffle bucket; the
     # worker must preserve them instead of concatenating (expect_outputs > 1).

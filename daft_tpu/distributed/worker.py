@@ -101,9 +101,11 @@ class LocalWorker(Worker):
                 if self._dead:
                     raise WorkerDiedError(f"worker {self.worker_id} is dead")
                 from daft_tpu.execution.executor import Executor
+                from daft_tpu.execution.resource_manager import RuntimeStats
 
                 bound = bind_task_fragment(task.fragment, task.inputs)
-                executor = Executor(self.cfg, partition_offset=task.partition_idx)
+                executor = Executor(self.cfg, partition_offset=task.partition_idx,
+                                    stats=RuntimeStats(task.query_id))
                 out = list(executor.run(bound))
                 parts = collect_task_outputs(out, task.expect_outputs, task.fragment.schema)
                 return [LocalPartitionRef(p, self.worker_id) for p in parts]

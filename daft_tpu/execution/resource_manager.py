@@ -78,6 +78,10 @@ class RuntimeStats:
 
     def __init__(self, query_id: str = ""):
         self.query_id = query_id
+        # Workers that ship their snapshot back to the driver set
+        # local_flush=False so stats are not ALSO emitted into the worker's
+        # own context (double counting under env-gated tracing).
+        self.local_flush = True
         self._ops: Dict[str, OperatorCounters] = {}
         self._lock = threading.Lock()
 
@@ -92,6 +96,8 @@ class RuntimeStats:
         from daft_tpu.context import get_context
         from daft_tpu.subscribers.events import OperatorStats
 
+        if not self.local_flush:
+            return
         ctx = get_context()
         with self._lock:
             for op, c in self._ops.items():
@@ -104,3 +110,21 @@ class RuntimeStats:
     def snapshot(self) -> Dict[str, OperatorCounters]:
         with self._lock:
             return dict(self._ops)
+
+    def to_wire(self) -> Dict[str, dict]:
+        """Serializable snapshot (the worker->driver stats wire shape)."""
+        return {op: {"rows_in": c.rows_in, "rows_out": c.rows_out,
+                     "cpu_ns": c.cpu_ns}
+                for op, c in self.snapshot().items()}
+
+
+def emit_operator_stats(query_id: str, wire: Dict[str, dict]) -> None:
+    """Driver-side re-emit of a worker's RuntimeStats.to_wire() payload."""
+    from daft_tpu.context import get_context
+    from daft_tpu.subscribers.events import OperatorStats
+
+    notify = get_context().notify
+    for op, c in (wire or {}).items():
+        notify(OperatorStats(query_id=query_id, operator=op,
+                             rows_in=c["rows_in"], rows_out=c["rows_out"],
+                             cpu_us=c["cpu_ns"] // 1000))

@@ -131,7 +131,7 @@ class DDSketch:
     # -- build ----------------------------------------------------------- #
     def add_array(self, values: np.ndarray) -> "DDSketch":
         v = np.asarray(values, dtype=np.float64)
-        v = v[~np.isnan(v)]
+        v = v[np.isfinite(v)]  # NaN and +/-inf have no log bucket
         self.count += len(v)
         self.zeros += int((v == 0).sum())
         for store, sel in ((self.pos, v[v > 0]), (self.neg, -v[v < 0])):

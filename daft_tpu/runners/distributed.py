@@ -95,7 +95,7 @@ class DistributedRunner(Runner):
         start = time.perf_counter()
         error = None
         try:
-            executor = DistributedExecutor(self.manager, cfg)
+            executor = DistributedExecutor(self.manager, cfg, query_id=query_id)
             refs = executor.execute(physical)
             for ref in refs:
                 mp = ref.fetch()

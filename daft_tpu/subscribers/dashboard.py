@@ -24,16 +24,45 @@ from daft_tpu.subscribers.events import (
 )
 
 _HTML = """<!doctype html><html><head><title>daft_tpu dashboard</title>
-<style>body{font-family:monospace;margin:2em}table{border-collapse:collapse}
-td,th{border:1px solid #999;padding:4px 8px}</style></head>
-<body><h2>daft_tpu dashboard</h2><div id="out">loading...</div>
+<style>body{font-family:monospace;margin:2em;background:#fafafa}
+table{border-collapse:collapse;margin-bottom:1em}
+td,th{border:1px solid #999;padding:4px 8px;text-align:left}
+th{background:#eee}.err{color:#b00}.ok{color:#080}
+#summary span{margin-right:2em}</style></head>
+<body><h2>daft_tpu dashboard</h2>
+<div id="summary">loading...</div>
+<div id="out"></div><div id="detail"></div>
 <script>
+let selected = null;
 async function tick(){
-  const r = await fetch('/api/queries'); const qs = await r.json();
-  let h = '<table><tr><th>query</th><th>status</th><th>duration</th><th>tasks</th></tr>';
-  for (const q of qs) h += `<tr><td>${q.query_id}</td><td>${q.status}</td>`+
-    `<td>${q.duration_s?.toFixed(2) ?? ''}</td><td>${q.tasks}</td></tr>`;
+  const eng = await (await fetch('/api/engine')).json();
+  document.getElementById('summary').innerHTML =
+    `<span>queries: ${eng.queries_total}</span>`+
+    `<span>running: ${eng.queries_running}</span>`+
+    `<span>failed: ${eng.queries_failed}</span>`+
+    `<span>tasks: ${eng.tasks_total}</span>`+
+    `<span>rows: ${eng.rows_processed}</span>`;
+  const qs = await (await fetch('/api/queries')).json();
+  let h = '<table><tr><th>query</th><th>status</th><th>duration</th>'+
+          '<th>tasks</th><th>operators</th><th>workers</th></tr>';
+  for (const q of qs) h += `<tr onclick="select('${q.query_id}')">`+
+    `<td>${q.query_id}</td>`+
+    `<td class="${q.status==='error'?'err':'ok'}">${q.status}</td>`+
+    `<td>${q.duration_s?.toFixed(2) ?? ''}</td><td>${q.tasks}</td>`+
+    `<td>${q.operators}</td><td>${q.workers}</td></tr>`;
   document.getElementById('out').innerHTML = h + '</table>';
+  if (selected) await detail(selected);
+}
+function select(qid){ selected = qid; detail(qid); }
+async function detail(qid){
+  const q = await (await fetch('/api/queries/'+qid)).json();
+  let h = `<h3>${qid}</h3><table><tr><th>operator</th><th>batches</th>`+
+          '<th>rows in</th><th>rows out</th><th>cpu ms</th></tr>';
+  for (const o of q.operators) h += `<tr><td>${o.operator}</td>`+
+    `<td>${o.batches}</td><td>${o.rows_in}</td><td>${o.rows_out}</td>`+
+    `<td>${(o.cpu_us/1000).toFixed(1)}</td></tr>`;
+  h += '</table><pre>'+(q.plan??'')+'</pre>';
+  document.getElementById('detail').innerHTML = h;
 }
 setInterval(tick, 1000); tick();
 </script></body></html>"""
@@ -50,7 +79,7 @@ class DashboardState:
                 self.queries[e.query_id] = {
                     "query_id": e.query_id, "status": "running", "plan": e.plan,
                     "start": time.time(), "duration_s": None, "tasks": 0,
-                    "operators": [],
+                    "operators": {}, "workers": {},
                 }
             elif isinstance(e, QueryEnd):
                 q = self.queries.get(e.query_id)
@@ -62,22 +91,55 @@ class DashboardState:
                 q = self.queries.get(e.query_id)
                 if q and isinstance(e, TaskCompleted):
                     q["tasks"] += 1
+                    w = q["workers"].setdefault(
+                        e.worker_id or "local",
+                        {"tasks": 0, "busy_s": 0.0, "errors": 0})
+                    w["tasks"] += 1
+                    w["busy_s"] += e.duration_s
+                    if e.error:
+                        w["errors"] += 1
             elif isinstance(e, OperatorStats):
                 q = self.queries.get(e.query_id)
                 if q:
-                    q["operators"].append({
-                        "operator": e.operator, "rows_in": e.rows_in,
-                        "rows_out": e.rows_out, "cpu_us": e.cpu_us,
-                    })
+                    op = q["operators"].setdefault(e.operator, {
+                        "operator": e.operator, "batches": 0, "rows_in": 0,
+                        "rows_out": 0, "cpu_us": 0})
+                    op["batches"] += 1
+                    op["rows_in"] += e.rows_in
+                    op["rows_out"] += e.rows_out
+                    op["cpu_us"] += e.cpu_us
 
     def snapshot(self) -> List[dict]:
         with self._lock:
-            return [dict(q, plan=None, operators=len(q["operators"]))
+            return [dict(q, plan=None, operators=len(q["operators"]),
+                         workers=len(q["workers"]))
                     for q in self.queries.values()]
 
     def query_detail(self, query_id: str) -> Optional[dict]:
         with self._lock:
-            return dict(self.queries.get(query_id) or {}) or None
+            q = self.queries.get(query_id)
+            if q is None:
+                return None
+            out = dict(q)
+            out["operators"] = sorted(q["operators"].values(),
+                                      key=lambda o: -o["cpu_us"])
+            out["workers"] = dict(q["workers"])
+            return out
+
+    def engine_summary(self) -> dict:
+        """Live engine state (reference: daft-dashboard engine.rs state)."""
+        with self._lock:
+            running = [q for q in self.queries.values() if q["status"] == "running"]
+            return {
+                "queries_total": len(self.queries),
+                "queries_running": len(running),
+                "queries_failed": sum(1 for q in self.queries.values()
+                                      if q["status"] == "error"),
+                "tasks_total": sum(q["tasks"] for q in self.queries.values()),
+                "rows_processed": sum(
+                    op["rows_out"] for q in self.queries.values()
+                    for op in q["operators"].values()),
+            }
 
 
 class DashboardSubscriber(Subscriber):
@@ -108,6 +170,9 @@ class _Handler(BaseHTTPRequestHandler):
                 self.send_error(404)
                 return
             body = json.dumps(detail, default=str).encode()
+            ctype = "application/json"
+        elif self.path == "/api/engine":
+            body = json.dumps(self.state.engine_summary()).encode()
             ctype = "application/json"
         elif self.path == "/api/health":
             body = b'{"status":"ok"}'

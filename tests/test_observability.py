@@ -133,3 +133,114 @@ def test_checkpoint_mixed_type_keys(make_df, tmp_path):
     out = cfg.filter_done(df).to_pydict()
     assert out["key"] == [3, "c"]
     assert out["v"] == [30, 40]
+
+
+def test_otel_style_tracing_in_memory(make_df):
+    """Engine events become OTel-shaped spans captured by an in-memory
+    exporter (reference: tests/observability/test_opentelemetry.py uses the
+    SDK's in-memory exporters the same way)."""
+    from daft_tpu.tracing import InMemorySpanExporter, TracingSubscriber
+
+    exporter = InMemorySpanExporter()
+    sub = TracingSubscriber(exporter)
+    ctx = daft_tpu.get_context()
+    ctx.attach_subscriber(sub)
+    try:
+        df = make_df({"x": list(range(100)), "g": [i % 3 for i in range(100)]})
+        df.groupby("g").agg(daft_tpu.col("x").sum().alias("s")).collect()
+    finally:
+        ctx.detach_subscriber(sub)
+    spans = exporter.get_finished_spans()
+    names = {s.name for s in spans}
+    assert "daft.query" in names
+    query_span = next(s for s in spans if s.name == "daft.query")
+    assert query_span.status == "OK" and query_span.end_ns > query_span.start_ns
+    op_spans = [s for s in spans if s.name.startswith("daft.operator.")]
+    assert op_spans, names
+    # operator spans parent into the query trace
+    assert all(s.trace_id == query_span.trace_id for s in op_spans)
+    # metrics accumulated
+    snap = sub.meter.snapshot()
+    assert snap["counters"]["daft.queries.ended"] >= 1
+    assert snap["counters"]["daft.rows.processed"] >= 100
+    # OTLP JSON shape is well-formed
+    otlp = query_span.to_otlp()
+    assert otlp["traceId"] == query_span.trace_id and otlp["status"]["code"] == 1
+    assert sub.meter.to_otlp()["resourceMetrics"]
+
+
+def test_otlp_file_exporter(tmp_path, make_df):
+    import json as _json
+
+    from daft_tpu.tracing import OTLPJsonFileExporter, TracingSubscriber
+
+    path = str(tmp_path / "traces.jsonl")
+    sub = TracingSubscriber(OTLPJsonFileExporter(path))
+    ctx = daft_tpu.get_context()
+    ctx.attach_subscriber(sub)
+    try:
+        make_df({"x": [1, 2, 3]}).where(daft_tpu.col("x") > 1).collect()
+    finally:
+        ctx.detach_subscriber(sub)
+    lines = [l for l in open(path).read().splitlines() if l]
+    assert lines
+    payload = _json.loads(lines[-1])
+    spans = payload["resourceSpans"][0]["scopeSpans"][0]["spans"]
+    assert all("traceId" in s and "startTimeUnixNano" in s for s in spans)
+
+
+def test_dashboard_live_operator_state(make_df):
+    """Dashboard aggregates per-operator and per-worker stats and serves an
+    engine summary (reference: daft-dashboard live query/operator state)."""
+    import json as _json
+    import urllib.request
+
+    from daft_tpu.subscribers.dashboard import DashboardServer
+
+    server = DashboardServer().start()
+    ctx = daft_tpu.get_context()
+    sub = server.subscriber()
+    ctx.attach_subscriber(sub)
+    try:
+        df = make_df({"x": list(range(50)), "g": [i % 2 for i in range(50)]})
+        df.groupby("g").agg(daft_tpu.col("x").mean().alias("m")).collect()
+        queries = _json.loads(urllib.request.urlopen(
+            server.url + "/api/queries").read())
+        assert queries and queries[-1]["status"] == "done"
+        qid = queries[-1]["query_id"]
+        detail = _json.loads(urllib.request.urlopen(
+            server.url + f"/api/queries/{qid}").read())
+        assert detail["operators"], detail
+        op = detail["operators"][0]
+        assert {"operator", "batches", "rows_in", "rows_out", "cpu_us"} <= set(op)
+        eng = _json.loads(urllib.request.urlopen(
+            server.url + "/api/engine").read())
+        assert eng["queries_total"] >= 1 and eng["rows_processed"] >= 50
+        html = urllib.request.urlopen(server.url + "/").read().decode()
+        assert "daft_tpu dashboard" in html and "/api/engine" in html
+    finally:
+        ctx.detach_subscriber(sub)
+        server.shutdown()
+
+
+def test_env_gated_tracing(tmp_path, make_df, monkeypatch):
+    import json as _json
+
+    import daft_tpu.tracing as tracing_mod
+
+    path = str(tmp_path / "auto.jsonl")
+    monkeypatch.setenv("DAFT_DEV_ENABLE_TRACING", "1")
+    monkeypatch.setenv("DAFT_TRACE_FILE", path)
+    monkeypatch.setattr(tracing_mod, "_auto_subscriber", None)
+    ctx = daft_tpu.get_context()
+    before = list(ctx.subscribers())
+    try:
+        make_df({"x": [1]}).collect()
+        assert tracing_mod._auto_subscriber is not None
+        make_df({"x": [2]}).collect()
+        assert path and open(path).read().strip()
+    finally:
+        for s in ctx.subscribers():
+            if s not in before:
+                ctx.detach_subscriber(s)
+        monkeypatch.setattr(tracing_mod, "_auto_subscriber", None)
