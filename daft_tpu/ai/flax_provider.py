@@ -234,15 +234,27 @@ class FlaxPrompter(_FlaxModelBase):
         self.temperature = temperature
         self.prompt_len = min(self.cfg.max_seq_len // 2, 128)
         self.tokenizer = HashingTokenizer(self.cfg.vocab_size, self.prompt_len)
+        self._batcher = None  # lazy ContinuousBatcher (persistent slots/caches)
+        import threading
+
+        self._batcher_lock = threading.Lock()  # batcher state is stateful
 
     def prompt(self, prompts: Sequence[Optional[str]]) -> List[str]:
-        from daft_tpu.models.lm import generate
+        """Continuous-batching generation with prefix routing (reference:
+        the vLLM streaming sink; see daft_tpu/models/serving.py)."""
+        from daft_tpu.models.serving import ContinuousBatcher, Request
 
         tokens, lengths = self.tokenizer.encode_batch(prompts)
         lengths = np.maximum(lengths, 1)
-        out = generate(self.model, self.params, jnp.asarray(tokens),
-                       jnp.asarray(lengths), self.max_new_tokens, self.temperature)
-        out = np.asarray(out)
+        reqs = [Request(tokens=np.asarray(tokens[i][:lengths[i]], np.int32),
+                        max_new_tokens=self.max_new_tokens)
+                for i in range(len(prompts))]
+        with self._batcher_lock:  # slot state is shared; runs serialize
+            if self._batcher is None:
+                self._batcher = ContinuousBatcher(
+                    self.model, self.params, num_slots=8,
+                    temperature=self.temperature)
+            out = self._batcher.run(reqs)
         return [" ".join(str(t) for t in row if t != 0) for row in out]
 
 
