@@ -62,18 +62,32 @@ def load_checkpoint(path: str, params):
     return load_params(path, params)
 
 
-def _chunked_forward(fwd, params, arr: np.ndarray, max_batch: int, out_dim: int) -> np.ndarray:
-    """Chunk to max_batch, pad each chunk to a bucket, dispatch ALL forwards
-    before gathering any result (jax async dispatch overlaps host->HBM
-    transfers with compute), then gather. Empty input short-circuits."""
+def _chunked_forward(fwd, params, arr: np.ndarray, max_batch: int, out_dim: int,
+                     stage_ahead: int = 4) -> np.ndarray:
+    """Chunk to max_batch and run with explicit double-buffered staging:
+    `device_put` the next `stage_ahead` chunks BEFORE dispatching each
+    forward, so host->HBM transfers (the bottleneck behind a tunnel —
+    ~240MB/s on axon) overlap the current chunk's compute. All dispatch is
+    async and single-threaded (threaded device_put deadlocks on axon);
+    results gather only at the end. Empty input short-circuits."""
     n = arr.shape[0]
     if n == 0:
         return np.zeros((0, out_dim), dtype=np.float32)
-    futures = []
+    chunks = []
     for start in range(0, n, max_batch):
         chunk = arr[start:start + max_batch]
         b = _bucket(min(len(chunk), max_batch))
-        futures.append((len(chunk), fwd(params, jnp.asarray(_pad_batch(chunk, b)))))
+        chunks.append((len(chunk), chunk, b))
+    staged: List[Any] = [None] * len(chunks)
+    futures = []
+    for i, (cn, chunk, b) in enumerate(chunks):
+        # Keep the transfer pipeline `stage_ahead` chunks deep.
+        for j in range(i, min(i + stage_ahead, len(chunks))):
+            if staged[j] is None:
+                jn, jc, jb = chunks[j]
+                staged[j] = jax.device_put(_pad_batch(jc, jb))
+        futures.append((cn, fwd(params, staged[i])))
+        staged[i] = None  # release our reference; donation frees HBM
     outs = [np.asarray(f)[:cn] for cn, f in futures]
     return np.concatenate(outs, axis=0) if len(outs) > 1 else outs[0]
 
@@ -101,12 +115,14 @@ class FlaxCLIPImageEmbedder(_FlaxModelBase):
         self.params = jax.device_put(params)
         model = self.model
 
-        @jax.jit
         def fwd(p, pixels):
             emb = model.apply(p, pixels, method=model.encode_image)
             return emb / jnp.linalg.norm(emb, axis=-1, keepdims=True).clip(1e-6)
 
-        self._fwd = fwd
+        # Donate the pixel buffer: each staged uint8 batch is used exactly
+        # once, so XLA can free/reuse its HBM as soon as the forward reads it
+        # (keeps the staging window's footprint bounded).
+        self._fwd = jax.jit(fwd, donate_argnums=(1,))
 
     @property
     def dimensions(self) -> int:
