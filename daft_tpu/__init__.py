@@ -113,3 +113,5 @@ def __getattr__(name: str):
 # (the subpackage import above sets the module attribute first; this eager
 # from-import shadows it — same pattern as the reference's daft/__init__.py).
 from daft_tpu.sql.sql import sql, sql_expr  # noqa: E402
+
+from daft_tpu.io.iostats import chunked_upload, io_stats, read_range, reset_io_stats  # noqa: E402,F401

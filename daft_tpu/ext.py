@@ -1,0 +1,197 @@
+"""Stable dlopen extension loader (ABI v1).
+
+Reference: src/daft-ext (stable FFI ABI for third-party .so plugins
+registering scalar functions), Session.load_extension (daft/session.py:269),
+and DAFT_EXTENSION_PATHS re-loading plugins on workers
+(daft/runners/flotilla.py:102-118).
+
+A plugin is any shared library exporting ``daft_extension_register`` per
+``native/daft_ext.h``. Arguments and results cross as Arrow C Data
+Interface structs; registered functions become ordinary registry kernels,
+usable from expressions and SQL like built-ins. Worker daemons inherit
+DAFT_EXTENSION_PATHS, so extensions resolve cluster-wide.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import threading
+from typing import Dict, List, Optional
+
+import pyarrow as pa
+
+from daft_tpu.datatype import DataType
+from daft_tpu.errors import DaftValueError
+from daft_tpu.schema import Field
+
+DAFT_EXT_ABI_VERSION = 1
+
+
+class _ArrowSchema(ctypes.Structure):
+    pass
+
+
+class _ArrowArray(ctypes.Structure):
+    pass
+
+
+_ArrowSchema._fields_ = [
+    ("format", ctypes.c_char_p), ("name", ctypes.c_char_p),
+    ("metadata", ctypes.c_char_p), ("flags", ctypes.c_int64),
+    ("n_children", ctypes.c_int64),
+    ("children", ctypes.POINTER(ctypes.POINTER(_ArrowSchema))),
+    ("dictionary", ctypes.POINTER(_ArrowSchema)),
+    ("release", ctypes.c_void_p), ("private_data", ctypes.c_void_p),
+]
+_ArrowArray._fields_ = [
+    ("length", ctypes.c_int64), ("null_count", ctypes.c_int64),
+    ("offset", ctypes.c_int64), ("n_buffers", ctypes.c_int64),
+    ("n_children", ctypes.c_int64),
+    ("buffers", ctypes.POINTER(ctypes.c_void_p)),
+    ("children", ctypes.POINTER(ctypes.POINTER(_ArrowArray))),
+    ("dictionary", ctypes.POINTER(ctypes.POINTER(_ArrowArray))),
+    ("release", ctypes.c_void_p), ("private_data", ctypes.c_void_p),
+]
+
+_SCALAR_FN = ctypes.CFUNCTYPE(
+    ctypes.c_int,
+    ctypes.POINTER(ctypes.POINTER(_ArrowArray)),
+    ctypes.POINTER(ctypes.POINTER(_ArrowSchema)),
+    ctypes.c_int32,
+    ctypes.POINTER(_ArrowArray),
+    ctypes.c_char_p, ctypes.c_int32,
+)
+
+_REGISTER_SCALAR = ctypes.CFUNCTYPE(
+    ctypes.c_int, ctypes.c_void_p, ctypes.c_char_p, _SCALAR_FN, ctypes.c_char_p)
+
+
+class _Registrar(ctypes.Structure):
+    _fields_ = [
+        ("abi_version", ctypes.c_uint32),
+        ("ctx", ctypes.c_void_p),
+        ("register_scalar", _REGISTER_SCALAR),
+    ]
+
+
+_loaded: Dict[str, List[str]] = {}
+_lock = threading.Lock()
+_keepalive: List[object] = []  # CDLLs + callbacks must outlive the process
+
+
+def _make_kernel(name: str, fn, out_format: Optional[str]):
+    from daft_tpu.kernels.registry import register_kernel
+    from daft_tpu.series import Series
+
+    out_arrow = None
+    if out_format:
+        fmt_map = {"g": pa.float64(), "f": pa.float32(), "l": pa.int64(),
+                   "i": pa.int32(), "u": pa.string(), "U": pa.large_string(),
+                   "b": pa.bool_(), "z": pa.binary(), "Z": pa.large_binary()}
+        if out_format not in fmt_map:
+            raise DaftValueError(
+                f"extension {name!r}: unsupported out_format {out_format!r}")
+        out_arrow = fmt_map[out_format]
+
+    def resolver(fields, kwargs):
+        if out_arrow is not None:
+            return Field(fields[0].name, DataType.from_arrow(out_arrow))
+        return fields[0]
+
+    def kernel(args, **kwargs):
+        n = len(args)
+        arr_ptrs = (ctypes.POINTER(_ArrowArray) * n)()
+        schema_ptrs = (ctypes.POINTER(_ArrowSchema) * n)()
+        holders = []
+        for i, s in enumerate(args):
+            arrow = s.to_arrow()
+            if isinstance(arrow, pa.ChunkedArray):
+                arrow = arrow.combine_chunks()
+            a = _ArrowArray()
+            sc = _ArrowSchema()
+            arrow._export_to_c(ctypes.addressof(a), ctypes.addressof(sc))
+            holders.append((a, sc, arrow))
+            arr_ptrs[i] = ctypes.pointer(a)
+            schema_ptrs[i] = ctypes.pointer(sc)
+        out = _ArrowArray()
+        err = ctypes.create_string_buffer(512)
+        try:
+            rc = fn(arr_ptrs, schema_ptrs, n, ctypes.byref(out), err, 512)
+            if rc != 0:
+                raise DaftValueError(
+                    f"extension function {name!r} failed: "
+                    f"{err.value.decode(errors='replace') or rc}")
+            result_type = out_arrow if out_arrow is not None else holders[0][2].type
+            result = pa.Array._import_from_c(ctypes.addressof(out), result_type)
+        finally:
+            # Always release our exported input copies, success or not.
+            for a, sc, _arrow in holders:
+                for struct, cls in ((a, _ArrowArray), (sc, _ArrowSchema)):
+                    if struct.release:
+                        ctypes.CFUNCTYPE(None, ctypes.POINTER(cls))(
+                            struct.release)(ctypes.byref(struct))
+        return Series.from_arrow(result, args[0].name,
+                                 DataType.from_arrow(result.type))
+
+    register_kernel(name, resolver)(kernel)
+    return name
+
+
+def load_extension(path: str) -> List[str]:
+    """dlopen a plugin and register its functions; returns the names."""
+    path = os.path.abspath(path)
+    with _lock:
+        if path in _loaded:
+            return list(_loaded[path])
+        lib = ctypes.CDLL(path)
+        try:
+            entry = lib.daft_extension_register
+        except AttributeError:
+            raise DaftValueError(
+                f"{path}: not a daft extension (no daft_extension_register)")
+        entry.restype = ctypes.c_int
+        entry.argtypes = [ctypes.POINTER(_Registrar)]
+        names: List[str] = []
+        callbacks: List[object] = []
+        errors: List[BaseException] = []
+
+        @_REGISTER_SCALAR
+        def register_scalar(ctx, name_b, fn, out_format_b):
+            try:
+                name = name_b.decode()
+                out_format = out_format_b.decode() if out_format_b else None
+                callbacks.append(fn)  # keep the C function pointer alive
+                _make_kernel(name, fn, out_format)
+                names.append(name)
+                return 0
+            except BaseException as e:  # noqa: BLE001
+                errors.append(e)
+                return 1
+
+        reg = _Registrar(abi_version=DAFT_EXT_ABI_VERSION, ctx=None,
+                         register_scalar=register_scalar)
+        rc = entry(ctypes.byref(reg))
+        if rc != 0:
+            # All-or-nothing: roll back any functions registered before the
+            # failure so a failed load leaves no partial surface.
+            from daft_tpu.kernels.registry import _REGISTRY
+
+            for n in names:
+                _REGISTRY.pop(n, None)
+            detail = f"; first error: {errors[0]!r}" if errors else ""
+            raise DaftValueError(
+                f"{path}: daft_extension_register failed rc={rc}{detail}")
+        _keepalive.extend([lib, register_scalar, callbacks])
+        _loaded[path] = names
+        return list(names)
+
+
+def load_env_extensions() -> List[str]:
+    """Load every plugin in DAFT_EXTENSION_PATHS (reference: workers re-load
+    extensions from this env var, daft/runners/flotilla.py:102-118)."""
+    out: List[str] = []
+    for p in os.environ.get("DAFT_EXTENSION_PATHS", "").split(os.pathsep):
+        if p.strip():
+            out.extend(load_extension(p.strip()))
+    return out

@@ -34,9 +34,16 @@ def read_scan_task(task: ScanTask, morsel_rows: int = 128 * 1024) -> Iterator[Mi
         yield from _stream_with_retry(task, lambda: source_task.execute(),
                                       remaining, project_columns=True)
         return
+    from daft_tpu.io.iostats import IO_STATS
+
     for f in task.files:
         if remaining is not None and remaining <= 0:
             return
+        # Counted up front: a generator can be abandoned mid-stream (limit),
+        # and timing around `yield from` would measure downstream compute,
+        # not IO. bytes_read is the file's size upper bound.
+        IO_STATS.count_open()
+        IO_STATS.count_get(f.size_bytes or 0)
         remaining = yield from _stream_with_retry(
             task, lambda f=f: _read_one_file(task, f, morsel_rows), remaining
         )

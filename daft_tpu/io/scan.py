@@ -83,8 +83,21 @@ def resolve_filesystem(path: str, io_config=None) -> Tuple[pafs.FileSystem, str]
 
 
 def glob_paths(paths: Sequence[str], io_config=None) -> List[FileInfo]:
-    """Expand glob patterns / directories into concrete files with sizes
-    (reference: src/daft-io/src/object_store_glob.rs)."""
+    """Expand glob patterns / directories into concrete files with sizes.
+    Multiple patterns fan out over a thread pool (reference:
+    src/daft-io/src/object_store_glob.rs's concurrent fanout)."""
+    if len(paths) > 1:
+        from concurrent.futures import ThreadPoolExecutor
+
+        with ThreadPoolExecutor(max_workers=min(len(paths), 16)) as pool:
+            chunks = list(pool.map(
+                lambda one: _glob_one(one, io_config), paths))
+        out = [f for chunk in chunks for f in chunk]
+        # Emptiness is judged on the AGGREGATE: one pattern matching nothing
+        # is fine as long as some path matched.
+        if not out:
+            raise DaftIOError(f"No files found at {list(paths)!r}")
+        return out
     out: List[FileInfo] = []
     for path in paths:
         fs, p = resolve_filesystem(path, io_config)
@@ -130,6 +143,17 @@ def glob_paths(paths: Sequence[str], io_config=None) -> List[FileInfo]:
     if not out:
         raise DaftIOError(f"No files found at {list(paths)!r}")
     return out
+
+
+def _glob_one(path: str, io_config=None) -> List[FileInfo]:
+    try:
+        return glob_paths([path], io_config)
+    except DaftIOError as e:
+        # Distinguish "pattern matched nothing" (tolerated per-path) from a
+        # genuinely missing concrete path (propagate).
+        if "No files found" in str(e):
+            return []
+        raise
 
 
 class ScanInfo:

@@ -117,6 +117,15 @@ class FileWriter:
     def _close_current(self):
         self._current.close()
         self.results.append({"path": self._current_path, "num_rows": self._current_rows})
+        import os as _os
+
+        from daft_tpu.io.iostats import IO_STATS
+
+        try:
+            size = _os.path.getsize(self._current_path)
+        except OSError:
+            size = 0
+        IO_STATS.count_put(size)
         self._current = None
 
     def close(self) -> List[Dict[str, Any]]:

@@ -100,6 +100,20 @@ def _ensure_loaded() -> None:
             uri_ops,
         )
 
+        # Stable-ABI plugins from DAFT_EXTENSION_PATHS load with the
+        # registry, so daemon/process workers (which inherit the env)
+        # resolve extension functions exactly like built-ins (reference:
+        # flotilla workers re-loading extensions from this env var).
+        try:
+            from daft_tpu.ext import load_env_extensions
+
+            load_env_extensions()
+        except Exception:
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "failed loading DAFT_EXTENSION_PATHS", exc_info=True)
+
         _loaded = True
 
 

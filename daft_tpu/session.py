@@ -25,6 +25,13 @@ def current_session() -> "Session":
 
 
 class Session:
+    def load_extension(self, path: str):
+        """dlopen a stable-ABI plugin; its functions register globally
+        (reference: Session.load_extension, daft/session.py:269)."""
+        from daft_tpu.ext import load_extension
+
+        return load_extension(path)
+
     def __init__(self):
         self._catalogs: Dict[str, Catalog] = {"default": InMemoryCatalog("default")}
         self._current_catalog = "default"

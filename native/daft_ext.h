@@ -1,0 +1,83 @@
+/* daft_tpu stable extension ABI (version 1).
+ *
+ * Reference: src/daft-ext/src/lib.rs — the reference exposes a stable FFI
+ * ABI so third-party .so plugins can register scalar functions, loaded via
+ * Session.load_extension and re-loaded on workers via DAFT_EXTENSION_PATHS.
+ *
+ * Data crosses the boundary as Arrow C Data Interface structs
+ * (https://arrow.apache.org/docs/format/CDataInterface.html), so plugins
+ * need no daft headers beyond this file and no Arrow library if they build
+ * the structs by hand.
+ *
+ * A plugin exports ONE symbol:
+ *
+ *   int daft_extension_register(struct DaftRegistrar* reg);
+ *
+ * returning 0 on success. It must check reg->abi_version and call
+ * reg->register_scalar for each function it provides. The engine owns the
+ * registrar; the plugin owns every ArrowArray it returns (engine calls the
+ * array's release callback).
+ */
+#ifndef DAFT_EXT_H
+#define DAFT_EXT_H
+
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+#define DAFT_EXT_ABI_VERSION 1
+
+/* Arrow C Data Interface (verbatim from the Arrow spec). */
+#ifndef ARROW_C_DATA_INTERFACE
+#define ARROW_C_DATA_INTERFACE
+struct ArrowSchema {
+  const char* format;
+  const char* name;
+  const char* metadata;
+  int64_t flags;
+  int64_t n_children;
+  struct ArrowSchema** children;
+  struct ArrowSchema* dictionary;
+  void (*release)(struct ArrowSchema*);
+  void* private_data;
+};
+struct ArrowArray {
+  int64_t length;
+  int64_t null_count;
+  int64_t offset;
+  int64_t n_buffers;
+  int64_t n_children;
+  const void** buffers;
+  struct ArrowArray** children;
+  struct ArrowArray** dictionary;
+  void (*release)(struct ArrowArray*);
+  void* private_data;
+};
+#endif /* ARROW_C_DATA_INTERFACE */
+
+/* A scalar kernel: nargs input arrays (with schemas) -> one output array.
+ * Returns 0 on success; on failure writes a NUL-terminated message into
+ * err (err_cap bytes) and returns nonzero. */
+typedef int (*DaftScalarFn)(const struct ArrowArray** args,
+                            const struct ArrowSchema** arg_schemas,
+                            int32_t nargs,
+                            struct ArrowArray* out,
+                            char* err, int32_t err_cap);
+
+struct DaftRegistrar {
+  uint32_t abi_version; /* DAFT_EXT_ABI_VERSION */
+  void* ctx;            /* engine-owned; pass back verbatim */
+  /* out_format: Arrow format string of the result type ("g"=float64,
+   * "l"=int64, "u"=utf8, ...); NULL or "" means same type as first arg. */
+  int (*register_scalar)(void* ctx, const char* name, DaftScalarFn fn,
+                         const char* out_format);
+};
+
+int daft_extension_register(struct DaftRegistrar* reg);
+
+#ifdef __cplusplus
+}
+#endif
+#endif /* DAFT_EXT_H */

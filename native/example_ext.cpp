@@ -1,0 +1,87 @@
+/* Example daft_tpu extension: builds Arrow C arrays by hand (no Arrow lib).
+ * Registers:
+ *   ext_double(float64) -> float64   (x * 2)
+ *   ext_add(float64, float64) -> float64
+ * Compile: g++ -shared -fPIC -O2 -o example_ext.so example_ext.cpp
+ */
+#include "daft_ext.h"
+
+#include <cstdlib>
+#include <cstring>
+#include <string>
+
+namespace {
+
+struct OwnedArray {
+  const void* buffers[2];
+  uint8_t* validity;
+  double* values;
+};
+
+void release_array(struct ArrowArray* a) {
+  if (a->release == nullptr) return;
+  OwnedArray* p = static_cast<OwnedArray*>(a->private_data);
+  std::free(p->validity);
+  std::free(p->values);
+  delete p;
+  a->release = nullptr;
+}
+
+void make_f64_array(struct ArrowArray* out, int64_t n) {
+  OwnedArray* p = new OwnedArray();
+  p->validity = nullptr; /* no nulls */
+  p->values = static_cast<double*>(std::malloc(sizeof(double) * (n ? n : 1)));
+  p->buffers[0] = nullptr;
+  p->buffers[1] = p->values;
+  std::memset(out, 0, sizeof(*out));
+  out->length = n;
+  out->null_count = 0;
+  out->n_buffers = 2;
+  out->buffers = p->buffers;
+  out->private_data = p;
+  out->release = release_array;
+}
+
+const double* f64_values(const struct ArrowArray* a) {
+  return static_cast<const double*>(a->buffers[1]) + a->offset;
+}
+
+int fail(char* err, int32_t cap, const char* msg) {
+  std::snprintf(err, cap, "%s", msg);
+  return 1;
+}
+
+int ext_double(const struct ArrowArray** args, const struct ArrowSchema** schemas,
+               int32_t nargs, struct ArrowArray* out, char* err, int32_t cap) {
+  if (nargs != 1) return fail(err, cap, "ext_double takes 1 argument");
+  if (std::strcmp(schemas[0]->format, "g") != 0)
+    return fail(err, cap, "ext_double requires float64");
+  const int64_t n = args[0]->length;
+  make_f64_array(out, n);
+  const double* in = f64_values(args[0]);
+  double* dst = static_cast<OwnedArray*>(out->private_data)->values;
+  for (int64_t i = 0; i < n; i++) dst[i] = in[i] * 2.0;
+  return 0;
+}
+
+int ext_add(const struct ArrowArray** args, const struct ArrowSchema** schemas,
+            int32_t nargs, struct ArrowArray* out, char* err, int32_t cap) {
+  if (nargs != 2) return fail(err, cap, "ext_add takes 2 arguments");
+  const int64_t n = args[0]->length;
+  if (args[1]->length != n) return fail(err, cap, "length mismatch");
+  make_f64_array(out, n);
+  const double* a = f64_values(args[0]);
+  const double* b = f64_values(args[1]);
+  double* dst = static_cast<OwnedArray*>(out->private_data)->values;
+  for (int64_t i = 0; i < n; i++) dst[i] = a[i] + b[i];
+  return 0;
+}
+
+}  // namespace
+
+extern "C" int daft_extension_register(struct DaftRegistrar* reg) {
+  if (reg->abi_version != DAFT_EXT_ABI_VERSION) return 2;
+  if (reg->register_scalar(reg->ctx, "ext_double", ext_double, "g")) return 3;
+  if (reg->register_scalar(reg->ctx, "ext_add", ext_add, "g")) return 3;
+  return 0;
+}

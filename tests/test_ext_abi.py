@@ -1,0 +1,100 @@
+"""Stable dlopen extension ABI tests (reference: src/daft-ext + 
+Session.load_extension + DAFT_EXTENSION_PATHS worker reload)."""
+
+import os
+import shutil
+import subprocess
+import tempfile
+
+import pytest
+
+import daft_tpu
+from daft_tpu import col
+from daft_tpu.expressions.expr import FunctionCall
+from daft_tpu.expressions.expression import Expression
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def plugin_so(tmp_path_factory):
+    d = tmp_path_factory.mktemp("ext")
+    shutil.copy(os.path.join(REPO, "native", "daft_ext.h"), d)
+    shutil.copy(os.path.join(REPO, "native", "example_ext.cpp"), d)
+    so = str(d / "example_ext.so")
+    subprocess.run(["g++", "-shared", "-fPIC", "-O2", "-o", so,
+                    str(d / "example_ext.cpp")], check=True)
+    return so
+
+
+def test_load_and_call_extension(plugin_so):
+    from daft_tpu.ext import load_extension
+
+    names = load_extension(plugin_so)
+    assert set(names) >= {"ext_double", "ext_add"}
+    df = daft_tpu.from_pydict({"x": [1.0, 2.5], "y": [10.0, 20.0]})
+    out = df.select(
+        Expression(FunctionCall("ext_double", [col("x")._expr])).alias("d"),
+        Expression(FunctionCall("ext_add", [col("x")._expr, col("y")._expr])).alias("s"),
+    ).to_pydict()
+    assert out["d"] == [2.0, 5.0] and out["s"] == [11.0, 22.5]
+
+
+def test_extension_via_sql_and_session(plugin_so):
+    from daft_tpu.session import Session
+
+    sess = Session()
+    sess.load_extension(plugin_so)
+    df = daft_tpu.from_pydict({"x": [3.0]})
+    assert daft_tpu.sql("SELECT ext_double(x) AS d FROM df",
+                        df=df).to_pydict()["d"] == [6.0]
+
+
+def test_extension_error_surface(plugin_so):
+    from daft_tpu.ext import load_extension
+
+    load_extension(plugin_so)
+    df = daft_tpu.from_pydict({"s": ["a", "b"]})
+    with pytest.raises(Exception, match="ext_double|float64"):
+        df.select(Expression(FunctionCall(
+            "ext_double", [col("s")._expr])).alias("d")).collect()
+
+
+def test_extension_env_reload_on_daemon_worker(plugin_so):
+    """DAFT_EXTENSION_PATHS resolves on network workers: the daemon process
+    loads the plugin itself (the reference re-loads extensions on Ray
+    workers the same way)."""
+    from daft_tpu.distributed.daemon import (
+        RemoteWorker,
+        spawn_local_daemon,
+        wait_for_daemon,
+    )
+    from daft_tpu.distributed.worker import WorkerManager
+    from daft_tpu.runners.distributed import DistributedRunner
+
+    env_before = os.environ.get("DAFT_EXTENSION_PATHS")
+    os.environ["DAFT_EXTENSION_PATHS"] = plugin_so
+    procs = []
+    try:
+        procs = [spawn_local_daemon(slots=1)]
+        addrs = [wait_for_daemon(p) for p in procs]
+        mgr = WorkerManager([RemoteWorker(a) for a in addrs])
+        runner = DistributedRunner(manager=mgr)
+        ctx = daft_tpu.get_context()
+        old = ctx._runner
+        ctx.set_runner(runner)
+        try:
+            df = daft_tpu.from_pydict({"x": [4.0, 5.0]})
+            out = df.select(Expression(FunctionCall(
+                "ext_double", [col("x")._expr])).alias("d")).to_pydict()
+            assert out["d"] == [8.0, 10.0]
+        finally:
+            ctx.set_runner(old)
+            mgr.shutdown()
+    finally:
+        for p in procs:
+            p.kill()
+        if env_before is None:
+            os.environ.pop("DAFT_EXTENSION_PATHS", None)
+        else:
+            os.environ["DAFT_EXTENSION_PATHS"] = env_before
