@@ -45,9 +45,26 @@ class DistributedExecutor:
         self.query_id = query_id
         self.scheduler = Scheduler(manager, cfg.autoscaling_threshold)
         self.dispatcher = Dispatcher(self.scheduler)
+        self._shared_ids: set = set()
+        self._subplan_cache: dict = {}
 
     # ------------------------------------------------------------------ #
     def execute(self, plan: pp.PhysicalPlan) -> List[PartitionRef]:
+        # Shared DAG subtrees (decorrelated subqueries reference one subtree
+        # from several parents) must execute once — also a correctness
+        # requirement when the subtree is nondeterministic (Sample,
+        # monotonic ids).
+        counts: dict = {}
+
+        def count(n):
+            counts[id(n)] = counts.get(id(n), 0) + 1
+            if counts[id(n)] == 1:
+                for c in n.children:
+                    count(c)
+
+        count(plan)
+        self._shared_ids = {i for i, c in counts.items() if c > 1}
+        self._subplan_cache = {}
         return self._run(plan)
 
     def _dispatch(self, tasks: Sequence[Task]) -> List[List[PartitionRef]]:
@@ -67,7 +84,8 @@ class DistributedExecutor:
     def _run_partitionwise(self, chain: List[pp.PhysicalPlan], boundary: pp.PhysicalPlan) -> List[PartitionRef]:
         """Run `chain` (narrow, outermost-first) over each partition of the
         boundary node as one task per partition."""
-        if isinstance(boundary, pp.PhysicalScan):
+        if isinstance(boundary, pp.PhysicalScan) and \
+                not (chain and id(boundary) in self._shared_ids):
             tasks = []
             for i, st in enumerate(boundary.scan_tasks):
                 frag = self._chain_over(chain, pp.PhysicalScan([st], boundary.schema))
@@ -94,12 +112,24 @@ class DistributedExecutor:
 
     # ------------------------------------------------------------------ #
     def _run(self, node: pp.PhysicalPlan) -> List[PartitionRef]:
+        hit = self._subplan_cache.get(id(node))
+        if hit is not None:
+            return hit
+        out = self._run_uncached(node)
+        self._subplan_cache[id(node)] = out
+        return out
+
+    def _run_uncached(self, node: pp.PhysicalPlan) -> List[PartitionRef]:
         # Collect the narrow chain above the first wide/source boundary.
+        # The walk never consumes a SHARED node below the top: it becomes
+        # the boundary so its (cached) result is computed exactly once.
         chain: List[pp.PhysicalPlan] = []
         cur = node
         while isinstance(cur, _NARROW):
             chain.append(cur)
             cur = cur.children[0]
+            if id(cur) in self._shared_ids:
+                break
         if chain:
             return self._run_partitionwise(chain, cur)
         handler = getattr(self, f"_run_{type(cur).__name__}", None)

@@ -66,9 +66,25 @@ class Executor:
         self._op_stack: List[str] = []
 
     def run(self, plan: pp.PhysicalPlan) -> Iterator[MicroPartition]:
+        # Plans are DAGs: subquery decorrelation references the same subtree
+        # object from multiple parents (e.g. the row-id EXISTS technique).
+        # Count shared nodes so _run materializes them ONCE — without this,
+        # nested EXISTS re-executes the base 2^depth times.
+        counts: dict = {}
+
+        def count(n):
+            counts[id(n)] = counts.get(id(n), 0) + 1
+            if counts[id(n)] == 1:
+                for c in n.children:
+                    count(c)
+
+        count(plan)
+        self._shared_ids = {i for i, c in counts.items() if c > 1}
+        self._shared_cache = {}
         try:
             yield from self._run(plan)
         finally:
+            self._shared_cache = {}
             if self._held_bytes:
                 self.memory.release(self._held_bytes)
                 self._held_bytes = 0
@@ -77,6 +93,22 @@ class Executor:
 
     # ------------------------------------------------------------------ #
     def _run(self, node: pp.PhysicalPlan) -> Iterator[MicroPartition]:
+        if id(node) in getattr(self, "_shared_ids", ()):
+            cached = self._shared_cache.get(id(node))
+            if cached is None:
+                cached = []
+                for mp in self._run_uncached(node):
+                    # Pinning a shared subtree's output is buffered state:
+                    # account it against the memory budget like any sink.
+                    nbytes = mp.size_bytes()
+                    self.memory.acquire(nbytes)
+                    self._held_bytes += nbytes
+                    cached.append(mp)
+                self._shared_cache[id(node)] = cached
+            return iter(cached)
+        return self._run_uncached(node)
+
+    def _run_uncached(self, node: pp.PhysicalPlan) -> Iterator[MicroPartition]:
         handler = getattr(self, f"_run_{type(node).__name__}", None)
         if handler is None:
             raise DaftPlanError(f"No executor for physical node {node.name()}")

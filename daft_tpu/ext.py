@@ -172,6 +172,8 @@ def load_extension(path: str) -> List[str]:
         reg = _Registrar(abi_version=DAFT_EXT_ABI_VERSION, ctx=None,
                          register_scalar=register_scalar)
         rc = entry(ctypes.byref(reg))
+        if rc == 0 and errors:
+            rc = -1  # plugin ignored a failed register_scalar; don't hide it
         if rc != 0:
             # All-or-nothing: roll back any functions registered before the
             # failure so a failed load leaves no partial surface.

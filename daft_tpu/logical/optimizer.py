@@ -44,21 +44,41 @@ class Rule:
         raise NotImplementedError
 
 
-def _rewrite_bottom_up(node: lp.LogicalPlan, rule: Rule) -> lp.LogicalPlan:
-    new_children = [_rewrite_bottom_up(c, rule) for c in node.children()]
+def _rewrite_bottom_up(node: lp.LogicalPlan, rule: Rule,
+                       _memo: Optional[dict] = None) -> lp.LogicalPlan:
+    # Memoized per pass so DAG-shared subtrees (decorrelated subqueries)
+    # stay SHARED through rewrites — executor-level subplan caching keys on
+    # object identity.
+    if _memo is None:
+        _memo = {}
+    hit = _memo.get(id(node))
+    if hit is not None:
+        return hit
+    orig = node
+    new_children = [_rewrite_bottom_up(c, rule, _memo) for c in node.children()]
     if any(a is not b for a, b in zip(new_children, node.children())):
         node = node.with_children(new_children)
     replaced = rule.rewrite(node)
-    return replaced if replaced is not None else node
+    out = replaced if replaced is not None else node
+    _memo[id(orig)] = out
+    return out
 
 
-def _rewrite_top_down(node: lp.LogicalPlan, rule: Rule) -> lp.LogicalPlan:
+def _rewrite_top_down(node: lp.LogicalPlan, rule: Rule,
+                      _memo: Optional[dict] = None) -> lp.LogicalPlan:
+    if _memo is None:
+        _memo = {}
+    hit = _memo.get(id(node))
+    if hit is not None:
+        return hit
+    orig = node
     replaced = rule.rewrite(node)
     if replaced is not None:
         node = replaced
-    new_children = [_rewrite_top_down(c, rule) for c in node.children()]
+    new_children = [_rewrite_top_down(c, rule, _memo) for c in node.children()]
     if any(a is not b for a, b in zip(new_children, node.children())):
         node = node.with_children(new_children)
+    _memo[id(orig)] = node
     return node
 
 
@@ -515,11 +535,21 @@ class UnnestSubqueries(Rule):
             proj.extend(Alias(ColumnRef(r), f"__in_{r}") for r in inner_refs)
             rowid = self._uniq("rowid")
             base_id = lp.MonotonicallyIncreasingId(base, rowid)
+            # The matching join only needs the row id, the equi keys, and the
+            # outer columns the extra predicates read — never the full base
+            # row (wide payload columns would be duplicated per inner match).
+            needed = {rowid}
+            for e in left_on:
+                needed |= e.column_refs()
+            for e in extra:
+                needed |= {r for r in e.column_refs() if not r.startswith("__in_")}
+            narrow = lp.Project(base_id, [ColumnRef(n) for n in needed
+                                          if n in base_id.schema])
             right = lp.Project(plan, proj)
             if left_on:
-                joined = lp.Join(base_id, right, left_on, right_on, "inner")
+                joined = lp.Join(narrow, right, left_on, right_on, "inner")
             else:
-                joined = lp.Join(base_id, right, [], [], "cross")
+                joined = lp.Join(narrow, right, [], [], "cross")
             matched = lp.Filter(joined, _and_all(list(extra)))
             return lp.Join(base_id, matched, [ColumnRef(rowid)], [ColumnRef(rowid)],
                            "anti" if negated else "semi")

@@ -12,8 +12,23 @@ from daft_tpu.logical import plan as lp
 from daft_tpu.physical import plan as pp
 
 
-def translate(node: lp.LogicalPlan, cfg) -> pp.PhysicalPlan:
-    t = lambda n: translate(n, cfg)
+def translate(node: lp.LogicalPlan, cfg, _memo=None) -> pp.PhysicalPlan:
+    """Memoized on logical-node identity: plans are DAGs (decorrelated
+    subqueries reference a subtree from several parents), and the executor
+    caches shared PHYSICAL subtrees by object id — so translation must map
+    one logical node to one physical node."""
+    if _memo is None:
+        _memo = {}
+    hit = _memo.get(id(node))
+    if hit is not None:
+        return hit
+    out = _translate_one(node, cfg, _memo)
+    _memo[id(node)] = out
+    return out
+
+
+def _translate_one(node: lp.LogicalPlan, cfg, _memo) -> pp.PhysicalPlan:
+    t = lambda n: translate(n, cfg, _memo)
     if isinstance(node, lp.InMemorySource):
         return pp.InMemorySource(node.partitions, node.schema)
     if isinstance(node, lp.ScanSource):

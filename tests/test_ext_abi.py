@@ -18,6 +18,8 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 @pytest.fixture(scope="module")
 def plugin_so(tmp_path_factory):
+    if shutil.which("g++") is None:
+        pytest.skip("no C++ toolchain available")
     d = tmp_path_factory.mktemp("ext")
     shutil.copy(os.path.join(REPO, "native", "daft_ext.h"), d)
     shutil.copy(os.path.join(REPO, "native", "example_ext.cpp"), d)
