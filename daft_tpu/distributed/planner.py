@@ -54,16 +54,7 @@ class DistributedExecutor:
         # from several parents) must execute once — also a correctness
         # requirement when the subtree is nondeterministic (Sample,
         # monotonic ids).
-        counts: dict = {}
-
-        def count(n):
-            counts[id(n)] = counts.get(id(n), 0) + 1
-            if counts[id(n)] == 1:
-                for c in n.children:
-                    count(c)
-
-        count(plan)
-        self._shared_ids = {i for i, c in counts.items() if c > 1}
+        self._shared_ids = pp.shared_subtree_ids(plan)
         self._subplan_cache = {}
         return self._run(plan)
 

@@ -68,18 +68,9 @@ class Executor:
     def run(self, plan: pp.PhysicalPlan) -> Iterator[MicroPartition]:
         # Plans are DAGs: subquery decorrelation references the same subtree
         # object from multiple parents (e.g. the row-id EXISTS technique).
-        # Count shared nodes so _run materializes them ONCE — without this,
-        # nested EXISTS re-executes the base 2^depth times.
-        counts: dict = {}
-
-        def count(n):
-            counts[id(n)] = counts.get(id(n), 0) + 1
-            if counts[id(n)] == 1:
-                for c in n.children:
-                    count(c)
-
-        count(plan)
-        self._shared_ids = {i for i, c in counts.items() if c > 1}
+        # Shared nodes materialize ONCE — without this, nested EXISTS
+        # re-executes the base 2^depth times.
+        self._shared_ids = pp.shared_subtree_ids(plan)
         self._shared_cache = {}
         try:
             yield from self._run(plan)
@@ -97,12 +88,19 @@ class Executor:
             cached = self._shared_cache.get(id(node))
             if cached is None:
                 cached = []
+                gate_on = True
                 for mp in self._run_uncached(node):
                     # Pinning a shared subtree's output is buffered state:
-                    # account it against the memory budget like any sink.
+                    # account it like a blocking sink. Same self-deadlock
+                    # guard as _collect — the only releaser is THIS executor
+                    # at query end, so a failed acquire disengages the gate
+                    # instead of waiting forever.
                     nbytes = mp.size_bytes()
-                    self.memory.acquire(nbytes)
-                    self._held_bytes += nbytes
+                    if gate_on:
+                        if self.memory.acquire(nbytes, timeout=5.0):
+                            self._held_bytes += nbytes
+                        else:
+                            gate_on = False
                     cached.append(mp)
                 self._shared_cache[id(node)] = cached
             return iter(cached)

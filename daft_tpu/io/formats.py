@@ -90,7 +90,6 @@ def _stream_with_retry(task: ScanTask, make_iter, remaining, project_columns: bo
             from daft_tpu.io.iostats import IO_STATS
 
             IO_STATS.count_retry()
-            IO_STATS.count_open()  # the retry re-opens and re-reads
             _time.sleep(0.05 * (2 ** attempt))
 
 

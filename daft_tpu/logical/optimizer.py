@@ -253,15 +253,36 @@ class PushDownFilter(Rule):
                 return child.with_children([lp.Filter(grand, pred)])
         if isinstance(child, lp.Concat):
             return lp.Concat([lp.Filter(c, pred) for c in child.children()])
-        if isinstance(child, lp.Join) and child.how in ("inner", "left", "right"):
-            refs = pred.column_refs()
+        if isinstance(child, lp.Join) and child.how in ("inner", "left", "right",
+                                                        "semi", "anti"):
+            # Split the predicate: each conjunct pushes independently to the
+            # side that produces all its columns (reference:
+            # rules/push_down_filter.rs splits conjuncts the same way —
+            # multi-relation WHERE clauses otherwise never push).
             left, right = child.children()
             left_names = set(left.schema.column_names())
             right_names = set(right.schema.column_names())
-            if refs and refs <= left_names and child.how in ("inner", "left"):
-                return child.with_children([lp.Filter(left, pred), right])
-            if refs and refs <= right_names and not (refs & left_names) and child.how in ("inner", "right"):
-                return child.with_children([left, lp.Filter(right, pred)])
+            conjuncts: List[Expr] = []
+            _flatten_and(pred, conjuncts)
+            to_left, to_right, keep = [], [], []
+            for c in conjuncts:
+                refs = c.column_refs()
+                if refs and refs <= left_names and not c.has_subquery() \
+                        and child.how in ("inner", "left", "semi", "anti"):
+                    to_left.append(c)
+                elif refs and refs <= right_names and not (refs & left_names) \
+                        and not c.has_subquery() and child.how in ("inner", "right"):
+                    to_right.append(c)
+                else:
+                    keep.append(c)
+            if not to_left and not to_right:
+                return None
+            new_left = lp.Filter(left, _and_all(to_left)) if to_left else left
+            new_right = lp.Filter(right, _and_all(to_right)) if to_right else right
+            out: lp.LogicalPlan = child.with_children([new_left, new_right])
+            if keep:
+                out = lp.Filter(out, _and_all(keep))
+            return out
         if isinstance(child, lp.ScanSource):
             pd = child.pushdowns
             combined = pred if pd.filters is None else BinaryOp("and", pd.filters, pred)
@@ -543,7 +564,7 @@ class UnnestSubqueries(Rule):
                 needed |= e.column_refs()
             for e in extra:
                 needed |= {r for r in e.column_refs() if not r.startswith("__in_")}
-            narrow = lp.Project(base_id, [ColumnRef(n) for n in needed
+            narrow = lp.Project(base_id, [ColumnRef(n) for n in sorted(needed)
                                           if n in base_id.schema])
             right = lp.Project(plan, proj)
             if left_on:
