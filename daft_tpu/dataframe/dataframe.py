@@ -472,6 +472,24 @@ class DataFrame:
     def write_ipc(self, root_dir: str, partition_cols=None, write_mode: str = "append") -> "DataFrame":
         return self._write("ipc", root_dir, partition_cols, None, write_mode)
 
+    def write_deltalake(self, table_uri: str, mode: str = "append",
+                        partition_cols=None, io_config=None) -> "DataFrame":
+        """Write to a Delta Lake table, creating it if absent (reference:
+        daft/dataframe/dataframe.py write_deltalake; native log writer in
+        daft_tpu/io/delta.py). Modes: append | overwrite | error | ignore."""
+        from daft_tpu.dataframe import creation
+        from daft_tpu.io import delta
+
+        if isinstance(partition_cols, str):
+            partition_cols = [partition_cols]
+        result = delta.write_table(self, table_uri, mode=mode,
+                                   partition_cols=partition_cols,
+                                   io_config=io_config)
+        return creation.from_pydict({
+            "path": result["paths"] or [""],
+            "version": [result["version"]] * max(len(result["paths"]), 1),
+        })
+
     def write_sink(self, sink) -> "DataFrame":
         """Write through a pluggable DataSink (reference: daft/io/sink.py)."""
         sink.start()

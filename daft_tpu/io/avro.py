@@ -1,0 +1,322 @@
+"""Minimal Apache Avro Object Container File codec.
+
+Iceberg's manifest lists and manifest files are Avro (reference reads them
+via the iceberg-rust/pyiceberg stack; daft_tpu parses them natively so
+``read_iceberg`` works with zero extra dependencies). Implements the subset
+of the 1.11 spec those files use: records, unions, arrays, maps, enums,
+fixed, all primitives, and the ``null``/``deflate`` block codecs — both
+reading and writing (the writer also backs the test fixtures).
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import os
+import struct
+import zlib
+from typing import Any, Dict, Iterator, List, Optional, Tuple
+
+from daft_tpu.errors import DaftIOError
+
+MAGIC = b"Obj\x01"
+
+
+# --------------------------------------------------------------------- #
+# primitive decode
+# --------------------------------------------------------------------- #
+class _Reader:
+    __slots__ = ("buf", "pos")
+
+    def __init__(self, buf: bytes):
+        self.buf = buf
+        self.pos = 0
+
+    def read(self, n: int) -> bytes:
+        b = self.buf[self.pos:self.pos + n]
+        if len(b) != n:
+            raise DaftIOError("avro: truncated input")
+        self.pos += n
+        return b
+
+    def read_long(self) -> int:
+        """Zigzag varint."""
+        shift = 0
+        accum = 0
+        while True:
+            byte = self.buf[self.pos]
+            self.pos += 1
+            accum |= (byte & 0x7F) << shift
+            if not byte & 0x80:
+                break
+            shift += 7
+        return (accum >> 1) ^ -(accum & 1)
+
+    def read_bytes(self) -> bytes:
+        return self.read(self.read_long())
+
+    def at_end(self) -> bool:
+        return self.pos >= len(self.buf)
+
+
+def _decode(reader: _Reader, schema: Any, named: Dict[str, Any]) -> Any:
+    if isinstance(schema, str):
+        if schema in named:
+            return _decode(reader, named[schema], named)
+        t = schema
+    elif isinstance(schema, list):  # union: branch index then value
+        idx = reader.read_long()
+        if not 0 <= idx < len(schema):
+            raise DaftIOError(f"avro: union branch {idx} out of range")
+        return _decode(reader, schema[idx], named)
+    else:
+        t = schema["type"]
+        if t in ("record", "error"):
+            _register(schema, named)
+            return {f["name"]: _decode(reader, f["type"], named)
+                    for f in schema["fields"]}
+        if t == "array":
+            out: List[Any] = []
+            while True:
+                n = reader.read_long()
+                if n == 0:
+                    return out
+                if n < 0:
+                    n = -n
+                    reader.read_long()  # byte size of block — unused
+                for _ in range(n):
+                    out.append(_decode(reader, schema["items"], named))
+        if t == "map":
+            m: Dict[str, Any] = {}
+            while True:
+                n = reader.read_long()
+                if n == 0:
+                    return m
+                if n < 0:
+                    n = -n
+                    reader.read_long()
+                for _ in range(n):
+                    k = reader.read_bytes().decode()
+                    m[k] = _decode(reader, schema["values"], named)
+        if t == "enum":
+            _register(schema, named)
+            return schema["symbols"][reader.read_long()]
+        if t == "fixed":
+            _register(schema, named)
+            return reader.read(schema["size"])
+        # logical types ride on a primitive "type"
+    if t == "null":
+        return None
+    if t == "boolean":
+        return reader.read(1) == b"\x01"
+    if t in ("int", "long"):
+        return reader.read_long()
+    if t == "float":
+        return struct.unpack("<f", reader.read(4))[0]
+    if t == "double":
+        return struct.unpack("<d", reader.read(8))[0]
+    if t == "bytes":
+        return reader.read_bytes()
+    if t == "string":
+        return reader.read_bytes().decode()
+    raise DaftIOError(f"avro: unsupported type {t!r}")
+
+
+def _register(schema: Dict[str, Any], named: Dict[str, Any]) -> None:
+    name = schema.get("name")
+    if name:
+        ns = schema.get("namespace")
+        named[name] = schema
+        if ns:
+            named[f"{ns}.{name}"] = schema
+
+
+# --------------------------------------------------------------------- #
+# primitive encode
+# --------------------------------------------------------------------- #
+class _Writer:
+    __slots__ = ("out",)
+
+    def __init__(self):
+        self.out = io.BytesIO()
+
+    def write(self, b: bytes) -> None:
+        self.out.write(b)
+
+    def write_long(self, v: int) -> None:
+        v = (v << 1) ^ (v >> 63)  # zigzag (python ints: arithmetic shift ok)
+        while True:
+            b = v & 0x7F
+            v >>= 7
+            if v:
+                self.out.write(bytes([b | 0x80]))
+            else:
+                self.out.write(bytes([b]))
+                return
+
+    def write_bytes(self, b: bytes) -> None:
+        self.write_long(len(b))
+        self.out.write(b)
+
+
+def _encode(w: _Writer, schema: Any, value: Any, named: Dict[str, Any]) -> None:
+    if isinstance(schema, str):
+        if schema in named:
+            return _encode(w, named[schema], value, named)
+        t = schema
+    elif isinstance(schema, list):
+        # Union: pick the first branch the value fits (null → "null").
+        for i, branch in enumerate(schema):
+            if _fits(branch, value, named):
+                w.write_long(i)
+                return _encode(w, branch, value, named)
+        raise DaftIOError(f"avro: no union branch for {type(value).__name__}")
+    else:
+        t = schema["type"]
+        if t in ("record", "error"):
+            _register(schema, named)
+            for f in schema["fields"]:
+                if f["name"] not in value and "default" in f:
+                    _encode(w, f["type"], f["default"], named)
+                else:
+                    _encode(w, f["type"], value[f["name"]], named)
+            return
+        if t == "array":
+            if value:
+                w.write_long(len(value))
+                for item in value:
+                    _encode(w, schema["items"], item, named)
+            w.write_long(0)
+            return
+        if t == "map":
+            if value:
+                w.write_long(len(value))
+                for k, v in value.items():
+                    w.write_bytes(str(k).encode())
+                    _encode(w, schema["values"], v, named)
+            w.write_long(0)
+            return
+        if t == "enum":
+            _register(schema, named)
+            w.write_long(schema["symbols"].index(value))
+            return
+        if t == "fixed":
+            _register(schema, named)
+            w.write(value)
+            return
+    if t == "null":
+        return
+    if t == "boolean":
+        w.write(b"\x01" if value else b"\x00")
+    elif t in ("int", "long"):
+        w.write_long(int(value))
+    elif t == "float":
+        w.write(struct.pack("<f", value))
+    elif t == "double":
+        w.write(struct.pack("<d", value))
+    elif t == "bytes":
+        w.write_bytes(bytes(value))
+    elif t == "string":
+        w.write_bytes(str(value).encode())
+    else:
+        raise DaftIOError(f"avro: unsupported type {t!r}")
+
+
+def _fits(schema: Any, value: Any, named: Dict[str, Any]) -> bool:
+    t = schema if isinstance(schema, str) else schema.get("type") \
+        if isinstance(schema, dict) else None
+    if t in named and isinstance(named[t], dict):
+        t = named[t]["type"]
+    if t == "null":
+        return value is None
+    if value is None:
+        return False
+    if t == "boolean":
+        return isinstance(value, bool)
+    if t in ("int", "long"):
+        return isinstance(value, int) and not isinstance(value, bool)
+    if t in ("float", "double"):
+        return isinstance(value, float)
+    if t == "string":
+        return isinstance(value, str)
+    if t in ("bytes", "fixed"):
+        return isinstance(value, (bytes, bytearray))
+    if t == "array":
+        return isinstance(value, list)
+    if t == "map":
+        return isinstance(value, dict)
+    if t in ("record", "error"):
+        return isinstance(value, dict)
+    if t == "enum":
+        return isinstance(value, str)
+    return False
+
+
+# --------------------------------------------------------------------- #
+# container files
+# --------------------------------------------------------------------- #
+def read_avro(data: bytes) -> Tuple[Dict[str, Any], List[Dict[str, Any]]]:
+    """Decode an Object Container File → (schema, records)."""
+    if data[:4] != MAGIC:
+        raise DaftIOError("avro: bad magic (not an avro container file)")
+    r = _Reader(data)
+    r.read(4)
+    meta = _decode(r, {"type": "map", "values": "bytes"}, {})
+    sync = r.read(16)
+    schema = json.loads(meta["avro.schema"].decode())
+    codec = meta.get("avro.codec", b"null").decode()
+    named: Dict[str, Any] = {}
+    records: List[Dict[str, Any]] = []
+    while not r.at_end():
+        count = r.read_long()
+        block = r.read_bytes()
+        if r.read(16) != sync:
+            raise DaftIOError("avro: sync marker mismatch")
+        if codec == "deflate":
+            block = zlib.decompress(block, -zlib.MAX_WBITS)
+        elif codec != "null":
+            raise DaftIOError(f"avro: unsupported codec {codec!r}")
+        br = _Reader(block)
+        for _ in range(count):
+            records.append(_decode(br, schema, named))
+    return schema, records
+
+
+def read_avro_file(path: str, io_config=None) -> Tuple[Dict[str, Any], List[Dict[str, Any]]]:
+    from daft_tpu.io.scan import resolve_filesystem
+
+    fs, p = resolve_filesystem(path, io_config)
+    with fs.open_input_file(p) as f:
+        return read_avro(f.read())
+
+
+def write_avro(schema: Dict[str, Any], records: List[Dict[str, Any]],
+               codec: str = "deflate") -> bytes:
+    """Encode records into an Object Container File (single block)."""
+    body = _Writer()
+    named: Dict[str, Any] = {}
+    for rec in records:
+        _encode(body, schema, rec, named)
+    block = body.out.getvalue()
+    if codec == "deflate":
+        co = zlib.compressobj(wbits=-zlib.MAX_WBITS)
+        block = co.compress(block) + co.flush()
+    elif codec != "null":
+        raise DaftIOError(f"avro: unsupported codec {codec!r}")
+    sync = os.urandom(16)
+    w = _Writer()
+    w.write(MAGIC)
+    _encode(w, {"type": "map", "values": "bytes"},
+            {"avro.schema": json.dumps(schema).encode(),
+             "avro.codec": codec.encode()}, {})
+    w.write(sync)
+    w.write_long(len(records))
+    w.write_bytes(block)
+    w.write(sync)
+    return w.out.getvalue()
+
+
+def write_avro_file(path: str, schema: Dict[str, Any],
+                    records: List[Dict[str, Any]], codec: str = "deflate") -> None:
+    with open(path, "wb") as f:
+        f.write(write_avro(schema, records, codec))

@@ -95,7 +95,8 @@ def _stream_with_retry(task: ScanTask, make_iter, remaining, project_columns: bo
 
 def _read_one_file(task: ScanTask, f, morsel_rows: int):
     if task.file_format == "parquet":
-        return _read_parquet_file(f.path, task, morsel_rows)
+        return _read_parquet_file(f.path, task, morsel_rows,
+                                  partition_values=f.partition_values)
     if task.file_format == "warc":
         return _read_warc_file(f.path, task, morsel_rows)
     if task.file_format == "csv":
@@ -125,19 +126,44 @@ def _filter_ref_columns(task: ScanTask) -> List[str]:
     return sorted(task.pushdowns.filters.column_refs())
 
 
-def _read_parquet_file(path: str, task: ScanTask, morsel_rows: int) -> Iterator[MicroPartition]:
+def _read_parquet_file(path: str, task: ScanTask, morsel_rows: int,
+                       partition_values=None) -> Iterator[MicroPartition]:
     fs, p = resolve_filesystem(path, task.read_options.get("io_config"))
     schema = _project_schema(task)
-    want = None
+    pv = partition_values or {}
+    # `needed` = projection + filter refs (None = every schema column); the
+    # file itself only holds the non-partition subset.
+    needed = None
     if task.pushdowns.columns is not None:
-        want = list(dict.fromkeys(list(task.pushdowns.columns) + _filter_ref_columns(task)))
+        needed = list(dict.fromkeys(list(task.pushdowns.columns) + _filter_ref_columns(task)))
+    file_cols = None if needed is None else [c for c in needed if c not in pv]
     pf = pq.ParquetFile(fs.open_input_file(p))
+    # Metadata-borne partition columns are injected as constants, cast to the
+    # table schema's dtype, in schema column order (table formats).
+    inject = [c for c in pv
+              if c in task.schema and (needed is None or c in needed)]
     try:
         # Row-group pruning via parquet statistics (reference:
         # src/daft-parquet/src/statistics) happens inside read_row_groups with
         # filters; here we stream batches with column pruning.
-        for batch in pf.iter_batches(batch_size=morsel_rows, columns=want, use_threads=True):
-            rb = RecordBatch.from_arrow_table(pa.Table.from_batches([batch]))
+        for batch in pf.iter_batches(batch_size=morsel_rows, columns=file_cols,
+                                     use_threads=True):
+            tbl = pa.Table.from_batches([batch])
+            if inject:
+                for c in inject:
+                    if c in tbl.column_names:
+                        continue
+                    atype = task.schema[c].dtype.to_arrow()
+                    v = pv[c]
+                    tbl = tbl.append_column(
+                        pa.field(c, atype),
+                        pa.nulls(len(tbl), atype) if v is None
+                        else pa.array([v] * len(tbl), atype))
+                present = set(tbl.column_names)
+                order = (needed if needed is not None
+                         else [f.name for f in task.schema])
+                tbl = tbl.select([c for c in order if c in present])
+            rb = RecordBatch.from_arrow_table(tbl)
             yield MicroPartition.from_record_batches([rb])
     finally:
         pf.close()

@@ -84,14 +84,44 @@ def _integration_read(name: str, required: str):
     )
 
 
-def read_iceberg(table, **kwargs):
-    """Apache Iceberg tables (reference: daft.read_iceberg)."""
-    return _integration_read("iceberg", "pyiceberg")
+def _table_format_df(schema, files, read_options=None) -> DataFrame:
+    from daft_tpu.io.scan import FileInfo
+
+    infos = [FileInfo(f["path"], size_bytes=f.get("size"),
+                      num_rows=f.get("num_records"),
+                      partition_values=f.get("partition_values") or None)
+             for f in files]
+    info = ScanInfo([f["path"] for f in files], "parquet", schema,
+                    read_options or {}, files=infos)
+    return DataFrame(LogicalPlanBuilder.scan(info))
 
 
-def read_deltalake(table, **kwargs):
-    """Delta Lake tables (reference: daft.read_deltalake)."""
-    return _integration_read("deltalake", "deltalake")
+def read_iceberg(table, snapshot_id: Optional[int] = None, io_config=None,
+                 **kwargs) -> DataFrame:
+    """Apache Iceberg tables, reading the metadata/manifest chain natively
+    (reference: daft.read_iceberg via pyiceberg; here
+    daft_tpu/io/iceberg.py parses metadata JSON + Avro manifests directly).
+    Accepts a table path or a pyiceberg-style object exposing
+    ``metadata_location``."""
+    from daft_tpu.io.iceberg import load_table
+
+    location = getattr(table, "metadata_location", None) or table
+    snap = load_table(location, snapshot_id=snapshot_id, io_config=io_config)
+    return _table_format_df(snap.schema, snap.files,
+                            {"io_config": io_config} if io_config else None)
+
+
+def read_deltalake(table, version: Optional[int] = None, io_config=None,
+                   **kwargs) -> DataFrame:
+    """Delta Lake tables via native _delta_log replay
+    (reference: daft.read_deltalake; impl daft_tpu/io/delta.py). Accepts a
+    table path or a deltalake-style object exposing ``table_uri``."""
+    from daft_tpu.io.delta import load_snapshot
+
+    uri = getattr(table, "table_uri", None) or table
+    snap = load_snapshot(uri, version=version, io_config=io_config)
+    return _table_format_df(snap.schema, snap.files,
+                            {"io_config": io_config} if io_config else None)
 
 
 def read_lance(url, **kwargs):
@@ -99,9 +129,14 @@ def read_lance(url, **kwargs):
     return _integration_read("lance", "pylance")
 
 
-def read_hudi(table_uri, **kwargs):
-    """Apache Hudi tables (reference: daft.read_hudi)."""
-    return _integration_read("hudi", "hudi")
+def read_hudi(table_uri, io_config=None, **kwargs) -> DataFrame:
+    """Apache Hudi copy-on-write tables via native .hoodie timeline replay
+    (reference: daft.read_hudi; impl daft_tpu/io/hudi.py)."""
+    from daft_tpu.io.hudi import load_table
+
+    snap = load_table(table_uri, io_config=io_config)
+    return _table_format_df(snap.schema, snap.files,
+                            {"io_config": io_config} if io_config else None)
 
 
 def read_sql(sql_query: str, conn, **kwargs):

@@ -42,6 +42,11 @@ class FileInfo:
     path: str
     size_bytes: Optional[int] = None
     num_rows: Optional[int] = None
+    # Table formats (delta/iceberg/hudi) carry per-file partition values that
+    # live in the metadata layer, not the data file; the parquet reader
+    # injects them as constant columns (reference: daft/io/_deltalake.py
+    # partition handling via the scan builder).
+    partition_values: Optional[Dict[str, Any]] = None
 
 
 @dataclass

@@ -83,7 +83,11 @@ def _rewrite_top_down(node: lp.LogicalPlan, rule: Rule,
 
 
 class Optimizer:
-    MAX_PASSES = 5
+    # Rules like PushDownFilter move a predicate ONE level per pass; deep
+    # join chains (TPC-H Q8 has 8 relations ⇒ 7 join levels) need at least
+    # that many passes to carry a filter to its leaf. Batches exit early at
+    # fixed point, so the ceiling only bounds pathological non-convergence.
+    MAX_PASSES = 24
 
     def __init__(self, cfg=None):
         from daft_tpu.context import get_context
@@ -93,7 +97,8 @@ class Optimizer:
             [UnnestSubqueries()],
             [SimplifyExpressions()],
             [SplitUDFs()],
-            [EliminateCrossJoin(), PushDownFilter(), PushDownShard(), DropRepartition()],
+            [EliminateCrossJoin(), PushDownFilter(), PushDownSemiAnti(),
+             PushDownShard(), DropRepartition()],
             [PushDownLimit()],
             [ReorderJoins(self.cfg)],
             [PushDownProjection()],
@@ -399,6 +404,53 @@ class DropRepartition(Rule):
         return None
 
 
+class PushDownSemiAnti(Rule):
+    """Push semi/anti joins toward the relation producing their keys
+    (reference: rules/push_down_anti_semi_join.rs). A semi/anti join only
+    filters left rows, so it commutes below projections, filters, and the
+    key-owning side of inner/left joins — without this, a subquery's
+    semi join runs over a fully-joined intermediate (TPC-H Q18: the
+    60-order `IN` filter otherwise applies AFTER customer⋈orders⋈lineitem)."""
+
+    name = "PushDownSemiAnti"
+    top_down = True
+
+    def rewrite(self, node):
+        if not isinstance(node, lp.Join) or node.how not in ("semi", "anti"):
+            return None
+        left, right = node.children()
+        keys = set()
+        for e in node.left_on:
+            keys |= e.column_refs()
+        if not keys:
+            return None
+        if isinstance(left, lp.Project):
+            mapping = {e.name(): _strip_alias(e) for e in left.exprs}
+            if all(isinstance(mapping.get(k), ColumnRef) for k in keys):
+                ref_map = {k: mapping[k] for k in keys}
+                new_on = [_substitute(e, ref_map) for e in node.left_on]
+                inner = lp.Join(left.children()[0], right, new_on,
+                                list(node.right_on), node.how)
+                return lp.Project(inner, left.exprs)
+            return None
+        # NOTE: no Filter branch here — hoisting a filter above the semi join
+        # would be the exact inverse of PushDownFilter's join branch (which
+        # already pushes filters below semi/anti joins) and the two rules
+        # would ping-pong without converging.
+        if isinstance(left, lp.Join) and left.how in ("inner", "left", "semi", "anti"):
+            a, b = left.children()
+            if keys <= set(a.schema.column_names()):
+                new_a = lp.Join(a, right, list(node.left_on),
+                                list(node.right_on), node.how)
+                return left.with_children([new_a, b])
+            if left.how == "inner" and keys <= set(b.schema.column_names()) \
+                    and not (keys & set(a.schema.column_names())):
+                new_b = lp.Join(b, right, list(node.left_on),
+                                list(node.right_on), node.how)
+                return left.with_children([a, new_b])
+        return None
+
+
 class PushDownProjection(Rule):
     """Column pruning: intersect each scan's columns with what the plan above
     actually reads (reference: rules/push_down_projection.rs)."""
@@ -497,6 +549,14 @@ class UnnestSubqueries(Rule):
         original_cols = [f.name for f in base.schema]
         conjuncts: List[Expr] = []
         _flatten_and(node.predicate, conjuncts)
+        # Plain conjuncts filter the base BEFORE any subquery join: the
+        # row-id technique wraps base in MonotonicallyIncreasingId, which
+        # blocks later filter pushdown, so filtering afterwards would run
+        # the semi/anti matching over the full unfiltered input.
+        plain = [c for c in conjuncts if not c.has_subquery()]
+        conjuncts = [c for c in conjuncts if c.has_subquery()]
+        if plain:
+            base = lp.Filter(base, _and_all(plain))
         remaining: List[Expr] = []
         self._counter = 0
         for c in conjuncts:
@@ -765,13 +825,18 @@ class ReorderJoins(Rule):
         return ("R", id(n))
 
     @staticmethod
-    def _ndv(rel, e) -> Optional[float]:
-        """Actual number-of-distinct-values of a join key when the relation's
-        data is already in memory (reference: EnrichWithStats feeding the
-        join-order cost model). Low-cardinality keys (e.g. nationkey) are
-        exactly where the rows-as-NDV proxy causes catastrophic orders."""
-        if not isinstance(e, ColumnRef):
+    def _ndv(rel, exprs) -> Optional[float]:
+        """Actual number-of-distinct-values of a join key (single column or
+        composite tuple) when the relation's data is already in memory
+        (reference: EnrichWithStats feeding the join-order cost model).
+        Low-cardinality keys (e.g. nationkey) are exactly where the
+        rows-as-NDV proxy causes catastrophic orders. Measured on the BASE
+        source beneath any filters: the System-R containment formula wants
+        the key space, while filter effects enter through the row counts."""
+        if not all(isinstance(e, ColumnRef) for e in exprs):
             return None
+        while isinstance(rel, lp.Filter):
+            rel = rel.children()[0]
         if not isinstance(rel, lp.InMemorySource):
             return None
         total_rows = sum(len(p) for p in rel.partitions)
@@ -781,9 +846,15 @@ class ReorderJoins(Rule):
             import pyarrow as pa
             import pyarrow.compute as pc
 
-            chunks = [p.combined().get_column(e.name_).to_arrow()
-                      for p in rel.partitions]
-            return float(pc.count_distinct(pa.chunked_array(chunks)).as_py())
+            names = [e.name_ for e in exprs]
+            if len(names) == 1:
+                chunks = [p.combined().get_column(names[0]).to_arrow()
+                          for p in rel.partitions]
+                return float(pc.count_distinct(pa.chunked_array(chunks)).as_py())
+            tables = [pa.table({n: p.combined().get_column(n).to_arrow()
+                                for n in names}) for p in rel.partitions]
+            combined = pa.concat_tables(tables)
+            return float(combined.group_by(names).aggregate([]).num_rows)
         except Exception:
             return None
 
@@ -794,10 +865,10 @@ class ReorderJoins(Rule):
         rows = [max(r.approx_stats().num_rows, 1.0) for r in relations]
         ndv_cache: dict = {}
 
-        def ndv(idx, e):
-            key = (idx, e.key())
+        def ndv(idx, exprs):
+            key = (idx, tuple(e.key() for e in exprs))
             if key not in ndv_cache:
-                ndv_cache[key] = self._ndv(relations[idx], e)
+                ndv_cache[key] = self._ndv(relations[idx], exprs)
             return ndv_cache[key]
         # Connectivity + per-pair selectivity from edges. Each equi-key pair
         # contributes 1/max(distinct) ~ 1/max(rows) of the smaller side —
@@ -808,20 +879,33 @@ class ReorderJoins(Rule):
             best[1 << i] = (0.0, rows[i], i)
 
         def join_sel(mask_a, mask_b):
-            found = False
-            sel = 1.0
+            # System-R: |L||R| / max(V(L,a), V(R,b)) — but edges between the
+            # SAME relation pair form one composite key, so their NDVs
+            # multiply per side and cap at that side's cardinality (naive
+            # per-edge independence estimated lineitem⋈partsupp on
+            # (suppkey, partkey) at ~0.04% of its true size, inverting the
+            # whole TPC-H Q9 join order). Distinct relation pairs still
+            # multiply independently.
+            groups: dict = {}
             for li, ri, le, re_ in edges:
                 if ((mask_a >> li) & 1 and (mask_b >> ri) & 1) or \
                    ((mask_b >> li) & 1 and (mask_a >> ri) & 1):
-                    found = True
-                    # System-R: |L||R| / max(V(L,a), V(R,b)). Use measured
-                    # NDV where available; otherwise the smaller relation's
-                    # cardinality (exact for FK->PK joins).
-                    vl, vr = ndv(li, le), ndv(ri, re_)
-                    known = [v for v in (vl, vr) if v]
-                    v = max(known) if known else min(rows[li], rows[ri])
-                    sel *= 1.0 / max(v, 1.0)
-            return sel if found else None
+                    groups.setdefault((li, ri), []).append((le, re_))
+            if not groups:
+                return None
+            sel = 1.0
+            for (li, ri), pairs in groups.items():
+                # Key space per side: measured NDV (composite measured as a
+                # tuple — per-column independence overestimates FK pair
+                # spaces by orders of magnitude). Sides without measurable
+                # data contribute nothing; with no measurement at all, fall
+                # back to the smaller side's cardinality (exact for FK→PK).
+                vl = ndv(li, [p[0] for p in pairs])
+                vr = ndv(ri, [p[1] for p in pairs])
+                known = [v for v in (vl, vr) if v]
+                v = max(known) if known else min(rows[li], rows[ri])
+                sel *= 1.0 / max(v, 1.0)
+            return sel
 
         full = (1 << n) - 1
         # Enumerate subsets by popcount so splits are ready.

@@ -91,50 +91,71 @@ def _src_alias(src) -> str:
 
 
 def _plan_from(stmt: SelectStmt, bindings, ctes, session=None):
-    """Plan the FROM clause + JOINs; returns (df, alias_names)."""
+    """Plan the FROM clause + JOINs; returns (df, scope).
+
+    ``scope`` maps each table alias (or name) to {source column → output
+    column}: joins rename collision columns (``<alias>.<col>``), and
+    qualified references MUST resolve through this mapping — stripping the
+    qualifier silently rebinds ``m.name`` to the left side in self-joins."""
     from daft_tpu.expressions.expression import Expression
 
     if stmt.source is None:
         # SELECT without FROM: single-row evaluation.
         import daft_tpu
 
-        return daft_tpu.from_pydict({"__dummy": [1]}), set()
+        return daft_tpu.from_pydict({"__dummy": [1]}), {}
     df = _resolve_source(stmt.source, bindings, ctes, session)
-    aliases = {_src_alias(stmt.source)}
+    a0 = _src_alias(stmt.source)
+    scope: dict = {a0: {c: c for c in df.column_names}}
     for join in stmt.joins:
         right = _resolve_source(join.right, bindings, ctes, session)
-        aliases.add(_src_alias(join.right))
+        ra = _src_alias(join.right)
+        right_names = list(right.column_names)
+        left_names = set(df.column_names)
+        merged: set = set()
         if join.how == "cross":
-            df = df.cross_join(right)
-            continue
-        if join.using:
-            df = df.join(right, on=join.using, how=join.how)
-            continue
-        left_on, right_on, lf, rf = _split_join_condition(join.on, df, right, join.how)
-        for f in lf:
-            df = df.where(Expression(f))
-        for f in rf:
-            right = right.where(Expression(f))
-        df = df.join(
-            right,
-            left_on=[Expression(e) for e in left_on],
-            right_on=[Expression(e) for e in right_on],
-            how=join.how,
-        )
-    return df, aliases
+            df = df.cross_join(right, suffix=f"{ra}.")
+        elif join.using:
+            df = df.join(right, on=join.using, how=join.how, suffix=f"{ra}.")
+            merged = set(join.using)
+        else:
+            left_on, right_on, lf, rf = _split_join_condition(
+                join.on, df, right, join.how, scope, ra)
+            for f in lf:
+                df = df.where(Expression(f))
+            for f in rf:
+                right = right.where(Expression(f))
+            df = df.join(
+                right,
+                left_on=[Expression(e) for e in left_on],
+                right_on=[Expression(e) for e in right_on],
+                how=join.how,
+                suffix=f"{ra}.",
+            )
+            merged = {r.name() for l, r in zip(left_on, right_on)
+                      if isinstance(l, ColumnRef) and isinstance(r, ColumnRef)
+                      and l.name_ == r.name_}
+        if join.how in ("semi", "anti"):
+            scope[ra] = {}  # right columns do not survive semi/anti joins
+        else:
+            scope[ra] = {c: (c if c in merged or c not in left_names
+                             else f"{ra}.{c}")
+                         for c in right_names}
+    return df, scope
 
 
 def _plan_select(stmt: SelectStmt, bindings, ctes, session=None):
     from daft_tpu.expressions.expression import Expression
 
-    df, aliases = _plan_from(stmt, bindings, ctes, session)
+    df, scope = _plan_from(stmt, bindings, ctes, session)
     # Table-qualifier resolution: `t.c` parses as struct_get(col(t), name=c);
-    # when t is a table name/alias rather than a struct column, rewrite to
-    # col(c) (reference: qualified-identifier binding in daft-sql's planner).
+    # when t is a table name/alias rather than a struct column, resolve
+    # through the FROM scope's rename map (reference: qualified-identifier
+    # binding in daft-sql's planner).
     colnames = set(df.column_names)
-    dequal = lambda e: _dequalify(e, colnames)
+    dequal = lambda e: _dequalify(e, colnames, scope)
     if stmt.where is not None:
-        w = _resolve_subqueries(dequal(stmt.where), df, aliases, bindings, ctes, session)
+        w = _resolve_subqueries(dequal(stmt.where), df, scope, bindings, ctes, session)
         df = df.where(Expression(w))
 
     # Projections: expand *, attach aliases.
@@ -149,7 +170,7 @@ def _plan_select(stmt: SelectStmt, bindings, ctes, session=None):
             proj_exprs.append(Alias(e, alias) if alias else e)
     stmt.group_by = [dequal(g) for g in stmt.group_by]
     if stmt.having is not None:
-        stmt.having = _resolve_subqueries(dequal(stmt.having), df, aliases,
+        stmt.having = _resolve_subqueries(dequal(stmt.having), df, scope,
                                           bindings, ctes, session)
     for o in stmt.order_by:
         o.expr = dequal(o.expr)
@@ -258,11 +279,19 @@ def _strip_alias(e: Expr) -> Expr:
     return e
 
 
-def _split_join_condition(on: Optional[Expr], left_df, right_df, how: str = "inner"):
+def _split_join_condition(on: Optional[Expr], left_df, right_df,
+                          how: str = "inner", scope=None,
+                          right_alias: Optional[str] = None):
     """Decompose an ON condition into (left_on, right_on, left_filters,
     right_filters). Single-side non-equi conjuncts become prefilters on that
     side when that is semantics-preserving (always for inner; for outer joins
-    only the side whose unmatched rows are dropped anyway)."""
+    only the side whose unmatched rows are dropped anyway).
+
+    Qualified refs resolve against ``scope`` (the accumulated left side) and
+    ``right_alias`` — qualifiers are authoritative about which side a column
+    comes from, which name-membership alone cannot decide in self-joins."""
+    from daft_tpu.expressions.expr import FunctionCall
+
     if on is None:
         raise DaftValueError("JOIN requires ON or USING")
     conjuncts: List[Expr] = []
@@ -277,26 +306,72 @@ def _split_join_condition(on: Optional[Expr], left_df, right_df, how: str = "inn
     flatten(on)
     left_names = set(left_df.column_names)
     right_names = set(right_df.column_names)
+    scope = scope or {}
+
+    def resolve(e: Expr):
+        """Resolve qualifiers → (expr, side tags from qualifiers)."""
+        sides = set()
+
+        def rw(n: Expr):
+            if isinstance(n, FunctionCall) and n.fn_name == "struct_get" \
+                    and len(n.args) == 1:
+                q = n.args[0]
+                if isinstance(q, ColumnRef) and q.name_ not in left_names \
+                        and q.name_ not in right_names:
+                    c = n.kwargs["name"]
+                    if q.name_ == right_alias and c in right_names:
+                        sides.add("right")
+                        return ColumnRef(c)
+                    if q.name_ in scope and c in scope[q.name_]:
+                        sides.add("left")
+                        return ColumnRef(scope[q.name_][c])
+                    return ColumnRef(c)
+            return None
+
+        return e.transform(rw), sides
+
+    def side_of(expr: Expr, sides) -> str:
+        if sides == {"left"}:
+            return "l"
+        if sides == {"right"}:
+            return "r"
+        if len(sides) > 1:
+            return "mixed"
+        refs = expr.column_refs()
+        in_l, in_r = refs <= left_names, refs <= right_names
+        if in_l and not in_r:
+            return "l"
+        if in_r and not in_l:
+            return "r"
+        if in_l and in_r:
+            return "either"
+        return "mixed"
+
     left_on, right_on = [], []
     left_filters, right_filters = [], []
     for c in conjuncts:
-        cq = _strip_qualifier(c)
-        refs = cq.column_refs()
         if isinstance(c, BinaryOp) and c.op == "eq":
-            l, r = _strip_qualifier(c.left), _strip_qualifier(c.right)
-            l_refs, r_refs = l.column_refs(), r.column_refs()
-            if l_refs <= left_names and r_refs <= right_names:
+            l, ls = resolve(c.left)
+            r, rs = resolve(c.right)
+            sl, sr = side_of(l, ls), side_of(r, rs)
+            if sl in ("l", "either") and sr in ("r", "either"):
                 left_on.append(l)
                 right_on.append(r)
                 continue
-            if l_refs <= right_names and r_refs <= left_names:
+            if sl == "r" and sr in ("l", "either") or \
+                    sl == "either" and sr == "l":
                 left_on.append(r)
                 right_on.append(l)
                 continue
-        if refs <= right_names and how in ("inner", "left", "semi", "anti"):
+            cq = BinaryOp("eq", l, r)
+            side = sl if sl == sr else ("mixed" if "mixed" in (sl, sr) else sl)
+        else:
+            cq, tags = resolve(c)
+            side = side_of(cq, tags)
+        if side in ("r", "either") and how in ("inner", "left", "semi", "anti"):
             right_filters.append(cq)
             continue
-        if refs <= left_names and how in ("inner", "right"):
+        if side in ("l", "either") and how in ("inner", "right"):
             left_filters.append(cq)
             continue
         raise DaftValueError(
@@ -305,15 +380,22 @@ def _split_join_condition(on: Optional[Expr], left_df, right_df, how: str = "inn
     return left_on, right_on, left_filters, right_filters
 
 
-def _dequalify(e: Expr, column_names: set) -> Expr:
-    """struct_get(col(q), name=c) -> col(c) when q is not a real column."""
+def _dequalify(e: Expr, column_names: set, scope=None) -> Expr:
+    """struct_get(col(q), name=c) -> the column ``q.c`` resolves to when q is
+    a table alias (via ``scope``'s rename map), else col(c) when q is not a
+    real column."""
     from daft_tpu.expressions.expr import FunctionCall
+
+    scope = scope or {}
 
     def rw(n: Expr):
         if isinstance(n, FunctionCall) and n.fn_name == "struct_get" and len(n.args) == 1:
             inner = n.args[0]
             if isinstance(inner, ColumnRef) and inner.name_ not in column_names:
-                return ColumnRef(n.kwargs["name"])
+                c = n.kwargs["name"]
+                if inner.name_ in scope:
+                    return ColumnRef(scope[inner.name_].get(c, c))
+                return ColumnRef(c)
         return None
 
     return e.transform(rw)
@@ -338,20 +420,20 @@ def _strip_qualifier(e: Expr) -> Expr:
 # Subquery resolution (reference: src/daft-sql/src/planner.rs subquery     #
 # lowering + src/daft-logical-plan rules/unnest_subquery.rs)               #
 # ---------------------------------------------------------------------- #
-def _resolve_subqueries(e: Expr, outer_df, outer_aliases, bindings, ctes, session):
+def _resolve_subqueries(e: Expr, outer_df, outer_scope, bindings, ctes, session):
     """Replace parser-level SubqueryExpr holders inside `e` with planned
     Subquery/InSubquery/Exists nodes, extracting correlated predicates
     against `outer_df`'s scope."""
 
     def rw(n: Expr):
         if isinstance(n, SubqueryExpr):
-            return _plan_subquery(n, outer_df, outer_aliases, bindings, ctes, session)
+            return _plan_subquery(n, outer_df, outer_scope, bindings, ctes, session)
         return None
 
     return e.transform(rw)
 
 
-def _plan_subquery(holder: SubqueryExpr, outer_df, outer_aliases, bindings, ctes, session):
+def _plan_subquery(holder: SubqueryExpr, outer_df, outer_scope, bindings, ctes, session):
     from daft_tpu.expressions.expression import Expression
 
     stmt = holder.stmt
@@ -361,7 +443,7 @@ def _plan_subquery(holder: SubqueryExpr, outer_df, outer_aliases, bindings, ctes
         # Uncorrelated-only path: delegate to the full SELECT planner. Any
         # reference into the outer scope would be silently rebound to a
         # same-named inner column by _dequalify — reject it up front.
-        _reject_correlation(stmt, outer_df, outer_aliases, bindings, ctes, session)
+        _reject_correlation(stmt, outer_df, outer_scope, bindings, ctes, session)
         inner = _plan_select(stmt, bindings, ctes, session)
         plan = inner._builder.plan
         names = plan.schema.column_names()
@@ -375,9 +457,9 @@ def _plan_subquery(holder: SubqueryExpr, outer_df, outer_aliases, bindings, ctes
                               (), holder.negated)
         return Subquery(plan, ColumnRef(names[0]))
 
-    inner_df, inner_aliases = _plan_from(stmt, bindings, ctes, session)
+    inner_df, inner_scope = _plan_from(stmt, bindings, ctes, session)
     filters, corr, extra = _classify_where(
-        stmt.where, inner_df, inner_aliases, outer_df, outer_aliases,
+        stmt.where, inner_df, inner_scope, outer_df, outer_scope,
         bindings, ctes, session)
     for f in filters:
         inner_df = inner_df.where(Expression(f))
@@ -392,7 +474,7 @@ def _plan_subquery(holder: SubqueryExpr, outer_df, outer_aliases, bindings, ctes
         if holder.kind == "in":
             raise DaftValueError("IN subquery must select exactly one column")
         raise DaftValueError("scalar subquery must select exactly one expression")
-    value = _dequalify_aliases(projs[0][0], set(inner_df.column_names), inner_aliases)
+    value = _dequalify(projs[0][0], set(inner_df.column_names), inner_scope)
     if holder.kind == "in":
         return InSubquery(holder.operand, plan, value, corr, holder.negated, extra)
     if extra:
@@ -401,11 +483,11 @@ def _plan_subquery(holder: SubqueryExpr, outer_df, outer_aliases, bindings, ctes
     return Subquery(plan, value, corr)
 
 
-def _reject_correlation(stmt, outer_df, outer_aliases, bindings, ctes, session):
+def _reject_correlation(stmt, outer_df, outer_scope, bindings, ctes, session):
     """Raise when a GROUP BY/HAVING/ORDER BY/LIMIT subquery references the
     outer scope — decorrelation of those shapes is not supported, and letting
     them through would silently rebind outer refs to inner columns."""
-    inner_df, inner_aliases = _plan_from(stmt, bindings, ctes, session)
+    inner_df, inner_scope = _plan_from(stmt, bindings, ctes, session)
     inner_cols = set(inner_df.column_names)
     outer_cols = set(outer_df.column_names)
     exprs = [e for e, _ in stmt.projections if e is not None]
@@ -418,23 +500,24 @@ def _reject_correlation(stmt, outer_df, outer_aliases, bindings, ctes, session):
                     and len(n.args) == 1:
                 q = n.args[0]
                 if isinstance(q, ColumnRef) and q.name_ not in inner_cols \
-                        and q.name_ not in inner_aliases and q.name_ in outer_aliases:
+                        and q.name_ not in inner_scope and q.name_ in outer_scope:
                     raise DaftValueError(
                         f"correlated reference {q.name_}.{n.kwargs['name']} is not "
                         "supported in subqueries with GROUP BY/HAVING/ORDER BY/LIMIT")
             elif isinstance(n, ColumnRef):
-                if n.name_ not in inner_cols and n.name_ not in inner_aliases \
+                if n.name_ not in inner_cols and n.name_ not in inner_scope \
                         and n.name_ in outer_cols:
                     raise DaftValueError(
                         f"correlated reference {n.name_!r} is not supported in "
                         "subqueries with GROUP BY/HAVING/ORDER BY/LIMIT")
 
 
-def _classify_where(where, inner_df, inner_aliases, outer_df, outer_aliases,
+def _classify_where(where, inner_df, inner_scope, outer_df, outer_scope,
                     bindings, ctes, session):
     """Split a subquery's WHERE into (inner filters, correlated equality
     pairs, non-equi correlated predicates). Inner refs win over outer refs
-    for both qualifiers and bare names (SQL scoping)."""
+    for both qualifiers and bare names (SQL scoping); qualified refs go
+    through the owning scope's rename map."""
     if where is None:
         return [], [], []
     inner_cols = set(inner_df.column_names)
@@ -445,11 +528,14 @@ def _classify_where(where, inner_df, inner_aliases, outer_df, outer_aliases,
             if isinstance(n, FunctionCall) and n.fn_name == "struct_get" and len(n.args) == 1:
                 q = n.args[0]
                 if isinstance(q, ColumnRef) and q.name_ not in inner_cols:
-                    if q.name_ in inner_aliases:
-                        return ColumnRef(n.kwargs["name"])
-                    if q.name_ in outer_aliases or q.name_ in outer_cols:
-                        return _OuterRef(n.kwargs["name"])
-                    return ColumnRef(n.kwargs["name"])
+                    c = n.kwargs["name"]
+                    if q.name_ in inner_scope:
+                        return ColumnRef(inner_scope[q.name_].get(c, c))
+                    if q.name_ in outer_scope:
+                        return _OuterRef(outer_scope[q.name_].get(c, c))
+                    if q.name_ in outer_cols:
+                        return _OuterRef(c)
+                    return ColumnRef(c)
             elif isinstance(n, ColumnRef):
                 if n.name_ not in inner_cols and n.name_ in outer_cols:
                     return _OuterRef(n.name_)
@@ -474,7 +560,7 @@ def _classify_where(where, inner_df, inner_aliases, outer_df, outer_aliases,
         c = scope(c)
         outers = [x for x in c.walk() if isinstance(x, _OuterRef)]
         if not outers:
-            filters.append(_resolve_subqueries(c, inner_df, inner_aliases,
+            filters.append(_resolve_subqueries(c, inner_df, inner_scope,
                                                bindings, ctes, session))
             continue
         if c.has_subquery() or any(isinstance(x, SubqueryExpr) for x in c.walk()):
@@ -515,14 +601,3 @@ def _outer_to_col(e: Expr) -> Expr:
     return e.transform(rw)
 
 
-def _dequalify_aliases(e: Expr, inner_cols: set, inner_aliases: set) -> Expr:
-    """Qualifier resolution for a subquery's projection expression."""
-
-    def rw(n: Expr):
-        if isinstance(n, FunctionCall) and n.fn_name == "struct_get" and len(n.args) == 1:
-            q = n.args[0]
-            if isinstance(q, ColumnRef) and q.name_ not in inner_cols:
-                return ColumnRef(n.kwargs["name"])
-        return None
-
-    return e.transform(rw)

@@ -122,11 +122,17 @@ def test_describe_summarize_into_batches():
 
 
 def test_integration_reader_stubs():
-    for name in ("read_iceberg", "read_deltalake", "read_lance", "read_hudi",
-                 "read_huggingface"):
+    # lance/huggingface remain gated on unavailable integrations; iceberg /
+    # deltalake / hudi are native readers now (tests/test_table_formats.py)
+    # and fail on a non-table path instead.
+    for name in ("read_lance", "read_huggingface"):
         fn = getattr(daft_tpu, name)
         with pytest.raises(Exception, match="integration"):
             fn("anything")
+    for name in ("read_iceberg", "read_deltalake", "read_hudi"):
+        fn = getattr(daft_tpu, name)
+        with pytest.raises(Exception):
+            fn("/nonexistent-table-path")
 
 
 def test_read_sql_dbapi():

@@ -261,3 +261,31 @@ def test_sql_scalar_subquery_in_having(subq_tables):
         HAVING sum(total) > (SELECT sum(total) * 0.5 FROM orders)""",
         orders=orders).to_pydict()
     assert out["c_id"] == [3]
+
+
+def test_sql_self_join_aliases():
+    """Qualified refs in self-joins must bind per alias — stripping the
+    qualifier silently rebinds m.name to the left side (round-2 regression)."""
+    emp = daft_tpu.from_pydict({"id": [1, 2], "mgr": [2, 1], "name": ["a", "b"]})
+    out = daft_tpu.sql("""
+        SELECT e.name, m.name AS mgr_name FROM emp e
+        JOIN emp m ON e.mgr = m.id WHERE m.name = 'a'""", emp=emp).to_pydict()
+    assert out == {"name": ["b"], "mgr_name": ["a"]}
+
+
+def test_sql_self_join_qualified_select_and_order():
+    emp = daft_tpu.from_pydict({"id": [1, 2, 3], "mgr": [2, 3, 1],
+                                "sal": [10, 20, 30]})
+    out = daft_tpu.sql("""
+        SELECT e.id, e.sal, m.sal AS mgr_sal FROM emp e
+        JOIN emp m ON e.mgr = m.id ORDER BY m.sal DESC""", emp=emp).to_pydict()
+    assert out == {"id": [2, 1, 3], "sal": [20, 10, 30], "mgr_sal": [30, 20, 10]}
+
+
+def test_sql_qualified_ambiguous_key_both_sides():
+    """ON m.id = e.id with both names on both sides: qualifiers decide."""
+    t = daft_tpu.from_pydict({"id": [1, 2], "v": [10, 20]})
+    out = daft_tpu.sql("""
+        SELECT a.v, b.v AS bv FROM t a JOIN t b ON a.id = b.id
+        ORDER BY a.v""", t=t).to_pydict()
+    assert out == {"v": [10, 20], "bv": [10, 20]}
