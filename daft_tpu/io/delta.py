@@ -26,7 +26,8 @@ from daft_tpu.errors import DaftIOError, DaftValueError
 from daft_tpu.schema import Field, Schema
 
 _COMMIT_RE = re.compile(r"^(\d{20})\.json$")
-_CHECKPOINT_RE = re.compile(r"^(\d{20})\.checkpoint(?:\.\d{10}\.\d{10})?\.parquet$")
+_CHECKPOINT_RE = re.compile(
+    r"^(\d{20})\.checkpoint(?:\.(\d{10})\.(\d{10}))?\.parquet$")
 
 
 # --------------------------------------------------------------------- #
@@ -137,12 +138,15 @@ class DeltaSnapshot:
     metadata: Dict[str, Any] = field(default_factory=dict)
 
 
-def _list_log(fs, log_dir: str) -> Tuple[List[Tuple[int, str]], List[Tuple[int, str]]]:
+def _list_log(fs, log_dir: str) -> Tuple[List[Tuple[int, str]], List[Tuple[int, str, Optional[int]]]]:
+    """List commit and checkpoint files. Checkpoints carry their declared
+    part-total (from the ``NNN.checkpoint.<part>.<of>.parquet`` name) so the
+    replay can reject half-written multi-part checkpoints."""
     import pyarrow.fs as pafs
 
     sel = pafs.FileSelector(log_dir, allow_not_found=True)
     commits: List[Tuple[int, str]] = []
-    checkpoints: List[Tuple[int, str]] = []
+    checkpoints: List[Tuple[int, str, Optional[int]]] = []
     for info in fs.get_file_info(sel):
         base = os.path.basename(info.path)
         m = _COMMIT_RE.match(base)
@@ -150,8 +154,40 @@ def _list_log(fs, log_dir: str) -> Tuple[List[Tuple[int, str]], List[Tuple[int, 
             commits.append((int(m.group(1)), info.path))
         m = _CHECKPOINT_RE.match(base)
         if m:
-            checkpoints.append((int(m.group(1)), info.path))
+            total = int(m.group(3)) if m.group(3) else None
+            checkpoints.append((int(m.group(1)), info.path, total))
     return sorted(commits), sorted(checkpoints)
+
+
+def _complete_checkpoints(fs, log_dir: str,
+                          checkpoints: List[Tuple[int, str, Optional[int]]]):
+    """Checkpoint versions whose parts are all present, each → sorted paths.
+    ``_last_checkpoint`` (when readable) pins the version writers consider
+    current; a version it names but whose parts are incomplete is rejected."""
+    by_version: Dict[int, List[Tuple[str, Optional[int]]]] = {}
+    for v, path, total in checkpoints:
+        by_version.setdefault(v, []).append((path, total))
+    complete: Dict[int, List[str]] = {}
+    for v, parts in by_version.items():
+        totals = {t for _, t in parts if t is not None}
+        declared = totals.pop() if len(totals) == 1 else (None if not totals else -1)
+        if declared == -1:  # conflicting part-totals: corrupt, skip
+            continue
+        if declared is not None and len(parts) != declared:
+            continue  # half-written multi-part checkpoint
+        complete[v] = sorted(p for p, _ in parts)
+    hint = f"{log_dir}/_last_checkpoint"
+    try:
+        if fs.get_file_info(hint).type.name != "NotFound":
+            with fs.open_input_stream(hint) as f:
+                rec = json.loads(f.read().decode())
+            v = rec.get("version")
+            n_parts = rec.get("parts")
+            if v in complete and n_parts and len(complete[v]) != n_parts:
+                del complete[v]
+    except (json.JSONDecodeError, OSError):
+        pass
+    return complete
 
 
 def _apply_action(state: Dict[str, Any], action: Dict[str, Any]) -> None:
@@ -167,7 +203,7 @@ def _apply_action(state: Dict[str, Any], action: Dict[str, Any]) -> None:
 
 
 def load_snapshot(table_uri: str, version: Optional[int] = None,
-                  io_config=None) -> DeltaSnapshot:
+                  io_config=None, _listing=None) -> DeltaSnapshot:
     """Replay the Delta log to the requested (or latest) version."""
     import pyarrow.parquet as pq
 
@@ -175,17 +211,18 @@ def load_snapshot(table_uri: str, version: Optional[int] = None,
 
     fs, root = resolve_filesystem(table_uri, io_config)
     log_dir = f"{root.rstrip('/')}/_delta_log"
-    commits, checkpoints = _list_log(fs, log_dir)
+    commits, checkpoints = _listing if _listing is not None \
+        else _list_log(fs, log_dir)
     if not commits and not checkpoints:
         raise DaftIOError(f"not a Delta table (no _delta_log): {table_uri}")
 
     state: Dict[str, Any] = {"files": {}, "metaData": None, "protocol": None}
     start_version = 0
-    usable = [c for c in checkpoints if version is None or c[0] <= version]
+    complete = _complete_checkpoints(fs, log_dir, checkpoints)
+    usable = [v for v in complete if version is None or v <= version]
     if usable:
-        ckpt_version = max(v for v, _ in usable)
-        parts = [p for v, p in usable if v == ckpt_version]
-        for p in sorted(parts):
+        ckpt_version = max(usable)
+        for p in complete[ckpt_version]:
             table = pq.read_table(fs.open_input_file(p))
             for row in table.to_pylist():
                 action = {k: v for k, v in row.items() if v is not None}
@@ -206,7 +243,7 @@ def load_snapshot(table_uri: str, version: Optional[int] = None,
                 if line.strip():
                     _apply_action(state, json.loads(line))
         last_seen = max(last_seen, v)
-    if version is not None and last_seen < version and not usable:
+    if version is not None and last_seen < version:
         raise DaftValueError(f"delta: version {version} not found (have <= {last_seen})")
 
     meta = state["metaData"]
@@ -268,19 +305,24 @@ def write_table(df, table_uri: str, mode: str = "append",
     if exists and mode == "error":
         raise DaftIOError(f"delta table already exists: {table_uri}")
     if exists and mode == "ignore":
-        return {"version": max(v for v, _ in commits), "paths": []}
+        current = load_snapshot(table_uri, io_config=io_config,
+                                _listing=(commits, checkpoints))
+        return {"version": current.version, "paths": []}
 
-    snapshot = load_snapshot(table_uri, io_config=io_config) if exists else None
+    snapshot = load_snapshot(table_uri, io_config=io_config,
+                             _listing=(commits, checkpoints)) if exists else None
     version = (snapshot.version + 1) if snapshot else 0
     part_cols = list(partition_cols or
                      (snapshot.partition_columns if snapshot else []))
 
     table = df.to_arrow()
     schema = Schema.from_arrow(table.schema)
-    if snapshot and [f.name for f in snapshot.schema] != [f.name for f in schema]:
-        raise DaftValueError(
-            f"delta: schema mismatch vs table "
-            f"({[f.name for f in snapshot.schema]} != {[f.name for f in schema]})")
+    if snapshot:
+        want = [(f.name, _dtype_to_delta(f.dtype)) for f in snapshot.schema]
+        got = [(f.name, _dtype_to_delta(f.dtype)) for f in schema]
+        if want != got:
+            raise DaftValueError(
+                f"delta: schema mismatch vs table ({want} != {got})")
 
     fs.create_dir(log_dir, recursive=True)
     import time as _time
@@ -354,8 +396,23 @@ def write_table(df, table_uri: str, mode: str = "append",
                                    "operationParameters": {"mode": mode},
                                    "engineInfo": "daft_tpu"}})
     commit_path = f"{log_dir}/{version:020d}.json"
-    if fs.get_file_info(commit_path).type.name != "NotFound":
-        raise DaftIOError(f"delta: concurrent commit at version {version}")
-    with fs.open_output_stream(commit_path) as f:
-        f.write(("\n".join(json.dumps(a) for a in actions) + "\n").encode())
+    payload = ("\n".join(json.dumps(a) for a in actions) + "\n").encode()
+    import pyarrow.fs as pafs
+
+    if isinstance(fs, pafs.LocalFileSystem):
+        # O_EXCL create: the commit either wins the version slot or raises —
+        # the Delta protocol's put-if-absent requirement.
+        try:
+            fd = os.open(commit_path, os.O_WRONLY | os.O_CREAT | os.O_EXCL)
+        except FileExistsError:
+            raise DaftIOError(f"delta: concurrent commit at version {version}")
+        with os.fdopen(fd, "wb") as f:
+            f.write(payload)
+    else:
+        # Object stores lack put-if-absent through pyarrow.fs; best-effort
+        # check-then-write (a true CAS needs a store-specific conditional put).
+        if fs.get_file_info(commit_path).type.name != "NotFound":
+            raise DaftIOError(f"delta: concurrent commit at version {version}")
+        with fs.open_output_stream(commit_path) as f:
+            f.write(payload)
     return {"version": version, "paths": written}

@@ -23,8 +23,6 @@ from daft_tpu.errors import DaftIOError
 from daft_tpu.schema import Schema
 
 _INSTANT_RE = re.compile(r"^(\d+)\.(commit|replacecommit)$")
-_FILENAME_RE = re.compile(r"^(?P<file_id>[^_]+(?:-[^_]+)*)_(?P<token>[^_]+)_"
-                          r"(?P<instant>\d+)\.parquet$")
 
 
 @dataclass

@@ -161,11 +161,15 @@ def load_table(location: str, snapshot_id: Optional[int] = None,
     if not part_specs and "partition-spec" in meta:  # v1
         part_specs = {0: {"spec-id": 0, "fields": meta["partition-spec"]}}
 
-    def identity_cols(spec_id: int) -> List[str]:
+    def identity_fields(spec_id: int) -> List[Tuple[str, str]]:
+        """(manifest partition-record key, current column name) pairs — the
+        manifest struct is keyed by the partition FIELD's immutable name,
+        while injection targets the (renamable) source column."""
         s = part_specs.get(spec_id)
         if not s:
             return []
-        return [field_names.get(f["source-id"], f["name"]) for f in s["fields"]
+        return [(f["name"], field_names.get(f["source-id"], f["name"]))
+                for f in s["fields"]
                 if f.get("transform", "identity") == "identity"]
 
     files: List[Dict[str, Any]] = []
@@ -180,7 +184,7 @@ def load_table(location: str, snapshot_id: Optional[int] = None,
             with fs.open_input_file(man_path) as f:
                 _, entries = read_avro(f.read())
             spec_id = m.get("partition_spec_id", 0)
-            part_cols = identity_cols(spec_id)
+            part_fields = identity_fields(spec_id)
             for e in entries:
                 if e.get("status") == 2:  # DELETED
                     continue
@@ -193,9 +197,9 @@ def load_table(location: str, snapshot_id: Optional[int] = None,
                     raise DaftIOError(f"iceberg: unsupported file format {fmt}")
                 part = df_.get("partition") or {}
                 pv = {}
-                for c in part_cols:
-                    if c in part:
-                        v = part[c]
+                for fname, c in part_fields:
+                    if fname in part:
+                        v = part[fname]
                         col_dt = schema[c].dtype.id.value if c in schema else None
                         if col_dt == "date" and isinstance(v, int):
                             import datetime
@@ -212,5 +216,6 @@ def load_table(location: str, snapshot_id: Optional[int] = None,
     default_spec_id = meta.get("default-spec-id", 0)
     return IcebergSnapshot(
         snapshot_id=None if snapshot is None else snapshot["snapshot-id"],
-        schema=schema, partition_columns=identity_cols(default_spec_id),
+        schema=schema,
+        partition_columns=[c for _, c in identity_fields(default_spec_id)],
         files=files, metadata=meta)

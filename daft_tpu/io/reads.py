@@ -87,6 +87,12 @@ def _integration_read(name: str, required: str):
 def _table_format_df(schema, files, read_options=None) -> DataFrame:
     from daft_tpu.io.scan import FileInfo
 
+    if not files:
+        # Valid empty table (e.g. Delta log with only protocol+metaData, or
+        # Iceberg with no current snapshot): empty frame with the schema.
+        from daft_tpu.dataframe.creation import from_arrow
+
+        return from_arrow(schema.to_arrow().empty_table())
     infos = [FileInfo(f["path"], size_bytes=f.get("size"),
                       num_rows=f.get("num_records"),
                       partition_values=f.get("partition_values") or None)

@@ -186,6 +186,60 @@ def test_delta_not_a_table(tmp_path):
         daft_tpu.read_deltalake(str(tmp_path))
 
 
+def test_delta_version_not_found_raises(tmp_path):
+    uri = str(tmp_path / "tbl")
+    daft_tpu.from_pydict({"id": [1]}).write_deltalake(uri)
+    with pytest.raises(Exception, match="version 99"):
+        daft_tpu.read_deltalake(uri, version=99)
+
+
+def test_delta_empty_table_read(tmp_path):
+    """A log with only protocol+metaData (no add) is a valid empty table."""
+    root = tmp_path / "tbl"
+    log = root / "_delta_log"
+    log.mkdir(parents=True)
+    schema_str = json.dumps({"type": "struct", "fields": [
+        {"name": "id", "type": "long", "nullable": True, "metadata": {}}]})
+    actions = [{"protocol": {"minReaderVersion": 1, "minWriterVersion": 2}},
+               {"metaData": {"id": "m", "schemaString": schema_str,
+                             "partitionColumns": []}}]
+    (log / f"{0:020d}.json").write_text(
+        "\n".join(json.dumps(a) for a in actions))
+    df = daft_tpu.read_deltalake(str(root))
+    assert df.column_names == ["id"]
+    assert df.to_pydict() == {"id": []}
+
+
+def test_delta_incomplete_multipart_checkpoint_skipped(tmp_path):
+    """A multi-part checkpoint missing parts must not be replayed; the JSON
+    commits still reconstruct the correct state."""
+    root = tmp_path / "tbl"
+    log = root / "_delta_log"
+    log.mkdir(parents=True)
+    pq.write_table(pa.table({"id": pa.array([1, 2], pa.int64())}),
+                   str(root / "f0.parquet"))
+    schema_str = json.dumps({"type": "struct", "fields": [
+        {"name": "id", "type": "long", "nullable": True, "metadata": {}}]})
+    commit0 = [
+        {"protocol": {"minReaderVersion": 1, "minWriterVersion": 2}},
+        {"metaData": {"id": "m", "schemaString": schema_str,
+                      "partitionColumns": []}},
+        {"add": {"path": "f0.parquet", "size": 1, "partitionValues": {},
+                 "modificationTime": 0, "dataChange": True}},
+    ]
+    (log / f"{0:020d}.json").write_text(
+        "\n".join(json.dumps(a) for a in commit0))
+    # part 1 of a declared 2-part checkpoint at v0 — part 2 missing; if it
+    # were replayed, the table would look empty (the part holds no actions)
+    empty_ckpt = pa.table({"add": pa.array(
+        [None], pa.struct([("path", pa.string()),
+                           ("partitionValues", pa.map_(pa.string(), pa.string()))]))})
+    pq.write_table(empty_ckpt,
+                   str(log / f"{0:020d}.checkpoint.{1:010d}.{2:010d}.parquet"))
+    got = daft_tpu.read_deltalake(str(root)).to_pydict()
+    assert sorted(got["id"]) == [1, 2]
+
+
 def test_delta_sql_and_aggregate(tmp_path):
     uri = str(tmp_path / "tbl")
     daft_tpu.from_pydict({"k": ["a", "b", "a"], "v": [1, 2, 3]}).write_deltalake(uri)
@@ -309,6 +363,35 @@ def test_iceberg_partition_filter(tmp_path):
 def test_iceberg_not_a_table(tmp_path):
     with pytest.raises(DaftIOError, match="metadata"):
         daft_tpu.read_iceberg(str(tmp_path))
+
+
+def test_iceberg_empty_table(tmp_path):
+    root = tmp_path / "ice"
+    (root / "metadata").mkdir(parents=True)
+    meta = {"format-version": 2, "table-uuid": "u", "location": str(root),
+            "current-schema-id": 0,
+            "schemas": [{"type": "struct", "schema-id": 0, "fields": [
+                {"id": 1, "name": "id", "required": False, "type": "long"}]}],
+            "current-snapshot-id": -1, "snapshots": []}
+    (root / "metadata" / "v1.metadata.json").write_text(json.dumps(meta))
+    df = daft_tpu.read_iceberg(str(root))
+    assert df.column_names == ["id"]
+    assert df.to_pydict() == {"id": []}
+
+
+def test_iceberg_renamed_partition_column(tmp_path):
+    """Partition specs key the manifest record by the partition FIELD name,
+    which survives column renames; injection must target the current column
+    name while reading the manifest by the field name."""
+    root = tmp_path / "ice"
+    _build_iceberg_table(root, two_snapshots=False)
+    meta_path = root / "metadata" / "v1.metadata.json"
+    meta = json.loads(meta_path.read_text())
+    # rename the source column region -> geo; the spec field keeps "region"
+    meta["schemas"][0]["fields"][1]["name"] = "geo"
+    meta_path.write_text(json.dumps(meta))
+    got = daft_tpu.read_iceberg(str(root)).sort("id").to_pydict()
+    assert got == {"id": [1, 2], "geo": ["eu", "eu"]}
 
 
 # --------------------------------------------------------------------- #
