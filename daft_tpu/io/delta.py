@@ -169,13 +169,20 @@ def _complete_checkpoints(fs, log_dir: str,
         by_version.setdefault(v, []).append((path, total))
     complete: Dict[int, List[str]] = {}
     for v, parts in by_version.items():
-        totals = {t for _, t in parts if t is not None}
-        declared = totals.pop() if len(totals) == 1 else (None if not totals else -1)
-        if declared == -1:  # conflicting part-totals: corrupt, skip
+        # A version can carry several checkpoint FORMS at once (a classic
+        # single-file one plus a multi-part one from another engine); judge
+        # each form on its own and prefer the single file.
+        single = sorted(p for p, t in parts if t is None)
+        if single:
+            complete[v] = single[:1]
             continue
-        if declared is not None and len(parts) != declared:
-            continue  # half-written multi-part checkpoint
-        complete[v] = sorted(p for p, _ in parts)
+        by_total: Dict[int, List[str]] = {}
+        for p, t in parts:
+            by_total.setdefault(t, []).append(p)
+        for t, paths in sorted(by_total.items()):
+            if len(set(paths)) == t:
+                complete[v] = sorted(set(paths))
+                break
     hint = f"{log_dir}/_last_checkpoint"
     try:
         if fs.get_file_info(hint).type.name != "NotFound":
@@ -305,9 +312,9 @@ def write_table(df, table_uri: str, mode: str = "append",
     if exists and mode == "error":
         raise DaftIOError(f"delta table already exists: {table_uri}")
     if exists and mode == "ignore":
-        current = load_snapshot(table_uri, io_config=io_config,
-                                _listing=(commits, checkpoints))
-        return {"version": current.version, "paths": []}
+        # Version number only — no need to replay the log.
+        latest = max(v for v, *_ in commits + checkpoints)
+        return {"version": latest, "paths": []}
 
     snapshot = load_snapshot(table_uri, io_config=io_config,
                              _listing=(commits, checkpoints)) if exists else None

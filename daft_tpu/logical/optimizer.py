@@ -239,8 +239,14 @@ class PushDownFilter(Rule):
         child = node.children()[0]
         pred = node.predicate
         if isinstance(child, lp.Filter):
-            merged = BinaryOp("and", child.predicate, pred)
-            return lp.Filter(child.children()[0], merged)
+            conj: List[Expr] = []
+            _flatten_and(child.predicate, conj)
+            _flatten_and(pred, conj)
+            # dedup by key: repeated derivation/merge must not stack copies
+            seen: dict = {}
+            for c in conj:
+                seen.setdefault(c.key(), c)
+            return lp.Filter(child.children()[0], _and_all(list(seen.values())))
         if isinstance(child, lp.Project):
             mapping = {e.name(): _strip_alias(e) for e in child.exprs}
             if all(not mapping[n].has_udf() for n in pred.column_refs() if n in mapping):
@@ -280,6 +286,26 @@ class PushDownFilter(Rule):
                     to_right.append(c)
                 else:
                     keep.append(c)
+            # Cross-relation OR conjuncts cannot move, but their side-local
+            # implications can prefilter each side (kept conjunct stays for
+            # exactness). Idempotent via the existing-conjunct check.
+            for c in keep:
+                ors: List[Expr] = []
+                _flatten_or(c, ors)
+                if len(ors) < 2:
+                    continue
+                for names, target, sink, ok in (
+                        (left_names, left, to_left,
+                         child.how in ("inner", "left", "semi", "anti")),
+                        (right_names, right, to_right,
+                         child.how in ("inner", "right"))):
+                    if not ok:
+                        continue
+                    derived = _derive_or_side(ors, names)
+                    if derived is not None and \
+                            not _already_filtering(target, derived) \
+                            and derived.key() not in {x.key() for x in sink}:
+                        sink.append(derived)
             if not to_left and not to_right:
                 return None
             new_left = lp.Filter(left, _and_all(to_left)) if to_left else left
@@ -290,7 +316,16 @@ class PushDownFilter(Rule):
             return out
         if isinstance(child, lp.ScanSource):
             pd = child.pushdowns
-            combined = pred if pd.filters is None else BinaryOp("and", pd.filters, pred)
+            conj = []
+            if pd.filters is not None:
+                _flatten_and(pd.filters, conj)
+            _flatten_and(pred, conj)
+            seen = {}
+            for c in conj:
+                seen.setdefault(c.key(), c)
+            combined = _and_all(list(seen.values()))
+            if pd.filters is not None and combined.key() == pd.filters.key():
+                return None  # nothing new — avoid a no-op rewrite loop
             return child.with_pushdowns(pd.with_changes(filters=combined))
         return None
 
@@ -524,6 +559,80 @@ def _and_all(conjuncts: Sequence[Expr]) -> Expr:
     for c in conjuncts[1:]:
         pred = BinaryOp("and", pred, c)
     return pred
+
+
+def _flatten_or(e: Expr, out: List[Expr]) -> None:
+    if isinstance(e, BinaryOp) and e.op == "or":
+        _flatten_or(e.left, out)
+        _flatten_or(e.right, out)
+    else:
+        out.append(e)
+
+
+def _derive_or_side(disjuncts: Sequence[Expr], names: set) -> Optional[Expr]:
+    """Side-local implication of an OR-of-ANDs: when EVERY disjunct carries
+    at least one conjunct entirely over `names`, the OR of those per-disjunct
+    parts is implied by the whole predicate and can prefilter that side
+    (reference: the optimizer's filter derivation for multi-relation
+    disjunctions — TPC-H Q7/Q19's cross-relation ORs are unpushable
+    otherwise)."""
+    parts: List[Expr] = []
+    for d in disjuncts:
+        conj: List[Expr] = []
+        _flatten_and(d, conj)
+        side = [x for x in conj if x.column_refs() and x.column_refs() <= names
+                and not x.has_subquery() and not x.has_udf()]
+        if not side:
+            return None
+        parts.append(_and_all(side))
+    out = parts[0]
+    for p in parts[1:]:
+        out = BinaryOp("or", out, p)
+    return out
+
+
+def _already_filtering(side, expr: Expr) -> bool:
+    """Is `expr` (or its pushed-down image) already filtering `side`?
+    Follows the same descent PushDownFilter uses — through Filters, Projects
+    (with substitution), Sorts — so OR-derivation stays idempotent across
+    passes even after the derived filter has been pushed to a leaf."""
+    node, e = side, expr
+    while True:
+        if isinstance(node, lp.Filter):
+            conj: List[Expr] = []
+            _flatten_and(node.predicate, conj)
+            if e.key() in {c.key() for c in conj}:
+                return True
+            node = node.children()[0]
+            continue
+        if isinstance(node, lp.Project):
+            mapping = {p.name(): _strip_alias(p) for p in node.exprs}
+            try:
+                e = _substitute(e, mapping)
+            except Exception:
+                return False
+            node = node.children()[0]
+            continue
+        if isinstance(node, (lp.Sort, lp.Repartition)):
+            node = node.children()[0]
+            continue
+        if isinstance(node, lp.Join):
+            # A pushed filter lands on whichever join side owns its columns —
+            # follow the same routing or the check misses it and derivation
+            # re-fires every pass on nested-join sides.
+            refs = e.column_refs()
+            for side_node in node.children():
+                if refs and refs <= set(side_node.schema.column_names()):
+                    node = side_node
+                    break
+            else:
+                return False
+            continue
+        if isinstance(node, lp.ScanSource) and node.pushdowns.filters is not None:
+            conj = []
+            _flatten_and(node.pushdowns.filters, conj)
+            return e.key() in {c.key() for c in conj}
+        return False
 
 
 class UnnestSubqueries(Rule):
