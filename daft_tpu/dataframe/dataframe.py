@@ -276,6 +276,45 @@ class DataFrame:
     def except_distinct(self, other: "DataFrame") -> "DataFrame":
         return self._with(self._builder.except_(other._builder))
 
+    def except_all(self, other: "DataFrame") -> "DataFrame":
+        """Multiset difference keeping duplicates (reference:
+        dataframe.py except_all)."""
+        return self._with(self._builder.except_(other._builder, is_all=True))
+
+    def union_all(self, other: "DataFrame") -> "DataFrame":
+        return self.concat(other)
+
+    def _align_by_name(self, other: "DataFrame") -> "DataFrame":
+        """Project `other` onto self's column set by name; columns missing on
+        either side surface as nulls (reference: union_by_name semantics)."""
+        mine = [f.name for f in self.schema]
+        theirs = set(other.column_names)
+        extra = [c for c in other.column_names if c not in set(mine)]
+        names = mine + extra
+        self_schema = {f.name: f.dtype for f in self.schema}
+        other_schema = {f.name: f.dtype for f in other.schema}
+
+        def side(df, have, types, other_types):
+            exprs = []
+            for n in names:
+                if n in have:
+                    exprs.append(col(n))
+                else:
+                    exprs.append(lit(None).cast(other_types[n]).alias(n))
+            return df.select(*exprs)
+
+        left = side(self, set(mine), self_schema, other_schema)
+        right = side(other, theirs, other_schema, self_schema)
+        return left.concat(right)
+
+    def union_by_name(self, other: "DataFrame") -> "DataFrame":
+        """Distinct union aligning columns by name."""
+        return self._align_by_name(other).distinct()
+
+    def union_all_by_name(self, other: "DataFrame") -> "DataFrame":
+        """Union-all aligning columns by name."""
+        return self._align_by_name(other)
+
     # -- aggregation ------------------------------------------------------
     def agg(self, *exprs: Expression) -> "DataFrame":
         exprs = _flatten(exprs)
@@ -316,8 +355,40 @@ class DataFrame:
     def agg_list(self, *cols: ColumnInput) -> "DataFrame":
         return self.agg(*[_to_expr(c).agg_list() for c in cols])
 
+    list_agg = agg_list
+
+    def agg_set(self, *cols: ColumnInput) -> "DataFrame":
+        """Global set (distinct-list) agg, ignoring nulls (reference:
+        dataframe.py agg_set)."""
+        return self.agg(*[_to_expr(c).agg_set() for c in cols])
+
+    list_agg_distinct = agg_set
+
     def agg_concat(self, *cols: ColumnInput) -> "DataFrame":
         return self.agg(*[_to_expr(c).agg_concat() for c in cols])
+
+    def var(self, *cols: ColumnInput) -> "DataFrame":
+        return (self.agg(*[_to_expr(c).variance() for c in cols])
+                if cols else self._agg_all("variance"))
+
+    def skew(self, *cols: ColumnInput) -> "DataFrame":
+        return (self.agg(*[_to_expr(c).skew() for c in cols])
+                if cols else self._agg_all("skew"))
+
+    def product(self, *cols: ColumnInput) -> "DataFrame":
+        return (self.agg(*[_to_expr(c).product() for c in cols])
+                if cols else self._agg_all("product"))
+
+    def count_distinct(self, *cols: ColumnInput) -> "DataFrame":
+        return self.agg(*[_to_expr(c).count_distinct() for c in cols])
+
+    def string_agg(self, *cols: ColumnInput, sep: str = ",") -> "DataFrame":
+        return self.agg(*[_to_expr(c).string_agg(sep) for c in cols])
+
+    def map_groups(self, udf) -> "DataFrame":
+        """Apply a UDF over the whole frame as one group (reference:
+        dataframe.py map_groups — the grouped form lives on GroupedDataFrame)."""
+        return self.groupby().map_groups(udf)
 
     def count(self, *cols: ColumnInput) -> "DataFrame":
         if cols:
@@ -489,6 +560,252 @@ class DataFrame:
             "path": result["paths"] or [""],
             "version": [result["version"]] * max(len(result["paths"]), 1),
         })
+
+    # -- hygiene filters --------------------------------------------------
+    def drop_nan(self, *cols: ColumnInput) -> "DataFrame":
+        """Drop rows with NaN in the given (default: all float) columns;
+        nulls are NOT dropped (reference: dataframe.py drop_nan)."""
+        targets = ([_to_expr(c) for c in cols] if cols else
+                   [col(f.name) for f in self.schema if f.dtype.is_floating()])
+        if not targets:
+            return self
+        pred = None
+        for e in targets:
+            keep = ~e.float.is_nan() | e.is_null()
+            pred = keep if pred is None else (pred & keep)
+        return self.where(pred)
+
+    def drop_null(self, *cols: ColumnInput) -> "DataFrame":
+        """Drop rows with nulls in the given (default: all) columns
+        (reference: dataframe.py drop_null)."""
+        targets = ([_to_expr(c) for c in cols] if cols else
+                   [col(f.name) for f in self.schema])
+        pred = None
+        for e in targets:
+            keep = e.not_null()
+            pred = keep if pred is None else (pred & keep)
+        return self.where(pred)
+
+    def pipe(self, function, *args, **kwargs):
+        """Apply `function(self, *args, **kwargs)` (reference: pipe)."""
+        return function(self, *args, **kwargs)
+
+    @staticmethod
+    def set_storage_option(key: str, value: str) -> None:
+        """Set a process-wide storage option consulted when building
+        filesystem connections (reference: dataframe.py set_storage_option)."""
+        from daft_tpu.io.config import set_storage_option as _set
+
+        _set(key, value)
+
+    def metrics(self) -> Dict[str, Dict[str, int]]:
+        """Per-operator metrics of the most recent execution on this context
+        (reference: dataframe.py metrics backed by the runtime-stats
+        subscriber)."""
+        stats = getattr(get_context(), "last_query_stats", None)
+        return stats.to_wire() if stats is not None else {}
+
+    def skipped_corrupt_files(self) -> List[str]:
+        """Files skipped during the last execution (reference surface;
+        corrupt-file skipping is not currently enabled, so always empty)."""
+        return []
+
+    def shuffle(self, seed: Optional[int] = None) -> "DataFrame":
+        """Randomly reorder rows (reference: dataframe.py shuffle)."""
+        from daft_tpu.functions import random_int
+
+        order = "__shuffle_order"
+        return (self.with_column(order, random_int(lit(0), seed=seed))
+                .sort(order).exclude(order))
+
+    def skip_existing(self, existing_path, on: Union[ColumnInput, List[ColumnInput]],
+                      file_format: str = "parquet") -> "DataFrame":
+        """Filter out rows whose key(s) already exist in data at
+        `existing_path` (reference: dataframe.py skip_existing — incremental
+        re-run hygiene). Missing/empty paths pass everything through."""
+        from daft_tpu.io import reads
+
+        on = on if isinstance(on, list) else [on]
+        keys = [_to_expr(c) for c in on]
+        names = [e.name() for e in keys]
+        paths = existing_path if isinstance(existing_path, list) else [existing_path]
+        try:
+            existing = getattr(reads, f"read_{file_format}")([str(p) for p in paths])
+            existing = existing.select(*names).distinct()
+        except Exception:
+            return self
+        return self.join(existing, left_on=names, right_on=names, how="anti")
+
+    # -- iterators / conversions -----------------------------------------
+    def to_arrow_iter(self):
+        """Iterate results as pyarrow RecordBatches (reference: to_arrow_iter)."""
+        for part in self.iter_partitions():
+            for batch in part.to_arrow_table().to_batches():
+                yield batch
+
+    def to_torch_map_dataset(self):
+        """Map-style torch Dataset over materialised rows (reference:
+        dataframe.py to_torch_map_dataset)."""
+        import torch.utils.data as tud
+
+        rows = list(self.iter_rows())
+
+        class _MapDataset(tud.Dataset):
+            def __len__(self):
+                return len(rows)
+
+            def __getitem__(self, idx):
+                return rows[idx]
+
+        return _MapDataset()
+
+    def to_torch_iter_dataset(self):
+        """Iterable-style torch Dataset streaming rows (reference:
+        dataframe.py to_torch_iter_dataset)."""
+        import torch.utils.data as tud
+
+        df = self
+
+        class _IterDataset(tud.IterableDataset):
+            def __iter__(self):
+                return df.iter_rows()
+
+        return _IterDataset()
+
+    def to_torch_dataloader(self, batch_size: int = 1, **kwargs):
+        """torch DataLoader over the materialised frame."""
+        import torch.utils.data as tud
+
+        return tud.DataLoader(self.to_torch_map_dataset(),
+                              batch_size=batch_size, **kwargs)
+
+    def to_dask_dataframe(self, *a, **kw):
+        from daft_tpu.errors import DaftIOError
+
+        raise DaftIOError("to_dask_dataframe requires the dask integration, "
+                          "which is not available in this environment")
+
+    def to_ray_dataset(self, *a, **kw):
+        from daft_tpu.errors import DaftIOError
+
+        raise DaftIOError("to_ray_dataset requires the ray integration, "
+                          "which is not available in this environment")
+
+    def write_sql(self, table_name: str, conn, if_exists: str = "append") -> "DataFrame":
+        """Write rows into a SQL table through a DB-API connection or
+        zero-arg factory (reference: dataframe.py write_sql)."""
+        from daft_tpu.dataframe import creation
+        from daft_tpu.errors import DaftValueError as _DVE
+
+        if if_exists not in ("append", "replace", "fail"):
+            raise _DVE(f"write_sql: bad if_exists {if_exists!r}")
+        connection = conn if hasattr(conn, "cursor") else conn()
+        cur = connection.cursor()
+        names = self.column_names
+        # Placeholder per the driver module's DB-API paramstyle (psycopg2 /
+        # MySQL use %s-format, sqlite qmark).
+        style = "qmark"
+        try:
+            import importlib
+
+            mod = importlib.import_module(
+                type(connection).__module__.split(".")[0])
+            style = getattr(mod, "paramstyle", "qmark")
+        except Exception:
+            pass
+        marker = {"qmark": "?", "format": "%s", "pyformat": "%s",
+                  "numeric": None, "named": None}.get(style, "?")
+        if marker is None:
+            raise _DVE(f"write_sql: unsupported DB-API paramstyle {style!r}")
+
+        def sql_type(dtype: DataType) -> str:
+            n = dtype.id.value
+            if n in ("int8", "int16", "int32"):
+                return "INTEGER"
+            if n in ("int64", "uint32", "uint64"):
+                return "BIGINT"
+            if n in ("float32", "float64"):
+                return "DOUBLE PRECISION"
+            if n == "bool":
+                return "BOOLEAN"
+            if n == "date":
+                return "DATE"
+            if n == "timestamp":
+                return "TIMESTAMP"
+            if n == "binary":
+                return "BLOB"
+            return "TEXT"
+
+        total = 0
+        first = True
+        for part in self.iter_partitions():
+            rows = list(zip(*[part.to_pydict()[n] for n in names]))
+            if first:
+                try:
+                    cur.execute(f"SELECT 1 FROM {table_name} LIMIT 1")
+                    cur.fetchall()
+                    exists = True
+                except Exception:
+                    exists = False
+                    if hasattr(connection, "rollback"):
+                        connection.rollback()
+                if exists and if_exists == "fail":
+                    raise _DVE(f"write_sql: table {table_name} exists")
+                if exists and if_exists == "replace":
+                    cur.execute(f"DELETE FROM {table_name}")
+                if not exists:
+                    cols = ", ".join(f"{f.name} {sql_type(f.dtype)}"
+                                     for f in self.schema)
+                    cur.execute(f"CREATE TABLE {table_name} ({cols})")
+                first = False
+            if rows:
+                ph = ", ".join([marker] * len(names))
+                cur.executemany(
+                    f"INSERT INTO {table_name} ({', '.join(names)}) VALUES ({ph})",
+                    rows)
+                total += len(rows)
+        connection.commit()
+        return creation.from_pydict({"table": [table_name], "rows_written": [total]})
+
+    def _integration_write(self, name: str, required: str):
+        from daft_tpu.errors import DaftIOError
+
+        raise DaftIOError(
+            f"write_{name} requires the {required} integration, which is not "
+            "available in this environment (no network egress / package)")
+
+    def write_iceberg(self, table_uri: str, mode: str = "append",
+                      io_config=None) -> "DataFrame":
+        """Write to an Iceberg table as a new snapshot, creating the table if
+        absent (reference: dataframe.py write_iceberg; native metadata +
+        Avro manifest writer in daft_tpu/io/iceberg.py)."""
+        from daft_tpu.dataframe import creation
+        from daft_tpu.io import iceberg
+
+        uri = getattr(table_uri, "metadata_location", None) or table_uri
+        result = iceberg.write_table(self, uri, mode=mode, io_config=io_config)
+        return creation.from_pydict({
+            "path": result["paths"],
+            "snapshot_id": [result["snapshot_id"]] * len(result["paths"]),
+        })
+
+    def write_turbopuffer(self, *a, **kw):
+        return self._integration_write("turbopuffer", "turbopuffer client + network egress")
+
+    def write_lance(self, *a, **kw):
+        return self._integration_write("lance", "pylance")
+
+    def write_paimon(self, *a, **kw):
+        return self._integration_write("paimon", "paimon")
+
+    def write_bigtable(self, *a, **kw):
+        return self._integration_write("bigtable", "google-cloud-bigtable")
+
+    def write_clickhouse(self, *a, **kw):
+        return self._integration_write("clickhouse", "clickhouse-connect")
+
+    def write_huggingface(self, *a, **kw):
+        return self._integration_write("huggingface", "network egress + hf hub")
 
     def write_sink(self, sink) -> "DataFrame":
         """Write through a pluggable DataSink (reference: daft/io/sink.py)."""

@@ -65,7 +65,55 @@ class GroupedDataFrame:
         return self.agg(*[_e(c).agg_concat() for c in cols]) if cols else self._agg_all("agg_concat")
 
     def map_groups(self, udf_expr):
-        raise NotImplementedError("map_groups lands with the UDAF layer")
+        """Apply a UDF to each group's full column values; the UDF may return
+        any number of rows per group (reference: dataframe.py map_groups →
+        per-group PyScalarFn evaluation). Lowered as: evaluate arg
+        expressions, agg_list them per group, run the UDF over each group's
+        flattened series, explode the per-group results."""
+        from daft_tpu.dataframe.dataframe import DataFrame, _to_expr
+        from daft_tpu.datatype import DataType
+        from daft_tpu.expressions.expr import Alias, UdfCall
+        from daft_tpu.series import Series
+        from daft_tpu.udf import Udf
+
+        e = _to_expr(udf_expr)._expr
+        out_name = e.name()
+        while isinstance(e, Alias):
+            e = e.child
+        if not isinstance(e, UdfCall):
+            raise DaftValueError("map_groups expects a UDF call expression")
+        u = e.udf
+
+        df = self._df
+        tmp = []
+        for i, a in enumerate(e.args):
+            nm = f"__mg_a{i}"
+            tmp.append(nm)
+            df = df.with_column(nm, Expression(a))
+        gdf = GroupedDataFrame(df, list(self._group_by))
+        agged = gdf.agg(*[col(nm).agg_list().alias(nm) for nm in tmp])
+
+        kwargs = dict(e.kwargs)
+
+        def per_group(*list_series):
+            outs = []
+            pylists = [s.to_pylist() for s in list_series]
+            for row in zip(*pylists) if pylists else ():
+                flat = [Series.from_pylist(list(v) if v is not None else [],
+                                           f"a{j}")
+                        for j, v in enumerate(row)]
+                outs.append(u.evaluate(flat, kwargs).to_pylist())
+            return outs
+
+        wrapper = Udf(per_group, DataType.list(u.return_dtype), batch=True,
+                      name=out_name)
+        keys = [g.name() for g in self._group_by]
+        out = agged.with_column(out_name, wrapper(*[col(nm) for nm in tmp]))
+        out = out.select(*(keys + [out_name])) if keys else out.select(out_name)
+        # A UDF may return zero rows for a group — exploding its empty list
+        # would fabricate a null row, so drop empty groups first.
+        out = out.where(col(out_name).list.length() > 0)
+        return out.explode(out_name)
 
 
 def _e(c) -> Expression:

@@ -486,6 +486,15 @@ class Expression:
     def agg_list_distinct(self):
         return self.agg_list().list.distinct()
 
+    agg_set = agg_list_distinct
+    list_agg_distinct = agg_list_distinct
+
+    def list_agg(self):
+        return self.agg_list()
+
+    def var(self):
+        return self.variance()
+
     def lag(self, offset: int = 1, default=None) -> "Expression":
         from daft_tpu.expressions.expr import WindowExpr
 

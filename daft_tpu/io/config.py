@@ -104,3 +104,17 @@ def filesystem_for(scheme: str, io_config: Optional[IOConfig]):
             kwargs["account_key"] = cfg.access_key
         return pafs.AzureFileSystem(**kwargs)
     return None
+
+
+# --------------------------------------------------------------------- #
+# Process-wide storage options (reference: DataFrame.set_storage_option) #
+# --------------------------------------------------------------------- #
+_STORAGE_OPTIONS: dict = {}
+
+
+def set_storage_option(key: str, value: str) -> None:
+    _STORAGE_OPTIONS[str(key)] = str(value)
+
+
+def get_storage_options() -> dict:
+    return dict(_STORAGE_OPTIONS)

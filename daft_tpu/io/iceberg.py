@@ -65,6 +65,190 @@ def _dtype_from_iceberg(t: Any) -> DataType:
     raise DaftIOError(f"iceberg: unsupported type {kind!r}")
 
 
+class _FieldIds:
+    """Monotonic field-id allocator — Iceberg requires every (nested) field
+    id in a schema to be unique."""
+
+    def __init__(self, start: int = 0):
+        self.last = start
+
+    def next(self) -> int:
+        self.last += 1
+        return self.last
+
+
+def _dtype_to_iceberg(dt: DataType, ids: Optional[_FieldIds] = None) -> Any:
+    ids = ids or _FieldIds()
+    name = dt.id.value
+    flat = {"bool": "boolean", "int32": "int", "int64": "long",
+            "float32": "float", "float64": "double", "date": "date",
+            "string": "string", "binary": "binary"}
+    if name in flat:
+        return flat[name]
+    if name == "timestamp":
+        return "timestamptz" if dt._params[1] else "timestamp"
+    if name == "decimal128":
+        p, s = dt._params
+        return f"decimal({p}, {s})"
+    if name == "list":
+        eid = ids.next()
+        return {"type": "list", "element-id": eid, "element-required": False,
+                "element": _dtype_to_iceberg(dt._params[0], ids)}
+    if name == "struct":
+        fields = []
+        for k, v in dt._params[0]:
+            fid = ids.next()
+            fields.append({"id": fid, "name": k, "required": False,
+                           "type": _dtype_to_iceberg(v, ids)})
+        return {"type": "struct", "fields": fields}
+    raise DaftValueError(f"iceberg: cannot write dtype {name}")
+
+
+_MANIFEST_LIST_SCHEMA = {
+    "type": "record", "name": "manifest_file", "fields": [
+        {"name": "manifest_path", "type": "string"},
+        {"name": "manifest_length", "type": "long"},
+        {"name": "partition_spec_id", "type": "int"},
+        {"name": "content", "type": "int", "default": 0},
+        {"name": "added_snapshot_id", "type": "long"},
+    ],
+}
+
+_MANIFEST_SCHEMA = {
+    "type": "record", "name": "manifest_entry", "fields": [
+        {"name": "status", "type": "int"},
+        {"name": "snapshot_id", "type": ["null", "long"], "default": None},
+        {"name": "data_file", "type": {"type": "record", "name": "r2", "fields": [
+            {"name": "content", "type": "int", "default": 0},
+            {"name": "file_path", "type": "string"},
+            {"name": "file_format", "type": "string"},
+            {"name": "partition", "type": {"type": "record", "name": "r102",
+                                           "fields": []}},
+            {"name": "record_count", "type": "long"},
+            {"name": "file_size_in_bytes", "type": "long"},
+        ]}},
+    ],
+}
+
+
+def write_table(df, table_uri: str, mode: str = "append",
+                io_config=None) -> Dict[str, Any]:
+    """Write a DataFrame as a new Iceberg snapshot (v2 metadata, avro
+    manifests via daft_tpu/io/avro.py). Unpartitioned; append/overwrite.
+    Reference surface: daft.DataFrame.write_iceberg."""
+    import uuid as _uuid
+
+    import pyarrow.parquet as pq
+
+    from daft_tpu.io.avro import write_avro
+    from daft_tpu.io.scan import resolve_filesystem
+    from daft_tpu.schema import Schema as _Schema
+
+    if mode not in ("append", "overwrite"):
+        raise DaftValueError(f"iceberg: bad mode {mode!r}")
+    fs, root = resolve_filesystem(table_uri, io_config)
+    root = root.rstrip("/")
+    meta_dir = f"{root}/metadata"
+    data_dir = f"{root}/data"
+    exists = fs.get_file_info(meta_dir).type.name != "NotFound" and any(
+        i.path.endswith(".metadata.json")
+        for i in fs.get_file_info(__import__("pyarrow.fs", fromlist=["fs"])
+                                  .FileSelector(meta_dir, allow_not_found=True)))
+    table = df.to_arrow()
+    schema = _Schema.from_arrow(table.schema)
+
+    def _next_meta_version() -> int:
+        import pyarrow.fs as pafs
+
+        sel = pafs.FileSelector(meta_dir, allow_not_found=True)
+        versions = [0]
+        for i in fs.get_file_info(sel):
+            m = re.search(r"v?(\d+)\.metadata\.json$", os.path.basename(i.path))
+            if m:
+                versions.append(int(m.group(1)))
+        return max(versions) + 1
+
+    if exists:
+        prev = load_table(root, io_config=io_config)
+        meta = prev.metadata
+        want = [(f.name, _dtype_to_iceberg(f.dtype)) for f in prev.schema]
+        got = [(f.name, _dtype_to_iceberg(f.dtype)) for f in schema]
+        if want != got:
+            raise DaftValueError(
+                f"iceberg: schema mismatch vs table ({want} != {got})")
+        version = 1 + max(
+            (s.get("sequence-number", 0) for s in meta.get("snapshots", [])),
+            default=0)
+    else:
+        ids = _FieldIds()
+        fields = []
+        for f in schema:
+            fid = ids.next()
+            fields.append({"id": fid, "name": f.name, "required": False,
+                           "type": _dtype_to_iceberg(f.dtype, ids)})
+        meta = {
+            "format-version": 2, "table-uuid": str(_uuid.uuid4()),
+            "location": root, "last-sequence-number": 0,
+            "last-updated-ms": 0, "last-column-id": ids.last,
+            "current-schema-id": 0,
+            "schemas": [{"type": "struct", "schema-id": 0, "fields": fields}],
+            "default-spec-id": 0,
+            "partition-specs": [{"spec-id": 0, "fields": []}],
+            "properties": {}, "snapshots": [],
+        }
+        version = 1
+        fs.create_dir(meta_dir, recursive=True)
+        fs.create_dir(data_dir, recursive=True)
+    next_meta_v = _next_meta_version()
+
+    snapshot_id = int(_uuid.uuid4().int % (1 << 62)) or 1
+    fname = f"{data_dir}/{_uuid.uuid4()}.parquet"
+    with fs.open_output_stream(fname) as out:
+        pq.write_table(table, out)
+    size = fs.get_file_info(fname).size
+
+    entries = [{"status": 1, "snapshot_id": snapshot_id, "data_file": {
+        "content": 0, "file_path": fname, "file_format": "PARQUET",
+        "partition": {}, "record_count": len(table),
+        "file_size_in_bytes": size}}]
+    man_path = f"{meta_dir}/manifest-{snapshot_id}.avro"
+    man_bytes = write_avro(_MANIFEST_SCHEMA, entries)
+    with fs.open_output_stream(man_path) as f:
+        f.write(man_bytes)
+
+    manifests = [{"manifest_path": man_path, "manifest_length": len(man_bytes),
+                  "partition_spec_id": 0, "content": 0,
+                  "added_snapshot_id": snapshot_id}]
+    if mode == "append" and exists and meta.get("current-snapshot-id") not in (None, -1):
+        cur = next((s for s in meta["snapshots"]
+                    if s["snapshot-id"] == meta["current-snapshot-id"]), None)
+        if cur is not None:
+            with fs.open_input_file(
+                    _resolve_path(cur["manifest-list"], root, meta.get("location", root))) as f:
+                from daft_tpu.io.avro import read_avro
+
+                _, prev_manifests = read_avro(f.read())
+            manifests = prev_manifests + manifests
+    ml_path = f"{meta_dir}/snap-{snapshot_id}.avro"
+    with fs.open_output_stream(ml_path) as f:
+        f.write(write_avro(_MANIFEST_LIST_SCHEMA, manifests))
+
+    meta = dict(meta)
+    meta["snapshots"] = list(meta.get("snapshots", [])) + [{
+        "snapshot-id": snapshot_id, "schema-id": 0,
+        "sequence-number": version, "timestamp-ms": version,
+        "manifest-list": ml_path,
+        "summary": {"operation": "append" if mode == "append" else "overwrite"},
+    }]
+    meta["current-snapshot-id"] = snapshot_id
+    meta["last-sequence-number"] = version
+    with fs.open_output_stream(f"{meta_dir}/v{next_meta_v}.metadata.json") as f:
+        f.write(json.dumps(meta).encode())
+    with fs.open_output_stream(f"{meta_dir}/version-hint.text") as f:
+        f.write(str(next_meta_v).encode())
+    return {"snapshot_id": snapshot_id, "paths": [fname]}
+
+
 @dataclass
 class IcebergSnapshot:
     snapshot_id: Optional[int]

@@ -616,6 +616,9 @@ def _already_filtering(side, expr: Expr) -> bool:
         if isinstance(node, (lp.Sort, lp.Repartition)):
             node = node.children()[0]
             continue
+        if isinstance(node, lp.Concat):
+            # Pushdown distributes a filter into every branch.
+            return all(_already_filtering(c, e) for c in node.children())
         if isinstance(node, lp.Join):
             # A pushed filter lands on whichever join side owns its columns —
             # follow the same routing or the check misses it and derivation

@@ -84,16 +84,30 @@ def _translate_one(node: lp.LogicalPlan, cfg, _memo) -> pp.PhysicalPlan:
         return pp.AsofJoin(t(left), t(right), node.left_on, node.right_on,
                            node.left_by, node.right_by, node.direction,
                            node.schema, node.suffix)
-    if isinstance(node, lp.Intersect):
+    if isinstance(node, (lp.Intersect, lp.Except)):
+        # Distinct form: (semi|anti) join of the deduplicated left against
+        # the right. ALL form (SQL INTERSECT ALL / EXCEPT ALL multiset
+        # semantics): tag every row on both sides with its occurrence number
+        # within its value group, then (semi|anti) join on (values, occ) —
+        # per value v, min(l,r) copies match and max(l-r, 0) don't.
+        how = "semi" if isinstance(node, lp.Intersect) else "anti"
         left, right = node.children()
         keys = [ColumnRef(n) for n in left.schema.column_names()]
-        join = lp.Join(lp.Distinct(left), right, keys, keys, "semi")
-        return t(join)
-    if isinstance(node, lp.Except):
-        left, right = node.children()
-        keys = [ColumnRef(n) for n in left.schema.column_names()]
-        join = lp.Join(lp.Distinct(left), right, keys, keys, "anti")
-        return t(join)
+        if not node.is_all:
+            join = lp.Join(lp.Distinct(left), right, keys, keys, how)
+            return t(join)
+        from daft_tpu.expressions.expr import Alias, WindowExpr
+
+        occ = "__occurrence"
+
+        def tagged(side):
+            rn = WindowExpr("row_number", None, tuple(keys), (), ())
+            return lp.Window(side, [Alias(rn, occ)])
+
+        join_keys = keys + [ColumnRef(occ)]
+        join = lp.Join(tagged(left), lp.Project(tagged(right), join_keys),
+                       join_keys, join_keys, how)
+        return t(lp.Project(join, keys))
     if isinstance(node, lp.Repartition):
         return pp.Repartition(t(node.children()[0]), node.scheme)
     if isinstance(node, lp.Shard):

@@ -33,7 +33,9 @@ class NativeRunner(Runner):
         try:
             from daft_tpu.execution.resource_manager import RuntimeStats
 
-            executor = Executor(cfg, stats=RuntimeStats(query_id))
+            stats = RuntimeStats(query_id)
+            ctx.last_query_stats = stats  # DataFrame.metrics() surface
+            executor = Executor(cfg, stats=stats)
             yield from executor.run(physical)
         except BaseException as e:  # noqa: BLE001
             error = str(e)
