@@ -288,23 +288,19 @@ class DataFrame:
         """Project `other` onto self's column set by name; columns missing on
         either side surface as nulls (reference: union_by_name semantics)."""
         mine = [f.name for f in self.schema]
-        theirs = set(other.column_names)
-        extra = [c for c in other.column_names if c not in set(mine)]
-        names = mine + extra
+        mine_set = set(mine)
+        names = mine + [c for c in other.column_names if c not in mine_set]
         self_schema = {f.name: f.dtype for f in self.schema}
         other_schema = {f.name: f.dtype for f in other.schema}
 
-        def side(df, have, types, other_types):
-            exprs = []
-            for n in names:
-                if n in have:
-                    exprs.append(col(n))
-                else:
-                    exprs.append(lit(None).cast(other_types[n]).alias(n))
+        def side(df, have, other_types):
+            exprs = [col(n) if n in have
+                     else lit(None).cast(other_types[n]).alias(n)
+                     for n in names]
             return df.select(*exprs)
 
-        left = side(self, set(mine), self_schema, other_schema)
-        right = side(other, theirs, other_schema, self_schema)
+        left = side(self, mine_set, other_schema)
+        right = side(other, set(other.column_names), self_schema)
         return left.concat(right)
 
     def union_by_name(self, other: "DataFrame") -> "DataFrame":
@@ -615,6 +611,8 @@ class DataFrame:
         from daft_tpu.functions import random_int
 
         order = "__shuffle_order"
+        while order in self.schema:
+            order += "_"
         return (self.with_column(order, random_int(lit(0), seed=seed))
                 .sort(order).exclude(order))
 
@@ -625,15 +623,24 @@ class DataFrame:
         re-run hygiene). Missing/empty paths pass everything through."""
         from daft_tpu.io import reads
 
+        from daft_tpu.io.scan import glob_paths
+
         on = on if isinstance(on, list) else [on]
         keys = [_to_expr(c) for c in on]
         names = [e.name() for e in keys]
-        paths = existing_path if isinstance(existing_path, list) else [existing_path]
+        paths = [str(p) for p in (existing_path if isinstance(existing_path, list)
+                                  else [existing_path])]
+        # Only a genuinely absent/empty path passes everything through; any
+        # other error (bad format name, missing key column) must raise —
+        # silently skipping the dedup would re-process finished work.
         try:
-            existing = getattr(reads, f"read_{file_format}")([str(p) for p in paths])
-            existing = existing.select(*names).distinct()
+            files = glob_paths(paths)
         except Exception:
+            files = []
+        if not files:
             return self
+        existing = getattr(reads, f"read_{file_format}")(paths)
+        existing = existing.select(*names).distinct()
         return self.join(existing, left_on=names, right_on=names, how="anti")
 
     # -- iterators / conversions -----------------------------------------

@@ -99,6 +99,9 @@ def _translate_one(node: lp.LogicalPlan, cfg, _memo) -> pp.PhysicalPlan:
         from daft_tpu.expressions.expr import Alias, WindowExpr
 
         occ = "__occurrence"
+        names = set(left.schema.column_names())
+        while occ in names:
+            occ += "_"
 
         def tagged(side):
             rn = WindowExpr("row_number", None, tuple(keys), (), ())

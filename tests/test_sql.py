@@ -289,3 +289,44 @@ def test_sql_qualified_ambiguous_key_both_sides():
         SELECT a.v, b.v AS bv FROM t a JOIN t b ON a.id = b.id
         ORDER BY a.v""", t=t).to_pydict()
     assert out == {"v": [10, 20], "bv": [10, 20]}
+
+
+def test_sql_window_functions():
+    df = daft_tpu.from_pydict({"g": ["a", "a", "a", "b"], "v": [1, 2, 3, 5]})
+    out = daft_tpu.sql("""SELECT g, v,
+      sum(v) OVER (PARTITION BY g) AS s,
+      row_number() OVER (PARTITION BY g ORDER BY v DESC) AS rn,
+      lag(v) OVER (PARTITION BY g ORDER BY v) AS prev,
+      sum(v) OVER (PARTITION BY g ORDER BY v
+                   ROWS BETWEEN UNBOUNDED PRECEDING AND CURRENT ROW) AS run
+      FROM t ORDER BY g, v""", t=df).to_pydict()
+    assert out["s"] == [6, 6, 6, 5]
+    assert out["rn"] == [3, 2, 1, 1]
+    assert out["prev"] == [None, 1, 2, None]
+    assert out["run"] == [1, 3, 6, 5]
+
+
+def test_sql_window_rank_and_frame():
+    df = daft_tpu.from_pydict({"v": [10, 10, 20, 30]})
+    out = daft_tpu.sql("""SELECT v,
+      rank() OVER (ORDER BY v) AS r,
+      dense_rank() OVER (ORDER BY v) AS dr,
+      avg(v) OVER (ORDER BY v ROWS BETWEEN 1 PRECEDING AND CURRENT ROW) AS ma
+      FROM t ORDER BY v, r""", t=df).to_pydict()
+    assert out["r"] == [1, 1, 3, 4]
+    assert out["dr"] == [1, 1, 2, 3]
+    assert out["ma"] == [10.0, 10.0, 15.0, 25.0]
+
+
+def test_sql_ordered_window_default_running_frame():
+    df = daft_tpu.from_pydict({"v": [1, 2, 3]})
+    out = daft_tpu.sql("SELECT v, sum(v) OVER (ORDER BY v) AS s FROM t ORDER BY v",
+                       t=df).to_pydict()
+    assert out["s"] == [1, 3, 6]  # running, not whole-partition
+
+
+def test_sql_lag_negative_offset_is_lead():
+    df = daft_tpu.from_pydict({"v": [1, 2, 3]})
+    out = daft_tpu.sql("SELECT v, lag(v, -1) OVER (ORDER BY v) AS nxt "
+                       "FROM t ORDER BY v", t=df).to_pydict()
+    assert out["nxt"] == [2, 3, None]
