@@ -101,13 +101,26 @@ class LocalWorker(Worker):
                 if self._dead:
                     raise WorkerDiedError(f"worker {self.worker_id} is dead")
                 from daft_tpu.execution.executor import Executor
-                from daft_tpu.execution.resource_manager import RuntimeStats
+                from daft_tpu.execution.resource_manager import (
+                    RuntimeStats,
+                    active_query_stats,
+                )
 
                 bound = bind_task_fragment(task.fragment, task.inputs)
+                # Worker-local stats keep their normal event flush (so
+                # subscribers see OperatorStats exactly once); the snapshot
+                # ALSO merges into the driver's per-query stats for the
+                # DataFrame.metrics() surface.
+                stats = RuntimeStats(task.query_id)
                 executor = Executor(self.cfg, partition_offset=task.partition_idx,
-                                    stats=RuntimeStats(task.query_id))
+                                    stats=stats)
                 out = list(executor.run(bound))
                 parts = collect_task_outputs(out, task.expect_outputs, task.fragment.schema)
+                driver_stats = active_query_stats(task.query_id)
+                if driver_stats is not None and driver_stats is not stats:
+                    for op, c in stats.snapshot().items():
+                        driver_stats.record(op, rows_in=c.rows_in,
+                                            rows_out=c.rows_out, cpu_ns=c.cpu_ns)
                 return [LocalPartitionRef(p, self.worker_id) for p in parts]
             finally:
                 with self._lock:

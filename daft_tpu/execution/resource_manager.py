@@ -118,13 +118,35 @@ class RuntimeStats:
                 for op, c in self.snapshot().items()}
 
 
+#: Driver-side stats for in-flight queries, keyed by query_id. Registered by
+#: the distributed runner so worker-shipped snapshots (and in-process
+#: LocalWorkers) all accumulate into the object behind DataFrame.metrics().
+_ACTIVE_QUERY_STATS: Dict[str, "RuntimeStats"] = {}
+
+
+def register_query_stats(query_id: str, stats: "RuntimeStats") -> None:
+    _ACTIVE_QUERY_STATS[query_id] = stats
+
+
+def unregister_query_stats(query_id: str) -> None:
+    _ACTIVE_QUERY_STATS.pop(query_id, None)
+
+
+def active_query_stats(query_id: str) -> "RuntimeStats | None":
+    return _ACTIVE_QUERY_STATS.get(query_id)
+
+
 def emit_operator_stats(query_id: str, wire: Dict[str, dict]) -> None:
     """Driver-side re-emit of a worker's RuntimeStats.to_wire() payload."""
     from daft_tpu.context import get_context
     from daft_tpu.subscribers.events import OperatorStats
 
+    driver_stats = _ACTIVE_QUERY_STATS.get(query_id)
     notify = get_context().notify
     for op, c in (wire or {}).items():
+        if driver_stats is not None:
+            driver_stats.record(op, rows_in=c["rows_in"],
+                                rows_out=c["rows_out"], cpu_ns=c["cpu_ns"])
         notify(OperatorStats(query_id=query_id, operator=op,
                              rows_in=c["rows_in"], rows_out=c["rows_out"],
                              cpu_us=c["cpu_ns"] // 1000))

@@ -373,6 +373,11 @@ class Expression:
     def approx_percentiles(self, percentiles):
         return self._agg("approx_percentile", percentiles=percentiles)
 
+    def unnest(self) -> "Expression":
+        """Expand this struct column into one output column per field when
+        used in select() (reference: Expression.unnest / .get("*"))."""
+        return self._fn("unnest")
+
     # -- window -----------------------------------------------------------
     def over(self, window) -> "Expression":
         from daft_tpu.expressions.expr import AggOp, WindowExpr
@@ -988,12 +993,22 @@ class ListNamespace(_Namespace):
 
 class StructNamespace(_Namespace):
     def get(self, name: str):
+        if name == "*":
+            # Wildcard expands at projection binding (reference:
+            # Expression.unnest == .get("*")).
+            return self._fn("unnest")
         return self._fn("struct_get", name=name)
 
 
 class MapNamespace(_Namespace):
     def get(self, key):
         return self._fn("map_get", key)
+
+    def keys(self):
+        return self._fn("map_keys")
+
+    def values(self):
+        return self._fn("map_values")
 
 
 class FloatNamespace(_Namespace):
@@ -1025,6 +1040,29 @@ class ImageNamespace(_Namespace):
 
     def to_mode(self, mode):
         return self._fn("image_to_mode", mode=mode)
+
+    def width(self):
+        return self._fn("image_attribute", name="width")
+
+    def height(self):
+        return self._fn("image_attribute", name="height")
+
+    def channel(self):
+        return self._fn("image_attribute", name="channel")
+
+    def mode(self):
+        return self._fn("image_attribute", name="mode")
+
+    def attribute(self, name: str):
+        return self._fn("image_attribute", name=name)
+
+    def hash(self, *, method: str = "phash", hash_size: int = 8,
+             binbits: int = 3, segments: int = 3):
+        return self._fn("image_hash", method=method, hash_size=hash_size,
+                        binbits=binbits, segments=segments)
+
+    def to_tensor(self):
+        return self._fn("to_tensor")
 
 
 class EmbeddingNamespace(_Namespace):

@@ -1312,3 +1312,162 @@ def decode_image(e, mode=None):
 
 def convert_image(e, mode: str):
     return _fn("image_to_mode", e, mode=mode)
+
+
+# -- image accessors (reference: daft/functions/image.py) ------------------
+def image_attribute(image, name: str):
+    return ensure_expr_wrap(image)._fn("image_attribute", name=name)
+
+
+def image_width(image):
+    return image_attribute(image, "width")
+
+
+def image_height(image):
+    return image_attribute(image, "height")
+
+
+def image_channel(image):
+    return image_attribute(image, "channel")
+
+
+def image_mode(image):
+    return image_attribute(image, "mode")
+
+
+def image_hash(image, *, method: str = "phash", hash_size: int = 8,
+               binbits: int = 3, segments: int = 3):
+    """Perceptual image hash -> FixedSizeBinary (reference: image.py
+    image_hash; methods: phash/phash_simple/dhash/dhash_vertical/ahash/
+    whash/crop_resistant/colorhash)."""
+    return ensure_expr_wrap(image)._fn(
+        "image_hash", method=method, hash_size=hash_size, binbits=binbits,
+        segments=segments)
+
+
+def image_to_tensor(image):
+    return ensure_expr_wrap(image)._fn("to_tensor")
+
+
+# -- struct / list / map long tail -----------------------------------------
+def to_struct(*fields, **named_fields):
+    """Pack columns into one struct column (reference: struct.py to_struct)."""
+    exprs = [ensure_expr_wrap(f) for f in fields]
+    names = [e._expr.name() for e in exprs]
+    for n, e in named_fields.items():
+        exprs.append(ensure_expr_wrap(e))
+        names.append(n)
+    from daft_tpu.expressions.expr import FunctionCall
+
+    return Expression(FunctionCall("pack_struct", [e._expr for e in exprs],
+                                   {"names": names}))
+
+
+def to_list(*items):
+    """Pack N columns into one list column per row (reference: list.py
+    to_list)."""
+    from daft_tpu.expressions.expr import FunctionCall
+
+    return Expression(FunctionCall(
+        "list_pack", [ensure_expr_wrap(i)._expr for i in items], {}))
+
+
+def unnest(expr):
+    """Expand a struct column into one output column per field (reference:
+    struct.py unnest = expr.get("*"); expansion happens at projection
+    binding in LogicalPlanBuilder.project)."""
+    return ensure_expr_wrap(expr)._fn("unnest")
+
+
+def seq(n):
+    """[0..n-1] list per row (reference: list.py seq)."""
+    return ensure_expr_wrap(n)._fn("list_seq")
+
+
+def map_keys(expr):
+    return ensure_expr_wrap(expr)._fn("map_keys")
+
+
+def map_values(expr):
+    return ensure_expr_wrap(expr)._fn("map_values")
+
+
+def explode(list_expr, ignore_empty_and_null: bool = False):
+    """Marker usable in select() to explode a list column: the projection
+    binds the inner expression and appends an Explode node (reference:
+    list.py explode)."""
+    return ensure_expr_wrap(list_expr)._fn("explode")
+
+
+# -- datetime long tail ----------------------------------------------------
+def time(expr):
+    """Extract the time-of-day component (reference: datetime.py time)."""
+    return ensure_expr_wrap(expr).dt.time()
+
+
+def make_timestamp(year, month, day, hour, minute, second,
+                   timezone: Optional[str] = None):
+    """Build Timestamp[us] from components; invalid dates -> null
+    (reference: datetime.py make_timestamp)."""
+    from daft_tpu.expressions.expr import FunctionCall
+
+    parts = [ensure_expr_wrap(e)._expr
+             for e in (year, month, day, hour, minute, second)]
+    return Expression(FunctionCall("make_timestamp", parts,
+                                   {"timezone": timezone}))
+
+
+def make_timestamp_ltz(year, month, day, hour, minute, second,
+                       timezone: str = "UTC"):
+    """make_timestamp carrying local-time-zone metadata (reference:
+    datetime.py make_timestamp_ltz)."""
+    return make_timestamp(year, month, day, hour, minute, second,
+                          timezone=timezone)
+
+
+# -- uuid7 partition transforms (reference: partition.py) ------------------
+def extract_minute_uuid7(expr):
+    return ensure_expr_wrap(expr)._fn("extract_minute_uuid7")
+
+
+def extract_hour_uuid7(expr):
+    return ensure_expr_wrap(expr)._fn("extract_hour_uuid7")
+
+
+def extract_day_uuid7(expr):
+    return ensure_expr_wrap(expr)._fn("extract_day_uuid7")
+
+
+def extract_month_uuid7(expr):
+    return ensure_expr_wrap(expr)._fn("extract_month_uuid7")
+
+
+# -- window ----------------------------------------------------------------
+def over(expr, window):
+    """Apply a Window spec to an expression (reference: window.py over)."""
+    return ensure_expr_wrap(expr).over(window)
+
+
+# -- typed files / hdf5 / video / process ----------------------------------
+from daft_tpu.functions.media import (  # noqa: E402
+    audio_file,
+    decode_image_file,
+    file,
+    hdf5_attrs,
+    hdf5_file,
+    hdf5_keys,
+    hdf5_metadata,
+    image_file,
+    image_file_metadata,
+    run_process,
+    video_file,
+    video_frames,
+    video_keyframes,
+)
+
+_AI_LAZY = ("embed_text", "embed_image", "classify_text", "classify_image",
+            "prompt", "llm_generate")
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_AI_LAZY))

@@ -246,3 +246,187 @@ def _image_crop(args, bbox=None, **kwargs):
         })
     out_dt = DataType.image(s.dtype.image_mode)
     return Series.from_arrow(pa.array(out_rows, out_dt.to_arrow()), s.name, out_dt)
+
+
+# ------------------------------------------------------------------ #
+# image accessors (reference: daft/functions/image.py image_attribute/ #
+# image_width/image_height/image_channel/image_mode)                  #
+# ------------------------------------------------------------------ #
+def _attr_resolver(fields, kwargs):
+    name = kwargs.get("name", "width")
+    dt = DataType.string() if name == "mode" else DataType.uint32()
+    return Field(fields[0].name, dt)
+
+
+@register_kernel("image_attribute", _attr_resolver)
+def _image_attribute(args, name: str = "width", **kwargs):
+    s = args[0]
+    out = []
+    for arr, m in _image_rows(s):
+        if arr is None:
+            out.append(None)
+        elif name == "width":
+            out.append(arr.shape[1])
+        elif name == "height":
+            out.append(arr.shape[0])
+        elif name == "channel":
+            out.append(arr.shape[2])
+        elif name == "mode":
+            out.append((m or ImageMode.RGB).name)
+        else:
+            raise DaftValueError(f"unknown image attribute {name!r}")
+    dt = DataType.string() if name == "mode" else DataType.uint32()
+    return Series.from_pylist(out, s.name, dt)
+
+
+@register_kernel("to_tensor", lambda f, k: Field(
+    f[0].name,
+    DataType.tensor(DataType.uint8(), (f[0].dtype._params[1], f[0].dtype._params[2],
+                                       (f[0].dtype._params[0].num_channels
+                                        if f[0].dtype._params[0] else 3)))
+    if f[0].dtype.id == TypeId.FIXED_SHAPE_IMAGE
+    else DataType.tensor(DataType.uint8())))
+def _image_to_tensor(args, **kwargs):
+    """Image -> (fixed-shape when known) uint8 tensor (reference: image.py
+    image_to_tensor / "to_tensor" builtin)."""
+    s = args[0]
+    dt = s.dtype
+    if dt.id == TypeId.FIXED_SHAPE_IMAGE:
+        out_dt = DataType.tensor(
+            DataType.uint8(),
+            (dt._params[1], dt._params[2],
+             dt._params[0].num_channels if dt._params[0] else 3))
+        return s.cast(out_dt)
+    out_dt = DataType.tensor(DataType.uint8())
+    rows = [None if arr is None else np.ascontiguousarray(arr).astype(np.uint8)
+            for arr, _ in _image_rows(s)]
+    return Series.from_pylist(rows, s.name, out_dt)
+
+
+# ------------------------------------------------------------------ #
+# perceptual image hashes (reference: daft/functions/image.py          #
+# image_hash: phash/phash_simple/dhash/dhash_vertical/ahash/whash/     #
+# crop_resistant/colorhash -> FixedSizeBinary)                         #
+# ------------------------------------------------------------------ #
+def _to_gray(arr: np.ndarray) -> np.ndarray:
+    if arr.shape[2] < 3:  # L or LA: luminance channel, alpha ignored
+        return arr[:, :, 0].astype(np.float64)
+    rgb = arr[:, :, :3].astype(np.float64)
+    return rgb @ np.array([0.299, 0.587, 0.114])
+
+
+def _pil_resize_gray(arr: np.ndarray, w: int, h: int) -> np.ndarray:
+    from PIL import Image as PILImage
+
+    g = _to_gray(arr)
+    img = PILImage.fromarray(np.clip(g, 0, 255).astype(np.uint8), "L")
+    return np.asarray(img.resize((w, h), PILImage.LANCZOS), dtype=np.float64)
+
+
+def _dct_matrix(n: int) -> np.ndarray:
+    k = np.arange(n)
+    return np.cos(np.pi / n * (k[None, :] + 0.5) * k[:, None])
+
+
+def _bits_to_bytes(bits: np.ndarray) -> bytes:
+    pad = (-len(bits)) % 8
+    if pad:
+        bits = np.concatenate([bits, np.zeros(pad, dtype=bool)])
+    return np.packbits(bits.astype(np.uint8)).tobytes()
+
+
+def _hash_one(arr: np.ndarray, method: str, hash_size: int, binbits: int,
+              segments: int) -> bytes:
+    hs = hash_size
+    if method == "ahash":
+        px = _pil_resize_gray(arr, hs, hs)
+        return _bits_to_bytes((px > px.mean()).ravel())
+    if method == "dhash":
+        px = _pil_resize_gray(arr, hs + 1, hs)
+        return _bits_to_bytes((px[:, 1:] > px[:, :-1]).ravel())
+    if method == "dhash_vertical":
+        px = _pil_resize_gray(arr, hs, hs + 1)
+        return _bits_to_bytes((px[1:, :] > px[:-1, :]).ravel())
+    if method == "phash":
+        n = hs * 4
+        px = _pil_resize_gray(arr, n, n)
+        C = _dct_matrix(n)
+        freq = (C @ px @ C.T)[:hs, :hs]
+        flat = freq.ravel()
+        med = np.median(flat[1:])  # exclude the DC coefficient
+        return _bits_to_bytes(flat > med)
+    if method == "phash_simple":
+        n = hs * 4
+        px = _pil_resize_gray(arr, n, n)
+        C = _dct_matrix(n)
+        freq = (C @ px)[:hs, :hs]
+        return _bits_to_bytes((freq > freq.mean()).ravel())
+    if method == "whash":
+        # One-level Haar approximation band: 2x2 mean pooling to hash_size.
+        px = _pil_resize_gray(arr, hs * 2, hs * 2)
+        ll = px.reshape(hs, 2, hs, 2).mean(axis=(1, 3))
+        return _bits_to_bytes((ll > np.median(ll)).ravel())
+    if method == "crop_resistant":
+        parts = []
+        H, W = arr.shape[0], arr.shape[1]
+        for i in range(segments):
+            for j in range(segments):
+                seg = arr[i * H // segments:(i + 1) * H // segments or H,
+                          j * W // segments:(j + 1) * W // segments or W]
+                if seg.size == 0:
+                    seg = arr
+                parts.append(_hash_one(seg, "phash", hash_size, binbits, segments))
+        return b"".join(parts)
+    if method == "colorhash":
+        # 14 hue/intensity bins quantized to binbits each (imagehash-style).
+        rgb = arr[:, :, :3].astype(np.float64) if arr.shape[2] >= 3 else np.repeat(
+            arr[:, :, :1].astype(np.float64), 3, axis=2)
+        mx, mn = rgb.max(axis=2), rgb.min(axis=2)
+        sat = np.where(mx > 0, (mx - mn) / np.maximum(mx, 1e-9), 0.0)
+        gray_mask = sat < 0.1
+        r, g, b = rgb[:, :, 0], rgb[:, :, 1], rgb[:, :, 2]
+        delta = np.maximum(mx - mn, 1e-9)
+        hue = np.where(mx == r, (g - b) / delta % 6,
+                       np.where(mx == g, (b - r) / delta + 2, (r - g) / delta + 4)) / 6
+        counts = np.zeros(14)
+        # 2 intensity bins for near-gray pixels + 12 hue bins for the rest.
+        lum = mx / 255.0
+        counts[0] = np.count_nonzero(gray_mask & (lum < 0.5))
+        counts[1] = np.count_nonzero(gray_mask & (lum >= 0.5))
+        hue_bins = np.minimum((hue[~gray_mask] * 12).astype(int), 11)
+        for hb in hue_bins:
+            counts[2 + hb] += 1
+        frac = counts / max(counts.sum(), 1)
+        maxq = (1 << binbits) - 1
+        q = np.minimum((frac * maxq * 4).astype(int), maxq)
+        bits = ((q[:, None] >> np.arange(binbits - 1, -1, -1)) & 1).astype(bool)
+        return _bits_to_bytes(bits.ravel())
+    raise DaftValueError(f"unknown image hash method {method!r}")
+
+
+def _image_hash_nbytes(method: str, hash_size: int, binbits: int,
+                       segments: int) -> int:
+    if method == "colorhash":
+        return (14 * binbits + 7) // 8
+    if method == "crop_resistant":
+        return segments * segments * ((hash_size * hash_size + 7) // 8)
+    return (hash_size * hash_size + 7) // 8
+
+
+def _image_hash_resolver(fields, kwargs):
+    n = _image_hash_nbytes(kwargs.get("method", "phash"),
+                           kwargs.get("hash_size", 8),
+                           kwargs.get("binbits", 3), kwargs.get("segments", 3))
+    return Field(fields[0].name, DataType.fixed_size_binary(n))
+
+
+@register_kernel("image_hash", _image_hash_resolver)
+def _image_hash(args, method: str = "phash", hash_size: int = 8,
+                binbits: int = 3, segments: int = 3, **kwargs):
+    s = args[0]
+    n = _image_hash_nbytes(method, hash_size, binbits, segments)
+    out = [None if arr is None
+           else _hash_one(arr, method, hash_size, binbits, segments)
+           for arr, _ in _image_rows(s)]
+    dt = DataType.fixed_size_binary(n)
+    return Series.from_arrow(pa.array(out, dt.to_arrow()), s.name, dt)

@@ -301,3 +301,47 @@ def _list_compact(args, **kwargs):
     out = [None if v is None else [x for x in v if x is not None]
            for v in args[0].to_pylist()]
     return Series.from_pylist(out, args[0].name, args[0].dtype)
+
+
+@register_kernel("list_seq", lambda f, k: Field(f[0].name, DataType.list(DataType.uint64())))
+def _list_seq(args, **kwargs):
+    """n -> [0, 1, ..., n-1] per row (reference: daft/functions/list.py seq)."""
+    import numpy as np
+
+    s = args[0]
+    vals, mask = s.cast(DataType.int64()).to_numpy_masked()
+    n = np.where(mask, 0, np.maximum(vals, 0)) if mask is not None else np.maximum(vals, 0)
+    offsets = np.zeros(len(n) + 1, dtype=np.int64)
+    np.cumsum(n, out=offsets[1:])
+    values = pa.array(
+        (np.arange(int(offsets[-1]), dtype=np.uint64) -
+         np.repeat(offsets[:-1], n).astype(np.uint64)),
+        pa.uint64())
+    null_mask = pa.array(mask) if mask is not None and mask.any() else None
+    arr = pa.LargeListArray.from_arrays(pa.array(offsets, pa.int64()), values,
+                                        mask=null_mask)
+    dt = DataType.list(DataType.uint64())
+    return Series.from_arrow(arr.cast(dt.to_arrow()), s.name, dt)
+
+
+def _list_pack_resolver(fields, kwargs):
+    from daft_tpu.datatype import unify_dtypes
+
+    inner = fields[0].dtype
+    for f in fields[1:]:
+        inner = unify_dtypes(inner, f.dtype)
+    return Field(fields[0].name, DataType.list(inner))
+
+
+@register_kernel("list_pack", _list_pack_resolver)
+def _list_pack(args, **kwargs):
+    """N columns -> one list column of [col0, col1, ...] per row (reference:
+    daft/functions/list.py to_list)."""
+    from daft_tpu.datatype import unify_dtypes
+
+    inner = args[0].dtype
+    for s in args[1:]:
+        inner = unify_dtypes(inner, s.dtype)
+    cols = [s.cast(inner).to_pylist() for s in args]
+    out = [list(row) for row in zip(*cols)]
+    return Series.from_pylist(out, args[0].name, DataType.list(inner))

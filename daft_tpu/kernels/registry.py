@@ -88,6 +88,7 @@ def _ensure_loaded() -> None:
             binary_ops,
             embedding_ops,
             extended_ops,
+            file_ops,
             float_ops,
             image_ops,
             list_ops,

@@ -313,3 +313,86 @@ def _convert_time_zone(args, timezone="UTC", **kwargs):
         arr = pc.assume_timezone(arr, "UTC")
     out = arr.cast(pa.timestamp(arr.type.unit, timezone))
     return _wrap(out, args[0].name, DataType.timestamp(arr.type.unit, timezone))
+
+
+def _make_ts_resolver(fields, kwargs):
+    return Field("timestamp",
+                 DataType.timestamp(TimeUnit.US, kwargs.get("timezone")))
+
+
+@register_kernel("make_timestamp", _make_ts_resolver)
+def _make_timestamp(args, timezone=None, **kwargs):
+    """(year, month, day, hour, minute, second[, microsecond]) -> Timestamp[us].
+    Components are wall-clock time IN the given timezone; fractional seconds
+    are honoured; invalid combinations yield null (reference:
+    daft/functions/datetime.py make_timestamp)."""
+    import datetime as _dt
+
+    tz = None
+    if timezone:
+        from zoneinfo import ZoneInfo
+
+        tz = _dt.timezone.utc if timezone.upper() == "UTC" else ZoneInfo(timezone)
+    cols = [s.to_pylist() for s in args]
+    epoch = _dt.datetime(1970, 1, 1, tzinfo=_dt.timezone.utc)
+    out = []
+    for row in zip(*cols):
+        if any(v is None for v in row[:6]):
+            out.append(None)
+            continue
+        y, mo, d, h, mi = (int(v) for v in row[:5])
+        # Whole micros first so 59.9999999 rounds into the next second
+        # instead of overflowing datetime's microsecond argument.
+        total_us = int(round(float(row[5]) * 1e6))
+        if len(row) > 6 and row[6] is not None:
+            total_us += int(row[6])
+        sec, us = divmod(total_us, 1_000_000)
+        extra_min, sec = divmod(sec, 60)
+        try:
+            base = _dt.datetime(y, mo, d, h, mi, sec, us, tzinfo=tz)
+        except ValueError:
+            out.append(None)
+            continue
+        if extra_min:
+            base += _dt.timedelta(minutes=extra_min)
+        if tz is None:
+            base = base.replace(tzinfo=_dt.timezone.utc)
+        out.append(int((base - epoch).total_seconds() * 1e6))
+    dt = DataType.timestamp(TimeUnit.US, timezone)
+    return Series.from_arrow(pa.array(out, pa.int64()).cast(dt.to_arrow()),
+                             "timestamp", dt)
+
+
+# ------------------------------------------------------------------ #
+# UUIDv7 partition transforms (reference: daft/functions/partition.py #
+# extract_{minute,hour,day,month}_uuid7; src/daft-functions/src/uuid.rs).
+# A UUIDv7 embeds a 48-bit unix-ms timestamp in its first 6 bytes.     #
+# ------------------------------------------------------------------ #
+def _uuid7_ms(v) -> int:
+    if isinstance(v, str):
+        raw = bytes.fromhex(v.replace("-", "")[:12])
+    else:
+        raw = bytes(v)[:6]
+    return int.from_bytes(raw, "big")
+
+
+def _register_uuid7(name: str, convert):
+    @register_kernel(name, returns(DataType.int64()))
+    def _k(args, **kwargs):
+        out = [None if v is None else convert(_uuid7_ms(v))
+               for v in args[0].to_pylist()]
+        return Series.from_pylist(out, args[0].name, DataType.int64())
+    return _k
+
+
+def _months_since_epoch(ms: int) -> int:
+    import datetime as _dt
+
+    d = _dt.datetime.fromtimestamp(ms / 1000.0, _dt.timezone.utc)
+    return (d.year - 1970) * 12 + (d.month - 1)
+
+
+_register_uuid7("extract_minute_uuid7", lambda ms: ms // 60_000)
+_register_uuid7("extract_hour_uuid7", lambda ms: ms // 3_600_000)
+_register_uuid7("extract_day_uuid7", lambda ms: ms // 86_400_000)
+_register_uuid7("extract_month_uuid7", _months_since_epoch)

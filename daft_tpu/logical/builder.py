@@ -36,7 +36,48 @@ class LogicalPlanBuilder:
 
     # -- row ops ----------------------------------------------------------
     def project(self, exprs: Sequence[Expr]) -> "LogicalPlanBuilder":
-        from daft_tpu.expressions.expr import Alias, WindowExpr
+        from daft_tpu.expressions.expr import Alias, FunctionCall, WindowExpr
+
+        # Top-level unnest(struct_col) markers expand into one struct_get per
+        # field (reference: Expression.unnest == .get("*"), expanded by the
+        # Rust builder's wildcard resolution). Top-level explode(list_col)
+        # markers project the inner expression and append an Explode node
+        # (reference: daft/functions/list.py explode usable in select).
+        def _is_marker(e: Expr, name: str) -> bool:
+            return isinstance(e, FunctionCall) and e.fn_name == name
+
+        explode_names = []
+        if any(_is_marker(e, "unnest") or _is_marker(e, "explode") or
+               (isinstance(e, Alias) and
+                (_is_marker(e.child, "explode") or _is_marker(e.child, "unnest")))
+               for e in exprs):
+            from daft_tpu.errors import DaftTypeError
+
+            expanded = []
+            for e in exprs:
+                if _is_marker(e, "unnest"):
+                    inner = e.args[0]
+                    dt = inner.to_field(self.schema).dtype
+                    if not dt.is_struct():
+                        raise DaftTypeError(
+                            f"unnest expects a struct column, got {dt!r}")
+                    for fname in dt.fields:
+                        expanded.append(Alias(
+                            FunctionCall("struct_get", [inner],
+                                         {"name": fname}), fname))
+                elif isinstance(e, Alias) and _is_marker(e.child, "unnest"):
+                    raise DaftTypeError(
+                        "unnest expands to multiple columns and cannot be "
+                        "aliased; select(unnest(col)) without .alias()")
+                elif _is_marker(e, "explode"):
+                    expanded.append(e.args[0])
+                    explode_names.append(e.args[0].name())
+                elif isinstance(e, Alias) and _is_marker(e.child, "explode"):
+                    expanded.append(Alias(e.child.args[0], e.name()))
+                    explode_names.append(e.name())
+                else:
+                    expanded.append(e)
+            exprs = expanded
 
         # Projections containing window expressions plan a Window node that
         # appends the window columns, then a final Project re-shapes
@@ -69,8 +110,12 @@ class LogicalPlanBuilder:
             windowed = self._plan
             for group in groups.values():
                 windowed = lp.Window(windowed, group)
-            return LogicalPlanBuilder(lp.Project(windowed, rewritten))
-        return LogicalPlanBuilder(lp.Project(self._plan, exprs))
+            out = LogicalPlanBuilder(lp.Project(windowed, rewritten))
+        else:
+            out = LogicalPlanBuilder(lp.Project(self._plan, exprs))
+        if explode_names:
+            out = out.explode([ColumnRef(n) for n in explode_names])
+        return out
 
     def select(self, exprs: Sequence[Expr]) -> "LogicalPlanBuilder":
         return self.project(exprs)

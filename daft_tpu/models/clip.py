@@ -177,7 +177,17 @@ def init_clip_params(cfg: CLIPConfig, seed: int = 0):
     rng = jax.random.PRNGKey(seed)
     pixels = jnp.zeros((2, cfg.image_size, cfg.image_size, 3), jnp.uint8)
     tokens = jnp.zeros((2, cfg.context_length), jnp.int32)
-    return model, model.init(rng, pixels, tokens)
+    init = model.init
+    try:
+        # Initialize on the host CPU backend when one exists: random-init of
+        # 300M+ params is memory-bandwidth work, and on a tunneled TPU the
+        # alternative is a multi-second remote compile of the init graph
+        # before the first batch can run. Callers device_put afterwards.
+        if jax.devices()[0].platform != "cpu" and jax.devices("cpu"):
+            init = jax.jit(model.init, backend="cpu")
+    except Exception:
+        pass
+    return model, init(rng, pixels, tokens)
 
 
 def load_params(path: str, cfg: CLIPConfig):

@@ -94,6 +94,16 @@ class DistributedRunner(Runner):
         ctx.notify(QueryStart(query_id=query_id, plan=repr(optimized.plan)))
         start = time.perf_counter()
         error = None
+        from daft_tpu.execution.resource_manager import (
+            RuntimeStats,
+            register_query_stats,
+            unregister_query_stats,
+        )
+
+        stats = RuntimeStats(query_id)
+        stats.local_flush = False  # workers already emit OperatorStats events
+        ctx.last_query_stats = stats  # DataFrame.metrics() surface
+        register_query_stats(query_id, stats)
         try:
             executor = DistributedExecutor(self.manager, cfg, query_id=query_id)
             refs = executor.execute(physical)
@@ -105,5 +115,6 @@ class DistributedRunner(Runner):
             error = str(e)
             raise
         finally:
+            unregister_query_stats(query_id)
             ctx.notify(QueryEnd(query_id=query_id,
                                 duration_s=time.perf_counter() - start, error=error))

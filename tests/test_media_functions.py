@@ -1,0 +1,328 @@
+"""Typed files, hdf5, video, image accessors/hashes, and misc long-tail
+functions (reference: daft/functions/{file_,hdf5,video,image,process,struct,
+list,partition,datetime}.py)."""
+
+from __future__ import annotations
+
+import io
+import struct as _struct
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+import daft_tpu
+from daft_tpu import col
+from daft_tpu import functions as F
+from daft_tpu.datatype import DataType
+
+
+def _png_bytes(w=6, h=4, color=(255, 0, 0)):
+    from PIL import Image
+
+    buf = io.BytesIO()
+    Image.new("RGB", (w, h), color).save(buf, "PNG")
+    return buf.getvalue()
+
+
+def _image_df(n=2, h=8, w=6):
+    img = np.zeros((n, h, w, 3), np.uint8)
+    img[0, : h // 2] = 200
+    s = daft_tpu.Series.from_numpy(img.reshape(n, -1), "img",
+                                   DataType.image("RGB", h, w))
+    return daft_tpu.from_pydict({"img": s})
+
+
+# -- typed file constructors ------------------------------------------------
+def test_file_constructors_and_verify(tmp_path):
+    png = tmp_path / "a.png"
+    png.write_bytes(_png_bytes())
+    txt = tmp_path / "b.txt"
+    txt.write_text("not an image")
+    df = daft_tpu.from_pydict({"p": [str(png)]})
+    out = df.select(F.image_file(col("p"), verify=True).alias("f")).to_pydict()
+    assert out["f"][0].url == str(png)
+
+    bad = daft_tpu.from_pydict({"p": [str(txt)]})
+    with pytest.raises(Exception, match="not a valid image"):
+        bad.select(F.image_file(col("p"), verify=True)).collect()
+    # without verify it passes through
+    bad.select(F.file(col("p"))).collect()
+
+
+def test_decode_image_file_and_metadata(tmp_path):
+    p = tmp_path / "img.png"
+    p.write_bytes(_png_bytes(10, 7))
+    df = daft_tpu.from_pydict({"p": [str(p), None]})
+    out = df.select(
+        F.decode_image_file(F.image_file(col("p"))).alias("img"),
+        F.image_file_metadata(F.file(col("p"))).alias("meta"),
+    ).to_pydict()
+    assert out["meta"][0] == {"width": 10, "height": 7, "format": "png",
+                              "mode": "RGB"}
+    assert out["meta"][1] is None
+
+
+# -- image accessors + hashes ----------------------------------------------
+def test_image_accessors():
+    df = _image_df()
+    out = df.select(
+        F.image_width(col("img")).alias("w"),
+        F.image_height(col("img")).alias("h"),
+        F.image_channel(col("img")).alias("c"),
+        F.image_mode(col("img")).alias("m"),
+    ).to_pydict()
+    assert out["w"] == [6, 6] and out["h"] == [8, 8]
+    assert out["c"] == [3, 3] and out["m"] == ["RGB", "RGB"]
+    # namespace forms
+    ns = df.select(col("img").image.width().alias("w"),
+                   col("img").image.mode().alias("m")).to_pydict()
+    assert ns["w"] == [6, 6] and ns["m"] == ["RGB", "RGB"]
+
+
+@pytest.mark.parametrize("method,nbytes", [
+    ("phash", 8), ("phash_simple", 8), ("ahash", 8), ("dhash", 8),
+    ("dhash_vertical", 8), ("whash", 8), ("colorhash", 6),
+    ("crop_resistant", 72),
+])
+def test_image_hash_methods(method, nbytes):
+    df = _image_df(n=2, h=32, w=32)
+    out = df.select(F.image_hash(col("img"), method=method).alias("h")).to_pydict()
+    assert len(out["h"][0]) == nbytes
+    # deterministic: same image hashes equal
+    assert out["h"][0] == df.select(
+        col("img").image.hash(method=method).alias("h")).to_pydict()["h"][0]
+
+
+def test_image_hash_similarity():
+    # a slightly perturbed image should be hamming-close; an inverted one far
+    rng = np.random.default_rng(0)
+    base = rng.integers(0, 255, (64, 64, 3), np.uint8)
+    near = base.copy()
+    near[:4, :4] = 0
+    far = 255 - base
+    imgs = np.stack([base, near, far])
+    s = daft_tpu.Series.from_numpy(imgs.reshape(3, -1), "img",
+                                   DataType.image("RGB", 64, 64))
+    out = daft_tpu.from_pydict({"img": s}).select(
+        F.image_hash(col("img")).alias("h")).to_pydict()["h"]
+
+    def ham(a, b):
+        return sum(bin(x ^ y).count("1") for x, y in zip(a, b))
+
+    assert ham(out[0], out[1]) < ham(out[0], out[2])
+
+
+def test_image_to_tensor():
+    df = _image_df()
+    out = df.select(F.image_to_tensor(col("img")).alias("t"))
+    assert out.schema["t"].dtype.id.value == "fixed_shape_tensor"
+    vals = out.to_pydict()["t"]
+    assert np.asarray(vals[0]).shape == (8, 6, 3)
+
+
+# -- struct/list/map long tail ---------------------------------------------
+def test_to_struct_and_unnest():
+    df = daft_tpu.from_pydict({"a": [1, 2], "b": ["x", "y"]})
+    st = df.select(F.to_struct(col("a"), col("b")).alias("s"))
+    assert st.to_pydict()["s"] == [{"a": 1, "b": "x"}, {"a": 2, "b": "y"}]
+    back = st.select(F.unnest(col("s"))).to_pydict()
+    assert back == {"a": [1, 2], "b": ["x", "y"]}
+    # method + wildcard forms
+    assert st.select(col("s").unnest()).to_pydict() == back
+    assert st.select(col("s").struct.get("*")).to_pydict() == back
+
+
+def test_to_list_seq_map_keys_values():
+    df = daft_tpu.from_pydict({"a": [1, 2], "b": [10, 20], "n": [2, 0]})
+    out = df.select(F.to_list(col("a"), col("b")).alias("l"),
+                    F.seq(col("n")).alias("s")).to_pydict()
+    assert out["l"] == [[1, 10], [2, 20]]
+    assert out["s"] == [[0, 1], []]
+
+    m = pa.array([[("a", 1), ("b", 2)], None],
+                 type=pa.map_(pa.string(), pa.int64()))
+    dm = daft_tpu.from_arrow(pa.table({"m": m}))
+    got = dm.select(F.map_keys(col("m")).alias("k"),
+                    F.map_values(col("m")).alias("v"),
+                    col("m").map.keys().alias("k2")).to_pydict()
+    assert got["k"] == [["a", "b"], None] and got["v"] == [[1, 2], None]
+    assert got["k2"] == got["k"]
+
+
+# -- datetime / uuid7 ------------------------------------------------------
+def test_make_timestamp():
+    df = daft_tpu.from_pydict({"y": [2024, 2024], "mo": [2, 13], "d": [29, 1],
+                               "h": [1, 1], "mi": [2, 2], "s": [3.25, 3.0]})
+    out = df.select(F.make_timestamp(col("y"), col("mo"), col("d"), col("h"),
+                                     col("mi"), col("s")).alias("t")).to_pydict()
+    t = out["t"][0]
+    assert (t.year, t.month, t.day, t.microsecond) == (2024, 2, 29, 250000)
+    assert out["t"][1] is None  # month 13 -> null
+
+
+def test_uuid7_extracts():
+    import datetime as dt
+
+    ms = int(dt.datetime(2023, 6, 15, 12, tzinfo=dt.timezone.utc).timestamp() * 1000)
+    u = ms.to_bytes(6, "big").hex()
+    u = f"{u[:8]}-{u[8:12]}-7000-8000-000000000000"
+    df = daft_tpu.from_pydict({"u": [u]})
+    out = df.select(F.extract_day_uuid7(col("u")).alias("d"),
+                    F.extract_hour_uuid7(col("u")).alias("h"),
+                    F.extract_minute_uuid7(col("u")).alias("mi"),
+                    F.extract_month_uuid7(col("u")).alias("mo")).to_pydict()
+    assert out["d"][0] == ms // 86_400_000
+    assert out["h"][0] == ms // 3_600_000
+    assert out["mi"][0] == ms // 60_000
+    assert out["mo"][0] == (2023 - 1970) * 12 + 5
+
+
+# -- hdf5 ------------------------------------------------------------------
+def test_hdf5_functions(tmp_path):
+    h5py = pytest.importorskip("h5py")
+    p = tmp_path / "d.h5"
+    with h5py.File(p, "w") as f:
+        f.create_dataset("x", data=np.arange(6).reshape(2, 3))
+        g = f.create_group("grp")
+        g.attrs["note"] = "hello"
+        f.attrs["version"] = 3
+    df = daft_tpu.from_pydict({"p": [str(p)]})
+    fexpr = F.hdf5_file(col("p"), verify=True)
+    keys = df.select(F.hdf5_keys(fexpr).alias("k")).to_pydict()["k"][0]
+    assert sorted(keys) == ["grp", "x"]
+    meta = df.select(F.hdf5_metadata(fexpr).alias("m")).to_pydict()["m"][0]
+    byname = {m["h5path"]: m for m in meta}
+    assert byname["/x"]["kind"] == "dataset" and byname["/x"]["shape"] == [2, 3]
+    assert byname["/grp"]["kind"] == "group"
+    attrs = df.select(F.hdf5_attrs(fexpr).alias("a")).to_pydict()["a"][0]
+    assert attrs["version"] == 3
+
+
+# -- video -----------------------------------------------------------------
+def _write_test_video(path, n_frames=12, w=64, h=48, fps=10):
+    cv2 = pytest.importorskip("cv2")
+    vw = cv2.VideoWriter(str(path), cv2.VideoWriter_fourcc(*"mp4v"), fps, (w, h))
+    assert vw.isOpened()
+    for i in range(n_frames):
+        frame = np.full((h, w, 3), i * 20 % 255, np.uint8)
+        vw.write(frame)
+    vw.release()
+
+
+def test_video_frames(tmp_path):
+    p = tmp_path / "v.mp4"
+    _write_test_video(p)
+    df = daft_tpu.from_pydict({"p": [str(p)]})
+    rows = df.select(F.video_frames(F.video_file(col("p"))).alias("fr")).to_pydict()["fr"][0]
+    assert len(rows) == 12
+    assert rows[0]["frame_index"] == 0 and rows[0]["data"] is not None
+    assert rows[1]["frame_time"] >= rows[0]["frame_time"]
+    # time-range + sampling
+    sampled = df.select(F.video_frames(
+        F.video_file(col("p")), sample_interval_seconds=0.5).alias("fr")
+    ).to_pydict()["fr"][0]
+    assert 0 < len(sampled) < 12
+
+
+def test_video_keyframes(tmp_path):
+    p = tmp_path / "v.mp4"
+    _write_test_video(p)
+    df = daft_tpu.from_pydict({"p": [str(p)]})
+    kf = df.select(F.video_keyframes(F.video_file(col("p"))).alias("k")).to_pydict()["k"][0]
+    assert len(kf) >= 1  # at least the first sync sample
+
+
+def test_mp4_stss_parser():
+    from daft_tpu.functions.media import _mp4_keyframe_indices
+
+    # hand-built minimal moov/trak/mdia/minf/stbl/stss box nest
+    stss = _struct.pack(">I4sII", 16 + 8, b"stss", 0, 2) + _struct.pack(">II", 1, 8)
+
+    def box(name, payload):
+        return _struct.pack(">I4s", 8 + len(payload), name) + payload
+
+    data = box(b"moov", box(b"trak", box(b"mdia", box(b"minf", box(b"stbl", stss)))))
+    assert _mp4_keyframe_indices(data) == [0, 7]
+
+
+# -- process ---------------------------------------------------------------
+def test_run_process():
+    df = daft_tpu.from_pydict({"a": ["hello"], "b": ["world"]})
+    out = df.select(F.run_process(["echo", col("a"), col("b")]).alias("o")).to_pydict()
+    assert out["o"][0].strip() == "hello world"
+    out2 = df.select(F.run_process("echo hi | wc -c", shell=True,
+                                   return_dtype=DataType.int64()).alias("n")).to_pydict()
+    assert out2["n"][0] == 3
+
+
+def test_run_process_on_error():
+    df = daft_tpu.from_pydict({"x": ["a"]})
+    out = df.select(F.run_process(["false"], on_error="ignore").alias("o")).to_pydict()
+    assert out["o"] == [None]
+
+
+# -- over / explode / time wrappers ----------------------------------------
+def test_over_and_time_wrappers():
+    from daft_tpu.window import Window
+
+    df = daft_tpu.from_pydict({"g": ["a", "a", "b"], "v": [1, 2, 3]})
+    w = Window().partition_by("g")
+    out = df.select(col("g"), F.over(F.sum(col("v")), w).alias("s")) \
+        .sort("g").to_pydict()
+    assert out["s"] == [3, 3, 3]
+
+    import datetime as dt
+
+    tdf = daft_tpu.from_pydict({
+        "t": [dt.datetime(2024, 1, 2, 3, 4, 5)]})
+    got = tdf.select(F.time(col("t")).alias("tt")).to_pydict()["tt"][0]
+    assert (got.hour, got.minute, got.second) == (3, 4, 5)
+
+
+# -- review regressions -----------------------------------------------------
+def test_make_timestamp_timezone_wall_clock():
+    df = daft_tpu.from_pydict({"y": [2024], "mo": [1], "d": [1], "h": [0],
+                               "mi": [0], "s": [0.0]})
+    out = df.select(F.make_timestamp(col("y"), col("mo"), col("d"), col("h"),
+                                     col("mi"), col("s"),
+                                     timezone="America/New_York").alias("t"))
+    t = out.to_pydict()["t"][0]
+    # components are wall-clock IN the zone, not UTC relabeled
+    assert (t.year, t.month, t.day, t.hour) == (2024, 1, 1, 0)
+    assert t.utcoffset().total_seconds() == -5 * 3600
+
+
+def test_make_timestamp_fractional_rollover():
+    df = daft_tpu.from_pydict({"y": [2024], "mo": [1], "d": [1], "h": [0],
+                               "mi": [0], "s": [59.9999999]})
+    t = df.select(F.make_timestamp(col("y"), col("mo"), col("d"), col("h"),
+                                   col("mi"), col("s")).alias("t")).to_pydict()["t"][0]
+    assert (t.minute, t.second, t.microsecond) == (1, 0, 0)
+
+
+def test_explode_in_select():
+    df = daft_tpu.from_pydict({"g": ["a", "b"], "l": [[1, 2], [3]]})
+    out = df.select(col("g"), F.explode(col("l"))).to_pydict()
+    assert out == {"g": ["a", "a", "b"], "l": [1, 2, 3]}
+    aliased = df.select(F.explode(col("l")).alias("v")).to_pydict()
+    assert aliased == {"v": [1, 2, 3]}
+
+
+def test_unnest_misuse_errors():
+    df = daft_tpu.from_pydict({"a": [1]})
+    st = df.select(F.to_struct(col("a")).alias("s"))
+    with pytest.raises(Exception, match="aliased"):
+        st.select(F.unnest(col("s")).alias("x")).collect()
+    with pytest.raises(Exception, match="top-level"):
+        st.where(F.unnest(col("s")) == 1).collect()
+
+
+def test_image_hash_la_mode():
+    img = np.zeros((1, 16, 16, 2), np.uint8)
+    img[0, :8, :, 0] = 250
+    s = daft_tpu.Series.from_numpy(img.reshape(1, -1), "img",
+                                   DataType.image("LA", 16, 16))
+    out = daft_tpu.from_pydict({"img": s}).select(
+        F.image_hash(col("img")).alias("h")).to_pydict()
+    assert len(out["h"][0]) == 8
