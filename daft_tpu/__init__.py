@@ -76,6 +76,23 @@ def __getattr__(name: str):
         from daft_tpu.io.source import read_source
 
         return read_source
+    if name in ("read_mcap", "read_kafka", "read_paimon", "read_video_frames",
+                "from_files"):
+        from daft_tpu.io import media_sources
+
+        return getattr(media_sources, name)
+    if name in ("DataSource", "DataSourceTask"):
+        from daft_tpu.io import source as _source_mod
+
+        return getattr(_source_mod, name)
+    if name == "DataSink":
+        from daft_tpu.io.sink import DataSink
+
+        return DataSink
+    if name == "File":
+        from daft_tpu.io.file import File
+
+        return File
     if name == "Session":
         from daft_tpu.session import Session
 
@@ -88,7 +105,10 @@ def __getattr__(name: str):
         from daft_tpu.catalog import Catalog
 
         return Catalog
-    if name in ("IOConfig", "S3Config", "GCSConfig", "AzureConfig", "HTTPConfig"):
+    if name in ("IOConfig", "S3Config", "S3Credentials", "GCSConfig",
+                "AzureConfig", "HTTPConfig", "CosConfig", "TosConfig",
+                "GooseFSConfig", "GravitinoConfig", "UnityConfig",
+                "HuggingFaceConfig"):
         from daft_tpu.io import config as io_config_mod
 
         return getattr(io_config_mod, name)

@@ -215,8 +215,9 @@ class Executor:
 
     def _run_Explode(self, node: pp.Explode) -> Iterator[MicroPartition]:
         names = [e.name() for e in node.to_explode]
+        ignore = getattr(node, "ignore_empty_and_null", False)
         for mp in self._run(node.children[0]):
-            yield mp.explode(names)
+            yield mp.explode(names, ignore_empty_and_null=ignore)
 
     def _run_Unpivot(self, node: pp.Unpivot) -> Iterator[MicroPartition]:
         id_names = [e.name() for e in node.ids]

@@ -13,7 +13,7 @@ from __future__ import annotations
 
 from typing import Any, Callable, Dict, Iterator, Optional, Sequence, Tuple
 
-from daft_tpu.datatype import DataType, TypeId, unify_dtypes
+from daft_tpu.datatype import DataType, TimeUnit, TypeId, unify_dtypes
 from daft_tpu.errors import DaftSchemaError, DaftTypeError, DaftValueError
 from daft_tpu.schema import Field, Schema
 
@@ -213,6 +213,33 @@ class BinaryOp(Expr):
             return Field(name, DataType.bool())
         if op == "add" and (lf.dtype.is_string() or rf.dtype.is_string()):
             return Field(name, DataType.string())
+        if op in ("add", "sub"):
+            # Temporal arithmetic. Result units match the Arrow C++ kernels
+            # the Series layer dispatches to:
+            #   ts[u1] ± dur[u2]  -> ts[finer(u1,u2)]
+            #   date ± dur[u]     -> ts[u]
+            #   ts[u1] - ts[u2]   -> dur[finer(u1,u2)];  date - date -> dur[s]
+            _ORDER = {TimeUnit.S: 0, TimeUnit.MS: 1, TimeUnit.US: 2, TimeUnit.NS: 3}
+
+            def _finer(a, b):
+                return a if _ORDER[a] >= _ORDER[b] else b
+
+            lt, rt = lf.dtype, rf.dtype
+            if rt.id == TypeId.DURATION and lt.id == TypeId.TIMESTAMP:
+                return Field(name, DataType.timestamp(
+                    _finer(lt._params[0], rt._params[0]), lt._params[1]))
+            if rt.id == TypeId.DURATION and lt.id == TypeId.DATE:
+                return Field(name, DataType.timestamp(rt._params[0]))
+            if op == "add" and lt.id == TypeId.DURATION and rt.id == TypeId.TIMESTAMP:
+                return Field(name, DataType.timestamp(
+                    _finer(lt._params[0], rt._params[0]), rt._params[1]))
+            if op == "add" and lt.id == TypeId.DURATION and rt.id == TypeId.DATE:
+                return Field(name, DataType.timestamp(lt._params[0]))
+            if op == "sub" and lt.id == rt.id == TypeId.TIMESTAMP:
+                return Field(name, DataType.duration(
+                    _finer(lt._params[0], rt._params[0])))
+            if op == "sub" and lt.id == rt.id == TypeId.DATE:
+                return Field(name, DataType.duration(TimeUnit.S))
         out = _literal_aware_unify(self.left, self.right, lf.dtype, rf.dtype)
         if op == "truediv":
             out = DataType.float32() if out.id in (TypeId.FLOAT32, TypeId.BFLOAT16) else DataType.float64()

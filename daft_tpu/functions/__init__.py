@@ -1394,9 +1394,11 @@ def map_values(expr):
 
 def explode(list_expr, ignore_empty_and_null: bool = False):
     """Marker usable in select() to explode a list column: the projection
-    binds the inner expression and appends an Explode node (reference:
+    binds the inner expression and appends an Explode node; with
+    ignore_empty_and_null, empty/null lists produce no row (reference:
     list.py explode)."""
-    return ensure_expr_wrap(list_expr)._fn("explode")
+    return ensure_expr_wrap(list_expr)._fn(
+        "explode", ignore_empty_and_null=ignore_empty_and_null)
 
 
 # -- datetime long tail ----------------------------------------------------

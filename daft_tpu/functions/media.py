@@ -249,10 +249,12 @@ def _decode_frames(f: File, start_time: float, end_time, width, height,
     import numpy as np
 
     data = f.read()
-    keyset = None
-    if is_key_frame is not None:
-        keys = _keyframe_indices(data)
-        keyset = set(keys) if keys is not None else None
+    # Always parse container keyframe indices (cheap) so the per-frame
+    # is_key_frame metadata is truthful even when no filtering was asked.
+    # When the container has no sync-sample table, every sample is a sync
+    # sample per the MP4 spec.
+    keys = _keyframe_indices(data)
+    keyset = set(keys) if keys is not None else None
     # cv2 VideoCapture needs a real path.
     with tempfile.NamedTemporaryFile(suffix=".bin", delete=False) as tmp:
         tmp.write(data)

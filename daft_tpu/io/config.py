@@ -48,11 +48,85 @@ class HTTPConfig:
 
 
 @dataclass(frozen=True)
+class S3Credentials:
+    """Static S3 credential bundle (reference: common/io-config
+    S3Credentials)."""
+
+    key_id: str = ""
+    access_key: str = ""
+    session_token: Optional[str] = None
+    expiry: Optional[object] = None
+
+
+@dataclass(frozen=True)
+class CosConfig:
+    """Tencent COS (S3-compatible; reference: common/io-config CosConfig)."""
+
+    region_name: Optional[str] = None
+    endpoint_url: Optional[str] = None
+    key_id: Optional[str] = None
+    access_key: Optional[str] = None
+    anonymous: bool = False
+
+
+@dataclass(frozen=True)
+class TosConfig:
+    """ByteDance TOS (S3-compatible; reference: common/io-config TosConfig)."""
+
+    region_name: Optional[str] = None
+    endpoint_url: Optional[str] = None
+    key_id: Optional[str] = None
+    access_key: Optional[str] = None
+    anonymous: bool = False
+
+
+@dataclass(frozen=True)
+class GooseFSConfig:
+    """GooseFS (S3-compatible cache layer; reference: GooseFSConfig)."""
+
+    endpoint_url: Optional[str] = None
+    key_id: Optional[str] = None
+    access_key: Optional[str] = None
+
+
+@dataclass(frozen=True)
+class GravitinoConfig:
+    """Apache Gravitino catalog service (reference: GravitinoConfig)."""
+
+    uri: Optional[str] = None
+    metalake: Optional[str] = None
+    auth_token: Optional[str] = None
+
+
+@dataclass(frozen=True)
+class UnityConfig:
+    """Databricks Unity Catalog (reference: UnityConfig)."""
+
+    endpoint: Optional[str] = None
+    token: Optional[str] = None
+
+
+@dataclass(frozen=True)
+class HuggingFaceConfig:
+    """HuggingFace Hub datasets access (reference: HuggingFaceConfig)."""
+
+    token: Optional[str] = None
+    anonymous: bool = False
+    use_content_defined_chunking: bool = False
+
+
+@dataclass(frozen=True)
 class IOConfig:
     s3: S3Config = field(default_factory=S3Config)
     gcs: GCSConfig = field(default_factory=GCSConfig)
     azure: AzureConfig = field(default_factory=AzureConfig)
     http: HTTPConfig = field(default_factory=HTTPConfig)
+    cos: CosConfig = field(default_factory=CosConfig)
+    tos: TosConfig = field(default_factory=TosConfig)
+    goosefs: GooseFSConfig = field(default_factory=GooseFSConfig)
+    gravitino: GravitinoConfig = field(default_factory=GravitinoConfig)
+    unity: UnityConfig = field(default_factory=UnityConfig)
+    hf: HuggingFaceConfig = field(default_factory=HuggingFaceConfig)
 
 
 def filesystem_for(scheme: str, io_config: Optional[IOConfig]):

@@ -47,6 +47,7 @@ class LogicalPlanBuilder:
             return isinstance(e, FunctionCall) and e.fn_name == name
 
         explode_names = []
+        explode_ignore = False
         if any(_is_marker(e, "unnest") or _is_marker(e, "explode") or
                (isinstance(e, Alias) and
                 (_is_marker(e.child, "explode") or _is_marker(e.child, "unnest")))
@@ -72,9 +73,11 @@ class LogicalPlanBuilder:
                 elif _is_marker(e, "explode"):
                     expanded.append(e.args[0])
                     explode_names.append(e.args[0].name())
+                    explode_ignore |= bool(e.kwargs.get("ignore_empty_and_null"))
                 elif isinstance(e, Alias) and _is_marker(e.child, "explode"):
                     expanded.append(Alias(e.child.args[0], e.name()))
                     explode_names.append(e.name())
+                    explode_ignore |= bool(e.child.kwargs.get("ignore_empty_and_null"))
                 else:
                     expanded.append(e)
             exprs = expanded
@@ -114,7 +117,8 @@ class LogicalPlanBuilder:
         else:
             out = LogicalPlanBuilder(lp.Project(self._plan, exprs))
         if explode_names:
-            out = out.explode([ColumnRef(n) for n in explode_names])
+            out = out.explode([ColumnRef(n) for n in explode_names],
+                              ignore_empty_and_null=explode_ignore)
         return out
 
     def select(self, exprs: Sequence[Expr]) -> "LogicalPlanBuilder":
@@ -139,8 +143,10 @@ class LogicalPlanBuilder:
     def sample(self, fraction=None, size=None, with_replacement=False, seed=None) -> "LogicalPlanBuilder":
         return LogicalPlanBuilder(lp.Sample(self._plan, fraction, size, with_replacement, seed))
 
-    def explode(self, exprs: Sequence[Expr]) -> "LogicalPlanBuilder":
-        return LogicalPlanBuilder(lp.Explode(self._plan, exprs))
+    def explode(self, exprs: Sequence[Expr],
+                ignore_empty_and_null: bool = False) -> "LogicalPlanBuilder":
+        return LogicalPlanBuilder(
+            lp.Explode(self._plan, exprs, ignore_empty_and_null))
 
     def unpivot(self, ids, values, variable_name="variable", value_name="value") -> "LogicalPlanBuilder":
         return LogicalPlanBuilder(lp.Unpivot(self._plan, ids, values, variable_name, value_name))

@@ -237,8 +237,10 @@ class Sample(LogicalPlan):
 
 
 class Explode(LogicalPlan):
-    def __init__(self, input: LogicalPlan, to_explode: Sequence[Expr]):
+    def __init__(self, input: LogicalPlan, to_explode: Sequence[Expr],
+                 ignore_empty_and_null: bool = False):
         self.to_explode = list(to_explode)
+        self.ignore_empty_and_null = ignore_empty_and_null
         fields = []
         explode_names = {e.name() for e in self.to_explode}
         for f in input.schema:
@@ -251,7 +253,7 @@ class Explode(LogicalPlan):
         super().__init__([input], Schema(fields))
 
     def with_children(self, children):
-        return Explode(children[0], self.to_explode)
+        return Explode(children[0], self.to_explode, self.ignore_empty_and_null)
 
     def multiline_display(self):
         return [f"Explode: {[e.name() for e in self.to_explode]}"]

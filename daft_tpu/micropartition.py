@@ -146,8 +146,8 @@ class MicroPartition:
         rb = self.combined().distinct(on)
         return MicroPartition(rb.schema, [rb])
 
-    def explode(self, columns) -> "MicroPartition":
-        out = [b.explode(columns) for b in self._batches]
+    def explode(self, columns, ignore_empty_and_null: bool = False) -> "MicroPartition":
+        out = [b.explode(columns, ignore_empty_and_null) for b in self._batches]
         schema = out[0].schema if out else self._schema
         return MicroPartition(schema, out)
 

@@ -85,9 +85,11 @@ class Filter(PhysicalPlan):
 
 
 class Explode(PhysicalPlan):
-    def __init__(self, child: PhysicalPlan, to_explode, schema: Schema):
+    def __init__(self, child: PhysicalPlan, to_explode, schema: Schema,
+                 ignore_empty_and_null: bool = False):
         super().__init__([child], schema)
         self.to_explode = to_explode
+        self.ignore_empty_and_null = ignore_empty_and_null
 
 
 class Unpivot(PhysicalPlan):

@@ -41,7 +41,8 @@ def _translate_one(node: lp.LogicalPlan, cfg, _memo) -> pp.PhysicalPlan:
     if isinstance(node, lp.Filter):
         return pp.Filter(t(node.children()[0]), node.predicate)
     if isinstance(node, lp.Explode):
-        return pp.Explode(t(node.children()[0]), node.to_explode, node.schema)
+        return pp.Explode(t(node.children()[0]), node.to_explode, node.schema,
+                          getattr(node, "ignore_empty_and_null", False))
     if isinstance(node, lp.Unpivot):
         return pp.Unpivot(t(node.children()[0]), node.ids, node.values,
                           node.variable_name, node.value_name, node.schema)

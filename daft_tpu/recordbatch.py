@@ -414,8 +414,12 @@ class RecordBatch:
     # ------------------------------------------------------------------ #
     # Reshaping                                                           #
     # ------------------------------------------------------------------ #
-    def explode(self, columns: Sequence[str]) -> "RecordBatch":
+    def explode(self, columns: Sequence[str],
+                ignore_empty_and_null: bool = False) -> "RecordBatch":
         """Explode list columns (all listed columns must align per-row).
+        Empty/null lists yield one null row, or no row at all with
+        ``ignore_empty_and_null`` (reference: daft-functions-list explode's
+        ignore_empty_and_null flag).
 
         Reference: src/daft-recordbatch explode + daft-functions-list.
         """
@@ -440,8 +444,9 @@ class RecordBatch:
                     f"explode columns {columns[0]!r} and {name!r} have mismatched "
                     "list lengths"
                 )
-        # Empty lists and nulls produce one null row (matches reference semantics).
-        out_counts = np.maximum(lengths_np, 1)
+        # Empty lists and nulls produce one null row (matches reference
+        # default semantics) unless the caller asked to drop them.
+        out_counts = lengths_np if ignore_empty_and_null else np.maximum(lengths_np, 1)
         parent_idx = np.repeat(np.arange(self._num_rows, dtype=np.int64), out_counts)
         new_cols = []
         exploded_len = int(out_counts.sum())
@@ -449,7 +454,8 @@ class RecordBatch:
             if c.name in columns:
                 if not c.dtype.is_list():
                     raise DaftTypeError(f"Cannot explode non-list column {c.name!r}")
-                new_cols.append(_explode_series(c, out_counts, exploded_len))
+                new_cols.append(_explode_series(c, out_counts, exploded_len,
+                                                ignore_empty_and_null))
             else:
                 new_cols.append(c.take(parent_idx.astype(np.uint64)))
         schema = Schema([Field(c.name, c.dtype) for c in new_cols])
@@ -583,11 +589,15 @@ def _render_cell(v: Any) -> str:
     return s if len(s) <= 30 else s[:27] + "..."
 
 
-def _explode_series(c: Series, out_counts: np.ndarray, exploded_len: int) -> Series:
+def _explode_series(c: Series, out_counts: np.ndarray, exploded_len: int,
+                    ignore_empty_and_null: bool = False) -> Series:
     arr = c.to_arrow()
     lengths = np.asarray(pc.fill_null(pc.list_value_length(arr), 0)).astype(np.int64)
     inner_dtype = c.dtype.inner
     flat = arr.flatten()  # non-null list values concatenated
+    if ignore_empty_and_null:
+        # Empty/null rows emit nothing, so the output IS the flattened values.
+        return Series.from_arrow(flat, c.name, inner_dtype)
     # Build the output by interleaving flat values with nulls for empty/null rows.
     out_idx = np.zeros(exploded_len, dtype=np.int64)
     validity = np.ones(exploded_len, dtype=bool)

@@ -379,6 +379,18 @@ class Series:
                 pa.scalar("", pa.large_string()),
             )
             return Series(lhs.name, DataType.string(), _combine(out))
+        mixed_temporal = (
+            op in ("add", "sub") and lhs.dtype.is_temporal()
+            and rhs.dtype.is_temporal()
+            and (lhs.dtype.id != rhs.dtype.id
+                 or (op == "sub"
+                     and lhs.dtype.id in (TypeId.TIMESTAMP, TypeId.DATE))))
+        if mixed_temporal:
+            # Mixed temporal arithmetic (ts/date ± duration, ts-ts, date-date)
+            # dispatches straight to Arrow — no unify/cast step applies.
+            kern = pc.add_checked if op == "add" else pc.subtract_checked
+            out = kern(lhs._data, rhs._data)
+            return Series(lhs.name, DataType.from_arrow(out.type), _combine(out))
         out_dtype = unify_dtypes(lhs.dtype, rhs.dtype)
         if not out_dtype.is_numeric() and not (
             out_dtype.is_temporal() and op in ("add", "sub")

@@ -49,7 +49,8 @@ KEYWORDS = {
     "is", "null", "true", "false", "case", "when", "then", "else", "end",
     "cast", "distinct", "join", "inner", "left", "right", "full", "outer",
     "cross", "on", "union", "all", "with", "asc", "desc", "nulls", "first",
-    "last", "semi", "anti", "using", "interval", "exists",
+    "last", "semi", "anti", "using", "interval", "exists", "intersect",
+    "except", "for",
 }
 
 
@@ -129,6 +130,17 @@ class TableRef:
 class SubqueryRef:
     query: "SelectStmt"
     alias: Optional[str] = None
+    column_aliases: Optional[List[str]] = None
+
+
+@dataclass
+class ValuesRef:
+    """VALUES (...), (...) — an inline rowset (reference: sqlparser-rs
+    Values; daft-sql plans it as an in-memory table)."""
+
+    rows: List[List[Expr]]
+    alias: Optional[str] = None
+    column_aliases: Optional[List[str]] = None
 
 
 @dataclass
@@ -158,7 +170,9 @@ class SelectStmt:
     limit: Optional[int] = None
     offset: Optional[int] = None
     distinct: bool = False
-    union: Optional[Tuple[str, "SelectStmt"]] = None  # ("all"|"distinct", stmt)
+    # Left-to-right set-operation chain: [("all"|"distinct"|"intersect"|
+    # "intersect_all"|"except"|"except_all", stmt), ...]
+    set_ops: List[Tuple[str, "SelectStmt"]] = field(default_factory=list)
     ctes: Dict[str, "SelectStmt"] = field(default_factory=dict)
 
 
@@ -261,10 +275,34 @@ class Parser:
         self.expect("eof")
         return stmt
 
+    def _at_values(self) -> bool:
+        t = self.peek()
+        return t.kind == "ident" and t.value.lower() == "values"
+
+    def _parse_values(self) -> ValuesRef:
+        self.next()  # 'values'
+        rows = []
+        while True:
+            self.expect("op", "(")
+            row = [self.parse_expr()]
+            while self.accept("op", ","):
+                row.append(self.parse_expr())
+            self.expect("op", ")")
+            rows.append(row)
+            if not self.accept("op", ","):
+                break
+        return ValuesRef(rows)
+
     def parse_select(self, in_union: bool = False) -> SelectStmt:
+        if self._at_values():
+            # Top-level VALUES: select * from the inline rowset.
+            return SelectStmt(projections=[(None, None)],
+                              source=self._parse_values())
         self.expect("kw", "select")
         stmt = SelectStmt(projections=[])
         stmt.distinct = bool(self.accept_kw("distinct"))
+        if not stmt.distinct:
+            self.accept_kw("all")  # SELECT ALL is the default
         while True:
             if self.accept("op", "*"):
                 stmt.projections.append((None, None))
@@ -308,11 +346,21 @@ class Parser:
                 stmt.group_by.append(self.parse_expr())
         if self.accept_kw("having"):
             stmt.having = self.parse_expr()
-        if self.accept_kw("union"):
-            mode = "all" if self.accept_kw("all") else "distinct"
-            # The right arm must NOT consume a trailing ORDER BY/LIMIT — in a
-            # union chain those apply to the whole union result.
-            stmt.union = (mode, self.parse_select(in_union=True))
+        # Set operations: collected as a flat left-to-right chain so the
+        # planner can apply SQL's left-associativity (with INTERSECT binding
+        # tighter than UNION/EXCEPT). The right arms must NOT consume
+        # trailing ORDER BY/LIMIT — those apply to the whole result.
+        if not in_union:
+            while True:
+                if self.accept_kw("union"):
+                    mode = "all" if self.accept_kw("all") else "distinct"
+                elif self.accept_kw("intersect"):
+                    mode = "intersect_all" if self.accept_kw("all") else "intersect"
+                elif self.accept_kw("except"):
+                    mode = "except_all" if self.accept_kw("all") else "except"
+                else:
+                    break
+                stmt.set_ops.append((mode, self.parse_select(in_union=True)))
         if in_union:
             return stmt
         if self.accept_kw("order"):
@@ -362,15 +410,31 @@ class Parser:
                 return how
         return None
 
-    def parse_table_factor(self) -> Union[TableRef, SubqueryRef]:
+    def _table_alias(self):
+        """[AS] alias [(col, ...)] after a derived table."""
+        alias = None
+        cols = None
+        self.accept_kw("as")
+        if self.peek().kind == "ident":
+            alias = self.next().value
+            if self.accept("op", "("):
+                cols = [self._ident_like()]
+                while self.accept("op", ","):
+                    cols.append(self._ident_like())
+                self.expect("op", ")")
+        return alias, cols
+
+    def parse_table_factor(self) -> Union[TableRef, SubqueryRef, ValuesRef]:
         if self.accept("op", "("):
+            if self._at_values():
+                v = self._parse_values()
+                self.expect("op", ")")
+                v.alias, v.column_aliases = self._table_alias()
+                return v
             sub = self.parse_select()
             self.expect("op", ")")
-            alias = None
-            self.accept_kw("as")
-            if self.peek().kind == "ident":
-                alias = self.next().value
-            return SubqueryRef(sub, alias)
+            alias, cols = self._table_alias()
+            return SubqueryRef(sub, alias, cols)
         name = self._ident_like()
         while self.accept("op", "."):
             name += "." + self._ident_like()
@@ -530,6 +594,14 @@ class Parser:
                 return Cast(inner, dtype)
             if self.accept_kw("interval"):
                 raw = self.expect("str").value[1:-1]
+                # INTERVAL '1' DAY — a standalone unit word after the quoted
+                # count. Only known unit words are consumed, so an implicit
+                # alias (INTERVAL '1 day' d) still parses.
+                t2 = self.peek()
+                if t2.kind == "ident" and t2.value.lower().rstrip("s") in (
+                        "year", "month", "week", "day", "hour", "minute",
+                        "second", "millisecond", "microsecond"):
+                    raw = f"{raw} {self.next().value}"
                 return Literal(_parse_interval(raw))
             if self.accept_kw("not"):
                 return UnaryOp("not", self._parse_not())
@@ -548,11 +620,30 @@ class Parser:
             return inner
         if t.kind == "ident":
             self.next()
-            if t.value.lower() == "date" and self.peek().kind == "str":
+            low = t.value.lower()
+            if low == "date" and self.peek().kind == "str":
                 raw = self.next().value[1:-1]
                 import datetime as _dt
 
                 return Literal(_dt.date.fromisoformat(raw))
+            if low == "timestamp" and self.peek().kind == "str":
+                raw = self.next().value[1:-1]
+                import datetime as _dt
+
+                return Literal(_dt.datetime.fromisoformat(raw))
+            if low == "array" and self.peek().kind == "op" and self.peek().value == "[":
+                self.next()
+                items = [self.parse_expr()]
+                while self.accept("op", ","):
+                    items.append(self.parse_expr())
+                self.expect("op", "]")
+                return FunctionCall("list_pack", items)
+            if low in ("current_date", "current_timestamp") and not (
+                    self.peek().kind == "op" and self.peek().value == "("):
+                import datetime as _dt
+
+                return Literal(_dt.date.today() if low == "current_date"
+                               else _dt.datetime.now())
             if self.peek().kind == "op" and self.peek().value == "(":
                 return self._maybe_over(self._parse_function(t.value))
             # qualified column a.b -> struct access is handled postfix; here a
@@ -576,12 +667,94 @@ class Parser:
             out = IfElse(cond, val, out)
         return out
 
+    def _peek_from_form(self) -> bool:
+        """True when the call uses SUBSTRING(x FROM n [FOR m]) syntax: scan
+        ahead for a FROM before the matching close-paren at depth 0."""
+        depth = 0
+        j = 0
+        while True:
+            t = self.peek(j)
+            if t.kind == "eof":
+                return False
+            if t.kind == "op" and t.value == "(":
+                depth += 1
+            elif t.kind == "op" and t.value == ")":
+                if depth == 0:
+                    return False
+                depth -= 1
+            elif t.kind == "op" and t.value == "," and depth == 0:
+                return False
+            elif t.kind == "kw" and t.value == "from" and depth == 0:
+                return True
+            j += 1
+
+    _EXTRACT_UNITS = {
+        "year": "dt_year", "month": "dt_month", "day": "dt_day",
+        "hour": "dt_hour", "minute": "dt_minute", "second": "dt_second",
+        "dow": "dt_day_of_week", "doy": "dt_day_of_year",
+        "week": "dt_week_of_year", "quarter": "dt_quarter",
+    }
+
     def _parse_function(self, name: str) -> Expr:
         name_l = name.lower()
         self.expect("op", "(")
         if name_l == "count" and self.accept("op", "*"):
             self.expect("op", ")")
             return AggOp("count", Literal(1), {"mode": "all"})
+        # SQL-standard special argument syntaxes (reference: daft-sql planner
+        # handles these through sqlparser-rs's dedicated AST nodes).
+        if name_l == "extract":
+            unit = self._ident_like().lower()
+            self.expect("kw", "from")
+            inner = self.parse_expr()
+            self.expect("op", ")")
+            fn = self._EXTRACT_UNITS.get(unit)
+            if fn is None:
+                raise SQLParseError(f"EXTRACT: unknown unit {unit!r}")
+            return FunctionCall(fn, [inner])
+        if name_l in ("substring", "substr") and self._peek_from_form():
+            inner = self._parse_additive()
+            self.expect("kw", "from")
+            start = self._parse_additive()
+            length: Optional[Expr] = None
+            if self.accept_kw("for"):
+                length = self._parse_additive()
+            self.expect("op", ")")
+            # SQL FROM is 1-based; str_slice is 0-based.
+            args = [inner, BinaryOp("sub", start, Literal(1))]
+            if length is not None:
+                args.append(length)
+            return FunctionCall("str_substr", args)
+        if name_l == "position":
+            needle = self._parse_additive()
+            self.expect("kw", "in")
+            hay = self.parse_expr()
+            self.expect("op", ")")
+            # 1-based; 0 when absent (str_find is 0-based, -1 when absent).
+            return BinaryOp("add", FunctionCall("str_find", [hay, needle]),
+                            Literal(1))
+        if name_l == "try_cast":
+            inner = self.parse_expr()
+            self.expect("kw", "as")
+            dtype = self._parse_type()
+            self.expect("op", ")")
+            return FunctionCall("try_cast", [inner], {"dtype": dtype})
+        if name_l == "nullif":
+            a = self.parse_expr()
+            self.expect("op", ",")
+            b = self.parse_expr()
+            self.expect("op", ")")
+            return IfElse(BinaryOp("eq", a, b), Literal(None), a)
+        if name_l in ("greatest", "least"):
+            args = [self.parse_expr()]
+            while self.accept("op", ","):
+                args.append(self.parse_expr())
+            self.expect("op", ")")
+            op = "gt" if name_l == "greatest" else "lt"
+            out = args[0]
+            for nxt in args[1:]:
+                out = IfElse(BinaryOp(op, out, nxt), out, nxt)
+            return out
         distinct = bool(self.accept_kw("distinct"))
         args: List[Expr] = []
         if not self.accept("op", ")"):

@@ -59,11 +59,48 @@ def plan_sql(query: str, bindings: Dict[str, object], session=None):
     return _plan_select(stmt, bindings, dict(stmt.ctes), session)
 
 
+def _rename_positional(df, cols):
+    """Apply derived-table column aliases: t(x, y) renames by position."""
+    names = [f.name for f in df.schema]
+    if len(cols) > len(names):
+        raise DaftValueError(
+            f"column alias list has {len(cols)} names but the table exposes "
+            f"{len(names)} columns")
+    return df.with_columns_renamed(dict(zip(names, cols)))
+
+
 def _resolve_source(src, bindings, ctes, session=None):
     from daft_tpu.dataframe.dataframe import DataFrame
+    from daft_tpu.sql.parser import ValuesRef
 
+    if isinstance(src, ValuesRef):
+        from daft_tpu.dataframe.creation import from_pydict
+        from daft_tpu.expressions.expr import Literal as _Lit
+
+        width = len(src.rows[0]) if src.rows else 0
+        for i, row in enumerate(src.rows):
+            if len(row) != width:
+                raise DaftValueError(
+                    f"VALUES row {i} has {len(row)} columns, expected {width}")
+        cols = {}
+        for j in range(width):
+            vals = []
+            for row in src.rows:
+                cell = row[j]
+                if not isinstance(cell, _Lit):
+                    raise DaftValueError(
+                        "VALUES rows must be literals in this engine")
+                vals.append(cell.value)
+            cols[f"col{j}"] = vals
+        df = from_pydict(cols)
+        if src.column_aliases:
+            df = _rename_positional(df, src.column_aliases)
+        return df
     if isinstance(src, SubqueryRef):
-        return _plan_select(src.query, bindings, ctes, session)
+        df = _plan_select(src.query, bindings, ctes, session)
+        if src.column_aliases:
+            df = _rename_positional(df, src.column_aliases)
+        return df
     assert isinstance(src, TableRef)
     name = src.name
     if name in ctes:
@@ -85,8 +122,12 @@ def _resolve_source(src, bindings, ctes, session=None):
 
 
 def _src_alias(src) -> str:
+    from daft_tpu.sql.parser import ValuesRef
+
     if isinstance(src, SubqueryRef):
         return src.alias or "__subquery"
+    if isinstance(src, ValuesRef):
+        return src.alias or "__values"
     return src.alias or src.name
 
 
@@ -253,12 +294,32 @@ def _plan_select(stmt: SelectStmt, bindings, ctes, session=None):
 
     if stmt.distinct:
         df = df.distinct()
-    if stmt.union is not None:
-        mode, other_stmt = stmt.union
-        other = _plan_select(other_stmt, bindings, ctes, session)
-        df = df.concat(other)
-        if mode == "distinct":
-            df = df.distinct()
+    if stmt.set_ops:
+        # SQL precedence: INTERSECT binds tighter than UNION/EXCEPT; within a
+        # precedence level, set ops associate left-to-right.
+        arms = [(None, df)] + [
+            (mode, _plan_select(other, bindings, ctes, session))
+            for mode, other in stmt.set_ops]
+        reduced = [arms[0]]
+        for mode, rhs in arms[1:]:
+            if mode == "intersect":
+                pmode, lhs = reduced[-1]
+                reduced[-1] = (pmode, lhs.intersect(rhs))
+            elif mode == "intersect_all":
+                pmode, lhs = reduced[-1]
+                reduced[-1] = (pmode, lhs.intersect_all(rhs))
+            else:
+                reduced.append((mode, rhs))
+        df = reduced[0][1]
+        for mode, rhs in reduced[1:]:
+            if mode == "all":
+                df = df.concat(rhs)
+            elif mode == "distinct":
+                df = df.concat(rhs).distinct()
+            elif mode == "except":
+                df = df.except_distinct(rhs)
+            else:
+                df = df.except_all(rhs)
     if stmt.order_by:
         df = df.sort(
             [Expression(o.expr) for o in stmt.order_by],
@@ -437,7 +498,7 @@ def _plan_subquery(holder: SubqueryExpr, outer_df, outer_scope, bindings, ctes, 
     from daft_tpu.expressions.expression import Expression
 
     stmt = holder.stmt
-    complex_shape = bool(stmt.group_by or stmt.having or stmt.union or
+    complex_shape = bool(stmt.group_by or stmt.having or stmt.set_ops or
                          stmt.order_by or stmt.limit is not None)
     if complex_shape:
         # Uncorrelated-only path: delegate to the full SELECT planner. Any

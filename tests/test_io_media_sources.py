@@ -1,0 +1,139 @@
+"""MCAP / video-frame / from_files sources (reference: daft/io/mcap,
+daft/io/av, daft/io/_files.py)."""
+
+from __future__ import annotations
+
+import struct
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+import daft_tpu
+from daft_tpu import col
+
+MAGIC = b"\x89MCAP0\r\n"
+
+
+def _rec(op: int, payload: bytes) -> bytes:
+    return bytes([op]) + struct.pack("<Q", len(payload)) + payload
+
+
+def _s(text: str) -> bytes:
+    b = text.encode()
+    return struct.pack("<I", len(b)) + b
+
+
+def _channel(cid: int, topic: str) -> bytes:
+    return _rec(0x04, struct.pack("<H", cid) + struct.pack("<H", 1) +
+                _s(topic) + _s("json") + struct.pack("<I", 0))
+
+
+def _message(cid: int, seq: int, log_t: int, pub_t: int, data: bytes) -> bytes:
+    return _rec(0x05, struct.pack("<HIQQ", cid, seq, log_t, pub_t) + data)
+
+
+def _write_mcap(path, chunk_compression=None):
+    """Minimal spec-conformant MCAP: header, channels, messages (optionally
+    inside a compressed chunk), data-end, footer."""
+    header = _rec(0x01, _s("") + _s("daft-test"))
+    body = (_channel(1, "/camera") + _channel(2, "/lidar") +
+            _message(1, 0, 100, 90, b"img-a") +
+            _message(2, 0, 150, 140, b"pc-a") +
+            _message(1, 1, 200, 190, b"img-b"))
+    if chunk_compression is not None:
+        comp_name = chunk_compression or ""
+        raw = body
+        blob = raw if not comp_name else pa.Codec(comp_name).compress(
+            raw, asbytes=True)
+        chunk = _rec(0x06, struct.pack("<QQQ", 100, 200, len(raw)) +
+                     struct.pack("<I", 0) + _s(comp_name) +
+                     struct.pack("<Q", len(blob)) + blob)
+        body = chunk
+    data_end = _rec(0x0F, struct.pack("<I", 0))
+    footer = _rec(0x02, struct.pack("<QQI", 0, 0, 0))
+    path.write_bytes(MAGIC + header + body + data_end + footer + MAGIC)
+
+
+@pytest.mark.parametrize("compression", [None, "", "zstd", "lz4"])
+def test_read_mcap(tmp_path, compression):
+    p = tmp_path / "log.mcap"
+    _write_mcap(p, chunk_compression=compression)
+    df = daft_tpu.read_mcap(str(p)).sort("log_time")
+    out = df.to_pydict()
+    assert out["topic"] == ["/camera", "/lidar", "/camera"]
+    assert out["log_time"] == [100, 150, 200]
+    assert out["publish_time"] == [90, 140, 190]
+    assert out["sequence"] == [0, 0, 1]
+    assert out["data"] == ["img-a", "pc-a", "img-b"]
+
+
+def test_read_mcap_filters(tmp_path):
+    p = tmp_path / "log.mcap"
+    _write_mcap(p)
+    only_cam = daft_tpu.read_mcap(str(p), topics=["/camera"]).to_pydict()
+    assert only_cam["topic"] == ["/camera", "/camera"]
+    windowed = daft_tpu.read_mcap(str(p), start_time=120, end_time=180).to_pydict()
+    assert windowed["topic"] == ["/lidar"]
+    # engine pushdowns compose on top
+    agg = daft_tpu.read_mcap(str(p)).groupby("topic").agg(
+        col("sequence").count().alias("n")).sort("topic").to_pydict()
+    assert agg == {"topic": ["/camera", "/lidar"], "n": [2, 1]}
+
+
+def test_read_mcap_bad_magic(tmp_path):
+    p = tmp_path / "bad.mcap"
+    p.write_bytes(b"not an mcap file")
+    with pytest.raises(Exception, match="magic"):
+        daft_tpu.read_mcap(str(p)).collect()
+
+
+def test_read_video_frames(tmp_path):
+    cv2 = pytest.importorskip("cv2")
+    p = tmp_path / "v.mp4"
+    vw = cv2.VideoWriter(str(p), cv2.VideoWriter_fourcc(*"mp4v"), 10, (64, 48))
+    for i in range(8):
+        vw.write(np.full((48, 64, 3), i * 30 % 255, np.uint8))
+    vw.release()
+    df = daft_tpu.read_video_frames(str(p), image_height=24, image_width=32)
+    out = df.to_pydict()
+    assert len(out["frame_index"]) == 8
+    assert out["path"][0] == str(p)
+    assert out["frame_index"] == list(range(8))
+    sch = df.schema["data"].dtype
+    assert sch.id.value == "fixed_shape_image"
+    # downstream engine ops work over the frames
+    n = df.where(col("frame_index") % 2 == 0).count_rows()
+    assert n == 4
+
+
+def test_from_files(tmp_path):
+    for i in range(3):
+        (tmp_path / f"f{i}.txt").write_text(f"data{i}")
+    df = daft_tpu.from_files(str(tmp_path / "*.txt"))
+    out = df.to_pydict()
+    assert len(out["file"]) == 3
+    assert df.schema["file"].dtype.id.value == "file"
+    assert sorted(f.read() for f in out["file"]) == [b"data0", b"data1", b"data2"]
+    # empty glob -> empty frame, not an error (reference behavior)
+    assert daft_tpu.from_files(str(tmp_path / "*.nope")).count_rows() == 0
+
+
+def test_gated_sources_raise_clearly():
+    with pytest.raises(Exception, match="confluent-kafka"):
+        daft_tpu.read_kafka(["t"], bootstrap_servers="localhost:9092")
+    with pytest.raises(Exception, match="pypaimon"):
+        daft_tpu.read_paimon(object())
+
+
+def test_io_config_surface():
+    cfg = daft_tpu.IOConfig(
+        s3=daft_tpu.S3Config(region_name="us-east-1"),
+        unity=daft_tpu.UnityConfig(endpoint="http://dbx"),
+        hf=daft_tpu.HuggingFaceConfig(anonymous=True),
+    )
+    assert cfg.s3.region_name == "us-east-1"
+    assert cfg.unity.endpoint == "http://dbx"
+    assert daft_tpu.S3Credentials(key_id="k").key_id == "k"
+    for name in ("CosConfig", "TosConfig", "GooseFSConfig", "GravitinoConfig"):
+        assert getattr(daft_tpu, name)() is not None

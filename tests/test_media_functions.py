@@ -326,3 +326,34 @@ def test_image_hash_la_mode():
     out = daft_tpu.from_pydict({"img": s}).select(
         F.image_hash(col("img")).alias("h")).to_pydict()
     assert len(out["h"][0]) == 8
+
+
+def test_explode_ignore_empty_and_null():
+    df = daft_tpu.from_pydict({"g": [1, 2, 3], "l": [[1, 2], [], None]})
+    out = df.select(col("g"), F.explode(col("l"), ignore_empty_and_null=True)).to_pydict()
+    assert out == {"g": [1, 1], "l": [1, 2]}
+
+
+def test_make_timestamp_microsecond_precision():
+    df = daft_tpu.from_pydict({"s": [2.646319]})
+    t = df.select(F.make_timestamp(
+        daft_tpu.lit(2005), daft_tpu.lit(4), daft_tpu.lit(17),
+        daft_tpu.lit(8), daft_tpu.lit(29), col("s")).alias("t")).to_pydict()["t"][0]
+    assert t.microsecond == 646319
+
+
+def test_temporal_arithmetic_units_match_runtime():
+    import datetime as dt
+
+    df = daft_tpu.from_pydict({"d": [dt.date(2024, 1, 2)],
+                               "t": [dt.datetime(2024, 1, 2, 3)]})
+    out = df.select((col("d") - col("d")).alias("dd"),
+                    (col("t") - col("t")).alias("tt"),
+                    (col("d") + daft_tpu.lit(dt.timedelta(days=1))).alias("dp"))
+    # planned dtype must match what Arrow actually returns
+    for name in ("dd", "tt", "dp"):
+        planned = out.schema[name].dtype
+        mat = out.to_pydict()
+        assert mat[name][0] is not None
+    assert repr(out.schema["dd"].dtype) == "Duration[s]"
+    assert repr(out.schema["dp"].dtype).startswith("Timestamp")

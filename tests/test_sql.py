@@ -330,3 +330,80 @@ def test_sql_lag_negative_offset_is_lead():
     out = daft_tpu.sql("SELECT v, lag(v, -1) OVER (ORDER BY v) AS nxt "
                        "FROM t ORDER BY v", t=df).to_pydict()
     assert out["nxt"] == [2, 3, None]
+
+
+# -- SQL-standard special syntax (reference: daft-sql via sqlparser-rs) -----
+def test_sql_extract_substring_position():
+    assert daft_tpu.sql("SELECT EXTRACT(YEAR FROM DATE '2024-01-02') AS y").to_pydict() == {"y": [2024]}
+    assert daft_tpu.sql("SELECT EXTRACT(QUARTER FROM DATE '2024-05-02') AS q").to_pydict() == {"q": [2]}
+    assert daft_tpu.sql("SELECT SUBSTRING('hello' FROM 2 FOR 3) AS s").to_pydict() == {"s": ["ell"]}
+    assert daft_tpu.sql("SELECT SUBSTRING('hello' FROM 2) AS s").to_pydict() == {"s": ["ello"]}
+    assert daft_tpu.sql("SELECT POSITION('l' IN 'hello') AS p").to_pydict() == {"p": [3]}
+    assert daft_tpu.sql("SELECT POSITION('z' IN 'hello') AS p").to_pydict() == {"p": [0]}
+
+
+def test_sql_nullif_greatest_least_try_cast():
+    assert daft_tpu.sql("SELECT NULLIF(1, 1) AS a, NULLIF(2, 1) AS b").to_pydict() == {"a": [None], "b": [2]}
+    assert daft_tpu.sql("SELECT GREATEST(1,5,3) AS g, LEAST(4,2,9) AS l").to_pydict() == {"g": [5], "l": [2]}
+    assert daft_tpu.sql("SELECT TRY_CAST('abc' AS INT) AS x, TRY_CAST('7' AS INT) AS y").to_pydict() == {"x": [None], "y": [7]}
+
+
+def test_sql_array_literal_and_interval_unit():
+    assert daft_tpu.sql("SELECT ARRAY[1,2,3] AS a").to_pydict() == {"a": [[1, 2, 3]]}
+    out = daft_tpu.sql("SELECT DATE '2024-01-01' + INTERVAL '1' DAY AS d").to_pydict()
+    assert str(out["d"][0])[:10] == "2024-01-02"
+
+
+def test_sql_set_operations():
+    assert daft_tpu.sql("SELECT 1 AS x UNION ALL SELECT 1 AS x").to_pydict() == {"x": [1, 1]}
+    assert daft_tpu.sql("SELECT 1 AS x INTERSECT SELECT 1 AS x").to_pydict() == {"x": [1]}
+    assert daft_tpu.sql("SELECT 1 AS x EXCEPT SELECT 1 AS x").to_pydict() == {"x": []}
+    got = daft_tpu.sql(
+        "SELECT x FROM (VALUES (1),(1),(2)) a(x) INTERSECT ALL "
+        "SELECT x FROM (VALUES (1),(1),(3)) b(x)").to_pydict()
+    assert sorted(got["x"]) == [1, 1]
+
+
+def test_sql_values_clause():
+    assert daft_tpu.sql("VALUES (1, 'a'), (2, 'b')").to_pydict() == {
+        "col0": [1, 2], "col1": ["a", "b"]}
+    out = daft_tpu.sql(
+        "SELECT x + 1 AS y FROM (VALUES (1),(2),(3)) v(x) WHERE x > 1").to_pydict()
+    assert out == {"y": [3, 4]}
+    # join a VALUES table against itself
+    out = daft_tpu.sql(
+        "SELECT a.x, b.y FROM (VALUES (1),(2)) a(x) "
+        "JOIN (VALUES (1, 'one'), (2, 'two')) b(x, y) ON a.x = b.x "
+        "ORDER BY a.x").to_pydict()
+    assert out == {"x": [1, 2], "y": ["one", "two"]}
+
+
+def test_sql_current_date_timestamp_literals():
+    out = daft_tpu.sql("SELECT CURRENT_DATE IS NOT NULL AS a, "
+                       "CURRENT_TIMESTAMP IS NOT NULL AS b").to_pydict()
+    assert out == {"a": [True], "b": [True]}
+    t = daft_tpu.sql("SELECT TIMESTAMP '2024-01-02T03:04:05' AS t").to_pydict()["t"][0]
+    assert (t.year, t.hour) == (2024, 3)
+
+
+def test_sql_setop_left_associativity_and_precedence():
+    # (A EXCEPT B) EXCEPT C, not A EXCEPT (B EXCEPT C)
+    assert daft_tpu.sql(
+        "SELECT 1 AS x EXCEPT SELECT 1 AS x EXCEPT SELECT 1 AS x"
+    ).to_pydict() == {"x": []}
+    # INTERSECT binds tighter than UNION
+    got = daft_tpu.sql(
+        "SELECT 1 AS x UNION SELECT 2 AS x INTERSECT SELECT 2 AS x").to_pydict()
+    assert sorted(got["x"]) == [1, 2]
+
+
+def test_sql_interval_implicit_alias():
+    got = daft_tpu.sql("SELECT INTERVAL '1 day' d").to_pydict()
+    assert list(got) == ["d"]
+
+
+def test_sql_values_width_mismatch():
+    import pytest as _pytest
+
+    with _pytest.raises(Exception, match="columns"):
+        daft_tpu.sql("VALUES (1, 2), (3)")
