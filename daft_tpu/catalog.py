@@ -156,3 +156,156 @@ class InMemoryCatalog(Catalog):
 
     def drop_table(self, name: str) -> None:
         self._tables.pop(name, None)
+
+
+class TableFormatTable(Table):
+    """A table stored in an open table format (iceberg/delta/hudi) or plain
+    parquet at a directory path."""
+
+    def __init__(self, name: str, path: str, fmt: str):
+        self.name = name
+        self.path = path
+        self.fmt = fmt
+
+    def read(self):
+        import daft_tpu
+
+        reader = {"iceberg": daft_tpu.read_iceberg,
+                  "delta": daft_tpu.read_deltalake,
+                  "hudi": daft_tpu.read_hudi,
+                  "parquet": daft_tpu.read_parquet}[self.fmt]
+        return reader(self.path)
+
+    def append(self, df) -> None:
+        if self.fmt == "iceberg":
+            df.write_iceberg(self.path)
+        elif self.fmt == "delta":
+            df.write_deltalake(self.path)
+        elif self.fmt == "parquet":
+            df.write_parquet(self.path)
+        else:
+            raise DaftValueError(f"{self.fmt} tables are read-only here")
+
+    def overwrite(self, df) -> None:
+        if self.fmt == "iceberg":
+            df.write_iceberg(self.path, mode="overwrite")
+        elif self.fmt == "delta":
+            df.write_deltalake(self.path, mode="overwrite")
+        elif self.fmt == "parquet":
+            df.write_parquet(self.path, write_mode="overwrite")
+        else:
+            raise DaftValueError(f"{self.fmt} tables are read-only here")
+
+
+def _sniff_table_format(path: str) -> Optional[str]:
+    """Detect the open-table-format of a directory by its metadata layout."""
+    import os
+
+    if os.path.isdir(os.path.join(path, "metadata")) and any(
+            f.endswith(".metadata.json") or f == "version-hint.text"
+            for f in os.listdir(os.path.join(path, "metadata"))):
+        return "iceberg"
+    if os.path.isdir(os.path.join(path, "_delta_log")):
+        return "delta"
+    if os.path.isdir(os.path.join(path, ".hoodie")):
+        return "hudi"
+    import glob as _glob
+
+    if _glob.glob(os.path.join(path, "*.parquet")) or _glob.glob(
+            os.path.join(path, "**", "*.parquet"), recursive=True):
+        return "parquet"
+    return None
+
+
+class DirectoryCatalog(Catalog):
+    """A warehouse directory where each subdirectory is a table in an open
+    table format (native iceberg/delta/hudi readers) or plain parquet.
+
+    This is the zero-service analogue of the reference's external catalog
+    bindings (daft/catalog/__iceberg.py etc.) for local/object-store
+    warehouses."""
+
+    def __init__(self, warehouse: str, name: str = "warehouse"):
+        self.name = name
+        self.warehouse = warehouse
+
+    def list_tables(self, pattern: Optional[str] = None) -> List[str]:
+        import fnmatch
+        import os
+
+        if not os.path.isdir(self.warehouse):
+            return []
+        out = []
+        for entry in sorted(os.listdir(self.warehouse)):
+            p = os.path.join(self.warehouse, entry)
+            if os.path.isdir(p) and _sniff_table_format(p):
+                out.append(entry)
+        if pattern:
+            out = [n for n in out if fnmatch.fnmatch(n, pattern)]
+        return out
+
+    def get_table(self, name: str) -> Table:
+        import os
+
+        p = os.path.join(self.warehouse, name)
+        fmt = _sniff_table_format(p) if os.path.isdir(p) else None
+        if fmt is None:
+            raise DaftValueError(
+                f"Table {name!r} not found in warehouse {self.warehouse!r}")
+        return TableFormatTable(name, p, fmt)
+
+    def create_table(self, name: str, source=None) -> Table:
+        import os
+
+        p = os.path.join(self.warehouse, name)
+        os.makedirs(p, exist_ok=True)
+        t = TableFormatTable(name, p, "parquet")
+        if source is not None and not isinstance(source, Schema):
+            t.append(source)
+        return t
+
+    def drop_table(self, name: str) -> None:
+        import os
+        import shutil
+
+        p = os.path.join(self.warehouse, name)
+        if os.path.isdir(p):
+            shutil.rmtree(p)
+
+
+def _gated_catalog(kind: str, dep: str):
+    raise DaftValueError(
+        f"Catalog.from_{kind} requires the {dep} package/service, which is "
+        f"not available in this environment")
+
+
+def _from_pydict(tables, name: str = "default") -> Catalog:
+    """Build an in-memory catalog from {name: DataFrame|Table|Schema}
+    (reference: daft/catalog/__init__.py Catalog.from_pydict)."""
+    cat = InMemoryCatalog(name)
+    for tname, obj in tables.items():
+        cat.create_table(str(tname), obj)
+    return cat
+
+
+def _from_iceberg(catalog_or_path) -> Catalog:
+    """A pyiceberg Catalog object (gated on pyiceberg) or a warehouse
+    directory path served by the native iceberg reader (reference:
+    daft/catalog/__iceberg.py)."""
+    if isinstance(catalog_or_path, str):
+        return DirectoryCatalog(catalog_or_path, name="iceberg")
+    try:
+        import pyiceberg  # noqa: F401
+    except ImportError:
+        _gated_catalog("iceberg", "pyiceberg")
+    raise DaftValueError("unsupported pyiceberg catalog object")
+
+
+Catalog.from_pydict = staticmethod(_from_pydict)
+Catalog.from_iceberg = staticmethod(_from_iceberg)
+Catalog.from_unity = staticmethod(lambda c: _gated_catalog("unity", "unitycatalog"))
+Catalog.from_glue = staticmethod(lambda *a, **k: _gated_catalog("glue", "boto3"))
+Catalog.from_s3tables = staticmethod(lambda *a, **k: _gated_catalog("s3tables", "boto3"))
+Catalog.from_gravitino = staticmethod(lambda *a, **k: _gated_catalog("gravitino", "gravitino"))
+Catalog.from_paimon = staticmethod(lambda *a, **k: _gated_catalog("paimon", "pypaimon"))
+Catalog.from_postgres = staticmethod(lambda *a, **k: _gated_catalog("postgres", "psycopg2"))

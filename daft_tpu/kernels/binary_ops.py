@@ -27,7 +27,7 @@ def _binary_concat(args, **kwargs):
 
 @register_kernel("binary_slice", returns(_BIN))
 def _binary_slice(args, length=None, **kwargs):
-    start = int(args[1].to_pylist()[0])
+    start = int(args[1].scalar())
     stop = None if length is None else start + int(length)
     out = [None if v is None else v[start:stop] for v in args[0].to_pylist()]
     return Series.from_pylist(out, args[0].name, _BIN)

@@ -44,7 +44,7 @@ def _wrap(out, name, dtype=None):
 
 
 def _scalar(args, i):
-    return args[i].to_pylist()[0]
+    return args[i].scalar()
 
 
 # ------------------------------------------------------------------ #
@@ -847,7 +847,7 @@ def _try_cast(args, dtype=None, **kwargs):
         out = []
         for v in args[0].to_pylist():
             try:
-                out.append(Series.from_pylist([v], "x").cast(dtype).to_pylist()[0])
+                out.append(Series.from_pylist([v], "x").cast(dtype).scalar())
             except Exception:
                 out.append(None)
         return Series.from_pylist(out, args[0].name, dtype)

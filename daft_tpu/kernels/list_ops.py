@@ -63,7 +63,7 @@ def _list_get(args, default=None, **kwargs):
 @register_kernel("list_slice", _same)
 def _list_slice(args, end=None, **kwargs):
     s = args[0]
-    start = int(args[1].to_pylist()[0])
+    start = int(args[1].scalar())
     out = [None if v is None else v[start:end] for v in s.to_pylist()]
     return Series.from_pylist(out, s.name, s.dtype)
 
@@ -83,7 +83,7 @@ def _list_chunk(args, size: int = 1, **kwargs):
 
 @register_kernel("list_join", lambda f, k: Field(f[0].name, DataType.string()))
 def _list_join(args, **kwargs):
-    sep = args[1].to_pylist()[0]
+    sep = args[1].scalar()
     arr = args[0].to_arrow()
     out = pc.binary_join(arr.cast(pa.large_list(pa.large_string())),
                          pa.scalar(sep, pa.large_string()))

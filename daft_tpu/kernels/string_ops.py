@@ -32,22 +32,22 @@ def _wrap(out, name, dtype=None):
 
 @register_kernel("str_contains", returns(_BOOL))
 def _contains(args, **kwargs):
-    return _wrap(pc.match_substring(_s(args).to_arrow(), args[1].to_pylist()[0]), args[0].name, _BOOL)
+    return _wrap(pc.match_substring(_s(args).to_arrow(), args[1].scalar()), args[0].name, _BOOL)
 
 
 @register_kernel("str_startswith", returns(_BOOL))
 def _startswith(args, **kwargs):
-    return _wrap(pc.starts_with(_s(args).to_arrow(), args[1].to_pylist()[0]), args[0].name, _BOOL)
+    return _wrap(pc.starts_with(_s(args).to_arrow(), args[1].scalar()), args[0].name, _BOOL)
 
 
 @register_kernel("str_endswith", returns(_BOOL))
 def _endswith(args, **kwargs):
-    return _wrap(pc.ends_with(_s(args).to_arrow(), args[1].to_pylist()[0]), args[0].name, _BOOL)
+    return _wrap(pc.ends_with(_s(args).to_arrow(), args[1].scalar()), args[0].name, _BOOL)
 
 
 @register_kernel("str_match", returns(_BOOL))
 def _match(args, **kwargs):
-    return _wrap(pc.match_substring_regex(_s(args).to_arrow(), args[1].to_pylist()[0]), args[0].name, _BOOL)
+    return _wrap(pc.match_substring_regex(_s(args).to_arrow(), args[1].scalar()), args[0].name, _BOOL)
 
 
 @register_kernel("str_length", returns(DataType.uint64()))
@@ -101,7 +101,7 @@ def _resolve_split(fields, kwargs):
 
 @register_kernel("str_split", _resolve_split)
 def _split(args, regex: bool = False, **kwargs):
-    pattern = args[1].to_pylist()[0]
+    pattern = args[1].scalar()
     arr = _s(args).to_arrow()
     out = pc.split_pattern_regex(arr, pattern) if regex else pc.split_pattern(arr, pattern)
     return _wrap(out, args[0].name, DataType.list(_STR))
@@ -109,7 +109,7 @@ def _split(args, regex: bool = False, **kwargs):
 
 @register_kernel("str_extract", returns(_STR))
 def _extract(args, index: int = 0, **kwargs):
-    pattern = args[1].to_pylist()[0]
+    pattern = args[1].scalar()
     cre = re.compile(pattern)
     out = []
     for v in _s(args).to_pylist():
@@ -123,7 +123,7 @@ def _extract(args, index: int = 0, **kwargs):
 
 @register_kernel("str_extract_all", lambda f, k: Field(f[0].name, DataType.list(_STR)))
 def _extract_all(args, index: int = 0, **kwargs):
-    pattern = args[1].to_pylist()[0]
+    pattern = args[1].scalar()
     cre = re.compile(pattern)
     out = []
     for v in _s(args).to_pylist():
@@ -137,8 +137,8 @@ def _extract_all(args, index: int = 0, **kwargs):
 @register_kernel("str_replace", returns(_STR))
 def _replace(args, regex: bool = False, **kwargs):
     arr = _s(args).to_arrow()
-    pattern = args[1].to_pylist()[0]
-    replacement = args[2].to_pylist()[0]
+    pattern = args[1].scalar()
+    replacement = args[2].scalar()
     if regex:
         out = pc.replace_substring_regex(arr, pattern, replacement)
     else:
@@ -148,13 +148,13 @@ def _replace(args, regex: bool = False, **kwargs):
 
 @register_kernel("str_left", returns(_STR))
 def _left(args, **kwargs):
-    n = int(args[1].to_pylist()[0])
+    n = int(args[1].scalar())
     return _wrap(pc.utf8_slice_codeunits(_s(args).to_arrow(), 0, n), args[0].name, _STR)
 
 
 @register_kernel("str_right", returns(_STR))
 def _right(args, **kwargs):
-    n = int(args[1].to_pylist()[0])
+    n = int(args[1].scalar())
     arr = _s(args).to_arrow()
     lens = pc.utf8_length(arr)
     starts = pc.max_element_wise(pc.subtract(lens, n), 0)
@@ -164,23 +164,23 @@ def _right(args, **kwargs):
 
 @register_kernel("str_find", returns(DataType.int64()))
 def _find(args, **kwargs):
-    sub = args[1].to_pylist()[0]
+    sub = args[1].scalar()
     out = pc.find_substring(_s(args).to_arrow(), sub)
     return _wrap(out.cast(pa.int64()), args[0].name, DataType.int64())
 
 
 @register_kernel("str_rpad", returns(_STR))
 def _rpad(args, **kwargs):
-    length = int(args[1].to_pylist()[0])
-    pad = args[2].to_pylist()[0]
+    length = int(args[1].scalar())
+    pad = args[2].scalar()
     out = pc.utf8_slice_codeunits(pc.ascii_rpad(_s(args).to_arrow(), length, padding=pad), 0, length)
     return _wrap(out, args[0].name, _STR)
 
 
 @register_kernel("str_lpad", returns(_STR))
 def _lpad(args, **kwargs):
-    length = int(args[1].to_pylist()[0])
-    pad = args[2].to_pylist()[0]
+    length = int(args[1].scalar())
+    pad = args[2].scalar()
     arr = _s(args).to_arrow()
     out = []
     for v in arr.to_pylist():
@@ -196,7 +196,7 @@ def _lpad(args, **kwargs):
 
 @register_kernel("str_repeat", returns(_STR))
 def _repeat(args, **kwargs):
-    n = int(args[1].to_pylist()[0])
+    n = int(args[1].scalar())
     out = pc.binary_repeat(_s(args).to_arrow(), n)
     return _wrap(out, args[0].name, _STR)
 
@@ -215,13 +215,13 @@ def _like_to_regex(pattern: str) -> str:
 
 @register_kernel("str_like", returns(_BOOL))
 def _like(args, **kwargs):
-    pattern = _like_to_regex(args[1].to_pylist()[0])
+    pattern = _like_to_regex(args[1].scalar())
     return _wrap(pc.match_substring_regex(_s(args).to_arrow(), pattern), args[0].name, _BOOL)
 
 
 @register_kernel("str_ilike", returns(_BOOL))
 def _ilike(args, **kwargs):
-    pattern = _like_to_regex(args[1].to_pylist()[0])
+    pattern = _like_to_regex(args[1].scalar())
     return _wrap(
         pc.match_substring_regex(_s(args).to_arrow(), pattern, ignore_case=True),
         args[0].name, _BOOL,
@@ -310,7 +310,7 @@ def _count_matches(args, patterns=None, whole_words=False, case_sensitive=True, 
 
 @register_kernel("concat_ws", returns(_STR))
 def _concat_ws(args, **kwargs):
-    sep = pa.scalar(args[0].to_pylist()[0], pa.large_string())
+    sep = pa.scalar(args[0].scalar(), pa.large_string())
     arrays = [a.cast(_STR).to_arrow() for a in args[1:]]
     out = pc.binary_join_element_wise(*arrays, sep, null_handling="skip")
     return _wrap(out, args[1].name if len(args) > 1 else "literal", _STR)

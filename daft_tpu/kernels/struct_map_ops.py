@@ -39,7 +39,7 @@ def _map_get_resolver(fields, kwargs):
 @register_kernel("map_get", _map_get_resolver)
 def _map_get(args, **kwargs):
     s = args[0]
-    key = args[1].to_pylist()[0]
+    key = args[1].scalar()
     value_dtype = s.dtype._params[1]
     out = []
     for row in s.to_arrow().to_pylist():

@@ -116,6 +116,9 @@ class Optimizer:
                         changed = True
                 if not changed:
                     break
+        # Final pass: prune unused columns through joins / in-memory sources
+        # (scan-source pruning happened via PushDownProjection's pushdowns).
+        plan = prune_columns(plan)
         return plan
 
 
@@ -1078,3 +1081,107 @@ class ReorderJoins(Rule):
             return j
         except Exception:
             return None
+
+
+# ---------------------------------------------------------------------- #
+# Column pruning through joins and in-memory sources                      #
+# ---------------------------------------------------------------------- #
+def prune_columns(plan: lp.LogicalPlan) -> lp.LogicalPlan:
+    """Top-down required-column analysis that inserts narrowing Projects on
+    join inputs and above in-memory sources (reference: the column-pruning
+    side of rules/push_down_projection.rs; scan pruning itself is handled by
+    PushDownProjection's pushdown path).
+
+    Join collision renaming depends on which names exist on BOTH sides, so a
+    pruned side keeps any (otherwise-unused) column whose name collides with
+    a kept column on the other side — output names never change."""
+
+    def all_names(n: lp.LogicalPlan) -> set:
+        return set(n.schema.column_names())
+
+    def narrow(child: lp.LogicalPlan, keep: set) -> lp.LogicalPlan:
+        names = child.schema.column_names()
+        wanted = [c for c in names if c in keep]
+        if len(wanted) == len(names) or not wanted:
+            return rec(child, set(names))
+        pruned = rec(child, set(wanted))
+        if set(pruned.schema.column_names()) == set(wanted):
+            return pruned
+        return lp.Project(pruned, [ColumnRef(c) for c in wanted])
+
+    def refs(exprs) -> set:
+        out: set = set()
+        for e in exprs:
+            out |= e.column_refs()
+        return out
+
+    def rec(node: lp.LogicalPlan, required: set) -> lp.LogicalPlan:
+        if isinstance(node, lp.Join) and node.how != "cross":
+            left, right = node.children()
+            lnames, rnames = all_names(left), all_names(right)
+            lkeys, rkeys = refs(node.left_on), refs(node.right_on)
+            lreq = (required & lnames) | (lkeys & lnames)
+            # Map join-output names back to right-side input names.
+            rreq = set(rkeys)
+            for f in right.schema:
+                out_name = (f"{node.prefix}{node.suffix}{f.name}"
+                            if f.name in lnames else f.name)
+                if out_name in required or f.name in required:
+                    rreq.add(f.name)
+            if node.how in ("semi", "anti"):
+                rreq = rkeys & rnames
+            # Preserve collision-driven renames: a kept right column keeps
+            # its suffixed name only while the left column exists (and vice
+            # versa for the un-suffixed name staying unambiguous).
+            lreq |= {c for c in rreq if c in lnames}
+            rreq |= {c for c in lreq if c in rnames} if node.how not in ("semi", "anti") else set()
+            new_left = narrow(left, lreq)
+            new_right = narrow(right, rreq)
+            if new_left is left and new_right is right:
+                return node
+            return node.with_children([new_left, new_right])
+        if isinstance(node, lp.InMemorySource):
+            return node  # narrowed by the caller via narrow()
+        if isinstance(node, lp.Project):
+            child = node.children()[0]
+            new_child = narrow(child, refs(node.exprs))
+            return node if new_child is child else node.with_children([new_child])
+        if isinstance(node, lp.UDFProject):
+            child = node.children()[0]
+            need = refs([node.udf_expr]) | refs(node.passthrough)
+            new_child = narrow(child, need)
+            return node if new_child is child else node.with_children([new_child])
+        if isinstance(node, lp.Aggregate):
+            child = node.children()[0]
+            new_child = narrow(child, refs(node.agg_exprs) | refs(node.group_by))
+            return node if new_child is child else node.with_children([new_child])
+        if isinstance(node, lp.Filter):
+            child = node.children()[0]
+            new_child = rec(child, required | node.predicate.column_refs())
+            return node if new_child is child else node.with_children([new_child])
+        if isinstance(node, (lp.Sort, lp.TopN)):
+            child = node.children()[0]
+            new_child = rec(child, required | refs(node.sort_by))
+            return node if new_child is child else node.with_children([new_child])
+        if isinstance(node, (lp.Limit, lp.Sample, lp.Shard, lp.Distinct)):
+            child = node.children()[0]
+            new_child = rec(child, required)
+            return node if new_child is child else node.with_children([new_child])
+        if isinstance(node, lp.Repartition):
+            child = node.children()[0]
+            new_child = rec(child, required | refs(getattr(node, "partition_by", []) or []))
+            return node if new_child is child else node.with_children([new_child])
+        if isinstance(node, (lp.Concat, lp.Intersect, lp.Except)):
+            new_children = [rec(c, set(c.schema.column_names())) for c in node.children()]
+            if all(a is b for a, b in zip(new_children, node.children())):
+                return node
+            return node.with_children(new_children)
+        # Conservative default (Explode/Unpivot/Window/Pivot/Sink/...):
+        # children keep their full column sets, but keep descending so joins
+        # below still benefit.
+        new_children = [rec(c, set(c.schema.column_names())) for c in node.children()]
+        if all(a is b for a, b in zip(new_children, node.children())):
+            return node
+        return node.with_children(new_children)
+
+    return rec(plan, set(plan.schema.column_names()))

@@ -641,17 +641,30 @@ def _group_codes(keys: Sequence[Series]) -> Tuple[np.ndarray, np.ndarray]:
             enc = pc.dictionary_encode(arr)
             idx = np.asarray(enc.indices.fill_null(-1)).astype(np.int64) + 1  # nulls -> 0
         else:
-            h = k.hash().to_numpy().astype(np.int64)
-            _, idx = np.unique(h, return_inverse=True)
-            idx = idx.astype(np.int64)
+            h = pa.chunked_array([pa.array(k.hash().to_numpy().astype(np.int64))])
+            idx = np.asarray(pc.dictionary_encode(h).combine_chunks().indices).astype(np.int64)
         codes.append(idx)
         radices.append(int(idx.max()) + 1 if len(idx) else 1)
-    # Combine per-column dense codes exactly: mixed-radix when the key-space
-    # product fits in int64, else unique over row tuples (a fixed-stride
-    # linear combination silently collides distinct key tuples at scale).
+
+    def _dense(combo: np.ndarray):
+        """Hash-based dense group ids via Arrow dictionary encoding: O(n),
+        ids numbered by first appearance (Arrow assigns dictionary slots in
+        encounter order — no sort needed)."""
+        enc = pc.dictionary_encode(
+            pa.chunked_array([pa.array(combo)])).combine_chunks()
+        inverse = np.asarray(enc.indices).astype(np.int64)
+        num = len(enc.dictionary)
+        first_idx = np.empty(num, dtype=np.int64)
+        first_idx[inverse[::-1]] = np.arange(n - 1, -1, -1)
+        return inverse, first_idx
+
+    # Combine per-column dense codes exactly: a single mixed-radix combo when
+    # the whole key-space product fits in int64, else fold columns in
+    # pairwise (dense_so_far * radix + code, re-densify) — after each
+    # densify the running radix is <= n, so dense*next_radix stays within
+    # int64 for any row count; no sort-based unique and no collisions.
     if len(codes) == 1:
-        combo = codes[0]
-        uniq, first_idx, inverse = np.unique(combo, return_index=True, return_inverse=True)
+        inverse, first_idx = _dense(codes[0])
     else:
         space = 1
         for r in radices:
@@ -660,13 +673,10 @@ def _group_codes(keys: Sequence[Series]) -> Tuple[np.ndarray, np.ndarray]:
             combo = np.zeros(n, dtype=np.int64)
             for c, r in zip(codes, radices):
                 combo = combo * np.int64(r) + c
-            uniq, first_idx, inverse = np.unique(combo, return_index=True, return_inverse=True)
+            inverse, first_idx = _dense(combo)
         else:
-            mat = np.ascontiguousarray(np.stack(codes, axis=1))
-            view = mat.view([("", mat.dtype)] * mat.shape[1]).reshape(-1)
-            uniq, first_idx, inverse = np.unique(view, return_index=True, return_inverse=True)
-    # Renumber groups by first occurrence to keep deterministic order.
-    order = np.argsort(first_idx, kind="stable")
-    remap = np.empty_like(order)
-    remap[order] = np.arange(len(order))
-    return remap[inverse].astype(np.int64), np.sort(first_idx).astype(np.int64)
+            inverse, first_idx = _dense(codes[0])
+            for c, r in zip(codes[1:], radices[1:]):
+                combo = inverse * np.int64(r) + c
+                inverse, first_idx = _dense(combo)
+    return inverse, first_idx.astype(np.int64)

@@ -185,6 +185,16 @@ class Series:
             raise DaftTypeError("Python object series has no Arrow representation")
         return self._data
 
+    def scalar(self):
+        """Element 0 as a Python value WITHOUT materializing the whole
+        column — kernels read broadcast literal arguments through this
+        (a literal arrives as a full-length Series)."""
+        if len(self) == 0:
+            return None
+        if self._dtype.is_python():
+            return self._data[0]
+        return self.slice(0, 1).to_pylist()[0]
+
     def to_pylist(self) -> list:
         if self._dtype.is_python():
             return list(self._data)
