@@ -118,3 +118,52 @@ def set_runner_distributed(**kwargs) -> None:
     from daft_tpu.runners.distributed import DistributedRunner
 
     get_context().set_runner(DistributedRunner(**kwargs))
+
+
+# -- per-query clock --------------------------------------------------------
+# CURRENT_DATE/CURRENT_TIMESTAMP must be one value per statement, not one per
+# micropartition. Runners freeze the clock at query start; the now()/today()
+# kernels read it through query_now(). Outside a query (bare Series eval) the
+# wall clock is read directly.
+import contextvars as _contextvars
+import datetime as _datetime
+
+_query_clock: _contextvars.ContextVar[Optional[_datetime.datetime]] = \
+    _contextvars.ContextVar("daft_query_clock", default=None)
+
+
+def query_now() -> _datetime.datetime:
+    frozen = _query_clock.get()
+    return frozen if frozen is not None \
+        else _datetime.datetime.now(_datetime.timezone.utc)
+
+
+def iter_with_frozen_clock(gen):
+    """Drain ``gen`` with the query clock frozen during each resumption only.
+
+    Freezing for the whole generator lifetime via set/reset tokens breaks
+    when two lazy queries interleave on one thread (finishing query A would
+    reset the clock out from under still-running query B), so the clock is
+    set right before each ``next()`` and reset before yielding control."""
+    now = _datetime.datetime.now(_datetime.timezone.utc)
+    while True:
+        token = _query_clock.set(now)
+        try:
+            try:
+                item = next(gen)
+            finally:
+                _query_clock.reset(token)
+        except StopIteration:
+            return
+        yield item
+
+
+@contextlib.contextmanager
+def frozen_clock_scope(at: Optional[_datetime.datetime] = None):
+    """Freeze the query clock for a synchronous block (worker task runs)."""
+    token = _query_clock.set(
+        at or _datetime.datetime.now(_datetime.timezone.utc))
+    try:
+        yield
+    finally:
+        _query_clock.reset(token)

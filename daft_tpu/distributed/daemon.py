@@ -199,7 +199,10 @@ class WorkerDaemon:
             stats.local_flush = False  # shipped back in the reply instead
             executor = Executor(msg["cfg"], partition_offset=msg["partition_idx"],
                                 stats=stats)
-            out = list(executor.run(bound))
+            from daft_tpu.context import frozen_clock_scope
+
+            with frozen_clock_scope(msg.get("frozen_clock")):
+                out = list(executor.run(bound))
             parts = collect_task_outputs(out, msg["expect_outputs"], fragment.schema)
             refs = []
             shuffle_id = f"task-{uuid.uuid4().hex[:12]}"
@@ -282,6 +285,7 @@ class RemoteWorker(Worker):
                     "partition_idx": task.partition_idx,
                     "expect_outputs": task.expect_outputs,
                     "query_id": task.query_id,
+                    "frozen_clock": task.frozen_clock,
                 }
                 reply = self._request(payload)
                 # Worker-side operator stats stream back with the reply and

@@ -95,7 +95,10 @@ def _worker_entry(fd: int) -> None:
             stats.local_flush = False  # shipped back in the reply instead
             executor = Executor(cfg, partition_offset=payload["partition_idx"],
                                 stats=stats)
-            out = list(executor.run(bound))
+            from daft_tpu.context import frozen_clock_scope
+
+            with frozen_clock_scope(payload.get("frozen_clock")):
+                out = list(executor.run(bound))
             parts = collect_task_outputs(out, expect, fragment.schema)
             blobs = [serialize_partition(p) for p in parts]
             _send_frame(sock, cloudpickle.dumps(
@@ -176,6 +179,7 @@ class ProcessWorker(Worker):
                         "partition_idx": task.partition_idx,
                         "expect_outputs": task.expect_outputs,
                         "query_id": task.query_id,
+                        "frozen_clock": task.frozen_clock,
                     }
                     try:
                         _send_frame(self._sock, cloudpickle.dumps(payload))

@@ -7,10 +7,12 @@ each task bundles a LocalPhysicalPlan + input partitions and a
 
 from __future__ import annotations
 
+import datetime
 import itertools
 from dataclasses import dataclass, field
 from typing import List, Optional, Sequence
 
+from daft_tpu.context import query_now
 from daft_tpu.distributed.partition_ref import PartitionRef
 from daft_tpu.physical import plan as pp
 
@@ -47,6 +49,11 @@ class Task:
     # Shuffle-map tasks yield one output partition per shuffle bucket; the
     # worker must preserve them instead of concatenating (expect_outputs > 1).
     expect_outputs: int = 1
+    # The query's frozen CURRENT_TIMESTAMP instant, captured at task-creation
+    # time on the driver (where the runner froze the clock) and shipped with
+    # the task so every worker — thread, process, or remote daemon — evaluates
+    # now()/today() to the same value.
+    frozen_clock: datetime.datetime = field(default_factory=query_now)
 
     def input_size_bytes(self) -> int:
         return sum(r.size_bytes() for refs in self.inputs for r in refs)

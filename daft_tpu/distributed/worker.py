@@ -106,6 +106,8 @@ class LocalWorker(Worker):
                     active_query_stats,
                 )
 
+                from daft_tpu.context import frozen_clock_scope
+
                 bound = bind_task_fragment(task.fragment, task.inputs)
                 # Worker-local stats keep their normal event flush (so
                 # subscribers see OperatorStats exactly once); the snapshot
@@ -114,7 +116,8 @@ class LocalWorker(Worker):
                 stats = RuntimeStats(task.query_id)
                 executor = Executor(self.cfg, partition_offset=task.partition_idx,
                                     stats=stats)
-                out = list(executor.run(bound))
+                with frozen_clock_scope(task.frozen_clock):
+                    out = list(executor.run(bound))
                 parts = collect_task_outputs(out, task.expect_outputs, task.fragment.schema)
                 driver_stats = active_query_stats(task.query_id)
                 if driver_stats is not None and driver_stats is not stats:

@@ -20,6 +20,7 @@ the reference's pipeline barriers.
 
 from __future__ import annotations
 
+import contextvars
 import queue
 import threading
 from concurrent.futures import Future, ThreadPoolExecutor
@@ -185,9 +186,12 @@ class Executor:
             except BaseException as e:  # noqa: BLE001
                 put_or_stop(q, e)
 
+        # Reader threads inherit the caller's contextvars (per-query frozen
+        # clock etc.) — a bare Thread/pool task starts with an empty context.
+        ambient = contextvars.copy_context()
         try:
             for task, q in zip(tasks, queues):
-                pool.submit(reader, task, q)
+                pool.submit(ambient.copy().run, reader, task, q)
             for q in queues:
                 while True:
                     item = q.get()
@@ -280,11 +284,15 @@ class Executor:
         pool = ThreadPoolExecutor(max_workers=concurrency, thread_name_prefix="daft-udf")
         inflight: "queue.Queue" = queue.Queue(maxsize=concurrency * 2)
         stop = threading.Event()
+        # Feeder + eval threads inherit the caller's contextvars (per-query
+        # frozen clock): bare threads start from an empty context.
+        ambient = contextvars.copy_context()
 
         def submit_all():
             try:
                 for mp in child_iter:
-                    fut = pool.submit(mp.eval_expression_list, exprs)
+                    fut = pool.submit(ambient.copy().run,
+                                      mp.eval_expression_list, exprs)
                     while not stop.is_set():
                         try:
                             inflight.put(fut, timeout=0.1)
@@ -308,7 +316,8 @@ class Executor:
                 except queue.Full:
                     continue
 
-        feeder = threading.Thread(target=submit_all, daemon=True)
+        feeder = threading.Thread(target=ambient.copy().run, args=(submit_all,),
+                                  daemon=True)
         feeder.start()
         try:
             while True:

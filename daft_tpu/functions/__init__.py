@@ -145,19 +145,11 @@ def columns_mean(*exprs) -> Expression:
 
 
 def columns_min(*exprs) -> Expression:
-    out = ensure_expr_wrap(exprs[0])
-    for e in exprs[1:]:
-        nxt = ensure_expr_wrap(e)
-        out = (out <= nxt).if_else(out, nxt)
-    return out
+    return _fn("elementwise_min", *exprs)
 
 
 def columns_max(*exprs) -> Expression:
-    out = ensure_expr_wrap(exprs[0])
-    for e in exprs[1:]:
-        nxt = ensure_expr_wrap(e)
-        out = (out >= nxt).if_else(out, nxt)
-    return out
+    return _fn("elementwise_max", *exprs)
 
 
 # -- geo -------------------------------------------------------------------

@@ -354,20 +354,34 @@ def run_process(args, *, shell: bool = False, on_error: str = "log",
 
     rd = return_dtype or DataType.string()
     arg_list = args if isinstance(args, (list, tuple)) else [args]
+    if shell and len(arg_list) != 1:
+        raise ValueError(
+            "run_process with shell=True requires exactly one string "
+            "expression; row values must not be joined into shell syntax")
     exprs = [a if isinstance(a, Expression) else lit(a) for a in arg_list]
+
+    def _cast_stdout(out: bytes):
+        kind = rd.id.value
+        if kind == "binary":
+            return out
+        s = out.decode().strip()
+        if kind in ("int8", "int16", "int32", "int64",
+                    "uint8", "uint16", "uint32", "uint64"):
+            return int(s or 0)
+        if kind in ("float32", "float64"):
+            return float(s or 0.0)
+        if kind == "bool":
+            return s.lower() in ("1", "true", "t", "yes")
+        return out.decode()
 
     @_udf(return_dtype=rd)
     def _run(*argv):
-        cmd = " ".join(str(a) for a in argv) if shell else [str(a) for a in argv]
+        cmd = str(argv[0]) if shell else [str(a) for a in argv]
         try:
+            # capture raw bytes: binary stdout must survive untouched
             proc = subprocess.run(cmd, shell=shell, capture_output=True,
-                                  text=True, check=True)
-            out = proc.stdout
-            if rd.id.value in ("int64", "int32"):
-                return int(out.strip() or 0)
-            if rd.id.value == "float64":
-                return float(out.strip() or 0.0)
-            return out
+                                  check=True)
+            return _cast_stdout(proc.stdout)
         except Exception as e:
             if on_error == "raise":
                 raise

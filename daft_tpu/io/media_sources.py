@@ -107,7 +107,7 @@ def parse_mcap(data: bytes, topics=None, start_time=None, end_time=None):
             rows.append({
                 "topic": topic, "log_time": log_t, "publish_time": pub_t,
                 "sequence": seq,
-                "data": payload[22:].decode("utf-8", errors="replace"),
+                "data": bytes(payload[22:]),
             })
     return rows
 
@@ -115,7 +115,8 @@ def parse_mcap(data: bytes, topics=None, start_time=None, end_time=None):
 _MCAP_SCHEMA = _schema([
     ("topic", DataType.string()), ("log_time", DataType.int64()),
     ("publish_time", DataType.int64()), ("sequence", DataType.int32()),
-    ("data", DataType.string()),
+    # binary, not lossy utf-8: MCAP payloads are protobuf/CDR bytes
+    ("data", DataType.binary()),
 ])
 
 

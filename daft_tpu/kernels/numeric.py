@@ -127,3 +127,44 @@ def _clip(args, min=None, max=None, **kwargs):
     vals, mask = s.to_numpy_masked()
     out = np.clip(vals, min, max)
     return Series.from_numpy(out, s.name, s.dtype)._with_mask(mask)
+
+
+def _promoted_dtype(fields, kwargs):
+    """Common supertype across args (null-typed args unify away)."""
+    from daft_tpu.datatype import unify_dtypes
+
+    unified = functools.reduce(unify_dtypes, (f.dtype for f in fields))
+    return fields[0].with_dtype(unified)
+
+
+def _elementwise_fold(pc_fn):
+    def fn(args, **kwargs):
+        # Null-typed args (literal NULL) contribute nothing: SQL
+        # GREATEST/LEAST ignore NULLs (skip_nulls=True below).
+        arrs = [s.to_arrow() for s in args
+                if not pa.types.is_null(s.to_arrow().type)]
+        if not arrs:
+            return args[0]
+        # arrow has no bool kernel for {min,max}_element_wise: via uint8
+        was_bool = all(pa.types.is_boolean(a.type) for a in arrs)
+        if was_bool:
+            arrs = [a.cast(pa.uint8()) for a in arrs]
+        out = pc_fn(*arrs) if len(arrs) > 1 else arrs[0]
+        if was_bool:
+            out = out.cast(pa.bool_())
+        return Series.from_arrow(out, args[0].name)
+
+    return fn
+
+
+import functools  # noqa: E402
+
+register_kernel(
+    "elementwise_max", _promoted_dtype,
+    jax_fn=lambda a: functools.reduce(jnp.maximum, a),
+)(_elementwise_fold(pc.max_element_wise))
+
+register_kernel(
+    "elementwise_min", _promoted_dtype,
+    jax_fn=lambda a: functools.reduce(jnp.minimum, a),
+)(_elementwise_fold(pc.min_element_wise))

@@ -398,3 +398,24 @@ _register_uuid7("extract_minute_uuid7", lambda ms: ms // 60_000)
 _register_uuid7("extract_hour_uuid7", lambda ms: ms // 3_600_000)
 _register_uuid7("extract_day_uuid7", lambda ms: ms // 86_400_000)
 _register_uuid7("extract_month_uuid7", _months_since_epoch)
+
+
+# CURRENT_DATE / CURRENT_TIMESTAMP evaluate at EXECUTION time in UTC (not
+# frozen into the plan at parse time), so re-running a cached plan re-reads
+# the clock — but the clock is frozen once per query by the runner
+# (context.freeze_query_clock), so every micropartition of one statement
+# sees the same instant. The single argument is a dummy carrying row count.
+@register_kernel("today", returns(DataType.date()))
+def _today(args, **kwargs):
+    from daft_tpu.context import query_now
+
+    return Series.full(args[0].name, query_now().date(), len(args[0]),
+                       DataType.date())
+
+
+@register_kernel("now", returns(DataType.timestamp("us")))
+def _now(args, **kwargs):
+    from daft_tpu.context import query_now
+
+    return Series.full(args[0].name, query_now().replace(tzinfo=None),
+                       len(args[0]), DataType.timestamp("us"))

@@ -104,9 +104,15 @@ class DistributedRunner(Runner):
         stats.local_flush = False  # workers already emit OperatorStats events
         ctx.last_query_stats = stats  # DataFrame.metrics() surface
         register_query_stats(query_id, stats)
+        from daft_tpu.context import frozen_clock_scope
+
         try:
             executor = DistributedExecutor(self.manager, cfg, query_id=query_id)
-            refs = executor.execute(physical)
+            # Freeze only around the synchronous plan execution: every Task
+            # created inside captures this one instant (Task.frozen_clock
+            # default_factory) and ships it to its worker.
+            with frozen_clock_scope():
+                refs = executor.execute(physical)
             for ref in refs:
                 mp = ref.fetch()
                 if len(mp):

@@ -33,10 +33,15 @@ class NativeRunner(Runner):
         try:
             from daft_tpu.execution.resource_manager import RuntimeStats
 
+            from daft_tpu.context import iter_with_frozen_clock
+
             stats = RuntimeStats(query_id)
             ctx.last_query_stats = stats  # DataFrame.metrics() surface
             executor = Executor(cfg, stats=stats)
-            yield from executor.run(physical)
+            # CURRENT_TIMESTAMP is one instant per statement: frozen per
+            # resumption (not per generator lifetime) so interleaved lazy
+            # queries on one thread can't clobber each other's clock.
+            yield from iter_with_frozen_clock(executor.run(physical))
         except BaseException as e:  # noqa: BLE001
             error = str(e)
             raise

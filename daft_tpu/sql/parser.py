@@ -640,10 +640,10 @@ class Parser:
                 return FunctionCall("list_pack", items)
             if low in ("current_date", "current_timestamp") and not (
                     self.peek().kind == "op" and self.peek().value == "("):
-                import datetime as _dt
-
-                return Literal(_dt.date.today() if low == "current_date"
-                               else _dt.datetime.now())
+                # Deferred: evaluated at execution time in UTC (the dummy
+                # literal arg only carries the row count to the kernel).
+                fn = "today" if low == "current_date" else "now"
+                return FunctionCall(fn, [Literal(1)])
             if self.peek().kind == "op" and self.peek().value == "(":
                 return self._maybe_over(self._parse_function(t.value))
             # qualified column a.b -> struct access is handled postfix; here a
@@ -750,11 +750,10 @@ class Parser:
             while self.accept("op", ","):
                 args.append(self.parse_expr())
             self.expect("op", ")")
-            op = "gt" if name_l == "greatest" else "lt"
-            out = args[0]
-            for nxt in args[1:]:
-                out = IfElse(BinaryOp(op, out, nxt), out, nxt)
-            return out
+            # n-ary kernel: a nested-IfElse fold would re-embed the
+            # accumulator twice per step (2^n tree growth for wide calls).
+            fn = "elementwise_max" if name_l == "greatest" else "elementwise_min"
+            return FunctionCall(fn, args)
         distinct = bool(self.accept_kw("distinct"))
         args: List[Expr] = []
         if not self.accept("op", ")"):

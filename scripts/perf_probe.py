@@ -1,0 +1,129 @@
+"""Instrument the embed_image perf pipeline: tunnel bandwidth, pure compute,
+and overlap behavior, printed as JSON lines (VERDICT r2 Next #1b).
+
+Run: python scripts/perf_probe.py [--quick]
+"""
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def _t(fn, reps=3):
+    ts = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        fn()
+        ts.append(time.perf_counter() - t0)
+    return min(ts), ts
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    dev = jax.devices()[0]
+    print(json.dumps({"probe": "device", "platform": dev.platform,
+                      "kind": getattr(dev, "device_kind", "?")}))
+
+    rng = np.random.default_rng(0)
+
+    # 1. host->device bandwidth vs transfer size, random (incompressible) data
+    for mb in (1, 8, 38, 154):
+        arr = rng.integers(0, 255, (mb * 1024 * 1024,), dtype=np.uint8)
+        def put():
+            jax.device_put(arr).block_until_ready()
+        best, ts = _t(put, reps=3)
+        print(json.dumps({"probe": "h2d_random", "mb": mb,
+                          "best_s": round(best, 3),
+                          "mbps": round(mb / best, 1),
+                          "all_s": [round(t, 3) for t in ts]}), flush=True)
+
+    # 1b. same but zeros (tests whether the tunnel compresses)
+    for mb in (38,):
+        arr = np.zeros((mb * 1024 * 1024,), dtype=np.uint8)
+        def put0():
+            jax.device_put(arr).block_until_ready()
+        best, ts = _t(put0, reps=3)
+        print(json.dumps({"probe": "h2d_zeros", "mb": mb,
+                          "best_s": round(best, 3),
+                          "mbps": round(mb / best, 1),
+                          "all_s": [round(t, 3) for t in ts]}), flush=True)
+
+    # 1c. natural-image-like data (smooth gradients): do natural pixels
+    # transfer faster than random? (transparent wire compression check)
+    mb = 38
+    base = np.linspace(0, 255, 224 * 224 * 3, dtype=np.float32)
+    img = (base + rng.normal(0, 8, base.shape)).clip(0, 255).astype(np.uint8)
+    arr = np.tile(img, 256)[: mb * 1024 * 1024]
+    def putn():
+        jax.device_put(arr).block_until_ready()
+    best, ts = _t(putn, reps=3)
+    print(json.dumps({"probe": "h2d_natural", "mb": mb,
+                      "best_s": round(best, 3), "mbps": round(mb / best, 1),
+                      "all_s": [round(t, 3) for t in ts]}), flush=True)
+
+    # 2. device->host bandwidth (result fetch)
+    big = jax.device_put(rng.integers(0, 255, (38 * 1024 * 1024,), dtype=np.uint8))
+    big.block_until_ready()
+    def fetch():
+        np.asarray(big)
+    best, ts = _t(fetch, reps=3)
+    print(json.dumps({"probe": "d2h_random", "mb": 38, "best_s": round(best, 3),
+                      "mbps": round(38 / best, 1)}), flush=True)
+
+    # 3. pure compute: CLIP ViT-L/14 forward, data resident
+    from daft_tpu.models.clip import CLIPConfig, init_clip_params
+
+    cfg = CLIPConfig.from_name("ViT-L/14")
+    model, params = init_clip_params(cfg, 0)
+    params = jax.device_put(params)
+
+    def fwd(p, pixels):
+        emb = model.apply(p, pixels, method=model.encode_image)
+        return emb / jnp.linalg.norm(emb, axis=-1, keepdims=True).clip(1e-6)
+
+    jfwd = jax.jit(fwd)
+    B = 256
+    pix = jax.device_put(
+        rng.integers(0, 255, (B, 224, 224, 3), dtype=np.uint8))
+    pix.block_until_ready()
+    t0 = time.perf_counter()
+    jfwd(params, pix).block_until_ready()
+    compile_s = time.perf_counter() - t0
+    print(json.dumps({"probe": "compile", "s": round(compile_s, 1)}), flush=True)
+
+    def run():
+        jfwd(params, pix).block_until_ready()
+    best, ts = _t(run, reps=5)
+    print(json.dumps({"probe": "compute_b256", "best_s": round(best, 4),
+                      "imgs_per_s": round(B / best, 1),
+                      "all_s": [round(t, 4) for t in ts]}), flush=True)
+
+    # 4. overlap test: transfer chunk i+1 while chunk i computes (the
+    # _chunked_forward strategy) over 3072 imgs
+    N = 3072
+    imgs = rng.integers(0, 255, (N, 224, 224, 3), dtype=np.uint8)
+    t0 = time.perf_counter()
+    futures = []
+    staged = jax.device_put(imgs[0:B])
+    for i in range(0, N, B):
+        nxt = None
+        if i + B < N:
+            nxt = jax.device_put(imgs[i + B:i + 2 * B])
+        f = jfwd(params, staged)
+        f.copy_to_host_async()
+        futures.append(f)
+        staged = nxt
+    outs = [np.asarray(f) for f in futures]
+    e2e = time.perf_counter() - t0
+    print(json.dumps({"probe": "overlap_e2e", "n": N, "s": round(e2e, 2),
+                      "imgs_per_s": round(N / e2e, 1),
+                      "out": len(outs)}), flush=True)
+
+
+if __name__ == "__main__":
+    main()

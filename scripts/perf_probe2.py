@@ -1,0 +1,138 @@
+"""Phase-level timing of the chunked forward pipeline on the axon TPU.
+
+Separates: serial put+fwd, stage-all-then-compute, pipelined variants,
+batch-size sweep, donation on/off. JSON lines out.
+"""
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from daft_tpu.models.clip import CLIPConfig, init_clip_params
+
+    rng = np.random.default_rng(0)
+    cfg = CLIPConfig.from_name("ViT-L/14")
+    model, params = init_clip_params(cfg, 0)
+    params = jax.device_put(params)
+
+    def fwd(p, pixels):
+        emb = model.apply(p, pixels, method=model.encode_image)
+        return emb / jnp.linalg.norm(emb, axis=-1, keepdims=True).clip(1e-6)
+
+    jfwd = jax.jit(fwd)
+    jfwd_don = jax.jit(fwd, donate_argnums=(1,))
+
+    N = 3072
+    imgs = rng.integers(0, 255, (N, 224, 224, 3), dtype=np.uint8)
+
+    for B in (256, 512):
+        chunks = [imgs[i:i + B] for i in range(0, N, B)]
+        # warm compile
+        w = jax.device_put(chunks[0])
+        jfwd(params, w).block_until_ready()
+        jfwd_don(params, jax.device_put(chunks[0])).block_until_ready()
+        del w
+
+        # A. fully serial: block after every phase
+        t_put = t_fwd = 0.0
+        t0 = time.perf_counter()
+        for c in chunks:
+            t1 = time.perf_counter()
+            d = jax.device_put(c)
+            d.block_until_ready()
+            t2 = time.perf_counter()
+            r = jfwd(params, d)
+            r.block_until_ready()
+            t3 = time.perf_counter()
+            t_put += t2 - t1
+            t_fwd += t3 - t2
+        total = time.perf_counter() - t0
+        print(json.dumps({"probe": "serial", "B": B, "total_s": round(total, 2),
+                          "put_s": round(t_put, 2), "fwd_s": round(t_fwd, 2),
+                          "imgs_per_s": round(N / total, 1)}), flush=True)
+
+        # B. stage everything first, then dispatch all computes
+        t0 = time.perf_counter()
+        staged = [jax.device_put(c) for c in chunks]
+        for s in staged:
+            s.block_until_ready()
+        t_stage = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        outs = [jfwd(params, s) for s in staged]
+        for o in outs:
+            o.block_until_ready()
+        t_comp = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        res = [np.asarray(o) for o in outs]
+        t_gather = time.perf_counter() - t0
+        print(json.dumps({"probe": "stage_all", "B": B,
+                          "stage_s": round(t_stage, 2),
+                          "compute_s": round(t_comp, 2),
+                          "gather_s": round(t_gather, 3),
+                          "imgs_per_s_total": round(
+                              N / (t_stage + t_comp + t_gather), 1),
+                          "imgs_per_s_compute": round(N / t_comp, 1)}),
+              flush=True)
+        del staged, outs, res
+
+        # C. pipelined, queue depth sweep, no donation
+        for depth in (1, 2, 4):
+            t0 = time.perf_counter()
+            staged = [jax.device_put(c) for c in chunks[:depth]]
+            futures = []
+            for i in range(len(chunks)):
+                if i + depth < len(chunks):
+                    staged.append(jax.device_put(chunks[i + depth]))
+                futures.append(jfwd(params, staged[0]))
+                staged.pop(0)
+            out = [np.asarray(f) for f in futures]
+            total = time.perf_counter() - t0
+            print(json.dumps({"probe": "pipelined", "B": B, "depth": depth,
+                              "total_s": round(total, 2),
+                              "imgs_per_s": round(N / total, 1)}), flush=True)
+
+        # D. pipelined depth 2 WITH donation
+        t0 = time.perf_counter()
+        staged = [jax.device_put(c) for c in chunks[:2]]
+        futures = []
+        for i in range(len(chunks)):
+            if i + 2 < len(chunks):
+                staged.append(jax.device_put(chunks[i + 2]))
+            futures.append(jfwd_don(params, staged[0]))
+            staged.pop(0)
+        out = [np.asarray(f) for f in futures]
+        total = time.perf_counter() - t0
+        print(json.dumps({"probe": "pipelined_donate", "B": B,
+                          "total_s": round(total, 2),
+                          "imgs_per_s": round(N / total, 1)}), flush=True)
+
+        # E. pipelined depth 2 with copy_to_host_async after each dispatch
+        t0 = time.perf_counter()
+        staged = [jax.device_put(c) for c in chunks[:2]]
+        futures = []
+        for i in range(len(chunks)):
+            if i + 2 < len(chunks):
+                staged.append(jax.device_put(chunks[i + 2]))
+            f = jfwd(params, staged[0])
+            try:
+                f.copy_to_host_async()
+            except Exception:
+                pass
+            futures.append(f)
+            staged.pop(0)
+        out = [np.asarray(f) for f in futures]
+        total = time.perf_counter() - t0
+        print(json.dumps({"probe": "pipelined_hostasync", "B": B,
+                          "total_s": round(total, 2),
+                          "imgs_per_s": round(N / total, 1)}), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -65,7 +65,7 @@ def test_read_mcap(tmp_path, compression):
     assert out["log_time"] == [100, 150, 200]
     assert out["publish_time"] == [90, 140, 190]
     assert out["sequence"] == [0, 0, 1]
-    assert out["data"] == ["img-a", "pc-a", "img-b"]
+    assert out["data"] == [b"img-a", b"pc-a", b"img-b"]
 
 
 def test_read_mcap_filters(tmp_path):

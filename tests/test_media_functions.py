@@ -357,3 +357,34 @@ def test_temporal_arithmetic_units_match_runtime():
         assert mat[name][0] is not None
     assert repr(out.schema["dd"].dtype) == "Duration[s]"
     assert repr(out.schema["dp"].dtype).startswith("Timestamp")
+
+
+def test_run_process_shell_guard_and_casts():
+    import pytest as _pytest
+
+    import daft_tpu
+    from daft_tpu import col
+    from daft_tpu.datatype import DataType
+    from daft_tpu.functions.media import run_process
+
+    # shell=True with multiple args must raise, not join row data into
+    # shell syntax (ADVICE r2, injection guard — matches reference).
+    with _pytest.raises(ValueError, match="shell=True"):
+        run_process([col("x"), "y"], shell=True)
+
+    df = daft_tpu.from_pydict({"n": ["1", "0"]})
+    out = df.with_column(
+        "b", run_process(["echo", col("n")], return_dtype=DataType.bool())
+    ).to_pydict()
+    assert out["b"] == [True, False]
+    out = df.with_column(
+        "i", run_process(["echo", col("n")], return_dtype=DataType.int16())
+    ).to_pydict()
+    assert out["i"] == [1, 0]
+    # binary stdout must survive byte-exact (no text-mode decode)
+    one = daft_tpu.from_pydict({"x": [1]})
+    out = one.with_column(
+        "raw", run_process(["printf", r"\x89PNG\xff"],
+                           return_dtype=DataType.binary())
+    ).to_pydict()
+    assert out["raw"] == [b"\x89PNG\xff"]

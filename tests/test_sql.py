@@ -407,3 +407,53 @@ def test_sql_values_width_mismatch():
 
     with _pytest.raises(Exception, match="columns"):
         daft_tpu.sql("VALUES (1, 2), (3)")
+
+
+def test_greatest_least_nary():
+    df = daft_tpu.from_pydict({"a": [1, 5, None], "b": [3, 2, 4], "c": [2, 9, 1]})
+    out = daft_tpu.sql(
+        "SELECT GREATEST(a,b,c) g, LEAST(a,b,c) l FROM df", df=df).to_pydict()
+    assert out["g"] == [3, 9, 4]  # NULLs ignored (postgres semantics)
+    assert out["l"] == [1, 2, 1]
+    # wide call must not blow up exponentially (ADVICE r2: 2^n IfElse fold)
+    cols = ",".join(["a", "b", "c"] * 12)
+    daft_tpu.sql(f"SELECT GREATEST({cols}) g FROM df", df=df).collect()
+    # bool args (no arrow elementwise kernel; lowered via uint8)
+    db = daft_tpu.from_pydict({"a": [True, False, None], "b": [False, True, True]})
+    out = daft_tpu.sql("SELECT GREATEST(a,b) g FROM df", df=db).to_pydict()
+    assert out["g"] == [True, True, True]
+    # literal NULL arg is ignored
+    out = daft_tpu.sql("SELECT GREATEST(a, NULL) g FROM df",
+                       df=daft_tpu.from_pydict({"a": [1, 2]})).to_pydict()
+    assert out["g"] == [1, 2]
+
+
+def test_current_timestamp_deferred_and_constant():
+    import datetime
+
+    df = daft_tpu.from_pydict({"i": list(range(400))}).into_partitions(8)
+    out = daft_tpu.sql("SELECT CURRENT_TIMESTAMP t, CURRENT_DATE d FROM df",
+                       df=df).to_pydict()
+    # one instant per statement, even across micropartitions
+    assert len(set(out["t"])) == 1
+    assert len(set(out["d"])) == 1
+    # evaluated at execution time, in UTC
+    now = datetime.datetime.now(datetime.timezone.utc).replace(tzinfo=None)
+    assert abs((now - out["t"][0]).total_seconds()) < 120
+
+
+def test_current_timestamp_constant_under_concurrent_udf():
+    """Executor pool threads must inherit the per-query frozen clock
+    (contextvars don't flow into bare threads without copy_context)."""
+    from daft_tpu import col
+    from daft_tpu.datatype import DataType
+    from daft_tpu.udf import func as udf_func
+
+    @udf_func(return_dtype=DataType.int64(), max_concurrency=4)
+    def bump(x):
+        return (x or 0) + 1
+
+    df = daft_tpu.from_pydict({"i": list(range(4000))}).into_partitions(8)
+    out = (daft_tpu.sql("SELECT i, CURRENT_TIMESTAMP t FROM df", df=df)
+           .with_column("j", bump(col("i"))).to_pydict())
+    assert len(set(out["t"])) == 1
