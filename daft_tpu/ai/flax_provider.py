@@ -62,56 +62,128 @@ def load_checkpoint(path: str, params):
     return load_params(path, params)
 
 
+# Phase breakdown of the most recent _chunked_forward call (seconds).
+# bench.py publishes this split (VERDICT r3 ask: device_put / forward+fetch
+# per chunk) so perf regressions are attributable.
+LAST_FORWARD_STATS: Dict[str, float] = {}
+
+
 def _chunked_forward(fwd, params, arr: np.ndarray, max_batch: int, out_dim: int,
-                     stage_ahead: int = 2) -> np.ndarray:
-    """Chunk to max_batch and run with explicit double-buffered staging:
-    `device_put` the next `stage_ahead` chunks BEFORE dispatching each
-    forward, so host->HBM transfers (the bottleneck behind a tunnel —
-    ~25-30MB/s measured on axon, with high variance) overlap the current
-    chunk's compute. All dispatch is async and single-threaded (threaded
-    device_put deadlocks on axon); device->host copies of each result start
-    asynchronously right after dispatch (the final gather then hits the host
-    cache instead of paying a ~130ms round trip per chunk). stage_ahead
-    stays shallow on purpose — queuing hundreds of MB of transfers degrades
-    the tunnel's effective bandwidth. Empty input short-circuits."""
+                     stage=None, pad_mult: int = 1) -> np.ndarray:
+    """Chunk to max_batch and run a SHALLOW software pipeline: dispatch the
+    forward for chunk i, stage chunk i+1 while it computes, then immediately
+    fetch chunk i's result.
+
+    Measured on the axon tunnel (scripts/perf_probe2/3/4/5.py, r3): each
+    dispatched executable costs ~1-2s of fixed runtime overhead nearly
+    independent of batch size, so LARGE chunks win (B=1024 ≈ 460 img/s e2e
+    vs B=256 ≈ 130); and queuing many async ops ahead DEGRADES the tunnel
+    3-4x (pipelined depth 2-4 ≈ 155-190 img/s vs shallow ≈ 415-460), so the
+    pipeline stays exactly one transfer deep and every result is fetched
+    (np.asarray) before the next dispatch. Empty input short-circuits."""
+    import time as _time
+
     n = arr.shape[0]
     if n == 0:
         return np.zeros((0, out_dim), dtype=np.float32)
+    if stage is None:
+        stage = jax.device_put
     chunks = []
     for start in range(0, n, max_batch):
         chunk = arr[start:start + max_batch]
         b = _bucket(min(len(chunk), max_batch))
+        if b % pad_mult:  # dp-sharded batches must divide the dp axis
+            b = ((b + pad_mult - 1) // pad_mult) * pad_mult
         chunks.append((len(chunk), chunk, b))
-    staged: List[Any] = [None] * len(chunks)
-    futures = []
-    for i, (cn, chunk, b) in enumerate(chunks):
-        # Keep the transfer pipeline `stage_ahead` chunks deep.
-        for j in range(i, min(i + stage_ahead, len(chunks))):
-            if staged[j] is None:
-                jn, jc, jb = chunks[j]
-                staged[j] = jax.device_put(_pad_batch(jc, jb))
+    stats = {"stage_s": 0.0, "fwd_fetch_s": 0.0, "chunks": len(chunks),
+             "rows": n}
+    # Stage ALL chunks before any compute: interleaving transfers with a
+    # running computation degrades the tunnel (measured: interleaved ≈ 7.3s
+    # per 1024-chunk vs 2.2s with clean separation). The staging window is
+    # bounded by the engine's UDF morsel size.
+    t0 = _time.perf_counter()
+    staged = [stage(_pad_batch(c, b)) for _, c, b in chunks]
+    for s in staged:
+        s.block_until_ready()
+    stats["stage_s"] = _time.perf_counter() - t0
+    outs = []
+    t0 = _time.perf_counter()
+    for i, (cn, _, _) in enumerate(chunks):
         f = fwd(params, staged[i])
-        try:
-            f.copy_to_host_async()
-        except Exception:
-            pass
-        futures.append((cn, f))
-        staged[i] = None  # release our reference; donation frees HBM
-    outs = [np.asarray(f)[:cn] for cn, f in futures]
+        staged[i] = None  # free the HBM reference once consumed
+        outs.append(np.asarray(f)[:cn])  # forces + fetches chunk i
+    stats["fwd_fetch_s"] = _time.perf_counter() - t0
+    LAST_FORWARD_STATS.clear()
+    LAST_FORWARD_STATS.update(stats)
     return np.concatenate(outs, axis=0) if len(outs) > 1 else outs[0]
 
 
 class _FlaxModelBase:
-    """Holds params on device; one instance per worker process (libtpu
-    single-owner: the UDF actor pool gives each chip one process)."""
+    """Holds params on device; one instance per replica slot (libtpu
+    single-owner: the UDF actor pool gives each chip one process, and with
+    ``chips_per_replica`` each instance owns an ICI mesh slice)."""
 
     def __init__(self):
         self._lock = threading.Lock()
+        self.mesh = None
+        self._param_specs = None
+
+    def setup_mesh(self, mesh_axes: Optional[Dict[str, int]] = None):
+        """Build this replica's mesh over its device slot.
+
+        ``mesh_axes`` e.g. ``{"dp": 2, "tp": 4}`` (-1 absorbs the rest);
+        default is pure data parallel over the replica's chips. Single-chip
+        replicas stay mesh-less (plain jit).
+        """
+        from daft_tpu.parallel.replica import replica_devices
+
+        devs = replica_devices()
+        if len(devs) <= 1 and not mesh_axes:
+            return None
+        from daft_tpu.parallel.mesh import make_mesh
+
+        self.mesh = make_mesh(dict(mesh_axes or {"dp": -1}), devices=devs)
+        return self.mesh
+
+    def place_params(self, params):
+        """Shard params onto the mesh (tp rules when a "tp" axis exists,
+        replicated otherwise); plain device_put without a mesh."""
+        if self.mesh is None:
+            return jax.device_put(params)
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from daft_tpu.parallel.mesh import DEFAULT_TP_RULES, match_partition_rules
+
+        if "tp" in self.mesh.axis_names:
+            specs = match_partition_rules(DEFAULT_TP_RULES, params, self.mesh)
+        else:
+            specs = jax.tree_util.tree_map(lambda _: P(), params)
+        self._param_specs = specs
+        return jax.tree_util.tree_map(
+            lambda x, s: jax.device_put(x, NamedSharding(self.mesh, s)),
+            params, specs)
+
+    def stage_batch(self, arr):
+        """Put one padded host batch onto the device(s): dp-sharded along
+        axis 0 when a mesh with a "dp" axis exists."""
+        if self.mesh is None or "dp" not in self.mesh.axis_names:
+            return jax.device_put(arr)
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        spec = P("dp", *([None] * (arr.ndim - 1)))
+        return jax.device_put(arr, NamedSharding(self.mesh, spec))
+
+    def batch_multiple(self) -> int:
+        """Padded batches must divide evenly across the dp axis."""
+        if self.mesh is None or "dp" not in self.mesh.axis_names:
+            return 1
+        return int(self.mesh.shape["dp"])
 
 
 class FlaxCLIPImageEmbedder(_FlaxModelBase):
     def __init__(self, model_name: str, weights_path: Optional[str] = None,
-                 dtype=jnp.bfloat16, seed: int = 0, batch_size: int = 128):
+                 dtype=jnp.bfloat16, seed: int = 0, batch_size: int = 128,
+                 mesh_axes: Optional[Dict[str, int]] = None):
         super().__init__()
         from daft_tpu.models.clip import CLIPConfig, init_clip_params, load_params
 
@@ -121,17 +193,18 @@ class FlaxCLIPImageEmbedder(_FlaxModelBase):
             self.model, params = load_params(weights_path, self.cfg)
         else:
             self.model, params = init_clip_params(self.cfg, seed)
-        self.params = jax.device_put(params)
+        # Multi-chip replica: params shard over this replica's mesh slice
+        # (tp rules when requested, replicated for pure dp) and batches
+        # dp-shard along axis 0; single-chip replicas keep plain jit.
+        self.setup_mesh(mesh_axes)
+        self.params = self.place_params(params)
         model = self.model
 
         def fwd(p, pixels):
             emb = model.apply(p, pixels, method=model.encode_image)
             return emb / jnp.linalg.norm(emb, axis=-1, keepdims=True).clip(1e-6)
 
-        # Donate the pixel buffer: each staged uint8 batch is used exactly
-        # once, so XLA can free/reuse its HBM as soon as the forward reads it
-        # (keeps the staging window's footprint bounded).
-        self._fwd = jax.jit(fwd, donate_argnums=(1,))
+        self._fwd = jax.jit(fwd)
 
     @property
     def dimensions(self) -> int:
@@ -148,7 +221,9 @@ class FlaxCLIPImageEmbedder(_FlaxModelBase):
         n = images.shape[0]
         if images.ndim == 2:
             images = images.reshape(n, self.cfg.image_size, self.cfg.image_size, 3)
-        return _chunked_forward(self._fwd, self.params, images, self.max_batch, self.cfg.embed_dim)
+        return _chunked_forward(self._fwd, self.params, images, self.max_batch,
+                                self.cfg.embed_dim, stage=self.stage_batch,
+                                pad_mult=self.batch_multiple())
 
 
 class FlaxCLIPTextEmbedder(_FlaxModelBase):
@@ -306,6 +381,7 @@ class _FlaxDescriptor(Descriptor):
             batch_size=self.options.get("batch_size", 256),
             max_concurrency=self.options.get("max_concurrency", 1),
             tpus=self.options.get("tpus", 1.0),
+            chips_per_replica=self.options.get("chips_per_replica"),
         )
 
     def get_dimensions(self) -> Optional[int]:
@@ -326,6 +402,7 @@ class _FlaxDescriptor(Descriptor):
         if self.kind == "image_embedder":
             kw = {k: v for k, v in opts.items() if k in ("weights_path", "seed")}
             kw["batch_size"] = self.options.get("batch_size", 128)
+            kw["mesh_axes"] = self.options.get("mesh_axes")
             return FlaxCLIPImageEmbedder(self.model, **kw)
         if self.kind == "text_embedder":
             if "clip" in self.model.lower() or "vit" in self.model.lower():

@@ -28,6 +28,10 @@ class UDFOptions:
     cpus: Optional[float] = None
     memory_bytes: Optional[int] = None
     use_process: bool = False
+    # >1: each replica owns an ICI mesh slice of this many chips and the
+    # provider shards its params/batches over it (parallel/replica.py) — the
+    # TPU generalisation of the reference's gpus_per_actor.
+    chips_per_replica: Optional[int] = None
 
 
 @runtime_checkable

@@ -265,6 +265,20 @@ class Executor:
                 udf = n.udf
                 break
         concurrency = max(1, getattr(udf, "max_concurrency", None) or 1)
+        # chips_per_replica: partition visible chips into replica slots; each
+        # concurrent morsel evaluation owns one slot's ICI mesh slice
+        # (reference: gpus_per_actor on the vLLM expr + GPU-slot pinning in
+        # intermediate_ops/udf.rs:391-406; SURVEY §7.8).
+        slots = None
+        cpr = getattr(udf, "chips_per_replica", None)
+        if cpr:
+            from daft_tpu.parallel.replica import ReplicaSlots
+
+            slots = ReplicaSlots(cpr)
+            if getattr(udf, "max_concurrency", None) is None:
+                concurrency = slots.num_replicas
+            else:
+                concurrency = min(concurrency, slots.num_replicas)
         exprs = node.passthrough + [node.udf_expr]
         # Re-morselize so oversized in-memory partitions don't reach the UDF
         # as one giant batch (bounds host memory + enables replica
@@ -274,9 +288,11 @@ class Executor:
         udf_bs = getattr(udf, "batch_size", None)
         morsel_rows = udf_bs * 16 if udf_bs else self.cfg.default_morsel_size
         child_iter = _remorsel(self._run(node.children[0]), min(morsel_rows, self.cfg.default_morsel_size))
+        eval_mp = (lambda mp: slots.run(mp.eval_expression_list, exprs)) if slots \
+            else (lambda mp: mp.eval_expression_list(exprs))
         if concurrency == 1:
             for mp in child_iter:
-                yield mp.eval_expression_list(exprs)
+                yield eval_mp(mp)
             return
         # Ordered concurrent map over morsels (actor-pool analogue). The
         # bounded queue's blocking put is the backpressure; a stop flag lets
@@ -291,8 +307,7 @@ class Executor:
         def submit_all():
             try:
                 for mp in child_iter:
-                    fut = pool.submit(ambient.copy().run,
-                                      mp.eval_expression_list, exprs)
+                    fut = pool.submit(ambient.copy().run, eval_mp, mp)
                     while not stop.is_set():
                         try:
                             inflight.put(fut, timeout=0.1)

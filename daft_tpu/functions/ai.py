@@ -33,7 +33,7 @@ class _ProtocolUdf(Udf):
     def __init__(self, descriptor, call, return_dtype: DataType, name: str):
         self._descriptor = descriptor
         self._call = call
-        self._instance = None
+        self._instances = {}
         self._instance_lock = threading.Lock()
         udf_opts = descriptor.get_udf_options()
 
@@ -51,20 +51,29 @@ class _ProtocolUdf(Udf):
             cpus=udf_opts.cpus, tpus=udf_opts.tpus,
             memory_bytes=udf_opts.memory_bytes,
             batch_size=udf_opts.batch_size, use_process=udf_opts.use_process,
+            chips_per_replica=udf_opts.chips_per_replica,
         )
 
     def _get_instance(self):
-        if self._instance is None:
+        # One model instance PER REPLICA SLOT: with chips_per_replica the
+        # executor runs each morsel inside a replica_scope, and the instance
+        # created there holds its params on that slot's mesh slice.
+        from daft_tpu.parallel.replica import replica_id
+
+        rid = replica_id()
+        inst = self._instances.get(rid)
+        if inst is None:
             with self._instance_lock:
-                if self._instance is None:
-                    self._instance = self._descriptor.instantiate()
-        return self._instance
+                inst = self._instances.get(rid)
+                if inst is None:
+                    inst = self._instances[rid] = self._descriptor.instantiate()
+        return inst
 
     def __getstate__(self):
-        # Cross-process shipping: drop the lock and the live model instance —
+        # Cross-process shipping: drop the lock and the live model instances —
         # each worker process re-instantiates (params must live in ITS HBM).
         state = self.__dict__.copy()
-        state["_instance"] = None
+        state["_instances"] = {}
         state.pop("_instance_lock", None)
         return state
 
@@ -72,7 +81,7 @@ class _ProtocolUdf(Udf):
         import threading
 
         self.__dict__.update(state)
-        self._instance = None
+        self._instances = {}
         self._instance_lock = threading.Lock()
 
 

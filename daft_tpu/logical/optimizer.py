@@ -954,6 +954,20 @@ class ReorderJoins(Rule):
             rel = rel.children()[0]
         if not isinstance(rel, lp.InMemorySource):
             return None
+        # Memoize on the source NODE: DataFrames keep their InMemorySource
+        # alive across queries, so a workload touching the same table many
+        # times (e.g. a TPC-H suite) measures each key space once.
+        key = tuple(e.name_ for e in exprs)
+        cache = getattr(rel, "_ndv_cache", None)
+        if cache is None:
+            cache = rel._ndv_cache = {}
+        if key in cache:
+            return cache[key]
+        cache[key] = out = ReorderJoins._ndv_measure(rel, exprs)
+        return out
+
+    @staticmethod
+    def _ndv_measure(rel, exprs) -> Optional[float]:
         total_rows = sum(len(p) for p in rel.partitions)
         if total_rows == 0 or total_rows > 5_000_000:
             return None

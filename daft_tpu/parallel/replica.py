@@ -1,0 +1,85 @@
+"""Replica device slots: the engine-side half of ``chips_per_replica``.
+
+The reference pins UDF actor replicas to GPU slots via ``CUDA_VISIBLE_DEVICES``
+(src/daft-local-execution/src/intermediate_ops/udf.rs:391-406,
+daft/runners/flotilla.py:177-180). On TPU a replica instead OWNS an ICI mesh
+slice: the UDFProject operator partitions the visible chips into
+``chips_per_replica``-sized groups, and each morsel evaluation runs inside a
+:func:`replica_scope` naming its group. Model providers read
+:func:`replica_devices` at instantiation time and build their
+``jax.sharding.Mesh`` over exactly those chips (see
+``flax_provider._FlaxModelBase.setup_mesh``), so tensor/data-parallel
+inference works per replica with no global state.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import queue
+from typing import List, Optional, Sequence, Tuple
+
+_replica_slot: contextvars.ContextVar[Optional[Tuple[int, tuple]]] = \
+    contextvars.ContextVar("daft_replica_slot", default=None)
+
+
+def replica_devices() -> list:
+    """Devices owned by the current replica (all visible devices outside a
+    replica scope — single-replica UDFs see the whole host)."""
+    slot = _replica_slot.get()
+    if slot is not None:
+        return list(slot[1])
+    import jax
+
+    return jax.devices()
+
+
+def replica_id() -> int:
+    """Stable id of the current replica slot (0 outside a scope)."""
+    slot = _replica_slot.get()
+    return slot[0] if slot is not None else 0
+
+
+@contextlib.contextmanager
+def replica_scope(idx: int, devices: Sequence):
+    token = _replica_slot.set((idx, tuple(devices)))
+    try:
+        yield
+    finally:
+        _replica_slot.reset(token)
+
+
+class ReplicaSlots:
+    """Partition visible devices into ``chips_per_replica`` groups and lend
+    them to morsel evaluations (the actor-pool slot queue).
+
+    With R groups, at most R morsels evaluate concurrently; each runs inside
+    a :func:`replica_scope` for its group, so the provider instance it
+    lazily creates lives on that group's chips for the worker's lifetime.
+    """
+
+    def __init__(self, chips_per_replica: int, devices: Optional[list] = None):
+        import jax
+
+        devs = list(devices if devices is not None else jax.devices())
+        cpr = max(1, int(chips_per_replica))
+        n = max(1, len(devs) // cpr)
+        self.groups: List[tuple] = [
+            tuple(devs[i * cpr:(i + 1) * cpr]) for i in range(n)
+        ]
+        self._free: "queue.Queue[int]" = queue.Queue()
+        for i in range(n):
+            self._free.put(i)
+
+    @property
+    def num_replicas(self) -> int:
+        return len(self.groups)
+
+    def run(self, fn, *args, **kwargs):
+        """Run ``fn`` holding one replica slot (blocks until a slot frees)."""
+        idx = self._free.get()
+        try:
+            with replica_scope(idx, self.groups[idx]):
+                return fn(*args, **kwargs)
+        finally:
+            self._free.put(idx)

@@ -33,7 +33,8 @@ class Udf:
                  cpus: Optional[float] = None, gpus: Optional[float] = None,
                  tpus: Optional[float] = None, memory_bytes: Optional[int] = None,
                  max_retries: int = 0, on_error: str = "raise",
-                 batch_size: Optional[int] = None, use_process: bool = False):
+                 batch_size: Optional[int] = None, use_process: bool = False,
+                 chips_per_replica: Optional[int] = None):
         self.fn = fn
         self.return_dtype = return_dtype
         self.batch = batch
@@ -47,6 +48,9 @@ class Udf:
         self.on_error = on_error
         self.batch_size = batch_size
         self.use_process = use_process
+        # TPU generalisation of the reference's gpus_per_actor: each replica
+        # owns an ICI mesh slice of this many chips (parallel/replica.py).
+        self.chips_per_replica = chips_per_replica
         functools.update_wrapper(self, fn)
 
     def __call__(self, *args, **kwargs) -> Expression:

@@ -1,0 +1,85 @@
+"""A/B/C: same data, same process — raw jit loop vs FlaxCLIPImageEmbedder vs
+the full engine path. Finds which layer adds overhead."""
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+
+def main() -> None:
+    import jax
+
+    N, B = 4096, 1024
+    rng = np.random.default_rng(0)
+    imgs = rng.integers(0, 255, (N, 224, 224, 3), dtype=np.uint8)
+
+    # --- C: engine path FIRST (so any warmup asymmetry favours the raw loop
+    # comparison afterwards, not the engine) -----------------------------
+    import daft_tpu
+    from daft_tpu import col
+    from daft_tpu.ai import flax_provider as fp
+    from daft_tpu.datatype import DataType
+    from daft_tpu.functions.ai import embed_image
+
+    series = daft_tpu.Series.from_numpy(
+        imgs.reshape(N, -1), "img", DataType.image("RGB", 224, 224))
+    df = daft_tpu.from_pydict({"img": series})
+    expr = embed_image(col("img"), provider="flax_random", model="ViT-L/14",
+                       batch_size=B)
+    with daft_tpu.execution_config_ctx(default_morsel_size=N):
+        warm = df.limit(B).with_column("emb", expr)
+        warm.collect()
+        t0 = time.perf_counter()
+        out = df.with_column("emb", expr).select("emb")
+        total = sum(len(p) for p in out.iter_partitions())
+        engine_s = time.perf_counter() - t0
+    print(json.dumps({"probe": "engine", "s": round(engine_s, 2),
+                      "imgs_per_s": round(N / engine_s, 1),
+                      "stats": {k: round(v, 2) if isinstance(v, float) else v
+                                for k, v in fp.LAST_FORWARD_STATS.items()}}),
+          flush=True)
+
+    # --- B: provider class directly (no engine) -------------------------
+    from daft_tpu.ai.flax_provider import FlaxCLIPImageEmbedder
+
+    emb = FlaxCLIPImageEmbedder("ViT-L/14", batch_size=B)
+    emb.embed_image(imgs[:B])  # warm
+    t0 = time.perf_counter()
+    out = emb.embed_image(imgs)
+    provider_s = time.perf_counter() - t0
+    print(json.dumps({"probe": "provider", "s": round(provider_s, 2),
+                      "imgs_per_s": round(N / provider_s, 1),
+                      "rows": int(out.shape[0]),
+                      "stats": {k: round(v, 2) if isinstance(v, float) else v
+                                for k, v in fp.LAST_FORWARD_STATS.items()}}),
+          flush=True)
+
+    # --- A: raw loop (probe5 pattern) -----------------------------------
+    import jax.numpy as jnp
+
+    from daft_tpu.models.clip import CLIPConfig, init_clip_params
+
+    cfg = CLIPConfig.from_name("ViT-L/14")
+    model, params = init_clip_params(cfg, 0)
+    params = jax.device_put(params)
+
+    def fwd(p, pixels):
+        e = model.apply(p, pixels, method=model.encode_image)
+        return e / jnp.linalg.norm(e, axis=-1, keepdims=True).clip(1e-6)
+
+    jfwd = jax.jit(fwd)
+    jfwd(params, jax.device_put(imgs[:B])).block_until_ready()  # warm
+    t0 = time.perf_counter()
+    staged = [jax.device_put(imgs[i:i + B]) for i in range(0, N, B)]
+    for s in staged:
+        s.block_until_ready()
+    outs = [np.asarray(jfwd(params, s)) for s in staged]
+    raw_s = time.perf_counter() - t0
+    print(json.dumps({"probe": "raw", "s": round(raw_s, 2),
+                      "imgs_per_s": round(N / raw_s, 1)}), flush=True)
+
+
+if __name__ == "__main__":
+    main()

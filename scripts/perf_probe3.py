@@ -1,0 +1,87 @@
+"""Serial-blocking variants of the chunked forward, WITH result fetch.
+
+The phase probe showed async queuing degrades the axon tunnel 3-4x while a
+fully serial put/fwd loop hit 630 img/s — but it never fetched outputs.
+This probe measures honest end-to-end variants including d2h of embeddings.
+"""
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from daft_tpu.models.clip import CLIPConfig, init_clip_params
+
+    rng = np.random.default_rng(0)
+    cfg = CLIPConfig.from_name("ViT-L/14")
+    model, params = init_clip_params(cfg, 0)
+    params = jax.device_put(params)
+
+    def fwd(p, pixels):
+        emb = model.apply(p, pixels, method=model.encode_image)
+        return emb / jnp.linalg.norm(emb, axis=-1, keepdims=True).clip(1e-6)
+
+    jfwd = jax.jit(fwd)
+
+    N = 3072
+    imgs = rng.integers(0, 255, (N, 224, 224, 3), dtype=np.uint8)
+
+    for B in (256, 512):
+        chunks = [imgs[i:i + B] for i in range(0, N, B)]
+        w = jax.device_put(chunks[0])
+        jfwd(params, w).block_until_ready()
+        del w
+
+        # A. fully serial with fetch: put.block -> fwd.block -> asarray
+        t0 = time.perf_counter()
+        outs = []
+        for c in chunks:
+            d = jax.device_put(c)
+            d.block_until_ready()
+            r = jfwd(params, d)
+            r.block_until_ready()
+            outs.append(np.asarray(r))
+        total = time.perf_counter() - t0
+        print(json.dumps({"probe": "serial_fetch", "B": B,
+                          "total_s": round(total, 2),
+                          "imgs_per_s": round(N / total, 1),
+                          "rows": sum(len(o) for o in outs)}), flush=True)
+
+        # B. serial but without intermediate blocks (put -> fwd -> asarray)
+        t0 = time.perf_counter()
+        outs = []
+        for c in chunks:
+            r = jfwd(params, jax.device_put(c))
+            outs.append(np.asarray(r))
+        total = time.perf_counter() - t0
+        print(json.dumps({"probe": "serial_noblock_fetch", "B": B,
+                          "total_s": round(total, 2),
+                          "imgs_per_s": round(N / total, 1)}), flush=True)
+
+        # C. depth-1 software pipeline with blocking puts: while chunk i
+        # computes, put chunk i+1 (blocking), then fetch i.
+        t0 = time.perf_counter()
+        outs = []
+        d = jax.device_put(chunks[0])
+        d.block_until_ready()
+        for i in range(len(chunks)):
+            r = jfwd(params, d)  # async dispatch
+            if i + 1 < len(chunks):
+                d = jax.device_put(chunks[i + 1])
+                d.block_until_ready()  # transfer while fwd computes
+            outs.append(np.asarray(r))  # forces fwd
+        total = time.perf_counter() - t0
+        print(json.dumps({"probe": "pipe1_blockput_fetch", "B": B,
+                          "total_s": round(total, 2),
+                          "imgs_per_s": round(N / total, 1)}), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,67 @@
+"""Honest TPU compute measurement: distinct pre-staged inputs, per-phase
+timing, separating dispatch / block / fetch. Defeats any runtime caching of
+(executable, input) pairs that polluted earlier probes.
+"""
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from daft_tpu.models.clip import CLIPConfig, init_clip_params
+
+    rng = np.random.default_rng(0)
+    cfg = CLIPConfig.from_name("ViT-L/14")
+    model, params = init_clip_params(cfg, 0)
+    params = jax.device_put(params)
+
+    def fwd(p, pixels):
+        emb = model.apply(p, pixels, method=model.encode_image)
+        return emb / jnp.linalg.norm(emb, axis=-1, keepdims=True).clip(1e-6)
+
+    jfwd = jax.jit(fwd)
+
+    for B, reps in ((256, 8), (512, 4)):
+        batches = [rng.integers(0, 255, (B, 224, 224, 3), dtype=np.uint8)
+                   for _ in range(reps)]
+        # stage all inputs on device first
+        t0 = time.perf_counter()
+        staged = [jax.device_put(b) for b in batches]
+        for s in staged:
+            s.block_until_ready()
+        stage_s = time.perf_counter() - t0
+        # warm compile
+        jfwd(params, staged[0]).block_until_ready()
+
+        # per-batch: dispatch+block on DISTINCT inputs
+        fwd_times = []
+        results = []
+        for s in staged:
+            t0 = time.perf_counter()
+            r = jfwd(params, s)
+            r.block_until_ready()
+            fwd_times.append(time.perf_counter() - t0)
+            results.append(r)
+        # fetch each result AFTER all compute done
+        fetch_times = []
+        for r in results:
+            t0 = time.perf_counter()
+            np.asarray(r)
+            fetch_times.append(time.perf_counter() - t0)
+        print(json.dumps({
+            "probe": "honest", "B": B,
+            "stage_s_per_batch": round(stage_s / reps, 3),
+            "fwd_s": [round(t, 3) for t in fwd_times],
+            "fetch_s": [round(t, 3) for t in fetch_times],
+            "compute_imgs_per_s": round(B / float(np.median(fwd_times)), 1),
+        }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

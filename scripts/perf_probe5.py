@@ -1,0 +1,55 @@
+"""Batch-size sweep for per-dispatch overhead amortization on axon."""
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from daft_tpu.models.clip import CLIPConfig, init_clip_params
+
+    rng = np.random.default_rng(0)
+    cfg = CLIPConfig.from_name("ViT-L/14")
+    model, params = init_clip_params(cfg, 0)
+    params = jax.device_put(params)
+
+    def fwd(p, pixels):
+        emb = model.apply(p, pixels, method=model.encode_image)
+        return emb / jnp.linalg.norm(emb, axis=-1, keepdims=True).clip(1e-6)
+
+    jfwd = jax.jit(fwd)
+
+    for B, reps in ((1024, 3), (2048, 2)):
+        batches = [rng.integers(0, 255, (B, 224, 224, 3), dtype=np.uint8)
+                   for _ in range(reps)]
+        t0 = time.perf_counter()
+        staged = [jax.device_put(b) for b in batches]
+        for s in staged:
+            s.block_until_ready()
+        stage_s = time.perf_counter() - t0
+        jfwd(params, staged[0]).block_until_ready()  # compile
+
+        # end-to-end per batch: dispatch -> fetch (fetch forces completion)
+        e2e = []
+        for s in staged:
+            t0 = time.perf_counter()
+            r = jfwd(params, s)
+            out = np.asarray(r)
+            e2e.append(time.perf_counter() - t0)
+        print(json.dumps({
+            "probe": "bigbatch", "B": B,
+            "stage_s_per_batch": round(stage_s / reps, 2),
+            "e2e_s": [round(t, 2) for t in e2e],
+            "imgs_per_s_e2e_best": round(B / min(e2e), 1),
+            "imgs_per_s_incl_stage": round(
+                B / (min(e2e) + stage_s / reps), 1),
+        }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,108 @@
+"""Profile hot TPC-H queries at SF1 with cProfile.
+
+Usage: python scripts/profile_tpch.py [q21 q18 ...]
+"""
+from __future__ import annotations
+
+import cProfile
+import io
+import pstats
+import sys
+import time
+
+sys.path.insert(0, "/root/repo")
+sys.path.insert(0, "/root/repo/tests")
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import daft_tpu  # noqa: E402
+from benchmarks.tpch_dbgen import generate_tpch_dbgen  # noqa: E402
+
+
+def _load_queries() -> dict:
+    """Extract the exact SQL from tests/benchmarks/test_tpch_full.py."""
+    import re
+
+    src = open("/root/repo/tests/benchmarks/test_tpch_full.py").read()
+    return dict(re.findall(r'run\("(q\d+)", """(.*?)"""', src, re.S))
+
+
+UNUSED_QUERIES = {
+    "q09": """
+      SELECT nation, o_year, sum(amount) AS sum_profit FROM (
+        SELECT n_name AS nation, EXTRACT(year FROM o_orderdate) AS o_year,
+               l_extendedprice * (1 - l_discount) - ps_supplycost * l_quantity AS amount
+        FROM part, supplier, lineitem, partsupp, orders, nation
+        WHERE s_suppkey = l_suppkey AND ps_suppkey = l_suppkey
+          AND ps_partkey = l_partkey AND p_partkey = l_partkey
+          AND o_orderkey = l_orderkey AND s_nationkey = n_nationkey
+          AND p_name LIKE '%green%') profit
+      GROUP BY nation, o_year ORDER BY nation, o_year DESC
+    """,
+    "q18": """
+      SELECT c_name, c_custkey, o_orderkey, o_orderdate, o_totalprice,
+             sum(l_quantity) AS sum_qty
+      FROM customer, orders, lineitem
+      WHERE o_orderkey IN (
+          SELECT l_orderkey FROM lineitem GROUP BY l_orderkey
+          HAVING sum(l_quantity) > 300)
+        AND c_custkey = o_custkey AND o_orderkey = l_orderkey
+      GROUP BY c_name, c_custkey, o_orderkey, o_orderdate, o_totalprice
+      ORDER BY o_totalprice DESC, o_orderdate LIMIT 100
+    """,
+    "q21": """
+      SELECT s_name, count(*) AS numwait
+      FROM supplier, lineitem l1, orders, nation
+      WHERE s_suppkey = l1.l_suppkey AND o_orderkey = l1.l_orderkey
+        AND o_orderstatus = 'F' AND l1.l_receiptdate > l1.l_commitdate
+        AND EXISTS (SELECT * FROM lineitem l2 WHERE l2.l_orderkey = l1.l_orderkey
+                    AND l2.l_suppkey <> l1.l_suppkey)
+        AND NOT EXISTS (SELECT * FROM lineitem l3 WHERE l3.l_orderkey = l1.l_orderkey
+                        AND l3.l_suppkey <> l1.l_suppkey
+                        AND l3.l_receiptdate > l3.l_commitdate)
+        AND s_nationkey = n_nationkey AND n_name = 'SAUDI ARABIA'
+      GROUP BY s_name ORDER BY numwait DESC, s_name LIMIT 100
+    """,
+    "q05": """
+      SELECT n_name, sum(l_extendedprice * (1 - l_discount)) AS revenue
+      FROM customer, orders, lineitem, supplier, nation, region
+      WHERE c_custkey = o_custkey AND l_orderkey = o_orderkey
+        AND l_suppkey = s_suppkey AND c_nationkey = s_nationkey
+        AND s_nationkey = n_nationkey AND n_regionkey = r_regionkey
+        AND r_name = 'ASIA' AND o_orderdate >= DATE '1994-01-01'
+        AND o_orderdate < DATE '1995-01-01'
+      GROUP BY n_name ORDER BY revenue DESC
+    """,
+}
+
+
+def main() -> None:
+    names = sys.argv[1:] or ["q21"]
+    queries = _load_queries()
+    t0 = time.perf_counter()
+    T = generate_tpch_dbgen(1.0)
+    print(f"datagen: {time.perf_counter() - t0:.1f}s", file=sys.stderr)
+
+    for name in names:
+        q = queries[name]
+        # warm run (plan caches, imports)
+        t0 = time.perf_counter()
+        daft_tpu.sql(q, **T).to_pandas()
+        warm = time.perf_counter() - t0
+        pr = cProfile.Profile()
+        t0 = time.perf_counter()
+        pr.enable()
+        daft_tpu.sql(q, **T).to_pandas()
+        pr.disable()
+        wall = time.perf_counter() - t0
+        s = io.StringIO()
+        ps = pstats.Stats(pr, stream=s).sort_stats("cumulative")
+        ps.print_stats(25)
+        print(f"=== {name}: wall {wall:.2f}s (first run {warm:.2f}s) ===")
+        print("\n".join(s.getvalue().splitlines()[:45]))
+
+
+if __name__ == "__main__":
+    main()
