@@ -62,25 +62,78 @@ def load_checkpoint(path: str, params):
     return load_params(path, params)
 
 
-# Phase breakdown of the most recent _chunked_forward call (seconds).
-# bench.py publishes this split (VERDICT r3 ask: device_put / forward+fetch
-# per chunk) so perf regressions are attributable.
+# Phase breakdown of the most recent _chunked_forward call (seconds),
+# DIAGNOSTICS ONLY: instances record their own split in
+# ``self.last_forward_stats``; this module-level mirror is lock-protected and
+# only meaningful when a single replica runs (e.g. bench.py).
 LAST_FORWARD_STATS: Dict[str, float] = {}
+_STATS_LOCK = threading.Lock()
+
+# Staging-mode probe result, cached per process (the h2d path does not change
+# within a process lifetime).
+_STAGING_PROBE: Optional[str] = None
+_PROBE_LOCK = threading.Lock()
+
+
+def resolve_staging_mode(requested: Optional[str] = None) -> str:
+    """Pick the h2d staging policy: ``overlap`` (depth-1 software pipeline,
+    right for real PCIe hosts where transfer/compute overlap wins) or
+    ``separated`` (stage every chunk, then compute — right for degraded
+    transports like the axon dev tunnel, where interleaving transfers with a
+    running computation slows both, measured r3 at ~3x).
+
+    ``requested`` may be "overlap" / "separated" / "auto" / None; env var
+    ``DAFT_STAGING_MODE`` overrides. "auto" probes first-touch h2d bandwidth
+    once per process: < 1 GB/s means a tunnel-class transport -> separated.
+    """
+    import os
+
+    req = os.environ.get("DAFT_STAGING_MODE") or requested or "auto"
+    if req in ("overlap", "separated"):
+        return req
+    if req != "auto":
+        raise DaftValueError(f"staging_mode must be overlap|separated|auto, got {req!r}")
+    global _STAGING_PROBE
+    if _STAGING_PROBE is not None:
+        return _STAGING_PROBE
+    with _PROBE_LOCK:
+        if _STAGING_PROBE is not None:
+            return _STAGING_PROBE
+        import logging
+        import time as _time
+
+        dev = jax.devices()[0]
+        if dev.platform == "cpu":
+            mode, bw = "overlap", float("inf")  # no transfer: overlap is free
+        else:
+            probe = np.zeros((32 << 20,), dtype=np.uint8)  # 32 MB first-touch
+            t0 = _time.perf_counter()
+            jax.device_put(probe, dev).block_until_ready()
+            bw = 32.0 / max(_time.perf_counter() - t0, 1e-9)  # MB/s
+            mode = "separated" if bw < 1000.0 else "overlap"
+        logging.getLogger("daft_tpu.ai").info(
+            "staging probe: h2d %.0f MB/s -> mode=%s", bw, mode)
+        _STAGING_PROBE = mode
+        return mode
 
 
 def _chunked_forward(fwd, params, arr: np.ndarray, max_batch: int, out_dim: int,
-                     stage=None, pad_mult: int = 1) -> np.ndarray:
-    """Chunk to max_batch and run a SHALLOW software pipeline: dispatch the
-    forward for chunk i, stage chunk i+1 while it computes, then immediately
-    fetch chunk i's result.
+                     stage=None, pad_mult: int = 1, mode: str = "separated",
+                     stats_out: Optional[Dict[str, float]] = None) -> np.ndarray:
+    """Chunk to max_batch and run the forwards under the given staging policy.
 
-    Measured on the axon tunnel (scripts/perf_probe2/3/4/5.py, r3): each
-    dispatched executable costs ~1-2s of fixed runtime overhead nearly
-    independent of batch size, so LARGE chunks win (B=1024 ≈ 460 img/s e2e
-    vs B=256 ≈ 130); and queuing many async ops ahead DEGRADES the tunnel
-    3-4x (pipelined depth 2-4 ≈ 155-190 img/s vs shallow ≈ 415-460), so the
-    pipeline stays exactly one transfer deep and every result is fetched
-    (np.asarray) before the next dispatch. Empty input short-circuits."""
+    Measured on the axon tunnel (r3 probes, conclusions in
+    scripts/perf_notes.md): each dispatched executable costs ~1-2s of fixed
+    runtime overhead nearly independent of batch size, so LARGE chunks win
+    (B=1024 ≈ 460 img/s e2e vs B=256 ≈ 130); queuing many async ops ahead
+    DEGRADES the tunnel 3-4x, so neither mode queues more than one compute.
+
+    * ``separated``: stage ALL chunks, block, then run forward+fetch per
+      chunk (tunnel-optimal: transfers never interleave a running compute;
+      host window bounded by the engine's UDF morsel size).
+    * ``overlap``: depth-1 pipeline — dispatch forward for chunk i, stage
+      chunk i+1 while it computes, then fetch chunk i (PCIe-optimal).
+    """
     import time as _time
 
     n = arr.shape[0]
@@ -96,25 +149,36 @@ def _chunked_forward(fwd, params, arr: np.ndarray, max_batch: int, out_dim: int,
             b = ((b + pad_mult - 1) // pad_mult) * pad_mult
         chunks.append((len(chunk), chunk, b))
     stats = {"stage_s": 0.0, "fwd_fetch_s": 0.0, "chunks": len(chunks),
-             "rows": n}
-    # Stage ALL chunks before any compute: interleaving transfers with a
-    # running computation degrades the tunnel (measured: interleaved ≈ 7.3s
-    # per 1024-chunk vs 2.2s with clean separation). The staging window is
-    # bounded by the engine's UDF morsel size.
-    t0 = _time.perf_counter()
-    staged = [stage(_pad_batch(c, b)) for _, c, b in chunks]
-    for s in staged:
-        s.block_until_ready()
-    stats["stage_s"] = _time.perf_counter() - t0
+             "rows": n, "mode": mode}
     outs = []
-    t0 = _time.perf_counter()
-    for i, (cn, _, _) in enumerate(chunks):
-        f = fwd(params, staged[i])
-        staged[i] = None  # free the HBM reference once consumed
-        outs.append(np.asarray(f)[:cn])  # forces + fetches chunk i
-    stats["fwd_fetch_s"] = _time.perf_counter() - t0
-    LAST_FORWARD_STATS.clear()
-    LAST_FORWARD_STATS.update(stats)
+    if mode == "overlap":
+        t0 = _time.perf_counter()
+        nxt = stage(_pad_batch(chunks[0][1], chunks[0][2]))
+        for i, (cn, _, _) in enumerate(chunks):
+            cur, nxt = nxt, None
+            f = fwd(params, cur)  # async dispatch
+            if i + 1 < len(chunks):  # stage i+1 while chunk i computes
+                nxt = stage(_pad_batch(chunks[i + 1][1], chunks[i + 1][2]))
+            outs.append(np.asarray(f)[:cn])  # forces + fetches chunk i
+        stats["fwd_fetch_s"] = _time.perf_counter() - t0
+    else:
+        t0 = _time.perf_counter()
+        staged = [stage(_pad_batch(c, b)) for _, c, b in chunks]
+        for s in staged:
+            s.block_until_ready()
+        stats["stage_s"] = _time.perf_counter() - t0
+        t0 = _time.perf_counter()
+        for i, (cn, _, _) in enumerate(chunks):
+            f = fwd(params, staged[i])
+            staged[i] = None  # free the HBM reference once consumed
+            outs.append(np.asarray(f)[:cn])
+        stats["fwd_fetch_s"] = _time.perf_counter() - t0
+    if stats_out is not None:
+        stats_out.clear()
+        stats_out.update(stats)
+    with _STATS_LOCK:
+        LAST_FORWARD_STATS.clear()
+        LAST_FORWARD_STATS.update(stats)
     return np.concatenate(outs, axis=0) if len(outs) > 1 else outs[0]
 
 
@@ -123,10 +187,14 @@ class _FlaxModelBase:
     single-owner: the UDF actor pool gives each chip one process, and with
     ``chips_per_replica`` each instance owns an ICI mesh slice)."""
 
-    def __init__(self):
+    def __init__(self, staging_mode: Optional[str] = None):
         self._lock = threading.Lock()
         self.mesh = None
         self._param_specs = None
+        self.staging_mode = resolve_staging_mode(staging_mode)
+        # Per-instance phase breakdown of the most recent forward (replicas
+        # each own their dict; the module-level mirror is diagnostics-only).
+        self.last_forward_stats: Dict[str, float] = {}
 
     def setup_mesh(self, mesh_axes: Optional[Dict[str, int]] = None):
         """Build this replica's mesh over its device slot.
@@ -183,8 +251,9 @@ class _FlaxModelBase:
 class FlaxCLIPImageEmbedder(_FlaxModelBase):
     def __init__(self, model_name: str, weights_path: Optional[str] = None,
                  dtype=jnp.bfloat16, seed: int = 0, batch_size: int = 128,
-                 mesh_axes: Optional[Dict[str, int]] = None):
-        super().__init__()
+                 mesh_axes: Optional[Dict[str, int]] = None,
+                 staging_mode: Optional[str] = None):
+        super().__init__(staging_mode)
         from daft_tpu.models.clip import CLIPConfig, init_clip_params, load_params
 
         self.cfg = CLIPConfig.from_name(model_name)
@@ -213,17 +282,19 @@ class FlaxCLIPImageEmbedder(_FlaxModelBase):
     def embed_image(self, images: np.ndarray) -> np.ndarray:
         """images: (B, H, W, 3) uint8 (or flat (B, H*W*3)). Returns (B, D) f32.
 
-        Chunks to ``max_batch`` and dispatches ALL chunk forwards before
-        gathering any result: jax's async dispatch queues them on device, so
-        host->HBM transfers of chunk i+1 overlap compute of chunk i — critical
-        when the chip sits behind a transfer tunnel.
+        Chunks to ``max_batch`` and runs forwards under this instance's
+        staging policy (``self.staging_mode``): depth-1 transfer/compute
+        overlap on real PCIe hosts, stage-then-compute separation on
+        degraded transports — see ``resolve_staging_mode``.
         """
         n = images.shape[0]
         if images.ndim == 2:
             images = images.reshape(n, self.cfg.image_size, self.cfg.image_size, 3)
         return _chunked_forward(self._fwd, self.params, images, self.max_batch,
                                 self.cfg.embed_dim, stage=self.stage_batch,
-                                pad_mult=self.batch_multiple())
+                                pad_mult=self.batch_multiple(),
+                                mode=self.staging_mode,
+                                stats_out=self.last_forward_stats)
 
 
 class FlaxCLIPTextEmbedder(_FlaxModelBase):
@@ -255,7 +326,9 @@ class FlaxCLIPTextEmbedder(_FlaxModelBase):
 
     def embed_text(self, texts: Sequence[Optional[str]]) -> np.ndarray:
         tokens, _ = self.tokenizer.encode_batch(texts)
-        return _chunked_forward(self._fwd, self.params, tokens, self.max_batch, self.cfg.embed_dim)
+        return _chunked_forward(self._fwd, self.params, tokens, self.max_batch,
+                                self.cfg.embed_dim, mode=self.staging_mode,
+                                stats_out=self.last_forward_stats)
 
 
 class FlaxMiniLMTextEmbedder(_FlaxModelBase):
@@ -280,7 +353,9 @@ class FlaxMiniLMTextEmbedder(_FlaxModelBase):
 
     def embed_text(self, texts: Sequence[Optional[str]]) -> np.ndarray:
         tokens, _ = self.tokenizer.encode_batch(texts)
-        return _chunked_forward(self._fwd, self.params, tokens, self.max_batch, self.cfg.embed_dim)
+        return _chunked_forward(self._fwd, self.params, tokens, self.max_batch,
+                                self.cfg.embed_dim, mode=self.staging_mode,
+                                stats_out=self.last_forward_stats)
 
 
 class FlaxCLIPClassifier(_FlaxModelBase):
@@ -403,6 +478,7 @@ class _FlaxDescriptor(Descriptor):
             kw = {k: v for k, v in opts.items() if k in ("weights_path", "seed")}
             kw["batch_size"] = self.options.get("batch_size", 128)
             kw["mesh_axes"] = self.options.get("mesh_axes")
+            kw["staging_mode"] = self.options.get("staging_mode")
             return FlaxCLIPImageEmbedder(self.model, **kw)
         if self.kind == "text_embedder":
             if "clip" in self.model.lower() or "vit" in self.model.lower():

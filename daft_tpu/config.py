@@ -51,6 +51,11 @@ class ExecutionConfig:
     # Execution
     enable_aqe: bool = False
     default_maintain_order: bool = True
+    # Worker-pool width for intra-op morsel parallelism (project / filter /
+    # join-probe / agg-partial). 0 = one worker per visible CPU core
+    # (reference: per-operator max_concurrency in
+    # src/daft-local-execution/src/intermediate_ops/intermediate_op.rs:41).
+    num_compute_threads: int = 0
     enable_strict_filter_pushdown: bool = True
     min_cpu_per_task: float = 0.5
     memory_limit_bytes: Optional[int] = None

@@ -162,22 +162,45 @@ class AggState:
         self.plan = TwoPhasePlan(agg_exprs, group_by)
         self.out_schema = out_schema
         self.input_schema = input_schema
+        self._raw: List[RecordBatch] = []      # un-aggregated input morsels
+        self._raw_rows = 0
+        # Partial-form batches. INVARIANT: each entry is the output of a
+        # grouped aggregation (a flush, a merge, or a worker's merged
+        # partials), so group keys are unique WITHIN a batch — a merge pass
+        # is needed exactly when len(_buffers) > 1.
         self._buffers: List[RecordBatch] = []
         self._buffer_rows = 0
 
     def accumulate(self, mp: MicroPartition) -> None:
+        """Buffer raw morsels; partial-agg only when the buffer exceeds the
+        memory threshold. High-cardinality group-bys (most groups unique per
+        morsel) would otherwise pay a full grouped pass per morsel PLUS a
+        merge pass at the end — buffering makes the common in-memory case a
+        single hash aggregation."""
         rb = mp.combined()
         if len(rb) == 0:
             return
-        partial = rb.agg(self.plan.partial_exprs, self.plan.group_by)
+        self._raw.append(rb)
+        self._raw_rows += len(rb)
+        if self._raw_rows > self.MERGE_THRESHOLD_ROWS:
+            self._flush_raw()
+            if self._buffer_rows > self.MERGE_THRESHOLD_ROWS:
+                self._merge()
+
+    def _flush_raw(self) -> None:
+        if not self._raw:
+            return
+        partial = RecordBatch.concat(self._raw).agg(
+            self.plan.partial_exprs, self.plan.group_by)
+        self._raw = []
+        self._raw_rows = 0
         self._buffers.append(partial)
         self._buffer_rows += len(partial)
-        if self._buffer_rows > self.MERGE_THRESHOLD_ROWS:
-            self._merge()
 
     def _merge(self) -> None:
-        if not self._buffers:
-            return
+        self._flush_raw()
+        if len(self._buffers) <= 1:
+            return  # single partial batch: groups already unique (invariant)
         merged = RecordBatch.concat(self._buffers).agg(
             self.plan.merge_exprs, self.plan.merge_group_by
         )
@@ -209,6 +232,7 @@ class AggState:
     def finalize(self) -> RecordBatch:
         from daft_tpu.expressions.evaluator import evaluate
 
+        self._flush_raw()
         if not self._buffers:
             if self.plan.group_by:
                 return RecordBatch.empty(self.out_schema)

@@ -40,6 +40,71 @@ from daft_tpu.series import Series
 _SENTINEL = object()
 
 
+def _ordered_parallel_map(child_iter: Iterator, fn, concurrency: int,
+                          pool: ThreadPoolExecutor,
+                          owns_pool: bool = False) -> Iterator:
+    """Ordered concurrent map over morsels: a feeder thread pulls the child
+    and submits to a worker pool; results yield in input order. The bounded
+    queue's blocking put is the backpressure (at most ~2x concurrency
+    completed-or-running morsels buffered per stage); a stop flag lets an
+    abandoned consumer release the feeder. Worker + feeder threads inherit
+    the caller's contextvars (per-query frozen clock etc.).
+
+    ``pool`` is normally the executor's SHARED compute pool: stacked stages
+    (Project over Filter over join-probe) then compete for one set of
+    core-count workers instead of multiplying threads per stage. Sharing is
+    deadlock-free because pooled tasks are pure morsel functions — only
+    feeder threads (never pool workers) wait on futures. Exceptions from the
+    child iterator or from ``fn`` propagate to the consumer UNWRAPPED, so
+    error types match the serial path regardless of core count.
+
+    This is the engine's intra-operator parallelism primitive (reference:
+    per-operator max_concurrency workers in
+    src/daft-local-execution/src/intermediate_ops/intermediate_op.rs:41,
+    pipeline.rs:101-120): Arrow/Acero kernels and XLA computations release
+    the GIL, so a thread pool gives real parallelism on multi-core hosts.
+    """
+    inflight: "queue.Queue" = queue.Queue(maxsize=max(concurrency * 2, 2))
+    stop = threading.Event()
+    ambient = contextvars.copy_context()
+
+    def put_or_stop(item) -> bool:
+        while not stop.is_set():
+            try:
+                inflight.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def submit_all():
+        try:
+            for item in child_iter:
+                fut = pool.submit(ambient.copy().run, fn, item)
+                if not put_or_stop(fut):
+                    return
+        except BaseException as e:  # noqa: BLE001
+            put_or_stop(e)
+            return
+        put_or_stop(_SENTINEL)
+
+    feeder = threading.Thread(target=ambient.copy().run, args=(submit_all,),
+                              daemon=True)
+    feeder.start()
+    try:
+        while True:
+            item = inflight.get()
+            if item is _SENTINEL:
+                break
+            if isinstance(item, BaseException):
+                raise item  # child-iterator failure: surface the original
+            yield item.result()  # fn failure: future re-raises the original
+    finally:
+        stop.set()
+        if owns_pool:
+            pool.shutdown(wait=False, cancel_futures=True)
+
+
 def _remorsel(it: Iterator[MicroPartition], max_rows: int) -> Iterator[MicroPartition]:
     """Split oversized morsels; small morsels pass through untouched."""
     for mp in it:
@@ -56,6 +121,8 @@ class Executor:
 
     def __init__(self, cfg, num_io_threads: int = 8, partition_offset: int = 0,
                  stats=None):
+        import os
+
         from daft_tpu.execution.resource_manager import get_memory_manager
 
         self.cfg = cfg
@@ -64,7 +131,23 @@ class Executor:
         self.stats = stats  # RuntimeStats | None
         self.memory = get_memory_manager()
         self._held_bytes = 0
-        self._op_stack: List[str] = []
+        # Per-THREAD pull-chain stack: with worker-pool stages, nested
+        # _instrumented frames run in different feeder threads; a shared list
+        # would interleave pushes/pops across chains (stats corruption and
+        # races). Exclusive-time attribution is per pull chain.
+        self._op_stacks = threading.local()
+        n = getattr(cfg, "num_compute_threads", 0)
+        self.compute_threads = n if n > 0 else (os.cpu_count() or 1)
+        self._compute_pool: Optional[ThreadPoolExecutor] = None
+
+    def _pool(self) -> ThreadPoolExecutor:
+        """The executor-wide compute pool, shared by all streaming stages so
+        stacked operators compete for core-count workers instead of
+        spawning a pool each."""
+        if self._compute_pool is None:
+            self._compute_pool = ThreadPoolExecutor(
+                max_workers=self.compute_threads, thread_name_prefix="daft-compute")
+        return self._compute_pool
 
     def run(self, plan: pp.PhysicalPlan) -> Iterator[MicroPartition]:
         # Plans are DAGs: subquery decorrelation references the same subtree
@@ -77,6 +160,9 @@ class Executor:
             yield from self._run(plan)
         finally:
             self._shared_cache = {}
+            if self._compute_pool is not None:
+                self._compute_pool.shutdown(wait=False, cancel_futures=True)
+                self._compute_pool = None
             if self._held_bytes:
                 self.memory.release(self._held_bytes)
                 self._held_bytes = 0
@@ -119,28 +205,33 @@ class Executor:
     def _instrumented(self, op: str, it: Iterator[MicroPartition]) -> Iterator[MicroPartition]:
         """Per-operator counters with EXCLUSIVE cpu attribution: each level
         subtracts its inclusive time from its parent (the op stack tracks the
-        current pull chain), so summing operator cpu ~= query cpu."""
+        current pull chain, per thread), so summing operator cpu ~= query cpu
+        on a serial chain; with parallel stages each thread's chain is
+        attributed independently."""
         import time as _time
 
+        stack = getattr(self._op_stacks, "stack", None)
+        if stack is None:
+            stack = self._op_stacks.stack = []
         while True:
             t0 = _time.perf_counter_ns()
             # Unique frame entry: identity-checked pop so adjacent same-named
             # operators (Project over Project) can never double-pop.
             entry = (object(), op)
-            self._op_stack.append(entry)
+            stack.append(entry)
             try:
                 mp = next(it)
             except StopIteration:
                 return
             finally:
-                if self._op_stack and self._op_stack[-1] is entry:
-                    self._op_stack.pop()
+                if stack and stack[-1] is entry:
+                    stack.pop()
             dt = _time.perf_counter_ns() - t0
             self.stats.record(op, rows_out=len(mp), cpu_ns=dt)
-            if self._op_stack:
+            if stack:
                 # Parent's timed region includes ours: remove the double count
                 # and credit it with the rows flowing in.
-                self.stats.record(self._op_stack[-1][1], rows_in=len(mp), cpu_ns=-dt)
+                self.stats.record(stack[-1][1], rows_in=len(mp), cpu_ns=-dt)
             yield mp
 
     # -- sources ---------------------------------------------------------
@@ -209,13 +300,23 @@ class Executor:
             yield ref.fetch()
 
     # -- intermediate (streaming) ops ------------------------------------
+    def _streaming_map(self, child: pp.PhysicalPlan, fn) -> Iterator[MicroPartition]:
+        """Per-morsel map with worker-pool parallelism when cores allow."""
+        it = self._run(child)
+        if self.compute_threads <= 1:
+            for mp in it:
+                yield fn(mp)
+            return
+        yield from _ordered_parallel_map(it, fn, self.compute_threads,
+                                         pool=self._pool())
+
     def _run_Project(self, node: pp.Project) -> Iterator[MicroPartition]:
-        for mp in self._run(node.children[0]):
-            yield mp.eval_expression_list(node.exprs)
+        yield from self._streaming_map(
+            node.children[0], lambda mp: mp.eval_expression_list(node.exprs))
 
     def _run_Filter(self, node: pp.Filter) -> Iterator[MicroPartition]:
-        for mp in self._run(node.children[0]):
-            yield mp.filter(node.predicate)
+        yield from self._streaming_map(
+            node.children[0], lambda mp: mp.filter(node.predicate))
 
     def _run_Explode(self, node: pp.Explode) -> Iterator[MicroPartition]:
         names = [e.name() for e in node.to_explode]
@@ -294,57 +395,13 @@ class Executor:
             for mp in child_iter:
                 yield eval_mp(mp)
             return
-        # Ordered concurrent map over morsels (actor-pool analogue). The
-        # bounded queue's blocking put is the backpressure; a stop flag lets
-        # an abandoned consumer release the feeder.
-        pool = ThreadPoolExecutor(max_workers=concurrency, thread_name_prefix="daft-udf")
-        inflight: "queue.Queue" = queue.Queue(maxsize=concurrency * 2)
-        stop = threading.Event()
-        # Feeder + eval threads inherit the caller's contextvars (per-query
-        # frozen clock): bare threads start from an empty context.
-        ambient = contextvars.copy_context()
-
-        def submit_all():
-            try:
-                for mp in child_iter:
-                    fut = pool.submit(ambient.copy().run, eval_mp, mp)
-                    while not stop.is_set():
-                        try:
-                            inflight.put(fut, timeout=0.1)
-                            break
-                        except queue.Full:
-                            continue
-                    if stop.is_set():
-                        return
-            except BaseException as e:  # noqa: BLE001
-                while not stop.is_set():
-                    try:
-                        inflight.put(e, timeout=0.1)
-                        return
-                    except queue.Full:
-                        continue
-                return
-            while not stop.is_set():
-                try:
-                    inflight.put(_SENTINEL, timeout=0.1)
-                    return
-                except queue.Full:
-                    continue
-
-        feeder = threading.Thread(target=ambient.copy().run, args=(submit_all,),
-                                  daemon=True)
-        feeder.start()
-        try:
-            while True:
-                item = inflight.get()
-                if item is _SENTINEL:
-                    break
-                if isinstance(item, BaseException):
-                    raise DaftExecutionError(f"UDF stage failed: {item}") from item
-                yield item.result()
-        finally:
-            stop.set()
-            pool.shutdown(wait=False, cancel_futures=True)
+        # Ordered concurrent map over morsels (actor-pool analogue). UDFs get
+        # their OWN pool: replica-slot acquisition can block a worker, which
+        # must never starve the shared relational compute pool.
+        udf_pool = ThreadPoolExecutor(max_workers=concurrency,
+                                      thread_name_prefix="daft-udf")
+        yield from _ordered_parallel_map(child_iter, eval_mp, concurrency,
+                                         pool=udf_pool, owns_pool=True)
 
     # -- streaming sinks --------------------------------------------------
     def _run_Limit(self, node: pp.Limit) -> Iterator[MicroPartition]:
@@ -490,12 +547,15 @@ class Executor:
                 self._join_and_fix(left, right, left_keys, right_keys, node)
             ])
             return
-        # Stream the probe (left) side morsel-by-morsel against the built side.
-        for mp in self._run(node.children[0]):
+        # Stream the probe (left) side morsel-by-morsel against the built
+        # side, probing morsels in parallel on multi-core hosts.
+        def probe(mp: MicroPartition) -> MicroPartition:
             left = mp.combined()
             left_keys = [evaluate(e, left) for e in node.left_on]
             out = self._join_and_fix(left, right, left_keys, right_keys, node)
-            yield MicroPartition(node.schema, [out])
+            return MicroPartition(node.schema, [out])
+
+        yield from self._streaming_map(node.children[0], probe)
 
     @staticmethod
     def _conform_to_schema(rb: RecordBatch, schema: Schema) -> RecordBatch:

@@ -74,64 +74,90 @@ def eval_aggregation(rb, agg_exprs: Sequence[Expr], group_by: Sequence[Expr] = (
         return RecordBatch(Schema([Field(c.name, c.dtype) for c in out_cols]), out_cols, 1)
 
     key_series = [evaluate(g, rb).rename(g.name()) for g in group_by]
-    group_ids, first_idx = _group_codes(key_series)
-    num_groups = len(first_idx)
-    keys_rb = RecordBatch(
-        Schema([Field(k.name, k.dtype) for k in key_series]), key_series, len(rb)
-    ).take(first_idx.astype(np.uint64))
 
     # Evaluate agg children once over the whole batch.
-    agg_results: List[Series] = []
-    arrow_cols: Dict[str, pa.Array] = {}
-    arrow_specs: List[Tuple[str, str, object]] = []
-    post_arrow: List[Tuple[int, str, object]] = []  # (slot, name, agg)
     slots: List[Tuple[str, object, object]] = []  # (name, agg, child_series)
     for name, agg in named_aggs:
         child = evaluate(agg.child, rb)
         slots.append((name, agg, child))
 
+    def _acero_spec(name, agg, child):
+        if agg.op not in _ARROW_AGGS or child.dtype.is_python() or child.dtype.is_logical():
+            return None
+        opts = None
+        if agg.op == "count":
+            mode = agg.kwargs.get("mode", "valid")
+            arrow_mode = {"valid": "only_valid", "null": "only_null", "all": "all"}.get(mode, "only_valid")
+            opts = pc.CountOptions(mode=arrow_mode)
+        elif agg.op in ("stddev", "variance"):
+            opts = pc.VarianceOptions(ddof=0)
+        elif agg.op == "any_value":
+            opts = pc.ScalarAggregateOptions(skip_nulls=bool(agg.kwargs.get("ignore_nulls", False)))
+        return (f"__v_{name}", _ARROW_AGGS[agg.op], opts, name, agg)
+
+    specs = [_acero_spec(name, agg, child) for name, agg, child in slots]
+    keys_direct = all(
+        not k.dtype.is_python() and not k.dtype.is_nested() and not k.dtype.is_logical()
+        for k in key_series)
     results: Dict[str, Series] = {}
-    code_arr = pa.array(group_ids)
-    # Build one Acero group_by for all standard aggs.
-    acero_targets = []
-    table_cols = {"__code": code_arr}
-    for name, agg, child in slots:
-        if agg.op in _ARROW_AGGS and not child.dtype.is_python() and not child.dtype.is_logical():
-            colname = f"__v_{name}"
+
+    if keys_direct and all(s is not None for s in specs):
+        # Fast path: ONE Arrow hash aggregation, grouped directly by the key
+        # columns. Arrow's single-threaded group_by emits groups in
+        # first-occurrence order (null keys form their own group), matching
+        # _group_codes semantics — no code pass, no argsort realignment.
+        key_names_internal = [f"__k_{i}" for i in range(len(key_series))]
+        table_cols = {n: k.to_arrow() for n, k in zip(key_names_internal, key_series)}
+        for (colname, _a, _o, _name, _g), (_n, _agg, child) in zip(specs, slots):
             table_cols[colname] = child.to_arrow()
-            opts = None
-            if agg.op == "count":
-                mode = agg.kwargs.get("mode", "valid")
-                arrow_mode = {"valid": "only_valid", "null": "only_null", "all": "all"}.get(mode, "only_valid")
-                opts = pc.CountOptions(mode=arrow_mode)
-            elif agg.op in ("stddev", "variance"):
-                opts = pc.VarianceOptions(ddof=0)
-            elif agg.op == "any_value":
-                opts = pc.ScalarAggregateOptions(skip_nulls=bool(agg.kwargs.get("ignore_nulls", False)))
-            acero_targets.append((colname, _ARROW_AGGS[agg.op], opts, name, agg))
-    if acero_targets:
         table = pa.table(table_cols)
-        tgb = table.group_by("__code", use_threads=False)
-        agged = tgb.aggregate([(c, a, o) if o is not None else (c, a) for c, a, o, _, _ in acero_targets])
-        # Align to first-occurrence group order.
-        code_order = np.asarray(agged.column("__code"))
-        perm = np.argsort(code_order, kind="stable")
-        for (colname, arrow_agg, _opts, name, agg) in acero_targets:
+        agged = table.group_by(key_names_internal, use_threads=False).aggregate(
+            [(c, a, o) if o is not None else (c, a) for c, a, o, _, _ in specs])
+        num_groups = len(agged)
+        key_cols = [Series.from_arrow(agged.column(n).combine_chunks(), k.name)
+                    .cast(k.dtype)
+                    for n, k in zip(key_names_internal, key_series)]
+        keys_rb = RecordBatch(
+            Schema([Field(c.name, c.dtype) for c in key_cols]), key_cols, num_groups)
+        for (colname, arrow_agg, _opts, name, agg) in specs:
             out_col = agged.column(f"{colname}_{arrow_agg}").combine_chunks()
-            out_col = out_col.take(pa.array(perm))
-            res = Series.from_arrow(out_col, name)
-            res = _fix_agg_dtype(res, agg, name)
-            results[name] = res
-    # Python/sketch/percentile fallbacks: loop per group.
-    for name, agg, child in slots:
-        if name in results:
-            continue
-        parts = []
-        for g in range(num_groups):
-            mask = group_ids == g
-            sub = child.take(np.nonzero(mask)[0].astype(np.uint64))
-            parts.append(_global_agg(sub, agg))
-        results[name] = Series.concat(parts).rename(name) if parts else Series.null(name, child.dtype, 0)
+            results[name] = _fix_agg_dtype(Series.from_arrow(out_col, name), agg, name)
+    else:
+        group_ids, first_idx = _group_codes(key_series)
+        num_groups = len(first_idx)
+        keys_rb = RecordBatch(
+            Schema([Field(k.name, k.dtype) for k in key_series]), key_series, len(rb)
+        ).take(first_idx.astype(np.uint64))
+        code_arr = pa.array(group_ids)
+        # Build one Acero group_by for all standard aggs.
+        acero_targets = [s for s in specs if s is not None]
+        table_cols = {"__code": code_arr}
+        for spec, (_n, _agg, child) in zip(specs, slots):
+            if spec is not None:
+                table_cols[spec[0]] = child.to_arrow()
+        if acero_targets:
+            table = pa.table(table_cols)
+            tgb = table.group_by("__code", use_threads=False)
+            agged = tgb.aggregate([(c, a, o) if o is not None else (c, a) for c, a, o, _, _ in acero_targets])
+            # Align to first-occurrence group order.
+            code_order = np.asarray(agged.column("__code"))
+            perm = np.argsort(code_order, kind="stable")
+            for (colname, arrow_agg, _opts, name, agg) in acero_targets:
+                out_col = agged.column(f"{colname}_{arrow_agg}").combine_chunks()
+                out_col = out_col.take(pa.array(perm))
+                res = Series.from_arrow(out_col, name)
+                res = _fix_agg_dtype(res, agg, name)
+                results[name] = res
+        # Python/sketch/percentile fallbacks: loop per group.
+        for name, agg, child in slots:
+            if name in results:
+                continue
+            parts = []
+            for g in range(num_groups):
+                mask = group_ids == g
+                sub = child.take(np.nonzero(mask)[0].astype(np.uint64))
+                parts.append(_global_agg(sub, agg))
+            results[name] = Series.concat(parts).rename(name) if parts else Series.null(name, child.dtype, 0)
 
     inter_cols = list(keys_rb.columns()) + [results[name] for name, _, _ in slots]
     inter = RecordBatch(

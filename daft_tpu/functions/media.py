@@ -364,7 +364,9 @@ def run_process(args, *, shell: bool = False, on_error: str = "log",
         kind = rd.id.value
         if kind == "binary":
             return out
-        s = out.decode().strip()
+        # errors="replace": a successful command whose stdout holds stray
+        # non-UTF-8 bytes must not be misreported as a process failure.
+        s = out.decode(errors="replace").strip()
         if kind in ("int8", "int16", "int32", "int64",
                     "uint8", "uint16", "uint32", "uint64"):
             return int(s or 0)
@@ -372,7 +374,7 @@ def run_process(args, *, shell: bool = False, on_error: str = "log",
             return float(s or 0.0)
         if kind == "bool":
             return s.lower() in ("1", "true", "t", "yes")
-        return out.decode()
+        return out.decode(errors="replace")
 
     @_udf(return_dtype=rd)
     def _run(*argv):

@@ -139,14 +139,29 @@ def _promoted_dtype(fields, kwargs):
 
 def _elementwise_fold(pc_fn):
     def fn(args, **kwargs):
+        from daft_tpu.datatype import unify_dtypes
+
         # Null-typed args (literal NULL) contribute nothing: SQL
         # GREATEST/LEAST ignore NULLs (skip_nulls=True below).
-        arrs = [s.to_arrow() for s in args
-                if not pa.types.is_null(s.to_arrow().type)]
-        if not arrs:
+        live = [s for s in args
+                if s.dtype.is_python() or not pa.types.is_null(s.to_arrow().type)]
+        if not live:
             return args[0]
+        # Cast every arg to the unified dtype the resolver declared: arrow's
+        # implicit promotion can't bridge e.g. (bool, int64) and mixed inputs
+        # would otherwise raise or return a dtype off the planned schema.
+        unified = functools.reduce(unify_dtypes, (s.dtype for s in live))
+        if unified.is_python():
+            # Non-promotable mix (e.g. bool/int64): per-row Python fold,
+            # skipping NULLs like the arrow kernels do.
+            pick = max if pc_fn is pc.max_element_wise else min
+            rows = zip(*(s.to_pylist() for s in live))
+            out_vals = [pick((v for v in row if v is not None), default=None)
+                        for row in rows]
+            return Series.from_pylist(out_vals, args[0].name, unified)
+        arrs = [s.cast(unified).to_arrow() for s in live]
         # arrow has no bool kernel for {min,max}_element_wise: via uint8
-        was_bool = all(pa.types.is_boolean(a.type) for a in arrs)
+        was_bool = pa.types.is_boolean(arrs[0].type)
         if was_bool:
             arrs = [a.cast(pa.uint8()) for a in arrs]
         out = pc_fn(*arrs) if len(arrs) > 1 else arrs[0]

@@ -22,6 +22,7 @@ from __future__ import annotations
 from typing import List, Optional, Sequence
 
 from daft_tpu.expressions.expr import (
+    AggOp,
     Alias,
     BinaryOp,
     ColumnRef,
@@ -724,6 +725,11 @@ class UnnestSubqueries(Rule):
             left_on.append(outer_e)
             right_on.append(ColumnRef(k))
         if extra:
+            rewritten = self._ne_exists_via_agg(base, plan, corr, in_value,
+                                                left_on, proj, right_on,
+                                                negated, extra)
+            if rewritten is not None:
+                return rewritten
             # Inner columns referenced by the non-equi predicates travel
             # through the join under their reserved __in_<name> aliases.
             inner_refs = sorted({ref[5:] for e in extra for ref in e.column_refs()
@@ -749,6 +755,63 @@ class UnnestSubqueries(Rule):
             matched = lp.Filter(joined, _and_all(list(extra)))
             return lp.Join(base_id, matched, [ColumnRef(rowid)], [ColumnRef(rowid)],
                            "anti" if negated else "semi")
+        return self._semi_anti_tail(base, plan, proj, left_on, right_on,
+                                    in_value, negated)
+
+    def _ne_exists_via_agg(self, base, plan, corr, in_value, left_on, proj,
+                           right_on, negated, extra):
+        """Decorrelate ``EXISTS(inner WHERE corr-equi AND inner.X <> outer.Y)``
+        WITHOUT the row-id self-join: per correlation group, a row with
+        ``X <> Y`` exists iff the group has ≥2 distinct X values
+        (``min(X) != max(X)``) or its single value differs from Y. So one
+        grouped min/max aggregate + a left join replaces tagging every outer
+        row, inner-joining the full inner relation, and semi-joining back —
+        on TPC-H q21 that was two 6M×6M lineitem self-joins.
+
+        Applies only to the single-predicate ``<>`` shape (multi-predicate
+        conjunctions need a simultaneous witness row; they keep the general
+        row-id path). Null semantics check out: Y null ⇒ EXISTS false (flag
+        gated on not_null(Y)); empty/all-null group ⇒ min null ⇒ false; the
+        negated flag is exactly NOT EXISTS for each of those cases.
+
+        Returns the rewritten plan, or None when the shape doesn't match.
+        """
+        if in_value is not None or not corr or len(extra) != 1:
+            return None
+        e = extra[0]
+        if not (isinstance(e, BinaryOp) and e.op == "ne"):
+            return None
+        sides = [e.left, e.right]
+        inner_side = [s for s in sides if isinstance(s, ColumnRef)
+                      and s.name().startswith("__in_")]
+        outer_side = [s for s in sides if not any(
+            r.startswith("__in_") for r in s.column_refs())]
+        if len(inner_side) != 1 or len(outer_side) != 1:
+            return None
+        x = inner_side[0].name()[5:]
+        outer_y = outer_side[0]
+        if x not in plan.schema:
+            return None
+        xv = self._uniq("x")
+        mn, mx = self._uniq("mn"), self._uniq("mx")
+        inner = lp.Project(plan, list(proj) + [Alias(ColumnRef(x), xv)])
+        agg = lp.Aggregate(inner,
+                           [Alias(AggOp("min", ColumnRef(xv)), mn),
+                            Alias(AggOp("max", ColumnRef(xv)), mx)],
+                           [ColumnRef(p.name()) for p in proj])
+        joined = lp.Join(base, agg, list(left_on), list(right_on), "left")
+        flag: Expr = BinaryOp(
+            "and",
+            BinaryOp("and", UnaryOp("not_null", outer_y),
+                     UnaryOp("not_null", ColumnRef(mn))),
+            BinaryOp("or", BinaryOp("ne", ColumnRef(mn), ColumnRef(mx)),
+                     BinaryOp("ne", ColumnRef(mn), outer_y)))
+        if negated:
+            flag = UnaryOp("not", flag)
+        return lp.Filter(joined, flag)
+
+    def _semi_anti_tail(self, base, plan, proj, left_on, right_on, in_value,
+                        negated):
         if not proj:  # uncorrelated EXISTS
             one = self._uniq("one")
             proj.append(Alias(Literal(1), one))

@@ -59,11 +59,24 @@ class ReplicaSlots:
     """
 
     def __init__(self, chips_per_replica: int, devices: Optional[list] = None):
+        import logging
+
         import jax
 
         devs = list(devices if devices is not None else jax.devices())
         cpr = max(1, int(chips_per_replica))
+        log = logging.getLogger("daft_tpu.parallel")
+        if cpr > len(devs):
+            log.warning(
+                "chips_per_replica=%d exceeds the %d visible chip(s); "
+                "clamping to one replica over all chips", cpr, len(devs))
+            cpr = len(devs)
         n = max(1, len(devs) // cpr)
+        stranded = len(devs) - n * cpr
+        if stranded:
+            log.warning(
+                "chips_per_replica=%d leaves %d of %d chips unused "
+                "(%d replica group(s) of %d)", cpr, stranded, len(devs), n, cpr)
         self.groups: List[tuple] = [
             tuple(devs[i * cpr:(i + 1) * cpr]) for i in range(n)
         ]

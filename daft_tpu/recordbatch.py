@@ -329,10 +329,25 @@ class RecordBatch:
         rename = {n: f"{suffix}{n}" for n in overlap}
         if rename:
             rt = rt.rename_columns([rename.get(n, n) for n in rt.schema.names])
-        joined = lt.join(
-            rt, keys=lkeys, right_keys=rkeys, join_type=how_map[how],
-            left_suffix="", right_suffix="",
-        )
+        # Acero's HashJoinNode always BUILDS on the right input. When the
+        # right side is much larger, flip the call so the hash table is built
+        # over the small side and the big side streams as the probe
+        # (reference: build-side choice in src/daft-physical-plan join
+        # strategy). semi/anti flip to their right-variants, which emit the
+        # original left rows.
+        flip_map = {"inner": "inner", "semi": "right semi", "anti": "right anti",
+                    "left": "right outer", "right": "left outer",
+                    "outer": "full outer"}
+        if how in flip_map and len(rt) > 2 * max(len(lt), 1):
+            joined = rt.join(
+                lt, keys=rkeys, right_keys=lkeys, join_type=flip_map[how],
+                left_suffix="", right_suffix="",
+            )
+        else:
+            joined = lt.join(
+                rt, keys=lkeys, right_keys=rkeys, join_type=how_map[how],
+                left_suffix="", right_suffix="",
+            )
         keep = [n for n in joined.schema.names if not n.startswith("__jk_")]
         joined = joined.select(keep)
         return RecordBatch.from_arrow_table(joined)

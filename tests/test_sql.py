@@ -457,3 +457,52 @@ def test_current_timestamp_constant_under_concurrent_udf():
     out = (daft_tpu.sql("SELECT i, CURRENT_TIMESTAMP t FROM df", df=df)
            .with_column("j", bump(col("i"))).to_pydict())
     assert len(set(out["t"])) == 1
+
+
+def test_sql_ne_exists_agg_rewrite_nulls_and_plan_shape():
+    """The <>-EXISTS aggregate decorrelation (UnnestSubqueries._ne_exists_via_agg)
+    must fire (no MonotonicallyIncreasingId in the optimized plan) and match
+    SQL null semantics: a NULL outer value satisfies no <> predicate, so
+    EXISTS is false and NOT EXISTS is true."""
+    import daft_tpu.logical.plan as lp
+
+    li = daft_tpu.from_pydict({"ok": [1, 1, 2, 3, 1],
+                               "sk": [10, 20, 30, 40, None]})
+    q = """SELECT ok FROM li l1 WHERE EXISTS (
+             SELECT 1 FROM li l2 WHERE l2.ok = l1.ok AND l2.sk <> l1.sk)
+           ORDER BY ok"""
+    df = daft_tpu.sql(q, li=li)
+    from daft_tpu.logical.optimizer import Optimizer
+
+    plan = Optimizer().optimize(df._builder.plan)
+    seen = set()
+
+    def walk(n):
+        if id(n) in seen:
+            return
+        seen.add(id(n))
+        assert not isinstance(n, lp.MonotonicallyIncreasingId), \
+            "row-id path taken; agg rewrite did not fire"
+        for c in n.children():
+            walk(c)
+
+    walk(plan)
+    # rows ok=1/sk=10 and ok=1/sk=20 have a sibling with different sk; the
+    # sk=None row must NOT pass EXISTS even though its group has 2 distinct.
+    assert df.to_pydict()["ok"] == [1, 1]
+    out = daft_tpu.sql("""SELECT ok, sk FROM li l1 WHERE NOT EXISTS (
+             SELECT 1 FROM li l2 WHERE l2.ok = l1.ok AND l2.sk <> l1.sk)
+           ORDER BY ok""", li=li).to_pydict()
+    # NOT EXISTS keeps: ok=2, ok=3 (singleton groups) and the NULL-sk row.
+    assert out["ok"] == [1, 2, 3]
+    assert out["sk"] == [None, 30, 40]
+
+
+def test_greatest_least_mixed_bool_int():
+    """ADVICE r3: GREATEST(bool, int) must cast to the unified dtype instead
+    of relying on arrow's implicit promotion (which raises on (bool, int64))."""
+    t = daft_tpu.from_pydict({"b": [True, False, None], "i": [0, 5, 2]})
+    out = daft_tpu.sql("SELECT GREATEST(b, i) AS g, LEAST(b, i) AS l FROM t",
+                       t=t).to_pydict()
+    assert out["g"] == [1, 5, 2]
+    assert out["l"] == [0, 0, 2]
