@@ -1,0 +1,36 @@
+"""Token/request accounting for API-backed AI providers.
+
+Reference: daft/ai/metrics.py (record_token_metrics) — usage counters flow
+to the tracing subsystem so dashboards can attribute cost per query. Here a
+process-wide, lock-protected tally keyed by (provider, model); the tracing
+layer snapshots it into span attributes.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict
+
+_LOCK = threading.Lock()
+_TOKENS: Dict[tuple, Dict[str, int]] = {}
+
+
+def record_token_metrics(provider: str, model: str, *, input_tokens: int = 0,
+                         output_tokens: int = 0, requests: int = 1) -> None:
+    with _LOCK:
+        slot = _TOKENS.setdefault((provider, model), {
+            "input_tokens": 0, "output_tokens": 0, "requests": 0})
+        slot["input_tokens"] += int(input_tokens)
+        slot["output_tokens"] += int(output_tokens)
+        slot["requests"] += int(requests)
+
+
+def token_metrics() -> Dict[tuple, Dict[str, int]]:
+    """Snapshot of accumulated usage."""
+    with _LOCK:
+        return {k: dict(v) for k, v in _TOKENS.items()}
+
+
+def reset_token_metrics() -> None:
+    with _LOCK:
+        _TOKENS.clear()

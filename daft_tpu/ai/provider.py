@@ -53,9 +53,19 @@ def load_provider(provider: "str | Provider | None", **options) -> Provider:
 
 
 def _ensure_builtins() -> None:
+    from daft_tpu.ai.api_providers import (
+        GoogleProvider,
+        LMStudioProvider,
+        OpenAIProvider,
+        VLLMProvider,
+    )
     from daft_tpu.ai.flax_provider import FlaxProvider
-    from daft_tpu.ai.stub_providers import register_stub_providers
+    from daft_tpu.ai.torch_provider import register_torch_provider
 
     _PROVIDERS.setdefault("flax", lambda **kw: FlaxProvider(**kw))
     _PROVIDERS.setdefault("flax_random", lambda **kw: FlaxProvider(random_init=True, **kw))
-    register_stub_providers()
+    _PROVIDERS.setdefault("openai", lambda **kw: OpenAIProvider(**kw))
+    _PROVIDERS.setdefault("google", lambda **kw: GoogleProvider(**kw))
+    _PROVIDERS.setdefault("lm_studio", lambda **kw: LMStudioProvider(**kw))
+    _PROVIDERS.setdefault("vllm", lambda **kw: VLLMProvider(**kw))
+    register_torch_provider()

@@ -1,0 +1,91 @@
+"""torch-transformers provider (CPU/local-weights).
+
+Reference: daft/ai/transformers — a working provider over torch transformers
+for locally-available model weights; same protocol surface as the flax
+provider. API-backed providers live in daft_tpu/ai/api_providers.py.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from daft_tpu.ai.protocols import Descriptor, UDFOptions
+from daft_tpu.ai.provider import Provider
+from daft_tpu.errors import DaftValueError
+
+
+class TorchTextEmbedder:
+    """sentence-transformers-style mean-pooled embedder over torch
+    transformers (reference: daft/ai/transformers provider)."""
+
+    def __init__(self, model_name: str, **options):
+        import torch
+        from transformers import AutoModel, AutoTokenizer
+
+        self.tokenizer = AutoTokenizer.from_pretrained(model_name)
+        self.model = AutoModel.from_pretrained(model_name)
+        self.model.eval()
+        self.torch = torch
+
+    @property
+    def dimensions(self) -> int:
+        return int(self.model.config.hidden_size)
+
+    def embed_text(self, texts: Sequence[Optional[str]]) -> np.ndarray:
+        torch = self.torch
+        clean = [t or "" for t in texts]
+        with torch.inference_mode():
+            enc = self.tokenizer(clean, padding=True, truncation=True,
+                                 max_length=256, return_tensors="pt")
+            out = self.model(**enc).last_hidden_state
+            mask = enc["attention_mask"].unsqueeze(-1).float()
+            pooled = (out * mask).sum(1) / mask.sum(1).clamp(min=1.0)
+            pooled = torch.nn.functional.normalize(pooled, dim=-1)
+        return pooled.numpy().astype(np.float32)
+
+
+class _TorchDescriptor(Descriptor):
+    def __init__(self, kind: str, model: str, options: Dict[str, Any]):
+        self.kind = kind
+        self.model = model
+        self.options = options
+
+    def get_provider(self) -> str:
+        return "transformers"
+
+    def get_model(self) -> str:
+        return self.model
+
+    def get_udf_options(self) -> UDFOptions:
+        return UDFOptions(batch_size=self.options.get("batch_size", 64),
+                          max_concurrency=self.options.get("max_concurrency", 1),
+                          tpus=0.0)
+
+    def get_dimensions(self) -> Optional[int]:
+        return self.options.get("dimensions")
+
+    def instantiate(self):
+        if self.kind == "text_embedder":
+            return TorchTextEmbedder(self.model, **self.options)
+        raise DaftValueError(f"transformers provider: {self.kind} not supported yet")
+
+
+class TorchTransformersProvider(Provider):
+    name = "transformers"
+
+    def __init__(self, **options):
+        self.options = options
+
+    def get_text_embedder(self, model: Optional[str] = None, **options) -> _TorchDescriptor:
+        return _TorchDescriptor("text_embedder",
+                                model or "sentence-transformers/all-MiniLM-L6-v2",
+                                {**self.options, **options})
+
+
+def register_torch_provider() -> None:
+    # setdefault: never clobber a provider the user registered first.
+    from daft_tpu.ai import provider as _p
+
+    _p._PROVIDERS.setdefault("transformers", lambda **kw: TorchTransformersProvider(**kw))
