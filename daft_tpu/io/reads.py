@@ -187,7 +187,10 @@ def read_sql(sql_query: str, conn, **kwargs):
     return from_arrow(pa.table(data))
 
 
-def read_huggingface(repo: str, **kwargs):
-    """HuggingFace datasets (reference: daft.read_huggingface); requires
-    network egress."""
-    return _integration_read("huggingface", "network egress + hf hub")
+def read_huggingface(repo: str, io_config=None, **kwargs):
+    """HuggingFace datasets (reference: daft.read_huggingface /
+    daft/io/huggingface/__init__.py): repo-level paths list parquet files
+    through the dataset-viewer API; file-level hf:// paths resolve to ranged
+    HTTP reads (daft_tpu/io/http_source.py)."""
+    path = repo if repo.startswith("hf://") else f"hf://datasets/{repo}"
+    return read_parquet(path, io_config=io_config, **kwargs)

@@ -1,0 +1,79 @@
+"""Retry policy for object-store operations.
+
+Reference: src/daft-io/src/retry.rs — per-cloud retry with exponential
+backoff + full jitter over transient statuses/errors; every retry is counted
+in IO stats. The same policy object serves HTTP sources, ranged reads, and
+multipart parts.
+"""
+
+from __future__ import annotations
+
+import random
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Optional, Tuple, Type
+
+from daft_tpu.errors import DaftIOError, DaftTransientError
+
+RETRYABLE_HTTP = (408, 409, 425, 429, 500, 502, 503, 504)
+
+
+@dataclass(frozen=True)
+class RetryPolicy:
+    max_retries: int = 4
+    backoff_base_s: float = 0.25
+    backoff_cap_s: float = 16.0
+    retryable_statuses: Tuple[int, ...] = RETRYABLE_HTTP
+    retryable_exceptions: Tuple[Type[BaseException], ...] = (
+        DaftTransientError, ConnectionError, TimeoutError, OSError)
+
+    def sleep_s(self, attempt: int, retry_after: Optional[str] = None) -> float:
+        if retry_after:
+            try:
+                return min(float(retry_after), self.backoff_cap_s)
+            except ValueError:
+                pass
+        base = min(self.backoff_base_s * (2 ** attempt), self.backoff_cap_s)
+        return base * (0.5 + random.random() / 2)  # full jitter, >= 50%
+
+
+def policy_from_config(io_config=None, scheme: str = "s3") -> RetryPolicy:
+    """Per-cloud policy from IOConfig (num_tries / retry_initial_backoff)."""
+    cfg = None
+    if io_config is not None:
+        cfg = getattr(io_config, {"s3": "s3", "gs": "gcs", "gcs": "gcs",
+                                  "az": "azure", "abfs": "azure",
+                                  "http": "http", "https": "http",
+                                  "hf": "hf"}.get(scheme, "s3"), None)
+    if cfg is None:
+        return RetryPolicy()
+    retries = getattr(cfg, "num_tries", None) or getattr(cfg, "max_retries", None)
+    backoff_ms = getattr(cfg, "retry_initial_backoff_ms", None)
+    return RetryPolicy(
+        max_retries=int(retries) - 1 if retries else RetryPolicy.max_retries,
+        backoff_base_s=(backoff_ms / 1000.0) if backoff_ms
+        else RetryPolicy.backoff_base_s,
+    )
+
+
+def with_retries(fn: Callable, policy: RetryPolicy, *,
+                 describe: str = "io operation",
+                 is_retryable: Optional[Callable[[BaseException], bool]] = None,
+                 on_retry: Optional[Callable[[], None]] = None):
+    """Run ``fn()`` under the policy. ``is_retryable`` may override the
+    default exception-class test (e.g. to inspect an HTTP status)."""
+    last: Optional[BaseException] = None
+    for attempt in range(policy.max_retries + 1):
+        try:
+            return fn()
+        except BaseException as e:  # noqa: BLE001
+            retryable = (is_retryable(e) if is_retryable is not None
+                         else isinstance(e, policy.retryable_exceptions))
+            if not retryable or attempt >= policy.max_retries:
+                raise
+            last = e
+            if on_retry is not None:
+                on_retry()
+            time.sleep(policy.sleep_s(attempt, getattr(e, "retry_after", None)))
+    raise DaftIOError(f"{describe} failed after {policy.max_retries + 1} "
+                      f"attempts: {last}")

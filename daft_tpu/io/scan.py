@@ -69,13 +69,35 @@ class ScanTask:
 
 def resolve_filesystem(path: str, io_config=None) -> Tuple[pafs.FileSystem, str]:
     """Resolve a URI to (filesystem, fs-local path) via Arrow C++ filesystems,
-    honouring IOConfig credentials (reference: common/io-config)."""
+    honouring IOConfig credentials (reference: common/io-config). http(s) and
+    hf:// resolve to the native ranged-read HTTP source
+    (daft_tpu/io/http_source.py)."""
     if "://" in path:
         scheme = path.split("://", 1)[0]
         if io_config is None:
             from daft_tpu.context import get_context
 
             io_config = get_context().planning_config.default_io_config
+        if scheme in ("http", "https", "hf"):
+            from daft_tpu.io.http_source import (
+                HttpFileSystemHandler,
+                resolve_hf_url,
+            )
+            from daft_tpu.io.retry import policy_from_config
+
+            if scheme == "hf":
+                url = resolve_hf_url(path)
+                scheme = url.split("://", 1)[0]
+            else:
+                url = path
+            headers = {}
+            if io_config is not None and scheme != "http":
+                tok = getattr(getattr(io_config, "hf", None), "token", None)
+                if tok and "huggingface.co" in url:
+                    headers["Authorization"] = f"Bearer {tok}"
+            handler = HttpFileSystemHandler(
+                scheme, policy_from_config(io_config, "http"), headers)
+            return pafs.PyFileSystem(handler), url.split("://", 1)[1]
         if io_config is not None:
             from daft_tpu.io.config import filesystem_for
 
@@ -105,6 +127,13 @@ def glob_paths(paths: Sequence[str], io_config=None) -> List[FileInfo]:
         return out
     out: List[FileInfo] = []
     for path in paths:
+        if path.startswith("hf://"):
+            from daft_tpu.io.http_source import expand_hf_dataset
+
+            expanded = expand_hf_dataset(path, io_config)
+            if expanded is not None:  # repo-level listing -> concrete URLs
+                out.extend(glob_paths(expanded, io_config))
+                continue
         fs, p = resolve_filesystem(path, io_config)
         if isinstance(fs, pafs.LocalFileSystem):
             if any(ch in p for ch in "*?["):
@@ -123,7 +152,14 @@ def glob_paths(paths: Sequence[str], io_config=None) -> List[FileInfo]:
             else:
                 raise DaftIOError(f"Path not found: {path}")
         else:
-            # Remote: support trailing glob on the basename and directories.
+            # Remote. FileInfo paths must stay full URIs (readers re-resolve
+            # them); reattach the RESOLVED scheme — e.g. hf:// paths resolve
+            # to https URLs, so the stored path is the https one.
+            scheme = path.split("://", 1)[0]
+            if isinstance(fs, pafs.PyFileSystem):
+                scheme = getattr(fs.handler, "scheme", scheme)
+            full = lambda q: f"{scheme}://{q}"  # noqa: E731
+            # Support trailing glob on the basename and directories.
             if any(ch in p for ch in "*?["):
                 base = p.split("*")[0].rsplit("/", 1)[0]
                 sel = pafs.FileSelector(base, recursive=True)
@@ -131,18 +167,20 @@ def glob_paths(paths: Sequence[str], io_config=None) -> List[FileInfo]:
 
                 for info in fs.get_file_info(sel):
                     if info.type == pafs.FileType.File and fnmatch.fnmatch(info.path, p):
-                        out.append(FileInfo(info.path, info.size))
+                        out.append(FileInfo(full(info.path), info.size))
                 out.sort(key=lambda f: f.path)
             else:
                 info = fs.get_file_info(p)
+                if isinstance(info, list):
+                    info = info[0]
                 if info.type == pafs.FileType.Directory:
                     sel = pafs.FileSelector(p, recursive=True)
                     for i in fs.get_file_info(sel):
                         if i.type == pafs.FileType.File:
-                            out.append(FileInfo(i.path, i.size))
+                            out.append(FileInfo(full(i.path), i.size))
                     out.sort(key=lambda f: f.path)
                 elif info.type == pafs.FileType.File:
-                    out.append(FileInfo(p, info.size))
+                    out.append(FileInfo(full(p), info.size))
                 else:
                     raise DaftIOError(f"Path not found: {path}")
     if not out:

@@ -1,0 +1,200 @@
+"""Iceberg REST catalog binding.
+
+Reference: daft/catalog/__iceberg.py (pyiceberg-backed Catalog adapter) and
+the Iceberg REST catalog spec the reference's integrations speak. Here the
+binding talks the REST wire protocol directly through an injectable JSON
+transport (tests run a local fixture server, zero egress) and reads table
+data with the native metadata/manifest reader in daft_tpu/io/iceberg.py —
+no pyiceberg dependency.
+
+Attach to a session:
+
+    cat = IcebergRestCatalog("prod", "http://rest:8181", warehouse="/wh")
+    session.attach(cat)
+    session.sql("SELECT * FROM prod.ns.tbl")
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List, Optional
+
+from daft_tpu.catalog import Catalog, Table
+from daft_tpu.errors import DaftIOError, DaftValueError
+from daft_tpu.io.retry import RetryPolicy, with_retries
+from daft_tpu.schema import Schema
+
+
+class UrllibJsonTransport:
+    """Minimal JSON-over-HTTP transport (GET/POST/DELETE) with retries."""
+
+    def __init__(self, policy: Optional[RetryPolicy] = None,
+                 timeout_s: float = 30.0):
+        self.policy = policy or RetryPolicy()
+        self.timeout_s = timeout_s
+
+    def request(self, method: str, url: str, body: Optional[dict] = None,
+                headers: Optional[Dict[str, str]] = None) -> dict:
+        import urllib.error
+        import urllib.request
+
+        def attempt() -> dict:
+            data = json.dumps(body).encode() if body is not None else None
+            req = urllib.request.Request(
+                url, data=data, method=method,
+                headers={"Content-Type": "application/json", **(headers or {})})
+            try:
+                with urllib.request.urlopen(req, timeout=self.timeout_s) as resp:
+                    raw = resp.read()
+                    return json.loads(raw.decode()) if raw.strip() else {}
+            except urllib.error.HTTPError as e:
+                detail = e.read().decode(errors="replace")[:300]
+                err = DaftIOError(f"{method} {url}: HTTP {e.code} {detail}")
+                err.status = e.code
+                err.retry_after = e.headers.get("Retry-After")
+                raise err from e
+            except (urllib.error.URLError, TimeoutError, OSError) as e:
+                raise ConnectionError(f"{method} {url}: {e}") from e
+
+        def retryable(e: BaseException) -> bool:
+            status = getattr(e, "status", None)
+            if status is not None:
+                return status in self.policy.retryable_statuses
+            return isinstance(e, self.policy.retryable_exceptions)
+
+        return with_retries(attempt, self.policy, describe=f"{method} {url}",
+                            is_retryable=retryable)
+
+
+class IcebergRestTable(Table):
+    def __init__(self, name: str, metadata_location: str, io_config=None):
+        self.name = name
+        self.metadata_location = metadata_location
+        self.io_config = io_config
+
+    def read(self):
+        from daft_tpu.io.reads import read_iceberg
+
+        return read_iceberg(self.metadata_location, io_config=self.io_config)
+
+    def schema(self) -> Schema:
+        from daft_tpu.io.iceberg import load_table
+
+        return load_table(self.metadata_location,
+                          io_config=self.io_config).schema
+
+    def append(self, df) -> None:
+        raise DaftValueError(
+            "IcebergRestTable.append: write through write_iceberg to the "
+            "table location, then commit via the catalog")
+
+    def overwrite(self, df) -> None:
+        self.append(df)
+
+
+class IcebergRestCatalog(Catalog):
+    """list/load/create/drop over the Iceberg REST catalog API."""
+
+    def __init__(self, name: str, uri: str, warehouse: Optional[str] = None,
+                 token: Optional[str] = None, transport=None, io_config=None,
+                 prefix: Optional[str] = None):
+        self.name = name
+        self.uri = uri.rstrip("/")
+        self.warehouse = warehouse
+        self.io_config = io_config
+        self.transport = transport or UrllibJsonTransport()
+        self.headers = {"Authorization": f"Bearer {token}"} if token else {}
+        # The /v1/config endpoint may hand back a path prefix for this
+        # warehouse (spec: overrides.prefix).
+        if prefix is None:
+            try:
+                cfg = self._req("GET", "/v1/config")
+                prefix = (cfg.get("overrides") or {}).get("prefix", "")
+            except Exception:  # config endpoint is optional in practice
+                prefix = ""
+        self.prefix = f"/{prefix.strip('/')}" if prefix else ""
+
+    # -- wire helpers ----------------------------------------------------
+    def _req(self, method: str, path: str, body: Optional[dict] = None) -> dict:
+        return self.transport.request(method, f"{self.uri}{path}",
+                                      body, self.headers)
+
+    def _tables_path(self, namespace: str) -> str:
+        return f"/v1{self.prefix}/namespaces/{namespace}/tables"
+
+    @staticmethod
+    def _split(name: str) -> tuple:
+        if "." not in name:
+            raise DaftValueError(
+                f"Iceberg REST tables are namespace-qualified; got {name!r}")
+        ns, tbl = name.rsplit(".", 1)
+        return ns.replace(".", "\x1f"), tbl  # multipart ns joins with 0x1f
+
+    # -- Catalog surface --------------------------------------------------
+    def list_namespaces(self) -> List[str]:
+        out = self._req("GET", f"/v1{self.prefix}/namespaces")
+        return [".".join(ns) for ns in out.get("namespaces", [])]
+
+    def create_namespace(self, namespace: str) -> None:
+        self._req("POST", f"/v1{self.prefix}/namespaces",
+                  {"namespace": namespace.split(".")})
+
+    def list_tables(self, pattern: Optional[str] = None) -> List[str]:
+        import fnmatch
+
+        names: List[str] = []
+        for ns in self.list_namespaces():
+            out = self._req("GET", self._tables_path(ns))
+            for ident in out.get("identifiers", []):
+                names.append(".".join(ident["namespace"]) + "." + ident["name"])
+        if pattern:
+            names = [n for n in names if fnmatch.fnmatch(n, pattern)]
+        return sorted(names)
+
+    def has_table(self, name: str) -> bool:
+        try:
+            self.get_table(name)
+            return True
+        except (DaftIOError, DaftValueError, ConnectionError):
+            return False
+
+    def get_table(self, name: str) -> Table:
+        ns, tbl = self._split(name)
+        out = self._req("GET", f"{self._tables_path(ns)}/{tbl}")
+        loc = out.get("metadata-location")
+        if not loc:
+            # Spec allows metadata inline without a location; the native
+            # reader needs the file, so require the location.
+            raise DaftIOError(f"table {name}: no metadata-location returned")
+        return IcebergRestTable(name, loc, self.io_config)
+
+    def create_table(self, name: str, source=None) -> Table:
+        """CTAS: write the DataFrame as an Iceberg table under the warehouse,
+        then register its metadata with the catalog."""
+        if source is None:
+            raise DaftValueError("IcebergRestCatalog.create_table needs a "
+                                 "DataFrame source")
+        if not self.warehouse:
+            raise DaftValueError("IcebergRestCatalog needs warehouse= to "
+                                 "create tables")
+        ns, tbl = self._split(name)
+        location = f"{self.warehouse.rstrip('/')}/{ns.replace(chr(31), '/')}/{tbl}"
+        from daft_tpu.io.iceberg import write_table
+
+        write_table(source, location, mode="overwrite")
+        meta_location = self._latest_metadata(location)
+        self._req("POST", f"/v1{self.prefix}/namespaces/{ns}/register",
+                  {"name": tbl, "metadata-location": meta_location})
+        return IcebergRestTable(name, meta_location, self.io_config)
+
+    @staticmethod
+    def _latest_metadata(location: str) -> str:
+        from daft_tpu.io.iceberg import _find_metadata_file
+        from daft_tpu.io.scan import resolve_filesystem
+
+        fs, root = resolve_filesystem(location, None)
+        return _find_metadata_file(fs, root)
+
+    def drop_table(self, name: str) -> None:
+        ns, tbl = self._split(name)
+        self._req("DELETE", f"{self._tables_path(ns)}/{tbl}")

@@ -143,6 +143,54 @@ class ValuesRef:
     column_aliases: Optional[List[str]] = None
 
 
+TABLE_FUNCTIONS = {"read_parquet", "read_csv", "read_json", "read_text",
+                   "range"}
+
+
+@dataclass
+class TableFuncRef:
+    """FROM read_parquet('path') — table-valued function (reference:
+    src/daft-sql/src/table_provider/ read_parquet/read_csv/read_json)."""
+
+    name: str
+    args: List[object]
+    kwargs: Dict[str, object] = field(default_factory=dict)
+    alias: Optional[str] = None
+
+
+# -- session statements (reference: src/daft-sql/src/statement.rs) -------- #
+@dataclass
+class ExplainStmt:
+    stmt: object
+    analyze: bool = False
+
+
+@dataclass
+class CreateTableStmt:
+    name: str
+    select: "SelectStmt"
+    temp: bool = False
+    or_replace: bool = False
+    if_not_exists: bool = False
+
+
+@dataclass
+class DropTableStmt:
+    name: str
+    if_exists: bool = False
+
+
+@dataclass
+class InsertStmt:
+    name: str
+    source: object  # SelectStmt | ValuesRef
+
+
+@dataclass
+class ShowTablesStmt:
+    pattern: Optional[str] = None
+
+
 @dataclass
 class JoinClause:
     right: Union[TableRef, SubqueryRef]
@@ -259,7 +307,67 @@ class Parser:
         return None
 
     # -- statements --------------------------------------------------------
-    def parse_statement(self) -> SelectStmt:
+    def parse_statement(self):
+        stmt = self._parse_statement_inner()
+        self.expect("eof")
+        return stmt
+
+    def _parse_statement_inner(self):
+        """SELECT plus session statements (reference:
+        src/daft-sql/src/statement.rs — Select / CreateTable / DropTable /
+        Insert / Explain / ShowTables)."""
+        t = self.peek()
+        word = t.value.lower() if t.kind in ("ident", "kw") else ""
+        if word == "explain":
+            self.next()
+            analyze = self._accept_word("analyze")
+            return ExplainStmt(self._parse_statement_inner(), analyze)
+        if word == "create":
+            self.next()
+            or_replace = False
+            if self._accept_word("or"):
+                self._expect_word("replace")
+                or_replace = True
+            temp = self._accept_word("temp") or self._accept_word("temporary")
+            self._expect_word("table")
+            if_not_exists = False
+            if self._accept_word("if"):
+                self._expect_word("not")
+                self._expect_word("exists")
+                if_not_exists = True
+            name = self._ident_like()
+            self.expect("kw", "as")
+            select = self._parse_statement_inner()
+            if not isinstance(select, SelectStmt):
+                raise SQLParseError("CREATE TABLE ... AS requires a SELECT")
+            return CreateTableStmt(name, select, temp=temp,
+                                   or_replace=or_replace,
+                                   if_not_exists=if_not_exists)
+        if word == "drop":
+            self.next()
+            self._expect_word("table")
+            if_exists = False
+            if self._accept_word("if"):
+                self._expect_word("exists")
+                if_exists = True
+            return DropTableStmt(self._ident_like(), if_exists=if_exists)
+        if word == "insert":
+            self.next()
+            self._expect_word("into")
+            name = self._ident_like()
+            if self._at_values():
+                return InsertStmt(name, self._parse_values())
+            select = self._parse_statement_inner()
+            if not isinstance(select, SelectStmt):
+                raise SQLParseError("INSERT INTO requires SELECT or VALUES")
+            return InsertStmt(name, select)
+        if word == "show":
+            self.next()
+            self._expect_word("tables")
+            pattern = None
+            if self._accept_word("like"):
+                pattern = self.expect("str").value[1:-1].replace("''", "'")
+            return ShowTablesStmt(pattern)
         ctes: Dict[str, SelectStmt] = {}
         if self.accept_kw("with"):
             while True:
@@ -272,8 +380,20 @@ class Parser:
                     break
         stmt = self.parse_select()
         stmt.ctes = ctes
-        self.expect("eof")
         return stmt
+
+    def _accept_word(self, word: str) -> bool:
+        """Accept an ident-or-keyword token by (case-insensitive) word."""
+        t = self.peek()
+        if t.kind in ("ident", "kw") and (t.value or "").lower() == word:
+            self.next()
+            return True
+        return False
+
+    def _expect_word(self, word: str) -> None:
+        t = self.next()
+        if (t.value or "").lower() != word:
+            raise SQLParseError(f"Expected {word.upper()!r}, got {t.value!r}")
 
     def _at_values(self) -> bool:
         t = self.peek()
@@ -436,6 +556,26 @@ class Parser:
             alias, cols = self._table_alias()
             return SubqueryRef(sub, alias, cols)
         name = self._ident_like()
+        if name.lower() in TABLE_FUNCTIONS and self.peek().kind == "op" \
+                and self.peek().value == "(":
+            self.next()  # consume "("
+            args: List[object] = []
+            kwargs: Dict[str, object] = {}
+            if not self.accept("op", ")"):
+                while True:
+                    if (self.peek().kind == "ident"
+                            and self.peek(1).kind == "op"
+                            and self.peek(1).value == "="):
+                        k = self.next().value
+                        self.next()
+                        kwargs[k] = self._literal_arg()
+                    else:
+                        args.append(self._literal_arg())
+                    if not self.accept("op", ","):
+                        break
+                self.expect("op", ")")
+            alias, _ = self._table_alias()
+            return TableFuncRef(name.lower(), args, kwargs, alias)
         while self.accept("op", "."):
             name += "." + self._ident_like()
         alias = None
@@ -444,6 +584,22 @@ class Parser:
         elif self.peek().kind == "ident":
             alias = self.next().value
         return TableRef(name, alias)
+
+    def _literal_arg(self):
+        """A literal argument of a table function: string/number/bool."""
+        t = self.next()
+        if t.kind == "str":
+            return t.value[1:-1].replace("''", "'")
+        if t.kind == "int":
+            return int(t.value)
+        if t.kind == "float":
+            return float(t.value)
+        if t.kind == "kw" and t.value in ("true", "false"):
+            return t.value == "true"
+        if t.kind == "op" and t.value == "-" and self.peek().kind in ("int", "float"):
+            n = self.next()
+            return -(int(n.value) if n.kind == "int" else float(n.value))
+        raise SQLParseError(f"Table function arguments must be literals, got {t.value!r}")
 
     def _ident_like(self) -> str:
         t = self.peek()

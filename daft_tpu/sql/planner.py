@@ -56,7 +56,107 @@ class _OuterRef(Expr):
 
 def plan_sql(query: str, bindings: Dict[str, object], session=None):
     stmt = parse_sql(query)
-    return _plan_select(stmt, bindings, dict(stmt.ctes), session)
+    return _execute_statement(stmt, bindings, session)
+
+
+def _execute_statement(stmt, bindings: Dict[str, object], session=None):
+    """Dispatch session statements (reference: src/daft-sql/src/exec.rs —
+    statements execute against the session; SELECT returns a DataFrame,
+    other statements return small status DataFrames)."""
+    from daft_tpu.sql.parser import (
+        CreateTableStmt,
+        DropTableStmt,
+        ExplainStmt,
+        InsertStmt,
+        SelectStmt,
+        ShowTablesStmt,
+        ValuesRef,
+    )
+
+    if isinstance(stmt, SelectStmt):
+        return _plan_select(stmt, bindings, dict(stmt.ctes), session)
+    from daft_tpu.dataframe.creation import from_pydict
+    from daft_tpu.session import current_session
+
+    sess = session or current_session()
+    if isinstance(stmt, ExplainStmt):
+        # EXPLAIN must never execute side effects: DDL/DML statements are
+        # DESCRIBED (with their inner SELECT's plan when they have one),
+        # only plain SELECT is planned/run.
+        target = stmt.stmt
+        if isinstance(target, SelectStmt):
+            inner = _plan_select(target, bindings, dict(target.ctes), session)
+            text = inner._builder.explain_string(show_all=True)
+            if stmt.analyze:
+                import time as _time
+
+                t0 = _time.perf_counter()
+                inner.collect()
+                wall = _time.perf_counter() - t0
+                rows = sum(len(p) for p in inner._result or [])
+                text += f"\n== Analyze ==\nrows: {rows}, wall: {wall:.4f}s"
+            return from_pydict({"plan": [text]})
+        if stmt.analyze:
+            raise DaftValueError("EXPLAIN ANALYZE supports SELECT only")
+        desc = type(target).__name__.replace("Stmt", "")
+        text = desc
+        inner_sel = getattr(target, "select", None) or getattr(target, "source", None)
+        if isinstance(inner_sel, SelectStmt):
+            sub = _plan_select(inner_sel, bindings, dict(inner_sel.ctes), session)
+            text += " <- \n" + sub._builder.explain_string(show_all=True)
+        return from_pydict({"plan": [text]})
+    if isinstance(stmt, CreateTableStmt):
+        existing = sess.get_table(stmt.name)
+        if existing is not None and not stmt.or_replace:
+            if stmt.if_not_exists:
+                return from_pydict({"table": [stmt.name], "created": [False]})
+            raise DaftValueError(f"Table {stmt.name!r} already exists "
+                                 f"(use OR REPLACE)")
+        df = _plan_select(stmt.select, bindings, dict(stmt.select.ctes),
+                          session).collect()
+        if existing is not None:
+            sess.drop_table(stmt.name)
+        if stmt.temp:
+            sess.create_temp_table(stmt.name, df)
+        else:
+            sess.create_table(stmt.name, df)
+        return from_pydict({"table": [stmt.name], "created": [True]})
+    if isinstance(stmt, DropTableStmt):
+        if sess.get_table(stmt.name) is None:
+            if stmt.if_exists:
+                return from_pydict({"table": [stmt.name], "dropped": [False]})
+            raise DaftValueError(f"Unknown table {stmt.name!r}")
+        try:
+            sess.drop_table(stmt.name)
+        except Exception:
+            sess.detach_table(stmt.name)  # temp tables detach
+        return from_pydict({"table": [stmt.name], "dropped": [True]})
+    if isinstance(stmt, InsertStmt):
+        table = sess.get_table(stmt.name)
+        if table is None:
+            raise DaftValueError(f"Unknown table {stmt.name!r} for INSERT")
+        if isinstance(stmt.source, ValuesRef):
+            df = _resolve_source(stmt.source, bindings, {}, session)
+            # Positional VALUES take the target table's column names.
+            df = _rename_positional(df, table.schema().column_names())
+        else:
+            df = _plan_select(stmt.source, bindings,
+                              dict(stmt.source.ctes), session)
+        df = df.collect()
+        table.append(df)
+        return from_pydict({"table": [stmt.name],
+                            "rows_inserted": [df.count_rows()]})
+    if isinstance(stmt, ShowTablesStmt):
+        import fnmatch
+
+        names = sess.list_tables(None)
+        if stmt.pattern is not None:
+            # SQL LIKE wildcards -> fnmatch, applied uniformly over temp AND
+            # catalog tables.
+            pat = stmt.pattern.replace("%", "*").replace("_", "?")
+            names = [n for n in names if fnmatch.fnmatch(n, pat)]
+        return from_pydict({"table": list(names) if names else []})
+    raise DaftValueError(f"Unsupported SQL statement {type(stmt).__name__}")
 
 
 def _rename_positional(df, cols):
@@ -101,6 +201,29 @@ def _resolve_source(src, bindings, ctes, session=None):
         if src.column_aliases:
             df = _rename_positional(df, src.column_aliases)
         return df
+    from daft_tpu.sql.parser import TableFuncRef
+
+    if isinstance(src, TableFuncRef):
+        # Table-valued functions (reference: src/daft-sql/src/table_provider/).
+        import daft_tpu as _dt
+
+        if src.name == "range":
+            import numpy as np
+
+            from daft_tpu.dataframe.creation import from_pydict
+
+            vals = [int(a) for a in src.args]
+            if len(vals) == 1:
+                start, stop, step = 0, vals[0], 1
+            elif len(vals) == 2:
+                start, stop, step = vals[0], vals[1], 1
+            elif len(vals) == 3:
+                start, stop, step = vals
+            else:
+                raise DaftValueError("range() takes 1-3 integer arguments")
+            return from_pydict({"id": np.arange(start, stop, step)})
+        reader = getattr(_dt, src.name)
+        return reader(*src.args, **src.kwargs)
     assert isinstance(src, TableRef)
     name = src.name
     if name in ctes:
@@ -122,12 +245,14 @@ def _resolve_source(src, bindings, ctes, session=None):
 
 
 def _src_alias(src) -> str:
-    from daft_tpu.sql.parser import ValuesRef
+    from daft_tpu.sql.parser import TableFuncRef, ValuesRef
 
     if isinstance(src, SubqueryRef):
         return src.alias or "__subquery"
     if isinstance(src, ValuesRef):
         return src.alias or "__values"
+    if isinstance(src, TableFuncRef):
+        return src.alias or src.name
     return src.alias or src.name
 
 

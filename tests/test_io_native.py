@@ -1,0 +1,307 @@
+"""Native cloud-IO layer: HTTP(S)/hf:// sources with ranged reads, retry
+policy, parallel ranged reads, and resumable multipart upload.
+
+Zero egress: a local http.server (with and without Range support) stands in
+for the remote store; fault injection wraps filesystems / monkeypatches the
+range reader. Mirrors /root/reference/src/daft-io/src/{http.rs,range.rs,
+multipart.rs,retry.rs,huggingface/} behaviors.
+"""
+
+import http.server
+import io as _io
+import os
+import threading
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.fs as pafs
+import pyarrow.parquet as pq
+import pytest
+
+import daft_tpu
+from daft_tpu.errors import DaftIOError
+from daft_tpu.io.iostats import (
+    IO_STATS,
+    MultipartUpload,
+    parallel_ranged_read,
+    reset_io_stats,
+)
+from daft_tpu.io.retry import RetryPolicy, with_retries
+
+
+class _RangeHandler(http.server.SimpleHTTPRequestHandler):
+    """Serves the docroot WITH HTTP Range support; logs silenced."""
+
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, *a):
+        pass
+
+    def send_head(self):
+        path = self.translate_path(self.path)
+        if not os.path.isfile(path):
+            self.send_error(404)
+            return None
+        size = os.path.getsize(path)
+        rng = self.headers.get("Range")
+        f = open(path, "rb")
+        if rng and rng.startswith("bytes="):
+            spec = rng[6:].split("-")
+            start = int(spec[0]) if spec[0] else 0
+            end = int(spec[1]) if len(spec) > 1 and spec[1] else size - 1
+            end = min(end, size - 1)
+            self.send_response(206)
+            self.send_header("Content-Range", f"bytes {start}-{end}/{size}")
+            self.send_header("Content-Length", str(end - start + 1))
+            self.end_headers()
+            f.seek(start)
+            return _io.BytesIO(f.read(end - start + 1))
+        self.send_response(200)
+        self.send_header("Content-Length", str(size))
+        self.end_headers()
+        return f
+
+
+@pytest.fixture(scope="module")
+def http_root(tmp_path_factory):
+    root = tmp_path_factory.mktemp("httproot")
+    t = pa.table({"a": list(range(1000)), "b": [f"s{i}" for i in range(1000)]})
+    pq.write_table(t, root / "data.parquet", row_group_size=100)
+    (root / "blob.bin").write_bytes(bytes(range(256)) * 64)
+    # hf-shaped layout: {base}/datasets/org/repo/resolve/main/file
+    hfdir = root / "datasets" / "org" / "repo" / "resolve" / "main"
+    hfdir.mkdir(parents=True)
+    pq.write_table(t.slice(0, 10), hfdir / "part0.parquet")
+    return root
+
+
+@pytest.fixture(scope="module")
+def http_server(http_root):
+    handler = lambda *a, **kw: _RangeHandler(*a, directory=str(http_root), **kw)  # noqa: E731
+    srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0), handler)
+    thread = threading.Thread(target=srv.serve_forever, daemon=True)
+    thread.start()
+    yield f"http://127.0.0.1:{srv.server_address[1]}"
+    srv.shutdown()
+
+
+def test_read_parquet_over_http_with_ranged_reads(http_server):
+    reset_io_stats()
+    df = daft_tpu.read_parquet(f"{http_server}/data.parquet")
+    out = df.where(daft_tpu.col("a") < 5).to_pydict()
+    assert out["a"] == [0, 1, 2, 3, 4]
+    s = IO_STATS.snapshot()
+    # The parquet reader issues multiple ranged gets (footer + row groups)
+    # through HttpReadableFile, never one whole-object download per touch.
+    assert s.gets >= 2
+    assert s.files_opened >= 1
+
+
+def test_http_readable_file_ranges(http_server):
+    from daft_tpu.io.http_source import HttpReadableFile
+
+    f = HttpReadableFile(f"{http_server}/blob.bin")
+    assert f.size() == 256 * 64
+    f.seek(256)
+    assert f.read(4) == bytes([0, 1, 2, 3])
+    f.seek(-4, 2)
+    assert f.read() == bytes([252, 253, 254, 255])
+
+
+def test_http_get_server_ignoring_range(http_root):
+    """A server that ignores Range (plain SimpleHTTPRequestHandler) returns
+    200 + full body; http_get must slice locally."""
+    handler = lambda *a, **kw: http.server.SimpleHTTPRequestHandler(  # noqa: E731
+        *a, directory=str(http_root), **kw)
+    handler = type("Quiet", (http.server.SimpleHTTPRequestHandler,),
+                   {"log_message": lambda self, *a: None,
+                    "__init__": lambda self, *a, **kw:
+                        http.server.SimpleHTTPRequestHandler.__init__(
+                            self, *a, directory=str(http_root), **kw)})
+    srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0), handler)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        from daft_tpu.io.http_source import http_get
+
+        url = f"http://127.0.0.1:{srv.server_address[1]}/blob.bin"
+        assert http_get(url, 256, 4) == bytes([0, 1, 2, 3])
+    finally:
+        srv.shutdown()
+
+
+def test_hf_url_resolution():
+    from daft_tpu.io.http_source import resolve_hf_url
+
+    assert resolve_hf_url("hf://datasets/org/repo/f.parquet") == \
+        "https://huggingface.co/datasets/org/repo/resolve/main/f.parquet"
+    assert resolve_hf_url("hf://datasets/org/repo@v2/dir/f.parquet") == \
+        "https://huggingface.co/datasets/org/repo/resolve/v2/dir/f.parquet"
+    assert resolve_hf_url("hf://org/repo/f.txt") == \
+        "https://huggingface.co/org/repo/resolve/main/f.txt"
+    with pytest.raises(DaftIOError):
+        resolve_hf_url("hf://justonepart")
+
+
+def test_read_parquet_hf_scheme(http_server, monkeypatch):
+    import daft_tpu.io.http_source as hs
+
+    monkeypatch.setattr(hs, "HF_RESOLVE_BASE", http_server)
+    out = daft_tpu.read_parquet("hf://datasets/org/repo/part0.parquet").to_pydict()
+    assert out["a"] == list(range(10))
+
+
+def test_parallel_ranged_read(tmp_path):
+    p = tmp_path / "x.bin"
+    data = bytes(range(256)) * 100
+    p.write_bytes(data)
+    ranges = [(0, 10), (100, 50), (25000, 600), (len(data) - 7, 7)]
+    out = parallel_ranged_read(str(p), ranges, max_concurrency=4)
+    for (start, length), got in zip(ranges, out):
+        assert got == data[start:start + length]
+
+
+def test_parallel_ranged_read_retries(tmp_path, monkeypatch):
+    p = tmp_path / "x.bin"
+    p.write_bytes(b"0123456789")
+    import daft_tpu.io.iostats as iostats
+
+    real = iostats.read_range
+    fails = {"n": 0}
+
+    def flaky(path, start, length, io_config=None):
+        if start == 4 and fails["n"] < 2:
+            fails["n"] += 1
+            raise ConnectionError("transient")
+        return real(path, start, length, io_config)
+
+    monkeypatch.setattr(iostats, "read_range", flaky)
+    monkeypatch.setattr("time.sleep", lambda s: None)
+    out = parallel_ranged_read(str(p), [(0, 4), (4, 4)],
+                               policy=RetryPolicy(max_retries=3,
+                                                  backoff_base_s=0.0))
+    assert out == [b"0123", b"4567"]
+    assert fails["n"] == 2
+
+
+class _FlakyFS:
+    """Delegating pyarrow-fs wrapper: first `fail_first` part writes raise."""
+
+    def __init__(self, inner, fail_first: int = 0):
+        self.inner = inner
+        self.fail_first = fail_first
+        self.part_writes = 0
+
+    def __getattr__(self, name):
+        return getattr(self.inner, name)
+
+    def open_output_stream(self, path, *a, **kw):
+        if ".daft-parts/" in path:
+            self.part_writes += 1
+            if self.part_writes <= self.fail_first:
+                raise ConnectionError(f"injected failure #{self.part_writes}")
+        return self.inner.open_output_stream(path, *a, **kw)
+
+
+def test_multipart_upload_roundtrip_with_retries(tmp_path, monkeypatch):
+    monkeypatch.setattr("time.sleep", lambda s: None)
+    reset_io_stats()
+    target = str(tmp_path / "big.bin")
+    fs = _FlakyFS(pafs.LocalFileSystem(), fail_first=2)
+    up = MultipartUpload(target, part_size=1 << 20, max_concurrency=3,
+                         filesystem=fs,
+                         policy=RetryPolicy(max_retries=3, backoff_base_s=0.0))
+    rng = np.random.default_rng(0)
+    payload = rng.integers(0, 256, 5 * (1 << 20) + 1234, dtype=np.uint8).tobytes()
+    for off in range(0, len(payload), 700_000):
+        up.write(payload[off:off + 700_000])
+    written = up.close()
+    assert written == len(payload)
+    assert open(target, "rb").read() == payload
+    assert not os.path.exists(target + ".daft-parts")
+    assert IO_STATS.snapshot().retries >= 2
+
+
+def test_multipart_upload_resume_skips_staged_parts(tmp_path):
+    target = str(tmp_path / "out.bin")
+    part0 = b"A" * (1 << 20)
+    part1 = b"B" * 1000
+    # A previous attempt staged part 00000 already.
+    os.makedirs(target + ".daft-parts")
+    with open(target + ".daft-parts/00000", "wb") as f:
+        f.write(part0)
+
+    class CountingFS(_FlakyFS):
+        def __init__(self, inner):
+            super().__init__(inner)
+            self.paths = []
+
+        def open_output_stream(self, path, *a, **kw):
+            self.paths.append(path)
+            return super().open_output_stream(path, *a, **kw)
+
+    fs = CountingFS(pafs.LocalFileSystem())
+    up = MultipartUpload(target, part_size=1 << 20, filesystem=fs)
+    up.write(part0)
+    up.write(part1)
+    assert up.close() == len(part0) + len(part1)
+    assert open(target, "rb").read() == part0 + part1
+    # part 00000 was already staged with the right size -> never re-written.
+    assert not any(p.endswith("/00000") for p in fs.paths)
+
+
+def test_multipart_failure_keeps_parts_for_resume(tmp_path, monkeypatch):
+    monkeypatch.setattr("time.sleep", lambda s: None)
+    target = str(tmp_path / "f.bin")
+    fs = _FlakyFS(pafs.LocalFileSystem(), fail_first=99)
+    up = MultipartUpload(target, part_size=1000, filesystem=fs,
+                         policy=RetryPolicy(max_retries=1, backoff_base_s=0.0))
+    up.write(b"x" * 2500)
+    with pytest.raises(DaftIOError, match="resume"):
+        up.close()
+    assert not os.path.exists(target)
+
+
+def test_with_retries_respects_policy(monkeypatch):
+    sleeps = []
+    monkeypatch.setattr("time.sleep", lambda s: sleeps.append(s))
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise TimeoutError("slow")
+        return 42
+
+    assert with_retries(flaky, RetryPolicy(max_retries=4,
+                                           backoff_base_s=0.1)) == 42
+    assert calls["n"] == 3 and len(sleeps) == 2
+    assert sleeps[1] > sleeps[0] * 0.9  # backoff grows (jitter aside)
+
+    with pytest.raises(ValueError):
+        with_retries(lambda: (_ for _ in ()).throw(ValueError("fatal")),
+                     RetryPolicy(max_retries=5))
+
+
+def test_glob_keeps_full_uri_for_remote(http_server):
+    from daft_tpu.io.scan import glob_paths
+
+    files = glob_paths([f"{http_server}/data.parquet"])
+    assert files[0].path.startswith("http://127.0.0.1")
+    assert files[0].size_bytes and files[0].size_bytes > 0
+
+
+def test_read_huggingface_repo_listing(http_server, http_root, monkeypatch):
+    """Repo-level read_huggingface lists parquet files via the dataset-viewer
+    API, then reads them as ranged HTTP objects."""
+    import json
+
+    api_dir = http_root / "api" / "datasets" / "org" / "repo"
+    api_dir.mkdir(parents=True, exist_ok=True)
+    (api_dir / "parquet").write_text(json.dumps({
+        "default": {"train": [
+            f"{http_server}/datasets/org/repo/resolve/main/part0.parquet"]}}))
+    import daft_tpu.io.http_source as hs
+
+    monkeypatch.setattr(hs, "HF_RESOLVE_BASE", http_server)
+    out = daft_tpu.read_huggingface("org/repo").to_pydict()
+    assert out["a"] == list(range(10))

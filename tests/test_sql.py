@@ -506,3 +506,97 @@ def test_greatest_least_mixed_bool_int():
                        t=t).to_pydict()
     assert out["g"] == [1, 5, 2]
     assert out["l"] == [0, 0, 2]
+
+
+# -------------- statements (EXPLAIN / DDL / DML / table functions) ------ #
+def test_sql_explain(people):
+    out = daft_tpu.sql("EXPLAIN SELECT name FROM people WHERE age > 20",
+                       people=people).to_pydict()
+    assert len(out["plan"]) == 1
+    assert "Filter" in out["plan"][0] or "Scan" in out["plan"][0]
+
+
+def test_sql_explain_analyze(people):
+    out = daft_tpu.sql("EXPLAIN ANALYZE SELECT count(*) AS n FROM people",
+                       people=people).to_pydict()
+    assert "== Analyze ==" in out["plan"][0]
+    assert "rows: 1" in out["plan"][0]
+
+
+def test_sql_create_drop_table(people):
+    s = daft_tpu.Session()
+    r = s.sql("CREATE TEMP TABLE adults AS SELECT * FROM people WHERE age >= 21",
+              people=people).to_pydict()
+    assert r == {"table": ["adults"], "created": [True]}
+    assert s.sql("SELECT count(*) AS n FROM adults").to_pydict() == {"n": [3]}
+    with pytest.raises(Exception, match="already exists"):
+        s.sql("CREATE TABLE adults AS SELECT 1 AS x")
+    s.sql("CREATE OR REPLACE TABLE adults AS SELECT * FROM people WHERE age > 40",
+          people=people)
+    assert s.sql("SELECT count(*) AS n FROM adults").to_pydict() == {"n": [1]}
+    assert s.sql("CREATE TABLE IF NOT EXISTS adults AS SELECT 1 AS x") \
+        .to_pydict()["created"] == [False]
+    assert s.sql("DROP TABLE adults").to_pydict()["dropped"] == [True]
+    with pytest.raises(Exception, match="Unknown table"):
+        s.sql("DROP TABLE adults")
+    assert s.sql("DROP TABLE IF EXISTS adults").to_pydict()["dropped"] == [False]
+
+
+def test_sql_insert_into(people):
+    s = daft_tpu.Session()
+    s.sql("CREATE TABLE t AS SELECT name, age FROM people WHERE age < 20",
+          people=people)
+    r = s.sql("INSERT INTO t SELECT name, age FROM people WHERE age > 40",
+              people=people).to_pydict()
+    assert r["rows_inserted"] == [1]
+    out = s.sql("SELECT name FROM t ORDER BY name").to_pydict()
+    assert out["name"] == ["cat", "dan"]
+    s.sql("INSERT INTO t VALUES ('zed', 99), ('amy', 3)")
+    assert s.sql("SELECT count(*) AS n FROM t").to_pydict() == {"n": [4]}
+    assert s.sql("SELECT age FROM t WHERE name = 'zed'").to_pydict()["age"] == [99]
+
+
+def test_sql_show_tables(people):
+    s = daft_tpu.Session()
+    s.sql("CREATE TABLE alpha AS SELECT 1 AS x")
+    s.sql("CREATE TABLE beta AS SELECT 2 AS y")
+    names = s.sql("SHOW TABLES").to_pydict()["table"]
+    assert set(names) >= {"alpha", "beta"}
+
+
+def test_sql_table_function_read_parquet(tmp_path, people):
+    people.write_parquet(str(tmp_path))
+    out = daft_tpu.sql(
+        f"SELECT count(*) AS n FROM read_parquet('{tmp_path}')").to_pydict()
+    assert out == {"n": [4]}
+    out2 = daft_tpu.sql(
+        f"SELECT p.name FROM read_parquet('{tmp_path}') p WHERE p.age > 40"
+    ).to_pydict()
+    assert out2["name"] == ["dan"]
+
+
+def test_sql_table_function_range_and_join(people):
+    out = daft_tpu.sql("SELECT count(*) AS n FROM range(10)").to_pydict()
+    assert out == {"n": [10]}
+    out2 = daft_tpu.sql(
+        "SELECT id FROM range(2, 8, 2) ORDER BY id").to_pydict()
+    assert out2["id"] == [2, 4, 6]
+
+
+def test_sql_explain_ddl_has_no_side_effects(people):
+    """EXPLAIN of DDL/DML describes without executing (review r4 finding)."""
+    s = daft_tpu.Session()
+    out = s.sql("EXPLAIN CREATE TABLE nope AS SELECT * FROM people",
+                people=people).to_pydict()
+    assert "CreateTable" in out["plan"][0]
+    assert s.get_table("nope") is None  # NOT created
+    with pytest.raises(Exception, match="SELECT only"):
+        s.sql("EXPLAIN ANALYZE DROP TABLE x")
+
+
+def test_sql_show_tables_like_sql_wildcards(people):
+    s = daft_tpu.Session()
+    s.sql("CREATE TEMP TABLE footmp AS SELECT 1 AS x")
+    s.sql("CREATE TABLE barcat AS SELECT 2 AS y")
+    out = s.sql("SHOW TABLES LIKE 'bar%'").to_pydict()
+    assert out["table"] == ["barcat"]
