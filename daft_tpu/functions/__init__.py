@@ -178,9 +178,12 @@ def dense_rank() -> Expression:
 
 
 def monotonically_increasing_id() -> Expression:
-    raise NotImplementedError(
-        "Use DataFrame.add_monotonically_increasing_id() (plan-level op)"
-    )
+    """Marker expression; the optimizer's DetectMonotonicId rule rewrites the
+    containing projection into a MonotonicallyIncreasingId plan op
+    (reference: optimization/rules/detect_monotonic_id.rs)."""
+    from daft_tpu.expressions.expr import FunctionCall
+
+    return Expression(FunctionCall("monotonically_increasing_id", []))
 
 
 def __getattr__(name: str):

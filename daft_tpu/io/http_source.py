@@ -198,8 +198,13 @@ class HttpFileSystemHandler(pafs.FileSystemHandler):
                 meta = http_head(self._url(p), self.policy, self.headers)
                 out.append(pafs.FileInfo(p, pafs.FileType.File,
                                          size=meta["size"] or -1))
-            except Exception:
-                out.append(pafs.FileInfo(p, pafs.FileType.NotFound))
+            except _HttpStatusError as e:
+                # Only genuine absence maps to NotFound; auth/server errors
+                # must surface (a 403 on a private dataset is not "no file").
+                if e.status in (404, 410):
+                    out.append(pafs.FileInfo(p, pafs.FileType.NotFound))
+                else:
+                    raise
         return out
 
     def get_file_info_selector(self, selector):

@@ -156,11 +156,15 @@ def glob_paths(paths: Sequence[str], io_config=None) -> List[FileInfo]:
             # them); reattach the RESOLVED scheme — e.g. hf:// paths resolve
             # to https URLs, so the stored path is the https one.
             scheme = path.split("://", 1)[0]
-            if isinstance(fs, pafs.PyFileSystem):
+            is_http = isinstance(fs, pafs.PyFileSystem)
+            if is_http:
                 scheme = getattr(fs.handler, "scheme", scheme)
             full = lambda q: f"{scheme}://{q}"  # noqa: E731
-            # Support trailing glob on the basename and directories.
-            if any(ch in p for ch in "*?["):
+            # Support trailing glob on the basename and directories. HTTP
+            # sources are never glob-expanded: '?' there starts a query
+            # string (presigned URLs), not a wildcard, and listing is
+            # impossible anyway.
+            if not is_http and any(ch in p for ch in "*?["):
                 base = p.split("*")[0].rsplit("/", 1)[0]
                 sel = pafs.FileSelector(base, recursive=True)
                 import fnmatch

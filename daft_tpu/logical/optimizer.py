@@ -96,11 +96,15 @@ class Optimizer:
         self.cfg = cfg or get_context().execution_config
         self.batches: List[List[Rule]] = [
             [UnnestSubqueries()],
+            [DetectMonotonicId()],
             [SimplifyExpressions()],
             [SplitUDFs()],
             [EliminateCrossJoin(), PushDownFilter(), PushDownSemiAnti(),
              PushDownShard(), DropRepartition()],
             [PushDownLimit()],
+            [EnrichWithStats()],
+            [PushDownAggregation()],
+            [FilterNullJoinKey(), PushDownFilter()],
             [ReorderJoins(self.cfg)],
             [PushDownProjection()],
         ]
@@ -488,6 +492,251 @@ class PushDownSemiAnti(Rule):
                                 list(node.right_on), node.how)
                 return left.with_children([a, new_b])
         return None
+
+
+class DetectMonotonicId(Rule):
+    """Rewrite projections containing ``monotonically_increasing_id()`` into
+    the MonotonicallyIncreasingId plan op (reference:
+    optimization/rules/detect_monotonic_id.rs)."""
+
+    name = "DetectMonotonicId"
+
+    @staticmethod
+    def _has_call(e: Expr) -> bool:
+        from daft_tpu.expressions.expr import FunctionCall
+
+        return any(isinstance(n, FunctionCall)
+                   and n.fn_name == "monotonically_increasing_id"
+                   for n in e.walk())
+
+    def rewrite(self, node):
+        from daft_tpu.expressions.expr import FunctionCall
+
+        if not isinstance(node, lp.Project):
+            return None
+        if not any(self._has_call(e) for e in node.exprs):
+            return None
+        tmp = "__mono_id"
+        child = lp.MonotonicallyIncreasingId(node.children()[0], tmp)
+
+        def sub(n: Expr):
+            if isinstance(n, FunctionCall) and \
+                    n.fn_name == "monotonically_increasing_id":
+                return ColumnRef(tmp)
+            return None
+
+        return lp.Project(child, [e.transform(sub) for e in node.exprs])
+
+
+class EnrichWithStats(Rule):
+    """Materialize parquet footer statistics into the scan's FileInfos: exact
+    row counts, per-column null counts and min/max (reference:
+    optimization/rules/{enrich_with_stats.rs,materialize_scans.rs}). The
+    stats feed cardinality estimates (ScanSource.approx_stats), ReorderJoins'
+    broadcast-side choice, PushDownAggregation's metadata-only count, and
+    FilterNullJoinKey's null evidence. Pure side-table mutation: the plan
+    shape never changes, so the rule engine's fixpoint is unaffected."""
+
+    name = "EnrichWithStats"
+    MAX_FOOTER_READS = 64
+
+    def rewrite(self, node):
+        if not isinstance(node, lp.ScanSource):
+            return None
+        info = node.scan_info
+        if getattr(info, "file_format", None) != "parquet" or \
+                getattr(info, "_stats_enriched", False):
+            return None
+        info._stats_enriched = True
+        import pyarrow.parquet as pq
+
+        from daft_tpu.io.scan import resolve_filesystem
+
+        col_stats: dict = {}
+        try:
+            files = info.files()
+        except Exception:
+            return None
+
+        def read_footer(f):
+            try:
+                fs, p = resolve_filesystem(f.path, info.read_options.get("io_config"))
+                return f, pq.ParquetFile(fs.open_input_file(p)).metadata
+            except Exception:  # unreadable footer: keep going without stats
+                return f, None
+
+        targets = files[:self.MAX_FOOTER_READS]
+        if len(targets) > 1:
+            from concurrent.futures import ThreadPoolExecutor
+
+            with ThreadPoolExecutor(max_workers=min(8, len(targets)),
+                                    thread_name_prefix="daft-footer") as pool:
+                metas = list(pool.map(read_footer, targets))
+        else:
+            metas = [read_footer(f) for f in targets]
+        for f, meta in metas:
+            if meta is None:
+                continue
+            f.num_rows = meta.num_rows
+            for rg in range(meta.num_row_groups):
+                g = meta.row_group(rg)
+                for ci in range(g.num_columns):
+                    c = g.column(ci)
+                    path = c.path_in_schema
+                    st = c.statistics
+                    if "." in path:
+                        # Nested leaf: leaf-level null counts don't compose
+                        # into a root-column null count — mark unknown.
+                        root = path.split(".", 1)[0]
+                        col_stats.setdefault(
+                            root, {"null_count": None, "min": None,
+                                   "max": None})["null_count"] = None
+                        continue
+                    slot = col_stats.setdefault(
+                        path, {"null_count": 0, "min": None, "max": None})
+                    if st is None or st.null_count is None:
+                        slot["null_count"] = None  # unknown -> never trust
+                    elif slot["null_count"] is not None:
+                        slot["null_count"] += st.null_count
+                    if st is not None and st.has_min_max:
+                        if slot["min"] is None or st.min < slot["min"]:
+                            slot["min"] = st.min
+                        if slot["max"] is None or st.max > slot["max"]:
+                            slot["max"] = st.max
+        info._column_stats = col_stats
+        return None
+
+
+class PushDownAggregation(Rule):
+    """Global COUNT over a bare parquet scan answers from footer metadata
+    (reference: optimization/rules/push_down_aggregation.rs): every file's
+    exact row count is known after EnrichWithStats, so the scan (and its IO)
+    disappears entirely."""
+
+    name = "PushDownAggregation"
+
+    def rewrite(self, node):
+        from daft_tpu.expressions.expr import Literal
+        from daft_tpu.micropartition import MicroPartition
+
+        if not isinstance(node, lp.Aggregate) or node.group_by:
+            return None
+        if len(node.agg_exprs) != 1:
+            return None
+        agg = _strip_alias(node.agg_exprs[0])
+        if not isinstance(agg, AggOp) or agg.op != "count":
+            return None
+        mode = agg.kwargs.get("mode", "valid") if agg.kwargs else "valid"
+        child = node.children()[0]
+        if not isinstance(child, lp.ScanSource):
+            return None
+        pd = child.pushdowns
+        if pd.filters is not None or pd.limit is not None or pd.shard is not None:
+            return None
+        info = child.scan_info
+        if not getattr(info, "_stats_enriched", False):
+            return None
+        files = info.files()
+        if not files or any(f.num_rows is None for f in files):
+            return None
+        total = sum(f.num_rows for f in files)
+        if mode != "all":
+            # count(col): subtract the column's footer null count (exact);
+            # bail if any footer lacked it.
+            ref = agg.child
+            if not isinstance(ref, ColumnRef):
+                return None
+            stats = getattr(info, "_column_stats", {}).get(ref.name())
+            if mode == "valid":
+                if not stats or stats["null_count"] is None:
+                    return None
+                total -= stats["null_count"]
+            elif mode == "null":
+                if not stats or stats["null_count"] is None:
+                    return None
+                total = stats["null_count"]
+            else:
+                return None
+        name = node.agg_exprs[0].name()
+        import numpy as np
+
+        part = MicroPartition.from_pydict(
+            {name: np.array([total], dtype=np.uint64)})
+        return lp.InMemorySource([part], node.schema)
+
+
+class FilterNullJoinKey(Rule):
+    """Insert not-null filters on join sides whose null keys can never
+    survive the join (reference: optimization/rules/filter_null_join_key.rs)
+    — shrinking join inputs before the hash table is built, and giving the
+    filter pushdown a predicate to carry to the scan.
+
+    Fires only with EVIDENCE of nulls (in-memory key columns measured, or
+    parquet footer null counts from EnrichWithStats): without evidence the
+    inserted filter is a pure per-row cost. _already_filtering guards
+    idempotence against the filter-pushdown ping-pong."""
+
+    name = "FilterNullJoinKey"
+
+    # sides whose null-keyed rows are always discarded
+    FILTERABLE = {"inner": (0, 1), "left": (1,), "right": (0,),
+                  "semi": (0, 1), "anti": (1,)}
+
+    def rewrite(self, node):
+        if not isinstance(node, lp.Join) or node.how not in self.FILTERABLE:
+            return None
+        sides = [node.children()[0], node.children()[1]]
+        keys = [node.left_on, node.right_on]
+        changed = False
+        for i in self.FILTERABLE[node.how]:
+            preds = []
+            for k in keys[i]:
+                if not isinstance(k, ColumnRef):
+                    continue
+                nn = UnaryOp("not_null", k)
+                if _already_filtering(sides[i], nn):
+                    continue
+                if self._may_have_nulls(sides[i], k.name()):
+                    preds.append(nn)
+            if preds:
+                sides[i] = lp.Filter(sides[i], _and_all(preds))
+                changed = True
+        if not changed:
+            return None
+        return node.with_children(sides)
+
+    @staticmethod
+    def _may_have_nulls(side, col: str) -> bool:
+        """True only with positive evidence of nulls in `col`."""
+        node = side
+        while isinstance(node, (lp.Filter, lp.Sort, lp.Limit)):
+            node = node.children()[0]
+        if isinstance(node, lp.Project):
+            mapping = {p.name(): _strip_alias(p) for p in node.exprs}
+            m = mapping.get(col)
+            if not isinstance(m, ColumnRef):
+                return False
+            return FilterNullJoinKey._may_have_nulls(node.children()[0], m.name())
+        if isinstance(node, lp.InMemorySource):
+            cache = getattr(node, "_nullcount_cache", None)
+            if cache is None:
+                cache = node._nullcount_cache = {}
+            if col not in cache:
+                n = 0
+                try:
+                    # Per-batch null_count is O(1) arrow metadata — never
+                    # combined() here (a full concat per optimizer pass).
+                    for part in node.partitions:
+                        for rb in part.record_batches():
+                            n += rb.get_column(col).null_count()
+                except Exception:
+                    n = 0
+                cache[col] = n
+            return cache[col] > 0
+        if isinstance(node, lp.ScanSource):
+            stats = getattr(node.scan_info, "_column_stats", {}).get(col)
+            return bool(stats and stats.get("null_count"))
+        return False
 
 
 class PushDownProjection(Rule):

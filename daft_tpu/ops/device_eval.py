@@ -13,9 +13,12 @@ so inputs are padded to a small set of bucket sizes (cfg.device_batch_buckets)
 before dispatch; jax.jit's shape-keyed cache then sees only O(#buckets) shapes
 per expression structure.
 
-Null semantics: fusion only engages when every referenced input column is
-null-free (the common case for decoded tensor/embedding/image columns). Any
-nulls → fall back to the host path, which is bit-exact on null propagation.
+Null semantics: nullable inputs stage zero-filled with HOST-side validity
+bitmaps; each fused output's validity is the AND-reduce of its referenced
+inputs' validities, which is bit-exact against the host for arithmetic /
+comparison / cast chains. Expressions whose null propagation differs from
+that law — Kleene and/or, IfElse, registry kernels with their own null
+rules — fall back to the host when any referenced input is nullable.
 """
 
 from __future__ import annotations
@@ -101,6 +104,19 @@ def _is_fusable(expr: Expr, schema) -> bool:
                 return False
         else:
             return False
+    return True
+
+
+def _nullable_safe(expr: Expr) -> bool:
+    """True when the expression's null propagation is exactly the AND-reduce
+    of its input validities (output null iff ANY referenced input null)."""
+    for node in expr.walk():
+        if isinstance(node, IfElse):
+            return False
+        if isinstance(node, FunctionCall):
+            return False  # registry kernels define their own null rules
+        if isinstance(node, BinaryOp) and node.op in ("and", "or", "xor"):
+            return False  # Kleene logic: true OR null = true, not null
     return True
 
 
@@ -225,13 +241,28 @@ def try_evaluate_fused(rb, exprs: Sequence[Expr]) -> Optional[Dict[int, Series]]
             needed_cols |= e.column_refs()
     if not chosen:
         return None
-    # Null-free requirement (see module docstring).
+    # Nullable inputs ride along as HOST-side validity masks: values stage
+    # zero-filled, the device computes densely, and each output's validity is
+    # the AND-reduce of its referenced columns' validity (VERDICT r3 #9).
+    # That propagation law only matches the host for arithmetic/comparison/
+    # cast chains — Kleene and/or (true OR null = true), IfElse (unselected
+    # branch's null is ignored), and registry kernels with their own null
+    # rules (e.g. GREATEST skips nulls) stay on the host when any input is
+    # nullable.
     cols_np: Dict[str, np.ndarray] = {}
+    null_masks: Dict[str, np.ndarray] = {}
     for name in needed_cols:
         s = rb.get_column(name)
-        if s.null_count() > 0:
+        vals, mask = s.to_numpy_masked()
+        cols_np[name] = vals
+        if mask is not None:
+            null_masks[name] = mask
+    if null_masks:
+        chosen = [i for i in chosen
+                  if not (exprs[i].column_refs() & set(null_masks))
+                  or _nullable_safe(exprs[i])]
+        if not chosen:
             return None
-        cols_np[name] = s.to_numpy()
     padded = _bucket(n, cfg.device_batch_buckets)
     cols_dev: Dict[str, jax.Array] = {}
     try:
@@ -252,6 +283,14 @@ def try_evaluate_fused(rb, exprs: Sequence[Expr]) -> Optional[Dict[int, Series]]
             s = Series.from_numpy(arr, e.name(), _np_result_dtype(target, arr))
             if s.dtype != target:
                 s = s.cast(target)
+            if null_masks:
+                out_mask = None
+                for ref in e.column_refs():
+                    m = null_masks.get(ref)
+                    if m is not None:
+                        out_mask = m if out_mask is None else (out_mask | m)
+                if out_mask is not None:
+                    s = s._with_mask(out_mask)
             result[i] = s
         return result
     except Exception:

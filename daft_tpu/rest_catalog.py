@@ -120,7 +120,11 @@ class IcebergRestCatalog(Catalog):
                                       body, self.headers)
 
     def _tables_path(self, namespace: str) -> str:
-        return f"/v1{self.prefix}/namespaces/{namespace}/tables"
+        # Multipart namespaces join on 0x1F, percent-encoded in the URL
+        # (Iceberg REST spec multipart-namespace encoding).
+        from urllib.parse import quote
+
+        return f"/v1{self.prefix}/namespaces/{quote(namespace, safe='')}/tables"
 
     @staticmethod
     def _split(name: str) -> tuple:
@@ -144,7 +148,7 @@ class IcebergRestCatalog(Catalog):
 
         names: List[str] = []
         for ns in self.list_namespaces():
-            out = self._req("GET", self._tables_path(ns))
+            out = self._req("GET", self._tables_path(ns.replace(".", "\x1f")))
             for ident in out.get("identifiers", []):
                 names.append(".".join(ident["namespace"]) + "." + ident["name"])
         if pattern:
@@ -181,9 +185,12 @@ class IcebergRestCatalog(Catalog):
         location = f"{self.warehouse.rstrip('/')}/{ns.replace(chr(31), '/')}/{tbl}"
         from daft_tpu.io.iceberg import write_table
 
+        from urllib.parse import quote
+
         write_table(source, location, mode="overwrite")
         meta_location = self._latest_metadata(location)
-        self._req("POST", f"/v1{self.prefix}/namespaces/{ns}/register",
+        self._req("POST",
+                  f"/v1{self.prefix}/namespaces/{quote(ns, safe='')}/register",
                   {"name": tbl, "metadata-location": meta_location})
         return IcebergRestTable(name, meta_location, self.io_config)
 

@@ -102,8 +102,15 @@ class Session:
     def drop_table(self, name: str) -> None:
         if name in self._temp_tables:
             del self._temp_tables[name]
-        else:
-            self.current_catalog.drop_table(name)
+            return
+        # Catalog-qualified names route like get_table/create_table.
+        if "." in name:
+            cat_name, tbl = name.split(".", 1)
+            cat = self._catalogs.get(cat_name)
+            if cat is not None and cat.has_table(tbl):
+                cat.drop_table(tbl)
+                return
+        self.current_catalog.drop_table(name)
 
     # -- sql --------------------------------------------------------------
     def sql(self, query: str, **bindings):

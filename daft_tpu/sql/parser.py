@@ -335,7 +335,7 @@ class Parser:
                 self._expect_word("not")
                 self._expect_word("exists")
                 if_not_exists = True
-            name = self._ident_like()
+            name = self._qualified_name()
             self.expect("kw", "as")
             select = self._parse_statement_inner()
             if not isinstance(select, SelectStmt):
@@ -350,11 +350,11 @@ class Parser:
             if self._accept_word("if"):
                 self._expect_word("exists")
                 if_exists = True
-            return DropTableStmt(self._ident_like(), if_exists=if_exists)
+            return DropTableStmt(self._qualified_name(), if_exists=if_exists)
         if word == "insert":
             self.next()
             self._expect_word("into")
-            name = self._ident_like()
+            name = self._qualified_name()
             if self._at_values():
                 return InsertStmt(name, self._parse_values())
             select = self._parse_statement_inner()
@@ -381,6 +381,13 @@ class Parser:
         stmt = self.parse_select()
         stmt.ctes = ctes
         return stmt
+
+    def _qualified_name(self) -> str:
+        """Dotted identifier: table, ns.table, catalog.ns.table."""
+        name = self._ident_like()
+        while self.accept("op", "."):
+            name += "." + self._ident_like()
+        return name
 
     def _accept_word(self, word: str) -> bool:
         """Accept an ident-or-keyword token by (case-insensitive) word."""

@@ -126,10 +126,7 @@ def _execute_statement(stmt, bindings: Dict[str, object], session=None):
             if stmt.if_exists:
                 return from_pydict({"table": [stmt.name], "dropped": [False]})
             raise DaftValueError(f"Unknown table {stmt.name!r}")
-        try:
-            sess.drop_table(stmt.name)
-        except Exception:
-            sess.detach_table(stmt.name)  # temp tables detach
+        sess.drop_table(stmt.name)  # catalog failures surface to the caller
         return from_pydict({"table": [stmt.name], "dropped": [True]})
     if isinstance(stmt, InsertStmt):
         table = sess.get_table(stmt.name)

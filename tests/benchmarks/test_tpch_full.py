@@ -667,6 +667,7 @@ def test_write_report(T):
         path = os.path.join(os.path.dirname(__file__), "..", "..", "BENCH_TPCH.json")
         with open(os.path.abspath(path), "w") as f:
             json.dump({"sf": SF, "runner": os.environ.get("DAFT_RUNNER", "native"),
+                       "cpu_cores": os.cpu_count(),
                        "times_sec": dict(sorted(_TIMES.items())),
                        "total_sec": round(sum(_TIMES.values()), 3)}, f, indent=1)
 

@@ -122,13 +122,14 @@ def test_describe_summarize_into_batches():
 
 
 def test_integration_reader_stubs():
-    # lance/huggingface remain gated on unavailable integrations; iceberg /
-    # deltalake / hudi are native readers now (tests/test_table_formats.py)
-    # and fail on a non-table path instead.
-    for name in ("read_lance", "read_huggingface"):
-        fn = getattr(daft_tpu, name)
-        with pytest.raises(Exception, match="integration"):
-            fn("anything")
+    # lance remains gated on the unavailable pylance integration; iceberg /
+    # deltalake / hudi are native readers now (tests/test_table_formats.py),
+    # huggingface is a native hf:// HTTP source (tests/test_io_native.py) —
+    # these fail on bad paths instead.
+    with pytest.raises(Exception, match="integration"):
+        daft_tpu.read_lance("anything")
+    with pytest.raises(Exception, match="hf://"):
+        daft_tpu.read_huggingface("not-a-repo-path")
     for name in ("read_iceberg", "read_deltalake", "read_hudi"):
         fn = getattr(daft_tpu, name)
         with pytest.raises(Exception):

@@ -210,3 +210,104 @@ def test_correlated_complex_subquery_rejected():
             SELECT c_id FROM cust WHERE c_id IN (
                 SELECT c_id FROM orders WHERE total > cust.total GROUP BY c_id)""",
               cust=cust, orders=orders).collect()
+
+
+def _nodes(plan):
+    out, seen = [], set()
+
+    def walk(n):
+        if id(n) in seen:
+            return
+        seen.add(id(n))
+        out.append(n)
+        for c in n.children():
+            walk(c)
+
+    walk(plan)
+    return out
+
+
+def test_detect_monotonic_id_expression():
+    """monotonically_increasing_id() in a projection becomes the plan op
+    (reference: detect_monotonic_id.rs)."""
+    from daft_tpu.functions import monotonically_increasing_id
+
+    df = daft_tpu.from_pydict({"a": [10, 20, 30]})
+    out = df.select(col("a"), (monotonically_increasing_id() + 1).alias("rid"))
+    plan = _optimized(out)
+    assert any(isinstance(n, lp.MonotonicallyIncreasingId) for n in _nodes(plan))
+    got = out.to_pydict()
+    assert got["rid"] == [1, 2, 3]
+    assert got["a"] == [10, 20, 30]
+
+
+def test_enrich_with_stats_and_count_pushdown(tmp_path):
+    """Global count(*) over a bare parquet scan answers from footer metadata:
+    the optimized plan has NO ScanSource left (push_down_aggregation.rs)."""
+    df = daft_tpu.from_pydict({"x": list(range(500)),
+                               "y": [None if i % 5 == 0 else i for i in range(500)]})
+    df.write_parquet(str(tmp_path))
+    scan = daft_tpu.read_parquet(str(tmp_path))
+
+    n_plan = _optimized(scan.agg(col("x").count().alias("n")))
+    assert not any(isinstance(n, lp.ScanSource) for n in _nodes(n_plan)), \
+        "count should be answered from parquet footers"
+    # Values: count(x) = 500 (no nulls), count(y) skips the 100 nulls.
+    assert scan.agg(col("x").count().alias("n")).to_pydict() == {"n": [500]}
+    assert scan.agg(col("y").count().alias("n")).to_pydict() == {"n": [400]}
+    # A filtered count must NOT be metadata-answered.
+    f_plan = _optimized(scan.where(col("x") > 10).agg(col("x").count().alias("n")))
+    assert any(isinstance(n, lp.ScanSource) for n in _nodes(f_plan))
+    assert scan.where(col("x") > 10).agg(col("x").count().alias("n")).to_pydict() == {"n": [489]}
+
+
+def test_enrich_with_stats_row_counts(tmp_path):
+    df = daft_tpu.from_pydict({"a": list(range(123))})
+    df.write_parquet(str(tmp_path))
+    scan = daft_tpu.read_parquet(str(tmp_path))
+    plan = _optimized(scan.where(col("a") > 5))
+    src = [n for n in _nodes(plan) if isinstance(n, lp.ScanSource)][0]
+    assert all(f.num_rows is not None for f in src.scan_info.files())
+    assert sum(f.num_rows for f in src.scan_info.files()) == 123
+    assert "a" in src.scan_info._column_stats
+
+
+def test_filter_null_join_key_with_evidence():
+    """Join keys with measured nulls get not-null filters on the discarding
+    side (filter_null_join_key.rs); clean keys add no filter."""
+    left = daft_tpu.from_pydict({"k": [1, None, 2, None, 3], "v": [1, 2, 3, 4, 5]})
+    right = daft_tpu.from_pydict({"k": [1, 2, 9], "w": [10.0, 20.0, 30.0]})
+    j = left.join(right, on="k")
+    plan = _optimized(j)
+    filters = [n for n in _nodes(plan) if isinstance(n, lp.Filter)
+               and "not_null" in repr(n.predicate)]
+    assert filters, "expected a not-null key filter on the nulled side"
+    out = j.sort(["k"]).to_pydict()
+    assert out["k"] == [1, 2]
+    # Clean keys: no not-null filter inserted (pure cost otherwise).
+    clean = daft_tpu.from_pydict({"k": [1, 2], "v": [1, 2]})
+    plan2 = _optimized(clean.join(right, on="k"))
+    assert not any(isinstance(n, lp.Filter) and "not_null" in repr(n.predicate)
+                   for n in _nodes(plan2))
+
+
+def test_filter_null_join_key_anti_keeps_null_left_rows():
+    """ANTI join must KEEP left rows with null keys (they match nothing), so
+    only the right side may be null-filtered."""
+    left = daft_tpu.from_pydict({"k": [1, None, 5]})
+    right = daft_tpu.from_pydict({"k": [1, None]})
+    out = left.join(right, on="k", how="anti").to_pydict()
+    assert sorted([v for v in out["k"] if v is not None]) == [5]
+    assert None in out["k"]
+
+
+def test_count_pushdown_struct_column_bails_to_scan(tmp_path):
+    """Nested-leaf footer stats don't compose into a root null count: a
+    struct column count must run the real scan, not metadata arithmetic
+    (review r4 finding: summed leaf nulls went negative)."""
+    df = daft_tpu.from_pydict(
+        {"s": [{"a": 1, "b": None}, None, {"a": None, "b": 2}]})
+    df.write_parquet(str(tmp_path))
+    scan = daft_tpu.read_parquet(str(tmp_path))
+    assert scan.agg(col("s").count().alias("n")).to_pydict() == {"n": [2]}
+    assert scan.agg(col("s").count(mode="all").alias("n")).to_pydict() == {"n": [3]}

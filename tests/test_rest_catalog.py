@@ -37,8 +37,16 @@ class _RestCatalogServer:
                 self.end_headers()
                 self.wfile.write(body)
 
+            def _parts(self):
+                from urllib.parse import unquote
+
+                # Real servers decode percent-encoding; multipart namespace
+                # segments arrive as %1F-joined and canonicalize to dots.
+                return [unquote(p).replace("\x1f", ".")
+                        for p in self.path.split("/") if p]
+
             def do_GET(self):
-                parts = [p for p in self.path.split("/") if p]
+                parts = self._parts()
                 # /v1/config
                 if parts == ["v1", "config"]:
                     return self._json(200, {"overrides": {}, "defaults": {}})
@@ -67,7 +75,7 @@ class _RestCatalogServer:
             def do_POST(self):
                 n = int(self.headers.get("Content-Length", 0))
                 body = json.loads(self.rfile.read(n) or b"{}")
-                parts = [p for p in self.path.split("/") if p]
+                parts = self._parts()
                 if parts == ["v1", "namespaces"]:
                     ns = ".".join(body["namespace"])
                     store.namespaces.setdefault(ns, {})
@@ -81,7 +89,7 @@ class _RestCatalogServer:
                 return self._json(404, {"error": f"bad path {self.path}"})
 
             def do_DELETE(self):
-                parts = [p for p in self.path.split("/") if p]
+                parts = self._parts()
                 if len(parts) == 5 and parts[3] == "tables":
                     ns, t = parts[2], parts[4]
                     if t in store.namespaces.get(ns, {}):
@@ -153,3 +161,18 @@ def test_unqualified_name_rejected(rest_catalog):
     cat, _ = rest_catalog
     with pytest.raises(Exception, match="namespace-qualified"):
         cat.get_table("bare")
+
+
+def test_multipart_namespace_and_qualified_ddl(rest_catalog):
+    """Multi-level namespaces percent-encode the 0x1F separator, and DDL/DML
+    accept qualified names (review r4 findings)."""
+    cat, store = rest_catalog
+    cat.create_namespace("a.b")
+    cat.create_table("a.b.t", daft_tpu.from_pydict({"v": [1, 2]}))
+    assert cat.has_table("a.b.t")
+    assert cat.list_tables() == ["a.b.t"]
+    s = daft_tpu.Session()
+    s.attach(cat)
+    assert s.sql("SELECT count(*) AS n FROM icecat.a.b.t").to_pydict() == {"n": [2]}
+    s.sql("DROP TABLE icecat.a.b.t")
+    assert not cat.has_table("a.b.t")
