@@ -59,6 +59,10 @@ class ExecutionConfig:
     enable_strict_filter_pushdown: bool = True
     min_cpu_per_task: float = 0.5
     memory_limit_bytes: Optional[int] = None
+    # Host-UDF dynamic batching (reference: dynamic_batching/
+    # latency_constrained_strategy.rs). Device UDFs keep static XLA buckets.
+    udf_dynamic_batching: bool = True
+    udf_target_batch_latency_s: float = 0.2
     # TPU-specific
     device_eval: bool = True
     device_eval_min_rows: int = 1024

@@ -74,6 +74,25 @@ class DataFrame:
         names = ", ".join(f"{f.name}: {f.dtype!r}" for f in self.schema)
         return f"DataFrame({names})\n(unmaterialized — call .collect() or .show())"
 
+    def _repr_html_(self) -> str:
+        """Notebook preview table (reference: the dashboard's interactive
+        HTML display, src/daft-dashboard python::generate_interactive_html)."""
+        from daft_tpu.subscribers.dashboard import (
+            DataFrameDisplay,
+            generate_interactive_html,
+        )
+
+        reg = DataFrameDisplay()
+        df_id = reg.register(self.limit(self._num_preview_rows())
+                             if self._result is None else self, "DataFrame")
+        return generate_interactive_html(reg.get(df_id))
+
+    @staticmethod
+    def _num_preview_rows() -> int:
+        from daft_tpu.context import get_context
+
+        return get_context().execution_config.num_preview_rows
+
     # ------------------------------------------------------------------ #
     # Transformations                                                     #
     # ------------------------------------------------------------------ #

@@ -385,12 +385,38 @@ class Executor:
         # as one giant batch (bounds host memory + enables replica
         # concurrency). A UDF with a declared device batch_size gets morsels
         # of 16 device-batches — enough chunks for async transfer/compute
-        # overlap inside the impl without unbounded host buffers.
+        # overlap inside the impl without unbounded host buffers. Host UDFs
+        # with no device batch shape instead follow the latency-constrained
+        # feedback loop (execution/dynamic_batching.py).
         udf_bs = getattr(udf, "batch_size", None)
-        morsel_rows = udf_bs * 16 if udf_bs else self.cfg.default_morsel_size
-        child_iter = _remorsel(self._run(node.children[0]), min(morsel_rows, self.cfg.default_morsel_size))
-        eval_mp = (lambda mp: slots.run(mp.eval_expression_list, exprs)) if slots \
-            else (lambda mp: mp.eval_expression_list(exprs))
+        batch_state = None
+        if udf_bs:
+            morsel_rows = min(udf_bs * 16, self.cfg.default_morsel_size)
+            child_iter = _remorsel(self._run(node.children[0]), morsel_rows)
+        elif getattr(self.cfg, "udf_dynamic_batching", False) and slots is None:
+            from daft_tpu.execution.dynamic_batching import (
+                LatencyConstrainedBatching,
+                dynamic_remorsel,
+            )
+
+            batch_state = LatencyConstrainedBatching(
+                target_latency_s=self.cfg.udf_target_batch_latency_s,
+                b_max=self.cfg.default_morsel_size).make_state()
+            child_iter = dynamic_remorsel(self._run(node.children[0]), batch_state)
+        else:
+            child_iter = _remorsel(self._run(node.children[0]),
+                                   self.cfg.default_morsel_size)
+        if batch_state is None:
+            eval_mp = (lambda mp: slots.run(mp.eval_expression_list, exprs)) if slots \
+                else (lambda mp: mp.eval_expression_list(exprs))
+        else:
+            import time as _time
+
+            def eval_mp(mp):
+                t0 = _time.perf_counter()
+                out = mp.eval_expression_list(exprs)
+                batch_state.record(len(mp), _time.perf_counter() - t0)
+                return out
         if concurrency == 1:
             for mp in child_iter:
                 yield eval_mp(mp)

@@ -145,46 +145,33 @@ def read_hudi(table_uri, io_config=None, **kwargs) -> DataFrame:
                             {"io_config": io_config} if io_config else None)
 
 
-def read_sql(sql_query: str, conn, **kwargs):
-    """SQL databases via a connection factory (reference: daft.read_sql).
+def read_sql(sql_query: str, conn, partition_col=None, num_partitions=None,
+             partition_bound_strategy: str = "min-max",
+             infer_schema_length: int = 10, **kwargs):
+    """SQL databases via a DB-API connection factory (reference:
+    daft.read_sql / daft/io/_sql.py + daft/sql/sql_scan.py).
 
-    Works when `conn` yields a DB-API connection: the query runs once and the
-    result materialises through Arrow.
+    With ``partition_col`` the query is split into ``num_partitions`` range
+    tasks (min-max equal ranges or PERCENTILE_DISC bounds) that read
+    concurrently; results stream in bounded fetchmany batches, and
+    projection/limit pushdowns rewrite the generated SQL. Connection-string
+    URLs need the connectorx integration, unavailable in this environment.
     """
-    import pyarrow as pa
+    from daft_tpu.errors import DaftIOError
+    from daft_tpu.io.source import read_source
+    from daft_tpu.io.sql_source import SQLSource
 
-    from daft_tpu.dataframe.creation import from_arrow
-    from daft_tpu.errors import DaftIOError, DaftValueError
-
-    # A factory is anything callable that isn't already a DB-API connection
-    # (sqlite3.Connection is itself callable, so check for .cursor first).
     if isinstance(conn, str):
         raise DaftIOError(
             "read_sql takes a DB-API connection or a zero-arg factory "
             "returning one; connection-string URLs need the connectorx "
             "integration, unavailable in this environment"
         )
-    connection = conn if hasattr(conn, "cursor") else conn()
-    cursor = connection.cursor()
-    cursor.execute(sql_query)
-    if cursor.description is None:
-        raise DaftValueError(
-            "read_sql requires a statement returning rows (SELECT); "
-            f"got no result set from {sql_query[:60]!r}"
-        )
-    columns = []
-    seen: dict = {}
-    for d in cursor.description:
-        name = d[0]
-        if name in seen:
-            seen[name] += 1
-            name = f"{name}_{seen[d[0]]}"
-        else:
-            seen[name] = 0
-        columns.append(name)
-    rows = cursor.fetchall()
-    data = {c: [r[i] for r in rows] for i, c in enumerate(columns)}
-    return from_arrow(pa.table(data))
+    source = SQLSource(sql_query, conn, partition_col=partition_col,
+                       num_partitions=num_partitions,
+                       partition_bound_strategy=partition_bound_strategy,
+                       infer_schema_length=infer_schema_length)
+    return read_source(source)
 
 
 def read_huggingface(repo: str, io_config=None, **kwargs):

@@ -1,0 +1,104 @@
+/* daft_tpu dashboard app (reference: src/daft-dashboard UI behavior). */
+let selected = null;
+let view = "queries";
+
+const $ = (s) => document.querySelector(s);
+const esc = (s) => String(s).replace(/[&<>"]/g,
+  (c) => ({"&": "&amp;", "<": "&lt;", ">": "&gt;", '"': "&quot;"}[c]));
+
+document.querySelectorAll("nav button").forEach((b) =>
+  b.addEventListener("click", () => {
+    view = b.dataset.view;
+    document.querySelectorAll("nav button").forEach((x) =>
+      x.classList.toggle("active", x === b));
+    document.querySelectorAll(".view").forEach((v) =>
+      v.hidden = v.id !== "view-" + view);
+    tick();
+  }));
+
+async function getJSON(url) { return (await fetch(url)).json(); }
+
+async function renderSummary() {
+  const e = await getJSON("/api/engine");
+  $("#summary").innerHTML = [
+    ["queries", e.queries_total], ["running", e.queries_running],
+    ["failed", e.queries_failed], ["tasks", e.tasks_total],
+    ["rows", e.rows_processed],
+  ].map(([l, n]) =>
+    `<div class="tile"><div class="n">${n}</div><div class="l">${l}</div></div>`
+  ).join("");
+}
+
+async function renderQueries() {
+  const qs = await getJSON("/api/queries");
+  $("#queries tbody").innerHTML = qs.map((q) =>
+    `<tr data-qid="${esc(q.query_id)}">
+      <td>${esc(q.query_id)}</td>
+      <td class="${q.status === "error" ? "err" : "ok"}">${esc(q.status)}</td>
+      <td>${q.duration_s != null ? q.duration_s.toFixed(3) : ""}</td>
+      <td>${q.tasks}</td><td>${q.operators}</td><td>${q.workers}</td></tr>`
+  ).join("");
+  document.querySelectorAll("#queries tbody tr").forEach((r) =>
+    r.addEventListener("click", () => { selected = r.dataset.qid; renderDetail(); }));
+  if (selected) await renderDetail();
+}
+
+async function renderDetail() {
+  const q = await getJSON("/api/queries/" + encodeURIComponent(selected));
+  $("#detail").hidden = false;
+  $("#detail-title").textContent = selected + " — " + q.status;
+  const max = Math.max(1, ...q.operators.map((o) => o.cpu_us));
+  $("#operators tbody").innerHTML = q.operators.map((o) =>
+    `<tr><td>${esc(o.operator)}</td><td>${o.batches}</td>
+     <td>${o.rows_in}</td><td>${o.rows_out}</td>
+     <td>${(o.cpu_us / 1000).toFixed(1)}</td>
+     <td><span class="bar" style="width:${(120 * o.cpu_us / max) | 0}px"></span></td></tr>`
+  ).join("");
+  $("#plan").textContent = q.plan || "";
+}
+
+async function renderWorkers() {
+  const qs = await getJSON("/api/queries");
+  const rows = [];
+  for (const q of qs) {
+    const d = await getJSON("/api/queries/" + encodeURIComponent(q.query_id));
+    for (const [wid, w] of Object.entries(d.workers || {}))
+      rows.push(`<tr><td>${esc(wid)}</td><td>${esc(q.query_id)}</td>
+        <td>${w.tasks}</td><td>${w.busy_s.toFixed(2)}</td><td>${w.errors}</td></tr>`);
+  }
+  $("#workers tbody").innerHTML = rows.join("");
+}
+
+async function renderDataframes() {
+  const dfs = await getJSON("/api/dataframes");
+  $("#dataframes").innerHTML = dfs.map((d) =>
+    `<li data-id="${esc(d.id)}">${esc(d.name)} (${d.rows} rows × ${d.cols} cols)</li>`
+  ).join("");
+  document.querySelectorAll("#dataframes li").forEach((li) =>
+    li.addEventListener("click", async () => {
+      const r = await fetch("/api/dataframes/" + li.dataset.id + "/html");
+      $("#df-preview").innerHTML = await r.text();
+      wireCells(li.dataset.id);
+    }));
+}
+
+function wireCells(id) {
+  document.querySelectorAll("#df-preview td.trunc").forEach((td) =>
+    td.addEventListener("click", async () => {
+      const r = await fetch(`/api/dataframes/${id}/cell?row=${td.dataset.row}&col=${encodeURIComponent(td.dataset.col)}`);
+      td.textContent = (await r.json()).value;
+      td.classList.remove("trunc");
+    }));
+}
+
+async function tick() {
+  try {
+    await renderSummary();
+    if (view === "queries") await renderQueries();
+    else if (view === "workers") await renderWorkers();
+    else await renderDataframes();
+  } catch (e) { /* server restarting */ }
+}
+
+setInterval(() => { if ($("#auto").checked) tick(); }, 1000);
+tick();

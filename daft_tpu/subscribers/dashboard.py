@@ -1,15 +1,22 @@
-"""Embedded dashboard: live query/operator state over HTTP.
+"""Embedded dashboard: live query/operator state + DataFrame previews.
 
-Reference: src/daft-dashboard (axum server + UI, lib.rs:326-397) and the
-dashboard subscriber posting events to it. Here a stdlib http.server serves
-JSON state + a minimal HTML view; the DashboardSubscriber feeds it events.
+Reference: src/daft-dashboard — axum server serving a static web app
+(assets.rs), engine/query state routes, and interactive DataFrame display
+(`register_dataframe_for_display` / `generate_interactive_html` /
+`/api/dataframes/{id}/cell`, lib.rs:326-397). Here a stdlib http.server
+serves the same surface: the static app lives in subscribers/assets/,
+queries/workers stream from the DashboardSubscriber, and registered
+DataFrames render as interactive tables with click-to-expand truncated
+cells backed by the cell endpoint.
 """
 
 from __future__ import annotations
 
 import json
+import os
 import threading
 import time
+import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, List, Optional
 
@@ -23,50 +30,117 @@ from daft_tpu.subscribers.events import (
     TaskScheduled,
 )
 
-_HTML = """<!doctype html><html><head><title>daft_tpu dashboard</title>
-<style>body{font-family:monospace;margin:2em;background:#fafafa}
-table{border-collapse:collapse;margin-bottom:1em}
-td,th{border:1px solid #999;padding:4px 8px;text-align:left}
-th{background:#eee}.err{color:#b00}.ok{color:#080}
-#summary span{margin-right:2em}</style></head>
-<body><h2>daft_tpu dashboard</h2>
-<div id="summary">loading...</div>
-<div id="out"></div><div id="detail"></div>
-<script>
-let selected = null;
-async function tick(){
-  const eng = await (await fetch('/api/engine')).json();
-  document.getElementById('summary').innerHTML =
-    `<span>queries: ${eng.queries_total}</span>`+
-    `<span>running: ${eng.queries_running}</span>`+
-    `<span>failed: ${eng.queries_failed}</span>`+
-    `<span>tasks: ${eng.tasks_total}</span>`+
-    `<span>rows: ${eng.rows_processed}</span>`;
-  const qs = await (await fetch('/api/queries')).json();
-  let h = '<table><tr><th>query</th><th>status</th><th>duration</th>'+
-          '<th>tasks</th><th>operators</th><th>workers</th></tr>';
-  for (const q of qs) h += `<tr onclick="select('${q.query_id}')">`+
-    `<td>${q.query_id}</td>`+
-    `<td class="${q.status==='error'?'err':'ok'}">${q.status}</td>`+
-    `<td>${q.duration_s?.toFixed(2) ?? ''}</td><td>${q.tasks}</td>`+
-    `<td>${q.operators}</td><td>${q.workers}</td></tr>`;
-  document.getElementById('out').innerHTML = h + '</table>';
-  if (selected) await detail(selected);
-}
-function select(qid){ selected = qid; detail(qid); }
-async function detail(qid){
-  const q = await (await fetch('/api/queries/'+qid)).json();
-  let h = `<h3>${qid}</h3><table><tr><th>operator</th><th>batches</th>`+
-          '<th>rows in</th><th>rows out</th><th>cpu ms</th></tr>';
-  for (const o of q.operators) h += `<tr><td>${o.operator}</td>`+
-    `<td>${o.batches}</td><td>${o.rows_in}</td><td>${o.rows_out}</td>`+
-    `<td>${(o.cpu_us/1000).toFixed(1)}</td></tr>`;
-  h += '</table><pre>'+(q.plan??'')+'</pre>';
-  document.getElementById('detail').innerHTML = h;
-}
-setInterval(tick, 1000); tick();
-</script></body></html>"""
+_ASSET_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "assets")
+_ASSET_TYPES = {".html": "text/html", ".js": "text/javascript",
+                ".css": "text/css", ".svg": "image/svg+xml",
+                ".png": "image/png"}
+_CELL_TRUNCATE = 80
 
+
+def _load_asset(name: str):
+    """(bytes, content-type) for a bundled asset, or None (assets.rs
+    analogue: only registered files are servable, no path traversal)."""
+    base = os.path.basename(name) or "index.html"
+    path = os.path.join(_ASSET_DIR, base)
+    if not os.path.isfile(path):
+        return None
+    ext = os.path.splitext(base)[1]
+    ctype = _ASSET_TYPES.get(ext)
+    if ctype is None:
+        return None
+    with open(path, "rb") as f:
+        return f.read(), ctype
+
+
+class DataFrameDisplay:
+    """Registry of DataFrames published for interactive display
+    (reference: python::register_dataframe_for_display)."""
+
+    MAX_PREVIEW_ROWS = 64
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._dfs: Dict[str, dict] = {}
+        self._next = 0
+
+    def register(self, df, name: Optional[str] = None) -> str:
+        # ONE execution: fetch MAX+1 rows to learn whether more exist. A
+        # separate count_rows() would re-run the full unlimited plan just
+        # for a number.
+        data = df.limit(self.MAX_PREVIEW_ROWS + 1).to_pydict()
+        fetched = len(next(iter(data.values()), []))
+        truncated = fetched > self.MAX_PREVIEW_ROWS
+        if truncated:
+            data = {k: v[:self.MAX_PREVIEW_ROWS] for k, v in data.items()}
+        with self._lock:
+            self._next += 1
+            df_id = f"df{self._next}"
+            self._dfs[df_id] = {
+                "id": df_id, "name": name or df_id, "data": data,
+                "columns": list(data.keys()),
+                "rows": None if truncated else fetched,
+                "preview_rows": min(fetched, self.MAX_PREVIEW_ROWS),
+            }
+        return df_id
+
+    def listing(self) -> List[dict]:
+        with self._lock:
+            return [{"id": d["id"], "name": d["name"],
+                     "rows": d["rows"] if d["rows"] is not None
+                     else f"{d['preview_rows']}+",
+                     "cols": len(d["columns"])} for d in self._dfs.values()]
+
+    def get(self, df_id: str) -> Optional[dict]:
+        with self._lock:
+            return self._dfs.get(df_id)
+
+    def cell(self, df_id: str, row: int, col: str) -> Optional[str]:
+        d = self.get(df_id)
+        if d is None or col not in d["data"]:
+            return None
+        vals = d["data"][col]
+        if not (0 <= row < len(vals)):
+            return None
+        return str(vals[row])
+
+
+def generate_interactive_html(entry: dict) -> str:
+    """Standalone interactive table for a registered DataFrame: truncated
+    cells carry data-row/data-col and the .trunc class so the app (or the
+    inline title fallback) can expand them (reference:
+    python::generate_interactive_html)."""
+    cols = entry["columns"]
+    data = entry["data"]
+    n = entry["preview_rows"]
+    head = "".join(f"<th>{_escape(c)}</th>" for c in cols)
+    rows = []
+    for i in range(n):
+        tds = []
+        for c in cols:
+            v = "" if data[c][i] is None else str(data[c][i])
+            if len(v) > _CELL_TRUNCATE:
+                # NO inline full value (a 10MB blob would ship with every
+                # preview): the /cell endpoint serves it on demand.
+                tds.append(
+                    f'<td class="trunc" data-row="{i}" data-col="{_escape(c)}"'
+                    f'>{_escape(v[:_CELL_TRUNCATE])}…</td>')
+            else:
+                tds.append(f"<td>{_escape(v)}</td>")
+        rows.append("<tr>" + "".join(tds) + "</tr>")
+    if entry["rows"] is None:
+        more = "<p>… more rows (preview truncated)</p>"
+    else:
+        more = (f"<p>… {entry['rows'] - n} more rows</p>"
+                if entry["rows"] > n else "")
+    return (f"<h3>{_escape(entry['name'])}</h3>"
+            f"<table><thead><tr>{head}</tr></thead>"
+            f"<tbody>{''.join(rows)}</tbody></table>{more}")
+
+
+def _escape(s: str) -> str:
+    import html
+
+    return html.escape(str(s), quote=True)
 
 class DashboardState:
     def __init__(self):
@@ -152,29 +226,70 @@ class DashboardSubscriber(Subscriber):
 
 class _Handler(BaseHTTPRequestHandler):
     state: DashboardState = None  # type: ignore[assignment]
+    displays: DataFrameDisplay = None  # type: ignore[assignment]
 
     def log_message(self, *args):  # quiet
         pass
 
     def do_GET(self):
-        if self.path in ("/", "/index.html"):
-            body = _HTML.encode()
-            ctype = "text/html"
-        elif self.path == "/api/queries":
+        parsed = urllib.parse.urlsplit(self.path)
+        path = parsed.path
+        if path in ("/", "/index.html"):
+            asset = _load_asset("index.html")
+            body, ctype = asset
+        elif path.startswith("/assets/"):
+            asset = _load_asset(path[len("/assets/"):])
+            if asset is None:
+                self.send_error(404)
+                return
+            body, ctype = asset
+        elif path == "/api/queries":
             body = json.dumps(self.state.snapshot()).encode()
             ctype = "application/json"
-        elif self.path.startswith("/api/queries/"):
-            qid = self.path.rsplit("/", 1)[1]
+        elif path.startswith("/api/queries/"):
+            qid = path.rsplit("/", 1)[1]
             detail = self.state.query_detail(qid)
             if detail is None:
                 self.send_error(404)
                 return
             body = json.dumps(detail, default=str).encode()
             ctype = "application/json"
-        elif self.path == "/api/engine":
+        elif path == "/api/engine":
             body = json.dumps(self.state.engine_summary()).encode()
             ctype = "application/json"
-        elif self.path == "/api/health":
+        elif path == "/api/dataframes":
+            body = json.dumps(self.displays.listing()).encode()
+            ctype = "application/json"
+        elif path.startswith("/api/dataframes/"):
+            parts = path.split("/")
+            df_id = parts[3] if len(parts) > 3 else ""
+            tail = parts[4] if len(parts) > 4 else ""
+            entry = self.displays.get(df_id)
+            if entry is None:
+                self.send_error(404)
+                return
+            if tail == "html":
+                body = generate_interactive_html(entry).encode()
+                ctype = "text/html"
+            elif tail == "cell":
+                q = urllib.parse.parse_qs(parsed.query)
+                try:
+                    row = int(q.get("row", ["0"])[0])
+                except ValueError:
+                    self.send_error(400)
+                    return
+                val = self.displays.cell(df_id, row, q.get("col", [""])[0])
+                if val is None:
+                    self.send_error(404)
+                    return
+                body = json.dumps({"value": val}).encode()
+                ctype = "application/json"
+            else:
+                body = json.dumps({"id": entry["id"], "name": entry["name"],
+                                   "rows": entry["rows"],
+                                   "columns": entry["columns"]}).encode()
+                ctype = "application/json"
+        elif path == "/api/health":
             body = b'{"status":"ok"}'
             ctype = "application/json"
         else:
@@ -190,7 +305,9 @@ class _Handler(BaseHTTPRequestHandler):
 class DashboardServer:
     def __init__(self, port: int = 0):
         self.state = DashboardState()
-        handler = type("Handler", (_Handler,), {"state": self.state})
+        self.displays = DataFrameDisplay()
+        handler = type("Handler", (_Handler,), {"state": self.state,
+                                                "displays": self.displays})
         self._server = ThreadingHTTPServer(("127.0.0.1", port), handler)
         self.port = self._server.server_address[1]
         self._thread = threading.Thread(target=self._server.serve_forever,
@@ -206,6 +323,11 @@ class DashboardServer:
 
     def subscriber(self) -> DashboardSubscriber:
         return DashboardSubscriber(self.state)
+
+    def register_dataframe_for_display(self, df, name: Optional[str] = None) -> str:
+        """Publish a DataFrame for interactive display; returns its id
+        (reference: python::register_dataframe_for_display)."""
+        return self.displays.register(df, name)
 
     def shutdown(self) -> None:
         self._server.shutdown()

@@ -1,0 +1,126 @@
+"""Latency-constrained dynamic batching for host UDFs.
+
+Reference: src/daft-local-execution/src/dynamic_batching/
+latency_constrained_strategy.rs (Algorithm 2, arXiv:2503.05248)."""
+
+import time
+
+import pytest
+
+import daft_tpu
+from daft_tpu import col
+from daft_tpu.execution.dynamic_batching import (
+    LatencyConstrainedBatching,
+    StaticBatching,
+    dynamic_remorsel,
+)
+from daft_tpu.micropartition import MicroPartition
+
+
+def test_contracts_when_too_slow():
+    st = LatencyConstrainedBatching(target_latency_s=0.1, tolerance_s=0.01,
+                                    alpha=64, delta=8).make_state()
+    start = st.next_batch_size()
+    for _ in range(20):
+        st.record(st.next_batch_size(), 0.5)  # 5x over target
+    assert st.next_batch_size() < max(start, 128)
+    assert st.b_low >= 1
+
+
+def test_expands_when_fast():
+    st = LatencyConstrainedBatching(target_latency_s=0.1, tolerance_s=0.01,
+                                    alpha=64, delta=8, b_max=10_000).make_state()
+    sizes = []
+    for _ in range(30):
+        b = st.next_batch_size()
+        sizes.append(b)
+        st.record(b, 0.001)  # far below target
+    assert sizes[-1] > sizes[0]  # search space keeps expanding
+
+
+def test_converges_within_band():
+    """Latency proportional to batch size: converges near the size whose
+    latency hits the target, then stays put (tightening branch)."""
+    target = 0.1
+    per_row = 0.001  # => ideal batch ~100
+    st = LatencyConstrainedBatching(target_latency_s=target, tolerance_s=0.01,
+                                    alpha=32, delta=4, b_max=100_000).make_state()
+    for _ in range(200):
+        b = st.next_batch_size()
+        st.record(b, b * per_row)
+    final = st.next_batch_size()
+    assert 50 <= final <= 200, f"converged to {final}, expected ~100"
+
+
+def test_static_strategy_fixed():
+    st = StaticBatching(42).make_state()
+    st.record(42, 99.0)
+    assert st.next_batch_size() == 42
+
+
+def test_dynamic_remorsel_respects_state():
+    class FixedState:
+        def __init__(self, n):
+            self.n = n
+
+        def next_batch_size(self):
+            return self.n
+
+        def record(self, *a):
+            pass
+
+    parts = [MicroPartition.from_pydict({"x": list(range(i * 10, i * 10 + 10))})
+             for i in range(5)]
+    out = list(dynamic_remorsel(iter(parts), FixedState(7)))
+    assert [len(m) for m in out] == [7, 7, 7, 7, 7, 7, 7, 1]
+    flat = [v for m in out for v in m.to_pydict()["x"]]
+    assert flat == list(range(50))  # order preserved
+
+
+def test_host_udf_runs_under_dynamic_batching():
+    """End-to-end: a host batch UDF sees multiple (varying) batch sizes and
+    produces exact results."""
+    seen = []
+
+    @daft_tpu.udf.func.batch(return_dtype=daft_tpu.DataType.int64())
+    def f(x):
+        seen.append(len(x))
+        time.sleep(0.001)
+        import numpy as np
+
+        return daft_tpu.Series.from_numpy(x.to_numpy() * 2, "y")
+
+    df = daft_tpu.from_pydict({"x": list(range(2000))})
+    with daft_tpu.execution_config_ctx(udf_dynamic_batching=True,
+                                       udf_target_batch_latency_s=0.005):
+        out = df.with_column("y", f(col("x"))).to_pydict()
+    assert out["y"] == [v * 2 for v in range(2000)]
+    assert len(seen) > 1, "expected multiple dynamic batches"
+
+
+def test_dynamic_batching_can_be_disabled():
+    sizes = []
+
+    @daft_tpu.udf.func.batch(return_dtype=daft_tpu.DataType.int64())
+    def g(x):
+        sizes.append(len(x))
+        return x
+
+    df = daft_tpu.from_pydict({"x": list(range(500))})
+    with daft_tpu.execution_config_ctx(udf_dynamic_batching=False,
+                                       default_morsel_size=100):
+        df.with_column("y", g(col("x"))).collect()
+    assert sizes == [100] * 5
+
+
+def test_converges_below_alpha_for_slow_udfs():
+    """Per-row cost far above target/alpha: batch size must fall below
+    alpha/2 (review r4 finding: the paper's contraction floors at ~alpha/2)."""
+    st = LatencyConstrainedBatching(target_latency_s=0.2, tolerance_s=0.02,
+                                    alpha=64, delta=8).make_state()
+    per_row = 0.05  # ideal batch = 4
+    for _ in range(100):
+        b = st.next_batch_size()
+        st.record(b, b * per_row)
+    final = st.next_batch_size()
+    assert final <= 8, f"stuck at {final}; latency would be {final * per_row:.2f}s"

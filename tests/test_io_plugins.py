@@ -144,3 +144,68 @@ def test_read_sql_dbapi():
     conn.executemany("INSERT INTO t VALUES (?, ?)", [(1, "x"), (2, "y")])
     df = daft_tpu.read_sql("SELECT * FROM t ORDER BY a", lambda: conn)
     assert df.to_pydict() == {"a": [1, 2], "b": ["x", "y"]}
+
+
+def test_read_sql_partitioned(tmp_path):
+    """Partitioned SQL reads: range tasks over partition_col, nulls carried
+    in the last partition, batched fetch (no fetchall) — reference
+    daft/io/_sql.py + daft/sql/sql_scan.py."""
+    import sqlite3
+
+    db = str(tmp_path / "t.db")
+    conn = sqlite3.connect(db)
+    conn.execute("CREATE TABLE ev (id INTEGER, v REAL)")
+    conn.executemany("INSERT INTO ev VALUES (?, ?)",
+                     [(i, float(i) * 0.5) for i in range(1000)])
+    conn.execute("INSERT INTO ev VALUES (NULL, -1.0)")
+    conn.commit()
+    conn.close()
+
+    factory = lambda: sqlite3.connect(db)  # noqa: E731
+    df = daft_tpu.read_sql("SELECT * FROM ev", factory,
+                           partition_col="id", num_partitions=4)
+    assert df.count_rows() == 1001  # incl. the NULL-id row
+    out = df.where(daft_tpu.col("id") < 10).sort("id").to_pydict()
+    assert out["id"] == list(range(10))
+
+    # Partition plan shape: 4 range tasks, null-inclusive tail.
+    from daft_tpu.io.sql_source import SQLSource
+
+    src = SQLSource("SELECT * FROM ev", factory, partition_col="id",
+                    num_partitions=4)
+    tasks = src.get_tasks()
+    assert len(tasks) == 4
+    assert "IS NULL" in tasks[-1].sql
+    # Limit pushdown rewrites the unpartitioned SQL.
+    src2 = SQLSource("SELECT * FROM ev", factory)
+    from daft_tpu.io.scan import Pushdowns
+
+    t = src2.get_tasks(Pushdowns(columns=("v",), limit=7))
+    assert t[0].sql.startswith("SELECT v FROM") and "LIMIT 7" in t[0].sql
+
+
+def test_read_sql_partitioned_rejects_shared_connection(tmp_path):
+    """Partition tasks run on pool threads; a live/shared connection must be
+    rejected with an actionable error (review r4 finding)."""
+    import sqlite3
+
+    conn = sqlite3.connect(str(tmp_path / "x.db"))
+    conn.execute("CREATE TABLE t (a INTEGER)")
+    with pytest.raises(Exception, match="FACTORY"):
+        daft_tpu.read_sql("SELECT * FROM t", conn, partition_col="a",
+                          num_partitions=2)
+    with pytest.raises(Exception, match="FACTORY"):
+        daft_tpu.read_sql("SELECT * FROM t", lambda: conn, partition_col="a")
+
+
+def test_sql_literal_formatting():
+    import datetime
+
+    from daft_tpu.io.sql_source import _sql_literal
+
+    assert _sql_literal(5) == "5"
+    assert _sql_literal(2.5) == "2.5"
+    assert _sql_literal("o'brien") == "'o''brien'"
+    assert _sql_literal(datetime.date(2020, 1, 2)) == "'2020-01-02'"
+    assert _sql_literal(datetime.datetime(2020, 1, 2, 3, 4, 5)) == \
+        "'2020-01-02 03:04:05'"

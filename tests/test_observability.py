@@ -217,7 +217,9 @@ def test_dashboard_live_operator_state(make_df):
             server.url + "/api/engine").read())
         assert eng["queries_total"] >= 1 and eng["rows_processed"] >= 50
         html = urllib.request.urlopen(server.url + "/").read().decode()
-        assert "daft_tpu dashboard" in html and "/api/engine" in html
+        assert "daft_tpu" in html and "/assets/app.js" in html
+        js = urllib.request.urlopen(server.url + "/assets/app.js").read().decode()
+        assert "/api/engine" in js
     finally:
         ctx.detach_subscriber(sub)
         server.shutdown()
@@ -258,3 +260,55 @@ def test_components_tally_not_stale():
         [sys.executable, os.path.join(root, "scripts", "gen_tally.py")],
         capture_output=True, text=True, cwd=root)
     assert proc.returncode == 0, f"tally drifted:\n{proc.stdout}{proc.stderr}"
+
+
+def test_dashboard_static_app_and_dataframe_display():
+    """The dashboard serves the static web app and interactive DataFrame
+    previews with a cell drill-down endpoint (reference: src/daft-dashboard
+    assets.rs + lib.rs:326-397)."""
+    import json as _json
+    import urllib.request
+
+    import daft_tpu
+    from daft_tpu.subscribers.dashboard import DashboardServer
+
+    srv = DashboardServer().start()
+    try:
+        def get(path):
+            with urllib.request.urlopen(srv.url + path, timeout=10) as r:
+                return r.read(), r.headers.get_content_type()
+
+        body, ctype = get("/")
+        assert ctype == "text/html" and b"daft_tpu" in body
+        js, jst = get("/assets/app.js")
+        assert jst == "text/javascript" and b"renderQueries" in js
+        css, csst = get("/assets/app.css")
+        assert csst == "text/css"
+        # Unknown assets and traversal 404.
+        import urllib.error
+
+        for bad in ("/assets/nope.js", "/assets/..%2Fdashboard.py"):
+            try:
+                get(bad)
+                assert False, f"expected 404 for {bad}"
+            except urllib.error.HTTPError as e:
+                assert e.code == 404
+
+        long = "x" * 300
+        df = daft_tpu.from_pydict({"a": [1, 2], "blob": [long, "short"]})
+        df_id = srv.register_dataframe_for_display(df, "mydf")
+        listing = _json.loads(get("/api/dataframes")[0])
+        assert listing[0]["name"] == "mydf" and listing[0]["rows"] == 2
+        html = get(f"/api/dataframes/{df_id}/html")[0].decode()
+        assert "mydf" in html and 'class="trunc"' in html
+        cell = _json.loads(get(f"/api/dataframes/{df_id}/cell?row=0&col=blob")[0])
+        assert cell["value"] == long  # untruncated through the cell endpoint
+    finally:
+        srv.shutdown()
+
+
+def test_dataframe_repr_html():
+    import daft_tpu
+
+    html = daft_tpu.from_pydict({"a": [1, 2, 3]})._repr_html_()
+    assert "<table>" in html and "<th>a</th>" in html
