@@ -76,22 +76,20 @@ class DataFrame:
 
     def _repr_html_(self) -> str:
         """Notebook preview table (reference: the dashboard's interactive
-        HTML display, src/daft-dashboard python::generate_interactive_html)."""
+        HTML display, src/daft-dashboard python::generate_interactive_html).
+        register() fetches max_rows+1, so the '... more rows' indicator is
+        accurate without executing the unlimited plan."""
+        from daft_tpu.context import get_context
         from daft_tpu.subscribers.dashboard import (
             DataFrameDisplay,
             generate_interactive_html,
         )
 
         reg = DataFrameDisplay()
-        df_id = reg.register(self.limit(self._num_preview_rows())
-                             if self._result is None else self, "DataFrame")
+        df_id = reg.register(
+            self, "DataFrame",
+            max_rows=get_context().execution_config.num_preview_rows)
         return generate_interactive_html(reg.get(df_id))
-
-    @staticmethod
-    def _num_preview_rows() -> int:
-        from daft_tpu.context import get_context
-
-        return get_context().execution_config.num_preview_rows
 
     # ------------------------------------------------------------------ #
     # Transformations                                                     #
@@ -832,6 +830,33 @@ class DataFrame:
 
     def write_huggingface(self, *a, **kw):
         return self._integration_write("huggingface", "network egress + hf hub")
+
+    def write_clickhouse(self, table: str, *, host: str, port: int = None,
+                         user: str = None, password: str = None,
+                         database: str = None, **kwargs) -> "DataFrame":
+        """Insert into a ClickHouse table over its HTTP interface
+        (reference: DataFrame.write_clickhouse, daft/io/clickhouse/)."""
+        from daft_tpu.io.connectors import ClickHouseDataSink
+
+        return self.write_sink(ClickHouseDataSink(
+            table, host=host, port=port, user=user, password=password,
+            database=database, **kwargs))
+
+    def write_turbopuffer(self, namespace: str, **kwargs) -> "DataFrame":
+        """Upsert rows into a Turbopuffer namespace
+        (reference: DataFrame.write_turbopuffer, daft/io/turbopuffer/)."""
+        from daft_tpu.io.connectors import TurbopufferDataSink
+
+        return self.write_sink(TurbopufferDataSink(namespace, **kwargs))
+
+    def write_bigtable(self, project_id: str, instance_id: str, table_id: str,
+                       **kwargs) -> "DataFrame":
+        """Write rows to a Bigtable table (reference:
+        DataFrame.write_bigtable, daft/io/bigtable/)."""
+        from daft_tpu.io.connectors import BigtableDataSink
+
+        return self.write_sink(BigtableDataSink(
+            project_id, instance_id, table_id, **kwargs))
 
     def write_sink(self, sink) -> "DataFrame":
         """Write through a pluggable DataSink (reference: daft/io/sink.py)."""

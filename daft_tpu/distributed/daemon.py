@@ -332,7 +332,8 @@ class RemoteWorker(Worker):
 # ------------------------------------------------------------------ #
 def spawn_local_daemon(port: int = 0, slots: int = 2,
                        jax_platforms: Optional[str] = None,
-                       fault_injection: bool = False) -> "subprocess.Popen":
+                       fault_injection: bool = False,
+                       advertise_host: str = "localhost") -> "subprocess.Popen":
     """Launch a daemon subprocess on localhost; returns the Popen. The port
     is written to stdout line 1 (`PORT <n>`) when 0 is requested."""
     repo_root = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
@@ -357,13 +358,14 @@ def spawn_local_daemon(port: int = 0, slots: int = 2,
     return subprocess.Popen(
         [sys.executable, "-m", "daft_tpu.distributed.daemon",
          "--port", str(port), "--slots", str(slots),
-         "--advertise-host", "localhost"],
+         "--advertise-host", advertise_host],
         env=env, stdout=subprocess.PIPE, text=True,
     )
 
 
-def wait_for_daemon(proc: "subprocess.Popen", timeout: float = 60.0) -> str:
-    """Block until the daemon prints its PORT line; returns 'localhost:port'.
+def wait_for_daemon(proc: "subprocess.Popen", timeout: float = 60.0,
+                    host: str = "localhost") -> str:
+    """Block until the daemon prints its PORT line; returns '<host>:port'.
     Fails fast if the process dies, and respects the deadline even if the
     daemon stays alive but silent."""
     import select
@@ -382,7 +384,7 @@ def wait_for_daemon(proc: "subprocess.Popen", timeout: float = 60.0) -> str:
             time.sleep(0.1)
             continue
         if line.startswith("PORT "):
-            return f"localhost:{line.split()[1]}"
+            return f"{host}:{line.split()[1]}"
     raise DaftDaemonError("daemon did not report a port in time")
 
 

@@ -147,7 +147,7 @@ def read_hudi(table_uri, io_config=None, **kwargs) -> DataFrame:
 
 def read_sql(sql_query: str, conn, partition_col=None, num_partitions=None,
              partition_bound_strategy: str = "min-max",
-             infer_schema_length: int = 10, **kwargs):
+             infer_schema_length: int = 10, schema=None, **kwargs):
     """SQL databases via a DB-API connection factory (reference:
     daft.read_sql / daft/io/_sql.py + daft/sql/sql_scan.py).
 
@@ -170,7 +170,7 @@ def read_sql(sql_query: str, conn, partition_col=None, num_partitions=None,
     source = SQLSource(sql_query, conn, partition_col=partition_col,
                        num_partitions=num_partitions,
                        partition_bound_strategy=partition_bound_strategy,
-                       infer_schema_length=infer_schema_length)
+                       infer_schema_length=infer_schema_length, schema=schema)
     return read_source(source)
 
 

@@ -106,7 +106,8 @@ class SQLSource(DataSource):
     def __init__(self, sql: str, conn_factory, partition_col: Optional[str] = None,
                  num_partitions: Optional[int] = None,
                  partition_bound_strategy: str = "min-max",
-                 infer_schema_length: int = 10):
+                 infer_schema_length: int = 10,
+                 schema: Optional[Schema] = None):
         if partition_bound_strategy not in ("min-max", "percentile"):
             raise DaftValueError(
                 f"partition_bound_strategy must be min-max|percentile, "
@@ -119,7 +120,7 @@ class SQLSource(DataSource):
         self.num_partitions = num_partitions
         self.strategy = partition_bound_strategy
         self.infer_schema_length = infer_schema_length
-        self._schema: Optional[Schema] = None
+        self._schema: Optional[Schema] = schema  # explicit schema skips probing
         self._factory_shared: Optional[bool] = None
         self._bounds_cache: Dict[int, List[Any]] = {}
         if partition_col is not None and not self._owns_connections():
@@ -155,7 +156,14 @@ class SQLSource(DataSource):
 
     # -- schema inference -------------------------------------------------
     def schema(self) -> Schema:
+        """Probe LIMIT infer_schema_length rows (reference: read_sql's
+        infer_schema/infer_schema_length — the probe is the price of a lazy
+        scan; pass schema= to read_sql to skip it). Columns that are
+        entirely NULL in the probe get a targeted WHERE col IS NOT NULL
+        probe so a late non-null value cannot break the declared type."""
         if self._schema is None:
+            import pyarrow as pa
+
             conn = self._connect()
             try:
                 cursor = conn.cursor()
@@ -165,7 +173,22 @@ class SQLSource(DataSource):
                 columns = _cursor_columns(cursor)
                 rows = cursor.fetchall()
                 mp = _rows_to_micropartition(columns, rows)
-                self._schema = mp.schema
+                schema = mp.schema
+                arrow = schema.to_arrow()
+                fixes = {}
+                for i, c in enumerate(columns):
+                    if pa.types.is_null(arrow.field(c).type):
+                        cursor.execute(
+                            f"SELECT {c} FROM ({self.sql}) AS __daft_t "
+                            f"WHERE {c} IS NOT NULL LIMIT 1")
+                        row = cursor.fetchone()
+                        if row is not None and row[0] is not None:
+                            fixes[c] = pa.array([row[0]]).type
+                if fixes:
+                    fields = [pa.field(f.name, fixes.get(f.name, arrow.field(f.name).type))
+                              for f in arrow]
+                    schema = Schema.from_arrow(pa.schema(fields))
+                self._schema = schema
             finally:
                 if self._owns_connections():
                     try:

@@ -58,15 +58,11 @@ async function renderDetail() {
 }
 
 async function renderWorkers() {
-  const qs = await getJSON("/api/queries");
-  const rows = [];
-  for (const q of qs) {
-    const d = await getJSON("/api/queries/" + encodeURIComponent(q.query_id));
-    for (const [wid, w] of Object.entries(d.workers || {}))
-      rows.push(`<tr><td>${esc(wid)}</td><td>${esc(q.query_id)}</td>
-        <td>${w.tasks}</td><td>${w.busy_s.toFixed(2)}</td><td>${w.errors}</td></tr>`);
-  }
-  $("#workers tbody").innerHTML = rows.join("");
+  const ws = await getJSON("/api/workers");  // one aggregate call, no N+1
+  $("#workers tbody").innerHTML = ws.map((w) =>
+    `<tr><td>${esc(w.worker)}</td><td>${esc(w.query_id)}</td>
+      <td>${w.tasks}</td><td>${w.busy_s.toFixed(2)}</td><td>${w.errors}</td></tr>`
+  ).join("");
 }
 
 async function renderDataframes() {

@@ -63,15 +63,17 @@ class DataFrameDisplay:
         self._dfs: Dict[str, dict] = {}
         self._next = 0
 
-    def register(self, df, name: Optional[str] = None) -> str:
-        # ONE execution: fetch MAX+1 rows to learn whether more exist. A
-        # separate count_rows() would re-run the full unlimited plan just
+    def register(self, df, name: Optional[str] = None,
+                 max_rows: Optional[int] = None) -> str:
+        # ONE execution: fetch max_rows+1 rows to learn whether more exist.
+        # A separate count_rows() would re-run the full unlimited plan just
         # for a number.
-        data = df.limit(self.MAX_PREVIEW_ROWS + 1).to_pydict()
+        limit = max_rows or self.MAX_PREVIEW_ROWS
+        data = df.limit(limit + 1).to_pydict()
         fetched = len(next(iter(data.values()), []))
-        truncated = fetched > self.MAX_PREVIEW_ROWS
+        truncated = fetched > limit
         if truncated:
-            data = {k: v[:self.MAX_PREVIEW_ROWS] for k, v in data.items()}
+            data = {k: v[:limit] for k, v in data.items()}
         with self._lock:
             self._next += 1
             df_id = f"df{self._next}"
@@ -79,7 +81,7 @@ class DataFrameDisplay:
                 "id": df_id, "name": name or df_id, "data": data,
                 "columns": list(data.keys()),
                 "rows": None if truncated else fetched,
-                "preview_rows": min(fetched, self.MAX_PREVIEW_ROWS),
+                "preview_rows": min(fetched, limit),
             }
         return df_id
 
@@ -200,6 +202,16 @@ class DashboardState:
             out["workers"] = dict(q["workers"])
             return out
 
+    def workers_summary(self) -> List[dict]:
+        """Flat worker rows across queries (one endpoint, not N+1 fetches)."""
+        with self._lock:
+            out = []
+            for q in self.queries.values():
+                for wid, w in q["workers"].items():
+                    out.append({"worker": wid, "query_id": q["query_id"],
+                                **w})
+            return out
+
     def engine_summary(self) -> dict:
         """Live engine state (reference: daft-dashboard engine.rs state)."""
         with self._lock:
@@ -256,6 +268,9 @@ class _Handler(BaseHTTPRequestHandler):
             ctype = "application/json"
         elif path == "/api/engine":
             body = json.dumps(self.state.engine_summary()).encode()
+            ctype = "application/json"
+        elif path == "/api/workers":
+            body = json.dumps(self.state.workers_summary()).encode()
             ctype = "application/json"
         elif path == "/api/dataframes":
             body = json.dumps(self.displays.listing()).encode()

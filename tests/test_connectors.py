@@ -1,0 +1,168 @@
+"""ClickHouse / Turbopuffer / Bigtable data sinks (zero egress: local HTTP
+fixtures and fake clients). Mirrors /root/reference/daft/io/{clickhouse,
+turbopuffer,bigtable}/ *_data_sink.py behavior."""
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+import daft_tpu
+from daft_tpu.errors import DaftIOError
+from daft_tpu.io.connectors import (
+    BigtableDataSink,
+    ClickHouseDataSink,
+    TurbopufferDataSink,
+)
+
+
+@pytest.fixture()
+def capture_server():
+    """Records POSTs; responds 200 {}."""
+    store = {"requests": []}
+
+    class H(BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_POST(self):
+            n = int(self.headers.get("Content-Length", 0))
+            store["requests"].append({
+                "path": self.path,
+                "headers": {k: v for k, v in self.headers.items()},
+                "body": self.rfile.read(n),
+            })
+            body = b"{}"
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), H)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    yield f"127.0.0.1:{srv.server_address[1]}", store
+    srv.shutdown()
+
+
+def test_clickhouse_sink_http_insert(capture_server):
+    hostport, store = capture_server
+    host, port = hostport.split(":")
+    df = daft_tpu.from_pydict({"a": [1, 2, 3], "s": ["x", "y", "z"]})
+    out = df.write_clickhouse("events", host=host, port=int(port),
+                              user="u1", password="p1",
+                              database="db").to_pydict()
+    assert out["total_written_rows"] == [3]
+    assert out["total_written_bytes"][0] > 0
+    req = store["requests"][0]
+    assert "INSERT+INTO+db.events+FORMAT+JSONEachRow" in req["path"].replace("%20", "+")
+    hdrs = {k.lower(): v for k, v in req["headers"].items()}  # urllib recases
+    assert hdrs["x-clickhouse-user"] == "u1"
+    assert hdrs["x-clickhouse-key"] == "p1"
+    rows = [json.loads(line) for line in req["body"].decode().splitlines()]
+    assert rows == [{"a": 1, "s": "x"}, {"a": 2, "s": "y"}, {"a": 3, "s": "z"}]
+
+
+def test_turbopuffer_sink_upsert(capture_server):
+    hostport, store = capture_server
+    df = daft_tpu.from_pydict({"id": [1, 2],
+                               "vector": [[0.1, 0.2], [0.3, 0.4]],
+                               "label": ["a", "b"]})
+    out = df.write_turbopuffer("ns1", api_key="tpuf-key",
+                               base_url=f"http://{hostport}").to_pydict()
+    assert out["rows_affected"] == [2]
+    req = store["requests"][0]
+    assert req["path"] == "/v2/namespaces/ns1"
+    assert req["headers"]["Authorization"] == "Bearer tpuf-key"
+    body = json.loads(req["body"])
+    assert body["distance_metric"] == "cosine_distance"
+    assert body["upsert_rows"][0]["id"] == 1
+    assert body["upsert_rows"][1]["vector"] == [0.3, 0.4]
+
+
+def test_turbopuffer_requires_id_column(capture_server):
+    hostport, _ = capture_server
+    df = daft_tpu.from_pydict({"x": [1]})
+    with pytest.raises(Exception, match="'id' column"):
+        df.write_turbopuffer("ns", api_key="k",
+                             base_url=f"http://{hostport}").to_pydict()
+
+
+def test_turbopuffer_requires_credentials(monkeypatch):
+    monkeypatch.delenv("TURBOPUFFER_API_KEY", raising=False)
+    with pytest.raises(DaftIOError, match="TURBOPUFFER_API_KEY"):
+        TurbopufferDataSink("ns")
+
+
+class _FakeBigtableStatus:
+    def __init__(self, code=0):
+        self.code = code
+
+
+class _FakeBigtableRow:
+    def __init__(self, key):
+        self.key = key
+        self.cells = []
+
+    def set_cell(self, family, qualifier, value):
+        self.cells.append((family, qualifier.decode(), value))
+
+
+class _FakeBigtableTable:
+    def __init__(self):
+        self.mutated = []
+
+    def direct_row(self, key):
+        return _FakeBigtableRow(key)
+
+    def mutate_rows(self, rows):
+        self.mutated.extend(rows)
+        return [_FakeBigtableStatus(0) for _ in rows]
+
+
+class _FakeBigtableClient:
+    def __init__(self):
+        self.table_obj = _FakeBigtableTable()
+
+    def instance(self, instance_id):
+        return self
+
+    def table(self, table_id):
+        return self.table_obj
+
+
+def test_bigtable_sink_with_fake_client():
+    client = _FakeBigtableClient()
+    df = daft_tpu.from_pydict({"row_key": ["r1", "r2"],
+                               "name": ["ann", "bob"], "age": [30, None]})
+    out = df.write_bigtable("proj", "inst", "tbl", client=client).to_pydict()
+    assert out["rows_written"] == [2]
+    t = client.table_obj
+    assert [r.key for r in t.mutated] == [b"r1", b"r2"]
+    assert ("cf", "name", b"ann") in t.mutated[0].cells
+    # None cells are skipped, not written as "None".
+    assert all(q != "age" for _, q, _v in t.mutated[1].cells)
+
+
+def test_bigtable_gates_on_missing_dependency():
+    with pytest.raises(DaftIOError, match="google-cloud-bigtable"):
+        BigtableDataSink("p", "i", "t")
+
+
+def test_clickhouse_http_error_surfaces():
+    class Deny(BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_POST(self):
+            self.send_error(403, "denied")
+
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), Deny)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        df = daft_tpu.from_pydict({"a": [1]})
+        with pytest.raises(Exception, match="403"):
+            df.write_clickhouse("t", host="127.0.0.1",
+                                port=srv.server_address[1]).to_pydict()
+    finally:
+        srv.shutdown()

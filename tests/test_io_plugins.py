@@ -209,3 +209,28 @@ def test_sql_literal_formatting():
     assert _sql_literal(datetime.date(2020, 1, 2)) == "'2020-01-02'"
     assert _sql_literal(datetime.datetime(2020, 1, 2, 3, 4, 5)) == \
         "'2020-01-02 03:04:05'"
+
+
+def test_read_sql_all_null_probe_column(tmp_path):
+    """A column NULL in the first probe rows but non-null later must infer
+    its real type via the targeted IS NOT NULL probe (review r4 finding)."""
+    import sqlite3
+
+    db = str(tmp_path / "n.db")
+    c = sqlite3.connect(db)
+    c.execute("CREATE TABLE t (a INTEGER, b TEXT)")
+    c.executemany("INSERT INTO t VALUES (?, ?)",
+                  [(i, None) for i in range(10)] + [(10, "late")])
+    c.commit(); c.close()
+    out = daft_tpu.read_sql("SELECT * FROM t ORDER BY a",
+                            lambda: sqlite3.connect(db)).to_pydict()
+    assert out["b"] == [None] * 10 + ["late"]
+    # Explicit schema skips probing entirely.
+    from daft_tpu.schema import Field, Schema
+
+    sch = Schema([Field("a", daft_tpu.DataType.int64()),
+                  Field("b", daft_tpu.DataType.string())])
+    out2 = daft_tpu.read_sql("SELECT * FROM t ORDER BY a",
+                             lambda: sqlite3.connect(db),
+                             schema=sch).to_pydict()
+    assert out2 == out
