@@ -56,9 +56,16 @@ class ClickHouseDataSink(DataSink):
                  user: Optional[str] = None, password: Optional[str] = None,
                  database: Optional[str] = None, secure: bool = False,
                  post=None):
+        if "://" in host:
+            # Honor an explicit scheme — silently downgrading https:// to
+            # plain HTTP would leak credentials in cleartext.
+            url_scheme, host = host.split("://", 1)
+            if url_scheme == "https":
+                secure = True
+            elif url_scheme != "http":
+                raise DaftIOError(f"unsupported ClickHouse scheme {url_scheme!r}")
         scheme = "https" if secure else "http"
         port = port or (8443 if secure else 8123)
-        host = host if "://" not in host else host.split("://", 1)[1]
         self.url = f"{scheme}://{host}:{port}/"
         self.table = table
         self.database = database
@@ -70,10 +77,19 @@ class ClickHouseDataSink(DataSink):
             self.headers["X-ClickHouse-Key"] = password
         self.post = post or _default_post
 
+    @staticmethod
+    def _ident(name: str) -> str:
+        """Backtick-quoted ClickHouse identifier (no SQL smuggling via
+        table/database strings)."""
+        return "`" + name.replace("\\", "\\\\").replace("`", "\\`") + "`"
+
     def write(self, partition: MicroPartition) -> WriteResult:
         rows = _json_rows(partition)
+        if not rows:  # empty partitions: no network round-trip
+            return WriteResult(None, rows=0, bytes_=0)
         payload = "\n".join(json.dumps(r, default=str) for r in rows).encode()
-        target = f"{self.database}.{self.table}" if self.database else self.table
+        target = self._ident(self.table) if not self.database else \
+            f"{self._ident(self.database)}.{self._ident(self.table)}"
         import urllib.parse
 
         q = urllib.parse.urlencode(
@@ -113,7 +129,9 @@ class TurbopufferDataSink(DataSink):
 
     def write(self, partition: MicroPartition) -> WriteResult:
         rows = _json_rows(partition)
-        if rows and "id" not in rows[0]:
+        if not rows:  # the v2 API rejects empty upserts
+            return WriteResult(None, rows=0, bytes_=0)
+        if "id" not in rows[0]:
             raise DaftIOError("turbopuffer upserts need an 'id' column")
         body = json.dumps({"upsert_rows": rows,
                            "distance_metric": self.distance_metric},

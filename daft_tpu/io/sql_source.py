@@ -178,10 +178,14 @@ class SQLSource(DataSource):
                 fixes = {}
                 for i, c in enumerate(columns):
                     if pa.types.is_null(arrow.field(c).type):
-                        cursor.execute(
-                            f"SELECT {c} FROM ({self.sql}) AS __daft_t "
-                            f"WHERE {c} IS NOT NULL LIMIT 1")
-                        row = cursor.fetchone()
+                        q = '"' + c.replace('"', '""') + '"'  # SQL ident quoting
+                        try:
+                            cursor.execute(
+                                f"SELECT {q} FROM ({self.sql}) AS __daft_t "
+                                f"WHERE {q} IS NOT NULL LIMIT 1")
+                            row = cursor.fetchone()
+                        except Exception:  # dialect quirk: keep Null dtype
+                            row = None
                         if row is not None and row[0] is not None:
                             fixes[c] = pa.array([row[0]]).type
                 if fixes:

@@ -133,11 +133,12 @@ class SimplifyExpressions(Rule):
 
     def rewrite(self, node):
         if isinstance(node, lp.Project):
-            new = [simplify_expr(e) for e in node.exprs]
+            schema = node.children()[0].schema
+            new = [simplify_expr(e, schema) for e in node.exprs]
             if any(a is not b for a, b in zip(new, node.exprs)):
                 return lp.Project(node.children()[0], new)
         if isinstance(node, lp.Filter):
-            p = simplify_expr(node.predicate)
+            p = simplify_expr(node.predicate, node.children()[0].schema)
             if isinstance(p, Literal) and p.value is True:
                 return node.children()[0]
             if p is not node.predicate:
@@ -145,7 +146,44 @@ class SimplifyExpressions(Rule):
         return None
 
 
-def simplify_expr(e: Expr) -> Expr:
+def _lit_is(v, value: bool) -> bool:
+    return isinstance(v, Literal) and v.value is value
+
+
+def _is_zero(n: Expr) -> bool:
+    return isinstance(n, Literal) and not isinstance(n.value, bool) \
+        and n.value == 0
+
+
+def _is_one(n: Expr) -> bool:
+    return isinstance(n, Literal) and not isinstance(n.value, bool) \
+        and n.value == 1
+
+
+def _is_null_lit(n: Expr) -> bool:
+    return isinstance(n, Literal) and n.value is None
+
+
+_NULL_PROPAGATING = {"eq", "ne", "lt", "le", "gt", "ge", "add", "sub", "mul",
+                     "truediv", "floordiv", "mod", "pow", "xor"}
+
+
+def simplify_expr(e: Expr, schema=None) -> Expr:
+    """Algebraic simplification (reference: src/daft-algebra/src/simplify/
+    {numeric.rs,boolean.rs,null.rs}): constant folding, boolean and numeric
+    identities, null-literal propagation, bool-comparison elimination.
+    Identity eliminations that could change the expression's dtype (e.g.
+    int32_col * 1int64) only fire when ``schema`` proves the dtype is
+    preserved."""
+
+    def same_dtype(a: Expr, whole: Expr) -> bool:
+        if schema is None:
+            return False
+        try:
+            return a.to_field(schema).dtype == whole.to_field(schema).dtype
+        except Exception:
+            return False
+
     def fold(n: Expr):
         if isinstance(n, BinaryOp):
             l, r = n.left, n.right
@@ -160,23 +198,65 @@ def simplify_expr(e: Expr) -> Expr:
                     return Literal(vals[0], res.dtype)
                 except Exception:
                     return None
-            # x AND true -> x ; x OR false -> x
+            # NULL literal propagates through comparisons/arithmetic
+            # (null.rs) — NOT through Kleene and/or. The replacement keeps
+            # the ORIGINAL dtype (an untyped None would silently turn the
+            # declared Int64 column into Arrow null type downstream).
+            if n.op in _NULL_PROPAGATING and (_is_null_lit(l) or _is_null_lit(r)):
+                if schema is None:
+                    return None
+                try:
+                    return Literal(None, n.to_field(schema).dtype)
+                except Exception:
+                    return None
+            # Kleene boolean identities (boolean.rs): the short-circuit
+            # absorptions hold even for null operands.
             if n.op == "and":
-                if isinstance(r, Literal) and r.value is True:
+                if _lit_is(r, True):
                     return l
-                if isinstance(l, Literal) and l.value is True:
+                if _lit_is(l, True):
                     return r
+                if _lit_is(l, False) or _lit_is(r, False):
+                    return Literal(False)
             if n.op == "or":
-                if isinstance(r, Literal) and r.value is False:
+                if _lit_is(r, False):
                     return l
-                if isinstance(l, Literal) and l.value is False:
+                if _lit_is(l, False):
                     return r
+                if _lit_is(l, True) or _lit_is(r, True):
+                    return Literal(True)
+            # bool_col == true -> bool_col ; == false -> NOT col ; etc.
+            if n.op in ("eq", "ne"):
+                for a, b in ((l, r), (r, l)):
+                    if isinstance(b, Literal) and isinstance(b.value, bool) \
+                            and same_dtype(a, n):
+                        want_not = (n.op == "eq") != b.value
+                        return UnaryOp("not", a) if want_not else a
+            # Numeric identities (numeric.rs), dtype-preserving only.
+            if n.op == "mul":
+                if _is_one(r) and same_dtype(l, n):
+                    return l
+                if _is_one(l) and same_dtype(r, n):
+                    return r
+            if n.op == "truediv" and _is_one(r) and same_dtype(l, n):
+                return l
+            if n.op == "add":
+                if _is_zero(r) and same_dtype(l, n):
+                    return l
+                if _is_zero(l) and same_dtype(r, n):
+                    return r
+            if n.op == "sub" and _is_zero(r) and same_dtype(l, n):
+                return l
         if isinstance(n, UnaryOp) and n.op == "not":
             c = n.child
             if isinstance(c, UnaryOp) and c.op == "not":
                 return c.child
             if isinstance(c, Literal) and isinstance(c.value, bool):
                 return Literal(not c.value)
+        if isinstance(n, UnaryOp) and n.op == "negate":
+            c = n.child
+            if isinstance(c, UnaryOp) and c.op == "negate":
+                return c.child
         return None
 
     return e.transform(fold)

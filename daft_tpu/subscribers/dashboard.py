@@ -68,7 +68,7 @@ class DataFrameDisplay:
         # ONE execution: fetch max_rows+1 rows to learn whether more exist.
         # A separate count_rows() would re-run the full unlimited plan just
         # for a number.
-        limit = max_rows or self.MAX_PREVIEW_ROWS
+        limit = self.MAX_PREVIEW_ROWS if max_rows is None else max_rows
         data = df.limit(limit + 1).to_pydict()
         fetched = len(next(iter(data.values()), []))
         truncated = fetched > limit

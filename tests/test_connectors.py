@@ -55,7 +55,10 @@ def test_clickhouse_sink_http_insert(capture_server):
     assert out["total_written_rows"] == [3]
     assert out["total_written_bytes"][0] > 0
     req = store["requests"][0]
-    assert "INSERT+INTO+db.events+FORMAT+JSONEachRow" in req["path"].replace("%20", "+")
+    import urllib.parse as _up
+
+    assert "INSERT INTO `db`.`events` FORMAT JSONEachRow" in \
+        _up.unquote_plus(req["path"])
     hdrs = {k.lower(): v for k, v in req["headers"].items()}  # urllib recases
     assert hdrs["x-clickhouse-user"] == "u1"
     assert hdrs["x-clickhouse-key"] == "p1"
@@ -166,3 +169,32 @@ def test_clickhouse_http_error_surfaces():
                                 port=srv.server_address[1]).to_pydict()
     finally:
         srv.shutdown()
+
+
+def test_clickhouse_identifier_quoting_and_https_host(capture_server):
+    hostport, store = capture_server
+    host, port = hostport.split(":")
+    df = daft_tpu.from_pydict({"a": [1]})
+    df.write_clickhouse("my-events", host=host, port=int(port),
+                        database="2024_db").to_pydict()
+    import urllib.parse
+
+    path = urllib.parse.unquote_plus(store["requests"][-1]["path"])
+    assert "INSERT INTO `2024_db`.`my-events` FORMAT JSONEachRow" in path
+    # https:// in host must NOT silently downgrade to plain http.
+    from daft_tpu.io.connectors import ClickHouseDataSink
+
+    sink = ClickHouseDataSink("t", host="https://ch.example.com", password="s")
+    assert sink.url.startswith("https://ch.example.com:8443")
+    with pytest.raises(Exception, match="scheme"):
+        ClickHouseDataSink("t", host="ftp://ch.example.com")
+
+
+def test_sinks_skip_empty_partitions(capture_server):
+    hostport, store = capture_server
+    host, port = hostport.split(":")
+    df = daft_tpu.from_pydict({"id": [1, 2]}).where(daft_tpu.col("id") > 99)
+    out = df.write_turbopuffer("ns", api_key="k",
+                               base_url=f"http://{hostport}").to_pydict()
+    assert out["rows_affected"] == [0]
+    assert store["requests"] == []  # no POST for an empty upsert

@@ -311,3 +311,66 @@ def test_count_pushdown_struct_column_bails_to_scan(tmp_path):
     scan = daft_tpu.read_parquet(str(tmp_path))
     assert scan.agg(col("s").count().alias("n")).to_pydict() == {"n": [2]}
     assert scan.agg(col("s").count(mode="all").alias("n")).to_pydict() == {"n": [3]}
+
+
+def test_simplify_algebraic_identities():
+    """daft-algebra parity: numeric/null/bool-compare simplifications
+    (reference: src/daft-algebra/src/simplify/{numeric,boolean,null}.rs)."""
+    from daft_tpu.logical.optimizer import simplify_expr
+    from daft_tpu.expressions.expr import BinaryOp, ColumnRef, Literal, UnaryOp
+    from daft_tpu.schema import Field, Schema
+    from daft_tpu.datatype import DataType
+
+    sch = Schema([Field("x", DataType.int64()), Field("f", DataType.float64()),
+                  Field("b", DataType.bool())])
+    x, f, b = ColumnRef("x"), ColumnRef("f"), ColumnRef("b")
+
+    assert simplify_expr(BinaryOp("mul", x, Literal(1)), sch).key() == x.key()
+    assert simplify_expr(BinaryOp("add", Literal(0), x), sch).key() == x.key()
+    assert simplify_expr(BinaryOp("sub", x, Literal(0)), sch).key() == x.key()
+    assert simplify_expr(BinaryOp("truediv", f, Literal(1)), sch).key() == f.key()
+    # int_col / 1 changes dtype (int->float): must NOT simplify.
+    e = simplify_expr(BinaryOp("truediv", x, Literal(1)), sch)
+    assert isinstance(e, BinaryOp)
+    # NULL propagation through comparisons/arithmetic, not Kleene and/or.
+    assert simplify_expr(BinaryOp("eq", x, Literal(None)), sch).value is None
+    assert simplify_expr(BinaryOp("add", Literal(None), x), sch).value is None
+    kleene = simplify_expr(BinaryOp("or", b, Literal(None)), sch)
+    assert isinstance(kleene, BinaryOp)  # null OR b is NOT null
+    # Kleene absorption: b AND false -> false even when b is null.
+    assert simplify_expr(BinaryOp("and", b, Literal(False)), sch).value is False
+    assert simplify_expr(BinaryOp("or", Literal(True), b), sch).value is True
+    # bool compare elimination.
+    assert simplify_expr(BinaryOp("eq", b, Literal(True)), sch).key() == b.key()
+    notb = simplify_expr(BinaryOp("eq", b, Literal(False)), sch)
+    assert isinstance(notb, UnaryOp) and notb.op == "not"
+    assert simplify_expr(BinaryOp("ne", Literal(False), b), sch).key() == b.key()
+    # x == true where x is NOT bool must not simplify.
+    e2 = simplify_expr(BinaryOp("eq", x, Literal(True)), sch)
+    assert isinstance(e2, BinaryOp)
+    # double negation
+    assert simplify_expr(UnaryOp("negate", UnaryOp("negate", x)), sch).key() == x.key()
+
+
+def test_simplify_end_to_end_results_unchanged():
+    df = daft_tpu.from_pydict({"x": [1, 2, None], "b": [True, False, None]})
+    out = df.select(
+        ((col("x") * 1 + 0).alias("x2")),
+        (col("b") == lit(True)).alias("bt"),
+        (col("x") + lit(None)).alias("xn"),
+    ).to_pydict()
+    assert out["x2"] == [1, 2, None]
+    assert out["bt"] == [True, False, None]
+    assert out["xn"] == [None, None, None]
+
+
+def test_simplify_null_propagation_keeps_dtype():
+    """x + NULL folds to a TYPED null literal: the declared Int64 schema and
+    the materialized Arrow type must agree (review r4 finding)."""
+    df = daft_tpu.from_pydict({"x": [1, 2]})
+    out = df.select((col("x") + lit(None)).alias("xn"))
+    assert out.schema["xn"].dtype == daft_tpu.DataType.int64()
+    parts = out._materialize().partitions
+    rb = parts[0].combined()
+    assert rb.get_column("xn").dtype == daft_tpu.DataType.int64()
+    assert rb.get_column("xn").to_pylist() == [None, None]
