@@ -46,15 +46,16 @@ HEALTH_BATCH, HEALTH_N = 64, 128
 CPU_NUM_IMAGES = 64
 CPU_BATCH_SIZE = 32
 
-# Global wall-clock budget: the driver enforces its own (unknown) timeout,
-# so the parent must print a JSON line well before any plausible budget. The
-# pieces below are carved out of this one deadline.
-TOTAL_BUDGET_S = int(os.environ.get("DAFT_BENCH_BUDGET_S", "1500"))
-TPU_PROBE_WAIT_S = int(os.environ.get("DAFT_BENCH_TPU_WAIT_S", "300"))
-CPU_RESERVE_S = int(os.environ.get("DAFT_BENCH_CPU_TIMEOUT_S", "300"))
-HEALTH_TIMEOUT_S = int(os.environ.get("DAFT_BENCH_HEALTH_TIMEOUT_S", "300"))
-RUNG_MAX_S = int(os.environ.get("DAFT_BENCH_RUNG_MAX_S", "420"))
-RUNG_MIN_S = 120  # skip a rung rather than run it with a hopeless timeout
+# Global wall-clock budget: the driver killed round 3's bench at ~1091 s, so
+# the parent must print a JSON line WELL before that — every stage below is
+# carved out of one ~950 s deadline (the r3 postmortem: a 1500 s budget
+# outlived the driver and the round recorded nothing).
+TOTAL_BUDGET_S = int(os.environ.get("DAFT_BENCH_BUDGET_S", "950"))
+TPU_PROBE_WAIT_S = int(os.environ.get("DAFT_BENCH_TPU_WAIT_S", "240"))
+CPU_RESERVE_S = int(os.environ.get("DAFT_BENCH_CPU_TIMEOUT_S", "250"))
+HEALTH_TIMEOUT_S = int(os.environ.get("DAFT_BENCH_HEALTH_TIMEOUT_S", "240"))
+RUNG_MAX_S = int(os.environ.get("DAFT_BENCH_RUNG_MAX_S", "300"))
+RUNG_MIN_S = 100  # skip a rung rather than run it with a hopeless timeout
 _START = time.time()
 
 

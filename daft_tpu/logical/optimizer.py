@@ -99,8 +99,8 @@ class Optimizer:
             [DetectMonotonicId()],
             [SimplifyExpressions()],
             [SplitUDFs()],
-            [EliminateCrossJoin(), PushDownFilter(), PushDownSemiAnti(),
-             PushDownShard(), DropRepartition()],
+            [SimplifyNullFilteredJoin(), EliminateCrossJoin(), PushDownFilter(),
+             PushDownSemiAnti(), PushDownShard(), DropRepartition()],
             [PushDownLimit()],
             [EnrichWithStats()],
             [PushDownAggregation()],
@@ -572,6 +572,97 @@ class PushDownSemiAnti(Rule):
                                 list(node.right_on), node.how)
                 return left.with_children([a, new_b])
         return None
+
+
+class SimplifyNullFilteredJoin(Rule):
+    """Downgrade left/right/outer joins whose null-producing side is
+    null-filtered above the join (reference:
+    optimization/rules/simplify_null_filtered_join.rs):
+    ``A LEFT JOIN B WHERE B.x > 0`` ≡ ``A INNER JOIN B WHERE B.x > 0`` —
+    the padded-null rows can never pass the predicate. Unblocks
+    ReorderJoins (which only touches inner joins)."""
+
+    name = "SimplifyNullFilteredJoin"
+
+    def rewrite(self, node):
+        if not isinstance(node, lp.Filter):
+            return None
+        child = node.children()[0]
+        if not isinstance(child, lp.Join) or child.how not in ("left", "right", "outer"):
+            return None
+        left, right = child.children()
+        conjuncts: List[Expr] = []
+        _flatten_and(node.predicate, conjuncts)
+        left_cols = set(left.schema.column_names())
+        # Right-side output columns may be suffixed; map back to originals.
+        right_cols = set(child.schema.column_names()) - left_cols
+        if child.how in ("right", "outer"):
+            # Merged equi-keys are COALESCED across sides on right/outer
+            # joins (executor._join_and_fix): they are non-null on padded
+            # rows from either side, so predicates on them reject neither
+            # side's nulls.
+            merged = {l.name() for l, r in zip(child.left_on, child.right_on)
+                      if isinstance(l, ColumnRef) and isinstance(r, ColumnRef)
+                      and l.name() == r.name()}
+            left_cols -= merged
+            right_cols -= merged
+
+        def removes_nulls_of(side_cols) -> bool:
+            for c in conjuncts:
+                refs = c.column_refs()
+                if not refs or not (refs & side_cols):
+                    continue
+                if self._null_rejecting(c):
+                    return True
+            return False
+
+        rejects_left = removes_nulls_of(left_cols)
+        rejects_right = removes_nulls_of(right_cols)
+        how = child.how
+        # Rejecting RIGHT-side nulls eliminates the rows padded with right
+        # nulls — the LEFT-unmatched ones — leaving matched + right-unmatched
+        # (a RIGHT join); symmetrically for the left side.
+        if how == "left" and rejects_right:
+            how = "inner"
+        elif how == "right" and rejects_left:
+            how = "inner"
+        elif how == "outer":
+            if rejects_left and rejects_right:
+                how = "inner"
+            elif rejects_right:
+                how = "right"
+            elif rejects_left:
+                how = "left"
+        if how == child.how:
+            return None
+        new_join = lp.Join(left, right, child.left_on, child.right_on, how,
+                           child.strategy, child.suffix, child.prefix)
+        return lp.Filter(new_join, node.predicate)
+
+    @staticmethod
+    def _null_rejecting(c: Expr) -> bool:
+        """Conservatively: does this conjunct evaluate false-or-null whenever
+        its referenced columns are null? Comparisons and arithmetic propagate
+        null (row dropped); not_null rejects by definition. IS NULL,
+        coalesce-like kernels, and Kleene or can PASS null rows — excluded."""
+        if isinstance(c, UnaryOp) and c.op == "not_null":
+            return True
+        if isinstance(c, BinaryOp) and c.op in ("eq", "ne", "lt", "le", "gt", "ge"):
+            # Both operands must be null-propagating trees (ColumnRef /
+            # Literal / arithmetic), not null-masking kernels.
+            def propagating(n: Expr) -> bool:
+                for sub in n.walk():
+                    if isinstance(sub, (ColumnRef, Literal)):
+                        continue
+                    if isinstance(sub, BinaryOp) and sub.op in _NULL_PROPAGATING:
+                        continue
+                    if isinstance(sub, UnaryOp) and sub.op in ("negate", "abs"):
+                        continue
+                    return False
+                return True
+
+            return propagating(c.left) and propagating(c.right)
+        return False
 
 
 class DetectMonotonicId(Rule):

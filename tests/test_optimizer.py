@@ -374,3 +374,42 @@ def test_simplify_null_propagation_keeps_dtype():
     rb = parts[0].combined()
     assert rb.get_column("xn").dtype == daft_tpu.DataType.int64()
     assert rb.get_column("xn").to_pylist() == [None, None]
+
+
+def test_simplify_null_filtered_join():
+    """Filter rejecting the null-producing side's nulls downgrades
+    left/outer joins (reference: simplify_null_filtered_join.rs)."""
+    a = daft_tpu.from_pydict({"k": [1, 2, 3], "x": [10, 20, 30]})
+    b = daft_tpu.from_pydict({"k": [1, 2], "y": [5, -5]})
+
+    j = a.join(b, on="k", how="left").where(col("y") > 0)
+    plan = _optimized(j)
+    joins = [n for n in _nodes(plan) if isinstance(n, lp.Join)]
+    assert joins and all(n.how == "inner" for n in joins)
+    assert j.sort(["k"]).to_pydict()["k"] == [1]
+
+    # outer + both-side rejection -> inner
+    j2 = a.join(b, on="k", how="outer").where((col("x") > 0) & (col("y") > -99))
+    plan2 = _optimized(j2)
+    assert all(n.how == "inner" for n in _nodes(plan2) if isinstance(n, lp.Join))
+    # IS NULL must NOT downgrade (it passes padded rows).
+    j3 = a.join(b, on="k", how="left").where(col("y").is_null())
+    plan3 = _optimized(j3)
+    assert any(n.how == "left" for n in _nodes(plan3) if isinstance(n, lp.Join))
+    assert j3.to_pydict()["k"] == [3]
+
+
+def test_simplify_null_filtered_join_outer_single_side_and_merged_keys():
+    """Review r4: outer single-side downgrades keep the surviving side
+    (rejecting RIGHT nulls leaves matched + right-unmatched = RIGHT join),
+    and coalesced merged keys never count as null-rejecting."""
+    a = daft_tpu.from_pydict({"k": [1, 3]})
+    b = daft_tpu.from_pydict({"k": [1, 2], "y": [5, 6]})
+    # outer + filter rejecting right-side nulls: right-unmatched k=2 row
+    # (y=6) must survive.
+    out = a.join(b, on="k", how="outer").where(col("y") > 0).sort(["k"]).to_pydict()
+    assert out["k"] == [1, 2]
+    # right join + predicate on the coalesced merged key: k=2 is
+    # right-unmatched but its coalesced key is non-null -> must survive.
+    out2 = a.join(b, on="k", how="right").where(col("k") > 0).sort(["k"]).to_pydict()
+    assert out2["k"] == [1, 2]
