@@ -645,22 +645,23 @@ class SimplifyNullFilteredJoin(Rule):
         its referenced columns are null? Comparisons and arithmetic propagate
         null (row dropped); not_null rejects by definition. IS NULL,
         coalesce-like kernels, and Kleene or can PASS null rows — excluded."""
-        if isinstance(c, UnaryOp) and c.op == "not_null":
+        def propagating(n: Expr) -> bool:
+            # Null-propagating trees only (ColumnRef / Literal / arithmetic)
+            # — null-MASKING kernels (fill_null, coalesce, is_null) can turn
+            # a padded-null row into a passing one.
+            for sub in n.walk():
+                if isinstance(sub, (ColumnRef, Literal)):
+                    continue
+                if isinstance(sub, BinaryOp) and sub.op in _NULL_PROPAGATING:
+                    continue
+                if isinstance(sub, UnaryOp) and sub.op in ("negate", "abs"):
+                    continue
+                return False
             return True
-        if isinstance(c, BinaryOp) and c.op in ("eq", "ne", "lt", "le", "gt", "ge"):
-            # Both operands must be null-propagating trees (ColumnRef /
-            # Literal / arithmetic), not null-masking kernels.
-            def propagating(n: Expr) -> bool:
-                for sub in n.walk():
-                    if isinstance(sub, (ColumnRef, Literal)):
-                        continue
-                    if isinstance(sub, BinaryOp) and sub.op in _NULL_PROPAGATING:
-                        continue
-                    if isinstance(sub, UnaryOp) and sub.op in ("negate", "abs"):
-                        continue
-                    return False
-                return True
 
+        if isinstance(c, UnaryOp) and c.op == "not_null":
+            return propagating(c.child)
+        if isinstance(c, BinaryOp) and c.op in ("eq", "ne", "lt", "le", "gt", "ge"):
             return propagating(c.left) and propagating(c.right)
         return False
 

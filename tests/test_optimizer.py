@@ -413,3 +413,17 @@ def test_simplify_null_filtered_join_outer_single_side_and_merged_keys():
     # right-unmatched but its coalesced key is non-null -> must survive.
     out2 = a.join(b, on="k", how="right").where(col("k") > 0).sort(["k"]).to_pydict()
     assert out2["k"] == [1, 2]
+
+
+def test_null_filtered_join_not_null_over_masking_kernel():
+    """not_null(fill_null(y, 0)) is ALWAYS true — it must not downgrade the
+    left join (review r4 finding)."""
+    a = daft_tpu.from_pydict({"k": [1, 2, 3]})
+    b = daft_tpu.from_pydict({"k": [1, 2], "y": [5, 6]})
+    out = (a.join(b, on="k", how="left")
+            .where(col("y").fill_null(0).not_null())
+            .sort(["k"]).to_pydict())
+    assert out["k"] == [1, 2, 3]  # unmatched k=3 row survives
+    # Plain not_null(y) DOES downgrade (genuinely null-rejecting).
+    plan = _optimized(a.join(b, on="k", how="left").where(col("y").not_null()))
+    assert all(n.how == "inner" for n in _nodes(plan) if isinstance(n, lp.Join))

@@ -1,0 +1,79 @@
+"""Ring attention on a virtual 8-device mesh: exact parity with dense
+attention while the sequence stays sharded (one K/V block per chip,
+rotated via ppermute). Long-context/sequence parallelism is first-class
+TPU design — the reference has no analogue (SURVEY.md)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+
+def _dense_attention(q, k, v):
+    s = jnp.einsum("btd,bsd->bts", q, k) / np.sqrt(q.shape[-1])
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bts,bsd->btd", p, v)
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    from daft_tpu.parallel.mesh import make_mesh
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs the 8-device virtual CPU mesh")
+    return make_mesh({"sp": 8})
+
+
+def test_ring_attention_matches_dense(mesh):
+    from daft_tpu.ops.ring_attention import sequence_parallel_attention
+
+    rng = np.random.default_rng(0)
+    b, t, d = 2, 64, 16  # t sharded 8 ways -> 8-token blocks per chip
+    q, k, v = (jnp.asarray(rng.standard_normal((b, t, d)), dtype=jnp.float32)
+               for _ in range(3))
+    out = sequence_parallel_attention(q, k, v, mesh)
+    ref = _dense_attention(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_ring_attention_jits_over_mesh(mesh):
+    """The whole sequence-parallel computation compiles as ONE jitted XLA
+    program with ppermute collectives inside a scan."""
+    import functools
+
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from daft_tpu.ops.ring_attention import ring_attention
+
+    spec = P(None, "sp", None)
+    fn = jax.jit(shard_map(functools.partial(ring_attention, axis_name="sp"),
+                           mesh=mesh, in_specs=(spec, spec, spec),
+                           out_specs=spec))
+    rng = np.random.default_rng(1)
+    sharding = NamedSharding(mesh, spec)
+    q = jax.device_put(jnp.asarray(rng.standard_normal((1, 32, 8)),
+                                   dtype=jnp.float32), sharding)
+    out = fn(q, q, q)
+    assert out.shape == (1, 32, 8)
+    # Output stays sequence-sharded (no gather to one chip).
+    assert out.sharding.spec == spec
+    np.testing.assert_allclose(np.asarray(out),
+                               np.asarray(_dense_attention(q, q, q)),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_ring_attention_long_context_memory_shape(mesh):
+    """Each chip only ever materializes a [T_local, T_local] score block:
+    16k global tokens over 8 chips = 2k x 2k blocks, never 16k x 16k."""
+    from daft_tpu.ops.ring_attention import sequence_parallel_attention
+
+    b, t, d = 1, 1024, 8  # modest for CI; same code path as 16k+
+    rng = np.random.default_rng(2)
+    q = jnp.asarray(rng.standard_normal((b, t, d)), dtype=jnp.float32)
+    out = sequence_parallel_attention(q, q, q, mesh)
+    ref = _dense_attention(q, q, q)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=3e-5, atol=3e-5)
