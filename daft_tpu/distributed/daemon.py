@@ -279,7 +279,7 @@ class RemoteWorker(Worker):
             try:
                 payload = {
                     "op": "run_task",
-                    "cfg": self.cfg,
+                    "cfg": task.cfg or self.cfg,
                     "fragment": task.fragment,
                     "inputs": [[encode_ref(r) for r in slot] for slot in task.inputs],
                     "partition_idx": task.partition_idx,

@@ -61,6 +61,7 @@ class DistributedExecutor:
     def _dispatch(self, tasks: Sequence[Task]) -> List[List[PartitionRef]]:
         for t in tasks:
             t.query_id = self.query_id
+            t.cfg = self.cfg  # the QUERY's config rides with the task
         return self.dispatcher.run_tasks(tasks)
 
     def _chain_over(self, chain: List[pp.PhysicalPlan], leaf: pp.PhysicalPlan) -> pp.PhysicalPlan:

@@ -170,7 +170,7 @@ class ProcessWorker(Worker):
                     if self._proc.poll() is not None:
                         raise WorkerDiedError(f"worker {self.worker_id} process is dead")
                     payload = {
-                        "cfg": self.cfg,
+                        "cfg": task.cfg or self.cfg,
                         "fragment": task.fragment,
                         "inputs": [
                             [serialize_partition(r.fetch()) for r in slot]

@@ -54,6 +54,11 @@ class Task:
     # the task so every worker — thread, process, or remote daemon — evaluates
     # now()/today() to the same value.
     frozen_clock: datetime.datetime = field(default_factory=query_now)
+    # The QUERY's execution config (frozen dataclass, picklable). Workers run
+    # with this, not their construction-time snapshot — per-query
+    # execution_config_ctx settings (morsel size, dynamic batching, …) must
+    # reach every worker thread/process/daemon.
+    cfg: object = None
 
     def input_size_bytes(self) -> int:
         return sum(r.size_bytes() for refs in self.inputs for r in refs)

@@ -114,7 +114,8 @@ class LocalWorker(Worker):
                 # ALSO merges into the driver's per-query stats for the
                 # DataFrame.metrics() surface.
                 stats = RuntimeStats(task.query_id)
-                executor = Executor(self.cfg, partition_offset=task.partition_idx,
+                executor = Executor(task.cfg or self.cfg,
+                                    partition_offset=task.partition_idx,
                                     stats=stats)
                 with frozen_clock_scope(task.frozen_clock):
                     out = list(executor.run(bound))

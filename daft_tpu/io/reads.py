@@ -171,6 +171,15 @@ def read_sql(sql_query: str, conn, partition_col=None, num_partitions=None,
                        num_partitions=num_partitions,
                        partition_bound_strategy=partition_bound_strategy,
                        infer_schema_length=infer_schema_length, schema=schema)
+    if not source._owns_connections():
+        # A live (or shared-factory) connection cannot be used from scan
+        # worker threads/processes (sqlite3 hard-fails; DB-API cursors are
+        # not thread-safe). Materialize eagerly on THIS thread instead —
+        # the pre-lazy behavior for exactly these connections. Partitions
+        # stay as-is (no Arrow round-trip/concat: batches keep streaming
+        # parallelism downstream).
+        parts = [mp for task in source.get_tasks() for mp in task.execute()]
+        return DataFrame(LogicalPlanBuilder.in_memory(parts, source.schema()))
     return read_source(source)
 
 
