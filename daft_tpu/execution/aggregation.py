@@ -164,12 +164,14 @@ class AggState:
         self.input_schema = input_schema
         self._raw: List[RecordBatch] = []      # un-aggregated input morsels
         self._raw_rows = 0
+        self._approx_bytes = 0  # running total; size_bytes() once per batch
         # Partial-form batches. INVARIANT: each entry is the output of a
         # grouped aggregation (a flush, a merge, or a worker's merged
         # partials), so group keys are unique WITHIN a batch — a merge pass
         # is needed exactly when len(_buffers) > 1.
         self._buffers: List[RecordBatch] = []
         self._buffer_rows = 0
+        self._needs_merge = False  # set when an ingested batch may break the invariant
 
     def accumulate(self, mp: MicroPartition) -> None:
         """Buffer raw morsels; partial-agg only when the buffer exceeds the
@@ -182,6 +184,7 @@ class AggState:
             return
         self._raw.append(rb)
         self._raw_rows += len(rb)
+        self._approx_bytes += rb.size_bytes()
         if self._raw_rows > self.MERGE_THRESHOLD_ROWS:
             self._flush_raw()
             if self._buffer_rows > self.MERGE_THRESHOLD_ROWS:
@@ -192,20 +195,33 @@ class AggState:
             return
         partial = RecordBatch.concat(self._raw).agg(
             self.plan.partial_exprs, self.plan.group_by)
+        self._approx_bytes -= sum(rb.size_bytes() for rb in self._raw)
         self._raw = []
         self._raw_rows = 0
         self._buffers.append(partial)
         self._buffer_rows += len(partial)
+        self._approx_bytes += partial.size_bytes()
 
     def _merge(self) -> None:
         self._flush_raw()
-        if len(self._buffers) <= 1:
+        if len(self._buffers) <= 1 and not self._needs_merge:
             return  # single partial batch: groups already unique (invariant)
+        if not self._buffers:
+            return
         merged = RecordBatch.concat(self._buffers).agg(
             self.plan.merge_exprs, self.plan.merge_group_by
         )
+        self._approx_bytes -= sum(rb.size_bytes() for rb in self._buffers)
         self._buffers = [merged]
         self._buffer_rows = len(merged)
+        self._approx_bytes += merged.size_bytes()
+        self._needs_merge = False
+
+    def approx_size_bytes(self) -> int:
+        """Approximate resident bytes of buffered raw + partial state (drives
+        the grace-aggregation spill decision in the executor). Maintained
+        incrementally — this is read per morsel on the ingest hot path."""
+        return self._approx_bytes
 
     def partial_batches(self) -> List[RecordBatch]:
         """Expose merged partial state (for distributed shuffle of partials)."""
@@ -218,8 +234,22 @@ class AggState:
             return
         self._buffers.append(rb)
         self._buffer_rows += len(rb)
+        self._approx_bytes += rb.size_bytes()
         if self._buffer_rows > self.MERGE_THRESHOLD_ROWS:
             self._merge()
+
+    def accumulate_unmerged_partial(self, rb: RecordBatch) -> None:
+        """Ingest a partial batch that may contain DUPLICATE group keys.
+
+        Disk-bucket re-reads (grace aggregation) coalesce fragments from
+        several spill events into one IPC batch, so the unique-keys-per-batch
+        invariant does not hold; force a merge pass before finalize even if
+        this ends up the only buffered batch.
+        """
+        if len(rb) == 0:
+            return
+        self._needs_merge = True
+        self.accumulate_partial(rb)
 
     def partial_schema(self, input_schema):
         """Schema of the partial-state batches."""

@@ -139,6 +139,22 @@ class Executor:
         n = getattr(cfg, "num_compute_threads", 0)
         self.compute_threads = n if n > 0 else (os.cpu_count() or 1)
         self._compute_pool: Optional[ThreadPoolExecutor] = None
+        self._spill_dir = None
+
+    def _spill(self):
+        """Lazy query-scoped spill directory (cleaned up at query end)."""
+        if self._spill_dir is None:
+            from daft_tpu.execution.spill import SpillDir
+
+            self._spill_dir = SpillDir()
+        return self._spill_dir
+
+    def _sink_budget(self) -> Optional[int]:
+        """In-memory working-set budget per blocking sink; None = unbounded
+        (no DAFT_MEMORY_LIMIT set), matching the pre-out-of-core behavior."""
+        from daft_tpu.execution.spill import sink_budget
+
+        return sink_budget(self.memory.limit)
 
     def _pool(self) -> ThreadPoolExecutor:
         """The executor-wide compute pool, shared by all streaming stages so
@@ -163,6 +179,9 @@ class Executor:
             if self._compute_pool is not None:
                 self._compute_pool.shutdown(wait=False, cancel_futures=True)
                 self._compute_pool = None
+            if self._spill_dir is not None:
+                self._spill_dir.cleanup()
+                self._spill_dir = None
             if self._held_bytes:
                 self.memory.release(self._held_bytes)
                 self._held_bytes = 0
@@ -475,8 +494,21 @@ class Executor:
         return MicroPartition.concat(parts)
 
     def _run_Sort(self, node: pp.Sort) -> Iterator[MicroPartition]:
-        combined = self._collect(node.children[0])
-        yield combined.sort(node.sort_by, node.descending, node.nulls_first)
+        budget = self._sink_budget()
+        if budget is None:
+            combined = self._collect(node.children[0])
+            yield combined.sort(node.sort_by, node.descending, node.nulls_first)
+            return
+        # Out-of-core: sorted-run generation + k-way streaming merge.
+        from daft_tpu.execution.spill import ExternalSort, budget_reservation
+
+        with budget_reservation(self.memory, budget):
+            state = ExternalSort(node.sort_by, node.descending, node.nulls_first,
+                                 node.schema, budget, self._spill(),
+                                 morsel_rows=self.cfg.default_morsel_size)
+            for mp in self._run(node.children[0]):
+                state.add(mp)
+            yield from state.results()
 
     def _run_TopN(self, node: pp.TopN) -> Iterator[MicroPartition]:
         k = node.limit + node.offset
@@ -497,11 +529,71 @@ class Executor:
         return rb.sort(keys, node.descending, node.nulls_first).head(k)
 
     def _run_Aggregate(self, node: pp.Aggregate) -> Iterator[MicroPartition]:
-        state = AggState(node.agg_exprs, node.group_by, node.schema,
-                         input_schema=node.children[0].schema)
-        for mp in self._run(node.children[0]):
-            state.accumulate(mp)
-        yield MicroPartition(node.schema, [state.finalize()])
+        budget = self._sink_budget()
+
+        def fresh_state() -> AggState:
+            return AggState(node.agg_exprs, node.group_by, node.schema,
+                            input_schema=node.children[0].schema)
+
+        state = fresh_state()
+        if budget is None or not node.group_by:
+            # Global aggs reduce to O(1) MERGED state, but raw morsels buffer
+            # by row count — under a budget, compress eagerly so raw buffers
+            # never exceed it (no disk needed: the partial state is ~1 row).
+            for mp in self._run(node.children[0]):
+                state.accumulate(mp)
+                if budget is not None and state.approx_size_bytes() > budget:
+                    state.partial_batches()  # flush raw + merge in place
+            yield MicroPartition(node.schema, [state.finalize()])
+            return
+        # Grace aggregation: whenever the merged partial state outgrows the
+        # budget, hash-partition it by group key into disk buckets; each
+        # bucket is then merged + finalized independently (keys of one group
+        # land in exactly one bucket, so per-bucket finalize is exact).
+        from daft_tpu.execution.spill import GracePartitioner, budget_reservation
+
+        key_names = [g.name() for g in node.group_by]
+        grace: Optional[GracePartitioner] = None
+
+        def spill_state(st: AggState) -> None:
+            nonlocal grace
+            if grace is None:
+                grace = GracePartitioner(
+                    lambda rb: [rb.get_column(n) for n in key_names],
+                    num_buckets=self.GRACE_BUCKETS, spill=self._spill(),
+                    total_buffer_bytes=budget)
+            for partial in st.partial_batches():
+                grace.add(partial)
+
+        with budget_reservation(self.memory, budget):
+            for mp in self._run(node.children[0]):
+                state.accumulate(mp)
+                if state.approx_size_bytes() > budget:
+                    spill_state(state)
+                    state = fresh_state()
+            if grace is None:
+                yield MicroPartition(node.schema, [state.finalize()])
+                return
+            spill_state(state)
+            grace.finish()
+            for b in range(grace.num_buckets):
+                # Stream the bucket into the merge state (never materialize
+                # it whole — a skew-hot bucket stays budget-bounded because
+                # merged partial state has one row per group).
+                bstate = fresh_state()
+                seen = False
+                for rb in grace.stream_bucket(b):
+                    seen = True
+                    # Bucket batches coalesce fragments from several spill
+                    # events, so group keys can repeat WITHIN one — force-merge.
+                    bstate.accumulate_unmerged_partial(rb)
+                    if bstate.approx_size_bytes() > budget:
+                        bstate.partial_batches()  # merge in place
+                if not seen:
+                    continue
+                out = bstate.finalize()
+                if len(out):
+                    yield MicroPartition(node.schema, [out])
 
     def _run_AggregatePartial(self, node: pp.AggregatePartial) -> Iterator[MicroPartition]:
         state: AggState = node.two_phase() if callable(node.two_phase) else node.two_phase
@@ -547,14 +639,52 @@ class Executor:
         yield MicroPartition(node.schema, [RecordBatch(node.schema, casted_cols, len(out))])
 
     def _run_Distinct(self, node: pp.Distinct) -> Iterator[MicroPartition]:
+        from daft_tpu.execution.spill import GracePartitioner, budget_reservation
+
         on = [e.name() for e in node.on] if node.on else None
-        buffer: List[RecordBatch] = []
-        for mp in self._run(node.children[0]):
-            buffer.append(mp.combined().distinct(on))
-        if not buffer:
-            yield MicroPartition.empty(node.schema)
-            return
-        yield MicroPartition(node.schema, [RecordBatch.concat(buffer).distinct(on)])
+        budget = self._sink_budget()
+        key_names = on or node.schema.column_names()
+        import contextlib
+
+        with budget_reservation(self.memory, budget) if budget is not None \
+                else contextlib.nullcontext():
+            grace: Optional[GracePartitioner] = None
+            buffer: List[RecordBatch] = []
+            buf_bytes = 0
+            for mp in self._run(node.children[0]):
+                rb = mp.combined().distinct(on)
+                buffer.append(rb)
+                buf_bytes += rb.size_bytes()
+                if budget is not None and buf_bytes > budget:
+                    # Grace distinct: dedupe-within-morsel already applied;
+                    # cross-morsel dedupe happens per disk bucket.
+                    if grace is None:
+                        grace = GracePartitioner(
+                            lambda b: [b.get_column(n) for n in key_names],
+                            num_buckets=self.GRACE_BUCKETS, spill=self._spill(),
+                            total_buffer_bytes=budget)
+                    for b in buffer:
+                        grace.add(b)
+                    buffer, buf_bytes = [], 0
+            if grace is not None:
+                for b in buffer:
+                    grace.add(b)
+                grace.finish()
+                for i in range(grace.num_buckets):
+                    # Incremental fold: resident memory tracks the bucket's
+                    # DISTINCT output, not its raw (possibly skew-hot) size.
+                    acc: Optional[RecordBatch] = None
+                    for rb in grace.stream_bucket(i):
+                        d = rb.distinct(on)
+                        acc = d if acc is None else \
+                            RecordBatch.concat([acc, d]).distinct(on)
+                    if acc is not None and len(acc):
+                        yield MicroPartition(node.schema, [acc])
+                return
+            if not buffer:
+                yield MicroPartition.empty(node.schema)
+                return
+            yield MicroPartition(node.schema, [RecordBatch.concat(buffer).distinct(on)])
 
     def _run_Window(self, node: pp.Window) -> Iterator[MicroPartition]:
         from daft_tpu.execution.window_eval import eval_windows
@@ -563,25 +693,162 @@ class Executor:
         yield MicroPartition(node.schema, [eval_windows(combined, node.window_exprs, node.schema)])
 
     # -- joins ------------------------------------------------------------
+    GRACE_BUCKETS = 32
+
+    def _collect_or_grace(self, child: pp.PhysicalPlan, key_exprs, budget,
+                          key_dtypes=None):
+        """Materialize a join side in memory, or — once it outgrows the
+        budget — hash-partition it by join key into disk buckets (grace hash
+        join). ``key_dtypes`` are the UNIFIED join-key dtypes: both sides must
+        hash identical key values identically, and the row hash is
+        byte-width-sensitive, so keys are cast before bucketing (the
+        in-memory join casts the same way, recordbatch.py hash_join).
+        Returns ("mem", MicroPartition) or ("grace", GracePartitioner)."""
+        if budget is None:
+            return "mem", self._collect(child)
+        from daft_tpu.execution.spill import GracePartitioner
+
+        key_fn = lambda rb: self._unified_keys(rb, key_exprs, key_dtypes)  # noqa: E731
+        buffer: List[MicroPartition] = []
+        buf_bytes = 0
+        grace: Optional[GracePartitioner] = None
+        for mp in self._run(child):
+            if grace is not None:
+                for rb in mp.record_batches():
+                    grace.add(rb)
+                continue
+            buffer.append(mp)
+            buf_bytes += mp.size_bytes()
+            if buf_bytes > budget:
+                grace = GracePartitioner(key_fn, self.GRACE_BUCKETS, self._spill(),
+                                         total_buffer_bytes=budget)
+                for buffered in buffer:
+                    for rb in buffered.record_batches():
+                        grace.add(rb)
+                buffer = []
+        if grace is not None:
+            grace.finish()
+            return "grace", grace
+        if not buffer:
+            return "mem", MicroPartition.empty(child.schema)
+        return "mem", MicroPartition.concat(buffer)
+
+    @staticmethod
+    def _unified_keys(rb: RecordBatch, key_exprs, key_dtypes) -> List[Series]:
+        keys = [evaluate(e, rb) for e in key_exprs]
+        if key_dtypes is None:
+            return keys
+        return [k.cast(dt) if dt is not None and k.dtype != dt else k
+                for k, dt in zip(keys, key_dtypes)]
+
+    def _grace_bucket_rbs(self, grace_or_parts, b: int, schema) -> RecordBatch:
+        """Bucket b of a graced side (or of an in-memory pre-partitioned
+        list), as a RecordBatch; empty batch when the bucket has no rows."""
+        if isinstance(grace_or_parts, list):
+            return grace_or_parts[b]
+        bucket = grace_or_parts.read_bucket(b)
+        if bucket is None or len(bucket) == 0:
+            return RecordBatch.empty(schema)
+        return bucket.combined()
+
+    def _grace_bucket_stream(self, grace_or_parts, b: int) -> Iterator[RecordBatch]:
+        if isinstance(grace_or_parts, list):
+            yield grace_or_parts[b]
+            return
+        yield from grace_or_parts.stream_bucket(b)
+
     def _run_HashJoin(self, node: pp.HashJoin) -> Iterator[MicroPartition]:
-        right = self._collect(node.children[1]).combined()
-        right_keys = [evaluate(e, right) for e in node.right_on]
-        if node.how in ("right", "outer"):
-            left = self._collect(node.children[0]).combined()
+        import contextlib
+
+        from daft_tpu.execution.spill import budget_reservation
+
+        budget = self._sink_budget()
+        with budget_reservation(self.memory, budget) if budget is not None \
+                else contextlib.nullcontext():
+            yield from self._hash_join_impl(node, budget)
+
+    def _hash_join_impl(self, node: pp.HashJoin, budget) -> Iterator[MicroPartition]:
+        from daft_tpu.datatype import unify_dtypes
+
+        lschema0, rschema0 = node.children[0].schema, node.children[1].schema
+        key_dtypes = []
+        for le, re in zip(node.left_on, node.right_on):
+            lt, rt = le.to_field(lschema0).dtype, re.to_field(rschema0).dtype
+            try:
+                key_dtypes.append(unify_dtypes(lt, rt) if lt != rt else None)
+            except Exception:
+                key_dtypes.append(None)
+        right_state, right_side = self._collect_or_grace(
+            node.children[1], node.right_on, budget, key_dtypes)
+        if right_state == "mem" and node.how not in ("right", "outer"):
+            right = right_side.combined()
+            right_keys = [evaluate(e, right) for e in node.right_on]
+
+            # Stream the probe (left) side morsel-by-morsel against the built
+            # side, probing morsels in parallel on multi-core hosts.
+            def probe(mp: MicroPartition) -> MicroPartition:
+                left = mp.combined()
+                left_keys = [evaluate(e, left) for e in node.left_on]
+                out = self._join_and_fix(left, right, left_keys, right_keys, node)
+                return MicroPartition(node.schema, [out])
+
+            yield from self._streaming_map(node.children[0], probe)
+            return
+        # Right/outer joins need the left side materialized too; an oversized
+        # build side forces grace mode for ALL join types.
+        left_state, left_side = self._collect_or_grace(
+            node.children[0], node.left_on, budget, key_dtypes)
+        if right_state == "mem" and left_state == "mem":
+            left, right = left_side.combined(), right_side.combined()
             left_keys = [evaluate(e, left) for e in node.left_on]
+            right_keys = [evaluate(e, right) for e in node.right_on]
             yield MicroPartition(node.schema, [
                 self._join_and_fix(left, right, left_keys, right_keys, node)
             ])
             return
-        # Stream the probe (left) side morsel-by-morsel against the built
-        # side, probing morsels in parallel on multi-core hosts.
-        def probe(mp: MicroPartition) -> MicroPartition:
-            left = mp.combined()
+        # Grace hash join: equal keys hash to the same bucket on both sides,
+        # so each bucket joins independently with exact semantics (including
+        # unmatched left/right rows for outer joins).
+        if right_state == "mem":
+            rb = right_side.combined()
+            keys = self._unified_keys(rb, node.right_on, key_dtypes)
+            right_side = rb.partition_by_hash(keys, self.GRACE_BUCKETS)
+        if left_state == "mem":
+            rb = left_side.combined()
+            keys = self._unified_keys(rb, node.left_on, key_dtypes)
+            left_side = rb.partition_by_hash(keys, self.GRACE_BUCKETS)
+        lschema, rschema = node.children[0].schema, node.children[1].schema
+        for b in range(self.GRACE_BUCKETS):
+            right = self._grace_bucket_rbs(right_side, b, rschema)
+            if node.how in ("inner", "left", "semi", "anti"):
+                if len(right) == 0 and node.how in ("inner", "semi"):
+                    continue
+                # Left-driven types stream the probe bucket morsel-by-morsel:
+                # only the build bucket must fit in memory, so probe-side key
+                # skew never materializes a hot bucket whole.
+                right_keys = [evaluate(e, right) for e in node.right_on]
+                for left in self._grace_bucket_stream(left_side, b):
+                    if len(left) == 0:
+                        continue
+                    left_keys = [evaluate(e, left) for e in node.left_on]
+                    out = self._join_and_fix(left, right, left_keys,
+                                             right_keys, node)
+                    if len(out):
+                        yield MicroPartition(node.schema, [out])
+                continue
+            # right/outer track unmatched build rows across the whole probe
+            # side, so both buckets materialize (hot-KEY skew beyond one
+            # bucket's budget is the known limit of single-level grace).
+            left = self._grace_bucket_rbs(left_side, b, lschema)
+            if len(left) == 0 and len(right) == 0:
+                continue
+            if len(right) == 0 and node.how == "right":
+                continue
             left_keys = [evaluate(e, left) for e in node.left_on]
+            right_keys = [evaluate(e, right) for e in node.right_on]
             out = self._join_and_fix(left, right, left_keys, right_keys, node)
-            return MicroPartition(node.schema, [out])
-
-        yield from self._streaming_map(node.children[0], probe)
+            if len(out):
+                yield MicroPartition(node.schema, [out])
 
     @staticmethod
     def _conform_to_schema(rb: RecordBatch, schema: Schema) -> RecordBatch:

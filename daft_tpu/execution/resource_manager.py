@@ -10,6 +10,7 @@ from __future__ import annotations
 import os
 import threading
 import time
+from contextlib import contextmanager
 from dataclasses import dataclass, field
 from typing import Dict, Optional
 
@@ -63,6 +64,18 @@ def get_memory_manager() -> MemoryManager:
         if _GLOBAL is None:
             _GLOBAL = MemoryManager()
         return _GLOBAL
+
+
+@contextmanager
+def memory_limit(limit_bytes: Optional[int]):
+    """Scoped override of the global memory limit (tests / notebooks)."""
+    mm = get_memory_manager()
+    old = mm.limit
+    mm.limit = limit_bytes
+    try:
+        yield mm
+    finally:
+        mm.limit = old
 
 
 @dataclass

@@ -316,12 +316,22 @@ class RecordBatch:
             raise DaftValueError(f"Unknown join type: {how}")
         lkeys = [f"__jk_l_{i}" for i in range(len(left_on))]
         rkeys = [f"__jk_r_{i}" for i in range(len(right_on))]
-        lt = self.to_arrow_table()
-        rt = right.to_arrow_table()
-        for i, (lk, rk) in enumerate(zip(left_on, right_on)):
-            common = unify_dtypes(lk.dtype, rk.dtype)
-            lt = lt.append_column(lkeys[i], lk.cast(common).to_arrow())
-            rt = rt.append_column(rkeys[i], rk.cast(common).to_arrow())
+        # Build each side's table from data + key arrays in ONE construction:
+        # a side whose data columns were all pruned away (e.g. count(*) over
+        # a key-only join) has a zero-column/zero-row arrow table that
+        # append_column would reject.
+        commons = [unify_dtypes(lk.dtype, rk.dtype)
+                   for lk, rk in zip(left_on, right_on)]
+        lt = pa.table({
+            **{n: c.to_arrow() for n, c in zip(self.column_names(), self._columns)},
+            **{lkeys[i]: left_on[i].cast(commons[i]).to_arrow()
+               for i in range(len(left_on))},
+        })
+        rt = pa.table({
+            **{n: c.to_arrow() for n, c in zip(right.column_names(), right._columns)},
+            **{rkeys[i]: right_on[i].cast(commons[i]).to_arrow()
+               for i in range(len(right_on))},
+        })
         # Disambiguate overlapping non-key output names before joining.
         overlap = set(self.column_names()) & set(right.column_names())
         if how in ("semi", "anti"):
