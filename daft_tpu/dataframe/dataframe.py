@@ -65,8 +65,16 @@ class DataFrame:
             return col(self.column_names[key])
         raise DaftValueError(f"Cannot index DataFrame with {key!r}")
 
-    def explain(self, show_all: bool = False) -> None:
-        print(self._builder.explain_string(show_all))
+    def explain(self, show_all: bool = False, analyze: bool = False) -> None:
+        """Print the plan; with ``analyze=True`` also execute it and append
+        runtime stats — rows/wall, device-eval fusion coverage, spill volume,
+        per-operator counters (reference: EXPLAIN ANALYZE surface)."""
+        text = self._builder.explain_string(show_all)
+        if analyze:
+            from daft_tpu.execution.analyze import analyze_suffix
+
+            text += analyze_suffix(self)
+        print(text)
 
     def __repr__(self) -> str:
         if self._result is not None:

@@ -65,28 +65,37 @@ def _l2_normalize_jax(a):
     return a / jnp.where(n == 0, 1.0, n)
 
 
-@register_kernel("cosine_distance", _f64)
+@register_kernel("cosine_distance", _f64,
+                 jax_fn=lambda args, **kw: _cosine_distance_jax(args[0], args[1]),
+                 jax_exact=True)
 def _cosine_distance(args, **kwargs):
     av, bv, mask = _emb_pair(args)
     out = np.asarray(_cosine_distance_jax(av, bv), dtype=np.float64)
     return Series.from_numpy(out, args[0].name)._with_mask(mask)
 
 
-@register_kernel("embedding_dot", _f64)
+@register_kernel("embedding_dot", _f64,
+                 jax_fn=lambda args, **kw: _dot_jax(args[0], args[1]),
+                 jax_exact=True)
 def _dot(args, **kwargs):
     av, bv, mask = _emb_pair(args)
     out = np.asarray(_dot_jax(av, bv), dtype=np.float64)
     return Series.from_numpy(out, args[0].name)._with_mask(mask)
 
 
-@register_kernel("l2_distance", _f64)
+@register_kernel("l2_distance", _f64,
+                 jax_fn=lambda args, **kw: _l2_jax(args[0], args[1]),
+                 jax_exact=True)
 def _l2_distance(args, **kwargs):
     av, bv, mask = _emb_pair(args)
     out = np.asarray(_l2_jax(av, bv), dtype=np.float64)
     return Series.from_numpy(out, args[0].name)._with_mask(mask)
 
 
-@register_kernel("l2_normalize", lambda f, k: Field(f[0].name, DataType.embedding(DataType.float32(), f[0].dtype.shape[0])))
+@register_kernel("l2_normalize",
+                 lambda f, k: Field(f[0].name, DataType.embedding(DataType.float32(), f[0].dtype.shape[0])),
+                 jax_fn=lambda args, **kw: _l2_normalize_jax(args[0]),
+                 jax_exact=True)
 def _l2_normalize(args, **kwargs):
     s = args[0]
     vals, mask = s.to_numpy_masked()

@@ -16,7 +16,7 @@ from daft_tpu.schema import Field
 
 
 class Kernel:
-    __slots__ = ("name", "fn", "resolver", "jax_fn")
+    __slots__ = ("name", "fn", "resolver", "jax_fn", "jax_exact")
 
     def __init__(
         self,
@@ -24,11 +24,19 @@ class Kernel:
         fn: Callable,
         resolver: Callable[[List[Field], Dict[str, Any]], Field],
         jax_fn: Optional[Callable] = None,
+        jax_exact: bool = False,
     ):
         self.name = name
         self.fn = fn            # (args: list[Series], **kwargs) -> Series
         self.resolver = resolver
         self.jax_fn = jax_fn    # (args: list[jax.Array], **kwargs) -> jax.Array
+        # jax_exact: the host impl itself computes through jax_fn (or is
+        # bit-identical to it), so device fusion reproduces host results
+        # exactly — even when the resolved OUTPUT dtype is 64-bit (the host
+        # computes 32-bit internally then upcasts, which fusion mirrors by
+        # casting after fetch) — and the null rule is the standard
+        # any-input-null -> output-null AND-reduce.
+        self.jax_exact = jax_exact
 
     def resolve(self, fields: List[Field], kwargs: Dict[str, Any]) -> Field:
         return self.resolver(fields, kwargs)
@@ -40,11 +48,11 @@ class Kernel:
 _REGISTRY: Dict[str, Kernel] = {}
 
 
-def register_kernel(name: str, resolver, jax_fn=None):
+def register_kernel(name: str, resolver, jax_fn=None, jax_exact=False):
     """Decorator: register ``fn(args: list[Series], **kwargs) -> Series``."""
 
     def deco(fn):
-        _REGISTRY[name] = Kernel(name, fn, resolver, jax_fn)
+        _REGISTRY[name] = Kernel(name, fn, resolver, jax_fn, jax_exact)
         return fn
 
     return deco

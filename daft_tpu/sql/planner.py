@@ -88,13 +88,9 @@ def _execute_statement(stmt, bindings: Dict[str, object], session=None):
             inner = _plan_select(target, bindings, dict(target.ctes), session)
             text = inner._builder.explain_string(show_all=True)
             if stmt.analyze:
-                import time as _time
+                from daft_tpu.execution.analyze import analyze_suffix
 
-                t0 = _time.perf_counter()
-                inner.collect()
-                wall = _time.perf_counter() - t0
-                rows = sum(len(p) for p in inner._result or [])
-                text += f"\n== Analyze ==\nrows: {rows}, wall: {wall:.4f}s"
+                text += analyze_suffix(inner)
             return from_pydict({"plan": [text]})
         if stmt.analyze:
             raise DaftValueError("EXPLAIN ANALYZE supports SELECT only")

@@ -77,6 +77,67 @@ def test_nullable_safe_classifier():
         daft_tpu.col("a").is_null().if_else(lit(0), col("a"))._expr)
 
 
+def test_metrics_count_fused_and_fallback():
+    """VERDICT r4 #3: fusion coverage must be observable — a numeric
+    projection records fused exprs/rows; an unfusable expr records a
+    fallback reason instead of vanishing silently."""
+    from daft_tpu.ops.device_eval import device_eval_metrics
+
+    rb = _rb({"x": np.arange(64, dtype=np.int32)},
+             dtypes={"x": daft_tpu.DataType.int32()})
+    device_eval_metrics.reset()
+    out = try_evaluate_fused(rb, [((col("x") * 2).alias("y"))._expr])
+    assert out is not None
+    snap = device_eval_metrics.snapshot()
+    assert snap["fused_exprs"] == 1 and snap["fused_rows"] == 64
+
+    srb = _rb({"s": ["a", "b"] * 32})
+    device_eval_metrics.reset()
+    out = try_evaluate_fused(
+        srb, [daft_tpu.functions.upper(col("s")).alias("u")._expr])
+    assert out is None
+    assert device_eval_metrics.snapshot()["fallback_reasons"].get("not_fusable") == 1
+
+
+def test_embedding_distance_kernels_fuse():
+    """jax_exact registry kernels (cosine/l2 distance, dot, normalize) fuse
+    into the device graph even though they resolve to f64 — the host impl
+    computes the same f32 jax function, so results match exactly."""
+    from daft_tpu.ops.device_eval import device_eval_metrics
+
+    n, dim = 128, 16
+    rng = np.random.default_rng(5)
+    a = rng.standard_normal((n, dim)).astype(np.float32)
+    b = rng.standard_normal((n, dim)).astype(np.float32)
+    emb = daft_tpu.DataType.embedding(daft_tpu.DataType.float32(), dim)
+    df = daft_tpu.from_pydict({
+        "a": daft_tpu.Series.from_numpy(a, "a", emb),
+        "b": daft_tpu.Series.from_numpy(b, "b", emb),
+    })
+    F = daft_tpu.functions
+    exprs = [F.cosine_distance(col("a"), col("b")).alias("cd"),
+             F.l2_distance(col("a"), col("b")).alias("l2")]
+    with daft_tpu.execution_config_ctx(device_eval=True, device_eval_min_rows=1):
+        device_eval_metrics.reset()
+        dev = df.select(*exprs).to_pydict()
+        assert device_eval_metrics.snapshot()["fused_exprs"] >= 2, \
+            "distance kernels must ride the fused device path"
+    with daft_tpu.execution_config_ctx(device_eval=False):
+        host = df.select(*exprs).to_pydict()
+    np.testing.assert_allclose(dev["cd"], host["cd"], rtol=1e-6)
+    np.testing.assert_allclose(dev["l2"], host["l2"], rtol=1e-6)
+
+
+def test_explain_analyze_shows_device_coverage(capsys):
+    df = daft_tpu.from_pydict({"x": np.arange(256, dtype=np.int32).tolist()})
+    df = df.with_column("x", col("x").cast(daft_tpu.DataType.int32()))
+    with daft_tpu.execution_config_ctx(device_eval=True, device_eval_min_rows=1):
+        df.select((col("x") * 3).alias("y")).explain(analyze=True)
+    text = capsys.readouterr().out
+    assert "== Analyze ==" in text
+    assert "device eval: fused_exprs=" in text
+
+
 def test_engine_parity_host_vs_device_on_nullable():
     """Same query, device_eval on vs off, bit-identical results."""
     n = 5000
