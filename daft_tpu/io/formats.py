@@ -98,14 +98,60 @@ def _read_one_file(task: ScanTask, f, morsel_rows: int):
         return _read_parquet_file(f.path, task, morsel_rows,
                                   partition_values=f.partition_values)
     if task.file_format == "warc":
-        return _read_warc_file(f.path, task, morsel_rows)
-    if task.file_format == "csv":
-        return _read_csv_file(f.path, task, morsel_rows)
-    if task.file_format == "json":
-        return _read_json_file(f.path, task, morsel_rows)
-    if task.file_format == "text":
-        return _read_text_file(f.path, task, morsel_rows)
-    raise DaftValueError(f"Unknown file format: {task.file_format}")
+        it = _read_warc_file(f.path, task, morsel_rows)
+    elif task.file_format == "csv":
+        it = _read_csv_file(f.path, task, morsel_rows)
+    elif task.file_format == "json":
+        it = _read_json_file(f.path, task, morsel_rows)
+    elif task.file_format == "text":
+        it = _read_text_file(f.path, task, morsel_rows)
+    else:
+        raise DaftValueError(f"Unknown file format: {task.file_format}")
+    if f.partition_values:
+        # Hive-partitioned csv/json: materialize path-borne partition columns
+        # as constants, like the parquet path (reference: hive.rs partition
+        # column materialization).
+        it = _inject_partition_columns(it, task, f.partition_values)
+    return it
+
+
+def _partition_inject_plan(task: ScanTask, pv):
+    """(needed columns, partition columns to inject) for a file whose
+    partition values live in metadata/path rather than the data file."""
+    needed = None
+    if task.pushdowns.columns is not None:
+        needed = list(dict.fromkeys(
+            list(task.pushdowns.columns) + _filter_ref_columns(task)))
+    inject = [c for c in pv
+              if c in task.schema and (needed is None or c in needed)]
+    return needed, inject
+
+
+def _inject_into_table(tbl: pa.Table, task: ScanTask, pv, needed,
+                       inject) -> pa.Table:
+    """Append partition-value constants (typed to the table schema) and
+    reorder to the projected schema — shared by the parquet and csv/json
+    hive paths."""
+    for c in inject:
+        if c in tbl.column_names:
+            continue
+        atype = task.schema[c].dtype.to_arrow()
+        v = pv[c]
+        tbl = tbl.append_column(
+            pa.field(c, atype),
+            pa.nulls(len(tbl), atype) if v is None
+            else pa.array([v] * len(tbl), atype))
+    present = set(tbl.column_names)
+    order = (needed if needed is not None else [f.name for f in task.schema])
+    return tbl.select([c for c in order if c in present])
+
+
+def _inject_partition_columns(it: Iterator[MicroPartition], task: ScanTask,
+                              pv) -> Iterator[MicroPartition]:
+    needed, inject = _partition_inject_plan(task, pv)
+    for mp in it:
+        tbl = _inject_into_table(mp.to_arrow_table(), task, pv, needed, inject)
+        yield MicroPartition.from_arrow_table(tbl)
 
 
 def _apply_post_pushdowns(mp: MicroPartition, task: ScanTask) -> MicroPartition:
@@ -132,16 +178,12 @@ def _read_parquet_file(path: str, task: ScanTask, morsel_rows: int,
     schema = _project_schema(task)
     pv = partition_values or {}
     # `needed` = projection + filter refs (None = every schema column); the
-    # file itself only holds the non-partition subset.
-    needed = None
-    if task.pushdowns.columns is not None:
-        needed = list(dict.fromkeys(list(task.pushdowns.columns) + _filter_ref_columns(task)))
+    # file itself only holds the non-partition subset. Metadata/path-borne
+    # partition columns are injected as constants, cast to the table
+    # schema's dtype, in schema column order (table formats + hive).
+    needed, inject = _partition_inject_plan(task, pv)
     file_cols = None if needed is None else [c for c in needed if c not in pv]
     pf = pq.ParquetFile(fs.open_input_file(p))
-    # Metadata-borne partition columns are injected as constants, cast to the
-    # table schema's dtype, in schema column order (table formats).
-    inject = [c for c in pv
-              if c in task.schema and (needed is None or c in needed)]
     try:
         # Row-group pruning via parquet statistics (reference:
         # src/daft-parquet/src/statistics) happens inside read_row_groups with
@@ -150,19 +192,7 @@ def _read_parquet_file(path: str, task: ScanTask, morsel_rows: int,
                                      use_threads=True):
             tbl = pa.Table.from_batches([batch])
             if inject:
-                for c in inject:
-                    if c in tbl.column_names:
-                        continue
-                    atype = task.schema[c].dtype.to_arrow()
-                    v = pv[c]
-                    tbl = tbl.append_column(
-                        pa.field(c, atype),
-                        pa.nulls(len(tbl), atype) if v is None
-                        else pa.array([v] * len(tbl), atype))
-                present = set(tbl.column_names)
-                order = (needed if needed is not None
-                         else [f.name for f in task.schema])
-                tbl = tbl.select([c for c in order if c in present])
+                tbl = _inject_into_table(tbl, task, pv, needed, inject)
             rb = RecordBatch.from_arrow_table(tbl)
             yield MicroPartition.from_record_batches([rb])
     finally:
@@ -218,13 +248,16 @@ def _read_text_file(path: str, task: ScanTask, morsel_rows: int) -> Iterator[Mic
 
 
 # -- schema inference ------------------------------------------------------
-def infer_schema(paths: List[str], file_format: str, read_options=None) -> Schema:
+def infer_schema(paths: List[str], file_format: str, read_options=None,
+                 files=None) -> Schema:
     """Infer schema from the first file (reference: per-format schema
-    inference in daft-parquet/daft-csv/daft-json)."""
+    inference in daft-parquet/daft-csv/daft-json). Pass already-globbed
+    ``files`` to avoid re-listing the store."""
     from daft_tpu.io.scan import glob_paths
 
     read_options = read_options or {}
-    files = glob_paths(paths, read_options.get("io_config"))
+    if files is None:
+        files = glob_paths(paths, read_options.get("io_config"))
     path = files[0].path
     fs, p = resolve_filesystem(path, read_options.get("io_config"))
     if file_format == "parquet":

@@ -27,6 +27,7 @@ class IOStatsSnapshot:
     read_time_s: float = 0.0
     write_time_s: float = 0.0
     retries: int = 0
+    files_pruned: int = 0  # scan files skipped via partition-value pruning
 
 
 class IOStats:
@@ -55,6 +56,10 @@ class IOStats:
     def count_retry(self) -> None:
         with self._lock:
             self._s.retries += 1
+
+    def count_pruned(self, nfiles: int) -> None:
+        with self._lock:
+            self._s.files_pruned += nfiles
 
     def snapshot(self) -> IOStatsSnapshot:
         with self._lock:

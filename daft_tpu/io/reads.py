@@ -12,32 +12,53 @@ from daft_tpu.schema import Schema
 
 
 def _read(paths: Union[str, List[str]], file_format: str, schema: Optional[Schema],
-          read_options: Optional[Dict[str, Any]] = None, io_config=None) -> DataFrame:
+          read_options: Optional[Dict[str, Any]] = None, io_config=None,
+          hive_partitioning: bool = False) -> DataFrame:
     if isinstance(paths, str):
         paths = [paths]
     read_options = dict(read_options or {})
     if io_config is not None:
         read_options["io_config"] = io_config
+    files = None
+    part_fields = []
+    if hive_partitioning:
+        # Parse k=v path segments into typed partition values up front
+        # (reference: src/daft-scan/src/hive.rs); the scan layer prunes
+        # files against pushdown predicates and readers materialize the
+        # partition columns as constants.
+        from daft_tpu.io.hive import attach_hive_partitions, dataset_roots
+        from daft_tpu.io.scan import glob_paths
+
+        files = glob_paths(paths, read_options.get("io_config"))
+        part_fields = attach_hive_partitions(files, dataset_roots(paths))
     if schema is None:
-        schema = infer_schema(paths, file_format, read_options)
-    info = ScanInfo(paths, file_format, schema, read_options)
+        schema = infer_schema(paths, file_format, read_options, files=files)
+    if part_fields:
+        from daft_tpu.schema import Schema as _Schema
+
+        schema = _Schema(list(schema)
+                         + [f for f in part_fields if f.name not in schema])
+    info = ScanInfo(paths, file_format, schema, read_options, files=files)
     return DataFrame(LogicalPlanBuilder.scan(info))
 
 
 def read_parquet(path: Union[str, List[str]], schema: Optional[Schema] = None,
-                 io_config=None, **kwargs) -> DataFrame:
-    return _read(path, "parquet", schema, io_config=io_config)
+                 io_config=None, hive_partitioning: bool = False, **kwargs) -> DataFrame:
+    return _read(path, "parquet", schema, io_config=io_config,
+                 hive_partitioning=hive_partitioning)
 
 
 def read_csv(path: Union[str, List[str]], schema: Optional[Schema] = None,
-             has_headers: bool = True, delimiter: str = ",", io_config=None, **kwargs) -> DataFrame:
+             has_headers: bool = True, delimiter: str = ",", io_config=None,
+             hive_partitioning: bool = False, **kwargs) -> DataFrame:
     return _read(path, "csv", schema, {"has_headers": has_headers, "delimiter": delimiter},
-                 io_config=io_config)
+                 io_config=io_config, hive_partitioning=hive_partitioning)
 
 
 def read_json(path: Union[str, List[str]], schema: Optional[Schema] = None,
-              io_config=None, **kwargs) -> DataFrame:
-    return _read(path, "json", schema, io_config=io_config)
+              io_config=None, hive_partitioning: bool = False, **kwargs) -> DataFrame:
+    return _read(path, "json", schema, io_config=io_config,
+                 hive_partitioning=hive_partitioning)
 
 
 def read_text(path: Union[str, List[str]], io_config=None, **kwargs) -> DataFrame:

@@ -235,6 +235,17 @@ class ScanInfo:
         """Split/merge files into scan tasks within [min,max] byte targets
         (reference: src/daft-scan/src/scan_task_iters/split_parquet_*)."""
         files = self.files()
+        if pushdowns.filters is not None:
+            # Partition-value pruning: hive k=v paths and metadata-carried
+            # table-format partitions both live on FileInfo.partition_values
+            # (reference: src/daft-scan/src/hive.rs pruning).
+            from daft_tpu.io.hive import prune_files_by_partition
+            from daft_tpu.io.iostats import IO_STATS
+
+            pruned = prune_files_by_partition(files, pushdowns.filters, self.schema)
+            if len(pruned) < len(files):
+                IO_STATS.count_pruned(len(files) - len(pruned))
+            files = pruned
         if pushdowns.shard is not None:
             world, rank = pushdowns.shard
             files = [f for i, f in enumerate(files) if i % world == rank]

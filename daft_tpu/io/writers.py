@@ -177,6 +177,10 @@ class PartitionedWriter:
 
 
 def _hive_escape(v) -> str:
+    if v is None:
+        # Hive's null-partition sentinel (read back as null by io/hive.py;
+        # same convention the delta writer uses, io/delta.py).
+        return "__HIVE_DEFAULT_PARTITION__"
     s = str(v)
     return s.replace("/", "%2F").replace("=", "%3D")
 
