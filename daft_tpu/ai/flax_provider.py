@@ -62,6 +62,23 @@ def load_checkpoint(path: str, params):
     return load_params(path, params)
 
 
+def _load_clip(model_name: str, weights_path: str):
+    """CLIP weights from a local HF checkpoint dir (torch -> flax
+    conversion, models/convert.py) or a flax-native file/orbax dir."""
+    from daft_tpu.models.clip import CLIPConfig, load_params
+    from daft_tpu.models.convert import is_hf_checkpoint_dir
+
+    if is_hf_checkpoint_dir(weights_path):
+        from daft_tpu.models.convert import load_hf_checkpoint
+
+        kind, model, params = load_hf_checkpoint(weights_path, dtype=jnp.bfloat16)
+        if kind != "clip":
+            raise DaftValueError(
+                f"CLIP embedder expects a clip checkpoint, got {kind!r}")
+        return model, params
+    return load_params(weights_path, CLIPConfig.from_name(model_name))
+
+
 # Phase breakdown of the most recent _chunked_forward call (seconds),
 # DIAGNOSTICS ONLY: instances record their own split in
 # ``self.last_forward_stats``; this module-level mirror is lock-protected and
@@ -256,11 +273,12 @@ class FlaxCLIPImageEmbedder(_FlaxModelBase):
         super().__init__(staging_mode)
         from daft_tpu.models.clip import CLIPConfig, init_clip_params, load_params
 
-        self.cfg = CLIPConfig.from_name(model_name)
         self.max_batch = batch_size
         if weights_path:
-            self.model, params = load_params(weights_path, self.cfg)
+            self.model, params = _load_clip(model_name, weights_path)
+            self.cfg = self.model.cfg
         else:
+            self.cfg = CLIPConfig.from_name(model_name)
             self.model, params = init_clip_params(self.cfg, seed)
         # Multi-chip replica: params shard over this replica's mesh slice
         # (tp rules when requested, replicated for pure dp) and batches
@@ -304,13 +322,30 @@ class FlaxCLIPTextEmbedder(_FlaxModelBase):
         super().__init__()
         from daft_tpu.models.clip import CLIPConfig, init_clip_params, load_params
 
-        self.cfg = CLIPConfig.from_name(model_name)
+        tokenizer = None
         if weights_path:
-            self.model, params = load_params(weights_path, self.cfg)
+            self.model, params = _load_clip(model_name, weights_path)
+            self.cfg = self.model.cfg
+            from daft_tpu.models.convert import is_hf_checkpoint_dir
+            from daft_tpu.utils.tokenizer import tokenizer_from_dir
+
+            if is_hf_checkpoint_dir(weights_path):
+                tokenizer = tokenizer_from_dir(weights_path,
+                                               self.cfg.context_length)
+                if tokenizer is None:
+                    # A converted CLIP pools at the checkpoint vocab's eos
+                    # position; hashing ids essentially never hit it, so a
+                    # missing tokenizer silently degenerates every embedding.
+                    raise DaftValueError(
+                        f"HF CLIP checkpoint {weights_path!r} has no "
+                        f"tokenizer files (vocab.json + merges.txt); they "
+                        f"are required for text embedding")
         else:
+            self.cfg = CLIPConfig.from_name(model_name)
             self.model, params = init_clip_params(self.cfg, seed)
         self.params = jax.device_put(params)
-        self.tokenizer = HashingTokenizer(self.cfg.vocab_size, self.cfg.context_length)
+        self.tokenizer = tokenizer or HashingTokenizer(
+            self.cfg.vocab_size, self.cfg.context_length)
         model = self.model
 
         @jax.jit
@@ -334,16 +369,38 @@ class FlaxCLIPTextEmbedder(_FlaxModelBase):
 class FlaxMiniLMTextEmbedder(_FlaxModelBase):
     max_batch = 512
 
-    def __init__(self, model_name: str, weights_path: Optional[str] = None, seed: int = 0):
+    def __init__(self, model_name: str, weights_path: Optional[str] = None,
+                 seed: int = 0, dtype=None):
         super().__init__()
+        from daft_tpu.models.convert import is_hf_checkpoint_dir
         from daft_tpu.models.minilm import MiniLMConfig, init_minilm_params
 
-        self.cfg = MiniLMConfig.from_name(model_name)
-        self.model, params = init_minilm_params(self.cfg, seed)
-        if weights_path:
-            params = load_checkpoint(weights_path, params)
+        if weights_path and is_hf_checkpoint_dir(weights_path):
+            # Local HF checkpoint: checkpoint-faithful BertEncoder + the
+            # checkpoint's own WordPiece vocab — embed_text then matches the
+            # torch provider numerically (reference:
+            # daft/ai/transformers text embedder; tests/test_convert.py).
+            from daft_tpu.models.convert import load_hf_checkpoint
+            from daft_tpu.utils.tokenizer import tokenizer_from_dir
+
+            kind, self.model, params = load_hf_checkpoint(
+                weights_path, dtype=dtype or jnp.bfloat16)
+            if kind != "bert":
+                raise DaftValueError(
+                    f"text_embedder expects a bert checkpoint, got {kind!r}")
+            self.cfg = self.model.cfg
+            # Sequences must fit the checkpoint's learned position table.
+            max_len = min(256, self.cfg.max_position)
+            tok = tokenizer_from_dir(weights_path, max_length=max_len)
+            self.tokenizer = tok or HashingTokenizer(self.cfg.vocab_size, max_len)
+        else:
+            self.cfg = MiniLMConfig.from_name(model_name)
+            self.model, params = init_minilm_params(self.cfg, seed)
+            if weights_path:
+                params = load_checkpoint(weights_path, params)
+            self.tokenizer = HashingTokenizer(self.cfg.vocab_size,
+                                              self.cfg.max_length)
         self.params = jax.device_put(params)
-        self.tokenizer = HashingTokenizer(self.cfg.vocab_size, self.cfg.max_length)
         model = self.model
         self._fwd = jax.jit(model.apply)
 
@@ -463,6 +520,18 @@ class _FlaxDescriptor(Descriptor):
         from daft_tpu.models.clip import CLIPConfig
         from daft_tpu.models.minilm import MiniLMConfig
 
+        wp = self.options.get("weights_path")
+        if wp:
+            # A local HF checkpoint defines its own dims — the name-derived
+            # config does not apply (tiny fixture checkpoints etc).
+            from daft_tpu.models.convert import hf_config, is_hf_checkpoint_dir
+
+            if is_hf_checkpoint_dir(wp):
+                d = hf_config(wp)
+                if d.get("model_type") == "clip":
+                    return d.get("projection_dim", 512)
+                if "hidden_size" in d:
+                    return d["hidden_size"]
         if self.kind == "image_embedder":
             return CLIPConfig.from_name(self.model).embed_dim
         if self.kind == "text_embedder":

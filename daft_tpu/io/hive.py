@@ -115,24 +115,33 @@ def _infer_one(values: Sequence[Optional[str]]) -> DataType:
 
 
 def _coerce(value: Optional[str], dtype: DataType) -> Any:
+    """Path string -> python value of ``dtype`` (covers user-declared dtypes
+    beyond the inference ladder: any integer/float width, bool, date)."""
     if value is None:
         return None
-    if dtype == DataType.int64():
-        return int(value)
-    if dtype == DataType.float64():
-        return float(value)
     if dtype == DataType.date():
         return datetime.date.fromisoformat(value)
     if dtype == DataType.bool():
         return value.lower() == "true"
+    try:
+        kind = dtype.to_numpy().kind
+    except Exception:
+        kind = "U"
+    if kind in "iu":
+        return int(value)
+    if kind == "f":
+        return float(value)
     return value
 
 
-def attach_hive_partitions(files, roots: Sequence[str] = ()) -> List[Field]:
+def attach_hive_partitions(files, roots: Sequence[str] = (),
+                           declared: Optional[Dict[str, DataType]] = None) -> List[Field]:
     """Parse each file's hive segments (below its dataset root), set
     ``FileInfo.partition_values`` to TYPED values, and return the
     partition-column fields (in first-seen path order). All files must agree
-    on the partition key set."""
+    on the partition key set. A user-declared schema dtype for a partition
+    column overrides inference (reference: hive.rs coerces to the table
+    schema)."""
     raw: List[Dict[str, str]] = []
     keys: List[str] = []
     for f in files:
@@ -152,7 +161,7 @@ def attach_hive_partitions(files, roots: Sequence[str] = ()) -> List[Field]:
     fields = []
     for k in keys:
         vals = [None if parts[k] == HIVE_NULL else parts[k] for parts in raw]
-        dtype = _infer_one(vals)
+        dtype = (declared or {}).get(k) or _infer_one(vals)
         fields.append(Field(k, dtype))
         for f, v in zip(files, vals):
             pv = dict(f.partition_values or {})

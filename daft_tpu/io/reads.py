@@ -30,7 +30,9 @@ def _read(paths: Union[str, List[str]], file_format: str, schema: Optional[Schem
         from daft_tpu.io.scan import glob_paths
 
         files = glob_paths(paths, read_options.get("io_config"))
-        part_fields = attach_hive_partitions(files, dataset_roots(paths))
+        declared = {f.name: f.dtype for f in schema} if schema is not None else None
+        part_fields = attach_hive_partitions(files, dataset_roots(paths),
+                                             declared=declared)
     if schema is None:
         schema = infer_schema(paths, file_format, read_options, files=files)
     if part_fields:

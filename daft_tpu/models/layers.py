@@ -71,20 +71,51 @@ class MultiHeadAttention(nn.Module):
         return nn.Dense(d, dtype=self.dtype, name="out")(out)
 
 
+def resolve_act(name: str) -> Callable:
+    """Activation registry keyed the way HF config.json names them.
+    ``gelu`` keeps flax's default (tanh approximation — the existing
+    random-init behavior); checkpoint converters pass the faithful variant."""
+    table = {
+        "gelu": nn.gelu,
+        "gelu_exact": lambda x: nn.gelu(x, approximate=False),
+        "gelu_python": lambda x: nn.gelu(x, approximate=False),
+        "gelu_new": nn.gelu,
+        "gelu_fast": nn.gelu,
+        "gelu_pytorch_tanh": nn.gelu,
+        "quick_gelu": lambda x: x * nn.sigmoid(1.702 * x),
+        "relu": nn.relu,
+        "silu": nn.silu,
+        "swish": nn.silu,
+        "tanh": jnp.tanh,
+    }
+    if name not in table:
+        from daft_tpu.errors import DaftValueError
+
+        raise DaftValueError(
+            f"Unsupported activation {name!r} (checkpoint hidden_act); "
+            f"supported: {sorted(table)}")
+    return table[name]
+
+
 class TransformerBlock(nn.Module):
     """Pre-norm transformer block (ViT / CLIP / GPT style)."""
 
     num_heads: int
     mlp_ratio: float = 4.0
     dtype: Dtype = jnp.bfloat16
+    act: str = "gelu"
+    ln_eps: float = 1e-6
 
     @nn.compact
     def __call__(self, x, mask: Optional[jax.Array] = None):
         d = x.shape[-1]
-        h = nn.LayerNorm(dtype=jnp.float32, name="ln1")(x).astype(self.dtype)
+        h = nn.LayerNorm(dtype=jnp.float32, epsilon=self.ln_eps,
+                         name="ln1")(x).astype(self.dtype)
         x = x + MultiHeadAttention(self.num_heads, self.dtype, name="attn")(h, mask)
-        h = nn.LayerNorm(dtype=jnp.float32, name="ln2")(x).astype(self.dtype)
-        x = x + MLP(int(d * self.mlp_ratio), d, self.dtype, name="mlp")(h)
+        h = nn.LayerNorm(dtype=jnp.float32, epsilon=self.ln_eps,
+                         name="ln2")(x).astype(self.dtype)
+        x = x + MLP(int(d * self.mlp_ratio), d, self.dtype,
+                    act=resolve_act(self.act), name="mlp")(h)
         return x
 
 

@@ -1,0 +1,290 @@
+"""torch/HF checkpoint -> flax conversion parity (VERDICT r4 missing #5).
+
+Builds TINY random-init HF models locally (no network), saves them as real
+checkpoint directories, converts with models/convert.py, and asserts the
+flax forward matches the torch forward numerically. Tokenizer parity runs
+the same way against HF's BertTokenizer / CLIPTokenizer over fixture vocabs.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import daft_tpu  # noqa: F401  (jax platform setup via conftest)
+
+transformers = pytest.importorskip("transformers")
+torch = pytest.importorskip("torch")
+
+import jax.numpy as jnp  # noqa: E402
+
+
+BERT_VOCAB = ["[PAD]", "[UNK]", "[CLS]", "[SEP]", "[MASK]",
+              "the", "quick", "brown", "fox", "jump", "##s", "##ed", "over",
+              "lazy", "dog", "##gy", "data", "##frame", "runs", "on", "tpu",
+              "!", ",", ".", "a", "b", "c", "深", "度", "学"]
+
+
+@pytest.fixture(scope="module")
+def bert_dir(tmp_path_factory):
+    d = tmp_path_factory.mktemp("bert_ckpt")
+    vocab = d / "vocab.txt"
+    vocab.write_text("\n".join(BERT_VOCAB) + "\n")
+    cfg = transformers.BertConfig(
+        vocab_size=len(BERT_VOCAB), hidden_size=32, num_hidden_layers=2,
+        num_attention_heads=4, intermediate_size=64,
+        max_position_embeddings=64, type_vocab_size=2)
+    torch.manual_seed(0)
+    model = transformers.BertModel(cfg)
+    model.eval()
+    model.save_pretrained(str(d), safe_serialization=False)
+    tok = transformers.BertTokenizer(str(vocab))
+    tok.save_pretrained(str(d))
+    return str(d)
+
+
+def test_bert_conversion_parity(bert_dir):
+    """Converted flax BERT == torch BERT through the sentence-transformers
+    mean-pool + normalize head, on real WordPiece tokens."""
+    from daft_tpu.ai.torch_provider import TorchTextEmbedder
+    from daft_tpu.ai.flax_provider import FlaxMiniLMTextEmbedder
+
+    texts = ["the quick brown fox jumps over the lazy dog",
+             "dataframe runs on tpu !",
+             "a b c , the doggy jumped ."]
+    ours = FlaxMiniLMTextEmbedder("all-MiniLM-L6-v2", weights_path=bert_dir,
+                                  dtype=jnp.float32).embed_text(texts)
+    theirs = TorchTextEmbedder(bert_dir).embed_text(texts)
+    cos = (ours * theirs).sum(axis=1)
+    np.testing.assert_allclose(cos, 1.0, atol=1e-4)
+
+
+def test_wordpiece_tokenizer_parity(bert_dir):
+    from daft_tpu.utils.tokenizer import WordPieceTokenizer
+
+    hf = transformers.BertTokenizer(os.path.join(bert_dir, "vocab.txt"))
+    ours = WordPieceTokenizer(os.path.join(bert_dir, "vocab.txt"), max_length=32)
+    for text in ["the quick brown fox jumps!", "doggy , jumped over tpu.",
+                 "unknownword the fox", "", "深度学 the fox", "深度habla"]:
+        expected = hf(text)["input_ids"]
+        got = ours.encode_one(text)
+        assert got == expected, (text, got, expected)
+
+
+CLIP_WORDS = ["the", "quick", "brown", "fox", "dog", "cat", "photo", "of",
+              "a", "on", "tpu"]
+
+
+def _clip_vocab_and_merges(d):
+    # Characters + whole-word merges for a tiny but real BPE.
+    chars = sorted({c for w in CLIP_WORDS for c in w})
+    vocab = {}
+    for c in chars:
+        vocab[c] = len(vocab)
+        vocab[c + "</w>"] = len(vocab)
+    merges = []
+    for w in CLIP_WORDS:
+        # build each word left-to-right: (ab), (abc), ... final gets </w>
+        parts = list(w[:-1]) + [w[-1] + "</w>"]
+        while len(parts) > 1:
+            merges.append((parts[0], parts[1]))
+            parts = [parts[0] + parts[1]] + parts[2:]
+        if parts[0] not in vocab:
+            vocab[parts[0]] = len(vocab)
+    # intermediate merge products must be in the vocab too
+    for a, b in merges:
+        if a + b not in vocab:
+            vocab[a + b] = len(vocab)
+    vocab["<|startoftext|>"] = len(vocab)
+    vocab["<|endoftext|>"] = len(vocab)
+    import json
+
+    (d / "vocab.json").write_text(json.dumps(vocab))
+    seen = set()
+    lines = ["#version: 0.2"]
+    for m in merges:
+        if m not in seen:
+            seen.add(m)
+            lines.append(f"{m[0]} {m[1]}")
+    (d / "merges.txt").write_text("\n".join(lines) + "\n")
+    return vocab
+
+
+@pytest.fixture(scope="module")
+def clip_dir(tmp_path_factory):
+    d = tmp_path_factory.mktemp("clip_ckpt")
+    vocab = _clip_vocab_and_merges(d)
+    cfg = transformers.CLIPConfig(
+        text_config={"vocab_size": len(vocab), "hidden_size": 32,
+                     "num_hidden_layers": 2, "num_attention_heads": 4,
+                     "intermediate_size": 64, "max_position_embeddings": 16,
+                     "eos_token_id": vocab["<|endoftext|>"],
+                     "bos_token_id": vocab["<|startoftext|>"]},
+        vision_config={"image_size": 32, "patch_size": 16, "hidden_size": 32,
+                       "num_hidden_layers": 2, "num_attention_heads": 4,
+                       "intermediate_size": 64},
+        projection_dim=24)
+    torch.manual_seed(1)
+    model = transformers.CLIPModel(cfg)
+    model.eval()
+    model.save_pretrained(str(d), safe_serialization=False)
+    return str(d), model, vocab
+
+
+def test_clip_image_conversion_parity(clip_dir):
+    d, hf_model, _ = clip_dir
+    from daft_tpu.ai.flax_provider import FlaxCLIPImageEmbedder
+    from daft_tpu.models.clip import CLIP_IMAGE_MEAN, CLIP_IMAGE_STD
+
+    rng = np.random.default_rng(2)
+    imgs = rng.integers(0, 255, (3, 32, 32, 3), dtype=np.uint8)
+    emb = FlaxCLIPImageEmbedder("tiny", weights_path=d, batch_size=4)
+    # force f32 compute for a numeric comparison
+    from daft_tpu.models.convert import load_hf_checkpoint
+
+    _, model, params = load_hf_checkpoint(d, dtype=jnp.float32)
+    ours = np.asarray(model.apply(params, jnp.asarray(imgs),
+                                  method=model.encode_image))
+    x = (imgs.astype(np.float32) / 255.0 - CLIP_IMAGE_MEAN) / CLIP_IMAGE_STD
+    with torch.inference_mode():
+        theirs = hf_model.get_image_features(
+            pixel_values=torch.from_numpy(x.transpose(0, 3, 1, 2))).numpy()
+    no = ours / np.linalg.norm(ours, axis=1, keepdims=True)
+    nt = theirs / np.linalg.norm(theirs, axis=1, keepdims=True)
+    np.testing.assert_allclose((no * nt).sum(axis=1), 1.0, atol=1e-4)
+    assert emb.dimensions == 24
+
+
+def test_clip_text_conversion_parity(clip_dir):
+    d, hf_model, vocab = clip_dir
+    from daft_tpu.models.convert import load_hf_checkpoint
+
+    _, model, params = load_hf_checkpoint(d, dtype=jnp.float32)
+    eos = vocab["<|endoftext|>"]
+    bos = vocab["<|startoftext|>"]
+    tok_rows = np.zeros((2, 16), dtype=np.int64)
+    for i, words in enumerate((["the", "quick", "fox"], ["a", "photo", "of", "a", "dog"])):
+        ids = [bos] + [vocab[w + "</w>"] for w in words] + [eos]
+        tok_rows[i, :len(ids)] = ids
+    ours = np.asarray(model.apply(params, jnp.asarray(tok_rows, jnp.int32),
+                                  method=model.encode_text))
+    with torch.inference_mode():
+        theirs = hf_model.get_text_features(
+            input_ids=torch.from_numpy(tok_rows),
+            attention_mask=torch.from_numpy((tok_rows != 0).astype(np.int64))).numpy()
+    no = ours / np.linalg.norm(ours, axis=1, keepdims=True)
+    nt = theirs / np.linalg.norm(theirs, axis=1, keepdims=True)
+    np.testing.assert_allclose((no * nt).sum(axis=1), 1.0, atol=1e-4)
+
+
+def test_clip_bpe_tokenizer_parity(clip_dir):
+    d, _, _ = clip_dir
+    from daft_tpu.utils.tokenizer import MergesBPETokenizer
+
+    hf = transformers.CLIPTokenizer(os.path.join(d, "vocab.json"),
+                                    os.path.join(d, "merges.txt"))
+    ours = MergesBPETokenizer(os.path.join(d, "vocab.json"),
+                              os.path.join(d, "merges.txt"), max_length=16)
+    for text in ["the quick brown fox", "a photo of a cat on tpu",
+                 "dog cat dog"]:
+        expected = hf(text)["input_ids"]
+        got = ours.encode_one(text)
+        assert got == expected, (text, got, expected)
+
+
+def test_clip_text_pooling_with_token_id_zero_mid_sequence(clip_dir):
+    """Regression: HF pools at the FIRST eos position; a vocab id 0
+    mid-sequence must not shift the pooled position (last-non-pad would)."""
+    d, hf_model, vocab = clip_dir
+    from daft_tpu.models.convert import load_hf_checkpoint
+
+    _, model, params = load_hf_checkpoint(d, dtype=jnp.float32)
+    zero_tok = next(k for k, v in vocab.items() if v == 0)
+    rows = np.zeros((1, 16), dtype=np.int64)
+    ids = [vocab["<|startoftext|>"], vocab[zero_tok],
+           next(v for k, v in vocab.items() if k.endswith("</w>") and v > 0),
+           vocab["<|endoftext|>"]]
+    rows[0, :len(ids)] = ids
+    ours = np.asarray(model.apply(params, jnp.asarray(rows, jnp.int32),
+                                  method=model.encode_text))
+    with torch.inference_mode():
+        theirs = hf_model.get_text_features(
+            input_ids=torch.from_numpy(rows),
+            attention_mask=torch.from_numpy(
+                (np.arange(16) < len(ids)).astype(np.int64)[None])).numpy()
+    no = ours / np.linalg.norm(ours, axis=1, keepdims=True)
+    nt = theirs / np.linalg.norm(theirs, axis=1, keepdims=True)
+    np.testing.assert_allclose((no * nt).sum(axis=1), 1.0, atol=1e-4)
+
+
+def test_gpt2_bpe_tokenizer_parity(tmp_path):
+    """Byte-level gpt2 dialect vs HF GPT2Tokenizer on a tiny fixture."""
+    import json
+
+    from daft_tpu.utils.tokenizer import MergesBPETokenizer, _bytes_to_unicode
+
+    words = ["the", "dog", "cat", "run"]
+    bm = _bytes_to_unicode()
+    vocab, merges = {}, []
+    for w in [" " + x for x in words] + words:
+        chars = [bm[b] for b in w.encode()]
+        for c in chars:
+            if c not in vocab:
+                vocab[c] = len(vocab)
+        parts = list(chars)
+        while len(parts) > 1:
+            merges.append((parts[0], parts[1]))
+            parts = [parts[0] + parts[1]] + parts[2:]
+        if parts[0] not in vocab:
+            vocab[parts[0]] = len(vocab)
+    for a, b in merges:
+        if a + b not in vocab:
+            vocab[a + b] = len(vocab)
+    vocab["<|endoftext|>"] = len(vocab)
+    (tmp_path / "vocab.json").write_text(json.dumps(vocab))
+    seen, lines = set(), ["#version: 0.2"]
+    for m in merges:
+        if m not in seen:
+            seen.add(m)
+            lines.append(f"{m[0]} {m[1]}")
+    (tmp_path / "merges.txt").write_text("\n".join(lines) + "\n")
+    hf = transformers.GPT2Tokenizer(str(tmp_path / "vocab.json"),
+                                    str(tmp_path / "merges.txt"))
+    ours = MergesBPETokenizer(str(tmp_path / "vocab.json"),
+                              str(tmp_path / "merges.txt"), max_length=16,
+                              style="gpt2")
+    for text in ["the dog", "cat run the", "dog"]:
+        assert ours.encode_one(text) == hf(text)["input_ids"], text
+
+
+def test_bpe_unknown_piece_maps_to_unk_keeps_positions(clip_dir):
+    d, _, vocab = clip_dir
+    from daft_tpu.utils.tokenizer import MergesBPETokenizer
+
+    ours = MergesBPETokenizer(os.path.join(d, "vocab.json"),
+                              os.path.join(d, "merges.txt"), max_length=16)
+    # '%' is not in the fixture vocab: it must become unk (eos id), not
+    # vanish — otherwise the eos the model pools at shifts position.
+    with_unk = ours.encode_one("the % fox")
+    clean = ours.encode_one("the fox")
+    assert len(with_unk) == len(clean) + 1
+    assert with_unk[2] == vocab["<|endoftext|>"]
+
+
+def test_embed_text_through_engine_with_local_checkpoint(bert_dir):
+    """End-to-end: df.with_column(embed_text) over a local HF checkpoint
+    produces the reference model's embeddings (engine path, flax provider)."""
+    from daft_tpu import col
+    from daft_tpu.functions.ai import embed_text
+    from daft_tpu.ai.torch_provider import TorchTextEmbedder
+
+    df = daft_tpu.from_pydict({"t": ["the quick brown fox", "tpu dataframe !"]})
+    out = df.with_column("e", embed_text(
+        col("t"), provider="flax", model="all-MiniLM-L6-v2",
+        weights_path=bert_dir)).to_pydict()
+    ours = np.asarray([np.asarray(e) for e in out["e"]], dtype=np.float32)
+    theirs = TorchTextEmbedder(bert_dir).embed_text(
+        ["the quick brown fox", "tpu dataframe !"])
+    cos = (ours * theirs).sum(axis=1)
+    # engine path runs bf16 by default: coarser tolerance
+    np.testing.assert_allclose(cos, 1.0, atol=5e-2)

@@ -130,6 +130,31 @@ def test_hive_null_partition(tmp_path):
     assert out["k"] == ["x", None, "y"]
 
 
+def test_hive_declared_schema_overrides_inference(hive_dir):
+    """A user-supplied schema dtype for a partition column beats the
+    inference ladder (reference: hive.rs coerces to the table schema)."""
+    from daft_tpu.schema import Field, Schema
+
+    schema = Schema([Field("v", daft_tpu.DataType.int64()),
+                     Field("dt", daft_tpu.DataType.string()),
+                     Field("region", daft_tpu.DataType.string())])
+    df = daft_tpu.read_parquet(hive_dir, schema=schema, hive_partitioning=True)
+    out = df.where(col("dt") == "2024-01-02").sort("v").to_pydict()
+    assert out["v"] == [4, 5, 6, 7]
+    assert set(out["dt"]) == {"2024-01-02"}
+
+
+def test_hive_percent_value_roundtrip(tmp_path):
+    """Values containing literal % (and / =) survive write -> read."""
+    d = str(tmp_path / "p")
+    vals = ["a%2Fb", "x/y", "k=v", "plain"]
+    daft_tpu.from_pydict({"k": vals, "v": [1, 2, 3, 4]}).write_parquet(
+        d, partition_cols=["k"])
+    out = (daft_tpu.read_parquet(d, hive_partitioning=True)
+           .sort("v").to_pydict())
+    assert out["k"] == vals
+
+
 def test_prune_helper_respects_unprunable_files():
     from daft_tpu.io.scan import FileInfo
     from daft_tpu.schema import Field, Schema
