@@ -125,7 +125,8 @@ def _probe_tpu(max_wait_s: int) -> bool:
         time.sleep(15)
 
 
-def _run_child(mode: str, timeout_s: float, batch: int = 0, n: int = 0) -> dict | None:
+def _run_child(mode: str, timeout_s: float, batch: int = 0, n: int = 0,
+               env_extra: dict | None = None) -> dict | None:
     """Run one bench config in a subprocess; return the parsed JSON line."""
     argv = [sys.executable, os.path.abspath(__file__), f"--child={mode}"]
     if batch:
@@ -133,7 +134,8 @@ def _run_child(mode: str, timeout_s: float, batch: int = 0, n: int = 0) -> dict 
     label = f"{mode} B={batch}" if batch else mode
     try:
         proc = subprocess.run(argv, capture_output=True, text=True,
-                              timeout=timeout_s)
+                              timeout=timeout_s,
+                              env={**os.environ, **(env_extra or {})})
     except subprocess.TimeoutExpired:
         sys.stderr.write(f"bench child ({label}) timed out after {timeout_s:.0f}s\n")
         return None
@@ -266,6 +268,19 @@ def main() -> None:
                     best = rec
                 if best["value"] >= A100_BASELINE_IMGS_PER_SEC:
                     break  # bar cleared; don't spend budget on smaller rungs
+            if best is not None and _remaining(reserve=60) > 2 * RUNG_MIN_S:
+                # Pallas flash-attention A/B on the healthy tunnel (VERDICT
+                # r4 weak #4): same engine path, attention kernel flipped.
+                ab = {}
+                for name, flag in (("pallas", "1"), ("xla", "0")):
+                    slice_s = min(RUNG_MAX_S, _remaining(reserve=60) / 2)
+                    rec = _run_child("tpu", slice_s, batch=256, n=2048,
+                                     env_extra={"DAFT_PALLAS_ATTENTION": flag})
+                    if rec:
+                        ab[name] = rec["value"]
+                        sys.stderr.write(f"pallas A/B {name}: {rec['value']} img/s\n")
+                if len(ab) == 2:
+                    best = {**best, "pallas_ab": ab}
     if best is not None:
         # Cache the BEST live TPU capture of the session (a later degraded
         # window must not clobber a better earlier number), stamped with the

@@ -392,7 +392,13 @@ class FlaxMiniLMTextEmbedder(_FlaxModelBase):
             # Sequences must fit the checkpoint's learned position table.
             max_len = min(256, self.cfg.max_position)
             tok = tokenizer_from_dir(weights_path, max_length=max_len)
-            self.tokenizer = tok or HashingTokenizer(self.cfg.vocab_size, max_len)
+            if tok is None:
+                # Hashed ids through a TRAINED embedding table are finite
+                # but semantically garbage — same contract as the CLIP path.
+                raise DaftValueError(
+                    f"HF BERT checkpoint {weights_path!r} has no tokenizer "
+                    f"files (vocab.txt); they are required for text embedding")
+            self.tokenizer = tok
         else:
             self.cfg = MiniLMConfig.from_name(model_name)
             self.model, params = init_minilm_params(self.cfg, seed)

@@ -131,7 +131,13 @@ def _coerce(value: Optional[str], dtype: DataType) -> Any:
         return int(value)
     if kind == "f":
         return float(value)
-    return value
+    if kind == "M":
+        return datetime.datetime.fromisoformat(value)
+    if kind in "USO" or dtype == DataType.string():
+        return value
+    raise DaftValueError(
+        f"Unsupported declared partition dtype {dtype!r} for hive value "
+        f"{value!r} (supported: integer/float/bool/date/timestamp/string)")
 
 
 def attach_hive_partitions(files, roots: Sequence[str] = (),

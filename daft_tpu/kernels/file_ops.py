@@ -72,6 +72,23 @@ def _head_bytes(f: File, n: int = 16) -> bytes:
         return fh.read(n)
 
 
+@register_kernel("file_path", lambda f, k: Field(f[0].name, DataType.string()))
+def _file_path(args, **kwargs):
+    """Path/URL of each File value (null for inline-bytes files)
+    (reference: daft Expression.file_path over the File dtype)."""
+    s = args[0]
+    rows = []
+    for v in s.to_pylist():
+        if isinstance(v, File):
+            rows.append(v._url)
+        elif isinstance(v, str):
+            rows.append(v)
+        else:
+            rows.append(None)
+    return Series.from_arrow(pa.array(rows, pa.large_string()), s.name,
+                             DataType.string())
+
+
 @register_kernel("file_ref", lambda f, k: Field(f[0].name, _FILE))
 def _file_ref(args, kind=None, verify: bool = False, **kwargs):
     """String path/URL or inline binary -> File column, optionally verifying

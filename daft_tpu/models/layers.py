@@ -114,7 +114,9 @@ class TransformerBlock(nn.Module):
         x = x + MultiHeadAttention(self.num_heads, self.dtype, name="attn")(h, mask)
         h = nn.LayerNorm(dtype=jnp.float32, epsilon=self.ln_eps,
                          name="ln2")(x).astype(self.dtype)
-        x = x + MLP(int(d * self.mlp_ratio), d, self.dtype,
+        # round(): converted checkpoints carry intermediate/hidden as a float
+        # ratio, and int() would truncate 119.9999... for valid size pairs.
+        x = x + MLP(round(d * self.mlp_ratio), d, self.dtype,
                     act=resolve_act(self.act), name="mlp")(h)
         return x
 

@@ -247,7 +247,10 @@ class MergesBPETokenizer:
         # (utf-8 bytes through the reversible byte->unicode table — printable
         # ASCII maps to itself) with the last byte-char carrying </w>.
         text = " ".join(text.lower().strip().split())
-        pat = re.compile(r"'s|'t|'re|'ve|'m|'ll|'d|[^\W\d_]+|\d|[^'\s\w]+|_+")
+        # HF classes: letters [\p{L}]+, single digits [\p{N}], symbol runs
+        # [^\s\p{L}\p{N}]+ (which INCLUDE apostrophes and underscores —
+        # contraction alternatives win by alternation order).
+        pat = re.compile(r"'s|'t|'re|'ve|'m|'ll|'d|[^\W\d_]+|\d|(?:[^\s\w]|_)+")
         out = []
         for tok in pat.findall(text):
             chars = [bm[b] for b in tok.encode("utf-8")]

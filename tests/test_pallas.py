@@ -49,3 +49,16 @@ def test_env_toggle_fallback(monkeypatch):
     px = jnp.zeros((2, cfg.image_size, cfg.image_size, 3), jnp.uint8)
     out = model.apply(params, px, method=model.encode_image)
     assert np.isfinite(np.asarray(out)).all()
+
+
+def test_auto_gate_modes(monkeypatch):
+    """DAFT_PALLAS_ATTENTION: 0/absent -> off; auto on a CPU backend -> off
+    (the probe is TPU-only); 1 on CPU backend -> off (backend gate)."""
+    from daft_tpu.ops import pallas_attention as pa
+
+    monkeypatch.delenv("DAFT_PALLAS_ATTENTION", raising=False)
+    assert pa.pallas_attention_enabled() is False
+    monkeypatch.setenv("DAFT_PALLAS_ATTENTION", "auto")
+    assert pa.pallas_attention_enabled() is False  # cpu backend, probe gated
+    monkeypatch.setenv("DAFT_PALLAS_ATTENTION", "0")
+    assert pa.pallas_attention_enabled() is False
