@@ -274,6 +274,10 @@ def main() -> None:
                 ab = {}
                 for name, flag in (("pallas", "1"), ("xla", "0")):
                     slice_s = min(RUNG_MAX_S, _remaining(reserve=60) / 2)
+                    if slice_s < RUNG_MIN_S:
+                        sys.stderr.write(f"skipping pallas A/B {name}: only "
+                                         f"{slice_s:.0f}s left\n")
+                        break
                     rec = _run_child("tpu", slice_s, batch=256, n=2048,
                                      env_extra={"DAFT_PALLAS_ATTENTION": flag})
                     if rec:

@@ -301,11 +301,41 @@ def _from_iceberg(catalog_or_path) -> Catalog:
     raise DaftValueError("unsupported pyiceberg catalog object")
 
 
+def _from_glue(database: str, **kwargs) -> "Catalog":
+    """AWS Glue over its JSON wire protocol — no boto3 needed (reference:
+    daft/catalog/__glue.py; impl daft_tpu/cloud_catalogs.py)."""
+    from daft_tpu.cloud_catalogs import GlueCatalog
+
+    return GlueCatalog(database, **kwargs)
+
+
+def _from_unity(endpoint, token: Optional[str] = None, **kwargs) -> "Catalog":
+    """Databricks Unity over its REST API — accepts an endpoint URL or a
+    UnityConfig (reference: daft/catalog/__unity.py)."""
+    from daft_tpu.cloud_catalogs import UnityCatalog
+    from daft_tpu.io.config import UnityConfig
+
+    if isinstance(endpoint, UnityConfig):
+        if not endpoint.endpoint:
+            raise DaftValueError("from_unity: UnityConfig.endpoint is not set")
+        return UnityCatalog(endpoint.endpoint, token=endpoint.token, **kwargs)
+    if isinstance(endpoint, str) and endpoint:
+        return UnityCatalog(endpoint, token=token, **kwargs)
+    raise DaftValueError("from_unity takes an endpoint URL or UnityConfig")
+
+
+def _from_s3tables(table_bucket_arn: str, **kwargs) -> "Catalog":
+    """AWS S3 Tables over its REST API (reference: daft/catalog/__s3tables.py)."""
+    from daft_tpu.cloud_catalogs import S3TablesCatalog
+
+    return S3TablesCatalog(table_bucket_arn, **kwargs)
+
+
 Catalog.from_pydict = staticmethod(_from_pydict)
 Catalog.from_iceberg = staticmethod(_from_iceberg)
-Catalog.from_unity = staticmethod(lambda c: _gated_catalog("unity", "unitycatalog"))
-Catalog.from_glue = staticmethod(lambda *a, **k: _gated_catalog("glue", "boto3"))
-Catalog.from_s3tables = staticmethod(lambda *a, **k: _gated_catalog("s3tables", "boto3"))
+Catalog.from_unity = staticmethod(_from_unity)
+Catalog.from_glue = staticmethod(_from_glue)
+Catalog.from_s3tables = staticmethod(_from_s3tables)
 Catalog.from_gravitino = staticmethod(lambda *a, **k: _gated_catalog("gravitino", "gravitino"))
 Catalog.from_paimon = staticmethod(lambda *a, **k: _gated_catalog("paimon", "pypaimon"))
 Catalog.from_postgres = staticmethod(lambda *a, **k: _gated_catalog("postgres", "psycopg2"))

@@ -3,17 +3,95 @@ lerobot.py, droid.py)."""
 
 from __future__ import annotations
 
+from typing import List, Optional
 
-def common_crawl(segment_paths, content: str = "raw", **kwargs):
-    """Load Common Crawl WARC segments (reference: daft/datasets/common_crawl.py).
+from daft_tpu.errors import DaftIOError, DaftValueError
 
-    ``segment_paths``: WARC file path(s)/glob (local or object store). In
-    connected environments pass the public CC segment URLs.
+#: source -> base URL; the manifest lives at {base}crawl-data/... and the
+#: manifest's relative paths resolve against the same base.
+_CC_SOURCES = {
+    "s3": "s3://commoncrawl/",
+    "hf": "hf://buckets/commoncrawl/commoncrawl/",
+    "http": "https://data.commoncrawl.org/",
+}
+
+_CC_CONTENT_TO_FILE_TYPE = {
+    "raw": "warc", "warc": "warc",
+    "text": "wet", "wet": "wet",
+    "metadata": "wat", "wat": "wat",
+}
+
+
+def _manifest_path(crawl: str, file_type: str, source: str) -> "tuple[str, str]":
+    """(manifest URL, path prefix) for a crawl's ``{file_type}.paths.gz``
+    (reference: daft/datasets/common_crawl.py _get_mainfest_path)."""
+    base = _CC_SOURCES[source]
+    return f"{base}crawl-data/{crawl}/{file_type}.paths.gz", base
+
+
+def _resolve_cc_paths(crawl: str, segment: Optional[str], file_type: str,
+                      num_files: Optional[int], io_config,
+                      source: Optional[str]) -> List[str]:
+    """Resolve crawl -> concrete file URLs via the manifest, with the
+    reference's hf -> http fallback when no source is pinned."""
+    import daft_tpu
+    from daft_tpu import col
+
+    order = [source] if source else ["hf", "http"]
+    last_err: Optional[Exception] = None
+    for src in order:
+        url, prefix = _manifest_path(crawl, file_type, src)
+        try:
+            paths = daft_tpu.read_text(url, io_config=io_config)
+        except (DaftIOError, FileNotFoundError, ConnectionError, OSError,
+                TimeoutError) as e:
+            # Missing manifest OR unreachable source: fall through to the
+            # next source in the chain (reference: hf -> http fallback).
+            last_err = e
+            continue
+        if segment is not None:
+            paths = paths.where(col("text").contains(segment))
+        if num_files is not None:
+            paths = paths.limit(num_files)
+        return [prefix + p for p in paths.to_pydict()["text"] if p]
+    raise DaftIOError(
+        f"Could not resolve Common Crawl manifest for crawl {crawl!r} "
+        f"(tried sources {order}): {last_err}")
+
+
+def common_crawl(crawl: str, segment: Optional[str] = None,
+                 content: str = "raw", num_files: Optional[int] = None,
+                 io_config=None, source: Optional[str] = None, **kwargs):
+    """Load Common Crawl data (reference: daft/datasets/common_crawl.py).
+
+    ``crawl`` is either a crawl id ("CC-MAIN-2025-33") resolved through the
+    crawl's ``{warc,wet,wat}.paths.gz`` manifest — segment-filtered and
+    ``num_files``-limited BEFORE any archive is opened — or a direct WARC
+    path/glob (the local/dev shortcut).
     """
     import daft_tpu
 
-    df = daft_tpu.read_warc(segment_paths)
-    if content == "text":
+    if content not in _CC_CONTENT_TO_FILE_TYPE:
+        raise DaftValueError(
+            f"content must be one of {sorted(_CC_CONTENT_TO_FILE_TYPE)}, "
+            f"got {content!r}")
+    if source is not None and source not in _CC_SOURCES:
+        raise DaftValueError(f"source must be one of {sorted(_CC_SOURCES)}")
+    if isinstance(crawl, (list, tuple)):
+        # Direct segment-path list (the pre-manifest API surface).
+        paths: List[str] = list(crawl)
+    elif any(ch in crawl for ch in "/*.") or not crawl.upper().startswith("CC-"):
+        paths = [crawl]
+    else:
+        file_type = _CC_CONTENT_TO_FILE_TYPE[content]
+        paths = _resolve_cc_paths(crawl, segment, file_type, num_files,
+                                  io_config, source)
+        if not paths:
+            raise DaftIOError(
+                f"Crawl {crawl!r} manifest matched no files"
+                + (f" for segment {segment!r}" if segment else ""))
+    df = daft_tpu.read_warc(paths, io_config=io_config)
+    if content in ("text", "wet"):
         from daft_tpu.datatype import DataType
         from daft_tpu.expressions.expression import col
 
@@ -21,12 +99,32 @@ def common_crawl(segment_paths, content: str = "raw", **kwargs):
     return df
 
 
-def lerobot(repo_path: str, **kwargs):
+def lerobot(repo_path: str, episodes: Optional[List[int]] = None,
+            io_config=None, **kwargs):
     """LeRobot episode datasets: parquet episode tables under the repo path
-    (reference: daft/datasets/lerobot.py)."""
+    (reference: daft/datasets/lerobot.py). ``episodes`` selects specific
+    episode indices via the conventional file layout; a requested episode
+    with no matching file is an error, not a silent drop."""
     import daft_tpu
 
-    return daft_tpu.read_parquet(f"{repo_path}/data/**/*.parquet")
+    if episodes:
+        from daft_tpu.io.scan import glob_paths
+
+        missing = []
+        files: List[str] = []
+        for i in episodes:
+            pattern = f"{repo_path}/data/**/episode_{i:06d}.parquet"
+            try:
+                files.extend(f.path for f in glob_paths([pattern], io_config))
+            except DaftIOError:
+                missing.append(i)
+        if missing:
+            raise DaftIOError(
+                f"lerobot: requested episode(s) {missing} not found under "
+                f"{repo_path!r}")
+        return daft_tpu.read_parquet(files, io_config=io_config)
+    return daft_tpu.read_parquet(f"{repo_path}/data/**/*.parquet",
+                                 io_config=io_config)
 
 
 def droid(path: str, **kwargs):

@@ -25,6 +25,10 @@ class S3Config:
     verify_ssl: bool = True
     connect_timeout_ms: int = 30_000
     num_tries: int = 3
+    # Route s3:// through the first-party sigv4 client (io/s3_client.py)
+    # instead of Arrow's S3FileSystem (also DAFT_NATIVE_S3=1). num_tries +
+    # credentials then apply per REQUEST via the shared retry policy.
+    use_native_client: bool = False
 
 
 @dataclass(frozen=True)
@@ -137,7 +141,13 @@ def filesystem_for(scheme: str, io_config: Optional[IOConfig]):
     if io_config is None:
         return None
     if scheme == "s3":
+        import os
+
         cfg = io_config.s3
+        if cfg.use_native_client or os.environ.get("DAFT_NATIVE_S3") == "1":
+            from daft_tpu.io.s3_client import S3Client, S3FileSystemHandler
+
+            return pafs.PyFileSystem(S3FileSystemHandler(S3Client(cfg)))
         kwargs = {}
         if cfg.region_name:
             kwargs["region"] = cfg.region_name

@@ -238,7 +238,14 @@ def _read_json_file(path: str, task: ScanTask, morsel_rows: int) -> Iterator[Mic
 def _read_text_file(path: str, task: ScanTask, morsel_rows: int) -> Iterator[MicroPartition]:
     fs, p = resolve_filesystem(path, task.read_options.get("io_config"))
     with fs.open_input_stream(p) as stream:
-        data = stream.read().decode("utf-8", errors="replace")
+        raw = stream.read()
+    if raw[:2] == b"\x1f\x8b":
+        # Still-gzipped text manifests (Common Crawl *.paths.gz; magic-byte
+        # gated — pyarrow streams often decompress *.gz transparently).
+        import gzip
+
+        raw = gzip.decompress(raw)
+    data = raw.decode("utf-8", errors="replace")
     lines = data.splitlines()
     for i in range(0, max(len(lines), 1), morsel_rows):
         chunk = lines[i:i + morsel_rows]

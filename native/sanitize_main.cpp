@@ -1,0 +1,106 @@
+// Sanitizer driver for the native kernels (SURVEY.md §5: TSAN/ASAN builds).
+//
+// Exercises every exported entry point of daft_native.cpp — single-threaded
+// for ASAN/UBSAN (bounds, overflow, UB), and concurrently from multiple
+// threads for TSAN (the engine calls these kernels from its worker pool on
+// shared read-only inputs with per-call outputs, which is exactly the shape
+// driven here). Built and run by tests/test_native_sanitizers.py:
+//   g++ -fsanitize=address,undefined ... daft_native.cpp sanitize_main.cpp
+//   g++ -fsanitize=thread           ... daft_native.cpp sanitize_main.cpp
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+extern "C" {
+int daft_native_abi_version();
+void hash_bytes_batch(const uint8_t*, const int64_t*, const int64_t*, int64_t,
+                      uint64_t*);
+void hash_fixed_width(const uint8_t*, int64_t, int64_t, uint64_t*);
+void combine_hashes(const uint64_t*, const uint64_t*, int64_t, uint64_t*);
+void minhash_rows(const uint64_t*, const int64_t*, int64_t, const uint64_t*,
+                  const uint64_t*, int64_t, uint32_t*);
+void hll_build(const uint64_t*, int64_t, int32_t, uint8_t*);
+}
+
+namespace {
+
+constexpr int64_t kRows = 4096;
+constexpr int64_t kWidth = 8;
+constexpr int64_t kNumHashes = 16;
+constexpr int32_t kPrecision = 12;
+
+struct Inputs {
+  std::vector<uint8_t> bytes;
+  std::vector<int64_t> starts, lengths, row_offsets;
+  std::vector<uint64_t> hashes_a, hashes_b, token_hashes, perm_a, perm_b;
+};
+
+Inputs make_inputs() {
+  Inputs in;
+  in.bytes.resize(kRows * kWidth);
+  for (size_t i = 0; i < in.bytes.size(); ++i)
+    in.bytes[i] = static_cast<uint8_t>(i * 131 + 7);
+  for (int64_t r = 0; r < kRows; ++r) {
+    in.starts.push_back(r * kWidth);
+    in.lengths.push_back(kWidth - (r % 3));  // ragged rows incl. width 6..8
+  }
+  for (int64_t r = 0; r <= kRows; ++r) in.row_offsets.push_back(r * 4);
+  for (int64_t i = 0; i < kRows * 4; ++i)
+    in.token_hashes.push_back(0x9E3779B97F4A7C15ull * (i + 1));
+  for (int64_t i = 0; i < kRows; ++i) {
+    in.hashes_a.push_back(0xDEADBEEFCAFEull * (i + 1));
+    in.hashes_b.push_back(0x12345678ull * (i + 3));
+  }
+  for (int64_t i = 0; i < kNumHashes; ++i) {
+    in.perm_a.push_back(2 * i + 1);  // odd multipliers
+    in.perm_b.push_back(0xABCDEFull * (i + 1));
+  }
+  return in;
+}
+
+uint64_t run_all(const Inputs& in) {
+  std::vector<uint64_t> h1(kRows), h2(kRows), combined(kRows);
+  hash_bytes_batch(in.bytes.data(), in.starts.data(), in.lengths.data(), kRows,
+                   h1.data());
+  hash_fixed_width(in.bytes.data(), kRows, kWidth, h2.data());
+  combine_hashes(h1.data(), h2.data(), kRows, combined.data());
+  std::vector<uint32_t> mh(kRows * kNumHashes);
+  minhash_rows(in.token_hashes.data(), in.row_offsets.data(), kRows,
+               in.perm_a.data(), in.perm_b.data(), kNumHashes, mh.data());
+  std::vector<uint8_t> registers(1u << kPrecision, 0);
+  hll_build(combined.data(), kRows, kPrecision, registers.data());
+  uint64_t acc = 0;
+  for (auto v : combined) acc ^= v;
+  for (auto v : mh) acc += v;
+  for (auto v : registers) acc += v;
+  return acc;
+}
+
+}  // namespace
+
+int main() {
+  if (daft_native_abi_version() != 1) {
+    std::fprintf(stderr, "unexpected ABI version\n");
+    return 2;
+  }
+  Inputs in = make_inputs();
+  uint64_t expected = run_all(in);
+
+  // TSAN shape: shared read-only inputs, distinct outputs per thread.
+  std::vector<std::thread> threads;
+  std::vector<uint64_t> results(8, 0);
+  for (int t = 0; t < 8; ++t)
+    threads.emplace_back([&, t] { results[t] = run_all(in); });
+  for (auto& th : threads) th.join();
+  for (auto r : results) {
+    if (r != expected) {
+      std::fprintf(stderr, "nondeterministic kernel result\n");
+      return 3;
+    }
+  }
+  std::printf("sanitize ok %llu\n", static_cast<unsigned long long>(expected));
+  return 0;
+}

@@ -1,0 +1,233 @@
+"""Glue / Unity / S3Tables catalog bindings against local fixture servers.
+
+Reference surface: daft/catalog/{__glue,__unity,__s3tables}.py. Each catalog
+speaks its real wire protocol (AWS JSON 1.1 with sigv4, Unity REST with
+bearer auth, S3 Tables REST with sigv4) against an in-process server — the
+ai/api_providers.py injectable-transport pattern, zero egress. The sigv4
+signer itself is validated against AWS's published test vector.
+"""
+
+import datetime
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+import daft_tpu
+from daft_tpu.catalog import Catalog
+
+
+def test_sigv4_aws_reference_vector():
+    """AWS's published sigv4 example (GET iam ListUsers, 2015-08-30)."""
+    from daft_tpu.io.sigv4 import AwsCredentials, sign_request
+
+    headers = sign_request(
+        "GET", "https://iam.amazonaws.com/",
+        region="us-east-1", service="iam",
+        credentials=AwsCredentials(
+            "AKIDEXAMPLE", "wJalrXUtnFEMI/K7MDENG+bPxRfiCYEXAMPLEKEY"),
+        headers={"Content-Type": "application/x-www-form-urlencoded; charset=utf-8"},
+        query={"Action": "ListUsers", "Version": "2010-05-08"},
+        now=datetime.datetime(2015, 8, 30, 12, 36, 0,
+                              tzinfo=datetime.timezone.utc))
+    assert headers["Authorization"] == (
+        "AWS4-HMAC-SHA256 "
+        "Credential=AKIDEXAMPLE/20150830/us-east-1/iam/aws4_request, "
+        "SignedHeaders=content-type;host;x-amz-date, "
+        "Signature=5d672d79c15b13162d9279b0855cfba6789a8edb4c82c400e06b5924a6f2b5d7")
+
+
+def _serve(handler_cls):
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), handler_cls)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    return srv, f"http://127.0.0.1:{srv.server_address[1]}"
+
+
+class _JsonHandler(BaseHTTPRequestHandler):
+    def log_message(self, *a):
+        pass
+
+    def _json(self, code, obj):
+        body = json.dumps(obj).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _body(self):
+        n = int(self.headers.get("Content-Length") or 0)
+        return json.loads(self.rfile.read(n).decode()) if n else {}
+
+
+@pytest.fixture
+def parquet_location(tmp_path):
+    loc = str(tmp_path / "tbl")
+    daft_tpu.from_pydict({"a": [1, 2, 3], "b": ["x", "y", "z"]}).write_parquet(loc)
+    return loc
+
+
+# --------------------------------------------------------------------------- #
+# Glue                                                                        #
+# --------------------------------------------------------------------------- #
+def test_glue_catalog_roundtrip(parquet_location, monkeypatch):
+    monkeypatch.setenv("AWS_ACCESS_KEY_ID", "AKIDTEST")
+    monkeypatch.setenv("AWS_SECRET_ACCESS_KEY", "secret")
+    tables = {}
+    seen_auth = []
+
+    class H(_JsonHandler):
+        def do_POST(self):
+            target = self.headers.get("X-Amz-Target", "")
+            seen_auth.append(self.headers.get("Authorization", ""))
+            body = self._body()
+            assert body.get("DatabaseName") == "db"
+            if target == "AWSGlue.CreateTable":
+                ti = body["TableInput"]
+                tables[ti["Name"]] = ti
+                return self._json(200, {})
+            if target == "AWSGlue.GetTables":
+                return self._json(200, {"TableList": [
+                    {"Name": n} for n in sorted(tables)]})
+            if target == "AWSGlue.GetTable":
+                t = tables.get(body["Name"])
+                if t is None:
+                    return self._json(400, {"__type": "EntityNotFoundException"})
+                return self._json(200, {"Table": t})
+            if target == "AWSGlue.DeleteTable":
+                tables.pop(body["Name"], None)
+                return self._json(200, {})
+            return self._json(400, {"__type": "UnknownOperation"})
+
+    srv, url = _serve(H)
+    try:
+        cat = Catalog.from_glue("db", region="us-east-1", endpoint_url=url)
+        cat.create_table("t1", location=parquet_location)
+        assert cat.list_tables() == ["t1"]
+        out = cat.get_table("t1").read().sort("a").to_pydict()
+        assert out["a"] == [1, 2, 3]
+        cat.drop_table("t1")
+        assert cat.list_tables() == []
+        # every request carried a sigv4 Authorization with the glue scope
+        assert seen_auth and all(
+            "/us-east-1/glue/aws4_request" in a and "Signature=" in a
+            for a in seen_auth)
+    finally:
+        srv.shutdown()
+
+
+# --------------------------------------------------------------------------- #
+# Unity                                                                       #
+# --------------------------------------------------------------------------- #
+def test_unity_catalog_roundtrip(parquet_location):
+    tables = {}
+
+    class H(_JsonHandler):
+        def do_GET(self):
+            assert self.headers.get("Authorization") == "Bearer tok123"
+            from urllib.parse import parse_qs, urlparse
+
+            u = urlparse(self.path)
+            if u.path == "/api/2.1/unity-catalog/tables":
+                q = parse_qs(u.query)
+                assert q["catalog_name"] == ["main"]
+                return self._json(200, {"tables": [
+                    {"name": n} for n in sorted(tables)]})
+            name = u.path.rsplit("/", 1)[-1].split(".")[-1]
+            if name in tables:
+                return self._json(200, tables[name])
+            return self._json(404, {"error_code": "TABLE_DOES_NOT_EXIST"})
+
+        def do_POST(self):
+            body = self._body()
+            tables[body["name"]] = {
+                "name": body["name"],
+                "storage_location": body["storage_location"],
+                "data_source_format": body["data_source_format"],
+            }
+            return self._json(200, tables[body["name"]])
+
+        def do_DELETE(self):
+            name = self.path.rsplit("/", 1)[-1].split(".")[-1]
+            tables.pop(name, None)
+            return self._json(200, {})
+
+    srv, url = _serve(H)
+    try:
+        cat = Catalog.from_unity(url, token="tok123")
+        cat.create_table("t2", location=parquet_location, fmt="PARQUET")
+        assert cat.list_tables() == ["t2"]
+        out = cat.get_table("t2").read().sort("a").to_pydict()
+        assert out["b"] == ["x", "y", "z"]
+        cat.drop_table("t2")
+        assert cat.list_tables() == []
+    finally:
+        srv.shutdown()
+
+
+def test_unity_from_config(parquet_location):
+    from daft_tpu.io.config import UnityConfig
+
+    cat = Catalog.from_unity(UnityConfig(endpoint="http://example", token="t"))
+    assert cat.endpoint == "http://example" and cat.token == "t"
+
+
+# --------------------------------------------------------------------------- #
+# S3 Tables                                                                   #
+# --------------------------------------------------------------------------- #
+def test_s3tables_catalog_roundtrip(tmp_path, monkeypatch):
+    monkeypatch.setenv("AWS_ACCESS_KEY_ID", "AKIDTEST")
+    monkeypatch.setenv("AWS_SECRET_ACCESS_KEY", "secret")
+    # a real iceberg table on disk for the metadata location
+    ice = str(tmp_path / "ice")
+    daft_tpu.from_pydict({"a": [7, 8]}).write_iceberg(ice)
+    import os
+
+    meta = sorted(os.listdir(os.path.join(ice, "metadata")))
+    meta_loc = os.path.join(ice, "metadata",
+                            [m for m in meta if m.endswith(".metadata.json")][-1])
+    tables = {}
+    seen_auth = []
+
+    class H(_JsonHandler):
+        def do_GET(self):
+            seen_auth.append(self.headers.get("Authorization", ""))
+            from urllib.parse import urlparse
+
+            path = urlparse(self.path).path
+            parts = [p for p in path.split("/") if p]
+            if len(parts) == 2:  # /tables/{arn}
+                return self._json(200, {"tables": [
+                    {"name": n} for n in sorted(tables)]})
+            name = parts[-1]
+            if name in tables:
+                return self._json(200, {"metadataLocation": tables[name]})
+            return self._json(404, {"message": "NotFound"})
+
+        def do_PUT(self):
+            name = self.path.split("?")[0].rsplit("/", 1)[-1]
+            tables[name] = meta_loc
+            return self._json(200, {})
+
+        def do_DELETE(self):
+            name = self.path.split("?")[0].rsplit("/", 1)[-1]
+            tables.pop(name, None)
+            return self._json(204, {})
+
+    srv, url = _serve(H)
+    try:
+        cat = Catalog.from_s3tables(
+            "arn:aws:s3tables:us-east-1:123456789012:bucket/my-tables",
+            namespace="ns", region="us-east-1", endpoint_url=url)
+        cat.create_table("t3")
+        assert cat.list_tables() == ["t3"]
+        out = cat.get_table("t3").read().sort("a").to_pydict()
+        assert out["a"] == [7, 8]
+        cat.drop_table("t3")
+        assert cat.list_tables() == []
+        assert seen_auth and all(
+            "/us-east-1/s3tables/aws4_request" in a for a in seen_auth if a)
+    finally:
+        srv.shutdown()

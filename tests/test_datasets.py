@@ -1,0 +1,106 @@
+"""Dataset loaders: Common Crawl manifest resolution (mocked, zero egress).
+
+Reference: daft/datasets/common_crawl.py + its
+tests/datasets/test_common_crawl_mocked.py — crawl id -> {warc,wet}.paths.gz
+manifest -> segment filter -> num_files limit -> WARC read, all against
+local fixtures.
+"""
+
+import gzip
+import os
+
+import pytest
+
+import daft_tpu
+from daft_tpu import datasets
+from daft_tpu.errors import DaftIOError, DaftValueError
+
+_REC = (b"WARC/1.0\r\nWARC-Type: response\r\nWARC-Record-ID: <urn:uuid:%d>\r\n"
+        b"WARC-Target-URI: http://x.test/\r\nWARC-Date: 2024-01-01T00:00:00Z\r\n"
+        b"Content-Length: 11\r\n\r\nhello world\r\n\r\n")
+
+
+@pytest.fixture
+def crawl_fixture(tmp_path, monkeypatch):
+    """Local 'crawl': 3 segment WARCs + a gzipped manifest, with the http
+    source rebased onto tmp_path."""
+    base = tmp_path / "cc"
+    rel_paths = []
+    for seg in ("seg-000", "seg-001", "seg-002"):
+        rel = f"crawl-data/CC-MAIN-2099-01/segments/{seg}/warc/f.warc.gz"
+        p = base / rel
+        os.makedirs(p.parent, exist_ok=True)
+        p.write_bytes(gzip.compress(_REC % 1 + _REC % 2))
+        rel_paths.append(rel)
+    for ft in ("warc", "wet"):  # wet shares fixtures: same records, text cast
+        manifest = base / f"crawl-data/CC-MAIN-2099-01/{ft}.paths.gz"
+        manifest.write_bytes(gzip.compress("\n".join(rel_paths).encode()))
+    monkeypatch.setitem(datasets._CC_SOURCES, "http", f"{base}/")
+    return base
+
+
+def test_common_crawl_manifest_resolution(crawl_fixture):
+    df = datasets.common_crawl("CC-MAIN-2099-01", source="http")
+    assert df.count_rows() == 6  # 3 segments x 2 records
+
+
+def test_common_crawl_segment_filter_and_limit(crawl_fixture):
+    df = datasets.common_crawl("CC-MAIN-2099-01", segment="seg-001",
+                               source="http")
+    assert df.count_rows() == 2
+    df = datasets.common_crawl("CC-MAIN-2099-01", num_files=2, source="http")
+    assert df.count_rows() == 4
+
+
+def test_common_crawl_text_content(crawl_fixture):
+    out = datasets.common_crawl("CC-MAIN-2099-01", segment="seg-000",
+                                content="text", source="http").to_pydict()
+    assert out["text"] == ["hello world"] * 2
+
+
+def test_common_crawl_source_fallback(crawl_fixture):
+    """source=None: hf manifest missing -> falls back to http."""
+    df = datasets.common_crawl("CC-MAIN-2099-01")
+    assert df.count_rows() == 6
+
+
+def test_common_crawl_validation(crawl_fixture):
+    with pytest.raises(DaftValueError, match="content"):
+        datasets.common_crawl("CC-MAIN-2099-01", content="bogus")
+    with pytest.raises(DaftValueError, match="source"):
+        datasets.common_crawl("CC-MAIN-2099-01", source="ftp")
+    with pytest.raises(DaftIOError):
+        datasets.common_crawl("CC-MAIN-1999-99", source="http")
+
+
+def test_common_crawl_direct_path(tmp_path):
+    p = tmp_path / "direct.warc.gz"
+    p.write_bytes(gzip.compress(_REC % 7))
+    assert datasets.common_crawl(str(p)).count_rows() == 1
+    # the pre-manifest list API still works
+    assert datasets.common_crawl([str(p), str(p)]).count_rows() == 2
+
+
+def test_lerobot_missing_episode_errors(tmp_path):
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    d = tmp_path / "data" / "chunk-000"
+    os.makedirs(d)
+    pq.write_table(pa.table({"idx": [0]}), str(d / "episode_000000.parquet"))
+    with pytest.raises(DaftIOError, match=r"\[99\]"):
+        datasets.lerobot(str(tmp_path), episodes=[0, 99])
+
+
+def test_lerobot_episode_selection(tmp_path):
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    for i in (0, 1, 2):
+        d = tmp_path / "data" / "chunk-000"
+        os.makedirs(d, exist_ok=True)
+        pq.write_table(pa.table({"idx": [i]}),
+                       str(d / f"episode_{i:06d}.parquet"))
+    assert datasets.lerobot(str(tmp_path)).count_rows() == 3
+    out = datasets.lerobot(str(tmp_path), episodes=[0, 2]).sort("idx").to_pydict()
+    assert out["idx"] == [0, 2]

@@ -1,0 +1,213 @@
+"""First-party S3 client: sigv4-signed ranged reads against a fixture server.
+
+Reference: src/daft-io/src/{s3_like.rs,object_io.rs:287-330}. The fixture
+is an in-process S3-compatible server (ranged GET / HEAD / PUT / DELETE /
+ListObjectsV2) that VERIFIES each request's sigv4 signature by recomputing
+it server-side from the received request — transport-level integrity on top
+of the AWS reference-vector test in test_cloud_catalogs.py. The engine path
+is covered by reading parquet through S3Config(use_native_client=True).
+"""
+
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qsl, unquote, urlparse
+
+import pytest
+
+import daft_tpu
+from daft_tpu.io.config import IOConfig, S3Config
+from daft_tpu.io.s3_client import S3Client
+
+KEY_ID, SECRET = "AKIDFIXTURE", "fixture-secret"
+
+
+class _S3Store:
+    def __init__(self):
+        self.objects = {}  # (bucket, key) -> bytes
+        self.bad_auth = []
+
+    def verify(self, handler, payload: bytes) -> bool:
+        """Recompute the sigv4 signature from the received request."""
+        import hashlib
+
+        from daft_tpu.io.sigv4 import AwsCredentials, sign_request
+
+        auth = handler.headers.get("Authorization", "")
+        if "Signature=" not in auth:
+            self.bad_auth.append(("missing", handler.path))
+            return False
+        u = urlparse(handler.path)
+        query = dict(parse_qsl(u.query, keep_blank_values=True))
+        # Reproduce exactly the signed header set the client used.
+        signed = auth.split("SignedHeaders=")[1].split(",")[0].split(";")
+        headers = {h: handler.headers.get(h) for h in signed if h != "host"}
+        amz_date = handler.headers["x-amz-date"]
+        import datetime
+
+        now = datetime.datetime.strptime(amz_date, "%Y%m%dT%H%M%SZ").replace(
+            tzinfo=datetime.timezone.utc)
+        expected = sign_request(
+            handler.command, f"http://{handler.headers['Host']}{u.path}",
+            region="fix-region", service="s3",
+            credentials=AwsCredentials(KEY_ID, SECRET),
+            headers={k: v for k, v in headers.items()
+                     if k not in ("x-amz-date", "x-amz-content-sha256")},
+            query=query,
+            payload_sha256=handler.headers.get("x-amz-content-sha256")
+            or hashlib.sha256(payload).hexdigest(),
+            now=now)
+        ok = expected["Authorization"] == auth
+        if not ok:
+            self.bad_auth.append((auth, expected["Authorization"]))
+        return ok
+
+
+def _serve(store):
+    class H(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, *a):
+            pass
+
+        def _split(self):
+            u = urlparse(self.path)
+            parts = u.path.lstrip("/").split("/", 1)
+            return unquote(parts[0]), unquote(parts[1]) if len(parts) > 1 else ""
+
+        def _send(self, code, body=b"", headers=None):
+            self.send_response(code)
+            for k, v in (headers or {}).items():
+                self.send_header(k, v)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_HEAD(self):
+            assert store.verify(self, b"")
+            bucket, key = self._split()
+            data = store.objects.get((bucket, key))
+            if data is None:
+                return self._send(404)
+            # HEAD: real Content-Length, no body.
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+
+        def do_GET(self):
+            assert store.verify(self, b"")
+            bucket, key = self._split()
+            if not key:  # ListObjectsV2
+                q = dict(parse_qsl(urlparse(self.path).query))
+                prefix = q.get("prefix", "")
+                items = sorted((k, len(v)) for (b, k), v in store.objects.items()
+                               if b == bucket and k.startswith(prefix))
+                xml = "<?xml version='1.0'?><ListBucketResult>" + "".join(
+                    f"<Contents><Key>{k}</Key><Size>{s}</Size></Contents>"
+                    for k, s in items) + \
+                    "<IsTruncated>false</IsTruncated></ListBucketResult>"
+                return self._send(200, xml.encode())
+            data = store.objects.get((bucket, key))
+            if data is None:
+                return self._send(404)
+            rng = self.headers.get("Range")
+            if rng:
+                spec = rng.split("=")[1]
+                start_s, _, end_s = spec.partition("-")
+                start = int(start_s)
+                end = int(end_s) if end_s else len(data) - 1
+                chunk = data[start:end + 1]
+                return self._send(206, chunk)
+            self._send(200, data)
+
+        def do_PUT(self):
+            n = int(self.headers.get("Content-Length") or 0)
+            payload = self.rfile.read(n)
+            assert store.verify(self, payload)
+            bucket, key = self._split()
+            store.objects[(bucket, key)] = payload
+            self._send(200)
+
+        def do_DELETE(self):
+            assert store.verify(self, b"")
+            bucket, key = self._split()
+            store.objects.pop((bucket, key), None)
+            self._send(204)
+
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), H)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    return srv, f"http://127.0.0.1:{srv.server_address[1]}"
+
+
+@pytest.fixture
+def s3(monkeypatch):
+    monkeypatch.delenv("AWS_ACCESS_KEY_ID", raising=False)
+    store = _S3Store()
+    srv, url = _serve(store)
+    cfg = S3Config(region_name="fix-region", endpoint_url=url,
+                   key_id=KEY_ID, access_key=SECRET, use_native_client=True)
+    yield store, cfg, url
+    srv.shutdown()
+
+
+def test_key_needing_percent_encoding_signs_single_encoded(s3):
+    """S3 canonical-URI rule: sign over the path AS SENT (single encoding).
+    The fixture recomputes the signature from the received path, so a
+    double-encoding signer fails this round trip."""
+    store, cfg, url = s3
+    c = S3Client(cfg)
+    key = "dir with space/a+b#c.bin"
+    c.put_object("bkt", key, b"payload")
+    assert c.get_object("bkt", key) == b"payload"
+    assert c.get_object("bkt", key, start=2, length=3) == b"ylo"
+    assert not store.bad_auth, store.bad_auth[:1]
+
+
+def test_put_get_ranged_list_delete(s3):
+    store, cfg, url = s3
+    c = S3Client(cfg)
+    c.put_object("bkt", "dir/a.bin", b"0123456789abcdef")
+    assert store.objects[("bkt", "dir/a.bin")] == b"0123456789abcdef"
+    assert c.get_object("bkt", "dir/a.bin") == b"0123456789abcdef"
+    assert c.get_object("bkt", "dir/a.bin", start=4, length=6) == b"456789"
+    c.put_object("bkt", "dir/b.bin", b"xy")
+    assert [(o.key, o.size) for o in c.list_objects("bkt", prefix="dir/")] == \
+        [("dir/a.bin", 16), ("dir/b.bin", 2)]
+    c.delete_object("bkt", "dir/b.bin")
+    assert [o.key for o in c.list_objects("bkt", prefix="dir/")] == ["dir/a.bin"]
+    assert not store.bad_auth, store.bad_auth[:1]
+
+
+def test_engine_reads_parquet_through_native_client(s3, tmp_path):
+    """write_parquet locally -> upload through the client -> read_parquet
+    over s3:// with use_native_client: the full scan path (glob, open,
+    ranged parquet reads) rides the signed first-party client."""
+    store, cfg, url = s3
+    local = tmp_path / "t.parquet"
+    daft_tpu.from_pydict({"a": list(range(50)), "b": ["v"] * 50}) \
+        .write_parquet(str(tmp_path))
+    import os
+
+    files = [f for f in os.listdir(tmp_path) if f.endswith(".parquet")]
+    c = S3Client(cfg)
+    for f in files:
+        c.put_object("data", f"tbl/{f}", (tmp_path / f).read_bytes())
+    io_cfg = IOConfig(s3=cfg)
+    out = (daft_tpu.read_parquet("s3://data/tbl", io_config=io_cfg)
+           .where(daft_tpu.col("a") >= 45).sort("a").to_pydict())
+    assert out["a"] == [45, 46, 47, 48, 49]
+    assert not store.bad_auth
+
+
+def test_anonymous_requests_unsigned(monkeypatch):
+    monkeypatch.delenv("AWS_ACCESS_KEY_ID", raising=False)
+    from daft_tpu.io.sigv4 import resolve_credentials
+
+    assert resolve_credentials(S3Config(anonymous=True)) is None
+    assert resolve_credentials(None) is None
+    monkeypatch.setenv("AWS_ACCESS_KEY_ID", "k")
+    monkeypatch.setenv("AWS_SECRET_ACCESS_KEY", "s")
+    creds = resolve_credentials(None)
+    assert creds.key_id == "k" and creds.secret_key == "s"
+    # explicit config beats the environment
+    creds = resolve_credentials(S3Config(key_id="cfg", access_key="ca"))
+    assert creds.key_id == "cfg"
