@@ -147,6 +147,11 @@ class InMemoryCatalog(Catalog):
             t = MemoryTable(name, source)
         elif isinstance(source, Schema):
             t = MemoryTable(name, schema=source)
+        elif isinstance(source, dict):
+            # Column data (reference: Catalog.from_pydict table values).
+            from daft_tpu.dataframe.creation import from_pydict
+
+            t = MemoryTable(name, from_pydict(source))
         elif source is None:
             t = MemoryTable(name)
         else:

@@ -66,22 +66,15 @@ class GlueCatalog(Catalog):
         self.s3_config = s3_config
 
     def _call(self, operation: str, body: dict) -> dict:
-        from daft_tpu.io.sigv4 import resolve_credentials, sign_request
+        from daft_tpu.io.sigv4 import signed_url_and_headers
 
-        payload = json.dumps(body).encode()
-        headers = {
-            "Content-Type": "application/x-amz-json-1.1",
-            "X-Amz-Target": f"AWSGlue.{operation}",
-        }
-        creds = resolve_credentials(self.s3_config)
-        if creds is not None:
-            headers = {**sign_request("POST", self.endpoint + "/",
-                                      region=self.region, service="glue",
-                                      credentials=creds, headers=headers,
-                                      payload=payload),
-                       "Content-Type": "application/x-amz-json-1.1"}
-        return self.transport.request("POST", self.endpoint + "/", body=body,
-                                      headers=headers)
+        url, headers = signed_url_and_headers(
+            "POST", self.endpoint + "/", region=self.region, service="glue",
+            s3_config=self.s3_config,
+            headers={"Content-Type": "application/x-amz-json-1.1",
+                     "X-Amz-Target": f"AWSGlue.{operation}"},
+            payload=json.dumps(body).encode())
+        return self.transport.request("POST", url, body=body, headers=headers)
 
     def list_tables(self, pattern: Optional[str] = None) -> List[str]:
         out: List[str] = []
@@ -228,19 +221,12 @@ class S3TablesCatalog(Catalog):
 
     def _req(self, method: str, path: str, body: Optional[dict] = None,
              query: Optional[dict] = None) -> dict:
-        from daft_tpu.io.sigv4 import resolve_credentials, sign_request
+        from daft_tpu.io.sigv4 import signed_url_and_headers
 
-        url = self.endpoint + path
-        headers: Dict[str, str] = {}
-        creds = resolve_credentials(self.s3_config)
-        if creds is not None:
-            payload = json.dumps(body).encode() if body is not None else b""
-            headers = sign_request(method, url, region=self.region,
-                                   service="s3tables", credentials=creds,
-                                   headers=headers, query=query or {},
-                                   payload=payload)
-        if query:
-            url += "?" + urllib.parse.urlencode(query)
+        url, headers = signed_url_and_headers(
+            method, self.endpoint + path, region=self.region,
+            service="s3tables", s3_config=self.s3_config, query=query,
+            payload=json.dumps(body).encode() if body is not None else b"")
         return self.transport.request(method, url, body=body, headers=headers)
 
     def _table_path(self, name: str) -> str:
@@ -273,11 +259,13 @@ class S3TablesCatalog(Catalog):
         return _LocationTable(name, meta, "iceberg")
 
     def create_table(self, name: str, source=None) -> Table:
-        self._req("PUT", self._table_path(name), body={"format": "ICEBERG"})
         if source is not None:
+            # Validate BEFORE the remote PUT: raising after it would leave
+            # the table created in AWS behind the error.
             raise DaftValueError(
                 "S3TablesCatalog.create_table(source=...) requires an "
                 "Iceberg write through the table's warehouse location")
+        self._req("PUT", self._table_path(name), body={"format": "ICEBERG"})
         return self.get_table(name)
 
     def drop_table(self, name: str) -> None:

@@ -107,6 +107,9 @@ def lerobot(repo_path: str, episodes: Optional[List[int]] = None,
     with no matching file is an error, not a silent drop."""
     import daft_tpu
 
+    if episodes is not None and not episodes:
+        raise DaftValueError("lerobot: episodes=[] selects nothing; pass "
+                             "None to load every episode")
     if episodes:
         from daft_tpu.io.scan import glob_paths
 

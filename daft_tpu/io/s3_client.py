@@ -228,13 +228,16 @@ class S3FileSystemHandler(pafs.FileSystemHandler):
         client = self.client
 
         class _Out(io.BytesIO):
-            def close(self):
-                import sys
+            # close() uploads what was written — matching the local-filesystem
+            # backend, where a writer failing mid-write also leaves the
+            # partial file on disk (cleanup is the writer's job on all
+            # backends). Double-close (PythonFile.close then GC __del__)
+            # must not re-upload.
+            _uploaded = False
 
-                # A close() during exception unwind (failed serialization,
-                # GC of an aborted writer) must NOT upload the truncated
-                # buffer as a live object.
-                if sys.exc_info()[0] is None:
+            def close(self):
+                if not self._uploaded and not self.closed:
+                    self._uploaded = True
                     client.put_object(bucket, key, self.getvalue())
                 super().close()
 

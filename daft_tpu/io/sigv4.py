@@ -35,7 +35,14 @@ def resolve_credentials(s3_config=None) -> Optional[AwsCredentials]:
         if getattr(s3_config, "anonymous", False):
             return None
         if getattr(s3_config, "key_id", None):
-            return AwsCredentials(s3_config.key_id, s3_config.access_key or "",
+            if not getattr(s3_config, "access_key", None):
+                from daft_tpu.errors import DaftValueError
+
+                raise DaftValueError(
+                    "S3Config.key_id is set without access_key — signing "
+                    "with an empty secret would fail every request with "
+                    "SignatureDoesNotMatch")
+            return AwsCredentials(s3_config.key_id, s3_config.access_key,
                                   getattr(s3_config, "session_token", None))
     key = os.environ.get("AWS_ACCESS_KEY_ID")
     if key:

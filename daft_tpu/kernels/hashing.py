@@ -148,7 +148,14 @@ def hash_series(s, seed=None):
         casted = s._data.cast(pa.large_binary()) if dt.id == TypeId.FIXED_SIZE_BINARY else None
         if casted is not None:
             return hash_series(Series("h", DataType.binary(), casted), seed).rename(s.name)
-        vals = np.asarray(pc.cast(s._data, pa.int64(), safe=False))
+        t = s._data.type
+        if pa.types.is_date32(t) or pa.types.is_time32(t):
+            # 32-bit temporals have no direct int64 cast path in arrow:
+            # go through their physical int32 first.
+            vals = np.asarray(pc.cast(pc.cast(s._data, pa.int32(), safe=False),
+                                      pa.int64()))
+        else:
+            vals = np.asarray(pc.cast(s._data, pa.int64(), safe=False))
         out = _hash_fixed_width(vals)
     else:
         # Nested types: hash the canonical string repr row-wise (slow path).

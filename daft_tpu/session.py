@@ -36,6 +36,15 @@ class Session:
         self._catalogs: Dict[str, Catalog] = {"default": InMemoryCatalog("default")}
         self._current_catalog = "default"
         self._temp_tables: Dict[str, Table] = {}
+        self._variables: Dict[str, object] = {}
+        self._current_namespace: Optional[str] = None
+
+    # -- session variables (SQL SET; reference: daft-sql session vars) -----
+    def set_variable(self, name: str, value) -> None:
+        self._variables[name] = value
+
+    def get_variable(self, name: str, default=None):
+        return self._variables.get(name, default)
 
     # -- catalogs ---------------------------------------------------------
     def attach(self, catalog: Catalog, alias: Optional[str] = None) -> None:
@@ -58,9 +67,13 @@ class Session:
         self._temp_tables.pop(alias, None)
 
     def use(self, catalog: str) -> None:
-        if catalog not in self._catalogs:
-            raise DaftValueError(f"Unknown catalog {catalog!r}")
-        self._current_catalog = catalog
+        """Switch the current catalog; ``catalog.namespace`` also records a
+        current namespace (reference: Session.use / SQL USE)."""
+        name, _, namespace = catalog.partition(".")
+        if name not in self._catalogs:
+            raise DaftValueError(f"Unknown catalog {name!r}")
+        self._current_catalog = name
+        self._current_namespace = namespace or None
 
     @property
     def current_catalog(self) -> Catalog:

@@ -192,6 +192,29 @@ class ShowTablesStmt:
 
 
 @dataclass
+class UseStmt:
+    """USE <catalog>[.<namespace>] (reference: daft-sql Statement::Use)."""
+
+    name: str
+
+
+@dataclass
+class DescribeStmt:
+    """DESCRIBE <table> | DESCRIBE <select> (reference: daft-sql describe)."""
+
+    target: object  # str table name | SelectStmt
+
+
+@dataclass
+class SetStmt:
+    """SET <name> = <literal> (reference: daft-sql Statement::Set session
+    variables; engine-config keys apply to the execution/planning config)."""
+
+    name: str
+    value: object
+
+
+@dataclass
 class JoinClause:
     right: Union[TableRef, SubqueryRef]
     how: str
@@ -368,6 +391,25 @@ class Parser:
             if self._accept_word("like"):
                 pattern = self.expect("str").value[1:-1].replace("''", "'")
             return ShowTablesStmt(pattern)
+        if word == "use":
+            self.next()
+            return UseStmt(self._qualified_name())
+        if word in ("describe", "desc"):
+            self.next()
+            nxt = self.peek()
+            nxt_word = nxt.value.lower() if nxt.kind in ("ident", "kw") else ""
+            if nxt_word in ("select", "with") and nxt.kind == "kw":
+                inner = self._parse_statement_inner()
+                if not isinstance(inner, SelectStmt):
+                    raise SQLParseError("DESCRIBE takes a table or a SELECT")
+                return DescribeStmt(inner)
+            return DescribeStmt(self._qualified_name())
+        if word == "set":
+            self.next()
+            name = self._qualified_name()
+            if not (self.accept("op", "=") or self._accept_word("to")):
+                raise SQLParseError("SET requires '=' or TO")
+            return SetStmt(name, self._literal_arg())
         ctes: Dict[str, SelectStmt] = {}
         if self.accept_kw("with"):
             while True:

@@ -65,11 +65,14 @@ def _execute_statement(stmt, bindings: Dict[str, object], session=None):
     other statements return small status DataFrames)."""
     from daft_tpu.sql.parser import (
         CreateTableStmt,
+        DescribeStmt,
         DropTableStmt,
         ExplainStmt,
         InsertStmt,
         SelectStmt,
+        SetStmt,
         ShowTablesStmt,
+        UseStmt,
         ValuesRef,
     )
 
@@ -149,6 +152,44 @@ def _execute_statement(stmt, bindings: Dict[str, object], session=None):
             pat = stmt.pattern.replace("%", "*").replace("_", "?")
             names = [n for n in names if fnmatch.fnmatch(n, pat)]
         return from_pydict({"table": list(names) if names else []})
+    if isinstance(stmt, UseStmt):
+        sess.use(stmt.name)
+        return from_pydict({"catalog": [stmt.name]})
+    if isinstance(stmt, DescribeStmt):
+        if isinstance(stmt.target, SelectStmt):
+            schema = _plan_select(stmt.target, bindings,
+                                  dict(stmt.target.ctes), session).schema
+        else:
+            name = stmt.target
+            table = sess.get_table(name) if sess else None
+            if table is None and name in bindings:
+                schema = bindings[name].schema
+            elif table is not None:
+                schema = table.schema()
+            else:
+                raise DaftValueError(f"Unknown table {name!r} for DESCRIBE")
+        return from_pydict({
+            "column_name": [f.name for f in schema],
+            "type": [repr(f.dtype) for f in schema],
+        })
+    if isinstance(stmt, SetStmt):
+        # Engine-config keys apply live; anything else lands in the
+        # session's variable store (reference: daft-sql session variables).
+        import dataclasses as _dc
+
+        from daft_tpu import context as _ctx
+        from daft_tpu.context import get_context
+
+        key = stmt.name.lower()
+        exec_fields = {f.name for f in _dc.fields(type(get_context().execution_config))}
+        plan_fields = {f.name for f in _dc.fields(type(get_context().planning_config))}
+        if key in exec_fields:
+            _ctx.set_execution_config(**{key: stmt.value})
+        elif key in plan_fields:
+            _ctx.set_planning_config(**{key: stmt.value})
+        else:
+            sess.set_variable(key, stmt.value)
+        return from_pydict({"name": [key], "value": [str(stmt.value)]})
     raise DaftValueError(f"Unsupported SQL statement {type(stmt).__name__}")
 
 

@@ -600,3 +600,42 @@ def test_sql_show_tables_like_sql_wildcards(people):
     s.sql("CREATE TABLE barcat AS SELECT 2 AS y")
     out = s.sql("SHOW TABLES LIKE 'bar%'").to_pydict()
     assert out["table"] == ["barcat"]
+
+
+def test_sql_use_describe_set(make_df):
+    """USE / DESCRIBE / SET statements (reference: daft-sql statement.rs)."""
+    import daft_tpu
+    from daft_tpu.catalog import Catalog
+    from daft_tpu.session import current_session
+
+    sess = current_session()
+    cat = Catalog.from_pydict({"t": {"a": [1, 2], "s": ["x", "y"]}}, name="cat2")
+    sess.attach(cat, "cat2")
+    try:
+        out = daft_tpu.sql("USE cat2").to_pydict()
+        assert out["catalog"] == ["cat2"]
+        assert sess._current_catalog == "cat2"
+
+        d = daft_tpu.sql("DESCRIBE t").to_pydict()
+        assert d["column_name"] == ["a", "s"]
+        assert "Int" in d["type"][0]
+
+        d2 = daft_tpu.sql("DESCRIBE SELECT a + 1 AS b FROM t").to_pydict()
+        assert d2["column_name"] == ["b"]
+
+        # engine-config key applies live and restores after
+        from daft_tpu.context import get_context
+
+        old = get_context().execution_config.default_morsel_size
+        try:
+            daft_tpu.sql("SET default_morsel_size = 4096")
+            assert get_context().execution_config.default_morsel_size == 4096
+        finally:
+            daft_tpu.sql(f"SET default_morsel_size = {old}")
+
+        # unknown keys land in the session variable store
+        daft_tpu.sql("SET my_var = 'hello'")
+        assert sess.get_variable("my_var") == "hello"
+    finally:
+        daft_tpu.sql("USE default")
+        sess.detach_catalog("cat2")
