@@ -546,13 +546,23 @@ class Executor:
                     state.partial_batches()  # flush raw + merge in place
             yield MicroPartition(node.schema, [state.finalize()])
             return
-        # Grace aggregation: whenever the merged partial state outgrows the
-        # budget, hash-partition it by group key into disk buckets; each
-        # bucket is then merged + finalized independently (keys of one group
-        # land in exactly one bucket, so per-bucket finalize is exact).
+        yield from self._grace_grouped_agg(
+            self._run(node.children[0]), fresh_state, budget, node.schema,
+            ingest=lambda st, mp: st.accumulate(mp))
+
+    def _grace_grouped_agg(self, items, fresh_state, budget, schema,
+                           ingest) -> Iterator[MicroPartition]:
+        """Grace aggregation: whenever the merged partial state outgrows the
+        budget, hash-partition it by group key into disk buckets; each
+        bucket is then merged + finalized independently (keys of one group
+        land in exactly one bucket, so per-bucket finalize is exact).
+        ``ingest`` feeds one input item into the state — raw morsels for the
+        single-phase Aggregate, partial batches for the distributed
+        AggregateFinal."""
         from daft_tpu.execution.spill import GracePartitioner, budget_reservation
 
-        key_names = [g.name() for g in node.group_by]
+        state: AggState = fresh_state()
+        key_names = state.plan.key_names
         grace: Optional[GracePartitioner] = None
 
         def spill_state(st: AggState) -> None:
@@ -566,13 +576,13 @@ class Executor:
                 grace.add(partial)
 
         with budget_reservation(self.memory, budget):
-            for mp in self._run(node.children[0]):
-                state.accumulate(mp)
+            for item in items:
+                ingest(state, item)
                 if state.approx_size_bytes() > budget:
                     spill_state(state)
                     state = fresh_state()
             if grace is None:
-                yield MicroPartition(node.schema, [state.finalize()])
+                yield MicroPartition(schema, [state.finalize()])
                 return
             spill_state(state)
             grace.finish()
@@ -593,21 +603,57 @@ class Executor:
                     continue
                 out = bstate.finalize()
                 if len(out):
-                    yield MicroPartition(node.schema, [out])
+                    yield MicroPartition(schema, [out])
 
     def _run_AggregatePartial(self, node: pp.AggregatePartial) -> Iterator[MicroPartition]:
         state: AggState = node.two_phase() if callable(node.two_phase) else node.two_phase
+        budget = self._sink_budget()
+        emitted = False
         for mp in self._run(node.children[0]):
             state.accumulate(mp)
+            if budget is not None and callable(node.two_phase) \
+                    and state.approx_size_bytes() > budget:
+                # First COMPRESS in place: raw morsel buffers merge into one
+                # partial batch (bounded by group count, not input rows).
+                state.partial_batches()
+                if state.approx_size_bytes() <= budget:
+                    continue
+                # Still over budget = genuinely high-cardinality groups:
+                # EMIT-early instead of spilling — partial batches are
+                # mergeable downstream (the final stage re-aggregates).
+                batches = state.partial_batches()
+                if batches:
+                    emitted = True
+                    yield MicroPartition(node.schema, batches)
+                state = node.two_phase()
         batches = state.partial_batches()
-        yield MicroPartition(node.schema, batches or [RecordBatch.empty(node.schema)])
+        if batches or not emitted:
+            yield MicroPartition(node.schema,
+                                 batches or [RecordBatch.empty(node.schema)])
 
     def _run_AggregateFinal(self, node: pp.AggregateFinal) -> Iterator[MicroPartition]:
-        state: AggState = node.two_phase() if callable(node.two_phase) else node.two_phase
-        for mp in self._run(node.children[0]):
-            for rb in mp.record_batches():
-                state.accumulate_partial(rb)
-        yield MicroPartition(node.schema, [state.finalize()])
+        make = node.two_phase if callable(node.two_phase) \
+            else (lambda: node.two_phase)
+        budget = self._sink_budget()
+        probe: AggState = make()
+        # Emit-early partials upstream + shuffle-map concat mean a received
+        # batch CAN repeat a group key within itself — always force a merge
+        # pass before finalize (accumulate_unmerged_partial).
+        if budget is None or not probe.plan.group_by or not callable(node.two_phase):
+            state = probe
+            for mp in self._run(node.children[0]):
+                for rb in mp.record_batches():
+                    state.accumulate_unmerged_partial(rb)
+            yield MicroPartition(node.schema, [state.finalize()])
+            return
+
+        def rb_stream():
+            for mp in self._run(node.children[0]):
+                yield from mp.record_batches()
+
+        yield from self._grace_grouped_agg(
+            rb_stream(), make, budget, node.schema,
+            ingest=lambda st, rb: st.accumulate_unmerged_partial(rb))
 
     def _run_SortSample(self, node: pp.SortSample) -> Iterator[MicroPartition]:
         combined = self._collect(node.children[0]).combined()
@@ -689,8 +735,78 @@ class Executor:
     def _run_Window(self, node: pp.Window) -> Iterator[MicroPartition]:
         from daft_tpu.execution.window_eval import eval_windows
 
-        combined = self._collect(node.children[0]).combined()
-        yield MicroPartition(node.schema, [eval_windows(combined, node.window_exprs, node.schema)])
+        budget = self._sink_budget()
+        part_keys = self._common_window_partition_keys(node.window_exprs)
+        if budget is None or part_keys is None:
+            # Unpartitioned windows (or no memory limit) need the whole
+            # input in one batch.
+            combined = self._collect(node.children[0]).combined()
+            yield MicroPartition(node.schema,
+                                 [eval_windows(combined, node.window_exprs,
+                                               node.schema)])
+            return
+        # Grace windows: every window spec partitions by the same keys, so
+        # rows of one window-partition land in one disk bucket and each
+        # bucket evaluates independently (row order across buckets is
+        # unspecified, as everywhere else in the engine outside Sort).
+        from daft_tpu.execution.spill import GracePartitioner, budget_reservation
+
+        with budget_reservation(self.memory, budget):
+            grace: Optional[GracePartitioner] = None
+            buffer: List[RecordBatch] = []
+            buf_bytes = 0
+            for mp in self._run(node.children[0]):
+                rb = mp.combined()
+                buffer.append(rb)
+                buf_bytes += rb.size_bytes()
+                if grace is None and buf_bytes > budget:
+                    grace = GracePartitioner(
+                        lambda b: [evaluate(k, b) for k in part_keys],
+                        num_buckets=self.GRACE_BUCKETS, spill=self._spill(),
+                        total_buffer_bytes=budget)
+                if grace is not None:
+                    for b in buffer:
+                        grace.add(b)
+                    buffer, buf_bytes = [], 0
+            if grace is None:
+                if not buffer:
+                    yield MicroPartition.empty(node.schema)
+                    return
+                combined = RecordBatch.concat(buffer)
+                yield MicroPartition(node.schema,
+                                     [eval_windows(combined, node.window_exprs,
+                                                   node.schema)])
+                return
+            grace.finish()
+            for b in range(grace.num_buckets):
+                batches = list(grace.stream_bucket(b))
+                if not batches:
+                    continue
+                combined = RecordBatch.concat(batches)
+                yield MicroPartition(node.schema,
+                                     [eval_windows(combined, node.window_exprs,
+                                                   node.schema)])
+
+    @staticmethod
+    def _common_window_partition_keys(window_exprs):
+        """The shared partition_by exprs when EVERY window spec in the
+        projection partitions by the same non-empty key set; None otherwise
+        (those windows are global and cannot bucket)."""
+        from daft_tpu.expressions.expr import WindowExpr
+
+        common_key = None
+        keys = None
+        for e in window_exprs:
+            for n in e.walk():
+                if isinstance(n, WindowExpr):
+                    if not n.partition_by:
+                        return None
+                    k = frozenset(p.key() for p in n.partition_by)
+                    if common_key is None:
+                        common_key, keys = k, list(n.partition_by)
+                    elif k != common_key:
+                        return None
+        return keys
 
     # -- joins ------------------------------------------------------------
     GRACE_BUCKETS = 32

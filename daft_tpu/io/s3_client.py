@@ -228,15 +228,19 @@ class S3FileSystemHandler(pafs.FileSystemHandler):
         client = self.client
 
         class _Out(io.BytesIO):
-            # close() uploads what was written — matching the local-filesystem
-            # backend, where a writer failing mid-write also leaves the
-            # partial file on disk (cleanup is the writer's job on all
-            # backends). Double-close (PythonFile.close then GC __del__)
-            # must not re-upload.
+            # Upload exactly once, and NEVER from a close() running during
+            # exception unwind (a failed serializer GC-closing its stream
+            # must not publish a truncated object as a live key). The
+            # trade-off: a deliberate write inside an unrelated `except`
+            # block also skips — that write raises nothing but uploads
+            # nothing; corrupt-object publication is the worse failure.
             _uploaded = False
 
             def close(self):
-                if not self._uploaded and not self.closed:
+                import sys
+
+                if not self._uploaded and not self.closed \
+                        and sys.exc_info()[0] is None:
                     self._uploaded = True
                     client.put_object(bucket, key, self.getvalue())
                 super().close()

@@ -46,8 +46,15 @@ def resolve_credentials(s3_config=None) -> Optional[AwsCredentials]:
                                   getattr(s3_config, "session_token", None))
     key = os.environ.get("AWS_ACCESS_KEY_ID")
     if key:
-        return AwsCredentials(key, os.environ.get("AWS_SECRET_ACCESS_KEY", ""),
-                              os.environ.get("AWS_SESSION_TOKEN"))
+        secret = os.environ.get("AWS_SECRET_ACCESS_KEY")
+        if not secret:
+            from daft_tpu.errors import DaftValueError
+
+            raise DaftValueError(
+                "AWS_ACCESS_KEY_ID is set without AWS_SECRET_ACCESS_KEY — "
+                "signing with an empty secret would fail every request with "
+                "SignatureDoesNotMatch")
+        return AwsCredentials(key, secret, os.environ.get("AWS_SESSION_TOKEN"))
     return None
 
 

@@ -104,6 +104,12 @@ class Session:
                 return cat.get_table(tbl)
             return None
         cat = self.current_catalog
+        if self._current_namespace:
+            # USE catalog.namespace: unqualified names resolve inside the
+            # current namespace first (reference: session namespace scoping).
+            qualified = f"{self._current_namespace}.{name}"
+            if cat.has_table(qualified):
+                return cat.get_table(qualified)
         if cat.has_table(name):
             return cat.get_table(name)
         return None

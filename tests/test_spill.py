@@ -8,6 +8,8 @@ answer matches the unlimited in-memory run, and asserts spill actually
 happened (spill_metrics counters).
 """
 
+import os
+
 import numpy as np
 import pytest
 
@@ -183,10 +185,42 @@ def test_grace_agg_many_spill_events_few_keys(make_df):
     spill_metrics.reset()
     with memory_limit(LIMIT), daft_tpu.execution_config_ctx(default_morsel_size=4096):
         actual = q().to_pydict()
-    assert spill_metrics.snapshot()["spills"] > 1  # multiple spill events
+    if os.environ.get("DAFT_RUNNER", "native") == "native":
+        # The distributed runner's two-phase agg stays bounded by EMITTING
+        # partial batches early instead of spilling (no disk involved).
+        assert spill_metrics.snapshot()["spills"] > 1  # multiple spill events
     assert actual["k"] == list(range(8))
     assert actual["s"] == [12500] * 8
     assert actual["c"] == [12500] * 8
+
+
+def test_grace_window_spills(make_df):
+    """Partitioned window functions bucket by their partition keys under a
+    memory limit; unpartitioned specs keep the in-memory path."""
+    rng = np.random.default_rng(23)
+    n = 60_000
+    df = make_df({
+        "k": rng.integers(0, 3_000, n).tolist(),
+        "v": rng.standard_normal(n).tolist(),
+    })
+    from daft_tpu import Window
+    from daft_tpu.functions import rank
+
+    w = Window().partition_by("k").order_by("v")
+
+    def q():
+        return (df.with_column("rn", rank().over(w))
+                .with_column("s", col("v").sum().over(Window().partition_by("k")))
+                .sort(["k", "v"]))
+
+    expected = q().to_pydict()
+    spill_metrics.reset()
+    with memory_limit(LIMIT):
+        actual = q().to_pydict()
+    assert actual["k"] == expected["k"]
+    assert actual["rn"] == expected["rn"]
+    np.testing.assert_allclose(actual["s"], expected["s"], rtol=1e-9)
+    assert spill_metrics.snapshot()["spills"] > 0
 
 
 def test_no_spill_without_limit(big_df):
@@ -227,4 +261,5 @@ def test_tpch_style_query_under_memory_pressure(make_df):
     assert actual["status"] == expected["status"]
     np.testing.assert_allclose(actual["sum_rev"], expected["sum_rev"], rtol=1e-9)
     assert actual["cnt"] == expected["cnt"]
-    assert spill_metrics.snapshot()["spills"] > 0
+    if os.environ.get("DAFT_RUNNER", "native") == "native":
+        assert spill_metrics.snapshot()["spills"] > 0

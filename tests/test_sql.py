@@ -639,3 +639,21 @@ def test_sql_use_describe_set(make_df):
     finally:
         daft_tpu.sql("USE default")
         sess.detach_catalog("cat2")
+
+
+def test_use_namespace_scopes_table_resolution():
+    """USE catalog.namespace: unqualified names resolve inside the
+    namespace (regression: the namespace part used to be a silent no-op)."""
+    import daft_tpu
+    from daft_tpu.catalog import Catalog
+    from daft_tpu.session import current_session
+
+    sess = current_session()
+    cat = Catalog.from_pydict({"ns.t": {"a": [5]}}, name="cat3")
+    sess.attach(cat, "cat3")
+    try:
+        daft_tpu.sql("USE cat3.ns")
+        assert daft_tpu.sql("SELECT a FROM t").to_pydict() == {"a": [5]}
+    finally:
+        daft_tpu.sql("USE default")
+        sess.detach_catalog("cat3")
