@@ -606,30 +606,39 @@ class Executor:
                     yield MicroPartition(schema, [out])
 
     def _run_AggregatePartial(self, node: pp.AggregatePartial) -> Iterator[MicroPartition]:
+        import contextlib
+
+        from daft_tpu.execution.spill import budget_reservation
+
         state: AggState = node.two_phase() if callable(node.two_phase) else node.two_phase
         budget = self._sink_budget()
-        emitted = False
-        for mp in self._run(node.children[0]):
-            state.accumulate(mp)
-            if budget is not None and callable(node.two_phase) \
-                    and state.approx_size_bytes() > budget:
-                # First COMPRESS in place: raw morsel buffers merge into one
-                # partial batch (bounded by group count, not input rows).
-                state.partial_batches()
-                if state.approx_size_bytes() <= budget:
-                    continue
-                # Still over budget = genuinely high-cardinality groups:
-                # EMIT-early instead of spilling — partial batches are
-                # mergeable downstream (the final stage re-aggregates).
-                batches = state.partial_batches()
-                if batches:
-                    emitted = True
-                    yield MicroPartition(node.schema, batches)
-                state = node.two_phase()
-        batches = state.partial_batches()
-        if batches or not emitted:
-            yield MicroPartition(node.schema,
-                                 batches or [RecordBatch.empty(node.schema)])
+        with budget_reservation(self.memory, budget) if budget is not None \
+                else contextlib.nullcontext():
+            emitted = False
+            for mp in self._run(node.children[0]):
+                state.accumulate(mp)
+                if budget is not None and callable(node.two_phase) \
+                        and state.approx_size_bytes() > budget:
+                    # First COMPRESS in place: raw morsel buffers merge into
+                    # one partial batch (bounded by group count, not rows).
+                    state.partial_batches()
+                    # Hysteresis: only keep the compressed state when it
+                    # leaves real headroom — a state hovering just under
+                    # budget would otherwise re-merge per morsel (O(groups)
+                    # work each time). Near-budget state EMITS early instead:
+                    # partial batches are mergeable downstream, the final
+                    # stage re-aggregates.
+                    if state.approx_size_bytes() <= budget // 2:
+                        continue
+                    batches = state.partial_batches()
+                    if batches:
+                        emitted = True
+                        yield MicroPartition(node.schema, batches)
+                    state = node.two_phase()
+            batches = state.partial_batches()
+            if batches or not emitted:
+                yield MicroPartition(node.schema,
+                                     batches or [RecordBatch.empty(node.schema)])
 
     def _run_AggregateFinal(self, node: pp.AggregateFinal) -> Iterator[MicroPartition]:
         make = node.two_phase if callable(node.two_phase) \
@@ -779,6 +788,10 @@ class Executor:
                 return
             grace.finish()
             for b in range(grace.num_buckets):
+                # Window evaluation needs each window-partition whole, so one
+                # BUCKET (~input/32, or a skew-hot partition key) must fit in
+                # memory — the same single-level-grace bound as right/outer
+                # joins; 32x better than the pre-spill full materialization.
                 batches = list(grace.stream_bucket(b))
                 if not batches:
                     continue

@@ -230,19 +230,26 @@ class S3FileSystemHandler(pafs.FileSystemHandler):
         class _Out(io.BytesIO):
             # Upload exactly once, and NEVER from a close() running during
             # exception unwind (a failed serializer GC-closing its stream
-            # must not publish a truncated object as a live key). The
-            # trade-off: a deliberate write inside an unrelated `except`
-            # block also skips — that write raises nothing but uploads
-            # nothing; corrupt-object publication is the worse failure.
+            # must not publish a truncated object as a live key). The abort
+            # RAISES rather than silently skipping, so a deliberate write
+            # inside an unrelated `except` block surfaces as an error
+            # instead of undetectable data loss; a GC-driven close during
+            # unwind has the raise swallowed by __del__, which is fine —
+            # the original error is already propagating.
             _uploaded = False
 
             def close(self):
                 import sys
 
-                if not self._uploaded and not self.closed \
-                        and sys.exc_info()[0] is None:
-                    self._uploaded = True
-                    client.put_object(bucket, key, self.getvalue())
+                if self._uploaded or self.closed:
+                    return
+                if sys.exc_info()[0] is not None:
+                    super().close()
+                    raise DaftIOError(
+                        f"aborted s3 upload of {bucket}/{key}: stream closed "
+                        f"during exception unwind; object not written")
+                self._uploaded = True
+                client.put_object(bucket, key, self.getvalue())
                 super().close()
 
         return pa.PythonFile(_Out(), mode="w")

@@ -92,7 +92,22 @@ class Session:
         if "." in name:
             cat_name, tbl = name.split(".", 1)
             return self._catalogs[cat_name].create_table(tbl, source)
+        if self._current_namespace:
+            # USE catalog.namespace: unqualified creates land IN the
+            # namespace, so a following unqualified read finds them.
+            name = f"{self._current_namespace}.{name}"
         return self.current_catalog.create_table(name, source)
+
+    def _resolve_in_current(self, name: str) -> str:
+        """Namespace-scope an unqualified name against the current catalog:
+        after USE catalog.namespace, ``t`` means ``namespace.t`` when that
+        exists (reads/drops) — used by every entry point so reads and
+        writes of the same unqualified name target the same table."""
+        if self._current_namespace:
+            qualified = f"{self._current_namespace}.{name}"
+            if self.current_catalog.has_table(qualified):
+                return qualified
+        return name
 
     def get_table(self, name: str) -> Optional[Table]:
         if name in self._temp_tables:
@@ -104,18 +119,17 @@ class Session:
                 return cat.get_table(tbl)
             return None
         cat = self.current_catalog
-        if self._current_namespace:
-            # USE catalog.namespace: unqualified names resolve inside the
-            # current namespace first (reference: session namespace scoping).
-            qualified = f"{self._current_namespace}.{name}"
-            if cat.has_table(qualified):
-                return cat.get_table(qualified)
-        if cat.has_table(name):
-            return cat.get_table(name)
+        resolved = self._resolve_in_current(name)
+        if cat.has_table(resolved):
+            return cat.get_table(resolved)
         return None
 
     def list_tables(self, pattern: Optional[str] = None) -> List[str]:
         names = sorted(self._temp_tables) + self.current_catalog.list_tables(pattern)
+        if self._current_namespace:
+            prefix = self._current_namespace + "."
+            scoped = [n for n in names if n.startswith(prefix) or "." not in n]
+            return scoped
         return names
 
     def drop_table(self, name: str) -> None:
@@ -129,7 +143,7 @@ class Session:
             if cat is not None and cat.has_table(tbl):
                 cat.drop_table(tbl)
                 return
-        self.current_catalog.drop_table(name)
+        self.current_catalog.drop_table(self._resolve_in_current(name))
 
     # -- sql --------------------------------------------------------------
     def sql(self, query: str, **bindings):

@@ -18,12 +18,25 @@ document.querySelectorAll("nav button").forEach((b) =>
 
 async function getJSON(url) { return (await fetch(url)).json(); }
 
+function fmtBytes(n) {
+  if (n == null) return "0";
+  const u = ["B", "KB", "MB", "GB", "TB"];
+  let i = 0;
+  while (n >= 1024 && i < u.length - 1) { n /= 1024; i++; }
+  return (i ? n.toFixed(1) : n) + " " + u[i];
+}
+
 async function renderSummary() {
   const e = await getJSON("/api/engine");
   $("#summary").innerHTML = [
     ["queries", e.queries_total], ["running", e.queries_running],
     ["failed", e.queries_failed], ["tasks", e.tasks_total],
     ["rows", e.rows_processed],
+    ["spilled", fmtBytes(e.spill_bytes)],
+    ["fused exprs", e.device_fused_exprs],
+    ["device fallbacks", e.device_fallbacks],
+    ["io read", fmtBytes(e.io_bytes_read)],
+    ["files pruned", e.io_files_pruned],
   ].map(([l, n]) =>
     `<div class="tile"><div class="n">${n}</div><div class="l">${l}</div></div>`
   ).join("");

@@ -213,7 +213,16 @@ class DashboardState:
             return out
 
     def engine_summary(self) -> dict:
-        """Live engine state (reference: daft-dashboard engine.rs state)."""
+        """Live engine state (reference: daft-dashboard engine.rs state),
+        plus process-wide health counters: out-of-core spill volume,
+        device-eval fusion coverage, and IO stats."""
+        from daft_tpu.execution.spill import spill_metrics
+        from daft_tpu.io.iostats import io_stats
+        from daft_tpu.ops.device_eval import device_eval_metrics
+
+        sp = spill_metrics.snapshot()
+        dev = device_eval_metrics.snapshot()
+        io = io_stats()
         with self._lock:
             running = [q for q in self.queries.values() if q["status"] == "running"]
             return {
@@ -225,6 +234,14 @@ class DashboardState:
                 "rows_processed": sum(
                     op["rows_out"] for q in self.queries.values()
                     for op in q["operators"].values()),
+                "spill_bytes": sp["bytes_spilled"],
+                "spill_files": sp["files"],
+                "device_fused_exprs": dev["fused_exprs"],
+                "device_fused_rows": dev["fused_rows"],
+                "device_fallbacks": sum(dev["fallback_reasons"].values()),
+                "io_bytes_read": io.bytes_read,
+                "io_files_opened": io.files_opened,
+                "io_files_pruned": io.files_pruned,
             }
 
 

@@ -657,3 +657,25 @@ def test_use_namespace_scopes_table_resolution():
     finally:
         daft_tpu.sql("USE default")
         sess.detach_catalog("cat3")
+
+
+def test_use_namespace_create_drop_coherence():
+    """CREATE/DROP/SELECT of the same unqualified name target the same
+    namespaced table after USE catalog.namespace."""
+    import daft_tpu
+    from daft_tpu.catalog import Catalog
+    from daft_tpu.session import current_session
+
+    sess = current_session()
+    cat = Catalog.from_pydict({}, name="cat4")
+    sess.attach(cat, "cat4")
+    try:
+        daft_tpu.sql("USE cat4.ns")
+        daft_tpu.sql("CREATE TABLE t AS SELECT 1 AS a")
+        assert daft_tpu.sql("SELECT a FROM t").to_pydict() == {"a": [1]}
+        assert cat.has_table("ns.t") and not cat.has_table("t")
+        daft_tpu.sql("DROP TABLE t")
+        assert not cat.has_table("ns.t")
+    finally:
+        daft_tpu.sql("USE default")
+        sess.detach_catalog("cat4")
