@@ -24,7 +24,7 @@ import contextvars
 import queue
 import threading
 from concurrent.futures import Future, ThreadPoolExecutor
-from typing import Iterator, List, Optional
+from typing import Dict, Iterator, List, Optional
 
 import numpy as np
 
@@ -901,16 +901,21 @@ class Executor:
 
         lschema0, rschema0 = node.children[0].schema, node.children[1].schema
         key_dtypes = []
+        # name -> unified key dtype: the ONE source the bucket hashing,
+        # null-column upcasts, and hash_join key casts all agree on.
+        key_cast: Dict[str, object] = {}
         for le, re in zip(node.left_on, node.right_on):
             lt, rt = le.to_field(lschema0).dtype, re.to_field(rschema0).dtype
-            try:
-                key_dtypes.append(unify_dtypes(lt, rt) if lt != rt else None)
-            except Exception:
-                key_dtypes.append(None)
+            unified = unify_dtypes(lt, rt) if lt != rt else lt
+            key_dtypes.append(unified if lt != rt else None)
+            key_cast[le.name()] = unified
+            key_cast[re.name()] = unified
         right_state, right_side = self._collect_or_grace(
             node.children[1], node.right_on, budget, key_dtypes)
         if right_state == "mem" and node.how not in ("right", "outer"):
-            right = right_side.combined()
+            # Cast null-dtype build columns ONCE, not per probe morsel.
+            right = self._cast_null_cols_for_join(right_side.combined(), node,
+                                                  key_cast)
             right_keys = [evaluate(e, right) for e in node.right_on]
 
             # Stream the probe (left) side morsel-by-morsel against the built
@@ -918,7 +923,8 @@ class Executor:
             def probe(mp: MicroPartition) -> MicroPartition:
                 left = mp.combined()
                 left_keys = [evaluate(e, left) for e in node.left_on]
-                out = self._join_and_fix(left, right, left_keys, right_keys, node)
+                out = self._join_and_fix(left, right, left_keys, right_keys,
+                                         node, key_cast)
                 return MicroPartition(node.schema, [out])
 
             yield from self._streaming_map(node.children[0], probe)
@@ -932,7 +938,8 @@ class Executor:
             left_keys = [evaluate(e, left) for e in node.left_on]
             right_keys = [evaluate(e, right) for e in node.right_on]
             yield MicroPartition(node.schema, [
-                self._join_and_fix(left, right, left_keys, right_keys, node)
+                self._join_and_fix(left, right, left_keys, right_keys, node,
+                                   key_cast)
             ])
             return
         # Grace hash join: equal keys hash to the same bucket on both sides,
@@ -961,7 +968,7 @@ class Executor:
                         continue
                     left_keys = [evaluate(e, left) for e in node.left_on]
                     out = self._join_and_fix(left, right, left_keys,
-                                             right_keys, node)
+                                             right_keys, node, key_cast)
                     if len(out):
                         yield MicroPartition(node.schema, [out])
                 continue
@@ -975,20 +982,57 @@ class Executor:
                 continue
             left_keys = [evaluate(e, left) for e in node.left_on]
             right_keys = [evaluate(e, right) for e in node.right_on]
-            out = self._join_and_fix(left, right, left_keys, right_keys, node)
+            out = self._join_and_fix(left, right, left_keys, right_keys, node,
+                                     key_cast)
             if len(out):
                 yield MicroPartition(node.schema, [out])
 
     @staticmethod
     def _conform_to_schema(rb: RecordBatch, schema: Schema) -> RecordBatch:
         """Reorder/cast columns to the planned output schema."""
+        import pyarrow as pa
+
         cols = []
         for f in schema:
             c = rb.get_column(f.name)
-            cols.append(c.cast(f.dtype) if c.dtype != f.dtype else c)
+            if c.dtype != f.dtype:
+                if f.dtype.is_null() and c.to_arrow().null_count == len(rb):
+                    # A null-planned column whose runtime values ARE all null
+                    # (e.g. the upcast key of a semi join on an all-None
+                    # column) substitutes cleanly; arrow has no cast INTO
+                    # null. Real values against a null plan still fail loud.
+                    c = Series.from_arrow(pa.nulls(len(rb)), f.name, f.dtype)
+                else:
+                    c = c.cast(f.dtype)
+            cols.append(c)
         return RecordBatch(schema, cols, len(rb))
 
-    def _join_and_fix(self, left, right, left_keys, right_keys, node) -> RecordBatch:
+    def _cast_null_cols_for_join(self, rb: RecordBatch, node,
+                                 key_cast) -> RecordBatch:
+        """Acero rejects null-dtype payload fields; an all-None column (e.g.
+        a from_pydict key of Nones) casts up: key-named columns to the
+        dtype unified against the OTHER side's key (the map
+        _hash_join_impl computed once), anything else to its planned
+        output dtype when resolvable."""
+        if not any(c.dtype.is_null() for c in rb.columns()):
+            return rb
+        cols = []
+        for c in rb.columns():
+            if c.dtype.is_null():
+                target = key_cast.get(c.name)
+                if target is None:
+                    f = node.schema.get(c.name)
+                    target = f.dtype if f is not None else None
+                if target is not None and not target.is_null():
+                    c = c.cast(target)
+            cols.append(c)
+        return RecordBatch(Schema([Field(c.name, c.dtype) for c in cols]),
+                           cols, len(rb))
+
+    def _join_and_fix(self, left, right, left_keys, right_keys, node,
+                      key_cast=None) -> RecordBatch:
+        left = self._cast_null_cols_for_join(left, node, key_cast or {})
+        right = self._cast_null_cols_for_join(right, node, key_cast or {})
         merged = sorted(node.merged_keys) if node.merged_keys and node.how not in ("semi", "anti") else []
         # For right/outer joins, right-only output rows have null values in
         # the left copy of a merged key — carry the right copy through the

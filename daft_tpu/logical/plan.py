@@ -470,6 +470,15 @@ class Join(LogicalPlan):
                 r.name() for l, r in zip(self.left_on, self.right_on)
                 if isinstance(l, ColumnRef) and isinstance(r, ColumnRef) and l.name_ == r.name_
             } if how != "cross" else set()
+            # A merged key's output dtype unifies both sides (an all-null
+            # left key against an int64 right key resolves int64, not null —
+            # the execution-time join casts keys the same way).
+            if merged:
+                right_types = {f.name: f.dtype for f in right.schema}
+                for i, f in enumerate(fields):
+                    if f.name in merged and f.name in right_types:
+                        fields[i] = Field(f.name, unify_dtypes(
+                            f.dtype, right_types[f.name]))
             left_names = set(left.schema.column_names())
             for f in right.schema:
                 if f.name in merged:

