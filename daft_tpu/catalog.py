@@ -340,7 +340,24 @@ Catalog.from_pydict = staticmethod(_from_pydict)
 Catalog.from_iceberg = staticmethod(_from_iceberg)
 Catalog.from_unity = staticmethod(_from_unity)
 Catalog.from_glue = staticmethod(_from_glue)
+def _from_gravitino(uri_or_config, metalake: Optional[str] = None, **kwargs) -> "Catalog":
+    """Apache Gravitino over its metalake REST API — accepts a URI +
+    metalake or a GravitinoConfig (reference: daft/catalog gravitino)."""
+    from daft_tpu.cloud_catalogs import GravitinoCatalog
+    from daft_tpu.io.config import GravitinoConfig
+
+    if isinstance(uri_or_config, GravitinoConfig):
+        if not uri_or_config.uri or not uri_or_config.metalake:
+            raise DaftValueError(
+                "from_gravitino: GravitinoConfig.uri and .metalake are required")
+        return GravitinoCatalog(uri_or_config.uri, uri_or_config.metalake,
+                                auth_token=uri_or_config.auth_token, **kwargs)
+    if isinstance(uri_or_config, str) and uri_or_config and metalake:
+        return GravitinoCatalog(uri_or_config, metalake, **kwargs)
+    raise DaftValueError("from_gravitino takes (uri, metalake) or a GravitinoConfig")
+
+
 Catalog.from_s3tables = staticmethod(_from_s3tables)
-Catalog.from_gravitino = staticmethod(lambda *a, **k: _gated_catalog("gravitino", "gravitino"))
+Catalog.from_gravitino = staticmethod(_from_gravitino)
 Catalog.from_paimon = staticmethod(lambda *a, **k: _gated_catalog("paimon", "pypaimon"))
 Catalog.from_postgres = staticmethod(lambda *a, **k: _gated_catalog("postgres", "psycopg2"))

@@ -20,6 +20,15 @@ from daft_tpu.errors import DaftIOError, DaftValueError
 from daft_tpu.rest_catalog import UrllibJsonTransport
 
 
+def _filter_names(names, pattern):
+    """Shared catalog list filter (server order preserved)."""
+    if not pattern:
+        return names
+    import fnmatch
+
+    return [n for n in names if fnmatch.fnmatch(n, pattern)]
+
+
 class _LocationTable(Table):
     """A table at a storage location in a given format."""
 
@@ -167,11 +176,7 @@ class UnityCatalog(Catalog):
             token = resp.get("next_page_token")
             if not token:
                 break
-        if pattern:
-            import fnmatch
-
-            out = [t for t in out if fnmatch.fnmatch(t, pattern)]
-        return out
+        return _filter_names(out, pattern)
 
     def get_table(self, name: str) -> Table:
         resp = self._req("GET", f"/tables/{self._full(name)}")
@@ -198,6 +203,69 @@ class UnityCatalog(Catalog):
 
     def drop_table(self, name: str) -> None:
         self._req("DELETE", f"/tables/{self._full(name)}")
+
+
+# --------------------------------------------------------------------------- #
+# Apache Gravitino (REST, bearer/none auth)                                   #
+# --------------------------------------------------------------------------- #
+class GravitinoCatalog(Catalog):
+    """Apache Gravitino metalake REST API (reference: daft/catalog
+    gravitino binding via its SDK; wire shape api/metalakes/...)."""
+
+    def __init__(self, uri: str, metalake: str, catalog: str = "catalog",
+                 schema: str = "default", auth_token: Optional[str] = None,
+                 transport=None, name: str = "gravitino"):
+        self.name = name
+        self.uri = uri.rstrip("/")
+        self.metalake = metalake
+        self.catalog = catalog
+        self.schema = schema
+        self.token = auth_token
+        self.transport = transport or UrllibJsonTransport()
+
+    def _base(self) -> str:
+        return (f"{self.uri}/api/metalakes/{self.metalake}/catalogs/"
+                f"{self.catalog}/schemas/{self.schema}/tables")
+
+    def _req(self, method: str, path: str = "", body: Optional[dict] = None) -> dict:
+        headers = {"Accept": "application/vnd.gravitino.v1+json"}
+        if self.token:
+            headers["Authorization"] = f"Bearer {self.token}"
+        return self.transport.request(method, self._base() + path, body=body,
+                                      headers=headers)
+
+    def list_tables(self, pattern: Optional[str] = None) -> List[str]:
+        resp = self._req("GET")
+        names = [i["name"] for i in resp.get("identifiers", [])]
+        return _filter_names(names, pattern)
+
+    def get_table(self, name: str) -> Table:
+        resp = self._req("GET", f"/{name}")
+        t = resp.get("table") or {}
+        props = t.get("properties") or {}
+        location = props.get("location")
+        if not location:
+            raise DaftIOError(f"Gravitino table {name!r} has no location property")
+        fmt = (props.get("format")
+               or ("iceberg" if t.get("provider") == "lakehouse-iceberg"
+                   else "parquet"))
+        return _LocationTable(name, location, fmt)
+
+    def create_table(self, name: str, source=None, location: Optional[str] = None,
+                     fmt: str = "parquet") -> Table:
+        if location is None:
+            raise DaftValueError("GravitinoCatalog.create_table requires location=")
+        self._req("POST", body={
+            "name": name, "columns": [],
+            "properties": {"location": location, "format": fmt},
+        })
+        table = _LocationTable(name, location, fmt)
+        if source is not None:
+            table.append(source)
+        return table
+
+    def drop_table(self, name: str) -> None:
+        self._req("DELETE", f"/{name}")
 
 
 # --------------------------------------------------------------------------- #

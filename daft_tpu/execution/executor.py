@@ -900,22 +900,16 @@ class Executor:
         from daft_tpu.datatype import unify_dtypes
 
         lschema0, rschema0 = node.children[0].schema, node.children[1].schema
-        key_dtypes = []
-        # name -> unified key dtype: the ONE source the bucket hashing,
-        # null-column upcasts, and hash_join key casts all agree on.
-        key_cast: Dict[str, object] = {}
-        for le, re in zip(node.left_on, node.right_on):
-            lt, rt = le.to_field(lschema0).dtype, re.to_field(rschema0).dtype
-            unified = unify_dtypes(lt, rt) if lt != rt else lt
-            key_dtypes.append(unified if lt != rt else None)
-            key_cast[le.name()] = unified
-            key_cast[re.name()] = unified
+        key_dtypes = [
+            unify_dtypes(lt, rt) if lt != rt else None
+            for lt, rt in ((le.to_field(lschema0).dtype,
+                            re.to_field(rschema0).dtype)
+                           for le, re in zip(node.left_on, node.right_on))
+        ]
         right_state, right_side = self._collect_or_grace(
             node.children[1], node.right_on, budget, key_dtypes)
         if right_state == "mem" and node.how not in ("right", "outer"):
-            # Cast null-dtype build columns ONCE, not per probe morsel.
-            right = self._cast_null_cols_for_join(right_side.combined(), node,
-                                                  key_cast)
+            right = right_side.combined()
             right_keys = [evaluate(e, right) for e in node.right_on]
 
             # Stream the probe (left) side morsel-by-morsel against the built
@@ -923,8 +917,7 @@ class Executor:
             def probe(mp: MicroPartition) -> MicroPartition:
                 left = mp.combined()
                 left_keys = [evaluate(e, left) for e in node.left_on]
-                out = self._join_and_fix(left, right, left_keys, right_keys,
-                                         node, key_cast)
+                out = self._join_and_fix(left, right, left_keys, right_keys, node)
                 return MicroPartition(node.schema, [out])
 
             yield from self._streaming_map(node.children[0], probe)
@@ -938,8 +931,7 @@ class Executor:
             left_keys = [evaluate(e, left) for e in node.left_on]
             right_keys = [evaluate(e, right) for e in node.right_on]
             yield MicroPartition(node.schema, [
-                self._join_and_fix(left, right, left_keys, right_keys, node,
-                                   key_cast)
+                self._join_and_fix(left, right, left_keys, right_keys, node)
             ])
             return
         # Grace hash join: equal keys hash to the same bucket on both sides,
@@ -968,7 +960,7 @@ class Executor:
                         continue
                     left_keys = [evaluate(e, left) for e in node.left_on]
                     out = self._join_and_fix(left, right, left_keys,
-                                             right_keys, node, key_cast)
+                                             right_keys, node)
                     if len(out):
                         yield MicroPartition(node.schema, [out])
                 continue
@@ -982,8 +974,7 @@ class Executor:
                 continue
             left_keys = [evaluate(e, left) for e in node.left_on]
             right_keys = [evaluate(e, right) for e in node.right_on]
-            out = self._join_and_fix(left, right, left_keys, right_keys, node,
-                                     key_cast)
+            out = self._join_and_fix(left, right, left_keys, right_keys, node)
             if len(out):
                 yield MicroPartition(node.schema, [out])
 
@@ -1007,32 +998,7 @@ class Executor:
             cols.append(c)
         return RecordBatch(schema, cols, len(rb))
 
-    def _cast_null_cols_for_join(self, rb: RecordBatch, node,
-                                 key_cast) -> RecordBatch:
-        """Acero rejects null-dtype payload fields; an all-None column (e.g.
-        a from_pydict key of Nones) casts up: key-named columns to the
-        dtype unified against the OTHER side's key (the map
-        _hash_join_impl computed once), anything else to its planned
-        output dtype when resolvable."""
-        if not any(c.dtype.is_null() for c in rb.columns()):
-            return rb
-        cols = []
-        for c in rb.columns():
-            if c.dtype.is_null():
-                target = key_cast.get(c.name)
-                if target is None:
-                    f = node.schema.get(c.name)
-                    target = f.dtype if f is not None else None
-                if target is not None and not target.is_null():
-                    c = c.cast(target)
-            cols.append(c)
-        return RecordBatch(Schema([Field(c.name, c.dtype) for c in cols]),
-                           cols, len(rb))
-
-    def _join_and_fix(self, left, right, left_keys, right_keys, node,
-                      key_cast=None) -> RecordBatch:
-        left = self._cast_null_cols_for_join(left, node, key_cast or {})
-        right = self._cast_null_cols_for_join(right, node, key_cast or {})
+    def _join_and_fix(self, left, right, left_keys, right_keys, node) -> RecordBatch:
         merged = sorted(node.merged_keys) if node.merged_keys and node.how not in ("semi", "anti") else []
         # For right/outer joins, right-only output rows have null values in
         # the left copy of a merged key — carry the right copy through the

@@ -319,16 +319,27 @@ class RecordBatch:
         # Build each side's table from data + key arrays in ONE construction:
         # a side whose data columns were all pruned away (e.g. count(*) over
         # a key-only join) has a zero-column/zero-row arrow table that
-        # append_column would reject.
-        commons = [unify_dtypes(lk.dtype, rk.dtype)
+        # append_column would reject. Acero supports NO null-dtype field —
+        # key or payload — so all-None columns ride as int8 all-null arrays
+        # (join semantics unchanged: null keys never match) and downstream
+        # schema conformance restores the planned dtype.
+        def widen_null(dt: DataType) -> DataType:
+            return DataType.int8() if dt.is_null() else dt
+
+        def arrow_col(c: Series):
+            if c.dtype.is_null():
+                return pa.nulls(len(c), pa.int8())
+            return c.to_arrow()
+
+        commons = [widen_null(unify_dtypes(lk.dtype, rk.dtype))
                    for lk, rk in zip(left_on, right_on)]
         lt = pa.table({
-            **{n: c.to_arrow() for n, c in zip(self.column_names(), self._columns)},
+            **{n: arrow_col(c) for n, c in zip(self.column_names(), self._columns)},
             **{lkeys[i]: left_on[i].cast(commons[i]).to_arrow()
                for i in range(len(left_on))},
         })
         rt = pa.table({
-            **{n: c.to_arrow() for n, c in zip(right.column_names(), right._columns)},
+            **{n: arrow_col(c) for n, c in zip(right.column_names(), right._columns)},
             **{rkeys[i]: right_on[i].cast(commons[i]).to_arrow()
                for i in range(len(right_on))},
         })

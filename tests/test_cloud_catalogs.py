@@ -175,6 +175,60 @@ def test_unity_from_config(parquet_location):
 
 
 # --------------------------------------------------------------------------- #
+# Gravitino                                                                   #
+# --------------------------------------------------------------------------- #
+def test_gravitino_catalog_roundtrip(parquet_location):
+    tables = {}
+
+    class H(_JsonHandler):
+        def _name(self):
+            return self.path.rstrip("/").rsplit("/", 1)[-1]
+
+        def do_GET(self):
+            assert self.headers.get("Authorization") == "Bearer gtok"
+            if self.path.endswith("/tables"):
+                return self._json(200, {"identifiers": [
+                    {"name": n} for n in sorted(tables)]})
+            name = self._name()
+            if name in tables:
+                return self._json(200, {"table": tables[name]})
+            return self._json(404, {"code": 1003})
+
+        def do_POST(self):
+            body = self._body()
+            tables[body["name"]] = {"name": body["name"],
+                                    "properties": body["properties"]}
+            return self._json(200, {"table": tables[body["name"]]})
+
+        def do_DELETE(self):
+            tables.pop(self._name(), None)
+            return self._json(200, {"dropped": True})
+
+    srv, url = _serve(H)
+    try:
+        cat = Catalog.from_gravitino(url, "lake", auth_token="gtok")
+        cat.create_table("g1", location=parquet_location)
+        assert cat.list_tables() == ["g1"]
+        out = cat.get_table("g1").read().sort("a").to_pydict()
+        assert out["a"] == [1, 2, 3]
+        cat.drop_table("g1")
+        assert cat.list_tables() == []
+    finally:
+        srv.shutdown()
+
+
+def test_gravitino_from_config():
+    from daft_tpu.errors import DaftValueError
+    from daft_tpu.io.config import GravitinoConfig
+
+    cat = Catalog.from_gravitino(GravitinoConfig(
+        uri="http://gravitino", metalake="lake", auth_token="t"))
+    assert cat.metalake == "lake" and cat.token == "t"
+    with pytest.raises(DaftValueError, match="metalake"):
+        Catalog.from_gravitino(GravitinoConfig(uri="http://x"))
+
+
+# --------------------------------------------------------------------------- #
 # S3 Tables                                                                   #
 # --------------------------------------------------------------------------- #
 def test_s3tables_catalog_roundtrip(tmp_path, monkeypatch):
