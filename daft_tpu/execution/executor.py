@@ -1055,12 +1055,54 @@ class Executor:
                 mask = Series.from_numpy((hashes % np.uint64(world)) == np.uint64(rank), "m")
                 yield MicroPartition(node.schema, [rb.filter(mask)])
             return
-        combined = self._collect(node.children[0])
         if kind == "hash":
             _, exprs, n = scheme
+            budget = self._sink_budget()
+            if budget is not None:
+                # Buffer in memory until the sink budget trips, THEN stream
+                # into disk buckets with the same hash the in-memory
+                # partitioner uses (the _collect_or_grace pattern) — small
+                # repartitions never pay a disk round-trip. Every bucket
+                # yields, including empty ones (the n-partitions contract).
+                from daft_tpu.execution.spill import GracePartitioner, budget_reservation
+
+                with budget_reservation(self.memory, budget):
+                    grace: Optional[GracePartitioner] = None
+                    buffer: List[MicroPartition] = []
+                    buf_bytes = 0
+                    for mp in self._run(node.children[0]):
+                        if grace is not None:
+                            for rb in mp.record_batches():
+                                grace.add(rb)
+                            continue
+                        buffer.append(mp)
+                        buf_bytes += mp.size_bytes()
+                        if buf_bytes > budget:
+                            grace = GracePartitioner(
+                                lambda rb: [evaluate(e, rb) for e in exprs],
+                                num_buckets=max(n, 1), spill=self._spill(),
+                                total_buffer_bytes=budget)
+                            for buffered in buffer:
+                                for rb in buffered.record_batches():
+                                    grace.add(rb)
+                            buffer = []
+                    if grace is None:
+                        combined = MicroPartition.concat(buffer) if buffer \
+                            else MicroPartition.empty(node.schema)
+                        for part in combined.partition_by_hash(exprs, n):
+                            yield part
+                        return
+                    grace.finish()
+                    for b in range(max(n, 1)):
+                        yield MicroPartition(node.schema,
+                                             list(grace.stream_bucket(b)))
+                return
+            combined = self._collect(node.children[0])
             for part in combined.partition_by_hash(exprs, n):
                 yield part
-        elif kind == "range_bound":
+            return
+        combined = self._collect(node.children[0])
+        if kind == "range_bound":
             # Range partition against precomputed boundary rows (distributed
             # sort stage 2).
             _, exprs, descending, nulls_first, boundaries = scheme

@@ -263,3 +263,33 @@ def test_tpch_style_query_under_memory_pressure(make_df):
     assert actual["cnt"] == expected["cnt"]
     if os.environ.get("DAFT_RUNNER", "native") == "native":
         assert spill_metrics.snapshot()["spills"] > 0
+
+
+def test_grace_hash_repartition_spills(make_df):
+    """df.repartition under a memory limit streams into disk buckets and
+    yields exactly n partitions with the same row placement as in-memory."""
+    rng = np.random.default_rng(29)
+    df = make_df({"k": rng.integers(0, 4_000, N).tolist(),
+                  "v": list(range(N))})
+
+    def rows_per_part(d):
+        return [sorted(p.to_pydict()["v"]) for p in d.repartition(7, "k").iter_partitions()]
+
+    expected = rows_per_part(df)
+    spill_metrics.reset()
+    with memory_limit(LIMIT):
+        actual = rows_per_part(df)
+    assert len(actual) == 7
+    assert actual == expected
+    if os.environ.get("DAFT_RUNNER", "native") == "native":
+        assert spill_metrics.snapshot()["spills"] > 0
+
+
+def test_small_repartition_under_limit_stays_in_memory(make_df):
+    """A repartition far below the budget must NOT pay a disk round-trip."""
+    df = make_df({"k": [1, 2, 3, 4], "v": [10, 20, 30, 40]})
+    spill_metrics.reset()
+    with memory_limit(64 * 1024 * 1024):
+        parts = [p.to_pydict() for p in df.repartition(3, "k").iter_partitions()]
+    assert sum(len(p["v"]) for p in parts) == 4
+    assert spill_metrics.snapshot()["spills"] == 0
