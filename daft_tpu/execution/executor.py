@@ -825,7 +825,7 @@ class Executor:
     GRACE_BUCKETS = 32
 
     def _collect_or_grace(self, child: pp.PhysicalPlan, key_exprs, budget,
-                          key_dtypes=None):
+                          key_dtypes=None, num_buckets: Optional[int] = None):
         """Materialize a join side in memory, or — once it outgrows the
         budget — hash-partition it by join key into disk buckets (grace hash
         join). ``key_dtypes`` are the UNIFIED join-key dtypes: both sides must
@@ -849,7 +849,9 @@ class Executor:
             buffer.append(mp)
             buf_bytes += mp.size_bytes()
             if buf_bytes > budget:
-                grace = GracePartitioner(key_fn, self.GRACE_BUCKETS, self._spill(),
+                grace = GracePartitioner(key_fn,
+                                         num_buckets or self.GRACE_BUCKETS,
+                                         self._spill(),
                                          total_buffer_bytes=budget)
                 for buffered in buffer:
                     for rb in buffered.record_batches():
@@ -1060,42 +1062,24 @@ class Executor:
             budget = self._sink_budget()
             if budget is not None:
                 # Buffer in memory until the sink budget trips, THEN stream
-                # into disk buckets with the same hash the in-memory
-                # partitioner uses (the _collect_or_grace pattern) — small
-                # repartitions never pay a disk round-trip. Every bucket
-                # yields, including empty ones (the n-partitions contract).
-                from daft_tpu.execution.spill import GracePartitioner, budget_reservation
+                # into n disk buckets with the same hash the in-memory
+                # partitioner uses (the shared _collect_or_grace machinery) —
+                # small repartitions never pay a disk round-trip. Every
+                # bucket yields, including empty ones (the n-partitions
+                # contract).
+                from daft_tpu.execution.spill import budget_reservation
 
                 with budget_reservation(self.memory, budget):
-                    grace: Optional[GracePartitioner] = None
-                    buffer: List[MicroPartition] = []
-                    buf_bytes = 0
-                    for mp in self._run(node.children[0]):
-                        if grace is not None:
-                            for rb in mp.record_batches():
-                                grace.add(rb)
-                            continue
-                        buffer.append(mp)
-                        buf_bytes += mp.size_bytes()
-                        if buf_bytes > budget:
-                            grace = GracePartitioner(
-                                lambda rb: [evaluate(e, rb) for e in exprs],
-                                num_buckets=max(n, 1), spill=self._spill(),
-                                total_buffer_bytes=budget)
-                            for buffered in buffer:
-                                for rb in buffered.record_batches():
-                                    grace.add(rb)
-                            buffer = []
-                    if grace is None:
-                        combined = MicroPartition.concat(buffer) if buffer \
-                            else MicroPartition.empty(node.schema)
-                        for part in combined.partition_by_hash(exprs, n):
+                    state, side = self._collect_or_grace(
+                        node.children[0], exprs, budget,
+                        num_buckets=max(n, 1))
+                    if state == "mem":
+                        for part in side.partition_by_hash(exprs, n):
                             yield part
                         return
-                    grace.finish()
                     for b in range(max(n, 1)):
                         yield MicroPartition(node.schema,
-                                             list(grace.stream_bucket(b)))
+                                             list(side.stream_bucket(b)))
                 return
             combined = self._collect(node.children[0])
             for part in combined.partition_by_hash(exprs, n):
