@@ -36,6 +36,16 @@ class GCSConfig:
     project_id: Optional[str] = None
     credentials_path: Optional[str] = None
     anonymous: bool = False
+    # Static bearer token (skips the ADC chain; mostly tests/emulators).
+    token: Optional[str] = None
+    # Non-default endpoint (fake-gcs-server, private Google API endpoint).
+    # Also honours DAFT_GCS_ENDPOINT / STORAGE_EMULATOR_HOST env vars.
+    endpoint_url: Optional[str] = None
+    num_tries: int = 3
+    # gs:// rides the first-party client (io/gcs_client.py: ADC auth,
+    # ranged reads, resumable writes, shared retry policy) by DEFAULT;
+    # set False or DAFT_NATIVE_GCS=0 to fall back to Arrow's GcsFileSystem.
+    use_native_client: bool = True
 
 
 @dataclass(frozen=True)
@@ -163,7 +173,13 @@ def filesystem_for(scheme: str, io_config: Optional[IOConfig]):
         kwargs["connect_timeout"] = cfg.connect_timeout_ms / 1000.0
         return pafs.S3FileSystem(**kwargs)
     if scheme in ("gs", "gcs"):
+        import os
+
         cfg = io_config.gcs
+        if cfg.use_native_client and os.environ.get("DAFT_NATIVE_GCS") != "0":
+            from daft_tpu.io.gcs_client import GCSClient, GcsFileSystemHandler
+
+            return pafs.PyFileSystem(GcsFileSystemHandler(GCSClient(cfg)))
         kwargs = {}
         if cfg.anonymous:
             kwargs["anonymous"] = True
@@ -171,8 +187,6 @@ def filesystem_for(scheme: str, io_config: Optional[IOConfig]):
             kwargs["project_id"] = cfg.project_id
         if cfg.credentials_path:
             # Arrow's GCS filesystem reads ADC from the environment.
-            import os
-
             os.environ.setdefault("GOOGLE_APPLICATION_CREDENTIALS", cfg.credentials_path)
         return pafs.GcsFileSystem(**kwargs)
     if scheme in ("az", "abfs", "abfss"):

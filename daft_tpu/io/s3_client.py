@@ -26,11 +26,12 @@ from daft_tpu.io.sigv4 import resolve_credentials, sign_request
 
 
 class S3Object:
-    __slots__ = ("key", "size")
+    __slots__ = ("key", "size", "is_prefix")
 
-    def __init__(self, key: str, size: int):
+    def __init__(self, key: str, size: int, is_prefix: bool = False):
         self.key = key
         self.size = size
+        self.is_prefix = is_prefix
 
 
 class S3Client:
@@ -47,7 +48,9 @@ class S3Client:
         self.region = region or getattr(cfg, "region_name", None) or "us-east-1"
         self.creds = resolve_credentials(cfg)
         tries = getattr(cfg, "num_tries", 3) if cfg is not None else 3
-        self.policy = policy or RetryPolicy(max_retries=max(tries, 1))
+        # num_tries is TOTAL attempts (policy_from_config convention):
+        # max_retries = num_tries - 1.
+        self.policy = policy or RetryPolicy(max_retries=max(tries - 1, 0))
 
     # ------------------------------------------------------------------ #
     def _request(self, method: str, bucket: str, key: str = "",
@@ -60,10 +63,18 @@ class S3Client:
             hdrs = sign_request(method, url, region=self.region, service="s3",
                                 credentials=self.creds, headers=hdrs,
                                 query=query or {}, payload=payload)
-        full = url + (f"?{urllib.parse.urlencode(query)}" if query else "")
+        # %20 (never '+') so the sent query matches the sigv4 canonical
+        # encoding — strict S3-compatible endpoints reject '+' for values
+        # with spaces with SignatureDoesNotMatch.
+        full = url + (f"?{urllib.parse.urlencode(query, quote_via=urllib.parse.quote)}"
+                      if query else "")
+
+        # Zero-byte uploads must still send a body (Content-Length: 0) —
+        # `payload or None` would elide it and real endpoints answer 411.
+        body_arg = payload if (payload or method == "PUT") else None
 
         def attempt():
-            req = urllib.request.Request(full, data=payload or None,
+            req = urllib.request.Request(full, data=body_arg,
                                          headers=hdrs, method=method)
             try:
                 with urllib.request.urlopen(req, timeout=60) as resp:
@@ -71,22 +82,34 @@ class S3Client:
             except urllib.error.HTTPError as e:
                 body = e.read()
                 if e.code in self.policy.retryable_statuses:
-                    raise DaftTransientError(
-                        f"S3 {method} {full}: HTTP {e.code}") from e
-                raise DaftIOError(
+                    err = DaftTransientError(
+                        f"S3 {method} {full}: HTTP {e.code}")
+                    err.retry_after = e.headers.get("Retry-After")
+                    err.status = e.code
+                    raise err from e
+                err = DaftIOError(
                     f"S3 {method} {full}: HTTP {e.code}: "
-                    f"{body[:300]!r}") from e
+                    f"{body[:300]!r}")
+                err.status = e.code
+                raise err from e
             except (urllib.error.URLError, TimeoutError, ConnectionError, OSError) as e:
                 raise DaftTransientError(f"S3 {method} {full}: {e}") from e
 
+        from daft_tpu.io.iostats import IO_STATS
+
         return with_retries(
             attempt, self.policy, describe=f"S3 {method} {bucket}/{key}",
-            is_retryable=lambda e: isinstance(e, DaftTransientError))
+            is_retryable=lambda e: isinstance(e, DaftTransientError),
+            on_retry=IO_STATS.count_retry)
 
     # ------------------------------------------------------------------ #
     def get_object(self, bucket: str, key: str, start: Optional[int] = None,
                    length: Optional[int] = None) -> bytes:
-        """Whole-object or ranged GET (reference: object_io.rs:287-330)."""
+        """Whole-object or ranged GET (reference: object_io.rs:287-330).
+        A zero-length request short-circuits to b'' — ``bytes=N-(N-1)`` is
+        an invalid Range (HTTP 416)."""
+        if length is not None and length <= 0:
+            return b""
         headers = {}
         if start is not None:
             end = "" if length is None else str(start + length - 1)
@@ -105,13 +128,16 @@ class S3Client:
         self._request("DELETE", bucket, key)
 
     def list_objects(self, bucket: str, prefix: str = "",
-                     delimiter: str = "") -> Iterator[S3Object]:
+                     delimiter: str = "",
+                     page_size: Optional[int] = None) -> Iterator[S3Object]:
         """ListObjectsV2 with continuation (reference: s3_like.rs listing)."""
         token: Optional[str] = None
         while True:
             query = {"list-type": "2", "prefix": prefix}
             if delimiter:
                 query["delimiter"] = delimiter
+            if page_size:
+                query["max-keys"] = str(page_size)
             if token:
                 query["continuation-token"] = token
             _, body, _ = self._request("GET", bucket, query=query)
@@ -119,6 +145,10 @@ class S3Client:
             ns = ""
             if root.tag.startswith("{"):
                 ns = root.tag[: root.tag.index("}") + 1]
+            for cp in root.findall(f"{ns}CommonPrefixes"):
+                pfx = cp.find(f"{ns}Prefix")
+                if pfx is not None and pfx.text:
+                    yield S3Object(pfx.text, 0, is_prefix=True)
             for item in root.findall(f"{ns}Contents"):
                 key = item.find(f"{ns}Key").text or ""
                 size = int(item.find(f"{ns}Size").text or 0)
@@ -192,25 +222,57 @@ class S3FileSystemHandler(pafs.FileSystemHandler):
     def get_type_name(self):
         return "daft-s3"
 
+    def _classify_prefix(self, p: str, bucket: str, key: str) -> pafs.FileInfo:
+        for _ in self.client.list_objects(
+                bucket, prefix=key.rstrip("/") + "/" if key else "",
+                page_size=1):
+            return pafs.FileInfo(p, pafs.FileType.Directory)
+        return pafs.FileInfo(p, pafs.FileType.NotFound)
+
     def get_file_info(self, paths):
         out = []
         for p in paths if isinstance(paths, list) else [paths]:
             bucket, key = self._split(p)
+            if not key:
+                # Bucket root is never an object.
+                out.append(self._classify_prefix(p, bucket, key))
+                continue
             try:
                 size = self.client.head_object(bucket, key)
                 out.append(pafs.FileInfo(p, pafs.FileType.File, size=size))
-            except DaftIOError:
-                listed = list(self.client.list_objects(bucket, prefix=key.rstrip("/") + "/"))
-                kind = pafs.FileType.Directory if listed else pafs.FileType.NotFound
-                out.append(pafs.FileInfo(p, kind))
+            except DaftIOError as e:
+                if getattr(e, "status", None) not in (None, 404):
+                    raise  # 403 etc. must surface, not read as NotFound
+                out.append(self._classify_prefix(p, bucket, key))
         return out if isinstance(paths, list) else out[0]
 
     def get_file_info_selector(self, selector):
+        """Honors ``selector.recursive`` (delimiter '/' + Directory entries
+        from CommonPrefixes) and ``selector.allow_not_found``."""
         bucket, key = self._split(selector.base_dir)
         prefix = key.rstrip("/") + "/" if key else ""
-        return [pafs.FileInfo(f"{bucket}/{obj.key}", pafs.FileType.File,
-                              size=obj.size)
-                for obj in self.client.list_objects(bucket, prefix=prefix)]
+        delimiter = "" if selector.recursive else "/"
+        out = []
+        listed_any = False
+        for obj in self.client.list_objects(bucket, prefix=prefix,
+                                            delimiter=delimiter):
+            listed_any = True
+            if obj.is_prefix:
+                out.append(pafs.FileInfo(f"{bucket}/{obj.key.rstrip('/')}",
+                                         pafs.FileType.Directory))
+            elif not obj.key.endswith("/"):  # skip zero-byte dir markers
+                out.append(pafs.FileInfo(f"{bucket}/{obj.key}",
+                                         pafs.FileType.File, size=obj.size))
+        if not listed_any and prefix:
+            # Object stores have implicit directories: a fully empty
+            # listing (not even a marker) means the base_dir does not
+            # exist. A marker-only listing is an existing empty dir -> [],
+            # and the bucket root always "exists" (a nonexistent bucket
+            # fails the list call itself).
+            if getattr(selector, "allow_not_found", False):
+                return []
+            raise FileNotFoundError(selector.base_dir)
+        return out
 
     def open_input_file(self, path):
         import pyarrow as pa
@@ -290,8 +352,12 @@ class S3FileSystemHandler(pafs.FileSystemHandler):
         return path
 
     def __eq__(self, other):
+        # Config identity matters: same endpoint under different
+        # credentials is NOT the same filesystem (pyarrow merges datasets
+        # across handlers that compare equal).
         return isinstance(other, S3FileSystemHandler) and \
-            other.client.endpoint == self.client.endpoint
+            other.client.endpoint == self.client.endpoint and \
+            other.client.cfg == self.client.cfg
 
     def __ne__(self, other):
         return not self.__eq__(other)

@@ -78,6 +78,13 @@ def resolve_filesystem(path: str, io_config=None) -> Tuple[pafs.FileSystem, str]
             from daft_tpu.context import get_context
 
             io_config = get_context().planning_config.default_io_config
+        if io_config is None and scheme in ("gs", "gcs"):
+            # gs:// rides the native client by DEFAULT, io_config or not
+            # (an empty GCSConfig resolves auth through the ADC chain);
+            # DAFT_NATIVE_GCS=0 opts back out to Arrow's URI resolution.
+            from daft_tpu.io.config import IOConfig
+
+            io_config = IOConfig()
         if scheme in ("http", "https", "hf"):
             from daft_tpu.io.http_source import (
                 HttpFileSystemHandler,

@@ -144,8 +144,11 @@ def signed_url_and_headers(method: str, url: str, *, region: str,
     """Convenience: resolve the credential chain and sign; anonymous
     configurations return the headers unsigned."""
     creds = resolve_credentials(s3_config)
+    # %20 (never '+') so the sent query matches the canonical encoding
+    # (_canonical_query): strict S3-compatible endpoints reject '+' for
+    # values with spaces with SignatureDoesNotMatch.
     full = url if not query else \
-        f"{url}?{urllib.parse.urlencode(dict(query))}"
+        f"{url}?{urllib.parse.urlencode(dict(query), quote_via=urllib.parse.quote)}"
     if creds is None:
         return full, dict(headers or {})
     return full, sign_request(method, url, region=region, service=service,

@@ -1,0 +1,587 @@
+"""Native GCS client against a mock JSON-API server (no network).
+
+Reference: src/daft-io/src/google_cloud.rs. The fixture is an in-process
+GCS-compatible server (ranged GET / metadata GET / objects.list with
+pagination+delimiter / media+resumable upload / DELETE) that also hosts the
+OAuth2 token-exchange and GCE metadata endpoints, so the full ADC chain —
+service-account JWT (verified server-side with the RSA public operation),
+metadata-server refresh, static token, anonymous — runs end to end. The
+engine path is covered by reading parquet through gs:// with the
+default-native resolution.
+"""
+
+import base64
+import hashlib
+import json
+import subprocess
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qsl, unquote, urlparse
+
+import pytest
+
+import daft_tpu
+from daft_tpu.io import gcs_auth
+from daft_tpu.io.config import GCSConfig, IOConfig
+from daft_tpu.io.gcs_auth import (
+    MetadataServerProvider,
+    load_rsa_private_key,
+    resolve_gcs_token_provider,
+)
+from daft_tpu.io.gcs_client import GCSClient, GcsFileSystemHandler
+from daft_tpu.io.iostats import io_stats
+from daft_tpu.io.retry import RetryPolicy
+
+FAST = RetryPolicy(max_retries=4, backoff_base_s=0.01, backoff_cap_s=0.05)
+
+
+class _GcsStore:
+    def __init__(self):
+        self.objects = {}  # (bucket, key) -> bytes
+        self.tokens = {"t0"}  # accepted bearer tokens
+        self.allow_anonymous = False
+        self.page_size = 1000
+        self.fail_next = []  # statuses to emit before the next media GET
+        self.bad_auth = []
+        self.metadata_count = 0
+        self.metadata_expires_in = 3600
+        self.token_count = 0
+        self.sa_key = None  # RsaPrivateKey; set to verify JWT exchanges
+        self.jwt_claims = []
+        self.uploads = {}  # upload_id -> dict(bucket, name, buf, total)
+        self.list_calls = 0
+        self.media_gets = 0
+
+    def authorized(self, handler) -> bool:
+        auth = handler.headers.get("Authorization")
+        if auth is None:
+            if not self.allow_anonymous:
+                self.bad_auth.append(("missing", handler.path))
+                return False
+            return True
+        ok = auth.startswith("Bearer ") and auth[len("Bearer "):] in self.tokens
+        if not ok:
+            self.bad_auth.append((auth, handler.path))
+        return ok
+
+    def verify_jwt(self, assertion: str):
+        signing, _, sig_b64 = assertion.rpartition(".")
+        sig = base64.urlsafe_b64decode(sig_b64 + "==")
+        em = pow(int.from_bytes(sig, "big"), self.sa_key.e, self.sa_key.n) \
+            .to_bytes(self.sa_key.byte_length, "big")
+        digest_info = gcs_auth._SHA256_DIGEST_INFO + \
+            hashlib.sha256(signing.encode()).digest()
+        ok = em[:2] == b"\x00\x01" and em.endswith(b"\x00" + digest_info)
+        claims = json.loads(base64.urlsafe_b64decode(
+            signing.split(".")[1] + "=="))
+        self.jwt_claims.append(claims)
+        return ok, claims
+
+
+def _serve(store):
+    class H(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, *a):
+            pass
+
+        def _send(self, code, body=b"", headers=None):
+            if isinstance(body, str):
+                body = body.encode()
+            self.send_response(code)
+            for k, v in (headers or {}).items():
+                self.send_header(k, v)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def _json(self, code, doc, headers=None):
+            self._send(code, json.dumps(doc), headers)
+
+        # ---------------- token endpoints ---------------- #
+        def _metadata_token(self):
+            if self.headers.get("Metadata-Flavor") != "Google":
+                return self._send(403)
+            store.metadata_count += 1
+            tok = f"mtok-{store.metadata_count}"
+            store.tokens.add(tok)
+            self._json(200, {"access_token": tok,
+                             "expires_in": store.metadata_expires_in})
+
+        def _oauth_token(self, form):
+            grant = form.get("grant_type", "")
+            if grant == "urn:ietf:params:oauth:grant-type:jwt-bearer":
+                ok, claims = store.verify_jwt(form["assertion"])
+                if not ok:
+                    return self._json(400, {"error": "invalid_grant"})
+            elif grant == "refresh_token":
+                if form.get("refresh_token") != "rt-1":
+                    return self._json(400, {"error": "invalid_grant"})
+            else:
+                return self._json(400, {"error": "unsupported_grant_type"})
+            store.token_count += 1
+            tok = f"xtok-{store.token_count}"
+            store.tokens.add(tok)
+            self._json(200, {"access_token": tok, "expires_in": 3600,
+                             "token_type": "Bearer"})
+
+        # ---------------- storage endpoints ---------------- #
+        def _list(self, bucket, q):
+            store.list_calls += 1
+            prefix = q.get("prefix", "")
+            delimiter = q.get("delimiter", "")
+            max_results = int(q.get("maxResults") or store.page_size)
+            items, prefixes = [], []
+            for k in sorted(k for (b, k) in store.objects
+                            if b == bucket and k.startswith(prefix)):
+                rest = k[len(prefix):]
+                if delimiter and delimiter in rest:
+                    p = prefix + rest.split(delimiter)[0] + delimiter
+                    if p not in prefixes:
+                        prefixes.append(p)
+                else:
+                    items.append(k)
+            start = int(q.get("pageToken") or 0)
+            page = items[start:start + max_results]
+            doc = {"items": [{"name": k,
+                              "size": str(len(store.objects[(bucket, k)]))}
+                             for k in page]}
+            if start == 0 and prefixes:
+                doc["prefixes"] = prefixes
+            if start + max_results < len(items):
+                doc["nextPageToken"] = str(start + max_results)
+            self._json(200, doc)
+
+        def do_GET(self):
+            u = urlparse(self.path)
+            if u.path.startswith("/computeMetadata/"):
+                return self._metadata_token()
+            if not store.authorized(self):
+                return self._send(401)
+            q = dict(parse_qsl(u.query, keep_blank_values=True))
+            parts = u.path.split("/")
+            # /storage/v1/b/{bucket}/o[/{object}]
+            bucket = unquote(parts[4])
+            if len(parts) < 7 or not parts[6]:
+                return self._list(bucket, q)
+            key = unquote(parts[6])
+            data = store.objects.get((bucket, key))
+            if q.get("alt") == "media":
+                if store.fail_next:
+                    code = store.fail_next.pop(0)
+                    return self._send(code, headers={"Retry-After": "0.01"})
+                store.media_gets += 1
+                if data is None:
+                    return self._send(404)
+                rng = self.headers.get("Range")
+                if rng:
+                    spec = rng.split("=")[1]
+                    start_s, _, end_s = spec.partition("-")
+                    start = int(start_s)
+                    end = int(end_s) if end_s else len(data) - 1
+                    return self._send(206, data[start:end + 1])
+                return self._send(200, data)
+            if data is None:
+                return self._send(404)
+            self._json(200, {"name": key, "size": str(len(data)),
+                             "bucket": bucket})
+
+        def do_POST(self):
+            u = urlparse(self.path)
+            n = int(self.headers.get("Content-Length") or 0)
+            payload = self.rfile.read(n)
+            if u.path == "/token":
+                form = dict(parse_qsl(payload.decode(),
+                                      keep_blank_values=True))
+                return self._oauth_token(form)
+            if not store.authorized(self):
+                return self._send(401)
+            q = dict(parse_qsl(u.query, keep_blank_values=True))
+            bucket = unquote(u.path.split("/")[5])
+            name = q.get("name", "")
+            if q.get("uploadType") == "media":
+                store.objects[(bucket, name)] = payload
+                return self._json(200, {"name": name,
+                                        "size": str(len(payload))})
+            if q.get("uploadType") == "resumable":
+                uid = f"u{len(store.uploads)}"
+                store.uploads[uid] = {"bucket": bucket, "name": name,
+                                      "buf": bytearray()}
+                host = self.headers["Host"]
+                loc = (f"http://{host}/upload/storage/v1/b/{bucket}/o"
+                       f"?uploadType=resumable&upload_id={uid}")
+                return self._json(200, {}, headers={"Location": loc})
+            self._send(400)
+
+        def do_PUT(self):
+            u = urlparse(self.path)
+            if not store.authorized(self):
+                return self._send(401)
+            n = int(self.headers.get("Content-Length") or 0)
+            payload = self.rfile.read(n)
+            q = dict(parse_qsl(u.query, keep_blank_values=True))
+            up = store.uploads.get(q.get("upload_id", ""))
+            if up is None:
+                return self._send(404)
+            # Content-Range: bytes {start}-{end}/{total}
+            spec = self.headers["Content-Range"].split(" ")[1]
+            rng, total = spec.split("/")
+            start, end = (int(x) for x in rng.split("-"))
+            assert start == len(up["buf"]), "out-of-order resumable chunk"
+            up["buf"].extend(payload)
+            if end + 1 == int(total):
+                store.objects[(up["bucket"], up["name"])] = bytes(up["buf"])
+                return self._json(200, {"name": up["name"],
+                                        "size": total})
+            self._send(308, headers={"Range": f"bytes=0-{end}"})
+
+        def do_DELETE(self):
+            u = urlparse(self.path)
+            if not store.authorized(self):
+                return self._send(401)
+            parts = u.path.split("/")
+            bucket, key = unquote(parts[4]), unquote(parts[6])
+            store.objects.pop((bucket, key), None)
+            self._send(204)
+
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), H)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    return srv, f"http://127.0.0.1:{srv.server_address[1]}"
+
+
+@pytest.fixture
+def gcs(monkeypatch, tmp_path):
+    """Mock server + a static-token GCSConfig; the ADC chain is isolated
+    from the host environment (no env creds, no well-known file, no
+    metadata probe)."""
+    monkeypatch.delenv("GOOGLE_APPLICATION_CREDENTIALS", raising=False)
+    monkeypatch.delenv("GCE_METADATA_HOST", raising=False)
+    monkeypatch.delenv("STORAGE_EMULATOR_HOST", raising=False)
+    monkeypatch.delenv("DAFT_GCS_ENDPOINT", raising=False)
+    monkeypatch.setenv("HOME", str(tmp_path))
+    gcs_auth._PROVIDER_CACHE.clear()
+    monkeypatch.setattr(gcs_auth, "_METADATA_PROBE", False)
+    store = _GcsStore()
+    srv, url = _serve(store)
+    cfg = GCSConfig(endpoint_url=url, token="t0")
+    yield store, cfg, url
+    gcs_auth._PROVIDER_CACHE.clear()
+    srv.shutdown()
+
+
+def _client(cfg, **kw):
+    return GCSClient(cfg, policy=FAST, **kw)
+
+
+# --------------------------------------------------------------------- #
+# Client basics                                                          #
+# --------------------------------------------------------------------- #
+
+
+def test_put_get_ranged_list_delete(gcs):
+    store, cfg, url = gcs
+    c = _client(cfg)
+    c.put_object("bkt", "dir/a.bin", b"0123456789abcdef")
+    assert store.objects[("bkt", "dir/a.bin")] == b"0123456789abcdef"
+    assert c.get_object("bkt", "dir/a.bin") == b"0123456789abcdef"
+    assert c.get_object("bkt", "dir/a.bin", start=4, length=6) == b"456789"
+    assert c.get_object("bkt", "dir/a.bin", start=12) == b"cdef"
+    assert c.head_object("bkt", "dir/a.bin") == 16
+    c.put_object("bkt", "dir/b.bin", b"xy")
+    assert [(o.key, o.size) for o in c.list_objects("bkt", prefix="dir/")] \
+        == [("dir/a.bin", 16), ("dir/b.bin", 2)]
+    c.delete_object("bkt", "dir/b.bin")
+    assert [o.key for o in c.list_objects("bkt", prefix="dir/")] \
+        == ["dir/a.bin"]
+    assert not store.bad_auth, store.bad_auth[:1]
+
+
+def test_key_with_slash_space_and_zero_length_get(gcs):
+    store, cfg, url = gcs
+    c = _client(cfg)
+    key = "dir with space/a+b#c.bin"
+    c.put_object("bkt", key, b"payload")
+    assert c.get_object("bkt", key) == b"payload"
+    assert c.get_object("bkt", key, start=2, length=3) == b"ylo"
+    # zero-length short-circuits without a request (416 guard)
+    gets_before = store.media_gets
+    assert c.get_object("bkt", key, start=5, length=0) == b""
+    assert store.media_gets == gets_before
+    assert not store.bad_auth
+
+
+def test_list_pagination_and_delimiter(gcs):
+    store, cfg, url = gcs
+    c = _client(cfg)
+    for i in range(7):
+        c.put_object("bkt", f"t/part-{i}.bin", b"x" * (i + 1))
+    c.put_object("bkt", "t/sub/leaf.bin", b"zz")
+    store.page_size = 2  # force pagination
+    store.list_calls = 0
+    got = list(c.list_objects("bkt", prefix="t/"))
+    files = [(o.key, o.size) for o in got if not o.is_prefix]
+    assert files == [(f"t/part-{i}.bin", i + 1) for i in range(7)] + \
+        [("t/sub/leaf.bin", 2)]
+    assert store.list_calls >= 4  # 8 items, 2 per page
+    # delimiter: direct children + common prefix
+    got = list(c.list_objects("bkt", prefix="t/", delimiter="/"))
+    assert [o.key for o in got if o.is_prefix] == ["t/sub/"]
+    assert [o.key for o in got if not o.is_prefix] == \
+        [f"t/part-{i}.bin" for i in range(7)]
+
+
+def test_429_backoff_then_success(gcs):
+    store, cfg, url = gcs
+    c = _client(cfg)
+    c.put_object("bkt", "k", b"v" * 10)
+    store.fail_next = [429, 503]
+    before = io_stats().retries
+    assert c.get_object("bkt", "k") == b"v" * 10
+    assert io_stats().retries == before + 2
+
+
+def test_retries_exhausted_raises(gcs):
+    store, cfg, url = gcs
+    c = GCSClient(cfg, policy=RetryPolicy(max_retries=1,
+                                          backoff_base_s=0.01))
+    c.put_object("bkt", "k", b"v")
+    store.fail_next = [429, 429, 429]
+    with pytest.raises(Exception):
+        c.get_object("bkt", "k")
+
+
+def test_anonymous_requests_unsigned(gcs, monkeypatch):
+    store, _, url = gcs
+    store.allow_anonymous = True
+    cfg = GCSConfig(endpoint_url=url, anonymous=True)
+    c = _client(cfg)
+    assert c.provider is None
+    store.objects[("pub", "obj")] = b"public-bytes"
+    assert c.get_object("pub", "obj") == b"public-bytes"
+    assert c.get_object("pub", "obj", start=0, length=6) == b"public"
+    assert not store.bad_auth
+
+
+def test_writer_roundtrip_resumable(gcs):
+    store, cfg, url = gcs
+    c = _client(cfg, resumable_threshold=256, resumable_chunk=512)
+    data = bytes(range(256)) * 7  # 1792 bytes -> 4 chunks of <=512
+    c.put_object("bkt", "big/obj.bin", data)
+    assert store.objects[("bkt", "big/obj.bin")] == data
+    assert c.get_object("bkt", "big/obj.bin", start=512, length=16) == \
+        data[512:528]
+    # small objects take the simple-media path
+    c.put_object("bkt", "small.bin", b"tiny")
+    assert store.objects[("bkt", "small.bin")] == b"tiny"
+    assert not store.bad_auth
+
+
+# --------------------------------------------------------------------- #
+# Auth chain                                                             #
+# --------------------------------------------------------------------- #
+
+
+def test_metadata_server_token_cache_and_refresh(gcs, monkeypatch):
+    store, _, url = gcs
+    monkeypatch.setenv("GCE_METADATA_HOST", url.split("://", 1)[1])
+    # Long-lived token: one fetch serves many calls.
+    p = MetadataServerProvider(policy=FAST)
+    t1 = p.token()
+    assert p.token() == t1
+    assert store.metadata_count == 1
+    # Expiring token (expires_in below the skew): every call refreshes.
+    store.metadata_expires_in = 1  # < expiry_skew_s=60 -> always stale
+    p2 = MetadataServerProvider(policy=FAST)
+    a, b = p2.token(), p2.token()
+    assert (a, b) == ("mtok-2", "mtok-3")
+    assert store.metadata_count == 3
+
+
+def test_metadata_auth_end_to_end(gcs, monkeypatch):
+    store, _, url = gcs
+    monkeypatch.setenv("GCE_METADATA_HOST", url.split("://", 1)[1])
+    gcs_auth._PROVIDER_CACHE.clear()
+    cfg = GCSConfig(endpoint_url=url)  # no token -> ADC -> metadata server
+    c = _client(cfg)
+    assert isinstance(c.provider, MetadataServerProvider)
+    c.put_object("bkt", "k", b"v")
+    assert c.get_object("bkt", "k") == b"v"
+    assert not store.bad_auth
+
+
+def _gen_sa_json(tmp_path, url):
+    pem_pkcs1 = subprocess.run(["openssl", "genrsa", "1024"],
+                               capture_output=True, text=True,
+                               check=True).stdout
+    pem_pkcs8 = subprocess.run(
+        ["openssl", "pkcs8", "-topk8", "-nocrypt"], input=pem_pkcs1,
+        capture_output=True, text=True, check=True).stdout
+    info = {"type": "service_account", "client_email": "sa@fixture.test",
+            "private_key": pem_pkcs8, "private_key_id": "kid-1",
+            "token_uri": f"{url}/token"}
+    path = tmp_path / "sa.json"
+    path.write_text(json.dumps(info))
+    return path, pem_pkcs1, pem_pkcs8
+
+
+def test_service_account_jwt_exchange(gcs, tmp_path):
+    store, _, url = gcs
+    path, pem1, pem8 = _gen_sa_json(tmp_path, url)
+    # PKCS#1 and PKCS#8 encodings of the same key parse identically.
+    k1, k8 = load_rsa_private_key(pem1), load_rsa_private_key(pem8)
+    assert (k1.n, k1.e, k1.d) == (k8.n, k8.e, k8.d) and k1.e == 65537
+    store.sa_key = k8
+    cfg = GCSConfig(endpoint_url=url, credentials_path=str(path))
+    c = _client(cfg)
+    c.put_object("bkt", "k", b"sa-bytes")
+    assert c.get_object("bkt", "k") == b"sa-bytes"
+    assert not store.bad_auth
+    claims = store.jwt_claims[0]
+    assert claims["iss"] == "sa@fixture.test"
+    assert claims["aud"] == f"{url}/token"
+    assert claims["scope"] == gcs_auth.GCS_SCOPE
+    assert claims["exp"] - claims["iat"] == 3600
+
+
+def test_adc_env_var_and_authorized_user(gcs, tmp_path, monkeypatch):
+    store, _, url = gcs
+    info = {"type": "authorized_user", "client_id": "cid",
+            "client_secret": "cs", "refresh_token": "rt-1",
+            "token_uri": f"{url}/token"}
+    path = tmp_path / "adc.json"
+    path.write_text(json.dumps(info))
+    monkeypatch.setenv("GOOGLE_APPLICATION_CREDENTIALS", str(path))
+    gcs_auth._PROVIDER_CACHE.clear()
+    c = _client(GCSConfig(endpoint_url=url))
+    c.put_object("bkt", "k", b"au-bytes")
+    assert c.get_object("bkt", "k") == b"au-bytes"
+    assert not store.bad_auth
+    # config-level anonymous beats env creds
+    assert resolve_gcs_token_provider(GCSConfig(anonymous=True)) is None
+
+
+def test_expired_server_side_token_is_refreshed(gcs, monkeypatch):
+    """A 401 (token revoked before local expiry) invalidates the cache and
+    the retry re-fetches."""
+    store, _, url = gcs
+    monkeypatch.setenv("GCE_METADATA_HOST", url.split("://", 1)[1])
+    gcs_auth._PROVIDER_CACHE.clear()
+    c = _client(GCSConfig(endpoint_url=url))
+    c.put_object("bkt", "k", b"v")
+    store.tokens.discard("mtok-1")  # server-side revocation
+    store.bad_auth.clear()
+    assert c.get_object("bkt", "k") == b"v"
+    assert store.metadata_count == 2
+
+
+# --------------------------------------------------------------------- #
+# pyarrow handler + engine path                                          #
+# --------------------------------------------------------------------- #
+
+
+def test_selector_contract(gcs):
+    import pyarrow.fs as pafs
+
+    store, cfg, url = gcs
+    c = _client(cfg)
+    for k in ("d/x.bin", "d/y.bin", "d/sub/z.bin"):
+        c.put_object("bkt", k, b"abc")
+    fs = pafs.PyFileSystem(GcsFileSystemHandler(c))
+    rec = fs.get_file_info(pafs.FileSelector("bkt/d", recursive=True))
+    assert sorted(i.path for i in rec) == \
+        ["bkt/d/sub/z.bin", "bkt/d/x.bin", "bkt/d/y.bin"]
+    flat = fs.get_file_info(pafs.FileSelector("bkt/d", recursive=False))
+    by_type = {i.path: i.type for i in flat}
+    assert by_type == {"bkt/d/sub": pafs.FileType.Directory,
+                       "bkt/d/x.bin": pafs.FileType.File,
+                       "bkt/d/y.bin": pafs.FileType.File}
+    with pytest.raises(FileNotFoundError):
+        fs.get_file_info(pafs.FileSelector("bkt/nope", recursive=True))
+    assert fs.get_file_info(pafs.FileSelector("bkt/nope", recursive=True,
+                                              allow_not_found=True)) == []
+    # a zero-byte marker object means the dir EXISTS but is empty -> []
+    c.put_object("bkt", "emptydir/", b"")
+    assert fs.get_file_info(pafs.FileSelector("bkt/emptydir",
+                                              recursive=True)) == []
+    # bucket root is a Directory (or NotFound when empty), never a File
+    assert fs.get_file_info("bkt").type == pafs.FileType.Directory
+    assert fs.get_file_info("emptybkt").type == pafs.FileType.NotFound
+
+
+def test_engine_reads_parquet_native_by_default(gcs, tmp_path):
+    """write_parquet locally -> upload through the client -> read_parquet
+    over gs://: scheme resolution prefers the native client with no
+    opt-in flag set."""
+    store, cfg, url = gcs
+    daft_tpu.from_pydict({"a": list(range(50)), "b": ["v"] * 50}) \
+        .write_parquet(str(tmp_path))
+    import os
+
+    c = _client(cfg)
+    for f in os.listdir(tmp_path):
+        if f.endswith(".parquet"):
+            c.put_object("data", f"tbl/{f}", (tmp_path / f).read_bytes())
+    io_cfg = IOConfig(gcs=cfg)
+    out = (daft_tpu.read_parquet("gs://data/tbl", io_config=io_cfg)
+           .where(daft_tpu.col("a") >= 45).sort("a").to_pydict())
+    assert out["a"] == [45, 46, 47, 48, 49]
+    assert not store.bad_auth
+
+
+def test_engine_reads_native_without_io_config(gcs, tmp_path, monkeypatch):
+    """Even with NO io_config at all, gs:// resolves to the native client
+    (endpoint via DAFT_GCS_ENDPOINT, auth via the ADC chain -> anonymous
+    here)."""
+    store, cfg, url = gcs
+    store.allow_anonymous = True
+    monkeypatch.setenv("DAFT_GCS_ENDPOINT", url)
+    daft_tpu.from_pydict({"a": [1, 2, 3]}).write_parquet(str(tmp_path))
+    import os
+
+    c = _client(cfg)
+    for f in os.listdir(tmp_path):
+        if f.endswith(".parquet"):
+            c.put_object("nocfg", f"tbl/{f}", (tmp_path / f).read_bytes())
+    out = daft_tpu.read_parquet("gs://nocfg/tbl").sort("a").to_pydict()
+    assert out["a"] == [1, 2, 3]
+
+
+def test_native_escape_hatch(gcs, monkeypatch):
+    """DAFT_NATIVE_GCS=0 / use_native_client=False fall back to Arrow."""
+    import pyarrow.fs as pafs
+
+    from daft_tpu.io.config import filesystem_for
+
+    store, cfg, url = gcs
+    fs = filesystem_for("gs", IOConfig(gcs=cfg))
+    assert isinstance(fs, pafs.PyFileSystem)
+    assert fs.type_name == "py::daft-gcs"
+    monkeypatch.setenv("DAFT_NATIVE_GCS", "0")
+    fs2 = filesystem_for("gs", IOConfig(gcs=GCSConfig(anonymous=True)))
+    assert not isinstance(fs2, pafs.PyFileSystem)
+    monkeypatch.delenv("DAFT_NATIVE_GCS")
+    fs3 = filesystem_for(
+        "gs", IOConfig(gcs=GCSConfig(anonymous=True,
+                                     use_native_client=False)))
+    assert not isinstance(fs3, pafs.PyFileSystem)
+
+
+def test_writer_path_through_handler(gcs):
+    """open_output_stream publishes on clean close and aborts on unwind."""
+    store, cfg, url = gcs
+    c = _client(cfg)
+    import pyarrow.fs as pafs
+
+    fs = pafs.PyFileSystem(GcsFileSystemHandler(c))
+    with fs.open_output_stream("bkt/out/x.bin") as out:
+        out.write(b"hello ")
+        out.write(b"gcs")
+    assert store.objects[("bkt", "out/x.bin")] == b"hello gcs"
+    # A close() during exception unwind must NOT publish a truncated
+    # object (the abort may surface as either the original error or the
+    # handler's DaftIOError depending on how pyarrow relays close()).
+    with pytest.raises(Exception):
+        with fs.open_output_stream("bkt/out/broken.bin") as out:
+            out.write(b"partial")
+            raise RuntimeError("boom")
+    assert ("bkt", "out/broken.bin") not in store.objects

@@ -81,6 +81,59 @@ def test_hive_partition_dtype_inference(tmp_path):
     assert out["num"] == [1.5, 1.5, 2.5, 2.5]
 
 
+def test_hive_underscore_value_stays_string(tmp_path):
+    """Regression: Python's int()/float() accept underscore separators, so
+    month=2024_01 used to materialize as int 202401. Strict patterns keep
+    it a string."""
+    d = str(tmp_path / "t")
+    for m in ("2024_01", "2024_02"):
+        sub = os.path.join(d, f"month={m}")
+        os.makedirs(sub)
+        daft_tpu.from_pydict({"v": [1]}).write_parquet(sub)
+    df = daft_tpu.read_parquet(d, hive_partitioning=True)
+    assert {f.name: f.dtype for f in df.schema}["month"] == \
+        daft_tpu.DataType.string()
+    out = df.sort("month").to_pydict()
+    assert out["month"] == ["2024_01", "2024_02"]
+
+
+def test_hive_strict_numeric_inference_unit():
+    from daft_tpu.datatype import DataType
+    from daft_tpu.io.hive import _infer_one
+
+    assert _infer_one(["1", "-2", "+3"]) == DataType.int64()
+    assert _infer_one(["1.5", "2", "-3e2", ".5"]) == DataType.float64()
+    # nan/inf spellings are floats (Rust str::parse semantics; our own
+    # writer emits 'nan' for NaN partition values)
+    assert _infer_one(["1.5", "nan", "-inf", "Infinity"]) == \
+        DataType.float64()
+    # underscores, whitespace and trailing newlines (a %0A-decoded path
+    # segment) are NOT numbers
+    for vals in (["1_000"], ["2024_01"], [" 1"], ["1 "],
+                 ["123\n"], ["1.5\n"]):
+        assert _infer_one(vals) == DataType.string(), vals
+
+
+def test_hive_declared_numeric_dtype_rejects_loose_values():
+    """Regression: _coerce is gated on the same strict patterns — a
+    declared int/float dtype must not silently parse '2024_01'."""
+    from daft_tpu.datatype import DataType
+    from daft_tpu.errors import DaftValueError
+    from daft_tpu.io.hive import _coerce
+
+    import math
+
+    assert _coerce("2024", DataType.int64()) == 2024
+    assert _coerce("2.5", DataType.float64()) == 2.5
+    # the writer's own str() spellings round-trip for declared floats
+    assert math.isnan(_coerce("nan", DataType.float64()))
+    assert _coerce("-inf", DataType.float64()) == float("-inf")
+    with pytest.raises(DaftValueError):
+        _coerce("2024_01", DataType.int64())
+    with pytest.raises(DaftValueError):
+        _coerce("1_000.5", DataType.float64())
+
+
 def test_hive_filter_prunes_files(hive_dir):
     import datetime
 

@@ -37,7 +37,14 @@ class _S3Store:
             self.bad_auth.append(("missing", handler.path))
             return False
         u = urlparse(handler.path)
-        query = dict(parse_qsl(u.query, keep_blank_values=True))
+        # Strict RFC 3986 decoding ('+' is a literal plus, NOT a space) —
+        # the behaviour of strict S3-compatible endpoints. A client that
+        # urlencodes spaces as '+' canonicalizes to %2B here and fails
+        # verification, reproducing their SignatureDoesNotMatch.
+        query = {}
+        for part in u.query.split("&") if u.query else []:
+            k, _, v = part.partition("=")
+            query[unquote(k)] = unquote(v)
         # Reproduce exactly the signed header set the client used.
         signed = auth.split("SignedHeaders=")[1].split(",")[0].split(";")
         headers = {h: handler.headers.get(h) for h in signed if h != "host"}
@@ -99,11 +106,22 @@ def _serve(store):
             if not key:  # ListObjectsV2
                 q = dict(parse_qsl(urlparse(self.path).query))
                 prefix = q.get("prefix", "")
-                items = sorted((k, len(v)) for (b, k), v in store.objects.items()
-                               if b == bucket and k.startswith(prefix))
+                delimiter = q.get("delimiter", "")
+                items, prefixes = [], []
+                for k in sorted(k for (b, k) in store.objects
+                                if b == bucket and k.startswith(prefix)):
+                    rest = k[len(prefix):]
+                    if delimiter and delimiter in rest:
+                        p = prefix + rest.split(delimiter)[0] + delimiter
+                        if p not in prefixes:
+                            prefixes.append(p)
+                    else:
+                        items.append((k, len(store.objects[(bucket, k)])))
                 xml = "<?xml version='1.0'?><ListBucketResult>" + "".join(
                     f"<Contents><Key>{k}</Key><Size>{s}</Size></Contents>"
-                    for k, s in items) + \
+                    for k, s in items) + "".join(
+                    f"<CommonPrefixes><Prefix>{p}</Prefix></CommonPrefixes>"
+                    for p in prefixes) + \
                     "<IsTruncated>false</IsTruncated></ListBucketResult>"
                 return self._send(200, xml.encode())
             data = store.objects.get((bucket, key))
@@ -196,6 +214,59 @@ def test_engine_reads_parquet_through_native_client(s3, tmp_path):
            .where(daft_tpu.col("a") >= 45).sort("a").to_pydict())
     assert out["a"] == [45, 46, 47, 48, 49]
     assert not store.bad_auth
+
+
+def test_list_prefix_with_space_signs_percent20(s3):
+    """Regression: the sent query must use %20 (urlencode quote_via=quote),
+    matching the sigv4 canonical encoding — the fixture recomputes the
+    signature from the received query string, so a '+'-encoding client
+    fails this round trip with SignatureDoesNotMatch."""
+    store, cfg, url = s3
+    c = S3Client(cfg)
+    c.put_object("bkt", "dir with space/a.bin", b"xy")
+    assert [(o.key, o.size) for o in
+            c.list_objects("bkt", prefix="dir with space/")] == \
+        [("dir with space/a.bin", 2)]
+    assert not store.bad_auth, store.bad_auth[:1]
+
+
+def test_zero_length_get_short_circuits(s3):
+    """Regression: length=0 must return b'' without emitting the invalid
+    ``bytes=N-(N-1)`` Range header (HTTP 416)."""
+    store, cfg, url = s3
+    c = S3Client(cfg)
+    c.put_object("bkt", "k.bin", b"0123456789")
+    assert c.get_object("bkt", "k.bin", start=4, length=0) == b""
+    assert c.get_object("bkt", "k.bin", start=4, length=3) == b"456"
+    assert not store.bad_auth
+
+
+def test_selector_recursive_and_allow_not_found(s3):
+    """Regression: get_file_info_selector honors selector.recursive
+    (delimiter '/' + Directory entries from CommonPrefixes) and
+    selector.allow_not_found."""
+    import pyarrow.fs as pafs
+
+    from daft_tpu.io.s3_client import S3FileSystemHandler
+
+    store, cfg, url = s3
+    c = S3Client(cfg)
+    for k in ("d/x.bin", "d/y.bin", "d/sub/z.bin"):
+        c.put_object("bkt", k, b"abc")
+    fs = pafs.PyFileSystem(S3FileSystemHandler(c))
+    rec = fs.get_file_info(pafs.FileSelector("bkt/d", recursive=True))
+    assert sorted(i.path for i in rec) == \
+        ["bkt/d/sub/z.bin", "bkt/d/x.bin", "bkt/d/y.bin"]
+    flat = fs.get_file_info(pafs.FileSelector("bkt/d", recursive=False))
+    assert {i.path: i.type for i in flat} == \
+        {"bkt/d/sub": pafs.FileType.Directory,
+         "bkt/d/x.bin": pafs.FileType.File,
+         "bkt/d/y.bin": pafs.FileType.File}
+    with pytest.raises(FileNotFoundError):
+        fs.get_file_info(pafs.FileSelector("bkt/nope", recursive=True))
+    assert fs.get_file_info(pafs.FileSelector("bkt/nope", recursive=True,
+                                              allow_not_found=True)) == []
+    assert not store.bad_auth, store.bad_auth[:1]
 
 
 def test_anonymous_requests_unsigned(monkeypatch):
