@@ -71,6 +71,18 @@ class ExecutionConfig:
     # Distributed
     num_workers: int = 0  # 0 = autodetect / local
     autoscaling_threshold: float = 1.25
+    # Fault tolerance (distributed/faults.py, distributed/scheduler.py)
+    task_max_retries: int = 3           # per-task attempt budget (all causes)
+    task_transient_backoff_s: float = 0.05   # base backoff for transient retries
+    task_transient_backoff_cap_s: float = 2.0
+    max_partition_recoveries: int = 32  # per-query lineage-recompute budget
+    speculative_execution: bool = False  # duplicate straggler tasks
+    speculative_multiplier: float = 3.0  # straggler = > mult x median duration
+    speculative_min_completed: int = 3   # need this many samples for a median
+    heartbeat_interval_s: float = 5.0    # worker liveness probe period
+    heartbeat_miss_threshold: int = 3    # consecutive misses -> mark dead
+    fault_spec: Optional[str] = None     # DAFT_FAULT_SPEC (see faults.py)
+    fault_seed: int = 0
 
     def with_changes(self, **kwargs) -> "ExecutionConfig":
         return dataclasses.replace(self, **kwargs)
@@ -86,4 +98,10 @@ class ExecutionConfig:
             changes["device_eval"] = False
         if os.environ.get("DAFT_SHUFFLE_ALGORITHM"):
             changes["shuffle_algorithm"] = os.environ["DAFT_SHUFFLE_ALGORITHM"]
+        if os.environ.get("DAFT_FAULT_SPEC"):
+            changes["fault_spec"] = os.environ["DAFT_FAULT_SPEC"]
+        if os.environ.get("DAFT_FAULT_SEED"):
+            changes["fault_seed"] = int(os.environ["DAFT_FAULT_SEED"])
+        if os.environ.get("DAFT_SPECULATION") in ("1", "true"):
+            changes["speculative_execution"] = True
         return cfg.with_changes(**changes) if changes else cfg

@@ -154,9 +154,12 @@ class WorkerDaemon:
                     continue
                 op = msg.get("op")
                 if op == "ping":
+                    # Doubles as the heartbeat channel: drivers probe with a
+                    # short deadline and count silence as a missed beat.
                     _send_frame(conn, cloudpickle.dumps(
                         {"ok": True, "worker_id": self.worker_id,
-                         "slots": self.slots, "flight": self.flight_address}))
+                         "slots": self.slots, "flight": self.flight_address,
+                         "active": self._active}))
                 elif op == "run_task":
                     # The pool caps concurrent executions at `slots` even
                     # with many connections (per-chip ownership on TPU hosts).
@@ -215,7 +218,22 @@ class WorkerDaemon:
         except BaseException as e:  # noqa: BLE001
             import traceback
 
-            return {"ok": False, "error": f"{e}\n{traceback.format_exc()}"}
+            # Classify so the driver can keep its typed failure handling
+            # (transient retry / lineage recovery) across the wire, where
+            # exceptions travel as strings.
+            from daft_tpu.distributed.scheduler import (
+                find_fetch_failure,
+                is_transient_failure,
+            )
+
+            reply = {"ok": False, "error": f"{e}\n{traceback.format_exc()}"}
+            fetch = find_fetch_failure(e)
+            if fetch is not None:
+                reply["kind"] = "fetch"
+                reply["lost"] = fetch.lost
+            elif is_transient_failure(e):
+                reply["kind"] = "transient"
+            return reply
         finally:
             with self._lock:
                 self._active -= 1
@@ -267,7 +285,17 @@ class RemoteWorker(Worker):
             raise WorkerDiedError(
                 f"worker at {self.address} unreachable: {e}") from e
         if not reply.get("ok"):
-            raise RuntimeError(reply.get("error", "unknown daemon error"))
+            err = reply.get("error", "unknown daemon error")
+            kind = reply.get("kind")
+            if kind == "fetch":
+                from daft_tpu.distributed.partition_ref import PartitionFetchError
+
+                raise PartitionFetchError(err, reply.get("lost") or [])
+            if kind == "transient":
+                from daft_tpu.errors import DaftTransientError
+
+                raise DaftTransientError(err)
+            raise RuntimeError(err)
         return reply
 
     def submit(self, task: Task) -> "Future[List[PartitionRef]]":
@@ -300,6 +328,13 @@ class RemoteWorker(Worker):
                     self._active -= 1
 
         def runner():
+            # Honor a cancel() that lands before execution starts (dispatcher
+            # abort): the task is skipped entirely. Once running, cancel()
+            # fails and the abort path drains us instead.
+            if not fut.set_running_or_notify_cancel():
+                with self._lock:
+                    self._active -= 1
+                return
             try:
                 fut.set_result(run())
             except BaseException as e:  # noqa: BLE001
@@ -311,6 +346,16 @@ class RemoteWorker(Worker):
 
     def active_tasks(self) -> int:
         return self._active
+
+    def heartbeat(self) -> bool:
+        """Liveness probe: a quick ping with a short deadline. A daemon that
+        cannot answer within 2s counts as a missed beat (the monitor marks it
+        dead only after ``heartbeat_miss_threshold`` consecutive misses)."""
+        try:
+            self._request({"op": "ping"}, timeout=2.0)
+            return True
+        except Exception:
+            return False
 
     def kill(self) -> None:
         """Fault injection: crash the remote daemon process."""

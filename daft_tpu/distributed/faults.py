@@ -1,0 +1,268 @@
+"""Deterministic fault-injection framework.
+
+Reference discipline: src/daft-io/src/mock.rs (scheduled mock-store failures)
+generalised to the whole engine, following the chaos-testing pattern of
+lineage-recovering systems (Spark RDD lineage, Ray task reconstruction): make
+failure a first-class, *testable* input. A seeded :class:`FaultInjector`
+holds named injection points; production code calls
+:func:`maybe_inject(point, **ctx)` at those points (near-zero cost when no
+injector is active), and an active injector can raise, delay, kill a worker,
+or kill the whole process on a chosen hit — deterministically, so a CI
+failure reproduces from its seed + spec.
+
+Injection points wired in the engine:
+
+==================== =======================================================
+``worker.pre_submit``  dispatcher, just before ``worker.submit(task)``
+                       (ctx: ``task``, ``worker``)
+``shuffle.fetch``      worker-side input fetch of a PartitionRef
+                       (ctx: ``ref``, ``worker_id``) and the Flight client
+``io.get_object``      object-store get: scan-task file reads + ranged reads
+                       (ctx: ``path``)
+``daemon.heartbeat``   heartbeat probe of a worker (ctx: ``worker``); the
+                       ``drop`` action makes the probe count as missed
+==================== =======================================================
+
+Spec grammar (``DAFT_FAULT_SPEC`` / ``ExecutionConfig.fault_spec`` /
+:func:`fault_scope`): comma-separated clauses
+
+    point:action[:when[:arg]]
+
+where ``when`` is ``N`` (fire on the Nth hit only, 1-based), ``*`` (every
+hit), ``N+`` (every hit from the Nth on), or ``p0.25`` (each hit with
+probability 0.25 from the seeded RNG), and ``arg`` is an action parameter
+(seconds for ``delay``). Actions: ``raise``, ``raise_transient``,
+``raise_worker_died``, ``delay``, ``kill`` (ctx worker's ``.kill()``),
+``die`` (``os._exit`` — daemon process crash), ``drop`` (soft signal
+returned to the caller).
+
+Example: ``DAFT_FAULT_SPEC='worker.pre_submit:kill:3,io.get_object:raise_transient:1'``
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import random
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, Iterator, List, Optional, Union
+
+from daft_tpu.errors import DaftExecutionError, DaftTransientError
+
+KNOWN_POINTS = (
+    "worker.pre_submit",
+    "shuffle.fetch",
+    "io.get_object",
+    "daemon.heartbeat",
+)
+
+_ACTIONS = ("raise", "raise_transient", "raise_worker_died", "delay", "kill",
+            "die", "drop")
+
+
+class FaultInjected(DaftExecutionError):
+    """Raised by the ``raise`` action; marks the failure as injected."""
+
+    def __init__(self, point: str, hit: int):
+        super().__init__(f"injected fault at {point} (hit #{hit})")
+        self.point = point
+        self.hit = hit
+
+
+@dataclass
+class FaultSpec:
+    """One armed fault: fire ``action`` at injection point ``point`` when the
+    per-point hit counter matches ``when``."""
+
+    point: str
+    action: str
+    when: Union[int, str, float, None] = 1  # N | "N+" | "*" | p<float via prob
+    prob: Optional[float] = None
+    arg: Optional[float] = None
+    fired: int = 0
+
+    def should_fire(self, hit: int, rng: random.Random) -> bool:
+        if self.prob is not None:
+            return rng.random() < self.prob
+        if self.when == "*" or self.when is None:
+            return True
+        if isinstance(self.when, str) and self.when.endswith("+"):
+            return hit >= int(self.when[:-1])
+        return hit == int(self.when)
+
+
+def parse_fault_spec(spec: str) -> List[FaultSpec]:
+    out: List[FaultSpec] = []
+    for clause in spec.split(","):
+        clause = clause.strip()
+        if not clause:
+            continue
+        parts = clause.split(":")
+        if len(parts) < 2:
+            raise ValueError(f"bad fault clause {clause!r}: need point:action")
+        point, action = parts[0].strip(), parts[1].strip()
+        if action not in _ACTIONS:
+            raise ValueError(f"unknown fault action {action!r} "
+                             f"(choose from {_ACTIONS})")
+        when: Union[int, str] = 1
+        prob: Optional[float] = None
+        if len(parts) > 2 and parts[2]:
+            w = parts[2].strip()
+            if w == "*" or w.endswith("+"):
+                when = w
+            elif w.startswith("p"):
+                prob = float(w[1:])
+            else:
+                when = int(w)
+        arg = float(parts[3]) if len(parts) > 3 and parts[3] else None
+        out.append(FaultSpec(point, action, when=when, prob=prob, arg=arg))
+    return out
+
+
+class FaultInjector:
+    """Seeded registry of armed faults, keyed by injection point.
+
+    Deterministic: per-point hit counters plus a seeded RNG (only consulted
+    by probabilistic clauses) make every run with the same (spec, seed, task
+    order) fire identically.
+    """
+
+    def __init__(self, specs: Union[str, List[FaultSpec], None] = None,
+                 seed: int = 0):
+        if isinstance(specs, str):
+            specs = parse_fault_spec(specs)
+        self.specs: List[FaultSpec] = list(specs or [])
+        self.seed = seed
+        self._rng = random.Random(seed)
+        self._hits: Dict[str, int] = {}
+        self._fired: Dict[str, int] = {}
+        self._lock = threading.Lock()
+
+    def add(self, point: str, action: str, when: Union[int, str] = 1,
+            prob: Optional[float] = None, arg: Optional[float] = None) -> "FaultInjector":
+        self.specs.append(FaultSpec(point, action, when=when, prob=prob, arg=arg))
+        return self
+
+    # -- introspection (test assertions) --------------------------------- #
+    def hits(self, point: str) -> int:
+        with self._lock:
+            return self._hits.get(point, 0)
+
+    def fired(self, point: Optional[str] = None) -> int:
+        with self._lock:
+            if point is None:
+                return sum(self._fired.values())
+            return self._fired.get(point, 0)
+
+    # -- the hook --------------------------------------------------------- #
+    def hit(self, point: str, **ctx) -> Optional[str]:
+        """Record a hit at ``point``; fire any matching armed fault.
+
+        Returns a soft-signal string (``"drop"``) for actions the caller must
+        interpret, else ``None``. May raise or sleep.
+        """
+        with self._lock:
+            n = self._hits.get(point, 0) + 1
+            self._hits[point] = n
+            to_fire = [s for s in self.specs
+                       if s.point == point and s.should_fire(n, self._rng)]
+            for s in to_fire:
+                s.fired += 1
+                self._fired[point] = self._fired.get(point, 0) + 1
+        signal: Optional[str] = None
+        for s in to_fire:
+            if s.action == "raise":
+                raise FaultInjected(point, n)
+            if s.action == "raise_transient":
+                raise DaftTransientError(
+                    f"injected transient fault at {point} (hit #{n})")
+            if s.action == "raise_worker_died":
+                from daft_tpu.distributed.worker import WorkerDiedError
+
+                raise WorkerDiedError(
+                    f"injected worker death at {point} (hit #{n})")
+            if s.action == "delay":
+                time.sleep(s.arg if s.arg is not None else 0.1)
+            elif s.action == "kill":
+                worker = ctx.get("worker")
+                if worker is not None and hasattr(worker, "kill"):
+                    worker.kill()
+                signal = "kill"
+            elif s.action == "die":
+                # Whole-process crash — the daemon's guarded kill switch.
+                if os.environ.get("DAFT_DAEMON_ALLOW_FAULT_INJECTION"):
+                    os._exit(17)
+                raise FaultInjected(point, n)
+            elif s.action == "drop":
+                signal = "drop"
+        return signal
+
+
+# --------------------------------------------------------------------- #
+# Global injector plumbing                                                #
+# --------------------------------------------------------------------- #
+_INJECTOR: Optional[FaultInjector] = None
+_ENV_CHECKED = False
+_GUARD = threading.Lock()
+
+
+def active_injector() -> Optional[FaultInjector]:
+    """The currently-armed injector: an explicit :func:`install_injector` /
+    :func:`fault_scope` wins; otherwise ``DAFT_FAULT_SPEC`` from the
+    environment is parsed once and cached."""
+    global _INJECTOR, _ENV_CHECKED
+    if _INJECTOR is not None:
+        return _INJECTOR
+    if not _ENV_CHECKED:
+        with _GUARD:
+            if not _ENV_CHECKED:
+                spec = os.environ.get("DAFT_FAULT_SPEC")
+                if spec:
+                    _INJECTOR = FaultInjector(
+                        spec, seed=int(os.environ.get("DAFT_FAULT_SEED", "0")))
+                _ENV_CHECKED = True
+    return _INJECTOR
+
+
+def install_injector(injector: Optional[FaultInjector]) -> None:
+    global _INJECTOR
+    _INJECTOR = injector
+
+
+@contextlib.contextmanager
+def config_fault_scope(cfg) -> Iterator[Optional["FaultInjector"]]:
+    """Arm an injector from ``ExecutionConfig.fault_spec`` for ONE query's
+    duration, unless one is already active (explicit scope / env both win).
+    Scoped, not sticky: the spec and its hit counters never leak into the
+    next query — 'Nth hit' means the Nth hit of THIS query."""
+    spec = getattr(cfg, "fault_spec", None)
+    if not spec or active_injector() is not None:
+        yield None
+        return
+    with fault_scope(FaultInjector(spec, seed=getattr(cfg, "fault_seed", 0))) as inj:
+        yield inj
+
+
+@contextlib.contextmanager
+def fault_scope(spec: Union[str, FaultInjector, List[FaultSpec]],
+                seed: int = 0) -> Iterator[FaultInjector]:
+    """Arm an injector for the duration of a block (tests / chaos loops)."""
+    global _INJECTOR
+    injector = spec if isinstance(spec, FaultInjector) else FaultInjector(spec, seed)
+    prev = _INJECTOR
+    _INJECTOR = injector
+    try:
+        yield injector
+    finally:
+        _INJECTOR = prev
+
+
+def maybe_inject(point: str, **ctx) -> Optional[str]:
+    """Production-code hook: no-op (two attribute loads) when no injector is
+    armed."""
+    inj = active_injector()
+    if inj is None:
+        return None
+    return inj.hit(point, **ctx)

@@ -59,7 +59,11 @@ _client_lock = threading.Lock()
 
 
 def fetch_partition(address: str, ticket: str) -> MicroPartition:
-    """Pull one shuffle partition from a worker's flight server."""
+    """Pull one shuffle partition from a worker's flight server.
+
+    (No ``shuffle.fetch`` injection point here: every task-input fetch —
+    local or Flight — already routes through ``worker.fetch_task_input``,
+    which fires it exactly once per logical fetch.)"""
     with _client_lock:
         client = _client_cache.get(address)
         if client is None:

@@ -14,9 +14,24 @@ from typing import List, Optional
 
 import pyarrow as pa
 
+from daft_tpu.errors import DaftExecutionError
 from daft_tpu.micropartition import MicroPartition
 from daft_tpu.recordbatch import RecordBatch
 from daft_tpu.schema import Schema
+
+
+class PartitionFetchError(DaftExecutionError):
+    """A task could not fetch one of its input partitions (host dead /
+    unreachable / cache evicted). Carries enough to drive lineage recovery:
+    ``lost`` is a list of ``{"slot": int, "pos": int, "worker_id": str|None}``
+    descriptors locating the unfetchable refs within ``task.inputs``."""
+
+    def __init__(self, message: str, lost: Optional[List[dict]] = None):
+        super().__init__(message)
+        self.lost: List[dict] = lost or []
+
+    def __reduce__(self):
+        return (PartitionFetchError, (self.args[0], self.lost))
 
 
 class PartitionRef:

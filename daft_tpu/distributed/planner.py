@@ -20,15 +20,20 @@ Workers only see local physical plans; only PartitionRefs move between hosts.
 from __future__ import annotations
 
 import copy
+import threading
 from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from daft_tpu.distributed.partition_ref import LocalPartitionRef, PartitionRef
+from daft_tpu.distributed.partition_ref import (
+    LocalPartitionRef,
+    PartitionFetchError,
+    PartitionRef,
+)
 from daft_tpu.distributed.scheduler import Dispatcher, Scheduler
 from daft_tpu.distributed.task import BoundInput, SchedulingStrategy, Task
-from daft_tpu.distributed.worker import WorkerManager
-from daft_tpu.errors import DaftPlanError
+from daft_tpu.distributed.worker import WorkerManager, fetch_task_input
+from daft_tpu.errors import DaftExecutionError, DaftPlanError
 from daft_tpu.expressions.expr import ColumnRef
 from daft_tpu.micropartition import MicroPartition
 from daft_tpu.physical import plan as pp
@@ -38,13 +43,90 @@ _NARROW = (pp.Project, pp.UDFProject, pp.Filter, pp.Explode, pp.Unpivot,
            pp.MonotonicallyIncreasingId)
 
 
+class LineageTracker:
+    """Driver-side lineage: which task produced each PartitionRef.
+
+    The Spark-RDD recovery idea applied to the task graph: every dispatched
+    task plus its input refs IS the lineage of its outputs, so a partition
+    lost to a dead worker can be recomputed by re-running its producer (whose
+    own lost inputs recover recursively through the same mechanism).
+
+    Memory: output refs are tracked through weakrefs, and a producer task is
+    kept alive only by the map entries of its still-living output refs — so
+    lineage never extends the lifetime of a ref (or the intermediate data a
+    task's inputs pin) beyond the query's reachable working set. Replaced
+    (lost) refs are pinned strongly, bounded by the per-query recovery
+    budget, so their dict keys can't be recycled by a new object at the same
+    address."""
+
+    def __init__(self):
+        self._producer: dict = {}      # id(ref) -> (Task, output_index)
+        self._outputs: dict = {}       # id(task) -> List[weakref to ref]
+        self._replacement: dict = {}   # id(lost ref) -> replacement ref
+        self._replaced_keep: list = [] # lost refs w/ replacements (budget-bounded)
+
+    def record(self, task: Task, outputs: List[PartitionRef]) -> None:
+        import weakref
+
+        # No strong task registry: a producer Task is kept alive ONLY by its
+        # _producer entries, which die with its output refs. When the last
+        # output ref becomes unreachable, the task (and, transitively, the
+        # upstream refs its .inputs pin) becomes collectable — lineage
+        # tracks the reachable cone of the query, not its full history.
+        tkey = id(task)
+        try:
+            weakref.finalize(task, self._outputs.pop, tkey, None)
+        except TypeError:
+            self._replaced_keep.append(task)
+        wrefs = []
+        for j, ref in enumerate(outputs):
+            key = id(ref)
+            self._producer[key] = (task, j)
+            try:
+                # On collection, drop the id-keyed entry so a recycled id
+                # can never resolve to a stale producer.
+                wr = weakref.ref(ref, lambda _, k=key: self._producer.pop(k, None))
+            except TypeError:  # non-weakrefable ref type: pin it
+                self._replaced_keep.append(ref)
+                wr = (lambda r=ref: r)
+            wrefs.append(wr)
+        self._outputs[tkey] = wrefs
+
+    def producer(self, ref: PartitionRef):
+        return self._producer.get(id(ref))
+
+    def outputs_of(self, task: Task) -> Optional[List[Optional[PartitionRef]]]:
+        wrefs = self._outputs.get(id(task))
+        if wrefs is None:
+            return None
+        return [wr() for wr in wrefs]  # collected outputs surface as None
+
+    def replacement(self, ref: PartitionRef) -> PartitionRef:
+        """Latest live replacement for ``ref`` (transitively)."""
+        seen = set()
+        while id(ref) in self._replacement and id(ref) not in seen:
+            seen.add(id(ref))
+            ref = self._replacement[id(ref)]
+        return ref
+
+    def replace(self, old: PartitionRef, new: PartitionRef) -> None:
+        self._replacement[id(old)] = new
+        # Pin the OLD ref: its id is now a live dict key and must not be
+        # recycled. Bounded by max_partition_recoveries per query.
+        self._replaced_keep.append(old)
+
+
 class DistributedExecutor:
     def __init__(self, manager: WorkerManager, cfg, query_id: str = ""):
         self.manager = manager
         self.cfg = cfg
         self.query_id = query_id
         self.scheduler = Scheduler(manager, cfg.autoscaling_threshold)
-        self.dispatcher = Dispatcher(self.scheduler)
+        self.lineage = LineageTracker()
+        self.dispatcher = Dispatcher(self.scheduler, cfg=cfg,
+                                     recovery=self._recover_task_inputs)
+        self._recoveries = 0
+        self._recovery_lock = threading.Lock()
         self._shared_ids: set = set()
         self._subplan_cache: dict = {}
 
@@ -62,7 +144,100 @@ class DistributedExecutor:
         for t in tasks:
             t.query_id = self.query_id
             t.cfg = self.cfg  # the QUERY's config rides with the task
-        return self.dispatcher.run_tasks(tasks)
+        results = self.dispatcher.run_tasks(tasks)
+        # Record lineage: each output ref is recomputable from its producer.
+        for t, refs in zip(tasks, results):
+            self.lineage.record(t, refs)
+        return results
+
+    # -- lineage recovery -------------------------------------------------- #
+    def _recover_task_inputs(self, task: Task, lost: List[dict]) -> bool:
+        """Dispatcher hook: repair ``task.inputs`` after a fetch failure by
+        recomputing the lost partitions' producer tasks on live workers.
+        Returns False when lineage is unknown or the per-query recovery
+        budget is spent; True after swapping repaired refs in-place."""
+        from daft_tpu.context import get_context
+        from daft_tpu.subscribers.events import PartitionRecovered
+
+        # Mark the lost refs' hosts dead FIRST (idempotent), so recompute
+        # clones never get scheduled onto them — this covers the driver-side
+        # fetch_output path too, which has no dispatcher doing it for us.
+        for d in lost:
+            wid = d.get("worker_id")
+            if wid:
+                self.manager.mark_dead(wid, reason="unreachable")
+        by_producer: dict = {}  # id(producer task) -> producer Task
+        swaps: List[Tuple[int, int, PartitionRef]] = []
+        for d in lost:
+            slot, pos = d.get("slot", 0), d.get("pos", 0)
+            try:
+                ref = task.inputs[slot][pos]
+            except (IndexError, TypeError):
+                return False
+            # Another task may already have paid for this ref's recompute.
+            live = self.lineage.replacement(ref)
+            if live is not ref:
+                swaps.append((slot, pos, live))
+                continue
+            producer = self.lineage.producer(ref)
+            if producer is None:
+                return False  # driver-resident input (no lineage) — fatal
+            if producer[0].side_effecting:
+                # Re-running a write would duplicate its durable output
+                # files — the same refusal the dispatcher makes for
+                # speculation and wedged-worker reaping.
+                return False
+            by_producer[id(producer[0])] = producer[0]
+        budget = getattr(self.cfg, "max_partition_recoveries", 32)
+        if by_producer:
+            with self._recovery_lock:
+                if self._recoveries + len(by_producer) > budget:
+                    return False
+                self._recoveries += len(by_producer)
+                n = self._recoveries
+            clones = [p.recovery_clone(n) for p in by_producer.values()]
+            # Recompute runs through the same dispatcher: retries, further
+            # recovery (cascading loss), and events all apply recursively.
+            recomputed = self._dispatch(clones)
+            notify = get_context().notify
+            for original, clone, new_refs in zip(by_producer.values(), clones,
+                                                 recomputed):
+                old_refs = self.lineage.outputs_of(original) or []
+                # EVERY output of the dead producer gets a replacement — other
+                # consumers of sibling buckets repair without recomputing.
+                # (A None means that output ref was already collected: nothing
+                # can still reference it, so it needs no replacement.)
+                for old, new in zip(old_refs, new_refs):
+                    if old is not None:
+                        self.lineage.replace(old, new)
+                notify(PartitionRecovered(
+                    query_id=self.query_id, task_id=clone.task_id,
+                    worker_id=next((d.get("worker_id") or "" for d in lost), ""),
+                    num_partitions=len(new_refs)))
+            for d in lost:
+                slot, pos = d.get("slot", 0), d.get("pos", 0)
+                live = self.lineage.replacement(task.inputs[slot][pos])
+                swaps.append((slot, pos, live))
+        for slot, pos, live in swaps:
+            task.inputs[slot][pos] = live
+        return True
+
+    def fetch_output(self, ref: PartitionRef):
+        """Driver-side fetch of a query output partition, with the same
+        lineage recovery the workers get: a result hosted on a worker that
+        died after producing it is recomputed instead of failing collect.
+        Loops through the checked fetch path so a replacement lost to a
+        SECOND death recovers too — bounded by the per-query recovery
+        budget, which makes _recover_task_inputs eventually return False."""
+        carrier = Task(BoundInput(0, None), [[self.lineage.replacement(ref)]])
+        carrier.query_id = self.query_id
+        while True:
+            try:
+                return fetch_task_input(carrier.inputs[0][0], 0, 0)
+            except PartitionFetchError as e:
+                if not self._recover_task_inputs(carrier, e.lost):
+                    raise DaftExecutionError(
+                        f"query output partition unrecoverable: {e}") from e
 
     def _chain_over(self, chain: List[pp.PhysicalPlan], leaf: pp.PhysicalPlan) -> pp.PhysicalPlan:
         """Rebuild a narrow chain (outermost first) over a new leaf."""
@@ -290,7 +465,10 @@ class DistributedExecutor:
         tasks = [Task(sample_frag(BoundInput(0, child_schema)), [[r]], partition_idx=i)
                  for i, r in enumerate(refs)]
         sample_refs = [r[0] for r in self._dispatch(tasks)]
-        samples = MicroPartition.concat([r.fetch() for r in sample_refs]).combined()
+        # fetch_output, not raw fetch: a worker dying between the sample
+        # stage and this driver-side concat recovers through lineage.
+        samples = MicroPartition.concat(
+            [self.fetch_output(r) for r in sample_refs]).combined()
         if len(samples) == 0:
             boundaries = RecordBatch.empty(sample_schema)
             num_out = 1
@@ -487,7 +665,8 @@ class DistributedExecutor:
         tasks = []
         for i, ref in enumerate(refs):
             frag = pp.Write(BoundInput(0, child_schema), node.write_info, node.schema)
-            tasks.append(Task(frag, [[ref]], partition_idx=i))
+            tasks.append(Task(frag, [[ref]], partition_idx=i,
+                              side_effecting=True))
         result_refs = [r[0] for r in self._dispatch(tasks)]
         # Commit: concat per-partition write manifests (reference:
         # commit_write sink gathering file metadata).

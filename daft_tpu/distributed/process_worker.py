@@ -36,7 +36,7 @@ from daft_tpu.distributed.partition_ref import (
     serialize_partition,
 )
 from daft_tpu.distributed.task import Task
-from daft_tpu.distributed.worker import Worker, WorkerDiedError
+from daft_tpu.distributed.worker import Worker, WorkerDiedError, fetch_task_input
 
 _LEN = struct.Struct("<Q")
 
@@ -106,10 +106,15 @@ def _worker_entry(fd: int) -> None:
         except BaseException as e:  # noqa: BLE001
             import traceback
 
+            from daft_tpu.distributed.scheduler import is_transient_failure
+
+            reply = {"ok": False, "error": f"{e}\n{traceback.format_exc()}"}
+            if is_transient_failure(e):
+                # Keep the driver's typed transient-retry handling across the
+                # process boundary, where exceptions travel as strings.
+                reply["kind"] = "transient"
             try:
-                _send_frame(sock, cloudpickle.dumps(
-                    {"ok": False, "error": f"{e}\n{traceback.format_exc()}"}
-                ))
+                _send_frame(sock, cloudpickle.dumps(reply))
             except Exception:
                 return
 
@@ -157,6 +162,9 @@ class ProcessWorker(Worker):
         """Hard-kill the subprocess (fault injection / retire)."""
         self._proc.kill()
 
+    def heartbeat(self) -> bool:
+        return self._proc.poll() is None
+
     def submit(self, task: Task) -> "Future[List[PartitionRef]]":
         fut: "Future[List[PartitionRef]]" = Future()
         # Count queued work synchronously (before the thread even starts) so
@@ -172,9 +180,13 @@ class ProcessWorker(Worker):
                     payload = {
                         "cfg": task.cfg or self.cfg,
                         "fragment": task.fragment,
+                        # fetch_task_input: fetch failures surface as
+                        # PartitionFetchError -> lineage recovery, not a
+                        # query-fatal error.
                         "inputs": [
-                            [serialize_partition(r.fetch()) for r in slot]
-                            for slot in task.inputs
+                            [serialize_partition(fetch_task_input(r, si, pi))
+                             for pi, r in enumerate(slot)]
+                            for si, slot in enumerate(task.inputs)
                         ],
                         "partition_idx": task.partition_idx,
                         "expect_outputs": task.expect_outputs,
@@ -190,6 +202,10 @@ class ProcessWorker(Worker):
                         ) from e
                     result = cloudpickle.loads(msg)
                     if not result["ok"]:
+                        if result.get("kind") == "transient":
+                            from daft_tpu.errors import DaftTransientError
+
+                            raise DaftTransientError(result["error"])
                         raise RuntimeError(result["error"])
                     from daft_tpu.execution.resource_manager import (
                         emit_operator_stats,
@@ -205,6 +221,12 @@ class ProcessWorker(Worker):
                     self._active -= 1
 
         def runner():
+            # A cancel() before execution starts (dispatcher abort) skips the
+            # task; once running, cancel() fails and the abort path drains.
+            if not fut.set_running_or_notify_cancel():
+                with self._active_lock:
+                    self._active -= 1
+                return
             try:
                 fut.set_result(run())
             except BaseException as e:  # noqa: BLE001

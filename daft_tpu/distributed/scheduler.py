@@ -5,19 +5,41 @@ Reference: src/daft-distributed/src/scheduling — ``DefaultScheduler``
 mapping failures to ``WorkerDied``/``WorkerUnavailable`` and **rescheduling the
 task elsewhere** (dispatcher.rs:100-140), and the autoscale request at
 pending-demand > 1.25× capacity (default.rs:22-44).
+
+This dispatcher extends the reference's WorkerDied handling into a full
+fault-tolerance layer:
+
+* **transient task errors** (``DaftTransientError`` anywhere in the cause
+  chain — e.g. an object-store blip inside a scan) are retried with
+  exponential backoff under the same per-task attempt budget;
+* **lost input partitions** (``PartitionFetchError`` from a task that could
+  not fetch an input hosted on a dead worker) are repaired through a
+  pluggable ``recovery`` hook (lineage recomputation, planner.py) and the
+  task re-queued without consuming its attempt budget — the per-query
+  recovery budget bounds that loop instead;
+* **stragglers** are speculatively duplicated once a task runs longer than
+  ``speculative_multiplier ×`` the median completed-task duration; whichever
+  attempt finishes first wins, the loser is cancelled/ignored;
+* any failure **aborts cleanly**: not-yet-started futures are cancelled,
+  running ones drained, so no task keeps mutating state (writes!) after the
+  raise — including failures thrown by ``scheduler.assign`` itself inside
+  the submit loop.
 """
 
 from __future__ import annotations
 
 import itertools
-import threading
+import statistics
+import time
 from concurrent.futures import FIRST_COMPLETED, Future, wait
-from typing import Dict, List, Optional, Sequence, Tuple
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional, Sequence, Set
 
-from daft_tpu.distributed.partition_ref import PartitionRef
+from daft_tpu.distributed.faults import maybe_inject
+from daft_tpu.distributed.partition_ref import PartitionFetchError, PartitionRef
 from daft_tpu.distributed.task import Task
 from daft_tpu.distributed.worker import Worker, WorkerDiedError, WorkerManager
-from daft_tpu.errors import DaftExecutionError
+from daft_tpu.errors import DaftExecutionError, DaftTransientError
 
 
 class Scheduler:
@@ -29,21 +51,34 @@ class Scheduler:
         self.autoscaling_threshold = autoscaling_threshold
         self._rr = itertools.count()
 
-    def assign(self, task: Task) -> Worker:
+    def assign(self, task: Task, exclude: Optional[Set[str]] = None) -> Worker:
         workers = self.manager.workers()
         if not workers:
             raise DaftExecutionError("No live workers")
+        # Exclusions (speculation re-placement) are honored only when an
+        # alternative exists — never strand a task on an empty set.
+        candidates = [w for w in workers
+                      if not exclude or w.worker_id not in exclude] or workers
         if task.strategy.kind == "affinity" and task.strategy.worker_id:
             w = self.manager.get(task.strategy.worker_id)
             if w is not None:
-                return w
-            if not task.strategy.soft:
+                # Hard affinity is a placement CONTRACT (device/data
+                # residency) — it always wins, even over exclude. Soft
+                # affinity yields to an exclusion if any alternative exists.
+                if not task.strategy.soft:
+                    return w
+                if (not exclude or w.worker_id not in exclude
+                        or all(c.worker_id == w.worker_id for c in candidates)):
+                    return w
+            elif not task.strategy.soft:
                 raise DaftExecutionError(
                     f"Hard-affinity worker {task.strategy.worker_id} unavailable"
                 )
         # Spread: least active tasks, round-robin tiebreak.
         idx = next(self._rr)
-        return min(enumerate(workers), key=lambda iw: (iw[1].active_tasks(), (iw[0] + idx) % len(workers)))[1]
+        return min(enumerate(candidates),
+                   key=lambda iw: (iw[1].active_tasks(),
+                                   (iw[0] + idx) % len(candidates)))[1]
 
     def request_autoscale(self, pending: int) -> None:
         capacity = max(self.manager.total_slots(), 1)
@@ -51,68 +86,387 @@ class Scheduler:
             self.manager.try_autoscale(pending)
 
 
+def find_in_chain(e: Optional[BaseException], cls) -> Optional[BaseException]:
+    """First instance of ``cls`` in ``e``'s cause/context chain (cycle-safe)."""
+    seen: Set[int] = set()
+    while e is not None and id(e) not in seen:
+        if isinstance(e, cls):
+            return e
+        seen.add(id(e))
+        e = e.__cause__ or e.__context__
+    return None
+
+
+def is_transient_failure(e: Optional[BaseException]) -> bool:
+    """True if ``e`` or anything in its cause/context chain is transient."""
+    return find_in_chain(e, DaftTransientError) is not None
+
+
+def find_fetch_failure(e: Optional[BaseException]) -> Optional[PartitionFetchError]:
+    """The PartitionFetchError in ``e``'s cause/context chain, if any."""
+    return find_in_chain(e, PartitionFetchError)  # type: ignore[return-value]
+
+
+@dataclass
+class _Attempt:
+    """One in-flight execution attempt of a task."""
+
+    idx: int
+    task: Task
+    attempt: int
+    worker: Worker
+    t0: float
+    speculative: bool = False
+
+
+@dataclass(eq=False)  # identity semantics: pending.remove() must be exact
+class _Pending:
+    idx: int
+    task: Task
+    attempt: int
+    not_before: float = 0.0  # monotonic deadline for backoff retries
+
+
 class Dispatcher:
     """Runs a batch of tasks to completion with bounded in-flight tasks,
-    per-task retry on worker death, and ordered results."""
+    per-task retry (worker death / transient errors / repaired inputs),
+    straggler speculation, and ordered results."""
 
-    MAX_TASK_RETRIES = 3
+    MAX_TASK_RETRIES = 3  # default attempt budget (cfg.task_max_retries wins)
 
-    def __init__(self, scheduler: Scheduler, max_inflight: Optional[int] = None):
+    def __init__(self, scheduler: Scheduler, max_inflight: Optional[int] = None,
+                 cfg=None,
+                 recovery: Optional[Callable[[Task, List[dict]], bool]] = None):
         self.scheduler = scheduler
         self.max_inflight = max_inflight
+        self.cfg = cfg
+        # recovery(task, lost_descriptors) -> True if task.inputs was repaired
+        # (lineage recomputation); False means the partitions are gone for good.
+        self.recovery = recovery
+
+    # ------------------------------------------------------------------ #
+    def _config(self):
+        cfg = self.cfg
+        if cfg is None:
+            from daft_tpu.context import get_context
+
+            cfg = get_context().execution_config
+        return cfg
 
     def run_tasks(self, tasks: Sequence[Task]) -> List[List[PartitionRef]]:
-        import time
-
         from daft_tpu.context import get_context
-        from daft_tpu.subscribers.events import TaskCompleted, TaskScheduled
+        from daft_tpu.subscribers.events import (
+            TaskCompleted,
+            TaskRetried,
+            TaskScheduled,
+        )
+
+        cfg = self._config()
+        max_retries = getattr(cfg, "task_max_retries", self.MAX_TASK_RETRIES)
+        backoff_base = getattr(cfg, "task_transient_backoff_s", 0.05)
+        backoff_cap = getattr(cfg, "task_transient_backoff_cap_s", 2.0)
+        speculate = getattr(cfg, "speculative_execution", False)
+        spec_mult = getattr(cfg, "speculative_multiplier", 3.0)
+        # At least one completed sample: the median of an empty list raises.
+        spec_min = max(getattr(cfg, "speculative_min_completed", 3), 1)
 
         notify = get_context().notify
         results: Dict[int, List[PartitionRef]] = {}
-        pending: List[Tuple[int, Task, int]] = [(i, t, 0) for i, t in enumerate(tasks)]
-        inflight: Dict[Future, Tuple[int, Task, int, Worker, float]] = {}
+        pending: List[_Pending] = [_Pending(i, t, 0) for i, t in enumerate(tasks)]
+        inflight: Dict[Future, _Attempt] = {}
+        done_idx: Set[int] = set()
+        speculated: Set[int] = set()
+        durations: List[float] = []
         limit = self.max_inflight or max(self.scheduler.manager.total_slots(), 1)
         self.scheduler.request_autoscale(len(pending))
         failure: Optional[BaseException] = None
-        while pending or inflight:
-            while pending and len(inflight) < limit:
-                idx, task, attempt = pending.pop(0)
-                worker = self.scheduler.assign(task)
-                notify(TaskScheduled(query_id=task.query_id, task_id=task.task_id,
-                                     worker_id=worker.worker_id))
-                fut = worker.submit(task)
-                inflight[fut] = (idx, task, attempt, worker, time.perf_counter())
-            done, _ = wait(list(inflight.keys()), return_when=FIRST_COMPLETED)
-            for fut in done:
-                idx, task, attempt, worker, t0 = inflight.pop(fut)
-                err: Optional[str] = None
+
+        def attempts_inflight(idx: int) -> int:
+            return sum(1 for a in inflight.values() if a.idx == idx)
+
+        def submit(rec_idx: int, task: Task, attempt: int, *,
+                   speculative: bool = False,
+                   exclude: Optional[Set[str]] = None) -> None:
+            worker = self.scheduler.assign(task, exclude=exclude)
+            maybe_inject("worker.pre_submit", task=task, worker=worker)
+            notify(TaskScheduled(query_id=task.query_id, task_id=task.task_id,
+                                 worker_id=worker.worker_id))
+            fut = worker.submit(task)
+            inflight[fut] = _Attempt(rec_idx, task, attempt, worker,
+                                     time.monotonic(), speculative)
+
+        def requeue(rec: _Pending, reason: str, worker_id: str,
+                    consume_attempt: bool = True, backoff: bool = False) -> None:
+            attempt = rec.attempt + (1 if consume_attempt else 0)
+            not_before = 0.0
+            if backoff:
+                not_before = time.monotonic() + min(
+                    backoff_base * (2 ** rec.attempt), backoff_cap)
+            notify(TaskRetried(query_id=rec.task.query_id, task_id=rec.task.task_id,
+                               worker_id=worker_id, attempt=attempt, reason=reason))
+            pending.append(_Pending(rec.idx, rec.task, attempt, not_before))
+
+        # The extra `failure` term matters when the FINAL in-flight attempt
+        # fails: pending and inflight are both empty, but the abort path at
+        # the top of the loop still has to run (and raise).
+        while pending or inflight or failure is not None:
+            # ---- submit phase -------------------------------------------
+            if failure is None:
                 try:
-                    results[idx] = fut.result()
-                except WorkerDiedError as e:
-                    # Mark dead and reschedule elsewhere (reference
-                    # dispatcher.rs:100-140 WorkerDied handling).
-                    err = str(e)
-                    self.scheduler.manager.mark_dead(worker.worker_id)
-                    if attempt + 1 >= self.MAX_TASK_RETRIES:
-                        failure = DaftExecutionError(
-                            f"Task {task.task_id} failed after {attempt + 1} attempts"
-                        )
+                    now = time.monotonic()
+                    eligible = [p for p in pending if p.not_before <= now]
+                    while eligible and len(inflight) < limit:
+                        rec = eligible.pop(0)
+                        pending.remove(rec)
+                        if rec.idx in done_idx:
+                            continue  # stale retry of an already-won task
+                        submit(rec.idx, rec.task, rec.attempt)
+                except BaseException as e:  # noqa: BLE001 — assign/submit blew up
+                    # (e.g. "No live workers"): abort/drain like a task failure
+                    # instead of leaving inflight tasks mutating state.
+                    # Interrupts (KeyboardInterrupt/SystemExit) still drain,
+                    # but re-raise AS THEMSELVES — never wrapped in DaftError.
+                    if isinstance(e, DaftExecutionError) or not isinstance(e, Exception):
+                        failure = e
                     else:
-                        pending.append((idx, task, attempt + 1))
-                except Exception as e:  # noqa: BLE001
-                    err = str(e)
-                    failure = DaftExecutionError(f"Task {task.task_id} failed: {e}")
-                    failure.__cause__ = e
-                notify(TaskCompleted(
-                    query_id=task.query_id, task_id=task.task_id,
-                    worker_id=worker.worker_id,
-                    duration_s=time.perf_counter() - t0, error=err))
+                        failure = DaftExecutionError(f"Task submission failed: {e}")
+                        failure.__cause__ = e
             if failure is not None:
-                # Abort cleanly: stop submitting, drain in-flight work so no
-                # task keeps mutating state (writes!) after the raise.
+                # Abort cleanly: cancel not-yet-started work, drain the rest
+                # so no task keeps mutating state (writes!) after the raise.
                 pending.clear()
                 if inflight:
-                    wait(list(inflight.keys()))
+                    still_running = [f for f in inflight if not f.cancel()]
+                    if still_running:
+                        wait(still_running)
                     inflight.clear()
                 raise failure
+            if not inflight:
+                if pending:  # everything is backing off; sleep to the earliest
+                    delay = max(0.0, min(p.not_before for p in pending)
+                                - time.monotonic())
+                    time.sleep(min(delay, backoff_cap) or 0.001)
+                continue
+
+            # ---- wait phase ---------------------------------------------
+            # Only a real backoff deadline (not_before in the future) needs a
+            # timed wakeup; tasks merely waiting for a free slot are unblocked
+            # by FIRST_COMPLETED itself — giving them a timeout would busy-
+            # poll at the floor for the whole query.
+            timeout = None
+            now = time.monotonic()
+            backing_off = [p.not_before for p in pending if p.not_before > now]
+            if backing_off:
+                timeout = max(0.01, min(backing_off) - now)
+            if speculate and len(durations) >= spec_min:
+                timeout = min(timeout or 0.05, 0.05)
+            # Cap the block so asynchronous death detection (heartbeat
+            # monitor marking a partitioned worker dead) is noticed even
+            # when its wedged future never completes.
+            timeout = min(timeout or 5.0, 5.0)
+            done, _ = wait(list(inflight.keys()), timeout=timeout,
+                           return_when=FIRST_COMPLETED)
+
+            # ---- completion phase ---------------------------------------
+            for fut in done:
+                att = inflight.pop(fut, None)
+                if att is None:
+                    continue  # abandoned sibling already dropped this round
+                if att.idx in done_idx:
+                    continue  # defensive: task already won by another attempt
+                err: Optional[str] = None
+                exc: Optional[BaseException] = None
+                try:
+                    res = fut.result()
+                except BaseException as e:  # noqa: BLE001
+                    exc = e
+                    err = str(e)
+                else:
+                    results[att.idx] = res
+                    done_idx.add(att.idx)
+                    durations.append(time.monotonic() - att.t0)
+                    # Abandon still-running sibling attempts: cancel if not
+                    # started, and stop tracking either way — "whichever
+                    # attempt finishes first" must not wait for the loser. A
+                    # done-callback still observes a worker death the loser
+                    # uncovers AFTER being dropped from tracking.
+                    siblings = [(f, a) for f, a in inflight.items()
+                                if a.idx == att.idx]
+                    for f2, a2 in siblings:
+                        f2.cancel()
+                        del inflight[f2]
+
+                        def _observe(f, w=a2.worker):
+                            try:
+                                e2 = f.exception()
+                            except BaseException:  # noqa: BLE001 — cancelled
+                                return
+                            if isinstance(e2, WorkerDiedError):
+                                self.scheduler.manager.mark_dead(
+                                    w.worker_id, reason="worker-died")
+
+                        f2.add_done_callback(_observe)
+                notify(TaskCompleted(
+                    query_id=att.task.query_id, task_id=att.task.task_id,
+                    worker_id=att.worker.worker_id,
+                    duration_s=time.monotonic() - att.t0, error=err))
+                if exc is None:
+                    continue
+                failure = self._handle_attempt_failure(
+                    att, exc, max_retries, requeue, attempts_inflight)
+                if failure is not None:
+                    break
+
+            # ---- dead-worker reaping ------------------------------------
+            # A worker marked dead asynchronously (heartbeat timeout) may
+            # hold wedged futures that will NEVER complete — e.g. a daemon
+            # that network-partitioned mid-task. Fail those attempts as
+            # worker deaths instead of waiting forever.
+            if failure is None:
+                for f, a in [(f, a) for f, a in inflight.items()
+                             if self.scheduler.manager.is_dead(a.worker.worker_id)]:
+                    cancelled = f.cancel()
+                    del inflight[f]
+                    if a.idx in done_idx:
+                        continue
+                    if a.task.side_effecting and not cancelled:
+                        # The write may STILL be running on the unreachable
+                        # worker; re-executing it elsewhere would race
+                        # duplicate output files. Fail the query instead.
+                        failure = DaftExecutionError(
+                            f"write task {a.task.task_id} wedged on dead "
+                            f"worker {a.worker.worker_id}; cannot safely "
+                            f"re-execute a side-effecting task that may "
+                            f"still be running")
+                        break
+                    failure = self._handle_attempt_failure(
+                        a, WorkerDiedError(
+                            f"worker {a.worker.worker_id} marked dead with "
+                            f"task {a.task.task_id} in flight"),
+                        max_retries, requeue, attempts_inflight)
+                    if failure is not None:
+                        break
+
+            # ---- speculation phase --------------------------------------
+            if failure is None and speculate and len(durations) >= spec_min:
+                try:
+                    median = statistics.median(durations)
+                    threshold = max(spec_mult * median, 1e-3)
+                    now = time.monotonic()
+                    for fut, att in list(inflight.items()):
+                        hard_pin = (att.task.strategy.kind == "affinity"
+                                    and not att.task.strategy.soft)
+                        if (att.speculative or att.idx in speculated
+                                or att.idx in done_idx
+                                or hard_pin  # duplicate would land on the same pin
+                                or att.task.side_effecting  # duplicate writes
+                                # leave the loser's files behind — never race
+                                or now - att.t0 <= threshold
+                                or len(inflight) >= limit + 1):
+                            continue
+                        try:
+                            notify(TaskRetried(query_id=att.task.query_id,
+                                               task_id=att.task.task_id,
+                                               worker_id=att.worker.worker_id,
+                                               attempt=att.attempt + 1,
+                                               reason="straggler"))
+                            submit(att.idx, att.task, att.attempt + 1,
+                                   speculative=True,
+                                   exclude={att.worker.worker_id})
+                        except Exception:
+                            # Speculation is an optimization: ANY failure to
+                            # place the duplicate (no spare worker, injected
+                            # fault) just leaves the original running.
+                            pass
+                        speculated.add(att.idx)
+                except BaseException as e:  # noqa: BLE001 — e.g. interrupt:
+                    # abort through the drain path, re-raising interrupts
+                    # as themselves rather than wrapped in a DaftError.
+                    if not isinstance(e, Exception):
+                        failure = e
+                    else:
+                        failure = DaftExecutionError(f"speculation failed: {e}")
+                        failure.__cause__ = e
         return [results[i] for i in range(len(tasks))]
+
+    # ------------------------------------------------------------------ #
+    def _handle_attempt_failure(self, att: _Attempt, exc: BaseException,
+                                max_retries: int, requeue, attempts_inflight
+                                ) -> Optional[BaseException]:
+        """Classify one attempt's failure; requeue or return a fatal error."""
+        if not isinstance(exc, Exception):
+            # SystemExit/KeyboardInterrupt from a task: abort through the
+            # drain path but re-raise AS ITSELF, never wrapped in DaftError.
+            return exc
+        fetch_err = find_fetch_failure(exc)
+        rec = _Pending(att.idx, att.task, att.attempt)
+        if isinstance(exc, WorkerDiedError):
+            # Mark dead and reschedule elsewhere (reference dispatcher.rs:
+            # 100-140 WorkerDied handling).
+            self.scheduler.manager.mark_dead(att.worker.worker_id,
+                                             reason="worker-died")
+            if attempts_inflight(att.idx):
+                return None  # a sibling attempt is still running; let it win
+            if att.attempt + 1 >= max_retries:
+                return DaftExecutionError(
+                    f"Task {att.task.task_id} failed after "
+                    f"{att.attempt + 1} attempts")
+            requeue(rec, "worker-died", att.worker.worker_id)
+            return None
+        if fetch_err is not None:
+            # The task's INPUTS are gone, not the task itself: mark the refs'
+            # hosts dead and repair through lineage recomputation. Repaired
+            # retries don't consume the attempt budget — the per-query
+            # recovery budget (planner.py) bounds this loop.
+            for d in fetch_err.lost:
+                wid = d.get("worker_id")
+                if wid:
+                    self.scheduler.manager.mark_dead(wid, reason="unreachable")
+            if attempts_inflight(att.idx):
+                return None
+            repaired = False
+            if self.recovery is not None:
+                try:
+                    repaired = self.recovery(att.task, fetch_err.lost)
+                except BaseException as e2:  # noqa: BLE001 — the nested
+                    # recovery dispatch blew up (e.g. "No live workers"):
+                    # fail THROUGH the abort/drain path, not past it.
+                    # Interrupts propagate as themselves after the drain.
+                    if not isinstance(e2, Exception):
+                        return e2
+                    fatal = DaftExecutionError(
+                        f"partition recovery for task {att.task.task_id} "
+                        f"failed: {e2}")
+                    fatal.__cause__ = e2
+                    return fatal
+            if repaired:
+                requeue(rec, "fetch-recovery", att.worker.worker_id,
+                        consume_attempt=False)
+                return None
+            fatal = DaftExecutionError(
+                f"Task {att.task.task_id} lost {len(fetch_err.lost)} input "
+                f"partition(s) and recovery was "
+                f"{'exhausted' if self.recovery else 'unavailable'}: {exc}")
+            fatal.__cause__ = exc
+            return fatal
+        if is_transient_failure(exc):
+            # Transient task errors (object-store blips…) fold into the same
+            # per-task budget, with exponential backoff before resubmission.
+            if attempts_inflight(att.idx):
+                return None
+            if att.attempt + 1 >= max_retries:
+                fatal = DaftExecutionError(
+                    f"Task {att.task.task_id} failed after {att.attempt + 1} "
+                    f"attempts (transient): {exc}")
+                fatal.__cause__ = exc
+                return fatal
+            requeue(rec, "transient", att.worker.worker_id, backoff=True)
+            return None
+        if attempts_inflight(att.idx):
+            # A sibling attempt (speculation) is still running and may well
+            # succeed where this host failed — let it decide the task's fate
+            # instead of aborting the query on the loser's error.
+            return None
+        fatal = DaftExecutionError(f"Task {att.task.task_id} failed: {exc}")
+        fatal.__cause__ = exc
+        return fatal

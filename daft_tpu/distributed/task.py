@@ -59,9 +59,27 @@ class Task:
     # execution_config_ctx settings (morsel size, dynamic batching, …) must
     # reach every worker thread/process/daemon.
     cfg: object = None
+    # True for tasks with externally-visible effects (writes): the dispatcher
+    # must never speculatively duplicate them — a losing duplicate's output
+    # files cannot be retracted.
+    side_effecting: bool = False
 
     def input_size_bytes(self) -> int:
         return sum(r.size_bytes() for refs in self.inputs for r in refs)
+
+    def recovery_clone(self, n: int) -> "Task":
+        """Clone for lineage recomputation: fresh task id (events stay
+        unambiguous), spread placement (the original worker is dead), own
+        input lists (recovery may swap refs in-place), and the ORIGINAL
+        frozen clock — the recomputed partition must be byte-identical."""
+        import dataclasses
+
+        return dataclasses.replace(
+            self,
+            task_id=f"{self.task_id}~r{n}",
+            strategy=SchedulingStrategy.spread(),
+            inputs=[list(slot) for slot in self.inputs],
+        )
 
 
 class BoundInput(pp.PhysicalPlan):

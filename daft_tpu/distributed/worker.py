@@ -16,7 +16,12 @@ import uuid
 from concurrent.futures import Future, ThreadPoolExecutor
 from typing import Callable, Dict, List, Optional, Sequence
 
-from daft_tpu.distributed.partition_ref import LocalPartitionRef, PartitionRef
+from daft_tpu.distributed.faults import maybe_inject
+from daft_tpu.distributed.partition_ref import (
+    LocalPartitionRef,
+    PartitionFetchError,
+    PartitionRef,
+)
 from daft_tpu.distributed.task import BoundInput, Task
 from daft_tpu.errors import DaftExecutionError
 from daft_tpu.micropartition import MicroPartition
@@ -37,8 +42,19 @@ class Worker:
     def active_tasks(self) -> int:
         raise NotImplementedError
 
+    def heartbeat(self) -> bool:
+        """Liveness probe; False means the worker did not answer."""
+        return True
+
     def shutdown(self) -> None:
         pass
+
+
+# Worker ids whose "host" is down in the in-process fake cluster. A killed
+# LocalWorker's partitions become unreachable (fetch raises), faithfully
+# modelling a dead daemon's Flight server — so lineage recovery is testable
+# without subprocesses.
+_dead_local_workers: set = set()
 
 
 def collect_task_outputs(out, expect_outputs: int, schema):
@@ -53,13 +69,78 @@ def collect_task_outputs(out, expect_outputs: int, schema):
     return [MicroPartition.concat(out) if out else MicroPartition.empty(schema)]
 
 
+_FETCH_RETRIES = 2  # quick in-place retries before declaring partition loss
+
+
+def fetch_task_input(ref: PartitionRef, slot: int, pos: int) -> MicroPartition:
+    """Fetch one task input, converting a fetch failure into a
+    :class:`PartitionFetchError` carrying the ref's location — the signal the
+    dispatcher turns into lineage-based recovery instead of a query failure.
+
+    Genuine network blips get a couple of immediate retries first: declaring
+    loss marks the hosting worker dead (permanently, for the session), which
+    must not happen on one flaky connection to a healthy daemon. Injected
+    faults (``FaultInjected``) are NOT retried — they simulate a dead host,
+    and absorbing them would consume extra spec hits and mask recovery."""
+    import time as _time
+
+    from daft_tpu.distributed.faults import FaultInjected
+
+    lost = [{"slot": slot, "pos": pos, "worker_id": ref.location}]
+    if ref.location and ref.location in _dead_local_workers:
+        raise PartitionFetchError(
+            f"partition input[{slot}][{pos}] unreachable: worker "
+            f"{ref.location} is dead", lost)
+    last: Optional[Exception] = None
+    for attempt in range(_FETCH_RETRIES + 1):
+        try:
+            # Inside the try: an injected fault converts to
+            # PartitionFetchError like a real one, driving recovery.
+            maybe_inject("shuffle.fetch", ref=ref, worker_id=ref.location)
+            return ref.fetch()
+        except PartitionFetchError:
+            raise
+        except FaultInjected as e:
+            last = e
+            break
+        except Exception as e:  # noqa: BLE001 — persistent failure IS loss
+            last = e
+            if attempt < _FETCH_RETRIES:
+                _time.sleep(0.05 * (2 ** attempt))
+    raise PartitionFetchError(
+        f"failed to fetch partition input[{slot}][{pos}] from "
+        f"{ref.location or 'driver'}: {last}", lost) from last
+
+
 def bind_task_fragment(fragment: pp.PhysicalPlan, inputs: Sequence[Sequence[PartitionRef]]) -> pp.PhysicalPlan:
-    """Replace BoundInput leaves with InMemorySource over fetched partitions."""
+    """Replace BoundInput leaves with InMemorySource over fetched partitions.
+
+    All inputs are fetched up front and fetch failures are COLLECTED, so the
+    task fails with one PartitionFetchError naming every lost ref — letting
+    the driver repair them in a single lineage-recovery wave instead of one
+    retry per lost partition."""
+    fetched: List[List[MicroPartition]] = []
+    lost: List[dict] = []
+    first_err: Optional[PartitionFetchError] = None
+    for slot, refs in enumerate(inputs):
+        parts: List[MicroPartition] = []
+        for pos, r in enumerate(refs):
+            try:
+                parts.append(fetch_task_input(r, slot, pos))
+            except PartitionFetchError as e:
+                lost.extend(e.lost)
+                if first_err is None:
+                    first_err = e
+        fetched.append(parts)
+    if lost:
+        raise PartitionFetchError(
+            f"{len(lost)} task input partition(s) unreachable: {first_err}",
+            lost) from first_err
 
     def rebuild(node: pp.PhysicalPlan) -> pp.PhysicalPlan:
         if isinstance(node, BoundInput):
-            parts = [r.fetch() for r in inputs[node.slot]]
-            parts = [p for p in parts if len(p)] or [MicroPartition.empty(node.schema)]
+            parts = [p for p in fetched[node.slot] if len(p)] or [
+                MicroPartition.empty(node.schema)]
             return pp.InMemorySource(parts, node.schema)
         new_children = [rebuild(c) for c in node.children]
         if any(a is not b for a, b in zip(new_children, node.children)):
@@ -87,10 +168,18 @@ class LocalWorker(Worker):
         self._active = 0
         self._lock = threading.Lock()
         self._dead = False
+        # A fresh worker reusing an old id is a new host.
+        _dead_local_workers.discard(self.worker_id)
 
     def kill(self) -> None:
-        """Simulate worker death (fault-injection hook for tests)."""
+        """Simulate worker death (fault-injection hook for tests). The
+        worker stops accepting tasks AND its hosted partitions become
+        unreachable, like a crashed daemon's Flight server."""
         self._dead = True
+        _dead_local_workers.add(self.worker_id)
+
+    def heartbeat(self) -> bool:
+        return not self._dead
 
     def submit(self, task: Task) -> "Future[List[PartitionRef]]":
         with self._lock:
@@ -130,7 +219,18 @@ class LocalWorker(Worker):
                 with self._lock:
                     self._active -= 1
 
-        return self._pool.submit(run)
+        fut = self._pool.submit(run)
+
+        def _on_done(f):
+            # A future cancelled while still queued never enters run(), so
+            # its finally-decrement never happens — undo the count here or
+            # this worker looks permanently loaded to least-active placement.
+            if f.cancelled():
+                with self._lock:
+                    self._active -= 1
+
+        fut.add_done_callback(_on_done)
+        return fut
 
     def active_tasks(self) -> int:
         return self._active
@@ -149,6 +249,7 @@ class WorkerManager:
         self._factory = factory
         self._dead: set = set()
         self._lock = threading.Lock()
+        self._monitor: Optional["HeartbeatMonitor"] = None
 
     def workers(self) -> List[Worker]:
         with self._lock:
@@ -160,9 +261,19 @@ class WorkerManager:
                 return None
             return self._workers.get(worker_id)
 
-    def mark_dead(self, worker_id: str) -> None:
+    def mark_dead(self, worker_id: str, reason: str = "task-failure") -> None:
         with self._lock:
+            newly = worker_id not in self._dead
             self._dead.add(worker_id)
+        if newly:
+            from daft_tpu.context import get_context
+            from daft_tpu.subscribers.events import WorkerLost
+
+            get_context().notify(WorkerLost(worker_id=worker_id, reason=reason))
+
+    def is_dead(self, worker_id: str) -> bool:
+        with self._lock:
+            return worker_id in self._dead
 
     def total_slots(self) -> int:
         return sum(w.num_slots for w in self.workers())
@@ -177,10 +288,77 @@ class WorkerManager:
             with self._lock:
                 self._workers[w.worker_id] = w
 
+    # -- liveness --------------------------------------------------------- #
+    def start_heartbeat_monitor(self, interval_s: float = 5.0,
+                                miss_threshold: int = 3) -> "HeartbeatMonitor":
+        """Probe workers every ``interval_s``; after ``miss_threshold``
+        consecutive silent probes a worker is proactively marked dead
+        (reference discipline: Ray's heartbeat-based node failure detector),
+        so the scheduler stops assigning to it BEFORE a task has to fail."""
+        if self._monitor is None:
+            self._monitor = HeartbeatMonitor(self, interval_s, miss_threshold)
+            self._monitor.start()
+        return self._monitor
+
+    def stop_heartbeat_monitor(self) -> None:
+        if self._monitor is not None:
+            self._monitor.stop()
+            self._monitor = None
+
     def shutdown(self) -> None:
         # Include dead-marked workers: a crashed ProcessWorker still needs its
         # subprocess reaped and socket closed.
+        self.stop_heartbeat_monitor()
         with self._lock:
             all_workers = list(self._workers.values())
         for w in all_workers:
             w.shutdown()
+
+
+class HeartbeatMonitor:
+    """Background liveness prober over a WorkerManager's workers."""
+
+    def __init__(self, manager: WorkerManager, interval_s: float = 5.0,
+                 miss_threshold: int = 3):
+        self.manager = manager
+        self.interval_s = interval_s
+        self.miss_threshold = max(int(miss_threshold), 1)
+        self._misses: Dict[str, int] = {}
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="daft-worker-heartbeat")
+
+    def start(self) -> None:
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=2.0)
+
+    def probe_once(self) -> None:
+        """One probe round over all live workers (tests drive this directly
+        for determinism instead of sleeping through wall-clock intervals)."""
+        for w in self.manager.workers():
+            alive = False
+            # The injector can drop heartbeats (point: daemon.heartbeat) to
+            # simulate a silent/partitioned worker without killing it.
+            if maybe_inject("daemon.heartbeat", worker=w) != "drop":
+                try:
+                    alive = bool(w.heartbeat())
+                except Exception:
+                    alive = False
+            if alive:
+                self._misses.pop(w.worker_id, None)
+                continue
+            n = self._misses.get(w.worker_id, 0) + 1
+            self._misses[w.worker_id] = n
+            if n >= self.miss_threshold:
+                self.manager.mark_dead(w.worker_id, reason="heartbeat-timeout")
+                self._misses.pop(w.worker_id, None)
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.probe_once()
+            except Exception:
+                pass

@@ -308,6 +308,10 @@ class Executor:
                     if item is _SENTINEL:
                         break
                     if isinstance(item, BaseException):
+                        # `from item` preserves the cause chain, which is how
+                        # the distributed dispatcher classifies transiency
+                        # (scheduler.is_transient_failure walks __cause__) —
+                        # the user-facing type stays DaftExecutionError.
                         raise DaftExecutionError(f"Scan failed: {item}") from item
                     yield item
         finally:

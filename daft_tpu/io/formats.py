@@ -36,6 +36,8 @@ def read_scan_task(task: ScanTask, morsel_rows: int = 128 * 1024) -> Iterator[Mi
         return
     from daft_tpu.io.iostats import IO_STATS
 
+    from daft_tpu.distributed.faults import maybe_inject
+
     for f in task.files:
         if remaining is not None and remaining <= 0:
             return
@@ -44,9 +46,15 @@ def read_scan_task(task: ScanTask, morsel_rows: int = 128 * 1024) -> Iterator[Mi
         # not IO. bytes_read is the file's size upper bound.
         IO_STATS.count_open()
         IO_STATS.count_get(f.size_bytes or 0)
-        remaining = yield from _stream_with_retry(
-            task, lambda f=f: _read_one_file(task, f, morsel_rows), remaining
-        )
+
+        def open_file(f=f):
+            # Injection inside the retried thunk: a raise_transient fault here
+            # exercises the in-task retry (and, past _SCAN_RETRIES, the
+            # dispatcher's transient task-retry budget).
+            maybe_inject("io.get_object", path=f.path)
+            return _read_one_file(task, f, morsel_rows)
+
+        remaining = yield from _stream_with_retry(task, open_file, remaining)
 
 
 _SCAN_RETRIES = 3

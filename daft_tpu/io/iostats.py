@@ -84,8 +84,10 @@ def reset_io_stats() -> None:
 
 def read_range(path: str, start: int, length: int, io_config=None) -> bytes:
     """Ranged read: `length` bytes at `start` (reference: daft-io range.rs)."""
+    from daft_tpu.distributed.faults import maybe_inject
     from daft_tpu.io.scan import resolve_filesystem
 
+    maybe_inject("io.get_object", path=path)
     fs, p = resolve_filesystem(path, io_config)
     t0 = time.perf_counter()
     with fs.open_input_file(p) as f:

@@ -29,12 +29,31 @@ class RetryPolicy:
 
     def sleep_s(self, attempt: int, retry_after: Optional[str] = None) -> float:
         if retry_after:
-            try:
-                return min(float(retry_after), self.backoff_cap_s)
-            except ValueError:
-                pass
+            delay = _parse_retry_after(retry_after)
+            if delay is not None:
+                return min(delay, self.backoff_cap_s)
         base = min(self.backoff_base_s * (2 ** attempt), self.backoff_cap_s)
         return base * (0.5 + random.random() / 2)  # full jitter, >= 50%
+
+
+def _parse_retry_after(value: str) -> Optional[float]:
+    """Retry-After per RFC 9110: delta-seconds OR an HTTP-date."""
+    try:
+        delay = float(value)
+        return delay if delay >= 0 else None
+    except ValueError:
+        pass
+    import datetime
+    from email.utils import parsedate_to_datetime
+
+    try:
+        when = parsedate_to_datetime(value)
+    except (TypeError, ValueError):
+        return None
+    if when.tzinfo is None:  # HTTP-dates are GMT
+        when = when.replace(tzinfo=datetime.timezone.utc)
+    delta = (when - datetime.datetime.now(datetime.timezone.utc)).total_seconds()
+    return delta if delta > 0 else 0.0
 
 
 def policy_from_config(io_config=None, scheme: str = "s3") -> RetryPolicy:
@@ -67,6 +86,11 @@ def with_retries(fn: Callable, policy: RetryPolicy, *,
         try:
             return fn()
         except BaseException as e:  # noqa: BLE001
+            # Cancellation / interpreter-shutdown signals are NEVER retried,
+            # even if a custom is_retryable would claim them (it's only ever
+            # consulted for ordinary Exceptions).
+            if not isinstance(e, Exception):
+                raise
             retryable = (is_retryable(e) if is_retryable is not None
                          else isinstance(e, policy.retryable_exceptions))
             if not retryable or attempt >= policy.max_retries:

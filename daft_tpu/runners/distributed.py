@@ -71,6 +71,7 @@ class DistributedRunner(Runner):
                             pass
 
             self.manager = _DaemonManager(workers)
+            self._start_heartbeat(cfg)
             return
         if backend == "process":
             # True process isolation (reference: per-node Ray actors; on TPU
@@ -79,11 +80,19 @@ class DistributedRunner(Runner):
 
             workers = [ProcessWorker(f"proc-{i}") for i in range(n)]
             self.manager = WorkerManager(workers, factory=lambda: ProcessWorker())
+            self._start_heartbeat(cfg)
         else:
             workers = [LocalWorker(f"worker-{i}", num_slots=slots_per_worker) for i in range(n)]
             self.manager = WorkerManager(
                 workers, factory=lambda: LocalWorker(num_slots=slots_per_worker)
             )
+
+    def _start_heartbeat(self, cfg) -> None:
+        # Out-of-process workers can die silently; probe them so the
+        # scheduler stops routing to a dead host before a task has to fail.
+        if cfg.heartbeat_interval_s > 0:
+            self.manager.start_heartbeat_monitor(
+                cfg.heartbeat_interval_s, cfg.heartbeat_miss_threshold)
 
     def run_iter(self, builder) -> Iterator[MicroPartition]:
         ctx = get_context()
@@ -106,15 +115,24 @@ class DistributedRunner(Runner):
         register_query_stats(query_id, stats)
         from daft_tpu.context import frozen_clock_scope
 
+        from daft_tpu.distributed.faults import config_fault_scope
+
         try:
             executor = DistributedExecutor(self.manager, cfg, query_id=query_id)
-            # Freeze only around the synchronous plan execution: every Task
-            # created inside captures this one instant (Task.frozen_clock
-            # default_factory) and ships it to its worker.
-            with frozen_clock_scope():
-                refs = executor.execute(physical)
+            # A cfg-armed fault spec is scoped to the SYNCHRONOUS execution
+            # of this query only (explicit fault_scope / DAFT_FAULT_SPEC env
+            # injectors take precedence) — it must not stay armed across the
+            # generator's yields, where a concurrent query would inherit it.
+            with config_fault_scope(cfg):
+                # Freeze only around the synchronous plan execution: every
+                # Task created inside captures this one instant
+                # (Task.frozen_clock default_factory) and ships it with it.
+                with frozen_clock_scope():
+                    refs = executor.execute(physical)
             for ref in refs:
-                mp = ref.fetch()
+                # Recovery-aware: an output hosted on a since-dead worker
+                # is recomputed from lineage instead of failing collect.
+                mp = executor.fetch_output(ref)
                 if len(mp):
                     yield mp
         except BaseException as e:  # noqa: BLE001

@@ -57,6 +57,38 @@ class TaskCompleted(Event):
 
 
 @dataclass
+class TaskRetried(Event):
+    """A task attempt was abandoned / duplicated and the task re-queued.
+    ``reason`` is one of ``worker-died``, ``transient``, ``fetch-recovery``,
+    ``straggler`` (speculative duplicate)."""
+
+    query_id: str = ""
+    task_id: str = ""
+    worker_id: str = ""
+    attempt: int = 0
+    reason: str = ""
+
+
+@dataclass
+class WorkerLost(Event):
+    """A worker was marked dead (task failure, heartbeat timeout, or
+    unreachable partition fetch)."""
+
+    worker_id: str = ""
+    reason: str = ""
+
+
+@dataclass
+class PartitionRecovered(Event):
+    """Lost partitions were recomputed from lineage on a live worker."""
+
+    query_id: str = ""
+    task_id: str = ""  # the recomputed producer task
+    worker_id: str = ""  # the dead worker that held the partitions
+    num_partitions: int = 0
+
+
+@dataclass
 class OperatorStats(Event):
     query_id: str = ""
     operator: str = ""

@@ -29,10 +29,13 @@ from daft_tpu.subscribers.events import (
     OperatorStats,
     OptimizationEnd,
     OptimizationStart,
+    PartitionRecovered,
     QueryEnd,
     QueryStart,
     TaskCompleted,
+    TaskRetried,
     TaskScheduled,
+    WorkerLost,
 )
 
 
@@ -289,6 +292,13 @@ class TracingSubscriber:
                 self.exporter.export([span])
                 self.meter.add("daft.rows.processed", e.rows_out)
                 self.meter.record(f"daft.operator.{e.operator}.cpu_us", e.cpu_us)
+            elif isinstance(e, TaskRetried):
+                self.meter.add("daft.tasks.retried")
+                self.meter.add(f"daft.tasks.retried.{e.reason}")
+            elif isinstance(e, WorkerLost):
+                self.meter.add("daft.workers.lost")
+            elif isinstance(e, PartitionRecovered):
+                self.meter.add("daft.partitions.recovered", e.num_partitions or 1)
 
 
 _auto_subscriber: Optional[TracingSubscriber] = None

@@ -1,0 +1,173 @@
+"""Chaos stress loop: TPC-H-style queries under randomized fault specs.
+
+Each round draws a random (but seed-reproducible) fault spec — worker kills,
+dropped heartbeats, transient IO errors, shuffle-fetch failures — arms it via
+``fault_scope``, runs a TPC-H Q1-style aggregation and a join/sort query on
+the distributed runner, and asserts the results EQUAL the fault-free run.
+Any divergence or unexpected query failure prints the offending seed + spec,
+which reproduces the failure deterministically:
+
+    python scripts/chaos_stress.py --rounds 20 --seed 42
+    python scripts/chaos_stress.py --spec 'worker.pre_submit:kill:7'  # replay
+
+Exit code 0 = all rounds survived with identical results.
+"""
+from __future__ import annotations
+
+import argparse
+import random
+import sys
+import time
+
+sys.path.insert(0, "/root/repo")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import daft_tpu  # noqa: E402
+from daft_tpu import col  # noqa: E402
+from daft_tpu.distributed.faults import fault_scope  # noqa: E402
+from daft_tpu.errors import DaftError  # noqa: E402
+from daft_tpu.runners.distributed import DistributedRunner  # noqa: E402
+
+ROWS = 600
+PARTS = 6
+
+
+def make_lineitem():
+    rng = random.Random(0)
+    status = ["A", "F", "N", "O"]
+    return daft_tpu.from_pydict({
+        "l_orderkey": [rng.randrange(100) for _ in range(ROWS)],
+        "l_quantity": [float(rng.randrange(1, 50)) for _ in range(ROWS)],
+        "l_extendedprice": [round(rng.uniform(900.0, 10_000.0), 2)
+                            for _ in range(ROWS)],
+        "l_discount": [round(rng.uniform(0.0, 0.1), 2) for _ in range(ROWS)],
+        "l_returnflag": [rng.choice(status[:2]) for _ in range(ROWS)],
+        "l_linestatus": [rng.choice(status[2:]) for _ in range(ROWS)],
+    }).into_partitions(PARTS)
+
+
+def make_orders():
+    rng = random.Random(1)
+    return daft_tpu.from_pydict({
+        "o_orderkey": list(range(100)),
+        "o_custkey": [rng.randrange(20) for _ in range(100)],
+        "o_orderpriority": [f"{rng.randrange(1, 6)}-P" for _ in range(100)],
+    }).into_partitions(3)
+
+
+def q1_style(lineitem):
+    """TPC-H Q1 shape: wide grouped aggregation over a shuffle."""
+    return (
+        lineitem
+        .with_column("disc_price", col("l_extendedprice") * (1 - col("l_discount")))
+        .groupby("l_returnflag", "l_linestatus")
+        .agg(
+            col("l_quantity").sum().alias("sum_qty"),
+            col("l_extendedprice").sum().alias("sum_base_price"),
+            col("disc_price").sum().alias("sum_disc_price"),
+            col("l_quantity").mean().alias("avg_qty"),
+            col("l_discount").mean().alias("avg_disc"),
+            col("l_orderkey").count().alias("count_order"),
+        )
+        .sort(["l_returnflag", "l_linestatus"])
+        .to_pydict()
+    )
+
+
+def join_sort_style(lineitem, orders):
+    """Join + grouped count + global sort: exercises hash-shuffle joins and
+    the sample/range-shuffle sort path."""
+    return (
+        lineitem.join(orders, left_on="l_orderkey", right_on="o_orderkey")
+        .groupby("o_orderpriority")
+        .agg(col("l_quantity").sum().alias("qty"),
+             col("l_orderkey").count().alias("n"))
+        .sort("o_orderpriority")
+        .to_pydict()
+    )
+
+
+def random_spec(rng: random.Random) -> str:
+    """One randomized fault spec: 1-3 clauses over the named points."""
+    clauses = []
+    for _ in range(rng.randrange(1, 4)):
+        kind = rng.randrange(4)
+        if kind == 0:
+            clauses.append(f"worker.pre_submit:kill:{rng.randrange(2, 20)}")
+        elif kind == 1:
+            clauses.append(f"shuffle.fetch:raise:{rng.randrange(1, 12)}")
+        elif kind == 2:
+            n = rng.randrange(1, 4)
+            clauses.extend(f"io.get_object:raise_transient:{i + 1}"
+                           for i in range(n))
+        else:
+            clauses.append(f"worker.pre_submit:delay:{rng.randrange(1, 10)}:0.05")
+    return ",".join(clauses)
+
+
+def run_round(spec: str, seed: int, baseline: tuple) -> str | None:
+    """Returns an error string, or None if results match the baseline."""
+    ctx = daft_tpu.get_context()
+    old = ctx._runner
+    runner = DistributedRunner(num_workers=3)
+    ctx.set_runner(runner)
+    try:
+        with fault_scope(spec, seed=seed):
+            got = (q1_style(make_lineitem()),
+                   join_sort_style(make_lineitem(), make_orders()))
+    except DaftError as e:
+        # A spec can legitimately exceed the attempt/recovery budget (e.g.
+        # shuffle.fetch:raise on a hit that repeats across retries is handled;
+        # budget exhaustion raises cleanly). A clean DaftError is acceptable;
+        # wrong RESULTS or a non-engine crash are not.
+        return f"query failed cleanly under spec (ok if rare): {str(e).splitlines()[0]}"
+    finally:
+        runner.manager.shutdown()
+        ctx.set_runner(old)
+    if got != baseline:
+        raise AssertionError(f"RESULT DIVERGENCE under spec {spec!r}")
+    return None
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--rounds", type=int, default=10)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--spec", default=None,
+                    help="replay one exact spec instead of randomizing")
+    args = ap.parse_args()
+
+    ctx = daft_tpu.get_context()
+    old = ctx._runner
+    runner = DistributedRunner(num_workers=3)
+    ctx.set_runner(runner)
+    try:
+        baseline = (q1_style(make_lineitem()),
+                    join_sort_style(make_lineitem(), make_orders()))
+    finally:
+        runner.manager.shutdown()
+        ctx.set_runner(old)
+
+    rng = random.Random(args.seed)
+    specs = [args.spec] if args.spec else [random_spec(rng)
+                                           for _ in range(args.rounds)]
+    failures = 0
+    for i, spec in enumerate(specs):
+        t0 = time.time()
+        try:
+            note = run_round(spec, seed=args.seed + i, baseline=baseline)
+        except Exception as e:  # divergence or engine crash
+            failures += 1
+            print(f"[round {i}] FAIL  seed={args.seed + i} spec={spec!r}: {e}")
+            continue
+        status = "survived" if note is None else note
+        print(f"[round {i}] ok ({time.time() - t0:.1f}s) spec={spec!r} — {status}")
+    print(f"\n{len(specs) - failures}/{len(specs)} rounds ok")
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -27,6 +27,15 @@ if os.environ.get("DAFT_TEST_PLATFORM", "cpu") == "cpu":
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "chaos: deterministic fault-injection tests (tests/test_faults.py); "
+        "fast seeded specs run in tier-1 via `pytest -m chaos`",
+    )
+    config.addinivalue_line("markers", "slow: excluded from the tier-1 run")
+
+
 @pytest.fixture(scope="session")
 def runner_name():
     return os.environ.get("DAFT_RUNNER", "native")

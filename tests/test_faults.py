@@ -1,0 +1,587 @@
+"""Chaos suite: deterministic fault injection against the distributed engine.
+
+Every test arms a seeded FaultInjector (daft_tpu/distributed/faults.py) and
+asserts the engine SURVIVES — results identical to a fault-free run — and
+that the right recovery machinery fired (events). Seeds + hit counters make
+failures reproduce exactly in CI.
+
+Run with ``pytest -m chaos`` (all fast; wired into the tier-1 run).
+"""
+
+import threading
+import time
+from concurrent.futures import Future
+
+import pytest
+
+import daft_tpu
+from daft_tpu import col
+from daft_tpu.distributed.faults import (
+    FaultInjected,
+    FaultInjector,
+    fault_scope,
+    parse_fault_spec,
+)
+from daft_tpu.distributed.partition_ref import LocalPartitionRef, PartitionFetchError
+from daft_tpu.distributed.scheduler import Dispatcher, Scheduler
+from daft_tpu.distributed.task import BoundInput, Task
+from daft_tpu.distributed.worker import (
+    HeartbeatMonitor,
+    LocalWorker,
+    Worker,
+    WorkerManager,
+)
+from daft_tpu.errors import DaftExecutionError, DaftTransientError
+from daft_tpu.micropartition import MicroPartition
+from daft_tpu.runners.distributed import DistributedRunner
+from daft_tpu.subscribers.events import (
+    PartitionRecovered,
+    TaskRetried,
+    TaskScheduled,
+    WorkerLost,
+)
+
+pytestmark = pytest.mark.chaos
+
+
+class EventTap:
+    """Subscriber capturing events for assertions."""
+
+    def __init__(self):
+        self.events = []
+        self._lock = threading.Lock()
+
+    def on_event(self, event):
+        with self._lock:
+            self.events.append(event)
+
+    def of(self, kind):
+        with self._lock:
+            return [e for e in self.events if isinstance(e, kind)]
+
+
+@pytest.fixture
+def tap():
+    ctx = daft_tpu.get_context()
+    t = EventTap()
+    ctx.attach_subscriber(t)
+    yield t
+    ctx.detach_subscriber(t)
+
+
+@pytest.fixture
+def dist_runner():
+    ctx = daft_tpu.get_context()
+    old = ctx._runner
+    runner = DistributedRunner(num_workers=3)
+    ctx.set_runner(runner)
+    yield runner
+    runner.manager.shutdown()
+    ctx.set_runner(old)
+
+
+def groupby_df():
+    return daft_tpu.from_pydict({
+        "a": list(range(60)),
+        "b": [f"k{i % 5}" for i in range(60)],
+        "c": [float(i) for i in range(60)],
+    }).into_partitions(6)
+
+
+# ------------------------------------------------------------------ #
+# Framework semantics                                                  #
+# ------------------------------------------------------------------ #
+def test_fault_spec_parsing():
+    specs = parse_fault_spec(
+        "worker.pre_submit:kill:3,io.get_object:raise_transient,"
+        "shuffle.fetch:raise:*,daemon.heartbeat:drop:2+,io.get_object:delay:p0.5:0.2")
+    assert [s.point for s in specs] == [
+        "worker.pre_submit", "io.get_object", "shuffle.fetch",
+        "daemon.heartbeat", "io.get_object"]
+    assert specs[0].when == 3 and specs[0].action == "kill"
+    assert specs[1].when == 1
+    assert specs[2].when == "*"
+    assert specs[3].when == "2+"
+    assert specs[4].prob == 0.5 and specs[4].arg == 0.2
+    with pytest.raises(ValueError):
+        parse_fault_spec("io.get_object:explode")
+
+
+def test_injector_nth_hit_and_counting():
+    inj = FaultInjector("io.get_object:raise:2")
+    assert inj.hit("io.get_object") is None
+    with pytest.raises(FaultInjected):
+        inj.hit("io.get_object")
+    assert inj.hit("io.get_object") is None  # fires only on the 2nd hit
+    assert inj.hits("io.get_object") == 3
+    assert inj.fired("io.get_object") == 1
+
+
+def test_injector_probabilistic_is_seed_deterministic():
+    def firing_pattern(seed):
+        inj = FaultInjector("shuffle.fetch:drop:p0.4", seed=seed)
+        out = []
+        for _ in range(32):
+            out.append(inj.hit("shuffle.fetch") == "drop")
+        return out
+
+    assert firing_pattern(7) == firing_pattern(7)
+    assert firing_pattern(7) != firing_pattern(8)  # astronomically unlikely tie
+    assert any(firing_pattern(7)) and not all(firing_pattern(7))
+
+
+# ------------------------------------------------------------------ #
+# Acceptance: worker killed mid-query -> identical results             #
+# ------------------------------------------------------------------ #
+def test_worker_killed_mid_shuffle_recovers(dist_runner, tap):
+    """Kill the worker that produced shuffle inputs partway through a
+    grouped aggregation: lineage recovery must recompute the lost
+    partitions and the query must return results identical to a fault-free
+    run, without blowing the per-task retry budget."""
+    expected = groupby_df().groupby("b").agg(
+        col("c").sum().alias("s"), col("a").count().alias("n"),
+    ).sort("b").to_pydict()
+
+    # Hit 8 lands after the 6 stage-1 partial-agg submissions: the killed
+    # worker already hosts stage-1 outputs, so downstream fetches MUST fail
+    # and recover through lineage.
+    with fault_scope("worker.pre_submit:kill:8", seed=0) as inj:
+        out = groupby_df().groupby("b").agg(
+            col("c").sum().alias("s"), col("a").count().alias("n"),
+        ).sort("b").to_pydict()
+    assert inj.fired("worker.pre_submit") == 1
+    assert out == expected
+    assert len(tap.of(WorkerLost)) >= 1
+    assert len(tap.of(PartitionRecovered)) >= 1
+    # No task id scheduled more often than the attempt budget allows.
+    budget = daft_tpu.get_context().execution_config.task_max_retries
+    per_task = {}
+    for e in tap.of(TaskScheduled):
+        per_task[e.task_id] = per_task.get(e.task_id, 0) + 1
+    assert per_task and max(per_task.values()) <= budget
+
+
+def test_worker_killed_during_sort(dist_runner, tap):
+    expected = list(range(59, -1, -1))
+    with fault_scope("worker.pre_submit:kill:9", seed=0):
+        out = groupby_df().sort("a", desc=True).to_pydict()["a"]
+    assert out == expected
+    assert len(tap.of(WorkerLost)) >= 1
+
+
+# ------------------------------------------------------------------ #
+# Lineage recomputation of a fetch-from-dead-worker                    #
+# ------------------------------------------------------------------ #
+def test_fetch_from_dead_worker_lineage_recompute(tap):
+    from daft_tpu.distributed.planner import DistributedExecutor
+
+    workers = [LocalWorker(f"lw{i}", num_slots=2) for i in range(3)]
+    manager = WorkerManager(workers)
+    cfg = daft_tpu.get_context().execution_config
+    ex = DistributedExecutor(manager, cfg, query_id="qlineage")
+    mp = MicroPartition.from_pydict({"x": list(range(8))})
+
+    # Stage 1: materialise a partition on some worker (recorded in lineage).
+    stage1 = Task(BoundInput(0, mp.schema), [[LocalPartitionRef(mp)]])
+    (refs,) = ex._dispatch([stage1])
+    owner = refs[0].location
+    assert owner is not None
+
+    # The owner dies: its hosted partitions become unreachable.
+    next(w for w in workers if w.worker_id == owner).kill()
+
+    # Stage 2 consumes the now-lost ref: must recompute via lineage, not fail.
+    stage2 = Task(BoundInput(0, mp.schema), [list(refs)])
+    (out,) = ex._dispatch([stage2])
+    assert out[0].fetch().to_pydict() == {"x": list(range(8))}
+    assert [e for e in tap.of(PartitionRecovered) if e.query_id == "qlineage"]
+    assert any(e.reason == "fetch-recovery" for e in tap.of(TaskRetried))
+    manager.shutdown()
+
+
+def test_recovery_budget_exhaustion_fails_cleanly(tap):
+    from daft_tpu.distributed.planner import DistributedExecutor
+
+    workers = [LocalWorker(f"bw{i}", num_slots=2) for i in range(3)]
+    manager = WorkerManager(workers)
+    cfg = daft_tpu.get_context().execution_config.with_changes(
+        max_partition_recoveries=0)
+    ex = DistributedExecutor(manager, cfg, query_id="qbudget")
+    mp = MicroPartition.from_pydict({"x": [1, 2, 3]})
+    stage1 = Task(BoundInput(0, mp.schema), [[LocalPartitionRef(mp)]])
+    (refs,) = ex._dispatch([stage1])
+    next(w for w in workers if w.worker_id == refs[0].location).kill()
+    stage2 = Task(BoundInput(0, mp.schema), [list(refs)])
+    with pytest.raises(DaftExecutionError):
+        ex._dispatch([stage2])
+    manager.shutdown()
+
+
+def test_driver_output_fetch_recovers(tap):
+    """A query OUTPUT hosted on a worker that dies before collect is
+    recomputed by the driver-side fetch path."""
+    from daft_tpu.distributed.planner import DistributedExecutor
+
+    workers = [LocalWorker(f"ow{i}", num_slots=2) for i in range(2)]
+    manager = WorkerManager(workers)
+    cfg = daft_tpu.get_context().execution_config
+    ex = DistributedExecutor(manager, cfg, query_id="qout")
+    mp = MicroPartition.from_pydict({"x": [10, 20]})
+    (refs,) = ex._dispatch([Task(BoundInput(0, mp.schema), [[LocalPartitionRef(mp)]])])
+    next(w for w in workers if w.worker_id == refs[0].location).kill()
+    out = ex.fetch_output(refs[0])
+    assert out.to_pydict() == {"x": [10, 20]}
+    manager.shutdown()
+
+
+def test_daemon_killed_mid_shuffle_lineage_recovery(tap):
+    """REAL process death: a daemon holding shuffle map outputs is crashed
+    mid-query (os._exit via the injector's kill on RemoteWorker). Downstream
+    tasks on surviving daemons fail their Flight fetches, the failure crosses
+    the wire as kind="fetch", and the driver recomputes from lineage."""
+    from daft_tpu.distributed.daemon import (
+        RemoteWorker,
+        spawn_local_daemon,
+        wait_for_daemon,
+    )
+
+    procs = [spawn_local_daemon(slots=2, fault_injection=True) for _ in range(3)]
+    ctx = daft_tpu.get_context()
+    old = ctx._runner
+    try:
+        addrs = [wait_for_daemon(p) for p in procs]
+        workers = [RemoteWorker(a) for a in addrs]
+        manager = WorkerManager(workers)
+        runner = DistributedRunner(manager=manager)
+        ctx.set_runner(runner)
+
+        def q():
+            return daft_tpu.from_pydict({
+                "k": list(range(600)), "g": [i % 7 for i in range(600)],
+            }).into_partitions(6).groupby("g").agg(
+                col("k").sum().alias("s")).sort("g").to_pydict()
+
+        expected = q()
+        # Hit 8 lands after the 6 stage-1 submissions: the crashed daemon
+        # already hosts stage-1 Flight refs that downstream tasks need.
+        with fault_scope("worker.pre_submit:kill:8", seed=0):
+            out = q()
+        assert out == expected
+        assert len(manager.workers()) == 2
+        assert [e for e in tap.of(PartitionRecovered)]
+    finally:
+        ctx.set_runner(old)
+        for p in procs:
+            p.kill()
+
+
+# ------------------------------------------------------------------ #
+# Heartbeat liveness                                                   #
+# ------------------------------------------------------------------ #
+def test_heartbeat_timeout_marks_worker_dead(tap):
+    workers = [LocalWorker(f"hb{i}", num_slots=1) for i in range(3)]
+    manager = WorkerManager(workers)
+    monitor = HeartbeatMonitor(manager, interval_s=60, miss_threshold=3)
+    workers[1]._dead = True  # silent death: stops answering, no error raised
+    for _ in range(2):
+        monitor.probe_once()
+    assert manager.get("hb1") is not None  # below threshold: still live
+    monitor.probe_once()
+    assert manager.get("hb1") is None
+    lost = tap.of(WorkerLost)
+    assert any(e.worker_id == "hb1" and e.reason == "heartbeat-timeout"
+               for e in lost)
+    assert {w.worker_id for w in manager.workers()} == {"hb0", "hb2"}
+    manager.shutdown()
+
+
+def test_heartbeat_drop_injection(tap):
+    workers = [LocalWorker(f"hd{i}", num_slots=1) for i in range(2)]
+    manager = WorkerManager(workers)
+    monitor = HeartbeatMonitor(manager, interval_s=60, miss_threshold=2)
+    with fault_scope("daemon.heartbeat:drop:*"):
+        monitor.probe_once()
+        monitor.probe_once()
+    assert manager.workers() == []  # every probe dropped -> all marked dead
+    assert len(tap.of(WorkerLost)) == 2
+    # A recovered network (injector gone) keeps new workers alive.
+    w = LocalWorker("hd9", num_slots=1)
+    manager._workers["hd9"] = w
+    monitor.probe_once()
+    assert manager.get("hd9") is not None
+    manager.shutdown()
+
+
+# ------------------------------------------------------------------ #
+# Straggler speculation                                                #
+# ------------------------------------------------------------------ #
+class ScriptedWorker(Worker):
+    """Completes every task after a fixed delay (no real execution)."""
+
+    def __init__(self, worker_id, delay):
+        self.worker_id = worker_id
+        self.num_slots = 4
+        self.delay = delay
+        self._active = 0
+
+    def submit(self, task):
+        fut = Future()
+        mp = MicroPartition.from_pydict({"x": [1]})
+
+        def run():
+            time.sleep(self.delay)
+            if not fut.cancelled():
+                fut.set_result([LocalPartitionRef(mp, self.worker_id)])
+
+        threading.Thread(target=run, daemon=True).start()
+        return fut
+
+    def active_tasks(self):
+        return self._active
+
+
+def test_straggler_speculation_picks_fast_attempt(tap):
+    fast = ScriptedWorker("fast", delay=0.02)
+    slow = ScriptedWorker("slow", delay=8.0)
+    manager = WorkerManager([fast, slow])
+    cfg = daft_tpu.get_context().execution_config.with_changes(
+        speculative_execution=True, speculative_multiplier=2.0,
+        speculative_min_completed=2)
+    dispatcher = Dispatcher(Scheduler(manager), cfg=cfg)
+    mp = MicroPartition.from_pydict({"x": [0]})
+    tasks = [Task(BoundInput(0, mp.schema), [[LocalPartitionRef(mp)]],
+                  query_id="qspec") for _ in range(6)]
+    t0 = time.monotonic()
+    results = dispatcher.run_tasks(tasks)
+    elapsed = time.monotonic() - t0
+    assert len(results) == 6 and all(r[0].num_rows() == 1 for r in results)
+    # Tasks stuck on the slow worker were duplicated and won by the fast one:
+    # nowhere near the 8s the stragglers would have taken.
+    assert elapsed < 4.0
+    straggled = [e for e in tap.of(TaskRetried) if e.reason == "straggler"]
+    assert straggled and all(e.query_id == "qspec" for e in straggled)
+    manager.shutdown()
+
+
+# ------------------------------------------------------------------ #
+# Transient IO faults inside tasks                                     #
+# ------------------------------------------------------------------ #
+def test_transient_io_retry_inside_task(dist_runner, tap, tmp_path):
+    daft_tpu.from_pydict({"v": list(range(50))}).write_parquet(str(tmp_path))
+    expected = sorted(daft_tpu.read_parquet(str(tmp_path)).to_pydict()["v"])
+
+    # First THREE opens fail transiently: the in-task scan retry (3 attempts)
+    # is exhausted, the dispatcher folds the escaped DaftTransientError into
+    # the per-task budget, and the resubmitted task's 4th open succeeds.
+    spec = ",".join(f"io.get_object:raise_transient:{n}" for n in (1, 2, 3))
+    with fault_scope(spec) as inj:
+        out = sorted(daft_tpu.read_parquet(str(tmp_path)).to_pydict()["v"])
+    assert out == expected
+    assert inj.fired("io.get_object") == 3
+    assert any(e.reason == "transient" for e in tap.of(TaskRetried))
+
+
+def test_transient_failure_exhausts_task_budget(dist_runner):
+    with fault_scope("io.get_object:raise_transient:*"):
+        with daft_tpu.execution_config_ctx(task_transient_backoff_s=0.001):
+            with pytest.raises(DaftExecutionError, match="transient"):
+                import tempfile
+
+                with tempfile.TemporaryDirectory() as d:
+                    daft_tpu.from_pydict({"v": [1]}).write_parquet(d)
+                    daft_tpu.read_parquet(d).to_pydict()
+
+
+# ------------------------------------------------------------------ #
+# Dispatcher regressions (satellites)                                  #
+# ------------------------------------------------------------------ #
+class AcceptThenDieWorker(Worker):
+    """Accepts one slow task, then is declared dead — the next assignment
+    finds no live workers while the first task is still in flight."""
+
+    def __init__(self, manager_ref):
+        self.worker_id = "atd0"
+        self.num_slots = 2
+        self._manager_ref = manager_ref
+        self.finished = threading.Event()
+
+    def submit(self, task):
+        fut = Future()
+        fut.set_running_or_notify_cancel()  # execution starts immediately
+
+        def run():
+            time.sleep(0.3)
+            self.finished.set()
+            mp = MicroPartition.from_pydict({"x": [1]})
+            fut.set_result([LocalPartitionRef(mp, self.worker_id)])
+
+        threading.Thread(target=run, daemon=True).start()
+        self._manager_ref[0].mark_dead(self.worker_id, reason="test")
+        return fut
+
+    def active_tasks(self):
+        return 0
+
+
+def test_assign_failure_mid_submit_drains_inflight(tap):
+    """An exception from scheduler.assign inside the submit loop must abort
+    through the same drain path as a task failure: the raise happens only
+    AFTER in-flight work stopped mutating state."""
+    box = [None]
+    worker = AcceptThenDieWorker(box)
+    manager = WorkerManager([worker])
+    box[0] = manager
+    dispatcher = Dispatcher(Scheduler(manager),
+                            cfg=daft_tpu.get_context().execution_config)
+    mp = MicroPartition.from_pydict({"x": [0]})
+    tasks = [Task(BoundInput(0, mp.schema), [[LocalPartitionRef(mp)]])
+             for _ in range(2)]
+    with pytest.raises(DaftExecutionError, match="No live workers"):
+        dispatcher.run_tasks(tasks)
+    # The drain waited for the in-flight task before propagating.
+    assert worker.finished.is_set()
+
+
+def test_worker_died_reschedules_with_budget(tap):
+    """Original WorkerDied rescheduling still works under the new dispatcher
+    and emits TaskRetried(worker-died)."""
+    workers = [LocalWorker(f"rd{i}", num_slots=2) for i in range(3)]
+    manager = WorkerManager(workers)
+    workers[0].kill()
+    dispatcher = Dispatcher(Scheduler(manager),
+                            cfg=daft_tpu.get_context().execution_config)
+    mp = MicroPartition.from_pydict({"x": [1, 2, 3]})
+    tasks = [Task(BoundInput(0, mp.schema), [[LocalPartitionRef(mp)]])
+             for _ in range(6)]
+    results = dispatcher.run_tasks(tasks)
+    assert len(results) == 6 and all(r[0].num_rows() == 3 for r in results)
+    assert manager.get("rd0") is None
+    retried = tap.of(TaskRetried)
+    assert any(e.reason == "worker-died" for e in retried) or not retried
+
+
+def test_dead_worker_reaping_unwedges_query(tap):
+    """A worker marked dead asynchronously (heartbeat monitor) while holding
+    a future that will NEVER complete must not hang the dispatcher: the
+    wedged attempts are failed as worker deaths and rescheduled."""
+    stuck = ScriptedWorker("stuck", delay=600.0)  # would wedge forever
+    backup = ScriptedWorker("backup", delay=0.02)
+    manager = WorkerManager([stuck, backup])
+    dispatcher = Dispatcher(Scheduler(manager),
+                            cfg=daft_tpu.get_context().execution_config)
+    mp = MicroPartition.from_pydict({"x": [0]})
+    tasks = [Task(BoundInput(0, mp.schema), [[LocalPartitionRef(mp)]])
+             for _ in range(4)]
+    # Simulate the heartbeat monitor noticing the partition shortly after
+    # dispatch begins.
+    threading.Timer(0.5, manager.mark_dead, args=("stuck",),
+                    kwargs={"reason": "heartbeat-timeout"}).start()
+    t0 = time.monotonic()
+    results = dispatcher.run_tasks(tasks)
+    assert len(results) == 4 and all(r[0].num_rows() == 1 for r in results)
+    assert time.monotonic() - t0 < 30.0  # nowhere near the 600s wedge
+    assert any(e.reason == "worker-died" for e in tap.of(TaskRetried))
+    manager.shutdown()
+
+
+def test_config_fault_spec_is_query_scoped(dist_runner, tmp_path):
+    """A fault_spec set via ExecutionConfig arms the injector for ONE query
+    only — hit counters and the spec itself never leak into the next."""
+    from daft_tpu.distributed.faults import active_injector
+
+    daft_tpu.from_pydict({"v": [1, 2, 3]}).write_parquet(str(tmp_path))
+    with daft_tpu.execution_config_ctx(
+            fault_spec="io.get_object:raise_transient:1"):
+        out = sorted(daft_tpu.read_parquet(str(tmp_path)).to_pydict()["v"])
+    assert out == [1, 2, 3]
+    assert active_injector() is None  # disarmed once the query finished
+    # And a fresh run is completely fault-free.
+    assert sorted(daft_tpu.read_parquet(str(tmp_path)).to_pydict()["v"]) == [1, 2, 3]
+
+
+def test_soft_affinity_yields_to_exclusion_hard_pin_wins():
+    from daft_tpu.distributed.task import SchedulingStrategy
+
+    workers = [LocalWorker("sa0", num_slots=1), LocalWorker("sa1", num_slots=1)]
+    manager = WorkerManager(workers)
+    sched = Scheduler(manager)
+    mp = MicroPartition.from_pydict({"x": [1]})
+    soft = Task(BoundInput(0, mp.schema), [],
+                strategy=SchedulingStrategy.affinity("sa0"))
+    # Speculation excludes the straggler's worker: with ONE alternative the
+    # duplicate must land there, not back on the excluded worker.
+    assert sched.assign(soft, exclude={"sa0"}).worker_id == "sa1"
+    hard = Task(BoundInput(0, mp.schema), [],
+                strategy=SchedulingStrategy.affinity("sa0", soft=False))
+    # A hard pin is a placement contract — exclude never overrides it.
+    assert sched.assign(hard, exclude={"sa0"}).worker_id == "sa0"
+    manager.shutdown()
+
+
+# ------------------------------------------------------------------ #
+# io/retry.py satellites                                               #
+# ------------------------------------------------------------------ #
+def test_with_retries_never_retries_interrupts():
+    from daft_tpu.io.retry import RetryPolicy, with_retries
+
+    calls = []
+
+    def boom():
+        calls.append(1)
+        raise KeyboardInterrupt()
+
+    with pytest.raises(KeyboardInterrupt):
+        with_retries(boom, RetryPolicy(max_retries=5),
+                     is_retryable=lambda e: True)  # a greedy matcher
+    assert len(calls) == 1  # never retried
+
+    calls.clear()
+
+    def sysexit():
+        calls.append(1)
+        raise SystemExit(3)
+
+    with pytest.raises(SystemExit):
+        with_retries(sysexit, RetryPolicy(max_retries=5),
+                     is_retryable=lambda e: True)
+    assert len(calls) == 1
+
+
+def test_retry_after_http_date():
+    import datetime
+    from email.utils import format_datetime
+
+    from daft_tpu.io.retry import RetryPolicy
+
+    policy = RetryPolicy(backoff_cap_s=16.0)
+    future = datetime.datetime.now(datetime.timezone.utc) + \
+        datetime.timedelta(seconds=5)
+    delay = policy.sleep_s(0, retry_after=format_datetime(future, usegmt=True))
+    assert 3.0 <= delay <= 5.5
+    # A past HTTP-date means "retry now", not "fall back to backoff".
+    past = datetime.datetime.now(datetime.timezone.utc) - \
+        datetime.timedelta(seconds=30)
+    assert policy.sleep_s(0, retry_after=format_datetime(past, usegmt=True)) == 0.0
+    # Float seconds still parse; garbage falls back to jittered backoff.
+    assert policy.sleep_s(0, retry_after="2.5") == 2.5
+    assert 0.0 < policy.sleep_s(0, retry_after="soon") <= 0.25
+
+
+def test_transient_chain_classification():
+    from daft_tpu.distributed.scheduler import is_transient_failure
+
+    inner = DaftTransientError("blip")
+    outer = DaftExecutionError("Scan failed")
+    outer.__cause__ = inner
+    assert is_transient_failure(outer)
+    assert is_transient_failure(inner)
+    assert not is_transient_failure(DaftExecutionError("fatal"))
+    assert not is_transient_failure(None)
+
+
+def test_partition_fetch_error_pickles():
+    import pickle
+
+    e = PartitionFetchError("gone", [{"slot": 0, "pos": 2, "worker_id": "w9"}])
+    e2 = pickle.loads(pickle.dumps(e))
+    assert e2.lost == e.lost and "gone" in str(e2)
