@@ -68,7 +68,9 @@ def get_lib() -> Optional[ctypes.CDLL]:
         if _lib is not None or _tried:
             return _lib
         _tried = True
-        if os.environ.get("DAFT_NATIVE", "1") in ("0", "false"):
+        from daft_tpu.config import daft_env_flag
+
+        if not daft_env_flag("DAFT_NATIVE", True):
             return None
         if not os.path.exists(_SO) or (
             os.path.exists(_SRC) and os.path.getmtime(_SRC) > os.path.getmtime(_SO)

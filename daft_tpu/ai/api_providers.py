@@ -245,8 +245,9 @@ class _ApiDescriptor(Descriptor):
         transport = o.get("transport")
         base_url = o.get("base_url")
         if self.provider == "google":
+            # daftlint: disable=DTL007 -- provider-SDK key convention (GEMINI/GOOGLE_API_KEY)
             key = o.get("api_key") or os.environ.get("GEMINI_API_KEY") \
-                or os.environ.get("GOOGLE_API_KEY")
+                or os.environ.get("GOOGLE_API_KEY")  # daftlint: disable=DTL007 -- provider-SDK key convention
             if not key and transport is None:
                 raise DaftValueError(
                     "google provider needs api_key= or GEMINI_API_KEY/"
@@ -263,6 +264,7 @@ class _ApiDescriptor(Descriptor):
             raise DaftValueError(f"google provider: no {self.kind}")
         # OpenAI wire format (openai / lm_studio / vllm).
         if self.provider == "openai":
+            # daftlint: disable=DTL007 -- provider-SDK key convention (OPENAI_API_KEY)
             key = o.get("api_key") or os.environ.get("OPENAI_API_KEY")
             if not key and transport is None:
                 raise DaftValueError(

@@ -103,9 +103,9 @@ def resolve_staging_mode(requested: Optional[str] = None) -> str:
     ``DAFT_STAGING_MODE`` overrides. "auto" probes first-touch h2d bandwidth
     once per process: < 1 GB/s means a tunnel-class transport -> separated.
     """
-    import os
+    from daft_tpu.config import daft_env
 
-    req = os.environ.get("DAFT_STAGING_MODE") or requested or "auto"
+    req = daft_env("DAFT_STAGING_MODE") or requested or "auto"
     if req in ("overlap", "separated"):
         return req
     if req != "auto":

@@ -14,6 +14,25 @@ from dataclasses import dataclass
 from typing import Optional, Tuple
 
 
+def daft_env(name: str, default: Optional[str] = None) -> Optional[str]:
+    """The single audited choke point for environment reads (daftlint
+    DTL007). Every engine knob consulted from the environment goes through
+    here so tests can monkeypatch ONE function, scattered reads can't drift
+    from the config snapshot, and the set of honored variables stays
+    greppable. Cloud-SDK credential conventions (AWS_*, GOOGLE_*) are the
+    deliberate exception — they follow provider chains, not engine config."""
+    return os.environ.get(name, default)
+
+
+def daft_env_flag(name: str, default: bool = False) -> bool:
+    """Boolean form of :func:`daft_env`: '0'/'false'/'no'/'off' (any case)
+    are false, unset means ``default``, anything else is true."""
+    raw = os.environ.get(name)
+    if raw is None:
+        return default
+    return raw.strip().lower() not in ("0", "false", "no", "off", "")
+
+
 @dataclass(frozen=True)
 class PlanningConfig:
     default_io_config: Optional[object] = None

@@ -64,7 +64,13 @@ class DaftContext:
             try:
                 s.on_event(event)
             except Exception:
-                pass
+                # One broken subscriber must not kill the query, but a
+                # silently-dead metrics sink is a debugging trap: say so.
+                import logging
+
+                logging.getLogger("daft_tpu.context").warning(
+                    "event subscriber %r raised; event %s dropped",
+                    type(s).__name__, type(event).__name__, exc_info=True)
 
 
 _CONTEXT = DaftContext()

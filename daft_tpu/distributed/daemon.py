@@ -27,6 +27,7 @@ Connect a driver:   ``DAFT_WORKER_ADDRESSES=hostA:9201,hostB:9201``
 
 from __future__ import annotations
 
+import logging
 import os
 import socket
 import struct
@@ -55,6 +56,8 @@ from daft_tpu.distributed.worker import (
     bind_task_fragment,
     collect_task_outputs,
 )
+
+_log = logging.getLogger("daft_tpu.daemon")
 
 _LEN = struct.Struct("<Q")
 
@@ -114,7 +117,9 @@ class WorkerDaemon:
         self.slots = slots
         self.cache = ShuffleCache(data_dir or tempfile.mkdtemp(prefix="daft_daemon_"))
         self.flight = ShuffleFlightServer(self.cache)
-        self.advertise_host = advertise_host or os.environ.get(
+        from daft_tpu.config import daft_env
+
+        self.advertise_host = advertise_host or daft_env(
             "DAFT_ADVERTISE_HOST") or socket.gethostname()
         self._pool = ThreadPoolExecutor(max_workers=slots,
                                         thread_name_prefix=f"{self.worker_id}-task")
@@ -168,7 +173,9 @@ class WorkerDaemon:
                 elif op == "die":
                     # Fault injection (tests only): refuse unless explicitly
                     # enabled — an unauthenticated kill switch otherwise.
-                    if os.environ.get("DAFT_DAEMON_ALLOW_FAULT_INJECTION"):
+                    from daft_tpu.config import daft_env
+
+                    if daft_env("DAFT_DAEMON_ALLOW_FAULT_INJECTION"):
                         os._exit(17)
                     _send_frame(conn, cloudpickle.dumps(
                         {"ok": False, "error": "fault injection disabled"}))
@@ -355,6 +362,10 @@ class RemoteWorker(Worker):
             self._request({"op": "ping"}, timeout=2.0)
             return True
         except Exception:
+            # False IS the classification here: the heartbeat monitor counts
+            # the miss. Log so a systematic cause (bad pickle, auth) shows.
+            _log.debug("daemon ping %s:%s failed", self._host, self._port,
+                       exc_info=True)
             return False
 
     def kill(self) -> None:
@@ -369,7 +380,8 @@ class RemoteWorker(Worker):
         try:
             self._request({"op": "shutdown"}, timeout=2)
         except Exception:
-            pass
+            _log.debug("daemon shutdown frame failed (already dead?)",
+                       exc_info=True)
 
 
 # ------------------------------------------------------------------ #
@@ -382,6 +394,7 @@ def spawn_local_daemon(port: int = 0, slots: int = 2,
     """Launch a daemon subprocess on localhost; returns the Popen. The port
     is written to stdout line 1 (`PORT <n>`) when 0 is requested."""
     repo_root = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    # daftlint: disable=DTL007 -- constructs the child process environment, not a config read
     env = dict(os.environ)
     # Same-host spawn: propagate the driver's full sys.path so task payloads
     # referencing driver-importable modules (plugins, test fixtures) resolve.
@@ -394,8 +407,8 @@ def spawn_local_daemon(port: int = 0, slots: int = 2,
 
             if jax.config.jax_platforms == "cpu":
                 jax_platforms = "cpu"
-        except Exception:
-            pass
+        except (ImportError, AttributeError):
+            pass  # no jax on the driver: child picks its own platform
     if jax_platforms:
         env["DAFT_CHILD_JAX_PLATFORMS"] = jax_platforms
     if fault_injection:
@@ -415,9 +428,9 @@ def wait_for_daemon(proc: "subprocess.Popen", timeout: float = 60.0,
     daemon stays alive but silent."""
     import select
 
-    deadline = time.time() + timeout
+    deadline = time.monotonic() + timeout
     buf = ""
-    while time.time() < deadline:
+    while time.monotonic() < deadline:
         if proc.poll() is not None:
             raise DaftDaemonError(
                 f"daemon exited rc={proc.returncode} before reporting a port")
@@ -451,7 +464,9 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
                              "$DAFT_ADVERTISE_HOST or gethostname())")
     args = parser.parse_args(argv)
 
-    platforms = os.environ.get("DAFT_CHILD_JAX_PLATFORMS")
+    from daft_tpu.config import daft_env
+
+    platforms = daft_env("DAFT_CHILD_JAX_PLATFORMS")
     if platforms:
         import jax
 

@@ -139,6 +139,12 @@ class FaultInjector:
         self._hits: Dict[str, int] = {}
         self._fired: Dict[str, int] = {}
         self._lock = threading.Lock()
+        # Chaos determinism extends to RETRY TIMING: pin the io-retry
+        # backoff jitter to the same seed so a replayed fault schedule
+        # reproduces the full retry cadence, not just the fault sites.
+        from daft_tpu.io.retry import seed_retry_jitter
+
+        seed_retry_jitter(seed)
 
     def add(self, point: str, action: str, when: Union[int, str] = 1,
             prob: Optional[float] = None, arg: Optional[float] = None) -> "FaultInjector":
@@ -192,7 +198,9 @@ class FaultInjector:
                 signal = "kill"
             elif s.action == "die":
                 # Whole-process crash — the daemon's guarded kill switch.
-                if os.environ.get("DAFT_DAEMON_ALLOW_FAULT_INJECTION"):
+                from daft_tpu.config import daft_env
+
+                if daft_env("DAFT_DAEMON_ALLOW_FAULT_INJECTION"):
                     os._exit(17)
                 raise FaultInjected(point, n)
             elif s.action == "drop":
@@ -218,10 +226,12 @@ def active_injector() -> Optional[FaultInjector]:
     if not _ENV_CHECKED:
         with _GUARD:
             if not _ENV_CHECKED:
-                spec = os.environ.get("DAFT_FAULT_SPEC")
+                from daft_tpu.config import daft_env
+
+                spec = daft_env("DAFT_FAULT_SPEC")
                 if spec:
                     _INJECTOR = FaultInjector(
-                        spec, seed=int(os.environ.get("DAFT_FAULT_SEED", "0")))
+                        spec, seed=int(daft_env("DAFT_FAULT_SEED", "0")))
                 _ENV_CHECKED = True
     return _INJECTOR
 

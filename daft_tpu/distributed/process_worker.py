@@ -63,7 +63,9 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
 
 def _worker_entry(fd: int) -> None:
     """Subprocess loop (invoked via `python -c`)."""
-    platforms = os.environ.get("DAFT_CHILD_JAX_PLATFORMS")
+    from daft_tpu.config import daft_env
+
+    platforms = daft_env("DAFT_CHILD_JAX_PLATFORMS")
     if platforms:
         import jax
 
@@ -115,8 +117,8 @@ def _worker_entry(fd: int) -> None:
                 reply["kind"] = "transient"
             try:
                 _send_frame(sock, cloudpickle.dumps(reply))
-            except Exception:
-                return
+            except OSError:
+                return  # parent closed the socket: nobody to reply to
 
 
 class ProcessWorker(Worker):
@@ -131,6 +133,7 @@ class ProcessWorker(Worker):
         self.num_slots = 1
         self.cfg = cfg or get_context().execution_config
         parent_sock, child_sock = socket.socketpair()
+        # daftlint: disable=DTL007 -- constructs the child process environment, not a config read
         env = dict(os.environ)
         repo_root = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
         env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
@@ -142,8 +145,8 @@ class ProcessWorker(Worker):
 
                 if jax.config.jax_platforms == "cpu":
                     jax_platforms = "cpu"
-            except Exception:
-                pass
+            except (ImportError, AttributeError):
+                pass  # no jax on the driver: child picks its own platform
         if jax_platforms:
             env["DAFT_CHILD_JAX_PLATFORMS"] = jax_platforms
         self._proc = subprocess.Popen(
@@ -247,13 +250,13 @@ class ProcessWorker(Worker):
             if got:
                 try:
                     _send_frame(self._sock, b"__shutdown__")
-                except Exception:
-                    pass
+                except OSError:
+                    pass  # socket already dead: the kill below still runs
         finally:
             if got:
                 self._lock.release()
         try:
             self._proc.wait(timeout=2)
-        except Exception:
+        except subprocess.TimeoutExpired:
             self._proc.kill()
         self._sock.close()

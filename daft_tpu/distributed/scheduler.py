@@ -29,9 +29,15 @@ fault-tolerance layer:
 from __future__ import annotations
 
 import itertools
+import logging
 import statistics
 import time
-from concurrent.futures import FIRST_COMPLETED, Future, wait
+from concurrent.futures import (
+    FIRST_COMPLETED,
+    CancelledError,
+    Future,
+    wait,
+)
 from dataclasses import dataclass
 from typing import Callable, Dict, List, Optional, Sequence, Set
 
@@ -40,6 +46,8 @@ from daft_tpu.distributed.partition_ref import PartitionFetchError, PartitionRef
 from daft_tpu.distributed.task import Task
 from daft_tpu.distributed.worker import Worker, WorkerDiedError, WorkerManager
 from daft_tpu.errors import DaftExecutionError, DaftTransientError
+
+_log = logging.getLogger("daft_tpu.scheduler")
 
 
 class Scheduler:
@@ -299,8 +307,8 @@ class Dispatcher:
                         def _observe(f, w=a2.worker):
                             try:
                                 e2 = f.exception()
-                            except BaseException:  # noqa: BLE001 — cancelled
-                                return
+                            except (CancelledError, TimeoutError):
+                                return  # cancelled loser: nothing to observe
                             if isinstance(e2, WorkerDiedError):
                                 self.scheduler.manager.mark_dead(
                                     w.worker_id, reason="worker-died")
@@ -377,7 +385,9 @@ class Dispatcher:
                             # Speculation is an optimization: ANY failure to
                             # place the duplicate (no spare worker, injected
                             # fault) just leaves the original running.
-                            pass
+                            _log.debug("straggler duplicate for task %s not "
+                                       "placed", att.task.task_id,
+                                       exc_info=True)
                         speculated.add(att.idx)
                 except BaseException as e:  # noqa: BLE001 — e.g. interrupt:
                     # abort through the drain path, re-raising interrupts

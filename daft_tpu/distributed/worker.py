@@ -11,6 +11,7 @@ tests exercise real execution in CI.
 
 from __future__ import annotations
 
+import logging
 import threading
 import uuid
 from concurrent.futures import Future, ThreadPoolExecutor
@@ -26,6 +27,8 @@ from daft_tpu.distributed.task import BoundInput, Task
 from daft_tpu.errors import DaftExecutionError
 from daft_tpu.micropartition import MicroPartition
 from daft_tpu.physical import plan as pp
+
+_log = logging.getLogger("daft_tpu.worker")
 
 
 class WorkerDiedError(DaftExecutionError):
@@ -346,6 +349,10 @@ class HeartbeatMonitor:
                 try:
                     alive = bool(w.heartbeat())
                 except Exception:
+                    # False IS the classification (a missed beat); keep the
+                    # cause visible for post-mortems.
+                    _log.debug("heartbeat probe of %s failed", w.worker_id,
+                               exc_info=True)
                     alive = False
             if alive:
                 self._misses.pop(w.worker_id, None)
@@ -361,4 +368,7 @@ class HeartbeatMonitor:
             try:
                 self.probe_once()
             except Exception:
-                pass
+                # A crashing monitor loop would silently DISABLE death
+                # detection for the rest of the query — that must be loud.
+                _log.warning("heartbeat monitor probe crashed; worker-death "
+                             "detection degraded this round", exc_info=True)

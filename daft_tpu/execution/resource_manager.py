@@ -22,7 +22,9 @@ class MemoryManager:
 
     def __init__(self, limit_bytes: Optional[int] = None):
         if limit_bytes is None:
-            env = os.environ.get("DAFT_MEMORY_LIMIT")
+            from daft_tpu.config import daft_env
+
+            env = daft_env("DAFT_MEMORY_LIMIT")
             limit_bytes = int(env) if env else None
         self.limit = limit_bytes
         self._used = 0

@@ -113,7 +113,9 @@ class SpillDir:
     """A temp directory of Arrow IPC spill files, cleaned up at query end."""
 
     def __init__(self, root: Optional[str] = None):
-        base = root or os.environ.get("DAFT_SPILL_DIR") or tempfile.gettempdir()
+        from daft_tpu.config import daft_env
+
+        base = root or daft_env("DAFT_SPILL_DIR") or tempfile.gettempdir()
         self.root = os.path.join(base, f"daft-spill-{uuid.uuid4().hex[:8]}")
         self._created = False
 

@@ -141,8 +141,8 @@ def evaluate_to_batch(rb, exprs: Sequence[Expr]):
             # paths cast their result to the statically-resolved field dtype.
             try:
                 target = e.to_field(rb.schema).dtype
-            except Exception:
-                target = s.dtype
+            except (DaftError, KeyError, TypeError, NotImplementedError):
+                target = s.dtype  # unresolvable: trust the computed dtype
             if s.dtype != target and not target.is_null():
                 s = s.cast(target)
             series_out[i] = s

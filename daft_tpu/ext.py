@@ -193,7 +193,9 @@ def load_env_extensions() -> List[str]:
     """Load every plugin in DAFT_EXTENSION_PATHS (reference: workers re-load
     extensions from this env var, daft/runners/flotilla.py:102-118)."""
     out: List[str] = []
-    for p in os.environ.get("DAFT_EXTENSION_PATHS", "").split(os.pathsep):
+    from daft_tpu.config import daft_env
+
+    for p in (daft_env("DAFT_EXTENSION_PATHS", "") or "").split(os.pathsep):
         if p.strip():
             out.extend(load_extension(p.strip()))
     return out

@@ -151,10 +151,10 @@ def filesystem_for(scheme: str, io_config: Optional[IOConfig]):
     if io_config is None:
         return None
     if scheme == "s3":
-        import os
+        from daft_tpu.config import daft_env
 
         cfg = io_config.s3
-        if cfg.use_native_client or os.environ.get("DAFT_NATIVE_S3") == "1":
+        if cfg.use_native_client or daft_env("DAFT_NATIVE_S3") == "1":
             from daft_tpu.io.s3_client import S3Client, S3FileSystemHandler
 
             return pafs.PyFileSystem(S3FileSystemHandler(S3Client(cfg)))
@@ -175,8 +175,10 @@ def filesystem_for(scheme: str, io_config: Optional[IOConfig]):
     if scheme in ("gs", "gcs"):
         import os
 
+        from daft_tpu.config import daft_env
+
         cfg = io_config.gcs
-        if cfg.use_native_client and os.environ.get("DAFT_NATIVE_GCS") != "0":
+        if cfg.use_native_client and daft_env("DAFT_NATIVE_GCS") != "0":
             from daft_tpu.io.gcs_client import GCSClient, GcsFileSystemHandler
 
             return pafs.PyFileSystem(GcsFileSystemHandler(GCSClient(cfg)))
@@ -186,7 +188,9 @@ def filesystem_for(scheme: str, io_config: Optional[IOConfig]):
         if cfg.project_id:
             kwargs["project_id"] = cfg.project_id
         if cfg.credentials_path:
-            # Arrow's GCS filesystem reads ADC from the environment.
+            # Arrow's GCS filesystem reads ADC from the environment — this
+            # WRITES the child-SDK convention, it is not an engine-config read.
+            # daftlint: disable=DTL007 -- exporting ADC path to pyarrow, not reading config
             os.environ.setdefault("GOOGLE_APPLICATION_CREDENTIALS", cfg.credentials_path)
         return pafs.GcsFileSystem(**kwargs)
     if scheme in ("az", "abfs", "abfss"):

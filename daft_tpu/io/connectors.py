@@ -115,6 +115,7 @@ class TurbopufferDataSink(DataSink):
                  distance_metric: str = "cosine_distance", post=None):
         import os
 
+        # daftlint: disable=DTL007 -- provider-SDK key convention (TURBOPUFFER_API_KEY)
         key = api_key or os.environ.get("TURBOPUFFER_API_KEY")
         if not key and post is None:
             raise DaftIOError(

@@ -10,6 +10,7 @@ stdlib: service-account keys are exchanged via a self-signed RS256 JWT
 and the metadata server is probed once per process. Every token fetch rides
 the shared retry policy (io/retry.py).
 """
+# daftlint: disable-file=DTL007 -- google-auth ADC convention: credentials resolve from GOOGLE_APPLICATION_CREDENTIALS / GCE_METADATA_HOST / HOME, not engine config
 
 from __future__ import annotations
 
@@ -325,19 +326,28 @@ def metadata_server_available() -> bool:
     if host:
         return True  # explicit override: trust it
     with _METADATA_PROBE_LOCK:
+        if _METADATA_PROBE is not None:
+            return _METADATA_PROBE
+    # Probe OUTSIDE the lock (daftlint DTL004): the HTTP probe can block for
+    # its full timeout, and holding the lock through it would convoy every
+    # thread that merely wants the cached answer. A concurrent duplicate
+    # probe is an idempotent read-only GET — harmless.
+    dmi = _on_gce_dmi()
+    if dmi is not None:
+        result = dmi
+    else:
+        req = urllib.request.Request(
+            f"http://{METADATA_DEFAULT_HOST}/computeMetadata/v1/",
+            headers={"Metadata-Flavor": "Google"})
+        try:
+            with urllib.request.urlopen(req, timeout=1):
+                result = True
+        except (urllib.error.URLError, TimeoutError, ConnectionError,
+                OSError, ValueError):
+            result = False
+    with _METADATA_PROBE_LOCK:
         if _METADATA_PROBE is None:
-            dmi = _on_gce_dmi()
-            if dmi is not None:
-                _METADATA_PROBE = dmi
-            else:
-                req = urllib.request.Request(
-                    f"http://{METADATA_DEFAULT_HOST}/computeMetadata/v1/",
-                    headers={"Metadata-Flavor": "Google"})
-                try:
-                    with urllib.request.urlopen(req, timeout=1):
-                        _METADATA_PROBE = True
-                except Exception:  # noqa: BLE001
-                    _METADATA_PROBE = False
+            _METADATA_PROBE = result
         return _METADATA_PROBE
 
 

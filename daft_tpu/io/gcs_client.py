@@ -48,10 +48,12 @@ class GCSObject:
 
 
 def _resolve_endpoint(cfg, endpoint_url: Optional[str]) -> str:
+    from daft_tpu.config import daft_env
+
     ep = (endpoint_url
           or getattr(cfg, "endpoint_url", None)
-          or os.environ.get("DAFT_GCS_ENDPOINT")
-          or os.environ.get("STORAGE_EMULATOR_HOST")
+          or daft_env("DAFT_GCS_ENDPOINT")
+          or daft_env("STORAGE_EMULATOR_HOST")
           or GCS_DEFAULT_ENDPOINT)
     if "://" not in ep:  # STORAGE_EMULATOR_HOST convention is host:port
         ep = "http://" + ep

@@ -17,6 +17,19 @@ from daft_tpu.errors import DaftIOError, DaftTransientError
 
 RETRYABLE_HTTP = (408, 409, 425, 429, 500, 502, 503, 504)
 
+# Backoff jitter draws from a module-owned Random instance, never the global
+# `random` module (daftlint DTL003): the chaos suite replays fault schedules
+# deterministically, and a hidden global draw on the retry path would shift
+# every subsequent module-level sample. seed_retry_jitter() pins it.
+_jitter_rng = random.Random()
+
+
+def seed_retry_jitter(seed: Optional[int]) -> None:
+    """Make retry backoff reproducible (chaos suite / bisecting flakes).
+    ``None`` restores OS-seeded behavior."""
+    global _jitter_rng
+    _jitter_rng = random.Random(seed)
+
 
 @dataclass(frozen=True)
 class RetryPolicy:
@@ -33,7 +46,7 @@ class RetryPolicy:
             if delay is not None:
                 return min(delay, self.backoff_cap_s)
         base = min(self.backoff_base_s * (2 ** attempt), self.backoff_cap_s)
-        return base * (0.5 + random.random() / 2)  # full jitter, >= 50%
+        return base * (0.5 + _jitter_rng.random() / 2)  # full jitter, >= 50%
 
 
 def _parse_retry_after(value: str) -> Optional[float]:

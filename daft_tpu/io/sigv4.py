@@ -7,6 +7,7 @@ clients need: canonical request -> string-to-sign -> HMAC chain ->
 Authorization header, plus the standard credential chain
 (explicit config -> AWS_* environment -> anonymous).
 """
+# daftlint: disable-file=DTL007 -- AWS SDK credential-chain convention (AWS_ACCESS_KEY_ID et al.), not engine config
 
 from __future__ import annotations
 

@@ -9,14 +9,58 @@ here any DB-API connection factory works and results flow through Arrow.
 
 from __future__ import annotations
 
+import logging
+import re
 from typing import Any, Dict, Iterator, List, Optional, Sequence
 
-from daft_tpu.errors import DaftValueError
+from daft_tpu.errors import DaftIOError, DaftTransientError, DaftValueError
 from daft_tpu.io.source import DataSource, DataSourceTask
 from daft_tpu.micropartition import MicroPartition
 from daft_tpu.schema import Schema
 
 FETCH_BATCH_ROWS = 50_000
+
+_log = logging.getLogger("daft_tpu.io.sql")
+
+#: DB-API 2.0 exception class names that CAN indicate a retryable condition.
+#: Matched by NAME because each driver defines its own hierarchy (sqlite3,
+#: psycopg2, mysqlclient share only the PEP 249 naming convention).
+#: InterfaceError is connection-level by spec; OperationalError is a grab
+#: bag (sqlite uses it for "no such table" AND for locked databases), so it
+#: is transient only when the MESSAGE looks connection/contention-shaped.
+_TRANSIENT_DB_ERRORS = ("InterfaceError", "InternalError")
+# \b-anchored so identifier substrings don't match: "no such table:
+# closed_orders" must stay fatal ('closed' has no word boundary before '_').
+_TRANSIENT_MESSAGE_RE = re.compile(
+    r"\b(?:connection|connect(?:ion|ing|ed)?|timeout|timed out|reset"
+    r"|closed|broken pipe|gone away|network|unavailable|deadlock"
+    r"|locked|lock wait|too many connections|temporar\w+)\b")
+
+
+def classify_db_error(e: BaseException, context: str) -> "DaftIOError":
+    """Map a driver exception onto the engine's transient/fatal taxonomy
+    (errors.py, PR 2) so connector failures participate in the dispatcher's
+    retry classification instead of aborting the query on the first blip —
+    while a permanently-wrong query ("no such table") fails fast instead of
+    burning the whole retry budget."""
+    names = {cls.__name__ for cls in type(e).__mro__}
+    if names & set(_TRANSIENT_DB_ERRORS):
+        return DaftTransientError(f"{context}: {e}")
+    msg = str(e).lower()
+    if "OperationalError" in names and _TRANSIENT_MESSAGE_RE.search(msg):
+        return DaftTransientError(f"{context}: {e}")
+    return DaftIOError(f"{context}: {e}")
+
+
+def _close_quietly(conn, context: str) -> None:
+    """Best-effort close of a task-owned connection. Close failures don't
+    change the task result, but are logged — a driver that can't close is
+    usually leaking sockets."""
+    try:
+        conn.close()
+    except Exception:
+        _log.debug("closing SQL connection failed (%s)", context,
+                   exc_info=True)
 
 
 def _sql_literal(v) -> str:
@@ -75,7 +119,10 @@ class SQLTask(DataSourceTask):
         owned = self.source._owns_connections()
         try:
             cursor = conn.cursor()
-            cursor.execute(self.sql)
+            try:
+                cursor.execute(self.sql)
+            except Exception as e:
+                raise classify_db_error(e, "read_sql partition query") from e
             if cursor.description is None:
                 raise DaftValueError(
                     f"read_sql requires a row-returning statement; got none "
@@ -93,10 +140,7 @@ class SQLTask(DataSourceTask):
                 yield MicroPartition.empty(self.source.schema())
         finally:
             if owned:  # live caller-owned connections stay open
-                try:
-                    conn.close()
-                except Exception:  # noqa: BLE001
-                    pass
+                _close_quietly(conn, "task")
 
 
 class SQLSource(DataSource):
@@ -148,10 +192,7 @@ class SQLSource(DataSource):
             self._factory_shared = a is b
             if a is not b:
                 for c in (a, b):
-                    try:
-                        c.close()
-                    except Exception:  # noqa: BLE001
-                        pass
+                    _close_quietly(c, "factory probe")
         return not self._factory_shared
 
     # -- schema inference -------------------------------------------------
@@ -184,7 +225,11 @@ class SQLSource(DataSource):
                                 f"SELECT {q} FROM ({self.sql}) AS __daft_t "
                                 f"WHERE {q} IS NOT NULL LIMIT 1")
                             row = cursor.fetchone()
-                        except Exception:  # dialect quirk: keep Null dtype
+                        except Exception:
+                            # Dialect quirk (quoting, subquery aliasing):
+                            # keep the Null dtype, but leave a trace.
+                            _log.debug("null-column type probe for %r failed",
+                                       c, exc_info=True)
                             row = None
                         if row is not None and row[0] is not None:
                             fixes[c] = pa.array([row[0]]).type
@@ -195,10 +240,7 @@ class SQLSource(DataSource):
                 self._schema = schema
             finally:
                 if self._owns_connections():
-                    try:
-                        conn.close()
-                    except Exception:  # noqa: BLE001
-                        pass
+                    _close_quietly(conn, "schema probe")
         return self._schema
 
     # -- partition planning ----------------------------------------------
@@ -206,14 +248,14 @@ class SQLSource(DataSource):
         conn = self._connect()
         try:
             cursor = conn.cursor()
-            cursor.execute(sql)
+            try:
+                cursor.execute(sql)
+            except Exception as e:
+                raise classify_db_error(e, "read_sql bounds query") from e
             return cursor.fetchone()
         finally:
             if self._owns_connections():
-                try:
-                    conn.close()
-                except Exception:  # noqa: BLE001
-                    pass
+                _close_quietly(conn, "bounds query")
 
     def _bounds(self, n: int) -> List[Any]:
         """n-1 interior bounds for n partitions (cached: planning asks for
@@ -236,8 +278,16 @@ class SQLSource(DataSource):
                 row = self._scalar(
                     f"SELECT {exprs} FROM ({self.sql}) AS __daft_b")
                 return list(row)
-            except Exception:  # noqa: BLE001
-                pass
+            except Exception:
+                # Dialects without PERCENTILE_DISC surface it as
+                # OperationalError-shaped failures we cannot tell apart from
+                # a blip, so ALWAYS fall back: if the connection itself is
+                # bad, the min-max query fails next with proper
+                # classification.
+                _log.debug("PERCENTILE_DISC probe failed (unsupported "
+                           "dialect, or a blip the min-max query will "
+                           "re-surface); falling back to min-max bounds",
+                           exc_info=True)
         row = self._scalar(
             f"SELECT MIN({col}), MAX({col}) FROM ({self.sql}) AS __daft_b")
         lo, hi = row

@@ -57,7 +57,7 @@ def _image_decode(args, on_error: str = "raise", mode=None, **kwargs):
             pil_mode = _MODE_TO_PIL.get(mode) if mode else ("RGB" if img.mode not in ("L", "LA", "RGB", "RGBA") else img.mode)
             if pil_mode and img.mode != pil_mode:
                 img = img.convert(pil_mode)
-            arr = np.asarray(img)
+            arr = np.asarray(img)  # daftlint: disable=DTL005 -- PIL decode is host-side; rows are variable-shape
             if arr.ndim == 2:
                 arr = arr[:, :, None]
             m = mode or ImageMode.from_str(img.mode if img.mode in ("L", "LA", "RGB", "RGBA") else "RGB")
@@ -157,7 +157,7 @@ def _image_resize(args, w: int = 0, h: int = 0, **kwargs):
             continue
         img = PILImage.fromarray(arr.squeeze(-1) if arr.shape[2] == 1 else arr)
         img = img.resize((w, h), PILImage.BILINEAR)
-        res = np.asarray(img)
+        res = np.asarray(img)  # daftlint: disable=DTL005 -- PIL resize is host-side; no device sync
         if res.ndim == 2:
             res = res[:, :, None]
         out_rows.append({
@@ -195,7 +195,7 @@ def _image_to_mode(args, mode=None, **kwargs):
             continue
         img = PILImage.fromarray(arr.squeeze(-1) if arr.shape[2] == 1 else arr)
         img = img.convert(_MODE_TO_PIL[mode])
-        res = np.asarray(img)
+        res = np.asarray(img)  # daftlint: disable=DTL005 -- PIL convert is host-side; no device sync
         if res.ndim == 2:
             res = res[:, :, None]
         out_rows.append(res)

@@ -1,0 +1,38 @@
+"""daftlint — AST-based static analysis for daft_tpu's engine invariants.
+
+Usage (CLI)::
+
+    python -m daft_tpu.lint daft_tpu/              # text report, exit 1 on new
+    python -m daft_tpu.lint --format=json daft_tpu/
+    python -m daft_tpu.lint --update-baseline daft_tpu/
+
+Usage (API)::
+
+    from daft_tpu.lint import lint_source, run_paths
+    findings, suppressed = lint_source(code, "daft_tpu/foo.py")
+
+See rules.py for the rule table and docs/COMPONENTS.md for rationale.
+"""
+
+from daft_tpu.lint.baseline import DEFAULT_BASELINE_NAME, Baseline, BaselineEntry
+from daft_tpu.lint.core import FileContext, Finding, Rule, parse_suppressions
+from daft_tpu.lint.reporters import (
+    JSON_SCHEMA_VERSION,
+    LintResult,
+    render_json,
+    render_text,
+)
+from daft_tpu.lint.rules import ALL_RULES, default_rules, rules_by_id
+from daft_tpu.lint.runner import (
+    find_baseline,
+    lint_source,
+    repo_root,
+    run_paths,
+)
+
+__all__ = [
+    "ALL_RULES", "Baseline", "BaselineEntry", "DEFAULT_BASELINE_NAME",
+    "FileContext", "Finding", "JSON_SCHEMA_VERSION", "LintResult", "Rule",
+    "default_rules", "find_baseline", "lint_source", "parse_suppressions",
+    "render_json", "render_text", "repo_root", "rules_by_id", "run_paths",
+]

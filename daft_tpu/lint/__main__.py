@@ -1,0 +1,111 @@
+"""``python -m daft_tpu.lint`` — the CI gate entry point.
+
+Exit codes: 0 = clean (no NEW findings; baselined/suppressed ones don't
+fail the gate), 1 = new findings, 2 = usage/config error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from typing import List, Optional
+
+from daft_tpu.lint.baseline import DEFAULT_BASELINE_NAME, Baseline
+from daft_tpu.lint.reporters import render_json, render_text
+from daft_tpu.lint.rules import ALL_RULES, default_rules, rules_by_id
+from daft_tpu.lint.runner import find_baseline, repo_root, run_paths
+
+
+def _parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m daft_tpu.lint",
+        description="daftlint: engine-invariant static analysis")
+    p.add_argument("paths", nargs="*",
+                   help="files/directories to lint (default: the daft_tpu "
+                        "package)")
+    p.add_argument("--format", choices=("text", "json"), default="text")
+    p.add_argument("--baseline", default=None,
+                   help=f"baseline file (default: {DEFAULT_BASELINE_NAME} "
+                        f"at the repo root, if present)")
+    p.add_argument("--no-baseline", action="store_true",
+                   help="ignore any baseline: report every finding as new")
+    p.add_argument("--update-baseline", action="store_true",
+                   help="rewrite the baseline from current findings "
+                        "(preserves reasons for surviving entries)")
+    p.add_argument("--rules", default=None,
+                   help="comma-separated rule ids to run (default: all)")
+    p.add_argument("--list-rules", action="store_true")
+    p.add_argument("--verbose", action="store_true",
+                   help="also print baselined findings in text output")
+    return p
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = _parser().parse_args(argv)
+    if args.list_rules:
+        for cls in ALL_RULES:
+            print(f"{cls.rule_id}  {cls.summary}")
+        return 0
+
+    root = repo_root()
+    paths = args.paths or [os.path.join(root, "daft_tpu")]
+    for p in paths:
+        if not os.path.exists(p):
+            print(f"daftlint: no such path: {p}", file=sys.stderr)
+            return 2
+
+    rules = None
+    if args.rules:
+        table = rules_by_id()
+        rules = []
+        for rid in args.rules.split(","):
+            rid = rid.strip()
+            if rid not in table:
+                print(f"daftlint: unknown rule {rid!r} "
+                      f"(see --list-rules)", file=sys.stderr)
+                return 2
+            rules.append(table[rid]())
+
+    baseline_path = args.baseline or find_baseline(root)
+    baseline = None
+    if baseline_path and not args.no_baseline:
+        try:
+            baseline = Baseline.load(baseline_path)
+        except (OSError, ValueError, KeyError) as e:
+            print(f"daftlint: cannot load baseline {baseline_path}: {e}",
+                  file=sys.stderr)
+            return 2
+
+    result = run_paths(paths, root=root, rules=rules, baseline=baseline)
+
+    if args.update_baseline:
+        target = args.baseline or baseline_path \
+            or os.path.join(root, DEFAULT_BASELINE_NAME)
+        updated = Baseline.from_findings(result.new + result.baselined,
+                                         previous=baseline)
+        if baseline is not None:
+            # A partial run only re-baselines what it scanned: entries for
+            # unscanned files / inactive rules carry over untouched instead
+            # of being silently deleted (which would make the next full run
+            # fail on every grandfathered finding as "new").
+            scanned = set(result.scanned_paths)
+            active = {r.rule_id for r in (rules or default_rules())}
+            for key, entry in baseline.entries.items():
+                if (entry.path not in scanned or entry.rule not in active) \
+                        and key not in updated.entries:
+                    updated.entries[key] = entry
+        updated.save(target)
+        print(f"daftlint: wrote {len(updated.entries)} baseline entr(ies) "
+              f"to {target}")
+        return 0
+
+    if args.format == "json":
+        print(render_json(result))
+    else:
+        print(render_text(result, verbose=args.verbose))
+    return result.exit_code
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,115 @@
+"""Checked-in baseline of grandfathered findings.
+
+The baseline lets the lint gate demand "zero NEW violations" from day one
+without blocking on a full cleanup: existing findings are recorded with a
+count and an optional hand-written reason, matched by (rule, path, snippet)
+so line drift doesn't resurrect them, and reported as *stale* once the code
+they pointed at is fixed — stale entries are pruned by ``--update-baseline``
+(or flagged by scripts/lint_report.py for review).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from daft_tpu.lint.core import Finding
+
+BASELINE_VERSION = 1
+DEFAULT_BASELINE_NAME = ".daftlint-baseline.json"
+
+
+def _entry_key(rule: str, path: str, snippet: str) -> str:
+    digest = hashlib.sha1(snippet.encode("utf-8")).hexdigest()[:12]
+    return f"{rule}|{path}|{digest}"
+
+
+@dataclass
+class BaselineEntry:
+    rule: str
+    path: str
+    snippet: str
+    count: int = 1
+    reason: str = ""
+
+    def key(self) -> str:
+        return _entry_key(self.rule, self.path, self.snippet)
+
+
+@dataclass
+class Baseline:
+    entries: Dict[str, BaselineEntry] = field(default_factory=dict)
+
+    # -- matching ---------------------------------------------------------
+    def partition(self, findings: List[Finding]
+                  ) -> Tuple[List[Finding], List[Finding], List[BaselineEntry]]:
+        """Split findings into (new, baselined); also return stale entries
+        whose recorded occurrences are no longer all present."""
+        budget = {k: e.count for k, e in self.entries.items()}
+        new: List[Finding] = []
+        old: List[Finding] = []
+        for f in findings:
+            k = _entry_key(f.rule, f.path, f.snippet)
+            if budget.get(k, 0) > 0:
+                budget[k] -= 1
+                old.append(f)
+            else:
+                new.append(f)
+        stale = [self.entries[k] for k, remaining in budget.items()
+                 if remaining > 0]
+        return new, old, stale
+
+    # -- persistence ------------------------------------------------------
+    @staticmethod
+    def load(path: str) -> "Baseline":
+        with open(path, "r", encoding="utf-8") as fh:
+            raw = json.load(fh)
+        if raw.get("version") != BASELINE_VERSION:
+            raise ValueError(
+                f"unsupported baseline version {raw.get('version')!r} "
+                f"in {path} (expected {BASELINE_VERSION})")
+        out = Baseline()
+        for key, e in raw.get("findings", {}).items():
+            entry = BaselineEntry(rule=e["rule"], path=e["path"],
+                                  snippet=e["snippet"],
+                                  count=int(e.get("count", 1)),
+                                  reason=e.get("reason", ""))
+            out.entries[key] = entry
+        return out
+
+    def save(self, path: str) -> None:
+        raw = {
+            "version": BASELINE_VERSION,
+            "tool": "daftlint",
+            "findings": {
+                k: {"rule": e.rule, "path": e.path, "snippet": e.snippet,
+                    "count": e.count,
+                    **({"reason": e.reason} if e.reason else {})}
+                for k, e in sorted(self.entries.items())
+            },
+        }
+        with open(path, "w", encoding="utf-8") as fh:
+            json.dump(raw, fh, indent=2, sort_keys=False)
+            fh.write("\n")
+
+    @staticmethod
+    def from_findings(findings: List[Finding],
+                      previous: Optional["Baseline"] = None) -> "Baseline":
+        """Rebuild from current findings, carrying over reasons from a
+        previous baseline for entries that survive."""
+        out = Baseline()
+        for f in findings:
+            key = _entry_key(f.rule, f.path, f.snippet)
+            entry = out.entries.get(key)
+            if entry is None:
+                reason = ""
+                if previous is not None and key in previous.entries:
+                    reason = previous.entries[key].reason
+                out.entries[key] = BaselineEntry(
+                    rule=f.rule, path=f.path, snippet=f.snippet, count=1,
+                    reason=reason)
+            else:
+                entry.count += 1
+        return out

@@ -1,0 +1,183 @@
+"""daftlint core: findings, rule protocol, per-file analysis context.
+
+The engine's correctness-under-failure story (CHANGES.md PR 2) rests on
+invariants that code review cannot reliably police: task-path code must read
+the frozen query clock, failures must be classified against the
+transient/fatal taxonomy, execution randomness must be seeded, and plan
+construction must not depend on set iteration order. ``daftlint`` turns each
+of those conventions into a machine-checked rule over the stdlib ``ast``.
+
+A rule is a class with ``rule_id``, ``summary``, ``applies_to(rel_path)`` and
+``check(ctx) -> Iterable[Finding]``. Rules never import engine modules — the
+analyzer must run on a broken working tree.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+#: Suppression comments:  ``# daftlint: disable=DTL001,DTL002 -- reason``
+#: (line scope: same line, or a standalone comment suppressing the next line)
+#: and ``# daftlint: disable-file=DTL005 -- reason`` (whole file).
+_SUPPRESS_RE = re.compile(
+    r"#\s*daftlint:\s*disable(?P<scope>-file)?\s*=\s*"
+    r"(?P<rules>[A-Za-z0-9_,\s]+?|all)\s*(?:--\s*(?P<reason>.*))?$")
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One rule violation at a source location."""
+
+    rule: str
+    path: str          # posix-style path relative to the lint root
+    line: int          # 1-based
+    col: int           # 0-based
+    message: str
+    snippet: str       # stripped source line (baseline matching key)
+
+    def key(self) -> Tuple[str, str, str]:
+        """Line-number-independent identity used by the baseline: moving a
+        grandfathered violation around a file must not resurrect it."""
+        return (self.rule, self.path, self.snippet)
+
+    def to_dict(self) -> dict:
+        return {"rule": self.rule, "path": self.path, "line": self.line,
+                "col": self.col, "message": self.message,
+                "snippet": self.snippet}
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: {self.rule} {self.message}"
+
+
+class Rule:
+    """Base class for daftlint rules."""
+
+    rule_id: str = "DTL000"
+    summary: str = ""
+    #: directories (relative, trailing slash) the rule is restricted to;
+    #: empty means the whole package.
+    scope_dirs: Sequence[str] = ()
+    #: relative paths exempt from this rule.
+    exempt_files: Sequence[str] = ()
+
+    def applies_to(self, rel_path: str) -> bool:
+        if rel_path in self.exempt_files:
+            return False
+        if not self.scope_dirs:
+            return True
+        return any(rel_path.startswith(d) for d in self.scope_dirs)
+
+    def check(self, ctx: "FileContext") -> Iterable[Finding]:
+        raise NotImplementedError
+
+    def finding(self, ctx: "FileContext", node: ast.AST, message: str) -> Finding:
+        line = getattr(node, "lineno", 1)
+        col = getattr(node, "col_offset", 0)
+        return Finding(rule=self.rule_id, path=ctx.rel_path, line=line,
+                       col=col, message=message,
+                       snippet=ctx.line_text(line).strip())
+
+
+@dataclass
+class Suppressions:
+    """Parsed ``# daftlint: disable`` comments for one file."""
+
+    file_rules: Set[str] = field(default_factory=set)   # "all" or rule ids
+    line_rules: Dict[int, Set[str]] = field(default_factory=dict)
+
+    def is_suppressed(self, finding: Finding) -> bool:
+        if "all" in self.file_rules or finding.rule in self.file_rules:
+            return True
+        rules = self.line_rules.get(finding.line)
+        return rules is not None and ("all" in rules or finding.rule in rules)
+
+
+def parse_suppressions(source: str) -> Suppressions:
+    sup = Suppressions()
+    lines = source.splitlines()
+    for i, text in enumerate(lines, start=1):
+        m = _SUPPRESS_RE.search(text)
+        if m is None:
+            continue
+        rules = {r.strip() for r in m.group("rules").split(",") if r.strip()}
+        if m.group("scope"):
+            sup.file_rules |= rules
+            continue
+        targets = {i}
+        if text.lstrip().startswith("#"):
+            # Standalone comment: suppresses the following line too, so long
+            # statements can carry a suppression without exceeding line width.
+            targets.add(i + 1)
+        for t in targets:
+            sup.line_rules.setdefault(t, set()).update(rules)
+    return sup
+
+
+class ImportTable:
+    """Maps local names to canonical dotted paths so rules match semantics,
+    not spelling: ``np.random.rand`` and ``numpy.random.rand`` resolve the
+    same, as do ``from time import time; time()`` and ``time.time()``."""
+
+    def __init__(self, tree: ast.AST):
+        self.aliases: Dict[str, str] = {}
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    self.aliases[a.asname or a.name.split(".")[0]] = (
+                        a.name if a.asname else a.name.split(".")[0])
+            elif isinstance(node, ast.ImportFrom) and node.module \
+                    and node.level == 0:
+                for a in node.names:
+                    if a.name == "*":
+                        continue
+                    self.aliases[a.asname or a.name] = f"{node.module}.{a.name}"
+
+    def resolve(self, node: ast.AST) -> Optional[str]:
+        """Canonical dotted path for a Name/Attribute chain, or None."""
+        parts: List[str] = []
+        while isinstance(node, ast.Attribute):
+            parts.append(node.attr)
+            node = node.value
+        if not isinstance(node, ast.Name):
+            return None
+        root = self.aliases.get(node.id, node.id)
+        parts.append(root)
+        return ".".join(reversed(parts))
+
+    def resolve_call(self, call: ast.Call) -> Optional[str]:
+        return self.resolve(call.func)
+
+
+class FileContext:
+    """Everything a rule needs to analyze one file."""
+
+    def __init__(self, rel_path: str, source: str, tree: Optional[ast.AST] = None):
+        self.rel_path = rel_path.replace("\\", "/")
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = tree if tree is not None else ast.parse(source)
+        self.imports = ImportTable(self.tree)
+        self.suppressions = parse_suppressions(source)
+
+    def line_text(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1]
+        return ""
+
+    def walk(self):
+        return ast.walk(self.tree)
+
+
+def walk_without_nested_defs(node: ast.AST, *, skip_self: bool = True):
+    """``ast.walk`` that stops at nested function/class/lambda boundaries."""
+    stack = list(ast.iter_child_nodes(node)) if skip_self else [node]
+    while stack:
+        n = stack.pop()
+        yield n
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.ClassDef, ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(n))

@@ -1,0 +1,92 @@
+"""Text and JSON reporters over a lint run's result.
+
+The JSON schema is versioned and STABLE — CI (lint.yml) and
+scripts/lint_report.py parse it, and tests/test_lint.py pins the keys.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from typing import List
+
+from daft_tpu.lint.baseline import BaselineEntry
+from daft_tpu.lint.core import Finding
+
+JSON_SCHEMA_VERSION = 1
+
+
+@dataclass
+class LintResult:
+    files_checked: int = 0
+    new: List[Finding] = field(default_factory=list)
+    baselined: List[Finding] = field(default_factory=list)
+    suppressed: int = 0
+    stale_baseline: List[BaselineEntry] = field(default_factory=list)
+    #: rel paths actually scanned (scopes stale detection / baseline updates)
+    scanned_paths: List[str] = field(default_factory=list)
+
+    @property
+    def exit_code(self) -> int:
+        return 1 if self.new else 0
+
+
+def render_text(result: LintResult, *, verbose: bool = False) -> str:
+    lines: List[str] = []
+    for f in sorted(result.new, key=lambda f: (f.path, f.line, f.rule)):
+        lines.append(f.render())
+        if f.snippet:
+            lines.append(f"    {f.snippet}")
+    if verbose and result.baselined:
+        lines.append("")
+        lines.append(f"baselined ({len(result.baselined)} grandfathered):")
+        for f in sorted(result.baselined,
+                        key=lambda f: (f.path, f.line, f.rule)):
+            lines.append(f"  {f.render()}")
+    if result.stale_baseline:
+        lines.append("")
+        lines.append(
+            f"stale baseline entries ({len(result.stale_baseline)}) — the "
+            f"code they grandfathered is gone; run --update-baseline:")
+        for e in sorted(result.stale_baseline, key=lambda e: (e.path, e.rule)):
+            lines.append(f"  {e.rule} {e.path}: {e.snippet!r}")
+    lines.append("")
+    lines.append(
+        f"daftlint: {result.files_checked} files, "
+        f"{len(result.new)} new finding(s), "
+        f"{len(result.baselined)} baselined, "
+        f"{result.suppressed} suppressed, "
+        f"{len(result.stale_baseline)} stale baseline entr(ies)")
+    return "\n".join(lines)
+
+
+def render_json(result: LintResult) -> str:
+    def finding_dict(f: Finding, baselined: bool) -> dict:
+        d = f.to_dict()
+        d["baselined"] = baselined
+        return d
+
+    doc = {
+        "version": JSON_SCHEMA_VERSION,
+        "tool": "daftlint",
+        "summary": {
+            "files": result.files_checked,
+            "new": len(result.new),
+            "baselined": len(result.baselined),
+            "suppressed": result.suppressed,
+            "stale_baseline": len(result.stale_baseline),
+        },
+        "findings": (
+            [finding_dict(f, False) for f in
+             sorted(result.new, key=lambda f: (f.path, f.line, f.rule))]
+            + [finding_dict(f, True) for f in
+               sorted(result.baselined, key=lambda f: (f.path, f.line, f.rule))]
+        ),
+        "stale_baseline": [
+            {"rule": e.rule, "path": e.path, "snippet": e.snippet,
+             "count": e.count, "reason": e.reason}
+            for e in sorted(result.stale_baseline,
+                            key=lambda e: (e.path, e.rule))
+        ],
+    }
+    return json.dumps(doc, indent=2)

@@ -1,0 +1,448 @@
+"""The daftlint rule set: the engine's real invariants, one class each.
+
+| ID     | invariant                                                        |
+|--------|------------------------------------------------------------------|
+| DTL001 | task-path code reads the frozen query clock, not the wall clock  |
+| DTL002 | broad exception handlers classify, log, or re-raise — not drop   |
+| DTL003 | execution-path randomness comes from a seeded generator          |
+| DTL004 | no blocking calls while holding a lock                           |
+| DTL005 | no per-element host<->device transfers in kernel hot loops       |
+| DTL006 | plan/partition construction never iterates bare sets             |
+| DTL007 | environment variables are read only in config.py / context.py    |
+
+Each rule documents WHY the invariant exists — a lint error nobody can
+explain gets suppressed instead of fixed.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Optional, Set
+
+from daft_tpu.lint.core import (
+    FileContext,
+    Finding,
+    Rule,
+    walk_without_nested_defs,
+)
+
+TASK_PATH_DIRS = ("daft_tpu/distributed/", "daft_tpu/execution/",
+                  "daft_tpu/kernels/", "daft_tpu/expressions/")
+EXECUTION_DIRS = TASK_PATH_DIRS + ("daft_tpu/ops/", "daft_tpu/io/")
+KERNEL_DIRS = ("daft_tpu/kernels/", "daft_tpu/ops/")
+PLAN_ORDER_DIRS = ("daft_tpu/logical/", "daft_tpu/distributed/",
+                   "daft_tpu/execution/", "daft_tpu/sql/")
+
+
+class WallClockInTaskPath(Rule):
+    """DTL001: recomputed partitions are byte-identical only if task-path
+    code derives time from ``Task.frozen_clock`` / ``query_now()``; ad-hoc
+    wall-clock reads make lineage recovery (distributed/planner.py) produce
+    different bytes on replay. Intervals/deadlines belong to
+    ``time.monotonic()``, which is exempt."""
+
+    rule_id = "DTL001"
+    summary = "wall-clock read in task path"
+    scope_dirs = TASK_PATH_DIRS
+
+    WALL_CLOCK = {
+        "time.time": "time.time()",
+        "datetime.datetime.now": "datetime.now()",
+        "datetime.datetime.utcnow": "datetime.utcnow()",
+        "datetime.datetime.today": "datetime.today()",
+        "datetime.date.today": "date.today()",
+    }
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        for node in ctx.walk():
+            if not isinstance(node, ast.Call):
+                continue
+            dotted = ctx.imports.resolve_call(node)
+            if dotted in self.WALL_CLOCK:
+                yield self.finding(
+                    ctx, node,
+                    f"{self.WALL_CLOCK[dotted]} in a task execution path; "
+                    f"use context.query_now() (frozen per query for "
+                    f"byte-identical recompute) or time.monotonic() for "
+                    f"intervals")
+
+
+LOG_METHODS = {"debug", "info", "warning", "error", "exception", "critical",
+               "log", "warn"}
+CLASSIFY_NAMES = {"classify", "is_transient", "is_retryable", "find_in_chain",
+                  "is_transient_failure", "find_fetch_failure"}
+
+
+class SwallowedException(Rule):
+    """DTL002: an ``except Exception`` / bare ``except`` that neither
+    re-raises, logs, classifies (isinstance against the taxonomy), nor even
+    binds the exception object erases failures the dispatcher's
+    transient/fatal classification (distributed/scheduler.py) needs to see.
+    Narrow the catch to the expected failure types, or log before falling
+    back."""
+
+    rule_id = "DTL002"
+    summary = "swallowed broad exception"
+
+    BROAD = {"Exception", "BaseException"}
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        for node in ctx.walk():
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if not self._is_broad(node.type):
+                continue
+            if self._handles_it(node):
+                continue
+            label = "bare except" if node.type is None else \
+                f"except {ast.unparse(node.type)}"
+            yield self.finding(
+                ctx, node,
+                f"{label} swallows the failure: re-raise, classify against "
+                f"the transient/fatal taxonomy (errors.py), narrow the "
+                f"exception types, or log before falling back")
+
+    def _is_broad(self, type_node: Optional[ast.expr]) -> bool:
+        if type_node is None:
+            return True
+        candidates = type_node.elts if isinstance(type_node, ast.Tuple) \
+            else [type_node]
+        for c in candidates:
+            name = c.id if isinstance(c, ast.Name) else \
+                c.attr if isinstance(c, ast.Attribute) else None
+            if name in self.BROAD:
+                return True
+        return False
+
+    def _handles_it(self, handler: ast.ExceptHandler) -> bool:
+        bound = handler.name
+        for node in walk_without_nested_defs(ast.Module(body=handler.body,
+                                                        type_ignores=[]),
+                                             skip_self=True):
+            if isinstance(node, ast.Raise):
+                return True
+            if isinstance(node, ast.Call):
+                f = node.func
+                if isinstance(f, ast.Attribute) and f.attr in LOG_METHODS:
+                    return True
+                fname = f.id if isinstance(f, ast.Name) else \
+                    f.attr if isinstance(f, ast.Attribute) else ""
+                if fname in CLASSIFY_NAMES or "log" in fname.lower():
+                    return True
+            # Using the bound exception at all (isinstance classification,
+            # storing it for a later classifier, str(e) into a message)
+            # preserves the failure for someone downstream.
+            if bound and isinstance(node, ast.Name) and node.id == bound \
+                    and isinstance(node.ctx, ast.Load):
+                return True
+        return False
+
+
+class UnseededRandomness(Rule):
+    """DTL003: ``random.*`` / ``np.random.*`` module-level calls share hidden
+    global state, so FaultInjector replay (distributed/faults.py) and the
+    chaos suite stop being deterministic the moment any execution-path code
+    draws from them. Use a ``random.Random(seed)`` / ``np.random.default_rng``
+    instance owned and seeded by the component. ``jax.random`` is exempt
+    (explicit keys)."""
+
+    rule_id = "DTL003"
+    summary = "unseeded module-level randomness in execution path"
+    scope_dirs = EXECUTION_DIRS
+
+    ALLOWED_TAILS = {"default_rng"}
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        for node in ctx.walk():
+            if not isinstance(node, ast.Call):
+                continue
+            dotted = ctx.imports.resolve_call(node)
+            if dotted is None:
+                continue
+            if not (dotted.startswith("random.")
+                    or dotted.startswith("numpy.random.")):
+                continue
+            tail = dotted.rsplit(".", 1)[-1]
+            if tail in self.ALLOWED_TAILS or tail[:1].isupper():
+                continue  # constructors: random.Random(seed), np Generator...
+            yield self.finding(
+                ctx, node,
+                f"{dotted}() draws from hidden global RNG state; route "
+                f"through a seeded random.Random / numpy Generator owned by "
+                f"the component so fault-injection replay stays "
+                f"deterministic")
+
+
+class BlockingCallUnderLock(Rule):
+    """DTL004: ``time.sleep`` / synchronous IO inside a ``with lock:`` body
+    turns every other thread contending on that lock into a convoy — in the
+    scheduler/daemon that is a head-of-line stall for the whole query. Move
+    the blocking call outside the critical section (compute the deadline
+    under the lock, sleep outside)."""
+
+    rule_id = "DTL004"
+    summary = "blocking call while holding a lock"
+
+    LOCK_NAME_PARTS = ("lock", "cond", "guard", "mutex")
+    BLOCKING_PREFIXES = ("subprocess.", "socket.", "requests.")
+    BLOCKING_EXACT = {"time.sleep", "concurrent.futures.wait",
+                      "urllib.request.urlopen"}
+    BLOCKING_METHODS = {"recv", "recv_into", "sendall", "accept",
+                        "connect", "result", "urlopen"}
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        for node in ctx.walk():
+            if not isinstance(node, (ast.With, ast.AsyncWith)):
+                continue
+            lock_names = [self._lock_name(item.context_expr)
+                          for item in node.items]
+            lock_names = [n for n in lock_names if n]
+            if not lock_names:
+                continue
+            for inner in walk_without_nested_defs(
+                    ast.Module(body=node.body, type_ignores=[]),
+                    skip_self=True):
+                if not isinstance(inner, ast.Call):
+                    continue
+                why = self._blocking_reason(ctx, inner)
+                if why:
+                    yield self.finding(
+                        ctx, inner,
+                        f"{why} inside `with {lock_names[0]}:` blocks every "
+                        f"thread contending on the lock; move it outside the "
+                        f"critical section")
+
+    def _lock_name(self, expr: ast.expr) -> Optional[str]:
+        name = expr.attr if isinstance(expr, ast.Attribute) else \
+            expr.id if isinstance(expr, ast.Name) else None
+        if name and any(p in name.lower() for p in self.LOCK_NAME_PARTS):
+            return name
+        return None
+
+    def _blocking_reason(self, ctx: FileContext, call: ast.Call) -> Optional[str]:
+        dotted = ctx.imports.resolve_call(call)
+        if dotted:
+            if dotted in self.BLOCKING_EXACT:
+                return f"{dotted}()"
+            if any(dotted.startswith(p) for p in self.BLOCKING_PREFIXES):
+                return f"{dotted}()"
+        f = call.func
+        if isinstance(f, ast.Attribute) and f.attr in self.BLOCKING_METHODS:
+            return f".{f.attr}()"
+        return None
+
+
+class HostDeviceTransferInKernel(Rule):
+    """DTL005: ``np.asarray`` / ``.tolist()`` / ``jax.device_get`` /
+    ``block_until_ready`` inside a kernel hot loop synchronizes the device
+    once per element instead of once per batch — on TPU each sync is a full
+    round-trip that flushes the XLA pipeline. Hoist the transfer out of the
+    loop and operate on the batch."""
+
+    rule_id = "DTL005"
+    summary = "per-element host/device transfer in kernel loop"
+    scope_dirs = KERNEL_DIRS
+
+    TRANSFER_DOTTED = {"numpy.asarray", "jax.device_get",
+                       "jax.block_until_ready"}
+    TRANSFER_METHODS = {"tolist", "block_until_ready"}
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        self._scan(ctx, self._function_bodies(ctx), findings)
+        return findings
+
+    def _function_bodies(self, ctx: FileContext):
+        yield ctx.tree
+
+    def _scan(self, ctx: FileContext, roots, findings: List[Finding]) -> None:
+        for root in roots:
+            self._visit(ctx, root, 0, findings)
+
+    COMPREHENSIONS = (ast.ListComp, ast.SetComp, ast.DictComp,
+                      ast.GeneratorExp)
+
+    def _visit(self, ctx: FileContext, node: ast.AST, loop_depth: int,
+               findings: List[Finding]) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, self.COMPREHENSIONS):
+                # The first generator's ITERABLE evaluates once, outside the
+                # loop; the elt, conditions, and nested generators run per
+                # element.
+                self._visit(ctx, child.generators[0].iter, loop_depth,
+                            findings)
+                self._check_call(ctx, child.generators[0].iter, loop_depth,
+                                 findings)
+                for sub in ast.iter_child_nodes(child):
+                    if sub is child.generators[0]:
+                        for part in (child.generators[0].target,
+                                     *child.generators[0].ifs):
+                            self._check_call(ctx, part, loop_depth + 1,
+                                             findings)
+                            self._visit(ctx, part, loop_depth + 1, findings)
+                        continue
+                    self._check_call(ctx, sub, loop_depth + 1, findings)
+                    self._visit(ctx, sub, loop_depth + 1, findings)
+                continue
+            depth = loop_depth
+            if isinstance(child, (ast.For, ast.AsyncFor, ast.While)):
+                depth += 1
+            elif isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                    ast.Lambda)):
+                # A callback defined inside the loop runs LATER, outside it
+                # (same lexical-vs-dynamic distinction DTL004 makes).
+                depth = 0
+            self._check_call(ctx, child, depth, findings)
+            self._visit(ctx, child, depth, findings)
+
+    def _check_call(self, ctx: FileContext, node: ast.AST, depth: int,
+                    findings: List[Finding]) -> None:
+        if isinstance(node, ast.Call) and depth > 0:
+            what = self._transfer(ctx, node)
+            if what:
+                findings.append(self.finding(
+                    ctx, node,
+                    f"{what} inside a loop forces a host/device sync per "
+                    f"element; hoist the transfer out of the loop and "
+                    f"batch it"))
+
+    def _transfer(self, ctx: FileContext, call: ast.Call) -> Optional[str]:
+        dotted = ctx.imports.resolve_call(call)
+        if dotted in self.TRANSFER_DOTTED:
+            return f"{dotted}()"
+        f = call.func
+        if isinstance(f, ast.Attribute) and f.attr in self.TRANSFER_METHODS:
+            return f".{f.attr}()"
+        return None
+
+
+class NondeterministicIteration(Rule):
+    """DTL006: iterating a bare ``set`` builds an order that varies with
+    PYTHONHASHSEED; when that order feeds plan construction or partition
+    layout, plan fingerprints and chaos-suite replays diverge across
+    processes. Wrap the iteration in ``sorted(...)`` (order-insensitive
+    reducers like any/all/min/max/len and set algebra are fine and not
+    flagged)."""
+
+    rule_id = "DTL006"
+    summary = "order-sensitive iteration over a bare set"
+    scope_dirs = PLAN_ORDER_DIRS
+
+    #: engine APIs documented to return sets
+    SET_RETURNING_METHODS = {"column_refs", "union", "intersection",
+                             "difference", "symmetric_difference"}
+    SET_BINOPS = (ast.BitOr, ast.BitAnd, ast.Sub, ast.BitXor)
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        for scope in self._scopes(ctx.tree):
+            tracked = self._tracked_sets(scope)
+            for node in walk_without_nested_defs(scope, skip_self=True):
+                self._check_node(ctx, node, tracked, findings)
+        return findings
+
+    def _scopes(self, tree: ast.AST):
+        yield tree
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield node
+
+    def _tracked_sets(self, scope: ast.AST) -> Set[str]:
+        tracked: Set[str] = set()
+        # Two passes so `a = set(); b = a | other` tracks b.
+        for _ in range(2):
+            for node in walk_without_nested_defs(scope, skip_self=True):
+                if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+                    value = node.value
+                    targets = node.targets if isinstance(node, ast.Assign) \
+                        else [node.target]
+                    if value is not None and self._is_set_expr(value, tracked):
+                        for t in targets:
+                            if isinstance(t, ast.Name):
+                                tracked.add(t.id)
+        return tracked
+
+    def _is_set_expr(self, node: ast.expr, tracked: Set[str]) -> bool:
+        if isinstance(node, (ast.Set, ast.SetComp)):
+            return True
+        if isinstance(node, ast.Name):
+            return node.id in tracked
+        if isinstance(node, ast.Call):
+            f = node.func
+            if isinstance(f, ast.Name) and f.id in ("set", "frozenset"):
+                return True
+            if isinstance(f, ast.Attribute) \
+                    and f.attr in self.SET_RETURNING_METHODS:
+                return True
+        if isinstance(node, ast.BinOp) and isinstance(node.op, self.SET_BINOPS):
+            return self._is_set_expr(node.left, tracked) \
+                or self._is_set_expr(node.right, tracked)
+        return False
+
+    def _check_node(self, ctx: FileContext, node: ast.AST,
+                    tracked: Set[str], findings: List[Finding]) -> None:
+        hint = ("iteration order varies with PYTHONHASHSEED and feeds "
+                "ordered output; wrap in sorted(...)")
+        if isinstance(node, (ast.For, ast.AsyncFor)) \
+                and self._is_set_expr(node.iter, tracked):
+            findings.append(self.finding(
+                ctx, node.iter, f"for-loop over a bare set: {hint}"))
+            return
+        if isinstance(node, ast.ListComp):
+            gen = node.generators[0]
+            if self._is_set_expr(gen.iter, tracked):
+                findings.append(self.finding(
+                    ctx, gen.iter, f"list built from a bare set: {hint}"))
+            return
+        if isinstance(node, ast.Call):
+            f = node.func
+            ordered_builders = {"list", "tuple", "enumerate"}
+            if isinstance(f, ast.Name) and f.id in ordered_builders \
+                    and node.args and self._is_set_expr(node.args[0], tracked):
+                findings.append(self.finding(
+                    ctx, node, f"{f.id}() over a bare set: {hint}"))
+            elif isinstance(f, ast.Attribute) and f.attr == "join" \
+                    and node.args and self._is_set_expr(node.args[0], tracked):
+                findings.append(self.finding(
+                    ctx, node, f"str.join over a bare set: {hint}"))
+
+
+class EnvReadOutsideConfig(Rule):
+    """DTL007: scattered ``os.environ`` reads are how config drift happens —
+    a knob consulted in one process but not forwarded to workers, or read
+    after the config snapshot was taken. All environment access funnels
+    through ``config.py`` / ``context.py`` (``daft_env()``), which is the
+    single audited, mockable choke point."""
+
+    rule_id = "DTL007"
+    summary = "environment read outside config.py/context.py"
+    exempt_files = ("daft_tpu/config.py", "daft_tpu/context.py")
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        for node in ctx.walk():
+            if isinstance(node, ast.Attribute):
+                if ctx.imports.resolve(node) == "os.environ":
+                    yield self.finding(
+                        ctx, node,
+                        "os.environ access outside config.py/context.py; "
+                        "route through daft_tpu.config.daft_env() so every "
+                        "knob is forwarded to workers and mockable in tests")
+            elif isinstance(node, ast.Call):
+                if ctx.imports.resolve_call(node) == "os.getenv":
+                    yield self.finding(
+                        ctx, node,
+                        "os.getenv outside config.py/context.py; route "
+                        "through daft_tpu.config.daft_env()")
+
+
+ALL_RULES = [WallClockInTaskPath, SwallowedException, UnseededRandomness,
+             BlockingCallUnderLock, HostDeviceTransferInKernel,
+             NondeterministicIteration, EnvReadOutsideConfig]
+
+
+def default_rules() -> List[Rule]:
+    return [cls() for cls in ALL_RULES]
+
+
+def rules_by_id() -> dict:
+    return {cls.rule_id: cls for cls in ALL_RULES}

@@ -1,0 +1,105 @@
+"""File walking + rule application + suppression/baseline filtering."""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Iterable, List, Optional, Sequence, Tuple
+
+from daft_tpu.lint.baseline import DEFAULT_BASELINE_NAME, Baseline
+from daft_tpu.lint.core import FileContext, Finding, Rule
+from daft_tpu.lint.reporters import LintResult
+from daft_tpu.lint.rules import default_rules
+
+SKIP_DIRS = {"__pycache__", ".git", ".venv", "node_modules"}
+
+
+def repo_root() -> str:
+    """Parent of the daft_tpu package — baseline paths are relative to it.
+    Derived from __file__, not an import, so the analyzer works even when
+    the engine itself is too broken to import."""
+    return os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+
+
+def _iter_py_files(paths: Sequence[str]) -> Iterable[str]:
+    for p in paths:
+        if os.path.isfile(p):
+            if p.endswith(".py"):
+                yield p
+        else:
+            for dirpath, dirnames, filenames in os.walk(p):
+                dirnames[:] = sorted(d for d in dirnames if d not in SKIP_DIRS)
+                for fn in sorted(filenames):
+                    if fn.endswith(".py"):
+                        yield os.path.join(dirpath, fn)
+
+
+def _rel(path: str, root: str) -> str:
+    abspath = os.path.abspath(path)
+    absroot = os.path.abspath(root)
+    if abspath.startswith(absroot + os.sep):
+        return os.path.relpath(abspath, absroot).replace(os.sep, "/")
+    return abspath.replace(os.sep, "/")
+
+
+def lint_source(source: str, rel_path: str,
+                rules: Optional[Sequence[Rule]] = None,
+                *, apply_suppressions: bool = True
+                ) -> Tuple[List[Finding], int]:
+    """Lint one in-memory source blob. Returns (findings, n_suppressed).
+
+    A syntax error becomes a DTL000 finding rather than an exception: the
+    analyzer must keep working on a broken tree (that is when you need it)."""
+    rules = list(rules) if rules is not None else default_rules()
+    try:
+        tree = ast.parse(source)
+    except SyntaxError as e:
+        return [Finding(rule="DTL000", path=rel_path, line=e.lineno or 1,
+                        col=(e.offset or 1) - 1,
+                        message=f"syntax error: {e.msg}", snippet="")], 0
+    ctx = FileContext(rel_path, source, tree)
+    raw: List[Finding] = []
+    for rule in rules:
+        if rule.applies_to(rel_path):
+            raw.extend(rule.check(ctx))
+    if not apply_suppressions:
+        return raw, 0
+    kept = [f for f in raw if not ctx.suppressions.is_suppressed(f)]
+    return kept, len(raw) - len(kept)
+
+
+def run_paths(paths: Sequence[str], *, root: Optional[str] = None,
+              rules: Optional[Sequence[Rule]] = None,
+              baseline: Optional[Baseline] = None) -> LintResult:
+    root = root or repo_root()
+    rules = list(rules) if rules is not None else default_rules()
+    result = LintResult()
+    all_findings: List[Finding] = []
+    for path in _iter_py_files(paths):
+        rel = _rel(path, root)
+        with open(path, "r", encoding="utf-8") as fh:
+            source = fh.read()
+        findings, suppressed = lint_source(source, rel, rules)
+        all_findings.extend(findings)
+        result.suppressed += suppressed
+        result.files_checked += 1
+        result.scanned_paths.append(rel)
+    if baseline is not None:
+        result.new, result.baselined, stale = \
+            baseline.partition(all_findings)
+        # A partial run (subset of paths, subset of rules) says NOTHING
+        # about baseline entries outside its scope — reporting those as
+        # stale would tell the operator to --update-baseline them away.
+        scanned = set(result.scanned_paths)
+        active = {r.rule_id for r in rules}
+        result.stale_baseline = [e for e in stale
+                                 if e.path in scanned and e.rule in active]
+    else:
+        result.new = all_findings
+    return result
+
+
+def find_baseline(root: str) -> Optional[str]:
+    candidate = os.path.join(root, DEFAULT_BASELINE_NAME)
+    return candidate if os.path.isfile(candidate) else None

@@ -19,8 +19,10 @@ reference's highest-impact subset, see SURVEY.md §2.1 daft-logical-plan):
 
 from __future__ import annotations
 
+import logging
 from typing import List, Optional, Sequence
 
+from daft_tpu.errors import DaftError
 from daft_tpu.expressions.expr import (
     AggOp,
     Alias,
@@ -34,6 +36,8 @@ from daft_tpu.expressions.expr import (
     UnaryOp,
 )
 from daft_tpu.logical import plan as lp
+
+_log = logging.getLogger("daft_tpu.optimizer")
 
 
 class Rule:
@@ -115,7 +119,19 @@ class Optimizer:
                 changed = False
                 for rule in batch:
                     rewriter = _rewrite_top_down if rule.top_down else _rewrite_bottom_up
-                    new_plan = rewriter(plan, rule)
+                    try:
+                        new_plan = rewriter(plan, rule)
+                    except Exception:
+                        # A crashing rewrite rule must be DIAGNOSABLE, never a
+                        # silent skip: log the rule and the plan root, keep
+                        # the pre-rule plan, and continue with the batch
+                        # (optimizations are best-effort; correctness comes
+                        # from the unoptimized plan being valid).
+                        _log.warning(
+                            "optimizer rule %s crashed on plan node %s; "
+                            "keeping the pre-rule plan", rule.name,
+                            type(plan).__name__, exc_info=True)
+                        continue
                     if new_plan is not plan:
                         plan = new_plan
                         changed = True
@@ -181,8 +197,8 @@ def simplify_expr(e: Expr, schema=None) -> Expr:
             return False
         try:
             return a.to_field(schema).dtype == whole.to_field(schema).dtype
-        except Exception:
-            return False
+        except (DaftError, KeyError, TypeError, NotImplementedError):
+            return False  # unresolvable field: identity rewrite not provably safe
 
     def fold(n: Expr):
         if isinstance(n, BinaryOp):
@@ -197,6 +213,11 @@ def simplify_expr(e: Expr, schema=None) -> Expr:
                     vals = res.to_pylist()
                     return Literal(vals[0], res.dtype)
                 except Exception:
+                    # Folding is opportunistic; a non-foldable pair (e.g.
+                    # division by zero surfacing at plan time) stays symbolic
+                    # — but leave a trace so a mis-typed literal is findable.
+                    _log.debug("constant fold of %s failed", n.op,
+                               exc_info=True)
                     return None
             # NULL literal propagates through comparisons/arithmetic
             # (null.rs) — NOT through Kleene and/or. The replacement keeps
@@ -207,8 +228,8 @@ def simplify_expr(e: Expr, schema=None) -> Expr:
                     return None
                 try:
                     return Literal(None, n.to_field(schema).dtype)
-                except Exception:
-                    return None
+                except (DaftError, KeyError, TypeError, NotImplementedError):
+                    return None  # dtype unresolvable: keep the symbolic form
             # Kleene boolean identities (boolean.rs): the short-circuit
             # absorptions hold even for null operands.
             if n.op == "and":
@@ -341,8 +362,8 @@ class PushDownFilter(Rule):
                 try:
                     new_pred = _substitute(pred, mapping)
                     new_pred.to_field(child.children()[0].schema)
-                except Exception:
-                    return None
+                except (DaftError, KeyError, TypeError, NotImplementedError):
+                    return None  # predicate does not type below the project
                 return lp.Project(lp.Filter(child.children()[0], new_pred), child.exprs)
         # NOTE: MonotonicallyIncreasingId is NOT pass-through — filtering before
         # id assignment would renumber the surviving rows.
@@ -728,13 +749,19 @@ class EnrichWithStats(Rule):
         try:
             files = info.files()
         except Exception:
+            _log.debug("stats enrichment: listing files failed; skipping",
+                       exc_info=True)
             return None
 
         def read_footer(f):
             try:
                 fs, p = resolve_filesystem(f.path, info.read_options.get("io_config"))
                 return f, pq.ParquetFile(fs.open_input_file(p)).metadata
-            except Exception:  # unreadable footer: keep going without stats
+            except Exception:
+                # Unreadable footer: keep going without stats, but leave a
+                # trace — systematic footer failures mean IO misconfig.
+                _log.debug("stats enrichment: unreadable parquet footer %s",
+                           getattr(f, "path", f), exc_info=True)
                 return f, None
 
         targets = files[:self.MAX_FOOTER_READS]
@@ -902,6 +929,8 @@ class FilterNullJoinKey(Rule):
                         for rb in part.record_batches():
                             n += rb.get_column(col).null_count()
                 except Exception:
+                    _log.debug("null-count measurement for %r failed; "
+                               "assuming none", col, exc_info=True)
                     n = 0
                 cache[col] = n
             return cache[col] > 0
@@ -1034,8 +1063,8 @@ def _already_filtering(side, expr: Expr) -> bool:
             mapping = {p.name(): _strip_alias(p) for p in node.exprs}
             try:
                 e = _substitute(e, mapping)
-            except Exception:
-                return False
+            except (DaftError, KeyError, TypeError, NotImplementedError):
+                return False  # unmappable through the project: not filtered
             node = node.children()[0]
             continue
         if isinstance(node, (lp.Sort, lp.Repartition)):
@@ -1402,6 +1431,8 @@ class ReorderJoins(Rule):
                 return None
             rebuilt = lp.Project(new_plan, [ColumnRef(n) for n in out_names])
         except Exception:
+            _log.debug("join reorder: output projection rebuild failed; "
+                       "keeping original order", exc_info=True)
             return None
         if self._tree_shape(rebuilt) == self._tree_shape(node):
             return None
@@ -1469,6 +1500,8 @@ class ReorderJoins(Rule):
             combined = pa.concat_tables(tables)
             return float(combined.group_by(names).aggregate([]).num_rows)
         except Exception:
+            _log.debug("NDV measurement failed; falling back to row-count "
+                       "proxy", exc_info=True)
             return None
 
     def _dp_order(self, relations, edges):
@@ -1578,6 +1611,8 @@ class ReorderJoins(Rule):
             j._reordered = True  # don't re-enumerate subtrees of a DP result
             return j
         except Exception:
+            _log.debug("join reorder: Join construction failed; keeping "
+                       "original order", exc_info=True)
             return None
 
 

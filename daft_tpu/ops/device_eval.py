@@ -28,6 +28,7 @@ from typing import Dict, List, Optional, Sequence
 import numpy as np
 
 from daft_tpu.datatype import DataType, TypeId
+from daft_tpu.errors import DaftError
 from daft_tpu.expressions.expr import (
     Alias,
     BinaryOp,
@@ -114,8 +115,8 @@ def _dtype_ok(dt: DataType) -> bool:
             base = dt.inner
             break
         np_dt = base.to_numpy()
-    except Exception:
-        return False
+    except (DaftError, TypeError, ValueError, KeyError, NotImplementedError):
+        return False  # dtype has no numpy image: not device-representable
     return np_dt.itemsize <= _MAX_ITEMSIZE
 
 
@@ -149,8 +150,8 @@ def _out_dtype_ok(expr: Expr, dtype: DataType) -> bool:
 def _is_fusable(expr: Expr, schema) -> bool:
     try:
         out_field = expr.to_field(schema)
-    except Exception:
-        return False
+    except (DaftError, TypeError, KeyError, NotImplementedError):
+        return False  # unresolvable expression: stays on the host path
     if not _out_dtype_ok(expr, out_field.dtype):
         return False
     for node in expr.walk():
@@ -367,9 +368,12 @@ def try_evaluate_fused(rb, exprs: Sequence[Expr]) -> Optional[Dict[int, Series]]
                tuple(sorted((k, str(v.dtype), v.shape[1:]) for k, v in cols_dev.items())))
         fn = _compiled_for(key, chosen_exprs)
         outs = fn(cols_dev)
+        # ONE batched device->host transfer for every output column
+        # (daftlint DTL005): np.asarray per column inside the loop would
+        # sync the device once per expression instead of once per batch.
+        outs_host = jax.device_get([out[:n] for out in outs])
         result: Dict[int, Series] = {}
-        for i, e, out in zip(chosen, chosen_exprs, outs):
-            arr = np.asarray(out[:n])
+        for i, e, arr in zip(chosen, chosen_exprs, outs_host):
             target = e.to_field(schema).dtype
             s = Series.from_numpy(arr, e.name(), _np_result_dtype(target, arr))
             if s.dtype != target:
@@ -411,7 +415,7 @@ def _np_result_dtype(target: DataType, arr: np.ndarray) -> DataType:
             if target.shape == () and not target.is_logical() \
                     and target.to_numpy() != arr.dtype:
                 return DataType.from_numpy(arr.dtype)
-        except Exception:
-            pass
+        except (DaftError, TypeError, ValueError, KeyError, NotImplementedError):
+            pass  # no numpy image for the target: keep the resolved dtype
         return target
     return DataType.from_numpy(arr.dtype)

@@ -31,9 +31,11 @@ class DistributedRunner(Runner):
         if manager is not None:
             self.manager = manager
             return
-        backend = backend or os.environ.get("DAFT_WORKER_BACKEND", "thread")
-        addresses = os.environ.get("DAFT_WORKER_ADDRESSES")
-        n = num_workers or cfg.num_workers or int(os.environ.get("DAFT_NUM_WORKERS", "2"))
+        from daft_tpu.config import daft_env
+
+        backend = backend or daft_env("DAFT_WORKER_BACKEND", "thread")
+        addresses = daft_env("DAFT_WORKER_ADDRESSES")
+        n = num_workers or cfg.num_workers or int(daft_env("DAFT_NUM_WORKERS", "2"))
         if addresses or backend == "daemon":
             # Multi-host daemons reachable over TCP + Flight (reference: the
             # Ray-actor control plane in daft/runners/flotilla.py:139-290).
@@ -56,8 +58,8 @@ class DistributedRunner(Runner):
                 for p in self._daemon_procs:  # don't leak half-started daemons
                     try:
                         p.kill()
-                    except Exception:
-                        pass
+                    except OSError:
+                        pass  # already exited
                 raise
             procs = self._daemon_procs
 
@@ -67,8 +69,8 @@ class DistributedRunner(Runner):
                     for p in procs:
                         try:
                             p.kill()
-                        except Exception:
-                            pass
+                        except OSError:
+                            pass  # already exited
 
             self.manager = _DaemonManager(workers)
             self._start_heartbeat(cfg)

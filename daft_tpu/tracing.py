@@ -308,12 +308,14 @@ _auto_lock = threading.Lock()
 def maybe_enable_tracing(context) -> None:
     """Env-gated auto-attach (reference: DAFT_DEV_ENABLE_TRACING)."""
     global _auto_subscriber
-    if _auto_subscriber is not None or not os.environ.get("DAFT_DEV_ENABLE_TRACING"):
+    from daft_tpu.config import daft_env
+
+    if _auto_subscriber is not None or not daft_env("DAFT_DEV_ENABLE_TRACING"):
         return
     with _auto_lock:
         if _auto_subscriber is not None:  # double-checked: notify() races
             return
-        path = os.environ.get("DAFT_TRACE_FILE")
+        path = daft_env("DAFT_TRACE_FILE")
         if not path:
             import tempfile
 

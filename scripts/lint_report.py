@@ -1,0 +1,86 @@
+#!/usr/bin/env python
+"""Diff current daftlint findings against the checked-in baseline — the
+review-time view: what is NEW in this change, what is still grandfathered,
+and which baseline entries went stale (their code was fixed; prune them
+with ``python -m daft_tpu.lint --update-baseline``).
+
+Usage::
+
+    python -m daft_tpu.lint --format=json daft_tpu/ | python scripts/lint_report.py
+    python scripts/lint_report.py daftlint.json
+    python scripts/lint_report.py            # runs the linter itself
+
+Exit code mirrors the gate: non-zero iff there are NEW findings.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def load_document(argv) -> dict:
+    if len(argv) > 1:
+        with open(argv[1], "r", encoding="utf-8") as fh:
+            return json.load(fh)
+    if not sys.stdin.isatty():
+        data = sys.stdin.read().strip()
+        if data:
+            return json.loads(data)
+    # No input: run the analysis in-process.
+    from daft_tpu.lint import (
+        Baseline,
+        find_baseline,
+        render_json,
+        repo_root,
+        run_paths,
+    )
+
+    root = repo_root()
+    baseline_path = find_baseline(root)
+    baseline = Baseline.load(baseline_path) if baseline_path else None
+    result = run_paths([os.path.join(root, "daft_tpu")], root=root,
+                       baseline=baseline)
+    return json.loads(render_json(result))
+
+
+def main(argv) -> int:
+    doc = load_document(argv)
+    if doc.get("tool") != "daftlint":
+        print("lint_report: input is not a daftlint JSON document",
+              file=sys.stderr)
+        return 2
+    summary = doc["summary"]
+    new = [f for f in doc["findings"] if not f["baselined"]]
+    stale = doc.get("stale_baseline", [])
+
+    print(f"daftlint report — {summary['files']} files scanned")
+    print(f"  new:            {summary['new']}")
+    print(f"  baselined:      {summary['baselined']} (grandfathered)")
+    print(f"  suppressed:     {summary['suppressed']} (inline, with reasons)")
+    print(f"  stale baseline: {summary['stale_baseline']}")
+
+    if new:
+        print("\nNEW findings (these block the gate):")
+        for f in new:
+            print(f"  {f['path']}:{f['line']}:{f['col']}: {f['rule']} "
+                  f"{f['message']}")
+            if f.get("snippet"):
+                print(f"      {f['snippet']}")
+    if stale:
+        print("\nstale baseline entries — the grandfathered code is gone; "
+              "shrink the baseline:")
+        for e in stale:
+            reason = f"  ({e['reason']})" if e.get("reason") else ""
+            print(f"  {e['rule']} {e['path']}: {e['snippet']!r}{reason}")
+        print("  -> python -m daft_tpu.lint --update-baseline daft_tpu/")
+    if not new and not stale:
+        print("\nclean: no new findings, baseline fully accounted for")
+    return 1 if new else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))

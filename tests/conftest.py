@@ -36,6 +36,26 @@ def pytest_configure(config):
     config.addinivalue_line("markers", "slow: excluded from the tier-1 run")
 
 
+def pytest_collection_modifyitems(config, items):
+    # Enforce the `slow` marker's contract instead of trusting every
+    # invocation to pass -m 'not slow': a bare `pytest tests/` skips slow
+    # tests; any explicit -m expression (e.g. `-m slow`, `-m 'not chaos'`)
+    # takes full control.
+    if config.getoption("-m") or config.getoption("-k"):
+        return
+    # Explicit node-id selection is the most direct opt-in there is.
+    explicit = [str(a) for a in config.invocation_params.args if "::" in str(a)]
+
+    def selected_directly(item):
+        return any(item.nodeid == a or
+                   item.nodeid.endswith(a[a.index("::"):]) for a in explicit)
+
+    skip_slow = pytest.mark.skip(reason="slow: select explicitly with -m slow")
+    for item in items:
+        if "slow" in item.keywords and not selected_directly(item):
+            item.add_marker(skip_slow)
+
+
 @pytest.fixture(scope="session")
 def runner_name():
     return os.environ.get("DAFT_RUNNER", "native")

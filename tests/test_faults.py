@@ -585,3 +585,21 @@ def test_partition_fetch_error_pickles():
     e = PartitionFetchError("gone", [{"slot": 0, "pos": 2, "worker_id": "w9"}])
     e2 = pickle.loads(pickle.dumps(e))
     assert e2.lost == e.lost and "gone" in str(e2)
+
+
+def test_fault_injector_seeds_retry_jitter():
+    """Arming a seeded FaultInjector pins the io-retry backoff jitter, so a
+    replayed fault schedule reproduces the full retry CADENCE too (PR 3:
+    daftlint DTL003 fix is wired, not just available)."""
+    from daft_tpu.distributed.faults import FaultInjector
+    from daft_tpu.io.retry import RetryPolicy, seed_retry_jitter
+
+    p = RetryPolicy()
+    try:
+        FaultInjector("worker.pre_submit:raise:1", seed=123)
+        a = [p.sleep_s(i) for i in range(4)]
+        FaultInjector("worker.pre_submit:raise:1", seed=123)
+        b = [p.sleep_s(i) for i in range(4)]
+        assert a == b
+    finally:
+        seed_retry_jitter(None)

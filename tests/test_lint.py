@@ -1,0 +1,437 @@
+"""daftlint: rule unit tests on synthetic snippets, suppression/baseline
+mechanics, JSON reporter schema stability, and the zero-new-violations
+sweep over the real package (the CI gate, in-process)."""
+
+import json
+import os
+import textwrap
+
+import pytest
+
+from daft_tpu.lint import (
+    Baseline,
+    Finding,
+    LintResult,
+    default_rules,
+    lint_source,
+    render_json,
+    render_text,
+    repo_root,
+    run_paths,
+    rules_by_id,
+)
+
+TASK_PATH = "daft_tpu/distributed/snippet.py"
+KERNEL_PATH = "daft_tpu/kernels/snippet.py"
+PLAN_PATH = "daft_tpu/logical/snippet.py"
+ANY_PATH = "daft_tpu/snippet.py"
+
+
+def findings_for(code, path, rule_id=None):
+    out, _ = lint_source(textwrap.dedent(code), path)
+    if rule_id is not None:
+        out = [f for f in out if f.rule == rule_id]
+    return out
+
+
+# --------------------------------------------------------------------- #
+# Per-rule: fires on the minimal positive snippet, quiet on the negative #
+# --------------------------------------------------------------------- #
+
+def test_dtl001_wall_clock_positive_and_negative():
+    pos = """
+    import time
+    def task_body():
+        return time.time()
+    """
+    neg = """
+    import time
+    from daft_tpu.context import query_now
+    def task_body():
+        t0 = time.monotonic()
+        return query_now(), time.monotonic() - t0
+    """
+    assert len(findings_for(pos, TASK_PATH, "DTL001")) == 1
+    assert findings_for(neg, TASK_PATH, "DTL001") == []
+
+
+def test_dtl001_resolves_import_aliases_and_scope():
+    aliased = """
+    import datetime as dt
+    def f():
+        return dt.datetime.utcnow()
+    """
+    from_import = """
+    from datetime import datetime
+    def f():
+        return datetime.now()
+    """
+    assert len(findings_for(aliased, TASK_PATH, "DTL001")) == 1
+    assert len(findings_for(from_import, TASK_PATH, "DTL001")) == 1
+    # Outside the task-path directories the rule does not apply.
+    assert findings_for(aliased, "daft_tpu/sql/snippet.py", "DTL001") == []
+
+
+def test_dtl002_swallowed_exception_positive_and_negative():
+    pos = """
+    def f():
+        try:
+            work()
+        except Exception:
+            return None
+    """
+    bare = """
+    def f():
+        try:
+            work()
+        except:
+            pass
+    """
+    assert len(findings_for(pos, ANY_PATH, "DTL002")) == 1
+    assert len(findings_for(bare, ANY_PATH, "DTL002")) == 1
+    for neg in [
+        # re-raise
+        "def f():\n try:\n  work()\n except Exception:\n  raise",
+        # logs
+        "import logging\ndef f():\n try:\n  work()\n except Exception:\n"
+        "  logging.getLogger(__name__).warning('x', exc_info=True)",
+        # narrow catch
+        "def f():\n try:\n  work()\n except ValueError:\n  return None",
+        # uses the bound exception (stored for a later classifier)
+        "def f(out):\n try:\n  work()\n except Exception as e:\n"
+        "  out.append(e)",
+    ]:
+        assert findings_for(neg, ANY_PATH, "DTL002") == [], neg
+
+
+def test_dtl003_unseeded_randomness_positive_and_negative():
+    pos = """
+    import random
+    def backoff():
+        return random.random()
+    """
+    np_pos = """
+    import numpy as np
+    def sample():
+        return np.random.rand(4)
+    """
+    neg = """
+    import random
+    import numpy as np
+    _rng = random.Random(42)
+    _gen = np.random.default_rng(7)
+    def backoff():
+        return _rng.random() + _gen.random()
+    """
+    assert len(findings_for(pos, "daft_tpu/io/snippet.py", "DTL003")) == 1
+    assert len(findings_for(np_pos, KERNEL_PATH, "DTL003")) == 1
+    assert findings_for(neg, "daft_tpu/io/snippet.py", "DTL003") == []
+
+
+def test_dtl004_blocking_under_lock_positive_and_negative():
+    pos = """
+    import threading, time
+    _lock = threading.Lock()
+    def f():
+        with _lock:
+            time.sleep(1.0)
+    """
+    neg = """
+    import threading, time
+    _lock = threading.Lock()
+    def f():
+        with _lock:
+            deadline = compute()
+        time.sleep(deadline)
+    """
+    assert len(findings_for(pos, ANY_PATH, "DTL004")) == 1
+    assert findings_for(neg, ANY_PATH, "DTL004") == []
+
+
+def test_dtl004_ignores_nested_function_bodies():
+    code = """
+    import threading, time
+    _lock = threading.Lock()
+    def f():
+        with _lock:
+            def callback():
+                time.sleep(1.0)  # runs later, NOT under the lock
+            register(callback)
+    """
+    assert findings_for(code, ANY_PATH, "DTL004") == []
+
+
+def test_dtl005_transfer_in_loop_positive_and_negative():
+    pos = """
+    import numpy as np
+    def kernel(rows):
+        out = []
+        for r in rows:
+            out.append(np.asarray(r))
+        return out
+    """
+    tolist = """
+    def kernel(batches):
+        return [b.tolist() for b in batches]
+    """
+    neg = """
+    import numpy as np
+    def kernel(rows):
+        batch = np.asarray(rows)
+        return [r + 1 for r in batch]
+    """
+    assert len(findings_for(pos, KERNEL_PATH, "DTL005")) == 1
+    assert len(findings_for(tolist, KERNEL_PATH, "DTL005")) == 1
+    assert findings_for(neg, KERNEL_PATH, "DTL005") == []
+    # Out of kernel scope: no findings even in a loop.
+    assert findings_for(pos, "daft_tpu/io/snippet.py", "DTL005") == []
+
+
+def test_dtl005_ignores_callbacks_defined_inside_loops():
+    code = """
+    import numpy as np
+    def kernel(rows):
+        cbs = []
+        for r in rows:
+            def cb():
+                return np.asarray(r)  # runs later, outside the loop
+            cbs.append(cb)
+        return cbs
+    """
+    assert findings_for(code, KERNEL_PATH, "DTL005") == []
+
+
+def test_dtl006_set_iteration_positive_and_negative():
+    pos = """
+    def build(exprs):
+        cols = set()
+        for e in exprs:
+            cols |= e.column_refs()
+        return [make_ref(c) for c in cols]
+    """
+    neg = """
+    def build(exprs):
+        cols = set()
+        for e in exprs:
+            cols |= e.column_refs()
+        ok = all(c.isidentifier() for c in cols)
+        return [make_ref(c) for c in sorted(cols)]
+    """
+    assert len(findings_for(pos, PLAN_PATH, "DTL006")) == 1
+    assert findings_for(neg, PLAN_PATH, "DTL006") == []
+
+
+def test_dtl007_env_read_positive_and_exempt_files():
+    pos = """
+    import os
+    def knob():
+        return os.environ.get("DAFT_THING")
+    """
+    getenv = """
+    import os
+    def knob():
+        return os.getenv("DAFT_THING")
+    """
+    neg = """
+    from daft_tpu.config import daft_env
+    def knob():
+        return daft_env("DAFT_THING")
+    """
+    assert len(findings_for(pos, ANY_PATH, "DTL007")) == 1
+    assert len(findings_for(getenv, ANY_PATH, "DTL007")) == 1
+    assert findings_for(neg, ANY_PATH, "DTL007") == []
+    # config.py and context.py are the sanctioned homes.
+    assert findings_for(pos, "daft_tpu/config.py", "DTL007") == []
+    assert findings_for(pos, "daft_tpu/context.py", "DTL007") == []
+
+
+def test_syntax_error_becomes_dtl000_finding():
+    findings, _ = lint_source("def broken(:\n", ANY_PATH)
+    assert [f.rule for f in findings] == ["DTL000"]
+
+
+# --------------------------------------------------------------------- #
+# Suppression mechanics                                                  #
+# --------------------------------------------------------------------- #
+
+SUPPRESSIBLE = """
+import os
+def knob():
+    return os.environ.get("DAFT_THING")
+"""
+
+
+def test_line_scope_suppression_trailing_comment():
+    code = SUPPRESSIBLE.replace(
+        'os.environ.get("DAFT_THING")',
+        'os.environ.get("DAFT_THING")  # daftlint: disable=DTL007 -- test')
+    findings, suppressed = lint_source(code, ANY_PATH)
+    assert findings == [] and suppressed == 1
+
+
+def test_line_scope_suppression_standalone_comment_covers_next_line():
+    code = SUPPRESSIBLE.replace(
+        '    return os.environ.get("DAFT_THING")',
+        '    # daftlint: disable=DTL007 -- test\n'
+        '    return os.environ.get("DAFT_THING")')
+    findings, suppressed = lint_source(code, ANY_PATH)
+    assert findings == [] and suppressed == 1
+
+
+def test_line_scope_suppression_is_rule_specific():
+    code = SUPPRESSIBLE.replace(
+        'os.environ.get("DAFT_THING")',
+        'os.environ.get("DAFT_THING")  # daftlint: disable=DTL001 -- wrong rule')
+    findings, suppressed = lint_source(code, ANY_PATH)
+    assert [f.rule for f in findings] == ["DTL007"] and suppressed == 0
+
+
+def test_file_scope_suppression():
+    code = "# daftlint: disable-file=DTL007 -- test fixture\n" + SUPPRESSIBLE
+    findings, suppressed = lint_source(code, ANY_PATH)
+    assert findings == [] and suppressed == 1
+
+
+def test_file_scope_all():
+    code = "# daftlint: disable-file=all -- generated file\n" + SUPPRESSIBLE
+    findings, suppressed = lint_source(code, ANY_PATH)
+    assert findings == [] and suppressed == 1
+
+
+# --------------------------------------------------------------------- #
+# Baseline mechanics: add, match (line-drift tolerant), expire           #
+# --------------------------------------------------------------------- #
+
+def _finding(rule="DTL007", path=ANY_PATH, line=3,
+             snippet='return os.environ.get("DAFT_THING")'):
+    return Finding(rule=rule, path=path, line=line, col=4,
+                   message="m", snippet=snippet)
+
+
+def test_baseline_add_and_match_ignores_line_numbers(tmp_path):
+    f = _finding(line=3)
+    bl = Baseline.from_findings([f])
+    path = str(tmp_path / "bl.json")
+    bl.save(path)
+    loaded = Baseline.load(path)
+    moved = _finding(line=99)  # same code, different line
+    new, old, stale = loaded.partition([moved])
+    assert new == [] and old == [moved] and stale == []
+
+
+def test_baseline_budget_is_per_occurrence(tmp_path):
+    bl = Baseline.from_findings([_finding()])
+    dup = [_finding(line=3), _finding(line=40)]  # second occurrence is NEW
+    new, old, stale = bl.partition(dup)
+    assert len(old) == 1 and len(new) == 1
+
+
+def test_baseline_expiry_reports_stale_entries():
+    bl = Baseline.from_findings([_finding()])
+    new, old, stale = bl.partition([])  # the violation was fixed
+    assert new == [] and old == []
+    assert [e.snippet for e in stale] == ['return os.environ.get("DAFT_THING")']
+
+
+def test_baseline_update_preserves_reasons(tmp_path):
+    f = _finding()
+    bl = Baseline.from_findings([f])
+    key = next(iter(bl.entries))
+    bl.entries[key].reason = "grandfathered: tracked in #123"
+    rebuilt = Baseline.from_findings([f], previous=bl)
+    assert rebuilt.entries[key].reason == "grandfathered: tracked in #123"
+
+
+def test_baseline_rejects_unknown_version(tmp_path):
+    p = tmp_path / "bl.json"
+    p.write_text(json.dumps({"version": 99, "findings": {}}))
+    with pytest.raises(ValueError):
+        Baseline.load(str(p))
+
+
+# --------------------------------------------------------------------- #
+# Reporter schema stability                                              #
+# --------------------------------------------------------------------- #
+
+def test_json_reporter_schema_is_stable():
+    result = LintResult(files_checked=2, new=[_finding()],
+                        baselined=[_finding(rule="DTL002", snippet="x")],
+                        suppressed=3)
+    doc = json.loads(render_json(result))
+    assert set(doc) == {"version", "tool", "summary", "findings",
+                        "stale_baseline"}
+    assert doc["version"] == 1 and doc["tool"] == "daftlint"
+    assert set(doc["summary"]) == {"files", "new", "baselined", "suppressed",
+                                   "stale_baseline"}
+    assert doc["summary"] == {"files": 2, "new": 1, "baselined": 1,
+                              "suppressed": 3, "stale_baseline": 0}
+    for f in doc["findings"]:
+        assert set(f) == {"rule", "path", "line", "col", "message",
+                          "snippet", "baselined"}
+    # new findings sort before baselined ones
+    assert [f["baselined"] for f in doc["findings"]] == [False, True]
+
+
+def test_text_reporter_mentions_location_and_counts():
+    result = LintResult(files_checked=1, new=[_finding()])
+    text = render_text(result)
+    assert f"{ANY_PATH}:3:4: DTL007" in text
+    assert "1 new finding(s)" in text
+    assert result.exit_code == 1
+    assert LintResult(files_checked=1).exit_code == 0
+
+
+# --------------------------------------------------------------------- #
+# The gate: zero new violations across the real package                  #
+# --------------------------------------------------------------------- #
+
+def test_rule_registry_complete():
+    assert sorted(rules_by_id()) == [
+        "DTL001", "DTL002", "DTL003", "DTL004", "DTL005", "DTL006", "DTL007"]
+    assert len(default_rules()) == 7
+
+
+def test_package_sweep_has_zero_new_violations():
+    """The same check CI runs: lint daft_tpu/ against the checked-in
+    baseline. New violations fail THIS tier-1 test, so the invariants hold
+    PR over PR even where CI is not wired up."""
+    root = repo_root()
+    baseline_path = os.path.join(root, ".daftlint-baseline.json")
+    assert os.path.isfile(baseline_path), "checked-in baseline missing"
+    baseline = Baseline.load(baseline_path)
+    result = run_paths([os.path.join(root, "daft_tpu")], root=root,
+                       baseline=baseline)
+    assert result.files_checked > 100
+    msgs = "\n".join(f.render() for f in result.new)
+    assert result.new == [], f"new daftlint violations:\n{msgs}"
+    stale = "\n".join(f"{e.rule} {e.path}" for e in result.stale_baseline)
+    assert result.stale_baseline == [], (
+        f"stale baseline entries (fixed code still grandfathered — run "
+        f"python -m daft_tpu.lint --update-baseline):\n{stale}")
+
+
+def test_partial_scan_does_not_report_out_of_scope_stale_entries(tmp_path):
+    """Linting a subset of files (or rules) says nothing about baseline
+    entries outside that scope — they must be neither stale-reported nor
+    (via --update-baseline) silently deleted."""
+    target = tmp_path / "daft_tpu"
+    target.mkdir()
+    (target / "clean.py").write_text("x = 1\n")
+    other = _finding(path="daft_tpu/other.py")  # never scanned
+    bl = Baseline.from_findings([other])
+    result = run_paths([str(target / "clean.py")], root=str(tmp_path),
+                       baseline=bl)
+    assert result.new == [] and result.stale_baseline == []
+    # Scanning the file the entry points at DOES expose it as stale.
+    (target / "other.py").write_text("y = 2\n")
+    result2 = run_paths([str(target)], root=str(tmp_path), baseline=bl)
+    assert [e.path for e in result2.stale_baseline] == ["daft_tpu/other.py"]
+
+
+def test_every_baseline_entry_has_a_reason():
+    """Grandfathering without a rationale defeats the point: each entry
+    must say WHY it is allowed to stay."""
+    root = repo_root()
+    baseline = Baseline.load(os.path.join(root, ".daftlint-baseline.json"))
+    missing = [k for k, e in baseline.entries.items() if not e.reason.strip()]
+    assert missing == [], f"baseline entries without a reason: {missing}"

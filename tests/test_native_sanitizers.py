@@ -21,11 +21,11 @@ pytestmark = pytest.mark.skipif(shutil.which("g++") is None,
                                 reason="g++ not available")
 
 
-def _build_and_run(tmp_path, name, san_flags):
+def _build_and_run(tmp_path, name, san_flags, driver="sanitize_main.cpp"):
     out = str(tmp_path / name)
     cmd = ["g++", "-O1", "-g", "-std=c++17", *san_flags,
            os.path.join(NATIVE, "daft_native.cpp"),
-           os.path.join(NATIVE, "sanitize_main.cpp"),
+           os.path.join(NATIVE, driver),
            "-o", out, "-lpthread"]
     build = subprocess.run(cmd, capture_output=True, text=True, timeout=240)
     assert build.returncode == 0, f"build failed:\n{build.stderr}"
@@ -47,3 +47,15 @@ def test_native_kernels_under_asan_ubsan(tmp_path):
 
 def test_native_kernels_under_tsan(tmp_path):
     _build_and_run(tmp_path, "san_tsan", ["-fsanitize=thread"])
+
+
+@pytest.mark.slow
+def test_native_kernels_under_tsan_batch_handoff(tmp_path):
+    """Concurrent batch HANDOFF (the daemon/shuffle usage shape): producer
+    threads build batches, publish them through a bounded mutex+condvar
+    queue, consumer threads hash them and merge HLL registers under a merge
+    lock. Catches races in ownership transfer that the shared-read-only
+    TSAN scenario above cannot see. Marked slow: two sanitizer builds per
+    tier-1 run is the budget; this one rides the nightly/slow lane."""
+    _build_and_run(tmp_path, "san_tsan_handoff", ["-fsanitize=thread"],
+                   driver="sanitize_handoff.cpp")

@@ -679,3 +679,76 @@ def test_use_namespace_create_drop_coherence():
     finally:
         daft_tpu.sql("USE default")
         sess.detach_catalog("cat4")
+
+
+# ---- connector error taxonomy (daftlint DTL002 audit, PR 3) ----------- #
+
+def test_classify_db_error_taxonomy():
+    from daft_tpu.errors import DaftIOError, DaftTransientError
+    from daft_tpu.io.sql_source import classify_db_error
+
+    class InterfaceError(Exception):
+        pass
+
+    class OperationalError(Exception):
+        pass
+
+    # InterfaceError is connection-level by DB-API spec: always transient.
+    assert isinstance(classify_db_error(InterfaceError("x"), "t"),
+                      DaftTransientError)
+    # OperationalError is a grab bag: transient only for connection/
+    # contention-shaped messages...
+    assert isinstance(
+        classify_db_error(OperationalError("connection reset by peer"), "t"),
+        DaftTransientError)
+    assert isinstance(
+        classify_db_error(OperationalError("database is locked"), "t"),
+        DaftTransientError)
+    # ...but a permanently-wrong query must fail fast, not burn retries.
+    wrapped = classify_db_error(OperationalError("no such table: nope"), "t")
+    assert isinstance(wrapped, DaftIOError)
+    assert not isinstance(wrapped, DaftTransientError)
+
+
+def test_partitioned_read_sql_execute_errors_are_classified(tmp_path):
+    import sqlite3
+
+    from daft_tpu.errors import DaftTransientError
+    from daft_tpu.io.sql_source import SQLSource
+
+    db = str(tmp_path / "t.db")
+    conn = sqlite3.connect(db)
+    conn.execute("CREATE TABLE t (a INT)")
+    conn.executemany("INSERT INTO t VALUES (?)", [(i,) for i in range(10)])
+    conn.commit()
+    conn.close()
+    src = SQLSource("SELECT * FROM missing_table",
+                    lambda: sqlite3.connect(db))
+    task = src.get_tasks()[0]
+    with pytest.raises(Exception) as ei:
+        list(task.execute())
+    # sqlite reports the typo as OperationalError; it must arrive FATAL.
+    assert not isinstance(ei.value, DaftTransientError)
+    assert "missing_table" in str(ei.value)
+
+
+def test_percentile_strategy_falls_back_to_min_max_on_sqlite(tmp_path):
+    """sqlite has no PERCENTILE_DISC and raises OperationalError for it —
+    the planner must fall back to min-max bounds, not abort (this regressed
+    once when transient-looking probe errors were re-raised)."""
+    import sqlite3
+
+    import daft_tpu
+
+    db = str(tmp_path / "t.db")
+    conn = sqlite3.connect(db)
+    conn.execute("CREATE TABLE t (a INT, b TEXT)")
+    conn.executemany("INSERT INTO t VALUES (?, ?)",
+                     [(i, "x") for i in range(100)])
+    conn.commit()
+    conn.close()
+    df = daft_tpu.read_sql("SELECT * FROM t",
+                           lambda: sqlite3.connect(db),
+                           partition_col="a", num_partitions=4,
+                           partition_bound_strategy="percentile")
+    assert sorted(df.to_pydict()["a"]) == list(range(100))
