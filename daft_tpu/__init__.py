@@ -14,7 +14,8 @@ from daft_tpu.context import (
     set_runner_native,
 )
 from daft_tpu.datatype import DataType, ImageFormat, ImageMode, TimeUnit
-from daft_tpu.errors import DaftError
+from daft_tpu.cancellation import cancel_query
+from daft_tpu.errors import DaftCancelledError, DaftError, DaftTimeoutError
 from daft_tpu.expressions import Expression, col, element, interval, lit
 from daft_tpu.schema import Field, Schema
 from daft_tpu.series import Series
@@ -26,7 +27,10 @@ __version__ = "0.1.0"
 __all__ = [
     "DataFrame",
     "DataType",
+    "DaftCancelledError",
     "DaftError",
+    "DaftTimeoutError",
+    "cancel_query",
     "Expression",
     "Field",
     "ImageFormat",

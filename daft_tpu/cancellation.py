@@ -1,0 +1,273 @@
+"""Per-query deadlines and cooperative cancellation.
+
+The dataflow-systems discipline (TensorFlow's cancellation manager, gRPC
+deadline propagation): a query gets ONE deadline/cancel token on the driver,
+the token travels with every unit of work it spawns, and everything that can
+block — dispatcher waits, IO retry sleeps, memory-permit waits, fault-
+injection delays — observes it cooperatively instead of being killed.
+
+Design points:
+
+* **Monotonic** (daftlint DTL001): deadlines are ``time.monotonic`` instants,
+  never wall-clock, so NTP steps can't expire (or resurrect) a query.
+* **Wire re-anchoring**: monotonic clocks are per-process, so a
+  :class:`Deadline` pickles as its *remaining budget* and re-anchors against
+  the receiving process's clock on deserialization
+  (``process_worker.py`` / ``daemon.py`` ship it inside the task payload).
+  The skew is the frame's transit time — strictly conservative for the
+  sender, which enforces the true deadline anyway.
+* **Ambient propagation**: the driver/runner installs the token in a
+  contextvar (:func:`cancel_scope`) and a query-id registry
+  (:func:`register_query_token`), so deep callees — ``io/retry.py``,
+  ``maybe_inject`` fault points, morsel loops — pick it up without
+  threading a parameter through every signature. In-process workers resolve
+  the driver's token by query id; out-of-process workers rebuild one from
+  the wire deadline (a driver-side user cancel reaches them at dispatch
+  boundaries, not mid-task — the dispatcher drains those).
+
+On expiry the observing site raises :class:`~daft_tpu.errors.DaftTimeoutError`;
+on explicit cancel, :class:`~daft_tpu.errors.DaftCancelledError`.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import threading
+import time
+from typing import Callable, Dict, Iterator, List, Optional
+
+from daft_tpu.errors import DaftCancelledError, DaftTimeoutError
+
+
+class Deadline:
+    """A monotonic instant by which work must finish.
+
+    Construct with :meth:`after`; compare/consume via :meth:`remaining` and
+    :meth:`expired`. Pickling captures the remaining budget and re-anchors
+    on load (see module docstring).
+    """
+
+    __slots__ = ("expires_at", "timeout_s")
+
+    def __init__(self, expires_at: float, timeout_s: float):
+        self.expires_at = expires_at  # time.monotonic() instant
+        self.timeout_s = timeout_s    # original budget (messages)
+
+    @staticmethod
+    def after(timeout_s: float) -> "Deadline":
+        return Deadline(time.monotonic() + timeout_s, timeout_s)
+
+    def remaining(self) -> float:
+        """Seconds left (<= 0 once expired)."""
+        return self.expires_at - time.monotonic()
+
+    def expired(self) -> bool:
+        return self.remaining() <= 0
+
+    def __reduce__(self):
+        # Re-anchor on the receiving process's monotonic clock: ship the
+        # remaining budget, not the (meaningless elsewhere) instant.
+        return (_rebuild_deadline, (self.remaining(), self.timeout_s))
+
+    def __repr__(self) -> str:
+        return f"Deadline(remaining={self.remaining():.3f}s of {self.timeout_s}s)"
+
+
+def _rebuild_deadline(remaining_s: float, timeout_s: float) -> Deadline:
+    return Deadline(time.monotonic() + remaining_s, timeout_s)
+
+
+class CancelToken:
+    """Cooperative cancellation signal, optionally deadline-bearing.
+
+    Thread-safe. ``cancel()`` is level-triggered and idempotent; listeners
+    registered via :meth:`add_listener` fire exactly once, outside the
+    token's lock (daftlint DTL004), and are used by blocking waiters
+    (dispatcher wait loop, MemoryManager) to wake promptly instead of
+    polling. Deadline expiry is passive — waiters bound their blocking call
+    by :meth:`remaining` instead.
+    """
+
+    def __init__(self, deadline: Optional[Deadline] = None,
+                 query_id: str = ""):
+        self.deadline = deadline
+        self.query_id = query_id
+        self.reason: Optional[str] = None
+        self._event = threading.Event()
+        self._listeners: List[Callable[[], None]] = []
+        self._lock = threading.Lock()
+
+    # -- signalling -------------------------------------------------------
+    def cancel(self, reason: str = "cancelled") -> None:
+        with self._lock:
+            if self._event.is_set():
+                return
+            self.reason = reason
+            self._event.set()
+            listeners = list(self._listeners)
+        for cb in listeners:  # outside the lock: callbacks may take locks
+            try:
+                cb()
+            except Exception:
+                import logging
+
+                logging.getLogger("daft_tpu.cancellation").warning(
+                    "cancel listener raised", exc_info=True)
+
+    def add_listener(self, cb: Callable[[], None]) -> None:
+        """Call ``cb`` when the token is cancelled (immediately if it
+        already is). Deadline expiry does NOT fire listeners."""
+        with self._lock:
+            if not self._event.is_set():
+                self._listeners.append(cb)
+                return
+        cb()
+
+    def remove_listener(self, cb: Callable[[], None]) -> None:
+        with self._lock:
+            try:
+                self._listeners.remove(cb)
+            except ValueError:
+                pass
+
+    # -- observation ------------------------------------------------------
+    def cancelled(self) -> bool:
+        return self._event.is_set()
+
+    def expired(self) -> bool:
+        return self.deadline is not None and self.deadline.expired()
+
+    def remaining(self) -> Optional[float]:
+        """Seconds until the deadline, or None when deadline-free.
+        0.0 the moment the token is CANCELLED — so waiters bounding a block
+        by remaining() return promptly either way."""
+        if self._event.is_set():
+            return 0.0
+        if self.deadline is None:
+            return None
+        return max(self.deadline.remaining(), 0.0)
+
+    def error(self, what: str = "") -> Optional[DaftCancelledError]:
+        """The error this token currently mandates, or None if live."""
+        suffix = f" during {what}" if what else ""
+        if self._event.is_set():
+            return DaftCancelledError(
+                f"query {self.query_id or '?'} cancelled"
+                f" ({self.reason}){suffix}")
+        if self.expired():
+            return DaftTimeoutError(
+                f"query {self.query_id or '?'} exceeded its "
+                f"{self.deadline.timeout_s}s deadline{suffix}")
+        return None
+
+    def check(self, what: str = "") -> None:
+        """Raise if cancelled or past deadline; no-op otherwise. This is the
+        cooperative observation point (morsel boundaries, fault-injection
+        points, retry attempts)."""
+        err = self.error(what)
+        if err is not None:
+            raise err
+
+    def wait(self, timeout_s: float) -> bool:
+        """Interruptible sleep: block up to ``timeout_s`` (clamped to the
+        deadline), returning True early if the token fired. Callers follow
+        with :meth:`check` when a wake must abort the work."""
+        rem = self.remaining()
+        if rem is not None:
+            timeout_s = min(timeout_s, rem)
+        return self._event.wait(max(timeout_s, 0.0))
+
+
+# --------------------------------------------------------------------- #
+# Ambient propagation: contextvar scope + query-id registry               #
+# --------------------------------------------------------------------- #
+_current: contextvars.ContextVar[Optional[CancelToken]] = \
+    contextvars.ContextVar("daft_cancel_token", default=None)
+
+_BY_QUERY: Dict[str, CancelToken] = {}
+_registry_lock = threading.Lock()
+
+
+def current_token() -> Optional[CancelToken]:
+    """The ambient token of the current execution scope (None outside any
+    query / for deadline-free queries)."""
+    return _current.get()
+
+
+def check_current(what: str = "") -> None:
+    """Observe the ambient token, if any (the one-liner for hot paths)."""
+    tok = _current.get()
+    if tok is not None:
+        tok.check(what)
+
+
+@contextlib.contextmanager
+def cancel_scope(token: Optional[CancelToken]) -> Iterator[Optional[CancelToken]]:
+    """Install ``token`` as the ambient token for a synchronous block."""
+    cv_token = _current.set(token)
+    try:
+        yield token
+    finally:
+        _current.reset(cv_token)
+
+
+def iter_with_cancel_scope(gen, token: Optional[CancelToken]):
+    """Drain ``gen`` with ``token`` ambient during each resumption only —
+    same shape as ``context.iter_with_frozen_clock``: set/reset around every
+    ``next()`` so interleaved lazy queries on one thread can't clobber each
+    other's token."""
+    if token is None:
+        yield from gen
+        return
+    while True:
+        token.check("query iteration")
+        cv = _current.set(token)
+        try:
+            try:
+                item = next(gen)
+            finally:
+                _current.reset(cv)
+        except StopIteration:
+            return
+        yield item
+
+
+def register_query_token(query_id: str, token: CancelToken) -> None:
+    """Driver-side registration so in-process workers (LocalWorker threads
+    share the driver process) resolve the LIVE token — including user
+    cancels — by query id."""
+    with _registry_lock:
+        _BY_QUERY[query_id] = token
+
+
+def unregister_query_token(query_id: str) -> None:
+    with _registry_lock:
+        _BY_QUERY.pop(query_id, None)
+
+
+def active_query_token(query_id: str) -> Optional[CancelToken]:
+    with _registry_lock:
+        return _BY_QUERY.get(query_id)
+
+
+def cancel_query(query_id: str, reason: str = "user-cancel") -> bool:
+    """Cancel a running query by id (the user-facing cancel entry point).
+    Returns False if no such query is registered."""
+    tok = active_query_token(query_id)
+    if tok is None:
+        return False
+    tok.cancel(reason)
+    return True
+
+
+def token_for_task(query_id: str, deadline: Optional[Deadline]) -> Optional[CancelToken]:
+    """Worker-side token resolution: prefer the driver's registered token
+    (same process — observes user cancels live); else rebuild a
+    deadline-only token from the wire deadline; else None."""
+    tok = active_query_token(query_id) if query_id else None
+    if tok is not None:
+        return tok
+    if deadline is not None:
+        return CancelToken(deadline, query_id=query_id)
+    return None

@@ -102,6 +102,19 @@ class ExecutionConfig:
     heartbeat_miss_threshold: int = 3    # consecutive misses -> mark dead
     fault_spec: Optional[str] = None     # DAFT_FAULT_SPEC (see faults.py)
     fault_seed: int = 0
+    # Bounded-time execution (cancellation.py, io/circuit.py)
+    query_timeout_s: Optional[float] = None  # DAFT_QUERY_TIMEOUT_S; None = unbounded
+    # On deadline/cancel abort, how long the dispatcher waits for running
+    # tasks to observe the token before abandoning them (a wedged worker
+    # must not hang collect(timeout=...) past t + grace).
+    cancel_drain_grace_s: float = 5.0
+    # Per-endpoint IO circuit breaker (io/circuit.py): consecutive transient
+    # failures to open; base/cap of the open->half-open probe delay
+    # (seeded-jitter exponential); probes allowed while half-open.
+    circuit_failure_threshold: int = 5
+    circuit_open_base_s: float = 1.0
+    circuit_open_cap_s: float = 30.0
+    circuit_half_open_probes: int = 1
 
     def with_changes(self, **kwargs) -> "ExecutionConfig":
         return dataclasses.replace(self, **kwargs)
@@ -123,4 +136,6 @@ class ExecutionConfig:
             changes["fault_seed"] = int(os.environ["DAFT_FAULT_SEED"])
         if os.environ.get("DAFT_SPECULATION") in ("1", "true"):
             changes["speculative_execution"] = True
+        if os.environ.get("DAFT_QUERY_TIMEOUT_S"):
+            changes["query_timeout_s"] = float(os.environ["DAFT_QUERY_TIMEOUT_S"])
         return cfg.with_changes(**changes) if changes else cfg

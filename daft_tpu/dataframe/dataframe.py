@@ -441,19 +441,25 @@ class DataFrame:
     # ------------------------------------------------------------------ #
     # Materialisation                                                     #
     # ------------------------------------------------------------------ #
-    def _materialize(self):
+    def _materialize(self, timeout: Optional[float] = None):
         from daft_tpu.runners.runner import PartitionCacheEntry
 
         if self._result is None:
             runner = get_context().get_or_create_runner()
-            entry = runner.run(self._builder)
+            entry = runner.run(self._builder, timeout=timeout)
             self._result = entry.partitions
         from daft_tpu.runners.runner import PartitionCacheEntry
 
         return PartitionCacheEntry(self._result)
 
-    def collect(self) -> "DataFrame":
-        self._materialize()
+    def collect(self, timeout: Optional[float] = None) -> "DataFrame":
+        """Materialise the query. ``timeout`` (seconds) bounds the WHOLE
+        query end to end — dispatch waits, retry backoff sleeps, morsel
+        loops, remote workers: on expiry it fails with
+        :class:`~daft_tpu.errors.DaftTimeoutError` (per-task progress
+        attached) instead of running on. Default: unbounded, or
+        ``DAFT_QUERY_TIMEOUT_S`` / ``ExecutionConfig.query_timeout_s``."""
+        self._materialize(timeout=timeout)
         return self
 
     def show(self, n: int = 8) -> None:

@@ -204,14 +204,21 @@ class WorkerDaemon:
 
             fragment = msg["fragment"]
             inputs = [[decode_ref(d) for d in slot] for slot in msg["inputs"]]
-            bound = bind_task_fragment(fragment, inputs)
             stats = RuntimeStats(msg.get("query_id", ""))
             stats.local_flush = False  # shipped back in the reply instead
+            # Wire deadline, re-anchored on this host's monotonic clock
+            # (Deadline.__reduce__): the daemon bounds its own execution.
+            from daft_tpu.cancellation import cancel_scope, token_for_task
+
+            token = token_for_task(msg.get("query_id", ""),
+                                   msg.get("deadline"))
             executor = Executor(msg["cfg"], partition_offset=msg["partition_idx"],
-                                stats=stats)
+                                stats=stats, cancel_token=token)
             from daft_tpu.context import frozen_clock_scope
 
-            with frozen_clock_scope(msg.get("frozen_clock")):
+            with cancel_scope(token), \
+                    frozen_clock_scope(msg.get("frozen_clock")):
+                bound = bind_task_fragment(fragment, inputs)
                 out = list(executor.run(bound))
             parts = collect_task_outputs(out, msg["expect_outputs"], fragment.schema)
             refs = []
@@ -226,16 +233,20 @@ class WorkerDaemon:
             import traceback
 
             # Classify so the driver can keep its typed failure handling
-            # (transient retry / lineage recovery) across the wire, where
-            # exceptions travel as strings.
+            # (transient retry / lineage recovery / cancellation) across the
+            # wire, where exceptions travel as strings.
             from daft_tpu.distributed.scheduler import (
                 find_fetch_failure,
+                find_in_chain,
                 is_transient_failure,
             )
+            from daft_tpu.errors import DaftCancelledError
 
             reply = {"ok": False, "error": f"{e}\n{traceback.format_exc()}"}
             fetch = find_fetch_failure(e)
-            if fetch is not None:
+            if find_in_chain(e, DaftCancelledError) is not None:
+                reply["kind"] = "cancelled"
+            elif fetch is not None:
                 reply["kind"] = "fetch"
                 reply["lost"] = fetch.lost
             elif is_transient_failure(e):
@@ -298,6 +309,10 @@ class RemoteWorker(Worker):
                 from daft_tpu.distributed.partition_ref import PartitionFetchError
 
                 raise PartitionFetchError(err, reply.get("lost") or [])
+            if kind == "cancelled":
+                from daft_tpu.errors import DaftCancelledError
+
+                raise DaftCancelledError(err)
             if kind == "transient":
                 from daft_tpu.errors import DaftTransientError
 
@@ -321,6 +336,7 @@ class RemoteWorker(Worker):
                     "expect_outputs": task.expect_outputs,
                     "query_id": task.query_id,
                     "frozen_clock": task.frozen_clock,
+                    "deadline": task.deadline,
                 }
                 reply = self._request(payload)
                 # Worker-side operator stats stream back with the reply and

@@ -21,7 +21,16 @@ Injection points wired in the engine:
                        (ctx: ``path``)
 ``daemon.heartbeat``   heartbeat probe of a worker (ctx: ``worker``); the
                        ``drop`` action makes the probe count as missed
+``io.circuit``         circuit-breaker admission check (ctx: ``endpoint``) —
+                       lets the chaos suite fail/delay the exact decision
+                       that opens or probes a breaker (io/circuit.py)
 ==================== =======================================================
+
+Every injection point is ALSO a cooperative-cancellation observation point:
+``maybe_inject`` checks the ambient :class:`~daft_tpu.cancellation.CancelToken`
+first, so a query past its deadline fails out of a task at the next
+injection site even when no injector is armed — and an injected ``delay``
+sleeps interruptibly against the token instead of pinning a cancelled task.
 
 Spec grammar (``DAFT_FAULT_SPEC`` / ``ExecutionConfig.fault_spec`` /
 :func:`fault_scope`): comma-separated clauses
@@ -56,6 +65,7 @@ KNOWN_POINTS = (
     "shuffle.fetch",
     "io.get_object",
     "daemon.heartbeat",
+    "io.circuit",
 )
 
 _ACTIONS = ("raise", "raise_transient", "raise_worker_died", "delay", "kill",
@@ -139,12 +149,15 @@ class FaultInjector:
         self._hits: Dict[str, int] = {}
         self._fired: Dict[str, int] = {}
         self._lock = threading.Lock()
-        # Chaos determinism extends to RETRY TIMING: pin the io-retry
-        # backoff jitter to the same seed so a replayed fault schedule
-        # reproduces the full retry cadence, not just the fault sites.
+        # Chaos determinism extends to RETRY and BREAKER TIMING: pin the
+        # io-retry backoff jitter and the circuit-probe jitter to the same
+        # seed so a replayed fault schedule reproduces the full retry and
+        # probe cadence, not just the fault sites.
+        from daft_tpu.io.circuit import seed_circuit_jitter
         from daft_tpu.io.retry import seed_retry_jitter
 
         seed_retry_jitter(seed)
+        seed_circuit_jitter(seed)
 
     def add(self, point: str, action: str, when: Union[int, str] = 1,
             prob: Optional[float] = None, arg: Optional[float] = None) -> "FaultInjector":
@@ -190,7 +203,22 @@ class FaultInjector:
                 raise WorkerDiedError(
                     f"injected worker death at {point} (hit #{n})")
             if s.action == "delay":
-                time.sleep(s.arg if s.arg is not None else 0.1)
+                # Interruptible: an injected stall (e.g. pinning shuffle
+                # fetches in flight) must still wake when the query's
+                # deadline expires or it is cancelled — otherwise the
+                # chaos suite's own delays would defeat bounded-time
+                # execution.
+                from daft_tpu.cancellation import current_token
+
+                delay_s = s.arg if s.arg is not None else 0.1
+                tok = current_token()
+                if tok is None:
+                    time.sleep(delay_s)
+                else:
+                    # wait() bounds itself by the deadline AND wakes on
+                    # cancel; either way the check raises if the token fired.
+                    tok.wait(delay_s)
+                    tok.check(point)
             elif s.action == "kill":
                 worker = ctx.get("worker")
                 if worker is not None and hasattr(worker, "kill"):
@@ -258,7 +286,11 @@ def config_fault_scope(cfg) -> Iterator[Optional["FaultInjector"]]:
 @contextlib.contextmanager
 def fault_scope(spec: Union[str, FaultInjector, List[FaultSpec]],
                 seed: int = 0) -> Iterator[FaultInjector]:
-    """Arm an injector for the duration of a block (tests / chaos loops)."""
+    """Arm an injector for the duration of a block (tests / chaos loops).
+
+    On exit, circuit-breaker state is reset along with the injector:
+    breakers tripped by INJECTED endpoint failures describe a simulated
+    outage, and leaving them open would fail-fast the next (healthy) query."""
     global _INJECTOR
     injector = spec if isinstance(spec, FaultInjector) else FaultInjector(spec, seed)
     prev = _INJECTOR
@@ -267,11 +299,19 @@ def fault_scope(spec: Union[str, FaultInjector, List[FaultSpec]],
         yield injector
     finally:
         _INJECTOR = prev
+        from daft_tpu.io.circuit import reset_circuit_breakers
+
+        reset_circuit_breakers()
 
 
 def maybe_inject(point: str, **ctx) -> Optional[str]:
-    """Production-code hook: no-op (two attribute loads) when no injector is
-    armed."""
+    """Production-code hook: near-no-op when no injector is armed and no
+    query token is ambient. Every injection point doubles as a cooperative
+    cancellation checkpoint (cancellation.py) — a cancelled/expired query
+    raises here before any fault logic runs."""
+    from daft_tpu.cancellation import check_current
+
+    check_current(point)
     inj = active_injector()
     if inj is None:
         return None

@@ -117,14 +117,17 @@ class LineageTracker:
 
 
 class DistributedExecutor:
-    def __init__(self, manager: WorkerManager, cfg, query_id: str = ""):
+    def __init__(self, manager: WorkerManager, cfg, query_id: str = "",
+                 cancel_token=None):
         self.manager = manager
         self.cfg = cfg
         self.query_id = query_id
+        self.cancel_token = cancel_token
         self.scheduler = Scheduler(manager, cfg.autoscaling_threshold)
         self.lineage = LineageTracker()
         self.dispatcher = Dispatcher(self.scheduler, cfg=cfg,
-                                     recovery=self._recover_task_inputs)
+                                     recovery=self._recover_task_inputs,
+                                     cancel_token=cancel_token)
         self._recoveries = 0
         self._recovery_lock = threading.Lock()
         self._shared_ids: set = set()
@@ -141,9 +144,16 @@ class DistributedExecutor:
         return self._run(plan)
 
     def _dispatch(self, tasks: Sequence[Task]) -> List[List[PartitionRef]]:
+        deadline = (self.cancel_token.deadline
+                    if self.cancel_token is not None else None)
         for t in tasks:
             t.query_id = self.query_id
             t.cfg = self.cfg  # the QUERY's config rides with the task
+            # The query deadline rides with the task across every worker
+            # wire (Deadline re-anchors its remaining budget on pickle), so
+            # out-of-process workers bound their own execution too.
+            if t.deadline is None:
+                t.deadline = deadline
         results = self.dispatcher.run_tasks(tasks)
         # Record lineage: each output ref is recomputable from its producer.
         for t, refs in zip(tasks, results):
@@ -232,6 +242,8 @@ class DistributedExecutor:
         carrier = Task(BoundInput(0, None), [[self.lineage.replacement(ref)]])
         carrier.query_id = self.query_id
         while True:
+            if self.cancel_token is not None:
+                self.cancel_token.check("output fetch")
             try:
                 return fetch_task_input(carrier.inputs[0][0], 0, 0)
             except PartitionFetchError as e:

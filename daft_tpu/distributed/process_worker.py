@@ -90,16 +90,24 @@ def _worker_entry(fd: int) -> None:
                 for slot in payload["inputs"]
             ]
             expect = payload["expect_outputs"]
-            bound = bind_task_fragment(fragment, inputs)
             from daft_tpu.execution.resource_manager import RuntimeStats
 
             stats = RuntimeStats(payload.get("query_id", ""))
             stats.local_flush = False  # shipped back in the reply instead
+            # The wire deadline re-anchored against THIS process's clock
+            # (Deadline.__reduce__): the child enforces the query bound
+            # locally at morsel boundaries and injection points.
+            from daft_tpu.cancellation import cancel_scope, token_for_task
+
+            token = token_for_task(payload.get("query_id", ""),
+                                   payload.get("deadline"))
             executor = Executor(cfg, partition_offset=payload["partition_idx"],
-                                stats=stats)
+                                stats=stats, cancel_token=token)
             from daft_tpu.context import frozen_clock_scope
 
-            with frozen_clock_scope(payload.get("frozen_clock")):
+            with cancel_scope(token), \
+                    frozen_clock_scope(payload.get("frozen_clock")):
+                bound = bind_task_fragment(fragment, inputs)
                 out = list(executor.run(bound))
             parts = collect_task_outputs(out, expect, fragment.schema)
             blobs = [serialize_partition(p) for p in parts]
@@ -108,10 +116,15 @@ def _worker_entry(fd: int) -> None:
         except BaseException as e:  # noqa: BLE001
             import traceback
 
-            from daft_tpu.distributed.scheduler import is_transient_failure
+            from daft_tpu.distributed.scheduler import find_in_chain, is_transient_failure
+            from daft_tpu.errors import DaftCancelledError
 
             reply = {"ok": False, "error": f"{e}\n{traceback.format_exc()}"}
-            if is_transient_failure(e):
+            if find_in_chain(e, DaftCancelledError) is not None:
+                # Keep the cancellation type across the wire so the driver
+                # never retries cancelled work.
+                reply["kind"] = "cancelled"
+            elif is_transient_failure(e):
                 # Keep the driver's typed transient-retry handling across the
                 # process boundary, where exceptions travel as strings.
                 reply["kind"] = "transient"
@@ -195,6 +208,7 @@ class ProcessWorker(Worker):
                         "expect_outputs": task.expect_outputs,
                         "query_id": task.query_id,
                         "frozen_clock": task.frozen_clock,
+                        "deadline": task.deadline,
                     }
                     try:
                         _send_frame(self._sock, cloudpickle.dumps(payload))
@@ -205,6 +219,10 @@ class ProcessWorker(Worker):
                         ) from e
                     result = cloudpickle.loads(msg)
                     if not result["ok"]:
+                        if result.get("kind") == "cancelled":
+                            from daft_tpu.errors import DaftCancelledError
+
+                            raise DaftCancelledError(result["error"])
                         if result.get("kind") == "transient":
                             from daft_tpu.errors import DaftTransientError
 

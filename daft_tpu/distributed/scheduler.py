@@ -31,9 +31,9 @@ from __future__ import annotations
 import itertools
 import logging
 import statistics
+import threading
 import time
 from concurrent.futures import (
-    FIRST_COMPLETED,
     CancelledError,
     Future,
     wait,
@@ -45,7 +45,12 @@ from daft_tpu.distributed.faults import maybe_inject
 from daft_tpu.distributed.partition_ref import PartitionFetchError, PartitionRef
 from daft_tpu.distributed.task import Task
 from daft_tpu.distributed.worker import Worker, WorkerDiedError, WorkerManager
-from daft_tpu.errors import DaftExecutionError, DaftTransientError
+from daft_tpu.errors import (
+    DaftCancelledError,
+    DaftExecutionError,
+    DaftTimeoutError,
+    DaftTransientError,
+)
 
 _log = logging.getLogger("daft_tpu.scheduler")
 
@@ -144,13 +149,18 @@ class Dispatcher:
 
     def __init__(self, scheduler: Scheduler, max_inflight: Optional[int] = None,
                  cfg=None,
-                 recovery: Optional[Callable[[Task, List[dict]], bool]] = None):
+                 recovery: Optional[Callable[[Task, List[dict]], bool]] = None,
+                 cancel_token=None):
         self.scheduler = scheduler
         self.max_inflight = max_inflight
         self.cfg = cfg
         # recovery(task, lost_descriptors) -> True if task.inputs was repaired
         # (lineage recomputation); False means the partitions are gone for good.
         self.recovery = recovery
+        # The query's CancelToken (cancellation.py): deadline expiry or user
+        # cancel aborts through the same drain path as a task failure, with
+        # one DaftTimeoutError/DaftCancelledError carrying per-task progress.
+        self.cancel_token = cancel_token
 
     # ------------------------------------------------------------------ #
     def _config(self):
@@ -188,6 +198,23 @@ class Dispatcher:
         limit = self.max_inflight or max(self.scheduler.manager.total_slots(), 1)
         self.scheduler.request_autoscale(len(pending))
         failure: Optional[BaseException] = None
+        token = self.cancel_token
+
+        # The dispatcher's wake signal: task completion, asynchronous worker
+        # death (heartbeat monitor), and query cancel all set it, so the wait
+        # loop blocks indefinitely when idle instead of busy-waking on a 5s
+        # poll — only real backoff deadlines (and the query deadline) need a
+        # timed wait. Local to this run_tasks call: lineage recovery re-enters
+        # run_tasks on the same Dispatcher, and nested runs must not share
+        # wake state.
+        wake = threading.Event()
+
+        def on_death(_worker_id: str) -> None:
+            wake.set()
+
+        self.scheduler.manager.add_death_listener(on_death)
+        if token is not None:
+            token.add_listener(wake.set)
 
         def attempts_inflight(idx: int) -> int:
             return sum(1 for a in inflight.values() if a.idx == idx)
@@ -202,6 +229,40 @@ class Dispatcher:
             fut = worker.submit(task)
             inflight[fut] = _Attempt(rec_idx, task, attempt, worker,
                                      time.monotonic(), speculative)
+            fut.add_done_callback(lambda _f: wake.set())
+
+        def progress_snapshot() -> dict:
+            now = time.monotonic()
+            return {
+                "completed": len(done_idx),
+                "running": [{"task_id": a.task.task_id,
+                             "worker_id": a.worker.worker_id,
+                             "attempt": a.attempt,
+                             "elapsed_s": round(now - a.t0, 3)}
+                            for a in inflight.values()],
+                "pending": len(pending),
+                "total": len(tasks),
+            }
+
+        def cancellation_failure() -> Optional[BaseException]:
+            """The query's cancel/timeout error (with per-task progress), or
+            None while the token is live."""
+            if token is None:
+                return None
+            err = token.error("task dispatch")
+            if err is None:
+                return None
+            from daft_tpu.subscribers.events import QueryCancelled
+
+            progress = progress_snapshot()
+            if isinstance(err, DaftTimeoutError):
+                err.progress = progress
+            query_id = tasks[0].query_id if tasks else ""
+            reason = "deadline" if not token.cancelled() else (
+                token.reason or "cancelled")
+            notify(QueryCancelled(query_id=query_id, reason=reason,
+                                  progress=progress))
+            return err
 
         def requeue(rec: _Pending, reason: str, worker_id: str,
                     consume_attempt: bool = True, backoff: bool = False) -> None:
@@ -216,187 +277,223 @@ class Dispatcher:
 
         # The extra `failure` term matters when the FINAL in-flight attempt
         # fails: pending and inflight are both empty, but the abort path at
-        # the top of the loop still has to run (and raise).
-        while pending or inflight or failure is not None:
-            # ---- submit phase -------------------------------------------
-            if failure is None:
-                try:
-                    now = time.monotonic()
-                    eligible = [p for p in pending if p.not_before <= now]
-                    while eligible and len(inflight) < limit:
-                        rec = eligible.pop(0)
-                        pending.remove(rec)
-                        if rec.idx in done_idx:
-                            continue  # stale retry of an already-won task
-                        submit(rec.idx, rec.task, rec.attempt)
-                except BaseException as e:  # noqa: BLE001 — assign/submit blew up
-                    # (e.g. "No live workers"): abort/drain like a task failure
-                    # instead of leaving inflight tasks mutating state.
-                    # Interrupts (KeyboardInterrupt/SystemExit) still drain,
-                    # but re-raise AS THEMSELVES — never wrapped in DaftError.
-                    if isinstance(e, DaftExecutionError) or not isinstance(e, Exception):
-                        failure = e
-                    else:
-                        failure = DaftExecutionError(f"Task submission failed: {e}")
-                        failure.__cause__ = e
-            if failure is not None:
-                # Abort cleanly: cancel not-yet-started work, drain the rest
-                # so no task keeps mutating state (writes!) after the raise.
-                pending.clear()
-                if inflight:
-                    still_running = [f for f in inflight if not f.cancel()]
-                    if still_running:
-                        wait(still_running)
-                    inflight.clear()
-                raise failure
-            if not inflight:
-                if pending:  # everything is backing off; sleep to the earliest
-                    delay = max(0.0, min(p.not_before for p in pending)
-                                - time.monotonic())
-                    time.sleep(min(delay, backoff_cap) or 0.001)
-                continue
-
-            # ---- wait phase ---------------------------------------------
-            # Only a real backoff deadline (not_before in the future) needs a
-            # timed wakeup; tasks merely waiting for a free slot are unblocked
-            # by FIRST_COMPLETED itself — giving them a timeout would busy-
-            # poll at the floor for the whole query.
-            timeout = None
-            now = time.monotonic()
-            backing_off = [p.not_before for p in pending if p.not_before > now]
-            if backing_off:
-                timeout = max(0.01, min(backing_off) - now)
-            if speculate and len(durations) >= spec_min:
-                timeout = min(timeout or 0.05, 0.05)
-            # Cap the block so asynchronous death detection (heartbeat
-            # monitor marking a partitioned worker dead) is noticed even
-            # when its wedged future never completes.
-            timeout = min(timeout or 5.0, 5.0)
-            done, _ = wait(list(inflight.keys()), timeout=timeout,
-                           return_when=FIRST_COMPLETED)
-
-            # ---- completion phase ---------------------------------------
-            for fut in done:
-                att = inflight.pop(fut, None)
-                if att is None:
-                    continue  # abandoned sibling already dropped this round
-                if att.idx in done_idx:
-                    continue  # defensive: task already won by another attempt
-                err: Optional[str] = None
-                exc: Optional[BaseException] = None
-                try:
-                    res = fut.result()
-                except BaseException as e:  # noqa: BLE001
-                    exc = e
-                    err = str(e)
-                else:
-                    results[att.idx] = res
-                    done_idx.add(att.idx)
-                    durations.append(time.monotonic() - att.t0)
-                    # Abandon still-running sibling attempts: cancel if not
-                    # started, and stop tracking either way — "whichever
-                    # attempt finishes first" must not wait for the loser. A
-                    # done-callback still observes a worker death the loser
-                    # uncovers AFTER being dropped from tracking.
-                    siblings = [(f, a) for f, a in inflight.items()
-                                if a.idx == att.idx]
-                    for f2, a2 in siblings:
-                        f2.cancel()
-                        del inflight[f2]
-
-                        def _observe(f, w=a2.worker):
-                            try:
-                                e2 = f.exception()
-                            except (CancelledError, TimeoutError):
-                                return  # cancelled loser: nothing to observe
-                            if isinstance(e2, WorkerDiedError):
-                                self.scheduler.manager.mark_dead(
-                                    w.worker_id, reason="worker-died")
-
-                        f2.add_done_callback(_observe)
-                notify(TaskCompleted(
-                    query_id=att.task.query_id, task_id=att.task.task_id,
-                    worker_id=att.worker.worker_id,
-                    duration_s=time.monotonic() - att.t0, error=err))
-                if exc is None:
-                    continue
-                failure = self._handle_attempt_failure(
-                    att, exc, max_retries, requeue, attempts_inflight)
+        # the top of the loop still has to run (and raise). The try/finally
+        # unhooks the wake listeners from the LONG-LIVED manager/token on
+        # every exit path (the manager outlives this query).
+        try:
+            while pending or inflight or failure is not None:
+                # ---- cancellation check -------------------------------------
+                # Deadline expiry / user cancel aborts through the SAME drain
+                # path as a task failure: checked before submitting more work.
+                if failure is None:
+                    failure = cancellation_failure()
+                # ---- submit phase -------------------------------------------
+                if failure is None:
+                    try:
+                        now = time.monotonic()
+                        eligible = [p for p in pending if p.not_before <= now]
+                        while eligible and len(inflight) < limit:
+                            rec = eligible.pop(0)
+                            pending.remove(rec)
+                            if rec.idx in done_idx:
+                                continue  # stale retry of an already-won task
+                            submit(rec.idx, rec.task, rec.attempt)
+                    except BaseException as e:  # noqa: BLE001 — assign/submit blew up
+                        # (e.g. "No live workers"): abort/drain like a task failure
+                        # instead of leaving inflight tasks mutating state.
+                        # Interrupts (KeyboardInterrupt/SystemExit) still drain,
+                        # but re-raise AS THEMSELVES — never wrapped in DaftError.
+                        # Cancellation raised at an injection point stays typed.
+                        if isinstance(e, (DaftExecutionError, DaftCancelledError)) \
+                                or not isinstance(e, Exception):
+                            failure = e
+                        else:
+                            failure = DaftExecutionError(f"Task submission failed: {e}")
+                            failure.__cause__ = e
                 if failure is not None:
-                    break
+                    # Abort cleanly: cancel not-yet-started work, drain the rest
+                    # so no task keeps mutating state (writes!) after the raise.
+                    # Running tasks observe the cancel token at morsel boundaries
+                    # and fault-injection points, so the drain converges — but a
+                    # CANCELLATION drain is grace-bounded: a wedged future on a
+                    # partitioned worker must not hang collect(timeout=t) past
+                    # t + grace. Ordinary failures keep the unbounded drain
+                    # (side-effecting tasks must stop before the raise).
+                    pending.clear()
+                    if inflight:
+                        still_running = [f for f in inflight if not f.cancel()]
+                        if still_running:
+                            grace = None
+                            if isinstance(failure, DaftCancelledError):
+                                grace = getattr(cfg, "cancel_drain_grace_s", 5.0)
+                            _, not_done = wait(still_running, timeout=grace)
+                            if not_done:
+                                _log.warning(
+                                    "cancellation drain abandoned %d task(s) "
+                                    "still running after %.1fs grace: %s",
+                                    len(not_done), grace,
+                                    [inflight[f].task.task_id
+                                     for f in not_done if f in inflight])
+                        inflight.clear()
+                    raise failure
+                if not inflight:
+                    if pending:  # everything is backing off; wait to the earliest
+                        # (interruptibly: completion/death/cancel set the event).
+                        delay = max(0.0, min(p.not_before for p in pending)
+                                    - time.monotonic())
+                        wake.wait(min(delay, backoff_cap) or 0.001)
+                        wake.clear()
+                    continue
 
-            # ---- dead-worker reaping ------------------------------------
-            # A worker marked dead asynchronously (heartbeat timeout) may
-            # hold wedged futures that will NEVER complete — e.g. a daemon
-            # that network-partitioned mid-task. Fail those attempts as
-            # worker deaths instead of waiting forever.
-            if failure is None:
-                for f, a in [(f, a) for f, a in inflight.items()
-                             if self.scheduler.manager.is_dead(a.worker.worker_id)]:
-                    cancelled = f.cancel()
-                    del inflight[f]
-                    if a.idx in done_idx:
+                # ---- wait phase ---------------------------------------------
+                # Event-driven: task completion, asynchronous death detection
+                # (heartbeat monitor -> death listener), and query cancel all
+                # set `wake`, so a fully-idle dispatcher blocks indefinitely
+                # instead of busy-waking every 5s. A timed wait is needed only
+                # for real deadlines: retry backoff (keep the earliest
+                # not_before), the speculation scan cadence, and the query
+                # deadline itself.
+                timeout = None
+                now = time.monotonic()
+                backing_off = [p.not_before for p in pending if p.not_before > now]
+                if backing_off:
+                    timeout = max(0.01, min(backing_off) - now)
+                if speculate and len(durations) >= spec_min:
+                    timeout = min(timeout or 0.05, 0.05)
+                if token is not None:
+                    remaining = token.remaining()
+                    if remaining is not None:
+                        timeout = max(min(timeout or remaining, remaining), 0.01)
+                wake.wait(timeout)
+                wake.clear()
+                done = [f for f in inflight if f.done()]
+
+                # ---- completion phase ---------------------------------------
+                for fut in done:
+                    att = inflight.pop(fut, None)
+                    if att is None:
+                        continue  # abandoned sibling already dropped this round
+                    if att.idx in done_idx:
+                        continue  # defensive: task already won by another attempt
+                    err: Optional[str] = None
+                    exc: Optional[BaseException] = None
+                    try:
+                        res = fut.result()
+                    except BaseException as e:  # noqa: BLE001
+                        exc = e
+                        err = str(e)
+                    else:
+                        results[att.idx] = res
+                        done_idx.add(att.idx)
+                        durations.append(time.monotonic() - att.t0)
+                        # Abandon still-running sibling attempts: cancel if not
+                        # started, and stop tracking either way — "whichever
+                        # attempt finishes first" must not wait for the loser. A
+                        # done-callback still observes a worker death the loser
+                        # uncovers AFTER being dropped from tracking.
+                        siblings = [(f, a) for f, a in inflight.items()
+                                    if a.idx == att.idx]
+                        for f2, a2 in siblings:
+                            f2.cancel()
+                            del inflight[f2]
+
+                            def _observe(f, w=a2.worker):
+                                try:
+                                    e2 = f.exception()
+                                except (CancelledError, TimeoutError):
+                                    return  # cancelled loser: nothing to observe
+                                if isinstance(e2, WorkerDiedError):
+                                    self.scheduler.manager.mark_dead(
+                                        w.worker_id, reason="worker-died")
+
+                            f2.add_done_callback(_observe)
+                    notify(TaskCompleted(
+                        query_id=att.task.query_id, task_id=att.task.task_id,
+                        worker_id=att.worker.worker_id,
+                        duration_s=time.monotonic() - att.t0, error=err))
+                    if exc is None:
                         continue
-                    if a.task.side_effecting and not cancelled:
-                        # The write may STILL be running on the unreachable
-                        # worker; re-executing it elsewhere would race
-                        # duplicate output files. Fail the query instead.
-                        failure = DaftExecutionError(
-                            f"write task {a.task.task_id} wedged on dead "
-                            f"worker {a.worker.worker_id}; cannot safely "
-                            f"re-execute a side-effecting task that may "
-                            f"still be running")
-                        break
                     failure = self._handle_attempt_failure(
-                        a, WorkerDiedError(
-                            f"worker {a.worker.worker_id} marked dead with "
-                            f"task {a.task.task_id} in flight"),
-                        max_retries, requeue, attempts_inflight)
+                        att, exc, max_retries, requeue, attempts_inflight)
                     if failure is not None:
                         break
 
-            # ---- speculation phase --------------------------------------
-            if failure is None and speculate and len(durations) >= spec_min:
-                try:
-                    median = statistics.median(durations)
-                    threshold = max(spec_mult * median, 1e-3)
-                    now = time.monotonic()
-                    for fut, att in list(inflight.items()):
-                        hard_pin = (att.task.strategy.kind == "affinity"
-                                    and not att.task.strategy.soft)
-                        if (att.speculative or att.idx in speculated
-                                or att.idx in done_idx
-                                or hard_pin  # duplicate would land on the same pin
-                                or att.task.side_effecting  # duplicate writes
-                                # leave the loser's files behind — never race
-                                or now - att.t0 <= threshold
-                                or len(inflight) >= limit + 1):
+                # ---- dead-worker reaping ------------------------------------
+                # A worker marked dead asynchronously (heartbeat timeout) may
+                # hold wedged futures that will NEVER complete — e.g. a daemon
+                # that network-partitioned mid-task. Fail those attempts as
+                # worker deaths instead of waiting forever.
+                if failure is None:
+                    for f, a in [(f, a) for f, a in inflight.items()
+                                 if self.scheduler.manager.is_dead(a.worker.worker_id)]:
+                        cancelled = f.cancel()
+                        del inflight[f]
+                        if a.idx in done_idx:
                             continue
-                        try:
-                            notify(TaskRetried(query_id=att.task.query_id,
-                                               task_id=att.task.task_id,
-                                               worker_id=att.worker.worker_id,
-                                               attempt=att.attempt + 1,
-                                               reason="straggler"))
-                            submit(att.idx, att.task, att.attempt + 1,
-                                   speculative=True,
-                                   exclude={att.worker.worker_id})
-                        except Exception:
-                            # Speculation is an optimization: ANY failure to
-                            # place the duplicate (no spare worker, injected
-                            # fault) just leaves the original running.
-                            _log.debug("straggler duplicate for task %s not "
-                                       "placed", att.task.task_id,
-                                       exc_info=True)
-                        speculated.add(att.idx)
-                except BaseException as e:  # noqa: BLE001 — e.g. interrupt:
-                    # abort through the drain path, re-raising interrupts
-                    # as themselves rather than wrapped in a DaftError.
-                    if not isinstance(e, Exception):
-                        failure = e
-                    else:
-                        failure = DaftExecutionError(f"speculation failed: {e}")
-                        failure.__cause__ = e
+                        if a.task.side_effecting and not cancelled:
+                            # The write may STILL be running on the unreachable
+                            # worker; re-executing it elsewhere would race
+                            # duplicate output files. Fail the query instead.
+                            failure = DaftExecutionError(
+                                f"write task {a.task.task_id} wedged on dead "
+                                f"worker {a.worker.worker_id}; cannot safely "
+                                f"re-execute a side-effecting task that may "
+                                f"still be running")
+                            break
+                        failure = self._handle_attempt_failure(
+                            a, WorkerDiedError(
+                                f"worker {a.worker.worker_id} marked dead with "
+                                f"task {a.task.task_id} in flight"),
+                            max_retries, requeue, attempts_inflight)
+                        if failure is not None:
+                            break
+
+                # ---- speculation phase --------------------------------------
+                if failure is None and speculate and len(durations) >= spec_min:
+                    try:
+                        median = statistics.median(durations)
+                        threshold = max(spec_mult * median, 1e-3)
+                        now = time.monotonic()
+                        for fut, att in list(inflight.items()):
+                            hard_pin = (att.task.strategy.kind == "affinity"
+                                        and not att.task.strategy.soft)
+                            if (att.speculative or att.idx in speculated
+                                    or att.idx in done_idx
+                                    or hard_pin  # duplicate would land on the same pin
+                                    or att.task.side_effecting  # duplicate writes
+                                    # leave the loser's files behind — never race
+                                    or now - att.t0 <= threshold
+                                    or len(inflight) >= limit + 1):
+                                continue
+                            try:
+                                notify(TaskRetried(query_id=att.task.query_id,
+                                                   task_id=att.task.task_id,
+                                                   worker_id=att.worker.worker_id,
+                                                   attempt=att.attempt + 1,
+                                                   reason="straggler"))
+                                submit(att.idx, att.task, att.attempt + 1,
+                                       speculative=True,
+                                       exclude={att.worker.worker_id})
+                            except Exception:
+                                # Speculation is an optimization: ANY failure to
+                                # place the duplicate (no spare worker, injected
+                                # fault) just leaves the original running.
+                                _log.debug("straggler duplicate for task %s not "
+                                           "placed", att.task.task_id,
+                                           exc_info=True)
+                            speculated.add(att.idx)
+                    except BaseException as e:  # noqa: BLE001 — e.g. interrupt:
+                        # abort through the drain path, re-raising interrupts
+                        # as themselves rather than wrapped in a DaftError.
+                        if not isinstance(e, Exception):
+                            failure = e
+                        else:
+                            failure = DaftExecutionError(f"speculation failed: {e}")
+                            failure.__cause__ = e
+        finally:
+            self.scheduler.manager.remove_death_listener(on_death)
+            if token is not None:
+                token.remove_listener(wake.set)
         return [results[i] for i in range(len(tasks))]
 
     # ------------------------------------------------------------------ #
@@ -407,6 +504,17 @@ class Dispatcher:
         if not isinstance(exc, Exception):
             # SystemExit/KeyboardInterrupt from a task: abort through the
             # drain path but re-raise AS ITSELF, never wrapped in DaftError.
+            return exc
+        if find_in_chain(exc, DaftCancelledError) is not None:
+            # The task observed the query's cancel token (deadline expiry /
+            # user cancel) cooperatively. Never retried — a dead query's
+            # work must stop, not migrate — and never wrapped: the query
+            # fails with the cancellation type itself.
+            tok = self.cancel_token
+            if tok is not None:
+                err = tok.error("task execution")
+                if err is not None:
+                    return err  # the canonical token error wins over per-task copies
             return exc
         fetch_err = find_fetch_failure(exc)
         rec = _Pending(att.idx, att.task, att.attempt)

@@ -63,6 +63,10 @@ class Task:
     # must never speculatively duplicate them — a losing duplicate's output
     # files cannot be retracted.
     side_effecting: bool = False
+    # The query's Deadline (cancellation.py), stamped at dispatch. Pickling
+    # re-anchors the remaining budget on the receiving process's monotonic
+    # clock, so process/daemon workers enforce the same bound locally.
+    deadline: Optional[object] = None
 
     def input_size_bytes(self) -> int:
         return sum(r.size_bytes() for refs in self.inputs for r in refs)

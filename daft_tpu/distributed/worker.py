@@ -192,6 +192,7 @@ class LocalWorker(Worker):
             try:
                 if self._dead:
                     raise WorkerDiedError(f"worker {self.worker_id} is dead")
+                from daft_tpu.cancellation import cancel_scope, token_for_task
                 from daft_tpu.execution.executor import Executor
                 from daft_tpu.execution.resource_manager import (
                     RuntimeStats,
@@ -200,7 +201,10 @@ class LocalWorker(Worker):
 
                 from daft_tpu.context import frozen_clock_scope
 
-                bound = bind_task_fragment(task.fragment, task.inputs)
+                # In-process workers resolve the driver's LIVE token by
+                # query id (user cancels included); the wire deadline is the
+                # fallback. Ambient scope covers io retries + fault points.
+                token = token_for_task(task.query_id, task.deadline)
                 # Worker-local stats keep their normal event flush (so
                 # subscribers see OperatorStats exactly once); the snapshot
                 # ALSO merges into the driver's per-query stats for the
@@ -208,8 +212,11 @@ class LocalWorker(Worker):
                 stats = RuntimeStats(task.query_id)
                 executor = Executor(task.cfg or self.cfg,
                                     partition_offset=task.partition_idx,
-                                    stats=stats)
-                with frozen_clock_scope(task.frozen_clock):
+                                    stats=stats, cancel_token=token)
+                with cancel_scope(token), frozen_clock_scope(task.frozen_clock):
+                    # Input fetches run inside the scope too: shuffle.fetch
+                    # injection points observe the token.
+                    bound = bind_task_fragment(task.fragment, task.inputs)
                     out = list(executor.run(bound))
                 parts = collect_task_outputs(out, task.expect_outputs, task.fragment.schema)
                 driver_stats = active_query_stats(task.query_id)
@@ -253,6 +260,21 @@ class WorkerManager:
         self._dead: set = set()
         self._lock = threading.Lock()
         self._monitor: Optional["HeartbeatMonitor"] = None
+        # Death listeners (dispatcher wake-ups): called outside the lock on
+        # every first-time mark_dead, so blocked wait loops notice an
+        # asynchronously-detected death immediately instead of polling.
+        self._death_listeners: List[Callable[[str], None]] = []
+
+    def add_death_listener(self, cb: Callable[[str], None]) -> None:
+        with self._lock:
+            self._death_listeners.append(cb)
+
+    def remove_death_listener(self, cb: Callable[[str], None]) -> None:
+        with self._lock:
+            try:
+                self._death_listeners.remove(cb)
+            except ValueError:
+                pass
 
     def workers(self) -> List[Worker]:
         with self._lock:
@@ -268,11 +290,17 @@ class WorkerManager:
         with self._lock:
             newly = worker_id not in self._dead
             self._dead.add(worker_id)
+            listeners = list(self._death_listeners) if newly else []
         if newly:
             from daft_tpu.context import get_context
             from daft_tpu.subscribers.events import WorkerLost
 
             get_context().notify(WorkerLost(worker_id=worker_id, reason=reason))
+            for cb in listeners:
+                try:
+                    cb(worker_id)
+                except Exception:
+                    _log.warning("worker-death listener raised", exc_info=True)
 
     def is_dead(self, worker_id: str) -> bool:
         with self._lock:

@@ -42,3 +42,30 @@ class DaftExecutionError(DaftError):
 class DaftTransientError(DaftError):
     """Retryable failure (mirrors reference retry taxonomy in
     src/daft-io/src/retry.rs and python_udf/retry.rs)."""
+
+
+class DaftCircuitOpenError(DaftTransientError):
+    """An IO endpoint's circuit breaker is open: the call failed fast
+    instead of re-hitting a flapping host (io/circuit.py). Transient by
+    classification — the dispatcher's retry/backoff machinery handles it,
+    and a later attempt may land after the breaker's probe succeeds."""
+
+    def __init__(self, message: str, endpoint: str = ""):
+        super().__init__(message)
+        self.endpoint = endpoint
+
+
+class DaftCancelledError(DaftError):
+    """The query was cancelled (user cancel or executor abort) and this
+    unit of work observed the cancel token cooperatively. Deliberately NOT
+    transient: retrying cancelled work defeats the cancel."""
+
+
+class DaftTimeoutError(DaftCancelledError):
+    """The query's deadline expired (``df.collect(timeout=...)`` /
+    ``DAFT_QUERY_TIMEOUT_S``). ``progress`` carries the per-task state at
+    expiry: ``{"completed": int, "running": [...], "pending": int}``."""
+
+    def __init__(self, message: str, progress: "dict | None" = None):
+        super().__init__(message)
+        self.progress = progress or {}

@@ -120,7 +120,7 @@ class Executor:
     """Runs a local physical plan, yielding result MicroPartitions."""
 
     def __init__(self, cfg, num_io_threads: int = 8, partition_offset: int = 0,
-                 stats=None):
+                 stats=None, cancel_token=None):
         import os
 
         from daft_tpu.execution.resource_manager import get_memory_manager
@@ -129,6 +129,9 @@ class Executor:
         self.num_io_threads = num_io_threads
         self.partition_offset = partition_offset
         self.stats = stats  # RuntimeStats | None
+        # Cooperative cancellation (cancellation.py): observed at morsel
+        # boundaries, memory-permit waits, and fault-injection points.
+        self.cancel_token = cancel_token
         self.memory = get_memory_manager()
         self._held_bytes = 0
         # Per-THREAD pull-chain stack: with worker-pool stages, nested
@@ -174,6 +177,20 @@ class Executor:
         self._shared_cache = {}
         try:
             yield from self._run(plan)
+        except BaseException as e:  # noqa: BLE001 — re-raised below
+            # The executor is dying: any sink thread still blocked in a
+            # memory-permit wait would otherwise sleep until its timeout
+            # (or forever, for unbounded waits). Poison wakes every CURRENT
+            # waiter with this failure; later queries are untouched
+            # (generation-scoped, and query-scoped when we know our query:
+            # concurrent healthy queries' waiters keep waiting).
+            # GeneratorExit is NORMAL early close (limit pushdown,
+            # abandoned iteration) — never a poison.
+            if not isinstance(e, GeneratorExit):
+                qid = getattr(self.cancel_token, "query_id", None) \
+                    or (self.stats.query_id if self.stats is not None else None)
+                self.memory.poison(e, query_id=qid or None)
+            raise
         finally:
             self._shared_cache = {}
             if self._compute_pool is not None:
@@ -203,7 +220,8 @@ class Executor:
                     # instead of waiting forever.
                     nbytes = mp.size_bytes()
                     if gate_on:
-                        if self.memory.acquire(nbytes, timeout=5.0):
+                        if self.memory.acquire(nbytes, timeout=5.0,
+                                               token=self.cancel_token):
                             self._held_bytes += nbytes
                         else:
                             gate_on = False
@@ -217,9 +235,21 @@ class Executor:
         if handler is None:
             raise DaftPlanError(f"No executor for physical node {node.name()}")
         it = handler(node)
+        if self.cancel_token is not None:
+            it = self._cancel_checked(node.name(), it)
         if self.stats is None:
             return it
         return self._instrumented(node.name(), it)
+
+    def _cancel_checked(self, op: str,
+                        it: Iterator[MicroPartition]) -> Iterator[MicroPartition]:
+        """Observe the query's cancel token at every morsel boundary: a
+        cancelled/expired query fails out of the pull chain at the next
+        morsel instead of running the plan to completion."""
+        token = self.cancel_token
+        for mp in it:
+            token.check(op)
+            yield mp
 
     def _instrumented(self, op: str, it: Iterator[MicroPartition]) -> Iterator[MicroPartition]:
         """Per-operator counters with EXCLUSIVE cpu attribution: each level
@@ -488,7 +518,8 @@ class Executor:
             # for this sink — the only releaser is this executor at query end,
             # so further waits are pure self-deadlock stalls.
             if gate_on and self._held_bytes < limit:
-                if self.memory.acquire(nbytes, timeout=5.0):
+                if self.memory.acquire(nbytes, timeout=5.0,
+                                       token=self.cancel_token):
                     self._held_bytes += min(nbytes, limit)
                 else:
                     gate_on = False
@@ -506,7 +537,7 @@ class Executor:
         # Out-of-core: sorted-run generation + k-way streaming merge.
         from daft_tpu.execution.spill import ExternalSort, budget_reservation
 
-        with budget_reservation(self.memory, budget):
+        with budget_reservation(self.memory, budget, token=self.cancel_token):
             state = ExternalSort(node.sort_by, node.descending, node.nulls_first,
                                  node.schema, budget, self._spill(),
                                  morsel_rows=self.cfg.default_morsel_size)
@@ -579,7 +610,7 @@ class Executor:
             for partial in st.partial_batches():
                 grace.add(partial)
 
-        with budget_reservation(self.memory, budget):
+        with budget_reservation(self.memory, budget, token=self.cancel_token):
             for item in items:
                 ingest(state, item)
                 if state.approx_size_bytes() > budget:
@@ -616,7 +647,7 @@ class Executor:
 
         state: AggState = node.two_phase() if callable(node.two_phase) else node.two_phase
         budget = self._sink_budget()
-        with budget_reservation(self.memory, budget) if budget is not None \
+        with budget_reservation(self.memory, budget, token=self.cancel_token) if budget is not None \
                 else contextlib.nullcontext():
             emitted = False
             for mp in self._run(node.children[0]):
@@ -705,7 +736,7 @@ class Executor:
         key_names = on or node.schema.column_names()
         import contextlib
 
-        with budget_reservation(self.memory, budget) if budget is not None \
+        with budget_reservation(self.memory, budget, token=self.cancel_token) if budget is not None \
                 else contextlib.nullcontext():
             grace: Optional[GracePartitioner] = None
             buffer: List[RecordBatch] = []
@@ -764,7 +795,7 @@ class Executor:
         # unspecified, as everywhere else in the engine outside Sort).
         from daft_tpu.execution.spill import GracePartitioner, budget_reservation
 
-        with budget_reservation(self.memory, budget):
+        with budget_reservation(self.memory, budget, token=self.cancel_token):
             grace: Optional[GracePartitioner] = None
             buffer: List[RecordBatch] = []
             buf_bytes = 0
@@ -898,7 +929,7 @@ class Executor:
         from daft_tpu.execution.spill import budget_reservation
 
         budget = self._sink_budget()
-        with budget_reservation(self.memory, budget) if budget is not None \
+        with budget_reservation(self.memory, budget, token=self.cancel_token) if budget is not None \
                 else contextlib.nullcontext():
             yield from self._hash_join_impl(node, budget)
 
@@ -1073,7 +1104,7 @@ class Executor:
                 # contract).
                 from daft_tpu.execution.spill import budget_reservation
 
-                with budget_reservation(self.memory, budget):
+                with budget_reservation(self.memory, budget, token=self.cancel_token):
                     state, side = self._collect_or_grace(
                         node.children[0], exprs, budget,
                         num_buckets=max(n, 1))

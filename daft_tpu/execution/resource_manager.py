@@ -18,7 +18,19 @@ from typing import Dict, Optional
 class MemoryManager:
     """Byte-permit gate for blocking sinks: acquire before buffering a morsel,
     release when the buffer drains. Oversized single requests are clamped so a
-    morsel larger than the budget still makes progress."""
+    morsel larger than the budget still makes progress.
+
+    Waiters are CANCELLABLE two ways (bounded-time execution):
+
+    * pass ``token`` (a :class:`~daft_tpu.cancellation.CancelToken`) — the
+      wait wakes the moment the query is cancelled and bounds itself by the
+      query deadline, returning False like a timeout;
+    * :meth:`poison` — the executor's failure path marks every *current*
+      waiter with the query's error, so sink threads blocked in
+      ``acquire(timeout=None)`` never outlive the query that died around
+      them. Generation-scoped: waiters that arrive after the poison (the
+      next query) are untouched.
+    """
 
     def __init__(self, limit_bytes: Optional[int] = None):
         if limit_bytes is None:
@@ -29,21 +41,66 @@ class MemoryManager:
         self.limit = limit_bytes
         self._used = 0
         self._cond = threading.Condition()
+        self._poison_gen = 0
+        self._poison_exc: Optional[BaseException] = None
+        self._poison_query: Optional[str] = None
 
-    def acquire(self, nbytes: int, timeout: Optional[float] = None) -> bool:
+    def acquire(self, nbytes: int, timeout: Optional[float] = None,
+                token=None) -> bool:
         if self.limit is None:
             return True
         request = min(nbytes, self.limit)
+        woken = None
+        if token is not None:
+            # Cancel wakes every waiter; each re-checks its own token below.
+            def woken():
+                with self._cond:
+                    self._cond.notify_all()
+
+            token.add_listener(woken)
+        try:
+            with self._cond:
+                my_gen = self._poison_gen
+                deadline = None if timeout is None else time.monotonic() + timeout
+                while self._used + request > self.limit:
+                    if self._poison_gen > my_gen and self._poison_exc is not None:
+                        # Scoped blast radius: a waiter carrying a LIVE
+                        # token of a DIFFERENT query is not this poison's
+                        # target — its own query is healthy; keep waiting.
+                        if (token is None or self._poison_query is None
+                                or getattr(token, "query_id", "")
+                                == self._poison_query):
+                            raise self._poison_exc
+                        my_gen = self._poison_gen
+                    if token is not None and (token.cancelled() or token.expired()):
+                        return False
+                    remaining = None if deadline is None \
+                        else deadline - time.monotonic()
+                    if remaining is not None and remaining <= 0:
+                        return False
+                    if token is not None:
+                        tok_rem = token.remaining()
+                        if tok_rem is not None:
+                            remaining = tok_rem if remaining is None \
+                                else min(remaining, tok_rem)
+                    if not self._cond.wait(remaining) and token is None:
+                        return False
+                self._used += request
+                return True
+        finally:
+            if woken is not None:
+                token.remove_listener(woken)
+
+    def poison(self, exc: BaseException, query_id: Optional[str] = None) -> None:
+        """Fail waiters CURRENTLY blocked in :meth:`acquire` with ``exc``
+        (the executor's abort path). With ``query_id``, only waiters of that
+        query (or token-less waiters) raise — concurrent healthy queries
+        keep waiting. Future acquires are unaffected (generation-scoped)."""
         with self._cond:
-            deadline = None if timeout is None else time.monotonic() + timeout
-            while self._used + request > self.limit:
-                remaining = None if deadline is None else deadline - time.monotonic()
-                if remaining is not None and remaining <= 0:
-                    return False
-                if not self._cond.wait(remaining):
-                    return False
-            self._used += request
-            return True
+            self._poison_gen += 1
+            self._poison_exc = exc
+            self._poison_query = query_id
+            self._cond.notify_all()
 
     def release(self, nbytes: int) -> None:
         if self.limit is None:

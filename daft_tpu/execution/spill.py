@@ -75,13 +75,14 @@ spill_metrics = SpillMetrics()
 
 
 @contextmanager
-def budget_reservation(memory, budget: int):
+def budget_reservation(memory, budget: int, token=None):
     """Reserve a spilling sink's working set against the global permit gate
     so CONCURRENT executors under one DAFT_MEMORY_LIMIT coordinate (at most
     limit/budget sinks hold reservations at once); a timed-out acquire
     degrades to best-effort rather than self-deadlocking, matching the
-    pre-spill permit semantics (reference: resource_manager.rs:44)."""
-    ok = memory.acquire(budget, timeout=5.0)
+    pre-spill permit semantics (reference: resource_manager.rs:44). A
+    cancel ``token`` wakes the wait early when the query dies."""
+    ok = memory.acquire(budget, timeout=5.0, token=token)
     try:
         yield
     finally:

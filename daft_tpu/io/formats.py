@@ -54,25 +54,43 @@ def read_scan_task(task: ScanTask, morsel_rows: int = 128 * 1024) -> Iterator[Mi
             maybe_inject("io.get_object", path=f.path)
             return _read_one_file(task, f, morsel_rows)
 
-        remaining = yield from _stream_with_retry(task, open_file, remaining)
+        remaining = yield from _stream_with_retry(task, open_file, remaining,
+                                                  endpoint=f.path)
 
 
 _SCAN_RETRIES = 3
 
 
-def _stream_with_retry(task: ScanTask, make_iter, remaining, project_columns: bool = False):
+def _stream_with_retry(task: ScanTask, make_iter, remaining,
+                       project_columns: bool = False,
+                       endpoint: Optional[str] = None):
     """Stream morsels from ``make_iter()`` applying pushdown filters/limit,
     retrying transient failures (reference: src/daft-io/src/retry.rs).
 
     Retry is only safe BEFORE the first morsel reached the consumer (a
     mid-stream retry would duplicate yielded rows); the final attempt always
     re-raises, so the loop has no normal fall-through.
+
+    Bounded-time: sleeps are interruptible against the ambient cancel token
+    and never overrun the query's remaining budget. With an ``endpoint``,
+    attempts feed that endpoint's shared circuit breaker — a host failing
+    across MANY scan tasks opens the circuit and later tasks fail fast with
+    ``DaftCircuitOpenError`` (transient: the dispatcher's backoff owns it).
     """
     import time as _time
 
-    from daft_tpu.errors import DaftTransientError
+    from daft_tpu.cancellation import current_token
+    from daft_tpu.errors import DaftCircuitOpenError, DaftTransientError
 
+    breaker = None
+    if endpoint is not None:
+        from daft_tpu.io.circuit import breaker_for, endpoint_of
+
+        breaker = breaker_for(endpoint_of(endpoint))
+    token = current_token()
     for attempt in range(_SCAN_RETRIES):
+        if breaker is not None:
+            breaker.allow()
         yielded = False
         try:
             for mp in make_iter():
@@ -90,15 +108,30 @@ def _stream_with_retry(task: ScanTask, make_iter, remaining, project_columns: bo
                     yielded = True
                     yield mp
                 if remaining is not None and remaining <= 0:
+                    if breaker is not None:
+                        breaker.record_success()
                     return remaining
+            if breaker is not None:
+                breaker.record_success()
             return remaining
-        except DaftTransientError:
+        except DaftTransientError as e:
+            if breaker is not None and not isinstance(e, DaftCircuitOpenError):
+                breaker.record_failure()
             if yielded or attempt + 1 >= _SCAN_RETRIES:
                 raise
             from daft_tpu.io.iostats import IO_STATS
 
+            delay = 0.05 * (2 ** attempt)
+            if token is not None:
+                rem = token.remaining()
+                if rem is not None and delay >= rem:
+                    raise  # sleeping would overrun the query budget
             IO_STATS.count_retry()
-            _time.sleep(0.05 * (2 ** attempt))
+            if token is not None:
+                if token.wait(delay):
+                    token.check("scan retry backoff")
+            else:
+                _time.sleep(delay)
 
 
 def _read_one_file(task: ScanTask, f, morsel_rows: int):

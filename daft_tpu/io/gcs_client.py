@@ -74,6 +74,11 @@ class GCSClient:
         # num_tries is TOTAL attempts (policy_from_config convention):
         # max_retries = num_tries - 1.
         self.policy = policy or RetryPolicy(max_retries=max(tries - 1, 0))
+        # Shared per-endpoint breaker: repeated transient failures against
+        # this host fail fast instead of re-hitting it (io/circuit.py).
+        from daft_tpu.io.circuit import breaker_for
+
+        self.breaker = breaker_for(self.endpoint)
         self.provider: Optional[TokenProvider] = \
             resolve_gcs_token_provider(gcs_config, self.policy)
         self.resumable_threshold = resumable_threshold
@@ -143,7 +148,7 @@ class GCSClient:
         return with_retries(
             attempt, self.policy, describe=f"GCS {method} {full}",
             is_retryable=lambda e: isinstance(e, DaftTransientError),
-            on_retry=IO_STATS.count_retry)
+            on_retry=IO_STATS.count_retry, breaker=self.breaker)
 
     # ------------------------------------------------------------------ #
     def get_object(self, bucket: str, key: str, start: Optional[int] = None,

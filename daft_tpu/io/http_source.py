@@ -80,9 +80,12 @@ def http_head(url: str, policy: Optional[RetryPolicy] = None,
         IO_STATS.count_get(0, _time.perf_counter() - t0)
         return out
 
+    from daft_tpu.io.circuit import breaker_for_url
+
     return with_retries(attempt, policy, describe=f"HEAD {url}",
                         is_retryable=lambda e: _is_retryable(e, policy),
-                        on_retry=IO_STATS.count_retry)
+                        on_retry=IO_STATS.count_retry,
+                        breaker=breaker_for_url(url))
 
 
 def http_get(url: str, start: Optional[int] = None,
@@ -109,9 +112,12 @@ def http_get(url: str, start: Optional[int] = None,
         IO_STATS.count_get(len(data), _time.perf_counter() - t0)
         return data
 
+    from daft_tpu.io.circuit import breaker_for_url
+
     return with_retries(attempt, policy, describe=f"GET {url}",
                         is_retryable=lambda e: _is_retryable(e, policy),
-                        on_retry=IO_STATS.count_retry)
+                        on_retry=IO_STATS.count_retry,
+                        breaker=breaker_for_url(url))
 
 
 class HttpReadableFile(io.RawIOBase):

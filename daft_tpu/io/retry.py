@@ -91,26 +91,70 @@ def policy_from_config(io_config=None, scheme: str = "s3") -> RetryPolicy:
 def with_retries(fn: Callable, policy: RetryPolicy, *,
                  describe: str = "io operation",
                  is_retryable: Optional[Callable[[BaseException], bool]] = None,
-                 on_retry: Optional[Callable[[], None]] = None):
+                 on_retry: Optional[Callable[[], None]] = None,
+                 deadline=None, breaker=None):
     """Run ``fn()`` under the policy. ``is_retryable`` may override the
-    default exception-class test (e.g. to inspect an HTTP status)."""
+    default exception-class test (e.g. to inspect an HTTP status).
+
+    **Bounded time**: retries never sleep past the remaining budget. The
+    budget is ``deadline`` (a :class:`~daft_tpu.cancellation.Deadline`) if
+    given, else the ambient query token's deadline (cancellation.py) — and a
+    backoff sleep that would overrun it raises the LAST error immediately
+    instead of sleeping into certain failure. With a live token, sleeps are
+    also interruptible: a user cancel wakes the sleeper, which re-raises
+    through the token. The per-attempt cap (``policy_from_config``) is
+    unchanged.
+
+    **Circuit breaking**: with a ``breaker``
+    (:class:`~daft_tpu.io.circuit.CircuitBreaker`), every attempt passes the
+    breaker's admission check first — an open circuit fails fast with
+    ``DaftCircuitOpenError`` (never counted as a new failure) — and attempt
+    outcomes feed the breaker's state machine. Cancellation errors feed
+    neither side: a dead query says nothing about the endpoint's health.
+    """
+    from daft_tpu.cancellation import current_token
+    from daft_tpu.errors import DaftCancelledError, DaftCircuitOpenError
+
+    token = current_token()
+    if deadline is None and token is not None:
+        deadline = token.deadline
     last: Optional[BaseException] = None
     for attempt in range(policy.max_retries + 1):
+        if token is not None:
+            token.check(describe)
+        if breaker is not None:
+            breaker.allow()
         try:
-            return fn()
+            result = fn()
         except BaseException as e:  # noqa: BLE001
             # Cancellation / interpreter-shutdown signals are NEVER retried,
             # even if a custom is_retryable would claim them (it's only ever
             # consulted for ordinary Exceptions).
-            if not isinstance(e, Exception):
+            if not isinstance(e, Exception) or isinstance(e, DaftCancelledError):
                 raise
             retryable = (is_retryable(e) if is_retryable is not None
                          else isinstance(e, policy.retryable_exceptions))
+            if breaker is not None and retryable \
+                    and not isinstance(e, DaftCircuitOpenError):
+                breaker.record_failure()
             if not retryable or attempt >= policy.max_retries:
                 raise
             last = e
+            delay = policy.sleep_s(attempt, getattr(e, "retry_after", None))
+            if deadline is not None and delay >= deadline.remaining():
+                # Sleeping would overrun the remaining budget: surfacing the
+                # real error NOW beats a guaranteed DaftTimeoutError later.
+                raise
             if on_retry is not None:
                 on_retry()
-            time.sleep(policy.sleep_s(attempt, getattr(e, "retry_after", None)))
+            if token is not None:
+                if token.wait(delay):
+                    token.check(describe)  # woken by cancel: raise through it
+            else:
+                time.sleep(delay)
+        else:
+            if breaker is not None:
+                breaker.record_success()
+            return result
     raise DaftIOError(f"{describe} failed after {policy.max_retries + 1} "
                       f"attempts: {last}")

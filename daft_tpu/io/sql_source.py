@@ -115,14 +115,9 @@ class SQLTask(DataSourceTask):
         return self.source.schema()
 
     def execute(self) -> Iterator[MicroPartition]:
-        conn = self.source._connect()
+        conn, cursor = self.source._connect_and_execute(self.sql)
         owned = self.source._owns_connections()
         try:
-            cursor = conn.cursor()
-            try:
-                cursor.execute(self.sql)
-            except Exception as e:
-                raise classify_db_error(e, "read_sql partition query") from e
             if cursor.description is None:
                 raise DaftValueError(
                     f"read_sql requires a row-returning statement; got none "
@@ -180,6 +175,48 @@ class SQLSource(DataSource):
         if hasattr(self.conn_factory, "cursor"):
             return self.conn_factory  # live DB-API connection
         return self.conn_factory()
+
+    def endpoint_key(self) -> str:
+        """Circuit-breaker key for this source's database: the factory
+        OBJECT identity (readable qualname prefix for events/messages).
+        Distinct factories built from the same closure/lambda share a
+        qualname but are different databases — keying by name alone would
+        let one flapping DB's open breaker fail fast against healthy ones.
+        All partition tasks of one read_sql share the factory object, which
+        is the sharing that matters."""
+        name = getattr(self.conn_factory, "__qualname__", None) \
+            or getattr(self.conn_factory, "__name__", None) \
+            or type(self.conn_factory).__name__
+        return f"sql://{name}@{id(self.conn_factory):x}"
+
+    def _connect_and_execute(self, sql: str):
+        """Connect + run ``sql`` with transient-classified retry behind the
+        database's shared circuit breaker (io/circuit.py): a flapping
+        database opens the breaker and later partitions fail fast with
+        DaftCircuitOpenError (which the dispatcher's retry/backoff owns)
+        instead of each burning a fresh connect timeout."""
+        from daft_tpu.io.circuit import breaker_for
+        from daft_tpu.io.retry import RetryPolicy, with_retries
+
+        owned = self._owns_connections()
+
+        def attempt():
+            conn = self._connect()
+            try:
+                cursor = conn.cursor()
+                cursor.execute(sql)
+                return conn, cursor
+            except Exception as e:
+                if owned:
+                    _close_quietly(conn, "failed partition query")
+                raise classify_db_error(e, "read_sql partition query") from e
+
+        return with_retries(
+            attempt, RetryPolicy(max_retries=2, backoff_base_s=0.1,
+                                 backoff_cap_s=2.0),
+            describe=f"read_sql against {self.endpoint_key()}",
+            is_retryable=lambda e: isinstance(e, DaftTransientError),
+            breaker=breaker_for(self.endpoint_key()))
 
     def _owns_connections(self) -> bool:
         """False for a live connection OR a factory that hands back the same

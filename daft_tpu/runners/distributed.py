@@ -96,7 +96,7 @@ class DistributedRunner(Runner):
             self.manager.start_heartbeat_monitor(
                 cfg.heartbeat_interval_s, cfg.heartbeat_miss_threshold)
 
-    def run_iter(self, builder) -> Iterator[MicroPartition]:
+    def run_iter(self, builder, timeout: Optional[float] = None) -> Iterator[MicroPartition]:
         ctx = get_context()
         cfg = ctx.execution_config
         query_id = uuid.uuid4().hex[:16]
@@ -115,12 +115,29 @@ class DistributedRunner(Runner):
         stats.local_flush = False  # workers already emit OperatorStats events
         ctx.last_query_stats = stats  # DataFrame.metrics() surface
         register_query_stats(query_id, stats)
+        from daft_tpu.cancellation import (
+            CancelToken,
+            Deadline,
+            cancel_scope,
+            register_query_token,
+            unregister_query_token,
+        )
         from daft_tpu.context import frozen_clock_scope
 
         from daft_tpu.distributed.faults import config_fault_scope
 
+        # One token per query, created HERE on the driver: explicit
+        # timeout > config default > unbounded. Registered by query id so
+        # in-process workers observe it live (daft_tpu.cancel_query too).
+        if timeout is None:
+            timeout = cfg.query_timeout_s
+        token = CancelToken(
+            Deadline.after(timeout) if timeout is not None else None,
+            query_id=query_id)
+        register_query_token(query_id, token)
         try:
-            executor = DistributedExecutor(self.manager, cfg, query_id=query_id)
+            executor = DistributedExecutor(self.manager, cfg, query_id=query_id,
+                                           cancel_token=token)
             # A cfg-armed fault spec is scoped to the SYNCHRONOUS execution
             # of this query only (explicit fault_scope / DAFT_FAULT_SPEC env
             # injectors take precedence) — it must not stay armed across the
@@ -129,11 +146,12 @@ class DistributedRunner(Runner):
                 # Freeze only around the synchronous plan execution: every
                 # Task created inside captures this one instant
                 # (Task.frozen_clock default_factory) and ships it with it.
-                with frozen_clock_scope():
+                with cancel_scope(token), frozen_clock_scope():
                     refs = executor.execute(physical)
             for ref in refs:
                 # Recovery-aware: an output hosted on a since-dead worker
                 # is recomputed from lineage instead of failing collect.
+                # Still deadline-bounded: fetch/recovery checks the token.
                 mp = executor.fetch_output(ref)
                 if len(mp):
                     yield mp
@@ -141,6 +159,7 @@ class DistributedRunner(Runner):
             error = str(e)
             raise
         finally:
+            unregister_query_token(query_id)
             unregister_query_stats(query_id)
             ctx.notify(QueryEnd(query_id=query_id,
                                 duration_s=time.perf_counter() - start, error=error))

@@ -21,7 +21,7 @@ from daft_tpu.subscribers.events import QueryEnd, QueryStart
 class NativeRunner(Runner):
     name = "native"
 
-    def run_iter(self, builder) -> Iterator[MicroPartition]:
+    def run_iter(self, builder, timeout=None) -> Iterator[MicroPartition]:
         ctx = get_context()
         cfg = ctx.execution_config
         query_id = uuid.uuid4().hex[:16]
@@ -30,6 +30,20 @@ class NativeRunner(Runner):
         ctx.notify(QueryStart(query_id=query_id, plan=repr(optimized.plan)))
         start = time.perf_counter()
         error = None
+        from daft_tpu.cancellation import (
+            CancelToken,
+            Deadline,
+            iter_with_cancel_scope,
+            register_query_token,
+            unregister_query_token,
+        )
+
+        if timeout is None:
+            timeout = cfg.query_timeout_s
+        token = CancelToken(
+            Deadline.after(timeout) if timeout is not None else None,
+            query_id=query_id)
+        register_query_token(query_id, token)
         try:
             from daft_tpu.execution.resource_manager import RuntimeStats
 
@@ -37,14 +51,17 @@ class NativeRunner(Runner):
 
             stats = RuntimeStats(query_id)
             ctx.last_query_stats = stats  # DataFrame.metrics() surface
-            executor = Executor(cfg, stats=stats)
+            executor = Executor(cfg, stats=stats, cancel_token=token)
             # CURRENT_TIMESTAMP is one instant per statement: frozen per
             # resumption (not per generator lifetime) so interleaved lazy
-            # queries on one thread can't clobber each other's clock.
-            yield from iter_with_frozen_clock(executor.run(physical))
+            # queries on one thread can't clobber each other's clock. The
+            # cancel token follows the same per-resumption discipline.
+            yield from iter_with_cancel_scope(
+                iter_with_frozen_clock(executor.run(physical)), token)
         except BaseException as e:  # noqa: BLE001
             error = str(e)
             raise
         finally:
+            unregister_query_token(query_id)
             ctx.notify(QueryEnd(query_id=query_id,
                                 duration_s=time.perf_counter() - start, error=error))

@@ -24,8 +24,12 @@ class PartitionCacheEntry:
 class Runner:
     name = "base"
 
-    def run_iter(self, builder) -> Iterator[MicroPartition]:
+    def run_iter(self, builder, timeout: "float | None" = None) -> Iterator[MicroPartition]:
+        """Stream result partitions. ``timeout`` (seconds) bounds the whole
+        query: on expiry it fails with DaftTimeoutError instead of running
+        on. None falls back to ExecutionConfig.query_timeout_s
+        (DAFT_QUERY_TIMEOUT_S); both None = unbounded."""
         raise NotImplementedError
 
-    def run(self, builder) -> PartitionCacheEntry:
-        return PartitionCacheEntry(list(self.run_iter(builder)))
+    def run(self, builder, timeout: "float | None" = None) -> PartitionCacheEntry:
+        return PartitionCacheEntry(list(self.run_iter(builder, timeout=timeout)))

@@ -89,6 +89,35 @@ class PartitionRecovered(Event):
 
 
 @dataclass
+class QueryCancelled(Event):
+    """The query's deadline expired or the user cancelled it; the scheduler
+    is aborting through the drain path. ``reason`` is ``deadline`` or the
+    user-supplied cancel reason; ``progress`` snapshots per-task state at
+    cancel time ({completed, running, pending})."""
+
+    query_id: str = ""
+    reason: str = ""
+    progress: Dict[str, Any] = field(default_factory=dict)
+
+
+@dataclass
+class CircuitOpened(Event):
+    """An IO endpoint's circuit breaker tripped open after consecutive
+    transient failures; calls now fail fast until a probe succeeds."""
+
+    endpoint: str = ""
+    failures: int = 0
+    open_for_s: float = 0.0
+
+
+@dataclass
+class CircuitClosed(Event):
+    """A half-open probe against the endpoint succeeded; traffic resumes."""
+
+    endpoint: str = ""
+
+
+@dataclass
 class OperatorStats(Event):
     query_id: str = ""
     operator: str = ""

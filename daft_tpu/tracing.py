@@ -25,11 +25,14 @@ from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional
 
 from daft_tpu.subscribers.events import (
+    CircuitClosed,
+    CircuitOpened,
     Event,
     OperatorStats,
     OptimizationEnd,
     OptimizationStart,
     PartitionRecovered,
+    QueryCancelled,
     QueryEnd,
     QueryStart,
     TaskCompleted,
@@ -299,6 +302,20 @@ class TracingSubscriber:
                 self.meter.add("daft.workers.lost")
             elif isinstance(e, PartitionRecovered):
                 self.meter.add("daft.partitions.recovered", e.num_partitions or 1)
+            elif isinstance(e, QueryCancelled):
+                parent = self._open.get(e.query_id)
+                if parent is not None:
+                    parent.status = "ERROR"
+                    parent.attributes["cancel_reason"] = e.reason
+                    parent.events.append({
+                        "name": "QueryCancelled", "timeUnixNano": str(now)})
+                self.meter.add("daft.queries.cancelled")
+                self.meter.add(f"daft.queries.cancelled.{e.reason or 'unknown'}")
+            elif isinstance(e, CircuitOpened):
+                self.meter.add("daft.circuit.opened")
+                self.meter.record("daft.circuit.open_for_s", e.open_for_s)
+            elif isinstance(e, CircuitClosed):
+                self.meter.add("daft.circuit.closed")
 
 
 _auto_subscriber: Optional[TracingSubscriber] = None

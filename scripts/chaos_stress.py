@@ -28,7 +28,7 @@ jax.config.update("jax_platforms", "cpu")
 import daft_tpu  # noqa: E402
 from daft_tpu import col  # noqa: E402
 from daft_tpu.distributed.faults import fault_scope  # noqa: E402
-from daft_tpu.errors import DaftError  # noqa: E402
+from daft_tpu.errors import DaftError, DaftTimeoutError  # noqa: E402
 from daft_tpu.runners.distributed import DistributedRunner  # noqa: E402
 
 ROWS = 600
@@ -94,7 +94,7 @@ def random_spec(rng: random.Random) -> str:
     """One randomized fault spec: 1-3 clauses over the named points."""
     clauses = []
     for _ in range(rng.randrange(1, 4)):
-        kind = rng.randrange(4)
+        kind = rng.randrange(6)
         if kind == 0:
             clauses.append(f"worker.pre_submit:kill:{rng.randrange(2, 20)}")
         elif kind == 1:
@@ -103,12 +103,31 @@ def random_spec(rng: random.Random) -> str:
             n = rng.randrange(1, 4)
             clauses.extend(f"io.get_object:raise_transient:{i + 1}"
                            for i in range(n))
+        elif kind == 3:
+            # Breaker scenario: a burst of endpoint failures long enough to
+            # trip the circuit (CircuitOpened) — the query must fail fast or
+            # recover through the half-open probe, never hang.
+            n = rng.randrange(5, 9)
+            clauses.extend(f"io.get_object:raise_transient:{i + 1}"
+                           for i in range(n))
+        elif kind == 4:
+            # Deadline scenario: pin shuffle fetches in flight; paired with
+            # a query timeout in run_round (every DEADLINE_EVERYth round).
+            clauses.append(f"shuffle.fetch:delay:{rng.randrange(1, 6)}+:0.3")
         else:
             clauses.append(f"worker.pre_submit:delay:{rng.randrange(1, 10)}:0.05")
     return ",".join(clauses)
 
 
-def run_round(spec: str, seed: int, baseline: tuple) -> str | None:
+#: Every Nth round runs under a query deadline: bounded-time acceptance —
+#: identical results within the budget, or a clean DaftTimeoutError, never a
+#: hang (the driver-level `timeout` on this script is the backstop).
+DEADLINE_EVERY = 3
+DEADLINE_S = 20.0
+
+
+def run_round(spec: str, seed: int, baseline: tuple,
+              timeout: float | None = None) -> str | None:
     """Returns an error string, or None if results match the baseline."""
     ctx = daft_tpu.get_context()
     old = ctx._runner
@@ -116,8 +135,16 @@ def run_round(spec: str, seed: int, baseline: tuple) -> str | None:
     ctx.set_runner(runner)
     try:
         with fault_scope(spec, seed=seed):
-            got = (q1_style(make_lineitem()),
-                   join_sort_style(make_lineitem(), make_orders()))
+            with daft_tpu.execution_config_ctx(query_timeout_s=timeout):
+                got = (q1_style(make_lineitem()),
+                       join_sort_style(make_lineitem(), make_orders()))
+    except DaftTimeoutError as e:
+        if timeout is None:
+            raise AssertionError(
+                f"DaftTimeoutError with NO deadline armed under {spec!r}: {e}")
+        return (f"query hit its {timeout}s deadline cleanly "
+                f"(progress: {e.progress.get('completed')}"
+                f"/{e.progress.get('total')})")
     except DaftError as e:
         # A spec can legitimately exceed the attempt/recovery budget (e.g.
         # shuffle.fetch:raise on a hit that repeats across retries is handled;
@@ -157,14 +184,17 @@ def main() -> int:
     failures = 0
     for i, spec in enumerate(specs):
         t0 = time.time()
+        deadline = DEADLINE_S if (i + 1) % DEADLINE_EVERY == 0 else None
         try:
-            note = run_round(spec, seed=args.seed + i, baseline=baseline)
+            note = run_round(spec, seed=args.seed + i, baseline=baseline,
+                             timeout=deadline)
         except Exception as e:  # divergence or engine crash
             failures += 1
             print(f"[round {i}] FAIL  seed={args.seed + i} spec={spec!r}: {e}")
             continue
         status = "survived" if note is None else note
-        print(f"[round {i}] ok ({time.time() - t0:.1f}s) spec={spec!r} — {status}")
+        dl = f" deadline={deadline}s" if deadline else ""
+        print(f"[round {i}] ok ({time.time() - t0:.1f}s) spec={spec!r}{dl} — {status}")
     print(f"\n{len(specs) - failures}/{len(specs)} rounds ok")
     return 1 if failures else 0
 
