@@ -245,7 +245,89 @@ def _bench_engine(num_images: int, batch_size: int, cpu: bool) -> dict:
     return rec
 
 
+# ------------------------------------------------------------------ #
+# Metrics-plane overhead guard (ISSUE 5 satellite)                     #
+# ------------------------------------------------------------------ #
+# A TPC-H-style relational loop (scan -> filter -> join -> groupby ->
+# sort), timed with the metrics plane enabled vs DAFT_METRICS=0. The
+# instrumented hot paths (morsel counters, permit gates, IO counters,
+# dispatcher gauges) must cost < 2% — otherwise the measurement plane is
+# eating the goodput it exists to protect.
+METRICS_OVERHEAD_LIMIT_PCT = float(
+    os.environ.get("DAFT_METRICS_OVERHEAD_LIMIT_PCT", "2.0"))
+_TPCH_CHILD = r"""
+import json, sys, time
+import numpy as np
+import daft_tpu
+from daft_tpu import col
+
+n = int(sys.argv[1]); reps = int(sys.argv[2])
+rng = np.random.default_rng(0)
+orders = daft_tpu.from_pydict({
+    "o_key": np.arange(n, dtype=np.int64).tolist(),
+    "o_cust": rng.integers(0, n // 8, n).tolist(),
+    "o_total": rng.random(n).tolist()})
+cust = daft_tpu.from_pydict({
+    "c_key": np.arange(n // 8, dtype=np.int64).tolist(),
+    "c_seg": rng.integers(0, 5, n // 8).tolist()})
+
+def loop():
+    q = (orders.where(col("o_total") > 0.2)
+         .join(cust, left_on="o_cust", right_on="c_key")
+         .groupby("c_seg").agg(col("o_total").sum().alias("rev"))
+         .sort("rev", desc=True))
+    return q.to_pydict()
+
+loop()  # warm caches/JIT before timing
+times = []
+for _ in range(reps):
+    t0 = time.perf_counter(); loop(); times.append(time.perf_counter() - t0)
+print(json.dumps({"best_s": min(times)}))
+"""
+
+
+def metrics_overhead_check(n: int = 400_000, reps: int = 7,
+                           rounds: int = 3) -> dict:
+    """Compare best-of-N loop times with DAFT_METRICS on vs off, each config
+    in fresh subprocesses (the registry reads the env once per process).
+    Single runs on a shared box vary 2x process-to-process, so the configs
+    run INTERLEAVED over several rounds and the best time per config wins —
+    the minimum is the only estimator whose noise shrinks with samples."""
+
+    def run(enabled: bool) -> float:
+        env = dict(os.environ, JAX_PLATFORMS="cpu",
+                   DAFT_METRICS="1" if enabled else "0")
+        proc = subprocess.run(
+            [sys.executable, "-c", _TPCH_CHILD, str(n), str(reps)],
+            capture_output=True, text=True, env=env, timeout=600,
+            cwd=os.path.dirname(os.path.abspath(__file__)))
+        if proc.returncode != 0:
+            raise RuntimeError(f"overhead child failed:\n{proc.stderr[-2000:]}")
+        return json.loads(proc.stdout.strip().splitlines()[-1])["best_s"]
+
+    offs, ons = [], []
+    for _ in range(rounds):  # alternate so load/thermal drift hits both
+        offs.append(run(False))
+        ons.append(run(True))
+    off, on = min(offs), min(ons)
+    pct = (on - off) / off * 100.0 if off > 0 else 0.0
+    return {"metric": "metrics_overhead_pct", "value": round(pct, 3),
+            "unit": "% vs DAFT_METRICS=0", "enabled_s": round(on, 4),
+            "disabled_s": round(off, 4),
+            "limit_pct": METRICS_OVERHEAD_LIMIT_PCT,
+            "ok": pct < METRICS_OVERHEAD_LIMIT_PCT}
+
+
 def main() -> None:
+    if len(sys.argv) > 1 and sys.argv[1] == "--metrics-overhead":
+        rec = metrics_overhead_check()
+        print(json.dumps(rec))
+        if not rec["ok"]:
+            sys.stderr.write(
+                f"metrics plane overhead {rec['value']}% exceeds "
+                f"{rec['limit_pct']}% budget\n")
+            sys.exit(1)
+        return
     if len(sys.argv) > 1 and sys.argv[1].startswith("--child="):
         mode = sys.argv[1].split("=", 1)[1]
         opts = dict(a.lstrip("-").split("=", 1) for a in sys.argv[2:])

@@ -115,6 +115,13 @@ class ExecutionConfig:
     circuit_open_base_s: float = 1.0
     circuit_open_cap_s: float = 30.0
     circuit_half_open_probes: int = 1
+    # Metrics plane (daft_tpu/metrics.py). The registry gates itself on
+    # DAFT_METRICS at first use; metrics_enabled=False on the ACTIVE config
+    # additionally disables it process-wide at the first event notify (one
+    # plane per process, not per query). metrics_export_path is the config
+    # spelling of DAFT_METRICS_FILE (OTLP-JSON resourceMetrics lines).
+    metrics_enabled: bool = True
+    metrics_export_path: Optional[str] = None
 
     def with_changes(self, **kwargs) -> "ExecutionConfig":
         return dataclasses.replace(self, **kwargs)
@@ -138,4 +145,8 @@ class ExecutionConfig:
             changes["speculative_execution"] = True
         if os.environ.get("DAFT_QUERY_TIMEOUT_S"):
             changes["query_timeout_s"] = float(os.environ["DAFT_QUERY_TIMEOUT_S"])
+        if not daft_env_flag("DAFT_METRICS", True):
+            changes["metrics_enabled"] = False
+        if os.environ.get("DAFT_METRICS_FILE"):
+            changes["metrics_export_path"] = os.environ["DAFT_METRICS_FILE"]
         return cfg.with_changes(**changes) if changes else cfg

@@ -57,9 +57,11 @@ class DaftContext:
         return list(self._subscribers)
 
     def notify(self, event) -> None:
+        from daft_tpu.metrics import maybe_enable_metrics
         from daft_tpu.tracing import maybe_enable_tracing
 
         maybe_enable_tracing(self)
+        maybe_enable_metrics(self)
         for s in self.subscribers():
             try:
                 s.on_event(event)

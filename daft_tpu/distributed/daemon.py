@@ -160,11 +160,17 @@ class WorkerDaemon:
                 op = msg.get("op")
                 if op == "ping":
                     # Doubles as the heartbeat channel: drivers probe with a
-                    # short deadline and count silence as a missed beat.
+                    # short deadline and count silence as a missed beat. The
+                    # registry snapshot piggybacks on the same frame, so
+                    # worker metrics reach the driver at heartbeat cadence
+                    # with zero extra connections.
+                    from daft_tpu.metrics import get_registry
+
                     _send_frame(conn, cloudpickle.dumps(
                         {"ok": True, "worker_id": self.worker_id,
                          "slots": self.slots, "flight": self.flight_address,
-                         "active": self._active}))
+                         "active": self._active,
+                         "metrics": get_registry().to_wire()}))
                 elif op == "run_task":
                     # The pool caps concurrent executions at `slots` even
                     # with many connections (per-chip ownership on TPU hosts).
@@ -228,7 +234,10 @@ class WorkerDaemon:
                 refs.append({"kind": "flight", "address": self.flight_address,
                              "ticket": ticket, "rows": len(p),
                              "bytes": p.size_bytes(), "worker_id": self.worker_id})
-            return {"ok": True, "refs": refs, "stats": stats.to_wire()}
+            from daft_tpu.metrics import get_registry
+
+            return {"ok": True, "refs": refs, "stats": stats.to_wire(),
+                    "metrics": get_registry().to_wire()}
         except BaseException as e:  # noqa: BLE001
             import traceback
 
@@ -343,8 +352,14 @@ class RemoteWorker(Worker):
                 # re-emit on the driver (reference: the remote event-log sink
                 # forwarding worker events, daft/runners/flotilla.py:171-176).
                 from daft_tpu.execution.resource_manager import emit_operator_stats
+                from daft_tpu.metrics import get_registry
 
                 emit_operator_stats(task.query_id, reply.get("stats"))
+                # revive=False: a reply racing this worker's death on a
+                # still-open connection must not un-stale it.
+                get_registry().merge_worker_wire(self.worker_id,
+                                                 reply.get("metrics"),
+                                                 revive=False)
                 return [decode_ref(d) for d in reply["refs"]]
             finally:
                 with self._lock:
@@ -375,7 +390,14 @@ class RemoteWorker(Worker):
         cannot answer within 2s counts as a missed beat (the monitor marks it
         dead only after ``heartbeat_miss_threshold`` consecutive misses)."""
         try:
-            self._request({"op": "ping"}, timeout=2.0)
+            info = self._request({"op": "ping"}, timeout=2.0)
+            # The worker's cumulative registry snapshot rides the heartbeat
+            # (ISSUE 5): merge under this worker's id so driver-side scrapes
+            # see per-worker series without a second wire.
+            from daft_tpu.metrics import get_registry
+
+            get_registry().merge_worker_wire(self.worker_id,
+                                             info.get("metrics"))
             return True
         except Exception:
             # False IS the classification here: the heartbeat monitor counts

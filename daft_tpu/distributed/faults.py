@@ -300,8 +300,12 @@ def fault_scope(spec: Union[str, FaultInjector, List[FaultSpec]],
     finally:
         _INJECTOR = prev
         from daft_tpu.io.circuit import reset_circuit_breakers
+        from daft_tpu.metrics import get_registry
 
         reset_circuit_breakers()
+        # Staleness marks from INJECTED kills describe a simulated outage;
+        # leaving them would suppress the next healthy run's worker series.
+        get_registry().clear_stale_workers()
 
 
 def maybe_inject(point: str, **ctx) -> Optional[str]:

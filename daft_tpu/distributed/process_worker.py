@@ -111,8 +111,14 @@ def _worker_entry(fd: int) -> None:
                 out = list(executor.run(bound))
             parts = collect_task_outputs(out, expect, fragment.schema)
             blobs = [serialize_partition(p) for p in parts]
+            from daft_tpu.metrics import get_registry
+
+            # The child's cumulative registry snapshot rides the task reply
+            # (this wire IS the heartbeat surface for process workers —
+            # liveness is proc.poll(), which carries no payload).
             _send_frame(sock, cloudpickle.dumps(
-                {"ok": True, "parts": blobs, "stats": stats.to_wire()}))
+                {"ok": True, "parts": blobs, "stats": stats.to_wire(),
+                 "metrics": get_registry().to_wire()}))
         except BaseException as e:  # noqa: BLE001
             import traceback
 
@@ -231,8 +237,12 @@ class ProcessWorker(Worker):
                     from daft_tpu.execution.resource_manager import (
                         emit_operator_stats,
                     )
+                    from daft_tpu.metrics import get_registry
 
                     emit_operator_stats(task.query_id, result.get("stats"))
+                    get_registry().merge_worker_wire(self.worker_id,
+                                                     result.get("metrics"),
+                                                     revive=False)
                     return [
                         LocalPartitionRef(deserialize_partition(blob), self.worker_id)
                         for blob in result["parts"]

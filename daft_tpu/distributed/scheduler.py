@@ -41,6 +41,7 @@ from concurrent.futures import (
 from dataclasses import dataclass
 from typing import Callable, Dict, List, Optional, Sequence, Set
 
+from daft_tpu import metrics
 from daft_tpu.distributed.faults import maybe_inject
 from daft_tpu.distributed.partition_ref import PartitionFetchError, PartitionRef
 from daft_tpu.distributed.task import Task
@@ -229,6 +230,8 @@ class Dispatcher:
             fut = worker.submit(task)
             inflight[fut] = _Attempt(rec_idx, task, attempt, worker,
                                      time.monotonic(), speculative)
+            if speculative:
+                metrics.SPECULATIONS.inc()
             fut.add_done_callback(lambda _f: wake.set())
 
         def progress_snapshot() -> dict:
@@ -271,6 +274,7 @@ class Dispatcher:
             if backoff:
                 not_before = time.monotonic() + min(
                     backoff_base * (2 ** rec.attempt), backoff_cap)
+            metrics.TASK_RETRIES.labels(reason).inc()
             notify(TaskRetried(query_id=rec.task.query_id, task_id=rec.task.task_id,
                                worker_id=worker_id, attempt=attempt, reason=reason))
             pending.append(_Pending(rec.idx, rec.task, attempt, not_before))
@@ -280,8 +284,21 @@ class Dispatcher:
         # the top of the loop still has to run (and raise). The try/finally
         # unhooks the wake listeners from the LONG-LIVED manager/token on
         # every exit path (the manager outlives this query).
+        # Queue-depth gauges are shared across concurrent queries, so each
+        # run contributes its DELTA (and withdraws it on exit) rather than
+        # set()-ing absolutes — query A finishing must not zero out query
+        # B's still-running depth.
+        gauged_pending = gauged_inflight = 0
+
+        def update_gauges() -> None:
+            nonlocal gauged_pending, gauged_inflight
+            metrics.DISPATCH_PENDING.inc(len(pending) - gauged_pending)
+            metrics.DISPATCH_INFLIGHT.inc(len(inflight) - gauged_inflight)
+            gauged_pending, gauged_inflight = len(pending), len(inflight)
+
         try:
             while pending or inflight or failure is not None:
+                update_gauges()
                 # ---- cancellation check -------------------------------------
                 # Deadline expiry / user cancel aborts through the SAME drain
                 # path as a task failure: checked before submitting more work.
@@ -407,10 +424,13 @@ class Dispatcher:
                                         w.worker_id, reason="worker-died")
 
                             f2.add_done_callback(_observe)
+                    elapsed = time.monotonic() - att.t0
+                    metrics.TASKS_COMPLETED.labels(att.worker.worker_id).inc()
+                    metrics.TASK_DURATION.observe(elapsed)
                     notify(TaskCompleted(
                         query_id=att.task.query_id, task_id=att.task.task_id,
                         worker_id=att.worker.worker_id,
-                        duration_s=time.monotonic() - att.t0, error=err))
+                        duration_s=elapsed, error=err))
                     if exc is None:
                         continue
                     failure = self._handle_attempt_failure(
@@ -491,6 +511,8 @@ class Dispatcher:
                             failure = DaftExecutionError(f"speculation failed: {e}")
                             failure.__cause__ = e
         finally:
+            metrics.DISPATCH_PENDING.inc(-gauged_pending)
+            metrics.DISPATCH_INFLIGHT.inc(-gauged_inflight)
             self.scheduler.manager.remove_death_listener(on_death)
             if token is not None:
                 token.remove_listener(wake.set)

@@ -383,6 +383,11 @@ class HeartbeatMonitor:
                                exc_info=True)
                     alive = False
             if alive:
+                from daft_tpu import metrics
+
+                if metrics.get_registry().enabled:
+                    metrics.HEARTBEATS.labels(w.worker_id).inc()
+                    metrics.WORKER_UP.labels(w.worker_id).set(1)
                 self._misses.pop(w.worker_id, None)
                 continue
             n = self._misses.get(w.worker_id, 0) + 1

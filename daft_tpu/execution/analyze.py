@@ -1,10 +1,14 @@
 """EXPLAIN ANALYZE instrumentation: run a query and report row/wall counts
 plus engine-health deltas — per-operator stats, device-eval fusion coverage
-(VERDICT r4 weak #3), and out-of-core spill volume.
+(VERDICT r4 weak #3), out-of-core spill volume, IO traffic, and memory
+permit pressure.
 
 Reference seam: the reference's explain(analyze) attaches runtime stats to
 the plan text (src/daft-local-execution runtime_stats + EXPLAIN ANALYZE in
-daft-sql); device/spill coverage are this engine's TPU-first extensions.
+daft-sql). All deltas come from ONE before/after pair of unified-registry
+snapshots (daft_tpu/metrics.py) instead of the three bespoke snapshot
+objects this module used to juggle — anything the registry learns to count
+shows up here for free.
 """
 
 from __future__ import annotations
@@ -14,31 +18,46 @@ import time
 
 def analyze_suffix(df) -> str:
     """Collect ``df`` and format the '== Analyze ==' plan-text suffix."""
-    from daft_tpu.execution.spill import spill_metrics
-    from daft_tpu.ops.device_eval import device_eval_metrics
+    from daft_tpu.metrics import get_registry
 
-    dev0 = device_eval_metrics.snapshot()
-    sp0 = spill_metrics.snapshot()
+    reg = get_registry()
+    s0 = reg.snapshot()
     t0 = time.perf_counter()
     df.collect()
     wall = time.perf_counter() - t0
-    dev1 = device_eval_metrics.snapshot()
-    sp1 = spill_metrics.snapshot()
+    s1 = reg.snapshot()
+
+    def d(name: str) -> float:
+        return s1.counter_total(name) - s0.counter_total(name)
+
     rows = sum(len(p) for p in df._result or [])
     lines = [f"\n== Analyze ==\nrows: {rows}, wall: {wall:.4f}s"]
-    fused = dev1["fused_exprs"] - dev0["fused_exprs"]
-    fused_rows = dev1["fused_rows"] - dev0["fused_rows"]
-    reasons = {
-        k: dev1["fallback_reasons"].get(k, 0) - dev0["fallback_reasons"].get(k, 0)
-        for k in dev1["fallback_reasons"]
-    }
-    reasons = {k: v for k, v in reasons.items() if v}
+    fused = int(d("daft_device_fused_exprs_total"))
+    fused_rows = int(d("daft_device_fused_rows_total"))
+    before = s0.label_totals("daft_device_fallback_exprs_total", "reason")
+    after = s1.label_totals("daft_device_fallback_exprs_total", "reason")
+    reasons = {k: int(v - before.get(k, 0)) for k, v in after.items()
+               if v - before.get(k, 0)}
     lines.append(f"device eval: fused_exprs={fused}, fused_rows={fused_rows}"
                  + (f", fallbacks={reasons}" if reasons else ""))
-    spilled = sp1["bytes_spilled"] - sp0["bytes_spilled"]
+    spilled = int(d("daft_spill_bytes_total"))
     if spilled:
         lines.append(f"spill: bytes={spilled}, "
-                     f"files={sp1['files'] - sp0['files']}")
+                     f"files={int(d('daft_spill_files_total'))}")
+    io_bytes = int(d("daft_io_bytes_total"))
+    io_reqs = int(d("daft_io_requests_total"))
+    if io_bytes or io_reqs:
+        line = f"io: bytes={io_bytes}, requests={io_reqs}"
+        retries = int(d("daft_io_retries_total"))
+        if retries:
+            line += f", retries={retries}"
+        lines.append(line)
+    h0 = s0.hist("daft_memory_permit_wait_seconds")
+    h1 = s1.hist("daft_memory_permit_wait_seconds")
+    waits = int(h1["count"] - h0["count"])
+    if waits:
+        lines.append(f"memory permits: waits={waits}, "
+                     f"wait_s={h1['sum'] - h0['sum']:.4f}")
     ops = getattr(df, "metrics", None)
     if callable(ops):
         m = df.metrics()

@@ -259,6 +259,12 @@ class Executor:
         attributed independently."""
         import time as _time
 
+        from daft_tpu import metrics
+
+        # Children resolved ONCE per operator iterator, not per morsel: the
+        # hot loop below pays one method call + one lock-cheap add.
+        morsels = metrics.MORSELS.labels(op)
+        morsel_rows = metrics.MORSEL_ROWS.labels(op)
         stack = getattr(self._op_stacks, "stack", None)
         if stack is None:
             stack = self._op_stacks.stack = []
@@ -276,6 +282,8 @@ class Executor:
                 if stack and stack[-1] is entry:
                     stack.pop()
             dt = _time.perf_counter_ns() - t0
+            morsels.inc()
+            morsel_rows.inc(len(mp))
             self.stats.record(op, rows_out=len(mp), cpu_ns=dt)
             if stack:
                 # Parent's timed region includes ours: remove the double count

@@ -58,11 +58,14 @@ class MemoryManager:
                     self._cond.notify_all()
 
             token.add_listener(woken)
+        wait_t0: Optional[float] = None
         try:
             with self._cond:
                 my_gen = self._poison_gen
                 deadline = None if timeout is None else time.monotonic() + timeout
                 while self._used + request > self.limit:
+                    if wait_t0 is None:
+                        wait_t0 = time.monotonic()
                     if self._poison_gen > my_gen and self._poison_exc is not None:
                         # Scoped blast radius: a waiter carrying a LIVE
                         # token of a DIFFERENT query is not this poison's
@@ -90,12 +93,19 @@ class MemoryManager:
         finally:
             if woken is not None:
                 token.remove_listener(woken)
+            if wait_t0 is not None:
+                from daft_tpu import metrics
+
+                metrics.PERMIT_WAIT.observe(time.monotonic() - wait_t0)
 
     def poison(self, exc: BaseException, query_id: Optional[str] = None) -> None:
         """Fail waiters CURRENTLY blocked in :meth:`acquire` with ``exc``
         (the executor's abort path). With ``query_id``, only waiters of that
         query (or token-less waiters) raise — concurrent healthy queries
         keep waiting. Future acquires are unaffected (generation-scoped)."""
+        from daft_tpu import metrics
+
+        metrics.MEMORY_POISON.inc()
         with self._cond:
             self._poison_gen += 1
             self._poison_exc = exc

@@ -27,7 +27,6 @@ from __future__ import annotations
 import os
 import shutil
 import tempfile
-import threading
 import uuid
 from contextlib import contextmanager
 from dataclasses import dataclass, field
@@ -45,30 +44,35 @@ _MARKER = "__daft_run_marker__"
 
 
 class SpillMetrics:
-    """Process-global spill counters (test- and explain(analyze)-visible)."""
-
-    def __init__(self):
-        self._lock = threading.Lock()
-        self.bytes_spilled = 0
-        self.files = 0
-        self.spills = 0  # number of sink-level spill events (runs/buckets flushed)
+    """Thin shim over the unified registry (daft_tpu/metrics.py): spill
+    counters live as ``daft_spill_*_total`` series; this object keeps the
+    historical ``record/snapshot/reset`` call-site surface (tests,
+    explain(analyze), dashboard) working on top of them."""
 
     def record(self, nbytes: int, nfiles: int = 1) -> None:
-        with self._lock:
-            self.bytes_spilled += nbytes
-            self.files += nfiles
-            self.spills += 1
+        from daft_tpu import metrics
+
+        metrics.SPILL_BYTES.inc(nbytes)
+        metrics.SPILL_FILES.inc(nfiles)
+        metrics.SPILL_EVENTS.inc()
 
     def reset(self) -> None:
-        with self._lock:
-            self.bytes_spilled = 0
-            self.files = 0
-            self.spills = 0
+        from daft_tpu import metrics
+
+        reg = metrics.get_registry()
+        for name in ("daft_spill_bytes_total", "daft_spill_files_total",
+                     "daft_spill_events_total"):
+            reg.reset(name)
 
     def snapshot(self) -> dict:
-        with self._lock:
-            return {"bytes_spilled": self.bytes_spilled, "files": self.files,
-                    "spills": self.spills}
+        from daft_tpu import metrics
+
+        snap = metrics.get_registry().snapshot()
+        return {
+            "bytes_spilled": int(snap.counter_total("daft_spill_bytes_total")),
+            "files": int(snap.counter_total("daft_spill_files_total")),
+            "spills": int(snap.counter_total("daft_spill_events_total")),
+        }
 
 
 spill_metrics = SpillMetrics()

@@ -92,46 +92,59 @@ class CircuitBreaker:
         through once the backoff elapses."""
         from daft_tpu.distributed.faults import maybe_inject
 
+        from daft_tpu.metrics import record_circuit_state
+
         maybe_inject("io.circuit", endpoint=self.endpoint)
-        with self._lock:
-            if self._state == CLOSED:
-                return
-            now = time.monotonic()
-            if self._state == OPEN:
-                if now < self._probe_at:
-                    wait_s = self._probe_at - now
-                    raise DaftCircuitOpenError(
-                        f"circuit open for {self.endpoint} "
-                        f"({self._consecutive_failures} consecutive "
-                        f"failures; probe in {wait_s:.2f}s)",
-                        endpoint=self.endpoint)
-                self._state = HALF_OPEN
-                self._probes_inflight = 0
-            # HALF_OPEN: recovery is PROBED, not stampeded — admit only the
-            # configured probe quota, fail the rest fast. The quota re-arms
-            # once the probe window passes WITHOUT an outcome: a probe whose
-            # caller never reports back (cancelled query, non-retryable
-            # error, abandoned stream) must not wedge the breaker half-open
-            # forever.
-            if self._probes_inflight >= self.half_open_probes:
-                if now < self._probe_window_until:
-                    raise DaftCircuitOpenError(
-                        f"circuit half-open for {self.endpoint}: probe quota "
-                        f"in flight", endpoint=self.endpoint)
-                self._probes_inflight = 0  # probe vanished: re-arm
-            self._probes_inflight += 1
-            self._probe_window_until = now + max(self.open_base_s, 0.1)
+        became_half_open = False
+        try:
+            with self._lock:
+                if self._state == CLOSED:
+                    return
+                now = time.monotonic()
+                if self._state == OPEN:
+                    if now < self._probe_at:
+                        wait_s = self._probe_at - now
+                        raise DaftCircuitOpenError(
+                            f"circuit open for {self.endpoint} "
+                            f"({self._consecutive_failures} consecutive "
+                            f"failures; probe in {wait_s:.2f}s)",
+                            endpoint=self.endpoint)
+                    self._state = HALF_OPEN
+                    self._probes_inflight = 0
+                    became_half_open = True
+                # HALF_OPEN: recovery is PROBED, not stampeded — admit only
+                # the configured probe quota, fail the rest fast. The quota
+                # re-arms once the probe window passes WITHOUT an outcome: a
+                # probe whose caller never reports back (cancelled query,
+                # non-retryable error, abandoned stream) must not wedge the
+                # breaker half-open forever.
+                if self._probes_inflight >= self.half_open_probes:
+                    if now < self._probe_window_until:
+                        raise DaftCircuitOpenError(
+                            f"circuit half-open for {self.endpoint}: probe "
+                            f"quota in flight", endpoint=self.endpoint)
+                    self._probes_inflight = 0  # probe vanished: re-arm
+                self._probes_inflight += 1
+                self._probe_window_until = now + max(self.open_base_s, 0.1)
+        finally:
+            if became_half_open:
+                record_circuit_state(self.endpoint, HALF_OPEN)
 
     def reset(self) -> None:
         """Force back to a pristine CLOSED state (no events). Used when the
         observed failures are known to be simulated (fault_scope exit)."""
         with self._lock:
+            was_closed = self._state == CLOSED
             self._state = CLOSED
             self._consecutive_failures = 0
             self._open_count = 0
             self._probes_inflight = 0
             self._probe_at = 0.0
             self._probe_window_until = 0.0
+        if not was_closed:
+            from daft_tpu.metrics import record_circuit_state
+
+            record_circuit_state(self.endpoint, CLOSED)
 
     def record_success(self) -> None:
         closed = False
@@ -143,6 +156,9 @@ class CircuitBreaker:
                 self._probes_inflight = 0
                 closed = True
         if closed:
+            from daft_tpu.metrics import record_circuit_state
+
+            record_circuit_state(self.endpoint, CLOSED)
             self._notify_closed()
 
     def record_failure(self) -> None:
@@ -166,6 +182,9 @@ class CircuitBreaker:
                 self._probe_at = time.monotonic() + delay
                 opened, failures = delay, self._consecutive_failures
         if opened:
+            from daft_tpu.metrics import record_circuit_state
+
+            record_circuit_state(self.endpoint, OPEN)
             self._notify_opened(failures, opened)
 
     # -- events -----------------------------------------------------------

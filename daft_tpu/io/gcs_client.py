@@ -115,14 +115,31 @@ class GCSClient:
             hdrs.update(self._auth_headers())
             req = urllib.request.Request(full, data=body_arg,
                                          headers=hdrs, method=method)
+            t0 = time.perf_counter()
             try:
                 with urllib.request.urlopen(req, timeout=60) as resp:
-                    return resp.status, resp.read(), dict(resp.headers)
+                    body = resp.read()
+                    dt = time.perf_counter() - t0
+                    # Count EVERY control-plane verb (list/metadata/delete/
+                    # resumable chunks), like the S3 client — per-endpoint
+                    # request series must not undercount gs:// workloads.
+                    if method in ("PUT", "POST"):
+                        IO_STATS.count_put(len(payload), dt,
+                                           endpoint=self.endpoint, verb=method)
+                    else:
+                        IO_STATS.count_get(len(body), dt,
+                                           endpoint=self.endpoint, verb=method)
+                    return resp.status, body, dict(resp.headers)
             except urllib.error.HTTPError as e:
                 body = e.read()
                 if e.code == 308:
                     # Resumable-upload "Resume Incomplete" — a success
                     # sentinel, not an error (urllib has no 308 handler).
+                    # Count it like the 2xx path: intermediate chunks are
+                    # real uploaded bytes, not failures.
+                    IO_STATS.count_put(len(payload),
+                                       time.perf_counter() - t0,
+                                       endpoint=self.endpoint, verb=method)
                     return e.code, body, dict(e.headers)
                 if e.code == 401 and self.provider is not None:
                     # Token revoked/expired server-side before our local
@@ -148,7 +165,8 @@ class GCSClient:
         return with_retries(
             attempt, self.policy, describe=f"GCS {method} {full}",
             is_retryable=lambda e: isinstance(e, DaftTransientError),
-            on_retry=IO_STATS.count_retry, breaker=self.breaker)
+            on_retry=lambda: IO_STATS.count_retry(endpoint=self.endpoint),
+            breaker=self.breaker)
 
     # ------------------------------------------------------------------ #
     def get_object(self, bucket: str, key: str, start: Optional[int] = None,
@@ -161,10 +179,8 @@ class GCSClient:
         if start is not None:
             end = "" if length is None else str(start + length - 1)
             headers["Range"] = f"bytes={start}-{end}"
-        t0 = time.perf_counter()
         _, body, _ = self._request("GET", self._object_url(bucket, key),
                                    query={"alt": "media"}, headers=headers)
-        IO_STATS.count_get(len(body), time.perf_counter() - t0)
         return body
 
     def object_metadata(self, bucket: str, key: str) -> dict:
@@ -204,7 +220,6 @@ class GCSClient:
         """Simple media upload below the resumable threshold; chunked
         resumable session above it (reference: google_cloud.rs writes +
         multipart.rs part sizing)."""
-        t0 = time.perf_counter()
         if data and len(data) >= self.resumable_threshold:
             self._resumable_upload(bucket, key, data)
         else:
@@ -212,7 +227,6 @@ class GCSClient:
                 "POST", self._object_url(bucket, key, upload=True),
                 query={"uploadType": "media", "name": key}, payload=data,
                 headers={"Content-Type": "application/octet-stream"})
-        IO_STATS.count_put(len(data), time.perf_counter() - t0)
 
     def _resumable_upload(self, bucket: str, key: str, data: bytes) -> None:
         _, _, headers = self._request(

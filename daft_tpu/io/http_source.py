@@ -77,14 +77,16 @@ def http_head(url: str, policy: Optional[RetryPolicy] = None,
                 size = rng.rsplit("/", 1)[-1] if "/" in rng else None
                 out = {"size": int(size) if size and size != "*" else None,
                        "final_url": resp.geturl()}
-        IO_STATS.count_get(0, _time.perf_counter() - t0)
+        IO_STATS.count_get(0, _time.perf_counter() - t0,
+                           endpoint=endpoint_of(url), verb="HEAD")
         return out
 
-    from daft_tpu.io.circuit import breaker_for_url
+    from daft_tpu.io.circuit import breaker_for_url, endpoint_of
 
     return with_retries(attempt, policy, describe=f"HEAD {url}",
                         is_retryable=lambda e: _is_retryable(e, policy),
-                        on_retry=IO_STATS.count_retry,
+                        on_retry=lambda: IO_STATS.count_retry(
+                            endpoint=endpoint_of(url)),
                         breaker=breaker_for_url(url))
 
 
@@ -109,14 +111,16 @@ def http_get(url: str, start: Optional[int] = None,
             # slice locally so callers still get exactly the range.
             if start is not None and getattr(resp, "status", 206) == 200:
                 data = data[start:start + length] if length is not None else data[start:]
-        IO_STATS.count_get(len(data), _time.perf_counter() - t0)
+        IO_STATS.count_get(len(data), _time.perf_counter() - t0,
+                           endpoint=endpoint_of(url))
         return data
 
-    from daft_tpu.io.circuit import breaker_for_url
+    from daft_tpu.io.circuit import breaker_for_url, endpoint_of
 
     return with_retries(attempt, policy, describe=f"GET {url}",
                         is_retryable=lambda e: _is_retryable(e, policy),
-                        on_retry=IO_STATS.count_retry,
+                        on_retry=lambda: IO_STATS.count_retry(
+                            endpoint=endpoint_of(url)),
                         breaker=breaker_for_url(url))
 
 

@@ -31,31 +31,50 @@ class IOStatsSnapshot:
 
 
 class IOStats:
-    """Process-wide thread-safe IO counters (reference: daft-io IOStatsRef)."""
+    """Process-wide thread-safe IO counters (reference: daft-io IOStatsRef).
+
+    Every count also feeds the unified registry (daft_tpu/metrics.py
+    ``daft_io_*`` series) — callers that know their endpoint pass it so the
+    Prometheus/OTLP exports break requests/bytes/latency out per origin;
+    legacy callers fall back to the shared ``unattributed`` series."""
 
     def __init__(self):
         self._lock = threading.Lock()
         self._s = IOStatsSnapshot()
 
-    def count_get(self, nbytes: int = 0, seconds: float = 0.0) -> None:
+    def count_get(self, nbytes: int = 0, seconds: float = 0.0,
+                  endpoint: Optional[str] = None,
+                  verb: str = "GET") -> None:
         with self._lock:
             self._s.gets += 1
             self._s.bytes_read += nbytes
             self._s.read_time_s += seconds
+        from daft_tpu.metrics import record_io
 
-    def count_put(self, nbytes: int = 0, seconds: float = 0.0) -> None:
+        record_io(endpoint or "unattributed", verb, nbytes, seconds, "read")
+
+    def count_put(self, nbytes: int = 0, seconds: float = 0.0,
+                  endpoint: Optional[str] = None,
+                  verb: str = "PUT") -> None:
         with self._lock:
             self._s.puts += 1
             self._s.bytes_written += nbytes
             self._s.write_time_s += seconds
+        from daft_tpu.metrics import record_io
+
+        record_io(endpoint or "unattributed", verb, nbytes, seconds, "write")
 
     def count_open(self) -> None:
         with self._lock:
             self._s.files_opened += 1
 
-    def count_retry(self) -> None:
+    def count_retry(self, endpoint: Optional[str] = None) -> None:
         with self._lock:
             self._s.retries += 1
+        from daft_tpu import metrics
+
+        if metrics.get_registry().enabled:
+            metrics.IO_RETRIES.labels(endpoint or "unattributed").inc()
 
     def count_pruned(self, nfiles: int) -> None:
         with self._lock:
@@ -93,8 +112,11 @@ def read_range(path: str, start: int, length: int, io_config=None) -> bytes:
     with fs.open_input_file(p) as f:
         f.seek(start)
         data = f.read(length)
+    from daft_tpu.io.circuit import endpoint_of
+
     IO_STATS.count_open()
-    IO_STATS.count_get(len(data), time.perf_counter() - t0)
+    IO_STATS.count_get(len(data), time.perf_counter() - t0,
+                       endpoint=endpoint_of(path))
     return data
 
 

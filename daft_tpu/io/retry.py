@@ -147,6 +147,12 @@ def with_retries(fn: Callable, policy: RetryPolicy, *,
                 raise
             if on_retry is not None:
                 on_retry()
+            from daft_tpu import metrics
+
+            if metrics.get_registry().enabled:
+                metrics.RETRY_SLEEP.labels(
+                    breaker.endpoint if breaker is not None
+                    else "unattributed").observe(delay)
             if token is not None:
                 if token.wait(delay):
                     token.check(describe)  # woken by cancel: raise through it

@@ -79,11 +79,26 @@ class S3Client:
         body_arg = payload if (payload or method == "PUT") else None
 
         def attempt():
+            import time as _time
+
+            from daft_tpu.io.iostats import IO_STATS
+
             req = urllib.request.Request(full, data=body_arg,
                                          headers=hdrs, method=method)
+            t0 = _time.perf_counter()
             try:
                 with urllib.request.urlopen(req, timeout=60) as resp:
-                    return resp.status, resp.read(), dict(resp.headers)
+                    body = resp.read()
+                    dt = _time.perf_counter() - t0
+                    if method in ("PUT", "POST"):
+                        IO_STATS.count_put(len(payload), dt,
+                                           endpoint=self.endpoint,
+                                           verb=method)
+                    else:  # GET/HEAD/DELETE each get their own verb series
+                        IO_STATS.count_get(len(body), dt,
+                                           endpoint=self.endpoint,
+                                           verb=method)
+                    return resp.status, body, dict(resp.headers)
             except urllib.error.HTTPError as e:
                 body = e.read()
                 if e.code in self.policy.retryable_statuses:
@@ -105,7 +120,8 @@ class S3Client:
         return with_retries(
             attempt, self.policy, describe=f"S3 {method} {bucket}/{key}",
             is_retryable=lambda e: isinstance(e, DaftTransientError),
-            on_retry=IO_STATS.count_retry, breaker=self.breaker)
+            on_retry=lambda: IO_STATS.count_retry(endpoint=self.endpoint),
+            breaker=self.breaker)
 
     # ------------------------------------------------------------------ #
     def get_object(self, bucket: str, key: str, start: Optional[int] = None,

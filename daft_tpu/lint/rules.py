@@ -9,6 +9,7 @@
 | DTL005 | no per-element host<->device transfers in kernel hot loops       |
 | DTL006 | plan/partition construction never iterates bare sets             |
 | DTL007 | environment variables are read only in config.py / context.py    |
+| DTL008 | counters live on the metrics registry, not module-level dicts    |
 
 Each rule documents WHY the invariant exists — a lint error nobody can
 explain gets suppressed instead of fixed.
@@ -435,9 +436,69 @@ class EnvReadOutsideConfig(Rule):
                         "through daft_tpu.config.daft_env()")
 
 
+class AdHocCounterDict(Rule):
+    """DTL008: a module-level dict used as a metrics tally (``_TOKENS = {}``,
+    ``request_counts: Dict[...] = {}``) is invisible to the unified metrics
+    plane — it never exports over Prometheus/OTLP, never aggregates across
+    workers, and usually grows a bespoke lock + snapshot/reset trio that
+    daft_tpu/metrics.py already provides. New counters register on the
+    process registry (``metrics.get_registry().counter(...)``) instead.
+    Heuristic: flags module-level assignments of an empty dict /
+    ``defaultdict``/``Counter`` to an accumulator-named binding; genuine
+    object registries that happen to match get a baseline entry with a
+    reason."""
+
+    rule_id = "DTL008"
+    summary = "ad-hoc module-level counter dict"
+    exempt_files = ("daft_tpu/metrics.py",)
+
+    COUNTER_NAME = ("metrics", "counts", "counters", "tokens", "tally",
+                    "tallies", "stats", "totals", "usage")
+    DICT_FACTORIES = {"dict", "collections.defaultdict", "defaultdict",
+                      "collections.Counter", "collections.OrderedDict"}
+
+    def _counterish(self, name: str) -> bool:
+        return name.lower().lstrip("_").rsplit("_", 1)[-1] in self.COUNTER_NAME
+
+    def _is_dict_value(self, value: Optional[ast.expr],
+                      ctx: FileContext) -> bool:
+        if isinstance(value, ast.Dict) and not value.keys:
+            return True
+        if isinstance(value, ast.Call):
+            dotted = ctx.imports.resolve_call(value)
+            name = value.func.id if isinstance(value.func, ast.Name) else None
+            return dotted in self.DICT_FACTORIES or name in ("dict",
+                                                             "defaultdict",
+                                                             "Counter")
+        return False
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        if ctx.tree is None:
+            return
+        for node in getattr(ctx.tree, "body", ()):  # module level only
+            if isinstance(node, ast.Assign):
+                targets, value = node.targets, node.value
+            elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                targets, value = [node.target], node.value
+            else:
+                continue
+            if not self._is_dict_value(value, ctx):
+                continue
+            for t in targets:
+                if isinstance(t, ast.Name) and self._counterish(t.id):
+                    yield self.finding(
+                        ctx, node,
+                        f"module-level counter dict {t.id!r}: register a "
+                        f"labeled Counter/Gauge/Histogram on "
+                        f"daft_tpu.metrics.get_registry() instead, so the "
+                        f"tally exports over Prometheus/OTLP and aggregates "
+                        f"across workers")
+
+
 ALL_RULES = [WallClockInTaskPath, SwallowedException, UnseededRandomness,
              BlockingCallUnderLock, HostDeviceTransferInKernel,
-             NondeterministicIteration, EnvReadOutsideConfig]
+             NondeterministicIteration, EnvReadOutsideConfig,
+             AdHocCounterDict]
 
 
 def default_rules() -> List[Rule]:

@@ -53,47 +53,51 @@ _FUSABLE_UNARY = {"not", "negate", "abs"}
 
 
 class DeviceEvalMetrics:
-    """Process-global fusion counters (VERDICT r4 weak #3: fusion regressions
-    must be visible). Surfaced by explain(analyze=True) and DataFrame-level
-    tests; device-path exceptions additionally log ONCE per process instead
-    of failing silently."""
+    """Fusion-coverage counters (VERDICT r4 weak #3: fusion regressions must
+    be visible), now a thin shim over the unified registry
+    (daft_tpu/metrics.py ``daft_device_*`` series) so they export over
+    Prometheus/OTLP like every other engine counter. The historical
+    ``snapshot()`` dict shape (explain(analyze), dashboard, tests) is
+    preserved; device-path exceptions additionally log ONCE per process
+    instead of failing silently."""
 
-    def __init__(self):
-        import threading
-
-        self._lock = threading.Lock()
-        self.fused_exprs = 0
-        self.fused_rows = 0
-        self.fallback_reasons: Dict[str, int] = {}
-        self.device_errors = 0
+    _NAMES = ("daft_device_fused_exprs_total", "daft_device_fused_rows_total",
+              "daft_device_fallback_exprs_total", "daft_device_errors_total")
 
     def record_fused(self, nexprs: int, rows: int) -> None:
-        with self._lock:
-            self.fused_exprs += nexprs
-            self.fused_rows += rows * nexprs
+        from daft_tpu import metrics
+
+        metrics.DEVICE_FUSED_EXPRS.inc(nexprs)
+        metrics.DEVICE_FUSED_ROWS.inc(rows * nexprs)
 
     def record_fallback(self, reason: str, nexprs: int = 1) -> None:
-        with self._lock:
-            self.fallback_reasons[reason] = \
-                self.fallback_reasons.get(reason, 0) + nexprs
+        from daft_tpu import metrics
+
+        metrics.DEVICE_FALLBACKS.labels(reason).inc(nexprs)
 
     def record_device_error(self) -> None:
-        with self._lock:
-            self.device_errors += 1
+        from daft_tpu import metrics
+
+        metrics.DEVICE_ERRORS.inc()
 
     def snapshot(self) -> dict:
-        with self._lock:
-            return {"fused_exprs": self.fused_exprs,
-                    "fused_rows": self.fused_rows,
-                    "device_errors": self.device_errors,
-                    "fallback_reasons": dict(self.fallback_reasons)}
+        from daft_tpu import metrics
+
+        snap = metrics.get_registry().snapshot()
+        reasons = snap.label_totals("daft_device_fallback_exprs_total",
+                                    "reason")
+        return {"fused_exprs": int(snap.counter_total(self._NAMES[0])),
+                "fused_rows": int(snap.counter_total(self._NAMES[1])),
+                "device_errors": int(snap.counter_total(self._NAMES[3])),
+                "fallback_reasons": {k: int(v) for k, v in reasons.items()
+                                     if v}}
 
     def reset(self) -> None:
-        with self._lock:
-            self.fused_exprs = 0
-            self.fused_rows = 0
-            self.device_errors = 0
-            self.fallback_reasons = {}
+        from daft_tpu import metrics
+
+        reg = metrics.get_registry()
+        for name in self._NAMES:
+            reg.reset(name)
 
 
 device_eval_metrics = DeviceEvalMetrics()

@@ -76,6 +76,17 @@ async function renderWorkers() {
     `<tr><td>${esc(w.worker)}</td><td>${esc(w.query_id)}</td>
       <td>${w.tasks}</td><td>${w.busy_s.toFixed(2)}</td><td>${w.errors}</td></tr>`
   ).join("");
+  const m = await getJSON("/api/metrics");  // liveness + breaker state
+  $("#liveness tbody").innerHTML = m.workers.map((w) =>
+    `<tr><td>${esc(w.worker)}</td>
+      <td class="${w.status === "lost" ? "err" : "ok"}">${esc(w.status)}</td>
+      <td>${esc(w.reason || "")}</td></tr>`
+  ).join("");
+  $("#breakers tbody").innerHTML = m.breakers.map((b) =>
+    `<tr><td>${esc(b.endpoint)}</td>
+      <td class="${b.state === "open" ? "err" : "ok"}">${esc(b.state)}</td>
+      <td>${b.failures}</td><td>${(b.open_for_s || 0).toFixed(2)}</td></tr>`
+  ).join("");
 }
 
 async function renderDataframes() {

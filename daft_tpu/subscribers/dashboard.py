@@ -21,13 +21,17 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, List, Optional
 
 from daft_tpu.subscribers.events import (
+    CircuitClosed,
+    CircuitOpened,
     Event,
     OperatorStats,
     QueryEnd,
     QueryStart,
     Subscriber,
     TaskCompleted,
+    TaskRetried,
     TaskScheduled,
+    WorkerLost,
 )
 
 _ASSET_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "assets")
@@ -148,9 +152,34 @@ class DashboardState:
     def __init__(self):
         self._lock = threading.Lock()
         self.queries: Dict[str, dict] = {}
+        # Cross-query engine state: worker liveness + breaker state
+        # (reference: daft-dashboard engine.rs worker panel; ISSUE 5).
+        self.workers_live: Dict[str, dict] = {}
+        self.breakers: Dict[str, dict] = {}
+        self.retries_by_reason: Dict[str, int] = {}
 
     def on_event(self, e: Event) -> None:
         with self._lock:
+            if isinstance(e, WorkerLost):
+                self.workers_live[e.worker_id] = {
+                    "worker": e.worker_id, "status": "lost",
+                    "reason": e.reason, "since": time.time()}
+                return
+            if isinstance(e, TaskRetried):
+                self.retries_by_reason[e.reason] = \
+                    self.retries_by_reason.get(e.reason, 0) + 1
+                return
+            if isinstance(e, CircuitOpened):
+                self.breakers[e.endpoint] = {
+                    "endpoint": e.endpoint, "state": "open",
+                    "failures": e.failures, "open_for_s": e.open_for_s,
+                    "since": time.time()}
+                return
+            if isinstance(e, CircuitClosed):
+                self.breakers[e.endpoint] = {
+                    "endpoint": e.endpoint, "state": "closed",
+                    "failures": 0, "open_for_s": 0.0, "since": time.time()}
+                return
             if isinstance(e, QueryStart):
                 self.queries[e.query_id] = {
                     "query_id": e.query_id, "status": "running", "plan": e.plan,
@@ -164,6 +193,15 @@ class DashboardState:
                     q["duration_s"] = e.duration_s
                     q["error"] = e.error
             elif isinstance(e, (TaskScheduled, TaskCompleted)):
+                wid = e.worker_id or "local"
+                prev = self.workers_live.get(wid)
+                if prev is None or prev.get("status") != "lost":
+                    # Scheduling onto / completing on a worker is liveness
+                    # evidence. A LOST mark is sticky: dead workers never
+                    # run new tasks (a revived host gets a fresh worker id).
+                    self.workers_live[wid] = {
+                        "worker": wid, "status": "up", "reason": "",
+                        "since": time.time()}
                 q = self.queries.get(e.query_id)
                 if q and isinstance(e, TaskCompleted):
                     q["tasks"] += 1
@@ -212,6 +250,16 @@ class DashboardState:
                                 **w})
             return out
 
+    def worker_liveness(self) -> List[dict]:
+        with self._lock:
+            return sorted((dict(w) for w in self.workers_live.values()),
+                          key=lambda w: w["worker"])
+
+    def breaker_states(self) -> List[dict]:
+        with self._lock:
+            return sorted((dict(b) for b in self.breakers.values()),
+                          key=lambda b: b["endpoint"])
+
     def engine_summary(self) -> dict:
         """Live engine state (reference: daft-dashboard engine.rs state),
         plus process-wide health counters: out-of-core spill volume,
@@ -242,6 +290,11 @@ class DashboardState:
                 "io_bytes_read": io.bytes_read,
                 "io_files_opened": io.files_opened,
                 "io_files_pruned": io.files_pruned,
+                "workers_lost": sum(1 for w in self.workers_live.values()
+                                    if w["status"] == "lost"),
+                "task_retries": sum(self.retries_by_reason.values()),
+                "breakers_open": sum(1 for b in self.breakers.values()
+                                     if b["state"] == "open"),
             }
 
 
@@ -282,6 +335,27 @@ class _Handler(BaseHTTPRequestHandler):
                 self.send_error(404)
                 return
             body = json.dumps(detail, default=str).encode()
+            ctype = "application/json"
+        elif path == "/metrics":
+            # Prometheus text exposition straight off the unified registry
+            # (driver-local series + live worker snapshots merged from the
+            # heartbeat wire). `curl <dashboard>/metrics` is the scrape.
+            from daft_tpu.metrics import get_registry
+
+            body = get_registry().to_prometheus().encode()
+            ctype = "text/plain; version=0.0.4; charset=utf-8"
+        elif path == "/api/metrics":
+            from daft_tpu.metrics import get_registry
+
+            reg = get_registry()
+            body = json.dumps({
+                "enabled": reg.enabled,
+                "workers": self.state.worker_liveness(),
+                "breakers": self.state.breaker_states(),
+                "retries_by_reason": dict(self.state.retries_by_reason),
+                "stale_workers": sorted(reg.stale_workers()),
+                "metrics": reg.snapshot().raw,
+            }).encode()
             ctype = "application/json"
         elif path == "/api/engine":
             body = json.dumps(self.state.engine_summary()).encode()

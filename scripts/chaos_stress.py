@@ -159,12 +159,40 @@ def run_round(spec: str, seed: int, baseline: tuple,
     return None
 
 
+#: Prometheus exposition sample line: name{labels} value  (or no labels).
+_SAMPLE_RE = __import__("re").compile(
+    r'^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^{}]*\})? -?[0-9.e+-]+(Inf|NaN)?$')
+
+
+def scrape_check(url: str) -> str | None:
+    """Curl the dashboard's /metrics mid-chaos and validate the acceptance
+    criterion: well-formed Prometheus exposition with per-worker task
+    series (ISSUE 5). Returns an error string or None."""
+    import urllib.request
+
+    text = urllib.request.urlopen(f"{url}/metrics", timeout=5).read().decode()
+    for line in text.strip().splitlines():
+        if line.startswith("#") or not line:
+            continue
+        if not _SAMPLE_RE.match(line):
+            return f"malformed exposition line: {line!r}"
+    if 'daft_tasks_completed_total{worker_id="' not in text:
+        return "no per-worker task series in scrape"
+    # Fault-path series (retries/worker-loss) are NOT required every round:
+    # a spec whose injection points never fire in this workload (e.g.
+    # io.get_object against in-memory sources) legitimately produces a
+    # fault-free round. Their exposition is covered by tests/test_metrics.py.
+    return None
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--rounds", type=int, default=10)
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--spec", default=None,
                     help="replay one exact spec instead of randomizing")
+    ap.add_argument("--no-scrape", action="store_true",
+                    help="skip the per-round dashboard /metrics validation")
     args = ap.parse_args()
 
     ctx = daft_tpu.get_context()
@@ -177,6 +205,14 @@ def main() -> int:
     finally:
         runner.manager.shutdown()
         ctx.set_runner(old)
+
+    dash = None
+    if not args.no_scrape:
+        from daft_tpu.subscribers.dashboard import DashboardServer
+
+        dash = DashboardServer(port=0).start()
+        ctx.attach_subscriber(dash.subscriber())
+        print(f"dashboard: {dash.url} (scraping /metrics each round)")
 
     rng = random.Random(args.seed)
     specs = [args.spec] if args.spec else [random_spec(rng)
@@ -192,9 +228,17 @@ def main() -> int:
             failures += 1
             print(f"[round {i}] FAIL  seed={args.seed + i} spec={spec!r}: {e}")
             continue
+        if dash is not None:
+            scrape_err = scrape_check(dash.url)
+            if scrape_err is not None:
+                failures += 1
+                print(f"[round {i}] SCRAPE FAIL  spec={spec!r}: {scrape_err}")
+                continue
         status = "survived" if note is None else note
         dl = f" deadline={deadline}s" if deadline else ""
         print(f"[round {i}] ok ({time.time() - t0:.1f}s) spec={spec!r}{dl} — {status}")
+    if dash is not None:
+        dash.shutdown()
     print(f"\n{len(specs) - failures}/{len(specs)} rounds ok")
     return 1 if failures else 0
 

@@ -245,6 +245,42 @@ def test_dtl007_env_read_positive_and_exempt_files():
     assert findings_for(pos, "daft_tpu/context.py", "DTL007") == []
 
 
+def test_dtl008_ad_hoc_counter_dict():
+    pos = """
+    _TOKEN_COUNTS = {}
+    """
+    annotated = """
+    from typing import Dict
+    request_metrics: Dict[str, int] = {}
+    """
+    factory = """
+    from collections import defaultdict
+    _RETRY_TALLY = defaultdict(int)
+    """
+    # Function-local dicts, non-accumulator names, and non-dict values are
+    # out of scope — the invariant is about MODULE-LEVEL tallies.
+    local = """
+    def f():
+        token_counts = {}
+        return token_counts
+    """
+    registry_obj = """
+    _BREAKER_CACHE = {}
+    """
+    neg = """
+    from daft_tpu.metrics import get_registry
+    _TOKENS = get_registry().counter("daft_ai_tokens_total")
+    """
+    assert len(findings_for(pos, ANY_PATH, "DTL008")) == 1
+    assert len(findings_for(annotated, ANY_PATH, "DTL008")) == 1
+    assert len(findings_for(factory, ANY_PATH, "DTL008")) == 1
+    assert findings_for(local, ANY_PATH, "DTL008") == []
+    assert findings_for(registry_obj, ANY_PATH, "DTL008") == []
+    assert findings_for(neg, ANY_PATH, "DTL008") == []
+    # metrics.py is the sanctioned home (it IS the registry).
+    assert findings_for(pos, "daft_tpu/metrics.py", "DTL008") == []
+
+
 def test_syntax_error_becomes_dtl000_finding():
     findings, _ = lint_source("def broken(:\n", ANY_PATH)
     assert [f.rule for f in findings] == ["DTL000"]
@@ -387,8 +423,9 @@ def test_text_reporter_mentions_location_and_counts():
 
 def test_rule_registry_complete():
     assert sorted(rules_by_id()) == [
-        "DTL001", "DTL002", "DTL003", "DTL004", "DTL005", "DTL006", "DTL007"]
-    assert len(default_rules()) == 7
+        "DTL001", "DTL002", "DTL003", "DTL004", "DTL005", "DTL006", "DTL007",
+        "DTL008"]
+    assert len(default_rules()) == 8
 
 
 def test_package_sweep_has_zero_new_violations():

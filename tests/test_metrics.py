@@ -1,0 +1,613 @@
+"""Unified metrics plane (daft_tpu/metrics.py): registry semantics, both
+exporters' schemas (golden-pinned), worker-snapshot aggregation incl.
+killed-worker staleness, the DAFT_METRICS=0 fast path, and the dashboard
+scrape routes.
+"""
+
+import json
+import threading
+import urllib.request
+
+import pytest
+
+import daft_tpu
+from daft_tpu import col
+from daft_tpu.metrics import (
+    BYTES_BUCKETS,
+    LATENCY_BUCKETS_S,
+    NOOP,
+    MetricRegistry,
+    exponential_buckets,
+    get_registry,
+)
+
+
+def fresh():
+    return MetricRegistry(enabled=True)
+
+
+# ------------------------------------------------------------------ #
+# Registry semantics                                                   #
+# ------------------------------------------------------------------ #
+def test_instrument_registration_is_idempotent_and_type_checked():
+    r = fresh()
+    c1 = r.counter("x_total", "help", ("a",))
+    assert r.counter("x_total", "help", ("a",)) is c1
+    with pytest.raises(ValueError):
+        r.gauge("x_total")
+    with pytest.raises(ValueError):
+        r.counter("x_total", labelnames=("b",))
+
+
+def test_labels_positional_kwargs_and_validation():
+    r = fresh()
+    c = r.counter("req_total", "", ("endpoint", "verb"))
+    c.labels("e1", "GET").inc(2)
+    c.labels(verb="GET", endpoint="e1").inc(3)
+    assert c.labels("e1", "GET").value() == 5
+    with pytest.raises(ValueError):
+        c.labels("only-one")
+    with pytest.raises(ValueError):
+        c.labels(endpoint="e1")  # missing verb
+
+
+def test_concurrent_increment_correctness():
+    r = fresh()
+    c = r.counter("hits_total", "", ("k",))
+    h = r.histogram("lat_seconds", "", buckets=(0.5, 1.0))
+    child = c.labels("a")
+
+    def work():
+        for _ in range(10_000):
+            child.inc()
+            c.labels("b").inc(2)
+            h.observe(0.25)
+
+    threads = [threading.Thread(target=work) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert c.labels("a").value() == 80_000
+    assert c.labels("b").value() == 160_000
+    state = h.labels().hist_state()
+    assert state["count"] == 80_000
+    assert state["bucket_counts"][0] == 80_000
+
+
+def test_histogram_bucket_boundaries():
+    assert exponential_buckets(1, 2, 4) == (1.0, 2.0, 4.0, 8.0)
+    assert LATENCY_BUCKETS_S[0] == 0.001 and len(LATENCY_BUCKETS_S) == 16
+    assert BYTES_BUCKETS[0] == 1024.0
+    with pytest.raises(ValueError):
+        exponential_buckets(0, 2, 4)
+    r = fresh()
+    h = r.histogram("h_seconds", "", buckets=(1.0, 10.0))
+    # le semantics: a value equal to a bound lands IN that bucket.
+    for v in (0.5, 1.0, 1.5, 10.0, 11.0):
+        h.observe(v)
+    state = h.labels().hist_state()
+    assert state["bucket_counts"] == [2, 2, 1]
+    assert state["count"] == 5 and state["sum"] == pytest.approx(24.0)
+
+
+def test_reset_zeroes_but_keeps_instruments():
+    r = fresh()
+    c = r.counter("n_total")
+    c.inc(5)
+    child = c.labels()
+    r.reset()
+    assert child.value() == 0
+    c.inc(1)
+    assert r.snapshot().counter_total("n_total") == 1
+    r.reset("n_total")
+    assert r.snapshot().counter_total("n_total") == 0
+
+
+# ------------------------------------------------------------------ #
+# Exposition goldens (schema pins for both exporters)                  #
+# ------------------------------------------------------------------ #
+def golden_registry():
+    r = fresh()
+    r.counter("daft_demo_requests_total", "Requests served",
+              ("endpoint", "verb")).labels("s3://x", "GET").inc(3)
+    r.gauge("daft_demo_up", "Liveness", ("worker_id",)).labels("w1").set(1)
+    h = r.histogram("daft_demo_seconds", "Latency", buckets=(0.1, 1.0))
+    h.observe(0.05)
+    h.observe(0.5)
+    h.observe(5.0)
+    return r
+
+
+def test_prometheus_exposition_golden():
+    text = golden_registry().to_prometheus()
+    assert text == (
+        "# HELP daft_demo_requests_total Requests served\n"
+        "# TYPE daft_demo_requests_total counter\n"
+        'daft_demo_requests_total{endpoint="s3://x",verb="GET"} 3\n'
+        "# HELP daft_demo_seconds Latency\n"
+        "# TYPE daft_demo_seconds histogram\n"
+        'daft_demo_seconds_bucket{le="0.1"} 1\n'
+        'daft_demo_seconds_bucket{le="1"} 2\n'
+        'daft_demo_seconds_bucket{le="+Inf"} 3\n'
+        "daft_demo_seconds_sum 5.55\n"
+        "daft_demo_seconds_count 3\n"
+        "# HELP daft_demo_up Liveness\n"
+        "# TYPE daft_demo_up gauge\n"
+        'daft_demo_up{worker_id="w1"} 1\n'
+    )
+
+
+def test_prometheus_label_escaping():
+    r = fresh()
+    r.counter("esc_total", "", ("p",)).labels('a"b\\c\nd').inc()
+    assert r.to_prometheus().splitlines()[-1] == \
+        'esc_total{p="a\\"b\\\\c\\nd"} 1'
+
+
+def test_otlp_json_schema_pin():
+    payload = golden_registry().to_otlp(service_name="svc")
+    json.dumps(payload)  # must be JSON-serializable end to end
+    rm = payload["resourceMetrics"][0]
+    assert rm["resource"]["attributes"][0] == {
+        "key": "service.name", "value": {"stringValue": "svc"}}
+    scope = rm["scopeMetrics"][0]
+    assert scope["scope"] == {"name": "daft_tpu.metrics"}
+    by_name = {m["name"]: m for m in scope["metrics"]}
+    counter = by_name["daft_demo_requests_total"]["sum"]
+    assert counter["isMonotonic"] is True
+    assert counter["aggregationTemporality"] == 2
+    dp = counter["dataPoints"][0]
+    assert dp["asDouble"] == 3.0
+    assert {"key": "verb", "value": {"stringValue": "GET"}} in dp["attributes"]
+    assert "timeUnixNano" in dp
+    gauge = by_name["daft_demo_up"]["gauge"]["dataPoints"][0]
+    assert gauge["asDouble"] == 1.0
+    hist = by_name["daft_demo_seconds"]["histogram"]
+    assert hist["aggregationTemporality"] == 2
+    hdp = hist["dataPoints"][0]
+    assert hdp["explicitBounds"] == [0.1, 1.0]
+    assert hdp["bucketCounts"] == ["1", "1", "1"]  # proto uint64 -> strings
+    assert hdp["count"] == "3" and hdp["sum"] == pytest.approx(5.55)
+
+
+def test_otlp_file_exporter_writes_resource_metrics_lines(tmp_path):
+    from daft_tpu.metrics import OTLPJsonMetricsFileExporter
+
+    path = tmp_path / "metrics.jsonl"
+    exp = OTLPJsonMetricsFileExporter(str(path))
+    exp.export(golden_registry())
+    exp.export(golden_registry())
+    lines = path.read_text().strip().splitlines()
+    assert len(lines) == 2
+    assert "resourceMetrics" in json.loads(lines[0])
+
+
+# ------------------------------------------------------------------ #
+# Worker aggregation over the heartbeat wire                           #
+# ------------------------------------------------------------------ #
+def test_worker_wire_merge_is_idempotent_and_labeled():
+    worker = fresh()
+    worker.counter("daft_w_total", "", ("reason",)).labels("t").inc(3)
+    worker.histogram("daft_w_seconds", "", buckets=(1.0,)).observe(0.5)
+    driver = fresh()
+    wire = worker.to_wire()
+    json.dumps(wire)  # the wire must survive JSON/pickle transports
+    driver.merge_worker_wire("w1", wire)
+    driver.merge_worker_wire("w1", wire)  # re-delivered heartbeat: no double
+    snap = driver.snapshot()
+    assert snap.counter_total("daft_w_total") == 3
+    assert snap.value("daft_w_total", worker_id="w1", reason="t") == 3
+    assert snap.hist("daft_w_seconds")["count"] == 1
+    text = driver.to_prometheus()
+    assert 'daft_w_total{reason="t",worker_id="w1"} 3' in text
+    assert 'daft_worker_up{worker_id="w1"} 1' in text
+    # A newer cumulative snapshot replaces the old one.
+    worker.counter("daft_w_total", "", ("reason",)).labels("t").inc(2)
+    driver.merge_worker_wire("w1", worker.to_wire())
+    assert driver.snapshot().counter_total("daft_w_total") == 5
+
+
+def test_stale_worker_series_leave_the_scrape():
+    worker = fresh()
+    worker.counter("daft_w_total").inc(7)
+    driver = fresh()
+    driver.merge_worker_wire("w1", worker.to_wire())
+    driver.mark_worker_stale("w1")
+    assert driver.stale_workers() == {"w1"}
+    text = driver.to_prometheus()
+    assert "daft_w_total" not in text
+    assert 'daft_worker_up{worker_id="w1"} 0' in text
+    assert driver.snapshot().counter_total("daft_w_total") == 0
+    # A fresh snapshot from a revived worker un-stales it.
+    driver.merge_worker_wire("w1", worker.to_wire())
+    assert driver.stale_workers() == set()
+    assert driver.snapshot().counter_total("daft_w_total") == 7
+
+
+def test_late_task_reply_does_not_revive_stale_worker():
+    worker = fresh()
+    worker.counter("daft_w3_total").inc(9)
+    driver = fresh()
+    driver.merge_worker_wire("w1", worker.to_wire())
+    driver.mark_worker_stale("w1")  # WorkerLost fired
+    # A task reply that raced the death on a still-open connection merges
+    # with revive=False: the wire updates for post-mortems, but the worker
+    # stays stale (death is sticky — nothing would ever re-mark it).
+    driver.merge_worker_wire("w1", worker.to_wire(), revive=False)
+    assert driver.stale_workers() == {"w1"}
+    assert 'daft_worker_up{worker_id="w1"} 0' in driver.to_prometheus()
+    # The heartbeat path (an answered ping) IS liveness evidence.
+    driver.merge_worker_wire("w1", worker.to_wire())
+    assert driver.stale_workers() == set()
+
+
+def test_clear_stale_workers_forgets_wires_and_liveness_series():
+    worker = fresh()
+    worker.counter("daft_w4_total").inc(5)
+    driver = fresh()
+    driver.merge_worker_wire("w1", worker.to_wire())
+    driver.mark_worker_stale("w1")
+    driver.clear_stale_workers()  # fault_scope exit
+    assert driver.stale_workers() == set()
+    text = driver.to_prometheus()
+    # Neither the dead worker's final snapshot nor a contradictory up=0
+    # series survives — the simulated worker is forgotten entirely.
+    assert "daft_w4_total" not in text
+    assert 'worker_id="w1"' not in text
+
+
+def test_snapshot_does_not_race_per_metric_reset():
+    worker = fresh()
+    for i in range(50):
+        worker.counter(f"daft_r{i}_total").inc(1)
+    driver = fresh()
+    driver.merge_worker_wire("w1", worker.to_wire())
+    errors = []
+
+    def scrape():
+        try:
+            for _ in range(200):
+                driver.to_prometheus()
+        except Exception as e:  # noqa: BLE001 — the failure IS the assertion
+            errors.append(e)
+
+    def resetter():
+        try:
+            for i in range(200):
+                driver.reset(f"daft_r{i % 50}_total")
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    threads = [threading.Thread(target=scrape),
+               threading.Thread(target=resetter)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert errors == []
+
+
+def test_per_metric_reset_strips_worker_wires_too():
+    worker = fresh()
+    worker.counter("daft_w2_total").inc(555)
+    worker.counter("daft_keep_total").inc(1)
+    driver = fresh()
+    driver.merge_worker_wire("w1", worker.to_wire())
+    driver.reset("daft_w2_total")
+    snap = driver.snapshot()
+    assert snap.counter_total("daft_w2_total") == 0
+    assert snap.counter_total("daft_keep_total") == 1  # untouched
+
+
+def test_per_metric_reset_survives_next_cumulative_heartbeat():
+    worker = fresh()
+    worker.counter("daft_w5_total").inc(100)
+    driver = fresh()
+    driver.merge_worker_wire("w1", worker.to_wire())
+    driver.reset("daft_w5_total")
+    # Workers count cumulatively through a driver reset: the next heartbeat
+    # re-delivers the full total, which must read as post-reset delta only.
+    worker.counter("daft_w5_total").inc(7)
+    driver.merge_worker_wire("w1", worker.to_wire())
+    assert driver.snapshot().counter_total("daft_w5_total") == 7
+    # A worker RESTART (counter below the baseline) reads raw, not negative.
+    restarted = fresh()
+    restarted.counter("daft_w5_total").inc(3)
+    driver.merge_worker_wire("w1", restarted.to_wire())
+    assert driver.snapshot().counter_total("daft_w5_total") == 3
+
+
+def test_per_query_series_stay_off_the_wire():
+    from daft_tpu.cancellation import CancelToken, cancel_scope
+    from daft_tpu.metrics import record_io
+
+    reg = get_registry()
+    reg.reset("daft_query_io_requests_total")
+    with cancel_scope(CancelToken(query_id="q-wire")):
+        record_io("s3://b", "GET", nbytes=10, seconds=0.001)
+    # Driver-local snapshot/scrape see the per-query series...
+    assert reg.snapshot().value("daft_query_io_requests_total",
+                                query_id="q-wire") == 1
+    # ...but the heartbeat wire never ships them (a worker has no QueryEnd
+    # signal to evict on, so shipped series would outlive their queries).
+    assert "daft_query_io_requests_total" not in reg.to_wire()
+    assert "daft_query_io_bytes_total" not in reg.to_wire()
+
+
+def test_query_series_capped_and_evicted_at_query_end():
+    from daft_tpu.metrics import MetricsSubscriber, QUERY_IO_BYTES
+    from daft_tpu.subscribers.events import QueryEnd
+
+    r = fresh()
+    capped = r.counter("cap_total", "", ("query_id",), max_series=4)
+    for i in range(10):
+        capped.labels(f"q{i}").inc()
+    assert len(capped.series()) == 4  # oldest evicted, newest kept
+    assert capped.labels("q9").value() == 1
+
+    QUERY_IO_BYTES.labels("q-end-test").inc(10)
+    MetricsSubscriber().on_event(QueryEnd(query_id="q-end-test"))
+    assert get_registry().snapshot().value(
+        "daft_query_io_bytes_total", query_id="q-end-test") == 0
+
+
+# ------------------------------------------------------------------ #
+# DAFT_METRICS=0: zero-allocation fast path                            #
+# ------------------------------------------------------------------ #
+def test_disabled_registry_fast_path_allocates_nothing():
+    r = MetricRegistry(enabled=False)
+    c = r.counter("off_total", "", ("k",))
+    # Every labels() call returns the SAME module singleton: no per-call
+    # child allocation, no series accumulation.
+    assert c.labels("a") is NOOP
+    assert c.labels("b") is c.labels("c")
+    c.labels("a").inc(100)
+    c.inc(5)
+    g = r.gauge("off_gauge")
+    g.set(3)
+    r.histogram("off_seconds").observe(1.0)
+    assert r.to_wire() == {}
+    assert r.to_prometheus() == "\n"
+    assert r.snapshot().counter_total("off_total") == 0
+    # Worker merges are dropped too (a disabled driver must stay empty).
+    r.merge_worker_wire("w1", {"x_total": {
+        "kind": "counter", "help": "", "series": [{"labels": {}, "value": 1}]}})
+    assert r.to_wire() == {}
+
+
+def test_disabled_registry_allocation_count():
+    import tracemalloc
+
+    r = MetricRegistry(enabled=False)
+    c = r.counter("off2_total", "", ("k",))
+    c.labels("warm").inc()  # warm any lazy imports before measuring
+    tracemalloc.start()
+    for _ in range(1000):
+        c.labels("hot").inc()
+    _, peak = tracemalloc.get_traced_memory()
+    tracemalloc.stop()
+    # The hot loop allocates only transient argument tuples (sub-KB peak),
+    # never children/series. A real child dict entry would show up here.
+    assert peak < 4096, f"disabled fast path allocated {peak} bytes"
+
+
+def test_daft_metrics_env_gates_registry(monkeypatch):
+    monkeypatch.setenv("DAFT_METRICS", "0")
+    assert MetricRegistry().enabled is False
+    monkeypatch.setenv("DAFT_METRICS", "1")
+    assert MetricRegistry().enabled is True
+    monkeypatch.delenv("DAFT_METRICS")
+    assert MetricRegistry().enabled is True  # default on
+
+
+# ------------------------------------------------------------------ #
+# Engine integration                                                   #
+# ------------------------------------------------------------------ #
+def test_query_increments_engine_counters(make_df):
+    reg = get_registry()
+    s0 = reg.snapshot()
+    df = make_df({"x": list(range(512)), "g": [i % 4 for i in range(512)]})
+    df.groupby("g").agg(col("x").sum().alias("s")).to_pydict()
+    s1 = reg.snapshot()
+    assert s1.counter_total("daft_queries_started_total") \
+        > s0.counter_total("daft_queries_started_total")
+    assert s1.counter_total("daft_executor_morsels_total") \
+        > s0.counter_total("daft_executor_morsels_total")
+    assert s1.counter_total("daft_executor_rows_total") \
+        > s0.counter_total("daft_executor_rows_total")
+
+
+def test_token_metrics_string_keys_json_and_tuple_compat():
+    from daft_tpu.ai.metrics import (
+        record_token_metrics,
+        reset_token_metrics,
+        token_metrics,
+    )
+
+    reset_token_metrics()
+    record_token_metrics("openai", "emb-small", input_tokens=9,
+                         output_tokens=4, requests=2)
+    tm = token_metrics()
+    assert set(tm) == {"openai/emb-small"}
+    assert tm["openai/emb-small"]["input_tokens"] == 9
+    # Legacy tuple keys keep resolving (pre-registry call sites).
+    assert tm[("openai", "emb-small")]["output_tokens"] == 4
+    assert ("openai", "emb-small") in tm
+    assert tm.get(("nope", "x")) is None
+    json.dumps(tm)  # the historical bug: tuple keys broke every exporter
+    reset_token_metrics()
+    assert token_metrics() == {}
+
+
+def test_per_query_io_attribution_via_cancel_scope():
+    from daft_tpu.cancellation import CancelToken, cancel_scope
+    from daft_tpu.metrics import record_io
+
+    reg = get_registry()
+    reg.reset("daft_query_io_requests_total")
+    reg.reset("daft_query_io_bytes_total")
+    with cancel_scope(CancelToken(query_id="qm1")):
+        record_io("s3://bucket", "GET", nbytes=100, seconds=0.01)
+    record_io("s3://bucket", "GET", nbytes=50, seconds=0.01)  # no scope
+    snap = reg.snapshot()
+    assert snap.value("daft_query_io_requests_total", query_id="qm1") == 1
+    assert snap.value("daft_query_io_bytes_total", query_id="qm1") == 100
+    assert snap.counter_total("daft_query_io_bytes_total") == 100
+
+
+def test_circuit_breaker_state_gauge_transitions():
+    from daft_tpu.io.circuit import CircuitBreaker
+    from daft_tpu.errors import DaftCircuitOpenError
+
+    reg = get_registry()
+    b = CircuitBreaker("https://metrics.test", failure_threshold=2,
+                       open_base_s=30.0, open_cap_s=30.0, half_open_probes=1)
+    b.record_failure()
+    b.record_failure()  # trips open
+    snap = reg.snapshot()
+    assert snap.value("daft_circuit_state",
+                      endpoint="https://metrics.test") == 2
+    assert snap.value("daft_circuit_transitions_total",
+                      endpoint="https://metrics.test", to="open") == 1
+    with pytest.raises(DaftCircuitOpenError):
+        b.allow()
+    b.record_success()  # half-open probe succeeded -> closed
+    snap = reg.snapshot()
+    assert snap.value("daft_circuit_state",
+                      endpoint="https://metrics.test") == 0
+    assert snap.value("daft_circuit_transitions_total",
+                      endpoint="https://metrics.test", to="closed") == 1
+
+
+def test_explain_analyze_reads_registry_deltas(make_df, capsys):
+    df = make_df({"x": list(range(64))})
+    df.select((col("x") * 2).alias("y")).explain(analyze=True)
+    text = capsys.readouterr().out
+    assert "== Analyze ==" in text
+    assert "device eval: fused_exprs=" in text
+
+
+# ------------------------------------------------------------------ #
+# Dashboard routes                                                     #
+# ------------------------------------------------------------------ #
+def test_dashboard_metrics_routes(make_df):
+    from daft_tpu.subscribers.dashboard import DashboardServer
+    from daft_tpu.subscribers.events import (
+        CircuitClosed,
+        CircuitOpened,
+        TaskRetried,
+        WorkerLost,
+    )
+
+    server = DashboardServer(port=0).start()
+    try:
+        sub = server.subscriber()
+        sub.on_event(WorkerLost(worker_id="wX", reason="heartbeat-timeout"))
+        sub.on_event(TaskRetried(query_id="q", task_id="t", attempt=1,
+                                 reason="transient"))
+        sub.on_event(CircuitOpened(endpoint="https://e1", failures=5,
+                                   open_for_s=1.5))
+        sub.on_event(CircuitClosed(endpoint="https://e2"))
+        make_df({"x": [1, 2, 3]}).to_pydict()
+
+        resp = urllib.request.urlopen(server.url + "/metrics")
+        assert resp.headers["Content-Type"].startswith(
+            "text/plain; version=0.0.4")
+        text = resp.read().decode()
+        # Correct exposition syntax: every sample line follows its TYPE line.
+        seen_type = set()
+        for line in text.strip().splitlines():
+            if line.startswith("# TYPE "):
+                seen_type.add(line.split()[2])
+            elif not line.startswith("#"):
+                base = line.split("{")[0].split(" ")[0]
+                for suffix in ("_bucket", "_sum", "_count"):
+                    if base.endswith(suffix) and base[: -len(suffix)] in seen_type:
+                        base = base[: -len(suffix)]
+                        break
+                assert base in seen_type, f"sample before TYPE: {line}"
+        assert "daft_queries_started_total" in text
+
+        api = json.loads(urllib.request.urlopen(
+            server.url + "/api/metrics").read())
+        assert api["enabled"] is True
+        workers = {w["worker"]: w for w in api["workers"]}
+        assert workers["wX"]["status"] == "lost"
+        assert workers["wX"]["reason"] == "heartbeat-timeout"
+        breakers = {b["endpoint"]: b for b in api["breakers"]}
+        assert breakers["https://e1"]["state"] == "open"
+        assert breakers["https://e2"]["state"] == "closed"
+        assert api["retries_by_reason"]["transient"] == 1
+        assert "daft_queries_started_total" in api["metrics"]
+
+        engine = json.loads(urllib.request.urlopen(
+            server.url + "/api/engine").read())
+        assert engine["workers_lost"] == 1
+        assert engine["breakers_open"] == 1
+        assert engine["task_retries"] == 1
+    finally:
+        server.shutdown()
+
+
+# ------------------------------------------------------------------ #
+# Distributed: heartbeat-shipped snapshots + killed-worker staleness   #
+# ------------------------------------------------------------------ #
+@pytest.mark.chaos
+def test_killed_worker_series_go_stale_under_fault_injector():
+    from daft_tpu.distributed.faults import fault_scope
+    from daft_tpu.runners.distributed import DistributedRunner
+
+    ctx = daft_tpu.get_context()
+    old = ctx._runner
+    runner = DistributedRunner(num_workers=3)
+    ctx.set_runner(runner)
+    reg = get_registry()
+    try:
+        df = daft_tpu.from_pydict({
+            "x": list(range(600)), "g": [i % 5 for i in range(600)]})
+        with fault_scope("worker.pre_submit:kill:3", seed=0):
+            out = df.repartition(6).groupby("g").agg(
+                col("x").sum().alias("s")).to_pydict()
+            assert len(out["g"]) == 5  # the query recovered
+            stale = reg.stale_workers()
+            assert stale, "killed worker must be marked stale"
+            text = reg.to_prometheus()
+            for wid in stale:
+                assert f'daft_worker_up{{worker_id="{wid}"}} 0' in text
+            snap = reg.snapshot()
+            assert snap.counter_total("daft_workers_lost_total") >= 1
+            assert snap.counter_total("daft_task_retries_total") >= 1
+        # fault_scope exit clears SIMULATED staleness.
+        assert reg.stale_workers() == set()
+        # Delta-based dispatcher gauges withdraw this query's contribution
+        # on exit instead of zeroing concurrent queries' depth.
+        snap = reg.snapshot()
+        assert snap.counter_total("daft_dispatcher_pending_tasks") >= 0
+        assert snap.counter_total("daft_dispatcher_inflight_tasks") >= 0
+    finally:
+        runner.manager.shutdown()
+        ctx.set_runner(old)
+
+
+@pytest.mark.chaos
+def test_daemon_heartbeat_ships_metrics_snapshot():
+    from daft_tpu.distributed.daemon import (
+        RemoteWorker,
+        spawn_local_daemon,
+        wait_for_daemon,
+    )
+
+    reg = get_registry()
+    proc = spawn_local_daemon(slots=1)
+    try:
+        addr = wait_for_daemon(proc)
+        w = RemoteWorker(addr)
+        assert w.heartbeat() is True
+        # The ping reply carried the daemon's registry snapshot; the driver
+        # merged it (even an empty one flips the liveness gauge).
+        assert reg.snapshot().value("daft_worker_up",
+                                    worker_id=w.worker_id) == 1
+        assert w.worker_id not in reg.stale_workers()
+    finally:
+        proc.kill()
