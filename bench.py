@@ -286,17 +286,17 @@ print(json.dumps({"best_s": min(times)}))
 """
 
 
-def metrics_overhead_check(n: int = 400_000, reps: int = 7,
-                           rounds: int = 3) -> dict:
-    """Compare best-of-N loop times with DAFT_METRICS on vs off, each config
-    in fresh subprocesses (the registry reads the env once per process).
+def _ab_overhead_check(env_var: str, metric: str, limit_pct: float,
+                       n: int, reps: int, rounds: int) -> dict:
+    """Compare best-of-N loop times with ``env_var`` on vs off, each config
+    in fresh subprocesses (both planes read the env once per process).
     Single runs on a shared box vary 2x process-to-process, so the configs
     run INTERLEAVED over several rounds and the best time per config wins —
     the minimum is the only estimator whose noise shrinks with samples."""
 
     def run(enabled: bool) -> float:
         env = dict(os.environ, JAX_PLATFORMS="cpu",
-                   DAFT_METRICS="1" if enabled else "0")
+                   **{env_var: "1" if enabled else "0"})
         proc = subprocess.run(
             [sys.executable, "-c", _TPCH_CHILD, str(n), str(reps)],
             capture_output=True, text=True, env=env, timeout=600,
@@ -311,11 +311,132 @@ def metrics_overhead_check(n: int = 400_000, reps: int = 7,
         ons.append(run(True))
     off, on = min(offs), min(ons)
     pct = (on - off) / off * 100.0 if off > 0 else 0.0
-    return {"metric": "metrics_overhead_pct", "value": round(pct, 3),
-            "unit": "% vs DAFT_METRICS=0", "enabled_s": round(on, 4),
-            "disabled_s": round(off, 4),
-            "limit_pct": METRICS_OVERHEAD_LIMIT_PCT,
-            "ok": pct < METRICS_OVERHEAD_LIMIT_PCT}
+    return {"metric": metric, "value": round(pct, 3),
+            "unit": f"% vs {env_var}=0", "enabled_s": round(on, 4),
+            "disabled_s": round(off, 4), "limit_pct": limit_pct,
+            "ok": pct < limit_pct}
+
+
+def metrics_overhead_check(n: int = 400_000, reps: int = 7,
+                           rounds: int = 3) -> dict:
+    return _ab_overhead_check("DAFT_METRICS", "metrics_overhead_pct",
+                              METRICS_OVERHEAD_LIMIT_PCT, n, reps, rounds)
+
+
+# The profiler's enabled path (operator spans + per-pull clocks + span
+# buffering) must ALSO stay under 2%: it is the instrument every perf PR is
+# judged with, so it cannot eat the goodput it measures. Unlike the metrics
+# registry (env read once per process), the profiler consults DAFT_PROFILE
+# at every begin_query — so the A/B can alternate profiled and unprofiled
+# reps INSIDE one process. That pairing is what makes the verdict stable on
+# shared boxes: machine drift between two separate child processes swings
+# 10x the 2% budget, but hits interleaved same-process reps symmetrically.
+PROFILE_OVERHEAD_LIMIT_PCT = float(
+    os.environ.get("DAFT_PROFILE_OVERHEAD_LIMIT_PCT", "2.0"))
+
+_PROFILE_AB_CHILD = r"""
+import gc, json, os, sys, time
+import numpy as np
+import daft_tpu
+from daft_tpu import col
+
+n = int(sys.argv[1]); blocks = int(sys.argv[2])
+rng = np.random.default_rng(0)
+# numpy arrays go to from_pydict as-is: .tolist() on three 6M-element
+# columns costs ~45s of untimed child setup per round, which alone eats
+# most of the CI lane's timeout budget.
+orders = daft_tpu.from_pydict({
+    "o_key": np.arange(n, dtype=np.int64),
+    "o_cust": rng.integers(0, n // 8, n),
+    "o_total": rng.random(n)})
+cust = daft_tpu.from_pydict({
+    "c_key": np.arange(n // 8, dtype=np.int64),
+    "c_seg": rng.integers(0, 5, n // 8)})
+
+def loop():
+    q = (orders.where(col("o_total") > 0.2)
+         .join(cust, left_on="o_cust", right_on="c_key")
+         .groupby("c_seg").agg(col("o_total").sum().alias("rev"))
+         .sort("rev", desc=True))
+    return q.to_pydict()
+
+os.environ["DAFT_PROFILE"] = "1"
+loop()  # warm caches/JIT + profiler module state before timing
+os.environ["DAFT_PROFILE"] = "0"
+loop()
+# ABBA blocks (phase alternates so a period-2 systematic — allocator
+# oscillation, cache state — can't masquerade as config cost) with a
+# gc.collect() before every timed rep (collector bursts land on whichever
+# rep they please, 10x the signal).
+on, off = [], []
+for b in range(blocks):
+    order = ("0", "1") if b % 2 == 0 else ("1", "0")
+    ts = {}
+    for m in order:
+        os.environ["DAFT_PROFILE"] = m
+        gc.collect()
+        t0 = time.perf_counter(); loop(); ts[m] = time.perf_counter() - t0
+    on.append(ts["1"]); off.append(ts["0"])
+print(json.dumps({"on_s": on, "off_s": off}))
+"""
+
+
+def profile_overhead_check(n: int = 6_000_000, reps: int = 10,
+                           rounds: int = 3) -> dict:
+    # n matches TPC-H SF1 lineitem scale (6M rows): the profiler's residual
+    # cost is FIXED per query (a handful of spans + two CPU-clock reads),
+    # and the issue's budget is "<2% TPC-H overhead" — queries there run
+    # hundreds of ms to seconds, so the guard's loop must be query-sized,
+    # not microbenchmark-sized, or a ~1ms fixed cost reads as inflated
+    # per-row cost. ``reps`` counts ABBA pair-blocks per child.
+    # Estimator: each pair shares one instant of machine weather; the
+    # MEDIAN of paired deltas (pooled across children) rejects both slow
+    # outliers and drift, where min-vs-min re-introduces each config's
+    # independent luck. Shared-box drift is 10x the 2% budget; pairing is
+    # what makes the verdict reproducible. Even so, the pooled median of
+    # ~30 pairs still wanders ±2% when the box spends a whole round in a
+    # storm, so a failing verdict ESCALATES once: double the sample with
+    # fresh rounds and re-judge the pooled set. A real regression holds
+    # its level through twice the data; weather does not.
+    deltas, offs = [], []
+
+    def collect(num_rounds: int) -> None:
+        for _ in range(num_rounds):
+            env = dict(os.environ, JAX_PLATFORMS="cpu")
+            env.pop("DAFT_PROFILE", None)       # the child drives the toggle
+            env.pop("DAFT_PROFILE_FILE", None)  # measure collection, not IO
+            proc = subprocess.run(
+                [sys.executable, "-c", _PROFILE_AB_CHILD, str(n), str(reps)],
+                capture_output=True, text=True, env=env, timeout=600,
+                cwd=os.path.dirname(os.path.abspath(__file__)))
+            if proc.returncode != 0:
+                raise RuntimeError(
+                    f"overhead child failed:\n{proc.stderr[-2000:]}")
+            rec = json.loads(proc.stdout.strip().splitlines()[-1])
+            deltas.extend(o - f for o, f in zip(rec["on_s"], rec["off_s"]))
+            offs.extend(rec["off_s"])
+
+    import statistics
+
+    def verdict() -> tuple:
+        off = statistics.median(offs)
+        delta = statistics.median(deltas)
+        pct = delta / off * 100.0 if off > 0 else 0.0
+        return pct, off, delta
+
+    collect(rounds)
+    pct, off, delta = verdict()
+    escalated = False
+    if pct >= PROFILE_OVERHEAD_LIMIT_PCT:
+        escalated = True
+        collect(rounds)
+        pct, off, delta = verdict()
+    return {"metric": "profile_overhead_pct", "value": round(pct, 3),
+            "unit": "% vs DAFT_PROFILE=0", "pairs": len(deltas),
+            "escalated": escalated,
+            "enabled_s": round(off + delta, 4), "disabled_s": round(off, 4),
+            "limit_pct": PROFILE_OVERHEAD_LIMIT_PCT,
+            "ok": pct < PROFILE_OVERHEAD_LIMIT_PCT}
 
 
 def main() -> None:
@@ -325,6 +446,15 @@ def main() -> None:
         if not rec["ok"]:
             sys.stderr.write(
                 f"metrics plane overhead {rec['value']}% exceeds "
+                f"{rec['limit_pct']}% budget\n")
+            sys.exit(1)
+        return
+    if len(sys.argv) > 1 and sys.argv[1] == "--profile-overhead":
+        rec = profile_overhead_check()
+        print(json.dumps(rec))
+        if not rec["ok"]:
+            sys.stderr.write(
+                f"profiler overhead {rec['value']}% exceeds "
                 f"{rec['limit_pct']}% budget\n")
             sys.exit(1)
         return

@@ -122,6 +122,12 @@ class ExecutionConfig:
     # spelling of DAFT_METRICS_FILE (OTLP-JSON resourceMetrics lines).
     metrics_enabled: bool = True
     metrics_export_path: Optional[str] = None
+    # Query profiler (daft_tpu/profiling.py). Default OFF: profiling is
+    # opt-in per query via df.collect(profile=...) or process-wide via
+    # DAFT_PROFILE=1; profile_export_path (DAFT_PROFILE_FILE) writes the
+    # Chrome trace-event JSON there at query end.
+    profile_enabled: bool = False
+    profile_export_path: Optional[str] = None
 
     def with_changes(self, **kwargs) -> "ExecutionConfig":
         return dataclasses.replace(self, **kwargs)
@@ -149,4 +155,8 @@ class ExecutionConfig:
             changes["metrics_enabled"] = False
         if os.environ.get("DAFT_METRICS_FILE"):
             changes["metrics_export_path"] = os.environ["DAFT_METRICS_FILE"]
+        if daft_env_flag("DAFT_PROFILE", False):
+            changes["profile_enabled"] = True
+        if os.environ.get("DAFT_PROFILE_FILE"):
+            changes["profile_export_path"] = os.environ["DAFT_PROFILE_FILE"]
         return cfg.with_changes(**changes) if changes else cfg

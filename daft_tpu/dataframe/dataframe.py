@@ -37,6 +37,9 @@ class DataFrame:
     def __init__(self, builder: LogicalPlanBuilder):
         self._builder = builder
         self._result: Optional[List[MicroPartition]] = None
+        # Set by collect(profile=...): THIS query's finished QueryProfile —
+        # race-free where the process-global last_profile() is not.
+        self.query_profile = None
 
     # ------------------------------------------------------------------ #
     # Introspection                                                       #
@@ -452,13 +455,42 @@ class DataFrame:
 
         return PartitionCacheEntry(self._result)
 
-    def collect(self, timeout: Optional[float] = None) -> "DataFrame":
+    def collect(self, timeout: Optional[float] = None,
+                profile: "str | bool | None" = None) -> "DataFrame":
         """Materialise the query. ``timeout`` (seconds) bounds the WHOLE
         query end to end — dispatch waits, retry backoff sleeps, morsel
         loops, remote workers: on expiry it fails with
         :class:`~daft_tpu.errors.DaftTimeoutError` (per-task progress
         attached) instead of running on. Default: unbounded, or
-        ``DAFT_QUERY_TIMEOUT_S`` / ``ExecutionConfig.query_timeout_s``."""
+        ``DAFT_QUERY_TIMEOUT_S`` / ``ExecutionConfig.query_timeout_s``.
+
+        ``profile`` records a distributed trace of this query — driver
+        scheduling plus every worker's per-operator execution under one
+        trace id. Pass a path to write Chrome trace-event JSON there (load
+        it in Perfetto or chrome://tracing), or ``True`` to keep the trace
+        in memory. Either way the finished profile lands on
+        ``df.query_profile`` (race-free under concurrent profiled queries,
+        unlike the process-global ``daft_tpu.profiling.last_profile()``).
+        Equivalent env switches: ``DAFT_PROFILE=1`` /
+        ``DAFT_PROFILE_FILE=path``."""
+        if profile:
+            from daft_tpu import profiling
+
+            if self._result is not None:
+                # Nothing will run — and silently returning a stale
+                # last_profile() (or no trace file) reads as a working
+                # profile of THIS query.
+                import logging
+
+                logging.getLogger("daft_tpu.dataframe").warning(
+                    "collect(profile=...) on an already-materialized "
+                    "DataFrame: no query runs, so no trace is recorded")
+                return self
+            with profiling.collect_profile(
+                    profile if isinstance(profile, str) else None) as req:
+                self._materialize(timeout=timeout)
+            self.query_profile = req.profile
+            return self
         self._materialize(timeout=timeout)
         return self
 

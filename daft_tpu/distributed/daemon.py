@@ -163,19 +163,47 @@ class WorkerDaemon:
                     # short deadline and count silence as a missed beat. The
                     # registry snapshot piggybacks on the same frame, so
                     # worker metrics reach the driver at heartbeat cadence
-                    # with zero extra connections.
+                    # with zero extra connections. Buffered profiler spans
+                    # and this host's span clock ride along too: spans of
+                    # operators that finished BEFORE a crash have already
+                    # shipped, and the clock sample feeds the driver's
+                    # RTT-midpoint skew estimate (profiling.py).
+                    from daft_tpu import profiling
                     from daft_tpu.metrics import get_registry
+                    from daft_tpu.tracing import span_clock_ns
 
-                    _send_frame(conn, cloudpickle.dumps(
-                        {"ok": True, "worker_id": self.worker_id,
-                         "slots": self.slots, "flight": self.flight_address,
-                         "active": self._active,
-                         "metrics": get_registry().to_wire()}))
+                    spans = profiling.drain_worker_buffer()
+                    try:
+                        _send_frame(conn, cloudpickle.dumps(
+                            {"ok": True, "worker_id": self.worker_id,
+                             "slots": self.slots,
+                             "flight": self.flight_address,
+                             "active": self._active,
+                             "metrics": get_registry().to_wire(),
+                             "spans": spans,
+                             "now_ns": span_clock_ns()}))
+                    except OSError:
+                        # The driver timed out / hung up mid-reply: put the
+                        # drained spans back so the next beat ships them —
+                        # crash durability must survive a missed heartbeat.
+                        profiling.buffer_spans(spans)
+                        raise
                 elif op == "run_task":
                     # The pool caps concurrent executions at `slots` even
                     # with many connections (per-chip ownership on TPU hosts).
                     fut = self._pool.submit(self._run_task, msg)
-                    _send_frame(conn, cloudpickle.dumps(fut.result()))
+                    reply = fut.result()
+                    try:
+                        _send_frame(conn, cloudpickle.dumps(reply))
+                    except OSError:
+                        # Driver hung up mid-reply: re-buffer the drained
+                        # spans so the next heartbeat ships them (same
+                        # crash-durability contract as the ping path).
+                        if reply.get("spans"):
+                            from daft_tpu import profiling
+
+                            profiling.buffer_spans(reply["spans"])
+                        raise
                 elif op == "die":
                     # Fault injection (tests only): refuse unless explicitly
                     # enabled — an unauthenticated kill switch otherwise.
@@ -203,6 +231,7 @@ class WorkerDaemon:
     def _run_task(self, msg: dict) -> dict:
         with self._lock:
             self._active += 1
+        prof = None
         try:
             from daft_tpu.execution.executor import Executor
 
@@ -218,13 +247,28 @@ class WorkerDaemon:
 
             token = token_for_task(msg.get("query_id", ""),
                                    msg.get("deadline"))
+            # Trace context (profiling.py): spans sink into the process-wide
+            # buffer as they finish, so completed-operator spans reach the
+            # driver on the NEXT heartbeat even if this task never replies
+            # (daemon killed mid-task).
+            from daft_tpu import profiling
+
+            prof = profiling.task_profiler_for(
+                msg.get("trace_ctx"), msg.get("query_id", ""),
+                self.worker_id, sink=profiling.buffer_spans)
             executor = Executor(msg["cfg"], partition_offset=msg["partition_idx"],
-                                stats=stats, cancel_token=token)
+                                stats=stats, cancel_token=token, profiler=prof)
             from daft_tpu.context import frozen_clock_scope
 
             with cancel_scope(token), \
-                    frozen_clock_scope(msg.get("frozen_clock")):
-                bound = bind_task_fragment(fragment, inputs)
+                    frozen_clock_scope(msg.get("frozen_clock")), \
+                    profiling.profiled_task_scope(
+                        prof,
+                        task_id=msg.get("task_id", ""),
+                        partition_idx=msg["partition_idx"],
+                        attempt=msg.get("attempt", 0)):
+                with profiling.maybe_span(prof, "daft.task.bind"):
+                    bound = bind_task_fragment(fragment, inputs)
                 out = list(executor.run(bound))
             parts = collect_task_outputs(out, msg["expect_outputs"], fragment.schema)
             refs = []
@@ -237,7 +281,9 @@ class WorkerDaemon:
             from daft_tpu.metrics import get_registry
 
             return {"ok": True, "refs": refs, "stats": stats.to_wire(),
-                    "metrics": get_registry().to_wire()}
+                    "metrics": get_registry().to_wire(),
+                    "spans": profiling.drain_worker_buffer()
+                    if prof is not None else None}
         except BaseException as e:  # noqa: BLE001
             import traceback
 
@@ -252,6 +298,10 @@ class WorkerDaemon:
             from daft_tpu.errors import DaftCancelledError
 
             reply = {"ok": False, "error": f"{e}\n{traceback.format_exc()}"}
+            if prof is not None:
+                # Partial ERROR spans (task_scope unwound) still ship: the
+                # driver's trace shows how far the task got before failing.
+                reply["spans"] = profiling.drain_worker_buffer()
             fetch = find_fetch_failure(e)
             if find_in_chain(e, DaftCancelledError) is not None:
                 reply["kind"] = "cancelled"
@@ -293,10 +343,26 @@ class RemoteWorker(Worker):
         self.cfg = cfg or get_context().execution_config
         self._active = 0
         self._lock = threading.Lock()
-        info = self._request({"op": "ping"}, timeout=connect_timeout)
+        info = self._ping(timeout=connect_timeout)
         self.worker_id = info["worker_id"]
         self.num_slots = info["slots"]
         self.flight_address = info["flight"]
+
+    def _ping(self, timeout: Optional[float] = None) -> dict:
+        """One ping round-trip, folding the piggybacked profiler payloads
+        in: the daemon's span-clock sample becomes an RTT-midpoint skew
+        estimate, and buffered worker spans reach the driver's span store."""
+        from daft_tpu import profiling
+        from daft_tpu.tracing import span_clock_ns
+
+        t0 = span_clock_ns()
+        info = self._request({"op": "ping"}, timeout=timeout)
+        t1 = span_clock_ns()
+        wid = info.get("worker_id", "")
+        if info.get("now_ns") and wid:
+            profiling.record_worker_clock(wid, info["now_ns"], t0, t1)
+        profiling.deliver_spans(info.get("spans"), worker_id=wid)
+        return info
 
     def _request(self, msg: dict, timeout: Optional[float] = None) -> dict:
         try:
@@ -312,6 +378,12 @@ class RemoteWorker(Worker):
             raise WorkerDiedError(
                 f"worker at {self.address} unreachable: {e}") from e
         if not reply.get("ok"):
+            # A failed task's partial ERROR spans piggyback the error reply;
+            # deliver them before the raise discards the frame.
+            from daft_tpu import profiling
+
+            profiling.deliver_spans(reply.get("spans"),
+                                    worker_id=getattr(self, "worker_id", None))
             err = reply.get("error", "unknown daemon error")
             kind = reply.get("kind")
             if kind == "fetch":
@@ -346,13 +418,20 @@ class RemoteWorker(Worker):
                     "query_id": task.query_id,
                     "frozen_clock": task.frozen_clock,
                     "deadline": task.deadline,
+                    "task_id": task.task_id,
+                    "attempt": task.attempt,
+                    "trace_ctx": task.trace_ctx,
                 }
                 reply = self._request(payload)
                 # Worker-side operator stats stream back with the reply and
                 # re-emit on the driver (reference: the remote event-log sink
                 # forwarding worker events, daft/runners/flotilla.py:171-176).
+                from daft_tpu import profiling
                 from daft_tpu.execution.resource_manager import emit_operator_stats
                 from daft_tpu.metrics import get_registry
+
+                profiling.deliver_spans(reply.get("spans"),
+                                        worker_id=self.worker_id)
 
                 emit_operator_stats(task.query_id, reply.get("stats"))
                 # revive=False: a reply racing this worker's death on a
@@ -390,7 +469,10 @@ class RemoteWorker(Worker):
         cannot answer within 2s counts as a missed beat (the monitor marks it
         dead only after ``heartbeat_miss_threshold`` consecutive misses)."""
         try:
-            info = self._request({"op": "ping"}, timeout=2.0)
+            # _ping also folds in the piggybacked profiler payloads: the
+            # span-clock sample (RTT-midpoint skew estimate) and any worker
+            # spans buffered since the last beat.
+            info = self._ping(timeout=2.0)
             # The worker's cumulative registry snapshot rides the heartbeat
             # (ISSUE 5): merge under this worker's id so driver-side scrapes
             # see per-worker series without a second wire.

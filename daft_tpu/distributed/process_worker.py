@@ -81,6 +81,7 @@ def _worker_entry(fd: int) -> None:
             return
         if msg == b"__shutdown__":
             return
+        prof = None
         try:
             payload = cloudpickle.loads(msg)
             cfg = payload["cfg"]
@@ -101,13 +102,26 @@ def _worker_entry(fd: int) -> None:
 
             token = token_for_task(payload.get("query_id", ""),
                                    payload.get("deadline"))
+            # Trace context shipped with the task (profiling.py): child
+            # spans buffer locally and ride the reply frame back.
+            from daft_tpu import profiling
+
+            prof = profiling.task_profiler_for(
+                payload.get("trace_ctx"), payload.get("query_id", ""),
+                payload.get("worker_id", ""))
             executor = Executor(cfg, partition_offset=payload["partition_idx"],
-                                stats=stats, cancel_token=token)
+                                stats=stats, cancel_token=token, profiler=prof)
             from daft_tpu.context import frozen_clock_scope
 
             with cancel_scope(token), \
-                    frozen_clock_scope(payload.get("frozen_clock")):
-                bound = bind_task_fragment(fragment, inputs)
+                    frozen_clock_scope(payload.get("frozen_clock")), \
+                    profiling.profiled_task_scope(
+                        prof,
+                        task_id=payload.get("task_id", ""),
+                        partition_idx=payload["partition_idx"],
+                        attempt=payload.get("attempt", 0)):
+                with profiling.maybe_span(prof, "daft.task.bind"):
+                    bound = bind_task_fragment(fragment, inputs)
                 out = list(executor.run(bound))
             parts = collect_task_outputs(out, expect, fragment.schema)
             blobs = [serialize_partition(p) for p in parts]
@@ -115,10 +129,12 @@ def _worker_entry(fd: int) -> None:
 
             # The child's cumulative registry snapshot rides the task reply
             # (this wire IS the heartbeat surface for process workers —
-            # liveness is proc.poll(), which carries no payload).
+            # liveness is proc.poll(), which carries no payload). Completed
+            # profiler spans piggyback the same frame.
             _send_frame(sock, cloudpickle.dumps(
                 {"ok": True, "parts": blobs, "stats": stats.to_wire(),
-                 "metrics": get_registry().to_wire()}))
+                 "metrics": get_registry().to_wire(),
+                 "spans": prof.drain() if prof is not None else None}))
         except BaseException as e:  # noqa: BLE001
             import traceback
 
@@ -126,6 +142,11 @@ def _worker_entry(fd: int) -> None:
             from daft_tpu.errors import DaftCancelledError
 
             reply = {"ok": False, "error": f"{e}\n{traceback.format_exc()}"}
+            if prof is not None:
+                # The task span closed ERROR/partial in task_scope's unwind:
+                # ship whatever finished so the driver's trace shows how far
+                # the task got before dying.
+                reply["spans"] = prof.drain()
             if find_in_chain(e, DaftCancelledError) is not None:
                 # Keep the cancellation type across the wire so the driver
                 # never retries cancelled work.
@@ -215,6 +236,10 @@ class ProcessWorker(Worker):
                         "query_id": task.query_id,
                         "frozen_clock": task.frozen_clock,
                         "deadline": task.deadline,
+                        "task_id": task.task_id,
+                        "attempt": task.attempt,
+                        "trace_ctx": task.trace_ctx,
+                        "worker_id": self.worker_id,
                     }
                     try:
                         _send_frame(self._sock, cloudpickle.dumps(payload))
@@ -224,6 +249,12 @@ class ProcessWorker(Worker):
                             f"worker {self.worker_id} died mid-task: {e}"
                         ) from e
                     result = cloudpickle.loads(msg)
+                    from daft_tpu import profiling
+
+                    # Spans piggyback BOTH reply shapes: a failed task still
+                    # delivers its partial ERROR spans before the raise.
+                    profiling.deliver_spans(result.get("spans"),
+                                            worker_id=self.worker_id)
                     if not result["ok"]:
                         if result.get("kind") == "cancelled":
                             from daft_tpu.errors import DaftCancelledError

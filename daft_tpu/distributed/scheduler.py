@@ -224,9 +224,18 @@ class Dispatcher:
                    speculative: bool = False,
                    exclude: Optional[Set[str]] = None) -> None:
             worker = self.scheduler.assign(task, exclude=exclude)
+            if attempt != task.attempt:
+                # Stamp the attempt number onto the SHIPPED task (a shallow
+                # copy: inputs lists stay shared, so in-place lineage
+                # repairs remain visible to every attempt) — worker-side
+                # profiler spans carry it, distinguishing retries and
+                # speculative duplicates on the timeline.
+                import dataclasses
+
+                task = dataclasses.replace(task, attempt=attempt)
             maybe_inject("worker.pre_submit", task=task, worker=worker)
             notify(TaskScheduled(query_id=task.query_id, task_id=task.task_id,
-                                 worker_id=worker.worker_id))
+                                 worker_id=worker.worker_id, attempt=attempt))
             fut = worker.submit(task)
             inflight[fut] = _Attempt(rec_idx, task, attempt, worker,
                                      time.monotonic(), speculative)
@@ -430,7 +439,7 @@ class Dispatcher:
                     notify(TaskCompleted(
                         query_id=att.task.query_id, task_id=att.task.task_id,
                         worker_id=att.worker.worker_id,
-                        duration_s=elapsed, error=err))
+                        duration_s=elapsed, error=err, attempt=att.attempt))
                     if exc is None:
                         continue
                     failure = self._handle_attempt_failure(

@@ -19,6 +19,12 @@ from daft_tpu.physical import plan as pp
 _task_counter = itertools.count()
 
 
+def _ambient_trace_ctx():
+    from daft_tpu.profiling import current_trace_ctx
+
+    return current_trace_ctx()
+
+
 @dataclass
 class SchedulingStrategy:
     kind: str = "spread"  # spread | affinity
@@ -67,6 +73,15 @@ class Task:
     # re-anchors the remaining budget on the receiving process's monotonic
     # clock, so process/daemon workers enforce the same bound locally.
     deadline: Optional[object] = None
+    # Trace context (profiling.py): (trace_id, parent span_id) captured from
+    # the ambient profiling scope at task creation — None unless the query
+    # is being profiled. Workers open child spans under it so the driver's
+    # exporter assembles ONE trace per query across every worker.
+    trace_ctx: Optional[tuple] = field(default_factory=_ambient_trace_ctx)
+    # Execution attempt number, stamped by the dispatcher at (re)submission:
+    # retried/speculated attempts carry it into span attributes so the
+    # timeline distinguishes a straggler duplicate from its original.
+    attempt: int = 0
 
     def input_size_bytes(self) -> int:
         return sum(r.size_bytes() for refs in self.inputs for r in refs)

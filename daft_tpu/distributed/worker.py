@@ -189,9 +189,11 @@ class LocalWorker(Worker):
             self._active += 1
 
         def run() -> List[PartitionRef]:
+            prof = None
             try:
                 if self._dead:
                     raise WorkerDiedError(f"worker {self.worker_id} is dead")
+                from daft_tpu import profiling
                 from daft_tpu.cancellation import cancel_scope, token_for_task
                 from daft_tpu.execution.executor import Executor
                 from daft_tpu.execution.resource_manager import (
@@ -210,13 +212,22 @@ class LocalWorker(Worker):
                 # ALSO merges into the driver's per-query stats for the
                 # DataFrame.metrics() surface.
                 stats = RuntimeStats(task.query_id)
+                # Profiled queries ship (trace_id, parent span_id) with the
+                # task: open the worker-side task span + per-operator spans
+                # under it so the driver assembles one coherent trace.
+                prof = profiling.task_profiler_for(
+                    task.trace_ctx, task.query_id, self.worker_id)
                 executor = Executor(task.cfg or self.cfg,
                                     partition_offset=task.partition_idx,
-                                    stats=stats, cancel_token=token)
-                with cancel_scope(token), frozen_clock_scope(task.frozen_clock):
+                                    stats=stats, cancel_token=token,
+                                    profiler=prof)
+                with cancel_scope(token), \
+                        frozen_clock_scope(task.frozen_clock), \
+                        profiling.profiled_task_scope(prof, task):
                     # Input fetches run inside the scope too: shuffle.fetch
                     # injection points observe the token.
-                    bound = bind_task_fragment(task.fragment, task.inputs)
+                    with profiling.maybe_span(prof, "daft.task.bind"):
+                        bound = bind_task_fragment(task.fragment, task.inputs)
                     out = list(executor.run(bound))
                 parts = collect_task_outputs(out, task.expect_outputs, task.fragment.schema)
                 driver_stats = active_query_stats(task.query_id)
@@ -226,6 +237,11 @@ class LocalWorker(Worker):
                                             rows_out=c.rows_out, cpu_ns=c.cpu_ns)
                 return [LocalPartitionRef(p, self.worker_id) for p in parts]
             finally:
+                if prof is not None:
+                    # In-process: completed spans (incl. a partial ERROR
+                    # task span on failure) go straight to the driver store.
+                    profiling.deliver_spans(prof.drain(),
+                                            worker_id=self.worker_id)
                 with self._lock:
                     self._active -= 1
 

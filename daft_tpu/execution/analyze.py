@@ -18,13 +18,22 @@ import time
 
 def analyze_suffix(df) -> str:
     """Collect ``df`` and format the '== Analyze ==' plan-text suffix."""
+    from daft_tpu import profiling
     from daft_tpu.metrics import get_registry
 
     reg = get_registry()
     s0 = reg.snapshot()
+    # Run the query under a profiling scope so the per-operator table comes
+    # from real operator spans (wall/self-CPU/spill/permit-wait per plan
+    # node, workers included) instead of only aggregate registry deltas.
+    # The scope's own handle — not the process-global last_profile(), which
+    # a concurrently finishing profiled query can replace — attributes the
+    # table; it stays None when df was already materialized (no fresh run).
     t0 = time.perf_counter()
-    df.collect()
+    with profiling.collect_profile() as req:
+        df.collect()
     wall = time.perf_counter() - t0
+    prof = req.profile
     s1 = reg.snapshot()
 
     def d(name: str) -> float:
@@ -58,12 +67,27 @@ def analyze_suffix(df) -> str:
     if waits:
         lines.append(f"memory permits: waits={waits}, "
                      f"wait_s={h1['sum'] - h0['sum']:.4f}")
-    ops = getattr(df, "metrics", None)
-    if callable(ops):
-        m = df.metrics()
-        if m:
-            per_op = ", ".join(
-                f"{op}: rows_out={c['rows_out']} cpu_ms={c['cpu_ns'] // 1_000_000}"
-                for op, c in sorted(m.items()))
-            lines.append(f"operators: {per_op}")
+    table = prof.operator_table() if prof is not None else []
+    if table:
+        lines.append("operators (by self time):")
+        lines.append(f"  {'operator':<22} {'rows':>10} {'wall_ms':>9} "
+                     f"{'self_ms':>9} {'cpu_ms':>8} {'spill':>10} "
+                     f"{'permit_ms':>9}")
+        for r in table:
+            lines.append(
+                f"  {r['operator']:<22} {r['rows']:>10} "
+                f"{r['wall_ns'] / 1e6:>9.1f} {r['self_wall_ns'] / 1e6:>9.1f} "
+                f"{r['self_cpu_ns'] / 1e6:>8.1f} {r['spill_bytes']:>10} "
+                f"{r['permit_wait_ns'] / 1e6:>9.1f}")
+    else:
+        # No fresh profile (pre-materialized df): fall back to the coarse
+        # RuntimeStats counters so analyze still says SOMETHING per op.
+        ops = getattr(df, "metrics", None)
+        if callable(ops):
+            m = df.metrics()
+            if m:
+                per_op = ", ".join(
+                    f"{op}: rows_out={c['rows_out']} cpu_ms={c['cpu_ns'] // 1_000_000}"
+                    for op, c in sorted(m.items()))
+                lines.append(f"operators: {per_op}")
     return "\n".join(lines)

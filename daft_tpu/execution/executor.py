@@ -120,7 +120,7 @@ class Executor:
     """Runs a local physical plan, yielding result MicroPartitions."""
 
     def __init__(self, cfg, num_io_threads: int = 8, partition_offset: int = 0,
-                 stats=None, cancel_token=None):
+                 stats=None, cancel_token=None, profiler=None):
         import os
 
         from daft_tpu.execution.resource_manager import get_memory_manager
@@ -132,6 +132,11 @@ class Executor:
         # Cooperative cancellation (cancellation.py): observed at morsel
         # boundaries, memory-permit waits, and fault-injection points.
         self.cancel_token = cancel_token
+        # Query profiler (profiling.py TaskProfiler | None): when present,
+        # every operator's morsel loop runs inside a span keyed by plan-node
+        # id. None is the DAFT_PROFILE=0 fast path — zero per-morsel cost.
+        self.profiler = profiler
+        self._profile_node_ids: Dict[int, int] = {}
         self.memory = get_memory_manager()
         self._held_bytes = 0
         # Per-THREAD pull-chain stack: with worker-pool stages, nested
@@ -237,6 +242,8 @@ class Executor:
         it = handler(node)
         if self.cancel_token is not None:
             it = self._cancel_checked(node.name(), it)
+        if self.profiler is not None:
+            it = self._profiled(node, it)
         if self.stats is None:
             return it
         return self._instrumented(node.name(), it)
@@ -250,6 +257,30 @@ class Executor:
         for mp in it:
             token.check(op)
             yield mp
+
+    def _profiled(self, node: pp.PhysicalPlan,
+                  it: Iterator[MicroPartition]) -> Iterator[MicroPartition]:
+        """One profiler span per operator iterator (profiling.py): wall and
+        thread-CPU time per pull, rows/bytes out per morsel, plus spill /
+        permit-wait / device-path tallies attributed through the ambient
+        frame stack. The span opens at the FIRST pull and closes on
+        exhaustion or abandonment (limit pushdown's GeneratorExit exits the
+        context manager, so abandoned operators still export)."""
+        prof = self.profiler
+        op = type(node).__name__
+        seq = self._profile_node_ids.setdefault(
+            id(node), len(self._profile_node_ids))
+        with prof.operator_span(op, f"{op}#{seq}") as frame:
+            while True:
+                frame.begin_pull()
+                try:
+                    mp = next(it)
+                except StopIteration:
+                    return
+                finally:
+                    frame.end_pull()
+                frame.add_output(len(mp), mp)
+                yield mp
 
     def _instrumented(self, op: str, it: Iterator[MicroPartition]) -> Iterator[MicroPartition]:
         """Per-operator counters with EXCLUSIVE cpu attribution: each level

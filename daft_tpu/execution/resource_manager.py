@@ -94,9 +94,11 @@ class MemoryManager:
             if woken is not None:
                 token.remove_listener(woken)
             if wait_t0 is not None:
-                from daft_tpu import metrics
+                from daft_tpu import metrics, profiling
 
-                metrics.PERMIT_WAIT.observe(time.monotonic() - wait_t0)
+                waited = time.monotonic() - wait_t0
+                metrics.PERMIT_WAIT.observe(waited)
+                profiling.note_permit_wait(waited)
 
     def poison(self, exc: BaseException, query_id: Optional[str] = None) -> None:
         """Fail waiters CURRENTLY blocked in :meth:`acquire` with ``exc``

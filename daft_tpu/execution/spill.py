@@ -50,11 +50,12 @@ class SpillMetrics:
     explain(analyze), dashboard) working on top of them."""
 
     def record(self, nbytes: int, nfiles: int = 1) -> None:
-        from daft_tpu import metrics
+        from daft_tpu import metrics, profiling
 
         metrics.SPILL_BYTES.inc(nbytes)
         metrics.SPILL_FILES.inc(nfiles)
         metrics.SPILL_EVENTS.inc()
+        profiling.note_spill(nbytes)
 
     def reset(self) -> None:
         from daft_tpu import metrics

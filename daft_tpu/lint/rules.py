@@ -10,6 +10,7 @@
 | DTL006 | plan/partition construction never iterates bare sets             |
 | DTL007 | environment variables are read only in config.py / context.py    |
 | DTL008 | counters live on the metrics registry, not module-level dicts    |
+| DTL009 | spans are opened via the context-manager API, never bare calls   |
 
 Each rule documents WHY the invariant exists — a lint error nobody can
 explain gets suppressed instead of fixed.
@@ -495,10 +496,58 @@ class AdHocCounterDict(Rule):
                         f"across workers")
 
 
+class SpanOutsideContextManager(Rule):
+    """DTL009: span openers (``tracer.start_span``, the profiler's
+    ``operator_span``/``task_scope``/``driver_span``) must be entered via
+    ``with`` (or ``ExitStack.enter_context`` for conditional spans). A span
+    opened as a bare call is never ended: it silently drops from OTLP and
+    Chrome-trace export (an un-ended span has end_ns=0 and renders as a
+    zero-length event) and leaks the thread-local parent stack, corrupting
+    parent attribution for every span opened after it."""
+
+    rule_id = "DTL009"
+    summary = "span opened outside a with-statement"
+
+    # "span" also matches regex-match .span() — a false positive worth the
+    # coverage (TaskProfiler.span IS an engine opener); suppress with a
+    # reasoned `# daftlint: disable=DTL009` where a match object is meant.
+    SPAN_OPENERS = {"start_span", "operator_span", "task_scope",
+                    "driver_span", "span"}
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        allowed: Set[int] = set()
+        for node in ctx.walk():
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                for item in node.items:
+                    if isinstance(item.context_expr, ast.Call):
+                        allowed.add(id(item.context_expr))
+            elif isinstance(node, ast.Call):
+                f = node.func
+                # ExitStack.enter_context(...) ends the span at stack close:
+                # the sanctioned escape hatch for conditionally-opened spans.
+                if isinstance(f, ast.Attribute) and f.attr == "enter_context":
+                    for a in node.args:
+                        if isinstance(a, ast.Call):
+                            allowed.add(id(a))
+        for node in ctx.walk():
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            name = f.attr if isinstance(f, ast.Attribute) else \
+                f.id if isinstance(f, ast.Name) else None
+            if name in self.SPAN_OPENERS and id(node) not in allowed:
+                yield self.finding(
+                    ctx, node,
+                    f"{name}(...) opened outside a with-statement: an "
+                    f"un-ended span silently drops from OTLP/Chrome-trace "
+                    f"export; use `with ...{name}(...):` or "
+                    f"ExitStack.enter_context")
+
+
 ALL_RULES = [WallClockInTaskPath, SwallowedException, UnseededRandomness,
              BlockingCallUnderLock, HostDeviceTransferInKernel,
              NondeterministicIteration, EnvReadOutsideConfig,
-             AdHocCounterDict]
+             AdHocCounterDict, SpanOutsideContextManager]
 
 
 def default_rules() -> List[Rule]:

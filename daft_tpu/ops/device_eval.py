@@ -65,15 +65,22 @@ class DeviceEvalMetrics:
               "daft_device_fallback_exprs_total", "daft_device_errors_total")
 
     def record_fused(self, nexprs: int, rows: int) -> None:
-        from daft_tpu import metrics
+        from daft_tpu import metrics, profiling
 
         metrics.DEVICE_FUSED_EXPRS.inc(nexprs)
         metrics.DEVICE_FUSED_ROWS.inc(rows * nexprs)
+        profiling.note_device(rows * nexprs, fused=True)
 
-    def record_fallback(self, reason: str, nexprs: int = 1) -> None:
-        from daft_tpu import metrics
+    def record_fallback(self, reason: str, nexprs: int = 1,
+                        rows: int = 0) -> None:
+        from daft_tpu import metrics, profiling
 
         metrics.DEVICE_FALLBACKS.labels(reason).inc(nexprs)
+        # The profiler's device-vs-numpy split counts expression-ROWS on
+        # both sides (record_fused tallies rows * nexprs), so the fallback
+        # side must too — expression counts against row counts would read
+        # as ~100% device even when most rows took the host path.
+        profiling.note_device(rows * nexprs, fused=False)
 
     def record_device_error(self) -> None:
         from daft_tpu import metrics
@@ -320,7 +327,8 @@ def try_evaluate_fused(rb, exprs: Sequence[Expr]) -> Optional[Dict[int, Series]]
     ]
     if n < cfg.device_eval_min_rows:
         if nontrivial:
-            device_eval_metrics.record_fallback("below_min_rows", len(nontrivial))
+            device_eval_metrics.record_fallback("below_min_rows",
+                                                len(nontrivial), rows=n)
         return None
     schema = rb.schema
     chosen: List[int] = []
@@ -330,7 +338,7 @@ def try_evaluate_fused(rb, exprs: Sequence[Expr]) -> Optional[Dict[int, Series]]
             chosen.append(i)
             needed_cols |= exprs[i].column_refs()
         else:
-            device_eval_metrics.record_fallback("not_fusable")
+            device_eval_metrics.record_fallback("not_fusable", rows=n)
     if not chosen:
         return None
     # Nullable inputs ride along as HOST-side validity masks: values stage
@@ -355,7 +363,8 @@ def try_evaluate_fused(rb, exprs: Sequence[Expr]) -> Optional[Dict[int, Series]]
                 or _nullable_safe(exprs[i])]
         if len(safe) < len(chosen):
             device_eval_metrics.record_fallback("nullable_unsafe",
-                                                len(chosen) - len(safe))
+                                                len(chosen) - len(safe),
+                                                rows=n)
         chosen = safe
         if not chosen:
             return None
@@ -399,7 +408,8 @@ def try_evaluate_fused(rb, exprs: Sequence[Expr]) -> Optional[Dict[int, Series]]
         # spamming every morsel; correctness never depends on fusion.
         global _ERROR_LOGGED
         device_eval_metrics.record_device_error()
-        device_eval_metrics.record_fallback("device_error", len(chosen))
+        device_eval_metrics.record_fallback("device_error", len(chosen),
+                                            rows=n)
         if not _ERROR_LOGGED:
             _ERROR_LOGGED = True
             import logging

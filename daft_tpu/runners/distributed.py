@@ -97,11 +97,30 @@ class DistributedRunner(Runner):
                 cfg.heartbeat_interval_s, cfg.heartbeat_miss_threshold)
 
     def run_iter(self, builder, timeout: Optional[float] = None) -> Iterator[MicroPartition]:
+        import contextlib
+
+        from daft_tpu import profiling
+
         ctx = get_context()
         cfg = ctx.execution_config
         query_id = uuid.uuid4().hex[:16]
-        optimized = builder.optimize(cfg)
-        physical = translate(optimized.plan, cfg)
+        # Profiling (opt-in: collect(profile=...) / DAFT_PROFILE): the
+        # QueryProfile's (trace_id, root span_id) becomes ambient inside
+        # trace_scope below, so every Task created by the planner captures
+        # it (Task.trace_ctx default_factory) and ships it to its worker.
+        prof = profiling.begin_query(query_id, cfg)
+        try:
+            with contextlib.ExitStack() as plan_st:
+                if prof is not None:
+                    plan_st.enter_context(prof.driver_span("daft.plan"))
+                optimized = builder.optimize(cfg)
+                physical = translate(optimized.plan, cfg)
+        except BaseException as e:  # noqa: BLE001
+            # The execution try/finally below hasn't started: close the
+            # profile HERE or a planning failure leaks it in the process-
+            # global registry forever (and collect_profile gets no trace).
+            profiling.end_query(query_id, error=str(e))
+            raise
         ctx.notify(QueryStart(query_id=query_id, plan=repr(optimized.plan)))
         start = time.perf_counter()
         error = None
@@ -145,8 +164,10 @@ class DistributedRunner(Runner):
             with config_fault_scope(cfg):
                 # Freeze only around the synchronous plan execution: every
                 # Task created inside captures this one instant
-                # (Task.frozen_clock default_factory) and ships it with it.
-                with cancel_scope(token), frozen_clock_scope():
+                # (Task.frozen_clock default_factory) and ships it with it —
+                # the trace context follows the same capture discipline.
+                with cancel_scope(token), frozen_clock_scope(), \
+                        profiling.trace_scope(prof):
                     refs = executor.execute(physical)
             for ref in refs:
                 # Recovery-aware: an output hosted on a since-dead worker
@@ -163,3 +184,4 @@ class DistributedRunner(Runner):
             unregister_query_stats(query_id)
             ctx.notify(QueryEnd(query_id=query_id,
                                 duration_s=time.perf_counter() - start, error=error))
+            profiling.end_query(query_id, error=error)

@@ -22,11 +22,29 @@ class NativeRunner(Runner):
     name = "native"
 
     def run_iter(self, builder, timeout=None) -> Iterator[MicroPartition]:
+        import contextlib
+
+        from daft_tpu import profiling
+
         ctx = get_context()
         cfg = ctx.execution_config
         query_id = uuid.uuid4().hex[:16]
-        optimized = builder.optimize(cfg)
-        physical = translate(optimized.plan, cfg)
+        # Profiling (opt-in: collect(profile=...) / DAFT_PROFILE): one
+        # QueryProfile per query; the driver-local TaskProfiler feeds it
+        # directly, and the Chrome trace writes at end_query.
+        prof = profiling.begin_query(query_id, cfg)
+        try:
+            with contextlib.ExitStack() as plan_st:
+                if prof is not None:
+                    plan_st.enter_context(prof.driver_span("daft.plan"))
+                optimized = builder.optimize(cfg)
+                physical = translate(optimized.plan, cfg)
+        except BaseException as e:  # noqa: BLE001
+            # The execution try/finally below hasn't started: close the
+            # profile HERE or a planning failure leaks it in the process-
+            # global registry forever (and collect_profile gets no trace).
+            profiling.end_query(query_id, error=str(e))
+            raise
         ctx.notify(QueryStart(query_id=query_id, plan=repr(optimized.plan)))
         start = time.perf_counter()
         error = None
@@ -51,13 +69,23 @@ class NativeRunner(Runner):
 
             stats = RuntimeStats(query_id)
             ctx.last_query_stats = stats  # DataFrame.metrics() surface
-            executor = Executor(cfg, stats=stats, cancel_token=token)
+            tprof = prof.local_task_profiler() if prof is not None else None
+            executor = Executor(cfg, stats=stats, cancel_token=token,
+                                profiler=tprof)
             # CURRENT_TIMESTAMP is one instant per statement: frozen per
             # resumption (not per generator lifetime) so interleaved lazy
             # queries on one thread can't clobber each other's clock. The
-            # cancel token follows the same per-resumption discipline.
-            yield from iter_with_cancel_scope(
-                iter_with_frozen_clock(executor.run(physical)), token)
+            # cancel token and the ambient profiler follow the same
+            # per-resumption discipline (the daft.execute SPAN still covers
+            # the generator's whole lifetime — ambient=False keeps the
+            # contextvar out of it).
+            with profiling.profiled_task_scope(tprof, name="daft.execute",
+                                               ambient=False):
+                yield from profiling.iter_with_profiler_scope(
+                    iter_with_cancel_scope(
+                        iter_with_frozen_clock(executor.run(physical)),
+                        token),
+                    tprof)
         except BaseException as e:  # noqa: BLE001
             error = str(e)
             raise
@@ -65,3 +93,4 @@ class NativeRunner(Runner):
             unregister_query_token(query_id)
             ctx.notify(QueryEnd(query_id=query_id,
                                 duration_s=time.perf_counter() - start, error=error))
+            profiling.end_query(query_id, error=error)

@@ -67,7 +67,27 @@ async function renderDetail() {
      <td>${(o.cpu_us / 1000).toFixed(1)}</td>
      <td><span class="bar" style="width:${(120 * o.cpu_us / max) | 0}px"></span></td></tr>`
   ).join("");
+  await renderTimeline();
   $("#plan").textContent = q.plan || "";
+}
+
+async function renderTimeline() {
+  // Gantt view of the profiler's span store (profiled queries only): one
+  // row per span, grouped worker·lane, bar position = time in the query.
+  let t;
+  try {
+    const r = await fetch("/api/queries/" + encodeURIComponent(selected) + "/timeline");
+    if (!r.ok) { $("#timeline").innerHTML = ""; return; }
+    t = await r.json();
+  } catch (e) { $("#timeline").innerHTML = ""; return; }
+  const total = Math.max(0.001, ...t.spans.map((s) => s.start_ms + s.dur_ms));
+  $("#timeline").innerHTML = t.spans.map((s) =>
+    `<div class="lane"><span class="lane-label"
+       title="${esc(s.name)}">${esc(s.worker)}·${esc(s.lane)}</span>
+      <span class="track"><span class="gantt ${s.status === "ERROR" ? "err-bar" : ""}"
+        style="left:${(100 * s.start_ms / total).toFixed(2)}%;width:${Math.max(100 * s.dur_ms / total, 0.25).toFixed(2)}%"
+        title="${esc(s.name)} ${s.dur_ms.toFixed(1)}ms${s.rows != null ? " · " + s.rows + " rows" : ""}"></span></span></div>`
+  ).join("");
 }
 
 async function renderWorkers() {

@@ -328,6 +328,19 @@ class _Handler(BaseHTTPRequestHandler):
         elif path == "/api/queries":
             body = json.dumps(self.state.snapshot()).encode()
             ctype = "application/json"
+        elif path.startswith("/api/queries/") and path.endswith("/timeline"):
+            # Per-query Gantt timeline off the profiler's span store
+            # (daft_tpu/profiling.py): present only for profiled queries
+            # (collect(profile=...) / DAFT_PROFILE=1).
+            qid = path.split("/")[3]
+            from daft_tpu import profiling
+
+            tl = profiling.timeline_json(qid)
+            if tl is None:
+                self.send_error(404)
+                return
+            body = json.dumps(tl).encode()
+            ctype = "application/json"
         elif path.startswith("/api/queries/"):
             qid = path.rsplit("/", 1)[1]
             detail = self.state.query_detail(qid)

@@ -45,6 +45,9 @@ class TaskScheduled(Event):
     query_id: str = ""
     task_id: str = ""
     worker_id: str = ""
+    # Execution attempt (0 = first): retries and speculative duplicates
+    # carry their attempt number so profiler spans stay distinguishable.
+    attempt: int = 0
 
 
 @dataclass
@@ -54,6 +57,10 @@ class TaskCompleted(Event):
     worker_id: str = ""
     duration_s: float = 0.0
     error: Optional[str] = None
+    # Which execution attempt finished (matches TaskScheduled.attempt): the
+    # profiler pairs completions to open attempt spans by it, so a retry
+    # landing on the same worker as its original can't close the wrong span.
+    attempt: int = 0
 
 
 @dataclass

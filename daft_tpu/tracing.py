@@ -41,6 +41,26 @@ from daft_tpu.subscribers.events import (
     WorkerLost,
 )
 
+# ------------------------------------------------------------------ #
+# Span clock: ONE monotonic epoch per process                          #
+# ------------------------------------------------------------------ #
+# Span timestamps used to mix clock sources (time.time_ns() at span open,
+# duration-derived ends), so cross-process span nesting could render
+# negative durations when the wall clock stepped mid-span. Every span
+# timestamp now derives from one pair captured at import: a wall-clock
+# anchor plus a perf_counter offset — strictly monotonic within the
+# process, wall-anchored across processes. Residual cross-host skew is
+# corrected by the profiler's heartbeat RTT-midpoint offset estimate
+# (daft_tpu/profiling.py record_worker_clock).
+_EPOCH_WALL_NS = time.time_ns()
+_EPOCH_PERF_NS = time.perf_counter_ns()
+
+
+def span_clock_ns() -> int:
+    """Monotonic, wall-anchored nanoseconds — the clock for ALL span
+    timestamps in this process."""
+    return _EPOCH_WALL_NS + (time.perf_counter_ns() - _EPOCH_PERF_NS)
+
 
 @dataclass
 class Span:
@@ -156,13 +176,13 @@ class Tracer:
             trace_id=trace_id or (parent.trace_id if parent else secrets.token_hex(16)),
             span_id=secrets.token_hex(8),
             parent_id=parent_id or (parent.span_id if parent else None),
-            start_ns=time.time_ns(),
+            start_ns=span_clock_ns(),
             attributes=dict(attributes or {}),
         )
         return _SpanCtx(self, span)
 
     def _finish(self, span: Span) -> None:
-        span.end_ns = time.time_ns()
+        span.end_ns = span_clock_ns()
         self.exporter.export([span])
 
 
@@ -246,7 +266,7 @@ class TracingSubscriber:
         self._lock = threading.Lock()
 
     def on_event(self, e: Event) -> None:
-        now = time.time_ns()
+        now = span_clock_ns()
         with self._lock:
             if isinstance(e, QueryStart):
                 self._open[e.query_id] = Span(

@@ -281,6 +281,38 @@ def test_dtl008_ad_hoc_counter_dict():
     assert findings_for(pos, "daft_tpu/metrics.py", "DTL008") == []
 
 
+def test_dtl009_span_outside_context_manager():
+    pos = """
+    def f(tracer):
+        span = tracer.start_span("daft.query")
+        return span
+    """
+    pos_profiler = """
+    def f(prof):
+        frame = prof.operator_span("Filter", "Filter#0")
+        frame.__enter__()
+    """
+    with_stmt = """
+    def f(tracer, prof):
+        with tracer.start_span("daft.query") as s:
+            with prof.task_scope(None) as root:
+                pass
+    """
+    # ExitStack.enter_context is the sanctioned escape hatch for spans
+    # opened conditionally (the stack still guarantees the end).
+    exit_stack = """
+    import contextlib
+    def f(prof):
+        with contextlib.ExitStack() as st:
+            if prof is not None:
+                st.enter_context(prof.driver_span("daft.plan"))
+    """
+    assert len(findings_for(pos, ANY_PATH, "DTL009")) == 1
+    assert len(findings_for(pos_profiler, ANY_PATH, "DTL009")) == 1
+    assert findings_for(with_stmt, ANY_PATH, "DTL009") == []
+    assert findings_for(exit_stack, ANY_PATH, "DTL009") == []
+
+
 def test_syntax_error_becomes_dtl000_finding():
     findings, _ = lint_source("def broken(:\n", ANY_PATH)
     assert [f.rule for f in findings] == ["DTL000"]
@@ -424,8 +456,8 @@ def test_text_reporter_mentions_location_and_counts():
 def test_rule_registry_complete():
     assert sorted(rules_by_id()) == [
         "DTL001", "DTL002", "DTL003", "DTL004", "DTL005", "DTL006", "DTL007",
-        "DTL008"]
-    assert len(default_rules()) == 8
+        "DTL008", "DTL009"]
+    assert len(default_rules()) == 9
 
 
 def test_package_sweep_has_zero_new_violations():

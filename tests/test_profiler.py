@@ -1,0 +1,562 @@
+"""Distributed query profiler: Chrome-trace schema goldens, trace-context
+wire round-trips, operator spans, clock-skew correction, EXPLAIN ANALYZE's
+per-operator table, the dashboard timeline, and the chaos cases (worker
+killed mid-task exports partial ERROR spans; retried/speculated attempts
+carry attempt numbers)."""
+
+import json
+import threading
+import time
+import urllib.request
+
+import cloudpickle
+import pytest
+
+import daft_tpu
+from daft_tpu import col, profiling
+from daft_tpu.distributed.task import Task
+from daft_tpu.distributed.worker import LocalWorker, WorkerManager
+from daft_tpu.physical import plan as pp
+from daft_tpu.runners.distributed import DistributedRunner
+from daft_tpu.tracing import Span, span_clock_ns
+
+
+@pytest.fixture(autouse=True)
+def _clean_profiler_state():
+    profiling.reset_worker_clocks()
+    yield
+    profiling.reset_worker_clocks()
+    profiling.drain_worker_buffer()
+
+
+@pytest.fixture
+def dist_runner():
+    ctx = daft_tpu.get_context()
+    old = ctx._runner
+    runner = DistributedRunner(num_workers=3)
+    ctx.set_runner(runner)
+    yield runner
+    runner.manager.shutdown()
+    ctx.set_runner(old)
+
+
+def small_df():
+    return daft_tpu.from_pydict({
+        "a": list(range(400)),
+        "b": [i % 5 for i in range(400)],
+    })
+
+
+def profiled_query(path=None):
+    q = (small_df().where(col("a") > 10)
+         .groupby("b").agg(col("a").sum().alias("s")).sort("s"))
+    q.collect(profile=path or True)
+    return profiling.last_profile()
+
+
+# ------------------------------------------------------------------ #
+# Span clock (monotonic epoch satellite)                               #
+# ------------------------------------------------------------------ #
+def test_span_clock_monotonic_and_wall_anchored():
+    t0 = span_clock_ns()
+    samples = [span_clock_ns() for _ in range(100)]
+    assert all(b >= a for a, b in zip(samples, samples[1:]))
+    # Anchored to the wall clock: within a generous drift bound.
+    assert abs(span_clock_ns() - time.time_ns()) < 60 * 1_000_000_000
+    assert span_clock_ns() >= t0
+
+
+def test_spans_never_negative_duration():
+    prof = profiled_query()
+    for s in prof.spans():
+        assert s.end_ns >= s.start_ns, s.name
+
+
+# ------------------------------------------------------------------ #
+# Chrome trace-event export: golden schema pin                         #
+# ------------------------------------------------------------------ #
+def test_chrome_trace_schema_golden(tmp_path):
+    path = str(tmp_path / "trace.json")
+    profiled_query(path)
+    with open(path) as f:
+        trace = json.load(f)  # must be valid JSON (Perfetto loads it)
+    # Top-level schema pin: exactly these keys.
+    assert sorted(trace.keys()) == ["displayTimeUnit", "otherData",
+                                    "traceEvents"]
+    assert trace["displayTimeUnit"] == "ms"
+    assert sorted(trace["otherData"].keys()) == ["dropped_spans", "query_id",
+                                                 "trace_id"]
+    events = trace["traceEvents"]
+    assert events
+    phases = {e["ph"] for e in events}
+    assert phases == {"M", "X"}
+    for e in events:
+        if e["ph"] == "M":
+            assert e["name"] in ("process_name", "thread_name")
+            assert set(e) >= {"ph", "name", "pid", "tid", "args"}
+            assert "name" in e["args"]
+        else:
+            # Complete events: the keys chrome://tracing/Perfetto require.
+            assert set(e) == {"ph", "cat", "name", "pid", "tid", "ts",
+                              "dur", "args"}
+            assert e["ts"] >= 0 and e["dur"] >= 0
+            assert e["args"]["status"] in ("OK", "ERROR")
+    # pid = worker: the driver process is always present and named.
+    proc_names = {e["args"]["name"] for e in events
+                  if e["ph"] == "M" and e["name"] == "process_name"}
+    assert "driver" in proc_names
+    # tid = operator lane: operator spans landed on named lanes.
+    lanes = {e["args"]["name"] for e in events
+             if e["ph"] == "M" and e["name"] == "thread_name"}
+    assert any(lane.startswith("Agg") or lane == "Filter" for lane in lanes)
+
+
+def test_operator_spans_record_timing_and_rows():
+    prof = profiled_query()
+    ops = [s for s in prof.spans() if s.name.startswith("daft.op.")]
+    assert ops
+    by_op = {s.attributes["operator"]: s for s in ops}
+    assert "Filter" in by_op and "Sort" in by_op
+    f = by_op["Filter"].attributes
+    assert f["rows_out"] == 389 and f["morsels"] >= 1
+    assert f["busy_ns"] >= 0 and f["cpu_ns"] >= 0 and f["bytes_out"] > 0
+    assert f["plan_node"].startswith("Filter#")
+    # Every span shares ONE trace id, parented under the query root.
+    roots = [s for s in prof.spans() if s.name == "daft.query"]
+    assert len(roots) == 1
+    assert {s.trace_id for s in prof.spans()} == {prof.trace_id}
+
+
+def test_operator_table_self_time_sorted():
+    prof = profiled_query()
+    table = prof.operator_table()
+    assert table
+    selfs = [r["self_wall_ns"] for r in table]
+    assert selfs == sorted(selfs, reverse=True)
+    total = {r["operator"]: r for r in table}
+    assert total["InMemorySource"]["rows"] == 400
+    # Self wall never exceeds inclusive wall.
+    for r in table:
+        assert 0 <= r["self_wall_ns"] <= r["wall_ns"] or r["wall_ns"] == 0
+
+
+def test_profile_disabled_is_inert():
+    df = small_df().where(col("a") > 10)
+    before = profiling.last_profile()
+    df.collect()  # no profile requested
+    assert profiling.last_profile() is before
+    # Hot-path hooks are no-ops with nothing active.
+    profiling.note_spill(123)
+    profiling.note_permit_wait(0.5)
+    profiling.note_device(10, fused=True)
+
+
+def test_daft_profile_0_overrides_baked_config(monkeypatch):
+    # DAFT_PROFILE is the documented LIVE process-wide switch: explicitly
+    # =0 must win over a context that baked profile_enabled=True at
+    # creation (and =1 still wins over a False config).
+    import types
+
+    baked = types.SimpleNamespace(profile_enabled=True)
+    monkeypatch.setenv("DAFT_PROFILE", "0")
+    assert profiling.begin_query("q-env-off", baked) is None
+    monkeypatch.delenv("DAFT_PROFILE")
+    prof = profiling.begin_query("q-cfg-on", baked)
+    assert prof is not None
+    profiling.end_query("q-cfg-on")
+
+
+def test_collect_profile_true_lands_on_dataframe():
+    df = small_df().where(col("a") > 10)
+    assert df.query_profile is None
+    df.collect(profile=True)
+    # THIS query's finished profile, not the racy process-global.
+    assert df.query_profile is not None and df.query_profile.finished
+    assert df.query_profile.operator_table()
+
+
+def test_planning_failure_does_not_leak_profile(monkeypatch):
+    # begin_query registers in the process-global store BEFORE the
+    # execution try/finally exists: a failure in optimize/translate must
+    # still close the profile or every failed profiled query leaks one.
+    import daft_tpu.runners.native as native_mod
+
+    def boom(plan, cfg):
+        raise RuntimeError("untranslatable")
+
+    monkeypatch.setattr(native_mod, "translate", boom)
+    with profiling.collect_profile() as req:
+        with pytest.raises(RuntimeError, match="untranslatable"):
+            small_df().where(col("a") > 10).collect()
+    assert profiling._PROFILES == {}
+    assert req.profile is not None and req.profile.error is not None
+
+
+def test_interleaved_lazy_profiled_queries_do_not_clobber(monkeypatch):
+    # The native runner's run_iter is a GENERATOR: its ambient-profiler
+    # contextvar must be set per resumption (iter_with_profiler_scope),
+    # not for the generator's lifetime — otherwise two lazily-consumed
+    # profiled queries interleaved on one thread clobber each other's
+    # profiler and closing one resets the var out from under the other.
+    monkeypatch.setenv("DAFT_PROFILE", "1")
+    it_a = small_df().where(col("a") > 10).iter_partitions()
+    it_b = small_df().where(col("a") > 100).iter_partitions()
+    next(it_a)
+    next(it_b)  # B's scope opens while A is mid-flight
+    # Between resumptions the caller's context carries NO profiler.
+    assert profiling._current_profiler.get() is None
+    for it in (it_a, it_b):
+        for _ in it:
+            pass
+    assert profiling._current_profiler.get() is None
+
+
+# ------------------------------------------------------------------ #
+# Wire round-trips                                                     #
+# ------------------------------------------------------------------ #
+def test_trace_context_rides_task_through_pickle_wire():
+    src = pp.InMemorySource([], schema=small_df().schema)
+    with profiling.collect_profile():
+        prof = profiling.begin_query("q-wire-test")
+        try:
+            with profiling.trace_scope(prof):
+                task = Task(fragment=src, query_id="q-wire-test")
+            assert task.trace_ctx == prof.trace_ctx
+            clone = cloudpickle.loads(cloudpickle.dumps(task))
+            assert clone.trace_ctx == (prof.trace_id, prof.root.span_id)
+            assert clone.attempt == task.attempt == 0
+        finally:
+            profiling.end_query("q-wire-test")
+    # Outside a trace scope Tasks carry no context (nothing to profile).
+    assert Task(fragment=src).trace_ctx is None
+
+
+def test_span_wire_roundtrip():
+    span = Span(name="daft.op.Filter", trace_id="t" * 32, span_id="s" * 16,
+                parent_id="p" * 16, start_ns=123, end_ns=456,
+                status="ERROR", attributes={"operator": "Filter",
+                                            "rows_out": 7, "partial": True})
+    clone = profiling.span_from_wire(profiling.span_to_wire(span))
+    assert clone == span
+
+
+def test_clock_skew_rtt_midpoint_correction():
+    prof = profiling.QueryProfile("q-skew")
+    skew = 5_000_000_000  # worker clock 5s ahead
+    now = span_clock_ns()
+    # Heartbeat sample: worker answered mid-RTT with its (skewed) clock.
+    profiling.record_worker_clock("w1", now + skew + 500_000,
+                                  now, now + 1_000_000)
+    s = Span(name="daft.task.run", trace_id=prof.trace_id,
+             span_id="a" * 16, start_ns=now + skew,
+             end_ns=now + skew + 1_000_000,
+             attributes={"worker_id": "w1", "query_id": "q-skew"})
+    prof.add_wires([profiling.span_to_wire(s)])
+    corrected = [x for x in prof.spans() if x.name == "daft.task.run"][0]
+    # Corrected onto the driver's clock: within the RTT of `now`.
+    assert abs(corrected.start_ns - now) < 10_000_000
+    assert corrected.end_ns - corrected.start_ns == 1_000_000
+
+
+def test_clock_skew_noisy_sample_does_not_clobber_crisp_one():
+    profiling.record_worker_clock("w2", 1_000_000, 0, 2_000)  # rtt 2µs
+    crisp = profiling.worker_clock_offsets()["w2"]
+    # A 100x-noisier sample with a wild offset is rejected.
+    profiling.record_worker_clock("w2", 99_000_000, 0, 200_000)
+    assert profiling.worker_clock_offsets()["w2"] == crisp
+
+
+def test_clock_skew_reanchors_after_lasting_rtt_shift():
+    # A PERMANENT RTT increase (route change) must not freeze the offset
+    # forever: after a run of rejected samples the estimate re-anchors.
+    profiling.record_worker_clock("w3", 1_000_000, 0, 2_000)
+    crisp = profiling.worker_clock_offsets()["w3"]
+    for _ in range(profiling._CLOCK_REANCHOR_AFTER):
+        profiling.record_worker_clock("w3", 99_000_000, 0, 200_000)
+    assert profiling.worker_clock_offsets()["w3"] != crisp
+    # ... and a post-re-anchor crisp-enough sample tracks again.
+    profiling.record_worker_clock("w3", 50_000_000, 0, 150_000)
+    assert profiling.worker_clock_offsets()["w3"] == 50_000_000 - 75_000
+
+
+def test_worker_buffer_overflow_is_counted_not_silent():
+    try:
+        base = {"name": "daft.op.X",
+                "attributes": {"query_id": "q-ovf", "worker_id": "w"}}
+        profiling.buffer_spans([dict(base)
+                                for _ in range(profiling._MAX_BUFFERED + 25)])
+        wires = profiling.drain_worker_buffer()
+        markers = [w for w in wires if w["name"] == profiling.DROP_MARKER]
+        assert len(wires) == profiling._MAX_BUFFERED + 1
+        assert markers[0]["attributes"] == {"query_id": "q-ovf",
+                                            "dropped_spans": 25}
+        # The driver folds the marker into dropped_spans, not the timeline.
+        prof = profiling.QueryProfile("q-ovf")
+        prof.add_wires(markers)
+        assert prof._dropped == 25
+        assert all(s.name != profiling.DROP_MARKER for s in prof.spans())
+    finally:
+        profiling.drain_worker_buffer()
+
+
+def test_profile_true_stays_in_memory_despite_env_file(tmp_path, monkeypatch):
+    # DAFT_PROFILE_FILE applies to env-triggered profiling only: an explicit
+    # collect(profile=True) scope asked for an in-memory trace and must not
+    # overwrite the file the env var was set to keep.
+    target = tmp_path / "keep.json"
+    target.write_text("sentinel")
+    monkeypatch.setenv("DAFT_PROFILE_FILE", str(target))
+    with profiling.collect_profile() as req:
+        small_df().where(col("a") > 10).collect()
+    assert req.profile is not None and req.profile.export_path is None
+    assert target.read_text() == "sentinel"
+
+
+# ------------------------------------------------------------------ #
+# Distributed: one coherent trace across workers                       #
+# ------------------------------------------------------------------ #
+def test_distributed_single_trace_covers_driver_and_workers(dist_runner):
+    df = small_df().into_partitions(6)
+    (df.where(col("a") > 10).groupby("b")
+       .agg(col("a").sum().alias("s"))).collect(profile=True)
+    prof = profiling.last_profile()
+    spans = prof.spans()
+    assert {s.trace_id for s in spans} == {prof.trace_id}
+    workers = {s.attributes.get("worker_id") for s in spans}
+    assert "driver" in workers and len(workers) >= 3  # driver + >=2 workers
+    names = {s.name for s in spans}
+    assert {"daft.query", "daft.plan", "daft.task",
+            "daft.task.run"} <= names
+    # Worker-side operator spans parent (transitively) into the trace.
+    ops = [s for s in spans if s.name.startswith("daft.op.")]
+    assert ops and all(s.parent_id for s in ops)
+    run_ids = {s.span_id for s in spans if s.name == "daft.task.run"}
+    top_level_ops = [s for s in ops if s.parent_id in run_ids]
+    assert top_level_ops
+
+
+def test_distributed_operator_table_merges_worker_spans(dist_runner):
+    df = small_df().into_partitions(4)
+    df.where(col("a") >= 0).collect(profile=True)
+    table = profiling.last_profile().operator_table()
+    rows = {r["operator"]: r["rows"] for r in table}
+    assert rows.get("Filter") == 400  # summed across all workers' tasks
+
+
+# ------------------------------------------------------------------ #
+# EXPLAIN ANALYZE per-operator table                                   #
+# ------------------------------------------------------------------ #
+def test_explain_analyze_operator_table(capsys):
+    q = small_df().where(col("a") > 100).groupby("b").agg(
+        col("a").sum().alias("s"))
+    q.explain(analyze=True)
+    out = capsys.readouterr().out
+    assert "== Analyze ==" in out
+    assert "operators (by self time):" in out
+    assert "permit_ms" in out and "spill" in out
+    assert "Filter" in out
+
+
+# ------------------------------------------------------------------ #
+# Dashboard timeline                                                   #
+# ------------------------------------------------------------------ #
+def test_dashboard_timeline_endpoint():
+    from daft_tpu.subscribers.dashboard import DashboardServer
+
+    server = DashboardServer().start()
+    try:
+        prof = profiled_query()
+        url = f"{server.url}/api/queries/{prof.query_id}/timeline"
+        tl = json.load(urllib.request.urlopen(url))
+        assert tl["query_id"] == prof.query_id
+        assert tl["trace_id"] == prof.trace_id and tl["finished"]
+        assert tl["spans"]
+        for row in tl["spans"]:
+            assert row["start_ms"] >= 0 and row["dur_ms"] >= 0
+            assert row["worker"] and row["lane"]
+        # Unprofiled/unknown queries 404 instead of serving an empty shell.
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(
+                f"{server.url}/api/queries/nope/timeline")
+    finally:
+        server.shutdown()
+
+
+# ------------------------------------------------------------------ #
+# Chaos: spans survive worker death; attempts are attributed           #
+# ------------------------------------------------------------------ #
+@pytest.mark.chaos
+def test_worker_killed_mid_task_exports_partial_error_span(dist_runner):
+    from daft_tpu.distributed.faults import fault_scope
+
+    df = small_df().into_partitions(6)
+    q = df.where(col("a") > 10).groupby("b").agg(col("a").sum().alias("s"))
+    with fault_scope("worker.pre_submit:kill:3", seed=0):
+        q.collect(profile=True)  # survives via retry on another worker
+    prof = profiling.last_profile()
+    spans = prof.spans()
+    # The killed attempt's driver span still exported: partial, ERROR.
+    errs = [s for s in spans if s.name == "daft.task"
+            and s.status == "ERROR" and s.attributes.get("partial")]
+    assert errs, "no partial ERROR span for the killed attempt"
+    # The retried attempt carries its attempt number.
+    retried = [s for s in spans if s.name == "daft.task"
+               and s.attributes.get("attempt", 0) >= 1]
+    assert retried, "retried attempt missing attempt attribute"
+    # And the query's data spans all still assemble under one trace.
+    assert {s.trace_id for s in spans} == {prof.trace_id}
+
+
+@pytest.mark.chaos
+def test_speculative_attempt_carries_attempt_number():
+    """A straggler duplicate's dispatch span records attempt >= 1, and the
+    abandoned loser closes as superseded — never as a failure."""
+    from concurrent.futures import Future
+
+    from daft_tpu.distributed.partition_ref import LocalPartitionRef
+    from daft_tpu.distributed.scheduler import Dispatcher, Scheduler
+    from daft_tpu.distributed.task import BoundInput
+    from daft_tpu.distributed.worker import Worker
+    from daft_tpu.micropartition import MicroPartition
+
+    class ScriptedWorker(Worker):
+        def __init__(self, worker_id, delay):
+            self.worker_id = worker_id
+            self.num_slots = 4
+            self.delay = delay
+
+        def submit(self, task):
+            fut = Future()
+            mp = MicroPartition.from_pydict({"x": [1]})
+
+            def run():
+                time.sleep(self.delay)
+                if not fut.cancelled():
+                    fut.set_result([LocalPartitionRef(mp, self.worker_id)])
+
+            threading.Thread(target=run, daemon=True).start()
+            return fut
+
+        def active_tasks(self):
+            return 0
+
+    fast = ScriptedWorker("fast", delay=0.02)
+    slow = ScriptedWorker("slow", delay=8.0)
+    manager = WorkerManager([fast, slow])
+    cfg = daft_tpu.get_context().execution_config.with_changes(
+        speculative_execution=True, speculative_multiplier=2.0,
+        speculative_min_completed=2)
+    mp = daft_tpu.from_pydict({"a": [1]})._materialize().partitions[0]
+    with profiling.collect_profile():
+        prof = profiling.begin_query("q-spec")
+    assert prof is not None
+    try:
+        with profiling.trace_scope(prof):
+            tasks = [Task(BoundInput(0, mp.schema), [[LocalPartitionRef(mp)]],
+                          query_id="q-spec") for _ in range(6)]
+        dispatcher = Dispatcher(Scheduler(manager), cfg=cfg)
+        results = dispatcher.run_tasks(tasks)
+        assert len(results) == len(tasks)
+    finally:
+        profiling.end_query("q-spec")
+        manager.shutdown()
+    spans = profiling.last_profile().spans()
+    attempts = {s.attributes.get("attempt", 0) for s in spans
+                if s.name in ("daft.task", "daft.task.run")}
+    assert 0 in attempts
+    assert any(a >= 1 for a in attempts), \
+        "speculative duplicate did not record its attempt number"
+    # A healthy speculated query renders NO failure bars: cancelled loser
+    # attempts close as superseded, never status=ERROR/partial.
+    task_spans = [s for s in spans if s.name == "daft.task"]
+    assert all(s.status == "OK" for s in task_spans), \
+        [s.attributes for s in task_spans if s.status != "OK"]
+
+
+@pytest.mark.chaos
+def test_daemon_heartbeat_ships_spans_and_clock(tmp_path):
+    """Daemon-backed query: spans cross the TCP wire (task replies +
+    heartbeat piggyback), the driver records a clock-offset estimate, and
+    the assembled trace covers the daemon's per-operator execution."""
+    from daft_tpu.distributed.daemon import (
+        RemoteWorker,
+        spawn_local_daemon,
+        wait_for_daemon,
+    )
+
+    proc = spawn_local_daemon(slots=2)
+    try:
+        addr = wait_for_daemon(proc)
+        worker = RemoteWorker(addr)
+        manager = WorkerManager([worker])
+        runner = DistributedRunner(manager=manager)
+        ctx = daft_tpu.get_context()
+        old = ctx._runner
+        ctx.set_runner(runner)
+        try:
+            df = small_df().into_partitions(3)
+            path = str(tmp_path / "daemon_trace.json")
+            (df.where(col("a") > 10).groupby("b")
+               .agg(col("a").sum().alias("s"))).collect(profile=path)
+            prof = profiling.last_profile()
+            spans = prof.spans()
+            assert {s.trace_id for s in spans} == {prof.trace_id}
+            remote_ops = [s for s in spans if s.name.startswith("daft.op.")
+                          and s.attributes.get("worker_id") == worker.worker_id]
+            assert remote_ops, "no operator spans came back over the wire"
+            # The constructor ping sampled the daemon's span clock.
+            assert worker.worker_id in profiling.worker_clock_offsets()
+            trace = json.load(open(path))  # valid Chrome trace JSON
+            procs = {e["args"]["name"] for e in trace["traceEvents"]
+                     if e["ph"] == "M" and e["name"] == "process_name"}
+            assert worker.worker_id in procs and "driver" in procs
+        finally:
+            ctx.set_runner(old)
+            manager.shutdown()
+    finally:
+        try:
+            proc.kill()
+        except OSError:
+            pass
+
+
+# ------------------------------------------------------------------ #
+# daftlint DTL009                                                      #
+# ------------------------------------------------------------------ #
+def test_dtl009_span_outside_with():
+    import textwrap
+
+    from daft_tpu.lint import lint_source
+
+    def findings(code):
+        out, _ = lint_source(textwrap.dedent(code), "daft_tpu/snippet.py")
+        return [f for f in out if f.rule == "DTL009"]
+
+    pos = """
+    def f(tracer):
+        span = tracer.start_span("daft.query")
+        span.attributes["x"] = 1
+    """
+    assert len(findings(pos)) == 1
+    neg_with = """
+    def f(tracer, prof):
+        with tracer.start_span("daft.query") as s:
+            pass
+        with prof.operator_span("Filter", "Filter#0") as frame:
+            pass
+    """
+    assert findings(neg_with) == []
+    neg_exitstack = """
+    import contextlib
+    def f(prof):
+        with contextlib.ExitStack() as st:
+            if prof is not None:
+                st.enter_context(prof.task_scope(None))
+    """
+    assert findings(neg_exitstack) == []
+    pos_profiler = """
+    def f(prof):
+        cm = prof.task_scope(None)
+        cm.__enter__()
+    """
+    assert len(findings(pos_profiler)) == 1
