@@ -902,12 +902,15 @@ class QueryProfile:
         with open(path, "w") as f:
             json.dump(self.to_chrome_trace(), f)
 
-    def operator_table(self) -> List[dict]:
+    def operator_table(self, by: str = "operator") -> List[dict]:
         """Per-operator aggregate over the trace's ``daft.op.*`` spans:
-        rows, inclusive wall, SELF wall/CPU (inclusive minus direct
-        children — on a serial chain self sums ≈ query time), spill bytes,
-        and memory-permit wait; sorted by self wall descending (the
-        EXPLAIN ANALYZE table)."""
+        rows/bytes out, inclusive wall, SELF wall/CPU (inclusive minus
+        direct children — on a serial chain self sums ≈ query time), spill
+        bytes, and memory-permit wait; sorted by self wall descending (the
+        EXPLAIN ANALYZE table). ``by="plan_node"`` keys rows on the plan
+        node id (``HashJoin#3``) instead of the operator name, so two
+        instances of one operator stay attributable — the granularity the
+        perf observatory's span-diff reports regress against."""
         ops = [s for s in self.spans() if s.name.startswith("daft.op.")]
         child_busy: Dict[str, int] = {}
         child_cpu: Dict[str, int] = {}
@@ -922,17 +925,22 @@ class QueryProfile:
         for s in ops:
             a = s.attributes
             op = str(a.get("operator") or s.name)
+            key = op if by != "plan_node" else str(a.get("plan_node") or op)
             busy = int(a.get("busy_ns", 0))
             cpu = int(a.get("cpu_ns", 0))
-            r = agg.setdefault(op, {
+            r = agg.setdefault(key, {
                 "operator": op, "rows": 0, "wall_ns": 0, "self_wall_ns": 0,
-                "self_cpu_ns": 0, "spill_bytes": 0, "permit_wait_ns": 0,
-                "morsels": 0, "device_rows": 0, "fallback_rows": 0})
+                "self_cpu_ns": 0, "bytes_out": 0, "spill_bytes": 0,
+                "permit_wait_ns": 0, "morsels": 0, "device_rows": 0,
+                "fallback_rows": 0})
+            if by == "plan_node":
+                r["plan_node"] = key
             r["rows"] += int(a.get("rows_out", 0))
             r["morsels"] += int(a.get("morsels", 0))
             r["wall_ns"] += busy
             r["self_wall_ns"] += max(busy - child_busy.get(s.span_id, 0), 0)
             r["self_cpu_ns"] += max(cpu - child_cpu.get(s.span_id, 0), 0)
+            r["bytes_out"] += int(a.get("bytes_out", 0))
             r["spill_bytes"] += int(a.get("spill_bytes", 0))
             r["permit_wait_ns"] += int(a.get("permit_wait_ns", 0))
             r["device_rows"] += int(a.get("device_rows", 0))

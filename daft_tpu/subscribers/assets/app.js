@@ -109,6 +109,67 @@ async function renderWorkers() {
   ).join("");
 }
 
+let perfSuite = null;
+
+function sparkline(points, w = 170, h = 34) {
+  // Single-series trend, newest right: 2px accent line, 3px dot on the
+  // latest point; y spans [0, max] so a shrinking bar means faster.
+  const vals = points.filter((v) => v != null);
+  if (!vals.length) return "";
+  const max = Math.max(...vals, 1e-9);
+  const dx = points.length > 1 ? w / (points.length - 1) : 0;
+  const xy = points.map((v, i) =>
+    v == null ? null : [i * dx, h - 3 - (h - 6) * (v / max)]);
+  const poly = xy.filter(Boolean).map((p) => p.map((c) => c.toFixed(1)).join(","))
+    .join(" ");
+  const last = xy.filter(Boolean).pop();
+  return `<svg viewBox="0 0 ${w} ${h}" width="${w}" height="${h}">
+    <polyline points="${poly}" fill="none" class="spark-line"/>
+    <circle cx="${last[0].toFixed(1)}" cy="${last[1].toFixed(1)}" r="3"
+      class="spark-dot"/></svg>`;
+}
+
+async function renderPerf() {
+  const qs = perfSuite ? "?suite=" + encodeURIComponent(perfSuite) : "";
+  const t = await getJSON("/api/perf/trajectory" + qs);
+  perfSuite = t.suite;
+  $("#perf-suites").innerHTML = t.suites.map((s) =>
+    `<button data-suite="${esc(s)}" class="${s === t.suite ? "active" : ""}">
+      ${esc(s)}</button>`).join("");
+  document.querySelectorAll("#perf-suites button").forEach((b) =>
+    b.addEventListener("click", () => { perfSuite = b.dataset.suite; renderPerf(); }));
+  const names = [...new Set(t.entries.flatMap((e) => Object.keys(e.queries)))];
+  const card = (label, series, latest) => {
+    const title = t.entries.map((e, i) =>
+      `${e.sha || "?"}: ${series[i] == null ? "-" : series[i].toFixed(3) + "s"}`
+    ).join("\n");
+    return `<div class="spark-card" title="${esc(title)}">
+      <div class="spark-head"><span class="spark-name">${esc(label)}</span>
+        <span class="spark-val">${latest == null ? "-" : latest.toFixed(3) + "s"}</span></div>
+      ${sparkline(series)}</div>`;
+  };
+  const cards = names.map((n) => {
+    const series = t.entries.map((e) => e.queries[n] ?? null);
+    return card(n, series, series[series.length - 1]);
+  });
+  const totals = t.entries.map((e) => e.total_wall_s ?? null);
+  if (totals.length)
+    cards.unshift(card("TOTAL", totals, totals[totals.length - 1]));
+  $("#spark-grid").innerHTML = cards.join("") ||
+    '<p class="hint">no trajectory entries yet</p>';
+  const r = await getJSON("/api/perf/regressions" + qs);
+  $("#regressions tbody").innerHTML = (r && r.queries ? r.queries : []).map((q) => {
+    const tops = q.operators.slice(0, 2)
+      .filter((o) => o.delta_self_wall_ns)
+      .map((o) => `${o.key} ${(o.delta_self_wall_ns / 1e9).toFixed(3)}s`)
+      .join("; ");
+    return `<tr><td>${esc(q.name)}</td><td>${q.base_wall_s.toFixed(3)}</td>
+      <td>${q.cur_wall_s.toFixed(3)}</td><td>${q.delta_s.toFixed(3)}</td>
+      <td class="${q.calibrated_pct >= 10 ? "err" : "ok"}">
+        ${q.calibrated_pct.toFixed(1)}%</td><td>${esc(tops)}</td></tr>`;
+  }).join("") || '<tr><td colspan="6" class="hint">need two entries to diff</td></tr>';
+}
+
 async function renderDataframes() {
   const dfs = await getJSON("/api/dataframes");
   $("#dataframes").innerHTML = dfs.map((d) =>
@@ -136,6 +197,7 @@ async function tick() {
     await renderSummary();
     if (view === "queries") await renderQueries();
     else if (view === "workers") await renderWorkers();
+    else if (view === "perf") await renderPerf();
     else await renderDataframes();
   } catch (e) { /* server restarting */ }
 }

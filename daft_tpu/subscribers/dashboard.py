@@ -349,6 +349,41 @@ class _Handler(BaseHTTPRequestHandler):
                 return
             body = json.dumps(detail, default=str).encode()
             ctype = "application/json"
+        elif path == "/api/perf/trajectory":
+            # Per-query wall series over the committed bench trajectory
+            # (BENCH_TRAJECTORY.jsonl / DAFT_TRAJECTORY_PATH) — the
+            # dashboard's sparkline trend view.
+            from daft_tpu import perf_report
+
+            q = urllib.parse.parse_qs(parsed.query)
+            entries = perf_report.load_trajectory()
+            suites = sorted({e["suite"] for e in entries})
+            suite = q.get("suite", [""])[0] \
+                or (entries[-1]["suite"] if entries else "")
+            rows = [{
+                "sha": e.get("sha", ""),
+                "captured_at": e.get("captured_at", ""),
+                "total_wall_s": e.get("total_wall_s", 0.0),
+                "peak_rss_bytes": e.get("peak_rss_bytes", 0),
+                "queries": {r["name"]: r["wall_s"] for r in e["queries"]},
+            } for e in entries if e["suite"] == suite]
+            body = json.dumps({"suite": suite, "suites": suites,
+                               "entries": rows}).encode()
+            ctype = "application/json"
+        elif path == "/api/perf/regressions":
+            # Span-diff of the suite's last two trajectory entries: the
+            # regression panel (ranked per-operator attribution).
+            from daft_tpu import perf_report
+
+            q = urllib.parse.parse_qs(parsed.query)
+            suite = q.get("suite", [None])[0]
+            entries = perf_report.load_trajectory(suite=suite)
+            if suite is None and entries:
+                suite = entries[-1]["suite"]
+                entries = [e for e in entries if e["suite"] == suite]
+            report = perf_report.diff_latest(entries)
+            body = json.dumps(report.to_json() if report else None).encode()
+            ctype = "application/json"
         elif path == "/metrics":
             # Prometheus text exposition straight off the unified registry
             # (driver-local series + live worker snapshots merged from the

@@ -1,0 +1,363 @@
+"""Performance observatory CLI: capture, diff, and gate perf trajectories.
+
+Runs TPC-H-shaped queries (tests/benchmarks/tpch_data.py generator) and a
+relational micro-suite under the query profiler, appends one structured
+entry per run to BENCH_TRAJECTORY.jsonl (keyed by git SHA; schema in
+daft_tpu/perf_report.py), and span-diffs any two entries into a ranked
+per-operator regression report.
+
+  python scripts/perf_observatory.py --suite tpch            # capture+append
+  python scripts/perf_observatory.py --suite micro --json    # print entry
+  python scripts/perf_observatory.py --diff-last             # report table
+  python scripts/perf_observatory.py --diff <shaA> <shaB>
+  python scripts/perf_observatory.py --check --suite micro   # CI gate
+  python scripts/perf_observatory.py --overhead-check        # <2% recording
+
+The CI gate (--check) compares a fresh capture against the LAST committed
+entry for the suite. Cross-machine honesty comes from median-ratio
+calibration (a uniformly slower runner flags nothing); a failing verdict
+escalates once with tripled per-query rounds before it is believed — the
+PR 5/6 overhead-guard discipline.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+sys.path.insert(0, os.path.join(_REPO, "tests"))
+
+import jax
+
+# The observatory measures the RELATIONAL engine; never touch (or wedge) a
+# TPU backend for it.
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+import daft_tpu  # noqa: E402
+from daft_tpu import col, lit, perf_report  # noqa: E402
+
+DEFAULT_TPCH_ROWS = 600_000
+DEFAULT_MICRO_ROWS = 400_000
+
+OVERHEAD_LIMIT_PCT = float(
+    os.environ.get("DAFT_OBSERVATORY_OVERHEAD_LIMIT_PCT", "2.0"))
+
+
+# --------------------------------------------------------------------- #
+# Suites: name -> (lazy-DataFrame builders over shared tables)           #
+# --------------------------------------------------------------------- #
+def tpch_suite(scale_rows: int):
+    """TPC-H-shaped per-query builders over the seeded generator tables
+    (q01/q03/q05/q06/q18 shapes — the columns tpch_data.py carries)."""
+    import datetime
+
+    from benchmarks.tpch_data import generate_tpch
+
+    t = generate_tpch(scale_rows)
+    li, orders, cust, nation = (t["lineitem"], t["orders"], t["customer"],
+                                t["nation"])
+
+    def q01():
+        return (li.where(col("l_shipdate") <= lit(datetime.date(1998, 9, 2)))
+                .groupby("l_returnflag", "l_linestatus")
+                .agg(col("l_quantity").sum().alias("sum_qty"),
+                     col("l_extendedprice").sum().alias("sum_base_price"),
+                     (col("l_extendedprice") * (1 - col("l_discount")))
+                     .sum().alias("sum_disc_price"),
+                     (col("l_extendedprice") * (1 - col("l_discount"))
+                      * (1 + col("l_tax"))).sum().alias("sum_charge"),
+                     col("l_quantity").mean().alias("avg_qty"),
+                     col("l_discount").mean().alias("avg_disc"),
+                     col("l_quantity").count().alias("count_order"))
+                .sort(["l_returnflag", "l_linestatus"]))
+
+    def q03():
+        cutoff = datetime.date(1995, 3, 15)
+        return (cust.where(col("c_mktsegment") == "BUILDING")
+                .join(orders.where(col("o_orderdate") < lit(cutoff)),
+                      left_on="c_custkey", right_on="o_custkey")
+                .join(li.where(col("l_shipdate") > lit(cutoff)),
+                      left_on="o_orderkey", right_on="l_orderkey")
+                .with_column("revenue", col("l_extendedprice")
+                             * (1 - col("l_discount")))
+                .groupby("o_orderkey", "o_orderdate", "o_shippriority")
+                .agg(col("revenue").sum().alias("revenue"))
+                .sort(["revenue", "o_orderdate"], desc=[True, False])
+                .limit(10))
+
+    def q05():
+        return (cust.join(nation, left_on="c_nationkey",
+                          right_on="n_nationkey")
+                .join(orders, left_on="c_custkey", right_on="o_custkey")
+                .join(li, left_on="o_orderkey", right_on="l_orderkey")
+                .with_column("revenue", col("l_extendedprice")
+                             * (1 - col("l_discount")))
+                .groupby("n_name")
+                .agg(col("revenue").sum().alias("revenue"))
+                .sort("revenue", desc=True))
+
+    def q06():
+        lo, hi = datetime.date(1994, 1, 1), datetime.date(1995, 1, 1)
+        return (li.where((col("l_shipdate") >= lit(lo))
+                         & (col("l_shipdate") < lit(hi))
+                         & (col("l_discount") >= 0.03)
+                         & (col("l_discount") <= 0.07)
+                         & (col("l_quantity") < 24))
+                .agg((col("l_extendedprice") * col("l_discount"))
+                     .sum().alias("revenue")))
+
+    def q18():
+        big = (li.groupby("l_orderkey")
+               .agg(col("l_quantity").sum().alias("sum_qty"))
+               .where(col("sum_qty") > 150))
+        return (big.join(orders, left_on="l_orderkey",
+                         right_on="o_orderkey")
+                .join(cust, left_on="o_custkey", right_on="c_custkey")
+                .sort(["o_totalprice", "o_orderdate"],
+                      desc=[True, False])
+                .limit(100))
+
+    return [("q01", q01), ("q03", q03), ("q05", q05), ("q06", q06),
+            ("q18", q18)]
+
+
+def micro_suite(n: int):
+    """Single-operator-dominated relational micros: each isolates one hot
+    path (scan+filter, fused projection, hash join, grouped agg, sort) so
+    a span-diff regression lands on exactly one plan node."""
+    rng = np.random.default_rng(0)
+    fact = daft_tpu.from_pydict({
+        "k": np.arange(n, dtype=np.int64),
+        "fk": rng.integers(0, max(n // 8, 1), n),
+        "x": rng.random(n),
+        "y": rng.random(n),
+        "g": rng.integers(0, 64, n)})
+    dim = daft_tpu.from_pydict({
+        "dk": np.arange(max(n // 8, 1), dtype=np.int64),
+        "seg": rng.integers(0, 5, max(n // 8, 1))})
+
+    def scan_filter():
+        return (fact.where((col("x") > 0.25) & (col("y") < 0.9))
+                .agg(col("k").count().alias("n")))
+
+    def project_fused():
+        return (fact.with_column(
+            "v", (col("x") * 2.0 + col("y")) * (1.0 - col("x")) + 0.5)
+            .agg(col("v").sum().alias("s")))
+
+    def hash_join():
+        return (fact.join(dim, left_on="fk", right_on="dk")
+                .agg(col("x").sum().alias("s")))
+
+    def groupby_agg():
+        return (fact.groupby("g")
+                .agg(col("x").sum().alias("sx"),
+                     col("y").mean().alias("my"),
+                     col("k").count().alias("n"))
+                .sort("g"))
+
+    def sort_topk():
+        return fact.sort("x", desc=True).limit(100)
+
+    return [("scan_filter", scan_filter), ("project_fused", project_fused),
+            ("hash_join", hash_join), ("groupby_agg", groupby_agg),
+            ("sort_topk", sort_topk)]
+
+
+def build_suite(name: str, args):
+    if name == "tpch":
+        return tpch_suite(args.scale_rows), {"scale_rows": args.scale_rows}
+    if name == "micro":
+        return micro_suite(args.micro_rows), {"micro_rows": args.micro_rows}
+    raise SystemExit(f"unknown suite {name!r} (tpch|micro)")
+
+
+# --------------------------------------------------------------------- #
+# Capture / diff / gate                                                 #
+# --------------------------------------------------------------------- #
+def run_capture(args, rounds=None) -> dict:
+    queries, cfg = build_suite(args.suite, args)
+    rounds = rounds if rounds is not None else args.rounds
+    cfg = dict(cfg, rounds=rounds)
+    records = []
+    for name, build in queries:
+        build().limit(1).collect()  # warm plan/jit caches outside the clock
+        rec = perf_report.capture_query(name, build, rounds=rounds)
+        print(f"  {name}: {rec['wall_s']:.3f}s "
+              f"({len(rec['operators'])} operators)", file=sys.stderr)
+        records.append(rec)
+    return perf_report.build_entry(args.suite, records, config=cfg)
+
+
+def cmd_capture(args) -> int:
+    t0 = time.perf_counter()
+    entry = run_capture(args)
+    print(f"suite {args.suite}: {entry['total_wall_s']:.3f}s total "
+          f"({time.perf_counter() - t0:.1f}s incl. datagen)",
+          file=sys.stderr)
+    if args.json:
+        print(json.dumps(entry, indent=1, sort_keys=True))
+    if not args.no_append:
+        path = perf_report.append_entry(entry, args.out)
+        print(f"appended entry sha={entry['sha'] or '?'} to {path}",
+              file=sys.stderr)
+    traj = perf_report.load_trajectory(args.out, suite=args.suite)
+    report = perf_report.diff_latest(traj)
+    if report is not None:
+        print(report.format_table())
+    return 0
+
+
+def _entry_by_ref(traj, ref: str):
+    """A trajectory entry by SHA (prefix ok), or by index (-1 = latest)."""
+    try:
+        return traj[int(ref)]
+    except (ValueError, IndexError):
+        pass
+    for entry in reversed(traj):
+        if entry.get("sha", "").startswith(ref):
+            return entry
+    raise SystemExit(f"no trajectory entry matches {ref!r}")
+
+
+def cmd_diff(args) -> int:
+    traj = perf_report.load_trajectory(args.out, suite=args.suite)
+    if args.diff_last:
+        report = perf_report.diff_latest(traj)
+        if report is None:
+            raise SystemExit(
+                f"need >= 2 {args.suite} entries in the trajectory "
+                f"(have {len(traj)})")
+    else:
+        report = perf_report.diff_entries(_entry_by_ref(traj, args.diff[0]),
+                                          _entry_by_ref(traj, args.diff[1]))
+    if args.json:
+        print(json.dumps(report.to_json(), indent=1, sort_keys=True))
+    else:
+        print(report.format_table())
+        for q in report.regressions(args.threshold_pct, args.min_delta_s):
+            print("REGRESSION " + report.headline(q))
+    return 0
+
+
+def cmd_check(args) -> int:
+    """CI gate: fresh capture vs the last committed entry for the suite.
+    A failing verdict escalates ONCE with tripled per-query rounds (fresh
+    capture) — shared-runner weather rarely survives 3x the samples; a
+    real regression does."""
+    traj = perf_report.load_trajectory(args.out, suite=args.suite)
+    if not traj:
+        print(f"no committed {args.suite} baseline in {args.out or 'store'};"
+              f" nothing to gate against", file=sys.stderr)
+        return 0
+    baseline = traj[-1]
+    for attempt, rounds in enumerate((args.rounds, args.rounds * 3)):
+        entry = run_capture(args, rounds=rounds)
+        report = perf_report.diff_entries(baseline, entry)
+        offenders = report.regressions(args.threshold_pct, args.min_delta_s)
+        print(report.format_table())
+        if not offenders:
+            print(f"perf gate OK vs baseline sha={baseline.get('sha')} "
+                  f"(calibration x{report.calibration:.3f})")
+            return 0
+        for q in offenders:
+            print(("SUSPECT " if attempt == 0 else "REGRESSION ")
+                  + report.headline(q))
+        if attempt == 0:
+            print(f"escalating: re-capturing with rounds={args.rounds * 3}",
+                  file=sys.stderr)
+    return 2
+
+
+def cmd_overhead(args) -> int:
+    """Recording overhead: the suite run through capture_query (profiler +
+    metrics-snapshot brackets) vs plain collect(), ABBA-paired in ONE
+    process so box weather hits both modes symmetrically; the median of
+    paired per-block deltas must stay under 2%."""
+    import statistics
+
+    queries, _ = build_suite(args.suite, args)
+    for _, build in queries:  # warm plans/jit before any timed block
+        build().collect()
+
+    def plain_once() -> float:
+        t0 = time.perf_counter()
+        for _, build in queries:
+            build().collect()
+        return time.perf_counter() - t0
+
+    def captured_once() -> float:
+        t0 = time.perf_counter()
+        for name, build in queries:
+            perf_report.capture_query(name, build)
+        return time.perf_counter() - t0
+
+    deltas, plains = [], []
+    for b in range(args.blocks):
+        order = ((plain_once, captured_once) if b % 2 == 0
+                 else (captured_once, plain_once))
+        ts = [fn() for fn in order]
+        plain, cap = (ts if b % 2 == 0 else (ts[1], ts[0]))
+        plains.append(plain)
+        deltas.append(cap - plain)
+    plain = statistics.median(plains)
+    pct = statistics.median(deltas) / plain * 100.0 if plain > 0 else 0.0
+    rec = {"metric": "observatory_overhead_pct", "value": round(pct, 3),
+           "unit": "% vs plain collect()", "blocks": args.blocks,
+           "plain_s": round(plain, 4),
+           "limit_pct": OVERHEAD_LIMIT_PCT, "ok": pct < OVERHEAD_LIMIT_PCT}
+    print(json.dumps(rec))
+    if not rec["ok"]:
+        print(f"observatory recording overhead {pct:.2f}% exceeds "
+              f"{OVERHEAD_LIMIT_PCT}% budget", file=sys.stderr)
+        return 1
+    return 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--suite", default="tpch", choices=("tpch", "micro"))
+    p.add_argument("--scale-rows", type=int, default=DEFAULT_TPCH_ROWS,
+                   help="lineitem rows for the tpch generator")
+    p.add_argument("--micro-rows", type=int, default=DEFAULT_MICRO_ROWS)
+    p.add_argument("--rounds", type=int, default=1,
+                   help="per-query best-of rounds")
+    p.add_argument("--out", default=None,
+                   help=f"trajectory path (default "
+                        f"{perf_report.TRAJECTORY_FILENAME} at repo root)")
+    p.add_argument("--no-append", action="store_true",
+                   help="capture + report without writing the trajectory")
+    p.add_argument("--json", action="store_true")
+    p.add_argument("--diff", nargs=2, metavar=("BASE", "CUR"),
+                   help="span-diff two entries by sha prefix or index")
+    p.add_argument("--diff-last", action="store_true",
+                   help="span-diff the last two entries of the suite")
+    p.add_argument("--check", action="store_true",
+                   help="CI gate: fresh capture vs last committed entry")
+    p.add_argument("--overhead-check", action="store_true",
+                   help="assert capture overhead < 2%% vs plain collect()")
+    p.add_argument("--threshold-pct", type=float, default=30.0,
+                   help="calibrated slowdown that counts as a regression")
+    p.add_argument("--min-delta-s", type=float, default=0.08,
+                   help="absolute floor below which deltas are noise")
+    p.add_argument("--blocks", type=int, default=6,
+                   help="ABBA blocks for --overhead-check")
+    args = p.parse_args(argv)
+    if args.diff or args.diff_last:
+        return cmd_diff(args)
+    if args.check:
+        return cmd_check(args)
+    if args.overhead_check:
+        return cmd_overhead(args)
+    return cmd_capture(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

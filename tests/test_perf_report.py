@@ -1,0 +1,293 @@
+"""Performance observatory: trajectory capture schema, append/load
+round-trips, span-diff regression attribution (an injected operator
+slowdown must be named FIRST), machine-speed calibration, the CLI, and the
+dashboard trend/regression endpoints."""
+
+import json
+import os
+import subprocess
+import sys
+import time
+import urllib.request
+
+import pytest
+
+import daft_tpu
+from daft_tpu import col, perf_report
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# Module-level switch the injected-slowdown UDF reads: the SAME pipeline
+# runs twice, the second time with one operator made slower. The extra work
+# is CPU-bound and PROPORTIONAL to rows — a fixed per-batch sleep would
+# read as huge per-row latency to the latency-constrained dynamic batcher,
+# which then shrinks batches toward 1 and multiplies the sleep by the row
+# count (a 300 s "hang" that is really the adaptive batching working).
+_INJECT_SLOW_REPS = 0
+
+
+@daft_tpu.udf.func.batch(return_dtype=daft_tpu.DataType.int64())
+def _slowable(s):
+    import numpy as np
+
+    x = s.to_numpy()
+    if _INJECT_SLOW_REPS:
+        acc = np.ones(512)
+        for _ in range(_INJECT_SLOW_REPS):
+            acc = acc + np.sin(x[:, None] * acc).sum(axis=0)
+    return x * 2
+
+
+def _pipeline():
+    df = daft_tpu.from_pydict({"a": list(range(2000)),
+                               "b": [i % 5 for i in range(2000)]})
+    return (df.where(col("a") > 10)
+            .with_column("c", _slowable(col("a")))
+            .groupby("b").agg(col("c").sum().alias("s")).sort("s"))
+
+
+# ------------------------------------------------------------------ #
+# Capture record + entry schema                                       #
+# ------------------------------------------------------------------ #
+def test_capture_query_record_schema():
+    rec = perf_report.capture_query("pipe", _pipeline)
+    assert rec["name"] == "pipe"
+    assert rec["wall_s"] > 0
+    assert rec["rows_out"] == 5  # groupby over b in 0..4
+    assert rec["peak_rss_bytes"] > 0
+    ops = rec["operators"]
+    assert ops, "per-operator attribution missing"
+    names = {o["operator"] for o in ops}
+    assert {"Filter", "Aggregate", "Sort"} <= names
+    for op in ops:
+        assert "#" in op["plan_node"]  # plan-node keyed, not name-keyed
+        for key in ("self_wall_ns", "wall_ns", "self_cpu_ns", "rows",
+                    "bytes_out", "morsels"):
+            assert key in op
+    # Metrics-snapshot deltas attribute THIS query's counters.
+    assert rec["metrics"].get("daft_queries_started_total") == 1.0
+    assert rec["metrics"].get("daft_executor_rows_total", 0) > 0
+
+
+def test_entry_build_validate_append_load(tmp_path):
+    rec = perf_report.capture_query("pipe", _pipeline)
+    entry = perf_report.build_entry("unit", [rec], config={"n": 2000})
+    assert perf_report.validate_entry(entry) == []
+    path = str(tmp_path / "traj.jsonl")
+    perf_report.append_entry(entry, path)
+    perf_report.append_entry(entry, path)
+    with open(path, "a") as f:
+        f.write("{not json\n")  # torn tail line must not kill the store
+        f.write(json.dumps({"schema_version": 99}) + "\n")  # invalid entry
+    loaded = perf_report.load_trajectory(path)
+    assert len(loaded) == 2
+    assert loaded[0]["suite"] == "unit"
+    assert loaded[0]["queries"][0]["name"] == "pipe"
+    assert perf_report.load_trajectory(path, suite="other") == []
+
+
+def test_validate_entry_rejects_malformed():
+    assert perf_report.validate_entry([]) != []
+    assert any("missing key" in e for e in perf_report.validate_entry({}))
+    rec = {"name": "q", "wall_s": -1, "rows_out": 0, "operators": [{}],
+           "metrics": {}}
+    entry = perf_report.build_entry("unit", [rec])
+    errs = perf_report.validate_entry(entry)
+    assert any("wall_s" in e for e in errs)
+    assert any("operators[0]" in e for e in errs)
+    with pytest.raises(Exception):
+        perf_report.append_entry(entry, "/dev/null")
+
+
+# ------------------------------------------------------------------ #
+# Span-diff regression attribution                                    #
+# ------------------------------------------------------------------ #
+def test_injected_operator_slowdown_named_first():
+    """Acceptance case: slow ONE operator between two otherwise identical
+    runs — the regression report must rank that operator's self-time delta
+    first and name the query as regressed."""
+    global _INJECT_SLOW_REPS
+    base_rec = perf_report.capture_query("pipe", _pipeline)
+    _INJECT_SLOW_REPS = 40
+    try:
+        cur_rec = perf_report.capture_query("pipe", _pipeline)
+    finally:
+        _INJECT_SLOW_REPS = 0
+    base = perf_report.build_entry("unit", [base_rec], sha="aaaaaaa")
+    cur = perf_report.build_entry("unit", [cur_rec], sha="bbbbbbb")
+    report = perf_report.diff_entries(base, cur)
+    q = report.queries[0]
+    assert q["cur_wall_s"] > q["base_wall_s"]
+    top = q["operators"][0]
+    assert top["operator"] == "UDFProject", q["operators"][:3]
+    assert top["delta_self_wall_ns"] > 0.1e9
+    headline = report.headline(q)
+    assert "UDFProject" in headline and "pipe" in headline
+    table = report.format_table()
+    assert "UDFProject" in table and "aaaaaaa -> bbbbbbb" in table
+    # With a single query the calibration IS its ratio, so the calibrated
+    # judgement is neutral — regressions() needs uncalibrated context too:
+    assert q["delta_pct"] > 100.0
+
+
+def _make_entry(sha, walls, op_walls=None):
+    """Synthetic schema-valid entry: walls = {query: wall_s}; op_walls =
+    {query: {plan_node: self_wall_s}} (defaults to one op at 90% wall)."""
+    records = []
+    for name, wall in walls.items():
+        ops = (op_walls or {}).get(name) or {f"Op#{name}": wall * 0.9}
+        records.append({
+            "name": name, "wall_s": wall, "rows_out": 1,
+            "peak_rss_bytes": 1,
+            "operators": [
+                {"operator": k.split("#")[0], "plan_node": k, "rows": 1,
+                 "morsels": 1, "wall_ns": int(v * 1e9),
+                 "self_wall_ns": int(v * 1e9), "self_cpu_ns": 0,
+                 "bytes_out": 0}
+                for k, v in ops.items()],
+            "metrics": {}})
+    return perf_report.build_entry("synth", records, sha=sha)
+
+
+def test_calibration_ignores_uniformly_slower_machine():
+    base = _make_entry("aaa", {"q1": 1.0, "q2": 2.0, "q3": 0.5})
+    # A box uniformly 2x slower: NOT a regression anywhere.
+    cur = _make_entry("bbb", {"q1": 2.0, "q2": 4.0, "q3": 1.0})
+    report = perf_report.diff_entries(base, cur)
+    assert report.calibration == pytest.approx(2.0)
+    assert all(abs(q["calibrated_pct"]) < 1e-6 for q in report.queries)
+    assert report.regressions() == []
+
+
+def test_calibration_flags_single_query_slip():
+    base = _make_entry("aaa", {"q1": 1.0, "q2": 2.0, "q3": 0.5},
+                       {"q2": {"HashJoin#3": 1.5, "Filter#1": 0.3}})
+    # Same machine speed (q1/q3 unchanged) but q2's join slipped 50%.
+    cur = _make_entry("bbb", {"q1": 1.0, "q2": 3.0, "q3": 0.5},
+                      {"q2": {"HashJoin#3": 2.5, "Filter#1": 0.3}})
+    report = perf_report.diff_entries(base, cur)
+    assert report.calibration == pytest.approx(1.0)
+    offenders = report.regressions(threshold_pct=20.0, min_delta_s=0.05)
+    assert [q["name"] for q in offenders] == ["q2"]
+    assert offenders[0]["operators"][0]["key"] == "HashJoin#3"
+    assert "HashJoin#3" in report.headline(offenders[0])
+
+
+def test_diff_handles_added_and_removed_queries_and_operators():
+    base = _make_entry("aaa", {"q1": 1.0, "gone": 1.0},
+                       {"q1": {"Scan#1": 0.5, "Old#2": 0.4}})
+    cur = _make_entry("bbb", {"q1": 1.0, "new": 1.0},
+                      {"q1": {"Scan#1": 0.5, "New#2": 0.4}})
+    report = perf_report.diff_entries(base, cur)
+    assert report.only_in_base == ["gone"]
+    assert report.only_in_cur == ["new"]
+    q1 = next(q for q in report.queries if q["name"] == "q1")
+    statuses = {d["key"]: d["status"] for d in q1["operators"]}
+    assert statuses["Old#2"] == "removed"
+    assert statuses["New#2"] == "added"
+    table = report.format_table()
+    assert "new" in table and "gone" in table
+
+
+def test_record_from_profile_in_process_diff():
+    """Two in-process profiled runs diff without a store round-trip."""
+    q = _pipeline()
+    t0 = time.perf_counter()
+    q.collect(profile=True)
+    rec = perf_report.record_from_profile("pipe", q.query_profile,
+                                          time.perf_counter() - t0)
+    assert rec["operators"]
+    d = perf_report.diff_records(rec, rec)
+    assert d["delta_s"] == 0.0
+    assert all(od["delta_self_wall_ns"] == 0 for od in d["operators"])
+
+
+# ------------------------------------------------------------------ #
+# CLI (scripts/perf_observatory.py)                                   #
+# ------------------------------------------------------------------ #
+def _run_cli(args, **env_extra):
+    return subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "perf_observatory.py"),
+         *args],
+        capture_output=True, text=True, timeout=300,
+        env={**os.environ, "JAX_PLATFORMS": "cpu", **env_extra}, cwd=REPO)
+
+
+def test_observatory_cli_appends_schema_valid_entry(tmp_path):
+    out = str(tmp_path / "traj.jsonl")
+    proc = _run_cli(["--suite", "micro", "--micro-rows", "20000",
+                     "--out", out, "--json"])
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    entries = perf_report.load_trajectory(out)
+    assert len(entries) == 1
+    assert entries[0]["suite"] == "micro"
+    assert perf_report.validate_entry(entries[0]) == []
+    assert all(r["operators"] for r in entries[0]["queries"])
+    printed = json.loads(proc.stdout)
+    assert printed["schema_version"] == perf_report.ENTRY_SCHEMA_VERSION
+    # Second run appends and prints the span-diff of the last two entries.
+    proc2 = _run_cli(["--suite", "micro", "--micro-rows", "20000",
+                      "--out", out])
+    assert proc2.returncode == 0, proc2.stderr[-2000:]
+    assert len(perf_report.load_trajectory(out)) == 2
+    assert "span-diff" in proc2.stdout
+    # --diff-last over the same store.
+    proc3 = _run_cli(["--suite", "micro", "--out", out, "--diff-last",
+                      "--json"])
+    assert proc3.returncode == 0, proc3.stderr[-2000:]
+    rep = json.loads(proc3.stdout)
+    assert {q["name"] for q in rep["queries"]} \
+        == {r["name"] for r in entries[0]["queries"]}
+
+
+def test_observatory_check_gate(tmp_path):
+    """--check gates a fresh capture against the last committed entry;
+    same box + same code must pass, and the gate never appends."""
+    out = str(tmp_path / "traj.jsonl")
+    # No baseline: nothing to gate against, exit 0.
+    proc0 = _run_cli(["--check", "--suite", "micro", "--micro-rows",
+                      "20000", "--out", out])
+    assert proc0.returncode == 0, proc0.stderr[-2000:]
+    assert "nothing to gate" in proc0.stderr
+    proc1 = _run_cli(["--suite", "micro", "--micro-rows", "20000",
+                      "--out", out])
+    assert proc1.returncode == 0, proc1.stderr[-2000:]
+    proc2 = _run_cli(["--check", "--suite", "micro", "--micro-rows",
+                      "20000", "--out", out])
+    assert proc2.returncode == 0, proc2.stdout + proc2.stderr[-2000:]
+    assert "perf gate OK" in proc2.stdout
+    assert len(perf_report.load_trajectory(out)) == 1  # check never appends
+
+
+# ------------------------------------------------------------------ #
+# Dashboard trend + regression endpoints                              #
+# ------------------------------------------------------------------ #
+def test_dashboard_perf_endpoints(tmp_path, monkeypatch):
+    path = str(tmp_path / "traj.jsonl")
+    perf_report.append_entry(
+        _make_entry("aaa", {"q1": 1.0, "q2": 2.0}), path)
+    perf_report.append_entry(
+        _make_entry("bbb", {"q1": 1.0, "q2": 3.0},
+                    {"q2": {"HashJoin#3": 2.5}}), path)
+    monkeypatch.setenv("DAFT_TRAJECTORY_PATH", path)
+    from daft_tpu.subscribers.dashboard import DashboardServer
+
+    server = DashboardServer().start()
+    try:
+        traj = json.load(urllib.request.urlopen(
+            f"{server.url}/api/perf/trajectory?suite=synth"))
+        assert [e["sha"] for e in traj["entries"]] == ["aaa", "bbb"]
+        assert traj["entries"][0]["queries"]["q2"] == 2.0
+        assert traj["suites"] == ["synth"]
+        reg = json.load(urllib.request.urlopen(
+            f"{server.url}/api/perf/regressions?suite=synth"))
+        assert reg["base_sha"] == "aaa" and reg["cur_sha"] == "bbb"
+        top = reg["queries"][0]
+        assert top["name"] == "q2"
+        assert top["operators"][0]["key"] == "HashJoin#3"
+        # Unknown suite: empty trend, null regression report.
+        empty = json.load(urllib.request.urlopen(
+            f"{server.url}/api/perf/trajectory?suite=nope"))
+        assert empty["entries"] == []
+    finally:
+        server.shutdown()
