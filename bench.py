@@ -237,6 +237,8 @@ def _bench_engine(num_images: int, batch_size: int, cpu: bool) -> dict:
                          f"engine wall {elapsed:.2f}s\n")
     except Exception:
         pass
+    from daft_tpu.perf_report import resolved_compute_threads
+
     per_chip = num_images / elapsed / n_chips
     metric = "embed_image_clip_vit_l14_throughput_per_chip"
     if cpu:
@@ -246,6 +248,8 @@ def _bench_engine(num_images: int, batch_size: int, cpu: bool) -> dict:
         "value": round(per_chip, 2),
         "unit": "images/sec/chip",
         "vs_baseline": round(per_chip / A100_BASELINE_IMGS_PER_SEC, 3),
+        "cpu_cores": os.cpu_count(),
+        "num_compute_threads": resolved_compute_threads(),
         "phases": stats,
     }
     if not cpu:
@@ -318,11 +322,15 @@ def _ab_overhead_check(env_var: str, metric: str, limit_pct: float,
     for _ in range(rounds):  # alternate so load/thermal drift hits both
         offs.append(run(False))
         ons.append(run(True))
+    from daft_tpu.perf_report import resolved_compute_threads
+
     off, on = min(offs), min(ons)
     pct = (on - off) / off * 100.0 if off > 0 else 0.0
     return {"metric": metric, "value": round(pct, 3),
             "unit": f"% vs {env_var}=0", "enabled_s": round(on, 4),
             "disabled_s": round(off, 4), "limit_pct": limit_pct,
+            "cpu_cores": os.cpu_count(),
+            "num_compute_threads": resolved_compute_threads(),
             "ok": pct < limit_pct}
 
 

@@ -238,6 +238,19 @@ class AggState:
         if self._buffer_rows > self.MERGE_THRESHOLD_ROWS:
             self._merge()
 
+    def add_partial(self, rb: RecordBatch) -> None:
+        """Buffer a partial batch WITHOUT threshold merging — for the
+        executor's in-memory pipelined aggregation, which merges exactly
+        once at finalize. The incremental threshold merge is wrong there:
+        once the merged state itself exceeds the threshold (high group
+        counts), every further partial would trigger a full O(groups)
+        re-merge, turning ingestion quadratic."""
+        if len(rb) == 0:
+            return
+        self._buffers.append(rb)
+        self._buffer_rows += len(rb)
+        self._approx_bytes += rb.size_bytes()
+
     def accumulate_unmerged_partial(self, rb: RecordBatch) -> None:
         """Ingest a partial batch that may contain DUPLICATE group keys.
 

@@ -1,21 +1,43 @@
-"""Single-host streaming execution engine.
+"""Single-host streaming execution engine — morsel-parallel and pipelined.
 
 Re-designs the reference's Swordfish push-based morsel engine
 (src/daft-local-execution: run.rs:408 NativeExecutor; sources / intermediate
-ops / streaming sinks / blocking sinks; pipeline.rs message flow) as a pull
-pipeline of Python generators with thread-based parallelism where it pays:
+ops / streaming sinks / blocking sinks; pipeline.rs message flow) as a
+pipeline of stages over ONE shared compute pool (execution/pipeline.py):
 
+* **pipelined streaming ops** — every Project / Filter / UDF-project /
+  join-probe becomes a stage: its input is morselized (oversized morsels
+  split at ``default_morsel_size``, undersized ones coalesced so queue +
+  span overhead never dominates tiny-row queries), a feeder pulls the
+  child and submits per-morsel work to the shared pool through a bounded
+  queue (the backpressure), and results yield in input order. Stacked
+  stages run CONCURRENTLY — while a join probes morsel i, the filter
+  below it evaluates morsel i+1 — and compete for ``num_compute_threads``
+  workers instead of multiplying threads per stage.
+* **parallel blocking sinks** — grouped aggregation consumes its upstream
+  in parallel: low-cardinality aggs partial-aggregate fixed row-chunks
+  across the pool and merge in chunk order; high-cardinality aggs hash-
+  partition morsels and aggregate each bucket single-shot in parallel.
+  Chunk/bucket structure is thread-count-invariant, so serial and
+  parallel runs produce byte-identical per-group float sums.
+* **build-once probe-many joins** — the in-memory hash-join path builds a
+  reusable sorted-key index over the build side (execution/join_index.py)
+  and probes morsels in parallel with zero per-morsel rebuild; shapes the
+  index can't serve fall back to per-call Acero on coarse morsels.
 * **scan prefetch** — scan tasks read concurrently on an IO thread pool with
-  bounded per-task queues (backpressure), yielding morsels in task order
-  (ordered mode, the reference's maintain_order default).
+  bounded per-task queues, yielding morsels in task order.
 * **UDF concurrency** — UDFProject dispatches morsels to a worker pool of
-  ``max_concurrency`` replicas (the reference's actor-pool UDF operator,
-  intermediate_ops/udf.rs:345-430); TPU inference UDFs hold chip slots.
-* **heavy compute** — Arrow C++ kernels and XLA computations release the GIL,
-  so threads give real parallelism without the reference's tokio runtime.
+  ``max_concurrency`` replicas (the reference's actor-pool UDF operator);
+  TPU inference UDFs hold chip slots.
 
-Blocking sinks (sort/agg/join-build/repartition/write) materialise, mirroring
-the reference's pipeline barriers.
+Sharing one pool is deadlock-free because pooled tasks are pure morsel
+functions — only feeder threads (never pool workers) wait on futures.
+Cancellation is observed at every morsel boundary (feeders pull through
+``_cancel_checked``); any failure poisons the MemoryManager's current
+waiters on the way out. Sort/limit/distinct and every other
+order-sensitive consumer see the serial sequence (ordered stages restore
+input order); Arrow/Acero kernels and XLA computations release the GIL,
+so the thread pool gives real parallelism on multi-core hosts.
 """
 
 from __future__ import annotations
@@ -30,6 +52,12 @@ import numpy as np
 
 from daft_tpu.errors import DaftExecutionError, DaftPlanError
 from daft_tpu.execution.aggregation import AggState
+from daft_tpu.execution.pipeline import (
+    chunk_morsels,
+    collect_parallel,
+    map_stage,
+    morselize,
+)
 from daft_tpu.expressions.evaluator import evaluate
 from daft_tpu.micropartition import MicroPartition
 from daft_tpu.physical import plan as pp
@@ -38,82 +66,6 @@ from daft_tpu.schema import Field, Schema
 from daft_tpu.series import Series
 
 _SENTINEL = object()
-
-
-def _ordered_parallel_map(child_iter: Iterator, fn, concurrency: int,
-                          pool: ThreadPoolExecutor,
-                          owns_pool: bool = False) -> Iterator:
-    """Ordered concurrent map over morsels: a feeder thread pulls the child
-    and submits to a worker pool; results yield in input order. The bounded
-    queue's blocking put is the backpressure (at most ~2x concurrency
-    completed-or-running morsels buffered per stage); a stop flag lets an
-    abandoned consumer release the feeder. Worker + feeder threads inherit
-    the caller's contextvars (per-query frozen clock etc.).
-
-    ``pool`` is normally the executor's SHARED compute pool: stacked stages
-    (Project over Filter over join-probe) then compete for one set of
-    core-count workers instead of multiplying threads per stage. Sharing is
-    deadlock-free because pooled tasks are pure morsel functions — only
-    feeder threads (never pool workers) wait on futures. Exceptions from the
-    child iterator or from ``fn`` propagate to the consumer UNWRAPPED, so
-    error types match the serial path regardless of core count.
-
-    This is the engine's intra-operator parallelism primitive (reference:
-    per-operator max_concurrency workers in
-    src/daft-local-execution/src/intermediate_ops/intermediate_op.rs:41,
-    pipeline.rs:101-120): Arrow/Acero kernels and XLA computations release
-    the GIL, so a thread pool gives real parallelism on multi-core hosts.
-    """
-    inflight: "queue.Queue" = queue.Queue(maxsize=max(concurrency * 2, 2))
-    stop = threading.Event()
-    ambient = contextvars.copy_context()
-
-    def put_or_stop(item) -> bool:
-        while not stop.is_set():
-            try:
-                inflight.put(item, timeout=0.1)
-                return True
-            except queue.Full:
-                continue
-        return False
-
-    def submit_all():
-        try:
-            for item in child_iter:
-                fut = pool.submit(ambient.copy().run, fn, item)
-                if not put_or_stop(fut):
-                    return
-        except BaseException as e:  # noqa: BLE001
-            put_or_stop(e)
-            return
-        put_or_stop(_SENTINEL)
-
-    feeder = threading.Thread(target=ambient.copy().run, args=(submit_all,),
-                              daemon=True)
-    feeder.start()
-    try:
-        while True:
-            item = inflight.get()
-            if item is _SENTINEL:
-                break
-            if isinstance(item, BaseException):
-                raise item  # child-iterator failure: surface the original
-            yield item.result()  # fn failure: future re-raises the original
-    finally:
-        stop.set()
-        if owns_pool:
-            pool.shutdown(wait=False, cancel_futures=True)
-
-
-def _remorsel(it: Iterator[MicroPartition], max_rows: int) -> Iterator[MicroPartition]:
-    """Split oversized morsels; small morsels pass through untouched."""
-    for mp in it:
-        n = len(mp)
-        if n <= max_rows:
-            yield mp
-            continue
-        for start in range(0, n, max_rows):
-            yield mp.slice(start, min(max_rows, n - start))
 
 
 class Executor:
@@ -137,8 +89,19 @@ class Executor:
         # id. None is the DAFT_PROFILE=0 fast path — zero per-morsel cost.
         self.profiler = profiler
         self._profile_node_ids: Dict[int, int] = {}
+        # Live _OpFrame per plan node while its operator span is open:
+        # stages hand this to pipeline workers so per-morsel wall/CPU is
+        # measured ON THE WORKER (tight around the kernel) and aggregated
+        # into the ONE span for that plan node.
+        self._op_frames: Dict[int, object] = {}
         self.memory = get_memory_manager()
         self._held_bytes = 0
+        # Guards executor state that the probe-side Prefetch thread can
+        # touch concurrently with the main pull chain: the shared-subtree
+        # cache (double materialization) and _held_bytes (lost updates
+        # would under-release permits at query end). RLock: a shared
+        # subtree may nest another shared subtree on the same thread.
+        self._state_lock = threading.RLock()
         # Per-THREAD pull-chain stack: with worker-pool stages, nested
         # _instrumented frames run in different feeder threads; a shared list
         # would interleave pushes/pops across chains (stats corruption and
@@ -146,6 +109,14 @@ class Executor:
         self._op_stacks = threading.local()
         n = getattr(cfg, "num_compute_threads", 0)
         self.compute_threads = n if n > 0 else (os.cpu_count() or 1)
+        # Morselization bounds for pipeline stages. The floor coalesces
+        # tiny morsels so per-morsel queue + span overhead can't dominate
+        # small-row (q11/q16-shaped) queries; both bounds are pure config
+        # (never thread-count), keeping the morsel stream identical at
+        # any num_compute_threads.
+        self.max_morsel_rows = cfg.default_morsel_size
+        self.min_morsel_rows = min(
+            getattr(cfg, "min_morsel_size", 16 * 1024), self.max_morsel_rows)
         self._compute_pool: Optional[ThreadPoolExecutor] = None
         self._spill_dir = None
 
@@ -213,27 +184,60 @@ class Executor:
     # ------------------------------------------------------------------ #
     def _run(self, node: pp.PhysicalPlan) -> Iterator[MicroPartition]:
         if id(node) in getattr(self, "_shared_ids", ()):
-            cached = self._shared_cache.get(id(node))
-            if cached is None:
-                cached = []
-                gate_on = True
-                for mp in self._run_uncached(node):
-                    # Pinning a shared subtree's output is buffered state:
-                    # account it like a blocking sink. Same self-deadlock
-                    # guard as _collect — the only releaser is THIS executor
-                    # at query end, so a failed acquire disengages the gate
-                    # instead of waiting forever.
-                    nbytes = mp.size_bytes()
-                    if gate_on:
-                        if self.memory.acquire(nbytes, timeout=5.0,
-                                               token=self.cancel_token):
-                            self._held_bytes += nbytes
-                        else:
-                            gate_on = False
-                    cached.append(mp)
-                self._shared_cache[id(node)] = cached
-            return iter(cached)
+            return iter(self._shared_subtree(node))
         return self._run_uncached(node)
+
+    def _shared_subtree(self, node: pp.PhysicalPlan) -> List[MicroPartition]:
+        """Materialize a shared subtree exactly once even when the probe-
+        side Prefetch thread races the main pull chain. Coordination is a
+        per-node fill event — the lock is held only for bookkeeping,
+        never across the materialization itself, so a stage feeder inside
+        the fill can hit another shared node without deadlocking (fill
+        dependencies follow the acyclic plan DAG)."""
+        while True:
+            with self._state_lock:
+                entry = self._shared_cache.get(id(node))
+                if entry is None:
+                    evt = threading.Event()
+                    self._shared_cache[id(node)] = ("filling", evt)
+                    break
+                if entry[0] == "done":
+                    return entry[1]
+                waiting = entry[1]
+            waiting.wait()
+            # Loop: the filler may have failed and cleared the slot — the
+            # next thread through re-fills instead of hanging on a stale
+            # in-progress marker.
+        try:
+            cached: List[MicroPartition] = []
+            gate_on = True
+            for mp in self._run_uncached(node):
+                # Pinning a shared subtree's output is buffered state:
+                # account it like a blocking sink. Same self-deadlock
+                # guard as _collect — the only releaser is THIS executor
+                # at query end, so a failed acquire disengages the gate
+                # instead of waiting forever.
+                nbytes = mp.size_bytes()
+                if gate_on:
+                    if self.memory.acquire(nbytes, timeout=5.0,
+                                           token=self.cancel_token):
+                        self._add_held(nbytes)
+                    else:
+                        gate_on = False
+                cached.append(mp)
+        except BaseException:
+            with self._state_lock:
+                self._shared_cache.pop(id(node), None)
+            evt.set()
+            raise
+        with self._state_lock:
+            self._shared_cache[id(node)] = ("done", cached)
+        evt.set()
+        return cached
+
+    def _add_held(self, nbytes: int) -> None:
+        with self._state_lock:
+            self._held_bytes += nbytes
 
     def _run_uncached(self, node: pp.PhysicalPlan) -> Iterator[MicroPartition]:
         handler = getattr(self, f"_run_{type(node).__name__}", None)
@@ -268,19 +272,32 @@ class Executor:
         context manager, so abandoned operators still export)."""
         prof = self.profiler
         op = type(node).__name__
-        seq = self._profile_node_ids.setdefault(
-            id(node), len(self._profile_node_ids))
+        # Locked: first pulls race across the Prefetch/feeder threads, and
+        # an unguarded read-then-write could hand two nodes one sequence
+        # number (two spans labelled "Project#3").
+        with self._state_lock:
+            seq = self._profile_node_ids.setdefault(
+                id(node), len(self._profile_node_ids))
         with prof.operator_span(op, f"{op}#{seq}") as frame:
-            while True:
-                frame.begin_pull()
-                try:
-                    mp = next(it)
-                except StopIteration:
-                    return
-                finally:
-                    frame.end_pull()
-                frame.add_output(len(mp), mp)
-                yield mp
+            # Publish the frame for the node's stage workers: pipelined
+            # operators time per-morsel work AT THE WORKER (run_timed),
+            # and the frame then reports worker-side work as busy/cpu
+            # while the consumer-side pull timing below degrades to wait
+            # attribution (self_timed spans in profiling.py).
+            self._op_frames[id(node)] = frame
+            try:
+                while True:
+                    frame.begin_pull()
+                    try:
+                        mp = next(it)
+                    except StopIteration:
+                        return
+                    finally:
+                        frame.end_pull()
+                    frame.add_output(len(mp), mp)
+                    yield mp
+            finally:
+                self._op_frames.pop(id(node), None)
 
     def _instrumented(self, op: str, it: Iterator[MicroPartition]) -> Iterator[MicroPartition]:
         """Per-operator counters with EXCLUSIVE cpu attribution: each level
@@ -392,23 +409,50 @@ class Executor:
             yield ref.fetch()
 
     # -- intermediate (streaming) ops ------------------------------------
-    def _streaming_map(self, child: pp.PhysicalPlan, fn) -> Iterator[MicroPartition]:
-        """Per-morsel map with worker-pool parallelism when cores allow."""
-        it = self._run(child)
-        if self.compute_threads <= 1:
-            for mp in it:
-                yield fn(mp)
-            return
-        yield from _ordered_parallel_map(it, fn, self.compute_threads,
-                                         pool=self._pool())
+    def _stage_frame(self, node):
+        """The node's live profiler _OpFrame (None when unprofiled) — the
+        worker-side timing hook pipeline stages thread through run_timed."""
+        return self._op_frames.get(id(node))
+
+    def _node_timed(self, node, fn, *args):
+        """Run a sink-side kernel (partial merge, finalize) under the
+        node's frame so its work is attributed even though it executes
+        outside the stage workers."""
+        frame = self._stage_frame(node)
+        if frame is None:
+            return fn(*args)
+        return frame.run_timed(lambda _: fn(*args), None)
+
+    def _streaming_map(self, node, fn, *, split: bool = True,
+                       ordered: Optional[bool] = None,
+                       source: Optional[Iterator[MicroPartition]] = None
+                       ) -> Iterator[MicroPartition]:
+        """Pipelined per-morsel map: the node becomes a stage fed by a
+        bounded morsel queue and driven by the shared compute pool. The
+        input is morselized at BOTH thread counts (split oversized,
+        coalesce undersized) so the morsel sequence — and every
+        downstream boundary keyed on it — is identical at
+        num_compute_threads=1 and =N; only scheduling changes. Ordered
+        unless the plan waived order (default_maintain_order=False).
+        ``source`` substitutes a pre-built child iterator (the hash join
+        passes its prefetched probe stream)."""
+        it = source if source is not None else self._run(node.children[0])
+        if split:
+            it = morselize(it, self.min_morsel_rows, self.max_morsel_rows)
+        if ordered is None:
+            ordered = getattr(self.cfg, "default_maintain_order", True)
+        yield from map_stage(
+            it, fn, pool=self._pool(), workers=self.compute_threads,
+            name=type(node).__name__, ordered=ordered,
+            timer=self._stage_frame(node))
 
     def _run_Project(self, node: pp.Project) -> Iterator[MicroPartition]:
         yield from self._streaming_map(
-            node.children[0], lambda mp: mp.eval_expression_list(node.exprs))
+            node, lambda mp: mp.eval_expression_list(node.exprs))
 
     def _run_Filter(self, node: pp.Filter) -> Iterator[MicroPartition]:
         yield from self._streaming_map(
-            node.children[0], lambda mp: mp.filter(node.predicate))
+            node, lambda mp: mp.filter(node.predicate))
 
     def _run_Explode(self, node: pp.Explode) -> Iterator[MicroPartition]:
         names = [e.name() for e in node.to_explode]
@@ -480,11 +524,13 @@ class Executor:
         # overlap inside the impl without unbounded host buffers. Host UDFs
         # with no device batch shape instead follow the latency-constrained
         # feedback loop (execution/dynamic_batching.py).
+        from daft_tpu.execution.pipeline import split_morsels
+
         udf_bs = getattr(udf, "batch_size", None)
         batch_state = None
         if udf_bs:
             morsel_rows = min(udf_bs * 16, self.cfg.default_morsel_size)
-            child_iter = _remorsel(self._run(node.children[0]), morsel_rows)
+            child_iter = split_morsels(self._run(node.children[0]), morsel_rows)
         elif getattr(self.cfg, "udf_dynamic_batching", False) and slots is None:
             from daft_tpu.execution.dynamic_batching import (
                 LatencyConstrainedBatching,
@@ -496,8 +542,8 @@ class Executor:
                 b_max=self.cfg.default_morsel_size).make_state()
             child_iter = dynamic_remorsel(self._run(node.children[0]), batch_state)
         else:
-            child_iter = _remorsel(self._run(node.children[0]),
-                                   self.cfg.default_morsel_size)
+            child_iter = split_morsels(self._run(node.children[0]),
+                                       self.cfg.default_morsel_size)
         if batch_state is None:
             eval_mp = (lambda mp: slots.run(mp.eval_expression_list, exprs)) if slots \
                 else (lambda mp: mp.eval_expression_list(exprs))
@@ -513,13 +559,16 @@ class Executor:
             for mp in child_iter:
                 yield eval_mp(mp)
             return
-        # Ordered concurrent map over morsels (actor-pool analogue). UDFs get
-        # their OWN pool: replica-slot acquisition can block a worker, which
+        # Ordered stage over morsels (actor-pool analogue). UDFs get their
+        # OWN pool: replica-slot acquisition can block a worker, which
         # must never starve the shared relational compute pool.
+        from daft_tpu.execution.pipeline import run_stage
+
         udf_pool = ThreadPoolExecutor(max_workers=concurrency,
                                       thread_name_prefix="daft-udf")
-        yield from _ordered_parallel_map(child_iter, eval_mp, concurrency,
-                                         pool=udf_pool, owns_pool=True)
+        yield from run_stage(child_iter, eval_mp, pool=udf_pool,
+                             workers=concurrency, name="UDFProject",
+                             owns_pool=True, timer=self._stage_frame(node))
 
     # -- streaming sinks --------------------------------------------------
     def _run_Limit(self, node: pp.Limit) -> Iterator[MicroPartition]:
@@ -543,13 +592,15 @@ class Executor:
                 break
 
     # -- blocking sinks ---------------------------------------------------
-    def _collect(self, node: pp.PhysicalPlan) -> MicroPartition:
+    def _collect(self, node: pp.PhysicalPlan,
+                 source: Optional[Iterator[MicroPartition]] = None
+                 ) -> MicroPartition:
         """Materialise a blocking-sink input under memory permits
         (reference: resource_manager.rs memory manager + DAFT_MEMORY_LIMIT)."""
         parts = []
         limit = self.memory.limit
         gate_on = limit is not None
-        for mp in self._run(node):
+        for mp in (source if source is not None else self._run(node)):
             nbytes = mp.size_bytes()
             # Permits bound memory across CONCURRENT executors (distributed
             # workers); within one oversized blocking sink they degrade to
@@ -559,7 +610,7 @@ class Executor:
             if gate_on and self._held_bytes < limit:
                 if self.memory.acquire(nbytes, timeout=5.0,
                                        token=self.cancel_token):
-                    self._held_bytes += min(nbytes, limit)
+                    self._add_held(min(nbytes, limit))
                 else:
                     gate_on = False
             parts.append(mp)
@@ -602,6 +653,13 @@ class Executor:
         keys = [evaluate(e, rb) for e in node.sort_by]
         return rb.sort(keys, node.descending, node.nulls_first).head(k)
 
+    #: Rows per parallel partial-aggregation chunk. Smaller than AggState's
+    #: flush threshold so chunk partials actually spread across a handful
+    #: of workers (one 1M-row chunk would serialize a 1.3M-row groupby);
+    #: FIXED so float partial-sum association never depends on thread
+    #: count — chunk boundaries are part of the determinism contract.
+    AGG_CHUNK_ROWS = 256 * 1024
+
     def _run_Aggregate(self, node: pp.Aggregate) -> Iterator[MicroPartition]:
         budget = self._sink_budget()
 
@@ -609,20 +667,164 @@ class Executor:
             return AggState(node.agg_exprs, node.group_by, node.schema,
                             input_schema=node.children[0].schema)
 
+        if budget is None:
+            # In-memory path: the blocking sink consumes its upstream IN
+            # PARALLEL (chunked partials or hash-partitioned buckets).
+            yield from self._pipelined_agg(node, fresh_state)
+            return
         state = fresh_state()
-        if budget is None or not node.group_by:
+        if not node.group_by:
             # Global aggs reduce to O(1) MERGED state, but raw morsels buffer
             # by row count — under a budget, compress eagerly so raw buffers
             # never exceed it (no disk needed: the partial state is ~1 row).
             for mp in self._run(node.children[0]):
                 state.accumulate(mp)
-                if budget is not None and state.approx_size_bytes() > budget:
+                if state.approx_size_bytes() > budget:
                     state.partial_batches()  # flush raw + merge in place
             yield MicroPartition(node.schema, [state.finalize()])
             return
         yield from self._grace_grouped_agg(
             self._run(node.children[0]), fresh_state, budget, node.schema,
             ingest=lambda st, mp: st.accumulate(mp))
+
+    def _pipelined_agg(self, node: pp.Aggregate,
+                       fresh_state) -> Iterator[MicroPartition]:
+        """Parallel in-memory aggregation with a cardinality-adaptive
+        strategy, structured identically at every thread count:
+
+        * the input is morselized and packed into row-chunks at AggState's
+          flush threshold (pure functions of the stream);
+        * the FIRST chunk's partial aggregation measures group reduction;
+        * low-cardinality aggs partial-aggregate the remaining chunks on
+          the compute pool and merge partials in chunk order (each group's
+          per-chunk sums associate at fixed chunk boundaries);
+        * high-cardinality aggs (partials barely shrink, so a merge pass
+          would nearly double the work) hash-partition instead.
+        """
+        import itertools
+
+        state: AggState = fresh_state()
+        plan = state.plan
+        it = morselize(self._run(node.children[0]),
+                       self.min_morsel_rows, self.max_morsel_rows)
+        chunks = chunk_morsels(it, self.AGG_CHUNK_ROWS)
+        first = next(chunks, None)
+        if first is None:
+            yield MicroPartition(node.schema, [state.finalize()])
+            return
+
+        def partial_of(chunk: List[MicroPartition]) -> RecordBatch:
+            rb = RecordBatch.concat(
+                [b for mp in chunk for b in mp.record_batches()])
+            return rb.agg(plan.partial_exprs, plan.group_by)
+
+        if plan.group_by:
+            # Cardinality probe on the FIRST MORSEL only (bounded waste —
+            # probing a whole chunk would hash-aggregate 2x the chunk on
+            # the high-cardinality path). Data-driven, so every thread
+            # count takes the same branch.
+            probe = partial_of(first[:1])
+            threshold = self.cfg.high_cardinality_aggregation_threshold
+            if len(probe) > len(first[0]) * threshold:
+                yield from self._partitioned_agg(
+                    node, fresh_state, itertools.chain([first], chunks))
+                return
+        # add_partial defers merging to ONE pass at finalize — the
+        # incremental threshold merge would re-aggregate the whole merged
+        # state once per chunk as soon as it outgrows the threshold.
+        for partial in map_stage(itertools.chain([first], chunks), partial_of,
+                                 pool=self._pool(),
+                                 workers=self.compute_threads,
+                                 name="AggPartial",
+                                 timer=self._stage_frame(node)):
+            state.add_partial(partial)
+        yield MicroPartition(node.schema,
+                             [self._node_timed(node, state.finalize)])
+
+    def _partitioned_agg(self, node: pp.Aggregate, fresh_state,
+                         chunks) -> Iterator[MicroPartition]:
+        """High-cardinality grouped aggregation: hash-partition each chunk
+        by group key into one bucket per worker, then aggregate every
+        bucket SINGLE-SHOT in parallel. A group's rows land whole in one
+        bucket with input order preserved (stable partitioning), so
+        per-group float accumulation order — and thus every sum — is
+        identical at any worker count; only output ROW order varies with
+        the bucket count, and grouped output order is unspecified
+        engine-wide."""
+        buckets_n = max(self.compute_threads, 1)
+
+        def split_chunk(chunk: List[MicroPartition]) -> List[RecordBatch]:
+            rb = RecordBatch.concat(
+                [b for mp in chunk for b in mp.record_batches()])
+            keys = [evaluate(g, rb) for g in node.group_by]
+            parts = self._cheap_int_partition(rb, keys, buckets_n)
+            if parts is not None:
+                return parts
+            return rb.partition_by_hash(keys, buckets_n)
+
+        buckets: List[List[RecordBatch]] = [[] for _ in range(buckets_n)]
+        for parts in map_stage(chunks, split_chunk, pool=self._pool(),
+                               workers=self.compute_threads,
+                               name="AggPartition",
+                               timer=self._stage_frame(node)):
+            for i, rb in enumerate(parts):
+                if len(rb):
+                    buckets[i].append(rb)
+
+        def agg_bucket(rbs: List[RecordBatch]) -> RecordBatch:
+            st: AggState = fresh_state()
+            if rbs:
+                rb = rbs[0] if len(rbs) == 1 else RecordBatch.concat(rbs)
+                # One partial pass over the whole bucket (bypassing the
+                # incremental flush threshold keeps per-group association
+                # a single in-order arrow pass, invariant to bucket count).
+                st.accumulate_partial(
+                    rb.agg(st.plan.partial_exprs, st.plan.group_by))
+            return st.finalize()
+
+        for out in collect_parallel(buckets, agg_bucket, pool=self._pool(),
+                                    workers=self.compute_threads,
+                                    timer=self._stage_frame(node)):
+            if len(out):
+                yield MicroPartition(node.schema, [out])
+
+    @staticmethod
+    def _cheap_int_partition(rb: RecordBatch, keys,
+                             n_buckets: int) -> Optional[List[RecordBatch]]:
+        """Bucket rows on a SINGLE int-like group key with one vector
+        multiply-shift and per-bucket mask filters — ~2x cheaper than the
+        generic row-hash + stable-sort partitioner for the small bucket
+        counts the partitioned aggregation uses. Order within a bucket is
+        input order (pc.filter is stable), which is the property the
+        float-determinism contract rests on; None defers to the generic
+        path. Bucket assignment depends only on key values (thread count
+        enters only through the modulus — and per-GROUP rows stay whole
+        in one bucket for any modulus)."""
+        from daft_tpu.execution.join_index import _key_values
+
+        if len(keys) != 1:
+            return None
+        kv = _key_values(keys[0])  # the ONE int-like-key eligibility rule
+        if kv is None:
+            return None
+        vals, mask = kv
+        # Eligibility must be DTYPE-only, never data-dependent: chunks of
+        # one aggregation that disagreed on the bucket function would
+        # split a group across buckets (duplicate output rows). Bucketing
+        # needs no order preservation, so any int width maps through a
+        # plain wrap-around uint64 cast — identical for every chunk.
+        if vals.dtype.kind == "M":
+            h = vals.view(np.int64).astype(np.uint64)
+        else:
+            h = vals.astype(np.uint64)
+        # Fibonacci multiplicative hash: one multiply + shift scrambles
+        # strided key sets (all-even keys etc.) that a bare modulo clumps.
+        h = (h * np.uint64(0x9E3779B97F4A7C15)) >> np.uint64(17)
+        ids = (h % np.uint64(n_buckets)).astype(np.int64)
+        if mask is not None:
+            ids[mask] = 0  # null group rows all land in bucket 0
+        return [rb.filter(Series.from_numpy(ids == b, "m"))
+                for b in range(n_buckets)]
 
     def _grace_grouped_agg(self, items, fresh_state, budget, schema,
                            ingest) -> Iterator[MicroPartition]:
@@ -899,23 +1101,26 @@ class Executor:
     GRACE_BUCKETS = 32
 
     def _collect_or_grace(self, child: pp.PhysicalPlan, key_exprs, budget,
-                          key_dtypes=None, num_buckets: Optional[int] = None):
+                          key_dtypes=None, num_buckets: Optional[int] = None,
+                          source: Optional[Iterator[MicroPartition]] = None):
         """Materialize a join side in memory, or — once it outgrows the
         budget — hash-partition it by join key into disk buckets (grace hash
         join). ``key_dtypes`` are the UNIFIED join-key dtypes: both sides must
         hash identical key values identically, and the row hash is
         byte-width-sensitive, so keys are cast before bucketing (the
         in-memory join casts the same way, recordbatch.py hash_join).
-        Returns ("mem", MicroPartition) or ("grace", GracePartitioner)."""
+        ``source`` substitutes a pre-built child iterator (the hash join's
+        probe-side prefetch). Returns ("mem", MicroPartition) or
+        ("grace", GracePartitioner)."""
         if budget is None:
-            return "mem", self._collect(child)
+            return "mem", self._collect(child, source=source)
         from daft_tpu.execution.spill import GracePartitioner
 
         key_fn = lambda rb: self._unified_keys(rb, key_exprs, key_dtypes)  # noqa: E731
         buffer: List[MicroPartition] = []
         buf_bytes = 0
         grace: Optional[GracePartitioner] = None
-        for mp in self._run(child):
+        for mp in (source if source is not None else self._run(child)):
             if grace is not None:
                 for rb in mp.record_batches():
                     grace.add(rb)
@@ -982,26 +1187,83 @@ class Executor:
                             re.to_field(rschema0).dtype)
                            for le, re in zip(node.left_on, node.right_on))
         ]
+        from daft_tpu.execution.pipeline import Prefetch
+
+        # Overlap the build with the probe-side upstream: while the right
+        # child materializes, a bounded prefetch warms the left subtree's
+        # stages so the probe starts on hot queues the moment the build
+        # lands. Memory-budgeted plans skip the look-ahead (the budget
+        # paths own their buffering); the prefetch closes on ANY exit so
+        # a build failure can't leak the puller thread.
+        left_prefetch: Optional[Prefetch] = None
+        if budget is None and self.compute_threads > 1:
+            left_prefetch = Prefetch(self._run(node.children[0]),
+                                     capacity=4, name="probe-side")
+        try:
+            yield from self._hash_join_sides(node, budget, key_dtypes,
+                                             left_prefetch)
+        finally:
+            if left_prefetch is not None:
+                left_prefetch.close()
+
+    def _hash_join_sides(self, node: pp.HashJoin, budget, key_dtypes,
+                         left_prefetch) -> Iterator[MicroPartition]:
         right_state, right_side = self._collect_or_grace(
             node.children[1], node.right_on, budget, key_dtypes)
         if right_state == "mem" and node.how not in ("right", "outer"):
+            from daft_tpu.execution.join_index import JoinIndex
+
             right = right_side.combined()
             right_keys = [evaluate(e, right) for e in node.right_on]
+            right_data, coalesce = self._prep_join_right(right, node)
+            # Build-once probe-many: a reusable sorted-key index over the
+            # build side, so parallel probe morsels never rebuild the hash
+            # table. Eligibility is plan/data-driven (single sortable key,
+            # probe-driven join type) — identical at every thread count.
+            index = JoinIndex.try_build(
+                self._unified_keys(right, node.right_on, key_dtypes),
+                node.how, right_data)
+            build_rb = right_data
+            if index is not None and node.how not in ("semi", "anti"):
+                lnames = set(node.children[0].schema.column_names())
+                ren = {n: f"{node.suffix}{n}"
+                       for n in right_data.schema.column_names()
+                       if n in lnames}
+                if ren:
+                    cols = [c.rename(ren[c.name]) if c.name in ren else c
+                            for c in right_data.columns()]
+                    build_rb = RecordBatch(
+                        Schema([Field(c.name, c.dtype) for c in cols]),
+                        cols, len(right_data))
 
             # Stream the probe (left) side morsel-by-morsel against the built
-            # side, probing morsels in parallel on multi-core hosts.
+            # side, probing morsels in parallel on multi-core hosts. Without
+            # an index the per-morsel Acero join re-hashes the build side
+            # each call, so the probe keeps its natural (coarse) morsels.
             def probe(mp: MicroPartition) -> MicroPartition:
                 left = mp.combined()
+                if index is not None:
+                    joined = index.probe(
+                        left, self._unified_keys(left, node.left_on, key_dtypes),
+                        build_rb, node.how)
+                    if joined is not None:
+                        return MicroPartition(
+                            node.schema,
+                            [self._finish_join(joined, coalesce, node)])
                 left_keys = [evaluate(e, left) for e in node.left_on]
                 out = self._join_and_fix(left, right, left_keys, right_keys, node)
                 return MicroPartition(node.schema, [out])
 
-            yield from self._streaming_map(node.children[0], probe)
+            yield from self._streaming_map(
+                node, probe, split=index is not None,
+                source=iter(left_prefetch) if left_prefetch is not None
+                else None)
             return
         # Right/outer joins need the left side materialized too; an oversized
         # build side forces grace mode for ALL join types.
         left_state, left_side = self._collect_or_grace(
-            node.children[0], node.left_on, budget, key_dtypes)
+            node.children[0], node.left_on, budget, key_dtypes,
+            source=iter(left_prefetch) if left_prefetch is not None else None)
         if right_state == "mem" and left_state == "mem":
             left, right = left_side.combined(), right_side.combined()
             left_keys = [evaluate(e, left) for e in node.left_on]
@@ -1074,28 +1336,35 @@ class Executor:
             cols.append(c)
         return RecordBatch(schema, cols, len(rb))
 
-    def _join_and_fix(self, left, right, left_keys, right_keys, node) -> RecordBatch:
+    def _prep_join_right(self, right: RecordBatch, node):
+        """Node-constant right-side prep shared by the Acero and probe-index
+        paths: drop merged join keys from the right copy and, for
+        right/outer joins, carry the right copy under a reserved ``__rk_``
+        name so right-only rows can coalesce the null left key after the
+        join (the reference coalesces common join columns in
+        hash_outer_join). Returns ``(right_data, coalesce_names)``."""
         merged = sorted(node.merged_keys) if node.merged_keys and node.how not in ("semi", "anti") else []
-        # For right/outer joins, right-only output rows have null values in
-        # the left copy of a merged key — carry the right copy through the
-        # join under a reserved name and coalesce after (the reference
-        # coalesces common join columns in hash_outer_join).
         coalesce = merged if node.how in ("right", "outer") else []
-        if merged:
-            keep = right.schema.exclude(merged)
-            cols = [right.get_column(n) for n in keep.column_names()]
-            cols += [right.get_column(n).rename(f"__rk_{n}") for n in coalesce]
-            schema = Schema([Field(c.name, c.dtype) for c in cols])
-            right_data = RecordBatch(schema, cols, len(right))
-        else:
-            right_data = right
-        joined = left.hash_join(right_data, left_keys, right_keys, node.how, node.suffix)
+        if not merged:
+            return right, coalesce
+        keep = right.schema.exclude(merged)
+        cols = [right.get_column(n) for n in keep.column_names()]
+        cols += [right.get_column(n).rename(f"__rk_{n}") for n in coalesce]
+        schema = Schema([Field(c.name, c.dtype) for c in cols])
+        return RecordBatch(schema, cols, len(right)), coalesce
+
+    def _finish_join(self, joined: RecordBatch, coalesce, node) -> RecordBatch:
         if coalesce:
             cols = [c.coalesce(joined.get_column(f"__rk_{c.name}")) if c.name in coalesce
                     else c for c in joined.columns() if not c.name.startswith("__rk_")]
             joined = RecordBatch(Schema([Field(c.name, c.dtype) for c in cols]),
                                  cols, len(joined))
         return self._conform_to_schema(joined, node.schema)
+
+    def _join_and_fix(self, left, right, left_keys, right_keys, node) -> RecordBatch:
+        right_data, coalesce = self._prep_join_right(right, node)
+        joined = left.hash_join(right_data, left_keys, right_keys, node.how, node.suffix)
+        return self._finish_join(joined, coalesce, node)
 
     def _run_AsofJoin(self, node: pp.AsofJoin) -> Iterator[MicroPartition]:
         right = self._collect(node.children[1]).combined()

@@ -25,6 +25,8 @@ from __future__ import annotations
 
 from typing import Dict, List, Optional, Sequence
 
+import threading
+
 import numpy as np
 
 from daft_tpu.datatype import DataType, TypeId
@@ -309,6 +311,36 @@ def _bucket(n: int, buckets: Sequence[int]) -> int:
     return ((n + top - 1) // top) * top
 
 
+#: Padded lengths already traced per compile key: jax.jit re-traces and
+#: re-compiles per input SHAPE, so every new bucket a query's tail
+#: morsels touch costs a fresh XLA compile (~0.1-1s on cold queries —
+#: measured ~1.5s/query of pure compile tax across TPC-H). Padding a
+#: tail into an already-compiled larger shape trades a little zero-lane
+#: compute for that compile.
+_SHAPES_SEEN: Dict[tuple, set] = {}
+_SHAPES_LOCK = threading.Lock()
+
+#: Never pad beyond this multiple of the real row count — past it the
+#: wasted dense compute outweighs a one-time compile.
+_PAD_REUSE_FACTOR = 8
+
+
+def _bucket_reusing(n: int, buckets: Sequence[int], key: tuple) -> int:
+    natural = _bucket(n, buckets)
+    # Locked: concurrent pipeline-stage workers share _SHAPES_SEEN, and
+    # iterating one worker's set while another adds would raise.
+    with _SHAPES_LOCK:
+        seen = _SHAPES_SEEN.setdefault(key, set())
+        if natural in seen:
+            return natural
+        candidates = [b for b in seen
+                      if n <= b <= _PAD_REUSE_FACTOR * max(n, 1)]
+        if candidates:
+            return min(candidates)
+        seen.add(natural)
+        return natural
+
+
 def try_evaluate_fused(rb, exprs: Sequence[Expr]) -> Optional[Dict[int, Series]]:
     """Evaluate the fusable subset of ``exprs`` on device.
 
@@ -368,7 +400,14 @@ def try_evaluate_fused(rb, exprs: Sequence[Expr]) -> Optional[Dict[int, Series]]
         chosen = safe
         if not chosen:
             return None
-    padded = _bucket(n, cfg.device_batch_buckets)
+    chosen_exprs = [exprs[i] for i in chosen]
+    # Key on the CANONICALIZED dtype (what jnp.asarray will stage) and the
+    # trailing shape — length-independent, so bucket reuse below can pick
+    # a compiled length for this exact computation.
+    key = (tuple(e.key() for e in chosen_exprs),
+           tuple(sorted((k, str(jax.dtypes.canonicalize_dtype(v.dtype)),
+                         v.shape[1:]) for k, v in cols_np.items())))
+    padded = _bucket_reusing(n, cfg.device_batch_buckets, key)
     cols_dev: Dict[str, jax.Array] = {}
     try:
         for name, v in cols_np.items():
@@ -376,9 +415,6 @@ def try_evaluate_fused(rb, exprs: Sequence[Expr]) -> Optional[Dict[int, Series]]
                 pad_width = [(0, padded - n)] + [(0, 0)] * (v.ndim - 1)
                 v = np.pad(v, pad_width)
             cols_dev[name] = jnp.asarray(v)
-        chosen_exprs = [exprs[i] for i in chosen]
-        key = (tuple(e.key() for e in chosen_exprs),
-               tuple(sorted((k, str(v.dtype), v.shape[1:]) for k, v in cols_dev.items())))
         fn = _compiled_for(key, chosen_exprs)
         outs = fn(cols_dev)
         # ONE batched device->host transfer for every output column

@@ -183,6 +183,19 @@ def record_from_profile(name: str, profile, wall_s: float) -> dict:
             "metrics": {}}
 
 
+def resolved_compute_threads() -> int:
+    """The worker count the pipelined executor would actually use right
+    now: the active config's ``num_compute_threads``, with 0 resolved to
+    the visible core count (executor.py's rule)."""
+    try:
+        from daft_tpu.context import get_context
+
+        n = get_context().execution_config.num_compute_threads
+    except (ImportError, AttributeError):
+        n = 0  # stamping must never fail a capture
+    return n if n > 0 else (os.cpu_count() or 1)
+
+
 def build_entry(suite: str, records: List[dict],
                 config: Optional[dict] = None,
                 sha: Optional[str] = None) -> dict:
@@ -193,8 +206,16 @@ def build_entry(suite: str, records: List[dict],
         "sha": sha if sha is not None else git_sha(),
         "captured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
         "suite": suite,
+        # cpu_cores + num_compute_threads make scaling claims auditable:
+        # a trajectory diff between entries at different worker counts is
+        # a configuration delta, not a code regression (the --cores sweep
+        # in scripts/perf_observatory.py compares them deliberately).
+        # cpu_cores is the canonical name going forward; cpu_count is the
+        # legacy spelling kept so pre-existing entries stay comparable.
         "host": {"platform": platform.platform(),
                  "cpu_count": os.cpu_count() or 1,
+                 "cpu_cores": os.cpu_count() or 1,
+                 "num_compute_threads": resolved_compute_threads(),
                  "python": platform.python_version()},
         "config": dict(config or {}),
         "queries": records,

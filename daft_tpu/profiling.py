@@ -393,12 +393,30 @@ def note_device(rows: int, fused: bool) -> None:
 # Operator frames + TaskProfiler (worker side)                          #
 # --------------------------------------------------------------------- #
 class _OpFrame:
-    """Mutable per-operator accumulator behind one operator span."""
+    """Mutable per-operator accumulator behind one operator span.
+
+    Two timing modes feed ONE frame (and so one span per plan node):
+
+    * **pull timing** (serial operators, blocking sinks) — the executor's
+      morsel loop brackets ``next(child)`` with begin_pull/end_pull on the
+      consumer thread; busy/cpu measure the pull chain as before.
+    * **worker timing** (pipeline stages) — every stage worker runs the
+      morsel kernel through :meth:`run_timed`, which measures wall/CPU
+      tight around the kernel on the worker thread and aggregates under
+      the frame lock. Concurrent per-morsel walls SUM (they are work, and
+      may legitimately exceed the span's open interval on multi-core);
+      the consumer-side pull times degrade to queue-wait attribution and
+      export separately as ``consumer_wait_ns``, so inclusive time is
+      never double-counted between an operator's own span and its
+      parent's (operator_table subtracts a stage child's *consumer-
+      visible* wait from the parent, not its parallel work).
+    """
 
     __slots__ = ("span", "busy_ns", "cpu_ns", "morsels", "rows_out",
                  "bytes_out", "spill_bytes", "permit_wait_ns",
                  "device_rows", "fallback_rows", "_t0", "_c0",
-                 "_row_width", "_sample_cpu")
+                 "_row_width", "_sample_cpu", "work_ns", "work_cpu_ns",
+                 "work_morsels", "self_timed", "_lock")
 
     def __init__(self, span: Span):
         self.span = span
@@ -412,6 +430,11 @@ class _OpFrame:
         self.permit_wait_ns = 0
         self.device_rows = 0
         self.fallback_rows = 0
+        self.work_ns = 0
+        self.work_cpu_ns = 0
+        self.work_morsels = 0
+        self.self_timed = False
+        self._lock = threading.Lock()
         self._t0 = 0
         self._c0 = 0
         self._row_width = 0.0
@@ -431,6 +454,29 @@ class _OpFrame:
         # several frames at once; never pop someone else's entry.
         if st and st[-1] is self:
             st.pop()
+
+    def run_timed(self, fn, item):
+        """Run one morsel kernel on a stage WORKER thread, attributing its
+        wall + thread-CPU to this frame. Local clocks + a locked add keep
+        concurrent workers race-free; the frame also rides this thread's
+        attribution stack so note_spill/note_permit_wait/note_device land
+        on the right operator from pool threads."""
+        st = _stack()
+        st.append(self)
+        t0 = time.perf_counter_ns()
+        c0 = _thread_cpu_ns() if self._sample_cpu else 0
+        try:
+            return fn(item)
+        finally:
+            dt = time.perf_counter_ns() - t0
+            dc = (_thread_cpu_ns() - c0) if self._sample_cpu else 0
+            with self._lock:
+                self.work_ns += dt
+                self.work_cpu_ns += dc
+                self.work_morsels += 1
+                self.self_timed = True
+            if st and st[-1] is self:
+                st.pop()
 
     def add_output(self, rows: int, mp) -> None:
         """Per-morsel output accounting. ``size_bytes()`` walks every
@@ -570,8 +616,20 @@ class TaskProfiler:
         finally:
             span.end_ns = span_clock_ns()
             a = span.attributes
-            a["busy_ns"] = frame.busy_ns
-            a["cpu_ns"] = frame.cpu_ns
+            if frame.self_timed:
+                # Stage-timed operator: busy/cpu are worker-side WORK
+                # (summed across concurrent pulls — can exceed the span
+                # interval); the consumer-side pull time is queue wait,
+                # exported separately so parents subtract the wait they
+                # actually saw instead of parallel work they never paid.
+                a["busy_ns"] = frame.work_ns
+                a["cpu_ns"] = frame.work_cpu_ns
+                a["consumer_wait_ns"] = frame.busy_ns
+                a["worker_morsels"] = frame.work_morsels
+                a["self_timed"] = True
+            else:
+                a["busy_ns"] = frame.busy_ns
+                a["cpu_ns"] = frame.cpu_ns
             a["morsels"] = frame.morsels
             a["rows_out"] = frame.rows_out
             a["bytes_out"] = frame.bytes_out
@@ -912,6 +970,12 @@ class QueryProfile:
         instances of one operator stay attributable — the granularity the
         perf observatory's span-diff reports regress against."""
         ops = [s for s in self.spans() if s.name.startswith("daft.op.")]
+        # Parent-child subtraction uses each child's CONSUMER-VISIBLE time:
+        # a pull-timed child's busy IS what its parent's pull included, but
+        # a stage-timed (self_timed) child's busy is parallel worker WORK
+        # the parent never paid — the parent only saw the child's queue
+        # wait (consumer_wait_ns). CPU of a stage child burns on pool
+        # threads, never inside the parent's pull, so it subtracts as 0.
         child_busy: Dict[str, int] = {}
         child_cpu: Dict[str, int] = {}
         by_id = {s.span_id for s in ops}
@@ -919,8 +983,14 @@ class QueryProfile:
             p = s.parent_id
             if p in by_id:
                 a = s.attributes
-                child_busy[p] = child_busy.get(p, 0) + int(a.get("busy_ns", 0))
-                child_cpu[p] = child_cpu.get(p, 0) + int(a.get("cpu_ns", 0))
+                if a.get("self_timed"):
+                    visible_busy = int(a.get("consumer_wait_ns", 0))
+                    visible_cpu = 0
+                else:
+                    visible_busy = int(a.get("busy_ns", 0))
+                    visible_cpu = int(a.get("cpu_ns", 0))
+                child_busy[p] = child_busy.get(p, 0) + visible_busy
+                child_cpu[p] = child_cpu.get(p, 0) + visible_cpu
         agg: Dict[str, dict] = {}
         for s in ops:
             a = s.attributes
@@ -938,8 +1008,15 @@ class QueryProfile:
             r["rows"] += int(a.get("rows_out", 0))
             r["morsels"] += int(a.get("morsels", 0))
             r["wall_ns"] += busy
-            r["self_wall_ns"] += max(busy - child_busy.get(s.span_id, 0), 0)
-            r["self_cpu_ns"] += max(cpu - child_cpu.get(s.span_id, 0), 0)
+            if a.get("self_timed"):
+                # Stage-timed: busy is already SELF work (the kernel never
+                # pulls its child — the feeder does), aggregated into the
+                # one span this plan node owns.
+                r["self_wall_ns"] += busy
+                r["self_cpu_ns"] += cpu
+            else:
+                r["self_wall_ns"] += max(busy - child_busy.get(s.span_id, 0), 0)
+                r["self_cpu_ns"] += max(cpu - child_cpu.get(s.span_id, 0), 0)
             r["bytes_out"] += int(a.get("bytes_out", 0))
             r["spill_bytes"] += int(a.get("spill_bytes", 0))
             r["permit_wait_ns"] += int(a.get("permit_wait_ns", 0))

@@ -23,7 +23,7 @@ from daft_tpu.series import Series
 
 
 class RecordBatch:
-    __slots__ = ("_schema", "_columns", "_num_rows")
+    __slots__ = ("_schema", "_columns", "_num_rows", "_size_bytes")
 
     def __init__(self, schema: Schema, columns: Sequence[Series], num_rows: Optional[int] = None):
         self._schema = schema
@@ -116,12 +116,20 @@ class RecordBatch:
         return self._columns[self._schema.index_of(name)]
 
     def size_bytes(self) -> int:
+        # Memoized: batches are immutable, and size_bytes walks every
+        # column buffer — the profiler's byte sampling, memory-permit
+        # accounting, and spill decisions all ask repeatedly as a morsel
+        # flows through stacked pipeline stages.
+        cached = getattr(self, "_size_bytes", None)
+        if cached is not None:
+            return cached
         total = 0
         for c in self._columns:
             if c.dtype.is_python():
                 total += 64 * len(c)
             else:
                 total += c.to_arrow().nbytes
+        self._size_bytes = total
         return total
 
     def __repr__(self) -> str:

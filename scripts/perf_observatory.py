@@ -166,9 +166,20 @@ def micro_suite(n: int):
     def sort_topk():
         return fact.sort("x", desc=True).limit(100)
 
+    def small_rows():
+        # q11/q16-shaped: highly selective filters leave TINY morsels
+        # flowing through join + groupby stages — guards the pipeline's
+        # coalescing floor (min_morsel_size): per-morsel queue + span
+        # overhead must never dominate small-row queries.
+        return (fact.where(col("x") > 0.995)
+                .join(dim, left_on="fk", right_on="dk")
+                .groupby("seg").agg(col("x").count().alias("n"),
+                                    col("y").sum().alias("sy"))
+                .sort("seg"))
+
     return [("scan_filter", scan_filter), ("project_fused", project_fused),
             ("hash_join", hash_join), ("groupby_agg", groupby_agg),
-            ("sort_topk", sort_topk)]
+            ("sort_topk", sort_topk), ("small_rows", small_rows)]
 
 
 def build_suite(name: str, args):
@@ -257,7 +268,16 @@ def cmd_check(args) -> int:
         print(f"no committed {args.suite} baseline in {args.out or 'store'};"
               f" nothing to gate against", file=sys.stderr)
         return 0
-    baseline = traj[-1]
+    # Gate against a baseline captured at THIS worker count when one
+    # exists: the --cores sweep appends entries at several counts, and
+    # diffing across counts reports the parallelism config delta as a
+    # phantom per-query regression. Fall back to the latest entry when no
+    # matching-count baseline is committed (cross-machine calibration
+    # still absorbs uniform speed).
+    threads = perf_report.resolved_compute_threads()
+    matching = [e for e in traj
+                if e.get("host", {}).get("num_compute_threads") == threads]
+    baseline = matching[-1] if matching else traj[-1]
     for attempt, rounds in enumerate((args.rounds, args.rounds * 3)):
         entry = run_capture(args, rounds=rounds)
         report = perf_report.diff_entries(baseline, entry)
@@ -276,11 +296,90 @@ def cmd_check(args) -> int:
     return 2
 
 
+def cmd_cores(args) -> int:
+    """``--cores N[,M,...]``: capture the suite once per compute-thread
+    count — each in a FRESH subprocess (clean pools, DAFT_COMPUTE_THREADS
+    read at context creation) — and print a per-query scaling table
+    (speedup vs the smallest requested count, normally the 1-core
+    baseline). Entries append to the trajectory unless --no-append,
+    largest worker count last so the CI gate's committed baseline matches
+    the parallel lane's configuration."""
+    import subprocess
+    import tempfile
+
+    cores = sorted({int(c) for c in args.cores.split(",") if c.strip()})
+    if not cores:
+        raise SystemExit("--cores needs at least one worker count")
+    entries = {}
+    with tempfile.TemporaryDirectory() as td:
+        for n in cores:
+            out = os.path.join(td, f"traj_{n}.jsonl")
+            argv = [sys.executable, os.path.abspath(__file__),
+                    "--suite", args.suite,
+                    "--scale-rows", str(args.scale_rows),
+                    "--micro-rows", str(args.micro_rows),
+                    "--rounds", str(args.rounds), "--out", out]
+            env = dict(os.environ, DAFT_COMPUTE_THREADS=str(n),
+                       JAX_PLATFORMS="cpu")
+            print(f"capturing {args.suite} at {n} compute thread(s)...",
+                  file=sys.stderr)
+            proc = subprocess.run(argv, env=env, capture_output=True,
+                                  text=True, timeout=1800)
+            if proc.returncode != 0:
+                sys.stderr.write(proc.stderr[-2000:])
+                raise SystemExit(f"sweep capture at cores={n} failed")
+            traj = perf_report.load_trajectory(out, suite=args.suite)
+            if not traj:
+                raise SystemExit(f"sweep capture at cores={n} wrote no entry")
+            entries[n] = traj[-1]
+    base_n = cores[0]
+    base = {r["name"]: r["wall_s"] for r in entries[base_n]["queries"]}
+    names = [r["name"] for r in entries[base_n]["queries"]]
+    w = max(len(n) for n in names + ["total", "query"])
+    header = f"{'query':<{w}}" + "".join(
+        f" {f'{n}c':>9}" + (f" {'vs ' + str(base_n) + 'c':>8}"
+                            if n != base_n else "") for n in cores)
+    print(f"per-query scaling, suite={args.suite} "
+          f"(baseline: {base_n} compute thread(s))")
+    print(header)
+    print("-" * len(header))
+
+    def row(name: str, walls: dict) -> str:
+        line = f"{name:<{w}}"
+        for n in cores:
+            wall = walls.get(n)
+            line += f" {wall:>8.3f}s" if wall is not None else f" {'-':>9}"
+            if n != base_n:
+                b = walls.get(base_n)
+                line += (f" {b / wall:>7.2f}x"
+                         if wall and b else f" {'-':>8}")
+        return line
+
+    for name in names:
+        walls = {n: next((r["wall_s"] for r in entries[n]["queries"]
+                          if r["name"] == name), None) for n in cores}
+        print(row(name, walls))
+    totals = {n: entries[n]["total_wall_s"] for n in cores}
+    print(row("total", totals))
+    if not args.no_append:
+        for n in cores:  # smallest first, largest (the lane config) last
+            path = perf_report.append_entry(entries[n], args.out)
+        print(f"appended {len(cores)} sweep entries to {path}",
+              file=sys.stderr)
+    return 0
+
+
 def cmd_overhead(args) -> int:
     """Recording overhead: the suite run through capture_query (profiler +
-    metrics-snapshot brackets) vs plain collect(), ABBA-paired in ONE
-    process so box weather hits both modes symmetrically; the median of
-    paired per-block deltas must stay under 2%."""
+    metrics-snapshot brackets) vs plain collect(), position-balanced
+    ABBA WITHIN each block — the first run of any back-to-back pair
+    measures consistently slower (allocator/cache debt left by the
+    previous run), so alternating order only BETWEEN blocks aliases that
+    position cost straight into the deltas (measured ~4-10% phantom
+    overhead where per-query medians show ~1.5%). In an A,B,B,A block
+    each config takes one outer and one inner position, cancelling the
+    drift to first order; the median of per-block deltas must stay
+    under 2%."""
     import statistics
 
     queries, _ = build_suite(args.suite, args)
@@ -300,18 +399,36 @@ def cmd_overhead(args) -> int:
         return time.perf_counter() - t0
 
     deltas, plains = [], []
-    for b in range(args.blocks):
-        order = ((plain_once, captured_once) if b % 2 == 0
-                 else (captured_once, plain_once))
-        ts = [fn() for fn in order]
-        plain, cap = (ts if b % 2 == 0 else (ts[1], ts[0]))
-        plains.append(plain)
-        deltas.append(cap - plain)
+
+    def run_blocks(n: int) -> None:
+        for b in range(n):
+            a, c = ((plain_once, captured_once) if b % 2 == 0
+                    else (captured_once, plain_once))
+            t1, t2, t3, t4 = a(), c(), c(), a()
+            plain_s, cap_s = ((t1 + t4, t2 + t3) if b % 2 == 0
+                              else (t2 + t3, t1 + t4))
+            plains.append(plain_s / 2)
+            deltas.append((cap_s - plain_s) / 2)
+
+    def verdict() -> float:
+        plain = statistics.median(plains)
+        return statistics.median(deltas) / plain * 100.0 if plain > 0 else 0.0
+
+    run_blocks(args.blocks)
+    pct = verdict()
+    escalated = False
+    if pct >= OVERHEAD_LIMIT_PCT:
+        # Escalate once (the PR 5/6 guard discipline): paired deltas on
+        # ~0.5s suites wander ±2% with box weather (per-query medians
+        # show ~1% true cost); a real regression holds its level through
+        # triple the sample.
+        escalated = True
+        run_blocks(args.blocks * 2)
+        pct = verdict()
     plain = statistics.median(plains)
-    pct = statistics.median(deltas) / plain * 100.0 if plain > 0 else 0.0
     rec = {"metric": "observatory_overhead_pct", "value": round(pct, 3),
-           "unit": "% vs plain collect()", "blocks": args.blocks,
-           "plain_s": round(plain, 4),
+           "unit": "% vs plain collect()", "blocks": len(plains),
+           "escalated": escalated, "plain_s": round(plain, 4),
            "limit_pct": OVERHEAD_LIMIT_PCT, "ok": pct < OVERHEAD_LIMIT_PCT}
     print(json.dumps(rec))
     if not rec["ok"]:
@@ -341,6 +458,10 @@ def main(argv=None) -> int:
                    help="span-diff the last two entries of the suite")
     p.add_argument("--check", action="store_true",
                    help="CI gate: fresh capture vs last committed entry")
+    p.add_argument("--cores", metavar="N[,M,...]",
+                   help="sweep mode: capture once per compute-thread count "
+                        "(fresh subprocess each) and print the per-query "
+                        "scaling table vs the smallest count")
     p.add_argument("--overhead-check", action="store_true",
                    help="assert capture overhead < 2%% vs plain collect()")
     p.add_argument("--threshold-pct", type=float, default=30.0,
@@ -356,6 +477,8 @@ def main(argv=None) -> int:
         return cmd_check(args)
     if args.overhead_check:
         return cmd_overhead(args)
+    if args.cores:
+        return cmd_cores(args)
     return cmd_capture(args)
 
 

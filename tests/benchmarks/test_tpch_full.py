@@ -664,10 +664,13 @@ def test_write_report(T):
     """Record per-query wall times (driver artifact when DAFT_TPCH_REPORT set)."""
     assert len(_TIMES) >= 20, f"queries did not all run: {sorted(_TIMES)}"
     if os.environ.get("DAFT_TPCH_REPORT"):
+        from daft_tpu.perf_report import resolved_compute_threads
+
         path = os.path.join(os.path.dirname(__file__), "..", "..", "BENCH_TPCH.json")
         with open(os.path.abspath(path), "w") as f:
             json.dump({"sf": SF, "runner": os.environ.get("DAFT_RUNNER", "native"),
                        "cpu_cores": os.cpu_count(),
+                       "num_compute_threads": resolved_compute_threads(),
                        "times_sec": dict(sorted(_TIMES.items())),
                        "total_sec": round(sum(_TIMES.values()), 3)}, f, indent=1)
 

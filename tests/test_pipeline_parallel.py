@@ -1,0 +1,459 @@
+"""Pipelined morsel-parallel executor: determinism, primitives, chaos.
+
+The executor's contract (executor.py docstring): ``num_compute_threads``
+changes only WHERE morsels run, never what they contain — morsel split
+points, coalesce boundaries, aggregation chunk/bucket structure are pure
+functions of the input stream. So every TPC-H-shaped query must produce
+byte-identical results at 1 and 4 threads: sorted outputs compare exactly
+(including float bits — partial-sum association is pinned by deterministic
+chunk boundaries), unordered outputs compare as multisets.
+
+The chaos case cancels a query mid-pipeline and asserts every stage
+worker unwinds and the MemoryManager stays healthy for the next query.
+"""
+
+import datetime
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import daft_tpu
+from daft_tpu import col, lit
+
+from benchmarks.tpch_data import generate_tpch
+
+SCALE_ROWS = 120_000
+
+#: Small morsels so even CI-sized tables exercise real splitting,
+#: coalescing, chunking, and multi-morsel stage scheduling.
+MORSEL_CFG = dict(default_morsel_size=8192, min_morsel_size=2048)
+
+
+@pytest.fixture(scope="module")
+def T():
+    return generate_tpch(SCALE_ROWS, seed=3)
+
+
+def tpch_queries(t):
+    """(name, build, sorted) TPC-H-shaped tier-1 queries — every executor
+    path the pipeline refactor touched: filter/project stages, low- and
+    high-cardinality aggregation, indexed join probes (inner/semi),
+    sort/limit over parallel upstreams."""
+    li, orders, cust, nation = (t["lineitem"], t["orders"], t["customer"],
+                                t["nation"])
+
+    def q01():
+        return (li.where(col("l_shipdate") <= lit(datetime.date(1998, 9, 2)))
+                .groupby("l_returnflag", "l_linestatus")
+                .agg(col("l_quantity").sum().alias("sum_qty"),
+                     (col("l_extendedprice") * (1 - col("l_discount")))
+                     .sum().alias("sum_disc_price"),
+                     col("l_discount").mean().alias("avg_disc"),
+                     col("l_quantity").count().alias("n"))
+                .sort(["l_returnflag", "l_linestatus"]))
+
+    def q03():
+        cutoff = datetime.date(1995, 3, 15)
+        return (cust.where(col("c_mktsegment") == "BUILDING")
+                .join(orders.where(col("o_orderdate") < lit(cutoff)),
+                      left_on="c_custkey", right_on="o_custkey")
+                .join(li, left_on="o_orderkey", right_on="l_orderkey")
+                .with_column("revenue", col("l_extendedprice")
+                             * (1 - col("l_discount")))
+                .groupby("o_orderkey", "o_orderdate", "o_shippriority")
+                .agg(col("revenue").sum().alias("revenue"))
+                .sort(["revenue", "o_orderdate"], desc=[True, False])
+                .limit(10))
+
+    def q06():
+        lo, hi = datetime.date(1994, 1, 1), datetime.date(1996, 1, 1)
+        return (li.where((col("l_shipdate") >= lit(lo))
+                         & (col("l_shipdate") < lit(hi))
+                         & (col("l_discount") >= 0.03)
+                         & (col("l_quantity") < 24))
+                .agg((col("l_extendedprice") * col("l_discount"))
+                     .sum().alias("revenue")))
+
+    def q18():
+        big = (li.groupby("l_orderkey")
+               .agg(col("l_quantity").sum().alias("sum_qty"))
+               .where(col("sum_qty") > 180))
+        return (big.join(orders, left_on="l_orderkey", right_on="o_orderkey")
+                .join(cust, left_on="o_custkey", right_on="c_custkey")
+                .sort(["o_totalprice", "o_orderkey"], desc=[True, False])
+                .limit(100))
+
+    def groupby_unsorted():
+        # High-cardinality grouped agg with NO downstream sort: the
+        # partitioned-agg path may emit buckets in any arrangement.
+        return (li.groupby("l_orderkey")
+                .agg(col("l_extendedprice").sum().alias("rev"),
+                     col("l_quantity").count().alias("n")))
+
+    def join_unsorted():
+        return (li.join(nation.join(cust, left_on="n_nationkey",
+                                    right_on="c_nationkey"),
+                        left_on="l_orderkey", right_on="c_custkey",
+                        how="semi"))
+
+    return [("q01", q01, True), ("q03", q03, True), ("q06", q06, True),
+            ("q18", q18, True),
+            ("groupby_unsorted", groupby_unsorted, False),
+            ("join_unsorted", join_unsorted, False)]
+
+
+def _run_at(build, threads):
+    with daft_tpu.execution_config_ctx(num_compute_threads=threads,
+                                       **MORSEL_CFG):
+        return build().to_pydict()
+
+
+def _multiset(d):
+    cols = sorted(d)
+    return sorted(zip(*(d[c] for c in cols))) if cols else []
+
+
+def test_parallel_vs_serial_equality(T):
+    """Every query byte-identical at 1 and 4 threads; sorted outputs
+    exactly (float bits included), unordered outputs as multisets."""
+    for name, build, is_sorted in tpch_queries(T):
+        serial = _run_at(build, 1)
+        par = _run_at(build, 4)
+        if is_sorted:
+            assert serial == par, f"{name}: sorted output diverged"
+        else:
+            assert sorted(serial) == sorted(par), f"{name}: columns diverged"
+            assert _multiset(serial) == _multiset(par), \
+                f"{name}: multiset diverged"
+
+
+def test_parallel_runs_are_reproducible(T):
+    """Two 4-thread runs of the same ordered query are byte-identical —
+    scheduling nondeterminism must never reach results."""
+    _, build, _ = tpch_queries(T)[1]  # q03: joins + agg + sort + limit
+    assert _run_at(build, 4) == _run_at(build, 4)
+
+
+# --------------------------------------------------------------------- #
+# Pipeline primitives                                                    #
+# --------------------------------------------------------------------- #
+def _mp(n, offset=0):
+    return daft_tpu.from_pydict(
+        {"x": np.arange(offset, offset + n, dtype=np.int64)}) \
+        ._materialize().partitions[0]
+
+
+def _rows(morsels):
+    out = []
+    for m in morsels:
+        out.extend(m.to_pydict()["x"])
+    return out
+
+
+def test_morselize_split_and_coalesce():
+    from daft_tpu.execution.pipeline import morselize
+
+    stream = [_mp(10_000, 0), _mp(50, 10_000), _mp(60, 10_050),
+              _mp(5_000, 10_110)]
+    out = list(morselize(iter(stream), 1_000, 4_096))
+    assert _rows(out) == list(range(15_110))          # nothing lost/dup'd
+    assert all(len(m) <= 4_096 for m in out)          # split bound
+    # the two tiny morsels coalesced with the following input
+    sizes = [len(m) for m in out]
+    assert 50 not in sizes and 60 not in sizes
+
+
+def test_morselize_is_deterministic_per_stream():
+    """The same incoming morsel stream always produces the same output
+    boundaries — the serial-vs-parallel determinism anchor (thread count
+    never reaches morselize; ordered stages hand every consumer the same
+    upstream stream shape)."""
+    from daft_tpu.execution.pipeline import morselize
+
+    def stream():
+        return iter([_mp(15_000, 0), _mp(300, 15_000), _mp(14_700, 15_300)])
+
+    a = [len(m) for m in morselize(stream(), 2_048, 8_192)]
+    b = [len(m) for m in morselize(stream(), 2_048, 8_192)]
+    assert a == b
+    assert _rows(morselize(stream(), 2_048, 8_192)) == list(range(30_000))
+
+
+def test_coalesce_never_duplicates_tail():
+    """Regression: a stream whose every morsel clears the floor must pass
+    through exactly once (the tail-morsel fallback used to re-emit)."""
+    from daft_tpu.execution.pipeline import coalesce_morsels
+
+    out = list(coalesce_morsels(iter([_mp(5_000)]), 1_000))
+    assert _rows(out) == list(range(5_000))
+
+
+def test_coalesce_empty_stream_keeps_schema_morsel():
+    from daft_tpu.execution.pipeline import coalesce_morsels
+
+    empty = _mp(0)
+    out = list(coalesce_morsels(iter([empty]), 1_000))
+    assert len(out) == 1 and len(out[0]) == 0
+
+
+def test_chunk_morsels_boundaries():
+    from daft_tpu.execution.pipeline import chunk_morsels
+
+    stream = [_mp(400)] * 10  # 4000 rows, chunk after cum > 1000
+    chunks = list(chunk_morsels(iter(stream), 1_000))
+    assert [sum(len(m) for m in c) for c in chunks] == [1200, 1200, 1200, 400]
+
+
+def test_run_stage_ordered_and_unordered():
+    from concurrent.futures import ThreadPoolExecutor
+
+    from daft_tpu.execution.pipeline import run_stage
+
+    pool = ThreadPoolExecutor(max_workers=4)
+    try:
+        items = list(range(64))
+        out = list(run_stage(iter(items), lambda x: x * 2, pool=pool,
+                             workers=4))
+        assert out == [x * 2 for x in items]  # order restored
+        un = list(run_stage(iter(items), lambda x: x * 2, pool=pool,
+                            workers=4, ordered=False))
+        assert sorted(un) == out  # same multiset, any order
+    finally:
+        pool.shutdown(wait=False)
+
+
+@pytest.mark.parametrize("ordered", [True, False])
+def test_run_stage_propagates_worker_error_unwrapped(ordered):
+    from concurrent.futures import ThreadPoolExecutor
+
+    from daft_tpu.execution.pipeline import run_stage
+
+    class Boom(RuntimeError):
+        pass
+
+    def fn(x):
+        if x == 13:
+            raise Boom("morsel 13")
+        return x
+
+    pool = ThreadPoolExecutor(max_workers=4)
+    try:
+        with pytest.raises(Boom, match="morsel 13"):
+            list(run_stage(iter(range(64)), fn, pool=pool, workers=4,
+                           ordered=ordered))
+    finally:
+        pool.shutdown(wait=False)
+
+
+def test_run_stage_child_error_reaches_consumer():
+    from concurrent.futures import ThreadPoolExecutor
+
+    from daft_tpu.execution.pipeline import run_stage
+
+    def child():
+        yield 1
+        raise ValueError("child died")
+
+    pool = ThreadPoolExecutor(max_workers=2)
+    try:
+        with pytest.raises(ValueError, match="child died"):
+            list(run_stage(child(), lambda x: x, pool=pool, workers=2))
+    finally:
+        pool.shutdown(wait=False)
+
+
+def test_run_stage_abandoned_consumer_releases_feeder():
+    from concurrent.futures import ThreadPoolExecutor
+
+    from daft_tpu.execution.pipeline import run_stage
+
+    pool = ThreadPoolExecutor(max_workers=2)
+    try:
+        before = {t.name for t in threading.enumerate()}
+        gen = run_stage(iter(range(10_000)), lambda x: x, pool=pool,
+                        workers=2, name="abandon-me")
+        assert next(gen) == 0
+        gen.close()  # limit-pushdown shape: upstream abandoned mid-stream
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            alive = {t.name for t in threading.enumerate()} - before
+            if not any("abandon-me" in n for n in alive):
+                break
+            time.sleep(0.05)
+        assert not any("abandon-me" in n for n in alive)
+    finally:
+        pool.shutdown(wait=False)
+
+
+def test_prefetch_close_releases_thread():
+    from daft_tpu.execution.pipeline import Prefetch
+
+    def slow():
+        for i in range(10_000):
+            yield i
+
+    p = Prefetch(slow(), capacity=2, name="prefetch-close-test")
+    p.close()  # never consumed — e.g. the join build failed first
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline:
+        if not any("prefetch-close-test" in t.name
+                   for t in threading.enumerate()):
+            break
+        time.sleep(0.05)
+    assert not any("prefetch-close-test" in t.name
+                   for t in threading.enumerate())
+
+
+# --------------------------------------------------------------------- #
+# Join index oracle                                                      #
+# --------------------------------------------------------------------- #
+@pytest.mark.parametrize("how", ["inner", "left", "semi", "anti"])
+@pytest.mark.parametrize("dense", [True, False])
+def test_join_index_matches_acero(how, dense):
+    """Index probes must agree with the Acero hash join as multisets for
+    every supported join type, on keys with duplicates and nulls, in both
+    dense (direct-address) and sparse (searchsorted) regimes."""
+    rng = np.random.default_rng(11)
+    n_build, n_probe = 4_000, 6_000
+    lo, hi = (0, 5_000) if dense else (0, 10_000_000)
+    bk = rng.integers(lo, hi, n_build).tolist()
+    pk = rng.integers(lo, hi, n_probe).tolist()
+    bk[7] = None
+    pk[3] = None
+    right = daft_tpu.from_pydict({"dk": bk, "w": rng.random(n_build)})
+    left = daft_tpu.from_pydict({"fk": pk, "x": rng.random(n_probe)})
+    with daft_tpu.execution_config_ctx(num_compute_threads=4,
+                                       **MORSEL_CFG):
+        got = left.join(right, left_on="fk", right_on="dk",
+                        how=how).to_pydict()
+    import pandas as pd
+
+    # pandas merge matches NaN == NaN; SQL (and the engine) never match
+    # null keys — distinct sentinels per side keep the oracle honest.
+    lp = pd.DataFrame({"fk": [-1 if v is None else v for v in pk],
+                       "x": left.to_pydict()["x"]})
+    rp = pd.DataFrame({"dk": [-2 if v is None else v for v in bk],
+                       "w": right.to_pydict()["w"]})
+    if how == "inner":
+        exp = lp.merge(rp, left_on="fk", right_on="dk")
+    elif how == "left":
+        exp = lp.merge(rp, left_on="fk", right_on="dk", how="left")
+    elif how == "semi":
+        exp = lp[lp.fk.isin(set(rp.dk))]
+    else:
+        exp = lp[~lp.fk.isin(set(rp.dk))]
+    assert len(got[next(iter(got))]) == len(exp)
+    got_ms = _multiset({"fk": [-1 if v is None else v for v in got["fk"]],
+                        "x": got["x"]})
+    exp_ms = _multiset({"fk": list(exp["fk"]), "x": list(exp["x"])})
+    assert got_ms == exp_ms
+
+
+def test_join_index_date_keys():
+    base = datetime.date(1994, 1, 1)
+    bk = [base + datetime.timedelta(days=int(d)) for d in range(50)]
+    pk = [base + datetime.timedelta(days=int(d)) for d in [0, 3, 99, 7]]
+    right = daft_tpu.from_pydict({"d": bk, "w": list(range(50))})
+    left = daft_tpu.from_pydict({"d2": pk, "x": [1, 2, 3, 4]})
+    got = left.join(right, left_on="d2", right_on="d").sort("x").to_pydict()
+    assert got["x"] == [1, 2, 4] and got["w"] == [0, 3, 7]
+
+
+def test_join_index_declines_strings_and_floats():
+    from daft_tpu.execution.join_index import JoinIndex
+    from daft_tpu.series import Series
+    from daft_tpu.recordbatch import RecordBatch
+    from daft_tpu.schema import Field, Schema
+
+    sk = Series.from_pylist(["a", "b"], "k")
+    rb = RecordBatch(Schema([Field("k", sk.dtype)]), [sk], 2)
+    assert JoinIndex.try_build([sk], "inner", rb) is None
+    fk = Series.from_numpy(np.array([1.0, float("nan")]), "k")
+    rbf = RecordBatch(Schema([Field("k", fk.dtype)]), [fk], 2)
+    assert JoinIndex.try_build([fk], "inner", rbf) is None
+    ik = Series.from_numpy(np.array([3, 1, 2]), "k")
+    rbi = RecordBatch(Schema([Field("k", ik.dtype)]), [ik], 3)
+    assert JoinIndex.try_build([ik], "outer", rbi) is None  # blocking shape
+    assert JoinIndex.try_build([ik], "inner", rbi) is not None
+
+
+# --------------------------------------------------------------------- #
+# Chaos: cancellation mid-pipeline                                       #
+# --------------------------------------------------------------------- #
+@pytest.mark.chaos
+def test_cancel_mid_pipeline_unwinds_stage_workers():
+    """Cancel a query while stage workers are mid-morsel: the collect must
+    fail with the timeout error, every pipeline thread must unwind, and
+    the MemoryManager must stay usable for the NEXT query (poison is
+    query-scoped)."""
+    from daft_tpu.errors import DaftTimeoutError
+    from daft_tpu.execution.resource_manager import get_memory_manager
+
+    @daft_tpu.udf.func(return_dtype=daft_tpu.DataType.int64())
+    def slow(x):
+        # Row-wise: ~0.2s of sleep per 256-row morsel, so the query would
+        # run ~25s uncancelled but each morsel boundary arrives fast
+        # enough for the 0.6s deadline to abort within ~1s.
+        time.sleep(0.0008)
+        return x
+
+    n = 32_000
+    df = daft_tpu.from_pydict({"a": np.arange(n, dtype=np.int64)})
+    before = {t.ident for t in threading.enumerate()}
+    with daft_tpu.execution_config_ctx(num_compute_threads=4,
+                                       default_morsel_size=256,
+                                       min_morsel_size=64,
+                                       udf_dynamic_batching=False):
+        with pytest.raises(DaftTimeoutError):
+            (df.with_column("b", slow(col("a")))
+               .where(col("b") >= 0)
+               .groupby("a").agg(col("b").sum().alias("s"))
+               .collect(timeout=0.6))
+    # Every stage/feeder/UDF worker unwinds (cancellation observed at
+    # morsel boundaries; stop flags release feeders and prefetchers).
+    deadline = time.monotonic() + 10.0
+    while time.monotonic() < deadline:
+        leaked = [t for t in threading.enumerate()
+                  if t.ident not in before and t.is_alive()
+                  and ("daft-compute" in t.name or "daft-feed" in t.name
+                       or "daft-udf" in t.name or "daft-probe" in t.name)]
+        if not leaked:
+            break
+        time.sleep(0.1)
+    assert not leaked, f"stage workers leaked: {[t.name for t in leaked]}"
+    # The manager is unpoisoned for the next query: a fresh acquire
+    # succeeds immediately and a fresh query runs cleanly.
+    mm = get_memory_manager()
+    assert mm.acquire(1, timeout=1.0)
+    mm.release(1)
+    with daft_tpu.execution_config_ctx(num_compute_threads=4,
+                                       default_morsel_size=1_024):
+        out = (df.where(col("a") < 1000)
+               .groupby("a").agg(col("a").count().alias("n"))
+               .to_pydict())
+    assert len(out["a"]) == 1000
+
+
+def test_join_index_dense_no_int64_wraparound():
+    """Probe keys near INT64_MIN must MISS a dense build range near
+    INT64_MAX — a naive (probe - key_min) rel computation wraps to a
+    small positive index and falsely matches."""
+    from daft_tpu.execution.join_index import JoinIndex
+    from daft_tpu.recordbatch import RecordBatch
+    from daft_tpu.schema import Field, Schema
+    from daft_tpu.series import Series
+
+    top = np.iinfo(np.int64).max
+    bk = Series.from_numpy(np.arange(top - 100, top, dtype=np.int64), "bk")
+    rb = RecordBatch(Schema([Field("bk", bk.dtype)]), [bk], 100)
+    idx = JoinIndex.try_build([bk], "inner", rb)
+    assert idx is not None and idx.offsets is not None  # dense path
+    pk = Series.from_numpy(
+        np.array([np.iinfo(np.int64).min, top - 50, 0], dtype=np.int64), "pk")
+    prb = RecordBatch(Schema([Field("pk", pk.dtype)]), [pk], 3)
+    out = idx.probe(prb, [pk], rb, "inner")
+    assert out is not None
+    assert out.get_column("pk").to_pylist() == [top - 50]
+    assert out.get_column("bk").to_pylist() == [top - 50]

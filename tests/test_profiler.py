@@ -560,3 +560,60 @@ def test_dtl009_span_outside_with():
         cm.__enter__()
     """
     assert len(findings(pos_profiler)) == 1
+
+
+def test_parallel_stage_span_attribution():
+    """Forced-parallel attribution: a stage's morsels are pulled by
+    MULTIPLE pool threads, yet per-pull wall/CPU aggregates into exactly
+    ONE span per plan-node id, worker-side work is the span's self time,
+    and the consumer-side queue wait is exported separately — so summed
+    self time stays bounded by real work instead of telescoping every
+    stage's inclusive wall (the serial-model failure under pipelining)."""
+    import numpy as np
+
+    n = 400_000
+    rng = np.random.default_rng(5)
+    df = daft_tpu.from_pydict({
+        "a": rng.integers(0, 1_000_000, n),
+        "b": rng.random(n),
+        "g": rng.integers(0, 64, n)})
+    dim = daft_tpu.from_pydict({"k": np.arange(1_000_000, dtype=np.int64),
+                                "w": rng.random(1_000_000)})
+    q = (df.where((col("a") % 7 > 0) & (col("b") < 0.97))
+           .with_column("c", col("b") * 2.0 + 1.0)
+           .where(col("c") > 1.1)
+           .join(dim, left_on="a", right_on="k")
+           .groupby("g").agg(col("c").sum().alias("s"),
+                             col("w").mean().alias("m"))
+           .sort("g"))
+    with daft_tpu.execution_config_ctx(num_compute_threads=4,
+                                       default_morsel_size=16_384,
+                                       min_morsel_size=4_096):
+        t0 = time.perf_counter()
+        q.collect(profile=True)
+        wall_ns = (time.perf_counter() - t0) * 1e9
+    prof = q.query_profile
+    ops = [s for s in prof.spans() if s.name.startswith("daft.op.")]
+    # ONE span per plan node, even though 4 workers pulled each stage.
+    nodes = [s.attributes["plan_node"] for s in ops]
+    assert len(nodes) == len(set(nodes))
+    staged = [s for s in ops if s.attributes.get("self_timed")]
+    assert staged, "no stage-timed spans under forced parallelism"
+    for s in staged:
+        a = s.attributes
+        assert a["busy_ns"] > 0
+        assert "consumer_wait_ns" in a
+    filt = next(s for s in ops
+                if s.attributes["operator"] == "Filter"
+                and s.attributes.get("self_timed"))
+    # Kernel invocations from ALL pool threads aggregate into this one
+    # span; output morsels are counted once (consumer side), and rows
+    # match a single accounting pass, not one per worker.
+    assert filt.attributes["worker_morsels"] >= 4
+    assert filt.attributes["morsels"] >= 4
+    assert filt.attributes["rows_out"] > 0
+    # No inclusive-time double counting: self times sum to at most the
+    # pool's possible work (threads x wall), where the serial pull model
+    # under pipelining would telescope ~every stage to the full wall.
+    table = prof.operator_table(by="plan_node")
+    assert sum(r["self_wall_ns"] for r in table) <= 4 * wall_ns
