@@ -15,7 +15,12 @@ from daft_tpu.context import (
 )
 from daft_tpu.datatype import DataType, ImageFormat, ImageMode, TimeUnit
 from daft_tpu.cancellation import cancel_query
-from daft_tpu.errors import DaftCancelledError, DaftError, DaftTimeoutError
+from daft_tpu.errors import (
+    DaftAdmissionError,
+    DaftCancelledError,
+    DaftError,
+    DaftTimeoutError,
+)
 from daft_tpu.expressions import Expression, col, element, interval, lit
 from daft_tpu.schema import Field, Schema
 from daft_tpu.series import Series
@@ -27,10 +32,14 @@ __version__ = "0.1.0"
 __all__ = [
     "DataFrame",
     "DataType",
+    "DaftAdmissionError",
     "DaftCancelledError",
     "DaftError",
     "DaftTimeoutError",
     "cancel_query",
+    "current_tenant",
+    "set_tenant",
+    "set_tenant_policy",
     "Expression",
     "Field",
     "ImageFormat",
@@ -130,6 +139,11 @@ def __getattr__(name: str):
         from daft_tpu.window import Window
 
         return Window
+    if name in ("set_tenant", "current_tenant", "set_tenant_policy",
+                "TenantPolicy"):
+        from daft_tpu.execution import admission
+
+        return getattr(admission, name)
     raise AttributeError(f"module 'daft_tpu' has no attribute {name!r}")
 
 

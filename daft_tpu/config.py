@@ -139,6 +139,27 @@ class ExecutionConfig:
     # spelling of DAFT_METRICS_FILE (OTLP-JSON resourceMetrics lines).
     metrics_enabled: bool = True
     metrics_export_path: Optional[str] = None
+    # Multi-tenant admission control (execution/admission.py). Enabled by
+    # default — with the default unlimited per-tenant concurrency the
+    # uncontended path is one lock acquisition per query (<2% guarded in
+    # CI). Per-tenant defaults: admission_max_concurrent_queries (0 =
+    # unlimited), admission_queue_depth (bounded wait queue; full = fast
+    # DaftAdmissionError), admission_max_memory_fraction (reservation quota
+    # vs DAFT_MEMORY_LIMIT; 1.0 = ungated). admission_policies is a JSON
+    # map {tenant: {max_concurrent_queries, max_memory_fraction,
+    # queue_depth, priority}} (DAFT_ADMISSION_POLICIES). Overload ladder:
+    # queue pressure above admission_overload_queue_fraction of capacity or
+    # MemoryManager permit-wait p95 above admission_permit_wait_p95_s sheds
+    # in steps (see admission.py docstring); levels decay one step per
+    # admission_shed_cooldown_s without overload.
+    admission_enabled: bool = True
+    admission_max_concurrent_queries: int = 0
+    admission_queue_depth: int = 32
+    admission_max_memory_fraction: float = 1.0
+    admission_policies: Optional[str] = None
+    admission_overload_queue_fraction: float = 0.8
+    admission_permit_wait_p95_s: float = 1.0
+    admission_shed_cooldown_s: float = 2.0
     # Query profiler (daft_tpu/profiling.py). Default OFF: profiling is
     # opt-in per query via df.collect(profile=...) or process-wide via
     # DAFT_PROFILE=1; profile_export_path (DAFT_PROFILE_FILE) writes the
@@ -175,6 +196,17 @@ class ExecutionConfig:
             changes["metrics_enabled"] = False
         if os.environ.get("DAFT_METRICS_FILE"):
             changes["metrics_export_path"] = os.environ["DAFT_METRICS_FILE"]
+        if not daft_env_flag("DAFT_ADMISSION", True):
+            changes["admission_enabled"] = False
+        if os.environ.get("DAFT_ADMISSION_MAX_CONCURRENT"):
+            changes["admission_max_concurrent_queries"] = int(
+                os.environ["DAFT_ADMISSION_MAX_CONCURRENT"])
+        if os.environ.get("DAFT_ADMISSION_QUEUE_DEPTH"):
+            changes["admission_queue_depth"] = int(
+                os.environ["DAFT_ADMISSION_QUEUE_DEPTH"])
+        if os.environ.get("DAFT_ADMISSION_POLICIES"):
+            changes["admission_policies"] = \
+                os.environ["DAFT_ADMISSION_POLICIES"]
         if daft_env_flag("DAFT_PROFILE", False):
             changes["profile_enabled"] = True
         if os.environ.get("DAFT_PROFILE_FILE"):

@@ -44,6 +44,21 @@ class DaftContext:
         with self._lock:
             self._runner = runner
 
+    # -- tenant identity (admission control) ------------------------------
+    def set_tenant(self, tenant: Optional[str]) -> None:
+        """Tag queries issued from this execution context with a tenant
+        identity for admission control (``ctx.set_tenant("analytics")``).
+        Contextvar-scoped: concurrent serving threads each carry their own.
+        ``None`` clears back to ``DAFT_TENANT`` / the default tenant."""
+        from daft_tpu.execution.admission import set_tenant
+
+        set_tenant(tenant)
+
+    def current_tenant(self) -> str:
+        from daft_tpu.execution.admission import current_tenant
+
+        return current_tenant()
+
     # -- subscribers ------------------------------------------------------
     def attach_subscriber(self, subscriber) -> None:
         with self._lock:

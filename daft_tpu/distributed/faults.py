@@ -24,6 +24,10 @@ Injection points wired in the engine:
 ``io.circuit``         circuit-breaker admission check (ctx: ``endpoint``) —
                        lets the chaos suite fail/delay the exact decision
                        that opens or probes a breaker (io/circuit.py)
+``admission.enqueue``  a query entering the bounded admission wait queue
+                       (ctx: ``query_id``, ``tenant``) — exercises the
+                       front-door queue itself (execution/admission.py);
+                       an injected failure must leave no queue slot behind
 ==================== =======================================================
 
 Every injection point is ALSO a cooperative-cancellation observation point:
@@ -66,6 +70,7 @@ KNOWN_POINTS = (
     "io.get_object",
     "daemon.heartbeat",
     "io.circuit",
+    "admission.enqueue",
 )
 
 _ACTIONS = ("raise", "raise_transient", "raise_worker_died", "delay", "kill",

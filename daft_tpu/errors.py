@@ -55,10 +55,33 @@ class DaftCircuitOpenError(DaftTransientError):
         self.endpoint = endpoint
 
 
+class DaftAdmissionError(DaftTransientError):
+    """The query was rejected at the admission front door
+    (execution/admission.py) before planning or dispatch: tenant quota
+    saturated with a full wait queue, remaining deadline smaller than the
+    estimated queue wait, or overload shedding. Transient by
+    classification — the condition is load, not the query: clients should
+    back off ``retry_after_s`` seconds and resubmit."""
+
+    def __init__(self, message: str, tenant: str = "", reason: str = "",
+                 queue_depth: int = 0, retry_after_s: float = 0.0):
+        super().__init__(message)
+        self.tenant = tenant
+        self.reason = reason
+        self.queue_depth = queue_depth
+        self.retry_after_s = retry_after_s
+
+
 class DaftCancelledError(DaftError):
     """The query was cancelled (user cancel or executor abort) and this
     unit of work observed the cancel token cooperatively. Deliberately NOT
-    transient: retrying cancelled work defeats the cancel."""
+    transient: retrying cancelled work defeats the cancel. ``progress``
+    (when set) snapshots where the query was — a query cancelled while
+    still waiting in the admission queue carries ``{"queued": True}``."""
+
+    def __init__(self, message: str = "", progress: "dict | None" = None):
+        super().__init__(message)
+        self.progress = progress or {}
 
 
 class DaftTimeoutError(DaftCancelledError):

@@ -96,6 +96,13 @@ class Executor:
         self._op_frames: Dict[int, object] = {}
         self.memory = get_memory_manager()
         self._held_bytes = 0
+        # Set under _state_lock when run()'s cleanup has already returned
+        # this executor's held permits: a Prefetch/feeder thread whose
+        # acquire succeeded JUST as the query unwound (cancel landing
+        # between acquire and the first morsel) must hand its permit
+        # straight back instead of adding to a counter nobody will ever
+        # release again (the permit-leak window, ISSUE 10).
+        self._permits_closed = False
         # Guards executor state that the probe-side Prefetch thread can
         # touch concurrently with the main pull chain: the shared-subtree
         # cache (double materialization) and _held_bytes (lost updates
@@ -151,6 +158,8 @@ class Executor:
         # re-executes the base 2^depth times.
         self._shared_ids = pp.shared_subtree_ids(plan)
         self._shared_cache = {}
+        with self._state_lock:
+            self._permits_closed = False  # executors are re-runnable
         try:
             yield from self._run(plan)
         except BaseException as e:  # noqa: BLE001 — re-raised below
@@ -175,9 +184,16 @@ class Executor:
             if self._spill_dir is not None:
                 self._spill_dir.cleanup()
                 self._spill_dir = None
-            if self._held_bytes:
-                self.memory.release(self._held_bytes)
-                self._held_bytes = 0
+            # Close the permit window ATOMICALLY with reading the held
+            # total: a side-thread acquire that lands after this point
+            # self-releases in _add_held instead of incrementing a counter
+            # that has already been drained (the cancel-between-acquire-
+            # and-first-morsel leak).
+            with self._state_lock:
+                held, self._held_bytes = self._held_bytes, 0
+                self._permits_closed = True
+            if held:
+                self.memory.release(held)
             if self.stats is not None:
                 self.stats.flush()
 
@@ -221,7 +237,12 @@ class Executor:
                 if gate_on:
                     if self.memory.acquire(nbytes, timeout=5.0,
                                            token=self.cancel_token):
-                        self._add_held(nbytes)
+                        # Track what acquire actually granted (it clamps
+                        # oversized requests to the limit) so the unwind
+                        # release is byte-symmetric with the grant.
+                        limit = self.memory.limit
+                        self._add_held(nbytes if limit is None
+                                       else min(nbytes, limit))
                     else:
                         gate_on = False
                 cached.append(mp)
@@ -237,7 +258,14 @@ class Executor:
 
     def _add_held(self, nbytes: int) -> None:
         with self._state_lock:
-            self._held_bytes += nbytes
+            if not self._permits_closed:
+                self._held_bytes += nbytes
+                return
+        # Query already unwound and released its held total: this acquire
+        # raced the cleanup (side thread past its token check). Releasing
+        # here — outside the state lock — keeps available_permits at
+        # baseline instead of leaking until process exit.
+        self.memory.release(nbytes)
 
     def _run_uncached(self, node: pp.PhysicalPlan) -> Iterator[MicroPartition]:
         handler = getattr(self, f"_run_{type(node).__name__}", None)

@@ -124,6 +124,15 @@ class MemoryManager:
     def used(self) -> int:
         return self._used
 
+    def available_permits(self) -> Optional[int]:
+        """Bytes still grantable (None = unlimited). The leak-audit surface:
+        after every query on an idle engine this must equal ``limit`` —
+        tests/test_admission.py poisons mid-acquire and asserts it."""
+        if self.limit is None:
+            return None
+        with self._cond:
+            return max(self.limit - self._used, 0)
+
 
 _GLOBAL: Optional[MemoryManager] = None
 _lock = threading.Lock()

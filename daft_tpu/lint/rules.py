@@ -11,6 +11,7 @@
 | DTL007 | environment variables are read only in config.py / context.py    |
 | DTL008 | counters live on the metrics registry, not module-level dicts    |
 | DTL009 | spans are opened via the context-manager API, never bare calls   |
+| DTL010 | engine-path queues/deques are constructed with an explicit bound |
 
 Each rule documents WHY the invariant exists — a lint error nobody can
 explain gets suppressed instead of fixed.
@@ -544,10 +545,96 @@ class SpanOutsideContextManager(Rule):
                     f"ExitStack.enter_context")
 
 
+class UnboundedQueueInEnginePath(Rule):
+    """DTL010: an unbounded ``queue.Queue()`` / ``collections.deque()`` /
+    ``queue.SimpleQueue()`` in an execution or distributed path is how
+    backpressure silently disappears — a fast producer (scan feeder, morsel
+    stage, admission front door) buffers without limit until the process
+    OOMs under exactly the overload the engine is supposed to shed
+    (admission control, PR 10; bounded morsel queues, PR 8). Construct with
+    an explicit bound (``maxsize=``/``maxlen=``), or — when the bound is
+    enforced by surrounding logic that must REJECT rather than drop —
+    suppress with a reasoned ``# daftlint: disable=DTL010``."""
+
+    rule_id = "DTL010"
+    summary = "unbounded queue/deque in engine path"
+    scope_dirs = ("daft_tpu/execution/", "daft_tpu/distributed/",
+                  "daft_tpu/runners/")
+
+    QUEUE_DOTTED = {"queue.Queue", "queue.LifoQueue", "queue.PriorityQueue"}
+    ALWAYS_UNBOUNDED = {"queue.SimpleQueue"}
+    DEQUE_DOTTED = {"collections.deque"}
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        for node in ctx.walk():
+            if not isinstance(node, ast.Call):
+                continue
+            dotted = ctx.imports.resolve_call(node)
+            if dotted is None:
+                continue
+            if dotted in self.ALWAYS_UNBOUNDED:
+                yield self.finding(
+                    ctx, node,
+                    f"{dotted}() has no capacity bound at all; use "
+                    f"queue.Queue(maxsize=...) so a stalled consumer "
+                    f"backpressures its producer instead of buffering "
+                    f"until OOM")
+            elif dotted in self.QUEUE_DOTTED:
+                if not self._bounded_queue(node):
+                    yield self.finding(
+                        ctx, node,
+                        f"{dotted}() without maxsize is an unbounded buffer "
+                        f"in an engine path; pass maxsize=... (backpressure) "
+                        f"or suppress with a reason if the bound is enforced "
+                        f"by reject-on-full logic")
+            elif dotted in self.DEQUE_DOTTED:
+                if not self._bounded_deque(node):
+                    yield self.finding(
+                        ctx, node,
+                        f"{dotted}() without maxlen is an unbounded buffer "
+                        f"in an engine path; pass maxlen=... — but note "
+                        f"maxlen DROPS silently, so queues that must refuse "
+                        f"work instead enforce the bound explicitly and "
+                        f"suppress with a reason")
+
+    @staticmethod
+    def _bounded_queue(call: ast.Call) -> bool:
+        # queue.Queue(maxsize) positional, or maxsize= kwarg; a literal 0
+        # (or negative) means unbounded in the stdlib contract.
+        bound = None
+        if call.args:
+            bound = call.args[0]
+        for kw in call.keywords:
+            if kw.arg == "maxsize":
+                bound = kw.value
+        if bound is None:
+            return False
+        if isinstance(bound, ast.Constant) and isinstance(bound.value, int):
+            return bound.value > 0
+        return True  # computed bound: trust it (maxsize=max(n, 1) idiom)
+
+    @staticmethod
+    def _bounded_deque(call: ast.Call) -> bool:
+        # deque(iterable, maxlen) positional, or maxlen= kwarg; an explicit
+        # maxlen=None is unbounded.
+        bound = None
+        if len(call.args) >= 2:
+            bound = call.args[1]
+        for kw in call.keywords:
+            if kw.arg == "maxlen":
+                bound = kw.value
+        if bound is None:
+            return False
+        if isinstance(bound, ast.Constant):
+            return bound.value is not None
+        return True
+
+
 ALL_RULES = [WallClockInTaskPath, SwallowedException, UnseededRandomness,
              BlockingCallUnderLock, HostDeviceTransferInKernel,
              NondeterministicIteration, EnvReadOutsideConfig,
-             AdHocCounterDict, SpanOutsideContextManager]
+             AdHocCounterDict, SpanOutsideContextManager,
+             UnboundedQueueInEnginePath]
 
 
 def default_rules() -> List[Rule]:

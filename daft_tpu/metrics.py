@@ -796,6 +796,29 @@ HEARTBEATS = _r.counter(
     "daft_worker_heartbeats_total", "Successful liveness probes",
     ("worker_id",))
 
+# Admission control (execution/admission.py)
+ADMISSION_QUEUE_DEPTH = _r.gauge(
+    "daft_admission_queue_depth",
+    "Queries waiting in the tenant's bounded admission queue", ("tenant",))
+ADMISSION_ACTIVE = _r.gauge(
+    "daft_admission_active_queries",
+    "Admitted queries currently holding a tenant slot", ("tenant",))
+ADMISSION_ADMITTED = _r.counter(
+    "daft_admission_admitted_total", "Queries admitted per tenant",
+    ("tenant",))
+ADMISSION_REJECTED = _r.counter(
+    "daft_admission_rejected_total",
+    "Queries rejected at the front door, by tenant and reason "
+    "(queue-full/deadline-too-short/shed-low-priority/shed-over-quota/"
+    "overload)", ("tenant", "reason"))
+ADMISSION_WAIT = _r.histogram(
+    "daft_admission_wait_seconds",
+    "Time from admit() call to admission (0 on the uncontended fast path)")
+ADMISSION_SHED_LEVEL = _r.gauge(
+    "daft_admission_shed_level",
+    "Overload ladder level: 0 normal, 1 shed low-priority/over-quota, "
+    "2 + halved stage parallelism, 3 + reject default-priority tenants")
+
 # AI providers (ai/metrics.py shims onto these)
 AI_TOKENS = _r.counter(
     "daft_ai_tokens_total", "Provider tokens consumed",

@@ -109,6 +109,19 @@ class DistributedRunner(Runner):
         # trace_scope below, so every Task created by the planner captures
         # it (Task.trace_ctx default_factory) and ships it to its worker.
         prof = profiling.begin_query(query_id, cfg)
+        from daft_tpu.cancellation import (
+            cancel_scope,
+            register_query_token,
+            unregister_query_token,
+        )
+        from daft_tpu.runners.runner import enter_front_door
+
+        # One token per query, created on the driver by the shared
+        # prologue (explicit timeout > config default > unbounded), then
+        # the admission front door BEFORE planning/dispatch. A shed-ladder
+        # thread cap lands on cfg, which ships with every Task, so worker-
+        # side executors inherit it (see runner.py).
+        token, ticket, cfg = enter_front_door(query_id, cfg, timeout)
         try:
             with contextlib.ExitStack() as plan_st:
                 if prof is not None:
@@ -118,7 +131,9 @@ class DistributedRunner(Runner):
         except BaseException as e:  # noqa: BLE001
             # The execution try/finally below hasn't started: close the
             # profile HERE or a planning failure leaks it in the process-
-            # global registry forever (and collect_profile gets no trace).
+            # global registry forever (and collect_profile gets no trace) —
+            # and release the admission slot the same way.
+            ticket.release()
             profiling.end_query(query_id, error=str(e))
             raise
         ctx.notify(QueryStart(query_id=query_id, plan=repr(optimized.plan)))
@@ -134,25 +149,10 @@ class DistributedRunner(Runner):
         stats.local_flush = False  # workers already emit OperatorStats events
         ctx.last_query_stats = stats  # DataFrame.metrics() surface
         register_query_stats(query_id, stats)
-        from daft_tpu.cancellation import (
-            CancelToken,
-            Deadline,
-            cancel_scope,
-            register_query_token,
-            unregister_query_token,
-        )
         from daft_tpu.context import frozen_clock_scope
 
         from daft_tpu.distributed.faults import config_fault_scope
 
-        # One token per query, created HERE on the driver: explicit
-        # timeout > config default > unbounded. Registered by query id so
-        # in-process workers observe it live (daft_tpu.cancel_query too).
-        if timeout is None:
-            timeout = cfg.query_timeout_s
-        token = CancelToken(
-            Deadline.after(timeout) if timeout is not None else None,
-            query_id=query_id)
         register_query_token(query_id, token)
         try:
             executor = DistributedExecutor(self.manager, cfg, query_id=query_id,
@@ -180,6 +180,10 @@ class DistributedRunner(Runner):
             error = str(e)
             raise
         finally:
+            # Exception-safe on EVERY exit: success, timeout, cancel,
+            # worker loss mid-query, chaos, and generator close all pass
+            # here — admission slots/reservations can never leak.
+            ticket.release()
             unregister_query_token(query_id)
             unregister_query_stats(query_id)
             ctx.notify(QueryEnd(query_id=query_id,

@@ -33,6 +33,16 @@ class NativeRunner(Runner):
         # QueryProfile per query; the driver-local TaskProfiler feeds it
         # directly, and the Chrome trace writes at end_query.
         prof = profiling.begin_query(query_id, cfg)
+        from daft_tpu.cancellation import (
+            iter_with_cancel_scope,
+            register_query_token,
+            unregister_query_token,
+        )
+        from daft_tpu.runners.runner import enter_front_door
+
+        # Admission front door BEFORE planning (shared prologue: cancel
+        # token + admit + shed-ladder thread cap; see runner.py).
+        token, ticket, cfg = enter_front_door(query_id, cfg, timeout)
         try:
             with contextlib.ExitStack() as plan_st:
                 if prof is not None:
@@ -42,25 +52,14 @@ class NativeRunner(Runner):
         except BaseException as e:  # noqa: BLE001
             # The execution try/finally below hasn't started: close the
             # profile HERE or a planning failure leaks it in the process-
-            # global registry forever (and collect_profile gets no trace).
+            # global registry forever (and collect_profile gets no trace) —
+            # and release the admission slot the same way.
+            ticket.release()
             profiling.end_query(query_id, error=str(e))
             raise
         ctx.notify(QueryStart(query_id=query_id, plan=repr(optimized.plan)))
         start = time.perf_counter()
         error = None
-        from daft_tpu.cancellation import (
-            CancelToken,
-            Deadline,
-            iter_with_cancel_scope,
-            register_query_token,
-            unregister_query_token,
-        )
-
-        if timeout is None:
-            timeout = cfg.query_timeout_s
-        token = CancelToken(
-            Deadline.after(timeout) if timeout is not None else None,
-            query_id=query_id)
         register_query_token(query_id, token)
         try:
             from daft_tpu.execution.resource_manager import RuntimeStats
@@ -90,6 +89,10 @@ class NativeRunner(Runner):
             error = str(e)
             raise
         finally:
+            # Exception-safe on EVERY exit: success, timeout, cancel,
+            # worker loss, chaos, and generator close all pass here —
+            # admission slots/reservations can never leak.
+            ticket.release()
             unregister_query_token(query_id)
             ctx.notify(QueryEnd(query_id=query_id,
                                 duration_s=time.perf_counter() - start, error=error))

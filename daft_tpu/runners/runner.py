@@ -21,6 +21,40 @@ class PartitionCacheEntry:
         return sum(p.size_bytes() for p in self.partitions)
 
 
+def enter_front_door(query_id: str, cfg, timeout: "float | None"):
+    """The shared query prologue for BOTH runners: create the one cancel
+    token (explicit timeout > config default > unbounded) and pass the
+    admission gate BEFORE any planning work. Returns ``(token, ticket,
+    cfg)`` where cfg may carry a shed-ladder compute-thread cap (safe: the
+    pipelined executor's determinism contract makes results thread-count
+    invariant). On admission failure the query's profile — opened by the
+    caller before this — is closed here so it can't leak in the process-
+    global registry. The caller OWNS ticket.release() on every later exit
+    path (its run_iter finally)."""
+    from daft_tpu import profiling
+    from daft_tpu.cancellation import CancelToken, Deadline
+    from daft_tpu.execution.admission import get_controller
+
+    if timeout is None:
+        timeout = cfg.query_timeout_s
+    token = CancelToken(
+        Deadline.after(timeout) if timeout is not None else None,
+        query_id=query_id)
+    try:
+        # May block in the tenant's bounded queue (deadline/cancel-aware)
+        # or raise DaftAdmissionError / DaftCancelledError /
+        # DaftTimeoutError — a shed query costs one lock acquisition,
+        # never an optimizer pass or a worker round-trip.
+        ticket = get_controller().admit(query_id, token=token, cfg=cfg)
+    except BaseException as e:  # noqa: BLE001 — profile must not leak
+        profiling.end_query(query_id, error=str(e))
+        raise
+    if ticket.compute_threads_cap:
+        cfg = cfg.with_changes(
+            num_compute_threads=ticket.compute_threads_cap)
+    return token, ticket, cfg
+
+
 class Runner:
     name = "base"
 

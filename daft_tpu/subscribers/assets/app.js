@@ -90,6 +90,26 @@ async function renderTimeline() {
   ).join("");
 }
 
+async function renderAdmission() {
+  const a = await getJSON("/api/admission");
+  const lvl = a.totals.shed_level;
+  const lvlTxt = ["0 · normal", "1 · shedding low-priority",
+    "2 · + halved parallelism", "3 · + rejecting default tenants"][lvl] || lvl;
+  $("#shed-level").innerHTML =
+    `<span class="${lvl ? "err" : "ok"}">level ${esc(lvlTxt)}</span>
+     · ${a.totals.running} running · ${a.totals.queued} queued`;
+  $("#admission tbody").innerHTML = a.tenants.map((t) => {
+    const reasons = Object.entries(t.shed_by_reason || {})
+      .map(([r, n]) => `${r}:${n}`).join(" ");
+    return `<tr><td>${esc(t.tenant)}</td><td>${t.running}</td>
+      <td class="${t.queued ? "err" : "ok"}">${t.queued}</td>
+      <td>${t.admitted}</td><td class="${t.shed ? "err" : "ok"}">${t.shed}</td>
+      <td>${(t.last_wait_s || 0).toFixed(3)}</td>
+      <td>${(t.max_wait_s || 0).toFixed(3)}</td>
+      <td>${fmtBytes(t.mem_reserved)}</td><td>${esc(reasons)}</td></tr>`;
+  }).join("") || '<tr><td colspan="9" class="hint">no tenants yet</td></tr>';
+}
+
 async function renderWorkers() {
   const ws = await getJSON("/api/workers");  // one aggregate call, no N+1
   $("#workers tbody").innerHTML = ws.map((w) =>
@@ -196,6 +216,7 @@ async function tick() {
   try {
     await renderSummary();
     if (view === "queries") await renderQueries();
+    else if (view === "admission") await renderAdmission();
     else if (view === "workers") await renderWorkers();
     else if (view === "perf") await renderPerf();
     else await renderDataframes();

@@ -25,7 +25,10 @@ from daft_tpu.subscribers.events import (
     CircuitOpened,
     Event,
     OperatorStats,
+    QueryAdmitted,
     QueryEnd,
+    QueryQueued,
+    QueryShed,
     QueryStart,
     Subscriber,
     TaskCompleted,
@@ -157,9 +160,36 @@ class DashboardState:
         self.workers_live: Dict[str, dict] = {}
         self.breakers: Dict[str, dict] = {}
         self.retries_by_reason: Dict[str, int] = {}
+        # Admission panel: per-tenant event tallies (the LIVE queue/slot
+        # numbers come from the controller snapshot in /api/admission; the
+        # event stream contributes history — admits, sheds, last wait).
+        self.admission: Dict[str, dict] = {}
+
+    def _tenant_row(self, tenant: str) -> dict:
+        return self.admission.setdefault(tenant, {
+            "tenant": tenant, "admitted": 0, "queued_events": 0, "shed": 0,
+            "shed_by_reason": {}, "last_wait_s": 0.0, "max_wait_s": 0.0,
+            "last_shed_level": 0})
 
     def on_event(self, e: Event) -> None:
         with self._lock:
+            if isinstance(e, QueryQueued):
+                row = self._tenant_row(e.tenant)
+                row["queued_events"] += 1
+                return
+            if isinstance(e, QueryAdmitted):
+                row = self._tenant_row(e.tenant)
+                row["admitted"] += 1
+                row["last_wait_s"] = e.wait_s
+                row["max_wait_s"] = max(row["max_wait_s"], e.wait_s)
+                row["last_shed_level"] = e.shed_level
+                return
+            if isinstance(e, QueryShed):
+                row = self._tenant_row(e.tenant)
+                row["shed"] += 1
+                row["shed_by_reason"][e.reason] = \
+                    row["shed_by_reason"].get(e.reason, 0) + 1
+                return
             if isinstance(e, WorkerLost):
                 self.workers_live[e.worker_id] = {
                     "worker": e.worker_id, "status": "lost",
@@ -259,6 +289,24 @@ class DashboardState:
         with self._lock:
             return sorted((dict(b) for b in self.breakers.values()),
                           key=lambda b: b["endpoint"])
+
+    def admission_rows(self) -> List[dict]:
+        """Per-tenant admission table: event-stream history merged with the
+        controller's LIVE queue/slot state (one endpoint, no N+1)."""
+        from daft_tpu.execution.admission import get_controller
+
+        ctl = get_controller()
+        live = ctl.snapshot()
+        with self._lock:
+            tenants = sorted(set(self.admission) | set(live))
+            rows = []
+            for t in tenants:
+                row = dict(self._tenant_row(t))
+                row["shed_by_reason"] = dict(row["shed_by_reason"])
+                row.update(live.get(t, {"running": 0, "queued": 0,
+                                        "mem_reserved": 0}))
+                rows.append(row)
+        return rows
 
     def engine_summary(self) -> dict:
         """Live engine state (reference: daft-dashboard engine.rs state),
@@ -407,6 +455,16 @@ class _Handler(BaseHTTPRequestHandler):
             ctype = "application/json"
         elif path == "/api/engine":
             body = json.dumps(self.state.engine_summary()).encode()
+            ctype = "application/json"
+        elif path == "/api/admission":
+            # Admission panel: per-tenant queue/slots table (live controller
+            # state + event-stream history) and the shed-ladder level.
+            from daft_tpu.execution.admission import get_controller
+
+            body = json.dumps({
+                "tenants": self.state.admission_rows(),
+                "totals": get_controller().totals(),
+            }).encode()
             ctype = "application/json"
         elif path == "/api/workers":
             body = json.dumps(self.state.workers_summary()).encode()

@@ -108,6 +108,45 @@ class QueryCancelled(Event):
 
 
 @dataclass
+class QueryQueued(Event):
+    """The query hit its tenant's concurrency/memory quota and entered the
+    bounded admission queue (execution/admission.py). ``queue_depth`` is
+    the tenant's queue length INCLUDING this query."""
+
+    query_id: str = ""
+    tenant: str = ""
+    queue_depth: int = 0
+
+
+@dataclass
+class QueryAdmitted(Event):
+    """The query passed the admission front door. ``wait_s`` is 0 on the
+    uncontended fast path; ``shed_level`` is the overload-ladder level at
+    admission and ``compute_threads_cap`` (0 = uncapped) the per-query
+    stage-parallelism cap applied at level >= 2."""
+
+    query_id: str = ""
+    tenant: str = ""
+    wait_s: float = 0.0
+    shed_level: int = 0
+    compute_threads_cap: int = 0
+
+
+@dataclass
+class QueryShed(Event):
+    """The query was rejected at admission — fast, before planning or
+    dispatch. ``reason``: queue-full / deadline-too-short /
+    shed-low-priority / shed-over-quota / overload. ``retry_after_s`` is
+    the backoff hint shipped to the client in DaftAdmissionError."""
+
+    query_id: str = ""
+    tenant: str = ""
+    reason: str = ""
+    queue_depth: int = 0
+    retry_after_s: float = 0.0
+
+
+@dataclass
 class CircuitOpened(Event):
     """An IO endpoint's circuit breaker tripped open after consecutive
     transient failures; calls now fail fast until a probe succeeds."""

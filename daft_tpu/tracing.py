@@ -32,8 +32,11 @@ from daft_tpu.subscribers.events import (
     OptimizationEnd,
     OptimizationStart,
     PartitionRecovered,
+    QueryAdmitted,
     QueryCancelled,
     QueryEnd,
+    QueryQueued,
+    QueryShed,
     QueryStart,
     TaskCompleted,
     TaskRetried,
@@ -336,6 +339,24 @@ class TracingSubscriber:
                 self.meter.record("daft.circuit.open_for_s", e.open_for_s)
             elif isinstance(e, CircuitClosed):
                 self.meter.add("daft.circuit.closed")
+            # Admission events fire BEFORE QueryStart (the front door is
+            # ahead of planning), so there is no open query span to attach
+            # to — they land on the meter, keyed by tenant.
+            elif isinstance(e, QueryQueued):
+                self.meter.add("daft.admission.queued")
+                self.meter.record("daft.admission.queue_depth", e.queue_depth)
+            elif isinstance(e, QueryAdmitted):
+                # No per-tenant meter keys: tenant ids are caller-supplied
+                # strings (unbounded cardinality for the life of the
+                # process); the metrics registry already carries tenant as
+                # a proper evictable label.
+                self.meter.add("daft.admission.admitted")
+                self.meter.record("daft.admission.wait_s", e.wait_s)
+            elif isinstance(e, QueryShed):
+                self.meter.add("daft.admission.shed")
+                # reason is a fixed engine enum (5 values) — bounded.
+                self.meter.add(
+                    f"daft.admission.shed.{e.reason or 'unknown'}")
 
 
 _auto_subscriber: Optional[TracingSubscriber] = None

@@ -15,11 +15,12 @@ Exit code 0 = all rounds survived with identical results.
 from __future__ import annotations
 
 import argparse
+import os
 import random
 import sys
 import time
 
-sys.path.insert(0, "/root/repo")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax  # noqa: E402
 
@@ -185,6 +186,112 @@ def scrape_check(url: str) -> str | None:
     return None
 
 
+def overload_round(seed: int, queries: int = 36) -> str | None:
+    """The `overload` spec (ISSUE 10): a query storm from 3 tenants — one
+    hostile (tight quota, huge scans) — on the distributed runner under
+    breaker-burst + worker-kill faults. Asserts: no leaked permits, no
+    stuck admission slots or threads, and every well-behaved tenant's
+    query either completes or fails with a CLASSIFIED DaftError (never a
+    hang — the script-level timeout is the backstop). Returns an error
+    string or None."""
+    import threading
+
+    from daft_tpu.errors import DaftAdmissionError
+    from daft_tpu.execution.admission import (
+        get_controller,
+        set_tenant,
+        set_tenant_policy,
+    )
+    from daft_tpu.execution.resource_manager import memory_limit
+
+    set_tenant_policy("hostile", max_concurrent_queries=1, queue_depth=2,
+                      priority=-1)
+    set_tenant_policy("steady", max_concurrent_queries=8, queue_depth=16)
+    set_tenant_policy("gold", max_concurrent_queries=8, queue_depth=16,
+                      priority=1)
+    big = make_lineitem()  # hostile's "huge" scan: every partition
+    small = daft_tpu.from_pydict({
+        "l_orderkey": list(range(60)),
+        "l_quantity": [float(i % 13) for i in range(60)],
+        "l_extendedprice": [100.0 + i for i in range(60)],
+        "l_discount": [0.01 * (i % 9) for i in range(60)],
+        "l_returnflag": ["A" if i % 2 else "F" for i in range(60)],
+        "l_linestatus": ["N" if i % 3 else "O" for i in range(60)],
+    }).into_partitions(2)
+    # Breaker burst (6 consecutive transient IO failures) + worker kill +
+    # dispatch delays: the storm rides the full PR 2/4 failure machinery.
+    spec = (",".join(f"io.get_object:raise_transient:{i + 1}"
+                     for i in range(6))
+            + ",worker.pre_submit:kill:4,worker.pre_submit:delay:2+:0.01")
+    ctx = daft_tpu.get_context()
+    old = ctx._runner
+    runner = DistributedRunner(num_workers=3)
+    ctx.set_runner(runner)
+    results = {"hang": 0, "unclassified": [], "well_behaved_bad": []}
+    lock = threading.Lock()
+
+    def one(i: int):
+        tenant = ("hostile", "steady", "gold")[i % 3]
+        set_tenant(tenant)
+        df = big if tenant == "hostile" else small
+        try:
+            q1_style(df)
+        except DaftAdmissionError:
+            pass  # shed is a classified, expected outcome
+        except DaftTimeoutError:
+            pass
+        except DaftError:
+            pass  # classified failure: acceptable under chaos
+        except BaseException as e:  # noqa: BLE001 — the assertion target
+            with lock:
+                results["unclassified"].append((tenant, repr(e)[:120]))
+
+    # Baseline AFTER the runner exists: the audit below measures what the
+    # STORM leaked, so the runner's own machinery (worker slots, heartbeat
+    # monitor) is shut down before threads are counted again.
+    thread_baseline = threading.active_count()
+    with memory_limit(256 << 20) as mm:
+        permit_baseline = mm.available_permits()
+        with fault_scope(spec, seed=seed):
+            with daft_tpu.execution_config_ctx(query_timeout_s=30.0):
+                threads = [threading.Thread(target=one, args=(i,))
+                           for i in range(queries)]
+                for t in threads:
+                    t.start()
+                for t in threads:
+                    t.join(timeout=120)
+                hung = [t for t in threads if t.is_alive()]
+        runner.manager.shutdown()
+        ctx.set_runner(old)
+        if hung:
+            return f"{len(hung)} query thread(s) hung past the deadline"
+        # Leak audit: permits, slots, gauges, threads — all back to zero.
+        deadline = time.time() + 15
+        err = "leak audit never converged"
+        while time.time() < deadline:
+            totals = get_controller().totals()
+            avail = mm.available_permits()
+            threads_now = threading.active_count()
+            if totals["running"] or totals["queued"] \
+                    or totals["mem_reserved"]:
+                err = f"stuck admission slots: {totals}"
+            elif avail != permit_baseline:
+                err = f"leaked permits: {avail} != {permit_baseline}"
+            elif threads_now > thread_baseline + 4:
+                err = (f"leaked threads: {threads_now} vs baseline "
+                       f"{thread_baseline}")
+            else:
+                err = None
+                break
+            time.sleep(0.1)
+    set_tenant(None)
+    if err:
+        return err
+    if results["unclassified"]:
+        return f"unclassified failures: {results['unclassified'][:3]}"
+    return None
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--rounds", type=int, default=10)
@@ -193,7 +300,19 @@ def main() -> int:
                     help="replay one exact spec instead of randomizing")
     ap.add_argument("--no-scrape", action="store_true",
                     help="skip the per-round dashboard /metrics validation")
+    ap.add_argument("--overload", action="store_true",
+                    help="run only the multi-tenant overload spec")
     args = ap.parse_args()
+
+    if args.overload:
+        t0 = time.time()
+        err = overload_round(seed=args.seed)
+        if err:
+            print(f"[overload] FAIL seed={args.seed}: {err}")
+            return 1
+        print(f"[overload] ok ({time.time() - t0:.1f}s) — storm survived, "
+              f"zero leaked permits/slots/threads, failures all classified")
+        return 0
 
     ctx = daft_tpu.get_context()
     old = ctx._runner

@@ -1,0 +1,521 @@
+"""Multi-tenant load storm: 100s of concurrent mixed TPC-H-shaped queries
+through the admission front door, with SLO assertions.
+
+Three tenants share one engine process:
+
+* ``hostile``  — tight quota (1 concurrent, queue depth 1, priority -1) and
+  the biggest scans: the tenant the front door must CAP.
+* ``batch``    — default-priority analytics mix.
+* ``gold``     — positive priority (rides out the whole shed ladder).
+
+The storm fires ``--queries`` collects (default 240, >= 200 for the
+acceptance run) from a thread pool, then asserts:
+
+1. the hostile tenant's observed concurrency never exceeded its quota;
+2. well-behaved tenants' p99 completion under the FULL storm stayed
+   within 2x their p99 under the same storm WITHOUT the hostile tenant
+   (the uncontended-by-hostile control: the isolation the front door
+   exists to provide — a serial baseline would measure GIL/core
+   contention, which admission does not and cannot remove);
+3. overload rejections were fast ``DaftAdmissionError``s
+   (p99 rejection latency < 100ms, measured around collect() alone);
+4. after the storm — including an optional ``--chaos`` round under
+   worker-kill + breaker-burst fault specs — zero leaked memory permits,
+   zero stuck admission slots, and queue-depth gauges back at 0.
+
+Admission-wait p50/p99 are scraped from the dashboard's ``/metrics``
+(Prometheus histogram), the same way an operator would.
+
+    python scripts/load_storm.py                  # full storm + chaos round
+    python scripts/load_storm.py --smoke          # CI-sized quick pass
+    python scripts/load_storm.py --assert-overhead  # <2% uncontended tax
+
+Exit code 0 = all assertions held.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import random
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import daft_tpu  # noqa: E402
+from daft_tpu import col  # noqa: E402
+from daft_tpu.errors import DaftAdmissionError, DaftError  # noqa: E402
+from daft_tpu.execution.admission import (  # noqa: E402
+    get_controller,
+    set_tenant,
+    set_tenant_policy,
+)
+
+ROWS = 1200
+HOSTILE_ROWS = 4000  # "huge scans": 3x+ everyone else's input
+
+
+def make_lineitem(rows: int, seed: int = 0):
+    rng = random.Random(seed)
+    return daft_tpu.from_pydict({
+        "l_orderkey": [rng.randrange(200) for _ in range(rows)],
+        "l_quantity": [float(rng.randrange(1, 50)) for _ in range(rows)],
+        "l_extendedprice": [round(rng.uniform(900.0, 10_000.0), 2)
+                            for _ in range(rows)],
+        "l_discount": [round(rng.uniform(0.0, 0.1), 2) for _ in range(rows)],
+        "l_returnflag": [rng.choice("AF") for _ in range(rows)],
+        "l_linestatus": [rng.choice("NO") for _ in range(rows)],
+    })
+
+
+def make_orders(seed: int = 1):
+    rng = random.Random(seed)
+    return daft_tpu.from_pydict({
+        "o_orderkey": list(range(200)),
+        "o_custkey": [rng.randrange(40) for _ in range(200)],
+        "o_orderpriority": [f"{rng.randrange(1, 6)}-P" for _ in range(200)],
+    })
+
+
+def q_agg(df):
+    """TPC-H Q1 shape: wide grouped aggregation."""
+    return (df.with_column("disc_price",
+                           col("l_extendedprice") * (1 - col("l_discount")))
+            .groupby("l_returnflag", "l_linestatus")
+            .agg(col("l_quantity").sum().alias("sum_qty"),
+                 col("disc_price").sum().alias("sum_disc_price"),
+                 col("l_orderkey").count().alias("n"))
+            .sort(["l_returnflag", "l_linestatus"]))
+
+
+def q_join(df, orders):
+    """Q3/Q5 shape: join + grouped count + sort."""
+    return (df.join(orders, left_on="l_orderkey", right_on="o_orderkey")
+            .groupby("o_orderpriority")
+            .agg(col("l_quantity").sum().alias("qty"))
+            .sort("o_orderpriority"))
+
+
+def q_filter(df):
+    """Q6 shape: selective filter + projection + global agg."""
+    return (df.where((col("l_discount") >= 0.03)
+                     & (col("l_quantity") < 24.0))
+            .with_column("rev", col("l_extendedprice") * col("l_discount"))
+            .agg(col("rev").sum().alias("revenue")))
+
+
+def build_mixes():
+    """Per-tenant lazy-query builders over SHARED immutable source frames.
+    Sources are materialized once here: regenerating row data per job is
+    pure GIL-bound Python that would perturb every concurrent query, and
+    transformed DataFrames (q_agg(df) etc.) are new objects per call, so
+    result caching never aliases across jobs."""
+    orders = make_orders()
+    small = [make_lineitem(ROWS, s) for s in range(3)]
+    big = [make_lineitem(HOSTILE_ROWS, s) for s in range(3)]
+    return {
+        "hostile": [lambda d=d: q_agg(d) for d in big]
+        + [lambda d=d: q_join(d, orders) for d in big],
+        "batch": [lambda d=d: q_agg(d) for d in small[:2]]
+        + [lambda d=d: q_join(d, orders) for d in small[:2]]
+        + [lambda d=d: q_filter(d) for d in small[:2]],
+        "gold": [lambda d=d: q_filter(d) for d in small],
+    }
+
+
+def pctl(sorted_vals, q):
+    if not sorted_vals:
+        return 0.0
+    i = min(int(q * len(sorted_vals)), len(sorted_vals) - 1)
+    return sorted_vals[i]
+
+
+# --------------------------------------------------------------------- #
+# Prometheus scrape: admission-wait histogram p50/p99                     #
+# --------------------------------------------------------------------- #
+def scrape_admission_wait(url: str):
+    """Parse daft_admission_wait_seconds buckets from /metrics; returns
+    (p50_bound, p99_bound, count) — quantiles as bucket upper bounds, the
+    standard Prometheus histogram_quantile view."""
+    import urllib.request
+
+    text = urllib.request.urlopen(f"{url}/metrics", timeout=5).read().decode()
+    buckets = []
+    count = 0
+    for line in text.splitlines():
+        if line.startswith("daft_admission_wait_seconds_bucket"):
+            le = line.split('le="')[1].split('"')[0]
+            val = float(line.rsplit(" ", 1)[1])
+            buckets.append((float("inf") if le == "+Inf" else float(le), val))
+        elif line.startswith("daft_admission_wait_seconds_count"):
+            count = float(line.rsplit(" ", 1)[1])
+    buckets.sort(key=lambda b: b[0])
+
+    def q(frac):
+        need = frac * count
+        for bound, cum in buckets:
+            if cum >= need:
+                return bound
+        return float("inf")
+
+    return (q(0.5), q(0.99), int(count)) if count else (0.0, 0.0, 0)
+
+
+def scrape_queue_gauges(url: str):
+    """All daft_admission_queue_depth series from /metrics."""
+    import urllib.request
+
+    text = urllib.request.urlopen(f"{url}/metrics", timeout=5).read().decode()
+    out = {}
+    for line in text.splitlines():
+        if line.startswith("daft_admission_queue_depth{"):
+            tenant = line.split('tenant="')[1].split('"')[0]
+            out[tenant] = float(line.rsplit(" ", 1)[1])
+    return out
+
+
+# --------------------------------------------------------------------- #
+# Storm                                                                   #
+# --------------------------------------------------------------------- #
+def warmup(mixes) -> None:
+    """One serial pass per shape: JIT/plan caches warm before anything is
+    measured."""
+    for tenant, builders in mixes.items():
+        set_tenant(tenant)
+        for build in builders:
+            build().collect()
+    set_tenant(None)
+
+
+class StormStats:
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.walls = {}          # tenant -> [completion wall]
+        self.rejections = []     # (tenant, latency_s, reason)
+        self.errors = []         # (tenant, error) — non-admission failures
+        self.unclassified = []   # crashes outside the Daft taxonomy
+
+    def record_wall(self, tenant, wall):
+        with self.lock:
+            self.walls.setdefault(tenant, []).append(wall)
+
+    def record_rejection(self, tenant, lat, reason):
+        with self.lock:
+            self.rejections.append((tenant, lat, reason))
+
+    def record_error(self, tenant, err):
+        with self.lock:
+            if isinstance(err, DaftError):
+                self.errors.append((tenant, type(err).__name__))
+            else:
+                self.unclassified.append((tenant, repr(err)))
+
+
+def run_storm(mixes, n_queries: int, n_threads: int, stats: StormStats,
+              seed: int = 0, exclude=()) -> dict:
+    """Fire n_queries across tenants from a thread pool; returns the peak
+    per-tenant concurrency observed by a 5ms monitor (the starvation
+    check's instrument). ``exclude`` drops tenants from the offered load
+    WITHOUT redistributing it (their job slots become no-ops) so a
+    hostile-free control run offers the well-behaved tenants the same
+    per-tenant load as the real storm."""
+    rng = random.Random(seed)
+    tenants = list(mixes)
+    # Hostile gets an outsized share of the offered load: the front door,
+    # not the traffic mix, must be what caps it.
+    weights = {"hostile": 3, "batch": 2, "gold": 1}
+    jobs = [rng.choices(tenants,
+                        weights=[weights.get(t, 1) for t in tenants])[0]
+            for _ in range(n_queries)]
+    jobs = [None if t in exclude else t for t in jobs]
+    idx = {"n": 0}
+    ctl = get_controller()
+    peak = {t: 0 for t in tenants}
+    stop = threading.Event()
+
+    def monitor():
+        while not stop.is_set():
+            snap = ctl.snapshot()
+            for t in tenants:
+                peak[t] = max(peak[t], snap.get(t, {}).get("running", 0))
+            time.sleep(0.005)
+
+    def worker():
+        while True:
+            with stats.lock:
+                if idx["n"] >= len(jobs):
+                    return
+                i = idx["n"]
+                idx["n"] += 1
+            tenant = jobs[i]
+            if tenant is None:  # excluded slot (control run)
+                continue
+            set_tenant(tenant)
+            build = mixes[tenant][i % len(mixes[tenant])]
+            df = build()  # data/plan construction is NOT front-door latency
+            t0 = time.monotonic()
+            try:
+                df.collect()
+                stats.record_wall(tenant, time.monotonic() - t0)
+            except DaftAdmissionError as e:
+                stats.record_rejection(tenant, time.monotonic() - t0,
+                                       e.reason)
+            except BaseException as e:  # noqa: BLE001 — classified below
+                stats.record_error(tenant, e)
+
+    mon = threading.Thread(target=monitor, daemon=True)
+    mon.start()
+    threads = [threading.Thread(target=worker) for _ in range(n_threads)]
+    t0 = time.monotonic()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    stop.set()
+    mon.join(timeout=5)
+    print(f"storm: {n_queries} queries / {n_threads} threads "
+          f"in {time.monotonic() - t0:.1f}s")
+    return peak
+
+
+def chaos_round(stats: StormStats, n_queries: int, seed: int) -> None:
+    """A storm slice on the DISTRIBUTED runner under worker kills +
+    transient IO bursts (breaker trips): admission state must still drain
+    to zero and failures must stay classified."""
+    from daft_tpu.distributed.faults import fault_scope
+    from daft_tpu.runners.distributed import DistributedRunner
+
+    ctx = daft_tpu.get_context()
+    old = ctx._runner
+    runner = DistributedRunner(num_workers=3)
+    ctx.set_runner(runner)
+    spec = ("worker.pre_submit:kill:5,"
+            + ",".join(f"io.get_object:raise_transient:{i + 1}"
+                       for i in range(6))
+            + ",worker.pre_submit:delay:3+:0.01")
+    try:
+        with fault_scope(spec, seed=seed):
+            mixes = build_mixes()
+            run_storm(mixes, n_queries, n_threads=8, stats=stats, seed=seed)
+    finally:
+        runner.manager.shutdown()
+        ctx.set_runner(old)
+
+
+# --------------------------------------------------------------------- #
+# Uncontended-overhead assertion (ABBA-paired, CI lane)                   #
+# --------------------------------------------------------------------- #
+def assert_overhead(blocks: int = 3, reps: int = 4) -> int:
+    """Admission must be invisible when uncontended: single-tenant SERIAL
+    TPC-H subset with admission on vs off, ABBA-paired within each block
+    (same discipline as the metrics/profiler <2% guards), median paired
+    ratio <= 1.02. Escalates once with doubled blocks before failing."""
+    from daft_tpu.context import execution_config_ctx
+
+    mixes = build_mixes()
+    serial = mixes["batch"]
+
+    def one_pass():
+        for build in serial:
+            build().collect()
+
+    def measure(enabled):
+        with execution_config_ctx(admission_enabled=enabled):
+            t0 = time.monotonic()
+            for _ in range(reps):
+                one_pass()
+            return time.monotonic() - t0
+
+    def run_blocks(n):
+        one_pass()  # warm caches/compile outside the measurement
+        deltas = []
+        for b in range(n):
+            # ABBA within the block: on,off,off,on — position bias cancels.
+            a1 = measure(True)
+            b1 = measure(False)
+            b2 = measure(False)
+            a2 = measure(True)
+            deltas.append((a1 + a2) / (b1 + b2))
+        deltas.sort()
+        return deltas[len(deltas) // 2]
+
+    ratio = run_blocks(blocks)
+    if ratio > 1.02:
+        print(f"overhead {100 * (ratio - 1):.2f}% > 2%: escalating once "
+              f"with {2 * blocks} blocks")
+        ratio = run_blocks(2 * blocks)
+    pct = 100 * (ratio - 1)
+    print(f"admission uncontended overhead: {pct:+.2f}% (bound 2%)")
+    if ratio > 1.02:
+        print("FAIL: admission adds >2% to uncontended serial TPC-H subset")
+        return 1
+    return 0
+
+
+def permit_leak_audit() -> str | None:
+    """Targeted zero-leaked-permits check: under a REAL memory limit, run
+    queries that acquire permits — including one cancelled mid-flight —
+    and assert available_permits returns to baseline. Kept separate from
+    the throughput storms: any memory limit caps concurrent spilling sinks
+    at limit/budget reservations (the engine's out-of-core guard), which
+    would convoy the storm on 5s degrade-timeouts and measure the permit
+    gate, not the front door."""
+    from daft_tpu.errors import DaftCancelledError, DaftTimeoutError
+    from daft_tpu.execution.resource_manager import memory_limit
+
+    with memory_limit(64 << 20) as mm:
+        baseline = mm.available_permits()
+        mixes = build_mixes()
+        set_tenant("batch")
+        for build in mixes["batch"][:3]:
+            build().collect()
+        # A cancelled query's unwind must hand every permit back.
+        try:
+            q_agg(make_lineitem(HOSTILE_ROWS, seed=99)).collect(
+                timeout=0.001)
+        except (DaftTimeoutError, DaftCancelledError):
+            pass
+        set_tenant(None)
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            if mm.available_permits() == baseline:
+                return None
+            time.sleep(0.05)
+        return (f"leaked memory permits: available {mm.available_permits()} "
+                f"!= baseline {baseline}")
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--queries", type=int, default=240,
+                    help=">= 200 for the acceptance run")
+    ap.add_argument("--threads", type=int, default=32)
+    ap.add_argument("--smoke", action="store_true",
+                    help="CI-sized: 36 queries / 12 threads, no chaos round")
+    ap.add_argument("--chaos", action="store_true", default=None,
+                    help="force the chaos round (default: on unless --smoke)")
+    ap.add_argument("--assert-overhead", action="store_true",
+                    help="only run the <2% uncontended overhead check")
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args()
+    if args.assert_overhead:
+        return assert_overhead()
+    if args.smoke:
+        args.queries, args.threads = 36, 12
+    chaos = args.chaos if args.chaos is not None else not args.smoke
+
+    # Keep the thread budget sane under N concurrent executors: 2 compute
+    # threads per query (determinism contract: results are unaffected).
+    daft_tpu.set_execution_config(num_compute_threads=2)
+
+    set_tenant_policy("hostile", max_concurrent_queries=1, queue_depth=1,
+                      priority=-1, max_memory_fraction=0.25)
+    set_tenant_policy("batch", max_concurrent_queries=16, queue_depth=24)
+    set_tenant_policy("gold", max_concurrent_queries=8, queue_depth=16,
+                      priority=1)
+
+    from daft_tpu.subscribers.dashboard import DashboardServer
+
+    dash = DashboardServer(port=0).start()
+    daft_tpu.get_context().attach_subscriber(dash.subscriber())
+    print(f"dashboard: {dash.url}")
+
+    mixes = build_mixes()
+    print("warmup pass...")
+    warmup(mixes)
+    # Control: the SAME storm with the hostile slots idled — the
+    # well-behaved tenants' p99 without hostile interference.
+    print("control storm (hostile idled)...")
+    control = StormStats()
+    run_storm(mixes, args.queries, args.threads, control, seed=args.seed,
+              exclude=("hostile",))
+    base_p99 = {t: pctl(sorted(w), 0.99)
+                for t, w in control.walls.items()}
+    print("control p99:",
+          {t: f"{v * 1000:.0f}ms" for t, v in base_p99.items()})
+
+    stats = StormStats()
+    thread_baseline = threading.active_count()
+    peak = run_storm(mixes, args.queries, args.threads, stats,
+                     seed=args.seed)
+    if chaos:
+        print("chaos round: worker kills + transient IO bursts...")
+        chaos_round(stats, max(args.queries // 6, 12), seed=args.seed)
+
+    # Let the storm threads' pools wind down before the leak audit.
+    deadline = time.monotonic() + 10
+    ctl = get_controller()
+    while time.monotonic() < deadline:
+        t = ctl.totals()
+        if t["running"] == 0 and t["queued"] == 0:
+            break
+        time.sleep(0.05)
+
+    failures = []
+    # 1. Hostile capped at its quota.
+    print(f"peak concurrency: {peak}")
+    if peak.get("hostile", 0) > 1:
+        failures.append(f"hostile exceeded its quota: peak {peak['hostile']}")
+    # 2. Well-behaved p99 within 2x uncontended.
+    for tenant in ("batch", "gold"):
+        walls = sorted(stats.walls.get(tenant, []))
+        if not walls:
+            failures.append(f"{tenant}: no completions at all (starved)")
+            continue
+        p99 = pctl(walls, 0.99)
+        bound = 2 * base_p99[tenant]
+        print(f"{tenant}: {len(walls)} completed, p99 {p99 * 1000:.0f}ms "
+              f"(bound {bound * 1000:.0f}ms)")
+        if p99 > bound:
+            failures.append(
+                f"{tenant} p99 {p99:.3f}s > 2x uncontended {bound:.3f}s")
+    hostile_done = len(stats.walls.get("hostile", []))
+    hostile_rej = sum(1 for t, _, _ in stats.rejections if t == "hostile")
+    print(f"hostile: {hostile_done} completed, {hostile_rej} shed")
+    # 3. Rejections fast.
+    rej_lat = sorted(lat for _, lat, _ in stats.rejections)
+    if rej_lat:
+        p99r = pctl(rej_lat, 0.99)
+        print(f"rejections: {len(rej_lat)}, p99 latency {p99r * 1000:.1f}ms")
+        if p99r > 0.1:
+            failures.append(f"rejection p99 latency {p99r:.3f}s > 100ms")
+    # 4. Nothing hung, nothing unclassified.
+    if stats.unclassified:
+        failures.append(f"unclassified failures: {stats.unclassified[:3]}")
+    if stats.errors:
+        print(f"classified (acceptable) failures: {len(stats.errors)}")
+    # 5. Zero leaks: permits, slots, gauges.
+    totals = ctl.totals()
+    if totals["running"] or totals["queued"] or totals["mem_reserved"]:
+        failures.append(f"stuck admission state after storm: {totals}")
+    leak = permit_leak_audit()
+    if leak:
+        failures.append(leak)
+    gauges = scrape_queue_gauges(dash.url)
+    if any(v != 0 for v in gauges.values()):
+        failures.append(f"queue-depth gauges not at 0: {gauges}")
+    leaked_threads = threading.active_count() - thread_baseline
+    if leaked_threads > 4:  # daemon monitor + dashboard handler slack
+        failures.append(f"{leaked_threads} threads leaked by the storm")
+    p50, p99w, n = scrape_admission_wait(dash.url)
+    print(f"admission wait (scraped, n={n}): p50 <= {p50 * 1000:.0f}ms, "
+          f"p99 <= {p99w if p99w == float('inf') else p99w * 1000:.0f}"
+          f"{'' if p99w == float('inf') else 'ms'}")
+    dash.shutdown()
+
+    if failures:
+        print("\nFAILURES:")
+        for f in failures:
+            print(f"  - {f}")
+        return 1
+    print("\nall admission SLOs held")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

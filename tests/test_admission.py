@@ -1,0 +1,840 @@
+"""Admission control: quotas, bounded queues, shed ladder, and the
+exception-safety contract (slots/permits can never leak).
+
+Unit tests drive the controller directly; integration tests go through
+``df.collect()`` on the native runner (the distributed runner shares the
+same front-door call); ``-m chaos`` cases cover cancellation/deadline of
+QUEUED queries, the ``admission.enqueue`` fault point, and the
+permit-leak regression (poison mid-acquire)."""
+
+import threading
+import time
+
+import pytest
+
+import daft_tpu
+from daft_tpu import col
+from daft_tpu.cancellation import CancelToken, Deadline
+from daft_tpu.errors import (
+    DaftAdmissionError,
+    DaftCancelledError,
+    DaftTimeoutError,
+)
+from daft_tpu.execution.admission import (
+    AdmissionController,
+    DEFAULT_TENANT,
+    TenantPolicy,
+    get_controller,
+    resolve_tenant,
+    set_tenant,
+)
+from daft_tpu.config import ExecutionConfig
+
+
+@pytest.fixture(autouse=True)
+def _clean_admission():
+    """Every test starts with a fresh process controller + tenant identity
+    and leaves none behind (the controller is process-global, like the
+    MemoryManager it fronts)."""
+    get_controller().reset()
+    set_tenant(None)
+    yield
+    get_controller().reset()
+    set_tenant(None)
+
+
+def _cfg(**kw):
+    return ExecutionConfig().with_changes(**kw)
+
+
+def _token(timeout_s=None, query_id="q"):
+    return CancelToken(
+        Deadline.after(timeout_s) if timeout_s is not None else None,
+        query_id=query_id)
+
+
+# --------------------------------------------------------------------- #
+# Controller unit tests                                                  #
+# --------------------------------------------------------------------- #
+
+def test_disabled_is_passthrough():
+    ctl = AdmissionController()
+    t = ctl.admit("q1", cfg=_cfg(admission_enabled=False))
+    assert not t.released()
+    t.release()
+    assert t.released()
+    assert ctl.snapshot() == {}  # no tenant state was created
+
+
+def test_unlimited_default_fast_path():
+    ctl = AdmissionController()
+    tickets = [ctl.admit(f"q{i}", cfg=_cfg()) for i in range(16)]
+    snap = ctl.snapshot()[DEFAULT_TENANT]
+    assert snap["running"] == 16 and snap["queued"] == 0
+    for t in tickets:
+        t.release()
+    assert ctl.snapshot()[DEFAULT_TENANT]["running"] == 0
+
+
+def test_release_is_idempotent():
+    ctl = AdmissionController()
+    t = ctl.admit("q1", cfg=_cfg())
+    t.release()
+    t.release()
+    assert ctl.snapshot()[DEFAULT_TENANT]["running"] == 0
+
+
+def test_quota_queues_then_admits_fifo():
+    ctl = AdmissionController()
+    ctl.set_policy(TenantPolicy(tenant="t", max_concurrent_queries=1,
+                                queue_depth=8))
+    cfg = _cfg()
+    first = ctl.admit("q0", tenant="t", cfg=cfg)
+    order = []
+    lock = threading.Lock()
+
+    def waiter(qid):
+        ticket = ctl.admit(qid, tenant="t", cfg=cfg)
+        with lock:
+            order.append(qid)
+        time.sleep(0.05)
+        ticket.release()
+
+    threads = []
+    for i in range(1, 4):
+        th = threading.Thread(target=waiter, args=(f"q{i}",))
+        th.start()
+        threads.append(th)
+        # Stagger starts so queue order is deterministic FIFO.
+        deadline = time.monotonic() + 5
+        while ctl.snapshot()["t"]["queued"] < i and time.monotonic() < deadline:
+            time.sleep(0.005)
+    assert ctl.snapshot()["t"]["queued"] == 3
+    first.release()
+    for th in threads:
+        th.join(timeout=10)
+    assert order == ["q1", "q2", "q3"]
+    snap = ctl.snapshot()["t"]
+    assert snap["running"] == 0 and snap["queued"] == 0
+
+
+def test_queue_full_fast_rejection_with_details():
+    ctl = AdmissionController()
+    ctl.set_policy(TenantPolicy(tenant="t", max_concurrent_queries=1,
+                                queue_depth=1))
+    cfg = _cfg()
+    held = ctl.admit("q0", tenant="t", cfg=cfg)
+    blocked = threading.Thread(
+        target=lambda: ctl.admit("q1", tenant="t", cfg=cfg).release())
+    blocked.start()
+    deadline = time.monotonic() + 5
+    while ctl.snapshot()["t"]["queued"] < 1 and time.monotonic() < deadline:
+        time.sleep(0.005)
+    with pytest.raises(DaftAdmissionError) as ei:
+        ctl.admit("q2", tenant="t", cfg=cfg)
+    err = ei.value
+    assert err.tenant == "t"
+    assert err.reason == "queue-full"
+    assert err.queue_depth == 1
+    assert err.retry_after_s > 0
+    # DaftAdmissionError IS transient: clients classify-and-retry.
+    from daft_tpu.errors import DaftTransientError
+
+    assert isinstance(err, DaftTransientError)
+    held.release()
+    blocked.join(timeout=10)
+
+
+def test_rejection_latency_is_fast():
+    """Overload rejections must be lock-and-raise, never queue waits: p99
+    over 100 rejections far under the 100ms acceptance bound."""
+    ctl = AdmissionController()
+    ctl.set_policy(TenantPolicy(tenant="t", max_concurrent_queries=1,
+                                queue_depth=1))
+    cfg = _cfg()
+    held = ctl.admit("q0", tenant="t", cfg=cfg)
+    blocked = threading.Thread(
+        target=lambda: ctl.admit("qq", tenant="t", cfg=cfg).release())
+    blocked.start()
+    deadline = time.monotonic() + 5
+    while ctl.snapshot()["t"]["queued"] < 1 and time.monotonic() < deadline:
+        time.sleep(0.005)
+    lat = []
+    for i in range(100):
+        t0 = time.monotonic()
+        with pytest.raises(DaftAdmissionError):
+            ctl.admit(f"r{i}", tenant="t", cfg=cfg)
+        lat.append(time.monotonic() - t0)
+    lat.sort()
+    assert lat[98] < 0.1, f"p99 rejection latency {lat[98]:.4f}s"
+    held.release()
+    blocked.join(timeout=10)
+
+
+def test_deadline_smaller_than_estimated_wait_rejected_immediately():
+    ctl = AdmissionController()
+    ctl.set_policy(TenantPolicy(tenant="t", max_concurrent_queries=1,
+                                queue_depth=8))
+    cfg = _cfg()
+    ctl._avg_query_s = 10.0  # queue wait estimate >> the query's budget
+    held = ctl.admit("q0", tenant="t", cfg=cfg)
+    t0 = time.monotonic()
+    with pytest.raises(DaftAdmissionError) as ei:
+        ctl.admit("q1", tenant="t", token=_token(0.5), cfg=cfg)
+    assert time.monotonic() - t0 < 0.1  # never enqueued to time out later
+    assert ei.value.reason == "deadline-too-short"
+    assert ei.value.retry_after_s >= 10.0
+    assert ctl.snapshot()["t"]["queued"] == 0
+    held.release()
+
+
+def test_memory_fraction_reservation_gate():
+    """With DAFT_MEMORY_LIMIT set, a tenant's running queries reserve one
+    sink working-set share each; past its fraction, new ones queue even
+    with concurrency slots free."""
+    from daft_tpu.execution.resource_manager import memory_limit
+
+    ctl = AdmissionController()
+    ctl.set_policy(TenantPolicy(tenant="t", max_concurrent_queries=0,
+                                max_memory_fraction=0.5, queue_depth=8))
+    cfg = _cfg()
+    with memory_limit(1 << 20):
+        # share = limit/4 = 256k; quota = 0.5 * 1M = 512k -> 2 fit.
+        t1 = ctl.admit("q1", tenant="t", cfg=cfg)
+        t2 = ctl.admit("q2", tenant="t", cfg=cfg)
+        assert ctl.snapshot()["t"]["mem_reserved"] == 2 * (1 << 18)
+        admitted = threading.Event()
+
+        def third():
+            tk = ctl.admit("q3", tenant="t", cfg=cfg)
+            admitted.set()
+            tk.release()
+
+        th = threading.Thread(target=third)
+        th.start()
+        deadline = time.monotonic() + 5
+        while ctl.snapshot()["t"]["queued"] < 1 \
+                and time.monotonic() < deadline:
+            time.sleep(0.005)
+        assert ctl.snapshot()["t"]["queued"] == 1
+        assert not admitted.is_set()
+        t1.release()  # reservation freed -> q3 admitted
+        assert admitted.wait(5)
+        th.join(timeout=10)
+        t2.release()
+    assert ctl.snapshot()["t"]["mem_reserved"] == 0
+
+
+def test_unsatisfiable_memory_quota_rejects_fast():
+    """A tenant whose whole memory quota is smaller than the per-query
+    reservation share must be rejected immediately — enqueueing could
+    never succeed (regression: used to queue forever)."""
+    from daft_tpu.execution.resource_manager import memory_limit
+
+    ctl = AdmissionController()
+    # share = limit/4 = 256k; quota = 0.1 * 1M ~= 104k < share.
+    ctl.set_policy(TenantPolicy(tenant="tiny", max_memory_fraction=0.1))
+    with memory_limit(1 << 20):
+        t0 = time.monotonic()
+        with pytest.raises(DaftAdmissionError, match="whole quota"):
+            ctl.admit("q1", tenant="tiny", cfg=_cfg())
+        assert time.monotonic() - t0 < 0.1
+    assert ctl.snapshot()["tiny"]["queued"] == 0
+
+
+def test_already_cancelled_token_raises_cancelled_not_admission():
+    """A query cancelled before admit() must fail with DaftCancelledError,
+    never a transient DaftAdmissionError a client would retry."""
+    ctl = AdmissionController()
+    ctl.set_policy(TenantPolicy(tenant="t", max_concurrent_queries=1))
+    held = ctl.admit("q0", tenant="t", cfg=_cfg())
+    tok = _token(query_id="dead")
+    tok.cancel("user-cancel")
+    with pytest.raises(DaftCancelledError):
+        ctl.admit("dead", tenant="t", token=tok, cfg=_cfg())
+    held.release()
+
+
+def test_policy_json_change_is_picked_up():
+    """Policies re-parse when the admission_policies STRING changes — the
+    cache must not key on object identity (id() reuse serves stale
+    quotas)."""
+    ctl = AdmissionController()
+    ctl.admit("q1", tenant="t",
+              cfg=_cfg(admission_policies='{"t": {"priority": -1}}')
+              ).release()
+    assert ctl.snapshot()["t"]["priority"] == -1
+    ctl.admit("q2", tenant="t",
+              cfg=_cfg(admission_policies='{"t": {"priority": 3}}')
+              ).release()
+    assert ctl.snapshot()["t"]["priority"] == 3
+
+
+def test_policies_from_config_json():
+    ctl = AdmissionController()
+    cfg = _cfg(admission_policies=(
+        '{"hostile": {"max_concurrent_queries": 2, "priority": -1},'
+        ' "gold": {"priority": 5}}'))
+    ctl.admit("q1", tenant="hostile", cfg=cfg).release()
+    snap = ctl.snapshot()["hostile"]
+    assert snap["max_concurrent"] == 2 and snap["priority"] == -1
+    ctl.admit("q2", tenant="gold", cfg=cfg).release()
+    assert ctl.snapshot()["gold"]["priority"] == 5
+
+
+def test_policies_bad_json_raises():
+    from daft_tpu.errors import DaftValueError
+
+    ctl = AdmissionController()
+    with pytest.raises(DaftValueError):
+        ctl.admit("q1", cfg=_cfg(admission_policies="{nope"))
+    with pytest.raises(DaftValueError):
+        ctl.admit("q1", cfg=_cfg(
+            admission_policies='{"t": {"max_queries": 1}}'))  # unknown key
+
+
+def test_tenant_resolution_precedence(monkeypatch):
+    assert resolve_tenant("explicit") == "explicit"
+    set_tenant("ctxvar")
+    assert resolve_tenant(None) == "ctxvar"
+    set_tenant(None)
+    import daft_tpu.config as config_mod
+
+    monkeypatch.setattr(
+        config_mod, "daft_env",
+        lambda name, default=None: "envtenant" if name == "DAFT_TENANT"
+        else default)
+    assert resolve_tenant(None) == "envtenant"
+    set_tenant("ctxvar2")  # contextvar wins over env
+    assert resolve_tenant(None) == "ctxvar2"
+
+
+# --------------------------------------------------------------------- #
+# Shed ladder                                                            #
+# --------------------------------------------------------------------- #
+
+def _force_level(ctl, level):
+    """White-box: pin the ladder at ``level`` (a recent escalation, so the
+    refresh's cooldown keeps it from decaying during the test)."""
+    with ctl._cond:
+        ctl._shed_level = level
+        ctl._shed_changed_at = time.monotonic() + 3600
+        ctl._hist_read_at = time.monotonic() + 3600  # freeze the signal
+
+
+def test_queue_pressure_escalates_shed_level():
+    ctl = AdmissionController()
+    ctl.set_policy(TenantPolicy(tenant="t", max_concurrent_queries=1,
+                                queue_depth=4))
+    cfg = _cfg(admission_overload_queue_fraction=0.5)
+    held = ctl.admit("q0", tenant="t", cfg=cfg)
+    threads = []
+    for i in range(4):
+        th = threading.Thread(
+            target=lambda i=i: ctl.admit(f"q{i + 1}", tenant="t",
+                                         cfg=cfg).release(),
+            daemon=True)
+        th.start()
+        threads.append(th)
+        deadline = time.monotonic() + 5
+        while ctl.snapshot()["t"]["queued"] < i + 1 \
+                and time.monotonic() < deadline:
+            time.sleep(0.005)
+    # queue 4/4 full, watermark 0.5 -> pressure 2.0 -> level 3.
+    with ctl._cond:
+        ctl._hist_read_at = 0.0  # bypass the refresh rate limit
+        ctl._refresh_signals_locked(cfg)
+    assert ctl.shed_level() == 3
+    held.release()
+    for th in threads:
+        th.join(timeout=10)
+
+
+def test_shed_level1_rejects_negative_priority_and_over_quota():
+    ctl = AdmissionController()
+    ctl.set_policy(TenantPolicy(tenant="low", priority=-1))
+    ctl.set_policy(TenantPolicy(tenant="busy", max_concurrent_queries=1))
+    cfg = _cfg()
+    _force_level(ctl, 1)
+    with pytest.raises(DaftAdmissionError) as ei:
+        ctl.admit("q1", tenant="low", cfg=cfg)
+    assert ei.value.reason == "shed-low-priority"
+    held = ctl.admit("q2", tenant="busy", cfg=cfg)
+    with pytest.raises(DaftAdmissionError) as ei:  # would queue -> shed
+        ctl.admit("q3", tenant="busy", cfg=cfg)
+    assert ei.value.reason == "shed-over-quota"
+    # Default tenant with free slots still sails through at level 1.
+    ctl.admit("q4", cfg=cfg).release()
+    held.release()
+
+
+def test_shed_level2_caps_compute_threads():
+    ctl = AdmissionController()
+    cfg = _cfg(num_compute_threads=8)
+    _force_level(ctl, 2)
+    t = ctl.admit("q1", cfg=cfg)
+    assert t.compute_threads_cap == 4
+    t.release()
+    _force_level(ctl, 0)
+    t2 = ctl.admit("q2", cfg=cfg)
+    assert t2.compute_threads_cap is None
+    t2.release()
+
+
+def test_shed_level3_rejects_default_admits_positive_priority():
+    ctl = AdmissionController()
+    ctl.set_policy(TenantPolicy(tenant="gold", priority=1))
+    cfg = _cfg()
+    _force_level(ctl, 3)
+    with pytest.raises(DaftAdmissionError) as ei:
+        ctl.admit("q1", cfg=cfg)
+    assert ei.value.reason == "overload"
+    t = ctl.admit("q2", tenant="gold", cfg=cfg)  # positive priority rides out
+    assert t.compute_threads_cap is not None     # but still capped (level>=2)
+    t.release()
+
+
+def test_shed_level_decays_one_step_per_cooldown():
+    ctl = AdmissionController()
+    cfg = _cfg(admission_shed_cooldown_s=0.05)
+    with ctl._cond:
+        ctl._shed_level = 2
+        ctl._shed_changed_at = time.monotonic() - 1.0
+        ctl._hist_read_at = 0.0
+        ctl._refresh_signals_locked(cfg)
+        assert ctl._shed_level == 1  # one step, not straight to 0
+        ctl._shed_changed_at = time.monotonic() - 1.0
+        ctl._hist_read_at = 0.0
+        ctl._refresh_signals_locked(cfg)
+        assert ctl._shed_level == 0
+
+
+def test_permit_wait_p95_watermark_escalates():
+    from daft_tpu import metrics
+
+    if not metrics.metrics_enabled():
+        pytest.skip("metrics disabled")
+    ctl = AdmissionController()
+    cfg = _cfg(admission_permit_wait_p95_s=0.5)
+    with ctl._cond:
+        ctl._hist_read_at = 0.0
+        ctl._refresh_signals_locked(cfg)  # establish the histogram base
+    for _ in range(32):
+        metrics.PERMIT_WAIT.observe(2.0)  # permit waits way past watermark
+    with ctl._cond:
+        ctl._hist_read_at = 0.0
+        ctl._refresh_signals_locked(cfg)
+    assert ctl.shed_level() >= 1
+
+
+# --------------------------------------------------------------------- #
+# Metrics + events                                                       #
+# --------------------------------------------------------------------- #
+
+class _Capture:
+    def __init__(self):
+        self.events = []
+
+    def on_event(self, e):
+        self.events.append(e)
+
+
+def test_metrics_and_events_roundtrip():
+    from daft_tpu import metrics
+    from daft_tpu.subscribers.events import (
+        QueryAdmitted,
+        QueryQueued,
+        QueryShed,
+    )
+
+    if not metrics.metrics_enabled():
+        pytest.skip("metrics disabled")
+    reg = metrics.get_registry()
+    base = reg.snapshot()
+    cap = _Capture()
+    ctx = daft_tpu.get_context()
+    ctx.attach_subscriber(cap)
+    try:
+        ctl = AdmissionController()
+        ctl.set_policy(TenantPolicy(tenant="t", max_concurrent_queries=1,
+                                    queue_depth=1))
+        cfg = _cfg()
+        held = ctl.admit("q0", tenant="t", cfg=cfg)
+        done = threading.Event()
+
+        def queued():
+            ctl.admit("q1", tenant="t", cfg=cfg).release()
+            done.set()
+
+        th = threading.Thread(target=queued)
+        th.start()
+        deadline = time.monotonic() + 5
+        while ctl.snapshot()["t"]["queued"] < 1 \
+                and time.monotonic() < deadline:
+            time.sleep(0.005)
+        with pytest.raises(DaftAdmissionError):
+            ctl.admit("q2", tenant="t", cfg=cfg)
+        held.release()
+        assert done.wait(5)
+        th.join(timeout=10)
+        snap = reg.snapshot()
+        admitted = snap.label_totals("daft_admission_admitted_total",
+                                     "tenant")
+        assert admitted.get("t", 0) \
+            - base.label_totals("daft_admission_admitted_total",
+                                "tenant").get("t", 0) == 2
+        rejected = snap.label_totals("daft_admission_rejected_total",
+                                     "tenant")
+        assert rejected.get("t", 0) >= 1
+        assert snap.value("daft_admission_queue_depth", tenant="t") == 0
+        kinds = [type(e).__name__ for e in cap.events]
+        assert "QueryQueued" in kinds
+        assert "QueryShed" in kinds
+        assert kinds.count("QueryAdmitted") >= 2
+        q = next(e for e in cap.events if isinstance(e, QueryQueued))
+        assert q.tenant == "t" and q.queue_depth == 1
+        shed = next(e for e in cap.events if isinstance(e, QueryShed))
+        assert shed.reason == "queue-full" and shed.retry_after_s > 0
+        waited = next(e for e in cap.events if isinstance(e, QueryAdmitted)
+                      and e.query_id == "q1")
+        assert waited.wait_s > 0
+    finally:
+        ctx.detach_subscriber(cap)
+
+
+def test_prometheus_exposition_includes_admission_series():
+    from daft_tpu import metrics
+
+    if not metrics.metrics_enabled():
+        pytest.skip("metrics disabled")
+    ctl = AdmissionController()
+    ctl.admit("q1", tenant="scrape-t", cfg=_cfg()).release()
+    text = metrics.get_registry().to_prometheus()
+    assert 'daft_admission_admitted_total{tenant="scrape-t"}' in text
+    assert "daft_admission_wait_seconds_bucket" in text
+    assert "daft_admission_shed_level" in text
+
+
+# --------------------------------------------------------------------- #
+# Runner integration (native; the distributed runner shares the call)    #
+# --------------------------------------------------------------------- #
+
+def test_collect_passes_front_door_and_releases():
+    daft_tpu.set_tenant("itest")
+    df = daft_tpu.from_pydict({"a": [1, 2, 3]}).with_column(
+        "b", col("a") * 2).collect()
+    assert df.to_pydict()["b"] == [2, 4, 6]
+    ctl = get_controller()
+    snap = ctl.snapshot().get("itest")
+    assert snap is not None and snap["running"] == 0 and snap["queued"] == 0
+
+
+def test_failed_query_releases_slot():
+    @daft_tpu.udf.func.batch(return_dtype=daft_tpu.DataType.int64())
+    def boom(x):
+        raise RuntimeError("kaboom")
+
+    daft_tpu.set_tenant("failer")
+    with pytest.raises(Exception, match="kaboom"):
+        daft_tpu.from_pydict({"a": [1, 2, 3]}).with_column(
+            "b", boom(col("a"))).collect()
+    snap = get_controller().snapshot()["failer"]
+    assert snap["running"] == 0 and snap["queued"] == 0
+
+
+def test_quota_serializes_collects_across_threads():
+    daft_tpu.set_tenant(None)
+    from daft_tpu.execution.admission import set_tenant_policy
+
+    set_tenant_policy("serial", max_concurrent_queries=1, queue_depth=8)
+    peak = [0]
+    active = [0]
+    lock = threading.Lock()
+
+    @daft_tpu.udf.func.batch(return_dtype=daft_tpu.DataType.int64())
+    def tracked(x):
+        with lock:
+            active[0] += 1
+            peak[0] = max(peak[0], active[0])
+        time.sleep(0.05)
+        with lock:
+            active[0] -= 1
+        return x
+
+    def run():
+        daft_tpu.set_tenant("serial")
+        daft_tpu.from_pydict({"a": [1, 2, 3]}).with_column(
+            "b", tracked(col("a"))).collect()
+
+    threads = [threading.Thread(target=run) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    assert peak[0] == 1, f"quota 1 but {peak[0]} queries ran concurrently"
+    snap = get_controller().snapshot()["serial"]
+    assert snap["running"] == 0 and snap["queued"] == 0
+
+
+def test_nested_query_bypasses_parent_tenant_quota():
+    """A query issued from inside another query's execution scope (ambient
+    cancel token of a different query id) rides the parent's slot —
+    queueing it against the quota the parent already holds would deadlock
+    the pair."""
+    from daft_tpu.cancellation import cancel_scope
+
+    ctl = AdmissionController()
+    ctl.set_policy(TenantPolicy(tenant="t", max_concurrent_queries=1,
+                                queue_depth=1))
+    cfg = _cfg()
+    outer = ctl.admit("outer", tenant="t", cfg=cfg)
+    with cancel_scope(_token(query_id="outer")):
+        inner = ctl.admit("inner", tenant="t", cfg=cfg)  # no deadlock
+        assert not inner.released()
+        inner.release()
+    assert ctl.snapshot()["t"]["running"] == 1  # only the outer held a slot
+    outer.release()
+
+
+def test_admission_disabled_creates_no_state():
+    from daft_tpu.context import execution_config_ctx
+
+    with execution_config_ctx(admission_enabled=False):
+        daft_tpu.set_tenant("ghost")
+        daft_tpu.from_pydict({"a": [1]}).collect()
+    assert "ghost" not in get_controller().snapshot()
+
+
+# --------------------------------------------------------------------- #
+# Chaos: cancellation/deadline of QUEUED queries, fault point, permits   #
+# --------------------------------------------------------------------- #
+
+@pytest.mark.chaos
+def test_cancel_query_dequeues_waiting_query():
+    """daft_tpu.cancel_query() on a query still in the admission queue must
+    dequeue it — never admit it — and raise DaftCancelledError with
+    {queued: true} progress."""
+    ctl = get_controller()
+    ctl.set_policy(TenantPolicy(tenant="t", max_concurrent_queries=1,
+                                queue_depth=8))
+    cfg = _cfg()
+    held = ctl.admit("q0", tenant="t", cfg=cfg)
+    from daft_tpu.cancellation import (
+        register_query_token,
+        unregister_query_token,
+    )
+
+    token = _token(query_id="queued-q")
+    register_query_token("queued-q", token)
+    result = {}
+
+    def waiter():
+        try:
+            t = ctl.admit("queued-q", tenant="t", token=token, cfg=cfg)
+            t.release()
+            result["out"] = "admitted"
+        except BaseException as e:  # noqa: BLE001 — recorded for asserts
+            result["out"] = e
+
+    th = threading.Thread(target=waiter)
+    th.start()
+    deadline = time.monotonic() + 5
+    while ctl.snapshot()["t"]["queued"] < 1 and time.monotonic() < deadline:
+        time.sleep(0.005)
+    assert daft_tpu.cancel_query("queued-q")
+    th.join(timeout=10)
+    unregister_query_token("queued-q")
+    err = result["out"]
+    assert isinstance(err, DaftCancelledError) \
+        and not isinstance(err, DaftTimeoutError)
+    assert err.progress.get("queued") is True
+    snap = ctl.snapshot()["t"]
+    assert snap["queued"] == 0 and snap["running"] == 1
+    held.release()
+    from daft_tpu import metrics
+
+    if metrics.metrics_enabled():
+        assert metrics.get_registry().snapshot().value(
+            "daft_admission_queue_depth", tenant="t") == 0
+
+
+@pytest.mark.chaos
+def test_deadline_expiry_dequeues_waiting_query():
+    ctl = get_controller()
+    ctl.set_policy(TenantPolicy(tenant="t", max_concurrent_queries=1,
+                                queue_depth=8))
+    cfg = _cfg()
+    ctl._avg_query_s = 0.01  # estimate small so the query IS enqueued
+    held = ctl.admit("q0", tenant="t", cfg=cfg)
+    t0 = time.monotonic()
+    with pytest.raises(DaftTimeoutError) as ei:
+        ctl.admit("q1", tenant="t", token=_token(0.3), cfg=cfg)
+    assert 0.2 < time.monotonic() - t0 < 5.0
+    assert ei.value.progress.get("queued") is True
+    snap = ctl.snapshot()["t"]
+    assert snap["queued"] == 0
+    held.release()
+
+
+@pytest.mark.chaos
+def test_enqueue_fault_point_leaks_no_slot():
+    """An injected failure at admission.enqueue (chaos exercising the queue
+    itself) must dequeue the waiter: queue depth back to 0, later queries
+    unaffected."""
+    from daft_tpu.distributed.faults import FaultInjected, fault_scope
+
+    ctl = get_controller()
+    ctl.set_policy(TenantPolicy(tenant="t", max_concurrent_queries=1,
+                                queue_depth=8))
+    cfg = _cfg()
+    held = ctl.admit("q0", tenant="t", cfg=cfg)
+    with fault_scope("admission.enqueue:raise:1"):
+        with pytest.raises(FaultInjected):
+            ctl.admit("q1", tenant="t", cfg=cfg)
+    snap = ctl.snapshot()["t"]
+    assert snap["queued"] == 0 and snap["running"] == 1
+    held.release()
+    # The queue still works after the injected failure.
+    ctl.admit("q2", tenant="t", cfg=cfg).release()
+    from daft_tpu import metrics
+
+    if metrics.metrics_enabled():
+        assert metrics.get_registry().snapshot().value(
+            "daft_admission_queue_depth", tenant="t") == 0
+
+
+@pytest.mark.chaos
+def test_collect_timeout_while_queued_has_queued_progress():
+    """End-to-end: a collect(timeout=) that expires while the query is
+    still waiting in the admission queue fails with {queued: true} and
+    leaves no state behind."""
+    from daft_tpu.execution.admission import set_tenant_policy
+
+    set_tenant_policy("e2e", max_concurrent_queries=1, queue_depth=8)
+    ctl = get_controller()
+    ctl._avg_query_s = 0.01  # keep the wait estimator from fast-rejecting
+    release_holder = threading.Event()
+
+    @daft_tpu.udf.func.batch(return_dtype=daft_tpu.DataType.int64())
+    def hold(x):
+        release_holder.wait(20)
+        return x
+
+    holder_done = {}
+
+    def holder():
+        daft_tpu.set_tenant("e2e")
+        try:
+            daft_tpu.from_pydict({"a": [1]}).with_column(
+                "b", hold(col("a"))).collect()
+            holder_done["out"] = "ok"
+        except BaseException as e:  # noqa: BLE001 — recorded for asserts
+            holder_done["out"] = e
+
+    th = threading.Thread(target=holder)
+    th.start()
+    deadline = time.monotonic() + 10
+    while ctl.snapshot().get("e2e", {}).get("running", 0) < 1 \
+            and time.monotonic() < deadline:
+        time.sleep(0.01)
+    try:
+        daft_tpu.set_tenant("e2e")
+        with pytest.raises(DaftTimeoutError) as ei:
+            daft_tpu.from_pydict({"a": [2]}).collect(timeout=0.5)
+        assert ei.value.progress.get("queued") is True
+    finally:
+        daft_tpu.set_tenant(None)
+        release_holder.set()
+        th.join(timeout=30)
+    assert holder_done["out"] == "ok"
+    snap = ctl.snapshot()["e2e"]
+    assert snap["running"] == 0 and snap["queued"] == 0
+
+
+@pytest.mark.chaos
+def test_permit_leak_poison_mid_acquire_returns_to_baseline():
+    """Regression for the permit-leak window: a waiter poisoned mid-acquire
+    (the executor's abort path) must leave available_permits at baseline
+    once the query unwinds."""
+    from daft_tpu.execution.resource_manager import memory_limit
+
+    with memory_limit(1 << 16) as mm:
+        baseline = mm.available_permits()
+        assert mm.acquire(1 << 15)  # holder: half the budget
+        token = _token(query_id="poisoned")
+        result = {}
+
+        def blocked():
+            # Requests more than remains -> blocks until poisoned.
+            try:
+                result["ok"] = mm.acquire(3 << 14, token=token)
+            except BaseException as e:  # noqa: BLE001 — recorded for asserts
+                result["err"] = e
+
+        th = threading.Thread(target=blocked)
+        th.start()
+        time.sleep(0.1)  # let it enter the wait
+        mm.poison(RuntimeError("query died"), query_id="poisoned")
+        th.join(timeout=10)
+        assert isinstance(result.get("err"), RuntimeError)
+        mm.release(1 << 15)
+        assert mm.available_permits() == baseline
+
+
+@pytest.mark.chaos
+def test_late_acquire_after_executor_unwind_self_releases():
+    """The cancel-between-acquire-and-first-morsel window: an acquire that
+    lands AFTER the executor's cleanup drained its held permits must hand
+    the permit straight back (executor._add_held on a closed executor)."""
+    from daft_tpu.execution.executor import Executor
+    from daft_tpu.execution.resource_manager import memory_limit
+
+    with memory_limit(1 << 16) as mm:
+        baseline = mm.available_permits()
+        cfg = daft_tpu.get_context().execution_config
+        ex = Executor(cfg)
+        from daft_tpu.physical.translate import translate
+
+        builder = daft_tpu.from_pydict({"a": [1, 2, 3]})._builder
+        physical = translate(builder.optimize(cfg).plan, cfg)
+        list(ex.run(physical))  # completes; executor permits are closed
+        # Simulate the racing side thread: its acquire succeeded just as
+        # the query unwound, its _add_held lands after the drain.
+        assert mm.acquire(1 << 10)
+        ex._add_held(1 << 10)
+        assert mm.available_permits() == baseline, \
+            "late _add_held after executor close leaked a permit"
+
+
+@pytest.mark.chaos
+def test_cancelled_collect_leaves_no_admission_or_permit_state():
+    """A query cancelled mid-execution under a memory limit unwinds with
+    zero leaked permits and a freed admission slot."""
+    from daft_tpu.execution.resource_manager import memory_limit
+
+    with memory_limit(1 << 20) as mm:
+        baseline = mm.available_permits()
+        daft_tpu.set_tenant("cancel-t")
+        started = threading.Event()
+
+        @daft_tpu.udf.func.batch(return_dtype=daft_tpu.DataType.int64())
+        def slow(x):
+            started.set()
+            time.sleep(0.1)
+            return x
+
+        df = daft_tpu.from_pydict({"a": list(range(2000))})
+        with pytest.raises((DaftTimeoutError, DaftCancelledError)):
+            df.with_column("b", slow(col("a"))).sort("a").collect(
+                timeout=0.3)
+        # After unwind: slot freed, permits at baseline (poll briefly —
+        # pool threads observe the token at the next morsel boundary).
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            snap = get_controller().snapshot().get("cancel-t", {})
+            if snap.get("running", 1) == 0 \
+                    and mm.available_permits() == baseline:
+                break
+            time.sleep(0.05)
+        assert get_controller().snapshot()["cancel-t"]["running"] == 0
+        assert mm.available_permits() == baseline

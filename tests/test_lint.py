@@ -313,6 +313,72 @@ def test_dtl009_span_outside_context_manager():
     assert findings_for(exit_stack, ANY_PATH, "DTL009") == []
 
 
+def test_dtl010_unbounded_queue_positive_and_negative():
+    pos_queue = """
+    import queue
+    def make():
+        return queue.Queue()
+    """
+    pos_zero = """
+    import queue
+    def make():
+        return queue.Queue(maxsize=0)
+    """
+    pos_deque = """
+    from collections import deque
+    def make():
+        return deque()
+    """
+    pos_simple = """
+    import queue
+    def make():
+        return queue.SimpleQueue()
+    """
+    neg_bounded = """
+    import queue
+    from collections import deque
+    def make(workers):
+        a = queue.Queue(maxsize=max(workers * 2, 2))
+        b = queue.Queue(4)
+        c = deque(maxlen=16)
+        d = deque([1, 2], 8)
+        return a, b, c, d
+    """
+    exec_path = "daft_tpu/execution/snippet.py"
+    assert len(findings_for(pos_queue, exec_path, "DTL010")) == 1
+    assert len(findings_for(pos_zero, exec_path, "DTL010")) == 1
+    assert len(findings_for(pos_deque, exec_path, "DTL010")) == 1
+    assert len(findings_for(pos_simple, exec_path, "DTL010")) == 1
+    assert findings_for(neg_bounded, exec_path, "DTL010") == []
+
+
+def test_dtl010_scoped_to_engine_paths():
+    code = """
+    import queue
+    def make():
+        return queue.Queue()
+    """
+    # Fires in execution/distributed/runners; quiet elsewhere (a CLI
+    # script's unbounded queue is not an engine overload hazard).
+    assert len(findings_for(code, "daft_tpu/distributed/snippet.py",
+                            "DTL010")) == 1
+    assert len(findings_for(code, "daft_tpu/runners/snippet.py",
+                            "DTL010")) == 1
+    assert findings_for(code, "daft_tpu/io/snippet.py", "DTL010") == []
+    assert findings_for(code, ANY_PATH, "DTL010") == []
+
+
+def test_dtl010_resolves_import_aliases():
+    aliased = """
+    import queue as q
+    import collections as c
+    def make():
+        return q.Queue(), c.deque()
+    """
+    assert len(findings_for(aliased, "daft_tpu/execution/snippet.py",
+                            "DTL010")) == 2
+
+
 def test_syntax_error_becomes_dtl000_finding():
     findings, _ = lint_source("def broken(:\n", ANY_PATH)
     assert [f.rule for f in findings] == ["DTL000"]
@@ -456,8 +522,8 @@ def test_text_reporter_mentions_location_and_counts():
 def test_rule_registry_complete():
     assert sorted(rules_by_id()) == [
         "DTL001", "DTL002", "DTL003", "DTL004", "DTL005", "DTL006", "DTL007",
-        "DTL008", "DTL009"]
-    assert len(default_rules()) == 9
+        "DTL008", "DTL009", "DTL010"]
+    assert len(default_rules()) == 10
 
 
 def test_package_sweep_has_zero_new_violations():
