@@ -103,6 +103,19 @@ class ExecutionConfig:
     device_eval: bool = True
     device_eval_min_rows: int = 1024
     device_batch_buckets: Tuple[int, ...] = (1024, 4096, 16384, 65536, 131072)
+    # Whole-chain compiled evaluation (ops/compiled_eval.py): filter →
+    # project → agg chains trace into ONE jitted XLA program per
+    # micropartition, cache-keyed on schema + canonicalized plan
+    # fingerprint. DAFT_COMPILED_EVAL=0 disables; the module also carries a
+    # process-level self-disable flipped by the fused-vs-interpreted ABBA
+    # guard (perf_observatory.py --ab-fusion) when the compiled path loses.
+    compiled_eval_enabled: bool = True
+    # Stage fusion (execution/executor.py): adjacent Project/Filter
+    # pipeline stages collapse into ONE composed morsel stage so a chain
+    # costs one queue hop instead of N. Pure plan+config decision — never
+    # thread-count — so the determinism contract holds. DAFT_STAGE_FUSION=0
+    # disables.
+    stage_fusion_enabled: bool = True
     tpu_chips_per_host: int = 0  # 0 = autodetect
     # Distributed
     num_workers: int = 0  # 0 = autodetect / local
@@ -179,6 +192,10 @@ class ExecutionConfig:
             changes["memory_limit_bytes"] = int(env_memory)
         if os.environ.get("DAFT_TPU_DEVICE_EVAL") in ("0", "false"):
             changes["device_eval"] = False
+        if not daft_env_flag("DAFT_COMPILED_EVAL", True):
+            changes["compiled_eval_enabled"] = False
+        if not daft_env_flag("DAFT_STAGE_FUSION", True):
+            changes["stage_fusion_enabled"] = False
         if os.environ.get("DAFT_SHUFFLE_ALGORITHM"):
             changes["shuffle_algorithm"] = os.environ["DAFT_SHUFFLE_ALGORITHM"]
         if os.environ.get("DAFT_FAULT_SPEC"):

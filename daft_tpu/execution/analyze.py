@@ -49,6 +49,18 @@ def analyze_suffix(df) -> str:
                if v - before.get(k, 0)}
     lines.append(f"device eval: fused_exprs={fused}, fused_rows={fused_rows}"
                  + (f", fallbacks={reasons}" if reasons else ""))
+    hits = int(d("daft_compile_cache_hits_total"))
+    misses = int(d("daft_compile_cache_misses_total"))
+    chain_morsels = int(d("daft_compiled_chain_morsels_total"))
+    if hits or misses or chain_morsels:
+        ch0 = s0.hist("daft_compile_seconds")
+        ch1 = s1.hist("daft_compile_seconds")
+        enabled = s1.value("daft_compiled_eval_enabled")
+        lines.append(
+            f"compiled chains: morsels={chain_morsels}, "
+            f"cache_hits={hits}, cache_misses={misses}, "
+            f"compile_s={ch1['sum'] - ch0['sum']:.4f}"
+            + ("" if enabled else " [SELF-DISABLED]"))
     spilled = int(d("daft_spill_bytes_total"))
     if spilled:
         lines.append(f"spill: bytes={spilled}, "

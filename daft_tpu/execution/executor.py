@@ -475,12 +475,157 @@ class Executor:
             timer=self._stage_frame(node))
 
     def _run_Project(self, node: pp.Project) -> Iterator[MicroPartition]:
-        yield from self._streaming_map(
-            node, lambda mp: mp.eval_expression_list(node.exprs))
+        yield from self._run_relational_chain(node)
 
     def _run_Filter(self, node: pp.Filter) -> Iterator[MicroPartition]:
-        yield from self._streaming_map(
-            node, lambda mp: mp.filter(node.predicate))
+        yield from self._run_relational_chain(node)
+
+    # -- stage + kernel fusion -------------------------------------------
+    @staticmethod
+    def _node_kernel(nd):
+        """The interpreted per-morsel kernel for one Project/Filter node."""
+        if isinstance(nd, pp.Filter):
+            return lambda mp: mp.filter(nd.predicate)
+        return lambda mp: mp.eval_expression_list(nd.exprs)
+
+    def _collect_stage_chain(self, head) -> List[pp.PhysicalPlan]:
+        """The maximal Project/Filter chain rooted at ``head``, top-first.
+
+        Fusion decisions are a PURE function of plan + config — never
+        thread count — preserving the determinism contract. The chain
+        stops at shared subtrees (their output must materialize once at
+        that boundary for every parent)."""
+        if not getattr(self.cfg, "stage_fusion_enabled", True):
+            return [head]
+        nodes = [head]
+        shared = getattr(self, "_shared_ids", ())
+        cur = head
+        while True:
+            child = cur.children[0]
+            if not isinstance(child, (pp.Project, pp.Filter)) \
+                    or id(child) in shared:
+                return nodes
+            nodes.append(child)
+            cur = child
+
+    @staticmethod
+    def _chain_steps(nodes) -> List[tuple]:
+        """(kind, payload) steps in EXECUTION (bottom-up) order for a
+        top-first node chain."""
+        steps = []
+        for nd in reversed(nodes):
+            if isinstance(nd, pp.Filter):
+                steps.append(("filter", nd.predicate))
+            else:
+                steps.append(("project", list(nd.exprs)))
+        return steps
+
+    def _member_frames(self, stack, members) -> Dict[int, object]:
+        """Open one profiler operator span per fused member node for the
+        stage's lifetime, so fused chains stay per-plan-node attributable:
+        interpreted fallback kernels time under their own node's frame,
+        and every fused-away operator still exports a span."""
+        frames: Dict[int, object] = {}
+        if self.profiler is None:
+            return frames
+        for nd in members:
+            op = type(nd).__name__
+            with self._state_lock:
+                seq = self._profile_node_ids.setdefault(
+                    id(nd), len(self._profile_node_ids))
+            frames[id(nd)] = stack.enter_context(
+                self.profiler.operator_span(op, f"{op}#{seq}"))
+        return frames
+
+    def _compiled_suffix(self, nodes, steps, out_schema):
+        """The longest compilable SUFFIX of a bottom-up step chain (real
+        plans often carry an untraceable prefix — the cast-projection off a
+        64-bit source): returns ``(k, spec)`` where steps[:k] stay
+        interpreted and steps[k:] run as one program, or ``(0, None)``.
+        Pure plan+config, like every other fusion decision."""
+        from daft_tpu.ops import compiled_eval
+
+        exec_order = list(reversed(nodes))  # exec_order[i] produced steps[i]
+        tail = nodes[-1]
+        for k in range(len(steps)):
+            input_schema = tail.children[0].schema if k == 0 \
+                else exec_order[k - 1].schema
+            spec = compiled_eval.build_chain_spec(
+                steps[k:], input_schema, out_schema, self.cfg)
+            if spec is not None:
+                return k, spec
+        return 0, None
+
+    def _run_relational_chain(self, head) -> Iterator[MicroPartition]:
+        """Fused Project/Filter execution: adjacent streaming stages
+        collapse into ONE composed morsel stage (a chain costs one queue
+        hop instead of N — the PR 8 hop tax), and the longest traceable
+        suffix of the chain (ops/compiled_eval.py) runs each morsel as a
+        single jitted XLA program with interpreted per-step fallback."""
+        import contextlib
+
+        from daft_tpu import metrics
+        from daft_tpu.execution.pipeline import map_stage
+
+        nodes = self._collect_stage_chain(head)
+        steps = self._chain_steps(nodes)
+        split, spec = self._compiled_suffix(nodes, steps, head.schema)
+        if len(nodes) == 1:
+            # Single stage: previous behavior, plus the compiled path for
+            # one-node "chains" (a lone big Filter still wins by tracing).
+            kern = self._node_kernel(head)
+            if spec is None:
+                yield from self._streaming_map(head, kern)
+                return
+
+            def one(mp: MicroPartition) -> MicroPartition:
+                out = spec.run_morsel(mp)
+                return out if out is not None else kern(mp)
+
+            yield from self._streaming_map(head, one)
+            return
+        metrics.STAGE_FUSIONS.inc(len(nodes) - 1)
+        members = nodes[1:]
+        exec_order = list(reversed(nodes))  # bottom-up kernels
+        kernels = [(nd, self._node_kernel(nd)) for nd in exec_order]
+        tail = nodes[-1]
+        with contextlib.ExitStack() as stack:
+            frames = self._member_frames(stack, members)
+
+            def run_step(nd, kern, mp, head_frame):
+                if nd is head:
+                    # The head's add_output happens at the consumer
+                    # (_profiled); only time the kernel here.
+                    return kern(mp) if head_frame is None \
+                        else head_frame.run_timed(kern, mp)
+                frame = frames.get(id(nd))
+                if frame is None:
+                    return kern(mp)
+                out = frame.run_timed(kern, mp)
+                frame.add_worker_output(len(out), out)
+                return out
+
+            def composed(mp: MicroPartition) -> MicroPartition:
+                head_frame = self._stage_frame(head)
+                for nd, kern in kernels[:split]:
+                    mp = run_step(nd, kern, mp, head_frame)
+                if spec is not None:
+                    run = spec.run_morsel
+                    out = run(mp) if head_frame is None \
+                        else head_frame.run_timed(run, mp)
+                    if out is not None:
+                        return out
+                for nd, kern in kernels[split:]:
+                    mp = run_step(nd, kern, mp, head_frame)
+                return mp
+
+            it = morselize(self._run(tail.children[0]),
+                           self.min_morsel_rows, self.max_morsel_rows)
+            ordered = getattr(self.cfg, "default_maintain_order", True)
+            yield from map_stage(
+                it, composed, pool=self._pool(),
+                workers=self.compute_threads,
+                name=type(head).__name__, ordered=ordered)
 
     def _run_Explode(self, node: pp.Explode) -> Iterator[MicroPartition]:
         names = [e.name() for e in node.to_explode]
@@ -729,22 +874,102 @@ class Executor:
         * high-cardinality aggs (partials barely shrink, so a merge pass
           would nearly double the work) hash-partition instead.
         """
+        import contextlib
         import itertools
 
         state: AggState = fresh_state()
         plan = state.plan
-        it = morselize(self._run(node.children[0]),
-                       self.min_morsel_rows, self.max_morsel_rows)
-        chunks = chunk_morsels(it, self.AGG_CHUNK_ROWS)
-        first = next(chunks, None)
-        if first is None:
-            yield MicroPartition(node.schema, [state.finalize()])
-            return
+        # Global (no-group-by) aggs can absorb the Filter/Project chain
+        # below them: the whole filter→project→partial-agg pipeline
+        # compiles into ONE jitted program per chunk (ops/compiled_eval),
+        # eliminating even the chain's single fused stage hop. Pure
+        # plan+config eligibility; ineligible plans keep the normal
+        # stage-fed path.
+        from daft_tpu.ops import compiled_eval
 
-        def partial_of(chunk: List[MicroPartition]) -> RecordBatch:
-            rb = RecordBatch.concat(
-                [b for mp in chunk for b in mp.record_batches()])
-            return rb.agg(plan.partial_exprs, plan.group_by)
+        agg_spec = None
+        agg_split = 0
+        chain_nodes: List[pp.PhysicalPlan] = []
+        cur = node.children[0]
+        if not plan.group_by:
+            # Chain absorption collapses stages, so it honors the stage-
+            # fusion off switch; with fusion disabled only the bare
+            # partial-reduction program (empty chain) may still compile.
+            if getattr(self.cfg, "stage_fusion_enabled", True):
+                shared = getattr(self, "_shared_ids", ())
+                while isinstance(cur, (pp.Project, pp.Filter)) \
+                        and id(cur) not in shared:
+                    chain_nodes.append(cur)
+                    cur = cur.children[0]
+            steps = self._chain_steps(chain_nodes)
+            exec_order = list(reversed(chain_nodes))
+            partial_schema = state.partial_schema(node.children[0].schema)
+            # Longest compilable suffix, like _compiled_suffix — k may
+            # reach len(steps): a bare partial-reduction program still
+            # fuses the agg even when the whole chain stays interpreted.
+            for k in range(len(steps) + 1):
+                input_schema = cur.schema if k == 0 \
+                    else exec_order[k - 1].schema
+                agg_spec = compiled_eval.build_agg_chain_spec(
+                    steps[k:], plan, input_schema, partial_schema, self.cfg)
+                if agg_spec is not None:
+                    agg_split = k
+                    break
+        with contextlib.ExitStack() as stack:
+            if agg_spec is not None:
+                frames = self._member_frames(stack, chain_nodes)
+                source = self._run(cur)
+            else:
+                frames = {}
+                source = self._run(node.children[0])
+            it = morselize(source, self.min_morsel_rows,
+                           self.max_morsel_rows)
+            chunks = chunk_morsels(it, self.AGG_CHUNK_ROWS)
+            first = next(chunks, None)
+            if first is None:
+                yield MicroPartition(node.schema, [state.finalize()])
+                return
+
+            chain_kernels = [(nd, self._node_kernel(nd))
+                             for nd in reversed(chain_nodes)]
+
+            def run_chain_step(nd, kern, mp):
+                frame = frames.get(id(nd))
+                if frame is None:
+                    return kern(mp)
+                out = frame.run_timed(kern, mp)
+                frame.add_worker_output(len(out), out)
+                return out
+
+            def partial_of(chunk: List[MicroPartition]) -> RecordBatch:
+                rb = RecordBatch.concat(
+                    [b for mp in chunk for b in mp.record_batches()])
+                if agg_spec is not None:
+                    # Interpreted prefix (untraceable bottom steps), then
+                    # the compiled suffix as one program per chunk.
+                    mp = MicroPartition(cur.schema, [rb])
+                    for nd, kern in chain_kernels[:agg_split]:
+                        mp = run_chain_step(nd, kern, mp)
+                    rb = mp.combined()
+                    out = agg_spec.run_chunk(rb)
+                    if out is not None:
+                        return out
+                    # Data-driven fallback: finish the suffix interpreted,
+                    # timed under each node's frame.
+                    mid_schema = cur.schema if agg_split == 0 \
+                        else chain_kernels[agg_split - 1][0].schema
+                    mp = MicroPartition(mid_schema, [rb])
+                    for nd, kern in chain_kernels[agg_split:]:
+                        mp = run_chain_step(nd, kern, mp)
+                    rb = mp.combined()
+                return rb.agg(plan.partial_exprs, plan.group_by)
+
+            yield from self._pipelined_agg_body(
+                node, fresh_state, state, plan, first, chunks, partial_of)
+
+    def _pipelined_agg_body(self, node, fresh_state, state, plan, first,
+                            chunks, partial_of) -> Iterator[MicroPartition]:
+        import itertools
 
         if plan.group_by:
             # Cardinality probe on the FIRST MORSEL only (bounded waste —

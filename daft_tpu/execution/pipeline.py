@@ -15,6 +15,15 @@ feeder); a failure anywhere poisons the stream by propagating the ORIGINAL
 exception to the consumer, unwrapped, so error types match the serial
 path regardless of core count.
 
+Stage fusion (PR 11): the executor no longer creates one stage per
+streaming operator — adjacent Project/Filter nodes collapse into ONE
+composed morsel function run through a single ``map_stage`` call
+(executor._run_relational_chain), so a chain costs one queue hop instead
+of N, and the traceable suffix of the chain can run as one jitted XLA
+program per morsel (ops/compiled_eval.py). The primitives below are
+unchanged: a fused chain is just a stage whose ``fn`` happens to be a
+composition.
+
 Determinism contract (the parallel-vs-serial equality suite): everything
 here that shapes *what* is computed — morsel split points, coalesce
 boundaries, aggregation chunk boundaries — is a pure function of the

@@ -753,6 +753,36 @@ DEVICE_FALLBACKS = _r.counter(
 DEVICE_ERRORS = _r.counter(
     "daft_device_errors_total", "Device-path evaluation errors")
 
+# Compiled chain evaluation (ops/compiled_eval.py): whole filter→project→agg
+# chains traced into single jitted XLA programs, cache-keyed on schema +
+# canonicalized plan fingerprint.
+COMPILE_CACHE_HITS = _r.counter(
+    "daft_compile_cache_hits_total",
+    "Compiled-chain program cache hits (fingerprint + bucket shape)")
+COMPILE_CACHE_MISSES = _r.counter(
+    "daft_compile_cache_misses_total",
+    "Compiled-chain program cache misses (fresh XLA trace + compile)")
+COMPILE_SECONDS = _r.histogram(
+    "daft_compile_seconds",
+    "XLA trace+compile wall seconds per fresh chain program",
+    buckets=exponential_buckets(0.001, 4.0, 10))
+COMPILED_EVAL_ENABLED = _r.gauge(
+    "daft_compiled_eval_enabled",
+    "1 while the compiled chain path is live; 0 when disabled by config "
+    "or by the fused-vs-interpreted self-disable guard")
+COMPILED_CHAIN_MORSELS = _r.counter(
+    "daft_compiled_chain_morsels_total",
+    "Morsels evaluated through a compiled chain program, by chain kind",
+    ("kind",))
+COMPILED_CHAIN_ROWS = _r.counter(
+    "daft_compiled_chain_rows_total",
+    "Rows evaluated through a compiled chain program, by chain kind",
+    ("kind",))
+STAGE_FUSIONS = _r.counter(
+    "daft_stage_fusions_total",
+    "Adjacent Project/Filter stages collapsed into one morsel stage "
+    "(counted once per fused chain per query plan walk)")
+
 # IO (io/iostats.py + native clients + retry)
 IO_REQUESTS = _r.counter(
     "daft_io_requests_total", "Object-store/HTTP requests",

@@ -478,6 +478,14 @@ class _OpFrame:
             if st and st[-1] is self:
                 st.pop()
 
+    def add_worker_output(self, rows: int, mp) -> None:
+        """Output accounting from a stage WORKER thread (fused-chain member
+        operators record their per-node output inside the composed morsel
+        fn): same bookkeeping as :meth:`add_output`, under the frame lock
+        because concurrent workers race on the counters."""
+        with self._lock:
+            self.add_output(rows, mp)
+
     def add_output(self, rows: int, mp) -> None:
         """Per-morsel output accounting. ``size_bytes()`` walks every
         column buffer, so bytes are SAMPLED (first morsel, then every

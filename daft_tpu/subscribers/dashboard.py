@@ -314,10 +314,12 @@ class DashboardState:
         device-eval fusion coverage, and IO stats."""
         from daft_tpu.execution.spill import spill_metrics
         from daft_tpu.io.iostats import io_stats
+        from daft_tpu.ops.compiled_eval import compile_cache_snapshot
         from daft_tpu.ops.device_eval import device_eval_metrics
 
         sp = spill_metrics.snapshot()
         dev = device_eval_metrics.snapshot()
+        comp = compile_cache_snapshot()
         io = io_stats()
         with self._lock:
             running = [q for q in self.queries.values() if q["status"] == "running"]
@@ -335,6 +337,11 @@ class DashboardState:
                 "device_fused_exprs": dev["fused_exprs"],
                 "device_fused_rows": dev["fused_rows"],
                 "device_fallbacks": sum(dev["fallback_reasons"].values()),
+                "compile_cache_hits": comp["cache_hits"],
+                "compile_cache_misses": comp["cache_misses"],
+                "compile_seconds": comp["compile_seconds"],
+                "compiled_chain_morsels": comp["chain_morsels"],
+                "compiled_eval_enabled": comp["enabled"],
                 "io_bytes_read": io.bytes_read,
                 "io_files_opened": io.files_opened,
                 "io_files_pruned": io.files_pruned,

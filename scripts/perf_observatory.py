@@ -12,6 +12,8 @@ per-operator regression report.
   python scripts/perf_observatory.py --diff <shaA> <shaB>
   python scripts/perf_observatory.py --check --suite micro   # CI gate
   python scripts/perf_observatory.py --overhead-check        # <2% recording
+  python scripts/perf_observatory.py --ab-fusion             # compiled-eval
+                                                             # ABBA guard
 
 The CI gate (--check) compares a fresh capture against the LAST committed
 entry for the suite. Cross-machine honesty comes from median-ratio
@@ -438,6 +440,37 @@ def cmd_overhead(args) -> int:
     return 0
 
 
+def cmd_ab_fusion(args) -> int:
+    """Fused-vs-interpreted ABBA A/B guard (the compiled-eval
+    self-disabling contract): the compiled chain path must beat the
+    interpreted path on q01/q06-shaped f32 scans, or it turns ITSELF off
+    (process-level switch + ``daft_compiled_eval_enabled 0``). The guard
+    fails (exit 3) only when the off switch malfunctions — a fused loss
+    that correctly self-disables is a PASSING run of the contract."""
+    from daft_tpu.ops import compiled_eval
+
+    result = compiled_eval.run_ab_guard(
+        rows=args.ab_rows, blocks=args.blocks,
+        tolerance_pct=args.ab_tolerance_pct)
+    print(json.dumps(result, indent=1, sort_keys=True))
+    if result["fused_wins"]:
+        print(f"ab-fusion guard OK: compiled path "
+              f"{-result['delta_pct']:.1f}% faster "
+              f"(median of {result['blocks']} ABBA blocks)",
+              file=sys.stderr)
+        return 0
+    # The contract fired: verify the off switch actually works.
+    if not result["self_disabled"] or compiled_eval.enabled(
+            daft_tpu.get_context().execution_config):
+        print("ab-fusion guard FAILED: compiled path lost but the "
+              "self-disable switch did not engage", file=sys.stderr)
+        return 3
+    print(f"ab-fusion guard: compiled path lost by "
+          f"{result['delta_pct']:.1f}% and correctly self-disabled "
+          f"(daft_compiled_eval_enabled=0)", file=sys.stderr)
+    return 0
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     p.add_argument("--suite", default="tpch", choices=("tpch", "micro"))
@@ -464,6 +497,13 @@ def main(argv=None) -> int:
                         "scaling table vs the smallest count")
     p.add_argument("--overhead-check", action="store_true",
                    help="assert capture overhead < 2%% vs plain collect()")
+    p.add_argument("--ab-fusion", action="store_true",
+                   help="fused-vs-interpreted ABBA guard on q01/q06-shaped "
+                        "scans (self-disabling contract)")
+    p.add_argument("--ab-rows", type=int, default=400_000,
+                   help="rows for the --ab-fusion tables")
+    p.add_argument("--ab-tolerance-pct", type=float, default=5.0,
+                   help="max compiled-path loss before self-disable fires")
     p.add_argument("--threshold-pct", type=float, default=30.0,
                    help="calibrated slowdown that counts as a regression")
     p.add_argument("--min-delta-s", type=float, default=0.08,
@@ -477,6 +517,8 @@ def main(argv=None) -> int:
         return cmd_check(args)
     if args.overhead_check:
         return cmd_overhead(args)
+    if args.ab_fusion:
+        return cmd_ab_fusion(args)
     if args.cores:
         return cmd_cores(args)
     return cmd_capture(args)

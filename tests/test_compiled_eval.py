@@ -1,0 +1,457 @@
+"""Compiled relational chains (ops/compiled_eval.py) + stage fusion.
+
+The PR 11 contracts:
+
+* filter→project(→agg) chains compile into ONE jitted program per
+  micropartition with results matching the interpreted path;
+* the compile cache is keyed on schema + canonicalized plan fingerprint —
+  repeated-shape workloads hit ≥ 90%;
+* fusion decisions are pure plan+config: results are byte-identical at
+  num_compute_threads=1 vs =4 with fusion on;
+* the self-disable switch (the fused-must-win contract) actually turns the
+  feature off, visibly (daft_compiled_eval_enabled 0);
+* fused stages stay per-plan-node attributable in the profiler.
+"""
+
+import numpy as np
+import pytest
+
+import daft_tpu
+from daft_tpu import col, lit
+from daft_tpu.metrics import get_registry
+from daft_tpu.ops import compiled_eval
+
+
+@pytest.fixture(autouse=True)
+def _clean_switch():
+    compiled_eval.clear_self_disabled()
+    yield
+    compiled_eval.clear_self_disabled()
+
+
+def _snap():
+    return get_registry().snapshot()
+
+
+def _delta(s0, s1, name):
+    return s1.counter_total(name) - s0.counter_total(name)
+
+
+def _f32_table(n=20_000, with_nulls=False, seed=3):
+    rng = np.random.default_rng(seed)
+    x = rng.uniform(0.0, 100.0, n).astype(np.float32)
+    y = rng.uniform(0.0, 1.0, n).astype(np.float32)
+    data = {
+        "x": x.tolist(), "y": y.tolist(),
+        "tag": [f"t{i % 7}" for i in range(n)],
+    }
+    if with_nulls:
+        data["x"] = [None if i % 11 == 0 else v
+                     for i, v in enumerate(data["x"])]
+    df = daft_tpu.from_pydict(data)
+    f32 = daft_tpu.DataType.float32()
+    return df.with_columns({"x": col("x").cast(f32),
+                            "y": col("y").cast(f32)})
+
+
+def _chain_query(df):
+    """Filter -> project (arith + string passthrough + literal) -> filter."""
+    return (df.where(col("y") < 0.9)
+            .select(col("x"), col("y"), col("tag"),
+                    (col("x") * 2 + col("y")).alias("v"),
+                    lit(7).alias("k"))
+            .where(col("v") > 20.0))
+
+
+def test_chain_parity_vs_interpreted():
+    df = _f32_table()
+    with daft_tpu.execution_config_ctx(compiled_eval_enabled=True,
+                                       device_eval_min_rows=1):
+        s0 = _snap()
+        fused = _chain_query(df).to_pydict()
+        s1 = _snap()
+    assert _delta(s0, s1, "daft_compiled_chain_morsels_total") >= 1, \
+        "chain did not take the compiled path"
+    with daft_tpu.execution_config_ctx(compiled_eval_enabled=False,
+                                       device_eval=False):
+        host = _chain_query(df).to_pydict()
+    assert fused["tag"] == host["tag"]
+    assert fused["k"] == host["k"]
+    # Elementwise f32 arithmetic is bit-identical between XLA-CPU and numpy.
+    np.testing.assert_array_equal(np.asarray(fused["v"]),
+                                  np.asarray(host["v"]))
+    np.testing.assert_array_equal(np.asarray(fused["x"]),
+                                  np.asarray(host["x"]))
+
+
+def test_chain_parity_with_nulls():
+    df = _f32_table(with_nulls=True)
+    with daft_tpu.execution_config_ctx(compiled_eval_enabled=True,
+                                       device_eval_min_rows=1):
+        fused = _chain_query(df).to_pydict()
+    with daft_tpu.execution_config_ctx(compiled_eval_enabled=False,
+                                       device_eval=False):
+        host = _chain_query(df).to_pydict()
+    # Null x rows: v is null -> pred null -> row dropped. Same row set and
+    # same null layout either way.
+    assert fused["tag"] == host["tag"]
+    assert [v is None for v in fused["v"]] == [v is None for v in host["v"]]
+    np.testing.assert_array_equal(
+        np.asarray([v for v in fused["v"] if v is not None]),
+        np.asarray([v for v in host["v"] if v is not None]))
+
+
+def _q06_query(df):
+    return (df.where((col("y") < 0.8) & (col("x") > 5.0))
+            .agg((col("x") * col("y")).sum().alias("rev"),
+                 col("x").count().alias("n"),
+                 col("x").min().alias("lo"),
+                 col("x").max().alias("hi")))
+
+
+def test_agg_chain_compiles_and_matches():
+    df = _f32_table(n=50_000)
+    with daft_tpu.execution_config_ctx(compiled_eval_enabled=True):
+        s0 = _snap()
+        fused = _q06_query(df).to_pydict()
+        s1 = _snap()
+    kinds = {k: v - s0.label_totals(
+        "daft_compiled_chain_morsels_total", "kind").get(k, 0)
+        for k, v in s1.label_totals(
+            "daft_compiled_chain_morsels_total", "kind").items()}
+    assert kinds.get("filter_project_agg", 0) >= 1, kinds
+    with daft_tpu.execution_config_ctx(compiled_eval_enabled=False,
+                                       device_eval=False):
+        host = _q06_query(df).to_pydict()
+    assert fused["n"] == host["n"]
+    np.testing.assert_array_equal(fused["lo"], host["lo"])
+    np.testing.assert_array_equal(fused["hi"], host["hi"])
+    # Sum accumulates in f32 on device vs arrow's wider accumulator: allow
+    # f32 accumulation error, nothing more.
+    np.testing.assert_allclose(fused["rev"], host["rev"], rtol=1e-5)
+
+
+def test_agg_chain_empty_filter_result_is_null_sum():
+    df = _f32_table(n=8_192)
+    q = (df.where(col("x") > 1e9)
+         .agg((col("x") * col("y")).sum().alias("s"),
+              col("x").count().alias("n")))
+    with daft_tpu.execution_config_ctx(compiled_eval_enabled=True):
+        fused = q.to_pydict()
+    assert fused["s"] == [None]
+    assert fused["n"] == [0]
+
+
+def test_compile_cache_hit_rate_on_repeated_shapes():
+    """Dashboard-tenant workload: the same query shape re-submitted many
+    times must hit the plan-fingerprint compile cache >= 90%."""
+    df = _f32_table(n=30_000)
+    runs = 10
+    with daft_tpu.execution_config_ctx(compiled_eval_enabled=True,
+                                       device_eval_min_rows=1):
+        s0 = _snap()
+        for _ in range(runs):
+            _chain_query(df).to_pydict()
+            _q06_query(df).to_pydict()
+        s1 = _snap()
+    hits = _delta(s0, s1, "daft_compile_cache_hits_total")
+    misses = _delta(s0, s1, "daft_compile_cache_misses_total")
+    assert hits + misses > 0, "no compiled-chain traffic at all"
+    rate = hits / (hits + misses)
+    assert rate >= 0.90, f"hit rate {rate:.2%} (hits={hits} misses={misses})"
+
+
+def test_int32_sum_falls_back_dtype_driven():
+    """i32 sums promote to i64 on the host — past the device's 32-bit cap,
+    so the agg chain must refuse (dtype-driven fallback), not mis-sum."""
+    n = 8_192
+    df = daft_tpu.from_pydict({"i": np.arange(n, dtype=np.int32)})
+    df = df.with_column("i", col("i").cast(daft_tpu.DataType.int32()))
+    q = df.agg(col("i").sum().alias("s"))
+    with daft_tpu.execution_config_ctx(compiled_eval_enabled=True):
+        s0 = _snap()
+        out = q.to_pydict()
+        s1 = _snap()
+    kinds = s1.label_totals("daft_compiled_chain_morsels_total", "kind")
+    base = s0.label_totals("daft_compiled_chain_morsels_total", "kind")
+    assert kinds.get("filter_project_agg", 0) == \
+        base.get("filter_project_agg", 0)
+    assert out["s"] == [int(np.arange(n, dtype=np.int64).sum())]
+
+
+def test_self_disable_switch_works():
+    """The self-disabling contract's off switch: once flipped, no chain
+    compiles, and the off state is visible in metrics."""
+    df = _f32_table(n=20_000)
+    compiled_eval.set_self_disabled("test: forced off")
+    try:
+        with daft_tpu.execution_config_ctx(compiled_eval_enabled=True,
+                                           device_eval_min_rows=1):
+            s0 = _snap()
+            _chain_query(df).to_pydict()
+            _q06_query(df).to_pydict()
+            s1 = _snap()
+        assert _delta(s0, s1, "daft_compiled_chain_morsels_total") == 0
+        assert s1.value("daft_compiled_eval_enabled") == 0
+        assert compiled_eval.self_disabled_reason() is not None
+    finally:
+        compiled_eval.clear_self_disabled()
+    assert _snap().value("daft_compiled_eval_enabled") == 1
+
+
+def test_env_knob_disables_chain_path():
+    df = _f32_table(n=20_000)
+    with daft_tpu.execution_config_ctx(compiled_eval_enabled=False,
+                                       device_eval_min_rows=1):
+        s0 = _snap()
+        out = _chain_query(df).to_pydict()
+        s1 = _snap()
+    assert _delta(s0, s1, "daft_compiled_chain_morsels_total") == 0
+    assert len(out["v"]) > 0
+
+
+def test_thread_count_determinism_with_fusion_on():
+    """Byte-identical results at num_compute_threads=1 vs =4 with stage
+    fusion + compiled chains on: fusion decisions and reduction shapes are
+    pure functions of plan+config, never thread count."""
+    df = _f32_table(n=200_000, seed=9)
+
+    def run(threads):
+        with daft_tpu.execution_config_ctx(
+                compiled_eval_enabled=True, stage_fusion_enabled=True,
+                num_compute_threads=threads,
+                default_morsel_size=16_384, min_morsel_size=4_096):
+            chain = _chain_query(df).to_pydict()
+            agg = _q06_query(df).to_pydict()
+        return chain, agg
+
+    c1, a1 = run(1)
+    c4, a4 = run(4)
+    for k in c1:
+        assert c1[k] == c4[k], f"chain column {k} differs across threads"
+    for k in a1:
+        assert a1[k] == a4[k], f"agg column {k} differs across threads"
+
+
+def test_stage_fusion_counts_and_parity():
+    """Adjacent Project/Filter stages collapse (counter moves) and fused
+    results equal the unfused pipeline, including for dtypes the compiler
+    refuses (f64 -> interpreted kernels inside ONE fused stage)."""
+    n = 50_000
+    rng = np.random.default_rng(4)
+    df = daft_tpu.from_pydict({
+        "a": rng.integers(0, 1_000_000, n),   # int64: never device-eligible
+        "b": rng.random(n),                   # f64
+    })
+    q = (df.where(col("a") % 7 > 0)
+         .with_column("c", col("b") * 2.0 + 1.0)
+         .where(col("c") > 1.1)
+         .select(col("a"), col("c")))
+    with daft_tpu.execution_config_ctx(stage_fusion_enabled=True):
+        s0 = _snap()
+        fused = q.to_pydict()
+        s1 = _snap()
+    assert _delta(s0, s1, "daft_stage_fusions_total") >= 1
+    with daft_tpu.execution_config_ctx(stage_fusion_enabled=False):
+        unfused = q.to_pydict()
+    assert fused == unfused
+
+
+def test_fused_chain_profiler_attribution():
+    """Fused spans stay per-plan-node attributable: every Project/Filter
+    in a fused chain still exports its own operator span."""
+    n = 120_000
+    rng = np.random.default_rng(5)
+    df = daft_tpu.from_pydict({
+        "a": rng.integers(0, 1_000_000, n),
+        "b": rng.random(n)})
+    def spans_for(fusion: bool):
+        q = (df.where(col("a") % 3 > 0)
+             .with_column("c", col("b") * 2.0)
+             .where(col("c") > 0.2)
+             .agg(col("c").sum().alias("s")))
+        with daft_tpu.execution_config_ctx(stage_fusion_enabled=fusion,
+                                           default_morsel_size=16_384,
+                                           min_morsel_size=4_096):
+            q.collect(profile=True)
+        return sorted(s.attributes["operator"]
+                      for s in q.query_profile.spans()
+                      if s.name.startswith("daft.op."))
+
+    fused, unfused = spans_for(True), spans_for(False)
+    # Fusion must not LOSE spans: every plan node an unfused run exports
+    # still exports under fusion (per-plan-node attributability).
+    assert fused == unfused, (fused, unfused)
+    assert "Filter" in fused and "Project" in fused, fused
+
+
+def test_filter_above_projection_drops_propagated_null_pred_rows():
+    """Code-review regression: a filter ABOVE a projection must mask on
+    the projected columns' PROPAGATED nulls (pred null -> row dropped),
+    not the raw-input namespace — zero-filled null lanes would otherwise
+    pass the predicate and survive. Driven at the spec level because the
+    optimizer's filter pushdown usually rewrites predicates into the
+    input namespace before the executor sees them."""
+    from daft_tpu.context import get_context
+    from daft_tpu.expressions.evaluator import resolve_schema
+    from daft_tpu.ops.compiled_eval import build_chain_spec
+
+    n = 2048
+    rng = np.random.default_rng(2)
+    xs = [None if i % 11 == 0 else float(v)
+          for i, v in enumerate(rng.uniform(1.0, 50.0, n))]
+    df = daft_tpu.from_pydict({"x": xs}).with_column(
+        "x", col("x").cast(daft_tpu.DataType.float32()))
+    mp = df._materialize().partitions[0]
+    rb = mp.combined()
+    proj = (col("x") * 2).alias("v")._expr
+    pred = (col("v") < 1e9)._expr  # true on every non-null lane
+    steps = [("project", [proj]), ("filter", pred)]
+    out_schema = resolve_schema([proj], rb.schema)
+    cfg = get_context().execution_config.with_changes(
+        compiled_eval_enabled=True, device_eval_min_rows=1)
+    spec = build_chain_spec(steps, rb.schema, out_schema, cfg)
+    assert spec is not None, "project->filter chain must be compilable"
+    out = spec.run_morsel(mp)
+    assert out is not None, "compiled path must engage"
+    got = out.combined().get_column("v").to_pylist()
+    expected = [x * 2 for x in xs if x is not None]
+    assert len(got) == len(expected), (len(got), len(expected))
+    np.testing.assert_allclose(got, expected, rtol=1e-6)
+
+
+def test_agg_chain_respects_min_rows_floor():
+    """Code-review regression: tiny global aggs must NOT pay device
+    staging + a cold XLA compile (min-rows floor, like the elementwise
+    path)."""
+    df = daft_tpu.from_pydict(
+        {"x": np.arange(50, dtype=np.float32)}).with_column(
+        "x", col("x").cast(daft_tpu.DataType.float32()))
+    with daft_tpu.execution_config_ctx(compiled_eval_enabled=True,
+                                       device_eval_min_rows=1024):
+        s0 = _snap()
+        out = df.agg(col("x").sum().alias("s")).to_pydict()
+        s1 = _snap()
+    assert out["s"] == [float(np.arange(50, dtype=np.float32).sum())]
+    kinds1 = s1.label_totals("daft_compiled_chain_morsels_total", "kind")
+    kinds0 = s0.label_totals("daft_compiled_chain_morsels_total", "kind")
+    assert kinds1.get("filter_project_agg", 0) == \
+        kinds0.get("filter_project_agg", 0), "tiny agg took the device path"
+
+
+def test_stage_fusion_off_disables_agg_chain_absorption():
+    """Code-review regression: DAFT_STAGE_FUSION=0 must also stop the
+    global-agg chain absorption (it collapses stages); only the bare
+    reduction program may still compile."""
+    df = _f32_table(n=30_000)
+    with daft_tpu.execution_config_ctx(compiled_eval_enabled=True,
+                                       stage_fusion_enabled=False):
+        fused_off = _q06_query(df).to_pydict()
+    with daft_tpu.execution_config_ctx(compiled_eval_enabled=False,
+                                       device_eval=False):
+        host = _q06_query(df).to_pydict()
+    assert fused_off["n"] == host["n"]
+    np.testing.assert_allclose(fused_off["rev"], host["rev"], rtol=1e-5)
+
+
+def test_ab_guard_rearbitrates_preexisting_disable():
+    """Code-review regression: a guard run after an earlier self-disable
+    must measure the REAL fused path (clearing the switch first), not
+    compare interpreted vs interpreted."""
+    compiled_eval.set_self_disabled("test: stale disable")
+    try:
+        res = compiled_eval.run_ab_guard(rows=60_000, blocks=1,
+                                         tolerance_pct=1e9)
+        assert res["previously_disabled"] == "test: stale disable"
+        assert res["fused_wins"] is True
+        # The win re-arms the feature.
+        assert compiled_eval.self_disabled_reason() is None
+    finally:
+        compiled_eval.clear_self_disabled()
+
+
+def test_ab_guard_win_path_leaves_feature_on():
+    res = compiled_eval.run_ab_guard(rows=60_000, blocks=1,
+                                     tolerance_pct=1e9)
+    assert res["fused_wins"] is True
+    assert res["self_disabled"] is False
+    assert compiled_eval.self_disabled_reason() is None
+
+
+def test_ab_guard_loss_self_disables(monkeypatch):
+    """Force a fused loss (timing monkeypatched) and prove the guard
+    flips the off switch."""
+    calls = {"n": 0}
+    real_perf = compiled_eval.time.perf_counter
+
+    def fake_guard_queries(df):
+        # One no-op "query" so the guard's timing loop stays cheap.
+        class _Q:
+            def collect(self):
+                return None
+
+        return [("noop", lambda: _Q())]
+
+    monkeypatch.setattr(compiled_eval, "_guard_queries", fake_guard_queries)
+
+    import daft_tpu as _dt
+
+    class _Ctx:
+        def __init__(self, compiled):
+            self.compiled = compiled
+
+        def __enter__(self):
+            # Compiled runs get a fake slow clock: every once(True) block
+            # measures 10x the interpreted one.
+            calls["slow"] = self.compiled
+            return self
+
+        def __exit__(self, *a):
+            return False
+
+    monkeypatch.setattr(
+        _dt, "execution_config_ctx",
+        lambda **kw: _Ctx(kw.get("compiled_eval_enabled", True)))
+
+    t = {"now": 0.0}
+
+    def fake_perf():
+        t["now"] += 1.0 if calls.get("slow") else 0.1
+        return t["now"]
+
+    monkeypatch.setattr(compiled_eval.time, "perf_counter", fake_perf)
+    try:
+        res = compiled_eval.run_ab_guard(rows=100, blocks=1,
+                                         tolerance_pct=5.0)
+        assert res["fused_wins"] is False
+        assert res["self_disabled"] is True
+        assert compiled_eval.self_disabled_reason() is not None
+        assert _snap().value("daft_compiled_eval_enabled") == 0
+    finally:
+        monkeypatch.setattr(compiled_eval.time, "perf_counter", real_perf)
+        compiled_eval.clear_self_disabled()
+
+
+def test_explain_analyze_shows_compile_cache(capsys):
+    df = _f32_table(n=8_192)
+    with daft_tpu.execution_config_ctx(compiled_eval_enabled=True,
+                                       device_eval_min_rows=1):
+        _chain_query(df).explain(analyze=True)
+    text = capsys.readouterr().out
+    assert "compiled chains:" in text
+    assert "cache_hits=" in text
+
+
+def test_dashboard_engine_summary_surfaces_compile_cache():
+    from daft_tpu.subscribers.dashboard import DashboardState
+
+    df = _f32_table(n=8_192)
+    with daft_tpu.execution_config_ctx(compiled_eval_enabled=True,
+                                       device_eval_min_rows=1):
+        _chain_query(df).to_pydict()
+    summary = DashboardState().engine_summary()
+    for key in ("compile_cache_hits", "compile_cache_misses",
+                "compile_seconds", "compiled_eval_enabled",
+                "compiled_chain_morsels"):
+        assert key in summary, key
+    assert summary["compiled_eval_enabled"] == 1

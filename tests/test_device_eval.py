@@ -157,33 +157,59 @@ def test_engine_parity_host_vs_device_on_nullable():
 
 
 def test_fusion_coverage_floor_on_representative_pipeline():
-    """VERDICT r5 weak #7 / Next #9: a q01/q06-shaped numeric pipeline
-    (filter -> arithmetic projections -> agg) must actually RIDE the fused
-    device path — a silent regression to not_fusable host fallbacks fails
-    this test instead of quietly eating a benchmark round."""
+    """VERDICT r5 weak #7 / Next #9, extended for PR 11: a q01/q06-shaped
+    f32 pipeline (filter -> arithmetic projections -> agg) must RIDE the
+    COMPILED chain path — one jitted program per micropartition, with a
+    compile-cache hit when the same shape re-runs, zero not_fusable
+    fallbacks, and zero device errors. A silent regression to interpreted
+    host evaluation fails here instead of quietly eating a benchmark
+    round."""
+    from daft_tpu.metrics import get_registry
     from daft_tpu.ops.device_eval import device_eval_metrics
 
     n = 4096
     rng = np.random.default_rng(7)
-    f32 = daft_tpu.DataType.float32()
     df = daft_tpu.from_pydict({
         "price": rng.uniform(900, 105000, n).astype(np.float32),
         "disc": rng.uniform(0.0, 0.1, n).astype(np.float32),
         "tax": rng.uniform(0.0, 0.08, n).astype(np.float32),
         "qty": rng.uniform(1, 50, n).astype(np.float32),
     })
+
+    def build():
+        return (df.where((col("qty") < 24.0) & (col("disc") >= 0.02))
+                .with_columns({
+                    "disc_price": col("price") * (1 - col("disc")),
+                    "charge": col("price") * (1 - col("disc"))
+                              * (1 + col("tax")),
+                })
+                .agg(col("disc_price").sum().alias("rev"),
+                     col("charge").sum().alias("charge")))
+
     device_eval_metrics.reset()
-    out = (df.where((col("qty") < 24.0) & (col("disc") >= 0.02))
-           .with_columns({
-               "disc_price": col("price") * (1 - col("disc")),
-               "charge": col("price") * (1 - col("disc")) * (1 + col("tax")),
-           })
-           .agg(col("disc_price").sum().alias("rev"),
-                col("charge").sum().alias("charge")))
-    out.collect()
+    s0 = get_registry().snapshot()
+    build().collect()
+    s1 = get_registry().snapshot()
     snap = device_eval_metrics.snapshot()
-    # Floor: both nontrivial arithmetic projections fused on device.
+    # Floor: the pipeline fused on device, nothing regressed to host.
     assert snap["fused_exprs"] >= 2, snap
     assert snap["fused_rows"] > 0, snap
     assert snap["fallback_reasons"].get("not_fusable", 0) == 0, snap
     assert snap["device_errors"] == 0, snap
+
+    def d(name):
+        return s1.counter_total(name) - s0.counter_total(name)
+
+    # PR 11 floor: the chain COMPILED (whole filter→project→agg as one
+    # jitted program), not just per-expression device eval.
+    assert d("daft_compiled_chain_morsels_total") >= 1, \
+        "compiled chain path not taken"
+    # Same shape again: the plan-fingerprint compile cache must hit.
+    build().collect()
+    s2 = get_registry().snapshot()
+    hits = s2.counter_total("daft_compile_cache_hits_total") \
+        - s1.counter_total("daft_compile_cache_hits_total")
+    misses = s2.counter_total("daft_compile_cache_misses_total") \
+        - s1.counter_total("daft_compile_cache_misses_total")
+    assert hits >= 1 and misses == 0, (hits, misses)
+    assert device_eval_metrics.snapshot()["device_errors"] == 0

@@ -1,9 +1,20 @@
 """Property-based tests (reference: tests/property_based_testing/
-{strategies.py,test_sort.py} — Hypothesis over dtypes/dataframes)."""
+{strategies.py,test_sort.py} — Hypothesis over dtypes/dataframes).
 
-import hypothesis.strategies as st
+Hypothesis is an optional test dependency (not baked into the container
+image); the module skips with a reason instead of erroring at collection —
+environmental, documented per the tier-1 blemish fix in PR 11."""
+
 import pytest
-from hypothesis import HealthCheck, given, settings
+
+hypothesis = pytest.importorskip(
+    "hypothesis",
+    reason="hypothesis not installed in this environment; the "
+           "property-based suite needs it and no in-repo stub can "
+           "meaningfully replace randomized strategy generation")
+
+import hypothesis.strategies as st  # noqa: E402
+from hypothesis import HealthCheck, given, settings  # noqa: E402
 
 import daft_tpu
 from daft_tpu import col
