@@ -351,6 +351,14 @@ def metrics_overhead_check(n: int = 400_000, reps: int = 7,
 PROFILE_OVERHEAD_LIMIT_PCT = float(
     os.environ.get("DAFT_PROFILE_OVERHEAD_LIMIT_PCT", "2.0"))
 
+# The flight recorder (daft_tpu/querylog.py) is ALWAYS on — unlike the
+# opt-in profiler, its cost lands on every production query — so it gets
+# the same paired guard with the same budget, toggling
+# DAFT_QUERY_RECORDER per rep (the recorder consults the env at every
+# begin, exactly so this A/B can alternate inside one process).
+QUERYLOG_OVERHEAD_LIMIT_PCT = float(
+    os.environ.get("DAFT_QUERYLOG_OVERHEAD_LIMIT_PCT", "2.0"))
+
 _PROFILE_AB_CHILD = r"""
 import gc, json, os, sys, time
 import numpy as np
@@ -358,6 +366,11 @@ import daft_tpu
 from daft_tpu import col
 
 n = int(sys.argv[1]); blocks = int(sys.argv[2])
+# Which plane's live switch this child A/Bs (DAFT_PROFILE for the
+# profiler guard, DAFT_QUERY_RECORDER for the flight-recorder guard —
+# both consult the env per query, which is what makes in-process
+# alternation valid).
+var = sys.argv[3] if len(sys.argv) > 3 else "DAFT_PROFILE"
 rng = np.random.default_rng(0)
 # numpy arrays go to from_pydict as-is: .tolist() on three 6M-element
 # columns costs ~45s of untimed child setup per round, which alone eats
@@ -377,9 +390,9 @@ def loop():
          .sort("rev", desc=True))
     return q.to_pydict()
 
-os.environ["DAFT_PROFILE"] = "1"
-loop()  # warm caches/JIT + profiler module state before timing
-os.environ["DAFT_PROFILE"] = "0"
+os.environ[var] = "1"
+loop()  # warm caches/JIT + plane module state before timing
+os.environ[var] = "0"
 loop()
 # ABBA blocks (phase alternates so a period-2 systematic — allocator
 # oscillation, cache state — can't masquerade as config cost) with a
@@ -390,7 +403,7 @@ for b in range(blocks):
     order = ("0", "1") if b % 2 == 0 else ("1", "0")
     ts = {}
     for m in order:
-        os.environ["DAFT_PROFILE"] = m
+        os.environ[var] = m
         gc.collect()
         t0 = time.perf_counter(); loop(); ts[m] = time.perf_counter() - t0
     on.append(ts["1"]); off.append(ts["0"])
@@ -398,14 +411,15 @@ print(json.dumps({"on_s": on, "off_s": off}))
 """
 
 
-def profile_overhead_check(n: int = 6_000_000, reps: int = 10,
-                           rounds: int = 3) -> dict:
-    # n matches TPC-H SF1 lineitem scale (6M rows): the profiler's residual
-    # cost is FIXED per query (a handful of spans + two CPU-clock reads),
-    # and the issue's budget is "<2% TPC-H overhead" — queries there run
-    # hundreds of ms to seconds, so the guard's loop must be query-sized,
-    # not microbenchmark-sized, or a ~1ms fixed cost reads as inflated
-    # per-row cost. ``reps`` counts ABBA pair-blocks per child.
+def _paired_overhead_check(env_var: str, metric: str, limit_pct: float,
+                           n: int, reps: int, rounds: int,
+                           drop_env: tuple = ()) -> dict:
+    # n matches TPC-H SF1 lineitem scale (6M rows): these planes' residual
+    # cost is FIXED per query (a handful of spans / one ring append), and
+    # the budget is "<2% TPC-H overhead" — queries there run hundreds of
+    # ms to seconds, so the guard's loop must be query-sized, not
+    # microbenchmark-sized, or a ~1ms fixed cost reads as inflated per-row
+    # cost. ``reps`` counts ABBA pair-blocks per child.
     # Estimator: each pair shares one instant of machine weather; the
     # MEDIAN of paired deltas (pooled across children) rejects both slow
     # outliers and drift, where min-vs-min re-introduces each config's
@@ -420,10 +434,12 @@ def profile_overhead_check(n: int = 6_000_000, reps: int = 10,
     def collect(num_rounds: int) -> None:
         for _ in range(num_rounds):
             env = dict(os.environ, JAX_PLATFORMS="cpu")
-            env.pop("DAFT_PROFILE", None)       # the child drives the toggle
-            env.pop("DAFT_PROFILE_FILE", None)  # measure collection, not IO
+            env.pop(env_var, None)  # the child drives the toggle
+            for k in drop_env:      # measure collection, not file IO
+                env.pop(k, None)
             proc = subprocess.run(
-                [sys.executable, "-c", _PROFILE_AB_CHILD, str(n), str(reps)],
+                [sys.executable, "-c", _PROFILE_AB_CHILD, str(n), str(reps),
+                 env_var],
                 capture_output=True, text=True, env=env, timeout=600,
                 cwd=os.path.dirname(os.path.abspath(__file__)))
             if proc.returncode != 0:
@@ -444,16 +460,33 @@ def profile_overhead_check(n: int = 6_000_000, reps: int = 10,
     collect(rounds)
     pct, off, delta = verdict()
     escalated = False
-    if pct >= PROFILE_OVERHEAD_LIMIT_PCT:
+    if pct >= limit_pct:
         escalated = True
         collect(rounds)
         pct, off, delta = verdict()
-    return {"metric": "profile_overhead_pct", "value": round(pct, 3),
-            "unit": "% vs DAFT_PROFILE=0", "pairs": len(deltas),
+    return {"metric": metric, "value": round(pct, 3),
+            "unit": f"% vs {env_var}=0", "pairs": len(deltas),
             "escalated": escalated,
             "enabled_s": round(off + delta, 4), "disabled_s": round(off, 4),
-            "limit_pct": PROFILE_OVERHEAD_LIMIT_PCT,
-            "ok": pct < PROFILE_OVERHEAD_LIMIT_PCT}
+            "limit_pct": limit_pct, "ok": pct < limit_pct}
+
+
+def profile_overhead_check(n: int = 6_000_000, reps: int = 10,
+                           rounds: int = 3) -> dict:
+    return _paired_overhead_check(
+        "DAFT_PROFILE", "profile_overhead_pct", PROFILE_OVERHEAD_LIMIT_PCT,
+        n, reps, rounds, drop_env=("DAFT_PROFILE_FILE",))
+
+
+def querylog_overhead_check(n: int = 6_000_000, reps: int = 10,
+                            rounds: int = 3) -> dict:
+    # Always-on recording must be invisible: same pairing, same budget,
+    # DAFT_QUERY_LOG dropped so the guard measures the ring + SLO feed,
+    # not an operator-configured sink's disk.
+    return _paired_overhead_check(
+        "DAFT_QUERY_RECORDER", "querylog_overhead_pct",
+        QUERYLOG_OVERHEAD_LIMIT_PCT, n, reps, rounds,
+        drop_env=("DAFT_QUERY_LOG",))
 
 
 def main() -> None:
@@ -472,6 +505,15 @@ def main() -> None:
         if not rec["ok"]:
             sys.stderr.write(
                 f"profiler overhead {rec['value']}% exceeds "
+                f"{rec['limit_pct']}% budget\n")
+            sys.exit(1)
+        return
+    if len(sys.argv) > 1 and sys.argv[1] == "--querylog-overhead":
+        rec = querylog_overhead_check()
+        print(json.dumps(rec))
+        if not rec["ok"]:
+            sys.stderr.write(
+                f"flight-recorder overhead {rec['value']}% exceeds "
                 f"{rec['limit_pct']}% budget\n")
             sys.exit(1)
         return

@@ -62,6 +62,7 @@ __all__ = [
     "read_csv",
     "read_json",
     "read_parquet",
+    "recent_queries",
     "set_execution_config",
     "set_planning_config",
     "sql",
@@ -144,6 +145,10 @@ def __getattr__(name: str):
         from daft_tpu.execution import admission
 
         return getattr(admission, name)
+    if name == "recent_queries":
+        from daft_tpu.querylog import recent_queries
+
+        return recent_queries
     raise AttributeError(f"module 'daft_tpu' has no attribute {name!r}")
 
 

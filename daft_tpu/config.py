@@ -179,6 +179,30 @@ class ExecutionConfig:
     # Chrome trace-event JSON there at query end.
     profile_enabled: bool = False
     profile_export_path: Optional[str] = None
+    # Query flight recorder (daft_tpu/querylog.py). Default ON — one
+    # structured record per query (every outcome) into a bounded ring
+    # (daft_tpu.recent_queries()); DAFT_QUERY_RECORDER=0 is the live kill
+    # switch (and the overhead guard's A/B lever). query_log_path
+    # (DAFT_QUERY_LOG) additionally appends schema-versioned JSONL with a
+    # size-capped rotation (DAFT_QUERY_LOG_MAX_BYTES).
+    query_recorder_enabled: bool = True
+    query_log_path: Optional[str] = None
+    # SLO plane (daft_tpu/slo.py). Per-tenant objectives — overridable per
+    # tenant via the admission policy JSON (slo_latency_p99_s /
+    # slo_error_rate keys) — and the multiwindow burn-rate alerting knobs:
+    # an alert fires when the bad-query fraction burns the error budget
+    # faster than slo_fast_burn x over slo_fast_window_s AND slo_slow_burn
+    # x over slo_slow_window_s. slo_autoprofile_count is the tail sampler's
+    # capture budget per armed plan fingerprint; slo_slow_query_s (> 0) is
+    # a global slow-query arming threshold below the tenant objective.
+    slo_latency_p99_s: float = 30.0
+    slo_error_rate: float = 0.05
+    slo_fast_window_s: float = 60.0
+    slo_slow_window_s: float = 300.0
+    slo_fast_burn: float = 14.0
+    slo_slow_burn: float = 6.0
+    slo_autoprofile_count: int = 3
+    slo_slow_query_s: float = 0.0
 
     def with_changes(self, **kwargs) -> "ExecutionConfig":
         return dataclasses.replace(self, **kwargs)
@@ -228,4 +252,17 @@ class ExecutionConfig:
             changes["profile_enabled"] = True
         if os.environ.get("DAFT_PROFILE_FILE"):
             changes["profile_export_path"] = os.environ["DAFT_PROFILE_FILE"]
+        if not daft_env_flag("DAFT_QUERY_RECORDER", True):
+            changes["query_recorder_enabled"] = False
+        if os.environ.get("DAFT_QUERY_LOG"):
+            changes["query_log_path"] = os.environ["DAFT_QUERY_LOG"]
+        if os.environ.get("DAFT_SLO_LATENCY_P99_S"):
+            changes["slo_latency_p99_s"] = float(
+                os.environ["DAFT_SLO_LATENCY_P99_S"])
+        if os.environ.get("DAFT_SLO_ERROR_RATE"):
+            changes["slo_error_rate"] = float(
+                os.environ["DAFT_SLO_ERROR_RATE"])
+        if os.environ.get("DAFT_SLO_AUTOPROFILE"):
+            changes["slo_autoprofile_count"] = int(
+                os.environ["DAFT_SLO_AUTOPROFILE"])
         return cfg.with_changes(**changes) if changes else cfg

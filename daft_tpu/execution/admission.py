@@ -107,6 +107,10 @@ class TenantPolicy:
     MemoryManager limit; it only gates when ``DAFT_MEMORY_LIMIT`` is set.
     ``priority``: negative = shed first under overload, 0 = default,
     positive = survives the whole ladder.
+    ``slo_latency_p99_s``/``slo_error_rate`` (0 = use the config defaults)
+    override the tenant's SLO objectives — the burn-rate tracker and the
+    tail-based auto-profiler (daft_tpu/slo.py) read them from here so
+    per-tenant SLOs ride the same policy JSON as quotas.
     """
 
     tenant: str = DEFAULT_TENANT
@@ -114,11 +118,14 @@ class TenantPolicy:
     max_memory_fraction: float = 1.0
     queue_depth: int = 0
     priority: int = 0
+    slo_latency_p99_s: float = 0.0
+    slo_error_rate: float = 0.0
 
     @staticmethod
     def from_dict(tenant: str, d: dict) -> "TenantPolicy":
         known = {"max_concurrent_queries", "max_memory_fraction",
-                 "queue_depth", "priority"}
+                 "queue_depth", "priority", "slo_latency_p99_s",
+                 "slo_error_rate"}
         bad = set(d) - known
         if bad:
             raise DaftValueError(
@@ -269,6 +276,13 @@ class AdmissionController:
             return ov
         cfgd = getattr(self, "_config_policies", None) or {}
         return cfgd.get(tenant, TenantPolicy(tenant=tenant))
+
+    def policy_for(self, tenant: str) -> TenantPolicy:
+        """The tenant's resolved policy (overrides > config JSON > default)
+        — the SLO plane's objective-lookup surface."""
+        with self._cond:
+            st = self._tenants.get(tenant)
+            return st.policy if st is not None else self._policy_for(tenant)
 
     def _state(self, tenant: str) -> _TenantState:
         st = self._tenants.get(tenant)
@@ -765,12 +779,14 @@ def get_controller() -> AdmissionController:
 
 def set_tenant_policy(tenant: str, *, max_concurrent_queries: int = 0,
                       max_memory_fraction: float = 1.0, queue_depth: int = 0,
-                      priority: int = 0) -> None:
+                      priority: int = 0, slo_latency_p99_s: float = 0.0,
+                      slo_error_rate: float = 0.0) -> None:
     """Convenience: install a per-tenant policy on the process controller."""
     get_controller().set_policy(TenantPolicy(
         tenant=tenant, max_concurrent_queries=max_concurrent_queries,
         max_memory_fraction=max_memory_fraction, queue_depth=queue_depth,
-        priority=priority))
+        priority=priority, slo_latency_p99_s=slo_latency_p99_s,
+        slo_error_rate=slo_error_rate))
 
 
 _tenant_var: contextvars.ContextVar[Optional[str]] = \

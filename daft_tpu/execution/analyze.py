@@ -79,6 +79,22 @@ def analyze_suffix(df) -> str:
     if waits:
         lines.append(f"memory permits: waits={waits}, "
                      f"wait_s={h1['sum'] - h0['sum']:.4f}")
+    if prof is not None:
+        # Flight-recorder line (daft_tpu/querylog.py): the SAME record the
+        # always-on query log kept for this run — tenant, admission wait,
+        # shed level, outcome — surfaced next to the profiler table so the
+        # two planes cannot silently disagree about what happened.
+        from daft_tpu.querylog import get_recorder
+
+        rec = get_recorder().record_for(prof.query_id)
+        if rec is not None:
+            lines.append(
+                f"flight record: tenant={rec['tenant']} "
+                f"outcome={rec['outcome']} "
+                f"admission_wait={rec['admission_wait_s']:.3f}s "
+                f"shed_level={rec['shed_level']} "
+                f"fingerprint={rec['plan_fingerprint']}"
+                + (" [autoprofiled]" if rec.get("autoprofiled") else ""))
     table = prof.operator_table() if prof is not None else []
     if table:
         lines.append("operators (by self time):")

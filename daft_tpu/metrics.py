@@ -849,6 +849,41 @@ ADMISSION_SHED_LEVEL = _r.gauge(
     "Overload ladder level: 0 normal, 1 shed low-priority/over-quota, "
     "2 + halved stage parallelism, 3 + reject default-priority tenants")
 
+# Query flight recorder (daft_tpu/querylog.py)
+QUERYLOG_RECORDS = _r.counter(
+    "daft_querylog_records_total",
+    "Flight-recorder records written, by outcome "
+    "(success/timeout/cancelled/shed/failed)", ("outcome",))
+QUERYLOG_DROPPED = _r.counter(
+    "daft_querylog_dropped_total",
+    "Flight records lost to recorder/sink failures (should stay 0)")
+
+# SLO plane (daft_tpu/slo.py). Tenant labels are caller-supplied, so every
+# tenant-labeled series is cardinality-capped (oldest-out) — the admission
+# plane's discipline.
+_MAX_TENANT_SERIES = 256
+SLO_BURN_RATE = _r.gauge(
+    "daft_slo_burn_rate",
+    "Error-budget burn rate per tenant and window (1.0 = burning exactly "
+    "at budget)", ("tenant", "window"),
+    # Two series per tenant (fast + slow): the cap doubles so this gauge
+    # holds exactly as many tenants as the one-series-per-tenant ones.
+    max_series=2 * _MAX_TENANT_SERIES)
+SLO_LATENCY_P99 = _r.gauge(
+    "daft_slo_latency_p99_seconds",
+    "Rolling p99 completion latency per tenant (slow SLO window)",
+    ("tenant",), max_series=_MAX_TENANT_SERIES)
+SLO_ERROR_RATE = _r.gauge(
+    "daft_slo_error_rate",
+    "Rolling bad-query fraction per tenant (slow SLO window)",
+    ("tenant",), max_series=_MAX_TENANT_SERIES)
+SLO_ALERTS = _r.counter(
+    "daft_slo_alerts_total", "Burn-rate alert episodes per tenant",
+    ("tenant",), max_series=_MAX_TENANT_SERIES)
+AUTOPROFILE_CAPTURES = _r.counter(
+    "daft_slo_autoprofile_captures_total",
+    "Queries auto-profiled by the tail sampler (armed plan fingerprints)")
+
 # AI providers (ai/metrics.py shims onto these)
 AI_TOKENS = _r.counter(
     "daft_ai_tokens_total", "Provider tokens consumed",

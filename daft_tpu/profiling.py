@@ -1168,6 +1168,26 @@ def begin_query(query_id: str, cfg=None) -> Optional[QueryProfile]:
     return prof
 
 
+def force_begin_query(query_id: str,
+                      export_path: Optional[str] = None
+                      ) -> Optional[QueryProfile]:
+    """Open a QueryProfile UNCONDITIONALLY for an already-started query —
+    the tail-based auto-profiling entry point (daft_tpu/slo.py): the SLO
+    plane decides post-planning that this query's plan fingerprint deserves
+    a trace, after begin_query already said no. Idempotent per query id
+    (returns the existing profile if one is open); the runner's normal
+    end_query finalizes it like any other profile."""
+    with _profiles_lock:
+        existing = _PROFILES.get(query_id)
+        if existing is not None:
+            return existing
+    prof = QueryProfile(query_id, export_path=export_path)
+    _ensure_subscriber()
+    with _profiles_lock:
+        _PROFILES.setdefault(query_id, prof)
+        return _PROFILES[query_id]
+
+
 def end_query(query_id: str, error: Optional[str] = None) -> Optional[QueryProfile]:
     """Finalize + export the query's profile (root span closed, Chrome
     trace written when a path was configured)."""

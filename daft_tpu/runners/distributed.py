@@ -109,6 +109,7 @@ class DistributedRunner(Runner):
         # trace_scope below, so every Task created by the planner captures
         # it (Task.trace_ctx default_factory) and ships it to its worker.
         prof = profiling.begin_query(query_id, cfg)
+        from daft_tpu import querylog
         from daft_tpu.cancellation import (
             cancel_scope,
             register_query_token,
@@ -117,28 +118,41 @@ class DistributedRunner(Runner):
         from daft_tpu.runners.runner import enter_front_door
 
         # One token per query, created on the driver by the shared
-        # prologue (explicit timeout > config default > unbounded), then
-        # the admission front door BEFORE planning/dispatch. A shed-ladder
-        # thread cap lands on cfg, which ships with every Task, so worker-
-        # side executors inherit it (see runner.py).
-        token, ticket, cfg = enter_front_door(query_id, cfg, timeout)
+        # prologue (flight-recorder entry + explicit timeout > config
+        # default > unbounded), then the admission front door BEFORE
+        # planning/dispatch. A shed-ladder thread cap lands on cfg, which
+        # ships with every Task, so worker-side executors inherit it (see
+        # runner.py).
+        token, ticket, cfg, fentry = enter_front_door(query_id, cfg, timeout,
+                                                      runner=self.name)
         try:
             with contextlib.ExitStack() as plan_st:
                 if prof is not None:
                     plan_st.enter_context(prof.driver_span("daft.plan"))
                 optimized = builder.optimize(cfg)
                 physical = translate(optimized.plan, cfg)
+            plan_repr = repr(optimized.plan)
+            if fentry is not None:
+                # First moment the plan fingerprint exists: the tail
+                # sampler may recognize an armed slow shape and open a
+                # full profile for this run (daft_tpu/slo.py).
+                fentry.observe_plan(plan_repr)
+                if prof is None:
+                    prof = querylog.maybe_autoprofile(query_id, fentry)
+                fentry.profiled = prof is not None
         except BaseException as e:  # noqa: BLE001
             # The execution try/finally below hasn't started: close the
             # profile HERE or a planning failure leaks it in the process-
             # global registry forever (and collect_profile gets no trace) —
-            # and release the admission slot the same way.
+            # and release the admission slot + flight record the same way.
             ticket.release()
             profiling.end_query(query_id, error=str(e))
+            querylog.finish_entry(fentry, error=e)
             raise
-        ctx.notify(QueryStart(query_id=query_id, plan=repr(optimized.plan)))
+        ctx.notify(QueryStart(query_id=query_id, plan=plan_repr))
         start = time.perf_counter()
         error = None
+        error_obj = None
         from daft_tpu.execution.resource_manager import (
             RuntimeStats,
             register_query_stats,
@@ -175,17 +189,22 @@ class DistributedRunner(Runner):
                 # Still deadline-bounded: fetch/recovery checks the token.
                 mp = executor.fetch_output(ref)
                 if len(mp):
+                    if fentry is not None:
+                        fentry.count(mp)
                     yield mp
         except BaseException as e:  # noqa: BLE001
             error = str(e)
+            error_obj = e
             raise
         finally:
             # Exception-safe on EVERY exit: success, timeout, cancel,
             # worker loss mid-query, chaos, and generator close all pass
-            # here — admission slots/reservations can never leak.
+            # here — admission slots/reservations can never leak, and the
+            # query's ONE flight record lands whatever the outcome.
             ticket.release()
             unregister_query_token(query_id)
             unregister_query_stats(query_id)
             ctx.notify(QueryEnd(query_id=query_id,
                                 duration_s=time.perf_counter() - start, error=error))
-            profiling.end_query(query_id, error=error)
+            prof_fin = profiling.end_query(query_id, error=error)
+            querylog.finish_entry(fentry, error=error_obj, profile=prof_fin)

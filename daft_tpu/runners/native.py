@@ -33,6 +33,7 @@ class NativeRunner(Runner):
         # QueryProfile per query; the driver-local TaskProfiler feeds it
         # directly, and the Chrome trace writes at end_query.
         prof = profiling.begin_query(query_id, cfg)
+        from daft_tpu import querylog
         from daft_tpu.cancellation import (
             iter_with_cancel_scope,
             register_query_token,
@@ -40,26 +41,39 @@ class NativeRunner(Runner):
         )
         from daft_tpu.runners.runner import enter_front_door
 
-        # Admission front door BEFORE planning (shared prologue: cancel
-        # token + admit + shed-ladder thread cap; see runner.py).
-        token, ticket, cfg = enter_front_door(query_id, cfg, timeout)
+        # Admission front door BEFORE planning (shared prologue: flight-
+        # recorder entry + cancel token + admit + shed-ladder thread cap;
+        # see runner.py).
+        token, ticket, cfg, fentry = enter_front_door(query_id, cfg, timeout,
+                                                      runner=self.name)
         try:
             with contextlib.ExitStack() as plan_st:
                 if prof is not None:
                     plan_st.enter_context(prof.driver_span("daft.plan"))
                 optimized = builder.optimize(cfg)
                 physical = translate(optimized.plan, cfg)
+            plan_repr = repr(optimized.plan)
+            if fentry is not None:
+                # The fingerprint exists only now — which is also the first
+                # moment the tail sampler can recognize a plan shape it
+                # armed after a slow run and open a full profile for it.
+                fentry.observe_plan(plan_repr)
+                if prof is None:
+                    prof = querylog.maybe_autoprofile(query_id, fentry)
+                fentry.profiled = prof is not None
         except BaseException as e:  # noqa: BLE001
             # The execution try/finally below hasn't started: close the
             # profile HERE or a planning failure leaks it in the process-
             # global registry forever (and collect_profile gets no trace) —
-            # and release the admission slot the same way.
+            # and release the admission slot + flight record the same way.
             ticket.release()
             profiling.end_query(query_id, error=str(e))
+            querylog.finish_entry(fentry, error=e)
             raise
-        ctx.notify(QueryStart(query_id=query_id, plan=repr(optimized.plan)))
+        ctx.notify(QueryStart(query_id=query_id, plan=plan_repr))
         start = time.perf_counter()
         error = None
+        error_obj = None
         register_query_token(query_id, token)
         try:
             from daft_tpu.execution.resource_manager import RuntimeStats
@@ -80,20 +94,30 @@ class NativeRunner(Runner):
             # contextvar out of it).
             with profiling.profiled_task_scope(tprof, name="daft.execute",
                                                ambient=False):
-                yield from profiling.iter_with_profiler_scope(
+                stream = profiling.iter_with_profiler_scope(
                     iter_with_cancel_scope(
                         iter_with_frozen_clock(executor.run(physical)),
                         token),
                     tprof)
+                if fentry is None:
+                    yield from stream
+                else:
+                    for mp in stream:
+                        fentry.count(mp)
+                        yield mp
         except BaseException as e:  # noqa: BLE001
             error = str(e)
+            error_obj = e
             raise
         finally:
             # Exception-safe on EVERY exit: success, timeout, cancel,
             # worker loss, chaos, and generator close all pass here —
-            # admission slots/reservations can never leak.
+            # admission slots/reservations can never leak, and the query's
+            # ONE flight record lands whatever the outcome (the finished
+            # profile rides along so the record carries its op digest).
             ticket.release()
             unregister_query_token(query_id)
             ctx.notify(QueryEnd(query_id=query_id,
                                 duration_s=time.perf_counter() - start, error=error))
-            profiling.end_query(query_id, error=error)
+            prof_fin = profiling.end_query(query_id, error=error)
+            querylog.finish_entry(fentry, error=error_obj, profile=prof_fin)

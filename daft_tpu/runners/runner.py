@@ -21,17 +21,23 @@ class PartitionCacheEntry:
         return sum(p.size_bytes() for p in self.partitions)
 
 
-def enter_front_door(query_id: str, cfg, timeout: "float | None"):
-    """The shared query prologue for BOTH runners: create the one cancel
-    token (explicit timeout > config default > unbounded) and pass the
-    admission gate BEFORE any planning work. Returns ``(token, ticket,
-    cfg)`` where cfg may carry a shed-ladder compute-thread cap (safe: the
+def enter_front_door(query_id: str, cfg, timeout: "float | None",
+                     runner: str = "native"):
+    """The shared query prologue for BOTH runners: open the flight-recorder
+    entry (daft_tpu/querylog.py — EVERY query gets exactly one record,
+    including the ones rejected right here), create the one cancel token
+    (explicit timeout > config default > unbounded), and pass the admission
+    gate BEFORE any planning work. Returns ``(token, ticket, cfg, entry)``
+    where cfg may carry a shed-ladder compute-thread cap (safe: the
     pipelined executor's determinism contract makes results thread-count
-    invariant). On admission failure the query's profile — opened by the
-    caller before this — is closed here so it can't leak in the process-
-    global registry. The caller OWNS ticket.release() on every later exit
+    invariant) and entry is the query's FlightEntry (None when recording is
+    disabled). On admission failure the query's record lands with
+    ``outcome=shed`` (or timeout/cancelled — whatever the queue wait raised)
+    and the profile — opened by the caller before this — is closed so it
+    can't leak in the process-global registry. The caller OWNS both
+    ticket.release() and querylog.finish_entry(entry) on every later exit
     path (its run_iter finally)."""
-    from daft_tpu import profiling
+    from daft_tpu import profiling, querylog
     from daft_tpu.cancellation import CancelToken, Deadline
     from daft_tpu.execution.admission import get_controller
 
@@ -40,19 +46,32 @@ def enter_front_door(query_id: str, cfg, timeout: "float | None"):
     token = CancelToken(
         Deadline.after(timeout) if timeout is not None else None,
         query_id=query_id)
+    entry = querylog.get_recorder().begin(query_id, cfg, runner=runner)
+    import time as _time
+
+    admit_t0 = _time.monotonic()
     try:
         # May block in the tenant's bounded queue (deadline/cancel-aware)
         # or raise DaftAdmissionError / DaftCancelledError /
         # DaftTimeoutError — a shed query costs one lock acquisition,
         # never an optimizer pass or a worker round-trip.
         ticket = get_controller().admit(query_id, token=token, cfg=cfg)
-    except BaseException as e:  # noqa: BLE001 — profile must not leak
+    except BaseException as e:  # noqa: BLE001 — profile/record must not leak
+        if entry is not None:
+            # The failed admission IS the story for this record: a query
+            # that waited 5s in the queue before its deadline fired must
+            # not read admission_wait_s=0 in the log.
+            entry.note_admission(_time.monotonic() - admit_t0,
+                                 get_controller().shed_level())
+        querylog.finish_entry(entry, error=e)
         profiling.end_query(query_id, error=str(e))
         raise
+    if entry is not None:
+        entry.note_admission(ticket.wait_s, get_controller().shed_level())
     if ticket.compute_threads_cap:
         cfg = cfg.with_changes(
             num_compute_threads=ticket.compute_threads_cap)
-    return token, ticket, cfg
+    return token, ticket, cfg, entry
 
 
 class Runner:

@@ -18,6 +18,12 @@ document.querySelectorAll("nav button").forEach((b) =>
 
 async function getJSON(url) { return (await fetch(url)).json(); }
 
+// Query-log filters re-render immediately instead of waiting for a tick.
+["ql-tenant", "ql-outcome"].forEach((id) => {
+  const el = document.getElementById(id);
+  if (el) el.addEventListener("change", () => renderQueryLog());
+});
+
 function fmtBytes(n) {
   if (n == null) return "0";
   const u = ["B", "KB", "MB", "GB", "TB"];
@@ -88,6 +94,52 @@ async function renderTimeline() {
         style="left:${(100 * s.start_ms / total).toFixed(2)}%;width:${Math.max(100 * s.dur_ms / total, 0.25).toFixed(2)}%"
         title="${esc(s.name)} ${s.dur_ms.toFixed(1)}ms${s.rows != null ? " · " + s.rows + " rows" : ""}"></span></span></div>`
   ).join("");
+}
+
+async function renderQueryLog() {
+  // Flight-recorder history (bounded ring): every query, every outcome.
+  const tenant = $("#ql-tenant").value.trim();
+  const outcome = $("#ql-outcome").value;
+  let url = "/api/querylog?n=100";
+  if (tenant) url += "&tenant=" + encodeURIComponent(tenant);
+  if (outcome) url += "&outcome=" + encodeURIComponent(outcome);
+  const d = await getJSON(url);
+  const st = d.stats.by_outcome || {};
+  $("#ql-stats").textContent =
+    `${d.stats.total} recorded · ` + Object.entries(st)
+      .filter(([, n]) => n).map(([o, n]) => `${o}:${n}`).join(" ");
+  $("#querylog tbody").innerHTML = d.records.map((r) => {
+    const top = (r.operators && r.operators[0])
+      ? `${r.operators[0].op} ${r.operators[0].self_ms.toFixed(1)}ms` : "";
+    return `<tr><td>${esc(r.query_id)}</td><td>${esc(r.tenant)}</td>
+      <td class="${r.outcome === "success" ? "ok" : "err"}">${esc(r.outcome)}</td>
+      <td>${r.duration_s.toFixed(3)}</td>
+      <td>${r.admission_wait_s.toFixed(3)}</td><td>${r.shed_level}</td>
+      <td>${esc(r.plan_fingerprint)}</td><td>${r.rows_out}</td>
+      <td>${esc(top)}</td>
+      <td>${r.autoprofiled ? "auto" : r.profiled ? "yes" : ""}</td></tr>`;
+  }).join("") || '<tr><td colspan="10" class="hint">no queries yet</td></tr>';
+}
+
+async function renderSLO() {
+  const d = await getJSON("/api/slo");
+  $("#slo tbody").innerHTML = d.tenants.map((t) =>
+    `<tr><td>${esc(t.tenant)}</td><td>${t.queries}</td>
+      <td>${t.latency_p50_s.toFixed(3)}</td>
+      <td>${t.latency_p95_s.toFixed(3)}</td>
+      <td>${t.latency_p99_s.toFixed(3)}</td>
+      <td>${t.objective_latency_p99_s}</td>
+      <td>${(100 * t.error_rate).toFixed(1)}%</td>
+      <td>${(100 * t.shed_rate).toFixed(1)}%</td>
+      <td class="${t.fast_burn_rate >= 1 ? "err" : "ok"}">${t.fast_burn_rate.toFixed(1)}x</td>
+      <td class="${t.slow_burn_rate >= 1 ? "err" : "ok"}">${t.slow_burn_rate.toFixed(1)}x</td>
+      <td class="${t.alerting ? "err" : "ok"}">${t.alerting ? "ALERTING" : "green"}</td>
+      <td>${t.alerts_fired}</td></tr>`
+  ).join("") || '<tr><td colspan="12" class="hint">no tenants yet</td></tr>';
+  const armed = Object.entries(d.autoprofile.armed || {});
+  $("#autoprofile tbody").innerHTML = armed.map(([fp, n]) =>
+    `<tr><td>${esc(fp)}</td><td>${n}</td></tr>`
+  ).join("") || '<tr><td colspan="2" class="hint">nothing armed</td></tr>';
 }
 
 async function renderAdmission() {
@@ -215,7 +267,8 @@ function wireCells(id) {
 async function tick() {
   try {
     await renderSummary();
-    if (view === "queries") await renderQueries();
+    if (view === "queries") { await renderQueries(); await renderQueryLog(); }
+    else if (view === "slo") await renderSLO();
     else if (view === "admission") await renderAdmission();
     else if (view === "workers") await renderWorkers();
     else if (view === "perf") await renderPerf();

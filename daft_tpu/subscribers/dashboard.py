@@ -152,9 +152,20 @@ def _escape(s: str) -> str:
     return html.escape(str(s), quote=True)
 
 class DashboardState:
+    #: Bounded per-query detail store: an always-on serving process sees
+    #: millions of queries, and the per-query dicts (operators, workers,
+    #: plan text) are the heavy part of dashboard state. Oldest FINISHED
+    #: queries evict beyond this; their contribution to the engine summary
+    #: survives in cumulative tallies, and the flight recorder's ring
+    #: (/api/querylog) remains the per-query history surface.
+    MAX_QUERIES = 512
+
     def __init__(self):
         self._lock = threading.Lock()
         self.queries: Dict[str, dict] = {}
+        # Cumulative tallies of evicted queries so engine_summary() stays
+        # a process-lifetime view while the detail store stays bounded.
+        self._evicted = {"queries": 0, "failed": 0, "tasks": 0, "rows": 0}
         # Cross-query engine state: worker liveness + breaker state
         # (reference: daft-dashboard engine.rs worker panel; ISSUE 5).
         self.workers_live: Dict[str, dict] = {}
@@ -211,6 +222,8 @@ class DashboardState:
                     "failures": 0, "open_for_s": 0.0, "since": time.time()}
                 return
             if isinstance(e, QueryStart):
+                if len(self.queries) >= self.MAX_QUERIES:
+                    self._evict_locked()
                 self.queries[e.query_id] = {
                     "query_id": e.query_id, "status": "running", "plan": e.plan,
                     "start": time.time(), "duration_s": None, "tasks": 0,
@@ -252,6 +265,25 @@ class DashboardState:
                     op["rows_in"] += e.rows_in
                     op["rows_out"] += e.rows_out
                     op["cpu_us"] += e.cpu_us
+
+    def _evict_locked(self) -> None:
+        """Drop oldest finished queries until under the bound, folding
+        their summary contribution into the cumulative tallies. Running
+        queries are never evicted (their views are live); a pathological
+        flood of still-running queries stays bounded by admission."""
+        for qid in list(self.queries):
+            if len(self.queries) < self.MAX_QUERIES:
+                break
+            q = self.queries[qid]
+            if q["status"] == "running":
+                continue
+            self._evicted["queries"] += 1
+            if q["status"] == "error":
+                self._evicted["failed"] += 1
+            self._evicted["tasks"] += q["tasks"]
+            self._evicted["rows"] += sum(
+                op["rows_out"] for op in q["operators"].values())
+            del self.queries[qid]
 
     def snapshot(self) -> List[dict]:
         with self._lock:
@@ -324,14 +356,18 @@ class DashboardState:
         with self._lock:
             running = [q for q in self.queries.values() if q["status"] == "running"]
             return {
-                "queries_total": len(self.queries),
+                "queries_total": len(self.queries)
+                + self._evicted["queries"],
                 "queries_running": len(running),
                 "queries_failed": sum(1 for q in self.queries.values()
-                                      if q["status"] == "error"),
-                "tasks_total": sum(q["tasks"] for q in self.queries.values()),
+                                      if q["status"] == "error")
+                + self._evicted["failed"],
+                "tasks_total": sum(q["tasks"] for q in self.queries.values())
+                + self._evicted["tasks"],
                 "rows_processed": sum(
                     op["rows_out"] for q in self.queries.values()
-                    for op in q["operators"].values()),
+                    for op in q["operators"].values())
+                + self._evicted["rows"],
                 "spill_bytes": sp["bytes_spilled"],
                 "spill_files": sp["files"],
                 "device_fused_exprs": dev["fused_exprs"],
@@ -403,6 +439,38 @@ class _Handler(BaseHTTPRequestHandler):
                 self.send_error(404)
                 return
             body = json.dumps(detail, default=str).encode()
+            ctype = "application/json"
+        elif path == "/api/querylog":
+            # Flight-recorder history (daft_tpu/querylog.py): the bounded
+            # ring of per-query records, filterable by tenant/outcome —
+            # the "which tenant's queries got slow, and why" view.
+            from daft_tpu.querylog import get_recorder
+
+            q = urllib.parse.parse_qs(parsed.query)
+            try:
+                n = int(q.get("n", ["200"])[0])
+            except ValueError:
+                self.send_error(400)
+                return
+            rec = get_recorder()
+            body = json.dumps({
+                "records": rec.recent(
+                    n=n, tenant=q.get("tenant", [None])[0],
+                    outcome=q.get("outcome", [None])[0]),
+                "stats": rec.stats(),
+            }).encode()
+            ctype = "application/json"
+        elif path == "/api/slo":
+            # Per-tenant SLO panel (daft_tpu/slo.py): rolling percentiles,
+            # burn-rate state, alert episodes, armed auto-profile
+            # fingerprints.
+            from daft_tpu import slo
+
+            tracker = slo.get_tracker()
+            body = json.dumps({
+                "tenants": tracker.snapshot(),
+                "autoprofile": tracker.autoprofile_state(),
+            }).encode()
             ctype = "application/json"
         elif path == "/api/perf/trajectory":
             # Per-query wall series over the committed bench trajectory

@@ -147,6 +147,22 @@ class QueryShed(Event):
 
 
 @dataclass
+class SLOBurnRateAlert(Event):
+    """A tenant is burning its SLO error budget faster than the alerting
+    thresholds in BOTH the fast and slow windows (daft_tpu/slo.py). Fired
+    once per episode; ``bad_fraction`` is the fast window's share of bad
+    queries (failed/timeout/shed/over-latency-objective)."""
+
+    tenant: str = ""
+    fast_burn_rate: float = 0.0
+    slow_burn_rate: float = 0.0
+    bad_fraction: float = 0.0
+    error_rate_objective: float = 0.0
+    latency_objective_s: float = 0.0
+    window_s: float = 0.0
+
+
+@dataclass
 class CircuitOpened(Event):
     """An IO endpoint's circuit breaker tripped open after consecutive
     transient failures; calls now fail fast until a probe succeeds."""

@@ -35,9 +35,11 @@ Exit code 0 = all assertions held.
 from __future__ import annotations
 
 import argparse
+import json
 import os
 import random
 import sys
+import tempfile
 import threading
 import time
 
@@ -164,6 +166,15 @@ def scrape_admission_wait(url: str):
         return float("inf")
 
     return (q(0.5), q(0.99), int(count)) if count else (0.0, 0.0, 0)
+
+
+def scrape_slo(url: str) -> dict:
+    """GET /api/slo — the per-tenant burn-rate panel, scraped the way an
+    operator's alerting would."""
+    import urllib.request
+
+    return json.loads(
+        urllib.request.urlopen(f"{url}/api/slo", timeout=5).read().decode())
 
 
 def scrape_queue_gauges(url: str):
@@ -413,8 +424,19 @@ def main() -> int:
     # threads per query (determinism contract: results are unaffected).
     daft_tpu.set_execution_config(num_compute_threads=2)
 
+    # Flight-recorder JSONL sink for the whole storm: the zero-leak audit
+    # at the end re-reads it and requires one schema-valid line per
+    # recorded query (ISSUE 12 — the ring/sink must not drop or leak).
+    query_log_path = os.path.join(
+        tempfile.mkdtemp(prefix="daft_storm_"), "querylog.jsonl")
+    os.environ["DAFT_QUERY_LOG"] = query_log_path
+
+    # Hostile gets a TIGHT SLO error budget on top of its tight quota: the
+    # front door shedding its flood must trip ITS burn-rate alert while
+    # the well-behaved tenants stay green.
     set_tenant_policy("hostile", max_concurrent_queries=1, queue_depth=1,
-                      priority=-1, max_memory_fraction=0.25)
+                      priority=-1, max_memory_fraction=0.25,
+                      slo_error_rate=0.02)
     set_tenant_policy("batch", max_concurrent_queries=16, queue_depth=24)
     set_tenant_policy("gold", max_concurrent_queries=8, queue_depth=16,
                       priority=1)
@@ -441,8 +463,18 @@ def main() -> int:
 
     stats = StormStats()
     thread_baseline = threading.active_count()
+    from daft_tpu.querylog import get_recorder, load_query_log
+
+    recorder = get_recorder()
+    rec_before = recorder.stats()["total"]
     peak = run_storm(mixes, args.queries, args.threads, stats,
                      seed=args.seed)
+    rec_after = recorder.stats()["total"]
+    # Snapshot the expected tally NOW: the chaos round below reuses the
+    # same StormStats, and its queries land after rec_after was read.
+    storm_expected = (sum(len(w) for w in stats.walls.values())
+                      + len(stats.rejections) + len(stats.errors)
+                      + len(stats.unclassified))
     if chaos:
         print("chaos round: worker kills + transient IO bursts...")
         chaos_round(stats, max(args.queries // 6, 12), seed=args.seed)
@@ -502,6 +534,47 @@ def main() -> int:
     leaked_threads = threading.active_count() - thread_baseline
     if leaked_threads > 4:  # daemon monitor + dashboard handler slack
         failures.append(f"{leaked_threads} threads leaked by the storm")
+    # 6. SLO plane (ISSUE 12): the hostile tenant's burn-rate alert fired
+    # during the storm; well-behaved tenants stayed green. Scraped from
+    # /api/slo exactly the way an operator's alerting would.
+    slo_panel = scrape_slo(dash.url)
+    by_tenant = {t["tenant"]: t for t in slo_panel["tenants"]}
+    hostile_slo = by_tenant.get("hostile", {})
+    print("slo: " + ", ".join(
+        f"{t['tenant']} fast={t['fast_burn_rate']:.1f}x "
+        f"alerts={t['alerts_fired']}" for t in slo_panel["tenants"]))
+    if hostile_slo.get("alerts_fired", 0) < 1:
+        failures.append(
+            f"hostile tenant never tripped a burn-rate alert: {hostile_slo}")
+    for tenant in ("batch", "gold"):
+        fired = by_tenant.get(tenant, {}).get("alerts_fired", 0)
+        if fired:
+            failures.append(
+                f"well-behaved tenant {tenant} tripped {fired} burn-rate "
+                f"alert(s) — the hostile flood leaked into its SLO")
+    # 7. Flight recorder ring/sink zero-leak audit: exactly one record per
+    # storm query (completions + rejections + classified errors), nothing
+    # dropped, ring within its bound, every sink line schema-valid.
+    storm_recorded = rec_after - rec_before
+    print(f"flight recorder: {storm_recorded} records for "
+          f"{storm_expected} storm queries")
+    if storm_recorded != storm_expected:
+        failures.append(
+            f"flight recorder leaked: {storm_recorded} records != "
+            f"{storm_expected} storm queries")
+    rstats = recorder.stats()
+    if rstats["ring"] > rstats["ring_size"]:
+        failures.append(f"flight-recorder ring over its bound: {rstats}")
+    from daft_tpu import metrics as _metrics
+
+    dropped = _metrics.QUERYLOG_DROPPED._default_child().value()
+    if dropped:
+        failures.append(f"flight recorder dropped {dropped} records")
+    sink_records = load_query_log(query_log_path)
+    if len(sink_records) != rstats["total"]:
+        failures.append(
+            f"query-log sink lost lines: {len(sink_records)} valid lines "
+            f"!= {rstats['total']} recorded")
     p50, p99w, n = scrape_admission_wait(dash.url)
     print(f"admission wait (scraped, n={n}): p50 <= {p50 * 1000:.0f}ms, "
           f"p99 <= {p99w if p99w == float('inf') else p99w * 1000:.0f}"

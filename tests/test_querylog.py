@@ -1,0 +1,612 @@
+"""Query flight recorder + per-tenant SLO plane (ISSUE 12).
+
+Covers the exactly-one-record-per-query contract across every outcome
+(success / timeout / cancelled / shed / failed — the chaos cases kill real
+workers), the schema-v1 JSONL sink (golden pin, torn-line resilience,
+size-capped rotation), burn-rate alerting, tail-based auto-profiling, the
+bounded event/dashboard stores, and the /api/querylog + /api/slo
+endpoints."""
+
+import json
+import threading
+import time
+
+import pytest
+
+import daft_tpu
+from daft_tpu import col
+from daft_tpu import querylog, slo
+from daft_tpu.context import execution_config_ctx
+from daft_tpu.errors import (
+    DaftAdmissionError,
+    DaftCancelledError,
+    DaftError,
+    DaftTimeoutError,
+)
+from daft_tpu.querylog import (
+    QUERYLOG_SCHEMA_VERSION,
+    RECORD_REQUIRED,
+    get_recorder,
+    load_query_log,
+    plan_fingerprint,
+    validate_record,
+)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_planes():
+    """Recorder + SLO tracker + admission policies reset per test: these
+    are process globals fed by EVERY collect in the suite."""
+    from daft_tpu.execution.admission import get_controller
+
+    get_recorder().reset()
+    slo.get_tracker().reset()
+    yield
+    get_recorder().reset()
+    slo.get_tracker().reset()
+    get_controller().reset()
+
+
+def _one_new_record(before: int) -> dict:
+    stats = get_recorder().stats()
+    assert stats["total"] == before + 1, stats
+    return get_recorder().recent(1)[0]
+
+
+# --------------------------------------------------------------------- #
+# Record contract: schema + one record per outcome                        #
+# --------------------------------------------------------------------- #
+def test_success_record_schema_golden(make_df):
+    make_df({"a": [1, 2, 3], "b": [1.0, 2.0, 3.0]}).where(
+        col("a") > 1).collect()
+    rec = _one_new_record(0)
+    # Schema v1 golden pin: these keys are the reader/writer contract —
+    # extending the record means new OPTIONAL keys or a version bump.
+    assert set(RECORD_REQUIRED) <= set(rec)
+    assert rec["schema_version"] == QUERYLOG_SCHEMA_VERSION
+    assert rec["outcome"] == "success" and rec["error_kind"] == ""
+    assert rec["tenant"] == "default" and rec["runner"] == "native"
+    assert rec["rows_out"] == 2 and rec["bytes_out"] > 0
+    assert len(rec["plan_fingerprint"]) == 16
+    int(rec["plan_fingerprint"], 16)  # hex
+    assert rec["duration_s"] >= 0 and rec["peak_rss_bytes"] > 0
+    assert validate_record(rec) == []
+
+
+def test_fingerprint_stable_across_repeats(make_df):
+    def build():
+        return make_df({"a": [1, 2, 3]}).where(col("a") > 1)
+
+    build().collect()
+    build().collect()
+    make_df({"z": [5]}).collect()  # a different shape
+    recs = get_recorder().recent()
+    assert recs[1]["plan_fingerprint"] == recs[2]["plan_fingerprint"]
+    assert recs[0]["plan_fingerprint"] != recs[1]["plan_fingerprint"]
+    assert plan_fingerprint("x") != plan_fingerprint("y")
+
+
+def test_timeout_outcome(make_df):
+    import daft_tpu.udf as udf_mod
+
+    @udf_mod.func(return_dtype=daft_tpu.DataType.int64())
+    def slow_fn(s):
+        time.sleep(0.4)
+        return s
+
+    df = make_df({"x": list(range(9))}).into_partitions(3) \
+        .select(slow_fn(col("x")))
+    with pytest.raises(DaftTimeoutError):
+        df.collect(timeout=0.3)
+    rec = _one_new_record(0)
+    assert rec["outcome"] == "timeout"
+    assert rec["error_kind"] == "DaftTimeoutError"
+    assert rec["plan_fingerprint"]  # planned before it died
+
+
+def test_failed_outcome(make_df):
+    import daft_tpu.udf as udf_mod
+
+    @udf_mod.func(return_dtype=daft_tpu.DataType.int64())
+    def boom(s):
+        raise RuntimeError("kaboom")
+
+    with pytest.raises(DaftError):
+        make_df({"x": [1, 2, 3]}).select(boom(col("x"))).collect()
+    rec = _one_new_record(0)
+    assert rec["outcome"] == "failed"
+    assert rec["error_kind"] and "kaboom" in rec["error"]
+
+
+def test_cancelled_outcome(make_df):
+    import daft_tpu.udf as udf_mod
+    from daft_tpu.subscribers.events import QueryStart
+
+    @udf_mod.func(return_dtype=daft_tpu.DataType.int64())
+    def slow_fn(s):
+        time.sleep(0.3)
+        return s
+
+    started = threading.Event()
+    qids = []
+
+    class Watcher:
+        def on_event(self, e):
+            if isinstance(e, QueryStart):
+                qids.append(e.query_id)
+                started.set()
+
+    ctx = daft_tpu.get_context()
+    w = Watcher()
+    ctx.attach_subscriber(w)
+
+    def cancel_soon():
+        started.wait(10.0)
+        time.sleep(0.1)
+        daft_tpu.cancel_query(qids[-1], reason="operator-abort")
+
+    try:
+        threading.Thread(target=cancel_soon, daemon=True).start()
+        df = make_df({"x": list(range(9))}).into_partitions(3) \
+            .select(slow_fn(col("x")))
+        with pytest.raises(DaftCancelledError):
+            df.collect()
+    finally:
+        ctx.detach_subscriber(w)
+    rec = _one_new_record(0)
+    assert rec["outcome"] == "cancelled"
+    assert rec["error_kind"] == "DaftCancelledError"
+
+
+def test_shed_outcome(make_df):
+    """A queue-full rejection — the query never planned — still lands one
+    record, with the admission taxonomy's error kind."""
+    from daft_tpu.execution.admission import get_controller, set_tenant
+
+    ctl = get_controller()
+    daft_tpu.set_tenant_policy("crowded", max_concurrent_queries=1,
+                               queue_depth=1)
+    cfg = daft_tpu.get_context().execution_config
+    held = ctl.admit("held-q", tenant="crowded", cfg=cfg)
+    queued_release = threading.Event()
+
+    def queued():
+        t = ctl.admit("queued-q", tenant="crowded", cfg=cfg)
+        queued_release.wait(10)
+        t.release()
+
+    blocker = threading.Thread(target=queued, daemon=True)
+    blocker.start()
+    deadline = time.monotonic() + 5
+    while ctl.snapshot().get("crowded", {}).get("queued", 0) < 1 \
+            and time.monotonic() < deadline:
+        time.sleep(0.005)
+    set_tenant("crowded")
+    try:
+        with pytest.raises(DaftAdmissionError):
+            make_df({"a": [1]}).collect()
+    finally:
+        set_tenant(None)
+        held.release()
+        queued_release.set()
+        blocker.join(timeout=10)
+    rec = _one_new_record(0)
+    assert rec["outcome"] == "shed"
+    assert rec["tenant"] == "crowded"
+    assert rec["error_kind"] == "DaftAdmissionError"
+    assert rec["plan_fingerprint"] == ""  # rejected before planning
+
+
+def test_recorder_kill_switch(make_df, monkeypatch):
+    monkeypatch.setenv("DAFT_QUERY_RECORDER", "0")
+    make_df({"a": [1]}).collect()
+    assert get_recorder().stats()["total"] == 0
+    monkeypatch.setenv("DAFT_QUERY_RECORDER", "1")
+    make_df({"a": [1]}).collect()
+    assert get_recorder().stats()["total"] == 1
+
+
+def test_ring_is_bounded(make_df):
+    rec = get_recorder()
+    for i in range(rec.ring_size + 40):
+        rec._publish({"schema_version": 1, "query_id": f"q{i}",
+                      "tenant": "default", "runner": "native",
+                      "ts": time.time(), "outcome": "success",
+                      "error_kind": "", "error": "", "duration_s": 0.001,
+                      "plan_fingerprint": "", "admission_wait_s": 0.0,
+                      "shed_level": 0, "rows_out": 0, "bytes_out": 0})
+    stats = rec.stats()
+    assert stats["ring"] == rec.ring_size
+    assert stats["total"] == rec.ring_size + 40  # totals keep counting
+    newest = rec.recent(1)[0]
+    assert newest["query_id"] == f"q{rec.ring_size + 39}"
+
+
+def test_recent_queries_filters(make_df):
+    make_df({"a": [1, 2]}).collect()
+    with pytest.raises(DaftError):
+        import daft_tpu.udf as udf_mod
+
+        @udf_mod.func(return_dtype=daft_tpu.DataType.int64())
+        def boom(s):
+            raise ValueError("no")
+
+        make_df({"x": [1]}).select(boom(col("x"))).collect()
+    assert len(daft_tpu.recent_queries()) == 2
+    assert [r["outcome"] for r in daft_tpu.recent_queries(
+        outcome="failed")] == ["failed"]
+    assert daft_tpu.recent_queries(tenant="nobody") == []
+
+
+# --------------------------------------------------------------------- #
+# JSONL sink: golden, torn lines, rotation                               #
+# --------------------------------------------------------------------- #
+def test_sink_writes_schema_valid_jsonl(make_df, tmp_path, monkeypatch):
+    path = str(tmp_path / "qlog.jsonl")
+    monkeypatch.setenv("DAFT_QUERY_LOG", path)
+    make_df({"a": [1, 2, 3]}).collect()
+    make_df({"a": [4]}).collect()
+    lines = [json.loads(l) for l in open(path) if l.strip()]
+    assert len(lines) == 2
+    for rec in lines:
+        assert validate_record(rec) == [], rec
+    assert load_query_log(path) == lines
+
+
+def test_sink_torn_line_resilience(tmp_path):
+    path = str(tmp_path / "qlog.jsonl")
+    good = {"schema_version": 1, "query_id": "q1", "tenant": "default",
+            "runner": "native", "ts": 1.0, "outcome": "success",
+            "error_kind": "", "error": "", "duration_s": 0.5,
+            "plan_fingerprint": "ab", "admission_wait_s": 0.0,
+            "shed_level": 0, "rows_out": 1, "bytes_out": 8}
+    with open(path, "w") as f:
+        f.write(json.dumps(good) + "\n")
+        f.write('{"schema_version": 1, "query_id": "torn')  # crash mid-write
+        f.write("\n")
+        f.write("not json at all\n")
+        f.write(json.dumps({"schema_version": 99, "query_id": "q2"}) + "\n")
+        f.write(json.dumps(dict(good, query_id="q3")) + "\n")
+    recs = load_query_log(path)
+    assert [r["query_id"] for r in recs] == ["q1", "q3"]
+
+
+def test_sink_rotation_size_cap(make_df, tmp_path, monkeypatch):
+    path = str(tmp_path / "qlog.jsonl")
+    monkeypatch.setenv("DAFT_QUERY_LOG", path)
+    monkeypatch.setenv("DAFT_QUERY_LOG_MAX_BYTES", "4096")
+    import os
+
+    for _ in range(20):
+        make_df({"a": [1, 2]}).collect()
+    assert os.path.exists(path + ".1")  # rotated at the cap
+    assert os.path.getsize(path) <= 4096
+    assert os.path.getsize(path + ".1") <= 4096 + 600  # one-line slop
+    # Rotated + live both load; every line schema-valid.
+    all_recs = load_query_log(path, include_rotated=True)
+    assert all_recs and all(validate_record(r) == [] for r in all_recs)
+
+
+# --------------------------------------------------------------------- #
+# SLO plane: burn-rate alerts + tail-based auto-profiling                #
+# --------------------------------------------------------------------- #
+def _fake_record(tenant: str, outcome: str = "success",
+                 duration_s: float = 0.001, fingerprint: str = "") -> dict:
+    return {"schema_version": 1, "query_id": "q", "tenant": tenant,
+            "runner": "native", "ts": time.time(), "outcome": outcome,
+            "error_kind": "", "error": "", "duration_s": duration_s,
+            "plan_fingerprint": fingerprint, "admission_wait_s": 0.0,
+            "shed_level": 0, "rows_out": 0, "bytes_out": 0}
+
+
+def test_burn_rate_alert_fires_and_is_episodic():
+    from daft_tpu.subscribers.events import SLOBurnRateAlert
+
+    events = []
+
+    class Tap:
+        def on_event(self, e):
+            if isinstance(e, SLOBurnRateAlert):
+                events.append(e)
+
+    ctx = daft_tpu.get_context()
+    tap = Tap()
+    ctx.attach_subscriber(tap)
+    tracker = slo.get_tracker()
+    cfg = ctx.execution_config
+    try:
+        # 30 bad queries for one tenant: bad fraction 1.0 over the default
+        # 0.05 budget = 20x burn, over both windows.
+        for i in range(30):
+            tracker.observe(_fake_record("victim", outcome="failed"), cfg)
+            if i == 15:
+                time.sleep(0.3)  # past the eval throttle -> re-evaluate
+        time.sleep(0.3)
+        tracker.observe(_fake_record("victim", outcome="failed"), cfg)
+    finally:
+        ctx.detach_subscriber(tap)
+    assert len(events) == 1, [e.tenant for e in events]  # once per episode
+    alert = events[0]
+    assert alert.tenant == "victim" and alert.fast_burn_rate >= 14.0
+    snap = {t["tenant"]: t for t in tracker.snapshot(cfg)}
+    assert snap["victim"]["alerting"] and snap["victim"]["alerts_fired"] == 1
+
+
+def test_healthy_tenant_stays_green():
+    tracker = slo.get_tracker()
+    cfg = daft_tpu.get_context().execution_config
+    for _ in range(30):
+        tracker.observe(_fake_record("calm"), cfg)
+    time.sleep(0.3)
+    tracker.observe(_fake_record("calm"), cfg)
+    snap = {t["tenant"]: t for t in tracker.snapshot(cfg)}
+    assert not snap["calm"]["alerting"]
+    assert snap["calm"]["alerts_fired"] == 0
+    assert snap["calm"]["fast_burn_rate"] == 0.0
+
+
+def test_cancelled_excluded_from_slo():
+    tracker = slo.get_tracker()
+    cfg = daft_tpu.get_context().execution_config
+    for _ in range(40):
+        tracker.observe(_fake_record("c", outcome="cancelled"), cfg)
+    time.sleep(0.3)
+    tracker.observe(_fake_record("c", outcome="cancelled"), cfg)
+    snap = {t["tenant"]: t for t in tracker.snapshot(cfg)}
+    assert snap["c"]["queries"] == 0  # client cancels don't move the SLO
+
+
+def test_slow_query_arms_fingerprint_and_consumes():
+    tracker = slo.get_tracker()
+    cfg = daft_tpu.get_context().execution_config.with_changes(
+        slo_latency_p99_s=0.01, slo_autoprofile_count=2)
+    tracker.observe(_fake_record("t", duration_s=5.0, fingerprint="f" * 16),
+                    cfg)
+    assert tracker.autoprofile_state()["armed"] == {"f" * 16: 2}
+    assert tracker.consume_autoprofile("f" * 16)
+    assert tracker.consume_autoprofile("f" * 16)
+    assert not tracker.consume_autoprofile("f" * 16)  # budget spent
+    assert not tracker.consume_autoprofile("unseen")
+
+
+def test_tail_autoprofile_end_to_end(make_df):
+    """A query over its tenant's latency objective arms its plan
+    fingerprint; the NEXT matching query is captured as a full profile
+    visible to the dashboard's timeline endpoint."""
+    from daft_tpu import profiling
+    import daft_tpu.udf as udf_mod
+
+    @udf_mod.func(return_dtype=daft_tpu.DataType.int64())
+    def slowish(s):
+        time.sleep(0.05)
+        return s
+
+    def build():
+        return make_df({"x": [1, 2, 3]}).select(slowish(col("x")))
+
+    with execution_config_ctx(slo_latency_p99_s=0.001,
+                              slo_autoprofile_count=1):
+        build().collect()  # slow -> arms the fingerprint
+        first = get_recorder().recent(1)[0]
+        assert not first["autoprofiled"]
+        assert slo.get_tracker().autoprofile_state()["armed"]
+        build().collect()  # same shape -> auto-profiled
+        second = get_recorder().recent(1)[0]
+    assert second["plan_fingerprint"] == first["plan_fingerprint"]
+    assert second["autoprofiled"] and second["profiled"]
+    # The profile digest names operators, and the profile itself is
+    # retrievable (the dashboard timeline's backing store).
+    assert second["operators"], second
+    prof = profiling.profile_for(second["query_id"])
+    assert prof is not None and prof.finished
+    assert prof.root.attributes.get("autoprofile") is True
+    assert profiling.timeline_json(second["query_id"]) is not None
+    # Budget of 1 is spent: a third run is NOT profiled.
+    with execution_config_ctx(slo_latency_p99_s=10.0):
+        build().collect()
+    assert not get_recorder().recent(1)[0]["autoprofiled"]
+
+
+def test_slo_objectives_from_admission_policy():
+    daft_tpu.set_tenant_policy("gold", slo_latency_p99_s=0.25,
+                               slo_error_rate=0.01)
+    from daft_tpu.slo import _objectives_for
+
+    cfg = daft_tpu.get_context().execution_config
+    assert _objectives_for("gold", cfg) == (0.25, 0.01)
+    assert _objectives_for("unknown", cfg) == (
+        cfg.slo_latency_p99_s, cfg.slo_error_rate)
+
+
+def test_admission_policy_json_accepts_slo_keys():
+    from daft_tpu.execution.admission import AdmissionController
+
+    ctl = AdmissionController()
+    cfg = daft_tpu.get_context().execution_config.with_changes(
+        admission_policies='{"t": {"queue_depth": 4, '
+                           '"slo_latency_p99_s": 0.5, '
+                           '"slo_error_rate": 0.02}}')
+    ctl._sync_policies(cfg)
+    pol = ctl.policy_for("t")
+    assert pol.slo_latency_p99_s == 0.5 and pol.slo_error_rate == 0.02
+
+
+# --------------------------------------------------------------------- #
+# EXPLAIN ANALYZE consistency                                            #
+# --------------------------------------------------------------------- #
+def test_explain_analyze_surfaces_flight_record(make_df, capsys):
+    df = make_df({"a": [1, 2, 3, 4]}).where(col("a") > 1)
+    df.explain(analyze=True)
+    out = capsys.readouterr().out
+    assert "flight record:" in out
+    rec = get_recorder().recent(1)[0]
+    # The analyze text and the query log must agree — same record.
+    assert f"tenant={rec['tenant']}" in out
+    assert f"outcome={rec['outcome']}" in out
+    assert f"fingerprint={rec['plan_fingerprint']}" in out
+    assert rec["outcome"] == "success"
+
+
+# --------------------------------------------------------------------- #
+# Bounded stores: event log + dashboard                                  #
+# --------------------------------------------------------------------- #
+def test_event_log_ring_and_rotation(tmp_path):
+    from daft_tpu.subscribers.event_log import EventLogSubscriber
+    from daft_tpu.subscribers.events import QueryStart
+
+    path = str(tmp_path / "events.jsonl")
+    sub = EventLogSubscriber(path, max_bytes=4096, max_events=50)
+    try:
+        for i in range(300):
+            sub.on_event(QueryStart(query_id=f"q{i}", plan="p"))
+        recent = sub.recent()
+        assert len(recent) == 50  # ring bounded
+        assert recent[0]["query_id"] == "q299"  # newest first
+        assert sub.recent(5, event="QueryStart")[0]["query_id"] == "q299"
+        import os
+
+        assert os.path.getsize(path) <= 4096 + 200
+        assert os.path.exists(path + ".1")
+    finally:
+        sub.close()
+
+
+def test_dashboard_query_store_bounded():
+    from daft_tpu.subscribers.dashboard import DashboardState
+    from daft_tpu.subscribers.events import QueryEnd, QueryStart
+
+    st = DashboardState()
+    n = DashboardState.MAX_QUERIES + 100
+    for i in range(n):
+        st.on_event(QueryStart(query_id=f"q{i}", plan="p"))
+        st.on_event(QueryEnd(query_id=f"q{i}", duration_s=0.01,
+                             error="x" if i % 7 == 0 else None))
+    assert len(st.queries) <= DashboardState.MAX_QUERIES
+    summary = st.engine_summary()
+    # Evicted queries still count in the cumulative summary.
+    assert summary["queries_total"] == n
+    assert summary["queries_failed"] == sum(1 for i in range(n) if i % 7 == 0)
+    # The newest queries survive in the detail store.
+    assert st.query_detail(f"q{n - 1}") is not None
+
+
+# --------------------------------------------------------------------- #
+# Dashboard endpoints                                                    #
+# --------------------------------------------------------------------- #
+def test_dashboard_querylog_and_slo_endpoints(make_df):
+    import urllib.request
+
+    from daft_tpu.subscribers.dashboard import DashboardServer
+
+    server = DashboardServer().start()
+    ctx = daft_tpu.get_context()
+    sub = server.subscriber()
+    ctx.attach_subscriber(sub)
+    try:
+        make_df({"a": [1, 2, 3]}).where(col("a") > 1).collect()
+        ql = json.load(urllib.request.urlopen(
+            f"{server.url}/api/querylog?n=10"))
+        assert ql["records"] and ql["records"][0]["outcome"] == "success"
+        assert ql["stats"]["total"] >= 1
+        empty = json.load(urllib.request.urlopen(
+            f"{server.url}/api/querylog?outcome=failed&n=10"))
+        assert empty["records"] == []
+        panel = json.load(urllib.request.urlopen(f"{server.url}/api/slo"))
+        tenants = {t["tenant"] for t in panel["tenants"]}
+        assert "default" in tenants
+        assert "armed" in panel["autoprofile"]
+        # The web app renders both (static asset sanity).
+        js = urllib.request.urlopen(
+            f"{server.url}/assets/app.js").read().decode()
+        assert "/api/querylog" in js and "/api/slo" in js
+        html = urllib.request.urlopen(server.url).read().decode()
+        assert "querylog" in html and "view-slo" in html
+    finally:
+        ctx.detach_subscriber(sub)
+        server.shutdown()
+
+
+# --------------------------------------------------------------------- #
+# Chaos: one record per query even when workers die                      #
+# --------------------------------------------------------------------- #
+@pytest.mark.chaos
+def test_worker_kill_failed_then_recovery_success_records(make_df):
+    """A worker-kill query with recovery disabled lands exactly one
+    outcome=failed record; the same query re-run with lineage recovery
+    enabled survives the same kill and lands outcome=success."""
+    from daft_tpu.distributed.faults import fault_scope
+    from daft_tpu.runners.distributed import DistributedRunner
+
+    ctx = daft_tpu.get_context()
+    old = ctx._runner
+    runner = DistributedRunner(num_workers=3)
+    ctx.set_runner(runner)
+
+    def build():
+        return make_df(
+            {"g": [i % 4 for i in range(64)],
+             "v": list(range(64))}).into_partitions(6) \
+            .groupby("g").agg(col("v").sum().alias("s")).sort("g")
+
+    try:
+        expected = build().collect().to_pydict()
+        base = get_recorder().stats()["total"]
+        # Kill with no retry/recovery budget: the query FAILS, one record.
+        with execution_config_ctx(task_max_retries=0,
+                                  max_partition_recoveries=0):
+            with fault_scope("worker.pre_submit:kill:3", seed=0):
+                with pytest.raises(DaftError):
+                    build().collect()
+        rec = get_recorder().recent(1)[0]
+        assert get_recorder().stats()["total"] == base + 1
+        assert rec["outcome"] == "failed" and rec["runner"] == "distributed"
+        # Same kill, recovery armed: lineage recomputes, one success record.
+        with fault_scope("worker.pre_submit:kill:3", seed=0):
+            out = build().collect().to_pydict()
+        assert out == expected
+        rec2 = get_recorder().recent(1)[0]
+        assert get_recorder().stats()["total"] == base + 2
+        assert rec2["outcome"] == "success"
+        assert rec2["plan_fingerprint"] == rec["plan_fingerprint"]
+    finally:
+        runner.manager.shutdown()
+        ctx.set_runner(old)
+
+
+@pytest.mark.chaos
+def test_shed_timeout_success_tally_under_concurrency(make_df):
+    """Concurrent mixed-outcome traffic: the by-outcome tallies sum exactly
+    to the number of queries issued — no record lost, none duplicated."""
+    from daft_tpu.execution.admission import set_tenant
+
+    daft_tpu.set_tenant_policy("narrow", max_concurrent_queries=1,
+                               queue_depth=2)
+    outcomes = []
+    lock = threading.Lock()
+
+    def job(i):
+        set_tenant("narrow")
+        try:
+            make_df({"a": list(range(200))}).where(
+                col("a") > 50).collect()
+            got = "success"
+        except DaftAdmissionError:
+            got = "shed"
+        except DaftError as e:
+            got = type(e).__name__
+        finally:
+            set_tenant(None)
+        with lock:
+            outcomes.append(got)
+
+    base = get_recorder().stats()["total"]
+    threads = [threading.Thread(target=job, args=(i,)) for i in range(12)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    stats = get_recorder().stats()
+    assert stats["total"] - base == 12, (stats, outcomes)
+    by = stats["by_outcome"]
+    assert by["success"] == outcomes.count("success")
+    assert by["shed"] == outcomes.count("shed")
