@@ -308,7 +308,11 @@ def _ab_overhead_check(env_var: str, metric: str, limit_pct: float,
     the minimum is the only estimator whose noise shrinks with samples."""
 
     def run(enabled: bool) -> float:
-        env = dict(os.environ, JAX_PLATFORMS="cpu",
+        # DAFT_RESULT_CACHE=0: the loop repeats ONE query shape, and a
+        # result-cache hit would replace the measured execution with a
+        # sub-ms lookup — the guard's fixed per-query cost would then read
+        # as a huge percentage of nothing.
+        env = dict(os.environ, JAX_PLATFORMS="cpu", DAFT_RESULT_CACHE="0",
                    **{env_var: "1" if enabled else "0"})
         proc = subprocess.run(
             [sys.executable, "-c", _TPCH_CHILD, str(n), str(reps)],
@@ -433,7 +437,11 @@ def _paired_overhead_check(env_var: str, metric: str, limit_pct: float,
 
     def collect(num_rounds: int) -> None:
         for _ in range(num_rounds):
-            env = dict(os.environ, JAX_PLATFORMS="cpu")
+            # DAFT_RESULT_CACHE=0: the child repeats one query shape —
+            # served from the result cache it would measure the plane's
+            # fixed tax against a sub-ms lookup instead of a real query.
+            env = dict(os.environ, JAX_PLATFORMS="cpu",
+                       DAFT_RESULT_CACHE="0")
             env.pop(env_var, None)  # the child drives the toggle
             for k in drop_env:      # measure collection, not file IO
                 env.pop(k, None)

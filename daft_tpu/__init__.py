@@ -61,8 +61,12 @@ __all__ = [
     "lit",
     "read_csv",
     "read_json",
+    "invalidate_cache_path",
     "read_parquet",
     "recent_queries",
+    "register_table",
+    "submit_query",
+    "set_request_priority",
     "set_execution_config",
     "set_planning_config",
     "sql",
@@ -149,6 +153,18 @@ def __getattr__(name: str):
         from daft_tpu.querylog import recent_queries
 
         return recent_queries
+    if name in ("set_request_priority",):
+        from daft_tpu.execution.admission import set_request_priority
+
+        return set_request_priority
+    if name in ("register_table", "submit_query"):
+        from daft_tpu import query_service
+
+        return getattr(query_service, name)
+    if name == "invalidate_cache_path":
+        from daft_tpu.plancache import invalidate_path
+
+        return invalidate_path
     raise AttributeError(f"module 'daft_tpu' has no attribute {name!r}")
 
 

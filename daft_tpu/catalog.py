@@ -12,6 +12,16 @@ from daft_tpu.errors import DaftValueError
 from daft_tpu.schema import Schema
 
 
+def _invalidate_cached_reads(path: str) -> None:
+    """Catalog mutations are writes: drop every cached plan/result/scan
+    entry rooted under the table's path (plancache.py). In-memory tables
+    need no hook — their cache keys are partition-identity-based, so a
+    mutation produces a different key by construction."""
+    from daft_tpu.plancache import invalidate_path
+
+    invalidate_path(path)
+
+
 class Table:
     """A named table: readable as a DataFrame, optionally writable."""
 
@@ -90,9 +100,11 @@ class ParquetTable(Table):
 
     def append(self, df) -> None:
         df.write_parquet(self.path)
+        _invalidate_cached_reads(self.path)
 
     def overwrite(self, df) -> None:
         df.write_parquet(self.path, write_mode="overwrite")
+        _invalidate_cached_reads(self.path)
 
 
 class Catalog:
@@ -190,6 +202,7 @@ class TableFormatTable(Table):
             df.write_parquet(self.path)
         else:
             raise DaftValueError(f"{self.fmt} tables are read-only here")
+        _invalidate_cached_reads(self.path)
 
     def overwrite(self, df) -> None:
         if self.fmt == "iceberg":
@@ -200,6 +213,7 @@ class TableFormatTable(Table):
             df.write_parquet(self.path, write_mode="overwrite")
         else:
             raise DaftValueError(f"{self.fmt} tables are read-only here")
+        _invalidate_cached_reads(self.path)
 
 
 def _sniff_table_format(path: str) -> Optional[str]:
@@ -276,6 +290,7 @@ class DirectoryCatalog(Catalog):
         p = os.path.join(self.warehouse, name)
         if os.path.isdir(p):
             shutil.rmtree(p)
+            _invalidate_cached_reads(p)
 
 
 def _gated_catalog(kind: str, dep: str):

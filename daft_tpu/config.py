@@ -203,6 +203,27 @@ class ExecutionConfig:
     slo_slow_burn: float = 6.0
     slo_autoprofile_count: int = 3
     slo_slow_query_s: float = 0.0
+    # Query-as-a-service caching (daft_tpu/plancache.py). Plan cache: a
+    # bounded LRU keyed on the canonical PRE-optimize logical-plan
+    # fingerprint + planning-config digest; a hit skips optimize+translate
+    # (DAFT_PLAN_CACHE=0 disables, plan_cache_size bounds entries).
+    # Result/scan cache: bounded byte-accounted cache of materialized
+    # results and hot scan outputs (memoized size_bytes is the unit),
+    # charged against the tenant's admission memory quota, invalidated by
+    # every engine write and validated against source-file mtime/size at
+    # hit time (DAFT_RESULT_CACHE=0 / DAFT_RESULT_CACHE_BYTES override;
+    # result_cache_max_entry_bytes drops results too big to be worth
+    # keeping; result_cache_scan_outputs gates the scan-output tier).
+    plan_cache_enabled: bool = True
+    plan_cache_size: int = 256
+    # A cached plan over in-memory frames keeps those frames resident
+    # (the plan references its InMemorySource partitions): the plan cache
+    # is byte-bounded on that pinned total, not just entry count.
+    plan_cache_max_pinned_bytes: int = 256 << 20
+    result_cache_enabled: bool = True
+    result_cache_max_bytes: int = 1 << 30
+    result_cache_max_entry_bytes: int = 256 << 20
+    result_cache_scan_outputs: bool = True
 
     def with_changes(self, **kwargs) -> "ExecutionConfig":
         return dataclasses.replace(self, **kwargs)
@@ -265,4 +286,14 @@ class ExecutionConfig:
         if os.environ.get("DAFT_SLO_AUTOPROFILE"):
             changes["slo_autoprofile_count"] = int(
                 os.environ["DAFT_SLO_AUTOPROFILE"])
+        if not daft_env_flag("DAFT_PLAN_CACHE", True):
+            changes["plan_cache_enabled"] = False
+        if os.environ.get("DAFT_PLAN_CACHE_SIZE"):
+            changes["plan_cache_size"] = int(
+                os.environ["DAFT_PLAN_CACHE_SIZE"])
+        if not daft_env_flag("DAFT_RESULT_CACHE", True):
+            changes["result_cache_enabled"] = False
+        if os.environ.get("DAFT_RESULT_CACHE_BYTES"):
+            changes["result_cache_max_bytes"] = int(
+                os.environ["DAFT_RESULT_CACHE_BYTES"])
         return cfg.with_changes(**changes) if changes else cfg

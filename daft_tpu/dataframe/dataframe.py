@@ -583,9 +583,21 @@ class DataFrame:
                 parts or [MicroPartition.empty(self.schema)], self.schema))
             out = mat._with(mat._builder.table_write(info)).collect()
             checkpoint.seal_partitions(parts, self.schema)
+            self._invalidate_written(root_dir)
             return out
         out = self._with(self._builder.table_write(info))
-        return out.collect()
+        out = out.collect()
+        # Driver-side write-invalidation: the worker-side writer hook only
+        # reaches the writing process's caches; this covers the driver's
+        # when the write ran distributed.
+        self._invalidate_written(root_dir)
+        return out
+
+    @staticmethod
+    def _invalidate_written(path: str) -> None:
+        from daft_tpu.plancache import invalidate_path
+
+        invalidate_path(str(path))
 
     def write_parquet(self, root_dir: str, compression: str = "snappy",
                       partition_cols=None, write_mode: str = "append",
@@ -911,6 +923,12 @@ class DataFrame:
         for part in self.iter_partitions():
             results.append(sink.write(part))
         final = sink.finalize(results)
+        # Write-invalidation: sinks declare what they touched (the
+        # DataSink.invalidates contract) so cached reads over the written
+        # storage drop with the same discipline as the file writers.
+        for path in (sink.invalidates() if hasattr(sink, "invalidates")
+                     else ()):
+            self._invalidate_written(path)
         from daft_tpu.dataframe import creation
 
         return creation.from_pydict(final if isinstance(final, dict) else {"result": [repr(final)]})

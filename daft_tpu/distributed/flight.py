@@ -54,6 +54,78 @@ def start_shuffle_server(cache: ShuffleCache, port: int = 0) -> ShuffleFlightSer
     return server
 
 
+class QueryFlightServer(flight.FlightServerBase):
+    """Arrow Flight query front door: ``do_get`` with a JSON ticket
+    ``{"sql": ..., "tenant": ..., "timeout_s": ..., "priority": ...}``
+    streams the result back as Arrow record batches — the bulk-transport
+    twin of the dashboard's ``POST /api/query``. Queries travel the same
+    in-process path (enter_front_door → admission → plan/result caches →
+    SLO plane), so a shed ticket fails with the engine's retry semantics
+    (FlightUnavailableError), a blown deadline with FlightTimedOutError,
+    and every outcome lands one flight-recorder record."""
+
+    def do_get(self, context, ticket: flight.Ticket):
+        import json
+
+        from daft_tpu import query_service
+        from daft_tpu.errors import (
+            DaftAdmissionError,
+            DaftCancelledError,
+            DaftTimeoutError,
+        )
+
+        try:
+            req = json.loads(ticket.ticket.decode() or "{}")
+            if not isinstance(req, dict):
+                raise ValueError("ticket must be a JSON object")
+            # Conversions are part of ticket validation: a malformed
+            # timeout_s is the CLIENT's error, not an engine fault.
+            timeout_s = req.get("timeout_s")
+            timeout_s = float(timeout_s) if timeout_s is not None else None
+            priority = req.get("priority")
+            priority = int(priority) if priority is not None else None
+        except (ValueError, TypeError, UnicodeDecodeError) as e:
+            raise flight.FlightServerError(f"bad query ticket: {e}")
+        try:
+            table = query_service.submit_query_arrow(
+                req.get("sql"), tenant=req.get("tenant"),
+                timeout_s=timeout_s, priority=priority)
+        except DaftAdmissionError as e:
+            # Transient by the engine's own taxonomy: clients back off
+            # retry_after_s and resubmit (carried in the message).
+            raise flight.FlightUnavailableError(
+                f"shed at admission (retry after "
+                f"~{getattr(e, 'retry_after_s', 1.0):.2f}s): {e}")
+        except DaftTimeoutError as e:
+            raise flight.FlightTimedOutError(str(e))
+        except DaftCancelledError as e:
+            raise flight.FlightCancelledError(str(e))
+        except Exception as e:  # noqa: BLE001 — one wire boundary
+            raise flight.FlightServerError(f"query failed: {e}")
+        return flight.RecordBatchStream(table)
+
+    def list_flights(self, context, criteria):
+        from daft_tpu.query_service import get_table_registry
+
+        for name in get_table_registry().names():
+            descriptor = flight.FlightDescriptor.for_path(name)
+            yield flight.FlightInfo(pa.schema([]), descriptor, [], -1, -1)
+
+    @property
+    def address(self) -> str:
+        return f"grpc://localhost:{self.port}"
+
+
+def start_query_server(port: int = 0) -> QueryFlightServer:
+    """Start the Flight query front door on a daemon thread; returns the
+    server (``.address`` is the dial string)."""
+    server = QueryFlightServer(f"grpc://0.0.0.0:{port}")
+    thread = threading.Thread(target=server.serve, daemon=True,
+                              name="daft-query-flight")
+    thread.start()
+    return server
+
+
 _client_cache: Dict[str, flight.FlightClient] = {}
 _client_lock = threading.Lock()
 

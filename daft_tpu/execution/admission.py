@@ -193,12 +193,16 @@ class _Waiter:
 class _TenantState:
     """Mutable per-tenant admission state (guarded by the controller lock)."""
 
-    __slots__ = ("policy", "running", "mem_reserved", "queue")
+    __slots__ = ("policy", "running", "mem_reserved", "cache_bytes", "queue")
 
     def __init__(self, policy: TenantPolicy):
         self.policy = policy
         self.running: Dict[str, int] = {}  # query_id -> mem reservation
         self.mem_reserved = 0
+        # Result-cache bytes charged to this tenant (plancache.py): cached
+        # results occupy quota headroom but always YIELD to live queries —
+        # admission reclaims them (shrink_tenant) instead of queueing.
+        self.cache_bytes = 0
         # Bound enforced explicitly above every append (queue-full REJECTS
         # with DaftAdmissionError; a deque maxlen would silently DROP).
         # daftlint: disable=DTL010 -- bound enforced by queue-full rejection (reject, not drop)
@@ -269,6 +273,17 @@ class AdmissionController:
                 if st is not None:
                     st.policy = pol
         self._config_policies = parsed
+
+    @staticmethod
+    def _effective_priority(pol: TenantPolicy) -> int:
+        """The tenant's policy priority, lowered (never raised) by any
+        per-request priority the network front door attached
+        (:func:`set_request_priority`) — a client can mark its own query
+        as background, but cannot outrank its tenant's policy."""
+        req = _request_priority_var.get()
+        if req is None:
+            return pol.priority
+        return min(pol.priority, int(req))
 
     def _policy_for(self, tenant: str) -> TenantPolicy:
         ov = self._policy_overrides.get(tenant)
@@ -409,6 +424,37 @@ class AdmissionController:
         with self._cond:
             return self._shed_level
 
+    # -- result-cache quota coupling ---------------------------------------- #
+    def note_cache_bytes(self, tenant: str, delta: int) -> None:
+        """Per-tenant result-cache byte ledger (plancache.py commits and
+        evictions mirror their deltas here). Cached bytes are charged
+        against the tenant's admission memory quota — a tenant cannot hold
+        its whole budget in cached results AND run a full complement of
+        queries. Called by the cache strictly OUTSIDE its own lock (lock
+        order is always cache → admission, never the reverse)."""
+        with self._cond:
+            st = self._state(tenant)
+            st.cache_bytes = max(0, st.cache_bytes + delta)
+            self._cond.notify_all()
+
+    def _cache_overage_locked(self, st: _TenantState, cfg) -> int:
+        """Bytes of this tenant's cached results that live queries now
+        need: reservations + cache over quota. Reclaimed outside the lock
+        (cache bytes always yield to live queries — they never block an
+        admission)."""
+        quota = self._mem_quota(st.policy, cfg)
+        if quota is None or not st.cache_bytes:
+            return 0
+        return max(st.mem_reserved + st.cache_bytes - quota, 0)
+
+    @staticmethod
+    def _reclaim_cache(tenant: str, nbytes: int) -> None:
+        if nbytes <= 0:
+            return
+        from daft_tpu import plancache
+
+        plancache.get_result_cache().shrink_tenant(tenant, nbytes)
+
     # -- admission --------------------------------------------------------- #
     def admit(self, query_id: str, tenant: Optional[str] = None,
               token=None, cfg=None) -> AdmissionTicket:
@@ -444,10 +490,12 @@ class AdmissionController:
         reject: Optional[DaftAdmissionError] = None
         ticket: Optional[AdmissionTicket] = None
         waiter: Optional[_Waiter] = None
+        reclaim = 0
         with self._cond:
             self._sync_policies(cfg)
             st = self._state(tenant)
             pol = st.policy
+            prio = self._effective_priority(pol)
             self._refresh_signals_locked(cfg)
             level = self._shed_level
             max_c = self._max_concurrent(pol, cfg)
@@ -455,6 +503,8 @@ class AdmissionController:
             quota = self._mem_quota(pol, cfg)
             share = self._mem_share(cfg) if quota is not None else 0
             slots_free = (max_c <= 0 or len(st.running) < max_c)
+            # Cache bytes do NOT gate here: they are reclaimable (evicted
+            # below, outside the lock) — only live reservations can block.
             mem_free = (quota is None or st.mem_reserved + share <= quota)
             # Shed ladder, most severe first. Positive-priority tenants ride
             # out every level; negative-priority tenants go first.
@@ -479,14 +529,14 @@ class AdmissionController:
                 events.append(QueryShed(
                     query_id=query_id, tenant=tenant, reason=REASON_OVERLOAD,
                     queue_depth=len(st.queue), retry_after_s=0.05))
-            elif level >= 3 and pol.priority <= 0:
+            elif level >= 3 and prio <= 0:
                 reject = self._reject_locked(st, cfg, query_id,
                                              REASON_OVERLOAD, events)
-            elif level >= 1 and pol.priority < 0:
+            elif level >= 1 and prio < 0:
                 reject = self._reject_locked(st, cfg, query_id,
                                              REASON_SHED_PRIORITY, events)
             elif level >= 1 and not (slots_free and mem_free) \
-                    and pol.priority <= 0:
+                    and prio <= 0:
                 # Over-quota work that would have queued is shed instead.
                 reject = self._reject_locked(st, cfg, query_id,
                                              REASON_SHED_OVER_QUOTA, events)
@@ -494,6 +544,7 @@ class AdmissionController:
                 ticket = self._admit_locked(st, query_id, tenant, share,
                                             wait_s=0.0, level=level, cfg=cfg,
                                             events=events)
+                reclaim = self._cache_overage_locked(st, cfg)
             elif len(st.queue) >= depth:
                 # Must wait, but the bounded queue is full -> fast rejection.
                 reject = self._reject_locked(st, cfg, query_id,
@@ -522,6 +573,10 @@ class AdmissionController:
             raise reject
         if ticket is not None:
             self._emit(events)
+            # Cached results occupying quota headroom a live query now
+            # needs are evicted here — outside the controller lock (the
+            # cache takes its own lock and calls back into this one).
+            self._reclaim_cache(tenant, reclaim)
             return ticket
         from daft_tpu.subscribers.events import QueryQueued
 
@@ -596,6 +651,7 @@ class AdmissionController:
                             st, waiter.query_id, waiter.tenant, share,
                             wait_s=wait_s, level=self._shed_level, cfg=cfg,
                             events=events)
+                        reclaim = self._cache_overage_locked(st, cfg)
                         break
                     timeout = 0.5
                     if token is not None:
@@ -604,6 +660,7 @@ class AdmissionController:
                             timeout = min(timeout, max(rem, 0.0))
                     self._cond.wait(timeout)
             self._emit(events)
+            self._reclaim_cache(waiter.tenant, reclaim)
             return ticket
         finally:
             if woken is not None:
@@ -720,6 +777,7 @@ class AdmissionController:
                     "running": len(st.running),
                     "queued": len(st.queue),
                     "mem_reserved": st.mem_reserved,
+                    "cache_bytes": st.cache_bytes,
                     "max_concurrent": st.policy.max_concurrent_queries,
                     "priority": st.policy.priority,
                 }
@@ -734,6 +792,8 @@ class AdmissionController:
                               for st in self._tenants.values()),
                 "mem_reserved": sum(st.mem_reserved
                                     for st in self._tenants.values()),
+                "cache_bytes": sum(st.cache_bytes
+                                   for st in self._tenants.values()),
                 "shed_level": self._shed_level,
             }
 
@@ -791,6 +851,17 @@ def set_tenant_policy(tenant: str, *, max_concurrent_queries: int = 0,
 
 _tenant_var: contextvars.ContextVar[Optional[str]] = \
     contextvars.ContextVar("daft_tenant", default=None)
+_request_priority_var: contextvars.ContextVar[Optional[int]] = \
+    contextvars.ContextVar("daft_request_priority", default=None)
+
+
+def set_request_priority(priority: Optional[int]) -> None:
+    """Attach a per-request priority to queries issued from this context
+    (the network front door's lever). Admission uses
+    ``min(policy.priority, request priority)`` — a request can only lower
+    its own standing on the shed ladder, never rise above its tenant's
+    policy. ``None`` clears."""
+    _request_priority_var.set(priority)
 
 
 def set_tenant(tenant: Optional[str]) -> None:

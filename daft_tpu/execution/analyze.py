@@ -61,6 +61,21 @@ def analyze_suffix(df) -> str:
             f"cache_hits={hits}, cache_misses={misses}, "
             f"compile_s={ch1['sum'] - ch0['sum']:.4f}"
             + ("" if enabled else " [SELF-DISABLED]"))
+    # Query-cache visibility (plancache.py): one line per cache tier —
+    # HIT means this run skipped optimize+translate (plan) or execution
+    # entirely (result; bytes served from memory instead of re-executed).
+    pc_hit = int(d("daft_plan_cache_hits_total"))
+    pc_miss = int(d("daft_plan_cache_misses_total"))
+    if pc_hit or pc_miss:
+        lines.append(f"plan cache: {'HIT' if pc_hit else 'MISS'}")
+    rc_hit = int(d("daft_result_cache_hits_total"))
+    rc_miss = int(d("daft_result_cache_misses_total"))
+    if rc_hit or rc_miss:
+        if rc_hit:
+            hit_bytes = int(d("daft_result_cache_hit_bytes_total"))
+            lines.append(f"result cache: HIT ({hit_bytes} bytes)")
+        else:
+            lines.append("result cache: MISS")
     spilled = int(d("daft_spill_bytes_total"))
     if spilled:
         lines.append(f"spill: bytes={spilled}, "

@@ -373,6 +373,83 @@ class Executor:
             yield p
 
     def _run_PhysicalScan(self, node: pp.PhysicalScan) -> Iterator[MicroPartition]:
+        """Scan with the hot-scan-output cache tier in front: repeated
+        scans of unchanged files (by mtime/size fingerprint) serve their
+        morsel stream from memory instead of re-reading + re-decoding.
+        The cached stream IS the fresh stream (same morsel boundaries),
+        so everything downstream keyed on morsel boundaries — the PR 8
+        determinism contract — is unaffected by hit-vs-miss."""
+        cfg = self.cfg
+        if not (getattr(cfg, "result_cache_enabled", True)
+                and getattr(cfg, "result_cache_scan_outputs", True)) \
+                or not node.scan_tasks \
+                or not all(hasattr(t, "files") and hasattr(t, "pushdowns")
+                           for t in node.scan_tasks):
+            yield from self._scan_stream(node)
+            return
+        from daft_tpu import plancache
+        from daft_tpu.execution.admission import current_tenant
+
+        try:
+            # The morsel width shapes the cached stream's boundaries (PR 8
+            # determinism contract), so it is part of the key: a config
+            # change re-reads rather than serving differently-shaped
+            # morsels.
+            key = "scan:" + plancache.fingerprint(
+                self._scan_key_text(node)
+                + f"\nmorsel={cfg.default_morsel_size}")
+        except (AttributeError, TypeError, ValueError):
+            # Unfingerprintable scan: read uncached (the cache is an
+            # optimization, never a gate).
+            yield from self._scan_stream(node)
+            return
+        cache = plancache.get_result_cache(cfg)
+        outcome, payload = cache.lookup_or_claim(
+            key, "scan", current_tenant(), token=self.cancel_token)
+        if outcome == "hit":
+            yield from payload.partitions
+            return
+        sources, roots = self._scan_sources(node)
+        payload.set_provenance(sources, roots)
+        try:
+            for mp in self._scan_stream(node):
+                payload.add(mp)
+                yield mp
+            # Full drain only: an abandoned scan (limit pushdown, error
+            # downstream) aborts in the finally — never a partial entry.
+            payload.commit()
+        finally:
+            payload.abort()
+
+    @staticmethod
+    def _scan_key_text(node: pp.PhysicalScan) -> str:
+        parts = []
+        for t in node.scan_tasks:
+            pd = t.pushdowns
+            filt = pd.filters.key() if pd.filters is not None else None
+            ro = sorted((k, repr(v)) for k, v in t.read_options.items()
+                        if k != "io_config")
+            files = ",".join(
+                f"{f.path}:{f.size_bytes}:{f.partition_values}"
+                for f in t.files)
+            parts.append(f"{t.file_format};cols={pd.columns};"
+                         f"limit={pd.limit};shard={pd.shard};filt={filt};"
+                         f"opts={ro};files={files}")
+        parts.append(f"schema={node.schema.column_names()}")
+        return "\n".join(parts)
+
+    @staticmethod
+    def _scan_sources(node: pp.PhysicalScan):
+        from daft_tpu.plancache import file_fingerprint
+
+        sources, roots = [], []
+        for t in node.scan_tasks:
+            for f in t.files:
+                roots.append(f.path)
+                sources.append(file_fingerprint(f.path, f.size_bytes))
+        return sources, roots
+
+    def _scan_stream(self, node: pp.PhysicalScan) -> Iterator[MicroPartition]:
         from daft_tpu.io.formats import read_scan_task
 
         tasks = node.scan_tasks

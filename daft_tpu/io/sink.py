@@ -34,3 +34,10 @@ class DataSink(Generic[T]):
     def finalize(self, results: List[WriteResult[T]]):
         """Called once after all writes; returns the result table dict."""
         return {"wrote": [r.rows for r in results]}
+
+    def invalidates(self) -> Iterable[str]:
+        """Paths this sink wrote to — the write-invalidation contract
+        (plancache.py): the ``write_sink`` driver drops every cached
+        plan/result/scan entry rooted under them after ``finalize``.
+        Sinks writing to engine-readable storage should override."""
+        return ()

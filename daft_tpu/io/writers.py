@@ -131,6 +131,14 @@ class FileWriter:
     def close(self) -> List[Dict[str, Any]]:
         if self._current is not None:
             self._close_current()
+        # Write-invalidation (plancache.py): any cached plan/result/scan
+        # entry reading under this root is now stale — the next read
+        # re-plans (fresh file list) and re-executes. Source-fingerprint
+        # validation at hit time is the backstop for writes this process
+        # never saw.
+        from daft_tpu.plancache import invalidate_path
+
+        invalidate_path(self.info.root_dir)
         return self.results
 
 
@@ -173,6 +181,9 @@ class PartitionedWriter:
         out = []
         for w in self._writers.values():
             out.extend(w.close())
+        from daft_tpu.plancache import invalidate_path
+
+        invalidate_path(self.info.root_dir)
         return out
 
 

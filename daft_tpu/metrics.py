@@ -884,6 +884,38 @@ AUTOPROFILE_CAPTURES = _r.counter(
     "daft_slo_autoprofile_captures_total",
     "Queries auto-profiled by the tail sampler (armed plan fingerprints)")
 
+# Query-as-a-service caching (daft_tpu/plancache.py)
+PLAN_CACHE_HITS = _r.counter(
+    "daft_plan_cache_hits_total",
+    "Queries whose optimize+translate was served from the plan cache")
+PLAN_CACHE_MISSES = _r.counter(
+    "daft_plan_cache_misses_total",
+    "Queries that paid a full optimize+translate pass")
+PLAN_CACHE_SIZE = _r.gauge(
+    "daft_plan_cache_entries", "Plans currently cached")
+RESULT_CACHE_HITS = _r.counter(
+    "daft_result_cache_hits_total",
+    "Result/scan-cache hits, by tier (result = whole query, scan = "
+    "scan-node output)", ("kind",))
+RESULT_CACHE_MISSES = _r.counter(
+    "daft_result_cache_misses_total", "Result/scan-cache misses, by tier",
+    ("kind",))
+RESULT_CACHE_HIT_BYTES = _r.counter(
+    "daft_result_cache_hit_bytes_total",
+    "Bytes served from the result/scan cache instead of re-executed")
+RESULT_CACHE_BYTES = _r.gauge(
+    "daft_result_cache_bytes", "Bytes currently resident in the "
+    "result/scan cache (memoized size_bytes accounting)")
+RESULT_CACHE_ENTRIES = _r.gauge(
+    "daft_result_cache_entries", "Entries currently in the result/scan cache")
+RESULT_CACHE_EVICTIONS = _r.counter(
+    "daft_result_cache_evictions_total",
+    "Cache entries dropped, by tier and reason (capacity / invalidated / "
+    "stale-source / tenant-quota)", ("kind", "reason"))
+RESULT_CACHE_INVALIDATIONS = _r.counter(
+    "daft_result_cache_invalidations_total",
+    "Entries dropped by write-invalidation (io/writers, io/sink, catalog)")
+
 # AI providers (ai/metrics.py shims onto these)
 AI_TOKENS = _r.counter(
     "daft_ai_tokens_total", "Provider tokens consumed",

@@ -19,7 +19,11 @@ from daft_tpu.stats import TableStatistics
 
 
 class MicroPartition:
-    __slots__ = ("_schema", "_batches", "_statistics")
+    # _cache_uid: process-unique identity stamped lazily by the query
+    # cache (plancache._partition_uid) — unlike id(), never recycled, so
+    # a cache entry keyed on it can outlive the partition without risking
+    # aliasing a new frame at a reused address.
+    __slots__ = ("_schema", "_batches", "_statistics", "_cache_uid")
 
     def __init__(self, schema: Schema, batches: Sequence[RecordBatch],
                  statistics: Optional[TableStatistics] = None):

@@ -43,7 +43,7 @@ failures never fail the query — the recorder logs and drops instead.
 
 from __future__ import annotations
 
-import hashlib
+import contextvars
 import json
 import logging
 import threading
@@ -55,7 +55,10 @@ from daft_tpu.utils.jsonl_sink import RotatingJsonlSink
 
 log = logging.getLogger("daft_tpu.querylog")
 
-QUERYLOG_SCHEMA_VERSION = 1
+#: Schema v2 adds ``plan_cache_hit`` / ``result_cache_hit`` (PR 13's
+#: query-as-a-service caching). The reader accepts v1 and v2 — a log
+#: written across the upgrade still loads whole.
+QUERYLOG_SCHEMA_VERSION = 2
 
 #: Outcome taxonomy — every query lands in exactly one bucket.
 OUTCOME_SUCCESS = "success"
@@ -66,12 +69,17 @@ OUTCOME_FAILED = "failed"
 OUTCOMES = (OUTCOME_SUCCESS, OUTCOME_TIMEOUT, OUTCOME_CANCELLED,
             OUTCOME_SHED, OUTCOME_FAILED)
 
-#: Schema v1 — the reader/writer contract (tests pin this set; extending
-#: the record means bumping QUERYLOG_SCHEMA_VERSION or adding OPTIONAL
-#: keys, never repurposing these).
-RECORD_REQUIRED = ("schema_version", "query_id", "tenant", "runner", "ts",
-                   "outcome", "duration_s", "plan_fingerprint",
-                   "admission_wait_s", "shed_level", "rows_out", "bytes_out")
+#: The reader/writer contract (tests pin these sets; extending the record
+#: means bumping QUERYLOG_SCHEMA_VERSION or adding OPTIONAL keys, never
+#: repurposing these). v1 is the pre-cache set; v2 additionally requires
+#: the cache-hit facts.
+RECORD_REQUIRED_V1 = ("schema_version", "query_id", "tenant", "runner", "ts",
+                      "outcome", "duration_s", "plan_fingerprint",
+                      "admission_wait_s", "shed_level", "rows_out",
+                      "bytes_out")
+RECORD_REQUIRED_V2 = RECORD_REQUIRED_V1 + ("plan_cache_hit",
+                                           "result_cache_hit")
+RECORD_REQUIRED = RECORD_REQUIRED_V2
 
 #: Ring capacity default; DAFT_QUERY_LOG_RING overrides at first use.
 DEFAULT_RING_SIZE = 512
@@ -92,10 +100,13 @@ def plan_fingerprint(plan_repr: str) -> str:
     Identical query shapes (the "same few hundred queries arrive millions
     of times" serving regime, ROADMAP item 2) produce identical reprs and
     so identical fingerprints — which is what lets the SLO plane say "auto-
-    profile the next N queries LIKE the slow one". Same spirit as the
-    compiled-eval chain fingerprint, lifted from chain suffix to whole
-    plan."""
-    return hashlib.sha1(plan_repr.encode("utf-8", "replace")).hexdigest()[:16]
+    profile the next N queries LIKE the slow one". The hash itself is THE
+    shared engine fingerprint helper (plancache.fingerprint) — the plan
+    cache, the compiled-eval chain keys, and this recorder all key through
+    one scheme so they can never drift apart."""
+    from daft_tpu.plancache import fingerprint
+
+    return fingerprint(plan_repr)
 
 
 def classify_outcome(error: Optional[BaseException]) -> tuple:
@@ -146,7 +157,8 @@ class FlightEntry:
     __slots__ = ("query_id", "tenant", "runner", "cfg", "ts", "_t0",
                  "plan_fingerprint", "admission_wait_s", "shed_level",
                  "shed_reason", "rows_out", "bytes_out", "profiled",
-                 "autoprofiled", "_m0", "_recorder", "_done")
+                 "autoprofiled", "plan_cache_hit", "result_cache_hit",
+                 "_m0", "_recorder", "_done")
 
     def __init__(self, query_id: str, tenant: str, runner: str, cfg,
                  recorder: "FlightRecorder"):
@@ -164,6 +176,8 @@ class FlightEntry:
         self.bytes_out = 0
         self.profiled = False
         self.autoprofiled = False
+        self.plan_cache_hit = False
+        self.result_cache_hit = False
         self._m0 = _counter_values()
         self._recorder = recorder
         self._done = False
@@ -174,6 +188,16 @@ class FlightEntry:
 
     def observe_plan(self, plan_repr: str) -> None:
         self.plan_fingerprint = plan_fingerprint(plan_repr)
+
+    def note_caches(self, plan_hit: "bool | None" = None,
+                    result_hit: "bool | None" = None) -> None:
+        """Cache-hit facts for this query (plancache.py): did the plan
+        cache skip optimize+translate, did the result cache skip execution
+        entirely. Schema-v2 record fields."""
+        if plan_hit is not None:
+            self.plan_cache_hit = bool(plan_hit)
+        if result_hit is not None:
+            self.result_cache_hit = bool(result_hit)
 
     def count(self, mp) -> None:
         """Per-yielded-partition output accounting (size_bytes is memoized
@@ -292,6 +316,8 @@ class FlightRecorder:
             "stage_fusions": int(m1["stage_fusions"]
                                  - entry._m0["stage_fusions"]),
             "peak_rss_bytes": _peak_rss(),
+            "plan_cache_hit": entry.plan_cache_hit,
+            "result_cache_hit": entry.result_cache_hit,
             "profiled": entry.profiled or profile is not None,
             "autoprofiled": entry.autoprofiled,
             "operators": _operator_digest(profile),
@@ -304,6 +330,11 @@ class FlightRecorder:
             self._ring.append(record)
             self._totals[record["outcome"]] = \
                 self._totals.get(record["outcome"], 0) + 1
+        # Per-context "my query's record": finish_entry runs on the thread
+        # draining the query (the runner's finally), so the network front
+        # door can read ITS query's facts race-free under concurrent
+        # serving threads — unlike recent(1), which any tenant can bump.
+        _last_record_var.set(record)
         from daft_tpu import metrics
 
         metrics.QUERYLOG_RECORDS.labels(record["outcome"]).inc()
@@ -413,18 +444,22 @@ def _peak_rss() -> int:
 def validate_record(rec: Any) -> List[str]:
     """Schema check for one query-log line; returns problems (empty =
     valid). Shared by the writer's tests and any reader that must not
-    trust a torn tail line."""
+    trust a torn tail line. Accepts BOTH schema versions: v1 records
+    (pre-cache) and v2 (with the cache-hit fields) — a log written across
+    the upgrade loads whole."""
     errs: List[str] = []
     if not isinstance(rec, dict):
         return [f"record is {type(rec).__name__}, not an object"]
-    for key in RECORD_REQUIRED:
+    version = rec.get("schema_version")
+    required = RECORD_REQUIRED_V1 if version == 1 else RECORD_REQUIRED_V2
+    for key in required:
         if key not in rec:
             errs.append(f"missing key {key!r}")
     if errs:
         return errs
-    if rec["schema_version"] != QUERYLOG_SCHEMA_VERSION:
-        errs.append(f"schema_version {rec['schema_version']!r} != "
-                    f"{QUERYLOG_SCHEMA_VERSION}")
+    if version not in (1, QUERYLOG_SCHEMA_VERSION):
+        errs.append(f"schema_version {version!r} not in "
+                    f"(1, {QUERYLOG_SCHEMA_VERSION})")
     if rec["outcome"] not in OUTCOMES:
         errs.append(f"unknown outcome {rec['outcome']!r}")
     if not isinstance(rec.get("duration_s"), (int, float)) \
@@ -474,6 +509,18 @@ def get_recorder() -> FlightRecorder:
             if _RECORDER is None:
                 _RECORDER = FlightRecorder()
     return _RECORDER
+
+
+_last_record_var: contextvars.ContextVar[Optional[dict]] = \
+    contextvars.ContextVar("daft_last_query_record", default=None)
+
+
+def last_record() -> Optional[dict]:
+    """The most recent flight record finished ON THIS context (thread-
+    scoped): the network front door's way to attach the record's facts
+    (cache hits, admission wait, outcome) to the response it just served,
+    race-free under concurrent serving threads."""
+    return _last_record_var.get()
 
 
 def recent_queries(n: Optional[int] = None, tenant: Optional[str] = None,

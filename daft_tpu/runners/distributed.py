@@ -17,7 +17,6 @@ from daft_tpu.context import get_context
 from daft_tpu.distributed.planner import DistributedExecutor
 from daft_tpu.distributed.worker import LocalWorker, WorkerManager
 from daft_tpu.micropartition import MicroPartition
-from daft_tpu.physical.translate import translate
 from daft_tpu.runners.runner import Runner
 from daft_tpu.subscribers.events import QueryEnd, QueryStart
 
@@ -97,8 +96,6 @@ class DistributedRunner(Runner):
                 cfg.heartbeat_interval_s, cfg.heartbeat_miss_threshold)
 
     def run_iter(self, builder, timeout: Optional[float] = None) -> Iterator[MicroPartition]:
-        import contextlib
-
         from daft_tpu import profiling
 
         ctx = get_context()
@@ -125,14 +122,16 @@ class DistributedRunner(Runner):
         # runner.py).
         token, ticket, cfg, fentry = enter_front_door(query_id, cfg, timeout,
                                                       runner=self.name)
+        from daft_tpu.runners.runner import plan_with_caches
+
+        build = None
         try:
-            with contextlib.ExitStack() as plan_st:
-                if prof is not None:
-                    plan_st.enter_context(prof.driver_span("daft.plan"))
-                optimized = builder.optimize(cfg)
-                physical = translate(optimized.plan, cfg)
-            plan_repr = repr(optimized.plan)
-            if fentry is not None:
+            # Result cache → plan cache → real optimize+translate (the
+            # shared plan_with_caches helper; see runner.py). A result-
+            # cache hit never dispatches a single task.
+            physical, plan_repr, cached_parts, build = plan_with_caches(
+                builder, cfg, prof, fentry, token, ticket.tenant)
+            if fentry is not None and cached_parts is None:
                 # First moment the plan fingerprint exists: the tail
                 # sampler may recognize an armed slow shape and open a
                 # full profile for this run (daft_tpu/slo.py).
@@ -145,10 +144,42 @@ class DistributedRunner(Runner):
             # profile HERE or a planning failure leaks it in the process-
             # global registry forever (and collect_profile gets no trace) —
             # and release the admission slot + flight record the same way.
+            if build is not None:
+                build.abort()
             ticket.release()
             profiling.end_query(query_id, error=str(e))
             querylog.finish_entry(fentry, error=e)
             raise
+        if cached_parts is not None:
+            # Result-cache hit: stream the materialized partitions under
+            # the same event/record/token/finally discipline as a real run
+            # (registered token: cancel_query(id) must work on a cached
+            # stream exactly as the native runner's hit path does).
+            ctx.notify(QueryStart(query_id=query_id, plan=plan_repr))
+            start = time.perf_counter()
+            error = None
+            error_obj = None
+            register_query_token(query_id, token)
+            try:
+                for mp in cached_parts:
+                    token.check("cached-result")
+                    if fentry is not None:
+                        fentry.count(mp)
+                    yield mp
+            except BaseException as e:  # noqa: BLE001
+                error = str(e)
+                error_obj = e
+                raise
+            finally:
+                ticket.release()
+                unregister_query_token(query_id)
+                ctx.notify(QueryEnd(query_id=query_id,
+                                    duration_s=time.perf_counter() - start,
+                                    error=error))
+                prof_fin = profiling.end_query(query_id, error=error)
+                querylog.finish_entry(fentry, error=error_obj,
+                                      profile=prof_fin)
+            return
         ctx.notify(QueryStart(query_id=query_id, plan=plan_repr))
         start = time.perf_counter()
         error = None
@@ -191,7 +222,13 @@ class DistributedRunner(Runner):
                 if len(mp):
                     if fentry is not None:
                         fentry.count(mp)
+                    if build is not None:
+                        build.add(mp)
                     yield mp
+            if build is not None:
+                # Full drain only — a partial iteration aborts in the
+                # finally instead (no partially-built cache entries).
+                build.commit()
         except BaseException as e:  # noqa: BLE001
             error = str(e)
             error_obj = e
@@ -201,6 +238,8 @@ class DistributedRunner(Runner):
             # worker loss mid-query, chaos, and generator close all pass
             # here — admission slots/reservations can never leak, and the
             # query's ONE flight record lands whatever the outcome.
+            if build is not None:
+                build.abort()
             ticket.release()
             unregister_query_token(query_id)
             unregister_query_stats(query_id)

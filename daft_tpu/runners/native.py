@@ -13,7 +13,6 @@ from typing import Iterator
 from daft_tpu.context import get_context
 from daft_tpu.execution.executor import Executor
 from daft_tpu.micropartition import MicroPartition
-from daft_tpu.physical.translate import translate
 from daft_tpu.runners.runner import Runner
 from daft_tpu.subscribers.events import QueryEnd, QueryStart
 
@@ -22,8 +21,6 @@ class NativeRunner(Runner):
     name = "native"
 
     def run_iter(self, builder, timeout=None) -> Iterator[MicroPartition]:
-        import contextlib
-
         from daft_tpu import profiling
 
         ctx = get_context()
@@ -46,14 +43,17 @@ class NativeRunner(Runner):
         # see runner.py).
         token, ticket, cfg, fentry = enter_front_door(query_id, cfg, timeout,
                                                       runner=self.name)
+        from daft_tpu.runners.runner import plan_with_caches
+
+        build = None
         try:
-            with contextlib.ExitStack() as plan_st:
-                if prof is not None:
-                    plan_st.enter_context(prof.driver_span("daft.plan"))
-                optimized = builder.optimize(cfg)
-                physical = translate(optimized.plan, cfg)
-            plan_repr = repr(optimized.plan)
-            if fentry is not None:
+            # Result cache → plan cache → real optimize+translate (see
+            # plan_with_caches). A result-cache hit skips execution
+            # entirely; a claimed build handle follows the ticket's
+            # finally discipline below.
+            physical, plan_repr, cached_parts, build = plan_with_caches(
+                builder, cfg, prof, fentry, token, ticket.tenant)
+            if fentry is not None and cached_parts is None:
                 # The fingerprint exists only now — which is also the first
                 # moment the tail sampler can recognize a plan shape it
                 # armed after a slow run and open a full profile for it.
@@ -66,6 +66,8 @@ class NativeRunner(Runner):
             # profile HERE or a planning failure leaks it in the process-
             # global registry forever (and collect_profile gets no trace) —
             # and release the admission slot + flight record the same way.
+            if build is not None:
+                build.abort()
             ticket.release()
             profiling.end_query(query_id, error=str(e))
             querylog.finish_entry(fentry, error=e)
@@ -76,35 +78,52 @@ class NativeRunner(Runner):
         error_obj = None
         register_query_token(query_id, token)
         try:
-            from daft_tpu.execution.resource_manager import RuntimeStats
-
-            from daft_tpu.context import iter_with_frozen_clock
-
-            stats = RuntimeStats(query_id)
-            ctx.last_query_stats = stats  # DataFrame.metrics() surface
-            tprof = prof.local_task_profiler() if prof is not None else None
-            executor = Executor(cfg, stats=stats, cancel_token=token,
-                                profiler=tprof)
-            # CURRENT_TIMESTAMP is one instant per statement: frozen per
-            # resumption (not per generator lifetime) so interleaved lazy
-            # queries on one thread can't clobber each other's clock. The
-            # cancel token and the ambient profiler follow the same
-            # per-resumption discipline (the daft.execute SPAN still covers
-            # the generator's whole lifetime — ambient=False keeps the
-            # contextvar out of it).
-            with profiling.profiled_task_scope(tprof, name="daft.execute",
-                                               ambient=False):
-                stream = profiling.iter_with_profiler_scope(
-                    iter_with_cancel_scope(
-                        iter_with_frozen_clock(executor.run(physical)),
-                        token),
-                    tprof)
-                if fentry is None:
-                    yield from stream
-                else:
-                    for mp in stream:
+            if cached_parts is not None:
+                # Result-cache hit: stream the materialized partitions.
+                # Deadline/cancel still observed per partition — a hit is
+                # fast, not exempt from the front door's contracts.
+                for mp in cached_parts:
+                    token.check("cached-result")
+                    if fentry is not None:
                         fentry.count(mp)
+                    yield mp
+            else:
+                from daft_tpu.execution.resource_manager import RuntimeStats
+
+                from daft_tpu.context import iter_with_frozen_clock
+
+                stats = RuntimeStats(query_id)
+                ctx.last_query_stats = stats  # DataFrame.metrics() surface
+                tprof = prof.local_task_profiler() if prof is not None \
+                    else None
+                executor = Executor(cfg, stats=stats, cancel_token=token,
+                                    profiler=tprof)
+                # CURRENT_TIMESTAMP is one instant per statement: frozen per
+                # resumption (not per generator lifetime) so interleaved
+                # lazy queries on one thread can't clobber each other's
+                # clock. The cancel token and the ambient profiler follow
+                # the same per-resumption discipline (the daft.execute SPAN
+                # still covers the generator's whole lifetime —
+                # ambient=False keeps the contextvar out of it).
+                with profiling.profiled_task_scope(tprof,
+                                                   name="daft.execute",
+                                                   ambient=False):
+                    stream = profiling.iter_with_profiler_scope(
+                        iter_with_cancel_scope(
+                            iter_with_frozen_clock(executor.run(physical)),
+                            token),
+                        tprof)
+                    for mp in stream:
+                        if fentry is not None:
+                            fentry.count(mp)
+                        if build is not None:
+                            build.add(mp)
                         yield mp
+                if build is not None:
+                    # Reached only on a FULL drain: a partial iteration
+                    # (limit pushdown, abandoned generator) aborts in the
+                    # finally instead — no partially-built entries.
+                    build.commit()
         except BaseException as e:  # noqa: BLE001
             error = str(e)
             error_obj = e
@@ -112,9 +131,12 @@ class NativeRunner(Runner):
         finally:
             # Exception-safe on EVERY exit: success, timeout, cancel,
             # worker loss, chaos, and generator close all pass here —
-            # admission slots/reservations can never leak, and the query's
+            # admission slots/reservations can never leak, the query's
             # ONE flight record lands whatever the outcome (the finished
-            # profile rides along so the record carries its op digest).
+            # profile rides along so the record carries its op digest),
+            # and an uncommitted cache build aborts with them.
+            if build is not None:
+                build.abort()
             ticket.release()
             unregister_query_token(query_id)
             ctx.notify(QueryEnd(query_id=query_id,

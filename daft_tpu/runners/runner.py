@@ -74,6 +74,94 @@ def enter_front_door(query_id: str, cfg, timeout: "float | None",
     return token, ticket, cfg, entry
 
 
+def plan_with_caches(builder, cfg, prof, fentry, token, tenant):
+    """The shared post-admission planning block for BOTH runners: result
+    cache first, then plan cache, then (and only then) a real
+    optimize+translate pass.
+
+    Returns ``(physical, plan_repr, cached_partitions, build_handle)``:
+
+    * ``cached_partitions`` is not None on a **result-cache hit** — the
+      runner streams them and never plans or executes (``physical`` is
+      None; the flight record carries ``result_cache_hit``).
+    * ``build_handle`` is not None when this query claimed the
+      single-flight build of its key: the runner feeds every yielded
+      partition into it, commits on a FULL drain, and aborts it in the
+      same ``finally`` as the admission ticket — a cancelled/timed-out/
+      early-closed query leaves no partial entry behind.
+    * A **plan-cache hit** reuses the cached optimize+translate output;
+      the ``daft.plan`` driver span is only entered on a miss, so the
+      optimizer wall is literally absent from hit profiles.
+    """
+    from daft_tpu import plancache
+    from daft_tpu.physical.translate import translate
+
+    use_plan = getattr(cfg, "plan_cache_enabled", True)
+    use_result = getattr(cfg, "result_cache_enabled", True)
+    key = None
+    if use_plan or use_result:
+        try:
+            key = plancache.compute_query_key(builder.plan, cfg)
+        except Exception:  # noqa: BLE001
+            # An unfingerprintable plan must run UNCACHED, never fail:
+            # the cache is an optimization, not a gate.
+            import logging
+
+            logging.getLogger("daft_tpu.plancache").warning(
+                "query key computation failed; running uncached",
+                exc_info=True)
+            key = None
+
+    handle = None
+    if use_result and key is not None and key.result_cacheable:
+        outcome, payload = plancache.get_result_cache(cfg).lookup_or_claim(
+            key.fp, "result", tenant, token=token)
+        if outcome == "hit":
+            if fentry is not None:
+                fentry.observe_plan(payload.plan_repr)
+                fentry.note_caches(result_hit=True)
+            return None, payload.plan_repr, payload.partitions, None
+        handle = payload
+
+    try:
+        use_plan = use_plan and key is not None and key.plan_cacheable
+        pentry = plancache.get_plan_cache(cfg).get(key) if use_plan \
+            else None
+        if pentry is not None:
+            optimized_plan = pentry.optimized_plan
+            physical = pentry.physical
+            plan_repr = pentry.plan_repr
+            sources, roots = pentry.sources, pentry.roots
+            if fentry is not None:
+                fentry.note_caches(plan_hit=True)
+        else:
+            import contextlib
+
+            with contextlib.ExitStack() as plan_st:
+                if prof is not None:
+                    plan_st.enter_context(prof.driver_span("daft.plan"))
+                optimized = builder.optimize(cfg)
+                physical = translate(optimized.plan, cfg)
+            optimized_plan = optimized.plan
+            plan_repr = repr(optimized_plan)
+            sources = plancache.source_fingerprints(optimized_plan) \
+                if (key is not None and (use_plan or handle is not None)) \
+                else []
+            roots = key.roots if key is not None else []
+            if use_plan:
+                plancache.get_plan_cache(cfg).put(key, optimized_plan,
+                                                  physical, plan_repr)
+        if handle is not None:
+            handle.set_provenance(sources, roots, plan_repr)
+    except BaseException:
+        # A planning failure must release the single-flight claim, or
+        # every later arrival of this shape waits out the claim timeout.
+        if handle is not None:
+            handle.abort()
+        raise
+    return physical, plan_repr, None, handle
+
+
 class Runner:
     name = "base"
 

@@ -142,6 +142,23 @@ async function renderSLO() {
   ).join("") || '<tr><td colspan="2" class="hint">nothing armed</td></tr>';
 }
 
+async function renderCache() {
+  const d = await getJSON("/api/cache");
+  const mb = (b) => (b / 1048576).toFixed(2);
+  $("#cache-summary").textContent =
+    `plan: ${d.plan.entries}/${d.plan.size} entries · result/scan: ` +
+    `${d.result.entries} entries, ${mb(d.result.bytes)} / ` +
+    `${mb(d.result.capacity)} MiB (${d.result.building} building)`;
+  $("#cache-entries tbody").innerHTML = (d.entries || []).map((e) =>
+    `<tr><td>${esc(e.key)}</td><td>${esc(e.kind)}</td>
+      <td>${esc(e.tenant)}</td><td>${e.bytes}</td><td>${e.hits}</td>
+      <td>${e.age_s.toFixed(1)}</td><td>${e.sources}</td></tr>`
+  ).join("") || '<tr><td colspan="7" class="hint">cache empty</td></tr>';
+  $("#cache-tables").innerHTML = (d.tables || []).map((t) =>
+    `<li><code>${esc(t)}</code></li>`).join("") ||
+    '<li class="hint">no tables registered</li>';
+}
+
 async function renderAdmission() {
   const a = await getJSON("/api/admission");
   const lvl = a.totals.shed_level;
@@ -270,6 +287,7 @@ async function tick() {
     if (view === "queries") { await renderQueries(); await renderQueryLog(); }
     else if (view === "slo") await renderSLO();
     else if (view === "admission") await renderAdmission();
+    else if (view === "cache") await renderCache();
     else if (view === "workers") await renderWorkers();
     else if (view === "perf") await renderPerf();
     else await renderDataframes();

@@ -576,6 +576,17 @@ class _Handler(BaseHTTPRequestHandler):
                                    "rows": entry["rows"],
                                    "columns": entry["columns"]}).encode()
                 ctype = "application/json"
+        elif path == "/api/cache":
+            # Query-cache panel (daft_tpu/plancache.py): plan-cache size,
+            # result/scan-cache bytes + per-entry table, and the servable
+            # table registry.
+            from daft_tpu import plancache
+            from daft_tpu.query_service import get_table_registry
+
+            payload = plancache.cache_stats()
+            payload["tables"] = get_table_registry().names()
+            body = json.dumps(payload).encode()
+            ctype = "application/json"
         elif path == "/api/health":
             body = b'{"status":"ok"}'
             ctype = "application/json"
@@ -585,6 +596,63 @@ class _Handler(BaseHTTPRequestHandler):
         self.send_response(200)
         self.send_header("Content-Type", ctype)
         self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_POST(self):
+        """The HTTP query front door: ``POST /api/query`` with JSON
+        ``{"sql": ..., "tenant": ..., "timeout_s": ..., "priority": ...,
+        "max_rows": ...}``. The query travels the SAME path as an
+        in-process collect — enter_front_door (admission, flight
+        recorder), plan/result caches, SLO plane — so a shed request is a
+        429 with Retry-After and a real ``outcome=shed`` flight record,
+        and a blown deadline is a 504 with a real ``outcome=timeout``
+        one."""
+        parsed = urllib.parse.urlsplit(self.path)
+        if parsed.path != "/api/query":
+            self.send_error(404)
+            return
+        from daft_tpu import query_service
+
+        try:
+            length = int(self.headers.get("Content-Length", "0"))
+            req = json.loads(self.rfile.read(length) or b"{}")
+            if not isinstance(req, dict):
+                raise ValueError("request body must be a JSON object")
+            # Field conversions are CLIENT input errors: parse them here
+            # so {"timeout_s": "abc"} answers 400, not a 500 engine fault.
+            timeout_s = req.get("timeout_s")
+            timeout_s = float(timeout_s) if timeout_s is not None else None
+            priority = req.get("priority")
+            priority = int(priority) if priority is not None else None
+            max_rows = req.get("max_rows")
+            max_rows = int(max_rows) if max_rows is not None else None
+        except (ValueError, TypeError) as e:
+            self._send_json(400, {"error": f"bad request body: {e}",
+                                  "kind": "BadRequest"})
+            return
+        try:
+            result = query_service.submit_query(
+                req.get("sql"), tenant=req.get("tenant"),
+                timeout_s=timeout_s, priority=priority, max_rows=max_rows)
+        except BaseException as e:  # noqa: BLE001 — mapped, never a socket kill
+            status, payload = query_service.error_response(e)
+            headers = {}
+            if status == 429 and payload.get("retry_after_s"):
+                headers["Retry-After"] = str(
+                    max(int(payload["retry_after_s"] + 0.5), 1))
+            self._send_json(status, payload, headers)
+            return
+        self._send_json(200, result)
+
+    def _send_json(self, status: int, payload: dict,
+                   headers: Optional[dict] = None) -> None:
+        body = json.dumps(payload, default=str).encode()
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        for k, v in (headers or {}).items():
+            self.send_header(k, v)
         self.end_headers()
         self.wfile.write(body)
 
@@ -615,6 +683,13 @@ class DashboardServer:
         """Publish a DataFrame for interactive display; returns its id
         (reference: python::register_dataframe_for_display)."""
         return self.displays.register(df, name)
+
+    def register_table(self, name: str, df) -> None:
+        """Serve ``df`` as SQL table ``name`` through POST /api/query
+        (process-global registry — the Flight front door sees it too)."""
+        from daft_tpu.query_service import register_table
+
+        register_table(name, df)
 
     def shutdown(self) -> None:
         self._server.shutdown()

@@ -368,6 +368,282 @@ def assert_overhead(blocks: int = 3, reps: int = 4) -> int:
     return 0
 
 
+# --------------------------------------------------------------------- #
+# Over-the-wire storm (--wire): the HTTP front door under repeated-shape  #
+# serving traffic (ISSUE 13)                                              #
+# --------------------------------------------------------------------- #
+WIRE_SHAPES = [
+    "SELECT l_returnflag, l_linestatus, SUM(l_quantity) AS sum_qty, "
+    "COUNT(l_orderkey) AS n FROM lineitem "
+    "GROUP BY l_returnflag, l_linestatus ORDER BY l_returnflag, l_linestatus",
+    "SELECT SUM(l_extendedprice * l_discount) AS revenue FROM lineitem "
+    "WHERE l_discount >= 0.03 AND l_quantity < 24.0",
+    "SELECT o_orderpriority, SUM(l_quantity) AS qty FROM lineitem "
+    "JOIN orders ON l_orderkey = o_orderkey "
+    "GROUP BY o_orderpriority ORDER BY o_orderpriority",
+    "SELECT l_returnflag, AVG(l_extendedprice) AS avg_price FROM lineitem "
+    "GROUP BY l_returnflag ORDER BY l_returnflag",
+    "SELECT COUNT(l_orderkey) AS n FROM lineitem WHERE l_quantity > 40.0",
+    "SELECT l_linestatus, MAX(l_extendedprice) AS mx FROM lineitem "
+    "GROUP BY l_linestatus ORDER BY l_linestatus",
+]
+
+
+def _post_query(url: str, body: dict, timeout: float = 60.0):
+    """(status, payload, retry_after_header) for one front-door POST."""
+    import urllib.error
+    import urllib.request
+
+    req = urllib.request.Request(
+        f"{url}/api/query", data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"})
+    try:
+        resp = urllib.request.urlopen(req, timeout=timeout)
+        return resp.status, json.loads(resp.read()), None
+    except urllib.error.HTTPError as e:
+        try:
+            payload = json.loads(e.read())
+        except ValueError:
+            payload = {}
+        return e.code, payload, e.headers.get("Retry-After")
+
+
+def wire_storm(args) -> int:
+    """Closed-loop storm THROUGH the HTTP front door: every client thread
+    waits for its response before the next request (the dashboard-traffic
+    shape). Repeated-shape queries must serve >= 90% from the caches;
+    shed and timed-out wire queries must land the same admission metrics
+    and flight-recorder records as in-process ones."""
+    from daft_tpu.querylog import get_recorder
+    from daft_tpu.subscribers.dashboard import DashboardServer
+
+    daft_tpu.set_execution_config(num_compute_threads=2)
+    set_tenant_policy("hostile", max_concurrent_queries=1, queue_depth=1,
+                      priority=-1)
+    set_tenant_policy("web", max_concurrent_queries=16, queue_depth=32)
+
+    dash = DashboardServer(port=0).start()
+    daft_tpu.get_context().attach_subscriber(dash.subscriber())
+    print(f"front door: {dash.url}/api/query")
+    dash.register_table("lineitem", make_lineitem(ROWS))
+    dash.register_table("orders", make_orders())
+
+    # Warmup: one pass per shape = the cold builds. Everything after is a
+    # repeat and must hit.
+    for sql in WIRE_SHAPES:
+        status, payload, _ = _post_query(dash.url,
+                                         {"sql": sql, "tenant": "web"})
+        assert status == 200, (status, payload)
+
+    n_queries = 48 if args.smoke else max(args.queries, 48)
+    n_threads = 8 if args.smoke else min(args.threads, 16)
+    lock = threading.Lock()
+    results = {"hits": 0, "misses": 0, "walls": [], "hit_walls": [],
+               "errors": [], "shed": 0, "timeouts": 0}
+    idx = {"n": 0}
+
+    def worker():
+        while True:
+            with lock:
+                if idx["n"] >= n_queries:
+                    return
+                i = idx["n"]
+                idx["n"] += 1
+            body = {"sql": WIRE_SHAPES[i % len(WIRE_SHAPES)],
+                    "tenant": "web"}
+            t0 = time.monotonic()
+            status, payload, _ = _post_query(dash.url, body)
+            wall = time.monotonic() - t0
+            with lock:
+                if status != 200:
+                    results["errors"].append((status, payload))
+                    continue
+                results["walls"].append(wall)
+                if payload.get("result_cache_hit"):
+                    results["hits"] += 1
+                    results["hit_walls"].append(wall)
+                else:
+                    results["misses"] += 1
+
+    threads = [threading.Thread(target=worker) for _ in range(n_threads)]
+    t0 = time.monotonic()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    print(f"wire storm: {n_queries} queries / {n_threads} threads "
+          f"in {time.monotonic() - t0:.1f}s")
+
+    # Shed leg: a burst of hostile posts against a 1-deep queue — some
+    # MUST come back 429 with Retry-After, and each shed must have landed
+    # a real outcome=shed flight record.
+    rec = get_recorder()
+    shed_before = rec.stats()["by_outcome"].get("shed", 0)
+    # A shape nothing has cached: the burst does real concurrent work, so
+    # the 1-deep hostile queue actually fills and sheds.
+    hostile_sql = ("SELECT SUM(l_quantity * l_extendedprice) AS x "
+                   "FROM lineitem WHERE l_orderkey >= 0")
+
+    def hostile_post():
+        status, _, retry_after = _post_query(
+            dash.url, {"sql": hostile_sql, "tenant": "hostile"})
+        with lock:
+            if status == 429:
+                results["shed"] += 1
+                if retry_after is None:
+                    results["errors"].append((429, "missing Retry-After"))
+
+    burst = [threading.Thread(target=hostile_post) for _ in range(8)]
+    for t in burst:
+        t.start()
+    for t in burst:
+        t.join()
+    shed_records = rec.stats()["by_outcome"].get("shed", 0) - shed_before
+
+    # Timeout leg: an unmeetable deadline must map to 504 AND land an
+    # outcome=timeout record (same treatment as in-process).
+    to_before = rec.stats()["by_outcome"].get("timeout", 0)
+    status, payload, _ = _post_query(
+        dash.url, {"sql": WIRE_SHAPES[2], "tenant": "web",
+                   "timeout_s": 1e-6})
+    if status == 504:
+        results["timeouts"] += 1
+    timeout_records = rec.stats()["by_outcome"].get("timeout", 0) - to_before
+
+    failures = []
+    repeats = results["hits"] + results["misses"]
+    hit_rate = results["hits"] / max(repeats, 1)
+    print(f"repeat traffic: {results['hits']}/{repeats} cache hits "
+          f"({hit_rate:.1%}, bound >= 90%)")
+    if hit_rate < 0.9:
+        failures.append(f"cache-hit rate {hit_rate:.1%} < 90% on repeats")
+    hw = sorted(results["hit_walls"])
+    if hw:
+        print(f"cached wire p50 {pctl(hw, 0.5) * 1000:.1f}ms, "
+              f"p99 {pctl(hw, 0.99) * 1000:.1f}ms (incl. HTTP round-trip)")
+    if results["errors"]:
+        failures.append(f"wire errors: {results['errors'][:3]}")
+    print(f"hostile burst: {results['shed']} shed as 429 "
+          f"({shed_records} outcome=shed flight records)")
+    if results["shed"] < 1:
+        failures.append("hostile burst produced no 429 sheds")
+    if shed_records < results["shed"]:
+        failures.append(
+            f"shed wire queries under-recorded: {shed_records} records for "
+            f"{results['shed']} 429s")
+    print(f"deadline leg: status={status} "
+          f"({timeout_records} outcome=timeout flight records)")
+    if status != 504 or timeout_records < 1:
+        failures.append(
+            f"wire timeout mapped to {status} with {timeout_records} "
+            f"timeout records (want 504 + >= 1)")
+    # Front-door metrics visible on the same scrape an operator uses.
+    import urllib.request
+
+    text = urllib.request.urlopen(f"{dash.url}/metrics",
+                                  timeout=5).read().decode()
+    # (plan-cache HITS may legitimately be zero here: result-cache hits
+    # short-circuit before the plan cache — the cache-bench lane asserts
+    # hits > 0; this scrape asserts the exposition itself.)
+    for needle in ("daft_result_cache_hits_total",
+                   "daft_plan_cache_misses_total",
+                   "daft_admission_rejected_total"):
+        if needle not in text:
+            failures.append(f"{needle} missing from /metrics")
+    dash.shutdown()
+    if failures:
+        print("\nFAILURES:")
+        for f in failures:
+            print(f"  - {f}")
+        return 1
+    print("\nwire storm: all serving SLOs held")
+    return 0
+
+
+def assert_cache_overhead(pairs: int = 20, rows: int = 300_000) -> int:
+    """The cache layer must be invisible on COLD/unique queries: every
+    query a distinct shape (a fresh literal per iteration -> a fresh
+    fingerprint -> key computation + miss + insert/evict, the full cold
+    tax), caches on vs off, <= 2%.
+
+    Estimator = the bench.py overhead-guard discipline: the tax is a
+    FIXED per-query cost (one key walk + one insert, ~100µs), so the loop
+    is QUERY-sized (TPC-H-style rows, tens of ms — not a microbenchmark
+    whose whole runtime is one optimizer pass), and the verdict is the
+    MEDIAN over ABBA blocks of the block's position-balanced delta
+    ((a1+a2-b1-b2)/2): whichever config runs FIRST in a window measures
+    ~1ms slower on this box (the PR 8 first-run systematic), so order
+    must cancel WITHIN each sample, not just across the pool. A failing
+    verdict escalates once with a fresh doubled sample."""
+    import statistics
+
+    from daft_tpu import plancache
+    from daft_tpu.context import execution_config_ctx
+
+    print(f"generating {rows}-row lineitem for the overhead guard...")
+    df = make_lineitem(rows)
+    uniq = {"n": 0}
+
+    def one():
+        uniq["n"] += 1
+        # The nano-offset literal makes every plan a DISTINCT shape: all
+        # cache lookups miss, which is exactly the tax we bound. q01-shaped
+        # grouped aggregation = the dashboard-serving query class this
+        # cache exists for (the tax is fixed per query, so the denominator
+        # must be a real serving query, not a microbenchmark).
+        q_agg(df.where(col("l_quantity") < (50.0 + uniq["n"] * 1e-9))
+              ).collect()
+
+    def one_pass(enabled):
+        # SERIAL, like the admission guard's "uncontended serial subset":
+        # the compute pool's scheduling jitter on a shared box is ±2ms —
+        # 2x the whole budget — while results are thread-count invariant
+        # (PR 8), so serial measures the cache tax, not the pool.
+        with execution_config_ctx(plan_cache_enabled=enabled,
+                                  result_cache_enabled=enabled,
+                                  num_compute_threads=1):
+            t0 = time.monotonic()
+            one()
+            return time.monotonic() - t0
+
+    deltas, offs = [], []
+
+    def collect(n):
+        one()  # warm jit/path outside the clock
+        for _ in range(n):
+            a1 = one_pass(True)
+            b1 = one_pass(False)
+            b2 = one_pass(False)
+            a2 = one_pass(True)
+            deltas.append((a1 + a2 - b1 - b2) / 2)
+            offs.append((b1 + b2) / 2)
+            plancache.reset_caches()  # bound the unique-entry build-up
+
+    def verdict():
+        # Interquartile (trimmed) mean over blocks: medians of a ±1ms
+        # near-symmetric noise distribution wander ~1.5x more than the
+        # middle-half mean at this sample size, and the tail trim keeps
+        # the occasional 10ms interference burst out of the verdict.
+        d = sorted(deltas)
+        q = max(len(d) // 4, 1)
+        mid = d[q:-q] if len(d) > 2 * q else d
+        return (sum(mid) / len(mid)) / statistics.median(offs) * 100.0
+
+    collect(pairs)
+    pct = verdict()
+    if pct > 2.0:
+        print(f"cache overhead {pct:.2f}% > 2%: escalating once with "
+              f"{pairs} more blocks")
+        collect(pairs)
+        pct = verdict()
+    print(f"cache layer cold-path overhead: {pct:+.2f}% "
+          f"(interquartile mean over {len(deltas)} ABBA blocks, bound 2%)")
+    if pct > 2.0:
+        print("FAIL: plan/result caches add >2% to cold unique queries")
+        return 1
+    return 0
+
+
 def permit_leak_audit() -> str | None:
     """Targeted zero-leaked-permits check: under a REAL memory limit, run
     queries that acquire permits — including one cancelled mid-flight —
@@ -411,18 +687,32 @@ def main() -> int:
     ap.add_argument("--chaos", action="store_true", default=None,
                     help="force the chaos round (default: on unless --smoke)")
     ap.add_argument("--assert-overhead", action="store_true",
-                    help="only run the <2% uncontended overhead check")
+                    help="only run the <2% uncontended overhead check "
+                         "(with --wire: the cache layer's cold-path guard)")
+    ap.add_argument("--wire", action="store_true",
+                    help="closed-loop storm THROUGH the HTTP front door: "
+                         "repeated-shape traffic, >= 90% cache-hit rate, "
+                         "shed/timeout wire parity with in-process queries")
     ap.add_argument("--seed", type=int, default=0)
     args = ap.parse_args()
+    if args.wire and args.assert_overhead:
+        return assert_cache_overhead()
     if args.assert_overhead:
         return assert_overhead()
+    if args.wire:
+        return wire_storm(args)
     if args.smoke:
         args.queries, args.threads = 36, 12
     chaos = args.chaos if args.chaos is not None else not args.smoke
 
     # Keep the thread budget sane under N concurrent executors: 2 compute
     # threads per query (determinism contract: results are unaffected).
-    daft_tpu.set_execution_config(num_compute_threads=2)
+    # Result cache OFF: this storm verifies the ADMISSION plane under real
+    # execution load — with caching on, the hostile tenant's repeated
+    # shapes serve in microseconds and nothing ever contends (the --wire
+    # storm is where cache behavior is asserted).
+    daft_tpu.set_execution_config(num_compute_threads=2,
+                                  result_cache_enabled=False)
 
     # Flight-recorder JSONL sink for the whole storm: the zero-leak audit
     # at the end re-reads it and requires one schema-valid line per

@@ -471,6 +471,110 @@ def cmd_ab_fusion(args) -> int:
     return 0
 
 
+def cmd_cache_bench(args) -> int:
+    """Query-cache acceptance bench (ISSUE 13): one TPC-H-shaped query run
+    cold, as a cached repeat (result-cache hit), and with the result cache
+    off but the plan cache warm (plan-cache-only hit). Appends a
+    ``query_cache`` trajectory entry and enforces:
+
+    * cached repeat >= 10x faster than its cold run;
+    * plan-cache-only hit skips optimize+translate — the plan-cache hit
+      counter moved AND the ``daft.plan`` driver span is absent from the
+      hit's profile.
+    """
+    import daft_tpu  # noqa: F401 — engine import side effects
+    from daft_tpu import metrics, plancache
+    from daft_tpu.context import execution_config_ctx
+
+    queries, _ = build_suite("tpch", args)
+    name, build = queries[0]  # q01-shaped grouped aggregation
+    build().limit(1).collect()  # warm jit/datagen outside the clock
+    plancache.reset_caches()
+    records = []
+
+    def _rec(tag, wall, prof, extra_metrics=None):
+        rec = perf_report.record_from_profile(f"{name}_{tag}", prof, wall) \
+            if prof is not None else {
+                "name": f"{name}_{tag}", "wall_s": round(wall, 6),
+                "rows_out": 0, "operators": [], "metrics": {}}
+        rec["metrics"].update(extra_metrics or {})
+        records.append(rec)
+        print(f"  {name}_{tag}: {wall * 1000:.1f}ms", file=sys.stderr)
+        return rec
+
+    # Cold: full optimize + translate + execute (best-of like the suites).
+    cold_wall = None
+    cold_prof = None
+    for _ in range(max(args.rounds, 1)):
+        plancache.reset_caches()
+        df = build()
+        t0 = time.perf_counter()
+        df.collect(profile=True)
+        w = time.perf_counter() - t0
+        if cold_wall is None or w < cold_wall:
+            cold_wall, cold_prof = w, df.query_profile
+    _rec("cold", cold_wall, cold_prof)
+
+    # Cached repeat: the result cache serves the materialized partitions.
+    h0 = metrics.RESULT_CACHE_HITS.labels("result").value()
+    cached_wall = None
+    for _ in range(max(args.rounds, 1) + 2):
+        t0 = time.perf_counter()
+        build().collect()
+        w = time.perf_counter() - t0
+        if cached_wall is None or w < cached_wall:
+            cached_wall = w
+    result_hits = metrics.RESULT_CACHE_HITS.labels("result").value() - h0
+    _rec("cached_repeat", cached_wall, None,
+         {"daft_result_cache_hits_total": result_hits})
+
+    # Plan-cache-only: result cache off for this query (config digest keys
+    # a DIFFERENT entry family, so the warm plan cache below is its own —
+    # warm it once, then time the hit).
+    with execution_config_ctx(result_cache_enabled=False):
+        build().collect()  # warms THIS config's plan-cache entry
+        p0 = metrics.PLAN_CACHE_HITS._default_child().value()
+        df = build()
+        t0 = time.perf_counter()
+        df.collect(profile=True)
+        plan_wall = time.perf_counter() - t0
+        plan_prof = df.query_profile
+    plan_hits = metrics.PLAN_CACHE_HITS._default_child().value() - p0
+    _rec("plan_cache_hit", plan_wall, plan_prof,
+         {"daft_plan_cache_hits_total": plan_hits})
+
+    failures = []
+    speedup = cold_wall / max(cached_wall, 1e-9)
+    print(f"cached repeat speedup: {speedup:.1f}x "
+          f"(cold {cold_wall * 1000:.1f}ms -> {cached_wall * 1000:.2f}ms, "
+          f"bound >= 10x)")
+    if speedup < 10.0:
+        failures.append(f"cached repeat only {speedup:.1f}x faster (< 10x)")
+    if result_hits < 1:
+        failures.append("no result-cache hit recorded on the repeat")
+    if plan_hits < 1:
+        failures.append("no plan-cache hit recorded on the plan-only run")
+    planned_spans = [s.name for s in plan_prof.spans()
+                     if s.name == "daft.plan"] if plan_prof else []
+    print(f"plan-cache hit: {plan_wall * 1000:.1f}ms, "
+          f"daft.plan spans in profile: {len(planned_spans)} (must be 0)")
+    if planned_spans:
+        failures.append("optimizer wall present in plan-cache-hit profile")
+    entry = perf_report.build_entry(
+        "query_cache", records,
+        config={"rounds": args.rounds, "scale_rows": args.scale_rows,
+                "cached_speedup_x": round(speedup, 2)})
+    if not args.no_append:
+        path = perf_report.append_entry(entry, args.out)
+        print(f"appended query_cache entry sha={entry['sha'] or '?'} "
+              f"to {path}", file=sys.stderr)
+    if failures:
+        for f in failures:
+            print(f"FAIL: {f}")
+        return 1
+    return 0
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     p.add_argument("--suite", default="tpch", choices=("tpch", "micro"))
@@ -500,6 +604,10 @@ def main(argv=None) -> int:
     p.add_argument("--ab-fusion", action="store_true",
                    help="fused-vs-interpreted ABBA guard on q01/q06-shaped "
                         "scans (self-disabling contract)")
+    p.add_argument("--cache-bench", action="store_true",
+                   help="query-cache acceptance: cold vs cached-repeat vs "
+                        "plan-cache-only timings; appends a query_cache "
+                        "trajectory entry and enforces >= 10x cached repeat")
     p.add_argument("--ab-rows", type=int, default=400_000,
                    help="rows for the --ab-fusion tables")
     p.add_argument("--ab-tolerance-pct", type=float, default=5.0,
@@ -519,6 +627,8 @@ def main(argv=None) -> int:
         return cmd_overhead(args)
     if args.ab_fusion:
         return cmd_ab_fusion(args)
+    if args.cache_bench:
+        return cmd_cache_bench(args)
     if args.cores:
         return cmd_cores(args)
     return cmd_capture(args)

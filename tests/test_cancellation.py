@@ -774,8 +774,11 @@ def test_breaker_chaos_query_fails_fast_never_hangs(dist_runner, tap, tmp_path):
     daft_tpu.from_pydict({"v": list(range(50))}).write_parquet(str(tmp_path))
     expected = sorted(daft_tpu.read_parquet(str(tmp_path)).to_pydict()["v"])
     t0 = time.monotonic()
+    # Result/scan cache off: the control read above would otherwise serve
+    # this repeat from memory and the breaker would never see a failure.
     with daft_tpu.execution_config_ctx(task_transient_backoff_s=0.01,
-                                       circuit_failure_threshold=3):
+                                       circuit_failure_threshold=3,
+                                       result_cache_enabled=False):
         with fault_scope("io.get_object:raise_transient:*"):
             with pytest.raises(DaftError):
                 daft_tpu.read_parquet(str(tmp_path)).to_pydict()
@@ -798,11 +801,13 @@ def test_breaker_partial_outage_retries_on_other_paths(dist_runner, tap, tmp_pat
     # First 4 object gets fail: the breaker (threshold 3) opens mid-query,
     # in-flight tasks fail fast, and the dispatcher's backoff outlives the
     # short probe delay — the probe succeeds and the query completes.
+    # result_cache off: the control read above must not serve this repeat.
     with daft_tpu.execution_config_ctx(task_transient_backoff_s=0.2,
                                        task_max_retries=6,
                                        circuit_failure_threshold=3,
                                        circuit_open_base_s=0.1,
-                                       circuit_open_cap_s=0.1):
+                                       circuit_open_cap_s=0.1,
+                                       result_cache_enabled=False):
         spec = ",".join(f"io.get_object:raise_transient:{n}"
                         for n in (1, 2, 3, 4))
         with fault_scope(spec):

@@ -147,8 +147,11 @@ def test_compile_cache_hit_rate_on_repeated_shapes():
     times must hit the plan-fingerprint compile cache >= 90%."""
     df = _f32_table(n=30_000)
     runs = 10
+    # Result cache off: a repeated shape served from the result cache
+    # never reaches compiled eval — this test measures the COMPILE cache.
     with daft_tpu.execution_config_ctx(compiled_eval_enabled=True,
-                                       device_eval_min_rows=1):
+                                       device_eval_min_rows=1,
+                                       result_cache_enabled=False):
         s0 = _snap()
         for _ in range(runs):
             _chain_query(df).to_pydict()

@@ -186,30 +186,34 @@ def test_fusion_coverage_floor_on_representative_pipeline():
                 .agg(col("disc_price").sum().alias("rev"),
                      col("charge").sum().alias("charge")))
 
-    device_eval_metrics.reset()
-    s0 = get_registry().snapshot()
-    build().collect()
-    s1 = get_registry().snapshot()
-    snap = device_eval_metrics.snapshot()
-    # Floor: the pipeline fused on device, nothing regressed to host.
-    assert snap["fused_exprs"] >= 2, snap
-    assert snap["fused_rows"] > 0, snap
-    assert snap["fallback_reasons"].get("not_fusable", 0) == 0, snap
-    assert snap["device_errors"] == 0, snap
+    # Result cache off: the re-run below must reach compiled eval (a
+    # result-cache hit would skip execution entirely — this test measures
+    # the COMPILE cache, one layer down).
+    with daft_tpu.execution_config_ctx(result_cache_enabled=False):
+        device_eval_metrics.reset()
+        s0 = get_registry().snapshot()
+        build().collect()
+        s1 = get_registry().snapshot()
+        snap = device_eval_metrics.snapshot()
+        # Floor: the pipeline fused on device, nothing regressed to host.
+        assert snap["fused_exprs"] >= 2, snap
+        assert snap["fused_rows"] > 0, snap
+        assert snap["fallback_reasons"].get("not_fusable", 0) == 0, snap
+        assert snap["device_errors"] == 0, snap
 
-    def d(name):
-        return s1.counter_total(name) - s0.counter_total(name)
+        def d(name):
+            return s1.counter_total(name) - s0.counter_total(name)
 
-    # PR 11 floor: the chain COMPILED (whole filter→project→agg as one
-    # jitted program), not just per-expression device eval.
-    assert d("daft_compiled_chain_morsels_total") >= 1, \
-        "compiled chain path not taken"
-    # Same shape again: the plan-fingerprint compile cache must hit.
-    build().collect()
-    s2 = get_registry().snapshot()
-    hits = s2.counter_total("daft_compile_cache_hits_total") \
-        - s1.counter_total("daft_compile_cache_hits_total")
-    misses = s2.counter_total("daft_compile_cache_misses_total") \
-        - s1.counter_total("daft_compile_cache_misses_total")
-    assert hits >= 1 and misses == 0, (hits, misses)
-    assert device_eval_metrics.snapshot()["device_errors"] == 0
+        # PR 11 floor: the chain COMPILED (whole filter→project→agg as one
+        # jitted program), not just per-expression device eval.
+        assert d("daft_compiled_chain_morsels_total") >= 1, \
+            "compiled chain path not taken"
+        # Same shape again: the plan-fingerprint compile cache must hit.
+        build().collect()
+        s2 = get_registry().snapshot()
+        hits = s2.counter_total("daft_compile_cache_hits_total") \
+            - s1.counter_total("daft_compile_cache_hits_total")
+        misses = s2.counter_total("daft_compile_cache_misses_total") \
+            - s1.counter_total("daft_compile_cache_misses_total")
+        assert hits >= 1 and misses == 0, (hits, misses)
+        assert device_eval_metrics.snapshot()["device_errors"] == 0

@@ -374,8 +374,12 @@ def test_transient_io_retry_inside_task(dist_runner, tap, tmp_path):
     # is exhausted, the dispatcher folds the escaped DaftTransientError into
     # the per-task budget, and the resubmitted task's 4th open succeeds.
     spec = ",".join(f"io.get_object:raise_transient:{n}" for n in (1, 2, 3))
-    with fault_scope(spec) as inj:
-        out = sorted(daft_tpu.read_parquet(str(tmp_path)).to_pydict()["v"])
+    # Result/scan cache off: this test exercises the IO retry path, and a
+    # cached repeat of the read above would never open the files at all.
+    with daft_tpu.execution_config_ctx(result_cache_enabled=False):
+        with fault_scope(spec) as inj:
+            out = sorted(
+                daft_tpu.read_parquet(str(tmp_path)).to_pydict()["v"])
     assert out == expected
     assert inj.fired("io.get_object") == 3
     assert any(e.reason == "transient" for e in tap.of(TaskRetried))

@@ -179,12 +179,15 @@ def test_planning_failure_does_not_leak_profile(monkeypatch):
     # begin_query registers in the process-global store BEFORE the
     # execution try/finally exists: a failure in optimize/translate must
     # still close the profile or every failed profiled query leaks one.
-    import daft_tpu.runners.native as native_mod
+    import daft_tpu.physical.translate as translate_mod
 
-    def boom(plan, cfg):
+    def boom(plan, cfg, _memo=None):
         raise RuntimeError("untranslatable")
 
-    monkeypatch.setattr(native_mod, "translate", boom)
+    # The planning seam moved into the shared plan_with_caches prologue
+    # (runners/runner.py), which imports translate at call time — patch
+    # the defining module so both runners' paths see the failure.
+    monkeypatch.setattr(translate_mod, "translate", boom)
     with profiling.collect_profile() as req:
         with pytest.raises(RuntimeError, match="untranslatable"):
             small_df().where(col("a") > 10).collect()

@@ -22,6 +22,17 @@ N = 50_000
 LIMIT = 256 * 1024  # sink budget = limit/4 = 64 KiB << data size (~1 MB)
 
 
+@pytest.fixture(autouse=True)
+def _no_result_cache():
+    """These tests assert on EXECUTION internals (spill counters): with
+    the result cache on, `_run_both`'s limited repeat would serve from
+    memory without executing — correct results, but nothing to spill."""
+    from daft_tpu.context import execution_config_ctx
+
+    with execution_config_ctx(result_cache_enabled=False):
+        yield
+
+
 @pytest.fixture
 def big_df(make_df):
     rng = np.random.default_rng(7)
