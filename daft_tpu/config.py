@@ -61,6 +61,17 @@ class ExecutionConfig:
     target_batch_size_bytes: int = 64 * 1024 * 1024
     shuffle_algorithm: str = "auto"  # "auto" | "flight" | "in_memory"
     flight_shuffle_dirs: Tuple[str, ...] = ("/tmp",)
+    # Shuffle data plane (distributed/shuffle.py): chunk files are
+    # compressed Arrow IPC ("auto" negotiates lz4 -> zstd -> raw against
+    # the local Arrow build), cut at shuffle_chunk_bytes; reduce readers
+    # prefetch up to shuffle_prefetch_depth refs ahead on the wire path
+    # (pipelined fetch overlapping downstream compute; <=1 fetches inline
+    # with no look-ahead). shuffle_pipelined_fetch=False restores the
+    # legacy eager whole-partition bind path entirely.
+    shuffle_compression: str = "auto"  # "auto" | "lz4" | "zstd" | "none"
+    shuffle_chunk_bytes: int = 4 * 1024 * 1024
+    shuffle_prefetch_depth: int = 4
+    shuffle_pipelined_fetch: bool = True
     partial_aggregation_threshold: int = 10_000
     # First-chunk group-reduction ratio above which the pipelined
     # aggregation hash-partitions instead of merging chunk partials: a
@@ -243,6 +254,17 @@ class ExecutionConfig:
             changes["stage_fusion_enabled"] = False
         if os.environ.get("DAFT_SHUFFLE_ALGORITHM"):
             changes["shuffle_algorithm"] = os.environ["DAFT_SHUFFLE_ALGORITHM"]
+        if os.environ.get("DAFT_SHUFFLE_COMPRESSION"):
+            changes["shuffle_compression"] = \
+                os.environ["DAFT_SHUFFLE_COMPRESSION"]
+        if os.environ.get("DAFT_SHUFFLE_CHUNK_BYTES"):
+            changes["shuffle_chunk_bytes"] = int(
+                os.environ["DAFT_SHUFFLE_CHUNK_BYTES"])
+        if os.environ.get("DAFT_SHUFFLE_PREFETCH_DEPTH"):
+            changes["shuffle_prefetch_depth"] = int(
+                os.environ["DAFT_SHUFFLE_PREFETCH_DEPTH"])
+        if not daft_env_flag("DAFT_SHUFFLE_PIPELINED", True):
+            changes["shuffle_pipelined_fetch"] = False
         if os.environ.get("DAFT_FAULT_SPEC"):
             changes["fault_spec"] = os.environ["DAFT_FAULT_SPEC"]
         if os.environ.get("DAFT_FAULT_SEED"):

@@ -87,8 +87,16 @@ def _recv_frame(sock: socket.socket) -> bytes:
 # Ref wire format                                                      #
 # ------------------------------------------------------------------ #
 def encode_ref(ref: PartitionRef) -> dict:
-    """Flight refs travel as addresses (zero-copy); anything else ships its
-    bytes inline (driver-resident partitions, e.g. from_pydict inputs)."""
+    """Flight/shuffle refs travel as addresses (zero-copy); anything else
+    ships its bytes inline (driver-resident partitions, e.g. from_pydict
+    inputs)."""
+    from daft_tpu.distributed.partition_ref import ShufflePartitionRef
+
+    if isinstance(ref, ShufflePartitionRef):
+        return {"kind": "shuffle", "address": ref.address, "ticket": ref.ticket,
+                "rows": ref.rows, "bytes": ref.bytes_,
+                "worker_id": ref.worker_id,
+                "chunks": [c.to_wire() for c in ref.chunks]}
     if isinstance(ref, FlightPartitionRef):
         return {"kind": "flight", "address": ref.address, "ticket": ref.ticket,
                 "rows": ref.rows, "bytes": ref.bytes_, "worker_id": ref.worker_id}
@@ -96,6 +104,16 @@ def encode_ref(ref: PartitionRef) -> dict:
 
 
 def decode_ref(d: dict) -> PartitionRef:
+    if d["kind"] == "shuffle":
+        from daft_tpu.distributed.partition_ref import (
+            ChunkRef,
+            ShufflePartitionRef,
+        )
+
+        return ShufflePartitionRef(
+            d["address"], d["ticket"], d["rows"], d["bytes"],
+            d.get("worker_id"),
+            [ChunkRef.from_wire(c) for c in d.get("chunks") or []])
     if d["kind"] == "flight":
         return FlightPartitionRef(d["address"], d["ticket"], d["rows"],
                                   d["bytes"], d.get("worker_id"))
@@ -115,7 +133,15 @@ class WorkerDaemon:
 
         self.worker_id = f"daemon-{uuid.uuid4().hex[:8]}"
         self.slots = slots
-        self.cache = ShuffleCache(data_dir or tempfile.mkdtemp(prefix="daft_daemon_"))
+        # The cache nests (and cleans up) its own root inside the given
+        # dir; a fresh mkdtemp here would strand the empty outer dir.
+        self.cache = ShuffleCache(data_dir or tempfile.gettempdir())
+        # Intra-host short-circuit: reduce tasks running ON this daemon
+        # read their colocated chunks straight off disk instead of
+        # round-tripping through their own Flight server.
+        from daft_tpu.distributed.shuffle import register_local_cache
+
+        register_local_cache(self.worker_id, self.cache)
         self.flight = ShuffleFlightServer(self.cache)
         from daft_tpu.config import daft_env
 
@@ -204,6 +230,15 @@ class WorkerDaemon:
 
                             profiling.buffer_spans(reply["spans"])
                         raise
+                elif op == "release_query":
+                    # Query teardown: delete this query's shuffle chunk
+                    # files NOW (same driver finally as admission-ticket
+                    # release) instead of letting them sit until daemon
+                    # shutdown — the zero-leak lifecycle contract.
+                    removed = self.cache.release_query(
+                        msg.get("query_id", ""))
+                    _send_frame(conn, cloudpickle.dumps(
+                        {"ok": True, "removed": removed}))
                 elif op == "die":
                     # Fault injection (tests only): refuse unless explicitly
                     # enabled — an unauthenticated kill switch otherwise.
@@ -268,16 +303,30 @@ class WorkerDaemon:
                         partition_idx=msg["partition_idx"],
                         attempt=msg.get("attempt", 0)):
                 with profiling.maybe_span(prof, "daft.task.bind"):
-                    bound = bind_task_fragment(fragment, inputs)
+                    bound = bind_task_fragment(fragment, inputs,
+                                               cfg=msg["cfg"])
                 out = list(executor.run(bound))
             parts = collect_task_outputs(out, msg["expect_outputs"], fragment.schema)
-            refs = []
+            # Outputs land in the chunked shuffle plane: compressed chunk
+            # files + chunk-granular tickets, so downstream reduce tasks
+            # stream them with pipelined prefetch (and colocated ones read
+            # the files directly). query_id-tracked for teardown.
             shuffle_id = f"task-{uuid.uuid4().hex[:12]}"
+            writer = self.cache.writer(shuffle_id, len(parts),
+                                       query_id=msg.get("query_id", ""),
+                                       cfg=msg["cfg"], profiler=prof)
             for i, p in enumerate(parts):
-                ticket = self.cache.write_partition(shuffle_id, i, p)
-                refs.append({"kind": "flight", "address": self.flight_address,
-                             "ticket": ticket, "rows": len(p),
-                             "bytes": p.size_bytes(), "worker_id": self.worker_id})
+                writer.write_bucket(i, p)
+            metas = writer.finish()
+            refs = []
+            for i, p in enumerate(parts):
+                m = metas[i]
+                refs.append({"kind": "shuffle",
+                             "address": self.flight_address,
+                             "ticket": m.ticket, "rows": m.rows,
+                             "bytes": m.bytes_, "worker_id": self.worker_id,
+                             "chunks": [[c.ticket, c.rows, c.bytes_]
+                                        for c in m.chunks]})
             from daft_tpu.metrics import get_registry
 
             return {"ok": True, "refs": refs, "stats": stats.to_wire(),
@@ -323,6 +372,9 @@ class WorkerDaemon:
         except OSError:
             pass
         self.flight.shutdown()
+        from daft_tpu.distributed.shuffle import unregister_local_cache
+
+        unregister_local_cache(self.worker_id)
         self.cache.cleanup()
 
 
@@ -487,6 +539,19 @@ class RemoteWorker(Worker):
             _log.debug("daemon ping %s:%s failed", self._host, self._port,
                        exc_info=True)
             return False
+
+    def release_query(self, query_id: str) -> int:
+        """Best-effort shuffle teardown on the remote daemon: a dead or
+        unreachable daemon just means its files die with its tempdir —
+        never a teardown failure on the driver."""
+        try:
+            reply = self._request({"op": "release_query",
+                                   "query_id": query_id}, timeout=5.0)
+            return int(reply.get("removed", 0))
+        except Exception:
+            _log.debug("release_query(%s) on %s failed", query_id,
+                       self.address, exc_info=True)
+            return 0
 
     def kill(self) -> None:
         """Fault injection: crash the remote daemon process."""

@@ -20,16 +20,57 @@ from daft_tpu.micropartition import MicroPartition
 
 
 class ShuffleFlightServer(flight.FlightServerBase):
-    def __init__(self, cache: ShuffleCache, location: str = "grpc://0.0.0.0:0"):
+    def __init__(self, cache: ShuffleCache, location: str = "grpc://0.0.0.0:0",
+                 wire_codec: Optional[str] = None):
         super().__init__(location)
         self.cache = cache
+        if wire_codec is None:
+            # The server's wire codec follows the process's configured
+            # shuffle_compression (DAFT_SHUFFLE_COMPRESSION reaches daemons
+            # through the environment): 'none' must actually mean raw
+            # frames on the wire, not just raw files on disk.
+            from daft_tpu.context import get_context
+
+            wire_codec = getattr(get_context().execution_config,
+                                 "shuffle_compression", "auto")
+        self.wire_codec = wire_codec
 
     def do_get(self, context, ticket: flight.Ticket):
         from daft_tpu.distributed.partition_ref import partition_to_wire_table
+        from daft_tpu.distributed.shuffle import is_chunk_ticket, negotiate_codec
 
         key = ticket.ticket.decode()
+        # The wire rides the same negotiated codec as the chunk files, so
+        # a DCN transfer ships compressed frames end to end; readers need
+        # nothing — Arrow IPC self-describes its buffer compression.
+        options = pa.ipc.IpcWriteOptions(
+            compression=negotiate_codec(self.wire_codec))
+        if is_chunk_ticket(key):
+            # Chunk-granular serving (recovery probes, tests): one ticket =
+            # one chunk file.
+            table = self.cache.read_chunk(key)
+            return flight.RecordBatchStream(table, options=options)
+        meta = self.cache.partition_meta(key)  # KeyError -> flight error
+        if meta.chunks:
+            # ONE streaming RPC per partition, ONE wire batch per chunk
+            # file: the reduce side consumes chunk-granular morsels without
+            # paying a do_get round-trip per chunk, the server never
+            # materializes the whole partition, and transfer overlaps the
+            # client's downstream compute (gRPC stream buffering).
+            chunks = sorted(meta.chunks, key=lambda c: c.seq)
+            first = self.cache.read_chunk(chunks[0].ticket)
+
+            def gen():
+                yield first.combine_chunks().to_batches()[0]
+                for c in chunks[1:]:
+                    tbl = self.cache.read_chunk(c.ticket).combine_chunks()
+                    yield tbl.to_batches()[0]
+
+            return flight.GeneratorStream(first.schema, gen(),
+                                          options=options)
         mp = self.cache.read_partition(key)
-        return flight.RecordBatchStream(partition_to_wire_table(mp))
+        return flight.RecordBatchStream(partition_to_wire_table(mp),
+                                        options=options)
 
     def list_flights(self, context, criteria):
         for t in self.cache.tickets():
@@ -46,8 +87,10 @@ class ShuffleFlightServer(flight.FlightServerBase):
         return f"grpc://localhost:{self.port}"
 
 
-def start_shuffle_server(cache: ShuffleCache, port: int = 0) -> ShuffleFlightServer:
-    server = ShuffleFlightServer(cache, f"grpc://0.0.0.0:{port}")
+def start_shuffle_server(cache: ShuffleCache, port: int = 0,
+                         wire_codec: "Optional[str]" = None) -> ShuffleFlightServer:
+    server = ShuffleFlightServer(cache, f"grpc://0.0.0.0:{port}",
+                                 wire_codec=wire_codec)
     thread = threading.Thread(target=server.serve, daemon=True,
                               name="daft-shuffle-flight")
     thread.start()
@@ -136,13 +179,43 @@ def fetch_partition(address: str, ticket: str) -> MicroPartition:
     (No ``shuffle.fetch`` injection point here: every task-input fetch —
     local or Flight — already routes through ``worker.fetch_task_input``,
     which fires it exactly once per logical fetch.)"""
+    reader = _client_for(address).do_get(flight.Ticket(ticket.encode()))
+    table = reader.read_all()
+    from daft_tpu.distributed.partition_ref import partition_from_wire_table
+
+    return partition_from_wire_table(table)
+
+
+def _client_for(address: str) -> flight.FlightClient:
     with _client_lock:
         client = _client_cache.get(address)
         if client is None:
             client = flight.FlightClient(address)
             _client_cache[address] = client
-    reader = client.do_get(flight.Ticket(ticket.encode()))
-    table = reader.read_all()
-    from daft_tpu.distributed.partition_ref import partition_from_wire_table
+    return client
 
-    return partition_from_wire_table(table)
+
+def fetch_chunk_table(address: str, chunk_ticket: str) -> "pa.Table":
+    """Pull ONE shuffle chunk by chunk ticket (recovery probes, tests), as
+    a raw wire table."""
+    return _client_for(address).do_get(
+        flight.Ticket(chunk_ticket.encode())).read_all()
+
+
+def iter_partition_tables(address: str, ticket: str):
+    """Stream a shuffle partition chunk-at-a-time over ONE do_get: yields
+    one wire table per chunk file, in chunk-seq order — the same morsel
+    boundaries a colocated reader gets from the files directly, so merge
+    output is placement-invariant. The server pushes ahead through the
+    gRPC stream while the caller decodes, and the caller (a ShuffleReader
+    pool worker) overlaps refs with downstream compute."""
+    reader = _client_for(address).do_get(flight.Ticket(ticket.encode()))
+    schema = reader.schema
+    while True:
+        try:
+            chunk = reader.read_chunk()
+        except StopIteration:
+            return
+        if chunk.data is None:
+            continue
+        yield pa.Table.from_batches([chunk.data], schema=schema)

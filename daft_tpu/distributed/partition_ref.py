@@ -98,6 +98,67 @@ class FlightPartitionRef(PartitionRef):
         return self.worker_id
 
 
+@dataclass
+class ChunkRef:
+    """One fetchable chunk of a shuffle partition: ticket + sizes (the
+    chunk-granular identity lineage descriptors and prefetch planning
+    key on)."""
+
+    ticket: str
+    rows: int
+    bytes_: int
+
+    def to_wire(self) -> list:
+        return [self.ticket, self.rows, self.bytes_]
+
+    @staticmethod
+    def from_wire(d) -> "ChunkRef":
+        return ChunkRef(d[0], int(d[1]), int(d[2]))
+
+
+@dataclass
+class ShufflePartitionRef(FlightPartitionRef):
+    """A partition written through the chunked shuffle plane: a
+    :class:`FlightPartitionRef` (address + partition ticket) PLUS the
+    chunk ticket list so readers can stream chunk-at-a-time with pipelined
+    prefetch (distributed/shuffle.py ShuffleReader). ``address`` may be
+    empty for in-process caches (LocalWorker flight mode): fetch then
+    short-circuits through the local cache registry."""
+
+    chunks: List[ChunkRef] = field(default_factory=list)
+
+    def fetch(self) -> MicroPartition:
+        if not self.chunks:
+            # An empty bucket never wrote a chunk file — there is nothing
+            # to fetch (and no cache entry to look up). Schema-less empty:
+            # bind/concat paths drop zero-row parts before use.
+            return MicroPartition.empty()
+        from daft_tpu.distributed.shuffle import local_cache_for
+
+        cache = local_cache_for(self.worker_id)
+        if cache is not None:
+            from daft_tpu import metrics
+
+            mp = cache.read_partition(self.ticket)
+            if metrics.get_registry().enabled:
+                metrics.SHUFFLE_LOCAL_HITS.inc()
+                metrics.SHUFFLE_BYTES_FETCHED.inc(mp.size_bytes())
+            return mp
+        if not self.address:
+            # Deliberately NOT a PartitionFetchError: this ref cannot know
+            # its (slot, pos) within the consuming task, and callers
+            # (fetch_task_input / ShuffleReader._fetch_ref) re-raise
+            # PartitionFetchError verbatim — a hardcoded coordinate would
+            # point lineage recovery at the WRONG input. Let the caller's
+            # retry loop classify the loss with the correct descriptor.
+            raise DaftExecutionError(
+                f"shuffle partition {self.ticket} has no flight address and "
+                f"no local cache for worker {self.worker_id!r}")
+        from daft_tpu.distributed.flight import fetch_partition
+
+        return fetch_partition(self.address, self.ticket)
+
+
 def partition_to_wire_table(mp: MicroPartition) -> pa.Table:
     """Arrow table in the shuffle wire format: daft Schema in the IPC schema
     metadata (logical types — File/Image/Embedding — survive the host

@@ -349,12 +349,27 @@ class DistributedExecutor:
     def _num_shuffle_partitions(self, refs: List[PartitionRef]) -> int:
         return max(len(refs), 1)
 
+    @staticmethod
+    def _locality_of(*ref_lists: Sequence[PartitionRef]) -> Optional[dict]:
+        """Per-worker input-bytes map for a reduce task's inputs (from
+        map-side ShufflePartitionMeta sizes): the soft-locality hint
+        scheduler.assign uses to place the reduce where most of its bytes
+        already live."""
+        weights: dict = {}
+        for refs in ref_lists:
+            for r in refs:
+                loc = r.location
+                if loc:
+                    weights[loc] = weights.get(loc, 0) + r.size_bytes()
+        return weights or None
+
     def _reduce_tasks(self, buckets: List[List[PartitionRef]], make_fragment,
                       schema) -> List[PartitionRef]:
         tasks = []
         for j, bucket in enumerate(buckets):
             frag = make_fragment(BoundInput(0, schema))
-            tasks.append(Task(frag, [list(bucket)], partition_idx=j))
+            tasks.append(Task(frag, [list(bucket)], partition_idx=j,
+                              input_locality=self._locality_of(bucket)))
         return [r[0] for r in self._dispatch(tasks)]
 
     # -- wide ops ---------------------------------------------------------
@@ -603,7 +618,9 @@ class DistributedExecutor:
             frag = pp.HashJoin(BoundInput(0, left.schema), BoundInput(1, right.schema),
                                node.left_on, node.right_on, node.how, node.schema,
                                node.suffix, node.merged_keys)
-            tasks.append(Task(frag, [left_buckets[j], right_buckets[j]], partition_idx=j))
+            tasks.append(Task(frag, [left_buckets[j], right_buckets[j]], partition_idx=j,
+                              input_locality=self._locality_of(
+                                  left_buckets[j], right_buckets[j])))
         return [r[0] for r in self._dispatch(tasks)]
 
     def _run_AsofJoin(self, node: pp.AsofJoin) -> List[PartitionRef]:

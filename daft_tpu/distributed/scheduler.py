@@ -88,6 +88,27 @@ class Scheduler:
                 raise DaftExecutionError(
                     f"Hard-affinity worker {task.strategy.worker_id} unavailable"
                 )
+        # Soft locality (shuffle reduce placement): prefer the candidate
+        # holding the task's input bytes (map-side ShufflePartitionMeta
+        # sums, stamped by the planner) — every byte already local is a
+        # byte that never crosses the wire. Guarded two ways so locality
+        # never degrades into a hotspot: the holder must own a MAJORITY of
+        # the input (an even all-to-all exchange gains ~1/N from locality
+        # but would pile every reducer onto one host) and must have a free
+        # slot (a loaded holder yields to spread — Spark's locality-wait
+        # idea with load as the clock). Exclusion/death already filtered
+        # `candidates`, so speculation and worker loss degrade cleanly.
+        locality = task.input_locality
+        if locality:
+            total = sum(locality.values())
+            weighted = [(locality.get(w.worker_id, 0), w) for w in candidates]
+            best_bytes = max((b for b, _ in weighted), default=0)
+            if best_bytes > 0 and best_bytes * 2 > total:
+                top = [w for b, w in weighted if b == best_bytes]
+                free = [w for w in top if w.active_tasks() < w.num_slots]
+                if free:
+                    return min(enumerate(free),
+                               key=lambda iw: (iw[1].active_tasks(), iw[0]))[1]
         # Spread: least active tasks, round-robin tiebreak.
         idx = next(self._rr)
         return min(enumerate(candidates),

@@ -1,77 +1,275 @@
-"""Shuffle substrate: partitioned spill to local-disk Arrow IPC files.
+"""Shuffle plane: partitioned, compressed, spill-backed chunk transfer.
 
-Reference: src/daft-shuffles/src/shuffle_cache.rs:10-60 — map tasks write
+Reference: src/daft-shuffles/src/shuffle_cache.rs — map tasks write
 hash-partitioned Arrow IPC chunk files (4 MiB chunk target) under the
 configured shuffle dirs; a per-worker Flight server serves them to reduce
 tasks (server/flight_server.rs). The wire format stays Arrow IPC end-to-end.
+
+This module is the full map/reduce shuffle data plane:
+
+* **ShuffleWriter** (map side): buckets rows into per-reducer streams with
+  bounded in-memory buffers that flush to compressed chunk files
+  (lz4/zstd-framed Arrow IPC, codec-negotiated with a raw fallback) at
+  ``shuffle_chunk_bytes`` boundaries — chunk-granular tickets, not whole
+  partitions, so reduce-side consumption can start as soon as chunks exist.
+* **ShuffleReader** (reduce side): pipelined prefetch with bounded
+  look-ahead (the PR 8 ``run_stage``/``Prefetch`` discipline) overlaps
+  network fetch with downstream compute; chunk streams merge
+  DETERMINISTICALLY — yield order is a pure function of the ticket list
+  (ref order, then chunk sequence), never of arrival time, so the PR 8
+  byte-identity contract holds at any prefetch depth. Oversized fetch
+  backlogs spill to local disk under the existing MemoryManager permits.
+* **ShuffleCache**: per-worker chunk-file store with per-query lifecycle —
+  ``release_query`` deletes a query's files in the runner's ``finally``
+  (the same finally as admission-ticket release), and ``audit()`` is the
+  zero-leak hook load_storm/chaos_stress assert on.
+* **Intra-host short-circuit**: a reader colocated with the cache that
+  wrote a chunk reads the file directly (``register_local_cache``)
+  instead of going through the wire — counted as
+  ``daft_shuffle_local_hits_total``.
 """
 
 from __future__ import annotations
 
+import logging
 import os
 import threading
 import uuid
+import weakref
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Sequence
+from typing import Dict, Iterator, List, Optional, Sequence, Tuple
 
 import pyarrow as pa
 
 from daft_tpu.micropartition import MicroPartition
-from daft_tpu.recordbatch import RecordBatch
-from daft_tpu.schema import Schema
+from daft_tpu.physical import plan as pp
+
+_log = logging.getLogger("daft_tpu.shuffle")
 
 TARGET_CHUNK_BYTES = 4 * 1024 * 1024  # reference: shuffle_cache.rs:30
+
+#: Chunk tickets are "<partition ticket>@<seq>"; partition tickets are
+#: "<shuffle_id>/<bucket>". '@' never appears in shuffle ids or buckets.
+_CHUNK_SEP = "@"
+
+
+# ------------------------------------------------------------------ #
+# Codec negotiation                                                    #
+# ------------------------------------------------------------------ #
+_codec_warned: set = set()
+
+
+def negotiate_codec(preference: str = "auto") -> Optional[str]:
+    """Resolve the configured compression preference against what this
+    build of Arrow actually ships: ``auto`` prefers lz4 then zstd, a named
+    codec is honored when available, and everything falls back to raw
+    (None) rather than failing — the reduce side never needs to know, the
+    IPC stream self-describes its compression."""
+    if preference in (None, "none", "raw", ""):
+        return None
+    if preference == "auto":
+        for codec in ("lz4", "zstd"):
+            if _codec_available(codec):
+                return codec
+        return None
+    if preference in ("lz4", "zstd"):
+        if _codec_available(preference):
+            return preference
+        if preference not in _codec_warned:
+            _codec_warned.add(preference)
+            _log.warning("shuffle codec %r unavailable in this pyarrow "
+                         "build; falling back to raw", preference)
+        return None
+    if preference not in _codec_warned:
+        _codec_warned.add(preference)
+        _log.warning("unknown shuffle codec %r; falling back to raw",
+                     preference)
+    return None
+
+
+def _codec_available(codec: str) -> bool:
+    name = "lz4_frame" if codec == "lz4" else codec
+    try:
+        return bool(pa.Codec.is_available(name))
+    except (ValueError, TypeError):
+        return False
+
+
+def _ipc_options(codec: Optional[str]) -> "pa.ipc.IpcWriteOptions":
+    return pa.ipc.IpcWriteOptions(compression=codec)
+
+
+# ------------------------------------------------------------------ #
+# Chunk / partition metadata                                           #
+# ------------------------------------------------------------------ #
+@dataclass
+class ChunkMeta:
+    """One compressed chunk file of one (shuffle, bucket) partition."""
+
+    ticket: str          # "<shuffle_id>/<bucket>@<seq>"
+    path: str
+    rows: int
+    bytes_: int          # uncompressed (logical) bytes
+    file_bytes: int      # on-disk (compressed) bytes
+    codec: Optional[str]
+    seq: int
 
 
 @dataclass
 class ShufflePartitionMeta:
     ticket: str
-    files: List[str] = field(default_factory=list)
+    chunks: List[ChunkMeta] = field(default_factory=list)
     rows: int = 0
     bytes_: int = 0
+    query_id: str = ""
+
+    @property
+    def files(self) -> List[str]:
+        return [c.path for c in self.chunks]
 
 
+def is_chunk_ticket(ticket: str) -> bool:
+    return _CHUNK_SEP in ticket
+
+
+def split_chunk_ticket(ticket: str) -> Tuple[str, int]:
+    base, _, seq = ticket.rpartition(_CHUNK_SEP)
+    return base, int(seq)
+
+
+# ------------------------------------------------------------------ #
+# Local cache registry (intra-host short-circuit)                      #
+# ------------------------------------------------------------------ #
+_local_caches: Dict[str, "ShuffleCache"] = {}
+_registry_lock = threading.Lock()
+#: Every live cache in this process (weak): the audit surface.
+_all_caches: "weakref.WeakSet[ShuffleCache]" = weakref.WeakSet()
+
+
+def register_local_cache(worker_id: str, cache: "ShuffleCache") -> None:
+    """Publish ``cache`` as worker ``worker_id``'s chunk store in THIS
+    process: readers colocated with the writer hand off through the local
+    filesystem instead of the Flight wire."""
+    with _registry_lock:
+        _local_caches[worker_id] = cache
+
+
+def unregister_local_cache(worker_id: str) -> None:
+    with _registry_lock:
+        _local_caches.pop(worker_id, None)
+
+
+def local_cache_for(worker_id: Optional[str]) -> Optional["ShuffleCache"]:
+    if not worker_id:
+        return None
+    with _registry_lock:
+        return _local_caches.get(worker_id)
+
+
+def audit_shuffle_leaks(query_id: Optional[str] = None) -> dict:
+    """Zero-leak audit hook (load_storm / chaos_stress): every chunk file
+    still held by any live cache in this process, optionally filtered to
+    one query. A clean teardown leaves ``files == 0``."""
+    files = 0
+    queries: set = set()
+    for cache in list(_all_caches):
+        a = cache.audit()
+        for qid, n in a["queries"].items():
+            if query_id is not None and qid != query_id:
+                continue
+            files += n
+            if n:
+                queries.add(qid)
+    return {"files": files, "queries": sorted(queries)}
+
+
+# ------------------------------------------------------------------ #
+# ShuffleCache                                                         #
+# ------------------------------------------------------------------ #
 class ShuffleCache:
-    """Per-worker shuffle spill: one directory per shuffle, one IPC file per
-    (map task, bucket) chunk; partitions are retrievable by ticket."""
+    """Per-worker shuffle chunk store: one directory per cache, one
+    compressed Arrow IPC file per (shuffle, bucket, chunk); partitions are
+    retrievable whole by partition ticket or chunk-at-a-time by chunk
+    ticket. Files are tracked per query so teardown (success, cancel,
+    worker death observed from the driver) deletes exactly that query's
+    chunks."""
 
     def __init__(self, dirs: Sequence[str] = ("/tmp",)):
-        self.root = os.path.join(dirs[0], f"daft-shuffle-{uuid.uuid4().hex[:8]}")
+        root_dir = dirs[0] if not isinstance(dirs, str) else dirs
+        self.root = os.path.join(root_dir, f"daft-shuffle-{uuid.uuid4().hex[:8]}")
         os.makedirs(self.root, exist_ok=True)
         self._meta: Dict[str, ShufflePartitionMeta] = {}
+        self._by_query: Dict[str, set] = {}  # query_id -> partition tickets
+        self._seq: Dict[str, int] = {}       # partition ticket -> next chunk seq
         self._lock = threading.Lock()
+        _all_caches.add(self)
 
-    def write_partition(self, shuffle_id: str, bucket: int, mp: MicroPartition) -> str:
-        """Spill one bucket's data from a map task; returns its ticket."""
-        from daft_tpu.distributed.partition_ref import partition_to_wire_table
+    # -- write ---------------------------------------------------------- #
+    def writer(self, shuffle_id: str, num_buckets: int, query_id: str = "",
+               cfg=None, profiler=None) -> "ShuffleWriter":
+        return ShuffleWriter(self, shuffle_id, num_buckets,
+                             query_id=query_id, cfg=cfg, profiler=profiler)
 
-        ticket = f"{shuffle_id}/{bucket}"
-        table = partition_to_wire_table(mp)
-        path = os.path.join(self.root, f"{shuffle_id}-{bucket}-{uuid.uuid4().hex[:8]}.arrow")
-        with pa.OSFile(path, "wb") as f:
-            with pa.ipc.new_stream(f, table.schema) as writer:
-                # Chunk to the target IPC chunk size.
-                if table.nbytes > TARGET_CHUNK_BYTES and table.num_rows > 1:
-                    rows_per_chunk = max(1, table.num_rows * TARGET_CHUNK_BYTES // max(table.nbytes, 1))
-                    for start in range(0, table.num_rows, rows_per_chunk):
-                        writer.write_table(table.slice(start, rows_per_chunk))
-                else:
-                    writer.write_table(table)
+    def write_partition(self, shuffle_id: str, bucket: int, mp: MicroPartition,
+                        query_id: str = "", cfg=None) -> str:
+        """One-shot bucket write (compat surface): chunk + compress ``mp``
+        through a writer; returns the partition ticket."""
+        w = self.writer(shuffle_id, bucket + 1, query_id=query_id, cfg=cfg)
+        w.write_bucket(bucket, mp)
+        metas = w.finish()
+        return metas[bucket].ticket
+
+    def _reserve_seq(self, ticket: str) -> int:
+        """Atomically mint the next chunk sequence number for a partition
+        ticket — CACHE-side, not writer-side, so two writers appending to
+        the same (shuffle, bucket) can never mint colliding chunk
+        tickets."""
         with self._lock:
-            meta = self._meta.setdefault(ticket, ShufflePartitionMeta(ticket))
-            meta.files.append(path)
-            meta.rows += table.num_rows
-            meta.bytes_ += table.nbytes
-        return ticket
+            seq = self._seq.get(ticket, 0)
+            self._seq[ticket] = seq + 1
+            return seq
 
-    def read_partition(self, ticket: str) -> MicroPartition:
+    def _add_chunk(self, ticket: str, chunk: ChunkMeta, query_id: str) -> None:
         with self._lock:
             meta = self._meta.get(ticket)
-        if meta is None:
+            if meta is None:
+                meta = ShufflePartitionMeta(ticket, query_id=query_id)
+                self._meta[ticket] = meta
+                self._by_query.setdefault(query_id, set()).add(ticket)
+            meta.chunks.append(chunk)
+            meta.rows += chunk.rows
+            meta.bytes_ += chunk.bytes_
+
+    # -- read ----------------------------------------------------------- #
+    def read_chunk(self, chunk_ticket: str) -> pa.Table:
+        base, seq = split_chunk_ticket(chunk_ticket)
+        with self._lock:
+            meta = self._meta.get(base)
+            chunk = None
+            if meta is not None:
+                for c in meta.chunks:
+                    if c.seq == seq:
+                        chunk = c
+                        break
+        if chunk is None:
+            raise KeyError(f"Unknown shuffle chunk ticket {chunk_ticket!r}")
+        with pa.OSFile(chunk.path, "rb") as f:
+            with pa.ipc.open_stream(f) as reader:
+                return reader.read_all()
+
+    def read_partition(self, ticket: str) -> MicroPartition:
+        if is_chunk_ticket(ticket):
+            from daft_tpu.distributed.partition_ref import partition_from_wire_table
+
+            return partition_from_wire_table(self.read_chunk(ticket))
+        with self._lock:
+            meta = self._meta.get(ticket)
+            chunks = sorted(meta.chunks, key=lambda c: c.seq) if meta else None
+        if chunks is None:
             raise KeyError(f"Unknown shuffle ticket {ticket!r}")
         tables = []
-        for path in meta.files:
-            with pa.OSFile(path, "rb") as f:
+        for c in chunks:
+            with pa.OSFile(c.path, "rb") as f:
                 with pa.ipc.open_stream(f) as reader:
                     tables.append(reader.read_all())
         if not tables:
@@ -88,7 +286,516 @@ class ShuffleCache:
         with self._lock:
             return list(self._meta)
 
+    # -- lifecycle ------------------------------------------------------ #
+    def release_query(self, query_id: str) -> int:
+        """Delete every chunk file ``query_id`` wrote through this cache.
+        Idempotent; returns the number of files removed. Runs in the same
+        finally as ticket release / query teardown on the driver."""
+        with self._lock:
+            tickets = self._by_query.pop(query_id, set())
+            metas = [self._meta.pop(t) for t in tickets if t in self._meta]
+            for t in tickets:
+                self._seq.pop(t, None)
+        removed = 0
+        for meta in metas:
+            for c in meta.chunks:
+                try:
+                    os.unlink(c.path)
+                    removed += 1
+                except OSError:
+                    pass  # already gone (cleanup raced shutdown)
+        return removed
+
+    def audit(self) -> dict:
+        """Per-query live chunk-file counts — the zero-leak surface."""
+        with self._lock:
+            queries = {qid: sum(len(self._meta[t].chunks)
+                                for t in tickets if t in self._meta)
+                       for qid, tickets in self._by_query.items()}
+        return {"root": self.root, "queries": queries,
+                "files": sum(queries.values())}
+
     def cleanup(self) -> None:
         import shutil
 
+        with self._lock:
+            self._meta.clear()
+            self._by_query.clear()
+            self._seq.clear()
         shutil.rmtree(self.root, ignore_errors=True)
+
+
+# ------------------------------------------------------------------ #
+# ShuffleWriter (map side)                                             #
+# ------------------------------------------------------------------ #
+class ShuffleWriter:
+    """Buckets map output into per-reducer chunk streams: rows accumulate
+    in a bounded in-memory buffer per bucket and flush to a compressed
+    chunk file whenever the buffer crosses ``shuffle_chunk_bytes`` — map
+    memory stays bounded by ``buckets x chunk_bytes`` regardless of
+    partition size, and every flush mints a chunk ticket a reducer can
+    fetch immediately."""
+
+    def __init__(self, cache: ShuffleCache, shuffle_id: str, num_buckets: int,
+                 query_id: str = "", cfg=None, profiler=None):
+        self.cache = cache
+        self.shuffle_id = shuffle_id
+        self.num_buckets = num_buckets
+        self.query_id = query_id
+        self.profiler = profiler
+        pref = getattr(cfg, "shuffle_compression", "auto") if cfg is not None \
+            else "auto"
+        self.codec = negotiate_codec(pref)
+        self.chunk_bytes = int(getattr(cfg, "shuffle_chunk_bytes",
+                                       TARGET_CHUNK_BYTES) or TARGET_CHUNK_BYTES)
+        self._buffers: Dict[int, List[pa.Table]] = {}
+        self._buffered: Dict[int, int] = {}
+        self._metas: Dict[int, str] = {}  # bucket -> partition ticket
+
+    def _ticket(self, bucket: int) -> str:
+        return f"{self.shuffle_id}/{bucket}"
+
+    def write_bucket(self, bucket: int, mp: MicroPartition) -> None:
+        """Append one map output partition to ``bucket``'s chunk stream."""
+        from daft_tpu.distributed.partition_ref import partition_to_wire_table
+
+        self.add_table(bucket, partition_to_wire_table(mp))
+
+    def add_table(self, bucket: int, table: pa.Table) -> None:
+        if table.num_rows == 0 and bucket in self._metas:
+            return
+        self._metas.setdefault(bucket, self._ticket(bucket))
+        if table.num_rows:
+            buf = self._buffers.setdefault(bucket, [])
+            buf.append(table)
+            self._buffered[bucket] = self._buffered.get(bucket, 0) + table.nbytes
+        # Oversized buffers flush NOW (possibly several chunks): the
+        # bounded-buffer contract.
+        while self._buffered.get(bucket, 0) >= self.chunk_bytes:
+            self._flush(bucket)
+
+    def _flush(self, bucket: int) -> None:
+        buf = self._buffers.get(bucket)
+        if not buf:
+            self._buffered[bucket] = 0
+            return
+        table = pa.concat_tables(buf) if len(buf) > 1 else buf[0]
+        # Split at the chunk target so one giant buffered append still
+        # produces ~chunk-sized files; the remainder stays buffered.
+        if table.nbytes > self.chunk_bytes and table.num_rows > 1:
+            rows_per_chunk = max(
+                1, table.num_rows * self.chunk_bytes // max(table.nbytes, 1))
+            head = table.slice(0, rows_per_chunk)
+            rest = table.slice(rows_per_chunk)
+            self._buffers[bucket] = [rest] if rest.num_rows else []
+            self._buffered[bucket] = rest.nbytes if rest.num_rows else 0
+            self._write_chunk(bucket, head)
+            return
+        self._buffers[bucket] = []
+        self._buffered[bucket] = 0
+        self._write_chunk(bucket, table)
+
+    def _write_chunk(self, bucket: int, table: pa.Table) -> None:
+        from daft_tpu import metrics, profiling
+
+        # Seq minted by the CACHE (atomic): appends from a second writer
+        # onto the same (shuffle, bucket) must never collide tickets.
+        seq = self.cache._reserve_seq(self._ticket(bucket))
+        ticket = f"{self._ticket(bucket)}{_CHUNK_SEP}{seq}"
+        path = os.path.join(
+            self.cache.root,
+            f"{self.shuffle_id}-{bucket}-{seq}-{uuid.uuid4().hex[:8]}.arrow")
+        with profiling.maybe_span(self.profiler, "daft.shuffle.write",
+                                  ticket=ticket, rows=table.num_rows,
+                                  nbytes=table.nbytes,
+                                  codec=self.codec or "raw"):
+            with pa.OSFile(path, "wb") as f:
+                with pa.ipc.new_stream(f, table.schema,
+                                       options=_ipc_options(self.codec)) as w:
+                    w.write_table(table)
+        file_bytes = os.path.getsize(path)
+        self.cache._add_chunk(
+            self._ticket(bucket),
+            ChunkMeta(ticket, path, table.num_rows, table.nbytes, file_bytes,
+                      self.codec, seq),
+            self.query_id)
+        if metrics.get_registry().enabled:
+            metrics.SHUFFLE_BYTES_WRITTEN.inc(table.nbytes)
+            metrics.SHUFFLE_CHUNKS.labels(self.codec or "raw").inc()
+
+    def finish(self) -> Dict[int, ShufflePartitionMeta]:
+        """Flush every buffer and return per-bucket partition metadata.
+        Buckets that never saw a row still get (empty) metadata so the
+        exchange keeps its N-output contract."""
+        for bucket in list(self._buffers):
+            while self._buffered.get(bucket, 0) > 0 or self._buffers.get(bucket):
+                self._flush(bucket)
+        out: Dict[int, ShufflePartitionMeta] = {}
+        for bucket, ticket in self._metas.items():
+            try:
+                out[bucket] = self.cache.partition_meta(ticket)
+            except KeyError:  # opened but all-empty bucket: no chunk files
+                out[bucket] = ShufflePartitionMeta(ticket,
+                                                   query_id=self.query_id)
+        return out
+
+
+# ------------------------------------------------------------------ #
+# ShuffleReadSource (reduce-side plan leaf)                            #
+# ------------------------------------------------------------------ #
+class ShuffleReadSource(pp.ShuffleReadSource):
+    """Leaf node binding one task input slot to a streaming shuffle read:
+    the executor pulls a :class:`ShuffleReader` built from ``entries``
+    (``(slot, pos, ref)`` triples, in deterministic input order), so
+    reduce-side compute overlaps chunk fetch instead of waiting for the
+    whole exchange to materialize. Built worker-side by
+    ``bind_task_fragment`` — it never crosses the wire. Subclasses the
+    physical-plan node of the same name (whose ``partition_refs`` surface
+    is the legacy eager read) so both bind to one executor handler."""
+
+    def __init__(self, entries: List[tuple], schema):
+        super().__init__([r for _, _, r in entries], schema)
+        self.entries = entries
+
+    def describe(self):
+        return f"ShuffleReadSource[{len(self.entries)} refs]"
+
+
+# ------------------------------------------------------------------ #
+# ShuffleReader (reduce side)                                          #
+# ------------------------------------------------------------------ #
+class ShuffleReader:
+    """Pipelined, deterministic, spill-backed chunk stream over one input
+    slot's refs.
+
+    * **Order**: chunks yield in (ref position, chunk seq) order — a pure
+      function of the ticket list. Prefetch only changes WHEN a chunk's
+      bytes arrive, never where they land in the stream.
+    * **Pipelining**: when any unit must cross the wire, up to
+      ``shuffle_prefetch_depth`` chunk fetches run concurrently on a
+      dedicated pool (PR 8 ``run_stage``/``ordered_prefetch_map`` with its
+      bounded in-flight queue — the feeder thread is the only waiter, so
+      sharing rules hold), overlapping network latency + decode with
+      downstream compute. A stream whose every unit short-circuits through
+      a LOCAL cache fetches inline instead — page-cached file reads have
+      no latency worth a pool's thread tax, and the yielded stream is
+      IDENTICAL either way (same chunks, same order), so the choice is
+      mechanics, never semantics.
+    * **Memory**: each in-flight chunk holds a MemoryManager permit; when
+      the permit can't be had quickly the fetched chunk spills to local
+      disk instead of holding memory (``daft_shuffle_bytes_spilled``) and
+      is re-read at its yield slot.
+    * **Faults**: ``shuffle.fetch`` injection fires once per REF (the
+      per-logical-fetch contract chaos specs count on); fetch failures
+      raise :class:`PartitionFetchError` with chunk-granular descriptors
+      ``{slot, pos, worker_id, ticket}`` so lineage recovery recomputes
+      only the lost map task.
+    """
+
+    _PERMIT_TIMEOUT_S = 0.2
+
+    def __init__(self, entries: Sequence[tuple], schema, cfg=None,
+                 memory=None, token=None, profiler=None):
+        self.entries = list(entries)
+        self.schema = schema
+        self.cfg = cfg
+        self.memory = memory
+        self.token = token
+        self.profiler = profiler
+        # depth<=1 (incl. an explicit 0) means NO look-ahead: inline
+        # fetching, no pool — never silently coerced back to the default.
+        d = getattr(cfg, "shuffle_prefetch_depth", 4)
+        self.depth = max(int(d) if d is not None else 4, 1)
+        self._spill_lock = threading.Lock()
+        # Permit ledger: every admitted item's held bytes, settled exactly
+        # once — at its yield slot, on fetch-retry release, or in bulk at
+        # reader teardown. Without it, a consumer abandoning the stream
+        # early (LIMIT, cancel, error) would leak the permits of every
+        # prefetched-but-unyielded chunk against the process-global
+        # MemoryManager.
+        self._ledger: Dict[int, int] = {}
+        self._ledger_lock = threading.Lock()
+        self._ledger_closed = False
+
+    # -- fetch units ----------------------------------------------------- #
+    def _units(self) -> Iterator[tuple]:
+        """Deterministic fetch-unit stream: one ``(slot, pos, ref)`` unit
+        per ref, in input order. A unit's fetch yields one payload item
+        per CHUNK (so downstream morsel boundaries are a pure function of
+        the chunk files, identical for local and wire reads); chunk-less
+        shuffle refs (empty buckets) are skipped outright."""
+        from daft_tpu.distributed.partition_ref import ShufflePartitionRef
+
+        for slot, pos, ref in self.entries:
+            if isinstance(ref, ShufflePartitionRef) and not ref.chunks:
+                continue  # empty bucket: nothing to fetch
+            yield (slot, pos, ref)
+
+    def _fetch_ref(self, unit: tuple) -> List[tuple]:
+        """Worker-side fetch of one ref's chunk stream; returns the list of
+        ``(kind, payload, held)`` items (kind ``mem`` | ``spill``), one per
+        chunk. The ``shuffle.fetch`` fault point fires exactly once per
+        logical fetch (the eager path's contract); genuine wire blips get
+        two in-place retries before being declared partition loss, with
+        any partially-admitted items released first."""
+        import time as _time
+
+        from daft_tpu import metrics, profiling
+        from daft_tpu.distributed.faults import FaultInjected, maybe_inject
+        from daft_tpu.distributed.partition_ref import PartitionFetchError
+
+        slot, pos, ref = unit
+        ticket = getattr(ref, "ticket", "")
+        lost = [{"slot": slot, "pos": pos, "worker_id": ref.location,
+                 "ticket": ticket}]
+        if ref.location and self._worker_dead(ref.location):
+            raise PartitionFetchError(
+                f"shuffle partition {ticket or 'input'} unreachable: worker "
+                f"{ref.location} is dead", lost)
+        from daft_tpu.distributed.worker import _FETCH_RETRIES
+
+        last: Optional[Exception] = None
+        items: List[tuple] = []
+        t0 = _time.perf_counter()
+        for attempt in range(_FETCH_RETRIES + 1):
+            items = []
+            try:
+                maybe_inject("shuffle.fetch", ref=ref, worker_id=ref.location)
+                with profiling.maybe_span(self.profiler, "daft.shuffle.fetch",
+                                          ticket=ticket,
+                                          worker=ref.location or "driver"):
+                    # Appended one-by-one (never a comprehension): the
+                    # except blocks below must see — and release — every
+                    # item admitted before the failure, or their permits
+                    # and spill files leak.
+                    for p in self._payloads(ref):
+                        items.append(self._admit(p))
+                last = None
+                break
+            except PartitionFetchError:
+                self._release_items(items)
+                raise
+            except FaultInjected as e:
+                # Injected faults simulate a dead host: never absorbed by
+                # in-place retries (they'd consume extra spec hits and
+                # mask recovery) — same contract as fetch_task_input.
+                self._release_items(items)
+                last = e
+                break
+            except Exception as e:  # noqa: BLE001 — persistent failure IS loss
+                self._release_items(items)
+                last = e
+                if attempt < _FETCH_RETRIES:
+                    _time.sleep(0.05 * (2 ** attempt))
+        if last is not None:
+            # Chunk-granular identity when we know it: the local read path
+            # annotates its failing chunk ticket, so recovery diagnostics
+            # pin the exact lost chunk, not just the partition.
+            lost[0]["ticket"] = getattr(last, "_daft_chunk_ticket", "") \
+                or ticket
+            raise PartitionFetchError(
+                f"failed to fetch shuffle partition "
+                f"{lost[0]['ticket'] or 'input'} from "
+                f"{ref.location or 'driver'}: {last}", lost) from last
+        if metrics.get_registry().enabled:
+            metrics.SHUFFLE_FETCH_SECONDS.observe(_time.perf_counter() - t0)
+        return items
+
+    def _payloads(self, ref) -> Iterator:
+        """One payload per chunk of ``ref``: local chunk files when the
+        cache is colocated, ONE streaming do_get otherwise (a wire batch
+        per chunk — same boundaries either way), whole-fetch for
+        non-chunked refs."""
+        from daft_tpu import metrics
+        from daft_tpu.distributed.partition_ref import ShufflePartitionRef
+
+        enabled = metrics.get_registry().enabled
+        if not isinstance(ref, ShufflePartitionRef) or not ref.chunks:
+            payload = ref.fetch()
+            if enabled:
+                metrics.SHUFFLE_BYTES_FETCHED.inc(payload.size_bytes())
+            yield payload
+            return
+        cache = local_cache_for(ref.location)
+        if cache is not None:
+            for chunk in ref.chunks:
+                try:
+                    table = cache.read_chunk(chunk.ticket)
+                except Exception as e:
+                    e._daft_chunk_ticket = chunk.ticket
+                    raise
+                if enabled:
+                    metrics.SHUFFLE_LOCAL_HITS.inc()
+                    metrics.SHUFFLE_BYTES_FETCHED.inc(table.nbytes)
+                yield table
+            return
+        from daft_tpu.distributed.flight import iter_partition_tables
+
+        for table in iter_partition_tables(ref.address, ref.ticket):
+            if enabled:
+                metrics.SHUFFLE_BYTES_FETCHED.inc(table.nbytes)
+            yield table
+
+    def _book(self, item: tuple) -> tuple:
+        """Register an admitted item's permit in the ledger; an admit that
+        raced reader teardown releases immediately instead (the executor's
+        ``_add_held`` discipline)."""
+        kind, payload, held = item
+        if not held:
+            return item
+        with self._ledger_lock:
+            if not self._ledger_closed:
+                self._ledger[id(item)] = held
+                return item
+        self.memory.release(held)
+        return (kind, payload, 0)
+
+    def _settle(self, item: tuple) -> None:
+        """Release an item's permit exactly once (idempotent vs teardown)."""
+        _, _, held = item
+        if not held or self.memory is None:
+            return
+        with self._ledger_lock:
+            booked = self._ledger.pop(id(item), None)
+        if booked:
+            self.memory.release(held)
+
+    def _close_ledger(self) -> None:
+        """Teardown: release every still-booked permit (prefetched items
+        the consumer never reached)."""
+        with self._ledger_lock:
+            self._ledger_closed = True
+            leftover = sum(self._ledger.values())
+            self._ledger.clear()
+        if leftover and self.memory is not None:
+            self.memory.release(leftover)
+
+    def _release_items(self, items: List[tuple]) -> None:
+        for item in items:
+            self._settle(item)
+            if item[0] == "spill":
+                try:
+                    os.unlink(item[1])
+                except OSError:
+                    pass
+
+    def _worker_dead(self, worker_id: str) -> bool:
+        from daft_tpu.distributed.worker import _dead_local_workers
+
+        return worker_id in _dead_local_workers
+
+    def _admit(self, payload):
+        """Account the fetched bytes: hold a memory permit, or spill the
+        chunk to local disk when the permit can't be had quickly (fetch
+        backlog larger than the budget must not OOM the reducer)."""
+        nbytes = payload.nbytes if isinstance(payload, pa.Table) \
+            else payload.size_bytes()
+        mem = self.memory
+        if mem is None or mem.limit is None:
+            return ("mem", payload, 0)
+        if mem.acquire(nbytes, timeout=self._PERMIT_TIMEOUT_S,
+                       token=self.token):
+            held = min(nbytes, mem.limit)
+            return self._book(("mem", payload, held))
+        from daft_tpu import metrics
+        from daft_tpu.execution.spill import spill_metrics
+
+        path = os.path.join(self._spill_root(),
+                            f"shuffle-fetch-{uuid.uuid4().hex[:12]}.arrow")
+        table = payload if isinstance(payload, pa.Table) else None
+        if table is None:
+            from daft_tpu.distributed.partition_ref import partition_to_wire_table
+
+            table = partition_to_wire_table(payload)
+        with pa.OSFile(path, "wb") as f:
+            with pa.ipc.new_stream(f, table.schema) as w:
+                w.write_table(table)
+        if metrics.get_registry().enabled:
+            metrics.SHUFFLE_BYTES_SPILLED.inc(nbytes)
+        # Shared spill accounting (execution/spill.py): the profiler's
+        # per-operator spill attribution and daft_spill_* totals see
+        # shuffle-backlog spill like any sink spill.
+        spill_metrics.record(nbytes, 1)
+        return ("spill", path, 0)
+
+    def _spill_root(self) -> str:
+        # Locked check-then-set: concurrent pool threads spilling their
+        # first chunk must agree on ONE directory (the loser's mkdtemp
+        # would never be cleaned up by __iter__'s finally).
+        with self._spill_lock:
+            root = getattr(self, "_spill_dir", None)
+            if root is None:
+                import tempfile
+
+                root = tempfile.mkdtemp(prefix="daft-shuffle-spill-")
+                self._spill_dir = root
+            return root
+
+    def _unit_is_remote(self, unit: tuple) -> bool:
+        """A unit crosses the wire when it has no local cache to
+        short-circuit through (only those benefit from pipelined
+        prefetch)."""
+        from daft_tpu.distributed.partition_ref import ShufflePartitionRef
+
+        _, _, ref = unit
+        if not isinstance(ref, ShufflePartitionRef) or not ref.chunks:
+            return False  # whole-ref units are driver/in-process fetches
+        return local_cache_for(ref.location) is None
+
+    # -- the merged stream ----------------------------------------------- #
+    def __iter__(self) -> Iterator[MicroPartition]:
+        from daft_tpu import profiling
+        from daft_tpu.distributed.partition_ref import partition_from_wire_table
+        from daft_tpu.execution.pipeline import ordered_prefetch_map
+
+        yielded = False
+        units = list(self._units())
+        # Pipelined prefetch only earns its thread tax when refs cross the
+        # wire; an all-local stream (intra-host short-circuit) reads
+        # inline. Either way the yielded stream is identical: one morsel
+        # per chunk, in (ref order, chunk seq) order.
+        depth = self.depth if any(map(self._unit_is_remote, units)) else 1
+        stream = ordered_prefetch_map(iter(units), self._fetch_ref,
+                                      depth=depth, name="shuffle-fetch")
+        try:
+            with profiling.maybe_span(self.profiler, "daft.shuffle.merge",
+                                      refs=len(self.entries)):
+                # Ordered prefetch = the deterministic merge: per-ref item
+                # lists pop in submission order however the fetch pool
+                # interleaves.
+                for items in stream:
+                    for item in items:
+                        kind, payload, _held = item
+                        try:
+                            if kind == "spill":
+                                with pa.OSFile(payload, "rb") as f:
+                                    with pa.ipc.open_stream(f) as reader:
+                                        table = reader.read_all()
+                                try:
+                                    os.unlink(payload)
+                                except OSError:
+                                    pass
+                                mp = partition_from_wire_table(table)
+                            elif isinstance(payload, pa.Table):
+                                mp = partition_from_wire_table(payload)
+                            else:
+                                mp = payload
+                        finally:
+                            self._settle(item)
+                        if len(mp):
+                            yielded = True
+                            yield mp
+            if not yielded:
+                yield MicroPartition.empty(self.schema)
+        finally:
+            # Explicit close releases the feeder + dedicated pool NOW
+            # (abandonment must not wait for GC — the Prefetch contract),
+            # then the ledger releases every prefetched-but-unyielded
+            # item's permit and the spill dir takes any orphan files.
+            stream.close()
+            self._close_ledger()
+            spill_dir = getattr(self, "_spill_dir", None)
+            if spill_dir is not None:
+                import shutil
+
+                shutil.rmtree(spill_dir, ignore_errors=True)

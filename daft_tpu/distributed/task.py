@@ -82,6 +82,12 @@ class Task:
     # retried/speculated attempts carry it into span attributes so the
     # timeline distinguishes a straggler duplicate from its original.
     attempt: int = 0
+    # Soft-locality hint for reduce tasks: worker_id -> input bytes hosted
+    # there (from map-side ShufflePartitionMeta). scheduler.assign prefers
+    # the worker holding the largest share — every byte it holds is a byte
+    # that never crosses the wire — but falls back cleanly to spread under
+    # exclusion/speculation/worker death. Hard affinity still wins.
+    input_locality: Optional[dict] = None
 
     def input_size_bytes(self) -> int:
         return sum(r.size_bytes() for refs in self.inputs for r in refs)

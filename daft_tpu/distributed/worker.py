@@ -49,6 +49,14 @@ class Worker:
         """Liveness probe; False means the worker did not answer."""
         return True
 
+    def release_query(self, query_id: str) -> int:
+        """Delete shuffle chunk files this worker holds for ``query_id``
+        (no-op for workers without a chunk store). Called from the
+        driver's query-teardown finally — the same finally that releases
+        the admission ticket — so cancel/timeout/chaos paths free disk
+        exactly like success."""
+        return 0
+
     def shutdown(self) -> None:
         pass
 
@@ -89,7 +97,11 @@ def fetch_task_input(ref: PartitionRef, slot: int, pos: int) -> MicroPartition:
 
     from daft_tpu.distributed.faults import FaultInjected
 
-    lost = [{"slot": slot, "pos": pos, "worker_id": ref.location}]
+    # Chunk-granular identity: descriptors name the shuffle ticket when the
+    # ref has one, so recovery diagnostics (and tests) can pin the exact
+    # lost map output, not just a (slot, pos) coordinate.
+    lost = [{"slot": slot, "pos": pos, "worker_id": ref.location,
+             "ticket": getattr(ref, "ticket", "")}]
     if ref.location and ref.location in _dead_local_workers:
         raise PartitionFetchError(
             f"partition input[{slot}][{pos}] unreachable: worker "
@@ -115,17 +127,53 @@ def fetch_task_input(ref: PartitionRef, slot: int, pos: int) -> MicroPartition:
         f"{ref.location or 'driver'}: {last}", lost) from last
 
 
-def bind_task_fragment(fragment: pp.PhysicalPlan, inputs: Sequence[Sequence[PartitionRef]]) -> pp.PhysicalPlan:
-    """Replace BoundInput leaves with InMemorySource over fetched partitions.
+def _slot_streams(refs: Sequence[PartitionRef], cfg) -> bool:
+    """True when this input slot should bind to a streaming shuffle read:
+    pipelined fetch is on and at least one ref carries chunk tickets.
+    Non-chunked refs in a mixed slot ride the same reader (whole-ref fetch
+    units) so the slot keeps ONE deterministic stream."""
+    if cfg is None or not getattr(cfg, "shuffle_pipelined_fetch", True):
+        return False
+    from daft_tpu.distributed.partition_ref import ShufflePartitionRef
 
-    All inputs are fetched up front and fetch failures are COLLECTED, so the
+    return any(isinstance(r, ShufflePartitionRef) and r.chunks for r in refs)
+
+
+def bind_task_fragment(fragment: pp.PhysicalPlan,
+                       inputs: Sequence[Sequence[PartitionRef]],
+                       cfg=None) -> pp.PhysicalPlan:
+    """Replace BoundInput leaves with sources over the task's inputs.
+
+    Chunked shuffle inputs (``ShufflePartitionRef`` under
+    ``shuffle_pipelined_fetch``) bind to a :class:`ShuffleReadSource` the
+    executor streams through a pipelined ShuffleReader — reduce-side
+    compute overlaps chunk fetch instead of waiting for the whole exchange.
+    Everything else is fetched up front with failures COLLECTED, so the
     task fails with one PartitionFetchError naming every lost ref — letting
     the driver repair them in a single lineage-recovery wave instead of one
-    retry per lost partition."""
-    fetched: List[List[MicroPartition]] = []
+    retry per lost partition. Streaming slots get the same single-wave
+    treatment for ALREADY-KNOWN-dead hosts via a preflight check; a death
+    discovered mid-stream surfaces with chunk-granular descriptors."""
+    from daft_tpu.distributed.shuffle import ShuffleReadSource
+
+    fetched: List[Optional[List[MicroPartition]]] = []
+    streaming: dict = {}  # slot -> [(slot, pos, ref), ...]
     lost: List[dict] = []
     first_err: Optional[PartitionFetchError] = None
     for slot, refs in enumerate(inputs):
+        if _slot_streams(refs, cfg):
+            entries = [(slot, pos, r) for pos, r in enumerate(refs)]
+            # Preflight: refs on hosts ALREADY known dead fail now, in one
+            # wave, like the eager path — the streaming reader only has to
+            # surface deaths discovered mid-stream.
+            for s, pos, r in entries:
+                if r.location and r.location in _dead_local_workers:
+                    lost.append({"slot": s, "pos": pos,
+                                 "worker_id": r.location,
+                                 "ticket": getattr(r, "ticket", "")})
+            streaming[slot] = entries
+            fetched.append(None)
+            continue
         parts: List[MicroPartition] = []
         for pos, r in enumerate(refs):
             try:
@@ -137,11 +185,13 @@ def bind_task_fragment(fragment: pp.PhysicalPlan, inputs: Sequence[Sequence[Part
         fetched.append(parts)
     if lost:
         raise PartitionFetchError(
-            f"{len(lost)} task input partition(s) unreachable: {first_err}",
-            lost) from first_err
+            f"{len(lost)} task input partition(s) unreachable: "
+            f"{first_err or 'worker dead'}", lost) from first_err
 
     def rebuild(node: pp.PhysicalPlan) -> pp.PhysicalPlan:
         if isinstance(node, BoundInput):
+            if node.slot in streaming:
+                return ShuffleReadSource(streaming[node.slot], node.schema)
             parts = [p for p in fetched[node.slot] if len(p)] or [
                 MicroPartition.empty(node.schema)]
             return pp.InMemorySource(parts, node.schema)
@@ -171,6 +221,7 @@ class LocalWorker(Worker):
         self._active = 0
         self._lock = threading.Lock()
         self._dead = False
+        self._shuffle_cache = None  # lazy: only flight-mode shuffles pay
         # A fresh worker reusing an old id is a new host.
         _dead_local_workers.discard(self.worker_id)
 
@@ -183,6 +234,58 @@ class LocalWorker(Worker):
 
     def heartbeat(self) -> bool:
         return not self._dead
+
+    def _get_shuffle_cache(self):
+        """This worker's chunk store (flight-mode shuffles), registered in
+        the local-cache registry so colocated readers short-circuit."""
+        with self._lock:
+            if self._shuffle_cache is None:
+                import tempfile
+
+                from daft_tpu.distributed.shuffle import (
+                    ShuffleCache,
+                    register_local_cache,
+                )
+
+                # The cache nests its own daft-shuffle-<hex> root inside
+                # the given dir and cleanup() removes exactly that root —
+                # handing it a fresh mkdtemp would strand the empty outer
+                # dir on every shutdown.
+                self._shuffle_cache = ShuffleCache(tempfile.gettempdir())
+                register_local_cache(self.worker_id, self._shuffle_cache)
+            return self._shuffle_cache
+
+    def release_query(self, query_id: str) -> int:
+        with self._lock:
+            cache = self._shuffle_cache
+        return cache.release_query(query_id) if cache is not None else 0
+
+    def _write_shuffle_outputs(self, task: Task, parts, prof):
+        """Flight-mode map output: chunk + compress each bucket through a
+        ShuffleWriter; returns chunk-granular ShufflePartitionRefs (no
+        flight address — colocated readers use the local cache registry,
+        which is the only way in-process refs are reachable anyway)."""
+        from daft_tpu.distributed.partition_ref import (
+            ChunkRef,
+            ShufflePartitionRef,
+        )
+
+        cache = self._get_shuffle_cache()
+        # Unique per ATTEMPT: a retried/speculated attempt must never
+        # append chunks onto its predecessor's tickets.
+        shuffle_id = f"{task.task_id}-a{task.attempt}-{uuid.uuid4().hex[:6]}"
+        writer = cache.writer(shuffle_id, len(parts), query_id=task.query_id,
+                              cfg=task.cfg or self.cfg, profiler=prof)
+        for i, p in enumerate(parts):
+            writer.write_bucket(i, p)
+        metas = writer.finish()
+        refs = []
+        for i in range(len(parts)):
+            m = metas[i]
+            refs.append(ShufflePartitionRef(
+                "", m.ticket, m.rows, m.bytes_, self.worker_id,
+                [ChunkRef(c.ticket, c.rows, c.bytes_) for c in m.chunks]))
+        return refs
 
     def submit(self, task: Task) -> "Future[List[PartitionRef]]":
         with self._lock:
@@ -226,8 +329,10 @@ class LocalWorker(Worker):
                         profiling.profiled_task_scope(prof, task):
                     # Input fetches run inside the scope too: shuffle.fetch
                     # injection points observe the token.
+                    task_cfg = task.cfg or self.cfg
                     with profiling.maybe_span(prof, "daft.task.bind"):
-                        bound = bind_task_fragment(task.fragment, task.inputs)
+                        bound = bind_task_fragment(task.fragment, task.inputs,
+                                                   cfg=task_cfg)
                     out = list(executor.run(bound))
                 parts = collect_task_outputs(out, task.expect_outputs, task.fragment.schema)
                 driver_stats = active_query_stats(task.query_id)
@@ -235,6 +340,15 @@ class LocalWorker(Worker):
                     for op, c in stats.snapshot().items():
                         driver_stats.record(op, rows_in=c.rows_in,
                                             rows_out=c.rows_out, cpu_ns=c.cpu_ns)
+                # Shuffle-map outputs go through the chunked shuffle plane
+                # when the flight algorithm is selected (in-memory refs are
+                # the in-process default, and the daemon path always
+                # chunks): chunk tickets + byte-accounted locality metadata
+                # instead of opaque in-memory partitions.
+                if (task.expect_outputs > 1
+                        and getattr(task_cfg, "shuffle_algorithm", "auto")
+                        == "flight"):
+                    return self._write_shuffle_outputs(task, parts, prof)
                 return [LocalPartitionRef(p, self.worker_id) for p in parts]
             finally:
                 if prof is not None:
@@ -263,6 +377,13 @@ class LocalWorker(Worker):
 
     def shutdown(self) -> None:
         self._pool.shutdown(wait=False, cancel_futures=True)
+        with self._lock:
+            cache, self._shuffle_cache = self._shuffle_cache, None
+        if cache is not None:
+            from daft_tpu.distributed.shuffle import unregister_local_cache
+
+            unregister_local_cache(self.worker_id)
+            cache.cleanup()
 
 
 class WorkerManager:
@@ -324,6 +445,25 @@ class WorkerManager:
 
     def total_slots(self) -> int:
         return sum(w.num_slots for w in self.workers())
+
+    def release_query(self, query_id: str) -> int:
+        """Broadcast shuffle teardown for ``query_id`` to EVERY worker —
+        including dead-MARKED ones: a worker declared unreachable by a
+        fault classification may be a perfectly healthy process whose
+        files would otherwise leak (a genuinely crashed daemon's release
+        just fails, and its files die with its tempdir). Failures never
+        block the others — leaks are caught by the audit hook, not by
+        failing teardown."""
+        with self._lock:
+            all_workers = list(self._workers.values())
+        released = 0
+        for w in all_workers:
+            try:
+                released += int(w.release_query(query_id) or 0)
+            except Exception:
+                _log.debug("shuffle release for query %s on %s failed",
+                           query_id, w.worker_id, exc_info=True)
+        return released
 
     def try_autoscale(self, demand: int) -> None:
         """Scale up when pending demand exceeds capacity (reference:

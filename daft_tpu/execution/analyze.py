@@ -76,6 +76,21 @@ def analyze_suffix(df) -> str:
             lines.append(f"result cache: HIT ({hit_bytes} bytes)")
         else:
             lines.append("result cache: MISS")
+    # Shuffle plane (distributed/shuffle.py): chunked compressed exchange
+    # traffic — written (map side), fetched (reduce side), backlog spilled
+    # under permit pressure, and intra-host short-circuit hits.
+    sh_w = int(d("daft_shuffle_bytes_written_total"))
+    sh_f = int(d("daft_shuffle_bytes_fetched_total"))
+    if sh_w or sh_f:
+        line = (f"shuffle: bytes_written={sh_w}, bytes_fetched={sh_f}, "
+                f"chunks={int(d('daft_shuffle_chunks_total'))}")
+        sh_sp = int(d("daft_shuffle_bytes_spilled_total"))
+        if sh_sp:
+            line += f", bytes_spilled={sh_sp}"
+        hits = int(d("daft_shuffle_local_hits_total"))
+        if hits:
+            line += f", local_hits={hits}"
+        lines.append(line)
     spilled = int(d("daft_spill_bytes_total"))
     if spilled:
         lines.append(f"spill: bytes={spilled}, "

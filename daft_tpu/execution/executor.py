@@ -372,6 +372,7 @@ class Executor:
         for p in node.partitions:
             yield p
 
+
     def _run_PhysicalScan(self, node: pp.PhysicalScan) -> Iterator[MicroPartition]:
         """Scan with the hot-scan-output cache tier in front: repeated
         scans of unchanged files (by mtime/size fingerprint) serve their
@@ -510,6 +511,21 @@ class Executor:
             pool.shutdown(wait=False, cancel_futures=True)
 
     def _run_ShuffleReadSource(self, node) -> Iterator[MicroPartition]:
+        entries = getattr(node, "entries", None)
+        if entries is not None:
+            # Streaming reduce-side shuffle input (distributed/shuffle.py):
+            # the reader's pipelined prefetch overlaps chunk fetch with
+            # whatever this executor computes downstream, its merge order
+            # is a pure function of the ticket list (PR 8 byte-identity
+            # contract), and fetch backlogs spill under THIS executor's
+            # memory permits.
+            from daft_tpu.distributed.shuffle import ShuffleReader
+
+            yield from ShuffleReader(entries, node.schema, cfg=self.cfg,
+                                     memory=self.memory,
+                                     token=self.cancel_token,
+                                     profiler=self.profiler)
+            return
         for ref in node.partition_refs:
             yield ref.fetch()
 

@@ -282,6 +282,27 @@ def map_stage(child_iter: Iterator, fn: Callable, *, pool, workers: int,
     return serial()
 
 
+def ordered_prefetch_map(items: Iterator, fn: Callable, *, depth: int,
+                         name: str = "prefetch-map") -> Iterator:
+    """``run_stage`` over a DEDICATED pool: apply ``fn`` to up to ``depth``
+    items concurrently, yielding results strictly in item order — the
+    bounded-look-ahead fetch primitive (shuffle chunk prefetch). Order is a
+    pure function of the item stream, never of completion time, so
+    consumers keep the determinism contract; the pool dies with the
+    iterator (exhaustion OR abandonment)."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    depth = max(int(depth), 1)
+    if depth == 1:
+        # Serial look-ahead is no look-ahead: plain inline map, no pool to
+        # build or tear down.
+        return (fn(item) for item in items)
+    pool = ThreadPoolExecutor(max_workers=depth,
+                              thread_name_prefix=f"daft-{name}")
+    return map_stage(items, fn, pool=pool, workers=depth, name=name,
+                     ordered=True, owns_pool=True)
+
+
 class Prefetch:
     """Pull an iterator eagerly on a dedicated thread into a bounded queue.
 

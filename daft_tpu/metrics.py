@@ -736,6 +736,25 @@ PERMIT_WAIT = _r.histogram(
 MEMORY_POISON = _r.counter(
     "daft_memory_poison_total", "Memory-manager poison events (query aborts)")
 
+# Shuffle plane (distributed/shuffle.py): chunked compressed transfers
+SHUFFLE_BYTES_WRITTEN = _r.counter(
+    "daft_shuffle_bytes_written_total",
+    "Uncompressed bytes written into shuffle chunk files (map side)")
+SHUFFLE_BYTES_FETCHED = _r.counter(
+    "daft_shuffle_bytes_fetched_total",
+    "Uncompressed bytes fetched by shuffle readers (reduce side)")
+SHUFFLE_BYTES_SPILLED = _r.counter(
+    "daft_shuffle_bytes_spilled_total",
+    "Fetched shuffle bytes spilled to disk under memory-permit pressure")
+SHUFFLE_CHUNKS = _r.counter(
+    "daft_shuffle_chunks_total", "Shuffle chunk files written, by codec",
+    ("codec",))
+SHUFFLE_FETCH_SECONDS = _r.histogram(
+    "daft_shuffle_fetch_seconds", "Wall time per shuffle chunk fetch")
+SHUFFLE_LOCAL_HITS = _r.counter(
+    "daft_shuffle_local_hits_total",
+    "Shuffle reads served by the intra-host short-circuit (no wire)")
+
 # Spill (execution/spill.py shims onto these)
 SPILL_BYTES = _r.counter("daft_spill_bytes_total", "Bytes spilled to disk")
 SPILL_FILES = _r.counter("daft_spill_files_total", "Spill files written")

@@ -145,6 +145,8 @@ def _counter_values() -> Dict[str, float]:
         "compile_cache_hits": metrics.COMPILE_CACHE_HITS._default_child().value(),
         "compile_cache_misses": metrics.COMPILE_CACHE_MISSES._default_child().value(),
         "stage_fusions": metrics.STAGE_FUSIONS._default_child().value(),
+        "shuffle_bytes_written": metrics.SHUFFLE_BYTES_WRITTEN._default_child().value(),
+        "shuffle_bytes_fetched": metrics.SHUFFLE_BYTES_FETCHED._default_child().value(),
     }
 
 
@@ -315,6 +317,13 @@ class FlightRecorder:
                                         - entry._m0["compile_cache_misses"]),
             "stage_fusions": int(m1["stage_fusions"]
                                  - entry._m0["stage_fusions"]),
+            # Optional (not in the v1/v2 required pin): shuffle exchange
+            # volume over the query's bracket — a flight record of a
+            # shuffle-heavy plan names its dominant cost without a trace.
+            "shuffle_bytes_written": int(m1["shuffle_bytes_written"]
+                                         - entry._m0["shuffle_bytes_written"]),
+            "shuffle_bytes_fetched": int(m1["shuffle_bytes_fetched"]
+                                         - entry._m0["shuffle_bytes_fetched"]),
             "peak_rss_bytes": _peak_rss(),
             "plan_cache_hit": entry.plan_cache_hit,
             "result_cache_hit": entry.result_cache_hit,

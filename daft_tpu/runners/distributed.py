@@ -8,6 +8,7 @@ in behind the same Worker interface.
 
 from __future__ import annotations
 
+import logging
 import os
 import time
 import uuid
@@ -240,6 +241,19 @@ class DistributedRunner(Runner):
             # query's ONE flight record lands whatever the outcome.
             if build is not None:
                 build.abort()
+            # Shuffle chunk files released in the SAME finally as the
+            # admission ticket: cancel/timeout/worker-death teardown frees
+            # disk exactly like success (zero-leak lifecycle contract;
+            # audit_shuffle_leaks() is the assertion surface).
+            try:
+                self.manager.release_query(query_id)
+            except Exception:
+                # Best-effort: the audit hook catches anything a broken
+                # release leaves behind; teardown must not mask the
+                # query's own outcome.
+                logging.getLogger("daft_tpu.runner").debug(
+                    "shuffle release for query %s failed", query_id,
+                    exc_info=True)
             ticket.release()
             unregister_query_token(query_id)
             unregister_query_stats(query_id)

@@ -39,6 +39,9 @@ async function renderSummary() {
     ["failed", e.queries_failed], ["tasks", e.tasks_total],
     ["rows", e.rows_processed],
     ["spilled", fmtBytes(e.spill_bytes)],
+    ["shuffle out", fmtBytes(e.shuffle_bytes_written)],
+    ["shuffle in", fmtBytes(e.shuffle_bytes_fetched)],
+    ["shuffle local", e.shuffle_local_hits],
     ["fused exprs", e.device_fused_exprs],
     ["device fallbacks", e.device_fallbacks],
     ["io read", fmtBytes(e.io_bytes_read)],

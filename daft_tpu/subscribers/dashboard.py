@@ -344,6 +344,7 @@ class DashboardState:
         """Live engine state (reference: daft-dashboard engine.rs state),
         plus process-wide health counters: out-of-core spill volume,
         device-eval fusion coverage, and IO stats."""
+        from daft_tpu import metrics
         from daft_tpu.execution.spill import spill_metrics
         from daft_tpu.io.iostats import io_stats
         from daft_tpu.ops.compiled_eval import compile_cache_snapshot
@@ -370,6 +371,14 @@ class DashboardState:
                 + self._evicted["rows"],
                 "spill_bytes": sp["bytes_spilled"],
                 "spill_files": sp["files"],
+                "shuffle_bytes_written": int(
+                    metrics.SHUFFLE_BYTES_WRITTEN._default_child().value()),
+                "shuffle_bytes_fetched": int(
+                    metrics.SHUFFLE_BYTES_FETCHED._default_child().value()),
+                "shuffle_bytes_spilled": int(
+                    metrics.SHUFFLE_BYTES_SPILLED._default_child().value()),
+                "shuffle_local_hits": int(
+                    metrics.SHUFFLE_LOCAL_HITS._default_child().value()),
                 "device_fused_exprs": dev["fused_exprs"],
                 "device_fused_rows": dev["fused_rows"],
                 "device_fallbacks": sum(dev["fallback_reasons"].values()),

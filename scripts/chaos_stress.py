@@ -292,6 +292,75 @@ def overload_round(seed: int, queries: int = 36) -> str | None:
     return None
 
 
+def shuffle_storm_round(seed: int, workers: int = 12,
+                        queries: int = 12) -> str | None:
+    """Shuffle-storm spec (ISSUE 14): a burst of concurrent shuffle-heavy
+    queries on a ``workers``-strong flight-shuffle cluster under worker
+    kills + shuffle.fetch faults. Asserts byte-identical results via
+    lineage recovery (or clean classified failure), zero leaked shuffle
+    chunk files, and no leaked threads."""
+    import threading
+
+    from daft_tpu.distributed.shuffle import audit_shuffle_leaks
+
+    ctx = daft_tpu.get_context()
+    old = ctx._runner
+    runner = DistributedRunner(num_workers=workers)
+    ctx.set_runner(runner)
+    errors: list = []
+    lock = threading.Lock()
+    try:
+        with daft_tpu.execution_config_ctx(
+                shuffle_algorithm="flight", shuffle_chunk_bytes=32 * 1024,
+                result_cache_enabled=False):
+            lineitem = make_lineitem()
+            orders = make_orders()
+            baseline = (q1_style(lineitem), join_sort_style(lineitem, orders))
+            rng = random.Random(seed)
+            specs = [
+                f"worker.pre_submit:kill:{rng.randrange(4, 16)},"
+                f"shuffle.fetch:raise:{rng.randrange(2, 8)}"
+                for _ in range(queries)
+            ]
+
+            def one(i: int) -> None:
+                try:
+                    with fault_scope(specs[i], seed=seed + i):
+                        got = (q1_style(lineitem),
+                               join_sort_style(lineitem, orders))
+                    if got != baseline:
+                        with lock:
+                            errors.append(
+                                f"divergence under {specs[i]!r}")
+                except DaftError:
+                    pass  # classified failure under chaos: acceptable
+                except BaseException as e:  # noqa: BLE001
+                    with lock:
+                        errors.append(f"unclassified under {specs[i]!r}: "
+                                      f"{repr(e)[:120]}")
+
+            threads = [threading.Thread(target=one, args=(i,))
+                       for i in range(queries)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=120)
+            if any(t.is_alive() for t in threads):
+                return "shuffle-storm query thread(s) hung"
+        # Audit BEFORE the runner shuts down: shutdown cleanup() wipes the
+        # caches wholesale, which would make a zero-leak assertion vacuous
+        # — we are checking that per-QUERY teardown freed the files.
+        leaks = audit_shuffle_leaks()
+    finally:
+        runner.manager.shutdown()
+        ctx.set_runner(old)
+    if errors:
+        return "; ".join(errors[:3])
+    if leaks["files"]:
+        return f"leaked shuffle chunk files after storm: {leaks}"
+    return None
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--rounds", type=int, default=10)
@@ -302,7 +371,23 @@ def main() -> int:
                     help="skip the per-round dashboard /metrics validation")
     ap.add_argument("--overload", action="store_true",
                     help="run only the multi-tenant overload spec")
+    ap.add_argument("--shuffle-storm", action="store_true",
+                    help="run only the shuffle-storm spec (worker kills + "
+                         "fetch faults on a flight-shuffle cluster)")
+    ap.add_argument("--workers", type=int, default=12,
+                    help="cluster size for --shuffle-storm (8-16)")
     args = ap.parse_args()
+
+    if args.shuffle_storm:
+        t0 = time.time()
+        err = shuffle_storm_round(seed=args.seed, workers=args.workers)
+        if err:
+            print(f"[shuffle-storm] FAIL seed={args.seed}: {err}")
+            return 1
+        print(f"[shuffle-storm] ok ({time.time() - t0:.1f}s) — "
+              f"{args.workers}-worker storm survived, byte-identical "
+              f"results, zero leaked chunk files")
+        return 0
 
     if args.overload:
         t0 = time.time()

@@ -313,6 +313,15 @@ def chaos_round(stats: StormStats, n_queries: int, seed: int) -> None:
         with fault_scope(spec, seed=seed):
             mixes = build_mixes()
             run_storm(mixes, n_queries, n_threads=8, stats=stats, seed=seed)
+        # Shuffle lifecycle audit BEFORE shutdown (which cleanups the
+        # caches wholesale and would make this vacuous): per-QUERY
+        # teardown must have freed every chunk file already.
+        from daft_tpu.distributed.shuffle import audit_shuffle_leaks
+
+        leaks = audit_shuffle_leaks()
+        if leaks["files"]:
+            stats.unclassified.append(
+                ("shuffle-audit", f"leaked chunk files: {leaks}"))
     finally:
         runner.manager.shutdown()
         ctx.set_runner(old)
@@ -824,6 +833,14 @@ def main() -> int:
     leaked_threads = threading.active_count() - thread_baseline
     if leaked_threads > 4:  # daemon monitor + dashboard handler slack
         failures.append(f"{leaked_threads} threads leaked by the storm")
+    # Shuffle-plane lifecycle (ISSUE 14): every query's chunk files were
+    # released in the runner's teardown finally — the audit hook must see
+    # zero live files across all caches in this process.
+    from daft_tpu.distributed.shuffle import audit_shuffle_leaks
+
+    shuffle_leaks = audit_shuffle_leaks()
+    if shuffle_leaks["files"]:
+        failures.append(f"leaked shuffle chunk files: {shuffle_leaks}")
     # 6. SLO plane (ISSUE 12): the hostile tenant's burn-rate alert fired
     # during the storm; well-behaved tenants stayed green. Scraped from
     # /api/slo exactly the way an operator's alerting would.

@@ -575,6 +575,237 @@ def cmd_cache_bench(args) -> int:
     return 0
 
 
+# --------------------------------------------------------------------- #
+# Shuffle plane: micro isolation + ABBA pipelined-vs-legacy + chaos storm #
+# --------------------------------------------------------------------- #
+def _shuffle_queries(rows: int, partitions: int):
+    rng = np.random.default_rng(3)
+    df = daft_tpu.from_pydict({
+        "k": np.arange(rows, dtype=np.int64),
+        "g": rng.integers(0, 97, rows),
+        "x": rng.random(rows),
+    }).into_partitions(partitions)
+
+    def exchange():
+        # q21/q18-shaped: two-phase grouped agg + range-shuffle sort —
+        # every row crosses the exchange twice.
+        return (df.groupby("g")
+                .agg(col("x").sum().alias("s"), col("k").count().alias("n"))
+                .sort("g"))
+
+    return df, exchange
+
+
+def cmd_shuffle_bench(args) -> int:
+    """Shuffle micro suite (map/fetch/merge isolation, over a REAL Arrow
+    Flight wire) + ABBA-paired old-vs-new transfer comparison: the old
+    path is the pre-PR whole-partition uncompressed eager fetch; the new
+    path is chunked + lz4 + pipelined prefetch. Appends one ``shuffle``
+    suite entry to the trajectory; gates on the wire micro (pipelined+
+    compressed must beat whole-partition eager)."""
+    import statistics
+    import tempfile
+
+    from daft_tpu.context import execution_config_ctx
+    from daft_tpu.distributed.flight import fetch_partition, start_shuffle_server
+    from daft_tpu.distributed.partition_ref import ChunkRef, ShufflePartitionRef
+    from daft_tpu.distributed.shuffle import ShuffleCache, ShuffleReader
+    from daft_tpu.micropartition import MicroPartition
+    from daft_tpu.runners.distributed import DistributedRunner
+
+    records = []
+
+    def _rec(name, wall, extra=None):
+        records.append({"name": name, "wall_s": round(wall, 6),
+                        "rows_out": 0, "operators": [],
+                        "metrics": dict(extra or {})})
+        print(f"  {name}: {wall * 1000:.1f}ms", file=sys.stderr)
+
+    cfg0 = daft_tpu.get_context().execution_config
+    rows = args.shuffle_rows
+    n_parts = 8
+    blocks = max(args.blocks, 3)
+    part = MicroPartition.from_pydict({
+        "k": np.arange(rows // n_parts, dtype=np.int64),
+        "x": np.random.default_rng(0).random(rows // n_parts)})
+    cache = ShuffleCache(tempfile.gettempdir())  # nests + cleans its own root
+    # Deliberately NOT registered as a local cache: every fetch below rides
+    # the Flight wire, like a cross-host reduce. TWO servers over the same
+    # cache pin each leg's wire codec honestly: the legacy leg must ship
+    # RAW frames (the pre-PR wire), the new leg the negotiated codec.
+    server_raw = start_shuffle_server(cache, wire_codec="none")
+    server = start_shuffle_server(cache, wire_codec="auto")
+    try:
+        # Old path: one whole-partition RAW file per map output.
+        legacy_cfg = cfg0.with_changes(shuffle_compression="none",
+                                       shuffle_chunk_bytes=1 << 40)
+        new_cfg = cfg0.with_changes(shuffle_compression="auto",
+                                    shuffle_chunk_bytes=256 * 1024,
+                                    shuffle_prefetch_depth=6)
+        t0 = time.perf_counter()
+        for i in range(n_parts):
+            cache.write_partition(f"old{i}", 0, part, query_id="bench",
+                                  cfg=legacy_cfg)
+        _rec("map_write_legacy", time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        for i in range(n_parts):
+            cache.write_partition(f"new{i}", 0, part, query_id="bench",
+                                  cfg=new_cfg)
+        _rec("map_write_chunked_lz4", time.perf_counter() - t0)
+
+        new_entries = []
+        for i in range(n_parts):
+            meta = cache.partition_meta(f"new{i}/0")
+            new_entries.append((0, i, ShufflePartitionRef(
+                server.address, meta.ticket, meta.rows, meta.bytes_,
+                f"remote-{i}",
+                [ChunkRef(c.ticket, c.rows, c.bytes_)
+                 for c in meta.chunks])))
+
+        def fetch_legacy():
+            # The pre-PR reduce input path: serial whole-partition do_get
+            # per ref over the RAW-wire server, fully decoded before the
+            # next fetch starts.
+            t0 = time.perf_counter()
+            n = sum(len(fetch_partition(server_raw.address, f"old{i}/0"))
+                    for i in range(n_parts))
+            return time.perf_counter() - t0, n
+
+        def fetch_new():
+            r = ShuffleReader(new_entries, part.schema, cfg=new_cfg)
+            t0 = time.perf_counter()
+            n = sum(len(mp) for mp in r)
+            return time.perf_counter() - t0, n
+
+        fetch_new()  # warm the flight client/channel for both legs
+        old_walls, new_walls = [], []
+        for b in range(blocks):
+            order = [(fetch_legacy, old_walls), (fetch_new, new_walls)]
+            if b % 2:
+                order.reverse()
+            for fn, sink in order:
+                w, n = fn()
+                assert n == n_parts * (rows // n_parts)
+                sink.append(w)
+        fetch_old = statistics.median(old_walls)
+        fetch_pipe = statistics.median(new_walls)
+        _rec("wire_fetch_whole_raw", fetch_old)
+        _rec("wire_fetch_pipelined_lz4", fetch_pipe)
+    finally:
+        server_raw.shutdown()
+        server.shutdown()
+        cache.cleanup()
+
+    # -- e2e: in-process distributed exchange (intra-host short-circuit) -- #
+    ctx = daft_tpu.get_context()
+    old_runner = ctx._runner
+    runner = DistributedRunner(num_workers=args.shuffle_workers)
+    ctx.set_runner(runner)
+    try:
+        legacy = dict(shuffle_algorithm="flight", result_cache_enabled=False,
+                      shuffle_pipelined_fetch=False,
+                      shuffle_compression="none")
+        pipelined = dict(shuffle_algorithm="flight",
+                         result_cache_enabled=False)
+        _, exchange = _shuffle_queries(rows, n_parts)
+        with execution_config_ctx(**pipelined):
+            exchange().collect()  # warm
+        legacy_walls, pipe_walls2 = [], []
+        for b in range(blocks):
+            order = [(legacy, legacy_walls), (pipelined, pipe_walls2)]
+            if b % 2:
+                order.reverse()
+            for conf, sink in order:
+                with execution_config_ctx(**conf):
+                    t0 = time.perf_counter()
+                    exchange().collect()
+                    sink.append(time.perf_counter() - t0)
+        e2e_legacy = statistics.median(legacy_walls)
+        e2e_pipe = statistics.median(pipe_walls2)
+        _rec("exchange_legacy", e2e_legacy)
+        _rec("exchange_pipelined", e2e_pipe)
+    finally:
+        runner.manager.shutdown()
+        ctx.set_runner(old_runner)
+
+    fetch_x = fetch_old / max(fetch_pipe, 1e-9)
+    e2e_x = e2e_legacy / max(e2e_pipe, 1e-9)
+    print(f"wire micro: pipelined+lz4 {fetch_x:.2f}x vs whole-partition raw "
+          f"({fetch_old * 1000:.1f}ms -> {fetch_pipe * 1000:.1f}ms)")
+    print(f"e2e exchange @ {args.shuffle_workers} in-process workers "
+          f"(local short-circuit, no wire to hide): pipelined {e2e_x:.2f}x "
+          f"vs eager ({e2e_legacy * 1000:.1f}ms -> {e2e_pipe * 1000:.1f}ms)")
+    entry = perf_report.build_entry(
+        "shuffle", records,
+        config={"shuffle_rows": rows, "workers": args.shuffle_workers,
+                "blocks": blocks,
+                "wire_fetch_speedup_x": round(fetch_x, 3),
+                "exchange_speedup_x": round(e2e_x, 3)})
+    if not args.no_append:
+        path = perf_report.append_entry(entry, args.out)
+        print(f"appended shuffle entry sha={entry['sha'] or '?'} to {path}",
+              file=sys.stderr)
+    if fetch_pipe >= fetch_old:
+        print("FAIL: pipelined+compressed wire fetch did not beat the "
+              "whole-partition path")
+        return 1
+    return 0
+
+
+def cmd_shuffle_chaos(args) -> int:
+    """Chaos-stress shuffle benchmark: an 8-16-worker storm of
+    shuffle-heavy queries under worker kills and shuffle.fetch faults —
+    results must stay byte-identical to the fault-free run, with zero
+    leaked chunk files."""
+    from daft_tpu.context import execution_config_ctx
+    from daft_tpu.distributed.faults import fault_scope
+    from daft_tpu.distributed.shuffle import audit_shuffle_leaks
+    from daft_tpu.runners.distributed import DistributedRunner
+
+    ctx = daft_tpu.get_context()
+    old = ctx._runner
+    runner = DistributedRunner(num_workers=args.shuffle_workers)
+    ctx.set_runner(runner)
+    failures = 0
+    t_start = time.perf_counter()
+    try:
+        _, exchange = _shuffle_queries(args.shuffle_rows, 8)
+        with execution_config_ctx(shuffle_algorithm="flight",
+                                  shuffle_chunk_bytes=64 * 1024,
+                                  result_cache_enabled=False):
+            baseline = exchange().to_pydict()
+            specs = [
+                "worker.pre_submit:kill:9",
+                "shuffle.fetch:raise:4",
+                "shuffle.fetch:delay:p0.2:0.01",
+                "worker.pre_submit:kill:12,shuffle.fetch:raise:6",
+            ]
+            for i, spec in enumerate(specs * max(args.rounds, 1)):
+                try:
+                    with fault_scope(spec, seed=i):
+                        out = exchange().to_pydict()
+                    if out != baseline:
+                        print(f"[{i}] DIVERGENCE under {spec!r}")
+                        failures += 1
+                    else:
+                        print(f"[{i}] ok  spec={spec!r}", file=sys.stderr)
+                except daft_tpu.errors.DaftError as e:
+                    # Clean classified failure is acceptable (budget blown
+                    # by an aggressive spec); hangs/diverges are not.
+                    print(f"[{i}] clean failure under {spec!r}: "
+                          f"{str(e).splitlines()[0]}", file=sys.stderr)
+        leaks = audit_shuffle_leaks()
+        if leaks["files"]:
+            print(f"LEAK: {leaks}")
+            failures += 1
+    finally:
+        runner.manager.shutdown()
+        ctx.set_runner(old)
+    print(f"shuffle chaos storm @ {args.shuffle_workers} workers: "
+          f"{failures} failure(s) in {time.perf_counter() - t_start:.1f}s")
+    return 1 if failures else 0
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     p.add_argument("--suite", default="tpch", choices=("tpch", "micro"))
@@ -608,6 +839,16 @@ def main(argv=None) -> int:
                    help="query-cache acceptance: cold vs cached-repeat vs "
                         "plan-cache-only timings; appends a query_cache "
                         "trajectory entry and enforces >= 10x cached repeat")
+    p.add_argument("--shuffle-bench", action="store_true",
+                   help="shuffle micro suite (map/fetch/merge isolation) + "
+                        "ABBA pipelined-vs-legacy exchange comparison; "
+                        "appends a `shuffle` trajectory entry")
+    p.add_argument("--shuffle-chaos", action="store_true",
+                   help="chaos-stress shuffle storm: worker kills + fetch "
+                        "faults at --shuffle-workers, byte-identity + "
+                        "zero-leak asserted")
+    p.add_argument("--shuffle-rows", type=int, default=300_000)
+    p.add_argument("--shuffle-workers", type=int, default=8)
     p.add_argument("--ab-rows", type=int, default=400_000,
                    help="rows for the --ab-fusion tables")
     p.add_argument("--ab-tolerance-pct", type=float, default=5.0,
@@ -629,6 +870,10 @@ def main(argv=None) -> int:
         return cmd_ab_fusion(args)
     if args.cache_bench:
         return cmd_cache_bench(args)
+    if args.shuffle_bench:
+        return cmd_shuffle_bench(args)
+    if args.shuffle_chaos:
+        return cmd_shuffle_chaos(args)
     if args.cores:
         return cmd_cores(args)
     return cmd_capture(args)

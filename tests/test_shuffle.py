@@ -1,17 +1,42 @@
-"""Shuffle cache + Flight server/client tests (cross-host data plane)."""
+"""Shuffle plane tests: chunked compressed transfers, pipelined
+deterministic merge, spill-backed buffers, locality placement, per-query
+lifecycle, and chaos recovery (cross-host data plane)."""
+
+import random
+import threading
+import time
 
 import numpy as np
 import pytest
 
 import daft_tpu
-from daft_tpu.distributed.flight import fetch_partition, start_shuffle_server
+from daft_tpu import col, metrics
+from daft_tpu.distributed.flight import (
+    fetch_chunk_table,
+    fetch_partition,
+    start_shuffle_server,
+)
 from daft_tpu.distributed.partition_ref import (
+    ChunkRef,
     FlightPartitionRef,
+    PartitionFetchError,
+    ShufflePartitionRef,
     deserialize_partition,
     serialize_partition,
 )
-from daft_tpu.distributed.shuffle import ShuffleCache
+from daft_tpu.distributed.shuffle import (
+    ShuffleCache,
+    ShuffleReader,
+    audit_shuffle_leaks,
+    is_chunk_ticket,
+    local_cache_for,
+    negotiate_codec,
+    register_local_cache,
+    split_chunk_ticket,
+    unregister_local_cache,
+)
 from daft_tpu.micropartition import MicroPartition
+from daft_tpu.runners.distributed import DistributedRunner
 
 
 @pytest.fixture
@@ -22,6 +47,21 @@ def mp():
     })
 
 
+def _counter(name: str) -> float:
+    return metrics.get_registry().snapshot().counter_total(name)
+
+
+def _shuffle_ref(cache: ShuffleCache, ticket: str, worker_id=None,
+                 address="") -> ShufflePartitionRef:
+    meta = cache.partition_meta(ticket)
+    return ShufflePartitionRef(
+        address, ticket, meta.rows, meta.bytes_, worker_id,
+        [ChunkRef(c.ticket, c.rows, c.bytes_) for c in meta.chunks])
+
+
+# ------------------------------------------------------------------ #
+# Wire format + cache basics (pre-existing contract)                   #
+# ------------------------------------------------------------------ #
 def test_ipc_roundtrip(mp):
     data = serialize_partition(mp)
     back = deserialize_partition(data)
@@ -54,3 +94,780 @@ def test_flight_server_fetch(mp, tmp_path):
     finally:
         server.shutdown()
         cache.cleanup()
+
+
+# ------------------------------------------------------------------ #
+# Codec negotiation + round trips                                      #
+# ------------------------------------------------------------------ #
+def test_codec_negotiation():
+    assert negotiate_codec("none") is None
+    assert negotiate_codec("") is None
+    auto = negotiate_codec("auto")
+    assert auto in ("lz4", "zstd", None)
+    assert negotiate_codec("definitely-not-a-codec") is None
+
+
+def test_codec_negotiation_raw_fallback(monkeypatch):
+    import daft_tpu.distributed.shuffle as sh
+
+    monkeypatch.setattr(sh, "_codec_available", lambda c: False)
+    assert negotiate_codec("lz4") is None
+    assert negotiate_codec("zstd") is None
+    assert negotiate_codec("auto") is None
+
+
+@pytest.mark.parametrize("codec", ["lz4", "zstd", "none"])
+def test_codec_roundtrip(codec, tmp_path):
+    if codec != "none" and negotiate_codec(codec) is None:
+        pytest.skip(f"{codec} unavailable in this pyarrow build")
+    cfg = daft_tpu.get_context().execution_config.with_changes(
+        shuffle_compression=codec, shuffle_chunk_bytes=8 * 1024)
+    # Highly compressible payload so a real codec provably shrinks it.
+    big = MicroPartition.from_pydict({
+        "x": [7] * 20000, "s": ["repetitive-value"] * 20000})
+    cache = ShuffleCache([str(tmp_path)])
+    w = cache.writer("cr", 1, query_id="qc", cfg=cfg)
+    w.write_bucket(0, big)
+    meta = w.finish()[0]
+    assert len(meta.chunks) > 1  # chunked at shuffle_chunk_bytes
+    assert all(c.codec == (None if codec == "none" else codec)
+               for c in meta.chunks)
+    if codec != "none":
+        assert sum(c.file_bytes for c in meta.chunks) < meta.bytes_
+    out = cache.read_partition(meta.ticket)
+    assert out.to_pydict() == big.to_pydict()
+    cache.cleanup()
+
+
+def test_writer_chunk_tickets_and_chunk_reads(mp, tmp_path):
+    cfg = daft_tpu.get_context().execution_config.with_changes(
+        shuffle_chunk_bytes=2048)
+    cache = ShuffleCache([str(tmp_path)])
+    ticket = cache.write_partition("ct", 0, mp, query_id="q1", cfg=cfg)
+    meta = cache.partition_meta(ticket)
+    assert len(meta.chunks) > 1
+    assert [c.seq for c in meta.chunks] == list(range(len(meta.chunks)))
+    rows = 0
+    for c in meta.chunks:
+        assert is_chunk_ticket(c.ticket)
+        base, seq = split_chunk_ticket(c.ticket)
+        assert base == ticket and seq == c.seq
+        rows += cache.read_chunk(c.ticket).num_rows
+    assert rows == 1000
+    # Chunk-by-chunk concat in seq order == whole-partition read.
+    assert cache.read_partition(ticket).to_pydict() == mp.to_pydict()
+    cache.cleanup()
+
+
+def test_chunk_granular_flight_fetch(mp, tmp_path):
+    cfg = daft_tpu.get_context().execution_config.with_changes(
+        shuffle_chunk_bytes=2048)
+    cache = ShuffleCache([str(tmp_path)])
+    ticket = cache.write_partition("cf", 0, mp, query_id="q1", cfg=cfg)
+    meta = cache.partition_meta(ticket)
+    server = start_shuffle_server(cache)
+    try:
+        import pyarrow as pa
+
+        tables = [fetch_chunk_table(server.address, c.ticket)
+                  for c in meta.chunks]
+        got = MicroPartition.from_arrow_table(pa.concat_tables(tables))
+        assert got.to_pydict()["a"] == mp.to_pydict()["a"]
+    finally:
+        server.shutdown()
+        cache.cleanup()
+
+
+# ------------------------------------------------------------------ #
+# Reader: deterministic merge, spill, short-circuit                    #
+# ------------------------------------------------------------------ #
+def _reader_pydict(entries, schema, cfg, **kw):
+    parts = list(ShuffleReader(entries, schema, cfg=cfg, **kw))
+    return MicroPartition.concat(parts).to_pydict()
+
+
+def test_reader_deterministic_merge_under_adversarial_arrival(
+        tmp_path, monkeypatch):
+    """Wire-path chunk arrival is randomized with injected per-chunk
+    server-side jitter; the pipelined merged stream must be byte-identical
+    to the serial local read — order is a pure function of ticket ids,
+    never arrival time. Refs are UNREGISTERED workers with a real Flight
+    address, so every fetch rides a concurrent do_get stream."""
+    cfg = daft_tpu.get_context().execution_config.with_changes(
+        shuffle_chunk_bytes=1024, shuffle_prefetch_depth=6)
+    cache = ShuffleCache([str(tmp_path)])
+    server = start_shuffle_server(cache)
+    register_local_cache("wA", cache)
+    try:
+        local_entries, remote_entries = [], []
+        for i in range(3):
+            part = MicroPartition.from_pydict({
+                "v": list(range(i * 1000, (i + 1) * 1000))})
+            t = cache.write_partition(f"m{i}", 0, part, query_id="q", cfg=cfg)
+            local_entries.append((0, i, _shuffle_ref(cache, t,
+                                                     worker_id="wA")))
+            remote_entries.append((0, i, _shuffle_ref(
+                cache, t, worker_id=f"remote-{i}", address=server.address)))
+        schema = part.schema
+        baseline = _reader_pydict(local_entries, schema, cfg)
+        assert baseline["v"] == list(range(3000))
+
+        real_read = ShuffleCache.read_chunk
+        rng = random.Random(7)
+        lock = threading.Lock()
+
+        def jittery(self, ticket):
+            with lock:
+                delay = rng.random() * 0.01
+            time.sleep(delay)
+            return real_read(self, ticket)
+
+        monkeypatch.setattr(ShuffleCache, "read_chunk", jittery)
+        for _ in range(3):
+            assert _reader_pydict(remote_entries, schema, cfg) == baseline
+    finally:
+        unregister_local_cache("wA")
+        server.shutdown()
+        cache.cleanup()
+
+
+def test_reader_spills_backlog_under_memory_pressure(tmp_path):
+    from daft_tpu.execution.resource_manager import MemoryManager
+
+    cfg = daft_tpu.get_context().execution_config.with_changes(
+        shuffle_chunk_bytes=4096, shuffle_prefetch_depth=4)
+    cache = ShuffleCache([str(tmp_path)])
+    register_local_cache("wS", cache)
+    try:
+        part = MicroPartition.from_pydict({"v": list(range(50000))})
+        t = cache.write_partition("sp", 0, part, query_id="q", cfg=cfg)
+        entries = [(0, 0, _shuffle_ref(cache, t, worker_id="wS"))]
+        # A limit far below one chunk: every admission fails fast and the
+        # backlog spills instead of holding permits.
+        mem = MemoryManager(limit_bytes=1024)
+        mem._used = 1024  # saturated: no permit will ever be granted
+        before = _counter("daft_shuffle_bytes_spilled_total")
+        out = _reader_pydict(entries, part.schema, cfg, memory=mem)
+        assert out == part.to_pydict()
+        assert _counter("daft_shuffle_bytes_spilled_total") > before
+        assert mem._used == 1024  # no permit leaked by the spill path
+    finally:
+        unregister_local_cache("wS")
+        cache.cleanup()
+
+
+def test_reader_releases_partial_permits_on_mid_fetch_failure(
+        tmp_path, monkeypatch):
+    """A fetch that dies mid-partition (chunk k of n raises) must release
+    the permits already admitted for chunks 1..k-1 — across every retry
+    attempt — or MemoryManager._used inflates for the process lifetime."""
+    from daft_tpu.execution.resource_manager import MemoryManager
+
+    cfg = daft_tpu.get_context().execution_config.with_changes(
+        shuffle_chunk_bytes=1024)
+    cache = ShuffleCache([str(tmp_path)])
+    register_local_cache("wPart", cache)
+    try:
+        part = MicroPartition.from_pydict({"v": list(range(20000))})
+        t = cache.write_partition("pf", 0, part, query_id="q", cfg=cfg)
+        meta = cache.partition_meta(t)
+        assert len(meta.chunks) >= 3
+        entries = [(0, 0, _shuffle_ref(cache, t, worker_id="wPart"))]
+        fail_after = len(meta.chunks) // 2
+        real_read = ShuffleCache.read_chunk
+        calls = {"n": 0}
+
+        def flaky(self, ticket):
+            calls["n"] += 1
+            _, seq = split_chunk_ticket(ticket)
+            if seq >= fail_after:
+                raise OSError("disk went away")
+            return real_read(self, ticket)
+
+        monkeypatch.setattr(ShuffleCache, "read_chunk", flaky)
+        mem = MemoryManager(limit_bytes=1 << 30)  # permits granted, tracked
+        used_before = mem._used
+        with pytest.raises(PartitionFetchError):
+            list(ShuffleReader(entries, part.schema, cfg=cfg, memory=mem))
+        assert mem._used == used_before, \
+            f"leaked {mem._used - used_before} permit bytes"
+    finally:
+        unregister_local_cache("wPart")
+        cache.cleanup()
+
+
+def test_reader_releases_permits_on_early_abandonment(tmp_path):
+    """A consumer abandoning the stream early (LIMIT pushdown, cancel)
+    must release the permits of every prefetched-but-unyielded chunk —
+    the MemoryManager is process-global, so a leak here starves every
+    later query."""
+    from daft_tpu.execution.resource_manager import MemoryManager
+
+    cfg = daft_tpu.get_context().execution_config.with_changes(
+        shuffle_chunk_bytes=1024, shuffle_prefetch_depth=4)
+    cache = ShuffleCache([str(tmp_path)])
+    register_local_cache("wAb", cache)
+    try:
+        entries = []
+        for i in range(4):
+            part = MicroPartition.from_pydict({"v": list(range(8000))})
+            t = cache.write_partition(f"ab{i}", 0, part, query_id="q",
+                                      cfg=cfg)
+            entries.append((0, i, _shuffle_ref(cache, t, worker_id="wAb")))
+        mem = MemoryManager(limit_bytes=1 << 30)
+        used_before = mem._used
+        it = iter(ShuffleReader(entries, part.schema, cfg=cfg, memory=mem))
+        next(it)  # consume ONE morsel, then walk away
+        it.close()
+        assert mem._used == used_before, \
+            f"leaked {mem._used - used_before} permit bytes on abandonment"
+    finally:
+        unregister_local_cache("wAb")
+        cache.cleanup()
+
+
+def test_append_writers_never_collide_chunk_tickets(tmp_path, mp):
+    """Two writers appending to the same (shuffle, bucket) — the
+    multi-map-task-append compat pattern — must mint DISTINCT chunk
+    tickets: a collision would silently serve one file twice and the
+    other never."""
+    cfg = daft_tpu.get_context().execution_config
+    cache = ShuffleCache([str(tmp_path)])
+    t = cache.write_partition("app", 0, mp, query_id="q", cfg=cfg)
+    cache.write_partition("app", 0, mp, query_id="q", cfg=cfg)
+    meta = cache.partition_meta(t)
+    tickets = [c.ticket for c in meta.chunks]
+    assert len(tickets) == len(set(tickets)), f"colliding tickets {tickets}"
+    assert meta.rows == 2000
+    # Chunk-addressed reads see both appends' rows exactly once.
+    total = sum(cache.read_chunk(c.ticket).num_rows for c in meta.chunks)
+    assert total == 2000
+    assert len(cache.read_partition(t)) == 2000
+    cache.cleanup()
+
+
+def test_prefetch_depth_zero_means_inline():
+    cfg = daft_tpu.get_context().execution_config.with_changes(
+        shuffle_prefetch_depth=0)
+    assert ShuffleReader([], None, cfg=cfg).depth == 1
+
+
+def test_eager_fetch_of_unreachable_ref_names_right_position():
+    """An address-less ShufflePartitionRef whose cache is gone must fail
+    through the CALLER's descriptor (correct slot/pos), never a hardcoded
+    (0, 0) — lineage recovery would repair the wrong input."""
+    from daft_tpu.distributed.worker import fetch_task_input
+
+    ref = ShufflePartitionRef("", "nx/0", 5, 100, "vanished-worker",
+                              [ChunkRef("nx/0@0", 5, 100)])
+    with pytest.raises(PartitionFetchError) as ei:
+        fetch_task_input(ref, 2, 7)
+    lost = ei.value.lost
+    assert lost[0]["slot"] == 2 and lost[0]["pos"] == 7
+    assert lost[0]["worker_id"] == "vanished-worker"
+
+
+def test_reader_local_short_circuit_counts_hits(tmp_path):
+    cfg = daft_tpu.get_context().execution_config
+    cache = ShuffleCache([str(tmp_path)])
+    register_local_cache("wL", cache)
+    try:
+        part = MicroPartition.from_pydict({"v": [1, 2, 3]})
+        t = cache.write_partition("lh", 0, part, query_id="q", cfg=cfg)
+        entries = [(0, 0, _shuffle_ref(cache, t, worker_id="wL"))]
+        before = _counter("daft_shuffle_local_hits_total")
+        out = _reader_pydict(entries, part.schema, cfg)
+        assert out == {"v": [1, 2, 3]}
+        assert _counter("daft_shuffle_local_hits_total") > before
+    finally:
+        unregister_local_cache("wL")
+        cache.cleanup()
+
+
+def test_empty_bucket_ref_yields_empty():
+    ref = ShufflePartitionRef("", "e/0", 0, 0, "nowhere", [])
+    assert len(ref.fetch()) == 0
+    cfg = daft_tpu.get_context().execution_config
+    part = MicroPartition.from_pydict({"v": [1]})
+    parts = list(ShuffleReader([(0, 0, ref)], part.schema, cfg=cfg))
+    assert len(parts) == 1 and len(parts[0]) == 0
+
+
+def test_fetch_error_carries_chunk_ticket(tmp_path):
+    """Lineage descriptors are chunk-granular: a failed fetch names the
+    exact lost ticket, so recovery diagnostics pin the lost map output."""
+    from daft_tpu.distributed.worker import _dead_local_workers
+
+    cfg = daft_tpu.get_context().execution_config
+    # Known-dead host: preflight loss carries the partition ticket.
+    ref = ShufflePartitionRef("", "d/0", 5, 100, "dead-worker",
+                              [ChunkRef("d/0@0", 5, 100)])
+    _dead_local_workers.add("dead-worker")
+    try:
+        reader = ShuffleReader([(0, 3, ref)], None, cfg=cfg)
+        with pytest.raises(PartitionFetchError) as ei:
+            list(reader)
+        lost = ei.value.lost
+        assert lost[0]["ticket"] == "d/0"
+        assert lost[0]["worker_id"] == "dead-worker"
+        assert lost[0]["pos"] == 3
+    finally:
+        _dead_local_workers.discard("dead-worker")
+    # Live host whose cache lost the chunk (evicted/corrupted): the
+    # descriptor names the exact CHUNK ticket that failed.
+    cache = ShuffleCache([str(tmp_path)])
+    register_local_cache("wGone", cache)
+    try:
+        gone = ShufflePartitionRef("", "g/0", 5, 100, "wGone",
+                                   [ChunkRef("g/0@0", 5, 100)])
+        reader = ShuffleReader([(0, 1, gone)], None, cfg=cfg)
+        with pytest.raises(PartitionFetchError) as ei:
+            list(reader)
+        assert ei.value.lost[0]["ticket"] == "g/0@0"
+    finally:
+        unregister_local_cache("wGone")
+        cache.cleanup()
+
+
+# ------------------------------------------------------------------ #
+# Lifecycle: per-query release + zero-leak audit                       #
+# ------------------------------------------------------------------ #
+def test_release_query_deletes_files_and_audit(tmp_path, mp):
+    import os
+
+    cfg = daft_tpu.get_context().execution_config.with_changes(
+        shuffle_chunk_bytes=2048)
+    cache = ShuffleCache([str(tmp_path)])
+    t1 = cache.write_partition("r1", 0, mp, query_id="qA", cfg=cfg)
+    t2 = cache.write_partition("r2", 0, mp, query_id="qB", cfg=cfg)
+    files_a = cache.partition_meta(t1).files
+    files_b = cache.partition_meta(t2).files
+    assert all(os.path.exists(p) for p in files_a + files_b)
+    assert cache.audit()["files"] == len(files_a) + len(files_b)
+    removed = cache.release_query("qA")
+    assert removed == len(files_a)
+    assert not any(os.path.exists(p) for p in files_a)
+    assert all(os.path.exists(p) for p in files_b)
+    assert cache.audit()["queries"] == {"qB": len(files_b)}
+    assert cache.release_query("qA") == 0  # idempotent
+    with pytest.raises(KeyError):
+        cache.read_partition(t1)
+    cache.cleanup()
+
+
+# ------------------------------------------------------------------ #
+# Locality-aware reduce placement                                      #
+# ------------------------------------------------------------------ #
+class _StubWorker:
+    def __init__(self, worker_id, active=0, num_slots=4):
+        self.worker_id = worker_id
+        self.num_slots = num_slots
+        self._active = active
+
+    def active_tasks(self):
+        return self._active
+
+
+def _locality_task(weights):
+    from daft_tpu.distributed.task import BoundInput, Task
+
+    return Task(BoundInput(0, None), [], input_locality=weights)
+
+
+def _scheduler(workers):
+    from daft_tpu.distributed.scheduler import Scheduler
+    from daft_tpu.distributed.worker import WorkerManager
+
+    return Scheduler(WorkerManager(list(workers)))
+
+
+def test_locality_prefers_majority_holder():
+    ws = [_StubWorker("w0"), _StubWorker("w1"), _StubWorker("w2")]
+    s = _scheduler(ws)
+    t = _locality_task({"w1": 1000, "w0": 10, "w2": 10})
+    assert s.assign(t).worker_id == "w1"
+
+
+def test_locality_falls_back_on_exclusion_and_death():
+    ws = [_StubWorker("w0"), _StubWorker("w1"), _StubWorker("w2")]
+    s = _scheduler(ws)
+    t = _locality_task({"w1": 1000})
+    # Excluded holder: degrade to spread among the others.
+    assert s.assign(t, exclude={"w1"}).worker_id in ("w0", "w2")
+    # Dead holder: same.
+    s.manager.mark_dead("w1", reason="test")
+    assert s.assign(t).worker_id in ("w0", "w2")
+
+
+def test_locality_skips_even_exchange_and_busy_holder():
+    ws = [_StubWorker("w0"), _StubWorker("w1"), _StubWorker("w2")]
+    s = _scheduler(ws)
+    # Even all-to-all: no majority holder -> spread (least active).
+    even = _locality_task({"w0": 100, "w1": 100, "w2": 100})
+    ws[0]._active = 2
+    ws[1]._active = 1
+    assert s.assign(even).worker_id in ("w1", "w2")
+    # Majority holder with no free slot yields to spread.
+    busy = [_StubWorker("b0", active=4, num_slots=4), _StubWorker("b1")]
+    s2 = _scheduler(busy)
+    t = _locality_task({"b0": 1000})
+    assert s2.assign(t).worker_id == "b1"
+
+
+def test_locality_never_overrides_hard_affinity():
+    from daft_tpu.distributed.task import BoundInput, SchedulingStrategy, Task
+
+    ws = [_StubWorker("w0"), _StubWorker("w1")]
+    s = _scheduler(ws)
+    t = Task(BoundInput(0, None), [],
+             strategy=SchedulingStrategy.affinity("w0", soft=False),
+             input_locality={"w1": 10_000})
+    assert s.assign(t).worker_id == "w0"
+
+
+def test_planner_stamps_reduce_locality():
+    from daft_tpu.distributed.planner import DistributedExecutor
+    from daft_tpu.distributed.partition_ref import LocalPartitionRef
+
+    mp1 = MicroPartition.from_pydict({"x": list(range(100))})
+    mp2 = MicroPartition.from_pydict({"x": [1]})
+    bucket = [LocalPartitionRef(mp1, "big"), LocalPartitionRef(mp2, "small")]
+    weights = DistributedExecutor._locality_of(bucket)
+    assert set(weights) == {"big", "small"}
+    assert weights["big"] > weights["small"]
+    assert DistributedExecutor._locality_of([]) is None
+
+
+# ------------------------------------------------------------------ #
+# End-to-end: byte-identical serial vs distributed (flight shuffle)    #
+# ------------------------------------------------------------------ #
+def _dataset():
+    n = 600
+    return {
+        "a": list(range(n)),
+        "b": [f"k{i % 13}" for i in range(n)],
+        "c": [float((i * 37) % 101) for i in range(n)],
+    }
+
+
+def _queries(df):
+    return {
+        "groupby_sum": lambda: df.groupby("b").agg(
+            col("a").sum().alias("s"), col("a").count().alias("n"),
+        ).sort("b").to_pydict(),
+        "range_sort": lambda: df.sort(["c", "a"], desc=[True, False]).to_pydict(),
+        "hash_join": lambda: df.join(
+            df.select("b").distinct(), on="b").sort("a").to_pydict(),
+        "distinct": lambda: df.select("b").distinct().sort("b").to_pydict(),
+    }
+
+
+@pytest.fixture
+def serial_results():
+    df = daft_tpu.from_pydict(_dataset())
+    with daft_tpu.execution_config_ctx(
+            broadcast_join_size_bytes_threshold=0, result_cache_enabled=False):
+        return {name: q() for name, q in _queries(df).items()}
+
+
+@pytest.mark.parametrize("workers", [2, 8, 16])
+def test_serial_vs_distributed_byte_identity(workers, serial_results):
+    ctx = daft_tpu.get_context()
+    old = ctx._runner
+    runner = DistributedRunner(num_workers=workers)
+    ctx.set_runner(runner)
+    try:
+        df = daft_tpu.from_pydict(_dataset()).into_partitions(
+            min(workers, 8))
+        with daft_tpu.execution_config_ctx(
+                shuffle_algorithm="flight", shuffle_chunk_bytes=4096,
+                broadcast_join_size_bytes_threshold=0,
+                result_cache_enabled=False):
+            for name, q in _queries(df).items():
+                assert q() == serial_results[name], f"{name} @ {workers}w"
+    finally:
+        runner.manager.shutdown()
+        ctx.set_runner(old)
+
+
+@pytest.mark.parametrize("overrides", [
+    {"shuffle_pipelined_fetch": False},
+    {"shuffle_compression": "none"},
+    {"shuffle_prefetch_depth": 1},
+])
+def test_shuffle_mode_equality(overrides, serial_results):
+    """Legacy eager fetch, raw codec, and depth-1 prefetch all produce the
+    SAME bytes as the pipelined+compressed default — mode knobs are perf
+    knobs, never semantics knobs."""
+    ctx = daft_tpu.get_context()
+    old = ctx._runner
+    runner = DistributedRunner(num_workers=3)
+    ctx.set_runner(runner)
+    try:
+        df = daft_tpu.from_pydict(_dataset()).into_partitions(6)
+        with daft_tpu.execution_config_ctx(
+                shuffle_algorithm="flight", shuffle_chunk_bytes=4096,
+                broadcast_join_size_bytes_threshold=0,
+                result_cache_enabled=False, **overrides):
+            for name, q in _queries(df).items():
+                assert q() == serial_results[name], name
+    finally:
+        runner.manager.shutdown()
+        ctx.set_runner(old)
+
+
+def test_distributed_zero_leak_and_metrics(serial_results):
+    ctx = daft_tpu.get_context()
+    old = ctx._runner
+    runner = DistributedRunner(num_workers=3)
+    ctx.set_runner(runner)
+    try:
+        df = daft_tpu.from_pydict(_dataset()).into_partitions(6)
+        w0 = _counter("daft_shuffle_bytes_written_total")
+        f0 = _counter("daft_shuffle_bytes_fetched_total")
+        c0 = _counter("daft_shuffle_chunks_total")
+        with daft_tpu.execution_config_ctx(
+                shuffle_algorithm="flight", shuffle_chunk_bytes=4096,
+                result_cache_enabled=False):
+            assert _queries(df)["groupby_sum"]() == \
+                serial_results["groupby_sum"]
+        assert _counter("daft_shuffle_bytes_written_total") > w0
+        assert _counter("daft_shuffle_bytes_fetched_total") > f0
+        assert _counter("daft_shuffle_chunks_total") > c0
+        # Query teardown released every chunk file (same finally as the
+        # admission ticket) — the zero-leak lifecycle contract.
+        assert audit_shuffle_leaks()["files"] == 0
+    finally:
+        runner.manager.shutdown()
+        ctx.set_runner(old)
+
+
+def test_explain_analyze_shuffle_line(capsys):
+    ctx = daft_tpu.get_context()
+    old = ctx._runner
+    runner = DistributedRunner(num_workers=3)
+    ctx.set_runner(runner)
+    try:
+        df = daft_tpu.from_pydict(_dataset()).into_partitions(4)
+        with daft_tpu.execution_config_ctx(
+                shuffle_algorithm="flight", result_cache_enabled=False):
+            df.groupby("b").agg(col("a").sum().alias("s")) \
+              .explain(analyze=True)
+        text = capsys.readouterr().out
+        assert "shuffle: bytes_written=" in text
+        assert "bytes_fetched=" in text
+    finally:
+        runner.manager.shutdown()
+        ctx.set_runner(old)
+
+
+def test_profiler_shows_fetch_compute_overlap(tmp_path, monkeypatch):
+    """Acceptance: the trace demonstrates pipelining — daft.shuffle.fetch
+    spans run CONCURRENTLY with downstream compute spans (fetch of ref k+1
+    overlaps compute on ref k's morsels). Wire-path refs over a real
+    Flight server with widened per-chunk reads make the overlap window
+    structural, not timing luck."""
+    from daft_tpu import profiling
+
+    real_read = ShuffleCache.read_chunk
+
+    def slow_read(self, ticket):
+        time.sleep(0.01)  # widen each fetch
+        return real_read(self, ticket)
+
+    # More refs than prefetch depth: while the consumer computes over ref
+    # k's morsels, the pool MUST be fetching ref k+2 — overlap is
+    # structural, not a race.
+    cfg = daft_tpu.get_context().execution_config.with_changes(
+        shuffle_chunk_bytes=2048, shuffle_prefetch_depth=2)
+    cache = ShuffleCache([str(tmp_path)])
+    server = start_shuffle_server(cache)
+    try:
+        entries = []
+        for i in range(8):
+            part = MicroPartition.from_pydict({
+                "v": list(range(i * 2000, (i + 1) * 2000))})
+            t = cache.write_partition(f"ov{i}", 0, part, query_id="q",
+                                      cfg=cfg)
+            entries.append((0, i, _shuffle_ref(
+                cache, t, worker_id=f"remote-{i}", address=server.address)))
+        monkeypatch.setattr(ShuffleCache, "read_chunk", slow_read)
+        prof = profiling.TaskProfiler("t" * 32, "0" * 16, "q-overlap",
+                                      worker_id="test")
+        reader = ShuffleReader(entries, part.schema, cfg=cfg, profiler=prof)
+        rows = 0
+        with prof.task_scope(task_id="t-overlap", partition_idx=0):
+            for mp in reader:
+                with prof.span("daft.op.consume"):
+                    time.sleep(0.005)  # downstream compute per morsel
+                    rows += len(mp)
+        assert rows == 16000
+        spans = [profiling.span_from_wire(d) for d in prof.drain()]
+        fetches = [s for s in spans if s.name == "daft.shuffle.fetch"]
+        computes = [s for s in spans if s.name == "daft.op.consume"]
+        assert fetches and computes
+
+        def overlaps(a, b):
+            return a.start_ns < b.end_ns and b.start_ns < a.end_ns
+
+        assert any(overlaps(f, c) for f in fetches for c in computes), \
+            "no fetch span overlapped a compute span: pipelining broken"
+    finally:
+        server.shutdown()
+        cache.cleanup()
+
+
+def test_e2e_profiled_query_has_shuffle_spans():
+    """A profiled distributed flight-shuffle query lands
+    daft.shuffle.{write,fetch,merge} spans in the assembled trace."""
+    ctx = daft_tpu.get_context()
+    old = ctx._runner
+    runner = DistributedRunner(num_workers=2)
+    ctx.set_runner(runner)
+    try:
+        df = daft_tpu.from_pydict(_dataset()).into_partitions(4)
+        with daft_tpu.execution_config_ctx(
+                shuffle_algorithm="flight", shuffle_chunk_bytes=4096,
+                result_cache_enabled=False):
+            q = df.groupby("b").agg(col("a").sum().alias("s")).sort("b")
+            q.collect(profile=True)
+        prof = q.query_profile
+        assert prof is not None
+        names = {s.name for s in prof.spans()}
+        assert "daft.shuffle.write" in names
+        assert "daft.shuffle.fetch" in names
+        assert "daft.shuffle.merge" in names
+    finally:
+        runner.manager.shutdown()
+        ctx.set_runner(old)
+
+
+# ------------------------------------------------------------------ #
+# Chaos: worker death + fetch faults mid-shuffle (lineage recovery)    #
+# ------------------------------------------------------------------ #
+@pytest.fixture
+def chaos_tap():
+    from tests.test_faults import EventTap
+
+    ctx = daft_tpu.get_context()
+    t = EventTap()
+    ctx.attach_subscriber(t)
+    yield t
+    ctx.detach_subscriber(t)
+
+
+@pytest.mark.chaos
+def test_worker_kill_mid_flight_shuffle_recovers(chaos_tap):
+    """Kill a LocalWorker holding chunked map outputs mid-query: the
+    reduce-side streaming reader surfaces chunk-granular fetch errors,
+    lineage recomputes ONLY the lost map task, results are byte-identical,
+    and teardown leaks zero chunk files."""
+    from daft_tpu.distributed.faults import fault_scope
+    from daft_tpu.subscribers.events import PartitionRecovered, WorkerLost
+
+    ctx = daft_tpu.get_context()
+    old = ctx._runner
+    runner = DistributedRunner(num_workers=3)
+    ctx.set_runner(runner)
+    try:
+        def q():
+            return daft_tpu.from_pydict(_dataset()).into_partitions(6) \
+                .groupby("b").agg(col("a").sum().alias("s"),
+                                  col("c").count().alias("n")) \
+                .sort("b").to_pydict()
+
+        with daft_tpu.execution_config_ctx(
+                shuffle_algorithm="flight", shuffle_chunk_bytes=2048,
+                result_cache_enabled=False):
+            expected = q()
+            # Hit 8 lands after the 6 stage-1 submissions: the killed
+            # worker already hosts chunked stage-1 outputs.
+            with fault_scope("worker.pre_submit:kill:8", seed=0):
+                out = q()
+        assert out == expected
+        assert len(chaos_tap.of(WorkerLost)) >= 1
+        assert len(chaos_tap.of(PartitionRecovered)) >= 1
+        assert audit_shuffle_leaks()["files"] == 0
+    finally:
+        runner.manager.shutdown()
+        ctx.set_runner(old)
+
+
+@pytest.mark.chaos
+def test_shuffle_fetch_faults_mid_stream_recover(chaos_tap):
+    """Injected shuffle.fetch failures (the chunk-stream fault point) drive
+    lineage recovery, not query failure; delay faults only slow things."""
+    from daft_tpu.distributed.faults import fault_scope
+    from daft_tpu.subscribers.events import PartitionRecovered
+
+    ctx = daft_tpu.get_context()
+    old = ctx._runner
+    runner = DistributedRunner(num_workers=3)
+    ctx.set_runner(runner)
+    try:
+        def q():
+            return daft_tpu.from_pydict(_dataset()).into_partitions(6) \
+                .groupby("b").agg(col("a").sum().alias("s")) \
+                .sort("b").to_pydict()
+
+        with daft_tpu.execution_config_ctx(
+                shuffle_algorithm="flight", shuffle_chunk_bytes=2048,
+                result_cache_enabled=False):
+            expected = q()
+            with fault_scope("shuffle.fetch:raise:3", seed=0) as inj:
+                out = q()
+            assert inj.fired("shuffle.fetch") == 1
+            assert out == expected
+            assert len(chaos_tap.of(PartitionRecovered)) >= 1
+            # Delay faults: same bytes, just slower.
+            with fault_scope("shuffle.fetch:delay:p0.3:0.02", seed=1):
+                assert q() == expected
+        assert audit_shuffle_leaks()["files"] == 0
+    finally:
+        runner.manager.shutdown()
+        ctx.set_runner(old)
+
+
+@pytest.mark.chaos
+def test_daemon_kill_mid_chunked_shuffle_recovery(chaos_tap):
+    """REAL process death with the chunked plane: a daemon holding chunk
+    files crashes mid-query; surviving daemons' streaming readers fail
+    their chunk fetches, the failure crosses the wire as kind=fetch, and
+    lineage recomputes the lost map outputs."""
+    from daft_tpu.distributed.daemon import (
+        RemoteWorker,
+        spawn_local_daemon,
+        wait_for_daemon,
+    )
+    from daft_tpu.distributed.faults import fault_scope
+    from daft_tpu.distributed.worker import WorkerManager
+    from daft_tpu.subscribers.events import PartitionRecovered
+
+    procs = [spawn_local_daemon(slots=2, fault_injection=True)
+             for _ in range(3)]
+    ctx = daft_tpu.get_context()
+    old = ctx._runner
+    try:
+        addrs = [wait_for_daemon(p) for p in procs]
+        manager = WorkerManager([RemoteWorker(a) for a in addrs])
+        runner = DistributedRunner(manager=manager)
+        ctx.set_runner(runner)
+
+        def q():
+            return daft_tpu.from_pydict({
+                "k": list(range(600)), "g": [i % 7 for i in range(600)],
+            }).into_partitions(6).groupby("g").agg(
+                col("k").sum().alias("s")).sort("g").to_pydict()
+
+        with daft_tpu.execution_config_ctx(
+                shuffle_chunk_bytes=2048, result_cache_enabled=False):
+            expected = q()
+            with fault_scope("worker.pre_submit:kill:8", seed=0):
+                out = q()
+        assert out == expected
+        assert len(manager.workers()) == 2
+        assert [e for e in chaos_tap.of(PartitionRecovered)]
+    finally:
+        ctx.set_runner(old)
+        for p in procs:
+            p.kill()
