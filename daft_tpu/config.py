@@ -235,6 +235,18 @@ class ExecutionConfig:
     result_cache_max_bytes: int = 1 << 30
     result_cache_max_entry_bytes: int = 256 << 20
     result_cache_scan_outputs: bool = True
+    # Memory observatory (execution/memledger.py). Default ON — the
+    # per-query byte ledger every byte-holding subsystem reports into
+    # (permits, stage queues, spill files, shuffle fetch buffers), with
+    # reservation-vs-actual reconciliation at query end and a v3 ``mem``
+    # block on every flight record. DAFT_MEMLEDGER=0 is the kill switch
+    # (and the <2% overhead guard's A/B lever). The RSS sampler thread
+    # correlates process truth against the ledger while queries are in
+    # flight; DAFT_MEM_SAMPLER=0 / mem_sampler_enabled=False disables it
+    # independently, mem_sampler_interval_s paces it.
+    memory_ledger_enabled: bool = True
+    mem_sampler_enabled: bool = True
+    mem_sampler_interval_s: float = 0.25
 
     def with_changes(self, **kwargs) -> "ExecutionConfig":
         return dataclasses.replace(self, **kwargs)
@@ -315,6 +327,10 @@ class ExecutionConfig:
                 os.environ["DAFT_PLAN_CACHE_SIZE"])
         if not daft_env_flag("DAFT_RESULT_CACHE", True):
             changes["result_cache_enabled"] = False
+        if not daft_env_flag("DAFT_MEMLEDGER", True):
+            changes["memory_ledger_enabled"] = False
+        if not daft_env_flag("DAFT_MEM_SAMPLER", True):
+            changes["mem_sampler_enabled"] = False
         if os.environ.get("DAFT_RESULT_CACHE_BYTES"):
             changes["result_cache_max_bytes"] = int(
                 os.environ["DAFT_RESULT_CACHE_BYTES"])

@@ -157,6 +157,11 @@ class WorkerDaemon:
         self._active = 0
         self._lock = threading.Lock()
         self._shutdown = threading.Event()
+        # In-flight task count per query: fragments of ONE query run
+        # concurrently on the pool, and the memory-ledger drain must ship
+        # with the query's LAST finishing fragment — a mid-flight pop
+        # would report a sibling's live held bytes as leaked residue.
+        self._query_tasks: Dict[str, int] = {}
 
     @property
     def flight_address(self) -> str:
@@ -263,9 +268,29 @@ class WorkerDaemon:
             except OSError:
                 pass
 
+    def _finish_query_task_mem(self, query_id: str):
+        """Decrement the query's in-flight task count; the LAST finishing
+        fragment (count reaches zero, decided atomically under the lock)
+        drains and ships the query's worker-side ledger profile. Earlier
+        fragments ship None — their attribution rides out with the last
+        one instead of popping a sibling's live bytes as phantom residue."""
+        with self._lock:
+            n = self._query_tasks.get(query_id, 1) - 1
+            if n <= 0:
+                self._query_tasks.pop(query_id, None)
+            else:
+                self._query_tasks[query_id] = n
+        if n > 0:
+            return None
+        from daft_tpu.execution.memledger import get_ledger
+
+        return get_ledger().drain_query_wire(query_id)
+
     def _run_task(self, msg: dict) -> dict:
         with self._lock:
             self._active += 1
+            qid = msg.get("query_id", "")
+            self._query_tasks[qid] = self._query_tasks.get(qid, 0) + 1
         prof = None
         try:
             from daft_tpu.execution.executor import Executor
@@ -331,6 +356,8 @@ class WorkerDaemon:
 
             return {"ok": True, "refs": refs, "stats": stats.to_wire(),
                     "metrics": get_registry().to_wire(),
+                    "mem": self._finish_query_task_mem(
+                        msg.get("query_id", "")),
                     "spans": profiling.drain_worker_buffer()
                     if prof is not None else None}
         except BaseException as e:  # noqa: BLE001
@@ -347,6 +374,15 @@ class WorkerDaemon:
             from daft_tpu.errors import DaftCancelledError
 
             reply = {"ok": False, "error": f"{e}\n{traceback.format_exc()}"}
+            try:
+                # Per-query ledger state still drains on failure (last
+                # fragment only) and ships whatever was attributed before
+                # the death.
+                reply["mem"] = self._finish_query_task_mem(
+                    msg.get("query_id", ""))
+            # daftlint: disable=DTL002 -- the error reply (which carries the REAL failure) must reach the driver even if the ledger drain breaks
+            except Exception:  # noqa: BLE001 — reply must still go out
+                pass
             if prof is not None:
                 # Partial ERROR spans (task_scope unwound) still ship: the
                 # driver's trace shows how far the task got before failing.
@@ -431,11 +467,17 @@ class RemoteWorker(Worker):
                 f"worker at {self.address} unreachable: {e}") from e
         if not reply.get("ok"):
             # A failed task's partial ERROR spans piggyback the error reply;
-            # deliver them before the raise discards the frame.
+            # deliver them before the raise discards the frame — and the
+            # worker's shipped ledger profile merges the same way (the
+            # daemon already drained its side, so dropping it here would
+            # make a dying task's attributed bytes vanish entirely).
             from daft_tpu import profiling
+            from daft_tpu.execution.memledger import get_ledger
 
             profiling.deliver_spans(reply.get("spans"),
                                     worker_id=getattr(self, "worker_id", None))
+            get_ledger().merge_worker_profile(msg.get("query_id", ""),
+                                              reply.get("mem"))
             err = reply.get("error", "unknown daemon error")
             kind = reply.get("kind")
             if kind == "fetch":
@@ -484,7 +526,10 @@ class RemoteWorker(Worker):
 
                 profiling.deliver_spans(reply.get("spans"),
                                         worker_id=self.worker_id)
+                from daft_tpu.execution.memledger import get_ledger
 
+                get_ledger().merge_worker_profile(task.query_id,
+                                                  reply.get("mem"))
                 emit_operator_stats(task.query_id, reply.get("stats"))
                 # revive=False: a reply racing this worker's death on a
                 # still-open connection must not un-stale it.

@@ -130,10 +130,16 @@ def _worker_entry(fd: int) -> None:
             # The child's cumulative registry snapshot rides the task reply
             # (this wire IS the heartbeat surface for process workers —
             # liveness is proc.poll(), which carries no payload). Completed
-            # profiler spans piggyback the same frame.
+            # profiler spans piggyback the same frame, and the memory
+            # ledger's per-query byte profile ships (and drains worker-
+            # side) like the spill/token tallies before it.
+            from daft_tpu.execution.memledger import get_ledger
+
             _send_frame(sock, cloudpickle.dumps(
                 {"ok": True, "parts": blobs, "stats": stats.to_wire(),
                  "metrics": get_registry().to_wire(),
+                 "mem": get_ledger().drain_query_wire(
+                     payload.get("query_id", "")),
                  "spans": prof.drain() if prof is not None else None}))
         except BaseException as e:  # noqa: BLE001
             import traceback
@@ -142,6 +148,17 @@ def _worker_entry(fd: int) -> None:
             from daft_tpu.errors import DaftCancelledError
 
             reply = {"ok": False, "error": f"{e}\n{traceback.format_exc()}"}
+            try:
+                # Drain the child ledger even on failure (the worker must
+                # not accumulate per-query state past the task) and ship
+                # whatever was attributed before the death.
+                from daft_tpu.execution.memledger import get_ledger
+
+                reply["mem"] = get_ledger().drain_query_wire(
+                    payload.get("query_id", ""))
+            # daftlint: disable=DTL002 -- the error reply (which carries the REAL failure) must reach the driver even if the ledger drain breaks
+            except Exception:  # noqa: BLE001 — reply must still go out
+                pass
             if prof is not None:
                 # The task span closed ERROR/partial in task_scope's unwind:
                 # ship whatever finished so the driver's trace shows how far
@@ -252,9 +269,15 @@ class ProcessWorker(Worker):
                     from daft_tpu import profiling
 
                     # Spans piggyback BOTH reply shapes: a failed task still
-                    # delivers its partial ERROR spans before the raise.
+                    # delivers its partial ERROR spans before the raise —
+                    # and the memory ledger's shipped profile merges the
+                    # same way (a dying task's attributed bytes still count).
                     profiling.deliver_spans(result.get("spans"),
                                             worker_id=self.worker_id)
+                    from daft_tpu.execution.memledger import get_ledger
+
+                    get_ledger().merge_worker_profile(task.query_id,
+                                                      result.get("mem"))
                     if not result["ok"]:
                         if result.get("kind") == "cancelled":
                             from daft_tpu.errors import DaftCancelledError

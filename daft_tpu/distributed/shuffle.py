@@ -516,6 +516,15 @@ class ShuffleReader:
         self._ledger: Dict[int, int] = {}
         self._ledger_lock = threading.Lock()
         self._ledger_closed = False
+        # Memory observatory (execution/memledger.py): fetch buffers charge
+        # kind "shuffle" under the SAME book/settle pairing as the permits
+        # they hold; spilled fetch backlogs charge kind "spill" until their
+        # file is consumed or the reader tears down.
+        from daft_tpu.execution.memledger import get_ledger
+
+        self._memledger = get_ledger()
+        self._memledger_qid = getattr(token, "query_id", "") or ""
+        self._spill_booked: Dict[int, int] = {}
 
     # -- fetch units ----------------------------------------------------- #
     def _units(self) -> Iterator[tuple]:
@@ -646,6 +655,8 @@ class ShuffleReader:
         with self._ledger_lock:
             if not self._ledger_closed:
                 self._ledger[id(item)] = held
+                self._memledger.charge(self._memledger_qid, "ShuffleRead",
+                                       held, kind="shuffle")
                 return item
         self.memory.release(held)
         return (kind, payload, 0)
@@ -653,12 +664,24 @@ class ShuffleReader:
     def _settle(self, item: tuple) -> None:
         """Release an item's permit exactly once (idempotent vs teardown)."""
         _, _, held = item
+        self._settle_spill(item)
         if not held or self.memory is None:
             return
         with self._ledger_lock:
             booked = self._ledger.pop(id(item), None)
         if booked:
             self.memory.release(held)
+            self._memledger.release(self._memledger_qid, "ShuffleRead",
+                                    held, kind="shuffle")
+
+    def _settle_spill(self, item: tuple) -> None:
+        """Release a spilled item's disk-residency attribution exactly once
+        (its file was consumed, unlinked, or is about to be swept)."""
+        with self._ledger_lock:
+            nbytes = self._spill_booked.pop(id(item), None)
+        if nbytes:
+            self._memledger.release(self._memledger_qid, "ShuffleRead",
+                                    nbytes, kind="spill")
 
     def _close_ledger(self) -> None:
         """Teardown: release every still-booked permit (prefetched items
@@ -667,8 +690,16 @@ class ShuffleReader:
             self._ledger_closed = True
             leftover = sum(self._ledger.values())
             self._ledger.clear()
+            spill_leftover = sum(self._spill_booked.values())
+            self._spill_booked.clear()
         if leftover and self.memory is not None:
             self.memory.release(leftover)
+        if leftover:
+            self._memledger.release(self._memledger_qid, "ShuffleRead",
+                                    leftover, kind="shuffle")
+        if spill_leftover:
+            self._memledger.release(self._memledger_qid, "ShuffleRead",
+                                    spill_leftover, kind="spill")
 
     def _release_items(self, items: List[tuple]) -> None:
         for item in items:
@@ -716,7 +747,13 @@ class ShuffleReader:
         # per-operator spill attribution and daft_spill_* totals see
         # shuffle-backlog spill like any sink spill.
         spill_metrics.record(nbytes, 1)
-        return ("spill", path, 0)
+        item = ("spill", path, 0)
+        with self._ledger_lock:
+            if not self._ledger_closed:
+                self._spill_booked[id(item)] = nbytes
+                self._memledger.charge(self._memledger_qid, "ShuffleRead",
+                                       nbytes, kind="spill")
+        return item
 
     def _spill_root(self) -> str:
         # Locked check-then-set: concurrent pool threads spilling their

@@ -435,7 +435,14 @@ class AdmissionController:
         with self._cond:
             st = self._state(tenant)
             st.cache_bytes = max(0, st.cache_bytes + delta)
+            resident = st.cache_bytes
             self._cond.notify_all()
+        # Memory observatory: per-tenant cache residency is exported as a
+        # gauge (the byte ledger's "cache" kind lives at tenant, not query,
+        # granularity — cached results outlive the query that built them).
+        from daft_tpu import metrics
+
+        metrics.RESULT_CACHE_TENANT_BYTES.labels(tenant).set(resident)
 
     def _cache_overage_locked(self, st: _TenantState, cfg) -> int:
         """Bytes of this tenant's cached results that live queries now

@@ -109,6 +109,31 @@ def analyze_suffix(df) -> str:
     if waits:
         lines.append(f"memory permits: waits={waits}, "
                      f"wait_s={h1['sum'] - h0['sum']:.4f}")
+    # Memory observatory (execution/memledger.py): the run's reconciled
+    # byte profile — reserved vs peak-held vs spilled, backpressure stall,
+    # and (below) a per-operator peak column on the profiler table.
+    mem_by_op = {}
+    if prof is not None:
+        from daft_tpu.execution.memledger import get_ledger
+
+        memprof = get_ledger().profile_for(prof.query_id)
+        if memprof is not None and memprof.get("peak_held_bytes"):
+            line = (f"memory: peak_held={memprof['peak_held_bytes']}, "
+                    f"charged={memprof['charged_bytes']}")
+            if memprof.get("reserved_bytes"):
+                over, under = memprof["over_bytes"], memprof["under_bytes"]
+                delta = (f"+{over}" if over
+                         else f"-{under}" if under else "exact")
+                line += (f", reserved={memprof['reserved_bytes']}"
+                         f" ({delta} vs reservation)")
+            if memprof.get("spilled_bytes"):
+                line += f", spilled={memprof['spilled_bytes']}"
+            if memprof.get("stall_s"):
+                line += f", stall_s={memprof['stall_s']:.4f}"
+            if memprof.get("residual_bytes"):
+                line += f", RESIDUAL={memprof['residual_bytes']}"
+            lines.append(line)
+            mem_by_op = memprof.get("by_operator") or {}
     if prof is not None:
         # Flight-recorder line (daft_tpu/querylog.py): the SAME record the
         # always-on query log kept for this run — tenant, admission wait,
@@ -130,13 +155,17 @@ def analyze_suffix(df) -> str:
         lines.append("operators (by self time):")
         lines.append(f"  {'operator':<22} {'rows':>10} {'wall_ms':>9} "
                      f"{'self_ms':>9} {'cpu_ms':>8} {'spill':>10} "
-                     f"{'permit_ms':>9}")
+                     f"{'permit_ms':>9} {'peak_mem':>10}")
         for r in table:
+            # Per-operator peak bytes from the memory ledger (keyed by
+            # operator TYPE; a plan with several nodes of one type shares
+            # the row — the waterfall view on /api/memory has the split).
+            peak = (mem_by_op.get(r["operator"]) or {}).get("peak", 0)
             lines.append(
                 f"  {r['operator']:<22} {r['rows']:>10} "
                 f"{r['wall_ns'] / 1e6:>9.1f} {r['self_wall_ns'] / 1e6:>9.1f} "
                 f"{r['self_cpu_ns'] / 1e6:>8.1f} {r['spill_bytes']:>10} "
-                f"{r['permit_wait_ns'] / 1e6:>9.1f}")
+                f"{r['permit_wait_ns'] / 1e6:>9.1f} {peak:>10}")
     else:
         # No fresh profile (pre-materialized df): fall back to the coarse
         # RuntimeStats counters so analyze still says SOMETHING per op.

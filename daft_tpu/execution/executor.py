@@ -96,6 +96,11 @@ class Executor:
         self._op_frames: Dict[int, object] = {}
         self.memory = get_memory_manager()
         self._held_bytes = 0
+        # Per-operator breakdown of _held_bytes for the memory ledger:
+        # cleanup releases EXACTLY what this executor charged (concurrent
+        # executors of one distributed query share a query id — a bulk
+        # query-wide drain here would zero a sibling's live attribution).
+        self._held_by_op: Dict[str, int] = {}
         # Set under _state_lock when run()'s cleanup has already returned
         # this executor's held permits: a Prefetch/feeder thread whose
         # acquire succeeded JUST as the query unwound (cancel landing
@@ -114,6 +119,14 @@ class Executor:
         # would interleave pushes/pops across chains (stats corruption and
         # races). Exclusive-time attribution is per pull chain.
         self._op_stacks = threading.local()
+        # Memory observatory (execution/memledger.py): every byte this
+        # executor holds — permits, stage-queue residency, spill files —
+        # is charged to (query_id, operator) and drained at run() cleanup.
+        from daft_tpu.execution.memledger import get_ledger
+
+        self._ledger = get_ledger()
+        self._ledger_qid = getattr(cancel_token, "query_id", "") \
+            or (stats.query_id if stats is not None else "") or ""
         n = getattr(cfg, "num_compute_threads", 0)
         self.compute_threads = n if n > 0 else (os.cpu_count() or 1)
         # Morselization bounds for pipeline stages. The floor coalesces
@@ -132,8 +145,15 @@ class Executor:
         if self._spill_dir is None:
             from daft_tpu.execution.spill import SpillDir
 
-            self._spill_dir = SpillDir()
+            self._spill_dir = SpillDir(query_id=self._ledger_qid)
         return self._spill_dir
+
+    def _stage_ledger(self, op: str):
+        """The ``(query_id, operator)`` tag pipeline stages charge their
+        bounded-queue residency under, or None when the plane is off."""
+        if not self._ledger.enabled:
+            return None
+        return (self._ledger_qid, op)
 
     def _sink_budget(self) -> Optional[int]:
         """In-memory working-set budget per blocking sink; None = unbounded
@@ -160,6 +180,7 @@ class Executor:
         self._shared_cache = {}
         with self._state_lock:
             self._permits_closed = False  # executors are re-runnable
+            self._live_iters: List = []
         try:
             yield from self._run(plan)
         except BaseException as e:  # noqa: BLE001 — re-raised below
@@ -177,6 +198,23 @@ class Executor:
                 self.memory.poison(e, query_id=qid or None)
             raise
         finally:
+            # Close every operator iterator DETERMINISTICALLY, children
+            # first. A failure that surfaces BETWEEN operators (the
+            # cancel-check wrapper raising after a pull) unwinds without
+            # passing through sibling handler generators' frames — and the
+            # exception's traceback then pins those suspended frames in a
+            # reference cycle, so their finallys (budget-reservation
+            # releases, spill cleanup, stage teardown) would otherwise wait
+            # for a cyclic GC pass. The memory ledger's drains-to-zero
+            # audit is what made this window visible.
+            with self._state_lock:
+                live, self._live_iters = list(self._live_iters), []
+            for g in reversed(live):
+                try:
+                    g.close()
+                # daftlint: disable=DTL002 -- teardown close of an already-unwinding iterator; an error here must not mask the query's own outcome
+                except Exception:  # noqa: BLE001 — best-effort teardown
+                    pass
             self._shared_cache = {}
             if self._compute_pool is not None:
                 self._compute_pool.shutdown(wait=False, cancel_futures=True)
@@ -191,9 +229,19 @@ class Executor:
             # and-first-morsel leak).
             with self._state_lock:
                 held, self._held_bytes = self._held_bytes, 0
+                by_op, self._held_by_op = self._held_by_op, {}
                 self._permits_closed = True
             if held:
                 self.memory.release(held)
+            # The ledger's permit drain is byte-symmetric with the permit
+            # drain above — EVERY exit (success, poison-woken waiters,
+            # cancel mid-acquire) returns this executor's held-byte
+            # attribution to zero here, so an aborted query can't leave
+            # phantom held bytes behind (the reconciliation audit's
+            # contract).
+            for op, nbytes in by_op.items():
+                self._ledger.release(self._ledger_qid, op, nbytes,
+                                     kind="permit")
             if self.stats is not None:
                 self.stats.flush()
 
@@ -242,7 +290,8 @@ class Executor:
                         # release is byte-symmetric with the grant.
                         limit = self.memory.limit
                         self._add_held(nbytes if limit is None
-                                       else min(nbytes, limit))
+                                       else min(nbytes, limit),
+                                       op="SharedSubtree")
                     else:
                         gate_on = False
                 cached.append(mp)
@@ -256,29 +305,43 @@ class Executor:
         evt.set()
         return cached
 
-    def _add_held(self, nbytes: int) -> None:
+    def _add_held(self, nbytes: int, op: str = "") -> None:
         with self._state_lock:
             if not self._permits_closed:
                 self._held_bytes += nbytes
+                self._held_by_op[op] = self._held_by_op.get(op, 0) + nbytes
+                self._ledger.charge(self._ledger_qid, op, nbytes,
+                                    kind="permit")
                 return
         # Query already unwound and released its held total: this acquire
         # raced the cleanup (side thread past its token check). Releasing
         # here — outside the state lock — keeps available_permits at
-        # baseline instead of leaking until process exit.
+        # baseline instead of leaking until process exit. The ledger was
+        # never charged on this path, so nothing phantom remains there
+        # either (the poison/cancel-mid-acquire regression pins this).
         self.memory.release(nbytes)
 
     def _run_uncached(self, node: pp.PhysicalPlan) -> Iterator[MicroPartition]:
         handler = getattr(self, f"_run_{type(node).__name__}", None)
         if handler is None:
             raise DaftPlanError(f"No executor for physical node {node.name()}")
-        it = handler(node)
+        it = self._track_iter(handler(node))
         if self.cancel_token is not None:
-            it = self._cancel_checked(node.name(), it)
+            it = self._track_iter(self._cancel_checked(node.name(), it))
         if self.profiler is not None:
-            it = self._profiled(node, it)
+            it = self._track_iter(self._profiled(node, it))
         if self.stats is None:
             return it
-        return self._instrumented(node.name(), it)
+        return self._track_iter(self._instrumented(node.name(), it))
+
+    def _track_iter(self, it):
+        """Register an operator iterator for deterministic close at run()
+        cleanup (closing exhausted/closed generators is a no-op)."""
+        with self._state_lock:
+            live = getattr(self, "_live_iters", None)
+            if live is not None:
+                live.append(it)
+        return it
 
     def _cancel_checked(self, op: str,
                         it: Iterator[MicroPartition]) -> Iterator[MicroPartition]:
@@ -565,7 +628,8 @@ class Executor:
         yield from map_stage(
             it, fn, pool=self._pool(), workers=self.compute_threads,
             name=type(node).__name__, ordered=ordered,
-            timer=self._stage_frame(node))
+            timer=self._stage_frame(node),
+            ledger=self._stage_ledger(type(node).__name__))
 
     def _run_Project(self, node: pp.Project) -> Iterator[MicroPartition]:
         yield from self._run_relational_chain(node)
@@ -718,7 +782,8 @@ class Executor:
             yield from map_stage(
                 it, composed, pool=self._pool(),
                 workers=self.compute_threads,
-                name=type(head).__name__, ordered=ordered)
+                name=type(head).__name__, ordered=ordered,
+                ledger=self._stage_ledger(type(head).__name__))
 
     def _run_Explode(self, node: pp.Explode) -> Iterator[MicroPartition]:
         names = [e.name() for e in node.to_explode]
@@ -834,7 +899,8 @@ class Executor:
                                       thread_name_prefix="daft-udf")
         yield from run_stage(child_iter, eval_mp, pool=udf_pool,
                              workers=concurrency, name="UDFProject",
-                             owns_pool=True, timer=self._stage_frame(node))
+                             owns_pool=True, timer=self._stage_frame(node),
+                             ledger=self._stage_ledger("UDFProject"))
 
     # -- streaming sinks --------------------------------------------------
     def _run_Limit(self, node: pp.Limit) -> Iterator[MicroPartition]:
@@ -859,13 +925,17 @@ class Executor:
 
     # -- blocking sinks ---------------------------------------------------
     def _collect(self, node: pp.PhysicalPlan,
-                 source: Optional[Iterator[MicroPartition]] = None
-                 ) -> MicroPartition:
+                 source: Optional[Iterator[MicroPartition]] = None,
+                 op: Optional[str] = None) -> MicroPartition:
         """Materialise a blocking-sink input under memory permits
-        (reference: resource_manager.rs memory manager + DAFT_MEMORY_LIMIT)."""
+        (reference: resource_manager.rs memory manager + DAFT_MEMORY_LIMIT).
+        ``op`` is the memory-ledger attribution — the SINK doing the
+        buffering (callers pass their own name; the default blames the
+        collected node, which is the sink itself on most paths)."""
         parts = []
         limit = self.memory.limit
         gate_on = limit is not None
+        op = op or type(node).__name__
         for mp in (source if source is not None else self._run(node)):
             nbytes = mp.size_bytes()
             # Permits bound memory across CONCURRENT executors (distributed
@@ -876,7 +946,7 @@ class Executor:
             if gate_on and self._held_bytes < limit:
                 if self.memory.acquire(nbytes, timeout=5.0,
                                        token=self.cancel_token):
-                    self._add_held(min(nbytes, limit))
+                    self._add_held(min(nbytes, limit), op=op)
                 else:
                     gate_on = False
             parts.append(mp)
@@ -887,13 +957,14 @@ class Executor:
     def _run_Sort(self, node: pp.Sort) -> Iterator[MicroPartition]:
         budget = self._sink_budget()
         if budget is None:
-            combined = self._collect(node.children[0])
+            combined = self._collect(node.children[0], op="Sort")
             yield combined.sort(node.sort_by, node.descending, node.nulls_first)
             return
         # Out-of-core: sorted-run generation + k-way streaming merge.
         from daft_tpu.execution.spill import ExternalSort, budget_reservation
 
-        with budget_reservation(self.memory, budget, token=self.cancel_token):
+        with budget_reservation(self.memory, budget, token=self.cancel_token,
+                                op="Sort"):
             state = ExternalSort(node.sort_by, node.descending, node.nulls_first,
                                  node.schema, budget, self._spill(),
                                  morsel_rows=self.cfg.default_morsel_size)
@@ -1082,7 +1153,8 @@ class Executor:
                                  pool=self._pool(),
                                  workers=self.compute_threads,
                                  name="AggPartial",
-                                 timer=self._stage_frame(node)):
+                                 timer=self._stage_frame(node),
+                                 ledger=self._stage_ledger("Aggregate")):
             state.add_partial(partial)
         yield MicroPartition(node.schema,
                              [self._node_timed(node, state.finalize)])
@@ -1112,7 +1184,8 @@ class Executor:
         for parts in map_stage(chunks, split_chunk, pool=self._pool(),
                                workers=self.compute_threads,
                                name="AggPartition",
-                               timer=self._stage_frame(node)):
+                               timer=self._stage_frame(node),
+                               ledger=None):  # lists, not morsels
             for i, rb in enumerate(parts):
                 if len(rb):
                     buckets[i].append(rb)
@@ -1173,7 +1246,8 @@ class Executor:
                 for b in range(n_buckets)]
 
     def _grace_grouped_agg(self, items, fresh_state, budget, schema,
-                           ingest) -> Iterator[MicroPartition]:
+                           ingest, op: str = "Aggregate"
+                           ) -> Iterator[MicroPartition]:
         """Grace aggregation: whenever the merged partial state outgrows the
         budget, hash-partition it by group key into disk buckets; each
         bucket is then merged + finalized independently (keys of one group
@@ -1193,11 +1267,12 @@ class Executor:
                 grace = GracePartitioner(
                     lambda rb: [rb.get_column(n) for n in key_names],
                     num_buckets=self.GRACE_BUCKETS, spill=self._spill(),
-                    total_buffer_bytes=budget)
+                    total_buffer_bytes=budget, op=op)
             for partial in st.partial_batches():
                 grace.add(partial)
 
-        with budget_reservation(self.memory, budget, token=self.cancel_token):
+        with budget_reservation(self.memory, budget, token=self.cancel_token,
+                                op=op):
             for item in items:
                 ingest(state, item)
                 if state.approx_size_bytes() > budget:
@@ -1234,7 +1309,8 @@ class Executor:
 
         state: AggState = node.two_phase() if callable(node.two_phase) else node.two_phase
         budget = self._sink_budget()
-        with budget_reservation(self.memory, budget, token=self.cancel_token) if budget is not None \
+        with budget_reservation(self.memory, budget, token=self.cancel_token,
+                                op="AggregatePartial") if budget is not None \
                 else contextlib.nullcontext():
             emitted = False
             for mp in self._run(node.children[0]):
@@ -1284,10 +1360,11 @@ class Executor:
 
         yield from self._grace_grouped_agg(
             rb_stream(), make, budget, node.schema,
-            ingest=lambda st, rb: st.accumulate_unmerged_partial(rb))
+            ingest=lambda st, rb: st.accumulate_unmerged_partial(rb),
+            op="AggregateFinal")
 
     def _run_SortSample(self, node: pp.SortSample) -> Iterator[MicroPartition]:
-        combined = self._collect(node.children[0]).combined()
+        combined = self._collect(node.children[0], op="SortSample").combined()
         keys = [evaluate(e, combined).rename(f"__sk_{i}") for i, e in enumerate(node.sort_by)]
         keys_rb = RecordBatch(node.schema, keys, len(combined)) if keys else RecordBatch.empty(node.schema)
         sorted_rb = keys_rb.sort(list(keys_rb.columns()), node.descending, node.nulls_first)
@@ -1304,7 +1381,7 @@ class Executor:
 
         # Pre-aggregate (group_by + pivot) then pivot to columns.
         agg = Alias(AggOp(node.agg_fn, node.value_col), "__pivot_value")
-        combined = self._collect(node.children[0]).combined()
+        combined = self._collect(node.children[0], op="Pivot").combined()
         pre = combined.agg([agg], node.group_by + [node.pivot_col])
         group_keys = [pre.get_column(g.name()) for g in node.group_by]
         out = pre.pivot(group_keys, pre.get_column(node.pivot_col.name()),
@@ -1323,7 +1400,8 @@ class Executor:
         key_names = on or node.schema.column_names()
         import contextlib
 
-        with budget_reservation(self.memory, budget, token=self.cancel_token) if budget is not None \
+        with budget_reservation(self.memory, budget, token=self.cancel_token,
+                                op="Distinct") if budget is not None \
                 else contextlib.nullcontext():
             grace: Optional[GracePartitioner] = None
             buffer: List[RecordBatch] = []
@@ -1339,7 +1417,7 @@ class Executor:
                         grace = GracePartitioner(
                             lambda b: [b.get_column(n) for n in key_names],
                             num_buckets=self.GRACE_BUCKETS, spill=self._spill(),
-                            total_buffer_bytes=budget)
+                            total_buffer_bytes=budget, op="Distinct")
                     for b in buffer:
                         grace.add(b)
                     buffer, buf_bytes = [], 0
@@ -1371,7 +1449,7 @@ class Executor:
         if budget is None or part_keys is None:
             # Unpartitioned windows (or no memory limit) need the whole
             # input in one batch.
-            combined = self._collect(node.children[0]).combined()
+            combined = self._collect(node.children[0], op="Window").combined()
             yield MicroPartition(node.schema,
                                  [eval_windows(combined, node.window_exprs,
                                                node.schema)])
@@ -1382,7 +1460,8 @@ class Executor:
         # unspecified, as everywhere else in the engine outside Sort).
         from daft_tpu.execution.spill import GracePartitioner, budget_reservation
 
-        with budget_reservation(self.memory, budget, token=self.cancel_token):
+        with budget_reservation(self.memory, budget, token=self.cancel_token,
+                                op="Window"):
             grace: Optional[GracePartitioner] = None
             buffer: List[RecordBatch] = []
             buf_bytes = 0
@@ -1394,7 +1473,7 @@ class Executor:
                     grace = GracePartitioner(
                         lambda b: [evaluate(k, b) for k in part_keys],
                         num_buckets=self.GRACE_BUCKETS, spill=self._spill(),
-                        total_buffer_bytes=budget)
+                        total_buffer_bytes=budget, op="Window")
                 if grace is not None:
                     for b in buffer:
                         grace.add(b)
@@ -1448,7 +1527,8 @@ class Executor:
 
     def _collect_or_grace(self, child: pp.PhysicalPlan, key_exprs, budget,
                           key_dtypes=None, num_buckets: Optional[int] = None,
-                          source: Optional[Iterator[MicroPartition]] = None):
+                          source: Optional[Iterator[MicroPartition]] = None,
+                          op: str = "HashJoin"):
         """Materialize a join side in memory, or — once it outgrows the
         budget — hash-partition it by join key into disk buckets (grace hash
         join). ``key_dtypes`` are the UNIFIED join-key dtypes: both sides must
@@ -1459,7 +1539,7 @@ class Executor:
         probe-side prefetch). Returns ("mem", MicroPartition) or
         ("grace", GracePartitioner)."""
         if budget is None:
-            return "mem", self._collect(child, source=source)
+            return "mem", self._collect(child, source=source, op=op)
         from daft_tpu.execution.spill import GracePartitioner
 
         key_fn = lambda rb: self._unified_keys(rb, key_exprs, key_dtypes)  # noqa: E731
@@ -1477,7 +1557,7 @@ class Executor:
                 grace = GracePartitioner(key_fn,
                                          num_buckets or self.GRACE_BUCKETS,
                                          self._spill(),
-                                         total_buffer_bytes=budget)
+                                         total_buffer_bytes=budget, op=op)
                 for buffered in buffer:
                     for rb in buffered.record_batches():
                         grace.add(rb)
@@ -1519,7 +1599,8 @@ class Executor:
         from daft_tpu.execution.spill import budget_reservation
 
         budget = self._sink_budget()
-        with budget_reservation(self.memory, budget, token=self.cancel_token) if budget is not None \
+        with budget_reservation(self.memory, budget, token=self.cancel_token,
+                                op="HashJoin") if budget is not None \
                 else contextlib.nullcontext():
             yield from self._hash_join_impl(node, budget)
 
@@ -1713,7 +1794,7 @@ class Executor:
         return self._finish_join(joined, coalesce, node)
 
     def _run_AsofJoin(self, node: pp.AsofJoin) -> Iterator[MicroPartition]:
-        right = self._collect(node.children[1]).combined()
+        right = self._collect(node.children[1], op="AsofJoin").combined()
         right_on = evaluate(node.right_on, right)
         right_by = [evaluate(e, right) for e in node.right_by]
         for mp in self._run(node.children[0]):
@@ -1725,7 +1806,7 @@ class Executor:
             yield MicroPartition(node.schema, [self._conform_to_schema(joined, node.schema)])
 
     def _run_CrossJoin(self, node: pp.CrossJoin) -> Iterator[MicroPartition]:
-        right = self._collect(node.children[1]).combined()
+        right = self._collect(node.children[1], op="CrossJoin").combined()
         for mp in self._run(node.children[0]):
             joined = mp.combined().cross_join(right, node.suffix)
             yield MicroPartition(node.schema, [self._conform_to_schema(joined, node.schema)])
@@ -1758,10 +1839,12 @@ class Executor:
                 # contract).
                 from daft_tpu.execution.spill import budget_reservation
 
-                with budget_reservation(self.memory, budget, token=self.cancel_token):
+                with budget_reservation(self.memory, budget,
+                                        token=self.cancel_token,
+                                        op="Repartition"):
                     state, side = self._collect_or_grace(
                         node.children[0], exprs, budget,
-                        num_buckets=max(n, 1))
+                        num_buckets=max(n, 1), op="Repartition")
                     if state == "mem":
                         for part in side.partition_by_hash(exprs, n):
                             yield part
@@ -1770,11 +1853,11 @@ class Executor:
                         yield MicroPartition(node.schema,
                                              list(side.stream_bucket(b)))
                 return
-            combined = self._collect(node.children[0])
+            combined = self._collect(node.children[0], op="Repartition")
             for part in combined.partition_by_hash(exprs, n):
                 yield part
             return
-        combined = self._collect(node.children[0])
+        combined = self._collect(node.children[0], op="Repartition")
         if kind == "range_bound":
             # Range partition against precomputed boundary rows (distributed
             # sort stage 2).

@@ -1,0 +1,529 @@
+"""Memory observatory: the per-query, per-operator byte ledger.
+
+The engine's time domain is observable end to end (profiler, flight
+recorder, SLO plane) but until now the BYTE domain was not: admission
+charges per-tenant memory *reservations* (execution/admission.py) that were
+never reconciled against what a query actually held, and every byte-holding
+subsystem — MemoryManager permits, pipeline stage queues, sink spill files,
+shuffle fetch buffers, the result cache — accounted privately. This module
+is the one ledger they all report into (the reservation-vs-usage gap that
+motivates resource accounting in TensorFlow's memory-aware placement and
+fair tenant batching in AAFLOW, PAPERS.md):
+
+* **Charges** are ``(query_id, operator, kind)``-keyed byte deltas. Kinds:
+
+  ========  ==============================================================
+  permit    MemoryManager bytes held by the query's executor (blocking
+            sinks, shared-subtree pins) — the executor's ``_add_held``
+            path and ``budget_reservation`` working-set reservations
+  queue     pipeline-stage bounded-queue residency: a morsel is charged
+            when a stage worker completes it and released when the
+            consumer takes it (execution/pipeline.py)
+  spill     sink spill-file residency (execution/spill.py SpillDir) —
+            charged at write, released when the spill dir cleans up
+  shuffle   reduce-side fetch buffers holding MemoryManager permits
+            (distributed/shuffle.py ShuffleReader)
+  cache     result-cache bytes charged per TENANT (mirrors
+            admission.note_cache_bytes; surfaced in /api/memory)
+  ========  ==============================================================
+
+* **Structural pairing, not ambient guessing**: every charge site is
+  paired with its release site by code structure (the same discipline as
+  the shuffle reader's permit ledger), so the ledger drains to zero at
+  query teardown by construction. Releases clamp at zero and ignore
+  unknown keys — a release that races teardown is a no-op, never a
+  negative balance. :meth:`MemoryLedger.finish_query` force-drains any
+  residue (counted in ``daft_memory_ledger_residual_bytes_total`` so a
+  leaking charge site is VISIBLE, and asserted zero by the load_storm /
+  chaos audits).
+* **Reconciliation**: at query end the runner calls
+  :meth:`finish_query` with the admission ticket's reservation; the
+  ledger emits ``daft_memory_reservation_over_bytes`` /
+  ``daft_memory_reservation_under_bytes`` and returns the flight-record
+  v3 ``mem`` block (reserved vs peak-held vs spilled, per-operator peaks,
+  stall time, RSS high-water over the query window).
+* **Determinism**: cumulative charged bytes per (operator, kind) are a
+  pure function of the morsel stream — the PR 8 contract makes them
+  identical at any ``num_compute_threads`` (peaks legitimately vary with
+  concurrency; tests pin the cumulative numbers).
+* **Process truth**: a lightweight RSS sampler thread (:class:`RssSampler`)
+  wakes only while queries are in flight, correlating ``/proc`` RSS
+  against the ledger's held total (``daft_memory_rss_bytes`` /
+  ``daft_memory_unaccounted_bytes``) so systematic under-accounting shows
+  up instead of hiding.
+
+Worker attribution: LocalWorkers share this process ledger (same query
+ids). Process/daemon workers charge their OWN ledger and ship
+:meth:`drain_query_wire` on the task reply — the driver folds it in with
+:meth:`merge_worker_profile` (charged/spill/stall sum; peaks take the max
+— per-task peaks on different workers never coexist in one address space,
+so summing them would overstate).
+
+``DAFT_MEMLEDGER=0`` (or ``memory_ledger_enabled=False``) disables the
+plane: charge/release become attribute-check no-ops and
+``perf_observatory.py --memory-overhead`` holds the enabled path under the
+established <2% ABBA bound against exactly that switch.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional
+
+KIND_PERMIT = "permit"
+KIND_QUEUE = "queue"
+KIND_SPILL = "spill"
+KIND_SHUFFLE = "shuffle"
+KIND_CACHE = "cache"
+KINDS = (KIND_PERMIT, KIND_QUEUE, KIND_SPILL, KIND_SHUFFLE, KIND_CACHE)
+
+#: Operator rows kept on a finished query's ``mem`` block (top by peak).
+PROFILE_TOP_OPERATORS = 8
+
+#: Finished-profile ring capacity (the /api/memory waterfall history).
+PROFILE_RING = 256
+
+
+class _OpSlot:
+    """Per-(operator, kind) accumulator inside one query's ledger."""
+
+    __slots__ = ("held", "peak", "charged")
+
+    def __init__(self):
+        self.held = 0
+        self.peak = 0
+        self.charged = 0
+
+
+class _QueryLedger:
+    """One in-flight query's byte state (guarded by its own lock so hot
+    charges on one query never contend with another query's)."""
+
+    __slots__ = ("query_id", "lock", "ops", "held", "peak", "charged",
+                 "stall_ns", "rss_peak", "started_at", "worker_peak",
+                 "worker_residual")
+
+    def __init__(self, query_id: str):
+        self.query_id = query_id
+        self.lock = threading.Lock()
+        self.ops: Dict[tuple, _OpSlot] = {}
+        self.held = 0
+        self.peak = 0
+        self.charged = 0
+        self.stall_ns = 0
+        self.rss_peak = 0
+        self.started_at = time.monotonic()
+        # Max single-worker peak merged off task-reply wires (process /
+        # daemon workers): remote peaks never share an address space with
+        # the driver's, so they are tracked separately and the profile
+        # reports the larger of the two. Worker-side force-drained residue
+        # sums — a leaking charge site on a worker must stay VISIBLE in
+        # the driver's reconciliation, not vanish with the worker's entry.
+        self.worker_peak = 0
+        self.worker_residual = 0
+
+    def snapshot(self) -> dict:
+        with self.lock:
+            by_op: Dict[str, dict] = {}
+            for (op, kind), slot in self.ops.items():
+                row = by_op.setdefault(op or "(unattributed)",
+                                       {"peak": 0, "held": 0, "charged": 0,
+                                        "kinds": {}})
+                row["peak"] += slot.peak
+                row["held"] += slot.held
+                row["charged"] += slot.charged
+                k = row["kinds"].setdefault(kind, {"peak": 0, "charged": 0})
+                k["peak"] = slot.peak
+                k["charged"] = slot.charged
+            return {
+                "query_id": self.query_id,
+                "held_bytes": self.held,
+                "peak_held_bytes": max(self.peak, self.worker_peak),
+                "charged_bytes": self.charged,
+                "stall_s": round(self.stall_ns / 1e9, 6),
+                "rss_peak_bytes": self.rss_peak,
+                "age_s": round(time.monotonic() - self.started_at, 3),
+                "by_operator": by_op,
+            }
+
+
+class MemoryLedger:
+    """THE process byte ledger (one per process, like the MemoryManager
+    whose grants it attributes)."""
+
+    def __init__(self, enabled: Optional[bool] = None):
+        if enabled is None:
+            from daft_tpu.config import daft_env_flag
+
+            enabled = daft_env_flag("DAFT_MEMLEDGER", True)
+        self.enabled = enabled
+        self._lock = threading.Lock()
+        self._queries: Dict[str, _QueryLedger] = {}
+        self._ring: deque = deque(maxlen=PROFILE_RING)
+        self._sampler: Optional[RssSampler] = None
+
+    # -- query lookup ------------------------------------------------------
+    def _q(self, query_id: str) -> _QueryLedger:
+        q = self._queries.get(query_id)
+        if q is None:
+            with self._lock:
+                q = self._queries.setdefault(query_id,
+                                             _QueryLedger(query_id))
+            self._wake_sampler()
+        return q
+
+    # -- charge / release --------------------------------------------------
+    def charge(self, query_id: str, op: str, nbytes: int,
+               kind: str = KIND_PERMIT) -> None:
+        """Attribute ``nbytes`` now held by ``query_id``'s ``op``. Charges
+        with NO query id are dropped outright: nothing would ever call
+        finish_query for them, so booking them could only strand balances
+        (bare Executors in tests, token-less shuffle readers)."""
+        if not self.enabled or nbytes <= 0 or not query_id:
+            return
+        q = self._q(query_id)
+        with q.lock:
+            slot = q.ops.get((op, kind))
+            if slot is None:
+                slot = q.ops.setdefault((op, kind), _OpSlot())
+            slot.held += nbytes
+            slot.charged += nbytes
+            if slot.held > slot.peak:
+                slot.peak = slot.held
+            q.held += nbytes
+            q.charged += nbytes
+            if q.held > q.peak:
+                q.peak = q.held
+
+    def release(self, query_id: str, op: str, nbytes: int,
+                kind: str = KIND_PERMIT) -> None:
+        """Return ``nbytes`` previously charged. Clamps at zero and ignores
+        unknown (query, op, kind) keys: a release racing query teardown is
+        a no-op, never a negative balance (the finish/force-drain already
+        zeroed the entry)."""
+        if not self.enabled or nbytes <= 0:
+            return
+        q = self._queries.get(query_id or "")
+        if q is None:
+            return
+        with q.lock:
+            slot = q.ops.get((op, kind))
+            if slot is None:
+                return
+            taken = min(nbytes, slot.held)
+            slot.held -= taken
+            q.held -= taken
+
+    def note_stall(self, query_id: str, op: str, seconds: float) -> None:
+        """Blocked-producer stall: a stage feeder spent ``seconds`` unable
+        to enqueue because the bounded queue was full (backpressure
+        engaged downstream of ``op``)."""
+        if not self.enabled or seconds <= 0:
+            return
+        from daft_tpu import metrics
+
+        metrics.PIPELINE_STALL.labels(op or "stage").inc(seconds)
+        if not query_id:
+            return  # the metric keeps the signal; no entry to strand
+        q = self._q(query_id)
+        with q.lock:
+            q.stall_ns += int(seconds * 1e9)
+
+    # -- worker merge ------------------------------------------------------
+    def drain_query_wire(self, query_id: str) -> Optional[dict]:
+        """Worker side: pop the query's ledger state into a task-reply
+        payload (the spill/token tally discipline — the worker must not
+        accumulate per-query state past the task that produced it)."""
+        if not self.enabled:
+            return None
+        with self._lock:
+            q = self._queries.pop(query_id or "", None)
+        if q is None:
+            return None
+        snap = q.snapshot()
+        snap["residual_bytes"] = snap.pop("held_bytes")
+        return snap
+
+    def merge_worker_profile(self, query_id: str,
+                             wire: Optional[dict]) -> None:
+        """Driver side: fold one worker task's shipped ledger profile into
+        the query's driver ledger. Charged/stall SUM (they are work);
+        peaks take the MAX (per-task peaks in different processes never
+        coexist, so summing would overstate the high-water mark)."""
+        if not self.enabled or not wire:
+            return
+        q = self._q(query_id or "")
+        with q.lock:
+            q.charged += int(wire.get("charged_bytes", 0))
+            q.stall_ns += int(wire.get("stall_s", 0.0) * 1e9)
+            q.worker_peak = max(q.worker_peak,
+                                int(wire.get("peak_held_bytes", 0)))
+            q.worker_residual += int(wire.get("residual_bytes", 0))
+            for op, row in (wire.get("by_operator") or {}).items():
+                for kind, k in (row.get("kinds") or {}).items():
+                    slot = q.ops.setdefault((op, kind), _OpSlot())
+                    slot.charged += int(k.get("charged", 0))
+                    slot.peak = max(slot.peak, int(k.get("peak", 0)))
+
+    # -- finish / reconcile ------------------------------------------------
+    def finish_query(self, query_id: str, reserved_bytes: int = 0,
+                     tenant: str = "") -> dict:
+        """Close the query's ledger into one ``mem`` profile (flight-record
+        v3 block), reconciling the peak against the admission reservation.
+        Any residue still held is FORCE-DRAINED (the ledger must return to
+        zero at teardown whatever the outcome) and reported both on the
+        block and on ``daft_memory_ledger_residual_bytes_total`` so a
+        leaking charge site cannot hide — worker-shipped residue
+        (``merge_worker_profile``) counts too."""
+        with self._lock:
+            # The pop runs even when the plane is DISABLED: a query that
+            # charged bytes before a mid-flight disable must still have
+            # its entry removed here, or the dict (and total_held) would
+            # strand its balance forever.
+            q = self._queries.pop(query_id or "", None)
+        if not self.enabled:
+            return {}
+        with self._lock:
+            self._sweep_stale_locked()
+        if q is None:
+            q = _QueryLedger(query_id or "")
+        snap = q.snapshot()
+        residual = snap.pop("held_bytes") + q.worker_residual
+        peak = snap["peak_held_bytes"]
+        spilled = sum(k["charged"]
+                      for row in snap["by_operator"].values()
+                      for kind, k in row["kinds"].items()
+                      if kind == KIND_SPILL)
+        over = under = 0
+        if reserved_bytes > 0:
+            over = max(peak - reserved_bytes, 0)
+            under = max(reserved_bytes - peak, 0)
+        # Bound the per-operator table (a 100-operator plan's mem block
+        # must not dominate the flight record): top rows by peak.
+        by_op = dict(sorted(snap["by_operator"].items(),
+                            key=lambda kv: -kv[1]["peak"]
+                            )[:PROFILE_TOP_OPERATORS])
+        for row in by_op.values():
+            row.pop("held", None)
+        block = {
+            "reserved_bytes": int(reserved_bytes),
+            "peak_held_bytes": peak,
+            "charged_bytes": snap["charged_bytes"],
+            "spilled_bytes": spilled,
+            "stall_s": snap["stall_s"],
+            "over_bytes": over,
+            "under_bytes": under,
+            "rss_peak_bytes": snap["rss_peak_bytes"],
+            "residual_bytes": residual,
+            "by_operator": by_op,
+        }
+        from daft_tpu import metrics
+
+        if reserved_bytes > 0:
+            metrics.MEM_RESERVATION_OVER.inc(over)
+            metrics.MEM_RESERVATION_UNDER.inc(under)
+        if residual:
+            metrics.MEM_LEDGER_RESIDUAL.inc(residual)
+        with self._lock:
+            self._ring.append({"query_id": query_id, "tenant": tenant,
+                               # daftlint: disable=DTL001 -- operator-facing wall timestamp on a finished profile (display, never recompute-sensitive)
+                               "ts": time.time(), **block})
+        return block
+
+    def _sweep_stale_locked(self, max_age_s: float = 3600.0) -> None:
+        """Drop resurrected husks (caller holds ``_lock``): a stage worker
+        completing a morsel JUST as its query finished — or a straggler
+        task reply merging after the driver reconciled — re-creates the
+        query's entry with zero held bytes and no finish_query ever
+        coming. Swept at finish_query AND from the sampler tick, so a
+        serving process can neither accumulate them nor keep the sampler
+        awake forever; an hour-old zero-held entry is never a live
+        query's state worth keeping."""
+        now = time.monotonic()
+        for qid in [qid for qid, ql in self._queries.items()
+                    if ql.held == 0 and now - ql.started_at > max_age_s]:
+            del self._queries[qid]
+
+    # -- introspection / audit ---------------------------------------------
+    def total_held(self) -> int:
+        """Bytes the ledger believes are live RIGHT NOW across every
+        query and kind — THE zero-leak audit surface: 0 on an idle
+        engine, always (load_storm / chaos assert it)."""
+        with self._lock:
+            queries = list(self._queries.values())
+        return sum(q.held for q in queries)
+
+    def audit(self) -> Dict[str, int]:
+        """{query_id: held_bytes} for every query with a non-zero balance
+        (empty on a healthy idle engine)."""
+        with self._lock:
+            queries = list(self._queries.values())
+        return {q.query_id: q.held for q in queries if q.held}
+
+    def live_snapshot(self) -> List[dict]:
+        with self._lock:
+            queries = list(self._queries.values())
+        return [q.snapshot() for q in queries]
+
+    def recent_profiles(self, n: int = 50) -> List[dict]:
+        with self._lock:
+            out = list(self._ring)
+        out.reverse()
+        return out[:n]
+
+    def profile_for(self, query_id: str) -> Optional[dict]:
+        with self._lock:
+            for p in reversed(self._ring):
+                if p["query_id"] == query_id:
+                    return p
+        return None
+
+    def reset(self) -> None:
+        """Drop all state (tests)."""
+        with self._lock:
+            self._queries.clear()
+            self._ring.clear()
+
+    # -- RSS sampler glue --------------------------------------------------
+    def _wake_sampler(self) -> None:
+        s = self._sampler
+        if s is not None:
+            s.wake()
+
+    def ensure_sampler(self, cfg=None) -> Optional["RssSampler"]:
+        """Start the process RSS sampler once (lazy — the first query
+        through a runner arms it). Disabled by DAFT_MEM_SAMPLER=0 /
+        ``mem_sampler_enabled=False`` or when the ledger itself is off."""
+        if not self.enabled:
+            return None
+        if self._sampler is not None:
+            return self._sampler
+        from daft_tpu.config import daft_env_flag
+
+        enabled = daft_env_flag("DAFT_MEM_SAMPLER", True)
+        if cfg is not None and not getattr(cfg, "mem_sampler_enabled", True):
+            enabled = False
+        if not enabled:
+            return None
+        with self._lock:
+            if self._sampler is None:
+                interval = getattr(cfg, "mem_sampler_interval_s", 0.25) \
+                    if cfg is not None else 0.25
+                self._sampler = RssSampler(self, interval_s=interval)
+                self._sampler.start()
+        return self._sampler
+
+    def _sampler_tick(self, rss: int) -> None:
+        """One sampler observation: export process truth vs ledger belief
+        and stamp the RSS high-water onto every in-flight query."""
+        from daft_tpu import metrics
+
+        held = self.total_held()
+        metrics.MEM_RSS.set(rss)
+        metrics.MEM_LEDGER_HELD.set(held)
+        metrics.MEM_UNACCOUNTED.set(max(rss - held, 0))
+        with self._lock:
+            self._sweep_stale_locked()
+            queries = list(self._queries.values())
+        for q in queries:
+            with q.lock:
+                if rss > q.rss_peak:
+                    q.rss_peak = rss
+
+    def active_queries(self) -> int:
+        with self._lock:
+            return len(self._queries)
+
+
+def read_rss_bytes() -> int:
+    """Current process RSS. Linux reads /proc/self/statm (resident pages);
+    elsewhere falls back to the ru_maxrss HIGH-water (the best portable
+    signal — documented as a peak, not a level)."""
+    try:
+        with open("/proc/self/statm") as f:
+            import os
+
+            return int(f.read().split()[1]) * (os.sysconf("SC_PAGE_SIZE")
+                                               if hasattr(os, "sysconf")
+                                               else 4096)
+    except (OSError, ValueError, IndexError):
+        pass
+    # Fallback: THE shared ru_maxrss helper (perf_report) — the darwin
+    # bytes-vs-kilobytes quirk is encoded exactly once in the engine.
+    # Documented caveat: this is the process HIGH-water, not a level.
+    try:
+        from daft_tpu.perf_report import peak_rss_bytes
+
+        return peak_rss_bytes()
+    # daftlint: disable=DTL002 -- observability fallback: RSS sampling must degrade to 0, never surface into query paths
+    except Exception:  # noqa: BLE001 — sampling must never raise
+        return 0
+
+
+class RssSampler:
+    """Daemon thread correlating process RSS against the ledger.
+
+    Sleeps on an event while no queries are in flight (an idle serving
+    process pays ZERO sampler wakeups); each active-period tick is two
+    file reads + three gauge sets, far under the <2% plane budget."""
+
+    def __init__(self, ledger: MemoryLedger, interval_s: float = 0.25):
+        self.ledger = ledger
+        self.interval_s = max(float(interval_s), 0.02)
+        self._wake = threading.Event()
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="daft-mem-sampler")
+        self.samples = 0
+
+    def start(self) -> None:
+        self._thread.start()
+
+    def wake(self) -> None:
+        self._wake.set()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._wake.set()
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            if self.ledger.active_queries() == 0:
+                # Park until the next query begins (no idle burn).
+                self._wake.wait()
+                self._wake.clear()
+                if self._stop.is_set():
+                    return
+            try:
+                self.ledger._sampler_tick(read_rss_bytes())
+                self.samples += 1
+            # daftlint: disable=DTL002 -- observability sampler: a tick failure must never kill the thread or surface into query paths
+            except Exception:  # noqa: BLE001 — the sampler must never die
+                pass
+            time.sleep(self.interval_s)
+
+
+# --------------------------------------------------------------------- #
+# Process-global ledger                                                   #
+# --------------------------------------------------------------------- #
+_LEDGER: Optional[MemoryLedger] = None
+_ledger_lock = threading.Lock()
+
+
+def get_ledger() -> MemoryLedger:
+    """THE process memory ledger. Never replaced (charge sites hold no
+    reference of their own); tests toggle ``.enabled`` / call ``reset()``."""
+    global _LEDGER
+    if _LEDGER is None:
+        with _ledger_lock:
+            if _LEDGER is None:
+                _LEDGER = MemoryLedger()
+    return _LEDGER
+
+
+def audit_ledger_leaks() -> Dict[str, int]:
+    """Zero-leak audit hook (the shuffle chunk audit's sibling): held bytes
+    per query that SHOULD have drained at teardown. Empty = healthy."""
+    return get_ledger().audit()

@@ -40,6 +40,7 @@ from __future__ import annotations
 import contextvars
 import queue
 import threading
+import time
 from typing import Callable, Iterator, List, Optional
 
 _SENTINEL = object()
@@ -129,9 +130,133 @@ def chunk_morsels(it, chunk_rows: int):
         yield chunk
 
 
+class _StageAccount:
+    """Byte accounting for one stage's bounded queue (memory observatory).
+
+    A morsel is CHARGED the moment a stage worker completes it (it is now
+    completed-or-queued residency nobody downstream has consumed) and
+    RELEASED when the consumer takes it — so the ledger's ``queue`` kind
+    tracks real backpressure-buffer occupancy. ``drain()`` zeroes whatever
+    is still outstanding on ANY stage exit (abandonment, failure), keeping
+    the drains-to-zero contract.
+
+    Sizing is TEMPLATE-based, not a per-morsel buffer walk: a stage's
+    outputs share one schema, so fixed-width columns are sized as
+    ``rows x dtype-width`` (a pure function of schema + morsel rows —
+    order-independent, so cumulative charged bytes per operator stay
+    thread-count invariant, which the tests pin) and only var-width
+    columns (strings/lists) pay an exact per-column buffer read. An
+    already-memoized exact ``size_bytes`` is used when a batch carries
+    one; fresh all-numeric morsels — the hot case — cost a multiply."""
+
+    __slots__ = ("qid", "op", "outstanding", "closed", "lock", "ledger",
+                 "_fixed_bits", "_var", "_sizes")
+
+    def __init__(self, qid: str, op: str):
+        from daft_tpu.execution.memledger import get_ledger
+
+        self.qid = qid
+        self.op = op
+        self.outstanding = 0
+        self.closed = False
+        self.lock = threading.Lock()
+        self.ledger = get_ledger()
+        self._fixed_bits = None  # per-row BITS of the fixed-width columns
+        self._var = ()           # indices of var-width columns (exact walk)
+        # id(morsel) -> measured bytes, written at produced(), popped at
+        # consumed(): one sizing pass per morsel, not two (var-width
+        # columns walk buffers). Pop-on-consume keeps id reuse safe.
+        self._sizes: dict = {}
+
+    def _sized_batch(self, rb) -> int:
+        # Always the template, never an opportunistic exact memo: memo
+        # presence depends on who ELSE sized the batch (profiler sampling,
+        # sink collection), and mixing exact and template values would
+        # make charged totals depend on that — not on the morsel stream.
+        cols = rb.columns()
+        if self._fixed_bits is None:
+            bits, var = 0, []
+            for i, c in enumerate(cols):
+                if c.dtype.is_python():
+                    bits += 64 * 8  # the engine's flat python-object estimate
+                    continue
+                try:
+                    # Accumulated in BITS so packed types (bool, width 1)
+                    # still count instead of flooring to zero per column.
+                    bits += c.to_arrow().type.bit_width
+                except (ValueError, AttributeError):
+                    var.append(i)  # var-width: offsets make width data-bound
+            self._fixed_bits, self._var = bits, tuple(var)
+        total = (self._fixed_bits * len(rb)) // 8
+        for i in self._var:
+            total += cols[i].to_arrow().nbytes
+        return total
+
+    def measure(self, mp) -> int:
+        if hasattr(mp, "record_batches"):
+            return sum(self._sized_batch(rb) for rb in mp.record_batches())
+        if hasattr(mp, "columns"):
+            return self._sized_batch(mp)
+        return int(mp.size_bytes())  # batch-shaped stand-ins (tests)
+
+    def produced(self, mp) -> None:
+        try:
+            nbytes = self.measure(mp)
+        except (AttributeError, TypeError):
+            return
+        # Charge FIRST, book under the lock after: a worker completing a
+        # morsel just as the consumer abandons the stage either lands in
+        # ``outstanding`` (drained below) or is undone right here — the
+        # ledger can never be left holding a morsel nobody will release.
+        self.ledger.charge(self.qid, self.op, nbytes, kind="queue")
+        with self.lock:
+            if not self.closed:
+                self.outstanding += nbytes
+                self._sizes[id(mp)] = nbytes
+                return
+        self.ledger.release(self.qid, self.op, nbytes, kind="queue")
+
+    def consumed(self, mp) -> None:
+        with self.lock:
+            nbytes = self._sizes.pop(id(mp), None)
+            if nbytes is None:
+                return  # never produced here (or already drained)
+            nbytes = min(nbytes, self.outstanding)
+            self.outstanding -= nbytes
+        if nbytes:
+            self.ledger.release(self.qid, self.op, nbytes, kind="queue")
+
+    def stalled(self, seconds: float) -> None:
+        self.ledger.note_stall(self.qid, self.op, seconds)
+
+    def drain(self) -> None:
+        with self.lock:
+            self.closed = True
+            leftover, self.outstanding = self.outstanding, 0
+            self._sizes.clear()
+        if leftover:
+            self.ledger.release(self.qid, self.op, leftover, kind="queue")
+
+
+def _stage_account(ledger: "Optional[tuple]", name: str
+                   ) -> Optional[_StageAccount]:
+    """Build the stage's byte account from the executor's ``(query_id,
+    op)`` tag, or None when untagged / the ledger plane is disabled (the
+    zero-cost path: no per-morsel work at all)."""
+    if ledger is None:
+        return None
+    from daft_tpu.execution.memledger import get_ledger
+
+    if not get_ledger().enabled:
+        return None
+    qid, op = ledger
+    return _StageAccount(qid, op or name)
+
+
 def run_stage(child_iter: Iterator, fn: Callable, *, pool, workers: int,
               name: str = "stage", ordered: bool = True, timer=None,
-              owns_pool: bool = False) -> Iterator:
+              owns_pool: bool = False,
+              ledger: "Optional[tuple]" = None) -> Iterator:
     """Run ``fn`` over every item of ``child_iter`` on ``pool`` workers,
     yielding results — THE pipeline stage primitive.
 
@@ -157,14 +282,34 @@ def run_stage(child_iter: Iterator, fn: Callable, *, pool, workers: int,
     stop = threading.Event()
     ambient = contextvars.copy_context()
     run_one = fn if timer is None else (lambda item: timer.run_timed(fn, item))
+    # Memory-observatory account for this stage's bounded queue (None =
+    # untagged stage / plane disabled — the zero-cost path).
+    acct = _stage_account(ledger, name)
+    if acct is not None:
+        base_run = run_one
+
+        def run_one(item, _run=base_run):
+            out = _run(item)
+            acct.produced(out)
+            return out
 
     def put_or_stop(item) -> bool:
+        stall_t0 = None
         while not stop.is_set():
             try:
                 inflight.put(item, timeout=0.1)
+                if stall_t0 is not None and acct is not None:
+                    acct.stalled(time.monotonic() - stall_t0)
                 return True
             except queue.Full:
+                # Blocked producer: the bounded queue is full, backpressure
+                # is engaged. Timed from the FIRST Full (the fast path pays
+                # zero clock reads).
+                if stall_t0 is None:
+                    stall_t0 = time.monotonic()
                 continue
+        if stall_t0 is not None and acct is not None:
+            acct.stalled(time.monotonic() - stall_t0)
         return False
 
     if ordered:
@@ -191,9 +336,14 @@ def run_stage(child_iter: Iterator, fn: Callable, *, pool, workers: int,
                     return
                 if isinstance(item, BaseException):
                     raise item  # child-iterator failure: the original
-                yield item.result()  # fn failure: future re-raises it
+                res = item.result()  # fn failure: future re-raises it
+                if acct is not None:
+                    acct.consumed(res)
+                yield res
         finally:
             stop.set()
+            if acct is not None:
+                acct.drain()
             if owns_pool:
                 pool.shutdown(wait=False, cancel_futures=True)
         return
@@ -249,33 +399,50 @@ def run_stage(child_iter: Iterator, fn: Callable, *, pool, workers: int,
                 return
             if isinstance(item, BaseException):
                 raise item
+            if acct is not None:
+                acct.consumed(item)
             yield item
     finally:
         stop.set()
+        if acct is not None:
+            acct.drain()
         if owns_pool:
             pool.shutdown(wait=False, cancel_futures=True)
 
 
 def map_stage(child_iter: Iterator, fn: Callable, *, pool, workers: int,
               name: str = "stage", ordered: bool = True, timer=None,
-              owns_pool: bool = False) -> Iterator:
+              owns_pool: bool = False,
+              ledger: "Optional[tuple]" = None) -> Iterator:
     """``run_stage`` when ``workers > 1``, an inline serial map otherwise
     (same stream shape either way — the stage machinery only changes
     where morsels run, never what they contain)."""
     if workers > 1:
         return run_stage(child_iter, fn, pool=pool, workers=workers,
                          name=name, ordered=ordered, timer=timer,
-                         owns_pool=owns_pool)
+                         owns_pool=owns_pool, ledger=ledger)
     # Serial path keeps the SAME timer hook: a 1-thread profiled run must
     # attribute kernel work to the frame identically (the frame flips to
     # self_timed either way once any sink-side _node_timed call lands).
     run_one = fn if timer is None else (lambda item: timer.run_timed(fn, item))
+    # Serial runs keep the SAME ledger hook too: each morsel is charged at
+    # production and released at hand-off, so cumulative charged bytes per
+    # operator are identical at num_compute_threads=1 and =N (the
+    # determinism property the cross-core attribution tests pin) — only
+    # PEAK residency legitimately varies with concurrency.
+    acct = _stage_account(ledger, name)
 
     def serial():
         try:
             for item in child_iter:
-                yield run_one(item)
+                out = run_one(item)
+                if acct is not None:
+                    acct.produced(out)
+                    acct.consumed(out)
+                yield out
         finally:
+            if acct is not None:
+                acct.drain()
             if owns_pool:
                 pool.shutdown(wait=False, cancel_futures=True)
 

@@ -27,6 +27,7 @@ from __future__ import annotations
 import os
 import shutil
 import tempfile
+import threading
 import uuid
 from contextlib import contextmanager
 from dataclasses import dataclass, field
@@ -80,19 +81,32 @@ spill_metrics = SpillMetrics()
 
 
 @contextmanager
-def budget_reservation(memory, budget: int, token=None):
+def budget_reservation(memory, budget: int, token=None, op: str = ""):
     """Reserve a spilling sink's working set against the global permit gate
     so CONCURRENT executors under one DAFT_MEMORY_LIMIT coordinate (at most
     limit/budget sinks hold reservations at once); a timed-out acquire
     degrades to best-effort rather than self-deadlocking, matching the
     pre-spill permit semantics (reference: resource_manager.rs:44). A
-    cancel ``token`` wakes the wait early when the query dies."""
+    cancel ``token`` wakes the wait early when the query dies. ``op`` tags
+    the reservation in the memory ledger (kind ``permit``), charged and
+    released in the SAME structural pair as the permit itself."""
     ok = memory.acquire(budget, timeout=5.0, token=token)
+    ledger = None
+    qid = getattr(token, "query_id", "") or ""
+    if ok and op:
+        from daft_tpu.execution.memledger import get_ledger
+
+        ledger = get_ledger()
+        granted = budget if memory.limit is None \
+            else min(budget, memory.limit)
+        ledger.charge(qid, op, granted, kind="permit")
     try:
         yield
     finally:
         if ok:
             memory.release(budget)
+            if ledger is not None:
+                ledger.release(qid, op, granted, kind="permit")
 
 
 def sink_budget(memory_limit: Optional[int]) -> Optional[int]:
@@ -116,21 +130,31 @@ class SpillFile:
 
 
 class SpillDir:
-    """A temp directory of Arrow IPC spill files, cleaned up at query end."""
+    """A temp directory of Arrow IPC spill files, cleaned up at query end.
 
-    def __init__(self, root: Optional[str] = None):
+    ``query_id`` tags every written file's bytes in the memory ledger
+    (kind ``spill``): a spill file is disk RESIDENCY the query holds until
+    this directory cleans up, so the ledger charges at :meth:`write` and
+    releases the whole tally at :meth:`cleanup` — the same structural
+    charge/release pairing as permits."""
+
+    def __init__(self, root: Optional[str] = None, query_id: str = ""):
         from daft_tpu.config import daft_env
 
         base = root or daft_env("DAFT_SPILL_DIR") or tempfile.gettempdir()
         self.root = os.path.join(base, f"daft-spill-{uuid.uuid4().hex[:8]}")
         self._created = False
+        self.query_id = query_id
+        self._ledger_lock = threading.Lock()
+        self._ledger_charges: dict = {}  # op -> bytes charged, per dir life
 
     def _ensure(self) -> None:
         if not self._created:
             os.makedirs(self.root, exist_ok=True)
             self._created = True
 
-    def write(self, mp: MicroPartition, chunk_rows: int = 1 << 16) -> SpillFile:
+    def write(self, mp: MicroPartition, chunk_rows: int = 1 << 16,
+              op: str = "") -> SpillFile:
         """Spill one partition to a new IPC file, chunked so reads stream."""
         from daft_tpu.distributed.partition_ref import partition_to_wire_table
 
@@ -145,6 +169,14 @@ class SpillDir:
                         writer.write_table(chunk)
         sf = SpillFile(path, table.num_rows, table.nbytes, mp.schema)
         spill_metrics.record(table.nbytes, 1)
+        from daft_tpu.execution.memledger import get_ledger
+
+        ledger = get_ledger()
+        if ledger.enabled and table.nbytes:
+            with self._ledger_lock:
+                self._ledger_charges[op] = \
+                    self._ledger_charges.get(op, 0) + table.nbytes
+            ledger.charge(self.query_id, op, table.nbytes, kind="spill")
         return sf
 
     def stream(self, sf: SpillFile) -> Iterator[RecordBatch]:
@@ -174,6 +206,16 @@ class SpillDir:
         if self._created:
             shutil.rmtree(self.root, ignore_errors=True)
             self._created = False
+        # Spill residency ends with the files: release the whole tally
+        # (idempotent — the dict empties on the first pass).
+        with self._ledger_lock:
+            charges, self._ledger_charges = self._ledger_charges, {}
+        if charges:
+            from daft_tpu.execution.memledger import get_ledger
+
+            ledger = get_ledger()
+            for op, nbytes in charges.items():
+                ledger.release(self.query_id, op, nbytes, kind="spill")
 
 
 # --------------------------------------------------------------------------- #
@@ -192,7 +234,8 @@ class ExternalSort:
     """
 
     def __init__(self, sort_by, descending, nulls_first, schema: Schema,
-                 budget: int, spill: SpillDir, morsel_rows: int = 1 << 16):
+                 budget: int, spill: SpillDir, morsel_rows: int = 1 << 16,
+                 op: str = "Sort"):
         self.sort_by = sort_by
         self.descending = descending
         self.nulls_first = nulls_first
@@ -200,6 +243,7 @@ class ExternalSort:
         self.budget = budget
         self.spill = spill
         self.morsel_rows = morsel_rows
+        self.op = op  # memory-ledger attribution for this sink's runs
         self._buf: List[MicroPartition] = []
         self._buf_bytes = 0
         self._runs: List[SpillFile] = []
@@ -217,7 +261,8 @@ class ExternalSort:
         if not self._buf:
             return
         run = self._sort_mp(MicroPartition.concat(self._buf))
-        self._runs.append(self.spill.write(run, chunk_rows=self.morsel_rows))
+        self._runs.append(self.spill.write(run, chunk_rows=self.morsel_rows,
+                                           op=self.op))
         self._buf = []
         self._buf_bytes = 0
 
@@ -351,10 +396,11 @@ class GracePartitioner:
 
     def __init__(self, key_fn: Callable[[RecordBatch], List],
                  num_buckets: int, spill: SpillDir,
-                 total_buffer_bytes: Optional[int] = None):
+                 total_buffer_bytes: Optional[int] = None, op: str = ""):
         self.key_fn = key_fn  # rb -> key Series list
         self.num_buckets = num_buckets
         self.spill = spill
+        self.op = op  # memory-ledger attribution for this sink's buckets
         # The COLLECTIVE pending cap keeps the partitioner itself inside the
         # sink budget (32 buckets x 4 MiB per-bucket caps alone would allow
         # 128 MiB resident); when it trips, the fullest bucket flushes.
@@ -388,7 +434,7 @@ class GracePartitioner:
             return
         rb = RecordBatch.concat(self._pend[b])
         mp = MicroPartition(rb.schema, [rb])
-        self.buckets[b].append(self.spill.write(mp))
+        self.buckets[b].append(self.spill.write(mp, op=self.op))
         self._pend_total -= self._pend_bytes[b]
         self._pend[b] = []
         self._pend_bytes[b] = 0

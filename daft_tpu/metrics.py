@@ -736,6 +736,36 @@ PERMIT_WAIT = _r.histogram(
 MEMORY_POISON = _r.counter(
     "daft_memory_poison_total", "Memory-manager poison events (query aborts)")
 
+# Memory observatory (execution/memledger.py): the per-query byte ledger,
+# its reservation reconciliation, and the RSS correlation sampler.
+MEM_RESERVATION_OVER = _r.counter(
+    "daft_memory_reservation_over_bytes",
+    "Bytes by which queries' peak held memory EXCEEDED their admission "
+    "reservation (summed per finished query)")
+MEM_RESERVATION_UNDER = _r.counter(
+    "daft_memory_reservation_under_bytes",
+    "Bytes by which queries' admission reservation exceeded their actual "
+    "peak held memory (reservation headroom, summed per finished query)")
+MEM_LEDGER_HELD = _r.gauge(
+    "daft_memory_ledger_held_bytes",
+    "Bytes the memory ledger currently attributes to in-flight queries "
+    "(all kinds; 0 on an idle engine — the zero-leak audit surface)")
+MEM_LEDGER_RESIDUAL = _r.counter(
+    "daft_memory_ledger_residual_bytes_total",
+    "Bytes force-drained at query finish because a charge site failed to "
+    "release them (should stay 0; the reconciliation audit asserts it)")
+MEM_RSS = _r.gauge(
+    "daft_memory_rss_bytes",
+    "Process resident-set size sampled by the memory observatory")
+MEM_UNACCOUNTED = _r.gauge(
+    "daft_memory_unaccounted_bytes",
+    "Sampled RSS minus ledger-held bytes: interpreter + caches + "
+    "systematic ledger under-accounting (watch the trend, not the level)")
+PIPELINE_STALL = _r.counter(
+    "daft_pipeline_stall_seconds_total",
+    "Seconds stage feeders spent blocked on a full bounded queue "
+    "(backpressure engaged), per operator", ("operator",))
+
 # Shuffle plane (distributed/shuffle.py): chunked compressed transfers
 SHUFFLE_BYTES_WRITTEN = _r.counter(
     "daft_shuffle_bytes_written_total",
@@ -934,6 +964,11 @@ RESULT_CACHE_EVICTIONS = _r.counter(
 RESULT_CACHE_INVALIDATIONS = _r.counter(
     "daft_result_cache_invalidations_total",
     "Entries dropped by write-invalidation (io/writers, io/sink, catalog)")
+RESULT_CACHE_TENANT_BYTES = _r.gauge(
+    "daft_result_cache_tenant_bytes",
+    "Result/scan-cache bytes resident per tenant (the admission quota "
+    "charge, mirrored into the memory observatory)", ("tenant",),
+    max_series=_MAX_TENANT_SERIES)
 
 # AI providers (ai/metrics.py shims onto these)
 AI_TOKENS = _r.counter(

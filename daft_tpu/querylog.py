@@ -55,10 +55,12 @@ from daft_tpu.utils.jsonl_sink import RotatingJsonlSink
 
 log = logging.getLogger("daft_tpu.querylog")
 
-#: Schema v2 adds ``plan_cache_hit`` / ``result_cache_hit`` (PR 13's
-#: query-as-a-service caching). The reader accepts v1 and v2 — a log
-#: written across the upgrade still loads whole.
-QUERYLOG_SCHEMA_VERSION = 2
+#: Schema v2 added ``plan_cache_hit`` / ``result_cache_hit`` (PR 13's
+#: query-as-a-service caching); v3 adds the memory observatory's ``mem``
+#: block (reserved vs peak-held vs spilled bytes, reconciliation, stall
+#: time — execution/memledger.py). The reader accepts v1 through v3 — a
+#: log written across either upgrade still loads whole.
+QUERYLOG_SCHEMA_VERSION = 3
 
 #: Outcome taxonomy — every query lands in exactly one bucket.
 OUTCOME_SUCCESS = "success"
@@ -72,14 +74,16 @@ OUTCOMES = (OUTCOME_SUCCESS, OUTCOME_TIMEOUT, OUTCOME_CANCELLED,
 #: The reader/writer contract (tests pin these sets; extending the record
 #: means bumping QUERYLOG_SCHEMA_VERSION or adding OPTIONAL keys, never
 #: repurposing these). v1 is the pre-cache set; v2 additionally requires
-#: the cache-hit facts.
+#: the cache-hit facts; v3 additionally requires the ``mem`` block ({} when
+#: the memory ledger is disabled).
 RECORD_REQUIRED_V1 = ("schema_version", "query_id", "tenant", "runner", "ts",
                       "outcome", "duration_s", "plan_fingerprint",
                       "admission_wait_s", "shed_level", "rows_out",
                       "bytes_out")
 RECORD_REQUIRED_V2 = RECORD_REQUIRED_V1 + ("plan_cache_hit",
                                            "result_cache_hit")
-RECORD_REQUIRED = RECORD_REQUIRED_V2
+RECORD_REQUIRED_V3 = RECORD_REQUIRED_V2 + ("mem",)
+RECORD_REQUIRED = RECORD_REQUIRED_V3
 
 #: Ring capacity default; DAFT_QUERY_LOG_RING overrides at first use.
 DEFAULT_RING_SIZE = 512
@@ -160,7 +164,7 @@ class FlightEntry:
                  "plan_fingerprint", "admission_wait_s", "shed_level",
                  "shed_reason", "rows_out", "bytes_out", "profiled",
                  "autoprofiled", "plan_cache_hit", "result_cache_hit",
-                 "_m0", "_recorder", "_done")
+                 "mem", "_m0", "_recorder", "_done")
 
     def __init__(self, query_id: str, tenant: str, runner: str, cfg,
                  recorder: "FlightRecorder"):
@@ -180,6 +184,7 @@ class FlightEntry:
         self.autoprofiled = False
         self.plan_cache_hit = False
         self.result_cache_hit = False
+        self.mem: Dict[str, Any] = {}
         self._m0 = _counter_values()
         self._recorder = recorder
         self._done = False
@@ -200,6 +205,14 @@ class FlightEntry:
             self.plan_cache_hit = bool(plan_hit)
         if result_hit is not None:
             self.result_cache_hit = bool(result_hit)
+
+    def note_memory(self, mem: "dict | None") -> None:
+        """The memory observatory's reconciled profile for this query
+        (execution/memledger.py finish_query): reserved vs peak-held vs
+        spilled bytes, per-operator peaks, stall time — the schema-v3
+        ``mem`` block. {} when the ledger plane is disabled."""
+        if mem:
+            self.mem = mem
 
     def count(self, mp) -> None:
         """Per-yielded-partition output accounting (size_bytes is memoized
@@ -327,6 +340,7 @@ class FlightRecorder:
             "peak_rss_bytes": _peak_rss(),
             "plan_cache_hit": entry.plan_cache_hit,
             "result_cache_hit": entry.result_cache_hit,
+            "mem": entry.mem,
             "profiled": entry.profiled or profile is not None,
             "autoprofiled": entry.autoprofiled,
             "operators": _operator_digest(profile),
@@ -453,22 +467,23 @@ def _peak_rss() -> int:
 def validate_record(rec: Any) -> List[str]:
     """Schema check for one query-log line; returns problems (empty =
     valid). Shared by the writer's tests and any reader that must not
-    trust a torn tail line. Accepts BOTH schema versions: v1 records
-    (pre-cache) and v2 (with the cache-hit fields) — a log written across
-    the upgrade loads whole."""
+    trust a torn tail line. Accepts EVERY schema version from v1
+    (pre-cache) through v2 (cache-hit fields) to v3 (the memory ``mem``
+    block) — a log written across the upgrades loads whole."""
     errs: List[str] = []
     if not isinstance(rec, dict):
         return [f"record is {type(rec).__name__}, not an object"]
     version = rec.get("schema_version")
-    required = RECORD_REQUIRED_V1 if version == 1 else RECORD_REQUIRED_V2
+    required = {1: RECORD_REQUIRED_V1,
+                2: RECORD_REQUIRED_V2}.get(version, RECORD_REQUIRED_V3)
     for key in required:
         if key not in rec:
             errs.append(f"missing key {key!r}")
     if errs:
         return errs
-    if version not in (1, QUERYLOG_SCHEMA_VERSION):
+    if version not in (1, 2, QUERYLOG_SCHEMA_VERSION):
         errs.append(f"schema_version {version!r} not in "
-                    f"(1, {QUERYLOG_SCHEMA_VERSION})")
+                    f"(1, 2, {QUERYLOG_SCHEMA_VERSION})")
     if rec["outcome"] not in OUTCOMES:
         errs.append(f"unknown outcome {rec['outcome']!r}")
     if not isinstance(rec.get("duration_s"), (int, float)) \

@@ -123,7 +123,28 @@ class DistributedRunner(Runner):
         # runner.py).
         token, ticket, cfg, fentry = enter_front_door(query_id, cfg, timeout,
                                                       runner=self.name)
+        from daft_tpu.execution import memledger
         from daft_tpu.runners.runner import plan_with_caches
+
+        # Memory observatory: LocalWorkers charge this process ledger
+        # directly (same query id); process/daemon workers ship their
+        # per-task ledger profiles on the reply wire, merged in the worker
+        # glue — the finish_query below reconciles the combined picture.
+        ledger = memledger.get_ledger()
+        if not getattr(cfg, "memory_ledger_enabled", True) and ledger.enabled:
+            # Like the metrics plane, config can only DISABLE, process-
+            # wide — and disabling drops all in-flight attribution so no
+            # balance strands behind the kill switch.
+            ledger.enabled = False
+            ledger.reset()
+        ledger.ensure_sampler(cfg)
+
+        def _finish_mem():
+            mem = ledger.finish_query(query_id,
+                                      reserved_bytes=ticket.mem_reserved,
+                                      tenant=ticket.tenant)
+            if fentry is not None:
+                fentry.note_memory(mem)
 
         build = None
         try:
@@ -148,6 +169,7 @@ class DistributedRunner(Runner):
             if build is not None:
                 build.abort()
             ticket.release()
+            _finish_mem()
             profiling.end_query(query_id, error=str(e))
             querylog.finish_entry(fentry, error=e)
             raise
@@ -173,6 +195,7 @@ class DistributedRunner(Runner):
                 raise
             finally:
                 ticket.release()
+                _finish_mem()
                 unregister_query_token(query_id)
                 ctx.notify(QueryEnd(query_id=query_id,
                                     duration_s=time.perf_counter() - start,
@@ -255,6 +278,11 @@ class DistributedRunner(Runner):
                     "shuffle release for query %s failed", query_id,
                     exc_info=True)
             ticket.release()
+            # Reservation-vs-actual reconciliation (memory observatory):
+            # worker-shipped ledger profiles have merged by now — the mem
+            # block lands on the flight record, residue force-drains, and
+            # the over/under counters move.
+            _finish_mem()
             unregister_query_token(query_id)
             unregister_query_stats(query_id)
             ctx.notify(QueryEnd(query_id=query_id,

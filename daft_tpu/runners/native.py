@@ -43,7 +43,28 @@ class NativeRunner(Runner):
         # see runner.py).
         token, ticket, cfg, fentry = enter_front_door(query_id, cfg, timeout,
                                                       runner=self.name)
+        from daft_tpu.execution import memledger
         from daft_tpu.runners.runner import plan_with_caches
+
+        # Memory observatory: one byte ledger per process (config can only
+        # disable it, like the metrics plane — and disabling drops all
+        # in-flight attribution so no balance strands); the RSS sampler
+        # arms lazily and sleeps whenever no query is in flight.
+        ledger = memledger.get_ledger()
+        if not getattr(cfg, "memory_ledger_enabled", True) and ledger.enabled:
+            ledger.enabled = False
+            ledger.reset()
+        ledger.ensure_sampler(cfg)
+
+        def _finish_mem():
+            # Reservation-vs-actual reconciliation: the ledger closes the
+            # query — force-draining any residue — and the mem block lands
+            # on the flight record + the over/under counters.
+            mem = ledger.finish_query(query_id,
+                                      reserved_bytes=ticket.mem_reserved,
+                                      tenant=ticket.tenant)
+            if fentry is not None:
+                fentry.note_memory(mem)
 
         build = None
         try:
@@ -69,6 +90,10 @@ class NativeRunner(Runner):
             if build is not None:
                 build.abort()
             ticket.release()
+            # Planning never executed anything, but the query's ledger
+            # entry (a cache probe may have charged it) must still close
+            # to zero, and the record's mem block rides along.
+            _finish_mem()
             profiling.end_query(query_id, error=str(e))
             querylog.finish_entry(fentry, error=e)
             raise
@@ -76,6 +101,8 @@ class NativeRunner(Runner):
         start = time.perf_counter()
         error = None
         error_obj = None
+        stream = None
+        exec_stream = None
         register_query_token(query_id, token)
         try:
             if cached_parts is not None:
@@ -108,9 +135,10 @@ class NativeRunner(Runner):
                 with profiling.profiled_task_scope(tprof,
                                                    name="daft.execute",
                                                    ambient=False):
+                    exec_stream = executor.run(physical)
                     stream = profiling.iter_with_profiler_scope(
                         iter_with_cancel_scope(
-                            iter_with_frozen_clock(executor.run(physical)),
+                            iter_with_frozen_clock(exec_stream),
                             token),
                         tprof)
                     for mp in stream:
@@ -137,7 +165,22 @@ class NativeRunner(Runner):
             # and an uncommitted cache build aborts with them.
             if build is not None:
                 build.abort()
+            # Close the execution chain DETERMINISTICALLY before the
+            # memory reconciliation below: an abandoned generator (limit
+            # pushdown, early close) would otherwise drain its permits
+            # whenever GC got to it, and the ledger must read zero at the
+            # moment finish_query audits it. The executor generator is
+            # closed DIRECTLY (wrapper generators use manual loops, so
+            # closing only the outermost would not propagate).
+            for gen in (stream, exec_stream):
+                if gen is not None:
+                    try:
+                        gen.close()
+                    # daftlint: disable=DTL002 -- teardown close in the query's finally; an error here must not mask the query's own outcome
+                    except Exception:  # noqa: BLE001 — teardown best-effort
+                        pass
             ticket.release()
+            _finish_mem()
             unregister_query_token(query_id)
             ctx.notify(QueryEnd(query_id=query_id,
                                 duration_s=time.perf_counter() - start, error=error))

@@ -162,6 +162,61 @@ async function renderCache() {
     '<li class="hint">no tables registered</li>';
 }
 
+let memSelected = null;
+
+async function renderMemory() {
+  const d = await getJSON("/api/memory");
+  $("#mem-summary").textContent =
+    `${fmtBytes(d.held_bytes)} ledger-held · RSS ${fmtBytes(d.sampler.rss_bytes)}` +
+    ` · unaccounted ${fmtBytes(d.sampler.unaccounted_bytes)}` +
+    (d.enabled ? "" : " · [DISABLED]");
+  $("#mem-active tbody").innerHTML = d.active.map((q) =>
+    `<tr><td>${esc(q.query_id)}</td><td>${fmtBytes(q.held_bytes)}</td>
+      <td>${fmtBytes(q.peak_held_bytes)}</td><td>${fmtBytes(q.charged_bytes)}</td>
+      <td>${q.stall_s.toFixed(3)}</td><td>${q.age_s.toFixed(1)}</td></tr>`
+  ).join("") || '<tr><td colspan="6" class="hint">no queries in flight</td></tr>';
+  $("#mem-recent tbody").innerHTML = d.recent.map((r) => {
+    const delta = r.reserved_bytes
+      ? (r.over_bytes ? `+${fmtBytes(r.over_bytes)} over`
+         : `-${fmtBytes(r.under_bytes)} under`) : "";
+    return `<tr data-qid="${esc(r.query_id)}"><td>${esc(r.query_id)}</td>
+      <td>${esc(r.tenant)}</td><td>${fmtBytes(r.reserved_bytes)}</td>
+      <td>${fmtBytes(r.peak_held_bytes)}</td>
+      <td class="${r.over_bytes ? "err" : "ok"}">${delta}</td>
+      <td>${fmtBytes(r.spilled_bytes)}</td><td>${r.stall_s.toFixed(3)}</td>
+      <td class="${r.residual_bytes ? "err" : "ok"}">${r.residual_bytes}</td></tr>`;
+  }).join("") || '<tr><td colspan="8" class="hint">no finished queries yet</td></tr>';
+  document.querySelectorAll("#mem-recent tbody tr").forEach((tr) =>
+    tr.addEventListener("click", () => { memSelected = tr.dataset.qid; renderWaterfall(d); }));
+  renderWaterfall(d);
+  $("#mem-tenants tbody").innerHTML = d.tenants.map((t) =>
+    `<tr><td>${esc(t.tenant)}</td><td>${t.running}</td>
+      <td>${fmtBytes(t.mem_reserved)}</td><td>${fmtBytes(t.cache_bytes)}</td></tr>`
+  ).join("") || '<tr><td colspan="4" class="hint">no tenants yet</td></tr>';
+}
+
+function renderWaterfall(d) {
+  // Per-query "memory waterfall": one horizontal bar per operator, width
+  // proportional to its peak held bytes, reservation drawn as a marker.
+  const r = d.recent.find((x) => x.query_id === memSelected) || d.recent[0];
+  if (!r) { $("#mem-waterfall").innerHTML = ""; return; }
+  const ops = Object.entries(r.by_operator || {});
+  const max = Math.max(r.reserved_bytes || 0, r.peak_held_bytes || 0,
+    ...ops.map(([, o]) => o.peak), 1);
+  const bar = (label, bytes, cls) =>
+    `<div class="lane"><span class="lane-label" title="${esc(label)}">${esc(label)}</span>
+      <span class="track"><span class="gantt ${cls || ""}"
+        style="left:0;width:${Math.max(100 * bytes / max, 0.5).toFixed(2)}%"
+        title="${esc(label)} ${fmtBytes(bytes)}"></span></span></div>`;
+  $("#mem-waterfall").innerHTML =
+    `<p class="hint">${esc(r.query_id)} — peak ${fmtBytes(r.peak_held_bytes)}` +
+    (r.reserved_bytes ? ` vs ${fmtBytes(r.reserved_bytes)} reserved` : "") + `</p>` +
+    bar("TOTAL PEAK", r.peak_held_bytes) +
+    (r.reserved_bytes ? bar("RESERVATION", r.reserved_bytes, "err-bar") : "") +
+    ops.sort((a, b) => b[1].peak - a[1].peak)
+      .map(([op, o]) => bar(op, o.peak)).join("");
+}
+
 async function renderAdmission() {
   const a = await getJSON("/api/admission");
   const lvl = a.totals.shed_level;
@@ -291,6 +346,7 @@ async function tick() {
     else if (view === "slo") await renderSLO();
     else if (view === "admission") await renderAdmission();
     else if (view === "cache") await renderCache();
+    else if (view === "memory") await renderMemory();
     else if (view === "workers") await renderWorkers();
     else if (view === "perf") await renderPerf();
     else await renderDataframes();

@@ -596,6 +596,36 @@ class _Handler(BaseHTTPRequestHandler):
             payload["tables"] = get_table_registry().names()
             body = json.dumps(payload).encode()
             ctype = "application/json"
+        elif path == "/api/memory":
+            # Memory observatory (execution/memledger.py): live per-query
+            # byte attribution, the finished-query "memory waterfall" ring
+            # (reserved vs peak-held vs spilled per operator), per-tenant
+            # reservation + cache residency, and the RSS sampler's
+            # process-truth correlation.
+            from daft_tpu import metrics
+            from daft_tpu.execution.admission import get_controller
+            from daft_tpu.execution.memledger import get_ledger
+
+            ledger = get_ledger()
+            held = ledger.total_held()
+            rss = metrics.MEM_RSS._default_child().value()
+            body = json.dumps({
+                "enabled": ledger.enabled,
+                "held_bytes": held,
+                "active": ledger.live_snapshot(),
+                "recent": ledger.recent_profiles(50),
+                "tenants": [
+                    {"tenant": t, "running": d["running"],
+                     "mem_reserved": d["mem_reserved"],
+                     "cache_bytes": d["cache_bytes"]}
+                    for t, d in get_controller().snapshot().items()],
+                "sampler": {
+                    "rss_bytes": int(rss),
+                    "ledger_bytes": held,
+                    "unaccounted_bytes": int(max(rss - held, 0)),
+                },
+            }).encode()
+            ctype = "application/json"
         elif path == "/api/health":
             body = b'{"status":"ok"}'
             ctype = "application/json"

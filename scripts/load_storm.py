@@ -663,6 +663,7 @@ def permit_leak_audit() -> str | None:
     gate, not the front door."""
     from daft_tpu.errors import DaftCancelledError, DaftTimeoutError
     from daft_tpu.execution.resource_manager import memory_limit
+    from daft_tpu.execution.spill import sink_budget
 
     with memory_limit(64 << 20) as mm:
         baseline = mm.available_permits()
@@ -670,6 +671,12 @@ def permit_leak_audit() -> str | None:
         set_tenant("batch")
         for build in mixes["batch"][:3]:
             build().collect()
+        # A quota'd tenant under a REAL limit carries an admission memory
+        # reservation — run one so the storm also exercises (and bounds)
+        # the ledger's reservation-vs-actual reconciliation (ISSUE 15).
+        set_tenant("hostile")
+        q_filter(make_lineitem(HOSTILE_ROWS, seed=98)).collect()
+        set_tenant("batch")
         # A cancelled query's unwind must hand every permit back.
         try:
             q_agg(make_lineitem(HOSTILE_ROWS, seed=99)).collect(
@@ -677,6 +684,24 @@ def permit_leak_audit() -> str | None:
         except (DaftTimeoutError, DaftCancelledError):
             pass
         set_tenant(None)
+        # Reservation-overshoot bound: the reserved run's mem block must
+        # carry the sink-budget reservation, and its over-shoot can never
+        # exceed limit - reservation (permits cap the real peak at limit).
+        from daft_tpu.execution.memledger import get_ledger
+
+        share = sink_budget(mm.limit)
+        reserved_profiles = [p for p in get_ledger().recent_profiles(100)
+                             if p.get("reserved_bytes")]
+        if not reserved_profiles:
+            return ("no reservation-carrying mem profile recorded for the "
+                    "quota'd tenant (reconciliation untested)")
+        p = reserved_profiles[0]
+        if p["reserved_bytes"] != share:
+            return (f"reserved_bytes {p['reserved_bytes']} != sink-budget "
+                    f"share {share}")
+        if p["over_bytes"] > mm.limit - share:
+            return (f"reservation overshoot {p['over_bytes']} exceeds "
+                    f"limit-minus-reservation {mm.limit - share}")
         deadline = time.monotonic() + 10
         while time.monotonic() < deadline:
             if mm.available_permits() == baseline:
@@ -841,6 +866,32 @@ def main() -> int:
     shuffle_leaks = audit_shuffle_leaks()
     if shuffle_leaks["files"]:
         failures.append(f"leaked shuffle chunk files: {shuffle_leaks}")
+    # 5b. Memory observatory (ISSUE 15): the per-query byte ledger drained
+    # to ZERO across every outcome the storm produced (success, shed,
+    # cancel, chaos kills), no record carried force-drained residue, and
+    # no query's peak overshot the process memory limit (permits make a
+    # bigger peak impossible — an overshoot means mis-accounting).
+    from daft_tpu.execution.memledger import audit_ledger_leaks, get_ledger
+    from daft_tpu.execution.resource_manager import get_memory_manager
+
+    mem_leaks = audit_ledger_leaks()
+    if mem_leaks:
+        failures.append(f"memory ledger did not drain to zero: {mem_leaks}")
+    residual = [p for p in get_ledger().recent_profiles(10_000)
+                if p.get("residual_bytes")]
+    if residual:
+        failures.append(
+            f"{len(residual)} queries force-drained ledger residue "
+            f"(first: {residual[0]['query_id']} "
+            f"{residual[0]['residual_bytes']}b)")
+    mem_limit = get_memory_manager().limit
+    overshoot = [p for p in get_ledger().recent_profiles(10_000)
+                 if mem_limit and p.get("reserved_bytes")
+                 and p["peak_held_bytes"] > mem_limit]
+    if overshoot:
+        failures.append(
+            f"{len(overshoot)} queries' ledger peaks overshot the "
+            f"process memory limit {mem_limit} (mis-accounting)")
     # 6. SLO plane (ISSUE 12): the hostile tenant's burn-rate alert fired
     # during the storm; well-behaved tenants stayed green. Scraped from
     # /api/slo exactly the way an operator's alerting would.

@@ -440,6 +440,94 @@ def cmd_overhead(args) -> int:
     return 0
 
 
+def cmd_memory_overhead(args) -> int:
+    """Memory-observatory overhead guard (ISSUE 15): the per-query byte
+    ledger + RSS sampler run on EVERY query, so the whole plane must stay
+    under the established <2% budget vs ``DAFT_MEMLEDGER=0``. Same ABBA
+    pair-block estimator as the recording-overhead guard (position-
+    balanced within each block, median of per-block deltas, one 3x
+    escalation before a failing verdict is believed). The result appends
+    to the committed trajectory as a ``memory_observatory`` entry so the
+    cost is tracked commit-over-commit like every other plane tax."""
+    import statistics
+
+    from daft_tpu.context import execution_config_ctx
+    from daft_tpu.execution.memledger import get_ledger
+
+    queries, _ = build_suite(args.suite, args)
+    ledger = get_ledger()
+
+    def suite_once() -> float:
+        t0 = time.perf_counter()
+        for _, build in queries:
+            build().collect()
+        return time.perf_counter() - t0
+
+    def on_once() -> float:
+        ledger.enabled = True
+        return suite_once()
+
+    def off_once() -> float:
+        ledger.enabled = False
+        return suite_once()
+
+    deltas, offs = [], []
+
+    def run_blocks(n: int) -> None:
+        for b in range(n):
+            a, c = (off_once, on_once) if b % 2 == 0 else (on_once, off_once)
+            t1, t2, t3, t4 = a(), c(), c(), a()
+            off_s, on_s = ((t1 + t4, t2 + t3) if b % 2 == 0
+                           else (t2 + t3, t1 + t4))
+            offs.append(off_s / 2)
+            deltas.append((on_s - off_s) / 2)
+
+    def verdict() -> float:
+        off = statistics.median(offs)
+        return statistics.median(deltas) / off * 100.0 if off > 0 else 0.0
+
+    # Repeated identical queries MUST re-execute (a cached sub-ms lookup
+    # would measure the plane tax against nothing) and the sampler must be
+    # live on the enabled leg — the production configuration being priced.
+    with execution_config_ctx(result_cache_enabled=False):
+        for _, build in queries:  # warm plans/jit outside the clock
+            build().collect()
+        ledger.ensure_sampler(None)
+        try:
+            run_blocks(args.blocks)
+            pct = verdict()
+            escalated = False
+            if pct >= OVERHEAD_LIMIT_PCT:
+                escalated = True
+                run_blocks(args.blocks * 2)
+                pct = verdict()
+        finally:
+            ledger.enabled = True
+    off = statistics.median(offs)
+    rec = {"metric": "memledger_overhead_pct", "value": round(pct, 3),
+           "unit": "% vs DAFT_MEMLEDGER=0", "blocks": len(offs),
+           "escalated": escalated, "off_s": round(off, 4),
+           "limit_pct": OVERHEAD_LIMIT_PCT, "ok": pct < OVERHEAD_LIMIT_PCT}
+    print(json.dumps(rec))
+    entry = perf_report.build_entry(
+        "memory_observatory",
+        [{"name": "tpch_suite_ledger_on",
+          "wall_s": round(off * (1 + pct / 100.0), 6), "rows_out": 0,
+          "operators": [], "metrics": {"memledger_overhead_pct": rec["value"],
+                                       "suite_off_s": rec["off_s"]}}],
+        config={"blocks": len(offs), "scale_rows": args.scale_rows,
+                "limit_pct": OVERHEAD_LIMIT_PCT})
+    if not args.no_append:
+        path = perf_report.append_entry(entry, args.out)
+        print(f"appended memory_observatory entry sha={entry['sha'] or '?'} "
+              f"to {path}", file=sys.stderr)
+    if not rec["ok"]:
+        print(f"memory-observatory overhead {pct:.2f}% exceeds "
+              f"{OVERHEAD_LIMIT_PCT}% budget", file=sys.stderr)
+        return 1
+    return 0
+
+
 def cmd_ab_fusion(args) -> int:
     """Fused-vs-interpreted ABBA A/B guard (the compiled-eval
     self-disabling contract): the compiled chain path must beat the
@@ -835,6 +923,10 @@ def main(argv=None) -> int:
     p.add_argument("--ab-fusion", action="store_true",
                    help="fused-vs-interpreted ABBA guard on q01/q06-shaped "
                         "scans (self-disabling contract)")
+    p.add_argument("--memory-overhead", action="store_true",
+                   help="memory-observatory ABBA guard: byte ledger + RSS "
+                        "sampler < 2%% vs DAFT_MEMLEDGER=0; appends a "
+                        "memory_observatory trajectory entry")
     p.add_argument("--cache-bench", action="store_true",
                    help="query-cache acceptance: cold vs cached-repeat vs "
                         "plan-cache-only timings; appends a query_cache "
@@ -868,6 +960,8 @@ def main(argv=None) -> int:
         return cmd_overhead(args)
     if args.ab_fusion:
         return cmd_ab_fusion(args)
+    if args.memory_overhead:
+        return cmd_memory_overhead(args)
     if args.cache_bench:
         return cmd_cache_bench(args)
     if args.shuffle_bench:

@@ -1,0 +1,492 @@
+"""Memory observatory tests (execution/memledger.py).
+
+The per-query, per-operator byte ledger: charge/release bookkeeping,
+drains-to-zero at teardown across every outcome, reservation-vs-actual
+reconciliation into flight-record v3 ``mem`` blocks, deterministic
+per-operator attribution across thread counts, no cross-attribution
+between concurrent queries, the poison/cancel-mid-acquire regression
+(ledger zero), pipeline stall accounting, and the dashboard surfaces
+(`/api/memory`, Prometheus `/metrics` HELP lines)."""
+
+import json
+import threading
+import time
+import urllib.request
+
+import pytest
+
+import daft_tpu
+from daft_tpu import col
+from daft_tpu.execution.memledger import (
+    MemoryLedger,
+    audit_ledger_leaks,
+    get_ledger,
+)
+from daft_tpu.execution.resource_manager import get_memory_manager, memory_limit
+
+
+@pytest.fixture(autouse=True)
+def _fresh_ledger():
+    led = get_ledger()
+    led.enabled = True
+    led.reset()
+    yield
+    led.reset()
+    led.enabled = True
+
+
+def make_df(rows, seed=0):
+    return daft_tpu.from_pydict({
+        "k": [(i * 7 + seed) % 97 for i in range(rows)],
+        "v": [float((i + seed) % 1013) for i in range(rows)],
+    })
+
+
+def wait_until(cond, timeout=10.0):
+    """Bounded wait for an audit condition: aborted queries release their
+    permits as side threads observe the cancel (the load_storm audit
+    discipline — the END state is exact, the instant is not)."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(0.05)
+    return cond()
+
+
+# ------------------------------------------------------------------ #
+# Unit: ledger bookkeeping                                            #
+# ------------------------------------------------------------------ #
+def test_charge_release_peak_and_audit():
+    led = MemoryLedger(enabled=True)
+    led.charge("q1", "Sort", 100, kind="permit")
+    led.charge("q1", "Sort", 50, kind="permit")
+    led.charge("q1", "Project", 30, kind="queue")
+    assert led.total_held() == 180
+    led.release("q1", "Sort", 60, kind="permit")
+    assert led.total_held() == 120
+    # Over-release clamps; unknown keys are no-ops (never negative).
+    led.release("q1", "Sort", 10_000, kind="permit")
+    led.release("q1", "Nope", 10, kind="queue")
+    led.release("zzz", "Sort", 10, kind="permit")
+    assert led.total_held() == 30
+    assert led.audit() == {"q1": 30}
+    block = led.finish_query("q1", reserved_bytes=100)
+    assert block["residual_bytes"] == 30  # the un-released queue charge
+    assert block["peak_held_bytes"] == 180
+    assert block["charged_bytes"] == 180
+    assert block["over_bytes"] == 80 and block["under_bytes"] == 0
+    assert led.total_held() == 0 and led.audit() == {}
+    # Per-operator rows carry peaks and kind breakdowns.
+    ops = block["by_operator"]
+    assert ops["Sort"]["kinds"]["permit"]["peak"] == 150
+    assert ops["Project"]["kinds"]["queue"]["charged"] == 30
+
+
+def test_disabled_ledger_is_a_noop():
+    led = MemoryLedger(enabled=False)
+    led.charge("q", "Sort", 100)
+    led.note_stall("q", "Sort", 1.0)
+    assert led.total_held() == 0
+    assert led.finish_query("q") == {}
+
+
+def test_worker_wire_round_trip():
+    """drain_query_wire (worker) -> merge_worker_profile (driver): charged
+    sums, peaks take the max, and the worker side is left clean."""
+    worker = MemoryLedger(enabled=True)
+    worker.charge("q1", "ShuffleRead", 500, kind="shuffle")
+    worker.release("q1", "ShuffleRead", 500, kind="shuffle")
+    worker.charge("q1", "Aggregate", 200, kind="queue")
+    worker.release("q1", "Aggregate", 200, kind="queue")
+    wire = worker.drain_query_wire("q1")
+    assert wire["residual_bytes"] == 0
+    assert worker.total_held() == 0 and worker.audit() == {}
+    driver = MemoryLedger(enabled=True)
+    driver.charge("q1", "Aggregate", 100, kind="queue")
+    driver.release("q1", "Aggregate", 100, kind="queue")
+    driver.merge_worker_profile("q1", wire)
+    block = driver.finish_query("q1")
+    assert block["charged_bytes"] == 800
+    assert block["by_operator"]["ShuffleRead"]["kinds"]["shuffle"]["peak"] \
+        == 500
+    # Peak is max(driver, worker), not a sum across address spaces.
+    assert block["peak_held_bytes"] == 500
+
+
+# ------------------------------------------------------------------ #
+# End to end: drains to zero, v3 mem block                            #
+# ------------------------------------------------------------------ #
+def test_query_mem_block_and_zero_drain():
+    with daft_tpu.execution_config_ctx(result_cache_enabled=False):
+        with memory_limit(1 << 20):
+            make_df(200_000).sort("v").to_pydict()
+    led = get_ledger()
+    assert led.total_held() == 0
+    assert audit_ledger_leaks() == {}
+    rec = daft_tpu.recent_queries(1)[0]
+    assert rec["schema_version"] == 3
+    mem = rec["mem"]
+    assert mem["residual_bytes"] == 0
+    assert mem["peak_held_bytes"] > 0
+    assert mem["spilled_bytes"] > 0  # 200k rows against a 1 MiB limit
+    sort_row = mem["by_operator"]["Sort"]
+    assert sort_row["kinds"]["spill"]["charged"] == mem["spilled_bytes"]
+    assert sort_row["kinds"]["permit"]["peak"] > 0
+
+
+def test_reservation_reconciliation_metrics_and_block():
+    """With an admission memory quota the ticket carries a reservation;
+    the finished query's mem block reconciles it and the over/under
+    counters move."""
+    from daft_tpu import metrics
+    from daft_tpu.execution.admission import get_controller
+    from daft_tpu.execution.spill import sink_budget
+
+    reg = metrics.get_registry()
+    s0o = reg.snapshot().counter_total("daft_memory_reservation_over_bytes")
+    s0u = reg.snapshot().counter_total("daft_memory_reservation_under_bytes")
+    get_controller().reset()
+    daft_tpu.set_tenant_policy("memobs", max_memory_fraction=0.5)
+    try:
+        daft_tpu.set_tenant("memobs")
+        with daft_tpu.execution_config_ctx(result_cache_enabled=False):
+            with memory_limit(8 << 20) as mm:
+                make_df(50_000).where(col("k") > 3).to_pydict()
+                share = sink_budget(mm.limit)
+    finally:
+        daft_tpu.set_tenant(None)
+        get_controller().reset()
+    rec = daft_tpu.recent_queries(1)[0]
+    assert rec["mem"]["reserved_bytes"] == share
+    assert rec["mem"]["over_bytes"] >= 0 and rec["mem"]["under_bytes"] >= 0
+    assert (rec["mem"]["over_bytes"] > 0) != (rec["mem"]["under_bytes"] > 0) \
+        or rec["mem"]["peak_held_bytes"] == share
+    s1o = reg.snapshot().counter_total("daft_memory_reservation_over_bytes")
+    s1u = reg.snapshot().counter_total("daft_memory_reservation_under_bytes")
+    assert (s1o - s0o) == rec["mem"]["over_bytes"]
+    assert (s1u - s0u) == rec["mem"]["under_bytes"]
+
+
+def test_cancelled_query_drains_to_zero():
+    from daft_tpu.errors import DaftTimeoutError
+
+    @daft_tpu.udf.func.batch(return_dtype=daft_tpu.DataType.float64())
+    def slow(v):
+        time.sleep(0.01)
+        return v
+
+    with daft_tpu.execution_config_ctx(result_cache_enabled=False):
+        with memory_limit(1 << 20) as mm:
+            baseline = mm.available_permits()
+            with pytest.raises(DaftTimeoutError):
+                make_df(500_000).with_column("s", slow(col("v"))) \
+                    .sort("s").collect(timeout=0.2)
+            assert wait_until(
+                lambda: mm.available_permits() == baseline), \
+                (mm.available_permits(), baseline)
+    led = get_ledger()
+    assert wait_until(lambda: led.total_held() == 0), led.audit()
+    rec = daft_tpu.recent_queries(1)[0]
+    assert rec["outcome"] == "timeout"
+
+
+def test_early_close_limit_drains_to_zero():
+    with daft_tpu.execution_config_ctx(result_cache_enabled=False):
+        out = make_df(300_000).where(col("k") > 1).limit(3).to_pydict()
+    assert len(out["k"]) == 3
+    assert get_ledger().total_held() == 0
+    assert daft_tpu.recent_queries(1)[0]["mem"]["residual_bytes"] == 0
+
+
+# ------------------------------------------------------------------ #
+# Satellite: poison / cancel-woken waiters through the ledger path    #
+# ------------------------------------------------------------------ #
+@pytest.mark.chaos
+def test_poison_mid_acquire_leaves_ledger_zero():
+    """Regression (the admission permit-leak test's ledger twin): a waiter
+    poisoned mid-acquire grants nothing, so the ledger must hold ZERO
+    phantom bytes for the aborted query once it unwinds — and permits
+    return to baseline."""
+    from daft_tpu.cancellation import CancelToken
+
+    led = get_ledger()
+    with memory_limit(1 << 16) as mm:
+        baseline = mm.available_permits()
+        assert mm.acquire(1 << 15)
+        token = CancelToken(None, query_id="poisoned")
+        result = {}
+
+        def blocked():
+            try:
+                ok = mm.acquire(3 << 14, token=token)
+                # The structural contract: only a GRANTED acquire charges.
+                if ok:
+                    led.charge("poisoned", "Sort", 3 << 14, kind="permit")
+                result["ok"] = ok
+            except BaseException as e:  # noqa: BLE001 — recorded for asserts
+                result["err"] = e
+
+        th = threading.Thread(target=blocked)
+        th.start()
+        time.sleep(0.1)
+        mm.poison(RuntimeError("query died"), query_id="poisoned")
+        th.join(timeout=10)
+        assert isinstance(result.get("err"), RuntimeError)
+        mm.release(1 << 15)
+        assert mm.available_permits() == baseline
+    assert led.audit().get("poisoned") is None
+    assert led.total_held() == 0
+
+
+@pytest.mark.chaos
+def test_late_add_held_after_unwind_charges_nothing():
+    """The cancel-between-acquire-and-first-morsel window: an _add_held
+    landing after the executor closed self-releases the PERMIT and leaves
+    no ledger charge either (the closed-window contract)."""
+    from daft_tpu.execution.executor import Executor
+    from daft_tpu.physical.translate import translate
+
+    led = get_ledger()
+    with memory_limit(1 << 16) as mm:
+        baseline = mm.available_permits()
+        cfg = daft_tpu.get_context().execution_config
+        ex = Executor(cfg)
+        builder = daft_tpu.from_pydict({"a": [1, 2, 3]})._builder
+        physical = translate(builder.optimize(cfg).plan, cfg)
+        list(ex.run(physical))
+        assert mm.acquire(1 << 10)
+        ex._add_held(1 << 10, op="Sort")
+        assert mm.available_permits() == baseline
+    assert led.total_held() == 0, led.audit()
+
+
+# ------------------------------------------------------------------ #
+# Determinism + attribution                                           #
+# ------------------------------------------------------------------ #
+def _charged_by_op(mem):
+    out = {}
+    for op, row in mem["by_operator"].items():
+        for kind, k in row["kinds"].items():
+            out[(op, kind)] = k["charged"]
+    return out
+
+
+@pytest.mark.parametrize("threads", [1, 4, 8])
+def test_charged_bytes_thread_count_invariant(threads):
+    """Cumulative charged bytes per (operator, kind) are a pure function
+    of the morsel stream — identical at --cores 1, 4, 8 (the PR 8
+    determinism contract extended into the byte domain). Baseline is the
+    serial run; every thread count must match it exactly."""
+    def run(n):
+        with daft_tpu.execution_config_ctx(num_compute_threads=n,
+                                           result_cache_enabled=False,
+                                           default_morsel_size=32 * 1024,
+                                           min_morsel_size=8 * 1024):
+            make_df(200_000).where(col("k") > 7) \
+                .groupby("k").agg(col("v").sum().alias("s")).to_pydict()
+        return _charged_by_op(daft_tpu.recent_queries(1)[0]["mem"])
+
+    serial = run(1)
+    assert serial, "serial run attributed nothing"
+    assert run(threads) == serial
+    assert get_ledger().total_held() == 0
+
+
+def test_concurrent_queries_never_cross_attribute():
+    """Two concurrent queries of very different sizes: each finished
+    profile's charged bytes equal its own serial baseline — bytes never
+    leak across query ids."""
+    def run_one(rows, seed, out, key):
+        daft_tpu.set_tenant(None)
+        with daft_tpu.execution_config_ctx(result_cache_enabled=False):
+            make_df(rows, seed=seed).where(col("k") > 7).select(
+                (col("v") * 2).alias("w")).to_pydict()
+        # recent_queries can interleave: find OUR record by rows_out.
+        for rec in daft_tpu.recent_queries(10):
+            if rec["query_id"] not in out.values() \
+                    and rec["rows_out"] == EXPECT[key]:
+                out[key] = rec["query_id"]
+                out[key + "_mem"] = rec["mem"]
+                return
+
+    # Precompute expected output row counts (the filter keeps k in 8..96).
+    def expect(rows, seed):
+        return sum(1 for i in range(rows) if (i * 7 + seed) % 97 > 7)
+
+    EXPECT = {"big": expect(400_000, 1), "small": expect(20_000, 2)}
+    assert EXPECT["big"] != EXPECT["small"]
+    serial = {}
+    run_one(400_000, 1, serial, "big")
+    run_one(20_000, 2, serial, "small")
+    out = {}
+    t1 = threading.Thread(target=run_one, args=(400_000, 1, out, "big"))
+    t2 = threading.Thread(target=run_one, args=(20_000, 2, out, "small"))
+    t1.start(); t2.start(); t1.join(30); t2.join(30)
+    assert _charged_by_op(out["big_mem"]) == _charged_by_op(serial["big_mem"])
+    assert _charged_by_op(out["small_mem"]) == \
+        _charged_by_op(serial["small_mem"])
+    assert get_ledger().total_held() == 0
+
+
+# ------------------------------------------------------------------ #
+# Pipeline stall + queue accounting                                   #
+# ------------------------------------------------------------------ #
+class _FakeMorsel:
+    def __init__(self, n):
+        self.n = n
+
+    def size_bytes(self):
+        return self.n
+
+
+def test_stage_queue_charges_and_stall():
+    from concurrent.futures import ThreadPoolExecutor
+
+    from daft_tpu import metrics
+    from daft_tpu.execution.pipeline import run_stage
+
+    led = get_ledger()
+    reg = metrics.get_registry()
+    stall0 = reg.snapshot().counter_total("daft_pipeline_stall_seconds_total")
+    pool = ThreadPoolExecutor(max_workers=2)
+    items = [_FakeMorsel(1000) for _ in range(24)]
+    seen = []
+    try:
+        stream = run_stage(iter(items), lambda m: m, pool=pool, workers=2,
+                           name="StallStage", ledger=("qstall", "StallStage"))
+        for i, m in enumerate(stream):
+            if i == 0:
+                # Slow consumer: the feeder fills the bounded queue and
+                # must block (the blocked-producer stall being measured).
+                time.sleep(0.6)
+                assert led.total_held() > 0, \
+                    "completed-but-unconsumed morsels should be charged"
+            seen.append(m)
+    finally:
+        pool.shutdown(wait=False)
+    assert len(seen) == 24
+    assert led.total_held() == 0
+    prof = led.finish_query("qstall")
+    assert prof["by_operator"]["StallStage"]["kinds"]["queue"]["charged"] \
+        == 24_000
+    assert prof["stall_s"] > 0
+    stall1 = reg.snapshot().counter_total("daft_pipeline_stall_seconds_total")
+    assert stall1 > stall0
+
+
+def test_abandoned_stage_drains_queue_charges():
+    from concurrent.futures import ThreadPoolExecutor
+
+    from daft_tpu.execution.pipeline import run_stage
+
+    led = get_ledger()
+    pool = ThreadPoolExecutor(max_workers=2)
+    items = [_FakeMorsel(500) for _ in range(50)]
+    try:
+        stream = run_stage(iter(items), lambda m: m, pool=pool, workers=2,
+                           name="Abandoned", ledger=("qab", "Abandoned"))
+        next(stream)
+        stream.close()  # abandon mid-flight
+    finally:
+        pool.shutdown(wait=True)
+    # Whatever workers completed after the close self-released.
+    time.sleep(0.2)
+    assert led.total_held() == 0, led.audit()
+
+
+# ------------------------------------------------------------------ #
+# Surfaces: /api/memory, /metrics exposition, EXPLAIN ANALYZE         #
+# ------------------------------------------------------------------ #
+def _get_json(url):
+    with urllib.request.urlopen(url, timeout=10) as r:
+        return json.loads(r.read())
+
+
+def test_dashboard_memory_endpoint_and_prometheus_help_lines():
+    from daft_tpu import metrics
+    from daft_tpu.subscribers.dashboard import DashboardServer
+
+    with daft_tpu.execution_config_ctx(result_cache_enabled=False):
+        with memory_limit(1 << 20):
+            make_df(100_000).sort("v").to_pydict()
+    # Gauges are sampler-fed; set deterministically for the scrape pin.
+    metrics.MEM_RSS.set(123.0)
+    metrics.MEM_LEDGER_HELD.set(0.0)
+    metrics.MEM_UNACCOUNTED.set(123.0)
+    server = DashboardServer(port=0).start()
+    try:
+        d = _get_json(server.url + "/api/memory")
+        assert d["enabled"] is True
+        assert d["held_bytes"] == 0
+        assert d["recent"], "finished query should be in the waterfall ring"
+        r = d["recent"][0]
+        assert r["peak_held_bytes"] > 0 and r["residual_bytes"] == 0
+        assert "by_operator" in r and "sampler" in d and "tenants" in d
+        # Satellite pin: the Prometheus text exposition serves the memory
+        # observatory's series with HELP lines for external scrapers.
+        with urllib.request.urlopen(server.url + "/metrics", timeout=10) as h:
+            text = h.read().decode()
+        assert "# HELP daft_memory_rss_bytes" in text
+        assert "# TYPE daft_memory_rss_bytes gauge" in text
+        assert "# HELP daft_memory_ledger_held_bytes" in text
+        import re
+
+        # A concrete sample line (value not pinned: the live sampler may
+        # overwrite the seeded value between set and scrape).
+        assert re.search(r"^daft_memory_rss_bytes \d", text, re.M)
+    finally:
+        server.shutdown()
+
+
+def test_explain_analyze_shows_memory(capsys):
+    with daft_tpu.execution_config_ctx(result_cache_enabled=False):
+        with memory_limit(1 << 20):
+            make_df(150_000).sort("v").explain(analyze=True)
+    out = capsys.readouterr().out
+    assert "memory: peak_held=" in out
+    assert "peak_mem" in out  # the operator-table column header
+
+
+# ------------------------------------------------------------------ #
+# Distributed runner + shuffle reader attribution                     #
+# ------------------------------------------------------------------ #
+def test_distributed_query_drains_to_zero():
+    from daft_tpu.runners.distributed import DistributedRunner
+
+    runner = DistributedRunner(num_workers=2)
+    try:
+        with daft_tpu.execution_config_ctx(result_cache_enabled=False):
+            with memory_limit(4 << 20):
+                df = make_df(100_000).repartition(4, "k") \
+                    .groupby("k").agg(col("v").sum().alias("s"))
+                builder = df._builder
+                cfg = daft_tpu.get_context().execution_config
+                rows = sum(len(p) for p in
+                           runner.run(builder, timeout=60).partitions)
+        assert rows == 97
+    finally:
+        runner.manager.shutdown()
+    led = get_ledger()
+    assert led.total_held() == 0, led.audit()
+
+
+def test_rss_sampler_ticks_and_parks():
+    from daft_tpu.execution.memledger import RssSampler, read_rss_bytes
+
+    assert read_rss_bytes() > 0
+    led = MemoryLedger(enabled=True)
+    sampler = RssSampler(led, interval_s=0.02)
+    sampler.start()
+    try:
+        led.charge("qs", "Sort", 10)
+        led._wake_sampler() if led._sampler else sampler.wake()
+        sampler.wake()
+        deadline = time.monotonic() + 5
+        while sampler.samples == 0 and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert sampler.samples > 0
+        prof = led.finish_query("qs")
+        assert prof["rss_peak_bytes"] > 0
+    finally:
+        sampler.stop()

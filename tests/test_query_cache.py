@@ -518,14 +518,14 @@ def test_explain_analyze_prints_cache_lines(capsys):
     assert "plan cache: MISS" in out or "result cache: MISS" in out
 
 
-def test_schema_v2_reader_accepts_v1_and_v2(tmp_path):
+def test_schema_reader_accepts_v1_v2_v3(tmp_path):
     from daft_tpu.querylog import (
         QUERYLOG_SCHEMA_VERSION,
         load_query_log,
         validate_record,
     )
 
-    assert QUERYLOG_SCHEMA_VERSION == 2
+    assert QUERYLOG_SCHEMA_VERSION == 3
     v1 = {"schema_version": 1, "query_id": "q1", "tenant": "default",
           "runner": "native", "ts": 1.0, "outcome": "success",
           "duration_s": 0.1, "plan_fingerprint": "ab", "error_kind": "",
@@ -535,26 +535,32 @@ def test_schema_v2_reader_accepts_v1_and_v2(tmp_path):
     v2 = dict(v1, schema_version=2, plan_cache_hit=True,
               result_cache_hit=False)
     assert validate_record(v2) == []
-    # v2 WITHOUT the cache fields is invalid; unknown versions rejected.
+    v3 = dict(v2, schema_version=3, mem={})
+    assert validate_record(v3) == []
+    # Records missing their version's new fields are invalid; unknown
+    # versions rejected.
     assert validate_record(dict(v1, schema_version=2))
     assert validate_record(dict(v2, schema_version=3))
+    assert validate_record(dict(v3, schema_version=4))
     p = tmp_path / "log.jsonl"
     with open(p, "w") as f:
         f.write(json.dumps(v1) + "\n")
         f.write(json.dumps(v2) + "\n")
+        f.write(json.dumps(v3) + "\n")
         f.write('{"torn')
-    assert len(load_query_log(str(p))) == 2
+    assert len(load_query_log(str(p))) == 3
 
 
-def test_live_records_are_schema_valid_v2():
+def test_live_records_are_schema_valid_v3():
     from daft_tpu.querylog import validate_record
 
     make_df(100, seed=13).agg(col("v").sum().alias("s")).collect()
     rec = daft_tpu.recent_queries(1)[0]
     assert validate_record(rec) == []
-    assert rec["schema_version"] == 2
+    assert rec["schema_version"] == 3
     assert isinstance(rec["plan_cache_hit"], bool)
     assert isinstance(rec["result_cache_hit"], bool)
+    assert isinstance(rec["mem"], dict)
 
 
 def test_shared_fingerprint_helper():
