@@ -63,8 +63,10 @@ __all__ = [
     "read_json",
     "invalidate_cache_path",
     "read_parquet",
+    "read_view",
     "recent_queries",
     "register_table",
+    "register_view",
     "submit_query",
     "set_request_priority",
     "set_execution_config",
@@ -165,6 +167,11 @@ def __getattr__(name: str):
         from daft_tpu.plancache import invalidate_path
 
         return invalidate_path
+    if name in ("register_view", "read_view", "view_freshness",
+                "get_view_registry"):
+        from daft_tpu.streaming import views as _views_mod
+
+        return getattr(_views_mod, name)
     raise AttributeError(f"module 'daft_tpu' has no attribute {name!r}")
 
 

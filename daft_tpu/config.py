@@ -247,6 +247,24 @@ class ExecutionConfig:
     memory_ledger_enabled: bool = True
     mem_sampler_enabled: bool = True
     mem_sampler_interval_s: float = 0.25
+    # Streaming ingestion & incremental materialized views
+    # (daft_tpu/streaming/). Tailing sources emit bounded micro-batches —
+    # at most streaming_max_batch_files files / streaming_max_batch_bytes
+    # listed bytes per poll — so one refresh query through the front door
+    # stays admission-sized; leftovers stay pending and surface as the
+    # view's delta backlog. streaming_poll_interval_s paces the refresh
+    # driver loop; streaming_checkpoint_dir (DAFT_STREAMING_CHECKPOINT)
+    # persists per-view refresh state (consumed-delta keys + merged
+    # partial state) so a process restart resumes without re-absorbing or
+    # losing deltas. slo_staleness_p99_s is the freshness objective the
+    # staleness burn-rate alerting (slo.py FreshnessTracker) evaluates —
+    # overridable per tenant via the admission policy JSON, like the
+    # latency objectives.
+    streaming_max_batch_files: int = 64
+    streaming_max_batch_bytes: int = 256 << 20
+    streaming_poll_interval_s: float = 1.0
+    streaming_checkpoint_dir: Optional[str] = None
+    slo_staleness_p99_s: float = 60.0
 
     def with_changes(self, **kwargs) -> "ExecutionConfig":
         return dataclasses.replace(self, **kwargs)
@@ -334,4 +352,16 @@ class ExecutionConfig:
         if os.environ.get("DAFT_RESULT_CACHE_BYTES"):
             changes["result_cache_max_bytes"] = int(
                 os.environ["DAFT_RESULT_CACHE_BYTES"])
+        if os.environ.get("DAFT_STREAMING_BATCH_FILES"):
+            changes["streaming_max_batch_files"] = int(
+                os.environ["DAFT_STREAMING_BATCH_FILES"])
+        if os.environ.get("DAFT_STREAMING_BATCH_BYTES"):
+            changes["streaming_max_batch_bytes"] = int(
+                os.environ["DAFT_STREAMING_BATCH_BYTES"])
+        if os.environ.get("DAFT_STREAMING_CHECKPOINT"):
+            changes["streaming_checkpoint_dir"] = \
+                os.environ["DAFT_STREAMING_CHECKPOINT"]
+        if os.environ.get("DAFT_SLO_STALENESS_P99_S"):
+            changes["slo_staleness_p99_s"] = float(
+                os.environ["DAFT_SLO_STALENESS_P99_S"])
         return cfg.with_changes(**changes) if changes else cfg

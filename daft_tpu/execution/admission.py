@@ -110,7 +110,9 @@ class TenantPolicy:
     ``slo_latency_p99_s``/``slo_error_rate`` (0 = use the config defaults)
     override the tenant's SLO objectives — the burn-rate tracker and the
     tail-based auto-profiler (daft_tpu/slo.py) read them from here so
-    per-tenant SLOs ride the same policy JSON as quotas.
+    per-tenant SLOs ride the same policy JSON as quotas;
+    ``slo_staleness_p99_s`` does the same for the freshness objective of
+    the tenant's materialized views (daft_tpu/streaming/).
     """
 
     tenant: str = DEFAULT_TENANT
@@ -120,12 +122,13 @@ class TenantPolicy:
     priority: int = 0
     slo_latency_p99_s: float = 0.0
     slo_error_rate: float = 0.0
+    slo_staleness_p99_s: float = 0.0
 
     @staticmethod
     def from_dict(tenant: str, d: dict) -> "TenantPolicy":
         known = {"max_concurrent_queries", "max_memory_fraction",
                  "queue_depth", "priority", "slo_latency_p99_s",
-                 "slo_error_rate"}
+                 "slo_error_rate", "slo_staleness_p99_s"}
         bad = set(d) - known
         if bad:
             raise DaftValueError(
@@ -847,13 +850,15 @@ def get_controller() -> AdmissionController:
 def set_tenant_policy(tenant: str, *, max_concurrent_queries: int = 0,
                       max_memory_fraction: float = 1.0, queue_depth: int = 0,
                       priority: int = 0, slo_latency_p99_s: float = 0.0,
-                      slo_error_rate: float = 0.0) -> None:
+                      slo_error_rate: float = 0.0,
+                      slo_staleness_p99_s: float = 0.0) -> None:
     """Convenience: install a per-tenant policy on the process controller."""
     get_controller().set_policy(TenantPolicy(
         tenant=tenant, max_concurrent_queries=max_concurrent_queries,
         max_memory_fraction=max_memory_fraction, queue_depth=queue_depth,
         priority=priority, slo_latency_p99_s=slo_latency_p99_s,
-        slo_error_rate=slo_error_rate))
+        slo_error_rate=slo_error_rate,
+        slo_staleness_p99_s=slo_staleness_p99_s))
 
 
 _tenant_var: contextvars.ContextVar[Optional[str]] = \

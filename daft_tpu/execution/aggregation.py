@@ -112,6 +112,15 @@ class TwoPhasePlan:
                 )
                 denom = FunctionCall("pow_3_2", [m2])
                 return BinaryOp("truediv", m3, denom)
+            if op == "dd_sketch":
+                # Re-decomposition of an already-partial plan (a streaming
+                # view runs `Aggregate(partial_exprs, keys)` over its delta
+                # through the executor): sketches are their own partial
+                # form and merge in sketch space.
+                return add("v", agg, "dd_merge")
+            if op == "udaf_partial":
+                # Same: a UDAF's partial state is its own partial form.
+                return add("st", agg, "udaf_merge", agg.kwargs)
             if op == "approx_percentile":
                 # Bounded-memory two-phase: DDSketch partials merged in
                 # sketch space (reference: src/daft-sketch).
@@ -216,6 +225,24 @@ class AggState:
         self._buffer_rows = len(merged)
         self._approx_bytes += merged.size_bytes()
         self._needs_merge = False
+
+    def fork(self) -> "AggState":
+        """Independent copy sharing the (immutable) plan and batches —
+        the materialized-view refresh discipline: absorb a delta into the
+        FORK, finalize it, and only then swap it in. A refresh that dies
+        mid-absorb leaves the original state untouched, so the replay
+        absorbs the same delta exactly once."""
+        clone = AggState.__new__(AggState)
+        clone.plan = self.plan
+        clone.out_schema = self.out_schema
+        clone.input_schema = self.input_schema
+        clone._raw = list(self._raw)
+        clone._raw_rows = self._raw_rows
+        clone._approx_bytes = self._approx_bytes
+        clone._buffers = list(self._buffers)
+        clone._buffer_rows = self._buffer_rows
+        clone._needs_merge = self._needs_merge
+        return clone
 
     def approx_size_bytes(self) -> int:
         """Approximate resident bytes of buffered raw + partial state (drives

@@ -448,7 +448,9 @@ class Executor:
                 and getattr(cfg, "result_cache_scan_outputs", True)) \
                 or not node.scan_tasks \
                 or not all(hasattr(t, "files") and hasattr(t, "pushdowns")
-                           for t in node.scan_tasks):
+                           for t in node.scan_tasks) \
+                or any(getattr(t, "ephemeral", False)
+                       for t in node.scan_tasks):
             yield from self._scan_stream(node)
             return
         from daft_tpu import plancache

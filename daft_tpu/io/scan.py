@@ -59,6 +59,10 @@ class ScanTask:
     schema: Schema
     pushdowns: Pushdowns = field(default_factory=Pushdowns)
     read_options: Dict[str, Any] = field(default_factory=dict)
+    # One-shot scans (streaming delta micro-batches) must not populate the
+    # scan-output cache: their keys never repeat, so caching only churns
+    # the LRU. Carried from ScanInfo.ephemeral.
+    ephemeral: bool = False
 
     def size_bytes(self) -> int:
         return sum(f.size_bytes or 0 for f in self.files)
@@ -199,6 +203,24 @@ def glob_paths(paths: Sequence[str], io_config=None) -> List[FileInfo]:
     return out
 
 
+def list_paths_tolerant(paths: Sequence[str], io_config=None) -> List[FileInfo]:
+    """Listing for tailing sources (daft_tpu/streaming/sources.py): the
+    same selector/list contract as :func:`glob_paths`, but an empty or
+    not-yet-created prefix is an empty listing, not an error — a stream's
+    source may simply have no data yet. Output is sorted by path, the
+    deterministic order deltas are absorbed in."""
+    out: List[FileInfo] = []
+    for p in paths:
+        try:
+            out.extend(glob_paths([p], io_config))
+        except DaftIOError as e:
+            if "No files found" in str(e) or "Path not found" in str(e):
+                continue
+            raise
+    out.sort(key=lambda f: f.path)
+    return out
+
+
 def _glob_one(path: str, io_config=None) -> List[FileInfo]:
     try:
         return glob_paths([path], io_config)
@@ -216,12 +238,18 @@ class ScanInfo:
 
     def __init__(self, paths: Sequence[str], file_format: str, schema: Schema,
                  read_options: Optional[Dict[str, Any]] = None,
-                 files: Optional[List[FileInfo]] = None):
+                 files: Optional[List[FileInfo]] = None,
+                 ephemeral: bool = False):
         self.paths = list(paths)
         self.file_format = file_format
         self.schema = schema
         self.read_options = read_options or {}
         self._files = files
+        # One-shot scans (streaming delta micro-batches): each carries a
+        # unique explicit file list, so caching its plan or result would
+        # only churn the LRUs with keys that never repeat. plancache's key
+        # walk marks ephemeral scans plan- and result-uncacheable.
+        self.ephemeral = ephemeral
 
     def files(self) -> List[FileInfo]:
         if self._files is None:
@@ -263,13 +291,13 @@ class ScanInfo:
             fsize = f.size_bytes or cfg.scan_tasks_min_size_bytes
             if bucket and (bucket_bytes + fsize > cfg.scan_tasks_max_size_bytes
                            or len(bucket) >= cfg.max_sources_per_scan_task):
-                tasks.append(ScanTask(bucket, self.file_format, self.schema, pushdowns, self.read_options))
+                tasks.append(ScanTask(bucket, self.file_format, self.schema, pushdowns, self.read_options, self.ephemeral))
                 bucket, bucket_bytes = [], 0
             bucket.append(f)
             bucket_bytes += fsize
             if bucket_bytes >= cfg.scan_tasks_min_size_bytes:
-                tasks.append(ScanTask(bucket, self.file_format, self.schema, pushdowns, self.read_options))
+                tasks.append(ScanTask(bucket, self.file_format, self.schema, pushdowns, self.read_options, self.ephemeral))
                 bucket, bucket_bytes = [], 0
         if bucket:
-            tasks.append(ScanTask(bucket, self.file_format, self.schema, pushdowns, self.read_options))
+            tasks.append(ScanTask(bucket, self.file_format, self.schema, pushdowns, self.read_options, self.ephemeral))
         return tasks

@@ -970,6 +970,58 @@ RESULT_CACHE_TENANT_BYTES = _r.gauge(
     "charge, mirrored into the memory observatory)", ("tenant",),
     max_series=_MAX_TENANT_SERIES)
 
+# Streaming ingestion & materialized views (daft_tpu/streaming/). View
+# names are operator-supplied registry keys — cardinality-capped like
+# tenants.
+_MAX_VIEW_SERIES = 256
+VIEW_REFRESHES = _r.counter(
+    "daft_view_refreshes_total",
+    "Materialized-view refreshes, by view and mode (incremental = delta "
+    "absorbed via partial merge, full = rebase recompute)",
+    ("view", "mode"), max_series=2 * _MAX_VIEW_SERIES)
+VIEW_REFRESH_SECONDS = _r.counter(
+    "daft_view_refresh_seconds_total",
+    "Wall seconds spent refreshing each view (cost-of-upkeep currency: "
+    "compare against full-recompute estimates)", ("view",),
+    max_series=_MAX_VIEW_SERIES)
+VIEW_DELTA_FILES = _r.counter(
+    "daft_view_delta_files_total",
+    "Source files absorbed as deltas per view", ("view",),
+    max_series=_MAX_VIEW_SERIES)
+VIEW_DELTA_ROWS = _r.counter(
+    "daft_view_delta_rows_total",
+    "Rows absorbed as deltas per view", ("view",),
+    max_series=_MAX_VIEW_SERIES)
+VIEW_SERVES = _r.counter(
+    "daft_view_serves_total",
+    "Queries served from a view cache entry (with freshness metadata) "
+    "instead of executing", ("view",), max_series=_MAX_VIEW_SERIES)
+VIEW_STALENESS = _r.gauge(
+    "daft_view_staleness_seconds",
+    "Seconds since each view's last refresh absorbed its watermark",
+    ("view",), max_series=_MAX_VIEW_SERIES)
+VIEW_BACKLOG = _r.gauge(
+    "daft_view_backlog_files",
+    "Discovered-but-unabsorbed source files per view (delta backlog)",
+    ("view",), max_series=_MAX_VIEW_SERIES)
+VIEW_STATE_BYTES = _r.gauge(
+    "daft_view_state_bytes",
+    "Bytes held by each view's incremental aggregate state", ("view",),
+    max_series=_MAX_VIEW_SERIES)
+STREAM_BATCHES = _r.counter(
+    "daft_stream_batches_total",
+    "Micro-batches emitted by tailing sources, by source kind "
+    "(listing / append-log)", ("kind",))
+FRESHNESS_BURN_RATE = _r.gauge(
+    "daft_freshness_burn_rate",
+    "Staleness-budget burn rate per view and window (1.0 = burning "
+    "exactly at budget)", ("view", "window"),
+    max_series=2 * _MAX_VIEW_SERIES)
+FRESHNESS_ALERTS = _r.counter(
+    "daft_freshness_alerts_total",
+    "Freshness burn-rate alert episodes per view", ("view",),
+    max_series=_MAX_VIEW_SERIES)
+
 # AI providers (ai/metrics.py shims onto these)
 AI_TOKENS = _r.counter(
     "daft_ai_tokens_total", "Provider tokens consumed",

@@ -105,7 +105,21 @@ _NONPLANNING_FIELDS = frozenset({
     "plan_cache_enabled", "plan_cache_size", "plan_cache_max_pinned_bytes",
     "result_cache_enabled", "result_cache_max_bytes",
     "result_cache_max_entry_bytes", "result_cache_scan_outputs",
+    "streaming_max_batch_files", "streaming_max_batch_bytes",
+    "streaming_poll_interval_s", "streaming_checkpoint_dir",
+    "slo_staleness_p99_s",
 })
+
+#: Result-cache entry kinds. ``result`` and ``scan`` entries are built by
+#: queries and dropped on write-invalidation; ``view`` entries are OWNED
+#: by the materialized-view registry (daft_tpu/streaming/views.py) — they
+#: are published by refreshes, served with freshness metadata, and a
+#: write under their roots marks them stale-but-servable instead of
+#: dropping them (the refresh absorbs the delta; recomputing per write is
+#: exactly the cost views exist to avoid).
+KIND_RESULT = "result"
+KIND_SCAN = "scan"
+KIND_VIEW = "view"
 
 #: Function calls whose output depends on when/where the query runs, not
 #: only on its inputs — plans containing them must never serve from the
@@ -237,6 +251,11 @@ def _node_text(node, roots: List[str], note) -> str:
                 note("unfingerprintable source "
                      f"({type(si).__name__})")
             return f"{name}(si:{id(si):x};cols={node.schema.column_names()})"
+        if getattr(si, "ephemeral", False) and note is not None:
+            # Streaming delta micro-batch: a one-shot explicit file list
+            # that never repeats — caching its plan or result would only
+            # churn the LRUs with single-use keys.
+            note("ephemeral streaming scan", plan_too=True)
         roots.extend(_normalize_path(p) for p in paths)
         opts = {k: v for k, v in getattr(si, "read_options", {}).items()
                 if k != "io_config"}
@@ -501,10 +520,12 @@ class PlanCache:
 # --------------------------------------------------------------------- #
 class _ResultEntry:
     __slots__ = ("key", "kind", "tenant", "partitions", "size_bytes",
-                 "sources", "roots", "created_at", "hits", "plan_repr")
+                 "sources", "roots", "created_at", "hits", "plan_repr",
+                 "freshness")
 
     def __init__(self, key: str, kind: str, tenant: str, partitions,
-                 size_bytes: int, sources, roots, plan_repr: str):
+                 size_bytes: int, sources, roots, plan_repr: str,
+                 freshness: Optional[dict] = None):
         self.key = key
         self.kind = kind
         self.tenant = tenant
@@ -515,6 +536,10 @@ class _ResultEntry:
         self.plan_repr = plan_repr
         self.created_at = time.time()
         self.hits = 0
+        #: ``view`` entries only: {view, watermark, refreshed_at,
+        #: delta_count, pending_writes} — served alongside the partitions
+        #: so a reader always knows HOW fresh the answer is.
+        self.freshness = freshness
 
 
 class BuildHandle:
@@ -643,6 +668,11 @@ class ResultCache:
         entry = self._entries.get(key)
         if entry is None:
             return None
+        if entry.kind == KIND_VIEW:
+            # Views are DESIGNED to serve while their sources move: the
+            # freshness block says exactly how far behind they are, and
+            # the refresh loop (not source stats) advances them.
+            return entry
         if not _sources_fresh(entry.sources):
             self._remove_locked(key, EVICT_STALE)
             return None
@@ -674,6 +704,13 @@ class ResultCache:
                 # claim: popping it would let every later same-key arrival
                 # stampede while the original builder still runs.
                 self._building.pop(base_key, None)
+            existing = self._entries.get(base_key)
+            if existing is not None and existing.kind == KIND_VIEW \
+                    and (entry is None or entry.kind != KIND_VIEW):
+                # The view registry owns this key: a query that raced a
+                # refresh must not replace the view entry (and its
+                # freshness block) with a plain result entry.
+                entry = None
             if entry is not None and entry.size_bytes <= self.capacity:
                 if self._make_room_locked(entry.tenant, entry.size_bytes,
                                           charged):
@@ -690,6 +727,64 @@ class ResultCache:
             self._cond.notify_all()
         self._apply_admission_charges(charged)
         return inserted
+
+    # -- materialized views --------------------------------------------- #
+    def put_view(self, key: str, tenant: str, partitions,
+                 freshness: dict, roots=None, plan_repr: str = "") -> bool:
+        """Publish a materialized-view snapshot under the view's query key
+        (daft_tpu/streaming/views.py). Bypasses the single-flight claim —
+        the view registry serializes refreshes per view itself — and
+        replaces any previous snapshot atomically under the lock. Returns
+        False when the snapshot is over the per-entry bound or the tenant's
+        fair share refuses the bytes (the view still serves from the
+        registry's in-memory snapshot; only the cache fast path is lost)."""
+        size = sum(p.size_bytes() for p in partitions)
+        if size > self.max_entry_bytes or size > self.capacity:
+            return False
+        entry = _ResultEntry(key, KIND_VIEW, tenant, list(partitions), size,
+                             [], list(roots or []), plan_repr,
+                             freshness=dict(freshness))
+        charged: List = []
+        inserted = False
+        with self._cond:
+            old = self._entries.pop(key, None)
+            if old is not None:
+                self._account_locked(old.tenant, -old.size_bytes, charged)
+            if self._make_room_locked(tenant, size, charged):
+                self._entries[key] = entry
+                self._account_locked(tenant, size, charged)
+                inserted = True
+            self._publish_gauges_locked()
+            self._cond.notify_all()
+        self._apply_admission_charges(charged)
+        return inserted
+
+    def update_view_freshness(self, key: str, **fields) -> bool:
+        """Refresh the freshness block on a live view entry (staleness is
+        recomputed at read time from ``refreshed_at``; this is for
+        watermark/delta-count advances that don't change the data)."""
+        with self._cond:
+            e = self._entries.get(key)
+            if e is None or e.kind != KIND_VIEW:
+                return False
+            if e.freshness is None:
+                e.freshness = {}
+            e.freshness.update(fields)
+            return True
+
+    def drop_view(self, key: str) -> bool:
+        """Unregister path: remove the view entry and its byte charges."""
+        charged: List = []
+        with self._cond:
+            e = self._entries.get(key)
+            if e is None or e.kind != KIND_VIEW:
+                return False
+            self._entries.pop(key)
+            self._account_locked(e.tenant, -e.size_bytes, charged)
+            self._publish_gauges_locked()
+            self._cond.notify_all()
+        self._apply_admission_charges(charged)
+        return True
 
     # -- accounting / eviction ------------------------------------------ #
     def _account_locked(self, tenant: str, delta: int, charged: List) -> None:
@@ -787,8 +882,20 @@ class ResultCache:
         p = _normalize_path(path)
         charged: List = []
         with self._cond:
-            doomed = [k for k, e in self._entries.items()
-                      if any(_path_overlaps(p, r) for r in e.roots)]
+            doomed = []
+            for k, e in self._entries.items():
+                if not any(_path_overlaps(p, r) for r in e.roots):
+                    continue
+                if e.kind == KIND_VIEW:
+                    # Stale-but-servable: the write is a pending delta the
+                    # next refresh absorbs; dropping the view would turn
+                    # every write into a full recompute — the exact cost
+                    # views exist to avoid.
+                    if e.freshness is not None:
+                        e.freshness["pending_writes"] = \
+                            e.freshness.get("pending_writes", 0) + 1
+                    continue
+                doomed.append(k)
             for k in doomed:
                 e = self._entries.pop(k)
                 self._account_locked(e.tenant, -e.size_bytes, charged)
@@ -850,12 +957,18 @@ class ResultCache:
     def snapshot(self) -> List[dict]:
         """Per-entry view for the dashboard cache panel."""
         with self._cond:
-            return [{
-                "key": e.key, "kind": e.kind, "tenant": e.tenant,
-                "bytes": e.size_bytes, "hits": e.hits,
-                "age_s": round(time.time() - e.created_at, 3),
-                "sources": len(e.sources),
-            } for e in self._entries.values()]
+            out = []
+            for e in self._entries.values():
+                row = {
+                    "key": e.key, "kind": e.kind, "tenant": e.tenant,
+                    "bytes": e.size_bytes, "hits": e.hits,
+                    "age_s": round(time.time() - e.created_at, 3),
+                    "sources": len(e.sources),
+                }
+                if e.freshness is not None:
+                    row["freshness"] = dict(e.freshness)
+                out.append(row)
+            return out
 
 
 # --------------------------------------------------------------------- #

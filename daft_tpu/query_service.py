@@ -151,6 +151,9 @@ def submit_query(sql: str, tenant: Optional[str] = None,
             "result_cache_hit": bool(record.get("result_cache_hit")),
             "admission_wait_s": record.get("admission_wait_s", 0.0),
             "plan_fingerprint": record.get("plan_fingerprint", ""),
+            # v4 freshness block: non-empty when the answer came from a
+            # materialized view — the client learns HOW fresh it is.
+            "view": record.get("view", {}),
         }
     finally:
         set_request_priority(None)

@@ -43,6 +43,7 @@ failures never fail the query — the recorder logs and drops instead.
 
 from __future__ import annotations
 
+import contextlib
 import contextvars
 import json
 import logging
@@ -58,9 +59,13 @@ log = logging.getLogger("daft_tpu.querylog")
 #: Schema v2 added ``plan_cache_hit`` / ``result_cache_hit`` (PR 13's
 #: query-as-a-service caching); v3 adds the memory observatory's ``mem``
 #: block (reserved vs peak-held vs spilled bytes, reconciliation, stall
-#: time — execution/memledger.py). The reader accepts v1 through v3 — a
-#: log written across either upgrade still loads whole.
-QUERYLOG_SCHEMA_VERSION = 3
+#: time — execution/memledger.py); v4 adds the streaming plane's ``view``
+#: block (daft_tpu/streaming/): which materialized view a refresh query
+#: maintained, or the freshness facts (watermark, staleness, delta count)
+#: attached when a query was SERVED from a view entry ({} for plain
+#: queries). The reader accepts v1 through v4 — a log written across any
+#: upgrade still loads whole.
+QUERYLOG_SCHEMA_VERSION = 4
 
 #: Outcome taxonomy — every query lands in exactly one bucket.
 OUTCOME_SUCCESS = "success"
@@ -75,7 +80,8 @@ OUTCOMES = (OUTCOME_SUCCESS, OUTCOME_TIMEOUT, OUTCOME_CANCELLED,
 #: means bumping QUERYLOG_SCHEMA_VERSION or adding OPTIONAL keys, never
 #: repurposing these). v1 is the pre-cache set; v2 additionally requires
 #: the cache-hit facts; v3 additionally requires the ``mem`` block ({} when
-#: the memory ledger is disabled).
+#: the memory ledger is disabled); v4 additionally requires the ``view``
+#: block ({} for queries that neither refreshed nor served from a view).
 RECORD_REQUIRED_V1 = ("schema_version", "query_id", "tenant", "runner", "ts",
                       "outcome", "duration_s", "plan_fingerprint",
                       "admission_wait_s", "shed_level", "rows_out",
@@ -83,7 +89,8 @@ RECORD_REQUIRED_V1 = ("schema_version", "query_id", "tenant", "runner", "ts",
 RECORD_REQUIRED_V2 = RECORD_REQUIRED_V1 + ("plan_cache_hit",
                                            "result_cache_hit")
 RECORD_REQUIRED_V3 = RECORD_REQUIRED_V2 + ("mem",)
-RECORD_REQUIRED = RECORD_REQUIRED_V3
+RECORD_REQUIRED_V4 = RECORD_REQUIRED_V3 + ("view",)
+RECORD_REQUIRED = RECORD_REQUIRED_V4
 
 #: Ring capacity default; DAFT_QUERY_LOG_RING overrides at first use.
 DEFAULT_RING_SIZE = 512
@@ -164,7 +171,7 @@ class FlightEntry:
                  "plan_fingerprint", "admission_wait_s", "shed_level",
                  "shed_reason", "rows_out", "bytes_out", "profiled",
                  "autoprofiled", "plan_cache_hit", "result_cache_hit",
-                 "mem", "_m0", "_recorder", "_done")
+                 "mem", "view", "_m0", "_recorder", "_done")
 
     def __init__(self, query_id: str, tenant: str, runner: str, cfg,
                  recorder: "FlightRecorder"):
@@ -185,6 +192,7 @@ class FlightEntry:
         self.plan_cache_hit = False
         self.result_cache_hit = False
         self.mem: Dict[str, Any] = {}
+        self.view: Dict[str, Any] = {}
         self._m0 = _counter_values()
         self._recorder = recorder
         self._done = False
@@ -213,6 +221,15 @@ class FlightEntry:
         ``mem`` block. {} when the ledger plane is disabled."""
         if mem:
             self.mem = mem
+
+    def note_view(self, view: "dict | None") -> None:
+        """The streaming plane's facts for this query — either the view a
+        refresh maintained ({view, role: "refresh", ...}) or, on a cache
+        hit served from a ``view`` entry, the freshness block (watermark,
+        staleness_s, delta_count) the reader got. Schema-v4 ``view``
+        field."""
+        if view:
+            self.view = dict(view)
 
     def count(self, mp) -> None:
         """Per-yielded-partition output accounting (size_bytes is memoized
@@ -341,6 +358,10 @@ class FlightRecorder:
             "plan_cache_hit": entry.plan_cache_hit,
             "result_cache_hit": entry.result_cache_hit,
             "mem": entry.mem,
+            # Explicit note_view wins; otherwise the ambient view scope
+            # (a refresh loop brackets its micro-batch queries with
+            # view_scope) stamps the record; {} for plain queries.
+            "view": entry.view or _view_scope_var.get() or {},
             "profiled": entry.profiled or profile is not None,
             "autoprofiled": entry.autoprofiled,
             "operators": _operator_digest(profile),
@@ -468,22 +489,24 @@ def validate_record(rec: Any) -> List[str]:
     """Schema check for one query-log line; returns problems (empty =
     valid). Shared by the writer's tests and any reader that must not
     trust a torn tail line. Accepts EVERY schema version from v1
-    (pre-cache) through v2 (cache-hit fields) to v3 (the memory ``mem``
-    block) — a log written across the upgrades loads whole."""
+    (pre-cache) through v2 (cache-hit fields), v3 (the memory ``mem``
+    block), and v4 (the streaming ``view`` block) — a log written across
+    the upgrades loads whole."""
     errs: List[str] = []
     if not isinstance(rec, dict):
         return [f"record is {type(rec).__name__}, not an object"]
     version = rec.get("schema_version")
     required = {1: RECORD_REQUIRED_V1,
-                2: RECORD_REQUIRED_V2}.get(version, RECORD_REQUIRED_V3)
+                2: RECORD_REQUIRED_V2,
+                3: RECORD_REQUIRED_V3}.get(version, RECORD_REQUIRED_V4)
     for key in required:
         if key not in rec:
             errs.append(f"missing key {key!r}")
     if errs:
         return errs
-    if version not in (1, 2, QUERYLOG_SCHEMA_VERSION):
+    if version not in (1, 2, 3, QUERYLOG_SCHEMA_VERSION):
         errs.append(f"schema_version {version!r} not in "
-                    f"(1, 2, {QUERYLOG_SCHEMA_VERSION})")
+                    f"(1, 2, 3, {QUERYLOG_SCHEMA_VERSION})")
     if rec["outcome"] not in OUTCOMES:
         errs.append(f"unknown outcome {rec['outcome']!r}")
     if not isinstance(rec.get("duration_s"), (int, float)) \
@@ -537,6 +560,24 @@ def get_recorder() -> FlightRecorder:
 
 _last_record_var: contextvars.ContextVar[Optional[dict]] = \
     contextvars.ContextVar("daft_last_query_record", default=None)
+
+_view_scope_var: contextvars.ContextVar[Optional[dict]] = \
+    contextvars.ContextVar("daft_view_scope", default=None)
+
+
+@contextlib.contextmanager
+def view_scope(info: dict):
+    """Bracket for a materialized-view refresh: every query finishing on
+    this context while the scope is open carries ``info`` as its v4
+    ``view`` block — the refresh loop runs its delta micro-batches through
+    the normal front door, and this is how their flight records say which
+    view they maintained without threading a parameter through the
+    runners."""
+    tok = _view_scope_var.set(dict(info))
+    try:
+        yield
+    finally:
+        _view_scope_var.reset(tok)
 
 
 def last_record() -> Optional[dict]:

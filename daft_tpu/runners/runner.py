@@ -120,6 +120,32 @@ def plan_with_caches(builder, cfg, prof, fentry, token, tenant):
             if fentry is not None:
                 fentry.observe_plan(payload.plan_repr)
                 fentry.note_caches(result_hit=True)
+            if payload.kind == plancache.KIND_VIEW \
+                    and payload.freshness is not None:
+                # Served from a materialized view: the reader (and this
+                # query's v4 flight record) learns exactly HOW fresh the
+                # answer is — watermark, seconds behind, deltas absorbed.
+                import time as _time
+
+                from daft_tpu import metrics, slo
+
+                fr = dict(payload.freshness)
+                fr["staleness_s"] = round(
+                    _time.time() - fr.get("refreshed_at", payload.created_at),
+                    3)
+                if fentry is not None:
+                    fentry.note_view(dict(fr, role="serve"))
+                view_name = fr.get("view", "?")
+                metrics.VIEW_SERVES.labels(view_name).inc()
+                try:
+                    slo.get_freshness_tracker().observe(
+                        view_name, tenant, fr["staleness_s"], cfg)
+                except Exception:  # noqa: BLE001 — observability, not a gate
+                    import logging
+
+                    logging.getLogger("daft_tpu.streaming").warning(
+                        "freshness observe failed for view %r",
+                        view_name, exc_info=True)
             return None, payload.plan_repr, payload.partitions, None
         handle = payload
 

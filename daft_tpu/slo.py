@@ -373,3 +373,211 @@ def get_tracker() -> SLOTracker:
             if _TRACKER is None:
                 _TRACKER = SLOTracker()
     return _TRACKER
+
+
+# --------------------------------------------------------------------- #
+# Freshness SLO (materialized views, daft_tpu/streaming/)                 #
+# --------------------------------------------------------------------- #
+def _staleness_objective_for(tenant: str, cfg) -> float:
+    """Staleness p99 objective (seconds) for a view's tenant: the same
+    admission-policy override channel as the latency objectives, above the
+    ``slo_staleness_p99_s`` config default."""
+    obj = 0.0
+    try:
+        from daft_tpu.execution.admission import get_controller
+
+        pol = get_controller().policy_for(tenant)
+        obj = float(getattr(pol, "slo_staleness_p99_s", 0.0) or 0.0)
+    except Exception:
+        log.warning("freshness objective lookup failed for tenant %r",
+                    tenant, exc_info=True)
+    if obj <= 0:
+        obj = float(getattr(cfg, "slo_staleness_p99_s", 60.0) or 60.0)
+    return obj
+
+
+class _ViewWindow:
+    """One view's rolling staleness observations + alert state."""
+
+    __slots__ = ("tenant", "records", "alerting", "alerts_fired",
+                 "last_eval", "last_seen", "fast_burn", "slow_burn",
+                 "bad_fast", "pending")
+
+    def __init__(self, tenant: str):
+        self.tenant = tenant
+        self.records: deque = deque(maxlen=WINDOW_CAPACITY)  # (ts, staleness, bad)
+        self.alerting = False
+        self.alerts_fired = 0
+        self.last_eval = 0.0
+        self.last_seen = time.monotonic()
+        self.fast_burn = 0.0
+        self.slow_burn = 0.0
+        self.bad_fast = 0.0
+        self.pending = 0
+
+
+class FreshnessTracker:
+    """Staleness SLO per view/tenant, same multiwindow burn-rate scheme as
+    :class:`SLOTracker`: a staleness sample (taken at every view serve AND
+    every refresh) is *bad* when it exceeds the tenant's staleness
+    objective; when both the fast and slow windows burn past their
+    thresholds a :class:`~daft_tpu.subscribers.events.FreshnessBurnRateAlert`
+    fires once per episode. "The view is quietly 20 minutes behind" is a
+    page, not a surprise in a postmortem."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._views: Dict[str, _ViewWindow] = {}
+
+    def observe(self, view: str, tenant: str, staleness_s: float,
+                cfg) -> None:
+        obj = _staleness_objective_for(tenant, cfg)
+        bad = staleness_s > obj
+        now = time.monotonic()
+        alert_event = None
+        with self._lock:
+            win = self._views.get(view)
+            if win is None:
+                while len(self._views) >= MAX_TENANTS:
+                    idle = min(self._views,
+                               key=lambda v: self._views[v].last_seen)
+                    del self._views[idle]
+                win = self._views[view] = _ViewWindow(tenant)
+            win.last_seen = now
+            win.tenant = tenant
+            win.records.append((now, float(staleness_s), bad))
+            win.pending += 1
+            if now - win.last_eval >= _EVAL_REFRESH_S \
+                    or win.pending >= MIN_SAMPLES:
+                win.last_eval = now
+                win.pending = 0
+                alert_event = self._evaluate_locked(view, win, cfg, obj, now)
+        from daft_tpu import metrics
+
+        metrics.VIEW_STALENESS.labels(view).set(staleness_s)
+        if alert_event is not None:
+            _emit_freshness_alert(alert_event)
+
+    @staticmethod
+    def _bad_fraction(win: _ViewWindow, now: float, window_s: float) -> tuple:
+        cutoff = now - window_s
+        n = bad = 0
+        for ts, _stale, is_bad in reversed(win.records):
+            if ts < cutoff:
+                break
+            n += 1
+            bad += 1 if is_bad else 0
+        return (bad / n if n else 0.0), n
+
+    def _evaluate_locked(self, view: str, win: _ViewWindow, cfg,
+                         obj: float, now: float):
+        fast_w = float(getattr(cfg, "slo_fast_window_s", 60.0))
+        slow_w = float(getattr(cfg, "slo_slow_window_s", 300.0))
+        fast_thr = float(getattr(cfg, "slo_fast_burn", 14.0))
+        slow_thr = float(getattr(cfg, "slo_slow_burn", 6.0))
+        budget = max(float(getattr(cfg, "slo_error_rate", 0.05) or 0.05),
+                     1e-9)
+        win.bad_fast, n_fast = self._bad_fraction(win, now, fast_w)
+        bad_slow, n_slow = self._bad_fraction(win, now, slow_w)
+        win.fast_burn = win.bad_fast / budget
+        win.slow_burn = bad_slow / budget
+        from daft_tpu import metrics
+
+        metrics.FRESHNESS_BURN_RATE.labels(view, "fast").set(win.fast_burn)
+        metrics.FRESHNESS_BURN_RATE.labels(view, "slow").set(win.slow_burn)
+        tripped = (n_fast >= MIN_SAMPLES and win.fast_burn >= fast_thr
+                   and n_slow >= MIN_SAMPLES and win.slow_burn >= slow_thr)
+        if tripped and not win.alerting:
+            win.alerting = True
+            win.alerts_fired += 1
+            metrics.FRESHNESS_ALERTS.labels(view).inc()
+            from daft_tpu.subscribers.events import FreshnessBurnRateAlert
+
+            return FreshnessBurnRateAlert(
+                view=view, tenant=win.tenant,
+                fast_burn_rate=round(win.fast_burn, 3),
+                slow_burn_rate=round(win.slow_burn, 3),
+                stale_fraction=round(win.bad_fast, 4),
+                staleness_objective_s=obj, window_s=fast_w)
+        if win.alerting and win.fast_burn < 1.0:
+            win.alerting = False
+        return None
+
+    def snapshot(self, cfg=None) -> List[dict]:
+        """Per-view staleness table for ``/api/slo`` — like the tenant
+        table, a scrape re-evaluates against the current windows."""
+        if cfg is None:
+            from daft_tpu.context import get_context
+
+            cfg = get_context().execution_config
+        now = time.monotonic()
+        slow_w = float(getattr(cfg, "slo_slow_window_s", 300.0))
+        with self._lock:
+            views = list(self._views.items())
+        out = []
+        alerts = []
+        for view, win in sorted(views):
+            obj = _staleness_objective_for(win.tenant, cfg)
+            with self._lock:
+                win.last_eval = now
+                win.pending = 0
+                ev = self._evaluate_locked(view, win, cfg, obj, now)
+            if ev is not None:
+                alerts.append(ev)
+            cutoff = now - slow_w
+            stales: List[float] = []
+            n_bad = 0
+            for ts, stale, bad in reversed(win.records):
+                if ts < cutoff:
+                    break
+                stales.append(stale)
+                n_bad += 1 if bad else 0
+            stales.sort()
+
+            def pct(q: float) -> float:
+                if not stales:
+                    return 0.0
+                return stales[min(int(q * len(stales)), len(stales) - 1)]
+
+            out.append({
+                "view": view,
+                "tenant": win.tenant,
+                "window_s": slow_w,
+                "samples": len(stales),
+                "staleness_p50_s": round(pct(0.5), 6),
+                "staleness_p95_s": round(pct(0.95), 6),
+                "staleness_p99_s": round(pct(0.99), 6),
+                "stale_fraction": round(n_bad / max(len(stales), 1), 4),
+                "fast_burn_rate": round(win.fast_burn, 3),
+                "slow_burn_rate": round(win.slow_burn, 3),
+                "alerting": win.alerting,
+                "alerts_fired": win.alerts_fired,
+                "objective_staleness_p99_s": obj,
+            })
+        for ev in alerts:
+            _emit_freshness_alert(ev)
+        return out
+
+    def reset(self) -> None:
+        with self._lock:
+            self._views.clear()
+
+
+def _emit_freshness_alert(event) -> None:
+    from daft_tpu.context import get_context
+
+    log.warning("freshness burn-rate alert: view=%s fast=%.1fx slow=%.1fx",
+                event.view, event.fast_burn_rate, event.slow_burn_rate)
+    get_context().notify(event)
+
+
+_FRESHNESS: Optional[FreshnessTracker] = None
+
+
+def get_freshness_tracker() -> FreshnessTracker:
+    global _FRESHNESS
+    if _FRESHNESS is None:
+        with _tracker_lock:
+            if _FRESHNESS is None:
+                _FRESHNESS = FreshnessTracker()
+    return _FRESHNESS

@@ -1,0 +1,30 @@
+"""Streaming ingestion & incremental materialized views.
+
+The continuously-fresh-data plane: tailing sources turn growing data
+into bounded micro-batch deltas (two-phase poll/commit cursors), and
+materialized views absorb those deltas into maintained aggregate state
+(``AggState.add_partial``) instead of recomputing — published through
+the result cache with honest freshness metadata and watched by the
+staleness SLO. See docs/COMPONENTS.md § Streaming & incremental views.
+"""
+
+from daft_tpu.streaming.checkpoint import ViewCheckpointStore
+from daft_tpu.streaming.sources import (AppendLogSource, ListingDeltaSource,
+                                        SourceDelta, TailingSource)
+from daft_tpu.streaming.views import (MaterializedView, ViewRegistry,
+                                      get_view_registry, read_view,
+                                      register_view, view_freshness)
+
+__all__ = [
+    "AppendLogSource",
+    "ListingDeltaSource",
+    "MaterializedView",
+    "SourceDelta",
+    "TailingSource",
+    "ViewCheckpointStore",
+    "ViewRegistry",
+    "get_view_registry",
+    "read_view",
+    "register_view",
+    "view_freshness",
+]

@@ -1,0 +1,108 @@
+"""Materialized-view refresh checkpoints.
+
+Worker death *inside* a refresh is already covered: the delta micro-batch
+runs through the normal front door, so the executor's lineage recovery
+replays lost partials deterministically, and the fork-then-swap absorb
+discipline plus the source's poll/commit cursor make the refresh itself
+replayable. What lineage cannot survive is the *process* dying — this
+module persists exactly what a restarted process needs to resume a view
+without recomputing or double-absorbing anything:
+
+* a JSON **manifest** (view name, refresh seq, watermark, delta count,
+  the source's committed cursor) written temp-file-then-rename, so a
+  crash mid-write leaves the previous manifest intact (the
+  BENCH_TRAJECTORY/jsonl-sink atomicity discipline); and
+* the view's merged **partial-state batches** as an Arrow IPC file —
+  partial form, not final form, because partials are what ``add_partial``
+  resumes from.
+
+Restore loads the manifest + state; the source re-polls from the
+committed cursor, so files that arrived while the process was down are
+simply the next delta.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import List, Optional
+
+import pyarrow as pa
+
+from daft_tpu.recordbatch import RecordBatch
+
+
+class ViewCheckpointStore:
+    """One directory, one ``<view>.json`` + ``<view>.arrow`` pair per view."""
+
+    def __init__(self, path: str):
+        self.path = os.path.abspath(os.path.expanduser(path))
+
+    def _paths(self, view: str) -> tuple:
+        safe = "".join(c if c.isalnum() or c in "-_." else "_" for c in view)
+        return (os.path.join(self.path, f"{safe}.json"),
+                os.path.join(self.path, f"{safe}.arrow"))
+
+    def save(self, view: str, manifest: dict,
+             partial_batches: List[RecordBatch]) -> None:
+        os.makedirs(self.path, exist_ok=True)
+        mpath, spath = self._paths(view)
+        # State first, manifest last: the manifest's rename is the commit
+        # point, and it must never point at state that isn't fully on disk.
+        if partial_batches:
+            tables = [rb.to_arrow_table() for rb in partial_batches]
+            tmp = spath + ".tmp"
+            with pa.OSFile(tmp, "wb") as f:
+                with pa.ipc.new_file(f, tables[0].schema) as writer:
+                    for t in tables:
+                        writer.write_table(t)
+            os.replace(tmp, spath)
+        elif os.path.exists(spath):
+            os.remove(spath)
+        tmp = mpath + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(manifest, f, sort_keys=True)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, mpath)
+
+    def load(self, view: str) -> Optional[dict]:
+        """The manifest plus restored partial batches, or None when no
+        (readable) checkpoint exists. A torn manifest is treated as
+        absent — the rename discipline makes that unreachable short of
+        disk corruption, and corruption must not wedge registration."""
+        mpath, spath = self._paths(view)
+        try:
+            with open(mpath) as f:
+                manifest = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            return None
+        batches: List[RecordBatch] = []
+        if os.path.exists(spath):
+            try:
+                with pa.OSFile(spath, "rb") as f:
+                    reader = pa.ipc.open_file(f)
+                    for i in range(reader.num_record_batches):
+                        batches.append(RecordBatch.from_arrow_table(
+                            pa.Table.from_batches(
+                                [reader.get_batch(i)])))
+            except (OSError, pa.ArrowInvalid):
+                return None  # manifest without state is a lie: start cold
+        manifest["partial_batches"] = batches
+        return manifest
+
+    def clear(self, view: Optional[str] = None) -> None:
+        if view is not None:
+            for p in self._paths(view):
+                try:
+                    os.remove(p)
+                except OSError:
+                    pass
+            return
+        if os.path.isdir(self.path):
+            for name in os.listdir(self.path):
+                if name.endswith((".json", ".arrow")):
+                    try:
+                        os.remove(os.path.join(self.path, name))
+                    except OSError:
+                        pass

@@ -1,0 +1,592 @@
+"""Incrementally-maintained materialized views.
+
+A registered aggregate query stops being "a query we re-run" and becomes
+**state we maintain**: its upkeep cost is proportional to NEW data, not
+to how often it is read (ROADMAP item 2's continuously-fresh-data
+scenario). The machinery is a composition of five existing planes:
+
+* The view's plan is split at its root :class:`~daft_tpu.logical.plan.
+  Aggregate`: everything below (Filter/Project chain over a ScanSource)
+  is the **delta pipeline**, re-applied verbatim to each micro-batch a
+  :class:`~daft_tpu.streaming.sources.TailingSource` discovers.
+* Each micro-batch runs ``Aggregate(partial_exprs, keys)`` through the
+  **normal front door** — admission ticket, cancel token, byte ledger,
+  and a v4 flight record stamped by ``querylog.view_scope`` — so a
+  refresh is governed, metered, and recovered exactly like any query
+  (worker death mid-refresh replays through the executor's lineage path).
+* The partial outputs are absorbed via ``AggState.add_partial`` — the
+  PR 8 partial-merge machinery — into a **fork** of the view's state;
+  the fork is swapped in and the source cursor committed only after a
+  clean finalize, so a refresh that dies anywhere leaves the view and
+  the cursor unmoved and the SAME delta replays exactly once.
+* The finalized snapshot publishes into the result cache as a ``view``
+  entry under the ORIGINAL query's fingerprint: anyone running the
+  registered query serves the snapshot instantly, with freshness
+  metadata (watermark, staleness, delta count) instead of a silent
+  staleness lie — and a write under the view's roots marks it pending
+  instead of evicting it.
+* Every refresh and serve feeds the staleness SLO
+  (``slo.FreshnessTracker``), so "the view is quietly far behind" pages
+  through the same burn-rate plane as latency.
+
+Determinism: deltas absorb in sorted-path order and the absorb is a
+left-fold over partial batches, so view contents are byte-identical at
+any thread count (the executor's determinism contract covers each
+micro-batch; the fold order is fixed by the source). Byte-identity
+against a COLD full recompute additionally requires the aggregate's
+merge to be associativity-insensitive (count/min/max/bool, integer-
+valued sums) — the honest caveat documented in docs/COMPONENTS.md.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+import uuid
+from typing import Dict, List, Optional
+
+from daft_tpu.errors import DaftValueError
+
+log = logging.getLogger("daft_tpu.streaming")
+
+
+def _split_view_plan(plan):
+    """Split a view definition at its root Aggregate.
+
+    Returns ``(agg_node, chain, scan_node)`` where ``chain`` is the
+    (root-first) list of Filter/Project nodes between the Aggregate's
+    input and the base ScanSource. Raises for anything else — views are
+    deliberately restricted to the shapes ``add_partial`` can maintain
+    incrementally (joins/sorts/limits would need full delta-join
+    machinery, not partial merges)."""
+    from daft_tpu.logical import plan as lp
+
+    node = plan
+    if isinstance(node, lp.Limit) and node.offset == 0:
+        # collect() row caps wrap harmlessly around an aggregate.
+        node = node.children()[0]
+    if not isinstance(node, lp.Aggregate):
+        raise DaftValueError(
+            "a materialized view must be an aggregation (df.agg / "
+            f"df.groupby(...).agg); got root {type(node).__name__}")
+    agg = node
+    chain = []
+    cur = agg.children()[0]
+    while isinstance(cur, (lp.Filter, lp.Project)):
+        chain.append(cur)
+        cur = cur.children()[0]
+    if not isinstance(cur, lp.ScanSource):
+        raise DaftValueError(
+            "a materialized view must bottom out in a file scan "
+            f"(daft_tpu.read_*); got {type(cur).__name__}")
+    return agg, chain, cur
+
+
+class MaterializedView:
+    """One registered aggregate query + its incremental state."""
+
+    def __init__(self, name: str, builder, tenant: str = "default",
+                 source=None, cfg=None):
+        from daft_tpu import plancache
+        from daft_tpu.context import get_context
+        from daft_tpu.execution.aggregation import AggState
+        from daft_tpu.streaming.sources import ListingDeltaSource
+
+        self.name = name
+        self.tenant = tenant
+        self.builder = builder
+        cfg = cfg or get_context().execution_config
+        self.key = plancache.compute_query_key(builder.plan, cfg)
+        self.agg, self.chain, self.scan = _split_view_plan(builder.plan)
+        input_schema = (self.chain[0].schema if self.chain
+                        else self.scan.schema)
+        self.state = AggState(self.agg.agg_exprs, self.agg.group_by,
+                              self.agg.schema, input_schema=input_schema)
+        if source is None:
+            source = ListingDeltaSource(
+                self.scan.scan_info.paths,
+                self.scan.scan_info.read_options.get("io_config"))
+        self.source = source
+        self._lock = threading.RLock()
+        self._snapshot: List = []  # finalized MicroPartitions
+        self.watermark = 0.0
+        self.refreshed_at = 0.0
+        self.delta_count = 0
+        self.refresh_count = 0
+        self.full_recomputes = 0
+        self.incremental_seconds = 0.0
+        self.full_recompute_estimate_s = 0.0
+        self.last_refresh_s = 0.0
+        self.last_error = ""
+
+    # -- delta plumbing ------------------------------------------------- #
+    def _delta_builder(self, delta):
+        """The delta micro-batch's logical plan: the view's own pipeline
+        over ONLY the delta, aggregated to PARTIAL form (the executor
+        re-decomposes partial exprs — they are their own partial form)."""
+        from daft_tpu.io.scan import ScanInfo
+        from daft_tpu.logical import plan as lp
+        from daft_tpu.logical.builder import LogicalPlanBuilder
+        from daft_tpu.micropartition import MicroPartition
+
+        si = self.scan.scan_info
+        if delta.rows:
+            import pyarrow as pa
+
+            from daft_tpu.recordbatch import RecordBatch
+
+            cols = {f.name: [r.get(f.name) for r in delta.rows]
+                    for f in si.schema}
+            rb = RecordBatch.from_arrow_table(
+                pa.table(cols, schema=si.schema.to_arrow()), si.schema)
+            cur = lp.InMemorySource(
+                [MicroPartition.from_record_batches([rb], si.schema)],
+                si.schema)
+        else:
+            files = sorted(delta.files, key=lambda f: f.path)
+            delta_si = ScanInfo([f.path for f in files], si.file_format,
+                                si.schema, read_options=si.read_options,
+                                files=files, ephemeral=True)
+            cur = lp.ScanSource(delta_si, si.schema)
+        for node in reversed(self.chain):
+            cur = node.with_children([cur])
+        plan = self.state.plan
+        cur = lp.Aggregate(cur, plan.partial_exprs, plan.group_by)
+        return LogicalPlanBuilder(cur)
+
+    def _full_builder(self):
+        """The whole-history plan in partial form (rebase path): every
+        committed file plus the current delta, re-scanned fresh."""
+        from daft_tpu.io.scan import ScanInfo
+        from daft_tpu.logical import plan as lp
+        from daft_tpu.logical.builder import LogicalPlanBuilder
+
+        si = self.scan.scan_info
+        full_si = ScanInfo(si.paths, si.file_format, si.schema,
+                           read_options=si.read_options, ephemeral=True)
+        cur = lp.ScanSource(full_si, si.schema)
+        for node in reversed(self.chain):
+            cur = node.with_children([cur])
+        plan = self.state.plan
+        cur = lp.Aggregate(cur, plan.partial_exprs, plan.group_by)
+        return LogicalPlanBuilder(cur)
+
+    def _run_front_door(self, builder, role: str, timeout=None):
+        """Run a refresh plan through the normal front door, stamped as
+        this view's work in the v4 flight record."""
+        from daft_tpu import querylog
+        from daft_tpu.context import get_context
+        from daft_tpu.execution.admission import set_tenant
+
+        prev_info = {"view": self.name, "role": role,
+                     "seq": self.refresh_count}
+        set_tenant(self.tenant)
+        try:
+            with querylog.view_scope(prev_info):
+                runner = get_context().get_or_create_runner()
+                return runner.run(builder, timeout=timeout).partitions
+        finally:
+            set_tenant(None)
+
+    # -- refresh -------------------------------------------------------- #
+    def refresh(self, timeout: Optional[float] = None, cfg=None) -> dict:
+        """Absorb ONE pending micro-batch (or rebase on in-place change).
+        Returns a report dict; ``refreshed`` False means nothing new."""
+        from daft_tpu import metrics
+        from daft_tpu.context import get_context
+
+        cfg = cfg or get_context().execution_config
+        with self._lock:
+            delta = self.source.poll(
+                int(getattr(cfg, "streaming_max_batch_files", 64)),
+                int(getattr(cfg, "streaming_max_batch_bytes", 256 << 20)))
+            if delta is None or delta.is_empty():
+                if delta is not None:
+                    self.source.commit(delta)  # consumed-but-empty span
+                self._observe_staleness(cfg)
+                return {"view": self.name, "refreshed": False,
+                        "backlog": self.source.backlog()}
+            t0 = time.monotonic()
+            full = bool(delta.changed)
+            try:
+                if full:
+                    report = self._rebase(delta, timeout, cfg)
+                else:
+                    report = self._absorb(delta, timeout, cfg)
+            except BaseException as e:
+                # Fork discipline: state and cursor are untouched — the
+                # next refresh re-polls the SAME delta and replays.
+                self.last_error = f"{type(e).__name__}: {e}"[:200]
+                raise
+            wall = time.monotonic() - t0
+            self.last_refresh_s = wall
+            self.refresh_count += 1
+            self.last_error = ""
+            if full:
+                self.full_recomputes += 1
+                self.full_recompute_estimate_s = wall
+            else:
+                self.incremental_seconds += wall
+            mode = "full" if full else "incremental"
+            metrics.VIEW_REFRESHES.labels(self.name, mode).inc()
+            metrics.VIEW_REFRESH_SECONDS.labels(self.name).inc(wall)
+            metrics.VIEW_DELTA_FILES.labels(self.name).inc(len(delta.files))
+            metrics.VIEW_DELTA_ROWS.labels(self.name).inc(
+                report.pop("_delta_rows", 0))
+            metrics.VIEW_BACKLOG.labels(self.name).set(self.source.backlog())
+            metrics.VIEW_STATE_BYTES.labels(self.name).set(
+                self.state.approx_size_bytes())
+            self._publish(cfg)
+            self._observe_staleness(cfg)
+            self._checkpoint(cfg)
+            self._emit_refreshed(delta, wall, full)
+            report.update({"view": self.name, "refreshed": True,
+                           "mode": mode, "duration_s": round(wall, 6),
+                           "watermark": self.watermark,
+                           "backlog": self.source.backlog()})
+            return report
+
+    def _absorb(self, delta, timeout, cfg) -> dict:
+        parts = self._run_front_door(self._delta_builder(delta), "refresh",
+                                     timeout)
+        fork = self.state.fork()
+        rows = 0
+        for mp in parts:
+            rb = mp.combined()
+            rows += len(rb)
+            # Partial outputs of one executor run may split groups across
+            # partitions — unmerged ingest forces the merge pass.
+            fork.accumulate_unmerged_partial(rb)
+        self._swap(fork, delta)
+        return {"_delta_rows": rows, "delta_files": len(delta.files)}
+
+    def _rebase(self, delta, timeout, cfg) -> dict:
+        """A committed file changed in place: incremental state built from
+        its old bytes is invalid. Rebuild the whole state from a fresh
+        scan — correctness over cleverness, and the event/metric makes the
+        cost visible."""
+        from daft_tpu.execution.aggregation import AggState
+
+        parts = self._run_front_door(self._full_builder(), "rebase", timeout)
+        fork = AggState(self.agg.agg_exprs, self.agg.group_by,
+                        self.agg.schema, input_schema=self.state.input_schema)
+        rows = 0
+        for mp in parts:
+            rb = mp.combined()
+            rows += len(rb)
+            fork.accumulate_unmerged_partial(rb)
+        self._swap(fork, delta)
+        return {"_delta_rows": rows, "delta_files": len(delta.files),
+                "changed": list(delta.changed)}
+
+    def _swap(self, fork, delta) -> None:
+        """The commit point: finalize the fork, then (and only then) swap
+        state, advance the cursor, and stamp freshness."""
+        from daft_tpu.micropartition import MicroPartition
+
+        final = fork.finalize()
+        self._snapshot = [MicroPartition.from_record_batches(
+            [final], self.agg.schema)]
+        self.state = fork
+        self.source.commit(delta)
+        self.watermark = max(self.watermark, delta.watermark)
+        self.refreshed_at = time.time()
+        self.delta_count += 1
+
+    def catch_up(self, timeout: Optional[float] = None, cfg=None,
+                 max_batches: int = 1000) -> int:
+        """Refresh until the source has no pending data (registration's
+        initial build, and the storm scripts' convergence step)."""
+        n = 0
+        for _ in range(max_batches):
+            if not self.refresh(timeout=timeout, cfg=cfg).get("refreshed"):
+                break
+            n += 1
+        return n
+
+    # -- publication / observability ------------------------------------ #
+    def freshness(self) -> dict:
+        stale = (time.time() - self.refreshed_at) if self.refreshed_at else 0.0
+        return {"view": self.name, "watermark": round(self.watermark, 6),
+                "refreshed_at": round(self.refreshed_at, 6),
+                "staleness_s": round(stale, 3),
+                "delta_count": self.delta_count, "pending_writes": 0}
+
+    def _publish(self, cfg) -> None:
+        from daft_tpu import plancache
+
+        if not getattr(cfg, "result_cache_enabled", True):
+            return
+        plancache.get_result_cache(cfg).put_view(
+            self.key.fp, self.tenant, self._snapshot, self.freshness(),
+            roots=self.key.roots, plan_repr=self.key.text.split("\n", 1)[0])
+
+    def _observe_staleness(self, cfg) -> None:
+        from daft_tpu import metrics, slo
+
+        stale = (time.time() - self.refreshed_at) if self.refreshed_at else 0.0
+        metrics.VIEW_STALENESS.labels(self.name).set(stale)
+        try:
+            slo.get_freshness_tracker().observe(self.name, self.tenant,
+                                                stale, cfg)
+        except Exception:  # noqa: BLE001 — observability, not a gate
+            log.warning("freshness observation failed for view %s",
+                        self.name, exc_info=True)
+
+    def _checkpoint(self, cfg) -> None:
+        from daft_tpu.streaming.checkpoint import ViewCheckpointStore
+
+        ckpt_dir = getattr(cfg, "streaming_checkpoint_dir", None)
+        if not ckpt_dir:
+            return
+        try:
+            ViewCheckpointStore(ckpt_dir).save(self.name, {
+                "view": self.name, "tenant": self.tenant,
+                "watermark": self.watermark,
+                "refreshed_at": self.refreshed_at,
+                "delta_count": self.delta_count,
+                "refresh_count": self.refresh_count,
+                "cursor": self.source.cursor_state(),
+            }, self.state.partial_batches())
+        except OSError:
+            log.warning("view checkpoint failed for %s under %s",
+                        self.name, ckpt_dir, exc_info=True)
+
+    def restore(self, cfg) -> bool:
+        """Adopt a checkpoint written by a previous process, if one exists.
+        The cursor restores to the last COMMITTED delta, so anything that
+        arrived since (including a delta that was mid-absorb at death) is
+        simply re-polled — nothing lost, nothing doubled."""
+        from daft_tpu.micropartition import MicroPartition
+        from daft_tpu.streaming.checkpoint import ViewCheckpointStore
+
+        ckpt_dir = getattr(cfg, "streaming_checkpoint_dir", None)
+        if not ckpt_dir:
+            return False
+        manifest = ViewCheckpointStore(ckpt_dir).load(self.name)
+        if manifest is None:
+            return False
+        with self._lock:
+            for rb in manifest["partial_batches"]:
+                self.state.accumulate_unmerged_partial(rb)
+            self.source.restore_cursor(manifest.get("cursor", {}))
+            self.watermark = float(manifest.get("watermark", 0.0))
+            self.refreshed_at = float(manifest.get("refreshed_at", 0.0))
+            self.delta_count = int(manifest.get("delta_count", 0))
+            self.refresh_count = int(manifest.get("refresh_count", 0))
+            final = self.state.fork().finalize()
+            self._snapshot = [MicroPartition.from_record_batches(
+                [final], self.agg.schema)]
+            self._publish(cfg)
+        return True
+
+    def _emit_refreshed(self, delta, wall: float, full: bool) -> None:
+        from daft_tpu.context import get_context
+        from daft_tpu.subscribers.events import ViewRefreshed
+
+        try:
+            get_context().notify(ViewRefreshed(
+                view=self.name, tenant=self.tenant,
+                watermark=self.watermark, delta_files=len(delta.files),
+                delta_rows=len(delta.rows), duration_s=round(wall, 6),
+                full_recompute=full))
+        except Exception:  # noqa: BLE001
+            log.warning("ViewRefreshed notify failed", exc_info=True)
+
+    # -- reads ---------------------------------------------------------- #
+    def snapshot_partitions(self) -> List:
+        with self._lock:
+            return list(self._snapshot)
+
+    def snapshot_df(self):
+        """The current view contents as a DataFrame (in-memory source —
+        reading the view never re-runs the query)."""
+        from daft_tpu.dataframe.dataframe import DataFrame
+        from daft_tpu.logical.builder import LogicalPlanBuilder
+
+        with self._lock:
+            parts = list(self._snapshot)
+        return DataFrame(LogicalPlanBuilder.in_memory(parts,
+                                                      self.agg.schema))
+
+    def recompute_cold(self, timeout: Optional[float] = None) -> "object":
+        """Ground truth for the chaos tests: the ORIGINAL query, executed
+        cold over a fresh scan (ephemeral, so neither cache serves or
+        stores it). Returns one combined RecordBatch."""
+        from daft_tpu.io.scan import ScanInfo
+        from daft_tpu.logical import plan as lp
+        from daft_tpu.logical.builder import LogicalPlanBuilder
+        from daft_tpu.recordbatch import RecordBatch
+
+        si = self.scan.scan_info
+        cold_si = ScanInfo(si.paths, si.file_format, si.schema,
+                           read_options=si.read_options, ephemeral=True)
+        cur = lp.ScanSource(cold_si, si.schema)
+        for node in reversed(self.chain):
+            cur = node.with_children([cur])
+        cur = lp.Aggregate(cur, self.agg.agg_exprs, self.agg.group_by)
+        parts = self._run_front_door(LogicalPlanBuilder(cur), "cold-verify",
+                                     timeout)
+        batches = [mp.combined() for mp in parts if len(mp)]
+        if not batches:
+            return RecordBatch.empty(self.agg.schema)
+        return RecordBatch.concat(batches)
+
+    def stats(self) -> dict:
+        """The /api/views row: freshness + cost accounting. The
+        full-recompute estimate starts at the initial build's wall time
+        (the initial catch-up IS a full compute of then-current data) and
+        tracks the latest rebase thereafter."""
+        with self._lock:
+            fr = self.freshness()
+            rows = sum(len(p) for p in self._snapshot)
+            per_refresh = (self.incremental_seconds
+                           / max(self.refresh_count - self.full_recomputes, 1))
+            return dict(fr, **{
+                "tenant": self.tenant,
+                "fingerprint": self.key.fp,
+                "rows": rows,
+                "state_bytes": self.state.approx_size_bytes(),
+                "backlog": self.source.backlog(),
+                "source_kind": getattr(self.source, "kind", "?"),
+                "refresh_count": self.refresh_count,
+                "full_recomputes": self.full_recomputes,
+                "last_refresh_s": round(self.last_refresh_s, 6),
+                "avg_incremental_refresh_s": round(per_refresh, 6),
+                "full_recompute_estimate_s":
+                    round(self.full_recompute_estimate_s, 6),
+                "last_error": self.last_error,
+            })
+
+
+class ViewRegistry:
+    """Process-global registry of materialized views (one per process,
+    like the table registry whose snapshots it can feed)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._views: Dict[str, MaterializedView] = {}
+
+    def register(self, name: str, df, tenant: str = "default", source=None,
+                 expose_table: bool = False, initial_build: bool = True,
+                 cfg=None) -> MaterializedView:
+        from daft_tpu.context import get_context
+
+        if not name or not isinstance(name, str):
+            raise DaftValueError(
+                f"view name must be a non-empty string, got {name!r}")
+        cfg = cfg or get_context().execution_config
+        view = MaterializedView(name, df._builder, tenant=tenant,
+                                source=source, cfg=cfg)
+        with self._lock:
+            if name in self._views:
+                raise DaftValueError(f"view {name!r} already registered "
+                                     "(unregister it first)")
+            self._views[name] = view
+        restored = view.restore(cfg)
+        if initial_build:
+            t0 = time.monotonic()
+            view.catch_up(cfg=cfg)
+            if not restored and view.full_recompute_estimate_s == 0.0:
+                # The initial build absorbed ALL current data: the best
+                # full-recompute cost estimate until a rebase measures one.
+                view.full_recompute_estimate_s = time.monotonic() - t0
+        if expose_table:
+            from daft_tpu.query_service import register_table
+
+            register_table(name, view.snapshot_df())
+        return view
+
+    def unregister(self, name: str) -> None:
+        from daft_tpu import plancache
+
+        with self._lock:
+            view = self._views.pop(name, None)
+        if view is not None:
+            plancache.get_result_cache().drop_view(view.key.fp)
+
+    def get(self, name: str) -> MaterializedView:
+        with self._lock:
+            view = self._views.get(name)
+        if view is None:
+            raise DaftValueError(f"no view named {name!r} (registered: "
+                                 f"{sorted(self._views)})")
+        return view
+
+    def names(self) -> List[str]:
+        with self._lock:
+            return sorted(self._views)
+
+    def refresh_all(self, timeout: Optional[float] = None, cfg=None
+                    ) -> List[dict]:
+        with self._lock:
+            views = list(self._views.values())
+        out = []
+        for v in views:
+            try:
+                out.append(v.refresh(timeout=timeout, cfg=cfg))
+            except Exception as e:  # noqa: BLE001 — one view must not block the rest
+                log.warning("refresh failed for view %s", v.name,
+                            exc_info=True)
+                out.append({"view": v.name, "refreshed": False,
+                            "error": f"{type(e).__name__}: {e}"[:200]})
+        return out
+
+    def snapshot(self) -> List[dict]:
+        """The /api/views payload."""
+        with self._lock:
+            views = list(self._views.values())
+        return [v.stats() for v in sorted(views, key=lambda v: v.name)]
+
+    def reset(self) -> None:
+        """Drop all views (tests). Cache entries drop with them."""
+        from daft_tpu import plancache
+
+        with self._lock:
+            views = list(self._views.values())
+            self._views.clear()
+        for v in views:
+            try:
+                plancache.get_result_cache().drop_view(v.key.fp)
+            except Exception:  # noqa: BLE001 — cleanup; the view is gone
+                log.warning("drop_view failed for %r", v.name,
+                            exc_info=True)
+
+
+_REGISTRY: Optional[ViewRegistry] = None
+_registry_lock = threading.Lock()
+
+
+def get_view_registry() -> ViewRegistry:
+    global _REGISTRY
+    if _REGISTRY is None:
+        with _registry_lock:
+            if _REGISTRY is None:
+                _REGISTRY = ViewRegistry()
+    return _REGISTRY
+
+
+def register_view(name: str, df, tenant: str = "default", source=None,
+                  expose_table: bool = False, initial_build: bool = True
+                  ) -> MaterializedView:
+    """Register ``df`` (an aggregate query over a file scan) as the
+    materialized view ``name`` (``daft_tpu.register_view``). The initial
+    build absorbs all current data; thereafter :meth:`MaterializedView.
+    refresh` absorbs deltas incrementally and readers of the same query
+    serve the snapshot with freshness metadata."""
+    return get_view_registry().register(
+        name, df, tenant=tenant, source=source, expose_table=expose_table,
+        initial_build=initial_build)
+
+
+def read_view(name: str):
+    """The view's current contents as a DataFrame
+    (``daft_tpu.read_view``)."""
+    return get_view_registry().get(name).snapshot_df()
+
+
+def view_freshness(name: str) -> dict:
+    """Freshness metadata for one view (watermark, staleness seconds,
+    delta count, backlog)."""
+    return get_view_registry().get(name).stats()

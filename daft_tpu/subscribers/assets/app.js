@@ -162,6 +162,37 @@ async function renderCache() {
     '<li class="hint">no tables registered</li>';
 }
 
+async function renderViews() {
+  // Materialized views: freshness + cost accounting, then the staleness
+  // SLO table (staleness percentiles vs objective, burn-rate state).
+  const d = await getJSON("/api/views");
+  $("#views tbody").innerHTML = (d.views || []).map((v) =>
+    `<tr><td>${esc(v.view)}</td><td>${esc(v.tenant)}</td>
+      <td>${esc(v.source_kind)}</td><td>${v.rows}</td>
+      <td>${v.staleness_s.toFixed(1)}</td>
+      <td>${v.watermark ? new Date(v.watermark * 1000).toISOString().slice(11, 19) : ""}</td>
+      <td class="${v.backlog ? "err" : "ok"}">${v.backlog}</td>
+      <td>${v.delta_count}</td><td>${v.refresh_count}</td>
+      <td>${v.avg_incremental_refresh_s.toFixed(3)}</td>
+      <td>${v.full_recompute_estimate_s.toFixed(3)}</td>
+      <td class="${v.speedup_vs_full >= 2 ? "ok" : ""}">${v.speedup_vs_full != null ? v.speedup_vs_full + "x" : ""}</td>
+      <td class="${v.last_error ? "err" : ""}">${esc(v.last_error || "")}</td></tr>`
+  ).join("") || '<tr><td colspan="13" class="hint">no views registered</td></tr>';
+  const s = await getJSON("/api/slo");
+  $("#views-slo tbody").innerHTML = (s.views || []).map((v) =>
+    `<tr><td>${esc(v.view)}</td><td>${esc(v.tenant)}</td>
+      <td>${v.samples}</td><td>${v.staleness_p50_s.toFixed(1)}</td>
+      <td>${v.staleness_p95_s.toFixed(1)}</td>
+      <td>${v.staleness_p99_s.toFixed(1)}</td>
+      <td>${v.objective_staleness_p99_s}</td>
+      <td>${(100 * v.stale_fraction).toFixed(1)}%</td>
+      <td class="${v.fast_burn_rate >= 1 ? "err" : "ok"}">${v.fast_burn_rate.toFixed(1)}x</td>
+      <td class="${v.slow_burn_rate >= 1 ? "err" : "ok"}">${v.slow_burn_rate.toFixed(1)}x</td>
+      <td class="${v.alerting ? "err" : "ok"}">${v.alerting ? "ALERTING" : "green"}</td>
+      <td>${v.alerts_fired}</td></tr>`
+  ).join("") || '<tr><td colspan="12" class="hint">no freshness samples yet</td></tr>';
+}
+
 let memSelected = null;
 
 async function renderMemory() {
@@ -346,6 +377,7 @@ async function tick() {
     else if (view === "slo") await renderSLO();
     else if (view === "admission") await renderAdmission();
     else if (view === "cache") await renderCache();
+    else if (view === "views") await renderViews();
     else if (view === "memory") await renderMemory();
     else if (view === "workers") await renderWorkers();
     else if (view === "perf") await renderPerf();

@@ -475,11 +475,30 @@ class _Handler(BaseHTTPRequestHandler):
             # fingerprints.
             from daft_tpu import slo
 
+            from daft_tpu.context import get_context
+
+            cfg = get_context().execution_config
             tracker = slo.get_tracker()
             body = json.dumps({
                 "tenants": tracker.snapshot(),
                 "autoprofile": tracker.autoprofile_state(),
+                "views": slo.get_freshness_tracker().snapshot(cfg),
             }).encode()
+            ctype = "application/json"
+        elif path == "/api/views":
+            # Views panel (daft_tpu/streaming/views.py): per-view
+            # watermark, staleness, delta backlog, and the refresh-cost
+            # ledger (avg incremental refresh vs last full-recompute wall
+            # — the "is incremental maintenance paying for itself" ratio).
+            from daft_tpu.streaming.views import get_view_registry
+
+            rows = get_view_registry().snapshot()
+            for r in rows:
+                full = r.get("full_recompute_estimate_s", 0.0)
+                inc = r.get("avg_incremental_refresh_s", 0.0)
+                r["speedup_vs_full"] = round(full / inc, 2) if inc > 0 \
+                    and full > 0 else None
+            body = json.dumps({"views": rows}).encode()
             ctype = "application/json"
         elif path == "/api/perf/trajectory":
             # Per-query wall series over the committed bench trajectory

@@ -163,6 +163,39 @@ class SLOBurnRateAlert(Event):
 
 
 @dataclass
+class ViewRefreshed(Event):
+    """A materialized view absorbed a delta (daft_tpu/streaming/views.py).
+    ``delta_files``/``delta_rows`` size the absorbed micro-batch;
+    ``watermark`` is the view's new high-water mark (max source mtime of
+    everything absorbed); ``full_recompute`` marks a rebase (a source file
+    changed in place, invalidating incremental state)."""
+
+    view: str = ""
+    tenant: str = ""
+    watermark: float = 0.0
+    delta_files: int = 0
+    delta_rows: int = 0
+    duration_s: float = 0.0
+    full_recompute: bool = False
+
+
+@dataclass
+class FreshnessBurnRateAlert(Event):
+    """A view is burning its staleness error budget faster than the
+    alerting thresholds in BOTH burn windows (daft_tpu/slo.py
+    FreshnessTracker). ``stale_fraction`` is the fast window's share of
+    samples over the staleness objective."""
+
+    view: str = ""
+    tenant: str = ""
+    fast_burn_rate: float = 0.0
+    slow_burn_rate: float = 0.0
+    stale_fraction: float = 0.0
+    staleness_objective_s: float = 0.0
+    window_s: float = 0.0
+
+
+@dataclass
 class CircuitOpened(Event):
     """An IO endpoint's circuit breaker tripped open after consecutive
     transient failures; calls now fail fast until a probe succeeds."""

@@ -1,0 +1,294 @@
+"""Freshness storm: concurrent writers + an incremental refresher + view
+readers, with staleness-SLO and correctness assertions — plus the
+``streaming_views`` micro-benchmark (incremental refresh vs full
+recompute on 1%-new-data).
+
+Smoke mode (``--smoke``, the CI lane) runs a scaled-down storm:
+
+* N writer threads append parquet parts to the tailed prefix;
+* one refresher thread drives ``MaterializedView.catch_up()`` in a loop
+  (every absorb is a bounded micro-batch through the admission front
+  door);
+* M reader threads run the registered query — served from the view's
+  cache entry with freshness metadata — and record observed staleness.
+
+After the storm the script asserts:
+
+1. the final view contents are EQUAL to a cold recompute of the
+   original query over everything the writers produced (integer-valued
+   floats: exact arithmetic, so incremental-vs-cold equality is also
+   byte equality);
+2. observed staleness p99 stayed under ``--staleness-bound`` seconds
+   (refreshes kept up with writers);
+3. the memory ledger drained to zero — ``audit_ledger_leaks() == {}`` —
+   after hundreds of micro-batch refreshes and reads.
+
+Bench mode (default) measures the headline claim: with 1% new data,
+``refresh()`` (absorb one delta as a partial merge) vs a full cold
+recompute of the aggregate, and appends a ``streaming_views`` entry to
+BENCH_TRAJECTORY.jsonl via daft_tpu.perf_report.
+
+    python scripts/freshness_storm.py            # bench + trajectory entry
+    python scripts/freshness_storm.py --smoke    # CI-sized storm
+
+Exit code 0 = all assertions held.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import shutil
+import sys
+import tempfile
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pyarrow as pa  # noqa: E402
+import pyarrow.parquet as pq  # noqa: E402
+
+import daft_tpu  # noqa: E402
+from daft_tpu import col, plancache, slo  # noqa: E402
+from daft_tpu.context import get_context  # noqa: E402
+from daft_tpu.execution.memledger import audit_ledger_leaks  # noqa: E402
+from daft_tpu.streaming import get_view_registry, register_view  # noqa: E402
+
+
+def write_part(d: str, name: str, rows: int, seed: int) -> None:
+    # Integer-valued floats: exact float arithmetic, so the incremental
+    # fold and the cold recompute agree bit-for-bit, not just approximately.
+    ks = [(seed * 7 + i) % 11 for i in range(rows)]
+    vs = [float((seed * 13 + i) % 97) for i in range(rows)]
+    tmp = os.path.join(d, f".{name}.tmp")
+    pq.write_table(pa.table({"k": ks, "v": vs}), tmp)
+    os.replace(tmp, os.path.join(d, name))  # appear atomically
+
+
+def view_query(d: str):
+    df = daft_tpu.read_parquet(os.path.join(d, "*.parquet"))
+    return df.groupby("k").agg(col("v").sum().alias("s"),
+                               col("v").mean().alias("m"),
+                               col("v").count().alias("c"))
+
+
+def rows_of(rb_or_pydict) -> list:
+    d = rb_or_pydict if isinstance(rb_or_pydict, dict) \
+        else rb_or_pydict.to_pydict()
+    keys = sorted(d)
+    return sorted(zip(*[d[k] for k in keys]))
+
+
+def percentile(samples: list, q: float) -> float:
+    if not samples:
+        return 0.0
+    s = sorted(samples)
+    return s[min(len(s) - 1, int(q * len(s)))]
+
+
+# ------------------------------------------------------------------ #
+# Storm (smoke / full)                                                 #
+# ------------------------------------------------------------------ #
+def run_storm(args) -> None:
+    d = tempfile.mkdtemp(prefix="freshness_storm_")
+    try:
+        for i in range(args.seed_files):
+            write_part(d, f"part-{i:05d}.parquet", args.rows_per_file, i)
+        view = register_view("storm_totals", view_query(d))
+        print(f"[storm] registered over {args.seed_files} seed files, "
+              f"initial build {view.full_recompute_estimate_s * 1e3:.1f}ms")
+
+        stop = threading.Event()
+        written = [args.seed_files]
+        staleness_samples: list = []
+        errors: list = []
+
+        def writer(wid: int) -> None:
+            i = 0
+            while not stop.is_set() and i < args.writes_per_writer:
+                seq = args.seed_files + wid * args.writes_per_writer + i
+                try:
+                    write_part(d, f"part-{seq:05d}.parquet",
+                               args.rows_per_file, seq)
+                    written[0] += 1
+                except Exception as e:  # pragma: no cover
+                    errors.append(("writer", repr(e)))
+                i += 1
+                time.sleep(args.write_interval_s)
+
+        def refresher() -> None:
+            while not stop.is_set():
+                try:
+                    view.catch_up()
+                except Exception as e:
+                    errors.append(("refresher", repr(e)))
+                time.sleep(args.refresh_interval_s)
+
+        def reader() -> None:
+            q = view_query(d)
+            while not stop.is_set():
+                try:
+                    q.collect()
+                    staleness_samples.append(
+                        view.freshness()["staleness_s"])
+                except Exception as e:
+                    errors.append(("reader", repr(e)))
+                time.sleep(args.read_interval_s)
+
+        threads = ([threading.Thread(target=writer, args=(w,), daemon=True)
+                    for w in range(args.writers)]
+                   + [threading.Thread(target=refresher, daemon=True)]
+                   + [threading.Thread(target=reader, daemon=True)
+                      for _ in range(args.readers)])
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        # Writers finish on their own; give the refresher time to drain.
+        for t in threads[:args.writers]:
+            t.join()
+        time.sleep(args.refresh_interval_s * 2)
+        stop.set()
+        for t in threads[args.writers:]:
+            t.join(timeout=10)
+        wall = time.perf_counter() - t0
+
+        # Converge, then compare against the cold ground truth.
+        drained = view.catch_up()
+        final = rows_of(view.snapshot_partitions()[0].combined()
+                        .to_pydict()) if view.snapshot_partitions() \
+            else rows_of({})
+        cold = rows_of(view.recompute_cold().to_pydict())
+        assert final == cold, (
+            f"storm view diverged from cold recompute "
+            f"({len(final)} vs {len(cold)} groups)")
+
+        p99 = percentile(staleness_samples, 0.99)
+        print(f"[storm] {written[0]} files by {args.writers} writers, "
+              f"{view.refresh_count} refreshes (+{drained} drain), "
+              f"{len(staleness_samples)} reads in {wall:.1f}s; "
+              f"staleness p99 {p99:.2f}s (bound {args.staleness_bound}s)")
+        assert not errors, f"storm thread errors: {errors[:3]}"
+        assert p99 <= args.staleness_bound, (
+            f"staleness p99 {p99:.2f}s exceeded bound "
+            f"{args.staleness_bound}s")
+
+        leaks = audit_ledger_leaks()
+        assert leaks == {}, f"memory ledger did not drain: {leaks}"
+        tracker_rows = slo.get_freshness_tracker().snapshot(
+            get_context().execution_config)
+        storm_rows = [r for r in tracker_rows if r["view"] == "storm_totals"]
+        assert storm_rows, "freshness tracker never observed the view"
+        print(f"[storm] tracker: {storm_rows[0]['samples']} samples, "
+              f"p99 {storm_rows[0]['staleness_p99_s']}s, "
+              f"alerting={storm_rows[0]['alerting']}  OK")
+    finally:
+        get_view_registry().reset()
+        shutil.rmtree(d, ignore_errors=True)
+
+
+# ------------------------------------------------------------------ #
+# Bench: incremental refresh vs full recompute on 1% new data          #
+# ------------------------------------------------------------------ #
+def run_bench(args) -> int:
+    d = tempfile.mkdtemp(prefix="freshness_bench_")
+    try:
+        n_seed = args.bench_files
+        for i in range(n_seed):
+            write_part(d, f"part-{i:05d}.parquet", args.bench_rows_per_file, i)
+        new_files = max(1, n_seed // 100)  # the 1%-new-data point
+
+        view = register_view("bench_totals", view_query(d))
+        for i in range(new_files):
+            write_part(d, f"part-{n_seed + i:05d}.parquet",
+                       args.bench_rows_per_file, n_seed + i)
+
+        t0 = time.perf_counter()
+        rep = view.refresh()
+        incremental_s = time.perf_counter() - t0
+        assert rep["refreshed"] and rep["delta_files"] == new_files
+
+        t0 = time.perf_counter()
+        cold = view.recompute_cold()
+        full_s = time.perf_counter() - t0
+
+        incr_rows = rows_of(view.snapshot_partitions()[0].combined()
+                            .to_pydict())
+        assert incr_rows == rows_of(cold.to_pydict()), \
+            "incremental refresh diverged from full recompute"
+
+        speedup = full_s / max(incremental_s, 1e-9)
+        total_rows = (n_seed + new_files) * args.bench_rows_per_file
+        print(f"[bench] {n_seed} files + {new_files} new "
+              f"({total_rows} rows total): incremental {incremental_s * 1e3:.1f}ms "
+              f"vs full {full_s * 1e3:.1f}ms -> {speedup:.1f}x")
+
+        if not args.no_record:
+            from daft_tpu import perf_report
+
+            entry = perf_report.build_entry(
+                "streaming_views",
+                [{"name": "incremental_refresh", "wall_s": round(incremental_s, 6),
+                  "rows_out": len(incr_rows), "operators": [],
+                  "metrics": {"delta_files": new_files,
+                              "delta_rows": rep.get("delta_rows", 0)}},
+                 {"name": "full_recompute", "wall_s": round(full_s, 6),
+                  "rows_out": len(incr_rows), "operators": [],
+                  "metrics": {"scan_files": n_seed + new_files}}],
+                config={"bench_files": n_seed, "new_files": new_files,
+                        "rows_per_file": args.bench_rows_per_file,
+                        "new_data_pct": round(100.0 * new_files / n_seed, 2),
+                        "incremental_speedup_x": round(speedup, 2)})
+            path = perf_report.append_entry(entry)
+            print(f"[bench] streaming_views entry appended to {path}")
+
+        if speedup < args.min_speedup:
+            print(f"[bench] FAIL: speedup {speedup:.1f}x < required "
+                  f"{args.min_speedup}x")
+            return 1
+        return 0
+    finally:
+        get_view_registry().reset()
+        plancache.reset_caches()
+        shutil.rmtree(d, ignore_errors=True)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--smoke", action="store_true",
+                    help="CI-sized storm (skips the trajectory append)")
+    ap.add_argument("--writers", type=int, default=None)
+    ap.add_argument("--readers", type=int, default=None)
+    ap.add_argument("--seed-files", type=int, default=None)
+    ap.add_argument("--writes-per-writer", type=int, default=None)
+    ap.add_argument("--rows-per-file", type=int, default=400)
+    ap.add_argument("--write-interval-s", type=float, default=0.02)
+    ap.add_argument("--refresh-interval-s", type=float, default=0.05)
+    ap.add_argument("--read-interval-s", type=float, default=0.05)
+    ap.add_argument("--staleness-bound", type=float, default=5.0,
+                    help="storm staleness p99 must stay under this")
+    ap.add_argument("--bench-files", type=int, default=100)
+    ap.add_argument("--bench-rows-per-file", type=int, default=2000)
+    ap.add_argument("--min-speedup", type=float, default=5.0)
+    ap.add_argument("--no-record", action="store_true",
+                    help="skip the BENCH_TRAJECTORY.jsonl append")
+    args = ap.parse_args()
+
+    smoke = args.smoke
+    args.writers = args.writers or (2 if smoke else 4)
+    args.readers = args.readers or (2 if smoke else 4)
+    args.seed_files = args.seed_files or (4 if smoke else 16)
+    args.writes_per_writer = args.writes_per_writer or (8 if smoke else 40)
+
+    run_storm(args)
+    if smoke:
+        print("[freshness_storm] smoke OK")
+        return 0
+    return run_bench(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,230 @@
+"""AggState partial-merge edge cases (ISSUE 16 satellite): empty partials,
+dtype-promoting merges, and merge-order invariance — the properties the
+materialized-view refresh path (absorb-delta-as-partial) leans on."""
+
+import pyarrow as pa
+import pytest
+
+import daft_tpu
+from daft_tpu import col
+from daft_tpu.context import execution_config_ctx
+from daft_tpu.execution.aggregation import AggState
+from daft_tpu.recordbatch import RecordBatch
+from daft_tpu.schema import Schema
+
+
+def _mp(data):
+    return daft_tpu.from_pydict(data)
+
+
+def _make_state(data, aggs, group_by=("k",)):
+    """An AggState for ``aggs`` over ``data``'s schema (via a throwaway
+    DataFrame, so expression resolution matches the real planner)."""
+    df = _mp(data)
+    gb = [col(g) for g in group_by]
+    plan = df.groupby(*group_by).agg(*aggs) if group_by else df.agg(*aggs)
+    node = plan._builder.plan
+    # Root may be the Aggregate directly or sit under a Project.
+    from daft_tpu.logical import plan as lp
+
+    while not isinstance(node, lp.Aggregate):
+        node = node.children()[0]
+    return AggState(node.agg_exprs, node.group_by, node.schema,
+                    input_schema=df._builder.schema)
+
+
+def _input_batch(data):
+    return RecordBatch.from_arrow_table(pa.table(data))
+
+
+def _partial_of(state, data):
+    rb = _input_batch(data)
+    return rb.agg(state.plan.partial_exprs, state.plan.group_by)
+
+
+def _rows(rb):
+    d = rb.to_pydict()
+    keys = sorted(d)
+    return sorted(zip(*[d[k] for k in keys]))
+
+
+# --------------------------------------------------------------------- #
+# Empty partials                                                          #
+# --------------------------------------------------------------------- #
+def test_empty_partials_are_noops():
+    """Empty batches through every ingest door leave state untouched;
+    finalize of a never-fed grouped state is an empty batch of the right
+    schema."""
+    base = {"k": [1], "v": [1.0]}
+    st = _make_state(base, [col("v").sum().alias("s")])
+    empty = _partial_of(st, {"k": [], "v": []})
+    assert len(empty) == 0
+    st.add_partial(empty)
+    st.accumulate_partial(empty)
+    st.accumulate_unmerged_partial(empty)
+    assert st._buffers == [] and st.approx_size_bytes() == 0
+    out = st.finalize()
+    assert len(out) == 0
+    assert [f.name for f in out.schema] == ["k", "s"]
+
+    # Empty partials interleaved with real ones change nothing.
+    st2 = _make_state(base, [col("v").sum().alias("s")])
+    st2.add_partial(_partial_of(st2, {"k": [1, 2], "v": [1.0, 2.0]}))
+    st2.add_partial(empty)
+    st2.add_partial(_partial_of(st2, {"k": [1], "v": [10.0]}))
+    assert _rows(st2.finalize()) == [(1, 11.0), (2, 2.0)]
+
+
+def test_global_agg_empty_input_yields_one_row():
+    """A global (ungrouped) aggregate over nothing still produces its
+    identity row — count 0, sum null — not an empty batch."""
+    st = _make_state({"k": [1], "v": [1.0]},
+                     [col("v").count().alias("c")], group_by=())
+    out = st.finalize()
+    assert len(out) == 1
+    assert out.to_pydict()["c"] == [0]
+
+
+# --------------------------------------------------------------------- #
+# Dtype-promoting merges                                                  #
+# --------------------------------------------------------------------- #
+def test_merge_promotes_narrow_int_partials():
+    """int8 inputs: the partial sum is already wide (int64) and merging
+    many partials never overflows the narrow input dtype."""
+    base = {"k": pa.array([0], type=pa.int64()),
+            "v": pa.array([1], type=pa.int8())}
+    st = _make_state({"k": [0], "v": [1]}, [col("v").sum().alias("s")])
+    for _ in range(4):
+        st.accumulate_unmerged_partial(_partial_of(st, {
+            "k": pa.array([0, 1], type=pa.int64()),
+            "v": pa.array([100, 127], type=pa.int8()),
+        }))
+    del base
+    out = st.finalize()
+    assert _rows(out) == [(0, 400), (1, 508)]  # > int8 range: promoted
+    s_field = [f for f in out.schema if f.name == "s"][0]
+    assert "int8" not in str(s_field.dtype)
+
+
+def test_merge_mixed_width_partial_batches():
+    """Partials whose value columns landed in different (promotable)
+    widths — int32 vs int64 inputs — still merge to one correct sum."""
+    st = _make_state({"k": [0], "v": [1]}, [col("v").sum().alias("s")])
+    st.accumulate_unmerged_partial(_partial_of(st, {
+        "k": pa.array([0], type=pa.int64()),
+        "v": pa.array([5], type=pa.int32())}))
+    st.accumulate_unmerged_partial(_partial_of(st, {
+        "k": pa.array([0], type=pa.int64()),
+        "v": pa.array([7], type=pa.int64())}))
+    assert _rows(st.finalize()) == [(0, 12)]
+
+
+def test_mean_merge_promotes_counts_to_float_division():
+    """mean = sum/count across partials: integer inputs, float output —
+    the dtype promotion happens in the final expr, not by accident."""
+    st = _make_state({"k": [0], "v": [1]}, [col("v").mean().alias("m")])
+    st.accumulate_unmerged_partial(
+        _partial_of(st, {"k": [0, 1], "v": [1, 10]}))
+    st.accumulate_unmerged_partial(
+        _partial_of(st, {"k": [0, 1], "v": [2, 20]}))
+    out = st.finalize().to_pydict()
+    got = dict(zip(out["k"], out["m"]))
+    assert got == {0: 1.5, 1: 15.0}
+    assert isinstance(got[0], float)
+
+
+# --------------------------------------------------------------------- #
+# Merge-order invariance (the determinism contract)                       #
+# --------------------------------------------------------------------- #
+def _partials(st, n=8):
+    return [_partial_of(st, {
+        "k": [i % 3 for i in range(j, j + 16)],
+        "v": [float(i * j % 97) for i in range(j, j + 16)],
+    }) for j in range(n)]
+
+
+def test_add_partial_order_invariant_byte_identical():
+    """The same partial set absorbed in ANY order finalizes to the same
+    bytes (integer-valued floats: exact arithmetic, so the left-fold's
+    order cannot show)."""
+    st0 = _make_state({"k": [0], "v": [1.0]},
+                      [col("v").sum().alias("s"),
+                       col("v").min().alias("lo"),
+                       col("v").max().alias("hi"),
+                       col("v").count().alias("c")])
+    parts = _partials(st0)
+    outs = []
+    for order in (parts, parts[::-1], parts[3:] + parts[:3]):
+        st = _make_state({"k": [0], "v": [1.0]},
+                         [col("v").sum().alias("s"),
+                          col("v").min().alias("lo"),
+                          col("v").max().alias("hi"),
+                          col("v").count().alias("c")])
+        for p in order:
+            st.accumulate_unmerged_partial(p)
+        outs.append(st.finalize())
+    rows = [_rows(o) for o in outs]
+    assert rows[0] == rows[1] == rows[2]
+    # Byte-level: identical values bit-for-bit once rows are aligned.
+    cols = sorted(outs[0].to_pydict())
+    for o in outs[1:]:
+        for c in cols:
+            a = sorted(outs[0].to_pydict()[c])
+            b = sorted(o.to_pydict()[c])
+            assert all(x == y and type(x) is type(y)
+                       for x, y in zip(a, b))
+
+
+def test_executor_thread_count_invariance_matches_partial_fold():
+    """1 vs 4 compute threads through the REAL executor: byte-identical
+    aggregation output (PR 8 determinism contract) — the property the
+    view's absorb-then-compare-to-cold chaos test builds on."""
+    data = {"k": [i % 5 for i in range(4000)],
+            "v": [float(i % 211) for i in range(4000)]}
+
+    def run(threads):
+        with execution_config_ctx(num_compute_threads=threads,
+                                  result_cache_enabled=False,
+                                  plan_cache_enabled=False):
+            return (_mp(data).groupby("k")
+                    .agg(col("v").sum().alias("s"),
+                         col("v").mean().alias("m"),
+                         col("v").count().alias("c"))
+                    .sort("k").collect().to_pydict())
+
+    r1, r4 = run(1), run(4)
+    assert r1 == r4
+    for a, b in zip(r1["m"], r4["m"]):
+        import struct
+
+        assert struct.pack("<d", a) == struct.pack("<d", b)
+
+
+def test_fork_isolation_and_reuse_after_finalize():
+    """fork(): absorbing into the fork leaves the original untouched;
+    finalize() leaves state in valid merged form so the NEXT fork absorbs
+    on top of it (the view's refresh-after-refresh path)."""
+    st = _make_state({"k": [0], "v": [1.0]}, [col("v").sum().alias("s")])
+    st.accumulate_unmerged_partial(_partial_of(st, {"k": [0], "v": [10.0]}))
+    base_rows = _rows(st.fork().finalize())
+    assert base_rows == [(0, 10.0)]
+
+    fork = st.fork()
+    fork.accumulate_unmerged_partial(_partial_of(st, {"k": [0], "v": [5.0]}))
+    assert _rows(fork.finalize()) == [(0, 15.0)]
+    # Original unchanged by the fork's absorb + finalize.
+    assert _rows(st.fork().finalize()) == [(0, 10.0)]
+    # Chain a second refresh on the swapped-in fork.
+    fork2 = fork.fork()
+    fork2.accumulate_unmerged_partial(_partial_of(st, {"k": [1], "v": [2.0]}))
+    assert _rows(fork2.finalize()) == [(0, 15.0), (1, 2.0)]
+
+
+def test_partial_schema_matches_partial_batches():
+    st = _make_state({"k": [0], "v": [1.0]},
+                     [col("v").sum().alias("s"), col("v").mean().alias("m")])
+    st.accumulate_unmerged_partial(
+        _partial_of(st, {"k": [0, 1], "v": [1.0, 2.0]}))
+    schema = st.partial_schema(st.input_schema)
+    for rb in st.partial_batches():
+        assert [f.name for f in rb.schema] == [f.name for f in schema]

@@ -518,14 +518,14 @@ def test_explain_analyze_prints_cache_lines(capsys):
     assert "plan cache: MISS" in out or "result cache: MISS" in out
 
 
-def test_schema_reader_accepts_v1_v2_v3(tmp_path):
+def test_schema_reader_accepts_v1_through_v4(tmp_path):
     from daft_tpu.querylog import (
         QUERYLOG_SCHEMA_VERSION,
         load_query_log,
         validate_record,
     )
 
-    assert QUERYLOG_SCHEMA_VERSION == 3
+    assert QUERYLOG_SCHEMA_VERSION == 4
     v1 = {"schema_version": 1, "query_id": "q1", "tenant": "default",
           "runner": "native", "ts": 1.0, "outcome": "success",
           "duration_s": 0.1, "plan_fingerprint": "ab", "error_kind": "",
@@ -537,30 +537,40 @@ def test_schema_reader_accepts_v1_v2_v3(tmp_path):
     assert validate_record(v2) == []
     v3 = dict(v2, schema_version=3, mem={})
     assert validate_record(v3) == []
+    # v4 golden pin: the freshness/view block (empty for non-view queries,
+    # watermark/staleness/role for view serves and refreshes).
+    v4 = dict(v3, schema_version=4, view={})
+    assert validate_record(v4) == []
+    assert validate_record(dict(v4, view={
+        "view": "totals", "role": "serve", "watermark": 1.0,
+        "staleness_s": 0.5, "delta_count": 3})) == []
     # Records missing their version's new fields are invalid; unknown
     # versions rejected.
     assert validate_record(dict(v1, schema_version=2))
     assert validate_record(dict(v2, schema_version=3))
     assert validate_record(dict(v3, schema_version=4))
+    assert validate_record(dict(v4, schema_version=5))
     p = tmp_path / "log.jsonl"
     with open(p, "w") as f:
         f.write(json.dumps(v1) + "\n")
         f.write(json.dumps(v2) + "\n")
         f.write(json.dumps(v3) + "\n")
+        f.write(json.dumps(v4) + "\n")
         f.write('{"torn')
-    assert len(load_query_log(str(p))) == 3
+    assert len(load_query_log(str(p))) == 4
 
 
-def test_live_records_are_schema_valid_v3():
+def test_live_records_are_schema_valid_v4():
     from daft_tpu.querylog import validate_record
 
     make_df(100, seed=13).agg(col("v").sum().alias("s")).collect()
     rec = daft_tpu.recent_queries(1)[0]
     assert validate_record(rec) == []
-    assert rec["schema_version"] == 3
+    assert rec["schema_version"] == 4
     assert isinstance(rec["plan_cache_hit"], bool)
     assert isinstance(rec["result_cache_hit"], bool)
     assert isinstance(rec["mem"], dict)
+    assert rec["view"] == {}  # not a view query: block present but empty
 
 
 def test_shared_fingerprint_helper():
@@ -611,3 +621,52 @@ def test_worker_death_mid_build_does_not_poison_entry():
     finally:
         runner.manager.shutdown()
         ctx.set_runner(old)
+
+
+# --------------------------------------------------------------------- #
+# Write-invalidation path matching: segment boundaries (ISSUE 16 audit)   #
+# --------------------------------------------------------------------- #
+def test_path_overlap_respects_segment_boundaries():
+    """/data/foo and /data/foobar are DIFFERENT trees: sibling prefixes
+    that share leading characters must never invalidate each other."""
+    from daft_tpu.plancache import _path_overlaps
+
+    # Exact / ancestor / descendant all overlap.
+    assert _path_overlaps("/data/foo", "/data/foo")
+    assert _path_overlaps("/data/foo/part.parquet", "/data/foo")
+    assert _path_overlaps("/data", "/data/foo")
+    assert _path_overlaps("/data/foo/", "/data/foo")  # trailing slash
+    # Character-prefix siblings do NOT.
+    assert not _path_overlaps("/data/foobar", "/data/foo")
+    assert not _path_overlaps("/data/foo", "/data/foobar")
+    assert not _path_overlaps("/data/foobar/x.parquet", "/data/foo")
+    assert not _path_overlaps("/data/foo.bak", "/data/foo")
+    # Scheme'd URIs obey the same rule.
+    assert _path_overlaps("s3://b/data/foo/x", "s3://b/data/foo")
+    assert not _path_overlaps("s3://b/data/foobar/x", "s3://b/data/foo")
+
+
+def test_invalidate_sibling_prefix_keeps_entry(tmp_path):
+    """End to end: writing under /data/foobar must not drop the cached
+    result rooted at /data/foo (and writing under /data/foo must)."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    foo = tmp_path / "foo"
+    foobar = tmp_path / "foobar"
+    foo.mkdir()
+    foobar.mkdir()
+    pq.write_table(pa.table({"k": [1, 2], "v": [1.0, 2.0]}),
+                   str(foo / "a.parquet"))
+
+    df = daft_tpu.read_parquet(str(foo / "*.parquet"))
+    q = df.groupby("k").agg(col("v").sum().alias("s"))
+    q.collect()  # warm the result (and scan) cache
+    n0 = plancache.get_result_cache().stats()["entries"]
+    assert n0 >= 1
+    # Sibling write: every entry survives.
+    assert daft_tpu.invalidate_cache_path(str(foobar)) == 0
+    assert plancache.get_result_cache().stats()["entries"] == n0
+    # Write under the actual root: all entries rooted there drop.
+    assert daft_tpu.invalidate_cache_path(str(foo)) >= 1
+    assert plancache.get_result_cache().stats()["entries"] == 0
