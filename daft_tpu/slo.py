@@ -522,12 +522,19 @@ class FreshnessTracker:
                 win.last_eval = now
                 win.pending = 0
                 ev = self._evaluate_locked(view, win, cfg, obj, now)
+                # Copy under the lock: observe() appends to the deque from
+                # refresh/serve threads, and mutating a deque while this
+                # scrape iterates it raises RuntimeError.
+                recs = list(win.records)
+                tenant = win.tenant
+                fast_burn, slow_burn = win.fast_burn, win.slow_burn
+                alerting, alerts_fired = win.alerting, win.alerts_fired
             if ev is not None:
                 alerts.append(ev)
             cutoff = now - slow_w
             stales: List[float] = []
             n_bad = 0
-            for ts, stale, bad in reversed(win.records):
+            for ts, stale, bad in reversed(recs):
                 if ts < cutoff:
                     break
                 stales.append(stale)
@@ -541,17 +548,17 @@ class FreshnessTracker:
 
             out.append({
                 "view": view,
-                "tenant": win.tenant,
+                "tenant": tenant,
                 "window_s": slow_w,
                 "samples": len(stales),
                 "staleness_p50_s": round(pct(0.5), 6),
                 "staleness_p95_s": round(pct(0.95), 6),
                 "staleness_p99_s": round(pct(0.99), 6),
                 "stale_fraction": round(n_bad / max(len(stales), 1), 4),
-                "fast_burn_rate": round(win.fast_burn, 3),
-                "slow_burn_rate": round(win.slow_burn, 3),
-                "alerting": win.alerting,
-                "alerts_fired": win.alerts_fired,
+                "fast_burn_rate": round(fast_burn, 3),
+                "slow_burn_rate": round(slow_burn, 3),
+                "alerting": alerting,
+                "alerts_fired": alerts_fired,
                 "objective_staleness_p99_s": obj,
             })
         for ev in alerts:

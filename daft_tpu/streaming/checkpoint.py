@@ -32,6 +32,22 @@ import pyarrow as pa
 from daft_tpu.recordbatch import RecordBatch
 
 
+def _fsync_path(path: str) -> None:
+    """fsync a file or directory by path (pa.OSFile exposes no usable
+    fileno after close; directories need their own fsync for renames).
+    Best-effort on platforms/filesystems that reject directory fds."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
 class ViewCheckpointStore:
     """One directory, one ``<view>.json`` + ``<view>.arrow`` pair per view."""
 
@@ -56,6 +72,7 @@ class ViewCheckpointStore:
                 with pa.ipc.new_file(f, tables[0].schema) as writer:
                     for t in tables:
                         writer.write_table(t)
+            _fsync_path(tmp)  # state must be durable BEFORE the manifest
             os.replace(tmp, spath)
         elif os.path.exists(spath):
             os.remove(spath)
@@ -65,6 +82,10 @@ class ViewCheckpointStore:
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp, mpath)
+        # The renames themselves live in the directory: without this, a
+        # power loss can still surface a manifest whose state rename never
+        # reached disk (load() would silently force a cold rebuild).
+        _fsync_path(self.path)
 
     def load(self, view: str) -> Optional[dict]:
         """The manifest plus restored partial batches, or None when no

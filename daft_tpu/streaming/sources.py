@@ -53,6 +53,13 @@ class SourceDelta:
     files: List[FileInfo] = field(default_factory=list)
     rows: List[dict] = field(default_factory=list)  # append-log payloads
     changed: List[str] = field(default_factory=list)
+    # When ``changed`` is non-empty the source MUST also pin its listing
+    # snapshot of every already-committed path here (changed ones carry
+    # their fresh listing info). The consumer's rebase scans EXACTLY
+    # known_files + files — never the live prefixes, whose extra entries
+    # (backlog beyond the batch bound, arrivals mid-rebase) commit()
+    # would not fingerprint and the next poll would absorb a second time.
+    known_files: List[FileInfo] = field(default_factory=list)
     watermark: float = 0.0
     discovered_at: float = 0.0
     size_bytes: int = 0
@@ -140,11 +147,13 @@ class ListingDeltaSource(TailingSource):
         listing = list_paths_tolerant(self.paths, self.io_config)
         new: List[FileInfo] = []
         changed: List[str] = []
+        known: List[FileInfo] = []
         total = 0
         backlog = 0
         for f in listing:
             prev = self._committed.get(f.path)
             if prev is not None:
+                known.append(f)
                 if self._fingerprint(f) != prev:
                     changed.append(f.path)
                 continue
@@ -162,15 +171,25 @@ class ListingDeltaSource(TailingSource):
         now = time.time()
         metrics.STREAM_BATCHES.labels(self.kind).inc()
         return SourceDelta(seq=self._seq, files=new, changed=changed,
+                           known_files=known if changed else [],
                            watermark=max(mtimes) if mtimes else now,
                            discovered_at=now, size_bytes=total)
 
     def commit(self, delta: SourceDelta) -> None:
-        for f in delta.files:
-            self._committed[f.path] = self._fingerprint(f)
-        for p in delta.changed:
-            # A rebase re-read the changed bytes; re-fingerprint from disk.
-            self._committed[p] = self._fingerprint(FileInfo(p))
+        if delta.changed:
+            # Rebase commit: the rebuilt state contains EXACTLY
+            # known_files + files, so the cursor resets to that set —
+            # fingerprinted from the listing's FileInfo (real size for
+            # remote URIs; FileInfo(p) with size=None would yield
+            # (None, None), never match (None, size), and flag the path
+            # "changed" — a full recompute — on every subsequent poll).
+            # Paths absent from the listing (deleted) drop out here too,
+            # matching the rebuilt state.
+            self._committed = {f.path: self._fingerprint(f)
+                               for f in list(delta.known_files) + list(delta.files)}
+        else:
+            for f in delta.files:
+                self._committed[f.path] = self._fingerprint(f)
         self._seq = delta.seq + 1
         self._last_backlog = max(0, self._last_backlog - len(delta.files))
 

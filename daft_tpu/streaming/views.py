@@ -155,17 +155,31 @@ class MaterializedView:
         cur = lp.Aggregate(cur, plan.partial_exprs, plan.group_by)
         return LogicalPlanBuilder(cur)
 
-    def _full_builder(self):
+    def _rebase_builder(self, delta):
         """The whole-history plan in partial form (rebase path): every
-        committed file plus the current delta, re-scanned fresh."""
+        committed file plus the current delta, re-scanned fresh. The file
+        set is EXACTLY the one the source pinned at poll time
+        (``delta.known_files`` + ``delta.files``) — scanning the live
+        prefixes instead would absorb files commit() never fingerprints
+        (backlog beyond the micro-batch bound, arrivals mid-rebase), and
+        the next poll would return them as "new" and absorb them twice."""
         from daft_tpu.io.scan import ScanInfo
         from daft_tpu.logical import plan as lp
         from daft_tpu.logical.builder import LogicalPlanBuilder
 
+        if not delta.known_files:
+            raise DaftValueError(
+                "rebase delta carries no known_files snapshot: a "
+                "TailingSource that flags SourceDelta.changed must pin "
+                "its listing of committed paths on SourceDelta.known_files "
+                "(exactly-once absorption depends on it)")
+        files = sorted(list(delta.known_files) + list(delta.files),
+                       key=lambda f: f.path)
         si = self.scan.scan_info
-        full_si = ScanInfo(si.paths, si.file_format, si.schema,
-                           read_options=si.read_options, ephemeral=True)
-        cur = lp.ScanSource(full_si, si.schema)
+        rebase_si = ScanInfo([f.path for f in files], si.file_format,
+                             si.schema, read_options=si.read_options,
+                             files=files, ephemeral=True)
+        cur = lp.ScanSource(rebase_si, si.schema)
         for node in reversed(self.chain):
             cur = node.with_children([cur])
         plan = self.state.plan
@@ -177,17 +191,19 @@ class MaterializedView:
         this view's work in the v4 flight record."""
         from daft_tpu import querylog
         from daft_tpu.context import get_context
-        from daft_tpu.execution.admission import set_tenant
+        from daft_tpu.execution.admission import _tenant_var
 
         prev_info = {"view": self.name, "role": role,
                      "seq": self.refresh_count}
-        set_tenant(self.tenant)
+        # Token reset, not set_tenant(None): a caller refreshing inside
+        # its own tenant scope keeps that scope afterwards.
+        token = _tenant_var.set(self.tenant)
         try:
             with querylog.view_scope(prev_info):
                 runner = get_context().get_or_create_runner()
                 return runner.run(builder, timeout=timeout).partitions
         finally:
-            set_tenant(None)
+            _tenant_var.reset(token)
 
     # -- refresh -------------------------------------------------------- #
     def refresh(self, timeout: Optional[float] = None, cfg=None) -> dict:
@@ -268,7 +284,8 @@ class MaterializedView:
         cost visible."""
         from daft_tpu.execution.aggregation import AggState
 
-        parts = self._run_front_door(self._full_builder(), "rebase", timeout)
+        parts = self._run_front_door(self._rebase_builder(delta), "rebase",
+                                     timeout)
         fork = AggState(self.agg.agg_exprs, self.agg.group_by,
                         self.agg.schema, input_schema=self.state.input_schema)
         rows = 0

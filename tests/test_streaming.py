@@ -258,6 +258,74 @@ def test_in_place_change_triggers_rebase(tmp_path):
     assert view.full_recomputes == 1
 
 
+def test_rebase_with_backlog_absorbs_exactly_once(tmp_path):
+    """REVIEW regression: a rebase coinciding with backlog beyond the
+    micro-batch bound must not double-absorb. The rebase scans EXACTLY
+    committed ∪ delta (the source's pinned listing snapshot), so files
+    beyond the bound stay uncommitted backlog and absorb incrementally,
+    each exactly once."""
+    d = seed_dir(tmp_path, 2)
+    view = register_view("rebase_backlog", view_query(d))
+    p = os.path.join(d, "part-000.parquet")
+    pq.write_table(pa.table({"k": [0], "v": [1000.0]}), p)
+    os.utime(p, (time.time() + 5, time.time() + 5))
+    for i in range(4):
+        write_part(d, f"extra-{i}.parquet", [i % 3], [float(100 + i)])
+    with execution_config_ctx(streaming_max_batch_files=1):
+        rep = view.refresh()
+        assert rep["mode"] == "full"
+        # The rebase absorbed at most one new file; the rest is backlog.
+        assert view.source.backlog() > 0
+        drained = view.catch_up()
+    assert drained >= 3
+    assert view.source.backlog() == 0
+    assert rows(read_view("rebase_backlog").collect().to_pydict()) == \
+        rows(view.recompute_cold().to_pydict())
+
+
+def test_listing_source_rebase_commit_resets_cursor(tmp_path):
+    """Source-level half of the same regression: a rebase commit resets
+    the cursor to known ∪ new — backlog files beyond the bound are NOT
+    committed and re-arrive exactly once."""
+    d = seed_dir(tmp_path, 1)
+    src = ListingDeltaSource([os.path.join(d, "*.parquet")])
+    src.commit(src.poll())
+    p = os.path.join(d, "part-000.parquet")
+    pq.write_table(pa.table({"k": [0, 1], "v": [1.0, 2.0]}), p)
+    os.utime(p, (time.time() + 5, time.time() + 5))
+    write_part(d, "new-a.parquet", [0], [1.0])
+    write_part(d, "new-b.parquet", [1], [2.0])
+    delta = src.poll(max_files=1)
+    assert delta.changed == [p]
+    assert [os.path.basename(f.path) for f in delta.files] == \
+        ["new-a.parquet"]
+    # The listing snapshot pins the committed file (with fresh info).
+    assert [f.path for f in delta.known_files] == [p]
+    src.commit(delta)
+    # new-b was beyond the bound: still uncommitted, arrives exactly once.
+    nxt = src.poll()
+    assert [os.path.basename(f.path) for f in nxt.files] == \
+        ["new-b.parquet"] and not nxt.changed
+    src.commit(nxt)
+    assert src.poll() is None
+
+
+def test_remote_changed_fingerprint_committed_from_listing():
+    """REVIEW regression: committing a changed remote path must use the
+    listing's FileInfo (real size), not a statless (None, None)
+    fingerprint that would flag the path 'changed' — a full recompute —
+    on every subsequent poll."""
+    from daft_tpu.io.scan import FileInfo
+    from daft_tpu.streaming.sources import SourceDelta
+
+    src = ListingDeltaSource(["s3://bucket/prefix/*.parquet"])
+    src._committed = {"s3://bucket/prefix/a.parquet": (None, 100)}
+    grown = FileInfo("s3://bucket/prefix/a.parquet", size_bytes=150)
+    delta = SourceDelta(seq=0, changed=[grown.path], known_files=[grown])
+    src.commit(delta)
+    assert src._committed[grown.path] == (None, 150)
+
+
 def test_view_shape_restrictions():
     df = daft_tpu.from_pydict({"k": [1], "v": [1.0]})
     with pytest.raises(DaftValueError):  # not an aggregation
@@ -475,6 +543,61 @@ def test_freshness_tracker_alerts_on_sustained_staleness():
         assert not row["alerting"]
     finally:
         ctx.detach_subscriber(sub)
+
+
+def test_freshness_snapshot_safe_under_concurrent_observe():
+    """REVIEW regression: snapshot() iterates each window's record deque;
+    observe() appends from refresh/serve threads. The copy-under-lock
+    discipline must keep a scrape racing a refresh from raising
+    RuntimeError (deque mutated during iteration)."""
+    import threading
+
+    tracker = slo.get_freshness_tracker()
+    cfg = get_context().execution_config
+    for _ in range(500):  # a window big enough to iterate slowly
+        tracker.observe("racy", "default", 0.01, cfg)
+    stop = threading.Event()
+    errs = []
+
+    def observer():
+        while not stop.is_set():
+            tracker.observe("racy", "default", 0.01, cfg)
+
+    def scraper():
+        try:
+            for _ in range(200):
+                tracker.snapshot(cfg)
+        except RuntimeError as e:  # pragma: no cover — the regression
+            errs.append(e)
+
+    threads = [threading.Thread(target=observer) for _ in range(2)]
+    scrape = threading.Thread(target=scraper)
+    for t in threads:
+        t.start()
+    scrape.start()
+    scrape.join()
+    stop.set()
+    for t in threads:
+        t.join()
+    assert not errs
+
+
+def test_refresh_restores_ambient_tenant(tmp_path):
+    """REVIEW regression: a refresh runs as the view's tenant but must
+    restore the CALLER's ambient tenant afterwards (token reset, not
+    set_tenant(None))."""
+    from daft_tpu.execution.admission import current_tenant, set_tenant
+
+    d = seed_dir(tmp_path, 1)
+    view = register_view("tenanted", view_query(d), tenant="gold",
+                         initial_build=False)
+    set_tenant("caller")
+    try:
+        view.catch_up()
+        assert current_tenant() == "caller"
+    finally:
+        set_tenant(None)
+    assert current_tenant() != "caller"
 
 
 def test_tenant_policy_staleness_objective_override():
