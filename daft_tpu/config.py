@@ -143,6 +143,25 @@ class ExecutionConfig:
     heartbeat_miss_threshold: int = 3    # consecutive misses -> mark dead
     fault_spec: Optional[str] = None     # DAFT_FAULT_SPEC (see faults.py)
     fault_seed: int = 0
+    # Elastic fleet (distributed/fleet.py): SLO-driven autoscaling between
+    # fleet_min_workers and fleet_max_workers with hysteresis + cooldown.
+    # DAFT_FLEET=1 enables; scale-up fires on admission queue pressure /
+    # shed level / SLO burn / inflight saturation, scale-down drains ONE
+    # idle worker after fleet_idle_ticks consecutive calm control ticks.
+    # A drain that cannot pass the leak audits re-activates the worker;
+    # one still running tasks past fleet_drain_timeout_s is killed into
+    # the normal lineage-recovery path.
+    fleet_enabled: bool = False          # DAFT_FLEET
+    fleet_min_workers: int = 1           # DAFT_FLEET_MIN_WORKERS
+    fleet_max_workers: int = 8           # DAFT_FLEET_MAX_WORKERS
+    fleet_cooldown_s: float = 5.0        # DAFT_FLEET_COOLDOWN_S (between scale events)
+    fleet_tick_interval_s: float = 0.5   # controller decision cadence
+    fleet_idle_ticks: int = 3            # calm ticks before a drain (hysteresis)
+    fleet_drain_timeout_s: float = 30.0  # running-task grace before kill-to-recovery
+    fleet_up_queue_frac: float = 0.25    # queued/capacity fraction that scales up
+    fleet_up_burn_rate: float = 1.0      # fast SLO burn rate that scales up
+    fleet_up_inflight_frac: float = 0.9  # inflight/slots fraction that scales up
+    fleet_up_memory_frac: float = 0.85   # ledger-held/limit fraction that scales up
     # Bounded-time execution (cancellation.py, io/circuit.py)
     query_timeout_s: Optional[float] = None  # DAFT_QUERY_TIMEOUT_S; None = unbounded
     # On deadline/cancel abort, how long the dispatcher waits for running
@@ -299,6 +318,17 @@ class ExecutionConfig:
             changes["fault_spec"] = os.environ["DAFT_FAULT_SPEC"]
         if os.environ.get("DAFT_FAULT_SEED"):
             changes["fault_seed"] = int(os.environ["DAFT_FAULT_SEED"])
+        if daft_env_flag("DAFT_FLEET", False):
+            changes["fleet_enabled"] = True
+        if os.environ.get("DAFT_FLEET_MIN_WORKERS"):
+            changes["fleet_min_workers"] = int(
+                os.environ["DAFT_FLEET_MIN_WORKERS"])
+        if os.environ.get("DAFT_FLEET_MAX_WORKERS"):
+            changes["fleet_max_workers"] = int(
+                os.environ["DAFT_FLEET_MAX_WORKERS"])
+        if os.environ.get("DAFT_FLEET_COOLDOWN_S"):
+            changes["fleet_cooldown_s"] = float(
+                os.environ["DAFT_FLEET_COOLDOWN_S"])
         if os.environ.get("DAFT_SPECULATION") in ("1", "true"):
             changes["speculative_execution"] = True
         if os.environ.get("DAFT_COMPUTE_THREADS"):

@@ -28,6 +28,13 @@ Injection points wired in the engine:
                        (ctx: ``query_id``, ``tenant``) — exercises the
                        front-door queue itself (execution/admission.py);
                        an injected failure must leave no queue slot behind
+``fleet.drain``        the fleet controller starting a graceful drain
+                       (ctx: ``worker``) — ``kill`` crashes the worker
+                       MID-drain, which must fall back to normal lineage
+                       recovery byte-identically (distributed/fleet.py)
+``worker.launch``      the fleet controller launching a scale-up worker —
+                       a raised fault must leave the fleet consistent and
+                       be retried by a later controller tick
 ==================== =======================================================
 
 Every injection point is ALSO a cooperative-cancellation observation point:
@@ -71,6 +78,8 @@ KNOWN_POINTS = (
     "daemon.heartbeat",
     "io.circuit",
     "admission.enqueue",
+    "fleet.drain",
+    "worker.launch",
 )
 
 _ACTIONS = ("raise", "raise_transient", "raise_worker_died", "delay", "kill",

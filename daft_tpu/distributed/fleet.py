@@ -1,0 +1,655 @@
+"""Elastic worker fleet: SLO-driven autoscaling with graceful drain.
+
+The platform's earlier PRs built four write-mostly telemetry planes —
+admission queue depth + shed level (execution/admission.py), per-tenant SLO
+burn rates (slo.py), the drains-to-zero byte ledger (execution/memledger.py)
+and shuffle locality/inflight maps (distributed/scheduler.py). This module
+closes the loop: a :class:`FleetController` reads those planes every
+``fleet_tick_interval_s`` and drives the worker set between
+``fleet_min_workers`` and ``fleet_max_workers``, with hysteresis (a drain
+needs ``fleet_idle_ticks`` consecutive calm ticks) and a cooldown
+(``fleet_cooldown_s`` between membership changes) so the fleet never
+flaps on a noisy signal.
+
+Reference discipline: production serving stacks scale TPU serving replicas
+to load ("Fine-Tuning and Serving Gemma 4 31B on Google Cloud TPU",
+PAPERS.md), and the dynamic-cluster membership of "TensorFlow: A system for
+large-scale machine learning" (PAPERS.md) — planned departure must be a
+cheap, leak-free, ROUTINE operation, not a recovery event.
+
+Scale-up
+--------
+Any of the pressure signals trips a launch (reason names the dominant one):
+
+==================  ====================================================
+``queue-pressure``  admission queue depth > ``fleet_up_queue_frac`` x
+                    fleet slot capacity
+``shed-level``      the admission overload ladder is shedding (level > 0)
+``slo-burn``        any tenant's fast-window burn rate >
+                    ``fleet_up_burn_rate``
+``inflight``        fleet-wide inflight/slots > ``fleet_up_inflight_frac``
+``memory-pressure`` ledger-held bytes > ``fleet_up_memory_frac`` x
+                    ``memory_limit_bytes`` (when a limit is set)
+==================  ====================================================
+
+A scale-up REACTIVATES a draining worker first (cheapest capacity: its
+data never left), and only then launches through the worker factory —
+behind the ``worker.launch`` fault point, so chaos tests can fail a launch
+and prove the controller retries on a later tick.
+
+Graceful drain — the robustness heart
+-------------------------------------
+Drain is a first-class state machine owned by WorkerManager::
+
+    active ──begin_drain──▶ draining ──finish_drain──▶ drained ──release──▶ released
+       ▲                       │  ▲                        │
+       └─────reactivate────────┘  └──────reactivate────────┘
+    (``dead`` is orthogonal: a crash at ANY state wins and falls back to
+     normal lineage recovery.)
+
+While ``draining`` the scheduler stops placing new tasks on the worker
+(soft locality/affinity yield; hard affinity migrates via
+``recovery_clone`` in the migration step), running tasks finish or — after
+``fleet_drain_timeout_s`` — the worker is killed into the ordinary
+crash-recovery path. Then every live lineage-tracked partition and shuffle
+chunk file the worker holds is migrated to a surviving worker under the
+SAME tickets (planner.DistributedExecutor.migrate_worker), and the drain
+must pass BOTH leak audits before release:
+
+* ``audit()`` of the worker's shuffle cache reads zero chunk files, and
+* the memory-ledger sentinel query charged for the migration copies
+  finishes with zero residual bytes.
+
+A drain that leaks is a FAILED drain: the worker re-activates and the
+failure lands in the event log. Every membership change emits
+``WorkerLaunched`` / ``WorkerDrainStarted`` / ``WorkerDrained`` /
+``ScaleDecision`` events (with the triggering signal snapshot), the
+``daft_fleet_*`` metrics, and a record in the querylog fleet ring — so
+every scale event is attributable after the fact.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+from daft_tpu.distributed.faults import maybe_inject
+from daft_tpu.distributed.worker import (
+    STATE_DRAINING,
+    Worker,
+    WorkerManager,
+)
+
+_log = logging.getLogger("daft_tpu.fleet")
+
+#: Ledger sentinel prefix for drain-migration accounting: the copy bytes are
+#: charged under this query id and must drain to zero residual — the second
+#: leg of the dual drain audit.
+_DRAIN_QUERY_PREFIX = "__fleet_drain__"
+
+
+def _notify(event) -> None:
+    from daft_tpu.context import get_context
+
+    try:
+        get_context().notify(event)
+    except Exception:
+        _log.debug("fleet event notify failed", exc_info=True)
+
+
+def _metrics_enabled():
+    from daft_tpu import metrics
+
+    return metrics if metrics.get_registry().enabled else None
+
+
+# --------------------------------------------------------------------- #
+# Controller registry (dashboard surface)                                 #
+# --------------------------------------------------------------------- #
+_active_controller: Optional["FleetController"] = None
+_registry_lock = threading.Lock()
+
+
+def get_active_controller() -> Optional["FleetController"]:
+    """The process's live controller, if any (dashboard /api/fleet)."""
+    with _registry_lock:
+        return _active_controller
+
+
+def _set_active_controller(ctrl: Optional["FleetController"]) -> None:
+    global _active_controller
+    with _registry_lock:
+        _active_controller = ctrl
+
+
+class FleetController:
+    """Closed-loop membership controller over a :class:`WorkerManager`.
+
+    ``factory`` mints a new Worker per scale-up (defaults to the manager's
+    autoscale factory); tests drive :meth:`tick` directly instead of
+    starting the background thread, exactly like HeartbeatMonitor's
+    ``probe_once`` discipline."""
+
+    def __init__(self, manager: WorkerManager, cfg,
+                 factory: Optional[Callable[[], Worker]] = None):
+        self.manager = manager
+        self.cfg = cfg
+        self.factory = factory if factory is not None \
+            else getattr(manager, "_factory", None)
+        self.min_workers = max(int(getattr(cfg, "fleet_min_workers", 1)), 1)
+        self.max_workers = max(int(getattr(cfg, "fleet_max_workers", 8)),
+                               self.min_workers)
+        self.cooldown_s = float(getattr(cfg, "fleet_cooldown_s", 5.0))
+        self.idle_ticks_needed = max(int(getattr(cfg, "fleet_idle_ticks", 3)), 1)
+        self.drain_timeout_s = float(getattr(cfg, "fleet_drain_timeout_s", 30.0))
+        self._tick_interval_s = float(getattr(cfg, "fleet_tick_interval_s", 0.5))
+        self._calm_ticks = 0
+        self._last_scale_t = 0.0  # epoch of the last membership change
+        self._drain_seq = 0
+        self._aliases: List[str] = []  # cache aliases registered on release
+        self._lock = threading.RLock()
+        self._stop_evt = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        manager.attach_fleet(self)
+        _set_active_controller(self)
+        self._update_gauges()
+
+    # -- lifecycle ------------------------------------------------------ #
+    def start(self) -> "FleetController":
+        if self._thread is None:
+            self._thread = threading.Thread(target=self._loop, daemon=True,
+                                            name="daft-fleet-controller")
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop_evt.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=2.0)
+            self._thread = None
+        if get_active_controller() is self:
+            _set_active_controller(None)
+        # Cache aliases registered for released workers die with the
+        # controller — they only existed to serve refs minted before the
+        # drain's replacements propagated.
+        from daft_tpu.distributed.shuffle import unregister_local_cache
+
+        for wid in self._aliases:
+            unregister_local_cache(wid)
+        self._aliases.clear()
+
+    def _loop(self) -> None:
+        while not self._stop_evt.wait(self._tick_interval_s):
+            try:
+                self.tick()
+            except Exception:
+                # A crashing control loop silently FREEZES the fleet — keep
+                # that loud, then keep ticking.
+                _log.warning("fleet controller tick crashed", exc_info=True)
+
+    # -- signal plane --------------------------------------------------- #
+    def signals(self) -> Dict[str, float]:
+        """One joint read of every telemetry plane the decision uses."""
+        sig: Dict[str, float] = {"queued": 0.0, "shed_level": 0.0,
+                                 "burn_rate": 0.0, "inflight": 0.0,
+                                 "slots": 0.0, "mem_frac": 0.0,
+                                 "workers": 0.0}
+        try:
+            from daft_tpu.execution import admission
+
+            totals = admission.get_controller().totals()
+            sig["queued"] = float(totals.get("queued", 0) or 0)
+            sig["shed_level"] = float(totals.get("shed_level", 0) or 0)
+        except Exception:
+            _log.debug("fleet: admission signals unavailable", exc_info=True)
+        try:
+            from daft_tpu import slo
+
+            rows = slo.get_tracker().snapshot(self.cfg) or []
+            sig["burn_rate"] = max(
+                (float(r.get("fast_burn_rate", 0.0) or 0.0) for r in rows),
+                default=0.0)
+        except Exception:
+            _log.debug("fleet: slo signals unavailable", exc_info=True)
+        try:
+            limit = getattr(self.cfg, "memory_limit_bytes", None)
+            if limit:
+                from daft_tpu.execution import memledger
+
+                sig["mem_frac"] = (memledger.get_ledger().total_held()
+                                   / float(limit))
+        except Exception:
+            _log.debug("fleet: ledger signals unavailable", exc_info=True)
+        workers = self.manager.placeable_workers()
+        sig["workers"] = float(len(workers))
+        sig["slots"] = float(sum(w.num_slots for w in workers))
+        try:
+            sig["inflight"] = float(sum(w.active_tasks() for w in workers))
+        except Exception:
+            _log.debug("fleet: inflight read failed", exc_info=True)
+        return sig
+
+    def decide(self, sig: Dict[str, float]) -> Tuple[str, str]:
+        """Pure policy: map a signal snapshot to ``(direction, reason)``
+        with direction in ``up`` / ``down`` / ``hold``. Hysteresis state
+        (`_calm_ticks`) advances here; cooldown is enforced by the caller."""
+        cfg = self.cfg
+        capacity = max(sig["slots"], 1.0)
+        n = int(sig["workers"])
+        pressure: Optional[str] = None
+        if sig["shed_level"] > 0:
+            pressure = "shed-level"
+        elif sig["queued"] > getattr(cfg, "fleet_up_queue_frac", 0.25) * capacity:
+            pressure = "queue-pressure"
+        elif sig["burn_rate"] > getattr(cfg, "fleet_up_burn_rate", 1.0):
+            pressure = "slo-burn"
+        elif sig["inflight"] > getattr(cfg, "fleet_up_inflight_frac", 0.9) * capacity:
+            pressure = "inflight"
+        elif sig["mem_frac"] > getattr(cfg, "fleet_up_memory_frac", 0.85):
+            pressure = "memory-pressure"
+        if pressure is not None:
+            self._calm_ticks = 0
+            if n < self.max_workers or self.manager.draining_ids():
+                return ("up", pressure)
+            return ("hold", pressure)
+        # Calm: only drain once the fleet has an entirely idle worker to
+        # give back AND the calm has persisted (hysteresis).
+        if n <= self.min_workers:
+            self._calm_ticks = 0
+            return ("hold", "at-min")
+        idle_exists = any(w.active_tasks() == 0
+                          for w in self.manager.placeable_workers())
+        if not idle_exists or sig["queued"] > 0 or sig["inflight"] > 0:
+            self._calm_ticks = 0
+            return ("hold", "busy")
+        self._calm_ticks += 1
+        if self._calm_ticks < self.idle_ticks_needed:
+            return ("hold", "hysteresis")
+        return ("down", "idle")
+
+    # -- control loop --------------------------------------------------- #
+    def tick(self) -> Tuple[str, str]:
+        """One decision round. Returns the ``(direction, reason)`` acted
+        on (``hold`` when nothing changed)."""
+        with self._lock:
+            sig = self.signals()
+            direction, reason = self.decide(sig)
+            now = time.monotonic()
+            in_cooldown = (self._last_scale_t
+                           and now - self._last_scale_t < self.cooldown_s)
+            acted = False
+            if direction == "up":
+                # A load spike INTERRUPTS in-flight drains before anything
+                # else — reactivation beats both the cooldown (it is an
+                # abort, not a new scale event) and a fresh launch.
+                if self._reactivate_one(reason):
+                    acted = True
+                elif not in_cooldown:
+                    acted = self.scale_up(reason)
+                else:
+                    direction = "hold"
+            elif direction == "down":
+                if in_cooldown:
+                    direction = "hold"
+                else:
+                    acted = self.drain_one(reason)
+            if acted:
+                self._last_scale_t = now
+                self._calm_ticks = 0
+            elif direction != "hold":
+                direction = "hold"
+            self._record_decision(direction, reason, sig)
+            self._update_gauges()
+            return (direction, reason)
+
+    def _record_decision(self, direction: str, reason: str,
+                         sig: Dict[str, float]) -> None:
+        from daft_tpu import querylog
+        from daft_tpu.subscribers.events import ScaleDecision
+
+        workers = int(sig.get("workers", 0))
+        if direction != "hold":
+            _notify(ScaleDecision(direction=direction, reason=reason,
+                                  workers=workers, signal=dict(sig)))
+            querylog.record_fleet_event("scale-decision", direction=direction,
+                                        reason=reason, workers=workers,
+                                        signal=dict(sig))
+
+    # -- scale up ------------------------------------------------------- #
+    def _reactivate_one(self, reason: str) -> bool:
+        from daft_tpu import querylog
+        from daft_tpu.subscribers.events import WorkerLaunched
+
+        for wid in sorted(self.manager.draining_ids()):
+            if self.manager.reactivate(wid):
+                w = self.manager.get(wid)
+                slots = w.num_slots if w is not None else 0
+                _notify(WorkerLaunched(worker_id=wid, reason=reason,
+                                       num_slots=slots, reactivated=True))
+                querylog.record_fleet_event("drain-interrupted",
+                                            worker_id=wid, reason=reason)
+                m = _metrics_enabled()
+                if m:
+                    m.FLEET_SCALE_EVENTS.labels("up", "drain-interrupted").inc()
+                self._update_gauges()
+                return True
+        return False
+
+    def scale_up(self, reason: str = "manual") -> bool:
+        """Launch one worker through the factory (fault point:
+        ``worker.launch``). Returns True when the fleet grew."""
+        from daft_tpu import querylog
+        from daft_tpu.subscribers.events import WorkerLaunched
+
+        if self.factory is None:
+            return False
+        if len(self.manager.placeable_workers()) >= self.max_workers:
+            return False
+        m = _metrics_enabled()
+        try:
+            maybe_inject("worker.launch", reason=reason)
+            w = self.factory()
+        except Exception:
+            _log.warning("fleet: worker launch failed (reason=%s)", reason,
+                         exc_info=True)
+            querylog.record_fleet_event("launch-failed", reason=reason)
+            if m:
+                m.FLEET_SCALE_EVENTS.labels("up", "launch-failed").inc()
+            return False
+        self.manager.add_worker(w)
+        _notify(WorkerLaunched(worker_id=w.worker_id, reason=reason,
+                               num_slots=w.num_slots, reactivated=False))
+        querylog.record_fleet_event("worker-launched", worker_id=w.worker_id,
+                                    reason=reason, num_slots=w.num_slots)
+        if m:
+            m.FLEET_SCALE_EVENTS.labels("up", reason).inc()
+        self._update_gauges()
+        _log.info("fleet: launched %s (%s)", w.worker_id, reason)
+        return True
+
+    # -- scale down (graceful drain) ------------------------------------ #
+    def _pick_drain_candidate(self) -> Optional[str]:
+        """Idle-most placeable worker; never the last ``min_workers``."""
+        workers = self.manager.placeable_workers()
+        if len(workers) <= self.min_workers:
+            return None
+        try:
+            w = min(workers, key=lambda w: (w.active_tasks(), w.worker_id))
+        except ValueError:
+            return None
+        return w.worker_id
+
+    def drain_one(self, reason: str = "idle") -> bool:
+        wid = self._pick_drain_candidate()
+        if wid is None:
+            return False
+        return self.drain_worker(wid, reason=reason)
+
+    def drain_worker(self, worker_id: str, reason: str = "idle") -> bool:
+        """Run the full graceful-drain lifecycle against ``worker_id``.
+
+        active → draining (scheduler stops placing) → running tasks finish
+        (or timeout-kill into crash recovery) → migrate lineage partitions
+        + shuffle chunks → dual leak audit → drained → released. Any
+        failure re-activates the worker (a leaking drain is a FAILED
+        drain). Returns True only after a clean release."""
+        from daft_tpu import querylog
+        from daft_tpu.subscribers.events import WorkerDrained, WorkerDrainStarted
+
+        mgr = self.manager
+        w = mgr.get(worker_id)
+        if w is None or not mgr.begin_drain(worker_id):
+            return False
+        t0 = time.monotonic()
+        m = _metrics_enabled()
+        active0 = 0
+        try:
+            active0 = w.active_tasks()
+        # daftlint: disable=DTL002 -- observability read on a possibly-crashed worker; drain proceeds with active0=0
+        except Exception:
+            pass
+        _notify(WorkerDrainStarted(worker_id=worker_id, reason=reason,
+                                   active_tasks=active0))
+        querylog.record_fleet_event("drain-started", worker_id=worker_id,
+                                    reason=reason, active_tasks=active0)
+        self._update_gauges()
+        try:
+            # Chaos hook: the ``kill`` action crashes the worker MID-drain —
+            # the drain must abort and the loss fall back to the ordinary
+            # crash-recovery path, byte-identically.
+            maybe_inject("fleet.drain", worker=w)
+            if not self._await_quiesce(w):
+                return self._drain_failed(worker_id, reason, "quiesce", t0)
+            if mgr.is_dead(worker_id) \
+                    or mgr.worker_state(worker_id) != STATE_DRAINING:
+                # Killed mid-drain (crash recovery owns it now) or
+                # reactivated by a load spike: either way this drain is over.
+                return self._drain_failed(worker_id, reason, "interrupted", t0)
+            migrated, nbytes, failures = self._migrate(worker_id)
+            if failures:
+                _log.warning("fleet: drain of %s failed migration: %s",
+                             worker_id, failures)
+                return self._drain_failed(worker_id, reason, "migration", t0)
+            if not self._audit_clean(worker_id):
+                return self._drain_failed(worker_id, reason, "leak-audit", t0)
+            if not mgr.finish_drain(worker_id):
+                return self._drain_failed(worker_id, reason, "interrupted", t0)
+            released = mgr.release_worker(worker_id)
+            if released is None:
+                return self._drain_failed(worker_id, reason, "interrupted", t0)
+            self._release(released)
+            duration = time.monotonic() - t0
+            _notify(WorkerDrained(worker_id=worker_id, duration_s=duration,
+                                  migrated_partitions=migrated,
+                                  migrated_bytes=nbytes))
+            querylog.record_fleet_event(
+                "worker-drained", worker_id=worker_id, reason=reason,
+                duration_s=duration, migrated_partitions=migrated,
+                migrated_bytes=nbytes)
+            if m:
+                m.FLEET_SCALE_EVENTS.labels("down", reason).inc()
+                m.FLEET_DRAIN_SECONDS.observe(duration)
+            self._update_gauges()
+            _log.info("fleet: drained %s in %.2fs (%d partitions, %d bytes)",
+                      worker_id, duration, migrated, nbytes)
+            return True
+        except Exception:
+            _log.warning("fleet: drain of %s crashed", worker_id,
+                         exc_info=True)
+            self._drain_failed(worker_id, reason, "error", t0)
+            raise
+
+    def _await_quiesce(self, w: Worker) -> bool:
+        """Wait for the worker's running tasks to finish. On timeout the
+        worker is KILLED — the issue's contract: tasks that won't drain
+        time out into the normal lineage-recovery path."""
+        deadline = time.monotonic() + self.drain_timeout_s
+        while True:
+            if self.manager.is_dead(w.worker_id):
+                return False
+            try:
+                # Liveness probe, not just task-count: a worker that
+                # CRASHES mid-drain (chaos ``fleet.drain:kill``) may report
+                # zero active tasks while its data is already unreachable —
+                # draining it "cleanly" would release a corpse and strand
+                # its partitions. A failed heartbeat hands the worker to
+                # ordinary crash recovery instead.
+                if not w.heartbeat():
+                    self.manager.mark_dead(w.worker_id, reason="drain-crash")
+                    return False
+                if w.active_tasks() == 0:
+                    return True
+            # daftlint: disable=DTL002 -- not swallowed: a raising probe IS the crash signal, classified as drain-crash and handed to lineage recovery
+            except Exception:
+                self.manager.mark_dead(w.worker_id, reason="drain-crash")
+                return False
+            if self._stop_evt.is_set():
+                return False
+            if time.monotonic() >= deadline:
+                _log.warning("fleet: drain of %s timed out with tasks "
+                             "running; killing into crash recovery",
+                             w.worker_id)
+                try:
+                    w.kill()
+                # daftlint: disable=DTL002 -- kill of an already-wedged worker is best-effort; mark_dead below routes it to recovery either way
+                except Exception:
+                    pass
+                self.manager.mark_dead(w.worker_id, reason="drain-timeout")
+                return False
+            time.sleep(0.01)
+
+    def _migrate(self, worker_id: str) -> Tuple[int, int, List[str]]:
+        """Move the worker's lineage partitions + chunk files to the
+        least-loaded surviving worker, charging the copy bytes to the
+        ledger drain sentinel (released before the audit — residual must
+        read zero)."""
+        from daft_tpu.distributed.planner import active_executors
+        from daft_tpu.execution import memledger
+
+        target = self._pick_target(worker_id)
+        self._last_target = target
+        migrated = 0
+        nbytes = 0
+        failures: List[str] = []
+        self._drain_seq += 1
+        sentinel = f"{_DRAIN_QUERY_PREFIX}/{worker_id}/{self._drain_seq}"
+        ledger = None
+        try:
+            ledger = memledger.get_ledger()
+        # daftlint: disable=DTL002 -- the ledger plane is optional (DAFT_MEMLEDGER=0); migration proceeds without the sentinel audit
+        except Exception:
+            pass
+        for ex in active_executors():
+            if ex.manager is not self.manager:
+                continue
+            try:
+                out = ex.migrate_worker(worker_id, target)
+            except Exception as e:
+                failures.append(f"{ex.query_id or 'executor'}: {e}")
+                continue
+            migrated += out["migrated_partitions"]
+            nbytes += out["migrated_bytes"]
+            failures.extend(out["failed"])
+        if ledger is not None and nbytes:
+            # The migration's transient copy footprint flows through the
+            # byte ledger like any other shuffle traffic; finish_query in
+            # the audit step proves it drained to zero.
+            ledger.charge(sentinel, "fleet-drain-copy", nbytes,
+                          kind=memledger.KIND_SHUFFLE)
+            ledger.release(sentinel, "fleet-drain-copy", nbytes,
+                           kind=memledger.KIND_SHUFFLE)
+        self._last_sentinel = sentinel
+        return migrated, nbytes, failures
+
+    def _pick_target(self, worker_id: str) -> Optional[Worker]:
+        candidates = [w for w in self.manager.placeable_workers()
+                      if w.worker_id != worker_id]
+        if not candidates:
+            return None
+        return min(candidates, key=lambda w: (w.active_tasks(), w.worker_id))
+
+    def _audit_clean(self, worker_id: str) -> bool:
+        """The dual drain audit: the departing worker's shuffle cache holds
+        zero chunk files AND the ledger drain sentinel drained to zero."""
+        from daft_tpu.distributed.shuffle import local_cache_for
+        from daft_tpu.execution import memledger
+
+        cache = local_cache_for(worker_id)
+        if cache is not None:
+            a = cache.audit()
+            if a["files"]:
+                _log.warning("fleet: drain audit of %s found %d leaked "
+                             "chunk files: %s", worker_id, a["files"],
+                             a["queries"])
+                return False
+        sentinel = getattr(self, "_last_sentinel", "")
+        if sentinel:
+            try:
+                ledger = memledger.get_ledger()
+                res = ledger.finish_query(sentinel)
+                if res and res.get("residual_bytes"):
+                    _log.warning(
+                        "fleet: drain audit of %s found %d residual "
+                        "ledger bytes", worker_id, res["residual_bytes"])
+                    return False
+            except Exception:
+                _log.debug("fleet: ledger audit unavailable", exc_info=True)
+        return True
+
+    def _release(self, w: Worker) -> None:
+        """Shut the released worker down, then alias its worker id to the
+        surviving cache holding its migrated chunks — refs minted before
+        the drain's replacements propagated still fetch by the OLD worker
+        id, and the alias serves them without a recovery round-trip.
+        (Registered after shutdown: LocalWorker.shutdown unregisters its
+        own id, which would otherwise remove the alias.)"""
+        from daft_tpu.distributed.shuffle import (
+            local_cache_for,
+            register_local_cache,
+        )
+
+        # The alias must point at the cache that RECEIVED the migrated
+        # chunks — the migration target, not a fresh pick.
+        target = getattr(self, "_last_target", None) \
+            or self._pick_target(w.worker_id)
+        try:
+            w.shutdown()
+        except Exception:
+            _log.debug("fleet: released-worker shutdown failed",
+                       exc_info=True)
+        if target is not None:
+            tcache = local_cache_for(target.worker_id)
+            if tcache is not None:
+                register_local_cache(w.worker_id, tcache)
+                self._aliases.append(w.worker_id)
+
+    def _drain_failed(self, worker_id: str, reason: str, stage: str,
+                      t0: float) -> bool:
+        from daft_tpu import querylog
+
+        reactivated = self.manager.reactivate(worker_id)
+        querylog.record_fleet_event(
+            "drain-failed", worker_id=worker_id, reason=reason, stage=stage,
+            reactivated=reactivated,
+            duration_s=time.monotonic() - t0)
+        m = _metrics_enabled()
+        if m:
+            m.FLEET_SCALE_EVENTS.labels("down", "drain-failed").inc()
+        self._update_gauges()
+        return False
+
+    # -- observability -------------------------------------------------- #
+    def _update_gauges(self) -> None:
+        m = _metrics_enabled()
+        if not m:
+            return
+        for state, n in self.manager.counts_by_state().items():
+            m.FLEET_WORKERS.labels(state).set(n)
+
+    def snapshot(self) -> dict:
+        """Dashboard surface (/api/fleet)."""
+        from daft_tpu import querylog
+
+        counts = self.manager.counts_by_state()
+        per_worker = []
+        for w in self.manager.workers():
+            try:
+                inflight = w.active_tasks()
+            # daftlint: disable=DTL002 -- dashboard read of a possibly-dead worker degrades to -1, never breaks /api/fleet
+            except Exception:
+                inflight = -1
+            per_worker.append({"worker_id": w.worker_id,
+                               "state": self.manager.worker_state(w.worker_id),
+                               "slots": w.num_slots,
+                               "inflight": inflight})
+        return {"enabled": True,
+                "min_workers": self.min_workers,
+                "max_workers": self.max_workers,
+                "cooldown_s": self.cooldown_s,
+                "counts": counts,
+                "workers": per_worker,
+                "signals": self.signals(),
+                "events": querylog.recent_fleet_events(50)}

@@ -20,7 +20,9 @@ Workers only see local physical plans; only PartitionRefs move between hosts.
 from __future__ import annotations
 
 import copy
+import dataclasses
 import threading
+import weakref
 from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -64,6 +66,7 @@ class LineageTracker:
         self._outputs: dict = {}       # id(task) -> List[weakref to ref]
         self._replacement: dict = {}   # id(lost ref) -> replacement ref
         self._replaced_keep: list = [] # lost refs w/ replacements (budget-bounded)
+        self._aux_wrefs: list = []     # keeps inherit_producer weakrefs alive
 
     def record(self, task: Task, outputs: List[PartitionRef]) -> None:
         import weakref
@@ -115,6 +118,44 @@ class LineageTracker:
         # recycled. Bounded by max_partition_recoveries per query.
         self._replaced_keep.append(old)
 
+    def live_refs(self) -> List[PartitionRef]:
+        """Every still-living tracked output ref (deduped): the surface a
+        fleet drain walks to find partitions hosted on a departing worker."""
+        seen: dict = {}
+        for wrefs in list(self._outputs.values()):
+            for wr in wrefs:
+                ref = wr()
+                if ref is not None:
+                    seen[id(ref)] = ref
+        return list(seen.values())
+
+    def inherit_producer(self, old: PartitionRef, new: PartitionRef) -> None:
+        """A replacement minted WITHOUT a recompute (drain migration copies
+        the bytes instead) inherits the original's producer, so losing the
+        migrated copy later still recovers through lineage."""
+        prod = self._producer.get(id(old))
+        if prod is None:
+            return
+        key = id(new)
+        self._producer[key] = prod
+        try:
+            # The weakref object itself must stay reachable or its cleanup
+            # callback never fires.
+            self._aux_wrefs.append(
+                weakref.ref(new, lambda _, k=key: self._producer.pop(k, None)))
+        except TypeError:
+            self._replaced_keep.append(new)
+
+
+#: Live executors in this process (weak): the fleet controller walks their
+#: lineage during a graceful drain to migrate partitions off the departing
+#: worker before its release.
+_active_executors: "weakref.WeakSet" = weakref.WeakSet()
+
+
+def active_executors() -> List["DistributedExecutor"]:
+    return list(_active_executors)
+
 
 class DistributedExecutor:
     def __init__(self, manager: WorkerManager, cfg, query_id: str = "",
@@ -130,8 +171,17 @@ class DistributedExecutor:
                                      cancel_token=cancel_token)
         self._recoveries = 0
         self._recovery_lock = threading.Lock()
+        # Serializes lineage REPAIR (crash recovery) against drain MIGRATION:
+        # a WorkerLost recovery racing a drain that already migrated the same
+        # partitions must observe the migration's replacements and swap
+        # instead of recomputing — holding this across both bodies is the
+        # drain-vs-kill dedupe. RLock: recovery re-enters itself through
+        # nested dispatch on the same thread (cascading loss), and a drain
+        # recomputing non-copyable refs calls recovery under the same lock.
+        self._repair_lock = threading.RLock()
         self._shared_ids: set = set()
         self._subplan_cache: dict = {}
+        _active_executors.add(self)
 
     # ------------------------------------------------------------------ #
     def execute(self, plan: pp.PhysicalPlan) -> List[PartitionRef]:
@@ -165,7 +215,16 @@ class DistributedExecutor:
         """Dispatcher hook: repair ``task.inputs`` after a fetch failure by
         recomputing the lost partitions' producer tasks on live workers.
         Returns False when lineage is unknown or the per-query recovery
-        budget is spent; True after swapping repaired refs in-place."""
+        budget is spent; True after swapping repaired refs in-place.
+
+        Runs under ``_repair_lock``: a recovery racing a fleet drain that
+        already migrated the lost partitions must see the drain's
+        replacements (and swap, not recompute) — the descriptor-level
+        dedupe that keeps drain-then-kill from recovering twice."""
+        with self._repair_lock:
+            return self._recover_task_inputs_locked(task, lost)
+
+    def _recover_task_inputs_locked(self, task: Task, lost: List[dict]) -> bool:
         from daft_tpu.context import get_context
         from daft_tpu.subscribers.events import PartitionRecovered
 
@@ -250,6 +309,94 @@ class DistributedExecutor:
                 if not self._recover_task_inputs(carrier, e.lost):
                     raise DaftExecutionError(
                         f"query output partition unrecoverable: {e}") from e
+
+    # -- fleet drain migration --------------------------------------------- #
+    def migrate_worker(self, worker_id: str, target_worker=None) -> dict:
+        """Graceful-drain hook (distributed/fleet.py): move every live
+        lineage-tracked partition hosted on ``worker_id`` somewhere that
+        outlives it, WITHOUT changing any consumer-visible identity.
+
+        Three strategies by ref type:
+
+        * LocalPartitionRef — the data is an in-process object that merely
+          CARRIES the worker's id for locality; re-register a copy with no
+          location (the drain makes locality toward the worker meaningless).
+        * ShufflePartitionRef with a reachable local cache — copy its chunk
+          files into ``target_worker``'s cache under the SAME tickets
+          (ShuffleCache.migrate_partition) and point lineage at a ref
+          addressed to the target.
+        * anything else (remote flight ref, cache already gone) — recompute
+          through the normal lineage-recovery machinery; descriptors carry
+          ``worker_id=None`` so a GRACEFUL departure never marks the worker
+          dead.
+
+        Every replacement lands in ``lineage.replace`` under
+        ``_repair_lock``, so a concurrent WorkerLost recovery swaps instead
+        of recomputing. Returns ``{"migrated_partitions", "migrated_bytes",
+        "recomputed", "failed"}`` — a non-empty ``failed`` means the drain
+        must not release the worker."""
+        from daft_tpu.distributed.partition_ref import ShufflePartitionRef
+        from daft_tpu.distributed.shuffle import local_cache_for
+
+        out = {"migrated_partitions": 0, "migrated_bytes": 0,
+               "recomputed": 0, "failed": []}
+        with self._repair_lock:
+            refs = [r for r in self.lineage.live_refs()
+                    if r.location == worker_id
+                    and self.lineage.replacement(r) is r]
+            if not refs:
+                return out
+            src_cache = local_cache_for(worker_id)
+            target_cache = None
+            target_id = None
+            if target_worker is not None:
+                target_id = target_worker.worker_id
+                get_cache = getattr(target_worker, "_get_shuffle_cache", None)
+                if get_cache is not None:
+                    target_cache = get_cache()
+            recompute: List[PartitionRef] = []
+            for ref in refs:
+                if (isinstance(ref, ShufflePartitionRef)
+                        and src_cache is not None and target_cache is not None):
+                    try:
+                        files, nbytes = src_cache.migrate_partition(
+                            ref.ticket, target_cache)
+                    except KeyError:
+                        # Already torn down (query finished mid-drain):
+                        # nothing left on the worker to preserve.
+                        continue
+                    new = dataclasses.replace(ref, worker_id=target_id)
+                    self.lineage.inherit_producer(ref, new)
+                    self.lineage.replace(ref, new)
+                    out["migrated_partitions"] += 1
+                    out["migrated_bytes"] += nbytes
+                elif isinstance(ref, LocalPartitionRef):
+                    new = dataclasses.replace(ref, worker_id=None)
+                    self.lineage.inherit_producer(ref, new)
+                    self.lineage.replace(ref, new)
+                    out["migrated_partitions"] += 1
+                    out["migrated_bytes"] += ref.size_bytes()
+                else:
+                    recompute.append(ref)
+            if recompute:
+                # worker_id=None in the descriptors: recovery must NOT mark
+                # the draining worker dead — this is a planned departure.
+                carrier = Task(BoundInput(0, None), [list(recompute)])
+                carrier.query_id = self.query_id
+                lost = [{"slot": 0, "pos": i, "worker_id": None}
+                        for i in range(len(recompute))]
+                try:
+                    ok = self._recover_task_inputs(carrier, lost)
+                except Exception as e:
+                    ok = False
+                    out["failed"].append(f"recompute raised: {e}")
+                if ok:
+                    out["recomputed"] += len(recompute)
+                elif not out["failed"]:
+                    out["failed"].append(
+                        f"{len(recompute)} partition(s) not copyable and "
+                        f"not recomputable (no lineage or budget spent)")
+        return out
 
     def _chain_over(self, chain: List[pp.PhysicalPlan], leaf: pp.PhysicalPlan) -> pp.PhysicalPlan:
         """Rebuild a narrow chain (outermost first) over a new leaf."""

@@ -69,20 +69,33 @@ class Scheduler:
         workers = self.manager.workers()
         if not workers:
             raise DaftExecutionError("No live workers")
+        # Draining workers (fleet scale-down) accept no NEW tasks — filter
+        # them out exactly like exclusions, with the same never-strand
+        # fallback: if EVERY worker is draining (drain interrupted by load,
+        # controller about to reactivate) placement proceeds anyway.
+        placeable = [w for w in workers
+                     if self.manager.is_placeable(w.worker_id)] or workers
         # Exclusions (speculation re-placement) are honored only when an
         # alternative exists — never strand a task on an empty set.
-        candidates = [w for w in workers
-                      if not exclude or w.worker_id not in exclude] or workers
+        candidates = [w for w in placeable
+                      if not exclude or w.worker_id not in exclude] or placeable
         if task.strategy.kind == "affinity" and task.strategy.worker_id:
             w = self.manager.get(task.strategy.worker_id)
             if w is not None:
                 # Hard affinity is a placement CONTRACT (device/data
-                # residency) — it always wins, even over exclude. Soft
-                # affinity yields to an exclusion if any alternative exists.
+                # residency) — it always wins, even over exclude or a
+                # drain in progress (the drain migrates hard-pinned work
+                # off the worker via recovery_clone before release). Soft
+                # affinity yields to an exclusion OR a draining target if
+                # any alternative exists.
                 if not task.strategy.soft:
                     return w
-                if (not exclude or w.worker_id not in exclude
-                        or all(c.worker_id == w.worker_id for c in candidates)):
+                if (self.manager.is_placeable(w.worker_id)
+                        and (not exclude or w.worker_id not in exclude
+                             or all(c.worker_id == w.worker_id
+                                    for c in candidates))):
+                    return w
+                if all(c.worker_id == w.worker_id for c in candidates):
                     return w
             elif not task.strategy.soft:
                 raise DaftExecutionError(
@@ -96,14 +109,22 @@ class Scheduler:
         # the input (an even all-to-all exchange gains ~1/N from locality
         # but would pile every reducer onto one host) and must have a free
         # slot (a loaded holder yields to spread — Spark's locality-wait
-        # idea with load as the clock). Exclusion/death already filtered
-        # `candidates`, so speculation and worker loss degrade cleanly.
+        # idea with load as the clock). Exclusion/death/drain already
+        # filtered `candidates`, so speculation, worker loss and fleet
+        # scale-down degrade cleanly. When the MAJORITY holder itself was
+        # displaced (draining/excluded), locality spills to the next-best
+        # candidate holder instead of evaporating entirely — partial
+        # residency still beats a blind spread.
         locality = task.input_locality
         if locality:
             total = sum(locality.values())
             weighted = [(locality.get(w.worker_id, 0), w) for w in candidates]
             best_bytes = max((b for b, _ in weighted), default=0)
-            if best_bytes > 0 and best_bytes * 2 > total:
+            candidate_ids = {w.worker_id for w in candidates}
+            overall_best = max(locality, key=lambda wid: locality[wid])
+            displaced = (locality.get(overall_best, 0) * 2 > total
+                         and overall_best not in candidate_ids)
+            if best_bytes > 0 and (best_bytes * 2 > total or displaced):
                 top = [w for b, w in weighted if b == best_bytes]
                 free = [w for w in top if w.active_tasks() < w.num_slots]
                 if free:

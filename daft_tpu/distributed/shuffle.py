@@ -306,6 +306,55 @@ class ShuffleCache:
                     pass  # already gone (cleanup raced shutdown)
         return removed
 
+    def migrate_partition(self, ticket: str,
+                          target: "ShuffleCache") -> Tuple[int, int]:
+        """Move one partition's chunk files into ``target`` under the SAME
+        tickets (fleet drain: a released worker's shuffle state must outlive
+        it without changing a single ticket a reducer already holds). Chunk
+        files are copied byte-for-byte into the target root, registered
+        there under the source metadata, then dropped from this cache —
+        after which this cache's audit no longer counts them. Returns
+        ``(files_moved, logical_bytes_moved)``."""
+        import shutil
+
+        if target is self:
+            meta = self.partition_meta(ticket)
+            return (len(meta.chunks), meta.bytes_)
+        with self._lock:
+            meta = self._meta.get(ticket)
+            if meta is None:
+                raise KeyError(f"Unknown shuffle ticket {ticket!r}")
+            chunks = sorted(meta.chunks, key=lambda c: c.seq)
+            query_id = meta.query_id
+        moved_bytes = 0
+        for c in chunks:
+            dst = os.path.join(target.root, os.path.basename(c.path))
+            shutil.copy2(c.path, dst)
+            target._add_chunk(ticket, ChunkMeta(
+                ticket=c.ticket, path=dst, rows=c.rows, bytes_=c.bytes_,
+                file_bytes=c.file_bytes, codec=c.codec, seq=c.seq), query_id)
+            moved_bytes += c.bytes_
+        with target._lock:
+            # Future appends to the same (shuffle, bucket) on the target
+            # must mint seqs past everything that just arrived.
+            have = target._seq.get(ticket, 0)
+            target._seq[ticket] = max(have, (chunks[-1].seq + 1) if chunks
+                                      else 0)
+        with self._lock:
+            self._meta.pop(ticket, None)
+            self._seq.pop(ticket, None)
+            owned = self._by_query.get(query_id)
+            if owned is not None:
+                owned.discard(ticket)
+                if not owned:
+                    self._by_query.pop(query_id, None)
+        for c in chunks:
+            try:
+                os.unlink(c.path)
+            except OSError:
+                pass  # already gone (teardown raced the drain)
+        return (len(chunks), moved_bytes)
+
     def audit(self) -> dict:
         """Per-query live chunk-file counts — the zero-leak surface."""
         with self._lock:

@@ -260,6 +260,12 @@ class LocalWorker(Worker):
             cache = self._shuffle_cache
         return cache.release_query(query_id) if cache is not None else 0
 
+    def shuffle_cache(self):
+        """The worker's live chunk store, or None if it never wrote one
+        (fleet drains migrate its contents before release)."""
+        with self._lock:
+            return self._shuffle_cache
+
     def _write_shuffle_outputs(self, task: Task, parts, prof):
         """Flight-mode map output: chunk + compress each bucket through a
         ShuffleWriter; returns chunk-granular ShufflePartitionRefs (no
@@ -386,17 +392,33 @@ class LocalWorker(Worker):
             cache.cleanup()
 
 
+#: Membership states a worker moves through under fleet control
+#: (distributed/fleet.py). Workers default to ACTIVE; a graceful departure
+#: walks active -> draining -> drained -> released. ``dead`` is orthogonal
+#: (crash/heartbeat loss) and always wins.
+STATE_ACTIVE = "active"
+STATE_DRAINING = "draining"
+STATE_DRAINED = "drained"
+STATE_RELEASED = "released"
+
+
 class WorkerManager:
     """Tracks live workers; supports scale-up/down and death marking
-    (reference: worker.rs WorkerManager trait + try_autoscale/retire_idle)."""
+    (reference: worker.rs WorkerManager trait + try_autoscale/retire_idle),
+    plus the fleet membership state machine: ``begin_drain`` /
+    ``finish_drain`` / ``reactivate`` / ``release_worker`` move a worker
+    through active -> draining -> drained -> released, and the scheduler
+    only places NEW tasks on placeable (active) workers."""
 
     def __init__(self, workers: Optional[List[Worker]] = None,
                  factory: Optional[Callable[[], Worker]] = None):
         self._workers: Dict[str, Worker] = {w.worker_id: w for w in (workers or [])}
         self._factory = factory
         self._dead: set = set()
+        self._states: Dict[str, str] = {}  # absent = active
         self._lock = threading.Lock()
         self._monitor: Optional["HeartbeatMonitor"] = None
+        self._fleet = None  # attached FleetController (stopped on shutdown)
         # Death listeners (dispatcher wake-ups): called outside the lock on
         # every first-time mark_dead, so blocked wait loops notice an
         # asynchronously-detected death immediately instead of polling.
@@ -443,8 +465,113 @@ class WorkerManager:
         with self._lock:
             return worker_id in self._dead
 
+    # -- fleet membership state machine (distributed/fleet.py) ------------- #
+    def worker_state(self, worker_id: str) -> str:
+        """Membership state; dead workers report ``dead`` regardless."""
+        with self._lock:
+            if worker_id in self._dead:
+                return "dead"
+            return self._states.get(worker_id, STATE_ACTIVE)
+
+    def is_placeable(self, worker_id: str) -> bool:
+        """True when the scheduler may put NEW tasks on the worker."""
+        with self._lock:
+            return (worker_id in self._workers
+                    and worker_id not in self._dead
+                    and self._states.get(worker_id, STATE_ACTIVE)
+                    == STATE_ACTIVE)
+
+    def placeable_workers(self) -> List[Worker]:
+        with self._lock:
+            return [w for wid, w in self._workers.items()
+                    if wid not in self._dead
+                    and self._states.get(wid, STATE_ACTIVE) == STATE_ACTIVE]
+
+    def draining_ids(self) -> set:
+        with self._lock:
+            return {wid for wid, s in self._states.items()
+                    if s == STATE_DRAINING and wid not in self._dead}
+
+    def begin_drain(self, worker_id: str) -> bool:
+        """active -> draining. False if the worker is dead, missing, or
+        already past active."""
+        with self._lock:
+            if (worker_id not in self._workers or worker_id in self._dead
+                    or self._states.get(worker_id, STATE_ACTIVE)
+                    != STATE_ACTIVE):
+                return False
+            self._states[worker_id] = STATE_DRAINING
+            return True
+
+    def finish_drain(self, worker_id: str) -> bool:
+        """draining -> drained (tasks finished, migration audited clean)."""
+        with self._lock:
+            if (worker_id in self._dead
+                    or self._states.get(worker_id) != STATE_DRAINING):
+                return False
+            self._states[worker_id] = STATE_DRAINED
+            return True
+
+    def reactivate(self, worker_id: str) -> bool:
+        """draining/drained -> active: a failed (leaking) drain or a load
+        spike re-admits the worker to placement."""
+        with self._lock:
+            if (worker_id not in self._workers or worker_id in self._dead
+                    or self._states.get(worker_id)
+                    not in (STATE_DRAINING, STATE_DRAINED)):
+                return False
+            self._states.pop(worker_id, None)
+            return True
+
+    def release_worker(self, worker_id: str) -> Optional[Worker]:
+        """drained -> released: a PLANNED departure. The worker is
+        unregistered from the heartbeat monitor and the live set BEFORE its
+        sockets close, so the monitor can never misread the deliberate
+        departure as a silent death and log a spurious WorkerLost. Returns
+        the removed worker (caller shuts it down); None if the transition
+        is invalid."""
+        with self._lock:
+            if (worker_id in self._dead
+                    or self._states.get(worker_id) != STATE_DRAINED):
+                return None
+            w = self._workers.pop(worker_id, None)
+            if w is None:
+                return None
+            self._states[worker_id] = STATE_RELEASED
+            monitor = self._monitor
+        if monitor is not None:
+            monitor.forget(worker_id)
+        return w
+
+    def add_worker(self, worker: Worker) -> None:
+        """Register a newly-launched worker (fleet scale-up)."""
+        with self._lock:
+            self._workers[worker.worker_id] = worker
+            self._dead.discard(worker.worker_id)
+            self._states.pop(worker.worker_id, None)
+
+    def counts_by_state(self) -> Dict[str, int]:
+        """{state: count} over every worker this manager has seen —
+        released and dead included (the daft_fleet_workers gauge)."""
+        with self._lock:
+            counts = {STATE_ACTIVE: 0, STATE_DRAINING: 0, STATE_DRAINED: 0,
+                      STATE_RELEASED: 0, "dead": 0}
+            for wid in self._workers:
+                if wid in self._dead:
+                    counts["dead"] += 1
+                else:
+                    counts[self._states.get(wid, STATE_ACTIVE)] += 1
+            for wid, s in self._states.items():
+                if s == STATE_RELEASED and wid not in self._workers:
+                    counts[STATE_RELEASED] += 1
+            counts["dead"] += sum(1 for wid in self._dead
+                                  if wid not in self._workers)
+            return counts
+
     def total_slots(self) -> int:
-        return sum(w.num_slots for w in self.workers())
+        # Draining/drained workers finish what they have but accept no new
+        # tasks, so they no longer count as dispatch capacity.
+        return sum(w.num_slots for w in self.placeable_workers())
 
     def release_query(self, query_id: str) -> int:
         """Broadcast shuffle teardown for ``query_id`` to EVERY worker —
@@ -492,9 +619,24 @@ class WorkerManager:
             self._monitor.stop()
             self._monitor = None
 
+    # -- fleet attachment -------------------------------------------------- #
+    def attach_fleet(self, fleet) -> None:
+        """Bind a FleetController so manager shutdown stops it first (the
+        controller must not launch/drain against a closing worker set)."""
+        self._fleet = fleet
+
+    def fleet(self):
+        return self._fleet
+
     def shutdown(self) -> None:
         # Include dead-marked workers: a crashed ProcessWorker still needs its
         # subprocess reaped and socket closed.
+        fleet, self._fleet = self._fleet, None
+        if fleet is not None:
+            try:
+                fleet.stop()
+            except Exception:
+                _log.debug("fleet controller stop failed", exc_info=True)
         self.stop_heartbeat_monitor()
         with self._lock:
             all_workers = list(self._workers.values())
@@ -520,7 +662,14 @@ class HeartbeatMonitor:
 
     def stop(self) -> None:
         self._stop.set()
-        self._thread.join(timeout=2.0)
+        if self._thread.is_alive():
+            self._thread.join(timeout=2.0)
+
+    def forget(self, worker_id: str) -> None:
+        """Drop a deliberately released worker from the miss ledger BEFORE
+        its socket closes — a planned departure must never accumulate into
+        a heartbeat-timeout ``WorkerLost``."""
+        self._misses.pop(worker_id, None)
 
     def probe_once(self) -> None:
         """One probe round over all live workers (tests drive this directly

@@ -875,6 +875,21 @@ HEARTBEATS = _r.counter(
     "daft_worker_heartbeats_total", "Successful liveness probes",
     ("worker_id",))
 
+# Elastic fleet (distributed/fleet.py)
+FLEET_WORKERS = _r.gauge(
+    "daft_fleet_workers",
+    "Workers per membership state (active/draining/drained/released/dead)",
+    ("state",))
+FLEET_SCALE_EVENTS = _r.counter(
+    "daft_fleet_scale_events_total",
+    "Fleet membership changes, by direction (up/down) and triggering "
+    "reason (queue-pressure/slo-burn/shed-level/memory-pressure/inflight/"
+    "idle/launch-failed/drain-failed/drain-interrupted/manual)",
+    ("direction", "reason"))
+FLEET_DRAIN_SECONDS = _r.histogram(
+    "daft_fleet_drain_seconds",
+    "Graceful-drain duration from WorkerDrainStarted to release")
+
 # Admission control (execution/admission.py)
 ADMISSION_QUEUE_DEPTH = _r.gauge(
     "daft_admission_queue_depth",

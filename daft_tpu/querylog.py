@@ -595,6 +595,36 @@ def recent_queries(n: Optional[int] = None, tenant: Optional[str] = None,
     return get_recorder().recent(n=n, tenant=tenant, outcome=outcome)
 
 
+# --------------------------------------------------------------------- #
+# Fleet event ring (distributed/fleet.py)                                 #
+# --------------------------------------------------------------------- #
+# Membership changes are not queries, so they get their own bounded ring
+# instead of riding the schema-versioned per-query records: every scale
+# decision / launch / drain lands here with its triggering signal, making
+# "why did the fleet do that?" answerable after the fact.
+_FLEET_RING_CAP = 256
+_fleet_ring: deque = deque(maxlen=_FLEET_RING_CAP)
+_fleet_lock = threading.Lock()
+
+
+def record_fleet_event(kind: str, **fields) -> dict:
+    """Append one fleet membership event (``kind`` is ``scale-decision`` /
+    ``worker-launched`` / ``drain-started`` / ``worker-drained`` /
+    ``drain-failed`` / ``drain-interrupted``) to the bounded ring."""
+    rec = {"kind": kind, "ts": time.time(), **fields}
+    with _fleet_lock:
+        _fleet_ring.append(rec)
+    return rec
+
+
+def recent_fleet_events(n: Optional[int] = None) -> List[dict]:
+    """Newest-first slice of the fleet event ring."""
+    with _fleet_lock:
+        events = list(_fleet_ring)
+    events.reverse()
+    return events[:n] if n is not None else events
+
+
 def finish_entry(entry: Optional[FlightEntry],
                  error: Optional[BaseException] = None,
                  profile=None) -> None:

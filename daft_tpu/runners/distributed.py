@@ -72,8 +72,18 @@ class DistributedRunner(Runner):
                         except OSError:
                             pass  # already exited
 
-            self.manager = _DaemonManager(workers)
+            def _daemon_factory():
+                # Fleet scale-up for locally-spawned daemon clusters: mint a
+                # fresh daemon process; _DaemonManager.shutdown reaps it with
+                # the rest (procs is shared by closure).
+                p = spawn_local_daemon(slots=slots_per_worker)
+                procs.append(p)
+                return RemoteWorker(wait_for_daemon(p))
+
+            self.manager = _DaemonManager(
+                workers, factory=_daemon_factory if not addresses else None)
             self._start_heartbeat(cfg)
+            self._maybe_start_fleet(cfg)
             return
         if backend == "process":
             # True process isolation (reference: per-node Ray actors; on TPU
@@ -88,6 +98,22 @@ class DistributedRunner(Runner):
             self.manager = WorkerManager(
                 workers, factory=lambda: LocalWorker(num_slots=slots_per_worker)
             )
+        self._maybe_start_fleet(cfg)
+
+    def _maybe_start_fleet(self, cfg) -> None:
+        """Elastic fleet (DAFT_FLEET=1 / fleet_enabled): a FleetController
+        watching the telemetry planes drives this manager's worker set
+        between fleet_min_workers and fleet_max_workers. Factory-bearing
+        backends only — the controller must be able to mint workers. The
+        manager owns the controller's lifetime (stopped first in its
+        shutdown)."""
+        if not getattr(cfg, "fleet_enabled", False):
+            return
+        if getattr(self.manager, "_factory", None) is None:
+            return
+        from daft_tpu.distributed.fleet import FleetController
+
+        FleetController(self.manager, cfg).start()
 
     def _start_heartbeat(self, cfg) -> None:
         # Out-of-process workers can die silently; probe them so the

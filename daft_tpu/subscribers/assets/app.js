@@ -287,6 +287,42 @@ async function renderWorkers() {
   ).join("");
 }
 
+async function renderFleet() {
+  const f = await getJSON("/api/fleet");
+  const counts = Object.entries(f.counts || {})
+    .filter(([, n]) => n).map(([s, n]) => `${s}:${n}`).join(" · ");
+  $("#fleet-summary").innerHTML = f.enabled
+    ? `<span class="ok">controller live</span> · ${counts || "no workers"}` +
+      ` · min ${f.min_workers} / max ${f.max_workers}` +
+      ` · cooldown ${f.cooldown_s}s`
+    : `<span class="err">no controller</span> (static membership)`;
+  $("#fleet-workers tbody").innerHTML = (f.workers || []).map((w) =>
+    `<tr><td>${esc(w.worker_id)}</td>
+      <td class="${w.state === "active" ? "ok" : w.state === "dead" ? "err" : ""}">${esc(w.state)}</td>
+      <td>${w.slots}</td><td>${w.inflight}</td></tr>`
+  ).join("") || '<tr><td colspan="4" class="hint">no workers</td></tr>';
+  const s = f.signals || {};
+  $("#fleet-signals tbody").innerHTML = Object.keys(s).length
+    ? `<tr><td>${s.queued}</td>
+        <td class="${s.shed_level ? "err" : "ok"}">${s.shed_level}</td>
+        <td class="${s.burn_rate >= 1 ? "err" : "ok"}">${(s.burn_rate || 0).toFixed(2)}x</td>
+        <td>${s.inflight}</td><td>${s.slots}</td>
+        <td>${(100 * (s.mem_frac || 0)).toFixed(1)}%</td></tr>`
+    : '<tr><td colspan="6" class="hint">no signals</td></tr>';
+  const now = Date.now() / 1000;
+  $("#fleet-events tbody").innerHTML = (f.events || []).map((e) => {
+    const detail = Object.entries(e)
+      .filter(([k]) => !["kind", "ts", "worker_id", "reason"].includes(k))
+      .map(([k, v]) => `${k}=${typeof v === "object" ? JSON.stringify(v) : v}`)
+      .join(" ");
+    const bad = e.kind === "drain-failed" || e.kind === "launch-failed";
+    return `<tr><td>${(now - e.ts).toFixed(1)}</td>
+      <td class="${bad ? "err" : ""}">${esc(e.kind)}</td>
+      <td>${esc(e.worker_id || "")}</td><td>${esc(e.reason || "")}</td>
+      <td class="hint">${esc(detail)}</td></tr>`;
+  }).join("") || '<tr><td colspan="5" class="hint">no scale events yet</td></tr>';
+}
+
 let perfSuite = null;
 
 function sparkline(points, w = 170, h = 34) {
@@ -380,6 +416,7 @@ async function tick() {
     else if (view === "views") await renderViews();
     else if (view === "memory") await renderMemory();
     else if (view === "workers") await renderWorkers();
+    else if (view === "fleet") await renderFleet();
     else if (view === "perf") await renderPerf();
     else await renderDataframes();
   } catch (e) { /* server restarting */ }

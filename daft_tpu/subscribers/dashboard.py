@@ -34,6 +34,9 @@ from daft_tpu.subscribers.events import (
     TaskCompleted,
     TaskRetried,
     TaskScheduled,
+    WorkerDrained,
+    WorkerDrainStarted,
+    WorkerLaunched,
     WorkerLost,
 )
 
@@ -205,6 +208,25 @@ class DashboardState:
                 self.workers_live[e.worker_id] = {
                     "worker": e.worker_id, "status": "lost",
                     "reason": e.reason, "since": time.time()}
+                return
+            if isinstance(e, WorkerLaunched):
+                # Fleet scale-up (or drain reactivation): a launched worker
+                # is UP evidence even before its first task, and a fresh
+                # launch un-sticks a stale LOST row for a reused id.
+                self.workers_live[e.worker_id] = {
+                    "worker": e.worker_id, "status": "up",
+                    "reason": e.reason, "since": time.time()}
+                return
+            if isinstance(e, WorkerDrainStarted):
+                self.workers_live[e.worker_id] = {
+                    "worker": e.worker_id, "status": "draining",
+                    "reason": e.reason, "since": time.time()}
+                return
+            if isinstance(e, WorkerDrained):
+                self.workers_live[e.worker_id] = {
+                    "worker": e.worker_id, "status": "released",
+                    "reason": f"drained in {e.duration_s:.2f}s",
+                    "since": time.time()}
                 return
             if isinstance(e, TaskRetried):
                 self.retries_by_reason[e.reason] = \
@@ -571,6 +593,23 @@ class _Handler(BaseHTTPRequestHandler):
             ctype = "application/json"
         elif path == "/api/workers":
             body = json.dumps(self.state.workers_summary()).encode()
+            ctype = "application/json"
+        elif path == "/api/fleet":
+            # Fleet panel: membership counts, per-worker state + the scale
+            # event ring. Works without a live controller (fleet disabled):
+            # the event ring and liveness rows still render.
+            from daft_tpu import querylog
+            from daft_tpu.distributed.fleet import get_active_controller
+
+            ctrl = get_active_controller()
+            if ctrl is not None:
+                payload = ctrl.snapshot()
+            else:
+                payload = {"enabled": False, "counts": {}, "workers": [],
+                           "signals": {},
+                           "events": querylog.recent_fleet_events(50)}
+            payload["liveness"] = self.state.worker_liveness()
+            body = json.dumps(payload).encode()
             ctype = "application/json"
         elif path == "/api/dataframes":
             body = json.dumps(self.displays.listing()).encode()

@@ -86,6 +86,54 @@ class WorkerLost(Event):
 
 
 @dataclass
+class WorkerLaunched(Event):
+    """The fleet controller (distributed/fleet.py) added a worker —
+    scale-up launch or re-activation of a worker that was draining.
+    ``reason`` names the triggering signal (queue-pressure / slo-burn /
+    shed-level / memory-pressure / inflight / manual)."""
+
+    worker_id: str = ""
+    reason: str = ""
+    num_slots: int = 0
+    reactivated: bool = False
+
+
+@dataclass
+class WorkerDrainStarted(Event):
+    """A worker entered ``draining``: the scheduler stops placing new
+    tasks on it; running tasks finish (or time out into lineage
+    recovery) and its partitions/chunk files migrate before release."""
+
+    worker_id: str = ""
+    reason: str = ""
+    active_tasks: int = 0
+
+
+@dataclass
+class WorkerDrained(Event):
+    """A drain completed and passed both leak audits (shuffle chunk files
+    + memory ledger); the worker was released. ``migrated_partitions`` /
+    ``migrated_bytes`` size the state moved off the worker."""
+
+    worker_id: str = ""
+    duration_s: float = 0.0
+    migrated_partitions: int = 0
+    migrated_bytes: int = 0
+
+
+@dataclass
+class ScaleDecision(Event):
+    """One fleet-controller decision with its triggering signal snapshot.
+    ``direction`` is ``up`` / ``down`` / ``hold``; ``reason`` names the
+    dominant signal; ``workers`` is the post-decision live worker count."""
+
+    direction: str = ""
+    reason: str = ""
+    workers: int = 0
+    signal: Dict[str, Any] = field(default_factory=dict)
+
+
+@dataclass
 class PartitionRecovered(Event):
     """Lost partitions were recomputed from lineage on a live worker."""
 

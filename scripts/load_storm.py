@@ -29,6 +29,7 @@ Admission-wait p50/p99 are scraped from the dashboard's ``/metrics``
     python scripts/load_storm.py                  # full storm + chaos round
     python scripts/load_storm.py --smoke          # CI-sized quick pass
     python scripts/load_storm.py --assert-overhead  # <2% uncontended tax
+    python scripts/load_storm.py --sinusoidal     # elastic-fleet load wave
 
 Exit code 0 = all assertions held.
 """
@@ -325,6 +326,193 @@ def chaos_round(stats: StormStats, n_queries: int, seed: int) -> None:
     finally:
         runner.manager.shutdown()
         ctx.set_runner(old)
+
+
+# --------------------------------------------------------------------- #
+# Sinusoidal storm (--sinusoidal): the elastic fleet under a load wave    #
+# --------------------------------------------------------------------- #
+def sinusoidal_storm(args) -> int:
+    """Open-loop arrival wave against the DISTRIBUTED runner with the
+    elastic fleet on: arrival rate follows a half-wave sine (crest ->
+    silence -> crest -> silence), so the FleetController must scale UP
+    into each crest and DRAIN back to the floor in each trough. Asserts:
+
+    1. >= 1 scale-up (worker-launched) AND >= 1 graceful drain
+       (worker-drained) landed in the fleet event ring + flight recorder;
+    2. worker count tracked the load: peak active workers above the
+       floor during a crest, back AT the floor after the final trough;
+    3. p99 completion stayed within the (generous) storm objective while
+       membership churned under it;
+    4. every drain was leak-free: zero drain-failed events, and the
+       process-wide shuffle + ledger audits are clean afterwards.
+    """
+    import math
+
+    from daft_tpu.distributed.fleet import get_active_controller
+    from daft_tpu.distributed.shuffle import audit_shuffle_leaks
+    from daft_tpu.execution.memledger import audit_ledger_leaks
+    from daft_tpu.querylog import recent_fleet_events
+    from daft_tpu.runners.distributed import DistributedRunner
+
+    period = 5.0 if args.smoke else 8.0
+    cycles = 2
+    floor = 1
+
+    daft_tpu.set_execution_config(
+        num_compute_threads=2, result_cache_enabled=False,
+        fleet_enabled=True, fleet_min_workers=floor, fleet_max_workers=4,
+        fleet_tick_interval_s=0.05, fleet_cooldown_s=0.4,
+        fleet_idle_ticks=3, fleet_drain_timeout_s=10.0)
+
+    ctx = daft_tpu.get_context()
+    old = ctx._runner
+    runner = DistributedRunner(num_workers=floor, slots_per_worker=2)
+    ctx.set_runner(runner)
+    manager = runner.manager
+    ctrl = get_active_controller()
+    if ctrl is None:
+        print("FAIL: fleet controller did not start (fleet_enabled wiring)")
+        return 1
+
+    # Hostile-sized scans: the crest must genuinely saturate the floor
+    # fleet's slots (the inflight signal) or nothing ever scales.
+    df = make_lineitem(HOSTILE_ROWS)
+    orders = make_orders()
+    builders = [lambda: q_agg(df), lambda: q_join(df, orders),
+                lambda: q_filter(df)]
+    # Warm (JIT/plan caches) + a serial baseline for the p99 objective.
+    t0 = time.monotonic()
+    for b in builders:
+        b().collect()
+    baseline = (time.monotonic() - t0) / len(builders)
+    objective = max(2.0, 25 * baseline)
+    print(f"baseline {baseline * 1000:.0f}ms/query; "
+          f"storm p99 objective {objective:.1f}s")
+
+    walls, errors = [], []
+    lock = threading.Lock()
+    peak_active = {"n": 0}
+    stop = threading.Event()
+
+    def sampler():
+        while not stop.is_set():
+            counts = manager.counts_by_state()
+            with lock:
+                peak_active["n"] = max(peak_active["n"],
+                                       counts.get("active", 0))
+            time.sleep(0.05)
+
+    def one(i):
+        b = builders[i % len(builders)]
+        q0 = time.monotonic()
+        try:
+            b().collect()
+            with lock:
+                walls.append(time.monotonic() - q0)
+        except BaseException as e:  # noqa: BLE001 — tallied below
+            with lock:
+                errors.append(repr(e))
+
+    mon = threading.Thread(target=sampler, daemon=True)
+    mon.start()
+    # Closed-loop threads gated by the sine: thread k issues back-to-back
+    # queries only while k < peak_conc * sin+(t) — the offered CONCURRENCY
+    # follows the wave, so each crest genuinely saturates the floor
+    # fleet's slots and each trough is true silence (the drain window).
+    peak_conc = 8
+    t_start = time.monotonic()
+    total = cycles * period
+    counter = {"i": 0}
+
+    def wave_worker(k):
+        while True:
+            t = time.monotonic() - t_start
+            if t >= total:
+                return
+            target = peak_conc * max(0.0, math.sin(2 * math.pi * t / period))
+            if k >= target:
+                time.sleep(0.05)
+                continue
+            with lock:
+                i = counter["i"]
+                counter["i"] += 1
+            one(i)
+
+    threads = [threading.Thread(target=wave_worker, args=(k,))
+               for k in range(peak_conc)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    print(f"wave: {len(walls)} completed / {len(errors)} failed over "
+          f"{cycles} x {period:.0f}s cycles")
+
+    # Final trough: give the controller room to drain back to the floor.
+    deadline = time.monotonic() + max(6 * period, 20)
+    while time.monotonic() < deadline:
+        if manager.counts_by_state().get("active", 0) <= floor:
+            break
+        time.sleep(0.1)
+    stop.set()
+    mon.join(timeout=5)
+
+    failures = []
+    events = recent_fleet_events()
+    kinds = [e["kind"] for e in events]
+    launches = kinds.count("worker-launched") + kinds.count(
+        "drain-interrupted")
+    drains = kinds.count("worker-drained")
+    drain_fails = [e for e in events if e["kind"] == "drain-failed"]
+    print(f"fleet events: {launches} scale-ups, {drains} drains, "
+          f"{len(drain_fails)} drain failures")
+    if launches < 1:
+        failures.append("no scale-up ever fired under the crest")
+    if drains < 1:
+        failures.append("no graceful drain ever fired in the trough")
+    if drain_fails:
+        failures.append(f"drain(s) failed the leak audit: {drain_fails[:2]}")
+
+    final_active = manager.counts_by_state().get("active", 0)
+    print(f"workers: peak active {peak_active['n']} "
+          f"(floor {floor}), final active {final_active}")
+    if peak_active["n"] <= floor:
+        failures.append(
+            f"worker count never rose above the floor ({peak_active['n']})")
+    if final_active > floor:
+        failures.append(
+            f"fleet did not drain back to the floor: {final_active} active")
+
+    sw = sorted(walls)
+    p99 = pctl(sw, 0.99)
+    print(f"p99 {p99:.2f}s (objective {objective:.1f}s), "
+          f"p50 {pctl(sw, 0.5):.2f}s")
+    if not walls:
+        failures.append("no query ever completed")
+    elif p99 > objective:
+        failures.append(f"p99 {p99:.2f}s blew the {objective:.1f}s "
+                        "objective under membership churn")
+    if errors:
+        failures.append(f"{len(errors)} queries failed: {errors[:3]}")
+
+    # Zero-leak contract AFTER the drains, BEFORE shutdown (which cleans
+    # caches wholesale and would make the audit vacuous).
+    leaks = audit_shuffle_leaks()
+    if leaks["files"]:
+        failures.append(f"leaked shuffle chunk files after drains: {leaks}")
+    mem_leaks = audit_ledger_leaks()
+    if mem_leaks:
+        failures.append(f"ledger did not drain to zero: {mem_leaks}")
+
+    manager.shutdown()
+    ctx.set_runner(old)
+    daft_tpu.set_execution_config(fleet_enabled=False)
+    if failures:
+        print("\nFAILURES:")
+        for f in failures:
+            print(f"  - {f}")
+        return 1
+    print("\nsinusoidal storm: fleet tracked the wave, all drains clean")
+    return 0
 
 
 # --------------------------------------------------------------------- #
@@ -727,6 +915,11 @@ def main() -> int:
                     help="closed-loop storm THROUGH the HTTP front door: "
                          "repeated-shape traffic, >= 90% cache-hit rate, "
                          "shed/timeout wire parity with in-process queries")
+    ap.add_argument("--sinusoidal", action="store_true",
+                    help="elastic-fleet wave: sine arrival rate on the "
+                         "distributed runner; workers must scale into each "
+                         "crest and drain leak-free in each trough while "
+                         "p99 holds")
     ap.add_argument("--seed", type=int, default=0)
     args = ap.parse_args()
     if args.wire and args.assert_overhead:
@@ -735,6 +928,8 @@ def main() -> int:
         return assert_overhead()
     if args.wire:
         return wire_storm(args)
+    if args.sinusoidal:
+        return sinusoidal_storm(args)
     if args.smoke:
         args.queries, args.threads = 36, 12
     chaos = args.chaos if args.chaos is not None else not args.smoke

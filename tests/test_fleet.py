@@ -1,0 +1,621 @@
+"""Elastic fleet tests: membership state machine, drain-aware placement,
+the SLO-driven controller policy, graceful drain with its dual leak audit,
+and the chaos drains (kill mid-drain, interruption by load, launch failure).
+
+Controller tests drive ``tick()`` / ``drain_worker()`` synchronously
+(HeartbeatMonitor's ``probe_once`` discipline) — no background thread, no
+sleeps-as-synchronisation.
+"""
+
+import threading
+
+import pytest
+
+import daft_tpu
+from daft_tpu import col
+from daft_tpu.distributed.faults import fault_scope
+from daft_tpu.distributed.fleet import FleetController, get_active_controller
+from daft_tpu.distributed.partition_ref import LocalPartitionRef
+from daft_tpu.distributed.planner import DistributedExecutor
+from daft_tpu.distributed.scheduler import Scheduler
+from daft_tpu.distributed.shuffle import ShuffleCache, local_cache_for
+from daft_tpu.distributed.task import BoundInput, SchedulingStrategy, Task
+from daft_tpu.distributed.worker import (
+    STATE_ACTIVE,
+    STATE_DRAINED,
+    STATE_DRAINING,
+    STATE_RELEASED,
+    HeartbeatMonitor,
+    LocalWorker,
+    WorkerManager,
+)
+from daft_tpu.expressions.expr import ColumnRef
+from daft_tpu.micropartition import MicroPartition
+from daft_tpu.physical import plan as pp
+from daft_tpu.querylog import recent_fleet_events
+from daft_tpu.runners.distributed import DistributedRunner
+from daft_tpu.subscribers.events import (
+    PartitionRecovered,
+    WorkerDrained,
+    WorkerDrainStarted,
+    WorkerLaunched,
+    WorkerLost,
+)
+
+
+class EventTap:
+    def __init__(self):
+        self.events = []
+        self._lock = threading.Lock()
+
+    def on_event(self, event):
+        with self._lock:
+            self.events.append(event)
+
+    def of(self, kind):
+        with self._lock:
+            return [e for e in self.events if isinstance(e, kind)]
+
+
+@pytest.fixture
+def tap():
+    ctx = daft_tpu.get_context()
+    t = EventTap()
+    ctx.attach_subscriber(t)
+    yield t
+    ctx.detach_subscriber(t)
+
+
+def make_manager(n, slots=2, prefix="fw"):
+    counter = {"n": n}
+
+    def factory():
+        counter["n"] += 1
+        return LocalWorker(f"{prefix}{counter['n'] - 1}", num_slots=slots)
+
+    workers = [LocalWorker(f"{prefix}{i}", num_slots=slots) for i in range(n)]
+    return WorkerManager(workers, factory=factory)
+
+
+def make_controller(manager, **over):
+    base = dict(fleet_enabled=True, fleet_min_workers=1, fleet_max_workers=8,
+                fleet_cooldown_s=0.0, fleet_idle_ticks=1,
+                fleet_drain_timeout_s=5.0)
+    base.update(over)
+    cfg = daft_tpu.get_context().execution_config.with_changes(**base)
+    return FleetController(manager, cfg)
+
+
+def calm(workers=2.0, slots=4.0, **over):
+    sig = {"queued": 0.0, "shed_level": 0.0, "burn_rate": 0.0,
+           "inflight": 0.0, "slots": slots, "mem_frac": 0.0,
+           "workers": workers}
+    sig.update(over)
+    return sig
+
+
+# ------------------------------------------------------------------ #
+# Membership state machine                                             #
+# ------------------------------------------------------------------ #
+def test_membership_state_machine():
+    mgr = make_manager(3)
+    try:
+        assert mgr.worker_state("fw0") == STATE_ACTIVE
+        assert mgr.is_placeable("fw0")
+        assert mgr.total_slots() == 6
+
+        assert mgr.begin_drain("fw0")
+        assert mgr.worker_state("fw0") == STATE_DRAINING
+        assert not mgr.is_placeable("fw0")
+        assert mgr.draining_ids() == {"fw0"}
+        assert mgr.total_slots() == 4  # draining slots don't count
+        assert not mgr.begin_drain("fw0")  # already past active
+
+        assert mgr.finish_drain("fw0")
+        assert mgr.worker_state("fw0") == STATE_DRAINED
+        released = mgr.release_worker("fw0")
+        assert released is not None and released.worker_id == "fw0"
+        assert mgr.worker_state("fw0") == STATE_RELEASED
+        assert mgr.get("fw0") is None
+        assert mgr.release_worker("fw0") is None  # idempotent
+
+        # Reactivation path: a drain interrupted by load re-admits.
+        assert mgr.begin_drain("fw1")
+        assert mgr.reactivate("fw1")
+        assert mgr.worker_state("fw1") == STATE_ACTIVE
+        assert mgr.is_placeable("fw1")
+
+        # Dead wins over every membership state.
+        mgr.begin_drain("fw2")
+        mgr.mark_dead("fw2", reason="test")
+        assert mgr.worker_state("fw2") == "dead"
+        assert not mgr.begin_drain("fw2")
+
+        counts = mgr.counts_by_state()
+        assert counts.get("released") == 1
+        assert counts.get("dead") == 1
+        assert counts.get("active") == 1
+    finally:
+        mgr.shutdown()
+
+
+def test_released_worker_forgotten_by_heartbeat(tap):
+    """Regression: a deliberately-released worker must be unregistered
+    from the heartbeat monitor BEFORE its sockets close — the monitor
+    must never misread a planned departure as a crash (WorkerLost)."""
+    mgr = make_manager(3, prefix="hb")
+    monitor = HeartbeatMonitor(mgr, interval_s=60, miss_threshold=1)
+    mgr._monitor = monitor  # attached, not started: probe_once drives it
+    try:
+        # Seed a pending miss so a stale entry WOULD fire on the next probe
+        # if release didn't forget it.
+        monitor._misses["hb1"] = 1
+        assert mgr.begin_drain("hb1") and mgr.finish_drain("hb1")
+        w = mgr.release_worker("hb1")
+        w.shutdown()
+        assert "hb1" not in monitor._misses
+        for _ in range(3):
+            monitor.probe_once()
+        assert not tap.of(WorkerLost)
+        assert not mgr.is_dead("hb1")
+    finally:
+        mgr.shutdown()
+
+
+# ------------------------------------------------------------------ #
+# Drain-aware placement                                                #
+# ------------------------------------------------------------------ #
+def test_no_new_tasks_on_draining_worker():
+    mgr = make_manager(3, prefix="s")
+    try:
+        sched = Scheduler(mgr)
+        mgr.begin_drain("s1")
+        mp = MicroPartition.from_pydict({"x": [1]})
+        for _ in range(12):
+            t = Task(BoundInput(0, mp.schema), [[LocalPartitionRef(mp)]])
+            assert sched.assign(t).worker_id != "s1"
+    finally:
+        mgr.shutdown()
+
+
+def test_all_draining_never_strands_placement():
+    mgr = make_manager(2, prefix="s")
+    try:
+        sched = Scheduler(mgr)
+        mgr.begin_drain("s0")
+        mgr.begin_drain("s1")
+        mp = MicroPartition.from_pydict({"x": [1]})
+        t = Task(BoundInput(0, mp.schema), [[LocalPartitionRef(mp)]])
+        assert sched.assign(t).worker_id in {"s0", "s1"}
+    finally:
+        mgr.shutdown()
+
+
+def test_locality_spills_to_next_best_holder():
+    """The majority holder is draining: locality must fall through to the
+    next-best candidate holding bytes, not evaporate into a blind spread."""
+    mgr = make_manager(3, prefix="s")
+    try:
+        sched = Scheduler(mgr)
+        mgr.begin_drain("s1")
+        mp = MicroPartition.from_pydict({"x": [1]})
+        t = Task(BoundInput(0, mp.schema), [[LocalPartitionRef(mp)]],
+                 input_locality={"s1": 1000, "s2": 300})
+        assert sched.assign(t).worker_id == "s2"
+    finally:
+        mgr.shutdown()
+
+
+def test_hard_affinity_still_lands_on_draining():
+    mgr = make_manager(3, prefix="s")
+    try:
+        sched = Scheduler(mgr)
+        mgr.begin_drain("s1")
+        mp = MicroPartition.from_pydict({"x": [1]})
+        t = Task(BoundInput(0, mp.schema), [[LocalPartitionRef(mp)]],
+                 strategy=SchedulingStrategy.affinity("s1", soft=False))
+        assert sched.assign(t).worker_id == "s1"
+    finally:
+        mgr.shutdown()
+
+
+def test_soft_affinity_yields_to_drain():
+    mgr = make_manager(3, prefix="s")
+    try:
+        sched = Scheduler(mgr)
+        mgr.begin_drain("s1")
+        mp = MicroPartition.from_pydict({"x": [1]})
+        t = Task(BoundInput(0, mp.schema), [[LocalPartitionRef(mp)]],
+                 strategy=SchedulingStrategy.affinity("s1", soft=True))
+        assert sched.assign(t).worker_id != "s1"
+    finally:
+        mgr.shutdown()
+
+
+def test_speculation_never_targets_draining():
+    """Speculative re-placement excludes the original worker; a draining
+    worker must be equally out of bounds — the only remaining active
+    worker wins."""
+    mgr = make_manager(3, prefix="s")
+    try:
+        sched = Scheduler(mgr)
+        mgr.begin_drain("s1")
+        mp = MicroPartition.from_pydict({"x": [1]})
+        for _ in range(8):
+            t = Task(BoundInput(0, mp.schema), [[LocalPartitionRef(mp)]])
+            assert sched.assign(t, exclude={"s0"}).worker_id == "s2"
+    finally:
+        mgr.shutdown()
+
+
+# ------------------------------------------------------------------ #
+# Controller policy (pure decide + tick)                               #
+# ------------------------------------------------------------------ #
+def test_decide_pressure_ladder():
+    mgr = make_manager(2)
+    try:
+        fc = make_controller(mgr)
+        assert fc.decide(calm(shed_level=2)) == ("up", "shed-level")
+        assert fc.decide(calm(queued=3)) == ("up", "queue-pressure")
+        assert fc.decide(calm(burn_rate=2.5)) == ("up", "slo-burn")
+        assert fc.decide(calm(inflight=4.0)) == ("up", "inflight")
+        assert fc.decide(calm(mem_frac=0.95)) == ("up", "memory-pressure")
+        # Priority: shedding beats everything else in the reason.
+        assert fc.decide(calm(shed_level=1, queued=9, inflight=4.0)) \
+            == ("up", "shed-level")
+        fc.stop()
+    finally:
+        mgr.shutdown()
+
+
+def test_decide_hysteresis_then_drain():
+    mgr = make_manager(2)
+    try:
+        fc = make_controller(mgr, fleet_idle_ticks=3)
+        assert fc.decide(calm()) == ("hold", "hysteresis")
+        assert fc.decide(calm()) == ("hold", "hysteresis")
+        assert fc.decide(calm()) == ("down", "idle")
+        # Any pressure resets the calm streak.
+        fc.decide(calm(queued=5))
+        assert fc.decide(calm()) == ("hold", "hysteresis")
+        fc.stop()
+    finally:
+        mgr.shutdown()
+
+
+def test_decide_holds_at_min_and_when_busy():
+    mgr = make_manager(1)
+    try:
+        fc = make_controller(mgr)
+        assert fc.decide(calm(workers=1.0, slots=2.0)) == ("hold", "at-min")
+        fc.stop()
+    finally:
+        mgr.shutdown()
+    mgr = make_manager(2)
+    try:
+        fc = make_controller(mgr)
+        # Sub-threshold inflight isn't calm enough to give a worker back.
+        assert fc.decide(calm(inflight=1.0)) == ("hold", "busy")
+        fc.stop()
+    finally:
+        mgr.shutdown()
+
+
+def test_tick_scales_up_then_cooldown_holds():
+    mgr = make_manager(1)
+    try:
+        fc = make_controller(mgr, fleet_cooldown_s=600.0)
+        fc.signals = lambda: calm(workers=1.0, slots=2.0, queued=5.0)
+        assert fc.tick() == ("up", "queue-pressure")
+        assert len(mgr.workers()) == 2
+        fc.signals = lambda: calm(queued=9.0)
+        assert fc.tick()[0] == "hold"  # in cooldown: no flapping
+        assert len(mgr.workers()) == 2
+        fc.stop()
+    finally:
+        mgr.shutdown()
+
+
+def test_reactivation_beats_fresh_launch(tap):
+    mgr = make_manager(2)
+    try:
+        fc = make_controller(mgr, fleet_cooldown_s=600.0)
+        mgr.begin_drain("fw1")
+        fc._last_scale_t = __import__("time").monotonic()  # mid-cooldown
+        fc.signals = lambda: calm(queued=5.0)
+        assert fc.tick() == ("up", "queue-pressure")
+        # Reactivated, not launched — same fleet size, worker active again.
+        assert len(mgr.workers()) == 2
+        assert mgr.worker_state("fw1") == STATE_ACTIVE
+        launched = tap.of(WorkerLaunched)
+        assert launched and launched[-1].reactivated
+        assert any(e["kind"] == "drain-interrupted"
+                   for e in recent_fleet_events(20))
+        fc.stop()
+    finally:
+        mgr.shutdown()
+
+
+def test_launch_failure_recorded_and_retried():
+    mgr = make_manager(1)
+    try:
+        fc = make_controller(mgr)
+        with fault_scope("worker.launch:raise:1"):
+            assert fc.scale_up("queue-pressure") is False
+            assert len(mgr.workers()) == 1
+            assert any(e["kind"] == "launch-failed"
+                       for e in recent_fleet_events(10))
+            # Next attempt (= next controller tick) succeeds.
+            assert fc.scale_up("queue-pressure") is True
+        assert len(mgr.workers()) == 2
+        fc.stop()
+    finally:
+        mgr.shutdown()
+
+
+# ------------------------------------------------------------------ #
+# Graceful drain: migration + dual leak audit                          #
+# ------------------------------------------------------------------ #
+def test_migrate_partition_byte_identity(tmp_path):
+    src = ShuffleCache([str(tmp_path / "src")])
+    dst = ShuffleCache([str(tmp_path / "dst")])
+    mp = MicroPartition.from_pydict({"a": list(range(500)),
+                                     "b": [f"v{i}" for i in range(500)]})
+    ticket = src.write_partition("mig", 0, mp, query_id="q1")
+    src.write_partition("mig", 0, mp, query_id="q1")  # second chunk
+    expected = src.read_partition(ticket).to_pydict()
+
+    chunks, nbytes = src.migrate_partition(ticket, dst)
+    assert chunks == 2 and nbytes > 0
+    # Same ticket, new cache, byte-identical rows; source is EMPTY.
+    assert dst.read_partition(ticket).to_pydict() == expected
+    assert src.audit()["files"] == 0
+    with pytest.raises(KeyError):
+        src.migrate_partition("no-such-ticket", dst)
+    src.cleanup()
+    dst.cleanup()
+
+
+def test_drain_end_to_end_migrates_and_audits(tap):
+    """The full lifecycle against live lineage refs: local partitions are
+    re-homed, the dual audit passes, the worker releases, and fetching the
+    OLD refs still returns identical bytes with ZERO recovery events."""
+    mgr = make_manager(3, prefix="dr")
+    cfg = daft_tpu.get_context().execution_config
+    try:
+        ex = DistributedExecutor(mgr, cfg, query_id="qdrain")
+        mp = MicroPartition.from_pydict({"x": list(range(32))})
+        tasks = [Task(BoundInput(0, mp.schema), [[LocalPartitionRef(mp)]],
+                      strategy=SchedulingStrategy.affinity("dr0", soft=False))
+                 for _ in range(2)]
+        ref_lists = ex._dispatch(tasks)
+        refs = [r for refs_ in ref_lists for r in refs_]
+        assert all(r.location == "dr0" for r in refs)
+
+        fc = make_controller(mgr)
+        assert fc.drain_worker("dr0", reason="idle") is True
+        assert mgr.worker_state("dr0") == STATE_RELEASED
+        assert mgr.get("dr0") is None
+
+        # Old refs resolve through their lineage replacements — no
+        # recomputation, no WorkerLost, byte-identical.
+        for r in refs:
+            repl = ex.lineage.replacement(r)
+            assert repl is not r and repl.location != "dr0"
+            assert ex.fetch_output(r).to_pydict() == {"x": list(range(32))}
+        assert not tap.of(PartitionRecovered)
+        assert not tap.of(WorkerLost)
+        assert tap.of(WorkerDrainStarted) and tap.of(WorkerDrained)
+        kinds = [e["kind"] for e in recent_fleet_events(20)]
+        assert "worker-drained" in kinds and "drain-started" in kinds
+        fc.stop()
+    finally:
+        mgr.shutdown()
+
+
+def test_drain_migrates_flight_shuffle_chunks(tap):
+    """Chunk files migrate under the SAME tickets; the departing cache
+    audits empty; reads through the old refs stay byte-identical."""
+    mgr = make_manager(3, prefix="fs")
+    cfg = daft_tpu.get_context().execution_config.with_changes(
+        shuffle_algorithm="flight", shuffle_chunk_bytes=2048)
+    try:
+        ex = DistributedExecutor(mgr, cfg, query_id="qflight")
+        mp = MicroPartition.from_pydict({
+            "k": list(range(300)), "b": [f"g{i % 7}" for i in range(300)]})
+        frag = pp.Repartition(BoundInput(0, mp.schema),
+                              ("hash", [ColumnRef("b")], 3))
+        task = Task(frag, [[LocalPartitionRef(mp)]],
+                    strategy=SchedulingStrategy.affinity("fs0", soft=False),
+                    expect_outputs=3, cfg=cfg)
+        (refs,) = ex._dispatch([task])
+        assert all(r.worker_id == "fs0" for r in refs)
+        before = [ex.fetch_output(r).to_pydict() for r in refs]
+        assert sum(len(d["k"]) for d in before) == 300
+
+        fc = make_controller(mgr)
+        assert fc.drain_worker("fs0", reason="idle") is True
+        assert mgr.worker_state("fs0") == STATE_RELEASED
+        # Replacements point at the migration target and carry the bytes.
+        after = [ex.fetch_output(r).to_pydict() for r in refs]
+        assert after == before
+        target = {ex.lineage.replacement(r).worker_id for r in refs}
+        assert target and "fs0" not in target
+        assert local_cache_for(next(iter(target))).audit()["files"] > 0
+        assert not tap.of(PartitionRecovered)
+        drained = tap.of(WorkerDrained)
+        assert drained and drained[-1].migrated_partitions == 3
+        assert drained[-1].migrated_bytes > 0
+        fc.stop()
+    finally:
+        mgr.shutdown()
+
+
+def test_drain_then_worker_lost_never_double_recovers(tap):
+    """Regression (drain-vs-kill race): a late WorkerLost for a worker
+    whose partitions were drain-migrated must NOT re-trigger lineage
+    recomputation — the replacements already exist and must be swapped."""
+    mgr = make_manager(3, prefix="dk")
+    cfg = daft_tpu.get_context().execution_config
+    try:
+        ex = DistributedExecutor(mgr, cfg, query_id="qdedupe")
+        mp = MicroPartition.from_pydict({"x": list(range(12))})
+        stage1 = Task(BoundInput(0, mp.schema), [[LocalPartitionRef(mp)]],
+                      strategy=SchedulingStrategy.affinity("dk0", soft=False))
+        (refs,) = ex._dispatch([stage1])
+
+        fc = make_controller(mgr)
+        assert fc.drain_worker("dk0", reason="idle") is True
+        # The stale loss lands AFTER the drain released the worker.
+        mgr.mark_dead("dk0", reason="stale-heartbeat")
+        stage2 = Task(BoundInput(0, mp.schema), [list(refs)])
+        (out,) = ex._dispatch([stage2])
+        assert out[0].fetch().to_pydict() == {"x": list(range(12))}
+        assert not tap.of(PartitionRecovered)
+        fc.stop()
+    finally:
+        mgr.shutdown()
+
+
+def test_fleet_gauges_and_dashboard_api():
+    from urllib.request import urlopen
+    import json
+
+    from daft_tpu import metrics
+    from daft_tpu.subscribers.dashboard import DashboardServer
+
+    mgr = make_manager(2, prefix="gw")
+    try:
+        fc = make_controller(mgr)
+        assert get_active_controller() is fc
+        assert fc.drain_worker("gw0", reason="idle") is True
+        snap = metrics.get_registry().snapshot()
+        assert snap.value("daft_fleet_workers", state="released") >= 1
+        assert snap.value("daft_fleet_workers", state="active") >= 1
+        assert snap.label_totals("daft_fleet_scale_events_total",
+                                 "direction").get("down", 0) >= 1
+        assert snap.hist("daft_fleet_drain_seconds")["count"] >= 1
+
+        srv = DashboardServer(port=0).start()
+        try:
+            payload = json.loads(
+                urlopen(f"{srv.url}/api/fleet", timeout=5).read())
+            assert payload["enabled"] is True
+            assert payload["counts"].get("released") == 1
+            assert {w["worker_id"] for w in payload["workers"]} == {"gw1"}
+            assert "signals" in payload and "events" in payload
+        finally:
+            srv.shutdown()
+        fc.stop()
+        assert get_active_controller() is None
+    finally:
+        mgr.shutdown()
+
+
+# ------------------------------------------------------------------ #
+# Chaos: kill mid-drain, interruption, storm-shaped waves              #
+# ------------------------------------------------------------------ #
+@pytest.mark.chaos
+@pytest.mark.parametrize("workers", [2, 8])
+def test_kill_mid_drain_byte_identical(workers, tap):
+    """``fleet.drain:kill`` crashes the worker at drain start: the drain
+    must FAIL (crash recovery owns the worker now) and the engine must
+    keep returning byte-identical results on the shrunken fleet."""
+    ctx = daft_tpu.get_context()
+    old = ctx._runner
+    runner = DistributedRunner(num_workers=workers)
+    ctx.set_runner(runner)
+    try:
+        df = daft_tpu.from_pydict({
+            "a": list(range(240)),
+            "b": [f"k{i % 9}" for i in range(240)],
+        }).into_partitions(6)
+
+        def q():
+            return df.groupby("b").agg(
+                col("a").sum().alias("s"), col("a").count().alias("n"),
+            ).sort("b").to_pydict()
+
+        with daft_tpu.execution_config_ctx(
+                shuffle_algorithm="flight", shuffle_chunk_bytes=4096,
+                result_cache_enabled=False):
+            expected = q()
+            fc = make_controller(runner.manager)
+            victim = sorted(w.worker_id
+                            for w in runner.manager.workers())[0]
+            with fault_scope("fleet.drain:kill:1", seed=0):
+                assert fc.drain_worker(victim, reason="chaos") is False
+            assert runner.manager.is_dead(victim)
+            assert any(e.worker_id == victim and e.reason == "drain-crash"
+                       for e in tap.of(WorkerLost))
+            assert any(e["kind"] == "drain-failed"
+                       for e in recent_fleet_events(10))
+            assert not tap.of(WorkerDrained)
+            assert q() == expected
+            fc.stop()
+    finally:
+        runner.manager.shutdown()
+        ctx.set_runner(old)
+
+
+@pytest.mark.chaos
+def test_kill_mid_drain_recovers_live_refs(tap):
+    """Live partitions on the crashed-mid-drain worker recompute through
+    ordinary lineage recovery — byte-identically."""
+    mgr = make_manager(3, prefix="kc")
+    cfg = daft_tpu.get_context().execution_config
+    try:
+        ex = DistributedExecutor(mgr, cfg, query_id="qkill")
+        mp = MicroPartition.from_pydict({"x": list(range(24))})
+        stage1 = Task(BoundInput(0, mp.schema), [[LocalPartitionRef(mp)]],
+                      strategy=SchedulingStrategy.affinity("kc0", soft=False))
+        (refs,) = ex._dispatch([stage1])
+
+        fc = make_controller(mgr)
+        with fault_scope("fleet.drain:kill:1", seed=0):
+            assert fc.drain_worker("kc0", reason="chaos") is False
+        # Nothing migrated — the refs' host is DEAD, and consuming them
+        # goes through lineage recomputation, not the drain path.
+        stage2 = Task(BoundInput(0, mp.schema), [list(refs)])
+        (out,) = ex._dispatch([stage2])
+        assert out[0].fetch().to_pydict() == {"x": list(range(24))}
+        assert tap.of(PartitionRecovered)
+        fc.stop()
+    finally:
+        mgr.shutdown()
+
+
+@pytest.mark.chaos
+def test_drain_interrupted_by_load_reactivates(tap):
+    """A load spike mid-drain (reactivation racing the quiesce wait) must
+    abort the drain cleanly: worker back to active, placeable, a
+    drain-failed/interrupted record — and NOT a release."""
+    mgr = make_manager(2, prefix="ir")
+    try:
+        fc = make_controller(mgr)
+
+        def interrupting_quiesce(w):
+            # The controller's reactivation path fires while this drain is
+            # still waiting for tasks: by the time quiesce returns, the
+            # worker is active again.
+            mgr.reactivate(w.worker_id)
+            return True
+
+        fc._await_quiesce = interrupting_quiesce
+        assert fc.drain_worker("ir0", reason="idle") is False
+        assert mgr.worker_state("ir0") == STATE_ACTIVE
+        assert mgr.is_placeable("ir0")
+        assert len(mgr.workers()) == 2
+        ev = [e for e in recent_fleet_events(10)
+              if e["kind"] == "drain-failed"]
+        assert ev and ev[0]["stage"] == "interrupted"
+        assert not tap.of(WorkerDrained)
+        # The aborted drain leaves the worker fully schedulable.
+        sched = Scheduler(mgr)
+        mp = MicroPartition.from_pydict({"x": [1]})
+        t = Task(BoundInput(0, mp.schema), [[LocalPartitionRef(mp)]],
+                 strategy=SchedulingStrategy.affinity("ir0", soft=True))
+        assert sched.assign(t).worker_id == "ir0"
+        fc.stop()
+    finally:
+        mgr.shutdown()
