@@ -209,7 +209,6 @@ class WorkerDaemon:
                             {"ok": True, "worker_id": self.worker_id,
                              "slots": self.slots,
                              "flight": self.flight_address,
-                             "active": self._active,
                              "metrics": get_registry().to_wire(),
                              "spans": spans,
                              "now_ns": span_clock_ns()}))

@@ -128,9 +128,10 @@ class _QueryLedger:
         with self.lock:
             by_op: Dict[str, dict] = {}
             for (op, kind), slot in self.ops.items():
-                row = by_op.setdefault(op or "(unattributed)",
-                                       {"peak": 0, "held": 0, "charged": 0,
-                                        "kinds": {}})
+                row = by_op.setdefault(
+                    op or "(unattributed)",
+                    # daftlint: disable=DTL013 -- row held is dashboard-only
+                    {"peak": 0, "held": 0, "charged": 0, "kinds": {}})
                 row["peak"] += slot.peak
                 row["held"] += slot.held
                 row["charged"] += slot.charged
@@ -244,6 +245,14 @@ class MemoryLedger:
             return None
         snap = q.snapshot()
         snap["residual_bytes"] = snap.pop("held_bytes")
+        # Wire hygiene (DTL013): the driver merge (merge_worker_profile)
+        # reads charged/stall/peak/residual and the per-kind rows — local
+        # identity and dashboard-only fields stay off the frame.
+        snap.pop("query_id", None)
+        snap.pop("rss_peak_bytes", None)
+        snap.pop("age_s", None)
+        for op, row in snap["by_operator"].items():
+            snap["by_operator"][op] = {"kinds": row["kinds"]}
         return snap
 
     def merge_worker_profile(self, query_id: str,

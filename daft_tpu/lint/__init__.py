@@ -16,6 +16,16 @@ See rules.py for the rule table and docs/COMPONENTS.md for rationale.
 
 from daft_tpu.lint.baseline import DEFAULT_BASELINE_NAME, Baseline, BaselineEntry
 from daft_tpu.lint.core import FileContext, Finding, Rule, parse_suppressions
+from daft_tpu.lint.project import (
+    GRAPH_CACHE_NAME,
+    ProjectGraph,
+    build_project_graph,
+    default_lock_order_path,
+    extract_module_facts,
+    load_lock_order,
+    parse_lock_order,
+)
+from daft_tpu.lint.project_rules import PROJECT_RULES, default_project_rules
 from daft_tpu.lint.reporters import (
     JSON_SCHEMA_VERSION,
     LintResult,
@@ -24,6 +34,7 @@ from daft_tpu.lint.reporters import (
 )
 from daft_tpu.lint.rules import ALL_RULES, default_rules, rules_by_id
 from daft_tpu.lint.runner import (
+    changed_py_files,
     find_baseline,
     lint_source,
     repo_root,
@@ -32,7 +43,11 @@ from daft_tpu.lint.runner import (
 
 __all__ = [
     "ALL_RULES", "Baseline", "BaselineEntry", "DEFAULT_BASELINE_NAME",
-    "FileContext", "Finding", "JSON_SCHEMA_VERSION", "LintResult", "Rule",
-    "default_rules", "find_baseline", "lint_source", "parse_suppressions",
-    "render_json", "render_text", "repo_root", "rules_by_id", "run_paths",
+    "FileContext", "Finding", "GRAPH_CACHE_NAME", "JSON_SCHEMA_VERSION",
+    "LintResult", "PROJECT_RULES", "ProjectGraph", "Rule",
+    "build_project_graph", "changed_py_files", "default_lock_order_path",
+    "default_project_rules", "default_rules", "extract_module_facts",
+    "find_baseline", "lint_source", "load_lock_order", "parse_lock_order",
+    "parse_suppressions", "render_json", "render_text", "repo_root",
+    "rules_by_id", "run_paths",
 ]

@@ -14,7 +14,12 @@ from typing import List, Optional
 from daft_tpu.lint.baseline import DEFAULT_BASELINE_NAME, Baseline
 from daft_tpu.lint.reporters import render_json, render_text
 from daft_tpu.lint.rules import ALL_RULES, default_rules, rules_by_id
-from daft_tpu.lint.runner import find_baseline, repo_root, run_paths
+from daft_tpu.lint.runner import (
+    changed_py_files,
+    find_baseline,
+    repo_root,
+    run_paths,
+)
 
 
 def _parser() -> argparse.ArgumentParser:
@@ -38,6 +43,16 @@ def _parser() -> argparse.ArgumentParser:
     p.add_argument("--list-rules", action="store_true")
     p.add_argument("--verbose", action="store_true",
                    help="also print baselined findings in text output")
+    p.add_argument("--changed-only", action="store_true",
+                   help="file-tier lint only files changed vs git HEAD; "
+                        "the project graph is still built whole (from its "
+                        "cache) so cross-module rules stay sound")
+    p.add_argument("--no-project", action="store_true",
+                   help="skip the whole-program tier (DTL011+)")
+    p.add_argument("--graph-cache", default="auto", metavar="PATH",
+                   help="project graph cache file (default: "
+                        ".daftlint-graph-cache.json at the repo root; "
+                        "'none' disables caching)")
     return p
 
 
@@ -54,6 +69,22 @@ def main(argv: Optional[List[str]] = None) -> int:
         if not os.path.exists(p):
             print(f"daftlint: no such path: {p}", file=sys.stderr)
             return 2
+
+    project_paths = None
+    if args.changed_only:
+        changed = changed_py_files(root)
+        if changed is None:
+            print("daftlint: --changed-only needs git; running full sweep",
+                  file=sys.stderr)
+        else:
+            # File tier narrows to changed files under the requested paths;
+            # the project graph still covers the full requested scope.
+            want = [os.path.abspath(p) for p in paths]
+            project_paths = paths
+            paths = [c for c in changed
+                     if any(os.path.abspath(c) == w
+                            or os.path.abspath(c).startswith(w + os.sep)
+                            for w in want)]
 
     rules = None
     if args.rules:
@@ -77,7 +108,11 @@ def main(argv: Optional[List[str]] = None) -> int:
                   file=sys.stderr)
             return 2
 
-    result = run_paths(paths, root=root, rules=rules, baseline=baseline)
+    graph_cache = None if args.graph_cache == "none" else args.graph_cache
+    result = run_paths(paths, root=root, rules=rules, baseline=baseline,
+                       project=not args.no_project,
+                       project_paths=project_paths,
+                       graph_cache=graph_cache)
 
     if args.update_baseline:
         target = args.baseline or baseline_path \

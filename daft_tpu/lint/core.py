@@ -37,6 +37,7 @@ class Finding:
     col: int           # 0-based
     message: str
     snippet: str       # stripped source line (baseline matching key)
+    analysis: str = "file"   # "file" (single-module rules) or "project"
 
     def key(self) -> Tuple[str, str, str]:
         """Line-number-independent identity used by the baseline: moving a
@@ -46,7 +47,7 @@ class Finding:
     def to_dict(self) -> dict:
         return {"rule": self.rule, "path": self.path, "line": self.line,
                 "col": self.col, "message": self.message,
-                "snippet": self.snippet}
+                "snippet": self.snippet, "analysis": self.analysis}
 
     def render(self) -> str:
         return f"{self.path}:{self.line}:{self.col}: {self.rule} {self.message}"

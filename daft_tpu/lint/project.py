@@ -1,0 +1,814 @@
+"""daftlint whole-program tier: the project graph.
+
+File-tier rules (DTL001–DTL010) see one module at a time and structurally
+cannot check the engine's cross-module invariants: the declared lock order,
+charge/release pairing that spans classes, and worker→driver wire contracts
+whose writer and reader live in different processes. This module parses the
+whole package once into **per-module facts** (functions, call names, lock
+acquisitions under ``with``, resource charge/release sites, dict keys
+written/read) and aggregates them into a :class:`ProjectGraph` the project
+rules (DTL011–DTL013, see ``project_rules.py``) consume.
+
+Facts are JSON-serializable and cached on ``(path, mtime_ns, size)`` so a
+pre-commit run only re-parses changed files. Like every daftlint pass, the
+extraction never imports engine modules — it must work on a broken tree; a
+module that fails to parse is *excluded* from the graph (and surfaced as a
+project-tier DTL000 warning by the runner) instead of aborting the build.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from daft_tpu.lint.core import ImportTable, Suppressions, parse_suppressions
+
+#: Bump when the extraction schema changes — invalidates every cache entry.
+FACTS_VERSION = 1
+
+GRAPH_CACHE_NAME = ".daftlint-graph-cache.json"
+
+#: Package prefix stripped from lock / module identities so baselines stay
+#: stable if the tree is linted from a different checkout root.
+PKG_PREFIX = "daft_tpu."
+
+_SKIP_DIRS = {"__pycache__", ".git", ".venv", "node_modules"}
+
+#: Same lock-name heuristic as DTL004: an attribute is lock-shaped when its
+#: name contains one of these parts.
+LOCK_NAME_PARTS = ("lock", "cond", "guard", "mutex")
+
+_LOCK_CTORS = {
+    "threading.Lock": "Lock",
+    "threading.RLock": "RLock",
+    "threading.Condition": "Condition",
+    "multiprocessing.Lock": "Lock",
+    "multiprocessing.RLock": "RLock",
+}
+
+#: DTL012 paired-resource registry. A call is *charge-shaped* when its
+#: method name is in ``charge`` and its receiver matches ``charge_recv``
+#: (same for releases). ``with_only`` families are context managers that
+#: must be entered, never called bare.
+RESOURCE_FAMILIES: Dict[str, dict] = {
+    "ledger": {
+        "charge": {"charge"},
+        "charge_recv": r"ledger",
+        "release": {"release", "finish_query", "drain_query_wire"},
+        "release_recv": r"ledger",
+    },
+    "memory-permit": {
+        "charge": {"acquire"},
+        "charge_recv": r"(^|\.)(mem|memory|_mm|mem_manager|memory_manager)$",
+        "release": {"release"},
+        "release_recv": r"(^|\.)(mem|memory|_mm|mem_manager|memory_manager)$",
+    },
+    "admission": {
+        "charge": {"admit"},
+        "charge_recv": r"(controller|admission)",
+        "release": {"release"},
+        "release_recv": r"ticket",
+    },
+    "single-flight": {
+        "charge": {"lookup_or_claim"},
+        "charge_recv": r"(cache|result)",
+        "release": {"commit", "abort"},
+        "release_recv": r".*",  # commit/abort are distinctive on their own
+    },
+    "profiler-query": {
+        "charge": {"begin_query", "force_begin_query"},
+        "charge_recv": r"(profiling|prof|querylog|^$)",
+        "release": {"end_query"},
+        "release_recv": r"(profiling|prof|querylog|^$)",
+    },
+    "fault-scope": {
+        "charge": {"fault_scope", "config_fault_scope"},
+        "charge_recv": r".*",
+        "release": set(),
+        "release_recv": r"^\b$",  # never matches: with-entry is the release
+        "with_only": True,
+    },
+}
+
+
+def _lockish(name: str) -> bool:
+    low = name.lower()
+    return any(p in low for p in LOCK_NAME_PARTS)
+
+
+def _strip_pkg(dotted: str) -> str:
+    return dotted[len(PKG_PREFIX):] if dotted.startswith(PKG_PREFIX) else dotted
+
+
+def _call_name(call: ast.Call, imports: ImportTable) -> Optional[str]:
+    """Best-effort dotted name for a call: import-resolved for module paths,
+    ``self.x`` kept symbolic, ``f().meth`` rendered as ``f().meth``."""
+    func = call.func
+    if isinstance(func, ast.Name):
+        return imports.aliases.get(func.id, func.id)
+    if isinstance(func, ast.Attribute):
+        parts = [func.attr]
+        cur = func.value
+        while isinstance(cur, ast.Attribute):
+            parts.append(cur.attr)
+            cur = cur.value
+        if isinstance(cur, ast.Name):
+            root = "self" if cur.id == "self" \
+                else imports.aliases.get(cur.id, cur.id)
+            parts.append(root)
+            return ".".join(reversed(parts))
+        if isinstance(cur, ast.Call):
+            inner = _call_name(cur, imports) or "?"
+            parts.append(inner + "()")
+            return ".".join(reversed(parts))
+    return None
+
+
+def _split_recv(name: str) -> Tuple[str, str]:
+    """``a.b.meth`` -> ("a.b", "meth"); a bare name has receiver ""."""
+    if "." in name:
+        recv, meth = name.rsplit(".", 1)
+        return recv, meth
+    return "", name
+
+
+def _family_of(name: str, kind: str) -> Optional[str]:
+    recv, meth = _split_recv(name)
+    for fam, spec in RESOURCE_FAMILIES.items():
+        if meth in spec[kind] and re.search(spec[kind + "_recv"],
+                                            recv.lower() or ""):
+            return fam
+    return None
+
+
+def _target_names(target: ast.AST) -> Tuple[List[str], bool]:
+    """Names bound by an assignment target; also whether any target is a
+    ``self.x`` attribute (object-owned resource)."""
+    names: List[str] = []
+    bound_self = False
+    for n in ast.walk(target):
+        if isinstance(n, ast.Name):
+            names.append(n.id)
+        elif isinstance(n, ast.Attribute) and \
+                isinstance(n.value, ast.Name) and n.value.id == "self":
+            bound_self = True
+    return names, bound_self
+
+
+class _FunctionExtractor:
+    """One pass over a function body collecting calls, lock nesting,
+    resource sites, and wire keys. Nested def/class bodies are extracted
+    separately (a closure runs later — its locks are not 'held here')."""
+
+    def __init__(self, modshort: str, cls: Optional[str],
+                 imports: ImportTable, lines: List[str],
+                 module_globals: Set[str]):
+        self.modshort = modshort
+        self.cls = cls
+        self.imports = imports
+        self.lines = lines
+        self.module_globals = module_globals
+        self.calls: List[List] = []
+        self.acquisitions: List[dict] = []
+        self.edges: List[dict] = []
+        self.calls_under: List[dict] = []
+        self.charges: List[dict] = []
+        self.releases: Set[str] = set()
+        self.finally_callees: List[str] = []
+        self.keys_written: List[List] = []
+        self.keys_read: List[List] = []
+        self._withok_ids: Set[int] = set()
+        self._return_names: Set[str] = set()
+        self._aliases: List[Tuple[str, str]] = []  # dst = src
+
+    def _snippet(self, node: ast.AST) -> str:
+        line = getattr(node, "lineno", 1)
+        if 1 <= line <= len(self.lines):
+            return self.lines[line - 1].strip()
+        return ""
+
+    def _lock_id(self, expr: ast.AST) -> Optional[str]:
+        if isinstance(expr, ast.Attribute) and \
+                isinstance(expr.value, ast.Name) and expr.value.id == "self":
+            if self.cls and _lockish(expr.attr):
+                return f"{self.modshort}.{self.cls}.{expr.attr}"
+            return None
+        if isinstance(expr, ast.Name):
+            if not _lockish(expr.id):
+                return None
+            resolved = self.imports.aliases.get(expr.id)
+            if resolved and "." in resolved:
+                return _strip_pkg(resolved)
+            if expr.id in self.module_globals:
+                return f"{self.modshort}.{expr.id}"
+            return None  # local alias: identity unknown, stay silent
+        if isinstance(expr, ast.Attribute):
+            dotted = self.imports.resolve(expr)
+            if dotted and dotted.startswith(PKG_PREFIX) \
+                    and _lockish(dotted.rsplit(".", 1)[1]):
+                return _strip_pkg(dotted)
+        return None
+
+    # -- statement walk ----------------------------------------------------
+
+    def run(self, fn: ast.AST) -> None:
+        self._walk(fn.body, held=[], in_finally=False)
+        # Resolve charge "returned" verdicts now that every return is seen:
+        # one alias hop (ticket = ...; return ticket  /  h = payload).
+        returned = set(self._return_names)
+        for dst, src in self._aliases:
+            if dst in returned:
+                returned.add(src)
+        for ch in self.charges:
+            bound = ch.pop("_bound", False)
+            names = ch.pop("_bound_names", [])
+            if not ch["ok"] and bound and set(names) & returned:
+                ch["ok"] = "returned"
+            if not ch["ok"] and ch["family"] in self.releases:
+                ch["ok"] = "local-release"
+
+    def _walk(self, stmts: Sequence[ast.stmt], held: List[str],
+              in_finally: bool) -> None:
+        for st in stmts:
+            if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.ClassDef)):
+                continue  # nested scope: extracted as its own function
+            if isinstance(st, (ast.With, ast.AsyncWith)):
+                cur = list(held)
+                for item in st.items:
+                    self._visit_expr(item.context_expr, cur,
+                                     in_finally=in_finally, with_item=True)
+                    lid = self._lock_id(item.context_expr)
+                    if lid is not None:
+                        site = {"lock": lid, "line": item.context_expr.lineno,
+                                "snippet": self._snippet(item.context_expr)}
+                        self.acquisitions.append(site)
+                        for h in cur:
+                            self.edges.append(
+                                {"held": h, "acq": lid,
+                                 "line": site["line"],
+                                 "snippet": site["snippet"]})
+                        cur.append(lid)
+                self._walk(st.body, cur, in_finally)
+            elif isinstance(st, ast.Try):
+                self._walk(st.body, held, in_finally)
+                for h in st.handlers:
+                    if h.type is not None:
+                        self._visit_expr(h.type, held, in_finally=in_finally)
+                    self._walk(h.body, held, in_finally)
+                self._walk(st.orelse, held, in_finally)
+                self._walk(st.finalbody, held, in_finally=True)
+            else:
+                bind_names: List[str] = []
+                bound_self = False
+                if isinstance(st, (ast.Assign, ast.AnnAssign)):
+                    targets = st.targets if isinstance(st, ast.Assign) \
+                        else [st.target]
+                    for t in targets:
+                        names, bself = _target_names(t)
+                        bind_names.extend(names)
+                        bound_self = bound_self or bself
+                    value = st.value
+                    if isinstance(value, ast.Name) and len(bind_names) == 1:
+                        self._aliases.append((bind_names[0], value.id))
+                if isinstance(st, ast.Return) and st.value is not None:
+                    for n in ast.walk(st.value):
+                        if isinstance(n, ast.Name):
+                            self._return_names.add(n.id)
+                lists = _stmt_lists(st)
+                covered = {id(s) for lst in lists for s in lst}
+                for child in ast.iter_child_nodes(st):
+                    if isinstance(child, ast.stmt) or id(child) in covered:
+                        continue
+                    self._visit_expr(child, held, in_finally=in_finally,
+                                     in_return=isinstance(st, ast.Return),
+                                     bind=(bind_names, bound_self))
+                for lst in lists:
+                    self._walk(lst, held, in_finally)
+
+    # -- expression walk ---------------------------------------------------
+
+    def _visit_expr(self, node: ast.AST, held: List[str], *,
+                    in_finally: bool = False, with_item: bool = False,
+                    in_return: bool = False,
+                    bind: Optional[Tuple[List[str], bool]] = None) -> None:
+        root = node
+        stack = [node]
+        while stack:
+            n = stack.pop()
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.ClassDef, ast.Lambda)):
+                continue
+            if isinstance(n, ast.Call):
+                self._on_call(n, held, root=root, in_finally=in_finally,
+                              with_item=with_item, in_return=in_return,
+                              bind=bind)
+            elif isinstance(n, ast.Dict):
+                for k in n.keys:
+                    if isinstance(k, ast.Constant) and isinstance(k.value, str):
+                        self.keys_written.append(
+                            [k.value, k.lineno, self._snippet(k)])
+            elif isinstance(n, ast.Subscript):
+                sl = n.slice
+                if isinstance(sl, ast.Constant) and isinstance(sl.value, str):
+                    if isinstance(n.ctx, ast.Store):
+                        self.keys_written.append(
+                            [sl.value, n.lineno, self._snippet(n)])
+                    else:
+                        self.keys_read.append(
+                            [sl.value, n.lineno, self._snippet(n)])
+            elif isinstance(n, ast.Compare) and \
+                    isinstance(n.left, ast.Constant) and \
+                    isinstance(n.left.value, str) and \
+                    any(isinstance(op, (ast.In, ast.NotIn)) for op in n.ops):
+                self.keys_read.append(
+                    [n.left.value, n.lineno, self._snippet(n)])
+            stack.extend(ast.iter_child_nodes(n))
+
+    def _on_call(self, n: ast.Call, held: List[str], *, root: ast.AST,
+                 in_finally: bool, with_item: bool, in_return: bool,
+                 bind: Optional[Tuple[List[str], bool]]) -> None:
+        name = _call_name(n, self.imports)
+        if name is None:
+            return
+        recv, meth = _split_recv(name)
+        self.calls.append([name, n.lineno])
+        for h in held:
+            self.calls_under.append(
+                {"held": h, "callee": name, "line": n.lineno,
+                 "snippet": self._snippet(n)})
+        if in_finally:
+            self.finally_callees.append(name)
+        # dict(x, k=v) keyword keys count as written wire keys.
+        if meth == "dict" and not recv:
+            for kw in n.keywords:
+                if kw.arg:
+                    self.keys_written.append(
+                        [kw.arg, n.lineno, self._snippet(n)])
+        # .get("k") / .pop("k") / .setdefault("k", ...) read a key.
+        if meth in ("get", "pop", "setdefault") and n.args and \
+                isinstance(n.args[0], ast.Constant) and \
+                isinstance(n.args[0].value, str):
+            self.keys_read.append(
+                [n.args[0].value, n.lineno, self._snippet(n)])
+            if meth == "setdefault":
+                self.keys_written.append(
+                    [n.args[0].value, n.lineno, self._snippet(n)])
+        if meth == "enter_context" or name.endswith(".enter_context"):
+            for a in n.args:
+                if isinstance(a, ast.Call):
+                    self._withok_ids.add(id(a))
+        fam = _family_of(name, "charge")
+        if fam is not None:
+            ok: Optional[str] = None
+            if (with_item and n is root) or id(n) in self._withok_ids:
+                ok = "with"
+            elif in_return:
+                ok = "returned"
+            elif bind is not None and bind[1]:
+                ok = "bound-self"
+            ch = {"family": fam, "line": n.lineno,
+                  "snippet": self._snippet(n), "ok": ok,
+                  "_bound": bool(bind and bind[0]),
+                  "_bound_names": list(bind[0]) if bind else []}
+            self.charges.append(ch)
+        rfam = _family_of(name, "release")
+        if rfam is not None:
+            self.releases.add(rfam)
+
+
+def _stmt_lists(st: ast.stmt) -> List[List[ast.stmt]]:
+    out: List[List[ast.stmt]] = []
+    for f in ("body", "orelse", "finalbody"):
+        v = getattr(st, f, None)
+        if isinstance(v, list) and v and isinstance(v[0], ast.stmt):
+            out.append(v)
+    for h in getattr(st, "handlers", None) or []:
+        out.append(h.body)
+    for c in getattr(st, "cases", None) or []:
+        out.append(c.body)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# module extraction
+
+
+def _modshort(rel_path: str) -> str:
+    mod = rel_path[:-3] if rel_path.endswith(".py") else rel_path
+    mod = mod.replace("/", ".")
+    if mod.endswith(".__init__"):
+        mod = mod[:-len(".__init__")]
+    return _strip_pkg(mod)
+
+
+def extract_module_facts(source: str, rel_path: str) -> dict:
+    """Parse one file into its JSON-serializable fact record. Raises
+    SyntaxError upward — the graph builder degrades per-module."""
+    tree = ast.parse(source)
+    imports = ImportTable(tree)
+    lines = source.splitlines()
+    modshort = _modshort(rel_path)
+    sup = parse_suppressions(source)
+
+    module_globals: Set[str] = set()
+    lock_defs: Dict[str, str] = {}
+    functions: Dict[str, dict] = {}
+
+    def lock_kind_of(value: ast.AST) -> Optional[str]:
+        if isinstance(value, ast.Call):
+            resolved = imports.resolve(value.func)
+            if resolved in _LOCK_CTORS:
+                return _LOCK_CTORS[resolved]
+        return None
+
+    def extract_fn(fn: ast.AST, qual: str, cls: Optional[str]) -> None:
+        ex = _FunctionExtractor(modshort, cls, imports, lines, module_globals)
+        ex.run(fn)
+        functions[qual] = {
+            "name": qual, "line": fn.lineno, "class": cls,
+            "calls": ex.calls,
+            "acquisitions": ex.acquisitions,
+            "edges": ex.edges,
+            "calls_under": ex.calls_under,
+            "charges": ex.charges,
+            "releases": sorted(ex.releases),
+            "finally_callees": ex.finally_callees,
+            "keys_written": ex.keys_written,
+            "keys_read": ex.keys_read,
+        }
+        # self._x = threading.Lock() inside any method defines a class lock.
+        if cls:
+            for st in ast.walk(fn):
+                if isinstance(st, ast.Assign) and len(st.targets) == 1:
+                    t = st.targets[0]
+                    kind = lock_kind_of(st.value)
+                    if kind and isinstance(t, ast.Attribute) and \
+                            isinstance(t.value, ast.Name) and \
+                            t.value.id == "self":
+                        lock_defs[f"{modshort}.{cls}.{t.attr}"] = kind
+        for sub in fn.body:
+            if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                extract_fn(sub, f"{qual}.{sub.name}", cls)
+
+    for node in tree.body:
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                names, _ = _target_names(t)
+                module_globals.update(names)
+            kind = lock_kind_of(node.value)
+            if kind is not None and len(node.targets) == 1 and \
+                    isinstance(node.targets[0], ast.Name):
+                lock_defs[f"{modshort}.{node.targets[0].id}"] = kind
+    for node in tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            extract_fn(node, node.name, None)
+        elif isinstance(node, ast.ClassDef):
+            for sub in node.body:
+                if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    extract_fn(sub, f"{node.name}.{sub.name}", node.name)
+
+    return {
+        "module": modshort,
+        "path": rel_path,
+        "functions": functions,
+        "lock_defs": lock_defs,
+        "suppress": {
+            "file_rules": sorted(sup.file_rules),
+            "line_rules": {str(k): sorted(v)
+                           for k, v in sup.line_rules.items()},
+        },
+    }
+
+
+# ---------------------------------------------------------------------------
+# project graph
+
+
+class ProjectGraph:
+    """Aggregated per-module facts plus resolution indexes."""
+
+    def __init__(self) -> None:
+        self.modules: Dict[str, dict] = {}    # rel_path -> facts
+        self.errors: List[Tuple[str, int, str]] = []  # (rel, line, msg)
+        self._by_modshort: Optional[Dict[str, dict]] = None
+        self._method_index: Optional[Dict[str, List[Tuple[dict, dict]]]] = None
+
+    # -- indexes -----------------------------------------------------------
+
+    @property
+    def by_modshort(self) -> Dict[str, dict]:
+        if self._by_modshort is None:
+            self._by_modshort = {f["module"]: f for f in self.modules.values()}
+        return self._by_modshort
+
+    @property
+    def method_index(self) -> Dict[str, List[Tuple[dict, dict]]]:
+        """bare method name -> [(module facts, fn facts)] across classes."""
+        if self._method_index is None:
+            idx: Dict[str, List[Tuple[dict, dict]]] = {}
+            for facts, fn in self.functions():
+                if fn["class"] and fn["name"].count(".") == 1:
+                    meth = fn["name"].split(".", 1)[1]
+                    idx.setdefault(meth, []).append((facts, fn))
+            self._method_index = idx
+        return self._method_index
+
+    def functions(self) -> Iterable[Tuple[dict, dict]]:
+        for facts in self.modules.values():
+            for fn in facts["functions"].values():
+                yield facts, fn
+
+    @property
+    def lock_kinds(self) -> Dict[str, str]:
+        out: Dict[str, str] = {}
+        for facts in self.modules.values():
+            out.update(facts["lock_defs"])
+        return out
+
+    def suppressions_for(self, rel_path: str) -> Optional[Suppressions]:
+        facts = self.modules.get(rel_path)
+        if facts is None:
+            return None
+        sup = facts["suppress"]
+        return Suppressions(
+            file_rules=set(sup["file_rules"]),
+            line_rules={int(k): set(v)
+                        for k, v in sup["line_rules"].items()})
+
+    # -- call resolution ---------------------------------------------------
+
+    def resolve_callee(self, facts: dict, fn: dict,
+                       name: str) -> Optional[Tuple[dict, dict]]:
+        """One level of qualified-name resolution: ``self.meth`` to a
+        sibling method, a bare name to a same-module function, a dotted
+        path through the import table, and — for unresolvable receivers —
+        a project-wide *unique* method name."""
+        if name.startswith("self."):
+            rest = name[len("self."):]
+            if "." not in rest and fn["class"]:
+                target = facts["functions"].get(f"{fn['class']}.{rest}")
+                if target is not None:
+                    return facts, target
+            return self._unique_method(rest.rsplit(".", 1)[-1])
+        if "." not in name:
+            target = facts["functions"].get(name)
+            if target is not None:
+                return facts, target
+            return None
+        dotted = _strip_pkg(name)
+        parts = dotted.split(".")
+        for i in range(len(parts) - 1, 0, -1):
+            mod = ".".join(parts[:i])
+            owner = self.by_modshort.get(mod)
+            if owner is not None:
+                qual = ".".join(parts[i:])
+                target = owner["functions"].get(qual)
+                if target is not None:
+                    return owner, target
+                return None
+        return self._unique_method(parts[-1])
+
+    #: Too generic for the unique-method fallback: sharing a name with a
+    #: stdlib/file/queue method means "unique across OUR classes" proves
+    #: nothing about the receiver (self._f.flush is not RuntimeStats.flush).
+    _AMBIENT_METHODS = frozenset({
+        "flush", "close", "open", "write", "read", "get", "put", "pop",
+        "release", "acquire", "append", "extend", "items", "values", "keys",
+        "start", "stop", "join", "run", "send", "recv", "wait", "notify",
+        "set", "clear", "copy", "update", "add", "remove", "submit", "result",
+    })
+
+    def _unique_method(self, meth: str) -> Optional[Tuple[dict, dict]]:
+        if meth in self._AMBIENT_METHODS:
+            return None
+        hits = self.method_index.get(meth, [])
+        if len(hits) == 1:
+            return hits[0]
+        return None
+
+
+# ---------------------------------------------------------------------------
+# cache + build
+
+
+def _iter_py_files(paths: Sequence[str]) -> Iterable[str]:
+    for p in paths:
+        if os.path.isfile(p):
+            if p.endswith(".py"):
+                yield p
+        else:
+            for dirpath, dirnames, filenames in os.walk(p):
+                dirnames[:] = sorted(d for d in dirnames
+                                     if d not in _SKIP_DIRS)
+                for fn in sorted(filenames):
+                    if fn.endswith(".py"):
+                        yield os.path.join(dirpath, fn)
+
+
+def _rel(path: str, root: str) -> str:
+    abspath = os.path.abspath(path)
+    absroot = os.path.abspath(root)
+    if abspath.startswith(absroot + os.sep):
+        return os.path.relpath(abspath, absroot).replace(os.sep, "/")
+    return abspath.replace(os.sep, "/")
+
+
+def _load_cache(cache_path: Optional[str]) -> Dict[str, dict]:
+    if not cache_path or not os.path.isfile(cache_path):
+        return {}
+    try:
+        with open(cache_path, "r", encoding="utf-8") as fh:
+            doc = json.load(fh)
+    except (OSError, ValueError):
+        return {}
+    if doc.get("version") != FACTS_VERSION:
+        return {}
+    files = doc.get("files")
+    return files if isinstance(files, dict) else {}
+
+
+def _save_cache(cache_path: Optional[str], files: Dict[str, dict]) -> None:
+    if not cache_path:
+        return
+    try:
+        tmp = cache_path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as fh:
+            json.dump({"version": FACTS_VERSION, "files": files}, fh)
+        os.replace(tmp, cache_path)
+    except OSError:
+        pass  # cache is an optimization, never a failure
+
+
+def build_project_graph(paths: Sequence[str], *, root: str,
+                        cache_path: Optional[str] = None) -> ProjectGraph:
+    """Build (or incrementally refresh) the project graph over ``paths``.
+
+    Cache entries are keyed on ``(mtime_ns, size)``; only changed files
+    re-parse. A file with a syntax error lands in ``graph.errors`` instead
+    of aborting the build — the rest of the tree still gets whole-program
+    analysis.
+    """
+    cached = _load_cache(cache_path)
+    graph = ProjectGraph()
+    fresh: Dict[str, dict] = {}
+    dirty = False
+    for path in _iter_py_files(paths):
+        rel = _rel(path, root)
+        try:
+            st = os.stat(path)
+        except OSError:
+            continue
+        entry = cached.get(rel)
+        if entry is not None and entry.get("mtime_ns") == st.st_mtime_ns \
+                and entry.get("size") == st.st_size:
+            fresh[rel] = entry
+            if "facts" in entry:
+                graph.modules[rel] = entry["facts"]
+            else:
+                graph.errors.append((rel, entry.get("error_line", 1),
+                                     entry.get("error_msg", "syntax error")))
+            continue
+        dirty = True
+        try:
+            with open(path, "r", encoding="utf-8") as fh:
+                source = fh.read()
+            facts = extract_module_facts(source, rel)
+        except SyntaxError as e:
+            fresh[rel] = {"mtime_ns": st.st_mtime_ns, "size": st.st_size,
+                          "error_line": e.lineno or 1,
+                          "error_msg": e.msg or "syntax error"}
+            graph.errors.append((rel, e.lineno or 1,
+                                 e.msg or "syntax error"))
+            continue
+        except OSError:
+            continue
+        fresh[rel] = {"mtime_ns": st.st_mtime_ns, "size": st.st_size,
+                      "facts": facts}
+        graph.modules[rel] = facts
+    if dirty or set(fresh) != set(cached):
+        _save_cache(cache_path, fresh)
+    return graph
+
+
+# ---------------------------------------------------------------------------
+# lock_order.toml — restricted TOML-subset parser (this interpreter has no
+# tomllib and daftlint must not grow dependencies)
+
+LOCK_ORDER_NAME = "lock_order.toml"
+
+
+def default_lock_order_path() -> str:
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        LOCK_ORDER_NAME)
+
+
+def parse_lock_order(text: str) -> List[dict]:
+    """Parse the ``[[order]]`` tables of lock_order.toml.
+
+    Supported subset: ``[[order]]`` headers, ``key = "string"`` and
+    ``key = ["a", "b", ...]`` (arrays may span lines), ``#`` comments.
+    Anything else raises ValueError — the file is ours, keep it simple.
+    """
+    chains: List[dict] = []
+    current: Optional[dict] = None
+    pending_key: Optional[str] = None
+    pending_items: List[str] = []
+    in_array = False
+
+    def finish_array() -> None:
+        nonlocal in_array, pending_key, pending_items
+        assert current is not None and pending_key is not None
+        current[pending_key] = pending_items
+        in_array = False
+        pending_key = None
+        pending_items = []
+
+    for lineno, raw in enumerate(text.splitlines(), start=1):
+        line = _strip_comment(raw).strip()
+        if in_array:
+            closed = line.endswith("]")
+            body = line[:-1] if closed else line
+            pending_items.extend(_parse_string_items(body, lineno))
+            if closed:
+                finish_array()
+            continue
+        if not line:
+            continue
+        if line == "[[order]]":
+            current = {}
+            chains.append(current)
+            continue
+        if line.startswith("["):
+            raise ValueError(f"line {lineno}: unsupported table {line!r}")
+        if "=" not in line:
+            raise ValueError(f"line {lineno}: expected key = value")
+        if current is None:
+            raise ValueError(f"line {lineno}: key outside [[order]] table")
+        key, _, value = line.partition("=")
+        key = key.strip()
+        value = value.strip()
+        if value.startswith('"'):
+            items = _parse_string_items(value, lineno)
+            if len(items) != 1:
+                raise ValueError(f"line {lineno}: expected one string")
+            current[key] = items[0]
+        elif value.startswith("["):
+            body = value[1:]
+            if body.rstrip().endswith("]"):
+                current[key] = _parse_string_items(body.rstrip()[:-1], lineno)
+            else:
+                pending_key = key
+                pending_items = _parse_string_items(body, lineno)
+                in_array = True
+        else:
+            raise ValueError(f"line {lineno}: unsupported value {value!r}")
+    if in_array:
+        raise ValueError("unterminated array")
+    for c in chains:
+        if "locks" not in c or not isinstance(c.get("locks"), list):
+            raise ValueError("each [[order]] table needs a locks array")
+    return chains
+
+
+def _strip_comment(line: str) -> str:
+    """Drop a ``#`` comment, respecting double-quoted strings."""
+    out = []
+    in_str = False
+    for ch in line:
+        if ch == '"':
+            in_str = not in_str
+        elif ch == "#" and not in_str:
+            break
+        out.append(ch)
+    return "".join(out)
+
+
+def _parse_string_items(body: str, lineno: int) -> List[str]:
+    items: List[str] = []
+    rest = body.strip()
+    while rest:
+        if rest.startswith(","):
+            rest = rest[1:].lstrip()
+            continue
+        if rest.startswith("#"):
+            break
+        if not rest.startswith('"'):
+            raise ValueError(f"line {lineno}: expected string in {body!r}")
+        end = rest.find('"', 1)
+        if end < 0:
+            raise ValueError(f"line {lineno}: unterminated string")
+        items.append(rest[1:end])
+        rest = rest[end + 1:].lstrip()
+    return items
+
+
+def load_lock_order(path: Optional[str] = None) -> List[dict]:
+    path = path or default_lock_order_path()
+    if not os.path.isfile(path):
+        return []
+    with open(path, "r", encoding="utf-8") as fh:
+        return parse_lock_order(fh.read())

@@ -1,0 +1,311 @@
+"""daftlint project-tier rules (DTL011–DTL013).
+
+These consume the :class:`~daft_tpu.lint.project.ProjectGraph` instead of a
+single :class:`FileContext` — each finding still points at a real file/line
+and flows through the same suppression + baseline machinery, tagged
+``analysis="project"`` in the v2 JSON schema.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+from daft_tpu.lint.core import Finding, Rule
+from daft_tpu.lint.project import ProjectGraph, load_lock_order
+
+
+class ProjectRule(Rule):
+    """A rule that analyzes the whole-program graph. ``check`` (file tier)
+    is a no-op so a mixed rule list can flow through ``lint_source``."""
+
+    analysis = "project"
+
+    def check(self, ctx) -> Iterable[Finding]:
+        return ()
+
+    def check_project(self, graph: ProjectGraph) -> Iterable[Finding]:
+        raise NotImplementedError
+
+    def project_finding(self, path: str, line: int, snippet: str,
+                        message: str) -> Finding:
+        return Finding(rule=self.rule_id, path=path, line=line, col=0,
+                       message=message, snippet=snippet, analysis="project")
+
+
+# ---------------------------------------------------------------------------
+# DTL011 — lock-order cycles / declared-order contradictions
+
+
+class LockOrderCycle(ProjectRule):
+    rule_id = "DTL011"
+    summary = ("global lock-order graph must be acyclic and agree with the "
+               "declared order in lint/lock_order.toml")
+
+    def __init__(self, lock_order_path: Optional[str] = None):
+        self.lock_order_path = lock_order_path
+
+    def check_project(self, graph: ProjectGraph) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        # edge (held -> acquired) -> first witness site
+        edges: Dict[Tuple[str, str], dict] = {}
+        lock_kinds = graph.lock_kinds
+
+        def add_edge(a: str, b: str, path: str, line: int, snippet: str,
+                     via: Optional[str]) -> None:
+            edges.setdefault((a, b), {"path": path, "line": line,
+                                      "snippet": snippet, "via": via})
+
+        for facts, fn in graph.functions():
+            for e in fn["edges"]:
+                add_edge(e["held"], e["acq"], facts["path"], e["line"],
+                         e["snippet"], None)
+            for c in fn["calls_under"]:
+                target = graph.resolve_callee(facts, fn, c["callee"])
+                if target is None:
+                    continue
+                _, tfn = target
+                for acq in tfn["acquisitions"]:
+                    a, b = c["held"], acq["lock"]
+                    if a == b:
+                        # Reacquiring the lock you hold through a callee is
+                        # a self-deadlock only for non-reentrant kinds; a
+                        # class-keyed identity cannot tell two instances
+                        # apart, so only flag the unambiguous case.
+                        if lock_kinds.get(a) == "Lock":
+                            findings.append(self.project_finding(
+                                facts["path"], c["line"], c["snippet"],
+                                f"call to {c['callee']} while holding "
+                                f"{a} re-acquires the same non-reentrant "
+                                f"lock (self-deadlock)"))
+                        continue
+                    add_edge(a, b, facts["path"], c["line"], c["snippet"],
+                             c["callee"])
+
+        # Declared order: A before B in a chain forbids any extracted B->A.
+        declared_before: Dict[Tuple[str, str], str] = {}
+        for chain in load_lock_order(self.lock_order_path):
+            locks = chain.get("locks", [])
+            name = chain.get("name", "?")
+            for i in range(len(locks)):
+                for j in range(i + 1, len(locks)):
+                    declared_before[(locks[i], locks[j])] = name
+        for (a, b), w in sorted(edges.items()):
+            chain = declared_before.get((b, a))
+            if chain is not None:
+                via = f" (via {w['via']})" if w["via"] else ""
+                findings.append(self.project_finding(
+                    w["path"], w["line"], w["snippet"],
+                    f"acquires {b} while holding {a}{via}, contradicting "
+                    f"declared lock order chain '{chain}'"))
+
+        findings.extend(self._cycles(edges))
+        return findings
+
+    def _cycles(self, edges: Dict[Tuple[str, str], dict]) -> List[Finding]:
+        adj: Dict[str, List[str]] = {}
+        for a, b in edges:
+            adj.setdefault(a, []).append(b)
+        findings: List[Finding] = []
+        seen_cycles = set()
+        WHITE, GREY, BLACK = 0, 1, 2
+        color: Dict[str, int] = {}
+        for start in sorted(adj):
+            if color.get(start, WHITE) != WHITE:
+                continue
+            stack: List[Tuple[str, int]] = [(start, 0)]
+            path: List[str] = []
+            while stack:
+                node, idx = stack.pop()
+                if idx == 0:
+                    color[node] = GREY
+                    path.append(node)
+                nbrs = sorted(adj.get(node, ()))
+                if idx < len(nbrs):
+                    stack.append((node, idx + 1))
+                    nxt = nbrs[idx]
+                    st = color.get(nxt, WHITE)
+                    if st == GREY:
+                        cyc = path[path.index(nxt):] + [nxt]
+                        key = frozenset(cyc)
+                        if key not in seen_cycles:
+                            seen_cycles.add(key)
+                            w = edges[(cyc[0], cyc[1])] \
+                                if (cyc[0], cyc[1]) in edges \
+                                else edges[(node, nxt)]
+                            findings.append(self.project_finding(
+                                w["path"], w["line"], w["snippet"],
+                                "lock-order cycle: " + " -> ".join(cyc)))
+                    elif st == WHITE:
+                        stack.append((nxt, 0))
+                else:
+                    color[node] = BLACK
+                    path.pop()
+        return findings
+
+
+# ---------------------------------------------------------------------------
+# DTL012 — unpaired resource charge
+
+
+class UnpairedResource(ProjectRule):
+    rule_id = "DTL012"
+    summary = ("every charge-shaped call (ledger charge, permit acquire, "
+               "admission admit, single-flight claim, profiler begin, fault "
+               "scope) must be structurally paired with its release")
+
+    def check_project(self, graph: ProjectGraph) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        for facts, fn in graph.functions():
+            for ch in fn["charges"]:
+                if ch["ok"]:
+                    continue
+                fam = ch["family"]
+                if self._class_sibling_releases(graph, facts, fn, fam):
+                    continue
+                if self._finally_callee_releases(graph, facts, fn, fam):
+                    continue
+                findings.append(self.project_finding(
+                    facts["path"], ch["line"], ch["snippet"],
+                    f"{fam} charge has no structural release pairing (not "
+                    f"a with-item, not released in a finally/cleanup path, "
+                    f"not returned to the caller)"))
+        return findings
+
+    @staticmethod
+    def _class_sibling_releases(graph: ProjectGraph, facts: dict, fn: dict,
+                                fam: str) -> bool:
+        """Deferred-release object protocol: the charge's class owns the
+        obligation and some method of the same class releases it."""
+        cls = fn["class"]
+        if not cls:
+            return False
+        prefix = cls + "."
+        for other in facts["functions"].values():
+            if other["name"].startswith(prefix) and fam in other["releases"]:
+                return True
+        return False
+
+    @staticmethod
+    def _finally_callee_releases(graph: ProjectGraph, facts: dict, fn: dict,
+                                 fam: str) -> bool:
+        """Cross-function pairing: a cleanup-path callee (called from some
+        finally in this function) contains the matching release."""
+        for callee in fn["finally_callees"]:
+            target = graph.resolve_callee(facts, fn, callee)
+            if target is not None and fam in target[1]["releases"]:
+                return True
+        return False
+
+
+# ---------------------------------------------------------------------------
+# DTL013 — wire-contract drift
+
+
+#: Payload families: keys written by the writer sites must be read by the
+#: reader sites and vice versa. Site specs are (path suffix, qualname
+#: prefix); a spec matches nested defs too ("ProcessWorker.submit" covers
+#: "ProcessWorker.submit.run").
+WIRE_FAMILIES: List[dict] = [
+    {
+        "name": "process-task-request",
+        "writers": [("distributed/process_worker.py", "ProcessWorker.submit")],
+        "readers": [("distributed/process_worker.py", "_worker_entry")],
+        "ignore": set(),
+    },
+    {
+        "name": "process-task-reply",
+        "writers": [("distributed/process_worker.py", "_worker_entry")],
+        "readers": [("distributed/process_worker.py", "ProcessWorker.submit")],
+        "ignore": set(),
+    },
+    {
+        "name": "daemon-wire",
+        "writers": [("distributed/daemon.py", "RemoteWorker"),
+                    ("distributed/daemon.py", "WorkerDaemon"),
+                    ("distributed/daemon.py", "encode_ref")],
+        "readers": [("distributed/daemon.py", "RemoteWorker"),
+                    ("distributed/daemon.py", "WorkerDaemon"),
+                    ("distributed/daemon.py", "decode_ref")],
+        "ignore": set(),
+    },
+    {
+        "name": "mem-wire",
+        "writers": [("execution/memledger.py", "_QueryLedger.snapshot"),
+                    ("execution/memledger.py",
+                     "MemoryLedger.drain_query_wire")],
+        "readers": [("execution/memledger.py",
+                     "MemoryLedger.merge_worker_profile"),
+                    ("execution/memledger.py",
+                     "MemoryLedger.drain_query_wire")],
+        "ignore": set(),
+    },
+    {
+        "name": "stats-wire",
+        "writers": [("execution/resource_manager.py", "RuntimeStats.to_wire")],
+        "readers": [("execution/resource_manager.py", "emit_operator_stats")],
+        "ignore": set(),
+    },
+    {
+        "name": "span-wire",
+        "writers": [("profiling.py", "span_to_wire")],
+        "readers": [("profiling.py", "span_from_wire")],
+        "ignore": set(),
+    },
+]
+
+
+class WireContractDrift(ProjectRule):
+    rule_id = "DTL013"
+    summary = ("worker->driver payload keys must be both written by the "
+               "wire writers and read by the driver merge paths")
+
+    def __init__(self, families: Optional[Sequence[dict]] = None):
+        self.families = list(families) if families is not None \
+            else WIRE_FAMILIES
+
+    @staticmethod
+    def _matches(facts: dict, fn: dict, specs: Sequence[tuple]) -> bool:
+        for path_suffix, qual in specs:
+            if not facts["path"].endswith(path_suffix):
+                continue
+            name = fn["name"]
+            if name == qual or name.startswith(qual + "."):
+                return True
+        return False
+
+    def check_project(self, graph: ProjectGraph) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        for fam in self.families:
+            written: Dict[str, tuple] = {}
+            read: Dict[str, tuple] = {}
+            for facts, fn in graph.functions():
+                if self._matches(facts, fn, fam["writers"]):
+                    for key, line, snippet in fn["keys_written"]:
+                        written.setdefault(key,
+                                           (facts["path"], line, snippet))
+                if self._matches(facts, fn, fam["readers"]):
+                    for key, line, snippet in fn["keys_read"]:
+                        read.setdefault(key, (facts["path"], line, snippet))
+            if not written and not read:
+                continue  # family's modules not in scope for this run
+            ignore = fam.get("ignore", set())
+            for key in sorted(set(written) - set(read) - set(ignore)):
+                path, line, snippet = written[key]
+                findings.append(self.project_finding(
+                    path, line, snippet,
+                    f"wire key '{key}' in {fam['name']} payload is written "
+                    f"but never read by any declared reader"))
+            for key in sorted(set(read) - set(written) - set(ignore)):
+                path, line, snippet = read[key]
+                findings.append(self.project_finding(
+                    path, line, snippet,
+                    f"wire key '{key}' in {fam['name']} payload is read "
+                    f"but never written by any declared writer"))
+        return findings
+
+
+PROJECT_RULES = [LockOrderCycle, UnpairedResource, WireContractDrift]
+
+
+def default_project_rules() -> List[ProjectRule]:
+    return [cls() for cls in PROJECT_RULES]

@@ -13,7 +13,10 @@ from typing import List
 from daft_tpu.lint.baseline import BaselineEntry
 from daft_tpu.lint.core import Finding
 
-JSON_SCHEMA_VERSION = 1
+#: v2 added the per-finding ``analysis`` ("file" | "project") field when the
+#: whole-program tier (DTL011–DTL013) landed. scripts/lint_report.py accepts
+#: both v1 and v2 documents.
+JSON_SCHEMA_VERSION = 2
 
 
 @dataclass
@@ -25,6 +28,8 @@ class LintResult:
     stale_baseline: List[BaselineEntry] = field(default_factory=list)
     #: rel paths actually scanned (scopes stale detection / baseline updates)
     scanned_paths: List[str] = field(default_factory=list)
+    #: modules in the whole-program graph (0 when the project tier is off)
+    project_files: int = 0
 
     @property
     def exit_code(self) -> int:
@@ -51,12 +56,17 @@ def render_text(result: LintResult, *, verbose: bool = False) -> str:
         for e in sorted(result.stale_baseline, key=lambda e: (e.path, e.rule)):
             lines.append(f"  {e.rule} {e.path}: {e.snippet!r}")
     lines.append("")
+    tiers = ""
+    if result.project_files:
+        n_proj = sum(1 for f in result.new if f.analysis == "project")
+        tiers = (f" [project tier: {result.project_files} modules, "
+                 f"{n_proj} new]")
     lines.append(
         f"daftlint: {result.files_checked} files, "
         f"{len(result.new)} new finding(s), "
         f"{len(result.baselined)} baselined, "
         f"{result.suppressed} suppressed, "
-        f"{len(result.stale_baseline)} stale baseline entr(ies)")
+        f"{len(result.stale_baseline)} stale baseline entr(ies){tiers}")
     return "\n".join(lines)
 
 

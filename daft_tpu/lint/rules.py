@@ -630,14 +630,17 @@ class UnboundedQueueInEnginePath(Rule):
         return True
 
 
+from daft_tpu.lint.project_rules import PROJECT_RULES  # noqa: E402
+
 ALL_RULES = [WallClockInTaskPath, SwallowedException, UnseededRandomness,
              BlockingCallUnderLock, HostDeviceTransferInKernel,
              NondeterministicIteration, EnvReadOutsideConfig,
              AdHocCounterDict, SpanOutsideContextManager,
-             UnboundedQueueInEnginePath]
+             UnboundedQueueInEnginePath] + PROJECT_RULES
 
 
 def default_rules() -> List[Rule]:
+    """Every rule, both tiers: file (DTL001–DTL010) + project (DTL011+)."""
     return [cls() for cls in ALL_RULES]
 
 

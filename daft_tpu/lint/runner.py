@@ -1,13 +1,21 @@
-"""File walking + rule application + suppression/baseline filtering."""
+"""File walking + rule application + suppression/baseline filtering.
+
+Two analysis tiers run here: the file tier (DTL001–DTL010, one module at a
+time) and the project tier (DTL011–DTL013 over the whole-program graph,
+``project.py``). A partial file set (``--changed-only``) only narrows the
+file tier — the graph is always built whole, cheaply, from its cache.
+"""
 
 from __future__ import annotations
 
 import ast
 import os
+import subprocess
 from typing import Iterable, List, Optional, Sequence, Tuple
 
 from daft_tpu.lint.baseline import DEFAULT_BASELINE_NAME, Baseline
 from daft_tpu.lint.core import FileContext, Finding, Rule
+from daft_tpu.lint.project import GRAPH_CACHE_NAME, build_project_graph
 from daft_tpu.lint.reporters import LintResult
 from daft_tpu.lint.rules import default_rules
 
@@ -52,6 +60,7 @@ def lint_source(source: str, rel_path: str,
     A syntax error becomes a DTL000 finding rather than an exception: the
     analyzer must keep working on a broken tree (that is when you need it)."""
     rules = list(rules) if rules is not None else default_rules()
+    rules = [r for r in rules if getattr(r, "analysis", "file") == "file"]
     try:
         tree = ast.parse(source)
     except SyntaxError as e:
@@ -71,20 +80,42 @@ def lint_source(source: str, rel_path: str,
 
 def run_paths(paths: Sequence[str], *, root: Optional[str] = None,
               rules: Optional[Sequence[Rule]] = None,
-              baseline: Optional[Baseline] = None) -> LintResult:
+              baseline: Optional[Baseline] = None,
+              project: bool = True,
+              project_paths: Optional[Sequence[str]] = None,
+              graph_cache: Optional[str] = "auto") -> LintResult:
+    """Run both analysis tiers over ``paths``.
+
+    ``project_paths`` (default: ``paths``) is the file set the project
+    graph is built from — pass the whole package when ``paths`` is a
+    changed-files subset. ``graph_cache`` is "auto" (the graph cache file
+    at the repo root), an explicit path, or None to disable caching.
+    """
     root = root or repo_root()
     rules = list(rules) if rules is not None else default_rules()
+    file_rules = [r for r in rules
+                  if getattr(r, "analysis", "file") == "file"]
+    proj_rules = [r for r in rules
+                  if getattr(r, "analysis", "file") == "project"]
     result = LintResult()
     all_findings: List[Finding] = []
     for path in _iter_py_files(paths):
         rel = _rel(path, root)
         with open(path, "r", encoding="utf-8") as fh:
             source = fh.read()
-        findings, suppressed = lint_source(source, rel, rules)
+        findings, suppressed = lint_source(source, rel, file_rules)
         all_findings.extend(findings)
         result.suppressed += suppressed
         result.files_checked += 1
         result.scanned_paths.append(rel)
+    if project and proj_rules:
+        kept, suppressed, project_files = _run_project_tier(
+            proj_rules, project_paths or paths, root, graph_cache,
+            file_dtl000={f.path for f in all_findings
+                         if f.rule == "DTL000"})
+        all_findings.extend(kept)
+        result.suppressed += suppressed
+        result.project_files = project_files
     if baseline is not None:
         result.new, result.baselined, stale = \
             baseline.partition(all_findings)
@@ -98,6 +129,71 @@ def run_paths(paths: Sequence[str], *, root: Optional[str] = None,
     else:
         result.new = all_findings
     return result
+
+
+def _run_project_tier(proj_rules: Sequence[Rule], paths: Sequence[str],
+                      root: str, graph_cache: Optional[str],
+                      file_dtl000: set) -> Tuple[List[Finding], int, int]:
+    """Build the project graph and run the project rules over it.
+
+    Returns (findings, n_suppressed, modules_in_graph). A module that
+    failed to parse is excluded from the graph and surfaced as a
+    project-tier DTL000 warning — unless the file tier already reported
+    the same syntax error (no double noise on full runs).
+    """
+    cache_path = None
+    if graph_cache == "auto":
+        cache_path = os.path.join(root, GRAPH_CACHE_NAME)
+    elif graph_cache is not None:
+        cache_path = graph_cache
+    graph = build_project_graph(paths, root=root, cache_path=cache_path)
+    findings: List[Finding] = []
+    for rel, line, msg in graph.errors:
+        if rel not in file_dtl000:
+            findings.append(Finding(
+                rule="DTL000", path=rel, line=line, col=0,
+                message=f"syntax error: {msg} — module excluded from "
+                        f"whole-program analysis", snippet="",
+                analysis="project"))
+    for rule in proj_rules:
+        findings.extend(rule.check_project(graph))
+    kept: List[Finding] = []
+    suppressed = 0
+    for f in findings:
+        sup = graph.suppressions_for(f.path)
+        if sup is not None and sup.is_suppressed(f):
+            suppressed += 1
+        else:
+            kept.append(f)
+    return kept, suppressed, len(graph.modules)
+
+
+def changed_py_files(root: str) -> Optional[List[str]]:
+    """Python files changed vs HEAD (staged, unstaged, and untracked),
+    for ``--changed-only``. None when git is unavailable — the caller
+    falls back to a full run."""
+    try:
+        diff = subprocess.run(
+            ["git", "-C", root, "diff", "--name-only", "HEAD"],
+            capture_output=True, text=True, timeout=30)
+        status = subprocess.run(
+            ["git", "-C", root, "status", "--porcelain", "--untracked-files"],
+            capture_output=True, text=True, timeout=30)
+    except (OSError, subprocess.SubprocessError):
+        return None
+    if diff.returncode != 0 or status.returncode != 0:
+        return None
+    names = set(diff.stdout.splitlines())
+    for line in status.stdout.splitlines():
+        if line.startswith("??"):
+            names.add(line[2:].strip())
+    out = []
+    for name in sorted(names):
+        if name.endswith(".py"):
+            full = os.path.join(root, name)
+            if os.path.isfile(full):
+                out.append(full)
+    return out
 
 
 def find_baseline(root: str) -> Optional[str]:

@@ -47,19 +47,43 @@ def load_document(argv) -> dict:
     return json.loads(render_json(result))
 
 
+#: Accepted daftlint JSON schema versions: v1 (file tier only) and v2
+#: (per-finding ``analysis`` tags the whole-program tier).
+ACCEPTED_VERSIONS = (1, 2)
+
+
+def _tier(finding: dict) -> str:
+    # v1 documents predate the project tier: every finding is file-tier.
+    return finding.get("analysis", "file")
+
+
 def main(argv) -> int:
     doc = load_document(argv)
     if doc.get("tool") != "daftlint":
         print("lint_report: input is not a daftlint JSON document",
               file=sys.stderr)
         return 2
+    if doc.get("version") not in ACCEPTED_VERSIONS:
+        print(f"lint_report: unsupported daftlint schema version "
+              f"{doc.get('version')!r} (accepted: {ACCEPTED_VERSIONS})",
+              file=sys.stderr)
+        return 2
     summary = doc["summary"]
     new = [f for f in doc["findings"] if not f["baselined"]]
     stale = doc.get("stale_baseline", [])
+    by_tier = {"file": 0, "project": 0}
+    base_by_tier = {"file": 0, "project": 0}
+    for f in doc["findings"]:
+        bucket = base_by_tier if f["baselined"] else by_tier
+        bucket[_tier(f)] = bucket.get(_tier(f), 0) + 1
 
-    print(f"daftlint report — {summary['files']} files scanned")
-    print(f"  new:            {summary['new']}")
-    print(f"  baselined:      {summary['baselined']} (grandfathered)")
+    print(f"daftlint report — {summary['files']} files scanned "
+          f"(schema v{doc['version']})")
+    print(f"  new:            {summary['new']} "
+          f"(file-tier {by_tier['file']}, project-tier {by_tier['project']})")
+    print(f"  baselined:      {summary['baselined']} (grandfathered; "
+          f"file-tier {base_by_tier['file']}, "
+          f"project-tier {base_by_tier['project']})")
     print(f"  suppressed:     {summary['suppressed']} (inline, with reasons)")
     print(f"  stale baseline: {summary['stale_baseline']}")
 
@@ -67,7 +91,7 @@ def main(argv) -> int:
         print("\nNEW findings (these block the gate):")
         for f in new:
             print(f"  {f['path']}:{f['line']}:{f['col']}: {f['rule']} "
-                  f"{f['message']}")
+                  f"[{_tier(f)}] {f['message']}")
             if f.get("snippet"):
                 print(f"      {f['snippet']}")
     if stale:

@@ -494,16 +494,46 @@ def test_json_reporter_schema_is_stable():
     doc = json.loads(render_json(result))
     assert set(doc) == {"version", "tool", "summary", "findings",
                         "stale_baseline"}
-    assert doc["version"] == 1 and doc["tool"] == "daftlint"
+    assert doc["version"] == 2 and doc["tool"] == "daftlint"
     assert set(doc["summary"]) == {"files", "new", "baselined", "suppressed",
                                    "stale_baseline"}
     assert doc["summary"] == {"files": 2, "new": 1, "baselined": 1,
                               "suppressed": 3, "stale_baseline": 0}
     for f in doc["findings"]:
         assert set(f) == {"rule", "path", "line", "col", "message",
-                          "snippet", "baselined"}
+                          "snippet", "baselined", "analysis"}
+        assert f["analysis"] in ("file", "project")
     # new findings sort before baselined ones
     assert [f["baselined"] for f in doc["findings"]] == [False, True]
+
+
+def test_report_script_accepts_v1_and_v2_documents(tmp_path):
+    """scripts/lint_report.py must keep reading v1 archives (no ``analysis``
+    key) alongside v2, and reject unknown versions."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "lint_report", os.path.join(repo_root(), "scripts", "lint_report.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    assert mod.ACCEPTED_VERSIONS == (1, 2)
+
+    v2 = json.loads(render_json(LintResult(files_checked=1, new=[_finding()])))
+    assert v2["version"] == 2
+    v1 = json.loads(render_json(LintResult(files_checked=1)))
+    v1["version"] = 1
+    for f in v1["findings"]:
+        del f["analysis"]  # v1 predates the project tier
+
+    def _run(doc):
+        path = tmp_path / "report.json"
+        path.write_text(json.dumps(doc))
+        return mod.main(["lint_report", str(path)])
+
+    assert _run(v1) == 0          # clean v1 document parses
+    assert _run(v2) == 1          # v2 with a new finding trips the gate
+    bad = dict(v1, version=99)
+    assert _run(bad) == 2         # unknown schema version is a usage error
 
 
 def test_text_reporter_mentions_location_and_counts():
@@ -522,8 +552,13 @@ def test_text_reporter_mentions_location_and_counts():
 def test_rule_registry_complete():
     assert sorted(rules_by_id()) == [
         "DTL001", "DTL002", "DTL003", "DTL004", "DTL005", "DTL006", "DTL007",
-        "DTL008", "DTL009", "DTL010"]
-    assert len(default_rules()) == 10
+        "DTL008", "DTL009", "DTL010", "DTL011", "DTL012", "DTL013"]
+    assert len(default_rules()) == 13
+    # The project tier is exactly the DTL011+ rules.
+    tiers = {cls.rule_id: getattr(cls, "analysis", "file")
+             for cls in rules_by_id().values()}
+    assert [rid for rid, t in sorted(tiers.items()) if t == "project"] == [
+        "DTL011", "DTL012", "DTL013"]
 
 
 def test_package_sweep_has_zero_new_violations():

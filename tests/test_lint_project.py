@@ -1,0 +1,542 @@
+"""daftlint whole-program tier (DTL011–DTL013): project-graph extraction,
+cache invalidation, lock-order cycle injection, declared-order
+contradictions, paired-resource pos/neg fixtures, wire-contract phantom
+keys, the DTL000 degrade path, and the lock_order.toml subset parser."""
+
+import json
+import os
+import subprocess
+import textwrap
+
+import pytest
+
+from daft_tpu.lint import (
+    Finding,
+    build_project_graph,
+    changed_py_files,
+    extract_module_facts,
+    parse_lock_order,
+    run_paths,
+)
+from daft_tpu.lint.project import FACTS_VERSION
+from daft_tpu.lint.project_rules import (
+    LockOrderCycle,
+    UnpairedResource,
+    WireContractDrift,
+)
+
+
+def make_tree(tmp_path, files):
+    """Write {relpath: source} under tmp_path/daft_tpu and return (root,
+    package dir). Paths mirror the real layout so lock/module identities
+    come out package-stripped ("alpha.A._lock"), as in production."""
+    pkg = tmp_path / "daft_tpu"
+    pkg.mkdir(parents=True, exist_ok=True)
+    for rel, src in files.items():
+        target = pkg / rel
+        target.parent.mkdir(parents=True, exist_ok=True)
+        target.write_text(textwrap.dedent(src))
+    return str(tmp_path), str(pkg)
+
+
+def graph_of(tmp_path, files, cache_path=None):
+    root, pkg = make_tree(tmp_path, files)
+    return build_project_graph([pkg], root=root, cache_path=cache_path)
+
+
+# --------------------------------------------------------------------- #
+# Fact extraction                                                        #
+# --------------------------------------------------------------------- #
+
+def test_extract_module_facts_core_shapes():
+    src = textwrap.dedent("""
+    import threading
+
+    _global_lock = threading.Lock()
+
+    class Pool:
+        def __init__(self):
+            self._state_lock = threading.RLock()
+
+        def grab(self):
+            with self._state_lock:
+                with _global_lock:
+                    self.helper()
+
+        def helper(self):
+            return {"chunks": 1, "meta": 2}
+
+    def merge(payload):
+        return payload.get("chunks"), payload["meta"]
+    """)
+    facts = extract_module_facts(src, "daft_tpu/execution/foo.py")
+    assert facts["module"] == "execution.foo"
+    assert facts["lock_defs"] == {
+        "execution.foo._global_lock": "Lock",
+        "execution.foo.Pool._state_lock": "RLock",
+    }
+    fns = facts["functions"]
+    assert set(fns) >= {"Pool.grab", "Pool.helper", "merge"}
+    grab = fns["Pool.grab"]
+    assert [a["lock"] for a in grab["acquisitions"]] == [
+        "execution.foo.Pool._state_lock", "execution.foo._global_lock"]
+    # Nested with produces the direct edge; the call under both locks is
+    # recorded against each held lock.
+    assert [(e["held"], e["acq"]) for e in grab["edges"]] == [
+        ("execution.foo.Pool._state_lock", "execution.foo._global_lock")]
+    assert {(c["held"], c["callee"]) for c in grab["calls_under"]} == {
+        ("execution.foo.Pool._state_lock", "self.helper"),
+        ("execution.foo._global_lock", "self.helper")}
+    assert {k for k, _, _ in fns["Pool.helper"]["keys_written"]} == {
+        "chunks", "meta"}
+    assert {k for k, _, _ in fns["merge"]["keys_read"]} == {"chunks", "meta"}
+
+
+def test_nested_defs_are_extracted_separately():
+    src = """
+    def outer():
+        def inner():
+            return {"k": 1}
+        return inner
+    """
+    facts = extract_module_facts(textwrap.dedent(src), "daft_tpu/m.py")
+    assert "outer" in facts["functions"]
+    assert "outer.inner" in facts["functions"]
+    # The closure's dict keys belong to the closure, not to outer.
+    assert facts["functions"]["outer"]["keys_written"] == []
+    assert [k for k, _, _ in
+            facts["functions"]["outer.inner"]["keys_written"]] == ["k"]
+
+
+# --------------------------------------------------------------------- #
+# Graph build + cache invalidation                                       #
+# --------------------------------------------------------------------- #
+
+def test_graph_cache_hit_and_invalidation_on_edit(tmp_path):
+    cache = str(tmp_path / "graph-cache.json")
+    files = {"alpha.py": "def f():\n    return 1\n"}
+    g1 = graph_of(tmp_path, files, cache_path=cache)
+    assert len(g1.modules) == 1
+    assert os.path.isfile(cache)
+    doc = json.loads(open(cache).read())
+    assert doc["version"] == FACTS_VERSION
+    assert "daft_tpu/alpha.py" in doc["files"]
+
+    # Unchanged file: the cached facts are served verbatim.
+    g2 = graph_of(tmp_path, files, cache_path=cache)
+    assert g2.modules["daft_tpu/alpha.py"] == g1.modules["daft_tpu/alpha.py"]
+
+    # Edit (content + size change) invalidates exactly that entry.
+    g3 = graph_of(tmp_path,
+                  {"alpha.py": "def f():\n    return 1\n\ndef g():\n"
+                               "    return 2\n"},
+                  cache_path=cache)
+    assert set(g3.modules["daft_tpu/alpha.py"]["functions"]) == {"f", "g"}
+
+
+def test_graph_excludes_broken_module_but_keeps_the_rest(tmp_path):
+    g = graph_of(tmp_path, {
+        "good.py": "def f():\n    return 1\n",
+        "broken.py": "def f(:\n",
+    })
+    assert set(g.modules) == {"daft_tpu/good.py"}
+    assert [e[0] for e in g.errors] == ["daft_tpu/broken.py"]
+
+
+def test_corrupt_cache_is_ignored_not_fatal(tmp_path):
+    cache = str(tmp_path / "graph-cache.json")
+    open(cache, "w").write("{not json")
+    g = graph_of(tmp_path, {"a.py": "x = 1\n"}, cache_path=cache)
+    assert len(g.modules) == 1
+    # And the build rewrote it into a valid cache.
+    assert json.loads(open(cache).read())["version"] == FACTS_VERSION
+
+
+# --------------------------------------------------------------------- #
+# DTL011 — lock-order cycles                                             #
+# --------------------------------------------------------------------- #
+
+CYCLE_ALPHA = """
+class A:
+    def take_alpha(self):
+        with self._alpha_lock:
+            pass
+
+    def grab(self):
+        with self._alpha_lock:
+            self._peer.take_beta()
+"""
+
+CYCLE_BETA = """
+class B:
+    def take_beta(self):
+        with self._beta_lock:
+            self._peer.take_alpha()
+"""
+
+
+def test_dtl011_cross_module_cycle_injection(tmp_path):
+    """Two synthetic modules acquiring each other's locks through one-level
+    call edges: A holds alpha and calls into B (acquires beta), B holds
+    beta and calls back into A (acquires alpha)."""
+    g = graph_of(tmp_path, {"alpha.py": CYCLE_ALPHA, "beta.py": CYCLE_BETA})
+    findings = list(LockOrderCycle(lock_order_path="/nonexistent")
+                    .check_project(g))
+    assert len(findings) == 1
+    f = findings[0]
+    assert f.rule == "DTL011" and f.analysis == "project"
+    assert "lock-order cycle" in f.message
+    assert "alpha.A._alpha_lock" in f.message
+    assert "beta.B._beta_lock" in f.message
+
+
+def test_dtl011_quiet_when_order_is_consistent(tmp_path):
+    g = graph_of(tmp_path, {"alpha.py": CYCLE_ALPHA, "beta.py": """
+    class B:
+        def take_beta(self):
+            with self._beta_lock:
+                pass
+    """})
+    assert list(LockOrderCycle(lock_order_path="/nonexistent")
+                .check_project(g)) == []
+
+
+def test_dtl011_declared_order_contradiction(tmp_path):
+    order = tmp_path / "lock_order.toml"
+    order.write_text(textwrap.dedent("""
+    [[order]]
+    name = "pool-before-queue"
+    locks = ["m.C._pool_lock", "m.C._queue_lock"]
+    """))
+    # Directly nested withs in the FORBIDDEN direction.
+    g = graph_of(tmp_path, {"m.py": """
+    class C:
+        def bad(self):
+            with self._queue_lock:
+                with self._pool_lock:
+                    pass
+    """})
+    findings = list(LockOrderCycle(lock_order_path=str(order))
+                    .check_project(g))
+    assert len(findings) == 1
+    assert "contradicting declared lock order" in findings[0].message
+    assert "pool-before-queue" in findings[0].message
+    # The declared direction itself is clean.
+    g2 = graph_of(tmp_path, {"m.py": """
+    class C:
+        def fine(self):
+            with self._pool_lock:
+                with self._queue_lock:
+                    pass
+    """})
+    assert list(LockOrderCycle(lock_order_path=str(order))
+                .check_project(g2)) == []
+
+
+def test_dtl011_self_deadlock_only_for_non_reentrant_locks(tmp_path):
+    template = """
+    import threading
+
+    class C:
+        def __init__(self):
+            self._lock = threading.{ctor}()
+
+        def outer(self):
+            with self._lock:
+                self.helper()
+
+        def helper(self):
+            with self._lock:
+                pass
+    """
+    g = graph_of(tmp_path, {"m.py": template.format(ctor="Lock")})
+    findings = list(LockOrderCycle(lock_order_path="/nonexistent")
+                    .check_project(g))
+    assert len(findings) == 1 and "self-deadlock" in findings[0].message
+    g2 = graph_of(tmp_path, {"m.py": template.format(ctor="RLock")})
+    assert list(LockOrderCycle(lock_order_path="/nonexistent")
+                .check_project(g2)) == []
+
+
+# --------------------------------------------------------------------- #
+# DTL012 — unpaired resource charges                                     #
+# --------------------------------------------------------------------- #
+
+def dtl012_findings(tmp_path, src):
+    g = graph_of(tmp_path, {"m.py": src})
+    return [f for f in UnpairedResource().check_project(g)
+            if f.rule == "DTL012"]
+
+
+def test_dtl012_bare_charge_fires(tmp_path):
+    assert len(dtl012_findings(tmp_path, """
+    class Op:
+        def work(self, q):
+            self._ledger.charge(q, "exec", 512)
+            return compute()
+    """)) == 1
+
+
+def test_dtl012_accepts_each_pairing_shape(tmp_path):
+    shapes = {
+        "with-item": """
+        class Op:
+            def work(self, q):
+                with self._ledger.charge(q, "exec", 512):
+                    return compute()
+        """,
+        "finally-release": """
+        class Op:
+            def work(self, q):
+                self._ledger.charge(q, "exec", 512)
+                try:
+                    return compute()
+                finally:
+                    self._ledger.release(q, "exec", 512)
+        """,
+        "returned-to-caller": """
+        class Op:
+            def work(self, q):
+                ticket = self._ledger.charge(q, "exec", 512)
+                return ticket
+        """,
+        "class-sibling-release": """
+        class Cursor:
+            def open(self, q):
+                self._ledger.charge(q, "scan", 64)
+
+            def close(self, q):
+                self._ledger.release(q, "scan", 64)
+        """,
+        "finally-callee-release": """
+        class Op:
+            def work(self, q):
+                self._ledger.charge(q, "exec", 512)
+                try:
+                    return compute()
+                finally:
+                    self._teardown(q)
+
+        class Cleaner:
+            def _teardown(self, q):
+                self._ledger.release(q, "exec", 512)
+        """,
+    }
+    for label, src in shapes.items():
+        assert dtl012_findings(tmp_path, src) == [], label
+
+
+def test_dtl012_other_families_fire_too(tmp_path):
+    assert len(dtl012_findings(tmp_path, """
+    class Gate:
+        def enter(self, q):
+            ticket = self.controller.admit(q)
+            self._work(ticket)
+    """)) == 1
+    # ...and pair via ticket.release on a cleanup path.
+    assert dtl012_findings(tmp_path, """
+    class Gate:
+        def enter(self, q):
+            ticket = self.controller.admit(q)
+            try:
+                self._work(ticket)
+            finally:
+                ticket.release()
+    """) == []
+
+
+# --------------------------------------------------------------------- #
+# DTL013 — wire-contract drift                                           #
+# --------------------------------------------------------------------- #
+
+WIRE_FAMILY = [{
+    "name": "test-reply",
+    "writers": [("wire.py", "build_reply")],
+    "readers": [("wire.py", "merge_reply")],
+    "ignore": set(),
+}]
+
+
+def test_dtl013_phantom_written_key_fires(tmp_path):
+    g = graph_of(tmp_path, {"wire.py": """
+    def build_reply(res):
+        return {"rows": res.rows, "phantom": res.debug}
+
+    def merge_reply(payload):
+        return payload.get("rows")
+    """})
+    findings = list(WireContractDrift(families=WIRE_FAMILY)
+                    .check_project(g))
+    assert len(findings) == 1
+    assert findings[0].rule == "DTL013"
+    assert "'phantom'" in findings[0].message
+    assert "written but never read" in findings[0].message
+
+
+def test_dtl013_read_only_key_and_symmetric_clean(tmp_path):
+    g = graph_of(tmp_path, {"wire.py": """
+    def build_reply(res):
+        return {"rows": res.rows}
+
+    def merge_reply(payload):
+        return payload["rows"], payload.get("ghost")
+    """})
+    findings = list(WireContractDrift(families=WIRE_FAMILY)
+                    .check_project(g))
+    assert len(findings) == 1 and "'ghost'" in findings[0].message
+    assert "read but never written" in findings[0].message
+
+    g2 = graph_of(tmp_path, {"wire.py": """
+    def build_reply(res):
+        return {"rows": res.rows, "bytes": res.nbytes}
+
+    def merge_reply(payload):
+        return payload["rows"], payload.get("bytes")
+    """})
+    assert list(WireContractDrift(families=WIRE_FAMILY)
+                .check_project(g2)) == []
+
+
+def test_dtl013_specs_cover_nested_defs_and_skip_absent_family(tmp_path):
+    # The writer key lives in a closure inside the matched function.
+    g = graph_of(tmp_path, {"wire.py": """
+    def build_reply(res):
+        def pack():
+            return {"rows": 1, "phantom": 2}
+        return pack()
+
+    def merge_reply(payload):
+        return payload.get("rows")
+    """})
+    findings = list(WireContractDrift(families=WIRE_FAMILY)
+                    .check_project(g))
+    assert [f for f in findings if "'phantom'" in f.message]
+    # A family whose modules are not in this graph at all stays silent
+    # (partial scans must not report the whole contract as missing).
+    g2 = graph_of(tmp_path / "second", {"other.py": "x = 1\n"})
+    assert list(WireContractDrift(families=WIRE_FAMILY)
+                .check_project(g2)) == []
+
+
+# --------------------------------------------------------------------- #
+# Runner integration: tiers, DTL000 degrade, changed-only narrowing      #
+# --------------------------------------------------------------------- #
+
+def test_runner_project_tier_reports_dtl000_degrade_once(tmp_path):
+    root, pkg = make_tree(tmp_path, {
+        "good.py": "def f():\n    return 1\n",
+        "broken.py": "def f(:\n",
+    })
+    result = run_paths([pkg], root=root, graph_cache=None)
+    dtl000 = [f for f in result.new if f.rule == "DTL000"]
+    # File tier already reported the syntax error; the project tier must
+    # not duplicate it.
+    assert len(dtl000) == 1 and dtl000[0].analysis == "file"
+    assert result.project_files == 1  # broken module excluded from graph
+
+    # When the broken file is OUTSIDE the file-tier scan (the
+    # --changed-only shape), the exclusion surfaces as a project-tier
+    # DTL000 warning instead of vanishing silently.
+    result2 = run_paths([os.path.join(pkg, "good.py")], root=root,
+                        project_paths=[pkg], graph_cache=None)
+    dtl000 = [f for f in result2.new if f.rule == "DTL000"]
+    assert len(dtl000) == 1 and dtl000[0].analysis == "project"
+    assert "excluded from whole-program analysis" in dtl000[0].message
+
+
+def test_runner_project_paths_widen_graph_beyond_changed_files(tmp_path):
+    """--changed-only semantics: the file tier narrows to the changed
+    subset, the project graph still covers the whole package — so a
+    cross-module cycle is caught even when only one side changed."""
+    root, pkg = make_tree(tmp_path, {"alpha.py": CYCLE_ALPHA,
+                                     "beta.py": CYCLE_BETA})
+    rule = LockOrderCycle(lock_order_path="/nonexistent")
+    result = run_paths([os.path.join(pkg, "alpha.py")], root=root,
+                       rules=[rule], project_paths=[pkg], graph_cache=None)
+    assert result.files_checked == 1
+    assert result.project_files == 2
+    assert [f.rule for f in result.new] == ["DTL011"]
+
+
+def test_changed_py_files_sees_worktree_and_untracked(tmp_path):
+    repo = tmp_path / "r"
+    repo.mkdir()
+
+    def git(*args):
+        return subprocess.run(
+            ["git", "-C", str(repo), "-c", "user.email=t@t",
+             "-c", "user.name=t"] + list(args),
+            capture_output=True, text=True)
+
+    if git("init").returncode != 0:
+        pytest.skip("git unavailable")
+    (repo / "a.py").write_text("x = 1\n")
+    git("add", "a.py")
+    assert git("commit", "-m", "seed").returncode == 0
+    assert changed_py_files(str(repo)) == []
+    (repo / "a.py").write_text("x = 2\n")          # modified vs HEAD
+    (repo / "b.py").write_text("y = 1\n")          # untracked
+    (repo / "notes.txt").write_text("not python\n")
+    changed = changed_py_files(str(repo))
+    assert [os.path.basename(p) for p in changed] == ["a.py", "b.py"]
+    # Outside any git repo the caller gets None and falls back to a full
+    # sweep (tmp_path itself may live under a repo, so use the API's own
+    # failure path: a directory git cannot run in).
+    assert changed_py_files(str(tmp_path / "missing")) is None
+
+
+# --------------------------------------------------------------------- #
+# lock_order.toml subset parser                                          #
+# --------------------------------------------------------------------- #
+
+def test_parse_lock_order_subset():
+    chains = parse_lock_order(textwrap.dedent("""
+    # cache before admission
+    [[order]]
+    name = "cache-admission"  # trailing comment
+    locks = ["plancache.PlanCache._lock",
+             "execution.admission.AdmissionController._cond"]
+
+    [[order]]
+    name = "one-line"
+    locks = ["a.X._lock", "b.Y._lock"]
+    """))
+    assert [c["name"] for c in chains] == ["cache-admission", "one-line"]
+    assert chains[0]["locks"] == [
+        "plancache.PlanCache._lock",
+        "execution.admission.AdmissionController._cond"]
+    assert chains[1]["locks"] == ["a.X._lock", "b.Y._lock"]
+
+
+@pytest.mark.parametrize("bad", [
+    'name = "orphan-key"\n',                       # key outside [[order]]
+    '[[order]]\nname = 42\n',                      # unsupported value
+    '[table]\n',                                   # non-order table
+    '[[order]]\nname = "x"\n',                     # missing locks array
+    '[[order]]\nlocks = ["a",\n',                  # unterminated array
+])
+def test_parse_lock_order_rejects_out_of_subset(bad):
+    with pytest.raises(ValueError):
+        parse_lock_order(bad)
+
+
+def test_checked_in_lock_order_parses_and_matches_real_locks():
+    """The shipped lock_order.toml must stay well-formed, and every lock it
+    names must still exist in the real tree (a rename would silently stop
+    enforcing the chain)."""
+    from daft_tpu.lint import (
+        default_lock_order_path, load_lock_order, repo_root)
+
+    chains = load_lock_order(default_lock_order_path())
+    assert chains, "shipped lock_order.toml is empty or missing"
+    g = build_project_graph([os.path.join(repo_root(), "daft_tpu")],
+                            root=repo_root(), cache_path=None)
+    known = set(g.lock_kinds)
+    for facts, fn in g.functions():
+        known.update(a["lock"] for a in fn["acquisitions"])
+    for chain in chains:
+        assert len(chain["locks"]) >= 2, chain
+        for lock in chain["locks"]:
+            assert lock in known, (
+                f"lock_order.toml chain {chain['name']!r} names unknown "
+                f"lock {lock!r} — update the chain after the rename")
