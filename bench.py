@@ -497,6 +497,108 @@ def querylog_overhead_check(n: int = 6_000_000, reps: int = 10,
         drop_env=("DAFT_QUERY_LOG",))
 
 
+# The integrity plane (daft_tpu/integrity.py) hashes every shuffle chunk
+# at write AND verifies at read — a per-byte cost, unlike the fixed-per-
+# query planes above, so its guard runs a genuinely shuffle-heavy query on
+# a small flight-shuffle cluster and toggles ``integrity_enabled`` via the
+# config (consulted at every verify site, so in-process ABBA alternation
+# is valid the same way the profiler's env toggle is).
+INTEGRITY_OVERHEAD_LIMIT_PCT = float(
+    os.environ.get("DAFT_INTEGRITY_OVERHEAD_LIMIT_PCT", "2.0"))
+
+_INTEGRITY_AB_CHILD = r"""
+import gc, json, sys, time
+import numpy as np
+import daft_tpu
+from daft_tpu import col
+from daft_tpu.runners.distributed import DistributedRunner
+
+n = int(sys.argv[1]); blocks = int(sys.argv[2])
+rng = np.random.default_rng(0)
+orders = daft_tpu.from_pydict({
+    "o_key": np.arange(n, dtype=np.int64),
+    "o_cust": rng.integers(0, n // 8, n),
+    "o_total": rng.random(n)})
+cust = daft_tpu.from_pydict({
+    "c_key": np.arange(n // 8, dtype=np.int64),
+    "c_seg": rng.integers(0, 5, n // 8)})
+
+ctx = daft_tpu.get_context()
+runner = DistributedRunner(num_workers=2)
+ctx.set_runner(runner)
+
+def loop(enabled):
+    with daft_tpu.execution_config_ctx(
+            shuffle_algorithm="flight", shuffle_chunk_bytes=64 * 1024,
+            result_cache_enabled=False, integrity_enabled=enabled):
+        q = (orders.join(cust, left_on="o_cust", right_on="c_key")
+             .groupby("c_seg").agg(col("o_total").sum().alias("rev"))
+             .sort("rev", desc=True))
+        return q.to_pydict()
+
+try:
+    loop(True)   # warm workers/JIT/plane module state before timing
+    loop(False)
+    on, off = [], []
+    for b in range(blocks):
+        order = (False, True) if b % 2 == 0 else (True, False)
+        ts = {}
+        for m in order:
+            gc.collect()
+            t0 = time.perf_counter(); loop(m)
+            ts[m] = time.perf_counter() - t0
+        on.append(ts[True]); off.append(ts[False])
+finally:
+    runner.manager.shutdown()
+print(json.dumps({"on_s": on, "off_s": off}))
+"""
+
+
+def integrity_overhead_check(n: int = 600_000, reps: int = 8,
+                             rounds: int = 3) -> dict:
+    import statistics
+
+    deltas, offs = [], []
+
+    def collect(num_rounds: int) -> None:
+        for _ in range(num_rounds):
+            env = dict(os.environ, JAX_PLATFORMS="cpu")
+            env.pop("DAFT_INTEGRITY", None)  # the child drives the toggle
+            proc = subprocess.run(
+                [sys.executable, "-c", _INTEGRITY_AB_CHILD, str(n),
+                 str(reps)],
+                capture_output=True, text=True, env=env, timeout=600,
+                cwd=os.path.dirname(os.path.abspath(__file__)))
+            if proc.returncode != 0:
+                raise RuntimeError(
+                    f"overhead child failed:\n{proc.stderr[-2000:]}")
+            rec = json.loads(proc.stdout.strip().splitlines()[-1])
+            deltas.extend(o - f for o, f in zip(rec["on_s"], rec["off_s"]))
+            offs.extend(rec["off_s"])
+
+    def verdict() -> tuple:
+        off = statistics.median(offs)
+        delta = statistics.median(deltas)
+        pct = delta / off * 100.0 if off > 0 else 0.0
+        return pct, off, delta
+
+    collect(rounds)
+    pct, off, delta = verdict()
+    escalated = False
+    if pct >= INTEGRITY_OVERHEAD_LIMIT_PCT:
+        # Same weather-vs-regression escalation as the paired guards:
+        # double the sample before believing a failure.
+        escalated = True
+        collect(rounds)
+        pct, off, delta = verdict()
+    return {"metric": "integrity_overhead_pct", "value": round(pct, 3),
+            "unit": "% vs integrity_enabled=False", "pairs": len(deltas),
+            "escalated": escalated,
+            "enabled_s": round(off + delta, 4), "disabled_s": round(off, 4),
+            "limit_pct": INTEGRITY_OVERHEAD_LIMIT_PCT,
+            "ok": pct < INTEGRITY_OVERHEAD_LIMIT_PCT}
+
+
 def main() -> None:
     if len(sys.argv) > 1 and sys.argv[1] == "--metrics-overhead":
         rec = metrics_overhead_check()
@@ -522,6 +624,15 @@ def main() -> None:
         if not rec["ok"]:
             sys.stderr.write(
                 f"flight-recorder overhead {rec['value']}% exceeds "
+                f"{rec['limit_pct']}% budget\n")
+            sys.exit(1)
+        return
+    if len(sys.argv) > 1 and sys.argv[1] == "--integrity-overhead":
+        rec = integrity_overhead_check()
+        print(json.dumps(rec))
+        if not rec["ok"]:
+            sys.stderr.write(
+                f"integrity plane overhead {rec['value']}% exceeds "
                 f"{rec['limit_pct']}% budget\n")
             sys.exit(1)
         return

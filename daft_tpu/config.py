@@ -284,6 +284,19 @@ class ExecutionConfig:
     streaming_poll_interval_s: float = 1.0
     streaming_checkpoint_dir: Optional[str] = None
     slo_staleness_p99_s: float = 60.0
+    # Data-integrity plane (daft_tpu/integrity.py). Default ON: every
+    # persisted / wire-crossing artifact (shuffle chunk files, spill files,
+    # streaming checkpoint state) carries a digest minted at write and
+    # verified at read; a mismatch quarantines the file and routes into
+    # lineage recovery instead of serving corrupt bytes. Digests are always
+    # MINTED (one streaming pass over bytes already in cache) so an
+    # artifact written while verification was off still verifies later;
+    # integrity_enabled gates only the read-side checks (DAFT_INTEGRITY=0
+    # is the kill switch and the <2% ABBA overhead guard's A/B lever).
+    # integrity_verify_on_write additionally re-reads each artifact
+    # immediately after flush — a paranoid write-path knob for chaos runs.
+    integrity_enabled: bool = True
+    integrity_verify_on_write: bool = False
 
     def with_changes(self, **kwargs) -> "ExecutionConfig":
         return dataclasses.replace(self, **kwargs)
@@ -394,4 +407,8 @@ class ExecutionConfig:
         if os.environ.get("DAFT_SLO_STALENESS_P99_S"):
             changes["slo_staleness_p99_s"] = float(
                 os.environ["DAFT_SLO_STALENESS_P99_S"])
+        if not daft_env_flag("DAFT_INTEGRITY", True):
+            changes["integrity_enabled"] = False
+        if daft_env_flag("DAFT_INTEGRITY_VERIFY_ON_WRITE", False):
+            changes["integrity_verify_on_write"] = True
         return cfg.with_changes(**changes) if changes else cfg

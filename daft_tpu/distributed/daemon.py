@@ -349,7 +349,7 @@ class WorkerDaemon:
                              "address": self.flight_address,
                              "ticket": m.ticket, "rows": m.rows,
                              "bytes": m.bytes_, "worker_id": self.worker_id,
-                             "chunks": [[c.ticket, c.rows, c.bytes_]
+                             "chunks": [[c.ticket, c.rows, c.bytes_, c.digest]
                                         for c in m.chunks]})
             from daft_tpu.metrics import get_registry
 
@@ -370,7 +370,7 @@ class WorkerDaemon:
                 find_in_chain,
                 is_transient_failure,
             )
-            from daft_tpu.errors import DaftCancelledError
+            from daft_tpu.errors import DaftCancelledError, DaftCorruptionError
 
             reply = {"ok": False, "error": f"{e}\n{traceback.format_exc()}"}
             try:
@@ -387,11 +387,23 @@ class WorkerDaemon:
                 # driver's trace shows how far the task got before failing.
                 reply["spans"] = profiling.drain_worker_buffer()
             fetch = find_fetch_failure(e)
+            corruption = find_in_chain(e, DaftCorruptionError)
             if find_in_chain(e, DaftCancelledError) is not None:
                 reply["kind"] = "cancelled"
             elif fetch is not None:
+                # Chunk corruption wrapped into a fetch failure keeps the
+                # fetch classification: the lost descriptors (flagged
+                # corruption=True) are what drive lineage recovery.
                 reply["kind"] = "fetch"
                 reply["lost"] = fetch.lost
+            elif corruption is not None:
+                # Bare corruption (spill / checkpoint artifact, no lineage
+                # descriptor): typed re-raise on the driver so the
+                # dispatcher keeps its deliberately-NOT-transient handling.
+                reply["kind"] = "corruption"
+                reply["artifact"] = corruption.artifact
+                reply["path"] = corruption.path
+                reply["ticket"] = corruption.ticket
             elif is_transient_failure(e):
                 reply["kind"] = "transient"
             return reply
@@ -487,6 +499,13 @@ class RemoteWorker(Worker):
                 from daft_tpu.errors import DaftCancelledError
 
                 raise DaftCancelledError(err)
+            if kind == "corruption":
+                from daft_tpu.errors import DaftCorruptionError
+
+                raise DaftCorruptionError(
+                    err, artifact=reply.get("artifact", ""),
+                    path=reply.get("path", ""),
+                    ticket=reply.get("ticket", ""))
             if kind == "transient":
                 from daft_tpu.errors import DaftTransientError
 

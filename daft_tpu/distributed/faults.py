@@ -35,6 +35,13 @@ Injection points wired in the engine:
 ``worker.launch``      the fleet controller launching a scale-up worker —
                        a raised fault must leave the fleet consistent and
                        be retried by a later controller tick
+``integrity.chunk``    shuffle chunk file about to be integrity-verified at
+                       a read site (ctx: ``path``) — ``corrupt``/``truncate``
+                       mutate the file so verification must catch it
+``integrity.spill``    spill file about to be integrity-verified at
+                       read-back (ctx: ``path``)
+``integrity.checkpoint`` checkpoint state file about to be verified at
+                       restore (ctx: ``path``)
 ==================== =======================================================
 
 Every injection point is ALSO a cooperative-cancellation observation point:
@@ -51,10 +58,12 @@ Spec grammar (``DAFT_FAULT_SPEC`` / ``ExecutionConfig.fault_spec`` /
 where ``when`` is ``N`` (fire on the Nth hit only, 1-based), ``*`` (every
 hit), ``N+`` (every hit from the Nth on), or ``p0.25`` (each hit with
 probability 0.25 from the seeded RNG), and ``arg`` is an action parameter
-(seconds for ``delay``). Actions: ``raise``, ``raise_transient``,
-``raise_worker_died``, ``delay``, ``kill`` (ctx worker's ``.kill()``),
-``die`` (``os._exit`` — daemon process crash), ``drop`` (soft signal
-returned to the caller).
+(seconds for ``delay``; a byte offset for ``corrupt``). Actions: ``raise``,
+``raise_transient``, ``raise_worker_died``, ``delay``, ``kill`` (ctx
+worker's ``.kill()``), ``die`` (``os._exit`` — daemon process crash),
+``drop`` (soft signal returned to the caller), ``corrupt`` (flip one bit of
+ctx's ``path`` file — at byte ``arg`` when given, else a seeded offset),
+``truncate`` (cut ctx's ``path`` file to half its length).
 
 Example: ``DAFT_FAULT_SPEC='worker.pre_submit:kill:3,io.get_object:raise_transient:1'``
 """
@@ -80,10 +89,13 @@ KNOWN_POINTS = (
     "admission.enqueue",
     "fleet.drain",
     "worker.launch",
+    "integrity.chunk",
+    "integrity.spill",
+    "integrity.checkpoint",
 )
 
 _ACTIONS = ("raise", "raise_transient", "raise_worker_died", "delay", "kill",
-            "die", "drop")
+            "die", "drop", "corrupt", "truncate")
 
 
 class FaultInjected(DaftExecutionError):
@@ -247,7 +259,43 @@ class FaultInjector:
                 raise FaultInjected(point, n)
             elif s.action == "drop":
                 signal = "drop"
+            elif s.action in ("corrupt", "truncate"):
+                path = ctx.get("path")
+                if path:
+                    _mutate_file(path, s.action, s.arg, self._rng)
+                signal = s.action
         return signal
+
+
+def _mutate_file(path: str, action: str, arg: Optional[float],
+                 rng: random.Random) -> None:
+    """Deterministically damage the file at ``path`` in place.
+
+    ``corrupt`` flips ONE bit — at byte ``arg`` when the clause names one,
+    else at a seeded-RNG offset — the smallest possible data fault, which
+    integrity verification must still catch. ``truncate`` cuts the file to
+    half its length (a torn write). Both are best-effort: a missing file
+    (already consumed/quarantined) is not an injection error.
+    """
+    try:
+        size = os.path.getsize(path)
+    except OSError:
+        return
+    if size <= 0:
+        return
+    if action == "truncate":
+        with open(path, "r+b") as f:
+            f.truncate(size // 2)
+        return
+    offset = int(arg) if arg is not None else rng.randrange(size)
+    offset = max(0, min(offset, size - 1))
+    with open(path, "r+b") as f:
+        f.seek(offset)
+        b = f.read(1)
+        if not b:
+            return
+        f.seek(offset)
+        f.write(bytes([b[0] ^ 0x01]))
 
 
 # --------------------------------------------------------------------- #

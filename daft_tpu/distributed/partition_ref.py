@@ -102,18 +102,25 @@ class FlightPartitionRef(PartitionRef):
 class ChunkRef:
     """One fetchable chunk of a shuffle partition: ticket + sizes (the
     chunk-granular identity lineage descriptors and prefetch planning
-    key on)."""
+    key on). ``digest`` is the chunk's CONTENT digest
+    (integrity.table_digest of its wire table, minted at flush) — it
+    travels with the ref so a client can re-verify the decoded table
+    after a Flight fetch re-framed the bytes with its own codec. Empty
+    for refs minted before the integrity plane (pre-v19 wire peers):
+    verification is skipped, never failed, for those."""
 
     ticket: str
     rows: int
     bytes_: int
+    digest: str = ""
 
     def to_wire(self) -> list:
-        return [self.ticket, self.rows, self.bytes_]
+        return [self.ticket, self.rows, self.bytes_, self.digest]
 
     @staticmethod
     def from_wire(d) -> "ChunkRef":
-        return ChunkRef(d[0], int(d[1]), int(d[2]))
+        return ChunkRef(d[0], int(d[1]), int(d[2]),
+                        str(d[3]) if len(d) > 3 and d[3] else "")
 
 
 @dataclass

@@ -233,7 +233,10 @@ class DistributedExecutor:
         # fetch_output path too, which has no dispatcher doing it for us.
         for d in lost:
             wid = d.get("worker_id")
-            if wid:
+            # Corruption-flagged descriptors name a healthy host that served
+            # one bad (now quarantined) file — recompute the partition but
+            # keep the worker in the fleet.
+            if wid and not d.get("corruption"):
                 self.manager.mark_dead(wid, reason="unreachable")
         by_producer: dict = {}  # id(producer task) -> producer Task
         swaps: List[Tuple[int, int, PartitionRef]] = []

@@ -145,7 +145,7 @@ def _worker_entry(fd: int) -> None:
             import traceback
 
             from daft_tpu.distributed.scheduler import find_in_chain, is_transient_failure
-            from daft_tpu.errors import DaftCancelledError
+            from daft_tpu.errors import DaftCancelledError, DaftCorruptionError
 
             reply = {"ok": False, "error": f"{e}\n{traceback.format_exc()}"}
             try:
@@ -164,10 +164,20 @@ def _worker_entry(fd: int) -> None:
                 # ship whatever finished so the driver's trace shows how far
                 # the task got before dying.
                 reply["spans"] = prof.drain()
+            corruption = find_in_chain(e, DaftCorruptionError)
             if find_in_chain(e, DaftCancelledError) is not None:
                 # Keep the cancellation type across the wire so the driver
                 # never retries cancelled work.
                 reply["kind"] = "cancelled"
+            elif corruption is not None:
+                # Keep the corruption type (deliberately NOT transient)
+                # across the wire: a spill/checkpoint artifact that failed
+                # verification inside the child must not be retried as if
+                # the failure were load.
+                reply["kind"] = "corruption"
+                reply["artifact"] = corruption.artifact
+                reply["path"] = corruption.path
+                reply["ticket"] = corruption.ticket
             elif is_transient_failure(e):
                 # Keep the driver's typed transient-retry handling across the
                 # process boundary, where exceptions travel as strings.
@@ -283,6 +293,14 @@ class ProcessWorker(Worker):
                             from daft_tpu.errors import DaftCancelledError
 
                             raise DaftCancelledError(result["error"])
+                        if result.get("kind") == "corruption":
+                            from daft_tpu.errors import DaftCorruptionError
+
+                            raise DaftCorruptionError(
+                                result["error"],
+                                artifact=result.get("artifact", ""),
+                                path=result.get("path", ""),
+                                ticket=result.get("ticket", ""))
                         if result.get("kind") == "transient":
                             from daft_tpu.errors import DaftTransientError
 

@@ -611,7 +611,11 @@ class Dispatcher:
             # recovery budget (planner.py) bounds this loop.
             for d in fetch_err.lost:
                 wid = d.get("worker_id")
-                if wid:
+                # A corruption-flagged descriptor means the host answered
+                # fine but served a bad file (now quarantined): the HOST is
+                # healthy, only the chunk is lost. Recompute it through
+                # lineage without declaring the worker dead.
+                if wid and not d.get("corruption"):
                     self.scheduler.manager.mark_dead(wid, reason="unreachable")
             if attempts_inflight(att.idx):
                 return None

@@ -104,7 +104,13 @@ def _ipc_options(codec: Optional[str]) -> "pa.ipc.IpcWriteOptions":
 # ------------------------------------------------------------------ #
 @dataclass
 class ChunkMeta:
-    """One compressed chunk file of one (shuffle, bucket) partition."""
+    """One compressed chunk file of one (shuffle, bucket) partition.
+
+    ``file_digest`` is the integrity digest of the raw on-disk bytes
+    (verified before any decode at every read site); ``digest`` is the
+    CONTENT digest of the chunk's wire table (travels on ChunkRef so a
+    client can re-verify after the Flight wire re-framed the bytes).
+    Both minted at flush (daft_tpu/integrity.py)."""
 
     ticket: str          # "<shuffle_id>/<bucket>@<seq>"
     path: str
@@ -113,6 +119,8 @@ class ChunkMeta:
     file_bytes: int      # on-disk (compressed) bytes
     codec: Optional[str]
     seq: int
+    digest: str = ""
+    file_digest: str = ""
 
 
 @dataclass
@@ -172,15 +180,18 @@ def audit_shuffle_leaks(query_id: Optional[str] = None) -> dict:
     one query. A clean teardown leaves ``files == 0``."""
     files = 0
     queries: set = set()
+    quarantined: List[str] = []
     for cache in list(_all_caches):
         a = cache.audit()
+        quarantined.extend(a.get("quarantined", ()))
         for qid, n in a["queries"].items():
             if query_id is not None and qid != query_id:
                 continue
             files += n
             if n:
                 queries.add(qid)
-    return {"files": files, "queries": sorted(queries)}
+    return {"files": files, "queries": sorted(queries),
+            "quarantined": sorted(quarantined)}
 
 
 # ------------------------------------------------------------------ #
@@ -241,6 +252,35 @@ class ShuffleCache:
             meta.bytes_ += chunk.bytes_
 
     # -- read ----------------------------------------------------------- #
+    def _read_chunk_file(self, chunk: ChunkMeta) -> pa.Table:
+        """Verified chunk-file read: raw bytes checked against the digest
+        minted at flush BEFORE Arrow decode touches them; a mismatch (or a
+        decode blow-up — corruption the digest plane was off for)
+        quarantines the file and raises DaftCorruptionError carrying the
+        chunk ticket, the lineage-recovery key."""
+        from daft_tpu import integrity
+        from daft_tpu.distributed.faults import maybe_inject
+        from daft_tpu.errors import DaftCorruptionError
+
+        maybe_inject("integrity.chunk", path=chunk.path)
+        integrity.verify_file(chunk.path, chunk.file_digest, "chunk",
+                              ticket=chunk.ticket)
+        try:
+            with pa.OSFile(chunk.path, "rb") as f:
+                with pa.ipc.open_stream(f) as reader:
+                    return reader.read_all()
+        except pa.ArrowInvalid as e:
+            # Undecodable despite (or without) a digest pass: classify as
+            # corruption, not a confusing deep-decode crash.
+            qpath = integrity.quarantine(chunk.path)
+            integrity._record_failure(
+                "chunk", chunk.path, chunk.ticket, chunk.file_digest,
+                "undecodable", quarantined=qpath is not None)
+            raise DaftCorruptionError(
+                f"chunk artifact undecodable: {chunk.path} ({e})",
+                artifact="chunk", path=chunk.path,
+                ticket=chunk.ticket) from e
+
     def read_chunk(self, chunk_ticket: str) -> pa.Table:
         base, seq = split_chunk_ticket(chunk_ticket)
         with self._lock:
@@ -253,9 +293,7 @@ class ShuffleCache:
                         break
         if chunk is None:
             raise KeyError(f"Unknown shuffle chunk ticket {chunk_ticket!r}")
-        with pa.OSFile(chunk.path, "rb") as f:
-            with pa.ipc.open_stream(f) as reader:
-                return reader.read_all()
+        return self._read_chunk_file(chunk)
 
     def read_partition(self, ticket: str) -> MicroPartition:
         if is_chunk_ticket(ticket):
@@ -267,11 +305,7 @@ class ShuffleCache:
             chunks = sorted(meta.chunks, key=lambda c: c.seq) if meta else None
         if chunks is None:
             raise KeyError(f"Unknown shuffle ticket {ticket!r}")
-        tables = []
-        for c in chunks:
-            with pa.OSFile(c.path, "rb") as f:
-                with pa.ipc.open_stream(f) as reader:
-                    tables.append(reader.read_all())
+        tables = [self._read_chunk_file(c) for c in chunks]
         if not tables:
             return MicroPartition.from_arrow_table(None)
         from daft_tpu.distributed.partition_ref import partition_from_wire_table
@@ -304,6 +338,12 @@ class ShuffleCache:
                     removed += 1
                 except OSError:
                     pass  # already gone (cleanup raced shutdown)
+        # Quarantined corpses of this (or any) query's chunks are swept in
+        # the same finally — quarantine must never outlive the query that
+        # found it, or the zero-leak audits would count it.
+        from daft_tpu import integrity
+
+        integrity.sweep_quarantined(self.root)
         return removed
 
     def migrate_partition(self, ticket: str,
@@ -332,7 +372,8 @@ class ShuffleCache:
             shutil.copy2(c.path, dst)
             target._add_chunk(ticket, ChunkMeta(
                 ticket=c.ticket, path=dst, rows=c.rows, bytes_=c.bytes_,
-                file_bytes=c.file_bytes, codec=c.codec, seq=c.seq), query_id)
+                file_bytes=c.file_bytes, codec=c.codec, seq=c.seq,
+                digest=c.digest, file_digest=c.file_digest), query_id)
             moved_bytes += c.bytes_
         with target._lock:
             # Future appends to the same (shuffle, bucket) on the target
@@ -356,13 +397,19 @@ class ShuffleCache:
         return (len(chunks), moved_bytes)
 
     def audit(self) -> dict:
-        """Per-query live chunk-file counts — the zero-leak surface."""
+        """Per-query live chunk-file counts — the zero-leak surface.
+        ``quarantined`` lists *.quarantined residue still under the cache
+        root (must be empty after teardown: quarantine is swept at query
+        release)."""
+        from daft_tpu import integrity
+
         with self._lock:
             queries = {qid: sum(len(self._meta[t].chunks)
                                 for t in tickets if t in self._meta)
                        for qid, tickets in self._by_query.items()}
         return {"root": self.root, "queries": queries,
-                "files": sum(queries.values())}
+                "files": sum(queries.values()),
+                "quarantined": integrity.audit_quarantine_residue(self.root)}
 
     def cleanup(self) -> None:
         import shutil
@@ -392,6 +439,7 @@ class ShuffleWriter:
         self.num_buckets = num_buckets
         self.query_id = query_id
         self.profiler = profiler
+        self.cfg = cfg
         pref = getattr(cfg, "shuffle_compression", "auto") if cfg is not None \
             else "auto"
         self.codec = negotiate_codec(pref)
@@ -445,7 +493,7 @@ class ShuffleWriter:
         self._write_chunk(bucket, table)
 
     def _write_chunk(self, bucket: int, table: pa.Table) -> None:
-        from daft_tpu import metrics, profiling
+        from daft_tpu import integrity, metrics, profiling
 
         # Seq minted by the CACHE (atomic): appends from a second writer
         # onto the same (shuffle, bucket) must never collide tickets.
@@ -463,10 +511,21 @@ class ShuffleWriter:
                                        options=_ipc_options(self.codec)) as w:
                     w.write_table(table)
         file_bytes = os.path.getsize(path)
+        # Mint both digests at flush — unconditionally (one streaming pass
+        # over bytes still in cache), so artifacts written while
+        # verification is off still verify later. file_digest covers the
+        # raw on-disk bytes; digest covers the canonical table content and
+        # rides the ChunkRef across the wire.
+        file_digest = integrity.hash_file(path)
+        digest = integrity.table_digest(table)
+        if integrity.verify_on_write(self.cfg):
+            integrity.verify_file(path, file_digest, "chunk", ticket=ticket,
+                                  cfg=self.cfg)
         self.cache._add_chunk(
             self._ticket(bucket),
             ChunkMeta(ticket, path, table.num_rows, table.nbytes, file_bytes,
-                      self.codec, seq),
+                      self.codec, seq, digest=digest,
+                      file_digest=file_digest),
             self.query_id)
         if metrics.get_registry().enabled:
             metrics.SHUFFLE_BYTES_WRITTEN.inc(table.nbytes)
@@ -601,6 +660,7 @@ class ShuffleReader:
         from daft_tpu import metrics, profiling
         from daft_tpu.distributed.faults import FaultInjected, maybe_inject
         from daft_tpu.distributed.partition_ref import PartitionFetchError
+        from daft_tpu.errors import DaftCorruptionError
 
         slot, pos, ref = unit
         ticket = getattr(ref, "ticket", "")
@@ -640,6 +700,14 @@ class ShuffleReader:
                 self._release_items(items)
                 last = e
                 break
+            except DaftCorruptionError as e:
+                # Corruption is deterministic — the file is quarantined,
+                # re-reading cannot succeed. Straight to lineage recovery;
+                # flag the descriptor so the healthy host serving one bad
+                # file is NOT declared dead.
+                self._release_items(items)
+                last = e
+                break
             except Exception as e:  # noqa: BLE001 — persistent failure IS loss
                 self._release_items(items)
                 last = e
@@ -650,7 +718,9 @@ class ShuffleReader:
             # annotates its failing chunk ticket, so recovery diagnostics
             # pin the exact lost chunk, not just the partition.
             lost[0]["ticket"] = getattr(last, "_daft_chunk_ticket", "") \
-                or ticket
+                or getattr(last, "ticket", "") or ticket
+            if isinstance(last, DaftCorruptionError):
+                lost[0]["corruption"] = True
             raise PartitionFetchError(
                 f"failed to fetch shuffle partition "
                 f"{lost[0]['ticket'] or 'input'} from "
@@ -687,9 +757,24 @@ class ShuffleReader:
                     metrics.SHUFFLE_BYTES_FETCHED.inc(table.nbytes)
                 yield table
             return
+        from daft_tpu import integrity
         from daft_tpu.distributed.flight import iter_partition_tables
 
-        for table in iter_partition_tables(ref.address, ref.ticket):
+        # Wire path: the Flight stream yields one table per chunk, in seq
+        # order — pair each against its ChunkRef and re-verify the CONTENT
+        # digest post-decode (the wire re-framed the bytes with its own
+        # codec, so only the content survives the hop).
+        chunks = list(ref.chunks)
+        for i, table in enumerate(iter_partition_tables(ref.address,
+                                                        ref.ticket)):
+            if i < len(chunks):
+                try:
+                    integrity.verify_table(table, chunks[i].digest, "chunk",
+                                           ticket=chunks[i].ticket,
+                                           cfg=self.cfg)
+                except Exception as e:
+                    e._daft_chunk_ticket = chunks[i].ticket
+                    raise
             if enabled:
                 metrics.SHUFFLE_BYTES_FETCHED.inc(table.nbytes)
             yield table
@@ -790,6 +875,14 @@ class ShuffleReader:
         with pa.OSFile(path, "wb") as f:
             with pa.ipc.new_stream(f, table.schema) as w:
                 w.write_table(table)
+        # Spill files are persisted artifacts too: digest at write,
+        # verified at the re-read in __iter__ (integrity.spill point).
+        from daft_tpu import integrity
+
+        with self._spill_lock:
+            if not hasattr(self, "_spill_digests"):
+                self._spill_digests = {}
+            self._spill_digests[path] = integrity.hash_file(path)
         if metrics.get_registry().enabled:
             metrics.SHUFFLE_BYTES_SPILLED.inc(nbytes)
         # Shared spill accounting (execution/spill.py): the profiler's
@@ -854,6 +947,16 @@ class ShuffleReader:
                         kind, payload, _held = item
                         try:
                             if kind == "spill":
+                                from daft_tpu import integrity
+                                from daft_tpu.distributed.faults import \
+                                    maybe_inject
+
+                                maybe_inject("integrity.spill", path=payload)
+                                with self._spill_lock:
+                                    sdig = getattr(self, "_spill_digests",
+                                                   {}).pop(payload, "")
+                                integrity.verify_file(payload, sdig, "spill",
+                                                      cfg=self.cfg)
                                 with pa.OSFile(payload, "rb") as f:
                                     with pa.ipc.open_stream(f) as reader:
                                         table = reader.read_all()

@@ -24,7 +24,7 @@ from daft_tpu.distributed.partition_ref import (
     PartitionRef,
 )
 from daft_tpu.distributed.task import BoundInput, Task
-from daft_tpu.errors import DaftExecutionError
+from daft_tpu.errors import DaftCorruptionError, DaftExecutionError
 from daft_tpu.micropartition import MicroPartition
 from daft_tpu.physical import plan as pp
 
@@ -118,10 +118,19 @@ def fetch_task_input(ref: PartitionRef, slot: int, pos: int) -> MicroPartition:
         except FaultInjected as e:
             last = e
             break
+        except DaftCorruptionError as e:
+            # Deterministic: the artifact is quarantined, re-reading cannot
+            # succeed — straight to lineage recovery. The corruption flag
+            # keeps the (healthy) hosting worker from being marked dead.
+            last = e
+            break
         except Exception as e:  # noqa: BLE001 — persistent failure IS loss
             last = e
             if attempt < _FETCH_RETRIES:
                 _time.sleep(0.05 * (2 ** attempt))
+    if isinstance(last, DaftCorruptionError):
+        lost[0]["ticket"] = last.ticket or lost[0]["ticket"]
+        lost[0]["corruption"] = True
     raise PartitionFetchError(
         f"failed to fetch partition input[{slot}][{pos}] from "
         f"{ref.location or 'driver'}: {last}", lost) from last
@@ -290,7 +299,8 @@ class LocalWorker(Worker):
             m = metas[i]
             refs.append(ShufflePartitionRef(
                 "", m.ticket, m.rows, m.bytes_, self.worker_id,
-                [ChunkRef(c.ticket, c.rows, c.bytes_) for c in m.chunks]))
+                [ChunkRef(c.ticket, c.rows, c.bytes_, c.digest)
+                 for c in m.chunks]))
         return refs
 
     def submit(self, task: Task) -> "Future[List[PartitionRef]]":

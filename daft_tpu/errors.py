@@ -31,6 +31,29 @@ class DaftIOError(DaftError, IOError):
     """IO-layer failure (object store, file format decode)."""
 
 
+class DaftCorruptionError(DaftIOError):
+    """A persisted or wire-crossing artifact failed integrity verification
+    (daft_tpu/integrity.py): the bytes read do not match the digest minted
+    at write time. Deliberately NOT transient — re-reading the same bad
+    bytes cannot succeed; the artifact is quarantined and the fix is
+    lineage recompute (shuffle chunks), task re-execution (spill files),
+    or a cold start (checkpoints). ``ticket`` names the shuffle chunk for
+    lineage recovery when the artifact is chunk-shaped."""
+
+    def __init__(self, message: str, artifact: str = "", path: str = "",
+                 ticket: str = ""):
+        super().__init__(message)
+        self.artifact = artifact
+        self.path = path
+        self.ticket = ticket
+
+    def __reduce__(self):
+        # Pickle-safe across the process-worker wire (the same survival
+        # contract PartitionFetchError keeps).
+        return (DaftCorruptionError,
+                (self.args[0], self.artifact, self.path, self.ticket))
+
+
 class DaftPlanError(DaftError):
     """Logical/physical planning failure."""
 

@@ -95,6 +95,18 @@ def analyze_suffix(df) -> str:
     if spilled:
         lines.append(f"spill: bytes={spilled}, "
                      f"files={int(d('daft_spill_files_total'))}")
+    # Integrity plane (daft_tpu/integrity.py): digest verifications over
+    # the run's bracket — silent when the plane saw no traffic, LOUD when
+    # anything failed (a quarantined artifact healed through lineage is
+    # exactly the kind of fact EXPLAIN ANALYZE must not hide).
+    iv = int(d("daft_integrity_verified_total"))
+    if_ = int(d("daft_integrity_failed_total"))
+    if iv or if_:
+        line = f"integrity: verified={iv}"
+        if if_:
+            line += (f", FAILED={if_}, "
+                     f"quarantined={int(d('daft_integrity_quarantined_total'))}")
+        lines.append(line)
     io_bytes = int(d("daft_io_bytes_total"))
     io_reqs = int(d("daft_io_requests_total"))
     if io_bytes or io_reqs:

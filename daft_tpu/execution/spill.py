@@ -127,6 +127,10 @@ class SpillFile:
     rows: int
     nbytes: int
     schema: Schema
+    # Integrity digest of the raw on-disk bytes, minted at write and
+    # verified before read-back (daft_tpu/integrity.py). Empty for files
+    # written before the plane existed: verification is skipped, not failed.
+    digest: str = ""
 
 
 class SpillDir:
@@ -167,7 +171,13 @@ class SpillDir:
                     chunk = table.slice(start, chunk_rows)
                     if chunk.num_rows or table.num_rows == 0:
                         writer.write_table(chunk)
-        sf = SpillFile(path, table.num_rows, table.nbytes, mp.schema)
+        from daft_tpu import integrity
+
+        digest = integrity.hash_file(path)
+        if integrity.verify_on_write():
+            integrity.verify_file(path, digest, "spill")
+        sf = SpillFile(path, table.num_rows, table.nbytes, mp.schema,
+                       digest=digest)
         spill_metrics.record(table.nbytes, 1)
         from daft_tpu.execution.memledger import get_ledger
 
@@ -180,9 +190,17 @@ class SpillDir:
         return sf
 
     def stream(self, sf: SpillFile) -> Iterator[RecordBatch]:
-        """Stream a spill file back batch-by-batch (bounded memory)."""
+        """Stream a spill file back batch-by-batch (bounded memory). The
+        raw bytes verify against the digest minted at write BEFORE decode
+        (the file is page-cache-hot — the extra pass is the <2% class the
+        integrity plane budgets); a mismatch quarantines and raises
+        DaftCorruptionError, healed by re-executing the owning task."""
+        from daft_tpu import integrity
+        from daft_tpu.distributed.faults import maybe_inject
         from daft_tpu.distributed.partition_ref import partition_from_wire_table
 
+        maybe_inject("integrity.spill", path=sf.path)
+        integrity.verify_file(sf.path, sf.digest, "spill")
         with pa.OSFile(sf.path, "rb") as f:
             with pa.ipc.open_stream(f) as reader:
                 for batch in reader:

@@ -1,0 +1,333 @@
+"""End-to-end data-integrity plane: checksummed artifacts, verified reads.
+
+Every byte-crossing artifact the engine persists — shuffle chunk files
+(``distributed/shuffle.py``), spill files (``execution/spill.py``),
+streaming-view checkpoints (``streaming/checkpoint.py``) — is stamped with
+a digest at write time and verified at every read site. The digest is the
+engine's own vectorised 64-bit hash (``kernels/hashing.py`` — the same
+FNV/splitmix64 kernel hash partitioning rides, native C++ when built) run
+block-at-a-time over the byte stream; when the kernel stack is unavailable
+the block hash falls back to crc32 (``zlib``) under a distinct digest
+prefix, so a digest never silently "verifies" across algorithms.
+
+The failure contract (reference discipline: TensorFlow's checksummed
+checkpoint/record formats treat on-disk bytes as untrusted):
+
+* a mismatch raises :class:`~daft_tpu.errors.DaftCorruptionError`, never a
+  confusing crash deep in Arrow IPC decode and never a silently wrong
+  answer;
+* the corrupt file is **quarantined** (renamed to ``<name>.quarantined``)
+  so a retry cannot re-read the same bad bytes, counted
+  (``daft_integrity_quarantined_total{artifact}``) and evented
+  (:class:`~daft_tpu.subscribers.events.CorruptionDetected`); quarantine
+  files are swept at query release / cleanup so the zero-leak audits hold;
+* shuffle-chunk corruption classifies over the wire like a fetch failure
+  (PR 2's ``fetch`` kind), carrying the chunk ticket — the dispatcher
+  routes it into lineage recovery and the flipped bit costs one partition
+  recompute, bounded by ``max_partition_recoveries``. The descriptor is
+  marked ``corruption: True`` so a healthy host serving one bad file is
+  NOT declared dead.
+
+Two digest flavors, one scheme:
+
+* **file digest** (``hash_file`` / :class:`StreamingDigest`) — over the
+  raw on-disk bytes, minted right after the artifact lands and verified
+  before any decode touches it (local chunk reads, the Flight server's
+  ``do_get``, spill read-back, checkpoint restore);
+* **content digest** (``table_digest``) — over the canonical uncompressed
+  Arrow IPC serialization of a chunk's wire table, carried on
+  ``ChunkRef`` across the wire and re-checked client-side after a Flight
+  fetch decodes the stream (the wire re-frames with its own codec, so
+  file bytes don't survive the hop but the content does).
+
+``ExecutionConfig.integrity_enabled`` / ``DAFT_INTEGRITY`` turns the whole
+plane off (digests still mint — they're one streaming pass over bytes
+already in cache — but reads skip verification);
+``integrity_verify_on_write`` additionally re-reads and verifies each
+artifact immediately after write (paranoid mode for tests/chaos).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import zlib
+from typing import Optional
+
+import numpy as np
+
+log = logging.getLogger("daft_tpu.integrity")
+
+#: Block protocol: the byte stream is hashed in fixed-size blocks and the
+#: per-block hashes are chained — identical digests regardless of how the
+#: writer chunked its write() calls, bounded memory regardless of file size.
+BLOCK_BYTES = 1 << 20
+
+_FNV_PRIME = 1099511628211
+_FNV_OFFSET = 14695981039346656037
+_MASK64 = (1 << 64) - 1
+
+#: Digest-string prefixes pin the algorithm: a kernel-hash digest can never
+#: accidentally "verify" against a crc32-fallback digest.
+_PREFIX_KERNEL = "x1"
+_PREFIX_CRC = "c1"
+
+
+def _mix(state: int, block_hash: int) -> int:
+    """Chain one block hash into the running state (splitmix64 avalanche)."""
+    h = ((state ^ block_hash) * _FNV_PRIME) & _MASK64
+    h ^= h >> 30
+    h = (h * 0xBF58476D1CE4E5B9) & _MASK64
+    h ^= h >> 27
+    h = (h * 0x94D049BB133111EB) & _MASK64
+    h ^= h >> 31
+    return h
+
+
+def _kernel_hash_block(data: bytes) -> Optional[int]:
+    """One-shot kernel hash of a block, or None when the kernel stack is
+    unavailable (then the crc32 fallback carries the digest)."""
+    try:
+        from daft_tpu.kernels.hashing import hash_bytes_batch
+
+        buf = np.frombuffer(data, dtype=np.uint8)
+        out = hash_bytes_batch(buf, np.array([0], dtype=np.int64),
+                               np.array([len(buf)], dtype=np.int64))
+        return int(out[0])
+    except Exception:  # noqa: BLE001 — classified: fall back to crc32
+        log.debug("kernel hash unavailable; digests fall back to crc32",
+                  exc_info=True)
+        return None
+
+
+class StreamingDigest:
+    """Incremental digest over a byte stream (the block protocol above).
+
+    ``update()`` accepts arbitrary-size buffers; memory stays bounded at
+    one block. ``hexdigest()`` finalizes (idempotent)."""
+
+    def __init__(self) -> None:
+        self._state = _FNV_OFFSET
+        self._crc = 0
+        self._nbytes = 0
+        self._buf = bytearray()
+        self._use_kernel: Optional[bool] = None  # decided on first block
+        self._final: Optional[str] = None
+
+    def update(self, data) -> None:
+        if self._final is not None:
+            raise ValueError("digest already finalized")
+        b = bytes(data)
+        self._nbytes += len(b)
+        self._buf += b
+        while len(self._buf) >= BLOCK_BYTES:
+            self._eat(bytes(self._buf[:BLOCK_BYTES]))
+            del self._buf[:BLOCK_BYTES]
+
+    def _eat(self, block: bytes) -> None:
+        if self._use_kernel is not False:
+            h = _kernel_hash_block(block)
+            if h is None:
+                self._use_kernel = False
+            else:
+                self._use_kernel = True
+                self._state = _mix(self._state, h)
+        # crc runs unconditionally: cheap, and it keeps the fallback digest
+        # well-defined even when the kernel vanished mid-stream.
+        self._crc = zlib.crc32(block, self._crc)
+
+    def hexdigest(self) -> str:
+        if self._final is None:
+            if self._buf:
+                self._eat(bytes(self._buf))
+                self._buf.clear()
+            if self._use_kernel:
+                self._final = f"{_PREFIX_KERNEL}-{self._nbytes:x}-{self._state:016x}"
+            else:
+                self._final = f"{_PREFIX_CRC}-{self._nbytes:x}-{self._crc:08x}"
+        return self._final
+
+
+def digest_bytes(data) -> str:
+    """One-shot digest of a byte buffer (the same scheme as files)."""
+    d = StreamingDigest()
+    d.update(data)
+    return d.hexdigest()
+
+
+def hash_file(path: str) -> str:
+    """Digest a file's raw bytes, block-at-a-time (bounded memory)."""
+    d = StreamingDigest()
+    with open(path, "rb") as f:
+        while True:
+            block = f.read(BLOCK_BYTES)
+            if not block:
+                break
+            d.update(block)
+    return d.hexdigest()
+
+
+def table_digest(table) -> str:
+    """Canonical content digest of an Arrow table: the uncompressed IPC
+    stream serialization of its combined single batch. Stable across the
+    file codec, the Flight wire codec, and a decode round-trip — the
+    digest a client can re-check after a fetch."""
+    import pyarrow as pa
+
+    combined = table.combine_chunks()
+    sink = pa.BufferOutputStream()
+    with pa.ipc.new_stream(sink, combined.schema) as writer:
+        for batch in combined.to_batches():
+            writer.write_batch(batch)
+    return digest_bytes(sink.getvalue())
+
+
+def enabled(cfg=None) -> bool:
+    """Is read-side verification on? (Minting is unconditional — a digest
+    stamped while the plane was off still verifies after it turns on.)"""
+    if cfg is None:
+        from daft_tpu.context import get_context
+
+        cfg = get_context().execution_config
+    return bool(getattr(cfg, "integrity_enabled", True))
+
+
+def verify_on_write(cfg=None) -> bool:
+    if cfg is None:
+        from daft_tpu.context import get_context
+
+        cfg = get_context().execution_config
+    return bool(getattr(cfg, "integrity_verify_on_write", False))
+
+
+# --------------------------------------------------------------------- #
+# Verification + quarantine                                              #
+# --------------------------------------------------------------------- #
+QUARANTINE_SUFFIX = ".quarantined"
+
+
+def _record_verified(artifact: str) -> None:
+    from daft_tpu import metrics
+
+    if metrics.get_registry().enabled:
+        metrics.INTEGRITY_VERIFIED.labels(artifact).inc()
+
+
+def _record_failure(artifact: str, path: str, ticket: str, expected: str,
+                    actual: str, quarantined: bool) -> None:
+    from daft_tpu import metrics
+    from daft_tpu.context import get_context
+    from daft_tpu.subscribers.events import CorruptionDetected
+
+    if metrics.get_registry().enabled:
+        metrics.INTEGRITY_FAILED.labels(artifact).inc()
+        if quarantined:
+            metrics.INTEGRITY_QUARANTINED.labels(artifact).inc()
+    try:
+        get_context().notify(CorruptionDetected(
+            artifact=artifact, path=path, ticket=ticket,
+            expected=expected, actual=actual,
+            action="quarantined" if quarantined else "detected"))
+    except Exception:  # noqa: BLE001 — observability must not mask the error
+        log.warning("CorruptionDetected event delivery failed", exc_info=True)
+
+
+def quarantine(path: str) -> Optional[str]:
+    """Rename a corrupt artifact to ``<path>.quarantined`` so no retry can
+    re-read the bad bytes. Returns the quarantine path, or None when the
+    rename was impossible (already gone / cross-process race — the reader
+    that lost the race still raises, it just doesn't own the rename)."""
+    qpath = path + QUARANTINE_SUFFIX
+    try:
+        os.replace(path, qpath)
+        return qpath
+    except OSError:
+        log.warning("failed to quarantine corrupt artifact %s", path,
+                    exc_info=True)
+        return None
+
+
+def sweep_quarantined(root: str) -> int:
+    """Delete every ``*.quarantined`` file under ``root`` (query release /
+    cleanup hook — quarantine must never outlive the query that found it).
+    Returns the number of files removed."""
+    removed = 0
+    try:
+        entries = list(os.walk(root))
+    except OSError:
+        return 0
+    for dirpath, _dirs, files in entries:
+        for name in files:
+            if name.endswith(QUARANTINE_SUFFIX):
+                try:
+                    os.unlink(os.path.join(dirpath, name))
+                    removed += 1
+                except OSError:
+                    log.debug("quarantine sweep failed for %s in %s",
+                              name, dirpath, exc_info=True)
+    return removed
+
+
+def audit_quarantine_residue(root: str) -> list:
+    """Paths of ``*.quarantined`` files still present under ``root`` — the
+    leak-audit extension (must be empty after teardown)."""
+    found = []
+    try:
+        entries = list(os.walk(root))
+    except OSError:
+        return found
+    for dirpath, _dirs, files in entries:
+        found.extend(os.path.join(dirpath, name) for name in files
+                     if name.endswith(QUARANTINE_SUFFIX))
+    return sorted(found)
+
+
+def verify_file(path: str, expected: str, artifact: str, ticket: str = "",
+                cfg=None, do_quarantine: bool = True) -> None:
+    """Verify a persisted artifact's raw bytes against its minted digest.
+
+    No-op when the plane is disabled or the artifact predates the plane
+    (``expected`` empty). On mismatch: quarantine + count + event + raise
+    :class:`DaftCorruptionError` carrying the artifact kind, path, and
+    chunk ticket (the lineage-recovery key)."""
+    from daft_tpu.errors import DaftCorruptionError
+
+    if not expected or not enabled(cfg):
+        return
+    try:
+        actual = hash_file(path)
+    except OSError as e:
+        # Unreadable is not corruption; let the read path classify it.
+        raise e
+    if actual == expected:
+        _record_verified(artifact)
+        return
+    qpath = quarantine(path) if do_quarantine else None
+    _record_failure(artifact, path, ticket, expected, actual,
+                    quarantined=qpath is not None)
+    raise DaftCorruptionError(
+        f"{artifact} artifact failed integrity verification: {path} "
+        f"(expected {expected}, got {actual})"
+        + (f" [quarantined -> {qpath}]" if qpath else ""),
+        artifact=artifact, path=path, ticket=ticket)
+
+
+def verify_table(table, expected: str, artifact: str, ticket: str = "",
+                 cfg=None) -> None:
+    """Verify a decoded wire table against its content digest (the client-
+    side post-fetch check). Raises :class:`DaftCorruptionError` on
+    mismatch — there is no file to quarantine on this side of the wire;
+    the ticket in the error names the chunk for lineage recovery."""
+    from daft_tpu.errors import DaftCorruptionError
+
+    if not expected or not enabled(cfg):
+        return
+    actual = table_digest(table)
+    if actual == expected:
+        _record_verified(artifact)
+        return
+    _record_failure(artifact, "", ticket, expected, actual, quarantined=False)
+    raise DaftCorruptionError(
+        f"{artifact} wire content failed integrity verification "
+        f"(ticket {ticket or '?'}: expected {expected}, got {actual})",
+        artifact=artifact, path="", ticket=ticket)

@@ -12,6 +12,9 @@
 | DTL008 | counters live on the metrics registry, not module-level dicts    |
 | DTL009 | spans are opened via the context-manager API, never bare calls   |
 | DTL010 | engine-path queues/deques are constructed with an explicit bound |
+| DTL014 | persistence modules mint an integrity digest for every artifact  |
+
+(DTL011–DTL013 are the whole-program project tier — lint/project_rules.py.)
 
 Each rule documents WHY the invariant exists — a lint error nobody can
 explain gets suppressed instead of fixed.
@@ -630,17 +633,112 @@ class UnboundedQueueInEnginePath(Rule):
         return True
 
 
+class UnframedArtifactWrite(Rule):
+    """DTL014: every artifact the engine persists and later trusts —
+    shuffle chunk files, spill files, checkpoint state — must be framed by
+    the integrity plane (daft_tpu/integrity.py): a digest minted in the
+    same scope that writes the bytes, so corruption is caught at read time
+    instead of silently decoded into wrong results. A bare
+    ``open(..., "wb")`` / ``pa.OSFile(..., "wb")`` / ``pa.ipc`` write in a
+    persistence module with no digest call after it is exactly how a new
+    artifact kind escapes the plane. Self-verifying formats (manifest JSON
+    whose torn/undecodable form already reads as absent) carry a reasoned
+    baseline entry instead of a digest."""
+
+    rule_id = "DTL014"
+    summary = "persisted artifact written without integrity framing"
+    # File-scoped, not directory-scoped: these are THE three persistence
+    # modules whose on-disk artifacts cross a read-back trust boundary.
+    scope_dirs = ("daft_tpu/distributed/shuffle.py",
+                  "daft_tpu/execution/spill.py",
+                  "daft_tpu/streaming/checkpoint.py")
+
+    #: a call to any of these (bare or as ``integrity.<name>``) counts as
+    #: minting a digest for the scope's write.
+    DIGEST_CALLS = {"hash_file", "table_digest", "digest_bytes",
+                    "StreamingDigest"}
+    IPC_WRITERS = {"pa.ipc.new_file", "pa.ipc.new_stream",
+                   "pyarrow.ipc.new_file", "pyarrow.ipc.new_stream"}
+    OSFILE = {"pa.OSFile", "pyarrow.OSFile"}
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        scopes = [n for n in ctx.walk()
+                  if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+        for scope in scopes:
+            writes = []  # (node, what)
+            digest_lines = []
+            for node in walk_without_nested_defs(scope):
+                if not isinstance(node, ast.Call):
+                    continue
+                if self._is_digest_call(ctx, node):
+                    digest_lines.append(node.lineno)
+                    continue
+                what = self._write_kind(ctx, node)
+                if what:
+                    writes.append((node, what))
+            for node, what in writes:
+                # Framed = a digest is minted AFTER the write in the same
+                # scope (write-then-hash is the plane's idiom; a digest
+                # computed before the write can't cover the bytes written).
+                if any(dl > node.lineno for dl in digest_lines):
+                    continue
+                yield self.finding(
+                    ctx, node,
+                    f"{what} writes a persisted artifact with no integrity "
+                    f"digest minted afterwards in the same scope; frame it "
+                    f"with integrity.hash_file/table_digest so read-back "
+                    f"verifies, or suppress with a reason if the format is "
+                    f"self-verifying (e.g. atomically-renamed JSON)")
+
+    def _is_digest_call(self, ctx: FileContext, call: ast.Call) -> bool:
+        f = call.func
+        if isinstance(f, ast.Name) and f.id in self.DIGEST_CALLS:
+            return True
+        if isinstance(f, ast.Attribute) and f.attr in self.DIGEST_CALLS:
+            return True
+        return False
+
+    def _write_kind(self, ctx: FileContext, call: ast.Call) -> Optional[str]:
+        f = call.func
+        if isinstance(f, ast.Name) and f.id == "open":
+            mode = self._mode_literal(call)
+            if mode and any(c in mode for c in "wax"):
+                return f'open(..., "{mode}")'
+            return None
+        dotted = ctx.imports.resolve_call(call)
+        if dotted in self.OSFILE:
+            mode = self._mode_literal(call)
+            if mode and any(c in mode for c in "wax"):
+                return f'{dotted}(..., "{mode}")'
+            return None
+        if dotted in self.IPC_WRITERS:
+            return f"{dotted}(...)"
+        return None
+
+    @staticmethod
+    def _mode_literal(call: ast.Call) -> Optional[str]:
+        mode = call.args[1] if len(call.args) > 1 else None
+        for kw in call.keywords:
+            if kw.arg == "mode":
+                mode = kw.value
+        if isinstance(mode, ast.Constant) and isinstance(mode.value, str):
+            return mode.value
+        return None
+
+
 from daft_tpu.lint.project_rules import PROJECT_RULES  # noqa: E402
 
 ALL_RULES = [WallClockInTaskPath, SwallowedException, UnseededRandomness,
              BlockingCallUnderLock, HostDeviceTransferInKernel,
              NondeterministicIteration, EnvReadOutsideConfig,
              AdHocCounterDict, SpanOutsideContextManager,
-             UnboundedQueueInEnginePath] + PROJECT_RULES
+             UnboundedQueueInEnginePath] + PROJECT_RULES + [
+                 UnframedArtifactWrite]
 
 
 def default_rules() -> List[Rule]:
-    """Every rule, both tiers: file (DTL001–DTL010) + project (DTL011+)."""
+    """Every rule, both tiers: file (DTL001–DTL010, DTL014) + project
+    (DTL011–DTL013)."""
     return [cls() for cls in ALL_RULES]
 
 

@@ -1037,6 +1037,25 @@ FRESHNESS_ALERTS = _r.counter(
     "Freshness burn-rate alert episodes per view", ("view",),
     max_series=_MAX_VIEW_SERIES)
 
+# Data-integrity plane (daft_tpu/integrity.py): digests verified at every
+# artifact read, failures quarantined and healed through lineage.
+INTEGRITY_VERIFIED = _r.counter(
+    "daft_integrity_verified_total",
+    "Artifact integrity verifications that passed, by artifact kind "
+    "(chunk / spill / checkpoint)", ("artifact",))
+INTEGRITY_FAILED = _r.counter(
+    "daft_integrity_failed_total",
+    "Artifact integrity verifications that FAILED (digest mismatch — "
+    "corruption caught before decode), by artifact kind", ("artifact",))
+INTEGRITY_QUARANTINED = _r.counter(
+    "daft_integrity_quarantined_total",
+    "Corrupt artifact files renamed to *.quarantined pending sweep at "
+    "query release, by artifact kind", ("artifact",))
+STREAM_CORRUPT_LINES = _r.counter(
+    "daft_streaming_corrupt_lines_total",
+    "Corrupt (undecodable) JSONL lines skipped by tailing sources, by "
+    "source kind", ("source",))
+
 # AI providers (ai/metrics.py shims onto these)
 AI_TOKENS = _r.counter(
     "daft_ai_tokens_total", "Provider tokens consumed",

@@ -64,8 +64,11 @@ log = logging.getLogger("daft_tpu.querylog")
 #: maintained, or the freshness facts (watermark, staleness, delta count)
 #: attached when a query was SERVED from a view entry ({} for plain
 #: queries). The reader accepts v1 through v4 — a log written across any
-#: upgrade still loads whole.
-QUERYLOG_SCHEMA_VERSION = 4
+#: upgrade still loads whole. v5 adds the integrity plane's OPTIONAL
+#: ``integrity`` block (daft_tpu/integrity.py): digest verifications,
+#: failures and quarantines observed over the query's bracket — present
+#: only when the plane saw traffic, so plain queries pay no bytes.
+QUERYLOG_SCHEMA_VERSION = 5
 
 #: Outcome taxonomy — every query lands in exactly one bucket.
 OUTCOME_SUCCESS = "success"
@@ -90,7 +93,11 @@ RECORD_REQUIRED_V2 = RECORD_REQUIRED_V1 + ("plan_cache_hit",
                                            "result_cache_hit")
 RECORD_REQUIRED_V3 = RECORD_REQUIRED_V2 + ("mem",)
 RECORD_REQUIRED_V4 = RECORD_REQUIRED_V3 + ("view",)
-RECORD_REQUIRED = RECORD_REQUIRED_V4
+#: v5 adds NO required keys: the ``integrity`` block is optional by design
+#: (only stamped when the integrity plane verified/failed/quarantined
+#: anything during the query), so the required pin is v4's.
+RECORD_REQUIRED_V5 = RECORD_REQUIRED_V4
+RECORD_REQUIRED = RECORD_REQUIRED_V5
 
 #: Ring capacity default; DAFT_QUERY_LOG_RING overrides at first use.
 DEFAULT_RING_SIZE = 512
@@ -158,6 +165,15 @@ def _counter_values() -> Dict[str, float]:
         "stage_fusions": metrics.STAGE_FUSIONS._default_child().value(),
         "shuffle_bytes_written": metrics.SHUFFLE_BYTES_WRITTEN._default_child().value(),
         "shuffle_bytes_fetched": metrics.SHUFFLE_BYTES_FETCHED._default_child().value(),
+        # Integrity plane (labelled by artifact): summed across children so
+        # the record's delta is "any artifact kind", matching the optional
+        # v5 block's coarse shape.
+        "integrity_verified": sum(
+            c.value() for _, c in metrics.INTEGRITY_VERIFIED.series()),
+        "integrity_failed": sum(
+            c.value() for _, c in metrics.INTEGRITY_FAILED.series()),
+        "integrity_quarantined": sum(
+            c.value() for _, c in metrics.INTEGRITY_QUARANTINED.series()),
     }
 
 
@@ -366,6 +382,13 @@ class FlightRecorder:
             "autoprofiled": entry.autoprofiled,
             "operators": _operator_digest(profile),
         }
+        # Schema-v5 OPTIONAL block: stamped only when the integrity plane
+        # saw traffic during this query's bracket (same process-level-delta
+        # caveat as the compile/shuffle counters above).
+        integ = {k: int(m1[f"integrity_{k}"] - entry._m0[f"integrity_{k}"])
+                 for k in ("verified", "failed", "quarantined")}
+        if any(integ.values()):
+            record["integrity"] = integ
         self._publish(record, cfg=entry.cfg)
         return record
 
@@ -498,15 +521,16 @@ def validate_record(rec: Any) -> List[str]:
     version = rec.get("schema_version")
     required = {1: RECORD_REQUIRED_V1,
                 2: RECORD_REQUIRED_V2,
-                3: RECORD_REQUIRED_V3}.get(version, RECORD_REQUIRED_V4)
+                3: RECORD_REQUIRED_V3,
+                4: RECORD_REQUIRED_V4}.get(version, RECORD_REQUIRED_V5)
     for key in required:
         if key not in rec:
             errs.append(f"missing key {key!r}")
     if errs:
         return errs
-    if version not in (1, 2, 3, QUERYLOG_SCHEMA_VERSION):
+    if version not in (1, 2, 3, 4, QUERYLOG_SCHEMA_VERSION):
         errs.append(f"schema_version {version!r} not in "
-                    f"(1, 2, 3, {QUERYLOG_SCHEMA_VERSION})")
+                    f"(1, 2, 3, 4, {QUERYLOG_SCHEMA_VERSION})")
     if rec["outcome"] not in OUTCOMES:
         errs.append(f"unknown outcome {rec['outcome']!r}")
     if not isinstance(rec.get("duration_s"), (int, float)) \

@@ -74,8 +74,20 @@ class ViewCheckpointStore:
                         writer.write_table(t)
             _fsync_path(tmp)  # state must be durable BEFORE the manifest
             os.replace(tmp, spath)
+            # The manifest carries the state file's integrity digest: a
+            # bit-flipped state file is then caught at restore and cold-
+            # starts (same contract as a torn manifest), never restoring
+            # garbage partials (daft_tpu/integrity.py). The manifest JSON
+            # itself is self-verifying — torn/undecodable JSON already
+            # reads as absent.
+            from daft_tpu import integrity
+
+            manifest = dict(manifest)
+            manifest["state_digest"] = integrity.hash_file(spath)
         elif os.path.exists(spath):
             os.remove(spath)
+            manifest = {k: v for k, v in manifest.items()
+                        if k != "state_digest"}
         tmp = mpath + ".tmp"
         with open(tmp, "w") as f:
             json.dump(manifest, f, sort_keys=True)
@@ -100,6 +112,21 @@ class ViewCheckpointStore:
             return None
         batches: List[RecordBatch] = []
         if os.path.exists(spath):
+            from daft_tpu import integrity
+            from daft_tpu.distributed.faults import maybe_inject
+            from daft_tpu.errors import DaftCorruptionError
+
+            try:
+                maybe_inject("integrity.checkpoint", path=spath)
+                integrity.verify_file(spath, manifest.get("state_digest", ""),
+                                      "checkpoint")
+            except DaftCorruptionError:
+                # Same contract as a torn manifest: corruption must not
+                # wedge registration — the corrupt state is quarantined
+                # (counted + evented) and the view starts cold; the source
+                # re-polls from scratch, so no data is lost, only
+                # incremental state.
+                return None
             try:
                 with pa.OSFile(spath, "rb") as f:
                     reader = pa.ipc.open_file(f)
@@ -113,16 +140,19 @@ class ViewCheckpointStore:
         return manifest
 
     def clear(self, view: Optional[str] = None) -> None:
+        from daft_tpu.integrity import QUARANTINE_SUFFIX
+
         if view is not None:
             for p in self._paths(view):
-                try:
-                    os.remove(p)
-                except OSError:
-                    pass
+                for path in (p, p + QUARANTINE_SUFFIX):
+                    try:
+                        os.remove(path)
+                    except OSError:
+                        pass
             return
         if os.path.isdir(self.path):
             for name in os.listdir(self.path):
-                if name.endswith((".json", ".arrow")):
+                if name.endswith((".json", ".arrow", QUARANTINE_SUFFIX)):
                     try:
                         os.remove(os.path.join(self.path, name))
                     except OSError:

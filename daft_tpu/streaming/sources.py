@@ -229,6 +229,7 @@ class AppendLogSource(TailingSource):
         self.path = os.path.abspath(os.path.expanduser(path))
         self._offset = 0
         self._seq = 0
+        self._corrupt_lines = 0  # lifetime tally, surfaced in view stats
 
     def poll(self, max_files: int = 64,
              max_bytes: int = 256 << 20) -> Optional[SourceDelta]:
@@ -250,16 +251,42 @@ class AppendLogSource(TailingSource):
             return None
         chunk = chunk[:cut + 1]
         rows: List[dict] = []
-        for line in chunk.splitlines():
-            line = line.strip()
+        bad_offsets: List[int] = []  # absolute byte offsets of corrupt lines
+        pos = 0
+        for raw in chunk.split(b"\n"):
+            line_at = self._offset + pos
+            pos += len(raw) + 1
+            line = raw.strip()
             if not line:
                 continue
             try:
                 rec = json.loads(line)
             except json.JSONDecodeError:
-                continue  # corrupt line: skipped, never fatal (log discipline)
+                # Corrupt line: skipped, never fatal (log discipline) — but
+                # never SILENTLY: counted per source and evented per poll so
+                # a producer writing garbage is visible, not vanished.
+                bad_offsets.append(line_at)
+                continue
             if isinstance(rec, dict):
                 rows.append(rec)
+        if bad_offsets:
+            self._corrupt_lines += len(bad_offsets)
+            try:
+                reg = metrics.get_registry()
+                if reg.enabled:
+                    metrics.STREAM_CORRUPT_LINES.labels(self.kind).inc(
+                        len(bad_offsets))
+                from daft_tpu.context import get_context
+                from daft_tpu.subscribers.events import StreamCorruptLines
+
+                get_context().notify(StreamCorruptLines(
+                    source=self.kind, path=self.path,
+                    count=len(bad_offsets),
+                    offsets=tuple(bad_offsets[:16])))
+            except Exception:  # daftlint: disable=DTL002 -- observability
+                # (a metrics/subscriber defect) must never fail the poll
+                # that detected the corruption it reports.
+                pass
         now = time.time()
         delta = SourceDelta(seq=self._seq, rows=rows,
                             watermark=_file_mtime(self.path) or now,
@@ -279,6 +306,10 @@ class AppendLogSource(TailingSource):
             return max(0, os.path.getsize(self.path) - self._offset)
         except OSError:
             return 0
+
+    def corrupt_lines(self) -> int:
+        """Lifetime count of skipped-undecodable JSONL lines."""
+        return self._corrupt_lines
 
     def cursor_state(self) -> dict:
         return {"kind": self.kind, "seq": self._seq, "offset": self._offset}

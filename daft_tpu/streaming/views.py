@@ -466,6 +466,8 @@ class MaterializedView:
                 "rows": rows,
                 "state_bytes": self.state.approx_size_bytes(),
                 "backlog": self.source.backlog(),
+                "corrupt_lines": int(getattr(
+                    self.source, "corrupt_lines", lambda: 0)()),
                 "source_kind": getattr(self.source, "kind", "?"),
                 "refresh_count": self.refresh_count,
                 "full_recomputes": self.full_recomputes,

@@ -144,6 +144,37 @@ class PartitionRecovered(Event):
 
 
 @dataclass
+class CorruptionDetected(Event):
+    """An artifact failed integrity verification at read
+    (daft_tpu/integrity.py): the bytes on disk / off the wire do not match
+    the digest minted at write time. ``artifact`` is chunk / spill /
+    checkpoint; ``action`` is what the plane did about it (``quarantined``
+    — file renamed to *.quarantined pending sweep — or ``detected`` when
+    there was no file to quarantine, e.g. a wire-side content mismatch);
+    ``ticket`` names the shuffle chunk for lineage recovery."""
+
+    artifact: str = ""
+    path: str = ""
+    ticket: str = ""
+    expected: str = ""
+    actual: str = ""
+    action: str = ""
+
+
+@dataclass
+class StreamCorruptLines(Event):
+    """A tailing source skipped corrupt (undecodable) JSONL lines during
+    one poll (streaming/sources.py AppendLogSource). One event per poll
+    that saw any — ``offsets`` are the byte offsets of the skipped lines
+    within the log, ``count`` how many this poll."""
+
+    source: str = ""
+    path: str = ""
+    count: int = 0
+    offsets: tuple = ()
+
+
+@dataclass
 class QueryCancelled(Event):
     """The query's deadline expired or the user cancelled it; the scheduler
     is aborting through the drain path. ``reason`` is ``deadline`` or the

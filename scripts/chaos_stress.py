@@ -361,6 +361,103 @@ def shuffle_storm_round(seed: int, workers: int = 12,
     return None
 
 
+def corruption_storm_round(seed: int, workers: int,
+                           queries: int = 6) -> str | None:
+    """Corruption-storm spec (ISSUE 19): shuffle-heavy queries on a
+    flight-shuffle cluster while the fault injector bit-flips and truncates
+    shuffle chunk files at read sites. Asserts every query is byte-identical
+    to the fault-free baseline (corruption healed through lineage — NOT
+    surfaced and NOT silently wrong), PartitionRecovered events fired for
+    the healed chunks, and zero ``*.quarantined`` residue after teardown."""
+    import threading
+
+    from daft_tpu.distributed.shuffle import audit_shuffle_leaks
+    from daft_tpu.subscribers.events import (
+        CorruptionDetected,
+        PartitionRecovered,
+    )
+
+    class _Tap:
+        def __init__(self):
+            self.events = []
+            self._lock = threading.Lock()
+
+        def on_event(self, event):
+            with self._lock:
+                self.events.append(event)
+
+        def of(self, kind):
+            with self._lock:
+                return [e for e in self.events if isinstance(e, kind)]
+
+    ctx = daft_tpu.get_context()
+    old = ctx._runner
+    runner = DistributedRunner(num_workers=workers)
+    ctx.set_runner(runner)
+    tap = _Tap()
+    ctx.attach_subscriber(tap)
+    errors: list = []
+    lock = threading.Lock()
+    try:
+        with daft_tpu.execution_config_ctx(
+                shuffle_algorithm="flight", shuffle_chunk_bytes=32 * 1024,
+                result_cache_enabled=False):
+            lineitem = make_lineitem()
+            orders = make_orders()
+            baseline = (q1_style(lineitem), join_sort_style(lineitem, orders))
+            rng = random.Random(seed)
+            # Low per-query fire counts: the point is silent-corruption
+            # detection + lineage healing, and the per-query recovery
+            # budget must never be the thing that fails the storm.
+            specs = [
+                f"integrity.chunk:{rng.choice(['corrupt', 'truncate'])}"
+                f":{rng.randrange(1, 4)}"
+                for _ in range(queries)
+            ]
+
+            def one(i: int) -> None:
+                try:
+                    with fault_scope(specs[i], seed=seed + i):
+                        got = (q1_style(lineitem),
+                               join_sort_style(lineitem, orders))
+                    if got != baseline:
+                        with lock:
+                            errors.append(
+                                f"SILENT DIVERGENCE under {specs[i]!r}")
+                except BaseException as e:  # noqa: BLE001
+                    # Unlike the kill-storm, corruption must HEAL, not
+                    # classify: any surfaced failure is a round failure.
+                    with lock:
+                        errors.append(f"query failed under {specs[i]!r}: "
+                                      f"{repr(e)[:120]}")
+
+            threads = [threading.Thread(target=one, args=(i,))
+                       for i in range(queries)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=120)
+            if any(t.is_alive() for t in threads):
+                return "corruption-storm query thread(s) hung"
+        leaks = audit_shuffle_leaks()
+    finally:
+        runner.manager.shutdown()
+        ctx.set_runner(old)
+        ctx.detach_subscriber(tap)
+    if errors:
+        return "; ".join(errors[:3])
+    detected = tap.of(CorruptionDetected)
+    recovered = tap.of(PartitionRecovered)
+    if detected and not recovered:
+        return (f"{len(detected)} corruption(s) detected but zero "
+                f"PartitionRecovered events — healing never ran")
+    if leaks["files"]:
+        return f"leaked shuffle chunk files after storm: {leaks}"
+    if leaks.get("quarantined"):
+        return f"quarantined-file residue after storm: {leaks['quarantined']}"
+    return None
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--rounds", type=int, default=10)
@@ -376,7 +473,25 @@ def main() -> int:
                          "fetch faults on a flight-shuffle cluster)")
     ap.add_argument("--workers", type=int, default=12,
                     help="cluster size for --shuffle-storm (8-16)")
+    ap.add_argument("--corruption", action="store_true",
+                    help="run only the corruption storm (bit-flip/truncate "
+                         "faults on shuffle chunk reads at 2/8/16 workers; "
+                         "asserts byte-identical healed results and zero "
+                         "quarantine residue)")
     args = ap.parse_args()
+
+    if args.corruption:
+        for workers in (2, 8, 16):
+            t0 = time.time()
+            err = corruption_storm_round(seed=args.seed, workers=workers)
+            if err:
+                print(f"[corruption] FAIL seed={args.seed} "
+                      f"workers={workers}: {err}")
+                return 1
+            print(f"[corruption] ok ({time.time() - t0:.1f}s) — "
+                  f"{workers}-worker storm healed byte-identically, "
+                  f"zero quarantine residue")
+        return 0
 
     if args.shuffle_storm:
         t0 = time.time()

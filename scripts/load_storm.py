@@ -323,6 +323,10 @@ def chaos_round(stats: StormStats, n_queries: int, seed: int) -> None:
         if leaks["files"]:
             stats.unclassified.append(
                 ("shuffle-audit", f"leaked chunk files: {leaks}"))
+        if leaks.get("quarantined"):
+            stats.unclassified.append(
+                ("integrity-audit",
+                 f"quarantined-file residue: {leaks['quarantined']}"))
     finally:
         runner.manager.shutdown()
         ctx.set_runner(old)
@@ -499,6 +503,9 @@ def sinusoidal_storm(args) -> int:
     leaks = audit_shuffle_leaks()
     if leaks["files"]:
         failures.append(f"leaked shuffle chunk files after drains: {leaks}")
+    if leaks.get("quarantined"):
+        failures.append(
+            f"quarantined-file residue after drains: {leaks['quarantined']}")
     mem_leaks = audit_ledger_leaks()
     if mem_leaks:
         failures.append(f"ledger did not drain to zero: {mem_leaks}")
@@ -1061,6 +1068,12 @@ def main() -> int:
     shuffle_leaks = audit_shuffle_leaks()
     if shuffle_leaks["files"]:
         failures.append(f"leaked shuffle chunk files: {shuffle_leaks}")
+    # Integrity plane (ISSUE 19): a quarantined artifact is evidence held
+    # for the audit trail DURING the query, but residue after release is
+    # a leak like any other chunk file.
+    if shuffle_leaks.get("quarantined"):
+        failures.append(
+            f"quarantined-file residue: {shuffle_leaks['quarantined']}")
     # 5b. Memory observatory (ISSUE 15): the per-query byte ledger drained
     # to ZERO across every outcome the storm produced (success, shed,
     # cancel, chaos kills), no record carried force-drained residue, and

@@ -1,0 +1,721 @@
+"""Data-integrity plane tests (daft_tpu/integrity.py).
+
+Covers the whole plane end-to-end: the digest scheme itself (block
+protocol, length framing, content-vs-file digests), verify + quarantine
+mechanics, the corrupt/truncate fault actions, per-artifact detection
+(shuffle chunks, spill files, streaming checkpoints), corrupt-JSONL line
+accounting in tailing sources, lineage-healed reads under injected
+corruption (byte-identical results, zero residue), wire classification
+across process boundaries, and the v5 flight-record / metrics /
+EXPLAIN ANALYZE observability surfaces.
+"""
+
+import json
+import os
+import pickle
+import threading
+
+import pytest
+
+import daft_tpu
+from daft_tpu import col, integrity, metrics
+from daft_tpu.distributed.faults import fault_scope, maybe_inject
+from daft_tpu.distributed.shuffle import ShuffleCache, audit_shuffle_leaks
+from daft_tpu.errors import DaftCorruptionError
+from daft_tpu.execution.spill import SpillDir
+from daft_tpu.micropartition import MicroPartition
+from daft_tpu.runners.distributed import DistributedRunner
+from daft_tpu.subscribers.events import (
+    CorruptionDetected,
+    PartitionRecovered,
+    StreamCorruptLines,
+)
+
+
+def _counter(name: str) -> float:
+    return metrics.get_registry().snapshot().counter_total(name)
+
+
+def _flip_byte(path: str, offset: int = None) -> None:
+    """Flip one bit of ``path`` in place (the canonical corruption)."""
+    with open(path, "r+b") as f:
+        f.seek(0, os.SEEK_END)
+        size = f.tell()
+        assert size > 0
+        pos = size // 2 if offset is None else offset
+        f.seek(pos)
+        b = f.read(1)
+        f.seek(pos)
+        f.write(bytes([b[0] ^ 0x01]))
+
+
+class EventTap:
+    def __init__(self):
+        self.events = []
+        self._lock = threading.Lock()
+
+    def on_event(self, event):
+        with self._lock:
+            self.events.append(event)
+
+    def of(self, kind):
+        with self._lock:
+            return [e for e in self.events if isinstance(e, kind)]
+
+
+@pytest.fixture
+def tap():
+    ctx = daft_tpu.get_context()
+    t = EventTap()
+    ctx.attach_subscriber(t)
+    yield t
+    ctx.detach_subscriber(t)
+
+
+@pytest.fixture
+def mp():
+    return MicroPartition.from_pydict({
+        "a": list(range(1000)),
+        "b": [f"val-{i}" for i in range(1000)],
+    })
+
+
+# ------------------------------------------------------------------ #
+# The digest scheme                                                    #
+# ------------------------------------------------------------------ #
+def test_digest_deterministic_and_bit_sensitive():
+    data = bytes(range(256)) * 100
+    d1 = integrity.digest_bytes(data)
+    assert d1 == integrity.digest_bytes(data)
+    flipped = bytearray(data)
+    flipped[1234] ^= 0x01
+    assert integrity.digest_bytes(bytes(flipped)) != d1
+
+
+def test_digest_independent_of_feed_chunking():
+    """The block protocol digests the STREAM, not the feed pattern: any
+    split of the same bytes lands on the same digest."""
+    data = os.urandom(3 * integrity.BLOCK_BYTES + 12345)
+    one_shot = integrity.digest_bytes(data)
+    for splits in ((1,), (7, 4096, 1 << 20), (integrity.BLOCK_BYTES,)):
+        d = integrity.StreamingDigest()
+        pos = 0
+        i = 0
+        while pos < len(data):
+            step = splits[i % len(splits)]
+            d.update(data[pos:pos + step])
+            pos += step
+            i += 1
+        assert d.hexdigest() == one_shot
+
+
+def test_digest_frames_length():
+    """Truncation is caught by the length field alone — a prefix of the
+    stream can never share a digest with the whole."""
+    data = b"x" * 1000
+    full = integrity.digest_bytes(data)
+    prefix, nbytes, _state = full.split("-")
+    assert prefix in ("x1", "c1")
+    assert int(nbytes, 16) == len(data)
+    assert integrity.digest_bytes(data[:500]) != full
+
+
+def test_hash_file_matches_digest_bytes(tmp_path):
+    data = os.urandom(200_000)
+    p = str(tmp_path / "blob")
+    with open(p, "wb") as f:
+        f.write(data)
+    assert integrity.hash_file(p) == integrity.digest_bytes(data)
+
+
+def test_table_digest_is_content_not_encoding(mp):
+    """The content digest survives a compressed IPC round-trip: it names
+    the DATA, so wire codec choices can't produce false mismatches."""
+    import pyarrow as pa
+
+    from daft_tpu.distributed.partition_ref import (
+        deserialize_partition,
+        serialize_partition,
+    )
+
+    t1 = pa.table(mp.to_pydict())
+    back = deserialize_partition(serialize_partition(mp))
+    t2 = pa.table(back.to_pydict())
+    assert integrity.table_digest(t1) == integrity.table_digest(t2)
+    t3 = pa.table({"a": [1, 2, 3]})
+    assert integrity.table_digest(t3) != integrity.table_digest(t1)
+
+
+def test_algorithms_never_cross_verify(tmp_path):
+    """An x1 (kernel) digest must not be accepted for a c1 (crc) one or
+    vice versa — the prefix is part of the identity."""
+    p = str(tmp_path / "blob")
+    with open(p, "wb") as f:
+        f.write(b"payload")
+    d = integrity.hash_file(p)
+    other = ("c1" if d.startswith("x1") else "x1") + d[2:]
+    with pytest.raises(DaftCorruptionError):
+        integrity.verify_file(p, other, "chunk", do_quarantine=False)
+
+
+# ------------------------------------------------------------------ #
+# verify_file / quarantine mechanics                                   #
+# ------------------------------------------------------------------ #
+def test_verify_match_counts_verified(tmp_path):
+    p = str(tmp_path / "ok")
+    with open(p, "wb") as f:
+        f.write(b"healthy bytes")
+    before = _counter("daft_integrity_verified_total")
+    integrity.verify_file(p, integrity.hash_file(p), "chunk")
+    assert _counter("daft_integrity_verified_total") == before + 1
+    assert os.path.exists(p)
+
+
+def test_verify_mismatch_quarantines_and_raises(tmp_path, tap):
+    p = str(tmp_path / "bad")
+    with open(p, "wb") as f:
+        f.write(os.urandom(4096))
+    expected = integrity.hash_file(p)
+    _flip_byte(p)
+    f0 = _counter("daft_integrity_failed_total")
+    q0 = _counter("daft_integrity_quarantined_total")
+    with pytest.raises(DaftCorruptionError) as ei:
+        integrity.verify_file(p, expected, "chunk", ticket="shuf1:0:c3")
+    err = ei.value
+    assert err.artifact == "chunk"
+    assert err.ticket == "shuf1:0:c3"
+    assert err.path == p
+    assert not os.path.exists(p)  # renamed away: no retry can re-read it
+    assert os.path.exists(p + integrity.QUARANTINE_SUFFIX)
+    assert _counter("daft_integrity_failed_total") == f0 + 1
+    assert _counter("daft_integrity_quarantined_total") == q0 + 1
+    evs = tap.of(CorruptionDetected)
+    assert len(evs) == 1
+    assert evs[0].artifact == "chunk"
+    assert evs[0].ticket == "shuf1:0:c3"
+    assert evs[0].action == "quarantined"
+    assert evs[0].expected == expected
+
+
+def test_verify_empty_expected_is_noop(tmp_path):
+    p = str(tmp_path / "legacy")
+    with open(p, "wb") as f:
+        f.write(b"pre-plane artifact")
+    integrity.verify_file(p, "", "spill")  # no digest: skip, don't fail
+
+
+def test_verify_disabled_skips_mismatch(tmp_path):
+    p = str(tmp_path / "off")
+    with open(p, "wb") as f:
+        f.write(os.urandom(1024))
+    expected = integrity.hash_file(p)
+    _flip_byte(p)
+    with daft_tpu.execution_config_ctx(integrity_enabled=False):
+        integrity.verify_file(p, expected, "chunk")
+    assert os.path.exists(p)  # no quarantine while the plane is off
+
+
+def test_unreadable_is_oserror_not_corruption(tmp_path):
+    with pytest.raises(OSError):
+        integrity.verify_file(str(tmp_path / "missing"), "x1-1-0", "chunk")
+
+
+def test_verify_table_mismatch_raises(tap):
+    import pyarrow as pa
+
+    t = pa.table({"a": [1, 2, 3]})
+    good = integrity.table_digest(t)
+    integrity.verify_table(t, good, "chunk")  # passes silently
+    with pytest.raises(DaftCorruptionError):
+        integrity.verify_table(t, "x1-ffff-0000000000000000", "chunk",
+                               ticket="tick")
+    evs = tap.of(CorruptionDetected)
+    assert evs and evs[-1].action == "detected"  # wire-side: no file
+
+
+def test_sweep_and_audit_quarantine(tmp_path):
+    nested = tmp_path / "a" / "b"
+    nested.mkdir(parents=True)
+    bad = str(nested / ("f.arrow" + integrity.QUARANTINE_SUFFIX))
+    with open(bad, "wb") as f:
+        f.write(b"junk")
+    assert integrity.audit_quarantine_residue(str(tmp_path)) == [bad]
+    assert integrity.sweep_quarantined(str(tmp_path)) == 1
+    assert integrity.audit_quarantine_residue(str(tmp_path)) == []
+
+
+# ------------------------------------------------------------------ #
+# Fault actions: corrupt / truncate                                    #
+# ------------------------------------------------------------------ #
+def test_corrupt_action_flips_exactly_one_bit(tmp_path):
+    p = str(tmp_path / "victim")
+    data = os.urandom(8192)
+    with open(p, "wb") as f:
+        f.write(data)
+    with fault_scope("integrity.chunk:corrupt:1", seed=3):
+        maybe_inject("integrity.chunk", path=p)
+    with open(p, "rb") as f:
+        after = f.read()
+    assert len(after) == len(data)
+    diff = sum(bin(a ^ b).count("1") for a, b in zip(data, after))
+    assert diff == 1
+
+
+def test_truncate_action_halves_file(tmp_path):
+    p = str(tmp_path / "victim")
+    with open(p, "wb") as f:
+        f.write(os.urandom(1000))
+    with fault_scope("integrity.chunk:truncate:1", seed=0):
+        maybe_inject("integrity.chunk", path=p)
+    assert os.path.getsize(p) == 500
+
+
+# ------------------------------------------------------------------ #
+# Per-artifact corruption: chunks, spills, checkpoints                 #
+# ------------------------------------------------------------------ #
+def test_shuffle_chunk_corruption_detected_and_quarantined(mp, tmp_path, tap):
+    cfg = daft_tpu.get_context().execution_config.with_changes(
+        shuffle_chunk_bytes=2048)
+    cache = ShuffleCache([str(tmp_path)])
+    try:
+        ticket = cache.write_partition("shuf1", 0, mp, query_id="q1", cfg=cfg)
+        chunks = cache.partition_meta(ticket).chunks
+        assert len(chunks) > 1  # chunked: corruption is chunk-granular
+        _flip_byte(chunks[1].path)
+        with pytest.raises(DaftCorruptionError) as ei:
+            cache.read_partition(ticket)
+        assert ei.value.artifact == "chunk"
+        assert ei.value.ticket == chunks[1].ticket  # lineage-recovery key
+        residue = integrity.audit_quarantine_residue(cache.root)
+        assert residue == [chunks[1].path + integrity.QUARANTINE_SUFFIX]
+        assert tap.of(CorruptionDetected)
+        # Healthy chunks still read fine — one bad file, not a bad cache.
+        assert cache.read_chunk(chunks[0].ticket).num_rows > 0
+    finally:
+        cache.cleanup()
+    # cleanup swept the quarantine: nothing outlives the cache.
+    assert not os.path.exists(cache.root) or \
+        integrity.audit_quarantine_residue(cache.root) == []
+
+
+def test_shuffle_chunk_truncation_detected(mp, tmp_path):
+    cfg = daft_tpu.get_context().execution_config.with_changes(
+        shuffle_chunk_bytes=2048)
+    cache = ShuffleCache([str(tmp_path)])
+    try:
+        ticket = cache.write_partition("shuf1", 0, mp, query_id="q1", cfg=cfg)
+        chunk = cache.partition_meta(ticket).chunks[0]
+        with open(chunk.path, "r+b") as f:
+            f.truncate(os.path.getsize(chunk.path) // 2)
+        with pytest.raises(DaftCorruptionError):
+            cache.read_chunk(chunk.ticket)
+    finally:
+        cache.cleanup()
+
+
+def test_spill_file_corruption_detected(mp, tmp_path):
+    sd = SpillDir(root=str(tmp_path), query_id="q1")
+    try:
+        sf = sd.write(mp, chunk_rows=128)
+        assert sf.digest  # minted at write
+        _flip_byte(sf.path)
+        with pytest.raises(DaftCorruptionError) as ei:
+            list(sd.stream(sf))
+        assert ei.value.artifact == "spill"
+        assert os.path.exists(sf.path + integrity.QUARANTINE_SUFFIX)
+    finally:
+        sd.cleanup()
+    assert integrity.audit_quarantine_residue(str(tmp_path)) == []
+
+
+def test_spill_roundtrip_still_clean(mp, tmp_path):
+    sd = SpillDir(root=str(tmp_path), query_id="q1")
+    try:
+        sf = sd.write(mp, chunk_rows=128)
+        back = sd.read_all([sf])
+        assert back.to_pydict() == mp.to_pydict()
+    finally:
+        sd.cleanup()
+
+
+def test_checkpoint_bitflip_cold_start(tmp_path, tap):
+    """The satellite regression: a bit-flipped checkpoint state file must
+    read as ABSENT (cold start), never as silently-wrong view state."""
+    from daft_tpu.recordbatch import RecordBatch
+    from daft_tpu.streaming import ViewCheckpointStore
+
+    store = ViewCheckpointStore(str(tmp_path / "ck"))
+    batch = RecordBatch.from_pydict({"k": [1, 2], "v": [1.0, 2.0]})
+    store.save("v", {"cursor": 7}, [batch])
+    loaded = store.load("v")
+    assert loaded is not None and loaded["cursor"] == 7
+    assert loaded["state_digest"].startswith(("x1-", "c1-"))
+    spath = store._paths("v")[1]
+    _flip_byte(spath)
+    assert store.load("v") is None  # corruption == cold start
+    assert os.path.exists(spath + integrity.QUARANTINE_SUFFIX)
+    assert tap.of(CorruptionDetected)
+    store.clear("v")  # clear removes quarantined siblings too
+    assert integrity.audit_quarantine_residue(str(tmp_path)) == []
+
+
+def test_checkpoint_save_after_corruption_recovers(tmp_path):
+    """Cold start is recoverable: the next save overwrites cleanly and
+    the view reads back whole."""
+    from daft_tpu.recordbatch import RecordBatch
+    from daft_tpu.streaming import ViewCheckpointStore
+
+    store = ViewCheckpointStore(str(tmp_path / "ck"))
+    batch = RecordBatch.from_pydict({"k": [1], "v": [9.0]})
+    store.save("v", {"cursor": 1}, [batch])
+    _flip_byte(store._paths("v")[1])
+    assert store.load("v") is None
+    store.save("v", {"cursor": 2}, [batch])
+    again = store.load("v")
+    assert again is not None and again["cursor"] == 2
+
+
+# ------------------------------------------------------------------ #
+# Streaming sources: corrupt-JSONL accounting                          #
+# ------------------------------------------------------------------ #
+def test_append_log_counts_corrupt_lines(tmp_path, tap):
+    from daft_tpu.streaming import AppendLogSource
+
+    p = str(tmp_path / "events.jsonl")
+    good0 = json.dumps({"k": 0, "v": 1}) + "\n"
+    bad1 = "NOT JSON\n"
+    good2 = json.dumps({"k": 1, "v": 2}) + "\n"
+    bad3 = "{torn json\n"
+    with open(p, "w") as f:
+        f.write(good0 + bad1 + good2 + bad3)
+    src = AppendLogSource(p)
+    m0 = _counter("daft_streaming_corrupt_lines_total")
+    delta = src.poll()
+    assert [r["k"] for r in delta.rows] == [0, 1]  # good rows survive
+    assert src.corrupt_lines() == 2
+    assert _counter("daft_streaming_corrupt_lines_total") == m0 + 2
+    evs = tap.of(StreamCorruptLines)
+    assert len(evs) == 1  # one event per poll that saw any
+    assert evs[0].count == 2 and evs[0].path == p
+    assert evs[0].offsets == (len(good0),
+                              len(good0) + len(bad1) + len(good2))
+    src.commit(delta)
+    # Next poll: one more corrupt line -> second event, running tally 3.
+    with open(p, "a") as f:
+        f.write("also bad\n" + json.dumps({"k": 2, "v": 3}) + "\n")
+    d2 = src.poll()
+    assert [r["k"] for r in d2.rows] == [2]
+    assert src.corrupt_lines() == 3
+    assert len(tap.of(StreamCorruptLines)) == 2
+
+
+def test_clean_poll_emits_no_corrupt_event(tmp_path, tap):
+    from daft_tpu.streaming import AppendLogSource
+
+    p = str(tmp_path / "clean.jsonl")
+    with open(p, "w") as f:
+        f.write(json.dumps({"k": 0, "v": 1}) + "\n")
+    src = AppendLogSource(p)
+    src.poll()
+    assert src.corrupt_lines() == 0
+    assert tap.of(StreamCorruptLines) == []
+
+
+def test_view_stats_expose_corrupt_line_tally(tmp_path):
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from daft_tpu import plancache
+    from daft_tpu.streaming import (
+        AppendLogSource,
+        get_view_registry,
+        register_view,
+    )
+
+    d = str(tmp_path / "seed")
+    os.makedirs(d)
+    pq.write_table(pa.table({"k": [0, 1], "v": [1.0, 2.0]}),
+                   os.path.join(d, "part-000.parquet"))
+    p = str(tmp_path / "log.jsonl")
+    with open(p, "w") as f:
+        f.write(json.dumps({"k": 0, "v": 1.0}) + "\n")
+        f.write("corrupt line\n")
+        f.write(json.dumps({"k": 1, "v": 2.0}) + "\n")
+    try:
+        df = daft_tpu.read_parquet(os.path.join(d, "*.parquet"))
+        q = df.groupby("k").agg(col("v").sum().alias("s"))
+        view = register_view("integ_log", q, source=AppendLogSource(p))
+        stats = view.stats()
+        assert stats["corrupt_lines"] == 1  # the /api/views tally
+    finally:
+        get_view_registry().reset()
+        plancache.reset_caches()
+
+
+# ------------------------------------------------------------------ #
+# Lineage-healed reads: corruption -> recompute -> byte-identity       #
+# ------------------------------------------------------------------ #
+def _heal_dataset():
+    n = 600
+    return {
+        "a": list(range(n)),
+        "b": [f"k{i % 13}" for i in range(n)],
+        "c": [float((i * 37) % 101) for i in range(n)],
+    }
+
+
+def _heal_query(df):
+    return df.groupby("b").agg(
+        col("a").sum().alias("s"), col("c").sum().alias("t"),
+        col("a").count().alias("n")).sort("b")
+
+
+@pytest.fixture
+def dist_runner():
+    ctx = daft_tpu.get_context()
+    old = ctx._runner
+    runner = DistributedRunner(num_workers=3)
+    ctx.set_runner(runner)
+    yield runner
+    runner.manager.shutdown()
+    ctx.set_runner(old)
+
+
+def _flight_ctx(**overrides):
+    return daft_tpu.execution_config_ctx(
+        shuffle_algorithm="flight", shuffle_chunk_bytes=4096,
+        result_cache_enabled=False, **overrides)
+
+
+@pytest.mark.chaos
+@pytest.mark.parametrize("spec", [
+    "integrity.chunk:corrupt:2",
+    "integrity.chunk:truncate:1",
+])
+def test_corrupt_chunk_heals_byte_identical(dist_runner, tap, spec):
+    df = daft_tpu.from_pydict(_heal_dataset()).into_partitions(6)
+    with _flight_ctx():
+        clean = _heal_query(df).to_pydict()
+        with fault_scope(spec, seed=7):
+            healed = _heal_query(df).to_pydict()
+    assert healed == clean  # byte-identical: recomputed, not approximated
+    assert tap.of(CorruptionDetected)
+    assert tap.of(PartitionRecovered)
+    leaks = audit_shuffle_leaks()
+    assert leaks["files"] == 0
+    assert leaks["quarantined"] == []  # quarantine never outlives release
+
+
+@pytest.mark.chaos
+def test_corruption_never_marks_worker_dead(dist_runner, tap):
+    """A healthy host serving one bad file is NOT a dead host: recovery
+    recomputes the chunk without shrinking the fleet."""
+    from daft_tpu.subscribers.events import WorkerLost
+
+    df = daft_tpu.from_pydict(_heal_dataset()).into_partitions(6)
+    with _flight_ctx():
+        with fault_scope("integrity.chunk:corrupt:1", seed=11):
+            _heal_query(df).to_pydict()
+    assert tap.of(CorruptionDetected)
+    assert tap.of(WorkerLost) == []
+    assert len(dist_runner.manager.workers()) == 3  # fleet intact
+
+
+@pytest.mark.chaos
+def test_heal_byte_identity_one_vs_four_threads(dist_runner):
+    """Concurrent queries sharing a corrupted data plane all heal to the
+    same answer as a single-threaded run."""
+    df = daft_tpu.from_pydict(_heal_dataset()).into_partitions(6)
+    with _flight_ctx():
+        clean = _heal_query(df).to_pydict()
+        results, errors = [None] * 4, []
+
+        def run(i):
+            try:
+                results[i] = _heal_query(df).to_pydict()
+            except Exception as e:  # noqa: BLE001 — thread join surface
+                errors.append(e)
+
+        with fault_scope("integrity.chunk:corrupt:2,"
+                         "integrity.chunk:truncate:5", seed=13):
+            threads = [threading.Thread(target=run, args=(i,))
+                       for i in range(4)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=120)
+            assert not any(t.is_alive() for t in threads)
+    assert errors == []
+    assert all(r == clean for r in results)
+    leaks = audit_shuffle_leaks()
+    assert leaks["files"] == 0 and leaks["quarantined"] == []
+
+
+@pytest.mark.chaos
+@pytest.mark.parametrize("workers", [2, 8, 16])
+def test_heal_across_fleet_sizes(workers, tap):
+    ctx = daft_tpu.get_context()
+    old = ctx._runner
+    runner = DistributedRunner(num_workers=workers)
+    ctx.set_runner(runner)
+    try:
+        df = daft_tpu.from_pydict(_heal_dataset()).into_partitions(
+            max(6, workers))
+        with _flight_ctx():
+            clean = _heal_query(df).to_pydict()
+            with fault_scope("integrity.chunk:corrupt:1", seed=workers):
+                healed = _heal_query(df).to_pydict()
+        assert healed == clean
+        assert tap.of(CorruptionDetected)
+        leaks = audit_shuffle_leaks()
+        assert leaks["files"] == 0 and leaks["quarantined"] == []
+    finally:
+        runner.manager.shutdown()
+        ctx.set_runner(old)
+
+
+# ------------------------------------------------------------------ #
+# Wire classification: corruption survives process boundaries          #
+# ------------------------------------------------------------------ #
+def test_corruption_error_pickle_roundtrip():
+    import cloudpickle
+
+    e = DaftCorruptionError("chunk artifact corrupt: /x/c3.arrow",
+                            artifact="chunk", path="/x/c3.arrow",
+                            ticket="shuf1:0:c3")
+    for codec in (pickle, cloudpickle):
+        back = codec.loads(codec.dumps(e))
+        assert isinstance(back, DaftCorruptionError)
+        assert back.artifact == "chunk"
+        assert back.path == "/x/c3.arrow"
+        assert back.ticket == "shuf1:0:c3"
+        assert "corrupt" in str(back)
+
+
+@pytest.mark.chaos
+def test_process_worker_reply_keeps_corruption_type():
+    """A DaftCorruptionError raised INSIDE a worker subprocess crosses the
+    reply frame classified: the driver re-raises the typed error with
+    artifact / path / ticket intact, never an opaque string crash (and
+    never a transient retry)."""
+    from daft_tpu.distributed.scheduler import find_in_chain
+
+    ctx = daft_tpu.get_context()
+    old = ctx._runner
+    runner = DistributedRunner(num_workers=2, backend="process")
+    ctx.set_runner(runner)
+    try:
+        @daft_tpu.udf.func(return_dtype=daft_tpu.DataType.int64())
+        def poison(x):
+            from daft_tpu.errors import DaftCorruptionError
+
+            raise DaftCorruptionError(
+                "spill artifact corrupt: /w/s3.arrow", artifact="spill",
+                path="/w/s3.arrow", ticket="")
+
+        df = daft_tpu.from_pydict({"a": [1, 2, 3, 4]}).into_partitions(2)
+        with pytest.raises(Exception) as ei:
+            df.select(poison(col("a")).alias("p")).to_pydict()
+        corr = find_in_chain(ei.value, DaftCorruptionError)
+        assert corr is not None
+        assert corr.artifact == "spill"
+        assert corr.path == "/w/s3.arrow"
+    finally:
+        runner.manager.shutdown()
+        ctx.set_runner(old)
+
+
+@pytest.mark.chaos
+def test_daemon_wire_corruption_heals(monkeypatch, tap):
+    """Corruption detected DAEMON-side (a remote host's chunk store) must
+    classify across the Flight wire and heal through lineage recovery on
+    the driver — the full cross-host story."""
+    from daft_tpu.distributed import faults
+    from daft_tpu.distributed.daemon import (
+        RemoteWorker,
+        spawn_local_daemon,
+        wait_for_daemon,
+    )
+    from daft_tpu.distributed.worker import WorkerManager
+
+    ctx = daft_tpu.get_context()
+    old = ctx._runner
+    clean_df = daft_tpu.from_pydict(_heal_dataset()).into_partitions(4)
+    with _flight_ctx():
+        clean = _heal_query(clean_df).to_pydict()
+    faults.active_injector()  # pin the driver's env-spec cache to None
+    monkeypatch.setenv("DAFT_FAULT_SPEC", "integrity.chunk:corrupt:1")
+    monkeypatch.setenv("DAFT_FAULT_SEED", "23")
+    procs = [spawn_local_daemon(slots=2) for _ in range(2)]
+    try:
+        addrs = [wait_for_daemon(p) for p in procs]
+        mgr = WorkerManager([RemoteWorker(a) for a in addrs])
+        runner = DistributedRunner(manager=mgr)
+        ctx.set_runner(runner)
+        df = daft_tpu.from_pydict(_heal_dataset()).into_partitions(4)
+        with _flight_ctx():
+            healed = _heal_query(df).to_pydict()
+        assert healed == clean
+        # Recovery ran driver-side: proof the daemon's corruption crossed
+        # the wire as a classified chunk loss, not a dead host.
+        assert tap.of(PartitionRecovered)
+    finally:
+        ctx.set_runner(old)
+        for p in procs:
+            p.kill()
+
+
+# ------------------------------------------------------------------ #
+# Observability: metrics names, flight-record v5, EXPLAIN ANALYZE      #
+# ------------------------------------------------------------------ #
+def test_integrity_metric_names_pinned(tmp_path):
+    """The exposition names are API: dashboards pin them."""
+    p = str(tmp_path / "f")
+    with open(p, "wb") as f:
+        f.write(b"bytes")
+    d = integrity.hash_file(p)
+    integrity.verify_file(p, d, "chunk")
+    _flip_byte(p)
+    with pytest.raises(DaftCorruptionError):
+        integrity.verify_file(p, d, "chunk")
+    snap = metrics.get_registry().snapshot()
+    for name in ("daft_integrity_verified_total",
+                 "daft_integrity_failed_total",
+                 "daft_integrity_quarantined_total"):
+        assert snap.counter_total(name) > 0, name
+    labels = {lbl for lbl, _ in metrics.INTEGRITY_VERIFIED.series()}
+    assert ("chunk",) in labels
+
+
+@pytest.mark.chaos
+def test_flight_record_v5_integrity_block(dist_runner):
+    from daft_tpu.querylog import validate_record
+
+    df = daft_tpu.from_pydict(_heal_dataset()).into_partitions(6)
+    with _flight_ctx():
+        with fault_scope("integrity.chunk:corrupt:2", seed=5):
+            _heal_query(df).to_pydict()
+    rec = daft_tpu.recent_queries(1)[0]
+    assert validate_record(rec) == []
+    assert rec["schema_version"] == 5
+    integ = rec.get("integrity")
+    assert integ is not None
+    assert integ["failed"] >= 1
+    assert integ["verified"] >= 1
+    assert set(integ) == {"verified", "failed", "quarantined"}
+
+
+def test_flight_record_omits_block_without_traffic(make_df):
+    make_df({"x": list(range(32))}).agg(col("x").sum().alias("s")).collect()
+    rec = daft_tpu.recent_queries(1)[0]
+    assert rec["schema_version"] == 5
+    assert "integrity" not in rec  # optional: absent when the plane idled
+
+
+def test_explain_analyze_integrity_line(dist_runner, capsys):
+    df = daft_tpu.from_pydict(_heal_dataset()).into_partitions(4)
+    with _flight_ctx():
+        _heal_query(df).explain(analyze=True)
+    text = capsys.readouterr().out
+    assert "== Analyze ==" in text
+    assert "integrity: verified=" in text

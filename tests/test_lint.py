@@ -552,8 +552,8 @@ def test_text_reporter_mentions_location_and_counts():
 def test_rule_registry_complete():
     assert sorted(rules_by_id()) == [
         "DTL001", "DTL002", "DTL003", "DTL004", "DTL005", "DTL006", "DTL007",
-        "DTL008", "DTL009", "DTL010", "DTL011", "DTL012", "DTL013"]
-    assert len(default_rules()) == 13
+        "DTL008", "DTL009", "DTL010", "DTL011", "DTL012", "DTL013", "DTL014"]
+    assert len(default_rules()) == 14
     # The project tier is exactly the DTL011+ rules.
     tiers = {cls.rule_id: getattr(cls, "analysis", "file")
              for cls in rules_by_id().values()}
