@@ -497,6 +497,24 @@ def querylog_overhead_check(n: int = 6_000_000, reps: int = 10,
         drop_env=("DAFT_QUERY_LOG",))
 
 
+FEEDBACK_OVERHEAD_LIMIT_PCT = float(
+    os.environ.get("DAFT_FEEDBACK_OVERHEAD_LIMIT_PCT", "2.0"))
+
+
+def feedback_overhead_check(n: int = 6_000_000, reps: int = 10,
+                            rounds: int = 3) -> dict:
+    # Feedback plane (daft_tpu/feedback.py): estimate stamping at
+    # translate, per-node actual counting in the executor's batch path,
+    # the v6 estimates block, and the statistics-store feed — all keyed
+    # off DAFT_FEEDBACK, consulted per query, so the same in-process
+    # ABBA alternation holds. DAFT_FEEDBACK_PATH dropped so the guard
+    # measures observation, not JSONL persistence.
+    return _paired_overhead_check(
+        "DAFT_FEEDBACK", "feedback_overhead_pct",
+        FEEDBACK_OVERHEAD_LIMIT_PCT, n, reps, rounds,
+        drop_env=("DAFT_FEEDBACK_PATH",))
+
+
 # The integrity plane (daft_tpu/integrity.py) hashes every shuffle chunk
 # at write AND verifies at read — a per-byte cost, unlike the fixed-per-
 # query planes above, so its guard runs a genuinely shuffle-heavy query on
@@ -624,6 +642,15 @@ def main() -> None:
         if not rec["ok"]:
             sys.stderr.write(
                 f"flight-recorder overhead {rec['value']}% exceeds "
+                f"{rec['limit_pct']}% budget\n")
+            sys.exit(1)
+        return
+    if len(sys.argv) > 1 and sys.argv[1] == "--feedback-overhead":
+        rec = feedback_overhead_check()
+        print(json.dumps(rec))
+        if not rec["ok"]:
+            sys.stderr.write(
+                f"feedback plane overhead {rec['value']}% exceeds "
                 f"{rec['limit_pct']}% budget\n")
             sys.exit(1)
         return

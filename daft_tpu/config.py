@@ -297,6 +297,30 @@ class ExecutionConfig:
     # immediately after flush — a paranoid write-path knob for chaos runs.
     integrity_enabled: bool = True
     integrity_verify_on_write: bool = False
+    # Feedback-driven planning (daft_tpu/feedback.py). The observation
+    # plane is ON by default: the optimizer stamps its per-node row/byte
+    # estimates into the physical plan, the executor counts what each
+    # node actually produced, and every completed flight record (schema
+    # v6 ``estimates`` block) feeds the per-fingerprint statistics store
+    # (EWMA of observed cardinalities + peak memory). The CORRECTION
+    # plane — approx_stats/ReorderJoins overridden by observed
+    # cardinalities, admission reservations sized from observed peaks,
+    # estimate-driven mid-query strategy switches — is opt-in via
+    # feedback_correct_plans (plan-cache entries for corrected plans key
+    # on the store's stats epoch, so a feedback update re-plans instead
+    # of serving the stale plan). DAFT_FEEDBACK wins both directions:
+    # =1 enables observation AND corrections, =0 byte-identically
+    # restores today's planning (and is the <2% ABBA overhead guard's
+    # A/B lever). feedback_path (DAFT_FEEDBACK_PATH) persists the store
+    # as torn-line-safe JSONL; feedback_probe_factor is the observed-vs-
+    # estimated contradiction ratio that triggers a mid-query strategy
+    # switch (PlanCorrected event).
+    feedback_enabled: bool = True
+    feedback_correct_plans: bool = False
+    feedback_path: Optional[str] = None
+    feedback_ewma_alpha: float = 0.4
+    feedback_max_fingerprints: int = 512
+    feedback_probe_factor: float = 8.0
 
     def with_changes(self, **kwargs) -> "ExecutionConfig":
         return dataclasses.replace(self, **kwargs)
@@ -411,4 +435,13 @@ class ExecutionConfig:
             changes["integrity_enabled"] = False
         if daft_env_flag("DAFT_INTEGRITY_VERIFY_ON_WRITE", False):
             changes["integrity_verify_on_write"] = True
+        if os.environ.get("DAFT_FEEDBACK") is not None:
+            on = daft_env_flag("DAFT_FEEDBACK", True)
+            changes["feedback_enabled"] = on
+            changes["feedback_correct_plans"] = on
+        if os.environ.get("DAFT_FEEDBACK_PATH"):
+            changes["feedback_path"] = os.environ["DAFT_FEEDBACK_PATH"]
+        if os.environ.get("DAFT_FEEDBACK_PROBE_FACTOR"):
+            changes["feedback_probe_factor"] = float(
+                os.environ["DAFT_FEEDBACK_PROBE_FACTOR"])
         return cfg.with_changes(**changes) if changes else cfg

@@ -183,14 +183,16 @@ class AdmissionTicket:
 class _Waiter:
     """One query blocked in a tenant's admission queue."""
 
-    __slots__ = ("query_id", "tenant", "token", "admitted", "enqueued_at")
+    __slots__ = ("query_id", "tenant", "token", "admitted", "enqueued_at",
+                 "mem_hint")
 
-    def __init__(self, query_id: str, tenant: str, token):
+    def __init__(self, query_id: str, tenant: str, token, mem_hint=None):
         self.query_id = query_id
         self.tenant = tenant
         self.token = token
         self.admitted = False
         self.enqueued_at = time.monotonic()
+        self.mem_hint = mem_hint
 
 
 class _TenantState:
@@ -350,6 +352,24 @@ class AdmissionController:
         share = sink_budget(limit)
         return share or 0
 
+    def _share_for(self, cfg, quota: Optional[int],
+                   mem_hint: Optional[int]) -> int:
+        """The reservation one query charges against its tenant quota.
+        Without a hint: the static limit/4 sink share. With a hint — the
+        feedback store's OBSERVED peak for this query fingerprint — the
+        reservation is the observation padded 25% + 1 MiB (headroom for
+        drift), clamped to the quota so a hinted query is always
+        satisfiable. A fingerprint observed at 40 MB stops reserving a
+        2 GB limit's 512 MB share; PR 15's over_bytes counter is the
+        audit that this closes the reconciliation gap."""
+        share = self._mem_share(cfg)
+        if mem_hint is None or mem_hint <= 0:
+            return share
+        padded = int(mem_hint * 1.25) + (1 << 20)
+        if quota is not None:
+            padded = min(padded, quota)
+        return padded
+
     # -- overload signal --------------------------------------------------- #
     def _refresh_signals_locked(self, cfg) -> None:
         now = time.monotonic()
@@ -467,7 +487,8 @@ class AdmissionController:
 
     # -- admission --------------------------------------------------------- #
     def admit(self, query_id: str, tenant: Optional[str] = None,
-              token=None, cfg=None) -> AdmissionTicket:
+              token=None, cfg=None,
+              mem_hint: Optional[int] = None) -> AdmissionTicket:
         """Admit ``query_id`` for ``tenant``, blocking in the tenant's
         bounded queue when its quota is saturated. Raises
         ``DaftAdmissionError`` (fast), ``DaftCancelledError``, or
@@ -511,7 +532,8 @@ class AdmissionController:
             max_c = self._max_concurrent(pol, cfg)
             depth = self._queue_depth(pol, cfg)
             quota = self._mem_quota(pol, cfg)
-            share = self._mem_share(cfg) if quota is not None else 0
+            share = self._share_for(cfg, quota, mem_hint) \
+                if quota is not None else 0
             slots_free = (max_c <= 0 or len(st.running) < max_c)
             # Cache bytes do NOT gate here: they are reclaimable (evicted
             # below, outside the lock) — only live reservations can block.
@@ -570,7 +592,8 @@ class AdmissionController:
                         st, cfg, query_id, REASON_DEADLINE, events,
                         retry_after_s=est_wait)
                 else:
-                    waiter = _Waiter(query_id, tenant, token)
+                    waiter = _Waiter(query_id, tenant, token,
+                                     mem_hint=mem_hint)
                     st.queue.append(waiter)
                     qdepth = len(st.queue)
                     from daft_tpu import metrics
@@ -636,7 +659,8 @@ class AdmissionController:
                     pol = st.policy
                     max_c = self._max_concurrent(pol, cfg)
                     quota = self._mem_quota(pol, cfg)
-                    share = self._mem_share(cfg) if quota is not None else 0
+                    share = self._share_for(cfg, quota, waiter.mem_hint) \
+                        if quota is not None else 0
                     if quota is not None and share > quota:
                         # A mid-wait policy/limit change made the quota
                         # unsatisfiable: waiting longer can never succeed.

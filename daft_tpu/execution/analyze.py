@@ -162,10 +162,34 @@ def analyze_suffix(df) -> str:
                 f"shed_level={rec['shed_level']} "
                 f"fingerprint={rec['plan_fingerprint']}"
                 + (" [autoprofiled]" if rec.get("autoprofiled") else ""))
-    table = prof.operator_table() if prof is not None else []
+    # Planner estimates (daft_tpu/feedback.py): the optimizer's predicted
+    # cardinality per plan node rides on the v6 flight record; joined on
+    # the plan-node label below, the table shows est vs actual rows and
+    # the q-error per operator — the planner's report card.
+    est_by_label = {}
+    est_block = rec.get("estimates") if (prof is not None
+                                         and rec is not None) else None
+    if est_block:
+        for n in est_block.get("nodes", []):
+            est_by_label[n.get("label") or n.get("op")] = n
+        qerrs = [(n["qerr"], n.get("label") or n.get("op"))
+                 for n in est_block.get("nodes", [])
+                 if n.get("qerr") is not None]
+        if qerrs:
+            worst, worst_op = max(qerrs)
+            line = (f"planner: {len(qerrs)} ops estimated, "
+                    f"max q-err {worst:.1f}x ({worst_op})")
+            if est_block.get("corrected"):
+                line += (f" [feedback-corrected plan, "
+                         f"epoch {est_block.get('epoch', 0)}]")
+            lines.append(line)
+    # Plan-node granularity (HashJoin#3, not just HashJoin) so every row
+    # joins exactly ONE estimates node — two Filters never share a row.
+    table = prof.operator_table(by="plan_node") if prof is not None else []
     if table:
         lines.append("operators (by self time):")
-        lines.append(f"  {'operator':<22} {'rows':>10} {'wall_ms':>9} "
+        lines.append(f"  {'operator':<22} {'rows':>10} {'est_rows':>10} "
+                     f"{'q_err':>7} {'wall_ms':>9} "
                      f"{'self_ms':>9} {'cpu_ms':>8} {'spill':>10} "
                      f"{'permit_ms':>9} {'peak_mem':>10}")
         for r in table:
@@ -173,8 +197,15 @@ def analyze_suffix(df) -> str:
             # operator TYPE; a plan with several nodes of one type shares
             # the row — the waterfall view on /api/memory has the split).
             peak = (mem_by_op.get(r["operator"]) or {}).get("peak", 0)
+            en = est_by_label.get(r.get("plan_node", r["operator"]))
+            est_s, qerr_s = "-", "-"
+            if en is not None and en.get("est_rows") is not None:
+                est_s = str(int(en["est_rows"]))
+                if en.get("qerr") is not None:
+                    qerr_s = f"{en['qerr']:.1f}x"
             lines.append(
-                f"  {r['operator']:<22} {r['rows']:>10} "
+                f"  {r['operator']:<22} {r['rows']:>10} {est_s:>10} "
+                f"{qerr_s:>7} "
                 f"{r['wall_ns'] / 1e6:>9.1f} {r['self_wall_ns'] / 1e6:>9.1f} "
                 f"{r['self_cpu_ns'] / 1e6:>8.1f} {r['spill_bytes']:>10} "
                 f"{r['permit_wait_ns'] / 1e6:>9.1f} {peak:>10}")

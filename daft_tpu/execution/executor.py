@@ -139,6 +139,19 @@ class Executor:
             getattr(cfg, "min_morsel_size", 16 * 1024), self.max_morsel_rows)
         self._compute_pool: Optional[ThreadPoolExecutor] = None
         self._spill_dir = None
+        # Feedback plane (daft_tpu/feedback.py). Observation counts every
+        # stamped operator's actual rows/bytes (innermost wrapper — the
+        # counts are the operator's true output, before cancel/profile
+        # frames). Corrections additionally let runtime strategy choices
+        # consult the stamped estimates (grace bucket sizing, est-driven
+        # early spill). Both gates are resolved ONCE per executor: a
+        # mid-query env flip must not change strategy between operators.
+        from daft_tpu import feedback as _feedback
+
+        self._fb_observe = _feedback.observation_enabled(cfg)
+        self._fb_correct = _feedback.corrections_enabled(cfg)
+        self._fb_obs: Dict[int, dict] = {}
+        self._fb_root: Optional[pp.PhysicalPlan] = None
 
     def _spill(self):
         """Lazy query-scoped spill directory (cleaned up at query end)."""
@@ -178,6 +191,12 @@ class Executor:
         # re-executes the base 2^depth times.
         self._shared_ids = pp.shared_subtree_ids(plan)
         self._shared_cache = {}
+        # Re-runnable executors restart observation from zero; the root is
+        # kept so feedback_report can mark nodes below a Limit/TopN as
+        # inexact (their drained counts are truncated, not cardinalities).
+        self._fb_root = plan
+        with self._state_lock:
+            self._fb_obs = {}
         with self._state_lock:
             self._permits_closed = False  # executors are re-runnable
             self._live_iters: List = []
@@ -326,6 +345,8 @@ class Executor:
         if handler is None:
             raise DaftPlanError(f"No executor for physical node {node.name()}")
         it = self._track_iter(handler(node))
+        if self._fb_observe and getattr(node, "_fb_fp", None) is not None:
+            it = self._track_iter(self._fb_counted(node, it))
         if self.cancel_token is not None:
             it = self._track_iter(self._cancel_checked(node.name(), it))
         if self.profiler is not None:
@@ -342,6 +363,73 @@ class Executor:
             if live is not None:
                 live.append(it)
         return it
+
+    def _fb_counted(self, node: pp.PhysicalPlan,
+                    it: Iterator[MicroPartition]) -> Iterator[MicroPartition]:
+        """Count an operator's ACTUAL output rows/bytes against its stamped
+        estimate. One registered dict per physical node; the per-morsel
+        increments run on the single thread pulling this iterator."""
+        with self._state_lock:
+            rec = self._fb_obs.setdefault(id(node), {
+                "node": node._fb_fp, "op": type(node).__name__,
+                "est_rows": getattr(node, "_est_rows", None),
+                "est_bytes": getattr(node, "_est_bytes", None),
+                "rows": 0, "bytes": 0, "done": False})
+        for mp in it:
+            rec["rows"] += len(mp)
+            rec["bytes"] += mp.size_bytes()
+            yield mp
+        rec["done"] = True
+
+    def feedback_report(self, complete: bool = True) -> "Optional[list]":
+        """The estimate-vs-actual pairs for this run — one dict per
+        observed node, for the flight record's v6 ``estimates`` block. An
+        observation is ``exact`` only when the node fully drained, the
+        query fully drained (``complete``), and the node is not beneath a
+        Limit/TopN (early close truncates its counts): the store learns
+        only from exact observations, everything else is display-only."""
+        if not self._fb_observe:
+            return None
+        from daft_tpu import feedback
+
+        root = self._fb_root
+        truncated = feedback.truncated_ids(root) if root is not None else set()
+        with self._state_lock:
+            obs = {nid: dict(rec) for nid, rec in self._fb_obs.items()}
+            seqs = dict(self._profile_node_ids)
+        out = []
+        for nid, rec in sorted(obs.items(), key=lambda kv: kv[1]["node"]):
+            seq = seqs.get(nid)
+            out.append({
+                "node": rec["node"],
+                "op": rec["op"],
+                "label": f"{rec['op']}#{seq}" if seq is not None else rec["op"],
+                "est_rows": rec["est_rows"],
+                "est_bytes": rec["est_bytes"],
+                "rows": rec["rows"],
+                "bytes": rec["bytes"],
+                "exact": bool(rec["done"]) and bool(complete)
+                and nid not in truncated,
+            })
+        return out
+
+    def _fb_emit_correction(self, node, kind: str, estimated: float,
+                            observed: float, action: str) -> None:
+        """A runtime strategy switch driven by an estimate-vs-observation
+        contradiction: metered, evented, never fatal."""
+        try:
+            from daft_tpu import metrics
+            from daft_tpu.context import get_context
+            from daft_tpu.subscribers.events import PlanCorrected
+
+            metrics.PLAN_CORRECTED.labels(kind).inc()
+            get_context().notify(PlanCorrected(
+                query_id=self._ledger_qid,
+                node=getattr(node, "_fb_fp", "") or type(node).__name__,
+                kind=kind, estimated=float(estimated),
+                observed=float(observed), action=action))
+        except Exception:  # daftlint: disable=DTL002 -- observability, never a gate
+            pass
 
     def _cancel_checked(self, op: str,
                         it: Iterator[MicroPartition]) -> Iterator[MicroPartition]:
@@ -1145,6 +1233,16 @@ class Executor:
             probe = partial_of(first[:1])
             threshold = self.cfg.high_cardinality_aggregation_threshold
             if len(probe) > len(first[0]) * threshold:
+                # The first-chunk probe contradicted the planner's grouped-
+                # cardinality estimate (PR 8's adaptive switch) — surface
+                # the correction on the feedback plane. The switch itself
+                # stays purely data-driven: emission never gates it.
+                if self._fb_observe:
+                    self._fb_emit_correction(
+                        node, kind="agg-partition",
+                        estimated=getattr(node, "_est_rows", 0.0) or 0.0,
+                        observed=float(len(probe)),
+                        action="switched to partitioned aggregation")
                 yield from self._partitioned_agg(
                     node, fresh_state, itertools.chain([first], chunks))
                 return
@@ -1530,7 +1628,8 @@ class Executor:
     def _collect_or_grace(self, child: pp.PhysicalPlan, key_exprs, budget,
                           key_dtypes=None, num_buckets: Optional[int] = None,
                           source: Optional[Iterator[MicroPartition]] = None,
-                          op: str = "HashJoin"):
+                          op: str = "HashJoin",
+                          est_bytes: Optional[float] = None):
         """Materialize a join side in memory, or — once it outgrows the
         budget — hash-partition it by join key into disk buckets (grace hash
         join). ``key_dtypes`` are the UNIFIED join-key dtypes: both sides must
@@ -1538,11 +1637,23 @@ class Executor:
         byte-width-sensitive, so keys are cast before bucketing (the
         in-memory join casts the same way, recordbatch.py hash_join).
         ``source`` substitutes a pre-built child iterator (the hash join's
-        probe-side prefetch). Returns ("mem", MicroPartition) or
+        probe-side prefetch). ``est_bytes`` is the side's stamped planner
+        estimate: under corrections, a side whose buffered bytes already
+        contradict it by the probe factor engages grace EARLY — the
+        estimate said "fits easily", the data says otherwise, so stop
+        buffering toward the budget cliff. The trigger is a pure function
+        of the (thread-count-invariant) morsel stream and config, per the
+        PR 8 determinism contract. Returns ("mem", MicroPartition) or
         ("grace", GracePartitioner)."""
         if budget is None:
             return "mem", self._collect(child, source=source, op=op)
         from daft_tpu.execution.spill import GracePartitioner
+
+        probe_trip = None
+        if self._fb_correct and est_bytes:
+            factor = max(getattr(self.cfg, "feedback_probe_factor", 8.0), 1.0)
+            # 1 MiB floor: tiny estimates must not make tiny sides spill.
+            probe_trip = max(float(est_bytes) * factor, 1 << 20)
 
         key_fn = lambda rb: self._unified_keys(rb, key_exprs, key_dtypes)  # noqa: E731
         buffer: List[MicroPartition] = []
@@ -1555,7 +1666,13 @@ class Executor:
                 continue
             buffer.append(mp)
             buf_bytes += mp.size_bytes()
-            if buf_bytes > budget:
+            if buf_bytes > budget or \
+                    (probe_trip is not None and buf_bytes > probe_trip):
+                if buf_bytes <= budget:
+                    self._fb_emit_correction(
+                        child, kind="join-spill",
+                        estimated=float(est_bytes), observed=float(buf_bytes),
+                        action="engaged grace partitioning early")
                 grace = GracePartitioner(key_fn,
                                          num_buckets or self.GRACE_BUCKETS,
                                          self._spill(),
@@ -1635,10 +1752,41 @@ class Executor:
             if left_prefetch is not None:
                 left_prefetch.close()
 
+    def _fb_join_buckets(self, node: pp.PhysicalPlan, budget) -> int:
+        """Grace bucket count for one join. Default GRACE_BUCKETS; under
+        corrections, sized so each bucket of the LARGER estimated side
+        fits in half the sink budget (clamped to [GRACE_BUCKETS, 64]) — a
+        side the store observed at 10x the budget gets more, smaller
+        buckets instead of per-bucket overflow. Pure function of the
+        stamped estimates + config, so both sides and the merge loop
+        agree on it at any thread count."""
+        if not self._fb_correct or budget is None or budget <= 0:
+            return self.GRACE_BUCKETS
+        est = max(float(getattr(node.children[0], "_est_bytes", 0) or 0),
+                  float(getattr(node.children[1], "_est_bytes", 0) or 0))
+        if est <= 0:
+            return self.GRACE_BUCKETS
+        import math
+
+        nb = min(max(math.ceil(est / max(budget / 2.0, 1.0)),
+                     self.GRACE_BUCKETS), 64)
+        if nb != self.GRACE_BUCKETS:
+            self._fb_emit_correction(
+                node, kind="shuffle-buckets",
+                estimated=float(self.GRACE_BUCKETS), observed=float(nb),
+                action=f"scaled grace buckets to {nb}")
+        return nb
+
     def _hash_join_sides(self, node: pp.HashJoin, budget, key_dtypes,
                          left_prefetch) -> Iterator[MicroPartition]:
+        # ONE bucket count per join, used by every graced side, every
+        # in-memory partition_by_hash, and the merge loop below — equal
+        # keys must land in equal bucket indices on both sides.
+        nb = self._fb_join_buckets(node, budget)
         right_state, right_side = self._collect_or_grace(
-            node.children[1], node.right_on, budget, key_dtypes)
+            node.children[1], node.right_on, budget, key_dtypes,
+            num_buckets=nb,
+            est_bytes=getattr(node.children[1], "_est_bytes", None))
         if right_state == "mem" and node.how not in ("right", "outer"):
             from daft_tpu.execution.join_index import JoinIndex
 
@@ -1692,7 +1840,9 @@ class Executor:
         # build side forces grace mode for ALL join types.
         left_state, left_side = self._collect_or_grace(
             node.children[0], node.left_on, budget, key_dtypes,
-            source=iter(left_prefetch) if left_prefetch is not None else None)
+            num_buckets=nb,
+            source=iter(left_prefetch) if left_prefetch is not None else None,
+            est_bytes=getattr(node.children[0], "_est_bytes", None))
         if right_state == "mem" and left_state == "mem":
             left, right = left_side.combined(), right_side.combined()
             left_keys = [evaluate(e, left) for e in node.left_on]
@@ -1707,13 +1857,13 @@ class Executor:
         if right_state == "mem":
             rb = right_side.combined()
             keys = self._unified_keys(rb, node.right_on, key_dtypes)
-            right_side = rb.partition_by_hash(keys, self.GRACE_BUCKETS)
+            right_side = rb.partition_by_hash(keys, nb)
         if left_state == "mem":
             rb = left_side.combined()
             keys = self._unified_keys(rb, node.left_on, key_dtypes)
-            left_side = rb.partition_by_hash(keys, self.GRACE_BUCKETS)
+            left_side = rb.partition_by_hash(keys, nb)
         lschema, rschema = node.children[0].schema, node.children[1].schema
-        for b in range(self.GRACE_BUCKETS):
+        for b in range(nb):
             right = self._grace_bucket_rbs(right_side, b, rschema)
             if node.how in ("inner", "left", "semi", "anti"):
                 if len(right) == 0 and node.how in ("inner", "semi"):

@@ -1,0 +1,525 @@
+"""Feedback-driven planning: the per-fingerprint statistics store.
+
+Three PRs of telemetry (per-operator digests, reservation-vs-actual memory
+reconciliation, shuffle byte maps) were write-only; this module closes the
+loop (ROADMAP item 3). Every completed flight record's ``estimates`` block
+— the optimizer's predicted rows/bytes per plan node paired with what the
+executor actually observed — feeds a bounded per-query-fingerprint store
+(EWMA of observed cardinalities + peak memory). On the next arrival of the
+same query shape the optimizer's ``approx_stats`` is overridden by the
+observed values, ``ReorderJoins`` costs its DP masks with observed join
+cardinalities, and admission sizes its reservation from the observed peak.
+
+Identity scheme (the part that makes feedback survive its own
+corrections):
+
+* Queries key on the PRE-optimize :func:`plancache.compute_query_key`
+  fingerprint — stable even when feedback changes the optimized plan.
+* Plan nodes key on a content-derived fingerprint of their logical
+  subtree (:func:`node_fingerprint`) — stable across ``with_children``
+  rebuilds, which identity-keyed schemes are not.
+* Reorderable inner equi-join subtrees key on an ORDER-INSENSITIVE
+  "joinset" fingerprint (sorted base-relation fingerprints + sorted join
+  key names): the observed output cardinality of ``(A⋈B)⋈C`` matches the
+  DP mask ``{A,B,C}`` no matter which order a later plan joins them in.
+
+Epoch discipline: a material change to a fingerprint's statistics bumps
+its epoch; the runner keys plan-cache entries for corrected plans on
+``fp~e{epoch}``, so a feedback update re-plans instead of serving the
+stale plan (the RESULT cache stays keyed on the bare fingerprint —
+results are plan-invariant).
+
+Kill switch: ``DAFT_FEEDBACK`` wins both directions over the config knobs
+(the profiler's live-switch discipline — also the ABBA overhead guard's
+A/B lever). ``=0`` byte-identically restores estimate-only planning;
+``=1`` enables the correction plane on top of the default-on observation
+plane. Persistence is torn-line-safe JSONL per the BENCH_TRAJECTORY
+discipline: append-only snapshots, last valid line per fingerprint wins,
+torn tails are skipped, never fatal.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+from collections import OrderedDict
+from contextlib import contextmanager
+from contextvars import ContextVar
+from typing import Dict, Iterable, List, Optional, Tuple
+
+log = logging.getLogger("daft_tpu.feedback")
+
+#: Store snapshot-line schema (bump on incompatible change; the loader
+#: skips lines with an unknown version instead of failing).
+FEEDBACK_SCHEMA_VERSION = 1
+
+#: Per-fingerprint node budget: one query shape can't evict the fleet.
+MAX_NODES_PER_FINGERPRINT = 128
+
+#: Per-node ratio of new-vs-stored rows above which an observation is
+#: "material" — bumps the epoch (forcing a re-plan under corrections) and
+#: triggers a persistence snapshot. Below it the EWMA absorbs drift
+#: silently, so a converged shape keeps serving its cached plan.
+MATERIAL_CHANGE_RATIO = 1.25
+
+#: Compaction threshold for the JSONL store file: past this many bytes an
+#: append rewrites the file to one line per live fingerprint (atomic
+#: tmp+rename; readers still tolerate torn tails on the append path).
+_COMPACT_BYTES = 4 << 20
+
+
+# --------------------------------------------------------------------- #
+# Gates (DAFT_FEEDBACK wins both directions over the config knobs)       #
+# --------------------------------------------------------------------- #
+def observation_enabled(cfg=None) -> bool:
+    """Is the OBSERVATION plane on — estimate stamping, per-node actual
+    counting, the v6 ``estimates`` block, store feeding? Default on."""
+    from daft_tpu.config import daft_env, daft_env_flag
+
+    if daft_env("DAFT_FEEDBACK") is not None:
+        return daft_env_flag("DAFT_FEEDBACK", True)
+    return bool(getattr(cfg, "feedback_enabled", True))
+
+
+def corrections_enabled(cfg=None) -> bool:
+    """Is the CORRECTION plane on — observed-stat overrides in planning,
+    feedback-sized admission reservations, estimate-driven mid-query
+    strategy switches? Default OFF (``feedback_correct_plans``);
+    ``DAFT_FEEDBACK=1`` enables it, ``=0`` kills both planes."""
+    from daft_tpu.config import daft_env, daft_env_flag
+
+    if daft_env("DAFT_FEEDBACK") is not None:
+        return daft_env_flag("DAFT_FEEDBACK", True)
+    return bool(getattr(cfg, "feedback_correct_plans", False))
+
+
+# --------------------------------------------------------------------- #
+# Node identity                                                          #
+# --------------------------------------------------------------------- #
+def _expr_key(e) -> str:
+    try:
+        return repr(e.key())
+    except Exception:  # daftlint: disable=DTL002 -- identity helper must not raise
+        return repr(e)
+
+
+def _reorderable_join(n) -> bool:
+    """Mirror of ``ReorderJoins._reorderable`` — the eligibility rule and
+    this fingerprint scheme MUST agree, or observed join cardinalities
+    key differently from the DP masks that want them."""
+    from daft_tpu.logical import plan as lp
+
+    return (isinstance(n, lp.Join) and n.how == "inner"
+            and n.strategy in (None, "auto")
+            and all(e.column_refs() and not e.has_udf()
+                    and not e.has_subquery()
+                    for e in list(n.left_on) + list(n.right_on)))
+
+
+def joinset_fp(rel_fps: Iterable[str], key_names: Iterable[str]) -> str:
+    """Order-insensitive fingerprint of a join region: the sorted set of
+    base-relation fingerprints plus the sorted set of join-key texts.
+    ``(A⋈B)⋈C`` and ``(B⋈C)⋈A`` collapse to the same identity."""
+    from daft_tpu.plancache import fingerprint
+
+    return fingerprint("J[" + ",".join(sorted(rel_fps)) + "|"
+                       + ",".join(sorted(set(key_names))) + "]")
+
+
+def node_fingerprint(node) -> str:
+    """Content-derived fingerprint of one LOGICAL plan node (memoized on
+    the instance as ``_fb_nfp`` — underscore attrs are excluded from the
+    plan-cache canonical text, so the memo can't pollute query keys).
+    Reorderable inner equi-join subtrees get the joinset fingerprint;
+    everything else fingerprints its canonical subtree text (the plan
+    cache's own node canonicalization, so the two schemes can't drift)."""
+    memo = node.__dict__.get("_fb_nfp")
+    if memo is not None:
+        return memo
+    if _reorderable_join(node):
+        rels: List[object] = []
+        keys: List[str] = []
+
+        def collect(j) -> None:
+            for side in j.children():
+                if _reorderable_join(side):
+                    collect(side)
+                else:
+                    rels.append(side)
+            for l, r in zip(j.left_on, j.right_on):
+                keys.append(_expr_key(l))
+                keys.append(_expr_key(r))
+
+        collect(node)
+        fp = joinset_fp([node_fingerprint(r) for r in rels], keys)
+    else:
+        from daft_tpu import plancache
+
+        lines = []
+        for depth, n in plancache._walk_with_depth(node):
+            lines.append(f"{depth}:{plancache._node_text(n, [], None)}")
+        fp = plancache.fingerprint("\n".join(lines))
+    try:
+        node._fb_nfp = fp
+    except Exception:  # daftlint: disable=DTL002 -- slotted/foreign node: skip the memo
+        pass
+    return fp
+
+
+def qerror(est: float, actual: float) -> float:
+    """The planner's scale-free error measure: max(est/actual,
+    actual/est), both floored at one row. 1.0 = perfect, 28 = "est 1.2M
+    → actual 43k"."""
+    est = max(float(est), 1.0)
+    actual = max(float(actual), 1.0)
+    return max(est / actual, actual / est)
+
+
+# --------------------------------------------------------------------- #
+# Correction scope (ambient observed stats during optimize+translate)    #
+# --------------------------------------------------------------------- #
+#: {node_fp: (rows, bytes)} consulted by LogicalPlan.approx_stats and the
+#: ReorderJoins DP while a correction scope is active. A contextvar — not
+#: attribute stamping — because optimizer rules rebuild nodes with
+#: ``with_children`` and stamped attributes would not survive; the
+#: content-derived fingerprint does.
+_scope_var: "ContextVar[Optional[Dict[str, Tuple[float, float]]]]" = \
+    ContextVar("daft_feedback_scope", default=None)
+
+
+@contextmanager
+def correction_scope(stats: "Optional[Dict[str, Tuple[float, float]]]"):
+    """Make ``stats`` the ambient observed-cardinality map for the
+    duration (planning only — never held across execution)."""
+    if not stats:
+        yield
+        return
+    tok = _scope_var.set(stats)
+    try:
+        yield
+    finally:
+        _scope_var.reset(tok)
+
+
+def scope_stats() -> "Optional[Dict[str, Tuple[float, float]]]":
+    return _scope_var.get()
+
+
+def ambient_observed(node):
+    """Observed ApproxStats for ``node`` under the active correction
+    scope, or None (also None — the fast path, one contextvar read — when
+    no scope is active, which is every query with corrections off)."""
+    m = _scope_var.get()
+    if m is None:
+        return None
+    try:
+        obs = m.get(node_fingerprint(node))
+    except Exception:  # daftlint: disable=DTL002 -- estimation fallback, never a gate
+        return None
+    if obs is None:
+        return None
+    from daft_tpu.stats import ApproxStats
+
+    return ApproxStats(max(float(obs[0]), 1.0), max(float(obs[1]), 0.0))
+
+
+# --------------------------------------------------------------------- #
+# Estimate stamping (translate-time)                                     #
+# --------------------------------------------------------------------- #
+def stamp_estimates(physical, logical, cfg) -> None:
+    """Stamp the optimizer's predicted rows/bytes and the logical node's
+    feedback fingerprint onto the freshly translated physical node. Runs
+    inside any active correction scope, so stamped estimates reflect the
+    corrected statistics — q-error then measures the CORRECTED planner,
+    which is the convergence signal the dashboard plots."""
+    try:
+        if not observation_enabled(cfg):
+            return
+        st = logical.approx_stats()
+        physical._fb_fp = node_fingerprint(logical)
+        physical._est_rows = float(st.num_rows)
+        physical._est_bytes = float(st.size_bytes)
+    except Exception:  # noqa: BLE001 — estimates must never fail planning
+        log.debug("estimate stamping failed for %s",
+                  type(logical).__name__, exc_info=True)
+
+
+def truncated_ids(root) -> set:
+    """ids() of physical nodes strictly BELOW a Limit/TopN: their observed
+    row counts are truncated by the early close, real but not exact — the
+    estimates block marks them inexact and the store never learns them."""
+    from daft_tpu.physical import plan as pp
+
+    out: set = set()
+
+    def walk(n, below: bool) -> None:
+        if below:
+            out.add(id(n))
+        below = below or isinstance(n, (pp.Limit, pp.TopN))
+        for c in n.children:
+            walk(c, below)
+
+    walk(root, False)
+    return out
+
+
+# --------------------------------------------------------------------- #
+# The statistics store                                                   #
+# --------------------------------------------------------------------- #
+def _ratio(a: float, b: float) -> float:
+    a = max(float(a), 1.0)
+    b = max(float(b), 1.0)
+    return max(a / b, b / a)
+
+
+class FeedbackStore:
+    """Bounded per-query-fingerprint statistics: EWMA of observed
+    per-node rows/bytes + observed peak memory, hit counts, epochs.
+    LRU over ``max_fingerprints``; optionally persisted as torn-line-safe
+    JSONL (one snapshot line per material change, last line per
+    fingerprint wins on load)."""
+
+    def __init__(self, path: Optional[str] = None, alpha: float = 0.4,
+                 max_fingerprints: int = 512):
+        self.path = path
+        self.alpha = min(max(float(alpha), 0.05), 1.0)
+        self.max_fingerprints = max(int(max_fingerprints), 4)
+        self._lock = threading.Lock()
+        self._entries: "OrderedDict[str, dict]" = OrderedDict()
+        if path:
+            self._load(path)
+
+    # -- feeding ----------------------------------------------------------
+    def observe(self, record: dict) -> None:
+        """Absorb one completed flight record (v6 ``estimates`` block).
+        Partial drains (``complete=False``) and truncated nodes
+        (``exact=False``) are displayed but never learned — a limit-closed
+        filter's 100 observed rows say nothing about its cardinality."""
+        qfp = record.get("query_fingerprint") or ""
+        est = record.get("estimates") or {}
+        nodes = est.get("nodes") or []
+        if not qfp or not est.get("complete"):
+            return
+        peak = float((record.get("mem") or {}).get("peak_held_bytes") or 0)
+        with self._lock:
+            e = self._entry_locked(qfp)
+            material = False
+            was_seeded = e["seeded"]
+            for n in nodes:
+                rows = n.get("rows")
+                if not n.get("exact") or rows is None:
+                    continue
+                nd = e["nodes"].get(n["node"])
+                nbytes = float(n.get("bytes") or 0)
+                if nd is None:
+                    if len(e["nodes"]) >= MAX_NODES_PER_FINGERPRINT:
+                        continue
+                    e["nodes"][n["node"]] = {"op": n.get("op", "?"),
+                                             "rows": float(rows),
+                                             "bytes": nbytes, "n": 1}
+                    material = True
+                elif nd["n"] == 0:
+                    # Seeded value (tests / operator priors): the first
+                    # REAL observation replaces it outright — averaging
+                    # truth with a deliberately mis-stated seed would slow
+                    # convergence by exactly the seed's error.
+                    material = material or _ratio(nd["rows"], rows) \
+                        > MATERIAL_CHANGE_RATIO
+                    nd.update(rows=float(rows), bytes=nbytes, n=1)
+                else:
+                    a = self.alpha
+                    new_rows = (1 - a) * nd["rows"] + a * float(rows)
+                    material = material or _ratio(nd["rows"], new_rows) \
+                        > MATERIAL_CHANGE_RATIO
+                    nd["rows"] = new_rows
+                    nd["bytes"] = (1 - a) * nd["bytes"] + a * nbytes
+                    nd["n"] += 1
+            if peak > 0:
+                if e["peak_mem"] <= 0 or was_seeded:
+                    e["peak_mem"] = peak
+                else:
+                    e["peak_mem"] = (1 - self.alpha) * e["peak_mem"] \
+                        + self.alpha * peak
+            e["hits"] += 1
+            e["seeded"] = False
+            qe = [n["qerr"] for n in nodes
+                  if n.get("qerr") is not None and n.get("exact")]
+            if qe:
+                e["qerr_mean"] = round(sum(qe) / len(qe), 3)
+                e["qerr_max"] = round(max(qe), 3)
+            if est.get("corrected"):
+                e["corrected_runs"] = e.get("corrected_runs", 0) + 1
+            if material:
+                e["epoch"] += 1
+                self._persist_locked(e)
+        self._export_gauges()
+
+    def seed(self, qfp: str, nodes: "Dict[str, Tuple[float, float]]",
+             peak_mem: Optional[int] = None) -> None:
+        """Install prior statistics for a fingerprint (tests use this to
+        mis-state stats deliberately; operators could preload priors).
+        Seeded values are fully replaced by the first real observation."""
+        with self._lock:
+            e = self._entry_locked(qfp)
+            e["nodes"] = {nfp: {"op": "?", "rows": float(r),
+                                "bytes": float(b), "n": 0}
+                          for nfp, (r, b) in nodes.items()}
+            if peak_mem is not None:
+                e["peak_mem"] = float(peak_mem)
+            e["seeded"] = True
+            e["epoch"] += 1  # a cached plan for this shape must re-plan
+            self._persist_locked(e)
+        self._export_gauges()
+
+    def _entry_locked(self, qfp: str) -> dict:
+        e = self._entries.get(qfp)
+        if e is None:
+            e = {"fp": qfp, "hits": 0, "seeded": False, "epoch": 0,
+                 "peak_mem": 0.0, "nodes": {}}
+            self._entries[qfp] = e
+        self._entries.move_to_end(qfp)
+        while len(self._entries) > self.max_fingerprints:
+            self._entries.popitem(last=False)
+        return e
+
+    # -- consumption ------------------------------------------------------
+    def stats_for(self, qfp: str
+                  ) -> "Optional[Dict[str, Tuple[float, float]]]":
+        """{node_fp: (rows, bytes)} for a fingerprint, or None when the
+        store knows nothing — the correction scope's payload."""
+        with self._lock:
+            e = self._entries.get(qfp)
+            if e is None or not e["nodes"]:
+                return None
+            self._entries.move_to_end(qfp)
+            return {nfp: (nd["rows"], nd["bytes"])
+                    for nfp, nd in e["nodes"].items()}
+
+    def epoch(self, qfp: str) -> int:
+        with self._lock:
+            e = self._entries.get(qfp)
+            return e["epoch"] if e is not None else 0
+
+    def mem_hint(self, qfp: str) -> Optional[int]:
+        """Observed peak held bytes for a fingerprint (admission sizes its
+        reservation from this, clamped to policy), or None."""
+        with self._lock:
+            e = self._entries.get(qfp)
+            if e is None or e["peak_mem"] <= 0:
+                return None
+            return int(e["peak_mem"])
+
+    def summary(self) -> List[dict]:
+        """Per-fingerprint digest for the dashboard's Planner view."""
+        with self._lock:
+            return [{"fp": e["fp"], "hits": e["hits"], "epoch": e["epoch"],
+                     "seeded": e["seeded"], "nodes": len(e["nodes"]),
+                     "peak_mem": int(e["peak_mem"]),
+                     "qerr_mean": e.get("qerr_mean"),
+                     "qerr_max": e.get("qerr_max"),
+                     "corrected_runs": e.get("corrected_runs", 0)}
+                    for e in reversed(self._entries.values())]
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    # -- persistence (torn-line-safe JSONL) -------------------------------
+    def _persist_locked(self, e: dict) -> None:
+        if not self.path:
+            return
+        line = json.dumps({"v": FEEDBACK_SCHEMA_VERSION, **e},
+                          separators=(",", ":"), sort_keys=True)
+        try:
+            try:
+                if os.path.getsize(self.path) > _COMPACT_BYTES:
+                    self._compact_locked()
+            except OSError:
+                pass
+            with open(self.path, "a", encoding="utf-8") as f:
+                f.write(line + "\n")
+        except OSError:
+            log.warning("feedback store append failed (%s)", self.path,
+                        exc_info=True)
+
+    def _compact_locked(self) -> None:
+        tmp = f"{self.path}.tmp.{os.getpid()}"
+        with open(tmp, "w", encoding="utf-8") as f:
+            for e in self._entries.values():
+                f.write(json.dumps({"v": FEEDBACK_SCHEMA_VERSION, **e},
+                                   separators=(",", ":"), sort_keys=True)
+                        + "\n")
+        os.replace(tmp, self.path)
+
+    def _load(self, path: str) -> None:
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                raw = f.read()
+        except OSError:
+            return
+        for line in raw.splitlines():
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue  # torn tail / corrupt line: skip, never fatal
+            if not isinstance(rec, dict) \
+                    or rec.get("v") != FEEDBACK_SCHEMA_VERSION \
+                    or not rec.get("fp"):
+                continue
+            rec.pop("v", None)
+            rec.setdefault("hits", 0)
+            rec.setdefault("seeded", False)
+            rec.setdefault("epoch", 0)
+            rec.setdefault("peak_mem", 0.0)
+            rec.setdefault("nodes", {})
+            # Last valid line per fingerprint wins (append-only snapshots).
+            self._entries.pop(rec["fp"], None)
+            self._entries[rec["fp"]] = rec
+        while len(self._entries) > self.max_fingerprints:
+            self._entries.popitem(last=False)
+
+    def _export_gauges(self) -> None:
+        try:
+            from daft_tpu import metrics
+
+            metrics.FEEDBACK_FINGERPRINTS.set(len(self))
+        except Exception:  # daftlint: disable=DTL002 -- observability, never a gate
+            pass
+
+
+# --------------------------------------------------------------------- #
+# Process singleton                                                      #
+# --------------------------------------------------------------------- #
+_store: Optional[FeedbackStore] = None
+_store_lock = threading.Lock()
+
+
+def get_store(cfg=None) -> FeedbackStore:
+    """THE process statistics store (like the metrics registry). Path from
+    ``DAFT_FEEDBACK_PATH`` / ``cfg.feedback_path``; in-memory when
+    neither is set."""
+    global _store
+    with _store_lock:
+        if _store is None:
+            from daft_tpu.config import daft_env
+
+            path = daft_env("DAFT_FEEDBACK_PATH") \
+                or getattr(cfg, "feedback_path", None)
+            _store = FeedbackStore(
+                path=path,
+                alpha=getattr(cfg, "feedback_ewma_alpha", 0.4),
+                max_fingerprints=getattr(cfg, "feedback_max_fingerprints",
+                                         512))
+        return _store
+
+
+def reset_store() -> None:
+    """Drop the process store (tests)."""
+    global _store
+    with _store_lock:
+        _store = None

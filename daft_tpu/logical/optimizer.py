@@ -1511,6 +1511,38 @@ class ReorderJoins(Rule):
         rows = [max(r.approx_stats().num_rows, 1.0) for r in relations]
         ndv_cache: dict = {}
 
+        # Feedback override: when a correction scope is active, DP masks
+        # whose joinset fingerprint (order-insensitive: sorted relation
+        # fps + sorted key texts) matches an OBSERVED intermediate-join
+        # cardinality use the observation instead of the System-R
+        # estimate. Masks the store hasn't seen keep estimating — one
+        # observed run of a bad order is enough to re-cost every order.
+        from daft_tpu import feedback
+
+        fb = feedback.scope_stats()
+        rel_fps = None
+        if fb:
+            try:
+                rel_fps = [feedback.node_fingerprint(r) for r in relations]
+            except Exception:
+                _log.debug("join reorder: feedback fingerprints failed",
+                           exc_info=True)
+
+        def observed_rows(mask):
+            if not rel_fps:
+                return None
+            keys = []
+            for li, ri, le, re_ in edges:
+                if (mask >> li) & 1 and (mask >> ri) & 1:
+                    keys.append(feedback._expr_key(le))
+                    keys.append(feedback._expr_key(re_))
+            if not keys:
+                return None
+            fp = feedback.joinset_fp(
+                [rel_fps[i] for i in range(n) if (mask >> i) & 1], keys)
+            obs = fb.get(fp)
+            return max(float(obs[0]), 1.0) if obs is not None else None
+
         def ndv(idx, exprs):
             key = (idx, tuple(e.key() for e in exprs))
             if key not in ndv_cache:
@@ -1559,6 +1591,7 @@ class ReorderJoins(Rule):
         for mask in masks:
             if mask in best and bin(mask).count("1") == 1:
                 continue
+            mask_obs = observed_rows(mask) if rel_fps else None
             entry = None
             sub = (mask - 1) & mask
             while sub:
@@ -1573,6 +1606,8 @@ class ReorderJoins(Rule):
                         ca, ra, pa = best[a]
                         cb, rb, pb = best[b]
                         out_rows = max(ra * rb * sel, 1.0)
+                        if mask_obs is not None:
+                            out_rows = mask_obs
                         # cost: intermediate rows produced + build-side size
                         cost = ca + cb + out_rows + min(ra, rb)
                         if entry is None or cost < entry[0]:

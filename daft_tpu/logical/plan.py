@@ -43,7 +43,20 @@ class LogicalPlan:
 
     def approx_stats(self) -> ApproxStats:
         """Cardinality estimate used by join ordering / broadcast decisions
-        (reference: src/daft-logical-plan/src/stats.rs)."""
+        (reference: src/daft-logical-plan/src/stats.rs). When a feedback
+        correction scope is active (daft_tpu/feedback.py) and the store
+        has an observed cardinality for this node's content fingerprint,
+        the observation overrides the heuristic — nodes the store hasn't
+        seen still estimate, so corrections degrade gracefully to guesses
+        rather than all-or-nothing."""
+        from daft_tpu import feedback
+
+        obs = feedback.ambient_observed(self)
+        if obs is not None:
+            return obs
+        return self._approx_stats()
+
+    def _approx_stats(self) -> ApproxStats:
         if self._children:
             return self._children[0].approx_stats()
         return ApproxStats()
@@ -82,7 +95,7 @@ class InMemorySource(LogicalPlan):
     def multiline_display(self):
         return [f"InMemorySource: {len(self.partitions)} partitions"]
 
-    def approx_stats(self) -> ApproxStats:
+    def _approx_stats(self) -> ApproxStats:
         rows = sum(len(p) for p in self.partitions)
         size = sum(p.size_bytes() for p in self.partitions)
         return ApproxStats(rows, size)
@@ -120,7 +133,7 @@ class ScanSource(LogicalPlan):
             out.append(f"Limit pushdown = {self.pushdowns.limit}")
         return out
 
-    def approx_stats(self) -> ApproxStats:
+    def _approx_stats(self) -> ApproxStats:
         est = self.scan_info.estimate_rows_bytes()
         stats = ApproxStats(*est)
         if self.pushdowns.limit is not None and stats.num_rows > self.pushdowns.limit:
@@ -150,7 +163,7 @@ class Project(LogicalPlan):
     def multiline_display(self):
         return [f"Project: {', '.join(repr(e) for e in self.exprs[:6])}{'...' if len(self.exprs) > 6 else ''}"]
 
-    def approx_stats(self) -> ApproxStats:
+    def _approx_stats(self) -> ApproxStats:
         return self._children[0].approx_stats()
 
 
@@ -196,7 +209,7 @@ class Filter(LogicalPlan):
     def multiline_display(self):
         return [f"Filter: {self.predicate!r}"]
 
-    def approx_stats(self) -> ApproxStats:
+    def _approx_stats(self) -> ApproxStats:
         from daft_tpu.stats import estimate_selectivity
 
         return self._children[0].approx_stats().scaled(
@@ -215,7 +228,7 @@ class Limit(LogicalPlan):
     def multiline_display(self):
         return [f"Limit: {self.limit}" + (f" offset {self.offset}" if self.offset else "")]
 
-    def approx_stats(self) -> ApproxStats:
+    def _approx_stats(self) -> ApproxStats:
         s = self._children[0].approx_stats()
         if s.num_rows > self.limit:
             return s.scaled(self.limit / max(s.num_rows, 1))
@@ -348,7 +361,7 @@ class Aggregate(LogicalPlan):
     def multiline_display(self):
         return [f"Aggregate: {[e.name() for e in self.agg_exprs]} groupby={[g.name() for g in self.group_by]}"]
 
-    def approx_stats(self) -> ApproxStats:
+    def _approx_stats(self) -> ApproxStats:
         s = self._children[0].approx_stats()
         if not self.group_by:
             return ApproxStats(1, 1024)
@@ -412,7 +425,7 @@ class Concat(LogicalPlan):
     def with_children(self, children):
         return Concat(children)
 
-    def approx_stats(self) -> ApproxStats:
+    def _approx_stats(self) -> ApproxStats:
         stats = [c.approx_stats() for c in self._children]
         return ApproxStats(sum(s.num_rows for s in stats), sum(s.size_bytes for s in stats))
 
@@ -496,7 +509,7 @@ class Join(LogicalPlan):
     def multiline_display(self):
         return [f"Join[{self.how}]: on {[e.name() for e in self.left_on]}"]
 
-    def approx_stats(self) -> ApproxStats:
+    def _approx_stats(self) -> ApproxStats:
         l = self._children[0].approx_stats()
         r = self._children[1].approx_stats()
         rows = max(l.num_rows, r.num_rows)

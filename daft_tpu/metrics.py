@@ -922,6 +922,24 @@ QUERYLOG_DROPPED = _r.counter(
     "daft_querylog_dropped_total",
     "Flight records lost to recorder/sink failures (should stay 0)")
 
+# Feedback-driven planning (daft_tpu/feedback.py): the estimate-vs-actual
+# plane and the corrections it drives.
+PLANNER_QERROR = _r.histogram(
+    "daft_planner_qerror",
+    "Per-plan-node q-error max(est/actual, actual/est) from completed "
+    "flight records (1 = perfect estimate; log-scale buckets)",
+    buckets=exponential_buckets(1.0, 2.0, 12))
+PLAN_CORRECTED = _r.counter(
+    "daft_plan_corrected_total",
+    "Feedback-driven plan corrections, by kind (replan/agg-partition/"
+    "join-spill/shuffle-buckets)", ("kind",))
+FEEDBACK_FINGERPRINTS = _r.gauge(
+    "daft_feedback_fingerprints",
+    "Query fingerprints currently held by the planner statistics store")
+FEEDBACK_CORRECTED_PLANS = _r.counter(
+    "daft_feedback_corrected_plans_total",
+    "Queries planned under observed (feedback-corrected) statistics")
+
 # SLO plane (daft_tpu/slo.py). Tenant labels are caller-supplied, so every
 # tenant-labeled series is cardinality-capped (oldest-out) — the admission
 # plane's discipline.

@@ -24,6 +24,13 @@ def translate(node: lp.LogicalPlan, cfg, _memo=None) -> pp.PhysicalPlan:
         return hit
     out = _translate_one(node, cfg, _memo)
     _memo[id(node)] = out
+    # Feedback plane: stamp the optimizer's predicted cardinality and the
+    # logical node's content fingerprint onto the physical node, so the
+    # executor can pair predictions with observed row counts (flight
+    # record v6 `estimates` block) and the statistics store can learn.
+    from daft_tpu import feedback
+
+    feedback.stamp_estimates(out, node, cfg)
     return out
 
 

@@ -108,6 +108,12 @@ _NONPLANNING_FIELDS = frozenset({
     "streaming_max_batch_files", "streaming_max_batch_bytes",
     "streaming_poll_interval_s", "streaming_checkpoint_dir",
     "slo_staleness_p99_s",
+    # Feedback OBSERVATION knobs are runtime-only (stamping estimates
+    # changes no plan); feedback_correct_plans is deliberately absent —
+    # corrections change the optimized plan, so flipping the knob must
+    # key distinct plan-cache entries.
+    "feedback_enabled", "feedback_path", "feedback_ewma_alpha",
+    "feedback_max_fingerprints", "feedback_probe_factor",
 })
 
 #: Result-cache entry kinds. ``result`` and ``scan`` entries are built by
@@ -265,7 +271,13 @@ def _node_text(node, roots: List[str], note) -> str:
                 f"cols={node.schema.column_names()})")
     parts = [name]
     for k in sorted(vars(node)):
-        if k in ("_children", "_schema"):
+        if k.startswith("_"):
+            # Private attrs are engine bookkeeping, never plan content:
+            # _children/_schema are canonicalized elsewhere, and memo
+            # stamps (ReorderJoins' _reordered / _ndv_cache, feedback's
+            # _fb_nfp node fingerprints) land lazily on shared subtrees —
+            # including them would make a query's fingerprint depend on
+            # what ran before it.
             continue
         parts.append(f"{k}={_attr_text(vars(node)[k], note)}")
     return "(".join([parts[0], ";".join(parts[1:]) + ")"])

@@ -68,7 +68,7 @@ log = logging.getLogger("daft_tpu.querylog")
 #: ``integrity`` block (daft_tpu/integrity.py): digest verifications,
 #: failures and quarantines observed over the query's bracket — present
 #: only when the plane saw traffic, so plain queries pay no bytes.
-QUERYLOG_SCHEMA_VERSION = 5
+QUERYLOG_SCHEMA_VERSION = 6
 
 #: Outcome taxonomy — every query lands in exactly one bucket.
 OUTCOME_SUCCESS = "success"
@@ -97,7 +97,14 @@ RECORD_REQUIRED_V4 = RECORD_REQUIRED_V3 + ("view",)
 #: (only stamped when the integrity plane verified/failed/quarantined
 #: anything during the query), so the required pin is v4's.
 RECORD_REQUIRED_V5 = RECORD_REQUIRED_V4
-RECORD_REQUIRED = RECORD_REQUIRED_V5
+#: v6 likewise adds only OPTIONAL keys: the ``estimates`` block (per-node
+#: predicted-vs-observed rows/bytes + q-error, present when the feedback
+#: observation plane stamped the plan) and the top-level
+#: ``query_fingerprint`` (the PRE-optimize query key the statistics store
+#: learns under — stable across feedback-driven re-plans, unlike
+#: ``plan_fingerprint`` which hashes the OPTIMIZED plan).
+RECORD_REQUIRED_V6 = RECORD_REQUIRED_V5
+RECORD_REQUIRED = RECORD_REQUIRED_V6
 
 #: Ring capacity default; DAFT_QUERY_LOG_RING overrides at first use.
 DEFAULT_RING_SIZE = 512
@@ -187,7 +194,8 @@ class FlightEntry:
                  "plan_fingerprint", "admission_wait_s", "shed_level",
                  "shed_reason", "rows_out", "bytes_out", "profiled",
                  "autoprofiled", "plan_cache_hit", "result_cache_hit",
-                 "mem", "view", "_m0", "_recorder", "_done")
+                 "mem", "view", "estimates", "query_fp", "fb_corrected",
+                 "fb_epoch", "_m0", "_recorder", "_done")
 
     def __init__(self, query_id: str, tenant: str, runner: str, cfg,
                  recorder: "FlightRecorder"):
@@ -209,6 +217,10 @@ class FlightEntry:
         self.result_cache_hit = False
         self.mem: Dict[str, Any] = {}
         self.view: Dict[str, Any] = {}
+        self.estimates: Optional[Dict[str, Any]] = None
+        self.query_fp = ""
+        self.fb_corrected = False
+        self.fb_epoch = 0
         self._m0 = _counter_values()
         self._recorder = recorder
         self._done = False
@@ -246,6 +258,31 @@ class FlightEntry:
         field."""
         if view:
             self.view = dict(view)
+
+    def note_query_fp(self, fp: "str | None") -> None:
+        """The PRE-optimize query-key fingerprint (plancache
+        compute_query_key): the statistics store's learning key. Stable
+        across feedback-driven re-plans — the OPTIMIZED plan fingerprint
+        changes when a correction changes the plan, this one doesn't."""
+        if fp:
+            self.query_fp = fp
+
+    def note_feedback(self, corrected: bool, epoch: int) -> None:
+        """Did this query run a feedback-corrected plan, and under which
+        statistics epoch — the dashboard Planner view's 'which fingerprints
+        run corrected plans' column."""
+        self.fb_corrected = bool(corrected)
+        self.fb_epoch = int(epoch)
+
+    def note_estimates(self, nodes: "list | None",
+                       complete: bool = True) -> None:
+        """The executor's estimate-vs-actual report: one dict per stamped
+        physical node ({node, op, est_rows, est_bytes, rows, bytes,
+        exact}). ``complete=False`` marks a partial drain (early close) —
+        displayed, never learned."""
+        if nodes is None:
+            return
+        self.estimates = {"complete": bool(complete), "nodes": list(nodes)}
 
     def count(self, mp) -> None:
         """Per-yielded-partition output accounting (size_bytes is memoized
@@ -389,6 +426,31 @@ class FlightRecorder:
                  for k in ("verified", "failed", "quarantined")}
         if any(integ.values()):
             record["integrity"] = integ
+        # Schema-v6 OPTIONAL block: estimate-vs-actual per plan node. The
+        # q-error is computed HERE (not in the executor) so every consumer
+        # — store, EXPLAIN ANALYZE, dashboard, the daft_planner_qerror
+        # histogram — reads one canonical number per node.
+        if entry.query_fp:
+            record["query_fingerprint"] = entry.query_fp
+        if entry.estimates is not None:
+            from daft_tpu import feedback, metrics
+
+            nodes = []
+            for n in entry.estimates.get("nodes", []):
+                n = dict(n)
+                if n.get("est_rows") is not None and n.get("rows") is not None:
+                    n["qerr"] = round(
+                        feedback.qerror(n["est_rows"], n["rows"]), 3)
+                    if n.get("exact") and outcome == OUTCOME_SUCCESS:
+                        metrics.PLANNER_QERROR.observe(n["qerr"])
+                nodes.append(n)
+            record["estimates"] = {
+                "complete": bool(entry.estimates.get("complete"))
+                and outcome == OUTCOME_SUCCESS,
+                "corrected": entry.fb_corrected,
+                "epoch": entry.fb_epoch,
+                "nodes": nodes,
+            }
         self._publish(record, cfg=entry.cfg)
         return record
 
@@ -427,6 +489,18 @@ class FlightRecorder:
             slo.get_tracker().observe(record, cfg)
         except Exception:
             log.warning("SLO tracker failed to observe query %s",
+                        record.get("query_id"), exc_info=True)
+        # Feed the planner's statistics store under the same isolation
+        # contract as the SLO plane: the record already landed — a store
+        # bug must not read as a recorder failure.
+        try:
+            from daft_tpu import feedback
+
+            if record.get("estimates") and record.get("query_fingerprint") \
+                    and feedback.observation_enabled(cfg):
+                feedback.get_store(cfg).observe(record)
+        except Exception:
+            log.warning("feedback store failed to observe query %s",
                         record.get("query_id"), exc_info=True)
 
     def _resolve_sink(self, cfg=None) -> Optional[_QueryLogSink]:
@@ -513,8 +587,9 @@ def validate_record(rec: Any) -> List[str]:
     valid). Shared by the writer's tests and any reader that must not
     trust a torn tail line. Accepts EVERY schema version from v1
     (pre-cache) through v2 (cache-hit fields), v3 (the memory ``mem``
-    block), and v4 (the streaming ``view`` block) — a log written across
-    the upgrades loads whole."""
+    block), v4 (the streaming ``view`` block), v5 (optional ``integrity``
+    block), and v6 (optional ``estimates`` block + ``query_fingerprint``)
+    — a log written across the upgrades loads whole."""
     errs: List[str] = []
     if not isinstance(rec, dict):
         return [f"record is {type(rec).__name__}, not an object"]
@@ -522,15 +597,16 @@ def validate_record(rec: Any) -> List[str]:
     required = {1: RECORD_REQUIRED_V1,
                 2: RECORD_REQUIRED_V2,
                 3: RECORD_REQUIRED_V3,
-                4: RECORD_REQUIRED_V4}.get(version, RECORD_REQUIRED_V5)
+                4: RECORD_REQUIRED_V4,
+                5: RECORD_REQUIRED_V5}.get(version, RECORD_REQUIRED_V6)
     for key in required:
         if key not in rec:
             errs.append(f"missing key {key!r}")
     if errs:
         return errs
-    if version not in (1, 2, 3, 4, QUERYLOG_SCHEMA_VERSION):
+    if version not in (1, 2, 3, 4, 5, QUERYLOG_SCHEMA_VERSION):
         errs.append(f"schema_version {version!r} not in "
-                    f"(1, 2, 3, 4, {QUERYLOG_SCHEMA_VERSION})")
+                    f"(1, 2, 3, 4, 5, {QUERYLOG_SCHEMA_VERSION})")
     if rec["outcome"] not in OUTCOMES:
         errs.append(f"unknown outcome {rec['outcome']!r}")
     if not isinstance(rec.get("duration_s"), (int, float)) \

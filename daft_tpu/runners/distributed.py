@@ -141,6 +141,23 @@ class DistributedRunner(Runner):
         )
         from daft_tpu.runners.runner import enter_front_door
 
+        # Feedback-sized admission (see native.py): the pre-optimize query
+        # key is computed before the front door so the reservation can be
+        # hinted from the store's observed peak for this fingerprint.
+        pre_key = None
+        mem_hint = None
+        from daft_tpu import feedback
+
+        if feedback.corrections_enabled(cfg):
+            try:
+                from daft_tpu import plancache
+
+                pre_key = plancache.compute_query_key(builder.plan, cfg)
+                mem_hint = feedback.get_store(cfg).mem_hint(pre_key.fp)
+            except Exception:  # daftlint: disable=DTL002 -- feedback is never a gate
+                pre_key = None
+                mem_hint = None
+
         # One token per query, created on the driver by the shared
         # prologue (flight-recorder entry + explicit timeout > config
         # default > unbounded), then the admission front door BEFORE
@@ -148,7 +165,8 @@ class DistributedRunner(Runner):
         # ships with every Task, so worker-side executors inherit it (see
         # runner.py).
         token, ticket, cfg, fentry = enter_front_door(query_id, cfg, timeout,
-                                                      runner=self.name)
+                                                      runner=self.name,
+                                                      mem_hint=mem_hint)
         from daft_tpu.execution import memledger
         from daft_tpu.runners.runner import plan_with_caches
 
@@ -178,7 +196,8 @@ class DistributedRunner(Runner):
             # shared plan_with_caches helper; see runner.py). A result-
             # cache hit never dispatches a single task.
             physical, plan_repr, cached_parts, build = plan_with_caches(
-                builder, cfg, prof, fentry, token, ticket.tenant)
+                builder, cfg, prof, fentry, token, ticket.tenant,
+                key=pre_key)
             if fentry is not None and cached_parts is None:
                 # First moment the plan fingerprint exists: the tail
                 # sampler may recognize an armed slow shape and open a

@@ -38,11 +38,33 @@ class NativeRunner(Runner):
         )
         from daft_tpu.runners.runner import enter_front_door
 
+        # Feedback-sized admission: compute the query key BEFORE the front
+        # door so the reservation can be hinted from the statistics
+        # store's observed peak for this fingerprint. Safe to compute
+        # pre-admission (one plan walk, no optimizer pass), and the key is
+        # handed to plan_with_caches so nothing walks twice. The key stays
+        # valid after the shed ladder's thread cap because
+        # num_compute_threads is a non-planning config field.
+        pre_key = None
+        mem_hint = None
+        from daft_tpu import feedback
+
+        if feedback.corrections_enabled(cfg):
+            try:
+                from daft_tpu import plancache
+
+                pre_key = plancache.compute_query_key(builder.plan, cfg)
+                mem_hint = feedback.get_store(cfg).mem_hint(pre_key.fp)
+            except Exception:  # daftlint: disable=DTL002 -- feedback is never a gate
+                pre_key = None
+                mem_hint = None
+
         # Admission front door BEFORE planning (shared prologue: flight-
         # recorder entry + cancel token + admit + shed-ladder thread cap;
         # see runner.py).
         token, ticket, cfg, fentry = enter_front_door(query_id, cfg, timeout,
-                                                      runner=self.name)
+                                                      runner=self.name,
+                                                      mem_hint=mem_hint)
         from daft_tpu.execution import memledger
         from daft_tpu.runners.runner import plan_with_caches
 
@@ -73,7 +95,8 @@ class NativeRunner(Runner):
             # entirely; a claimed build handle follows the ticket's
             # finally discipline below.
             physical, plan_repr, cached_parts, build = plan_with_caches(
-                builder, cfg, prof, fentry, token, ticket.tenant)
+                builder, cfg, prof, fentry, token, ticket.tenant,
+                key=pre_key)
             if fentry is not None and cached_parts is None:
                 # The fingerprint exists only now — which is also the first
                 # moment the tail sampler can recognize a plan shape it
@@ -103,6 +126,8 @@ class NativeRunner(Runner):
         error_obj = None
         stream = None
         exec_stream = None
+        executor = None
+        drained = False
         register_query_token(query_id, token)
         try:
             if cached_parts is not None:
@@ -147,6 +172,7 @@ class NativeRunner(Runner):
                         if build is not None:
                             build.add(mp)
                         yield mp
+                    drained = True
                 if build is not None:
                     # Reached only on a FULL drain: a partial iteration
                     # (limit pushdown, abandoned generator) aborts in the
@@ -184,5 +210,17 @@ class NativeRunner(Runner):
             unregister_query_token(query_id)
             ctx.notify(QueryEnd(query_id=query_id,
                                 duration_s=time.perf_counter() - start, error=error))
+            # Harvest the estimate-vs-actual pairs into the flight record
+            # (the v6 estimates block) before it closes. A partial drain
+            # (early close, limit abandon) still reports — marked
+            # incomplete so the statistics store never learns from it.
+            if fentry is not None and executor is not None:
+                try:
+                    complete = drained and error_obj is None
+                    fentry.note_estimates(
+                        executor.feedback_report(complete=complete),
+                        complete=complete)
+                except Exception:  # daftlint: disable=DTL002 -- observability only
+                    pass
             prof_fin = profiling.end_query(query_id, error=error)
             querylog.finish_entry(fentry, error=error_obj, profile=prof_fin)

@@ -22,7 +22,7 @@ class PartitionCacheEntry:
 
 
 def enter_front_door(query_id: str, cfg, timeout: "float | None",
-                     runner: str = "native"):
+                     runner: str = "native", mem_hint: "int | None" = None):
     """The shared query prologue for BOTH runners: open the flight-recorder
     entry (daft_tpu/querylog.py — EVERY query gets exactly one record,
     including the ones rejected right here), create the one cancel token
@@ -55,7 +55,11 @@ def enter_front_door(query_id: str, cfg, timeout: "float | None",
         # or raise DaftAdmissionError / DaftCancelledError /
         # DaftTimeoutError — a shed query costs one lock acquisition,
         # never an optimizer pass or a worker round-trip.
-        ticket = get_controller().admit(query_id, token=token, cfg=cfg)
+        # mem_hint: the feedback store's observed peak for this query
+        # fingerprint — admission sizes the reservation from it (padded,
+        # clamped to policy) instead of the static limit/4 share.
+        ticket = get_controller().admit(query_id, token=token, cfg=cfg,
+                                        mem_hint=mem_hint)
     except BaseException as e:  # noqa: BLE001 — profile/record must not leak
         if entry is not None:
             # The failed admission IS the story for this record: a query
@@ -74,7 +78,7 @@ def enter_front_door(query_id: str, cfg, timeout: "float | None",
     return token, ticket, cfg, entry
 
 
-def plan_with_caches(builder, cfg, prof, fentry, token, tenant):
+def plan_with_caches(builder, cfg, prof, fentry, token, tenant, key=None):
     """The shared post-admission planning block for BOTH runners: result
     cache first, then plan cache, then (and only then) a real
     optimize+translate pass.
@@ -92,14 +96,25 @@ def plan_with_caches(builder, cfg, prof, fentry, token, tenant):
     * A **plan-cache hit** reuses the cached optimize+translate output;
       the ``daft.plan`` driver span is only entered on a miss, so the
       optimizer wall is literally absent from hit profiles.
+    * ``key`` lets the caller hand in a pre-computed query key (the
+      native runner computes one BEFORE admission to size the memory
+      reservation from the feedback store — no second plan walk here).
+    * Under feedback corrections (daft_tpu/feedback.py), a fingerprint
+      the statistics store has observed optimizes inside a correction
+      scope (observed cardinalities override ``approx_stats``), and its
+      PLAN-cache entries key on the stats epoch (``fp~eN``) — a material
+      statistics update re-plans instead of serving the stale plan. The
+      RESULT cache stays on the bare fingerprint: results are
+      plan-invariant.
     """
-    from daft_tpu import plancache
+    from daft_tpu import feedback, plancache
     from daft_tpu.physical.translate import translate
 
     use_plan = getattr(cfg, "plan_cache_enabled", True)
     use_result = getattr(cfg, "result_cache_enabled", True)
-    key = None
-    if use_plan or use_result:
+    fb_correct = feedback.corrections_enabled(cfg)
+    if key is None and (use_plan or use_result or fb_correct
+                        or feedback.observation_enabled(cfg)):
         try:
             key = plancache.compute_query_key(builder.plan, cfg)
         except Exception:  # noqa: BLE001
@@ -111,6 +126,23 @@ def plan_with_caches(builder, cfg, prof, fentry, token, tenant):
                 "query key computation failed; running uncached",
                 exc_info=True)
             key = None
+    if fentry is not None and key is not None:
+        fentry.note_query_fp(key.fp)
+
+    fb_scope = None
+    fb_epoch = 0
+    if fb_correct and key is not None:
+        try:
+            store = feedback.get_store(cfg)
+            fb_scope = store.stats_for(key.fp)
+            fb_epoch = store.epoch(key.fp)
+        except Exception:  # noqa: BLE001 — feedback is never a gate
+            import logging
+
+            logging.getLogger("daft_tpu.feedback").warning(
+                "feedback lookup failed; planning on estimates",
+                exc_info=True)
+            fb_scope = None
 
     handle = None
     if use_result and key is not None and key.result_cacheable:
@@ -151,7 +183,21 @@ def plan_with_caches(builder, cfg, prof, fentry, token, tenant):
 
     try:
         use_plan = use_plan and key is not None and key.plan_cacheable
-        pentry = plancache.get_plan_cache(cfg).get(key) if use_plan \
+        # Stats-epoch keying: a corrected fingerprint's plan entries live
+        # under fp~eN. A feedback update bumps N, so the next arrival
+        # misses here and re-plans under the fresher statistics; the old
+        # entry ages out by LRU.
+        plan_key = key
+        if fb_scope is not None and key is not None:
+            import dataclasses
+
+            plan_key = dataclasses.replace(key, fp=f"{key.fp}~e{fb_epoch}")
+            if fentry is not None:
+                fentry.note_feedback(corrected=True, epoch=fb_epoch)
+            from daft_tpu import metrics
+
+            metrics.FEEDBACK_CORRECTED_PLANS.inc()
+        pentry = plancache.get_plan_cache(cfg).get(plan_key) if use_plan \
             else None
         if pentry is not None:
             optimized_plan = pentry.optimized_plan
@@ -166,8 +212,14 @@ def plan_with_caches(builder, cfg, prof, fentry, token, tenant):
             with contextlib.ExitStack() as plan_st:
                 if prof is not None:
                     plan_st.enter_context(prof.driver_span("daft.plan"))
-                optimized = builder.optimize(cfg)
-                physical = translate(optimized.plan, cfg)
+                # Optimize AND translate under the correction scope: the
+                # DP join order costs with observed cardinalities, and the
+                # estimates stamped on the physical plan reflect the
+                # corrected statistics (q-error then measures the
+                # corrected planner — the convergence signal).
+                with feedback.correction_scope(fb_scope):
+                    optimized = builder.optimize(cfg)
+                    physical = translate(optimized.plan, cfg)
             optimized_plan = optimized.plan
             plan_repr = repr(optimized_plan)
             sources = plancache.source_fingerprints(optimized_plan) \
@@ -175,8 +227,23 @@ def plan_with_caches(builder, cfg, prof, fentry, token, tenant):
                 else []
             roots = key.roots if key is not None else []
             if use_plan:
-                plancache.get_plan_cache(cfg).put(key, optimized_plan,
+                plancache.get_plan_cache(cfg).put(plan_key, optimized_plan,
                                                   physical, plan_repr)
+            if fb_scope is not None:
+                try:
+                    from daft_tpu import metrics
+                    from daft_tpu.context import get_context
+                    from daft_tpu.subscribers.events import PlanCorrected
+
+                    metrics.PLAN_CORRECTED.labels("replan").inc()
+                    get_context().notify(PlanCorrected(
+                        query_id=getattr(token, "query_id", "") or "",
+                        fingerprint=key.fp if key is not None else "",
+                        kind="replan",
+                        action=f"planned under observed statistics "
+                               f"(epoch {fb_epoch})"))
+                except Exception:  # daftlint: disable=DTL002 -- observability only
+                    pass
         if handle is not None:
             handle.set_provenance(sources, roots, plan_repr)
     except BaseException:

@@ -79,7 +79,26 @@ class ApproxStats:
     size_bytes: float = 0.0
 
     def scaled(self, selectivity: float) -> "ApproxStats":
-        return ApproxStats(self.num_rows * selectivity, self.size_bytes * selectivity)
+        # Floor at one row (when the input had any): a chain of filters
+        # multiplying selectivities can otherwise estimate 0 rows, and a
+        # zero cardinality starves join ordering — every order containing
+        # the "empty" relation costs the same, so the DP's tie-break (not
+        # the data) picks the plan.
+        rows = self.num_rows * selectivity
+        if self.num_rows > 0:
+            rows = max(rows, 1.0)
+        return ApproxStats(rows, self.size_bytes * selectivity)
+
+
+#: Pinned selectivity constants (tests/test_feedback.py asserts these —
+#: repurposing a value means re-deriving every seeded q-error baseline).
+#: Every estimate_selectivity return is clamped into
+#: [SELECTIVITY_FLOOR, 1.0]: a predicate may be arbitrarily weird, but
+#: the estimate must never claim "no rows survive" (0 would starve join
+#: ordering the same way an unclamped ``scaled`` did) nor "more rows than
+#: arrived".
+UNKNOWN_SELECTIVITY = 0.25
+SELECTIVITY_FLOOR = 0.01
 
 
 def estimate_selectivity(expr) -> float:
@@ -87,15 +106,21 @@ def estimate_selectivity(expr) -> float:
     src/daft-logical-plan/src/stats.rs selectivity heuristics).
 
     eq -> 0.1, ranges -> 0.3, AND multiplies, OR saturating-adds,
-    NOT complements, is_null -> 0.05, anything else -> 0.25.
+    NOT complements, is_null -> 0.05, anything else ->
+    UNKNOWN_SELECTIVITY. The result is clamped to
+    [SELECTIVITY_FLOOR, 1.0].
     """
+    return min(max(_estimate_selectivity(expr), SELECTIVITY_FLOOR), 1.0)
+
+
+def _estimate_selectivity(expr) -> float:
     from daft_tpu.expressions.expr import BinaryOp, UnaryOp
 
     if isinstance(expr, BinaryOp):
         if expr.op == "and":
-            return estimate_selectivity(expr.left) * estimate_selectivity(expr.right)
+            return _estimate_selectivity(expr.left) * _estimate_selectivity(expr.right)
         if expr.op == "or":
-            return min(estimate_selectivity(expr.left) + estimate_selectivity(expr.right), 1.0)
+            return min(_estimate_selectivity(expr.left) + _estimate_selectivity(expr.right), 1.0)
         if expr.op == "eq":
             return 0.1
         if expr.op in ("lt", "le", "gt", "ge"):
@@ -104,9 +129,9 @@ def estimate_selectivity(expr) -> float:
             return 0.9
     if isinstance(expr, UnaryOp):
         if expr.op == "not":
-            return max(1.0 - estimate_selectivity(expr.child), 0.05)
+            return max(1.0 - _estimate_selectivity(expr.child), 0.05)
         if expr.op == "is_null":
             return 0.05
         if expr.op == "not_null":
             return 0.95
-    return 0.25
+    return UNKNOWN_SELECTIVITY

@@ -193,6 +193,45 @@ async function renderViews() {
   ).join("") || '<tr><td colspan="12" class="hint">no freshness samples yet</td></tr>';
 }
 
+async function renderPlanner() {
+  // Feedback-driven planning: the statistics store's digest, the q-error
+  // histogram (log-scale buckets, bar chart), and correction counters.
+  const d = await getJSON("/api/planner");
+  $("#planner-summary").innerHTML =
+    (d.enabled ? '<span class="ok">observing</span>'
+               : '<span class="err">observation off</span>') +
+    (d.corrections_enabled ? ' · <span class="ok">corrections ON</span>'
+                           : ' · corrections off') +
+    ` · ${d.fingerprints.length} fingerprints learned` +
+    ` · ${d.corrected_plans} corrected plans`;
+  const q = d.qerror || {};
+  const counts = q.bucket_counts || [];
+  const max = Math.max(1, ...counts);
+  const label = (i) => i === 0 ? `≤${q.bounds[0]}x`
+    : i >= q.bounds.length ? `>${q.bounds[q.bounds.length - 1]}x`
+    : `≤${q.bounds[i]}x`;
+  $("#qerr-hist").innerHTML = q.count
+    ? counts.map((n, i) =>
+        `<div class="lane"><span class="lane-label">${label(i)}</span>
+          <span class="track"><span class="gantt ${i >= 3 ? "err-bar" : ""}"
+            style="left:0;width:${Math.max(100 * n / max, n ? 0.5 : 0).toFixed(2)}%"
+            title="${n} node observations"></span></span></div>`).join("") +
+      `<p class="hint">${q.count} observations · mean ` +
+      `${(q.sum / q.count).toFixed(2)}x</p>`
+    : '<p class="hint">no completed estimates yet</p>';
+  $("#planner-fps tbody").innerHTML = (d.fingerprints || []).map((f) =>
+    `<tr><td>${esc(f.fp)}</td><td>${f.hits}</td><td>${f.epoch}</td>
+      <td>${f.nodes}</td><td>${fmtBytes(f.peak_mem)}</td>
+      <td>${f.qerr_mean != null ? f.qerr_mean.toFixed(2) + "x" : ""}</td>
+      <td class="${f.qerr_max >= 4 ? "err" : "ok"}">${f.qerr_max != null ? f.qerr_max.toFixed(1) + "x" : ""}</td>
+      <td>${f.corrected_runs}</td><td>${f.seeded ? "yes" : ""}</td></tr>`
+  ).join("") || '<tr><td colspan="9" class="hint">nothing learned yet</td></tr>';
+  const kinds = Object.entries(d.corrections || {});
+  $("#planner-corrections tbody").innerHTML = kinds.map(([k, n]) =>
+    `<tr><td>${esc(k)}</td><td>${n}</td></tr>`
+  ).join("") || '<tr><td colspan="2" class="hint">no corrections fired</td></tr>';
+}
+
 let memSelected = null;
 
 async function renderMemory() {
@@ -414,6 +453,7 @@ async function tick() {
     else if (view === "admission") await renderAdmission();
     else if (view === "cache") await renderCache();
     else if (view === "views") await renderViews();
+    else if (view === "planner") await renderPlanner();
     else if (view === "memory") await renderMemory();
     else if (view === "workers") await renderWorkers();
     else if (view === "fleet") await renderFleet();

@@ -522,6 +522,37 @@ class _Handler(BaseHTTPRequestHandler):
                     and full > 0 else None
             body = json.dumps({"views": rows}).encode()
             ctype = "application/json"
+        elif path == "/api/planner":
+            # Planner panel (daft_tpu/feedback.py): the statistics store's
+            # per-fingerprint digest (hits, epoch, learned nodes, mean/max
+            # q-error, corrected runs), the process-wide q-error histogram,
+            # and the correction counters — "which plans does the
+            # optimizer still mis-estimate, and which run corrected".
+            from daft_tpu import feedback, metrics
+
+            from daft_tpu.context import get_context
+
+            cfg = get_context().execution_config
+            snap = metrics.get_registry().snapshot()
+            qe = snap.raw.get("daft_planner_qerror") or {}
+            series = (qe.get("series") or [{}])[0]
+            corrections = snap.label_totals(
+                "daft_plan_corrected_total", "kind")
+            body = json.dumps({
+                "enabled": feedback.observation_enabled(cfg),
+                "corrections_enabled": feedback.corrections_enabled(cfg),
+                "fingerprints": feedback.get_store(cfg).summary(),
+                "qerror": {
+                    "bounds": series.get("bounds", []),
+                    "bucket_counts": series.get("bucket_counts", []),
+                    "sum": series.get("sum", 0.0),
+                    "count": series.get("count", 0),
+                },
+                "corrections": {k: int(v) for k, v in corrections.items()},
+                "corrected_plans": int(snap.counter_total(
+                    "daft_feedback_corrected_plans_total")),
+            }).encode()
+            ctype = "application/json"
         elif path == "/api/perf/trajectory":
             # Per-query wall series over the committed bench trajectory
             # (BENCH_TRAJECTORY.jsonl / DAFT_TRAJECTORY_PATH) — the

@@ -292,6 +292,24 @@ class CircuitClosed(Event):
 
 
 @dataclass
+class PlanCorrected(Event):
+    """The feedback plane changed a decision the optimizer's estimates got
+    wrong — a re-plan under observed statistics (kind="replan"), or a
+    mid-query strategy switch when the first-chunk probe contradicted the
+    estimate (kind="agg-partition" / "join-spill" / "shuffle-buckets").
+    The correction itself is observable: estimated vs observed carry the
+    contradiction that triggered it."""
+
+    query_id: str = ""
+    fingerprint: str = ""  # query fingerprint (pre-optimize key)
+    node: str = ""         # plan-node fingerprint or operator label
+    kind: str = ""         # replan | agg-partition | join-spill | shuffle-buckets
+    estimated: float = 0.0
+    observed: float = 0.0
+    action: str = ""       # human-readable decision ("switched to ...")
+
+
+@dataclass
 class OperatorStats(Event):
     query_id: str = ""
     operator: str = ""

@@ -906,6 +906,61 @@ def permit_leak_audit() -> str | None:
                 f"!= baseline {baseline}")
 
 
+def feedback_reservation_audit() -> str | None:
+    """Feedback-sized admission reservations (ISSUE 20): under a real
+    memory limit and a quota'd tenant, a repeated query's second run must
+    reserve from the statistics store's observed peak — strictly tighter
+    than the first run's static sink-budget share (the feedback-off
+    sizing: run one IS the off baseline, its fingerprint not yet in the
+    store), so the reservation-vs-peak mis-sizing measurably drops."""
+    from daft_tpu import feedback
+    from daft_tpu.context import execution_config_ctx
+    from daft_tpu.execution.resource_manager import memory_limit
+    from daft_tpu.querylog import get_recorder
+
+    prior = os.environ.get("DAFT_FEEDBACK")
+    os.environ["DAFT_FEEDBACK"] = "1"
+    try:
+        base = daft_tpu.from_pydict({
+            "fk": list(range(4_000)),
+            "fv": [float(i) for i in range(4_000)]})
+
+        def run() -> dict:
+            # Streaming-only plan (no blocking sink): the ledger's
+            # observed peak is the real working set, not a sink's
+            # budget reservation.
+            base.where(col("fv") > 10).select("fk", "fv").collect()
+            return get_recorder().recent(n=1)[0]
+
+        with memory_limit(128 << 20), \
+                execution_config_ctx(result_cache_enabled=False):
+            set_tenant_policy("default", max_memory_fraction=0.5)
+            rec1 = run()
+            hint = feedback.get_store().mem_hint(rec1["query_fingerprint"])
+            if not hint:
+                return ("feedback store recorded no peak-mem hint after "
+                        "the first run (observation plane dead?)")
+            rec2 = run()
+        m1, m2 = rec1["mem"], rec2["mem"]
+        r1, r2 = m1["reserved_bytes"], m2["reserved_bytes"]
+        if not (0 < r2 < r1):
+            return (f"feedback reservation {r2} not tighter than the "
+                    f"static share {r1}")
+        mis1 = m1["over_bytes"] + m1["under_bytes"]
+        mis2 = m2["over_bytes"] + m2["under_bytes"]
+        if mis2 >= mis1:
+            return (f"reservation mis-sizing did not drop with feedback "
+                    f"on: {mis2} >= {mis1}")
+        print(f"feedback reservations: static {r1} -> sized {r2} "
+              f"(mis-sizing {mis1} -> {mis2})")
+        return None
+    finally:
+        if prior is None:
+            os.environ.pop("DAFT_FEEDBACK", None)
+        else:
+            os.environ["DAFT_FEEDBACK"] = prior
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--queries", type=int, default=240,
@@ -1054,6 +1109,9 @@ def main() -> int:
     leak = permit_leak_audit()
     if leak:
         failures.append(leak)
+    fb_miss = feedback_reservation_audit()
+    if fb_miss:
+        failures.append(f"feedback reservation audit: {fb_miss}")
     gauges = scrape_queue_gauges(dash.url)
     if any(v != 0 for v in gauges.values()):
         failures.append(f"queue-depth gauges not at 0: {gauges}")

@@ -697,7 +697,7 @@ def test_flight_record_v5_integrity_block(dist_runner):
             _heal_query(df).to_pydict()
     rec = daft_tpu.recent_queries(1)[0]
     assert validate_record(rec) == []
-    assert rec["schema_version"] == 5
+    assert rec["schema_version"] == 6
     integ = rec.get("integrity")
     assert integ is not None
     assert integ["failed"] >= 1
@@ -708,7 +708,7 @@ def test_flight_record_v5_integrity_block(dist_runner):
 def test_flight_record_omits_block_without_traffic(make_df):
     make_df({"x": list(range(32))}).agg(col("x").sum().alias("s")).collect()
     rec = daft_tpu.recent_queries(1)[0]
-    assert rec["schema_version"] == 5
+    assert rec["schema_version"] == 6
     assert "integrity" not in rec  # optional: absent when the plane idled
 
 

@@ -125,7 +125,7 @@ def test_query_mem_block_and_zero_drain():
     assert led.total_held() == 0
     assert audit_ledger_leaks() == {}
     rec = daft_tpu.recent_queries(1)[0]
-    assert rec["schema_version"] == 5
+    assert rec["schema_version"] == 6
     mem = rec["mem"]
     assert mem["residual_bytes"] == 0
     assert mem["peak_held_bytes"] > 0

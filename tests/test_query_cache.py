@@ -518,14 +518,14 @@ def test_explain_analyze_prints_cache_lines(capsys):
     assert "plan cache: MISS" in out or "result cache: MISS" in out
 
 
-def test_schema_reader_accepts_v1_through_v5(tmp_path):
+def test_schema_reader_accepts_v1_through_v6(tmp_path):
     from daft_tpu.querylog import (
         QUERYLOG_SCHEMA_VERSION,
         load_query_log,
         validate_record,
     )
 
-    assert QUERYLOG_SCHEMA_VERSION == 5
+    assert QUERYLOG_SCHEMA_VERSION == 6
     v1 = {"schema_version": 1, "query_id": "q1", "tenant": "default",
           "runner": "native", "ts": 1.0, "outcome": "success",
           "duration_s": 0.1, "plan_fingerprint": "ab", "error_kind": "",
@@ -550,12 +550,26 @@ def test_schema_reader_accepts_v1_through_v5(tmp_path):
     assert validate_record(v5) == []
     assert validate_record(dict(v5, integrity={
         "verified": 3, "failed": 1, "quarantined": 1})) == []
+    # v6 golden pin: same required set again — the estimates block and
+    # query_fingerprint are OPTIONAL (stamped only when the feedback
+    # observation plane ran).
+    v6 = dict(v5, schema_version=6)
+    assert validate_record(v6) == []
+    assert validate_record(dict(v6, query_fingerprint="ab12",
+                                estimates={"complete": True,
+                                           "corrected": False, "epoch": 0,
+                                           "nodes": [{"node": "cd34",
+                                                      "op": "Filter",
+                                                      "est_rows": 100.0,
+                                                      "rows": 43,
+                                                      "qerr": 2.326,
+                                                      "exact": True}]})) == []
     # Records missing their version's new fields are invalid; unknown
     # versions rejected.
     assert validate_record(dict(v1, schema_version=2))
     assert validate_record(dict(v2, schema_version=3))
     assert validate_record(dict(v3, schema_version=4))
-    assert validate_record(dict(v4, schema_version=6))
+    assert validate_record(dict(v4, schema_version=7))
     p = tmp_path / "log.jsonl"
     with open(p, "w") as f:
         f.write(json.dumps(v1) + "\n")
@@ -566,13 +580,13 @@ def test_schema_reader_accepts_v1_through_v5(tmp_path):
     assert len(load_query_log(str(p))) == 4
 
 
-def test_live_records_are_schema_valid_v5():
+def test_live_records_are_schema_valid_v6():
     from daft_tpu.querylog import validate_record
 
     make_df(100, seed=13).agg(col("v").sum().alias("s")).collect()
     rec = daft_tpu.recent_queries(1)[0]
     assert validate_record(rec) == []
-    assert rec["schema_version"] == 5
+    assert rec["schema_version"] == 6
     assert isinstance(rec["plan_cache_hit"], bool)
     assert isinstance(rec["result_cache_hit"], bool)
     assert isinstance(rec["mem"], dict)

@@ -217,7 +217,7 @@ def test_refresh_runs_through_front_door_with_v4_record(tmp_path):
     refresh_recs = [r for r in recs if r["view"].get("role") == "refresh"]
     assert refresh_recs and refresh_recs[0]["view"]["view"] == "governed"
     assert refresh_recs[0]["outcome"] == "success"
-    assert refresh_recs[0]["schema_version"] == 5
+    assert refresh_recs[0]["schema_version"] == 6
 
 
 def test_view_cache_entry_kind_and_pending_writes(tmp_path):
